@@ -1,0 +1,30 @@
+"""pinot_tpu — a TPU-native real-time distributed OLAP framework.
+
+Capabilities modeled on Apache Pinot (reference: /root/reference), redesigned
+idiomatically for TPUs: columnar segments live as pytrees of device arrays,
+per-segment query execution (predicate masks -> projection -> transform ->
+aggregate/group-by) compiles to fused XLA programs, per-segment partials merge
+via ICI collectives inside shard_map, and SQL planning / routing / ingestion /
+cluster management stay host-side.
+
+Layer map (mirrors SURVEY.md L0-L10):
+  common/   - schema, config, types              (ref: pinot-spi)
+  segment/  - columnar format, dictionaries,
+              stats, builder, loader             (ref: pinot-segment-spi/-local)
+  query/    - SQL parser, context, planner,
+              per-segment engine, reduce         (ref: pinot-core query engine)
+  parallel/ - device mesh, sharded combine       (ref: combine/scatter-gather)
+"""
+
+import os
+
+# Pinot semantics require LONG/DOUBLE (64-bit) columns and accumulators.
+# JAX defaults to 32-bit; enable x64 unless explicitly disabled. The engine
+# still downcasts per-platform (TPU has no f64 compute) via dtype policy in
+# query/plan.py.
+if os.environ.get("PINOT_TPU_NO_X64", "0") != "1":
+    import jax
+
+    jax.config.update("jax_enable_x64", True)
+
+__version__ = "0.1.0"

@@ -1,0 +1,12 @@
+from pinot_tpu.common.types import DataType, FieldSpec, FieldType, Schema
+from pinot_tpu.common.config import IndexingConfig, TableConfig, TableType
+
+__all__ = [
+    "DataType",
+    "FieldSpec",
+    "FieldType",
+    "Schema",
+    "IndexingConfig",
+    "TableConfig",
+    "TableType",
+]

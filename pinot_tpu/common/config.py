@@ -1,0 +1,117 @@
+"""Table configuration (indexing / encoding choices per column).
+
+Reference parity: pinot-spi/.../config/table/TableConfig.java:38 (tableType,
+indexing config, noDictionaryColumns, sortedColumn, invertedIndexColumns,
+starTree configs). Only the pieces the TPU engine consumes are modeled;
+unknown keys round-trip through `extra` for forward compatibility.
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+from enum import Enum
+
+
+class TableType(Enum):
+    OFFLINE = "OFFLINE"
+    REALTIME = "REALTIME"
+
+
+@dataclass
+class StarTreeIndexConfig:
+    """Parity with StarTreeIndexConfig (dimensionsSplitOrder,
+    functionColumnPairs, maxLeafRecords)."""
+
+    dimensions_split_order: list[str] = field(default_factory=list)
+    function_column_pairs: list[str] = field(default_factory=list)  # e.g. "SUM__revenue"
+    max_leaf_records: int = 10000
+
+    def to_dict(self) -> dict:
+        return {
+            "dimensionsSplitOrder": self.dimensions_split_order,
+            "functionColumnPairs": self.function_column_pairs,
+            "maxLeafRecords": self.max_leaf_records,
+        }
+
+    @staticmethod
+    def from_dict(d: dict) -> "StarTreeIndexConfig":
+        return StarTreeIndexConfig(
+            d.get("dimensionsSplitOrder", []),
+            d.get("functionColumnPairs", []),
+            d.get("maxLeafRecords", 10000),
+        )
+
+
+@dataclass
+class IndexingConfig:
+    # Columns stored raw (no dictionary). Default: metrics raw, dims dict-encoded.
+    no_dictionary_columns: list[str] = field(default_factory=list)
+    dictionary_columns: list[str] = field(default_factory=list)
+    inverted_index_columns: list[str] = field(default_factory=list)
+    range_index_columns: list[str] = field(default_factory=list)
+    bloom_filter_columns: list[str] = field(default_factory=list)
+    sorted_column: str | None = None
+    star_tree_configs: list[StarTreeIndexConfig] = field(default_factory=list)
+
+    def to_dict(self) -> dict:
+        return {
+            "noDictionaryColumns": self.no_dictionary_columns,
+            "dictionaryColumns": self.dictionary_columns,
+            "invertedIndexColumns": self.inverted_index_columns,
+            "rangeIndexColumns": self.range_index_columns,
+            "bloomFilterColumns": self.bloom_filter_columns,
+            "sortedColumn": self.sorted_column,
+            "starTreeConfigs": [c.to_dict() for c in self.star_tree_configs],
+        }
+
+    @staticmethod
+    def from_dict(d: dict) -> "IndexingConfig":
+        return IndexingConfig(
+            no_dictionary_columns=d.get("noDictionaryColumns", []),
+            dictionary_columns=d.get("dictionaryColumns", []),
+            inverted_index_columns=d.get("invertedIndexColumns", []),
+            range_index_columns=d.get("rangeIndexColumns", []),
+            bloom_filter_columns=d.get("bloomFilterColumns", []),
+            sorted_column=d.get("sortedColumn"),
+            star_tree_configs=[StarTreeIndexConfig.from_dict(c) for c in d.get("starTreeConfigs", [])],
+        )
+
+
+@dataclass
+class TableConfig:
+    table_name: str
+    table_type: TableType = TableType.OFFLINE
+    indexing: IndexingConfig = field(default_factory=IndexingConfig)
+    # Replication / routing knobs arrive with the cluster layer.
+    replication: int = 1
+    time_column: str | None = None
+    extra: dict = field(default_factory=dict)
+
+    @property
+    def table_name_with_type(self) -> str:
+        return f"{self.table_name}_{self.table_type.value}"
+
+    def to_json(self) -> str:
+        return json.dumps(
+            {
+                "tableName": self.table_name,
+                "tableType": self.table_type.value,
+                "indexing": self.indexing.to_dict(),
+                "replication": self.replication,
+                "timeColumn": self.time_column,
+                "extra": self.extra,
+            }
+        )
+
+    @staticmethod
+    def from_json(s: str) -> "TableConfig":
+        d = json.loads(s)
+        return TableConfig(
+            table_name=d["tableName"],
+            table_type=TableType(d.get("tableType", "OFFLINE")),
+            indexing=IndexingConfig.from_dict(d.get("indexing", {})),
+            replication=d.get("replication", 1),
+            time_column=d.get("timeColumn"),
+            extra=d.get("extra", {}),
+        )

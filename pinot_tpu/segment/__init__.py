@@ -1,0 +1,15 @@
+from pinot_tpu.segment.dictionary import Dictionary
+from pinot_tpu.segment.stats import ColumnStats
+from pinot_tpu.segment.builder import SegmentBuilder
+from pinot_tpu.segment.segment import ColumnIndex, DeviceSegment, ImmutableSegment
+from pinot_tpu.segment.loader import load_segment
+
+__all__ = [
+    "Dictionary",
+    "ColumnStats",
+    "SegmentBuilder",
+    "ColumnIndex",
+    "DeviceSegment",
+    "ImmutableSegment",
+    "load_segment",
+]

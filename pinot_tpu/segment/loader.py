@@ -1,0 +1,47 @@
+"""Segment loading: disk -> ImmutableSegment (host) -> DeviceSegment (HBM).
+
+Reference parity: ImmutableSegmentLoader + SegmentPreProcessor
+(pinot-segment-local/.../segment/index/loader/SegmentPreProcessor.java:59) and
+mmap via PinotDataBuffer. Redesigned: numpy-mmap the npz members, reconstruct
+dictionaries/stats from metadata, and stage to device with `to_device()` when
+the segment is assigned to a query-serving mesh.
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+
+import numpy as np
+
+from pinot_tpu.common.types import DataType, Schema
+from pinot_tpu.segment.builder import FORMAT_VERSION
+from pinot_tpu.segment.dictionary import Dictionary
+from pinot_tpu.segment.segment import ColumnIndex, ImmutableSegment
+from pinot_tpu.segment.stats import ColumnStats
+
+
+def load_segment(seg_dir: str | Path) -> ImmutableSegment:
+    seg_dir = Path(seg_dir)
+    meta = json.loads((seg_dir / "metadata.json").read_text())
+    version = meta.get("formatVersion")
+    if version != FORMAT_VERSION:
+        raise ValueError(f"segment {seg_dir} has formatVersion {version}, expected {FORMAT_VERSION}")
+    schema = Schema.from_json(json.dumps(meta["schema"]))
+    seg = ImmutableSegment(name=meta["segmentName"], schema=schema, n_docs=meta["numDocs"])
+    with np.load(seg_dir / "columns.npz", allow_pickle=False) as npz:
+        for cm in meta["columns"]:
+            col = cm["name"]
+            stats = ColumnStats.from_dict(cm["stats"])
+            dt = DataType(cm["stats"]["dataType"])
+            fwd = npz[f"fwd::{col}"]
+            dictionary = None
+            if cm["encoding"] == "DICT":
+                dv = npz[f"dict::{col}"]
+                if dt == DataType.BYTES:
+                    dv = np.asarray([bytes.fromhex(str(v)) for v in dv], dtype=object)
+                elif dt in (DataType.STRING, DataType.JSON):
+                    dv = dv.astype(object)
+                dictionary = Dictionary(dt, dv)
+            seg.columns[col] = ColumnIndex(col, dt, dictionary, fwd, stats)
+    return seg

@@ -1,0 +1,14 @@
+"""Test env: force CPU platform with 8 virtual devices so multi-chip sharding
+paths compile and execute without TPU hardware (SURVEY environment notes).
+
+Must run before jax is imported anywhere.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+import pinot_tpu  # noqa: E402,F401  (enables x64, must precede jax use)

@@ -1,0 +1,145 @@
+"""Segment layer unit tests (parity model: pinot-segment-local reader/creator
+tests, e.g. ImmutableDictionaryTest, SegmentGenerationWithNullValueVectorTest)."""
+
+import numpy as np
+import pytest
+
+from pinot_tpu.common import DataType, IndexingConfig, Schema, TableConfig
+from pinot_tpu.segment import Dictionary, SegmentBuilder, load_segment
+from pinot_tpu.segment.builder import write_segment
+from pinot_tpu.segment.segment import padded_len
+
+
+@pytest.fixture
+def schema():
+    return Schema.build(
+        "t",
+        dimensions=[("league", DataType.STRING), ("year", DataType.INT), ("team", DataType.STRING)],
+        metrics=[("runs", DataType.LONG), ("avg", DataType.DOUBLE)],
+    )
+
+
+@pytest.fixture
+def data():
+    rng = np.random.default_rng(0)
+    n = 5000
+    return {
+        "league": np.array(["NL", "AL", "XX"], dtype=object)[rng.integers(0, 3, n)],
+        "year": rng.integers(1900, 2020, n).astype(np.int32),
+        "team": np.array([f"T{i:03d}" for i in range(40)], dtype=object)[rng.integers(0, 40, n)],
+        "runs": rng.integers(0, 10_000, n).astype(np.int64),
+        "avg": rng.random(n),
+    }
+
+
+def test_dictionary_roundtrip():
+    d, ids = Dictionary.from_column(DataType.STRING, np.array(["b", "a", "c", "a"], dtype=object))
+    assert list(d.values) == ["a", "b", "c"]
+    assert list(ids) == [1, 0, 2, 0]
+    assert d.index_of("b") == 1
+    assert d.index_of("zz") == -1
+    assert d.id_range_for("a", "b", True, True) == (0, 1)
+    assert d.id_range_for("a", "b", False, True) == (1, 1)
+    assert d.id_range_for(None, "bb", True, False) == (0, 1)
+    lo, hi = d.id_range_for("x", "z", True, True)
+    assert lo > hi  # empty
+
+
+def test_numeric_dictionary_range():
+    d, _ = Dictionary.from_column(DataType.INT, np.array([10, 20, 30, 20], dtype=np.int32))
+    assert d.cardinality == 3
+    assert d.id_range_for(15, 30, True, True) == (1, 2)
+    assert d.id_range_for(10, 30, False, False) == (1, 1)
+    assert d.ids_for_values([20, 99, 10]).tolist() == [0, 1]
+
+
+def test_build_encodings(schema, data):
+    seg = SegmentBuilder(schema).build(data, "seg0")
+    assert seg.n_docs == 5000
+    assert seg.columns["league"].is_dict_encoded
+    assert seg.columns["year"].is_dict_encoded  # dimension => dict
+    assert not seg.columns["runs"].is_dict_encoded  # metric => raw
+    assert seg.columns["league"].cardinality == 3
+    # materialize round-trips to raw values
+    np.testing.assert_array_equal(seg.columns["league"].materialize().astype(str), data["league"].astype(str))
+    np.testing.assert_array_equal(seg.columns["year"].materialize(), data["year"])
+
+
+def test_indexing_config_overrides(schema, data):
+    cfg = TableConfig("t", indexing=IndexingConfig(no_dictionary_columns=["year"], dictionary_columns=["runs"]))
+    seg = SegmentBuilder(schema, cfg).build(data, "seg0")
+    assert not seg.columns["year"].is_dict_encoded
+    assert seg.columns["runs"].is_dict_encoded
+
+
+def test_rows_input(schema):
+    rows = [
+        {"league": "NL", "year": 2001, "team": "A", "runs": 5, "avg": 0.5},
+        {"league": "AL", "year": 2002, "team": "B", "runs": 7, "avg": 0.7},
+    ]
+    seg = SegmentBuilder(schema).build(rows, "s")
+    assert seg.n_docs == 2
+    assert seg.columns["runs"].forward.tolist() == [5, 7]
+
+
+def test_persist_roundtrip(tmp_path, schema, data):
+    seg = SegmentBuilder(schema).build(data, "seg0")
+    d = write_segment(seg, tmp_path)
+    loaded = load_segment(d)
+    assert loaded.n_docs == seg.n_docs
+    for col in schema.columns:
+        a, b = seg.columns[col], loaded.columns[col]
+        assert a.is_dict_encoded == b.is_dict_encoded
+        np.testing.assert_array_equal(a.forward, b.forward)
+        np.testing.assert_array_equal(
+            np.asarray(a.materialize()).astype(str), np.asarray(b.materialize()).astype(str)
+        )
+        assert a.stats.to_dict() == b.stats.to_dict()
+
+
+def test_to_device(schema, data):
+    seg = SegmentBuilder(schema).build(data, "seg0")
+    dev = seg.to_device()
+    assert dev.padded == padded_len(5000) == 5120
+    assert dev.array("league").shape == (5120,)
+    np.testing.assert_array_equal(np.asarray(dev.array("year"))[:5000], seg.columns["year"].forward)
+
+
+def test_stats_sorted_flag():
+    d = {"x": np.array([1, 2, 3], dtype=np.int32), "y": np.array([3, 1, 2], dtype=np.int32)}
+    sch = Schema.build("s", dimensions=[("x", DataType.INT), ("y", DataType.INT)])
+    seg = SegmentBuilder(sch).build(d, "s0")
+    assert seg.columns["x"].stats.is_sorted
+    assert not seg.columns["y"].stats.is_sorted
+
+
+def test_bytes_column_roundtrip(tmp_path):
+    sch = Schema.build("b", dimensions=[("payload", DataType.BYTES)])
+    data = {"payload": np.array([b"\xff\x00", b"ab", b"\xff\x00"], dtype=object)}
+    seg = SegmentBuilder(sch).build(data, "s0")
+    d = seg.columns["payload"].dictionary
+    assert d.cardinality == 2
+    assert d.index_of(b"ab") == 0
+    assert d.index_of(b"\xff\x00") == 1
+    assert d.index_of(b"zz") == -1
+    loaded = load_segment(write_segment(seg, tmp_path))
+    assert loaded.columns["payload"].materialize().tolist() == [b"\xff\x00", b"ab", b"\xff\x00"]
+
+
+def test_float_predicate_on_int_dictionary():
+    d, _ = Dictionary.from_column(DataType.INT, np.array([10, 20, 30], dtype=np.int32))
+    assert d.index_of(20.5) == -1  # no truncation
+    assert d.id_range_for(20.5, None, True, True) == (2, 2)  # x >= 20.5 excludes 20
+    assert d.id_range_for(None, 20.5, True, True) == (0, 1)
+    assert d.index_of(20.0) == 1  # integral float still matches
+
+
+def test_loader_rejects_future_format(tmp_path, schema, data):
+    import json
+    seg = SegmentBuilder(schema).build(data, "seg0")
+    d = write_segment(seg, tmp_path)
+    meta = json.loads((d / "metadata.json").read_text())
+    meta["formatVersion"] = 999
+    (d / "metadata.json").write_text(json.dumps(meta))
+    with pytest.raises(ValueError, match="formatVersion"):
+        load_segment(d)
