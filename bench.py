@@ -1,0 +1,113 @@
+"""North-star benchmark (BASELINE.md config 4): SSB Q4.x-style multi-dimension
+GROUP BY with dictionary-encoded keys + ORDER BY LIMIT, device engine vs a
+pandas CPU reference on identical data.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": <device p50 ms>, "unit": "ms", "vs_baseline": <cpu_p50/device_p50>}
+
+Env knobs: PINOT_TPU_BENCH_ROWS (default 4_000_000), PINOT_TPU_BENCH_ITERS (7).
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+
+def log(msg):
+    print(msg, file=sys.stderr, flush=True)
+
+
+def main():
+    import pinot_tpu  # noqa: F401  (x64 + platform setup)
+    import jax
+
+    from pinot_tpu.common import DataType, Schema
+    from pinot_tpu.parallel import build_sharded_table, make_mesh
+    from pinot_tpu.parallel.mesh import execute_sharded, execute_sharded_result
+
+    n = int(os.environ.get("PINOT_TPU_BENCH_ROWS", 4_000_000))
+    iters = int(os.environ.get("PINOT_TPU_BENCH_ITERS", 7))
+    rng = np.random.default_rng(0)
+    log(f"backend={jax.default_backend()} devices={len(jax.devices())} rows={n}")
+
+    schema = Schema.build(
+        "lineorder",
+        dimensions=[
+            ("d_year", DataType.INT),
+            ("c_nation", DataType.STRING),
+            ("p_category", DataType.STRING),
+        ],
+        metrics=[("lo_revenue", DataType.LONG), ("lo_supplycost", DataType.LONG), ("lo_quantity", DataType.INT)],
+    )
+    data = {
+        "d_year": rng.integers(1992, 1999, n).astype(np.int32),
+        "c_nation": np.array([f"NATION_{i:02d}" for i in range(25)], dtype=object)[rng.integers(0, 25, n)],
+        "p_category": np.array([f"MFGR#{i//10+1}{i%10+1}" for i in range(25)], dtype=object)[
+            rng.integers(0, 25, n)
+        ],
+        "lo_revenue": rng.integers(100, 600_000, n).astype(np.int64),
+        "lo_supplycost": rng.integers(50, 100_000, n).astype(np.int64),
+        "lo_quantity": rng.integers(1, 51, n).astype(np.int32),
+    }
+    # SSB Q4.2-flavored: profit by (year, nation, category) with a filter
+    sql = (
+        "SELECT d_year, c_nation, p_category, SUM(lo_revenue - lo_supplycost) "
+        "FROM lineorder WHERE lo_quantity > 5 AND d_year BETWEEN 1993 AND 1997 "
+        "GROUP BY d_year, c_nation, p_category ORDER BY SUM(lo_revenue - lo_supplycost) DESC LIMIT 10"
+    )
+
+    mesh = make_mesh()
+    t0 = time.perf_counter()
+    table = build_sharded_table(schema, data, mesh, rows_per_segment=max(1, n // max(4, len(jax.devices()))))
+    log(f"table built+staged in {time.perf_counter() - t0:.1f}s ({table.n_segments} segments)")
+
+    # warmup (compile)
+    t0 = time.perf_counter()
+    res = execute_sharded_result(table, sql)
+    log(f"first query (compile): {time.perf_counter() - t0:.1f}s; top row: {res.rows[0] if res.rows else None}")
+    execute_sharded_result(table, sql)
+
+    lat = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        ctx, plan, out = execute_sharded(table, sql)
+        jax.block_until_ready(out)
+        lat.append((time.perf_counter() - t0) * 1e3)
+    device_p50 = float(np.percentile(lat, 50))
+    log(f"device latencies ms: {[round(x, 2) for x in lat]}")
+
+    # CPU reference: pandas on identical data (the role of Pinot's CPU engine)
+    import pandas as pd
+
+    t = pd.DataFrame({k: (v.astype(str) if v.dtype == object else v) for k, v in data.items()})
+    cpu = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        sel = t[(t.lo_quantity > 5) & (t.d_year >= 1993) & (t.d_year <= 1997)]
+        profit = sel.lo_revenue - sel.lo_supplycost
+        g = profit.groupby([sel.d_year, sel.c_nation, sel.p_category]).sum().nlargest(10)
+        cpu.append((time.perf_counter() - t0) * 1e3)
+    cpu_p50 = float(np.percentile(cpu, 50))
+    log(f"cpu(pandas) latencies ms: {[round(x, 2) for x in cpu]}")
+
+    # sanity: results agree
+    top = g.iloc[0]
+    assert res.rows[0][3] == float(top), f"result mismatch: {res.rows[0][3]} vs {float(top)}"
+
+    print(
+        json.dumps(
+            {
+                "metric": "ssb_q4_groupby_p50_latency",
+                "value": round(device_p50, 3),
+                "unit": "ms",
+                "vs_baseline": round(cpu_p50 / device_p50, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

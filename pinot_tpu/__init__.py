@@ -19,9 +19,10 @@ Layer map (mirrors SURVEY.md L0-L10):
 import os
 
 # Pinot semantics require LONG/DOUBLE (64-bit) columns and accumulators.
-# JAX defaults to 32-bit; enable x64 unless explicitly disabled. The engine
-# still downcasts per-platform (TPU has no f64 compute) via dtype policy in
-# query/plan.py.
+# JAX defaults to 32-bit; enable x64 unless explicitly disabled. The axon TPU
+# emulates f64/i64, so 64-bit stays the default; the storage-level dtype
+# policy (lossless i64->i32 narrowing, opt-in lossy fast32) lives in
+# segment.py to_device / QueryEngine(fast32=...).
 if os.environ.get("PINOT_TPU_NO_X64", "0") != "1":
     import jax
 

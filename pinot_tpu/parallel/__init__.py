@@ -1,0 +1,3 @@
+from pinot_tpu.parallel.mesh import ShardedTable, build_sharded_table, execute_sharded, make_mesh
+
+__all__ = ["ShardedTable", "build_sharded_table", "execute_sharded", "make_mesh"]

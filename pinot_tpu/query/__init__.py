@@ -1,0 +1,6 @@
+from pinot_tpu.query.sql import parse_sql, SqlParseError
+from pinot_tpu.query.context import QueryContext, QueryType
+from pinot_tpu.query.engine import QueryEngine
+from pinot_tpu.query.result import ResultTable
+
+__all__ = ["parse_sql", "SqlParseError", "QueryContext", "QueryType", "QueryEngine", "ResultTable"]

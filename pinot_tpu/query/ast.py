@@ -1,0 +1,207 @@
+"""SQL abstract syntax tree.
+
+Reference parity: the thrift `PinotQuery` produced by CalciteSqlParser
+(pinot-common sql-utils; pinot-common/src/thrift/query.thrift:21). We model the
+same SELECT surface Pinot's single-stage engine accepts: projections with
+expressions and aliases, boolean filter trees, GROUP BY / HAVING / ORDER BY /
+LIMIT-OFFSET, DISTINCT, and function calls (aggregation + transform).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from enum import Enum
+from typing import Any
+
+
+class Expr:
+    """Base class for expressions."""
+
+
+@dataclass(frozen=True)
+class Literal(Expr):
+    value: Any  # int | float | str | bool | None
+
+    def __str__(self) -> str:
+        if isinstance(self.value, str):
+            return "'" + self.value.replace("'", "''") + "'"
+        return str(self.value)
+
+
+@dataclass(frozen=True)
+class Identifier(Expr):
+    name: str
+
+    def __str__(self) -> str:
+        return self.name
+
+
+@dataclass(frozen=True)
+class Star(Expr):
+    def __str__(self) -> str:
+        return "*"
+
+
+@dataclass(frozen=True)
+class FunctionCall(Expr):
+    name: str  # canonical lower-case
+    args: tuple[Expr, ...]
+    distinct: bool = False
+
+    def __str__(self) -> str:
+        d = "DISTINCT " if self.distinct else ""
+        return f"{self.name}({d}{','.join(map(str, self.args))})"
+
+
+@dataclass(frozen=True)
+class BinaryOp(Expr):
+    """Arithmetic: + - * / %"""
+
+    op: str
+    left: Expr
+    right: Expr
+
+    def __str__(self) -> str:
+        return f"({self.left}{self.op}{self.right})"
+
+
+# ---------------------------------------------------------------------------
+# Filter (boolean) expressions — kept distinct from value expressions, like
+# Pinot's FilterContext vs ExpressionContext split (pinot-common
+# request/context/FilterContext.java).
+# ---------------------------------------------------------------------------
+
+
+class FilterExpr:
+    """Base class for boolean filter nodes."""
+
+
+class CompareOp(Enum):
+    EQ = "="
+    NEQ = "!="
+    LT = "<"
+    LTE = "<="
+    GT = ">"
+    GTE = ">="
+
+
+@dataclass(frozen=True)
+class Compare(FilterExpr):
+    op: CompareOp
+    left: Expr
+    right: Expr
+
+    def __str__(self) -> str:
+        return f"{self.left} {self.op.value} {self.right}"
+
+
+@dataclass(frozen=True)
+class Between(FilterExpr):
+    expr: Expr
+    low: Expr
+    high: Expr
+    negated: bool = False
+
+    def __str__(self) -> str:
+        n = "NOT " if self.negated else ""
+        return f"{self.expr} {n}BETWEEN {self.low} AND {self.high}"
+
+
+@dataclass(frozen=True)
+class In(FilterExpr):
+    expr: Expr
+    values: tuple[Expr, ...]
+    negated: bool = False
+
+    def __str__(self) -> str:
+        n = "NOT " if self.negated else ""
+        return f"{self.expr} {n}IN ({','.join(map(str, self.values))})"
+
+
+@dataclass(frozen=True)
+class Like(FilterExpr):
+    expr: Expr
+    pattern: str
+    negated: bool = False
+
+    def __str__(self) -> str:
+        n = "NOT " if self.negated else ""
+        return f"{self.expr} {n}LIKE '{self.pattern}'"
+
+
+@dataclass(frozen=True)
+class RegexpLike(FilterExpr):
+    expr: Expr
+    pattern: str
+
+    def __str__(self) -> str:
+        return f"REGEXP_LIKE({self.expr}, '{self.pattern}')"
+
+
+@dataclass(frozen=True)
+class IsNull(FilterExpr):
+    expr: Expr
+    negated: bool = False  # negated => IS NOT NULL
+
+    def __str__(self) -> str:
+        return f"{self.expr} IS {'NOT ' if self.negated else ''}NULL"
+
+
+@dataclass(frozen=True)
+class And(FilterExpr):
+    children: tuple[FilterExpr, ...]
+
+    def __str__(self) -> str:
+        return "(" + " AND ".join(map(str, self.children)) + ")"
+
+
+@dataclass(frozen=True)
+class Or(FilterExpr):
+    children: tuple[FilterExpr, ...]
+
+    def __str__(self) -> str:
+        return "(" + " OR ".join(map(str, self.children)) + ")"
+
+
+@dataclass(frozen=True)
+class Not(FilterExpr):
+    child: FilterExpr
+
+    def __str__(self) -> str:
+        return f"NOT ({self.child})"
+
+
+# HAVING predicates compare aggregate expressions; reuse Compare/And/Or/Not
+# with FunctionCall leaves.
+
+
+@dataclass(frozen=True)
+class OrderByItem:
+    expr: Expr
+    desc: bool = False
+
+    def __str__(self) -> str:
+        return f"{self.expr} {'DESC' if self.desc else 'ASC'}"
+
+
+@dataclass(frozen=True)
+class SelectItem:
+    expr: Expr
+    alias: str | None = None
+
+    def __str__(self) -> str:
+        return f"{self.expr} AS {self.alias}" if self.alias else str(self.expr)
+
+
+@dataclass
+class SelectStatement:
+    select_list: list[SelectItem]
+    from_table: str
+    distinct: bool = False
+    where: FilterExpr | None = None
+    group_by: list[Expr] = field(default_factory=list)
+    having: FilterExpr | None = None
+    order_by: list[OrderByItem] = field(default_factory=list)
+    limit: int | None = None
+    offset: int = 0
+    options: dict[str, str] = field(default_factory=dict)

@@ -1,0 +1,240 @@
+"""QueryContext: resolved, canonicalized form of a parsed query.
+
+Reference parity: QueryContext (pinot-core/.../query/request/context/
+QueryContext.java:74) built from the thrift PinotQuery. Classifies the query
+(selection / aggregation / group-by / distinct), extracts the aggregation set
+from SELECT + HAVING + ORDER BY (deduped by canonical name), and applies
+Pinot's default LIMIT 10.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from enum import Enum
+
+from pinot_tpu.query.ast import (
+    Expr,
+    FilterExpr,
+    FunctionCall,
+    Identifier,
+    Literal,
+    OrderByItem,
+    SelectItem,
+    SelectStatement,
+    Star,
+    And,
+    Or,
+    Not,
+    Compare,
+    Between,
+    In,
+    Like,
+    RegexpLike,
+    IsNull,
+)
+from pinot_tpu.query.sql import parse_sql
+
+DEFAULT_LIMIT = 10  # Pinot's default broker LIMIT
+
+# Aggregation functions the engine recognizes (subset of the 94 in
+# pinot-core/.../query/aggregation/function/; grows each round).
+AGG_FUNCS = {
+    "count",
+    "sum",
+    "min",
+    "max",
+    "avg",
+    "distinctcount",
+    "minmaxrange",
+    "distinctcounthll",
+    "percentile",
+    "percentileest",
+}
+
+
+class QueryType(Enum):
+    SELECTION = "SELECTION"
+    SELECTION_ORDER_BY = "SELECTION_ORDER_BY"
+    AGGREGATION = "AGGREGATION"
+    GROUP_BY = "GROUP_BY"
+    DISTINCT = "DISTINCT"
+
+
+def canonical(expr: Expr) -> str:
+    """Canonical output/column name for an expression (Pinot emits lowercase
+    function names with raw args, e.g. `sum(runs)`, `count(*)`)."""
+    if isinstance(expr, FunctionCall):
+        d = "distinct " if expr.distinct else ""
+        return f"{expr.name}({d}{','.join(canonical(a) for a in expr.args)})"
+    if isinstance(expr, Star):
+        return "*"
+    if isinstance(expr, Identifier):
+        return expr.name
+    if isinstance(expr, Literal):
+        return str(expr)
+    # BinaryOp
+    return str(expr)
+
+
+@dataclass(frozen=True)
+class AggregationInfo:
+    func: str  # canonical lower-case function name
+    arg: Expr | None  # None for count(*)
+    name: str  # canonical output name
+
+    def __str__(self) -> str:
+        return self.name
+
+
+def _extract_aggs(expr: Expr, out: dict[str, AggregationInfo]) -> bool:
+    """Collect aggregations in expr; returns True if expr contains any."""
+    from pinot_tpu.query.ast import BinaryOp
+
+    if isinstance(expr, FunctionCall):
+        fname = expr.name
+        if fname in AGG_FUNCS or (fname == "count" and expr.distinct):
+            if fname == "count" and expr.distinct:
+                # COUNT(DISTINCT x) is DISTINCTCOUNT(x) (Pinot rewrites the same)
+                func, arg = "distinctcount", expr.args[0]
+                name = canonical(FunctionCall("distinctcount", expr.args))
+            elif fname == "count":
+                func, arg, name = "count", None, canonical(expr)
+            else:
+                func, arg, name = fname, (expr.args[0] if expr.args else None), canonical(expr)
+            out.setdefault(name, AggregationInfo(func, arg, name))
+            return True
+        # transform function: recurse into args
+        found = False
+        for a in expr.args:
+            found |= _extract_aggs(a, out)
+        return found
+    if isinstance(expr, BinaryOp):
+        left = _extract_aggs(expr.left, out)
+        right = _extract_aggs(expr.right, out)
+        return left or right
+    return False
+
+
+def _filter_agg_scan(f: FilterExpr, out: dict[str, AggregationInfo]) -> None:
+    if isinstance(f, (And, Or)):
+        for c in f.children:
+            _filter_agg_scan(c, out)
+    elif isinstance(f, Not):
+        _filter_agg_scan(f.child, out)
+    elif isinstance(f, Compare):
+        _extract_aggs(f.left, out)
+        _extract_aggs(f.right, out)
+    elif isinstance(f, Between):
+        _extract_aggs(f.expr, out)
+    elif isinstance(f, (In, Like, RegexpLike, IsNull)):
+        _extract_aggs(f.expr, out)
+
+
+def _collect_identifiers(expr: Expr, out: set[str]) -> None:
+    from pinot_tpu.query.ast import BinaryOp
+
+    if isinstance(expr, Identifier):
+        out.add(expr.name)
+    elif isinstance(expr, FunctionCall):
+        for a in expr.args:
+            _collect_identifiers(a, out)
+    elif isinstance(expr, BinaryOp):
+        _collect_identifiers(expr.left, out)
+        _collect_identifiers(expr.right, out)
+
+
+def _collect_filter_identifiers(f: FilterExpr | None, out: set[str]) -> None:
+    if f is None:
+        return
+    if isinstance(f, (And, Or)):
+        for c in f.children:
+            _collect_filter_identifiers(c, out)
+    elif isinstance(f, Not):
+        _collect_filter_identifiers(f.child, out)
+    elif isinstance(f, Compare):
+        _collect_identifiers(f.left, out)
+        _collect_identifiers(f.right, out)
+    elif isinstance(f, Between):
+        _collect_identifiers(f.expr, out)
+        _collect_identifiers(f.low, out)
+        _collect_identifiers(f.high, out)
+    elif isinstance(f, In):
+        _collect_identifiers(f.expr, out)
+    elif isinstance(f, (Like, RegexpLike, IsNull)):
+        _collect_identifiers(f.expr, out)
+
+
+@dataclass
+class QueryContext:
+    statement: SelectStatement
+    table: str
+    query_type: QueryType
+    select_items: list[SelectItem]
+    aggregations: list[AggregationInfo]  # from SELECT + HAVING + ORDER BY
+    group_by: list[Expr]
+    filter: FilterExpr | None
+    having: FilterExpr | None
+    order_by: list[OrderByItem]
+    limit: int
+    offset: int
+    options: dict[str, str] = field(default_factory=dict)
+
+    @property
+    def columns_used(self) -> set[str]:
+        out: set[str] = set()
+        for item in self.select_items:
+            _collect_identifiers(item.expr, out)
+        for g in self.group_by:
+            _collect_identifiers(g, out)
+        for o in self.order_by:
+            _collect_identifiers(o.expr, out)
+        _collect_filter_identifiers(self.filter, out)
+        _collect_filter_identifiers(self.having, out)
+        return out
+
+    def output_name(self, item: SelectItem) -> str:
+        return item.alias or canonical(item.expr)
+
+    @staticmethod
+    def from_sql(sql: str) -> "QueryContext":
+        return QueryContext.from_statement(parse_sql(sql))
+
+    @staticmethod
+    def from_statement(stmt: SelectStatement) -> "QueryContext":
+        aggs: dict[str, AggregationInfo] = {}
+        has_agg = False
+        for item in stmt.select_list:
+            has_agg |= _extract_aggs(item.expr, aggs)
+        if stmt.having is not None:
+            _filter_agg_scan(stmt.having, aggs)
+        for ob in stmt.order_by:
+            _extract_aggs(ob.expr, aggs)
+
+        if stmt.distinct:
+            qt = QueryType.DISTINCT
+            if has_agg:
+                raise ValueError("SELECT DISTINCT with aggregations is not supported")
+        elif stmt.group_by:
+            qt = QueryType.GROUP_BY
+        elif has_agg or aggs:
+            qt = QueryType.AGGREGATION
+        elif stmt.order_by:
+            qt = QueryType.SELECTION_ORDER_BY
+        else:
+            qt = QueryType.SELECTION
+
+        limit = stmt.limit if stmt.limit is not None else DEFAULT_LIMIT
+        return QueryContext(
+            statement=stmt,
+            table=stmt.from_table,
+            query_type=qt,
+            select_items=list(stmt.select_list),
+            aggregations=list(aggs.values()),
+            group_by=list(stmt.group_by),
+            filter=stmt.where,
+            having=stmt.having,
+            order_by=list(stmt.order_by),
+            limit=limit,
+            offset=stmt.offset,
+            options=dict(stmt.options),
+        )

@@ -1,0 +1,212 @@
+"""QueryEngine: end-to-end SQL execution over a set of segments.
+
+Reference parity: this composes, in-process, what Pinot splits across
+ServerQueryExecutorV1Impl (pinot-core/.../query/executor/
+ServerQueryExecutorV1Impl.java:141, per-segment plan + execute) and
+BrokerReduceService (core/query/reduce/BrokerReduceService.java:61, merge).
+Per segment it prefers the compiled device path (plan.py + kernels.py) and
+falls back to the host executor per DeviceFallback; partials from either path
+merge through one reduce (reduce.py). The distributed layers (scatter/gather
+over real server processes) wrap this same engine later.
+"""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+import pandas as pd
+
+from pinot_tpu.query import ast, host_exec, reduce as reduce_mod
+from pinot_tpu.query.context import QueryContext, QueryType
+from pinot_tpu.query.kernels import run_plan
+from pinot_tpu.query.plan import DeviceFallback, SegmentPlan, plan_segment
+from pinot_tpu.query.result import ResultTable
+from pinot_tpu.query.sql import parse_sql
+from pinot_tpu.segment.segment import DeviceSegment, ImmutableSegment
+
+
+class QueryEngine:
+    def __init__(self, segments: list[ImmutableSegment], fast32: bool = False):
+        """fast32=True stages DOUBLE columns as float32 (lossy) for speed."""
+        self.segments = list(segments)
+        self.fast32 = fast32
+        self._device: dict[str, DeviceSegment] = {}
+
+    def add_segment(self, seg: ImmutableSegment) -> None:
+        self.segments.append(seg)
+
+    def _device_seg(self, seg: ImmutableSegment) -> DeviceSegment:
+        ds = self._device.get(seg.name)
+        if ds is None:
+            ds = seg.to_device(fast32=self.fast32)
+            self._device[seg.name] = ds
+        return ds
+
+    # ------------------------------------------------------------------
+
+    def execute(self, sql: str) -> ResultTable:
+        t0 = time.perf_counter()
+        stmt = parse_sql(sql)
+        self._expand_star(stmt)
+        ctx = QueryContext.from_statement(stmt)
+
+        partials = []
+        scanned = 0
+        for seg in self.segments:
+            partial, matched = self._execute_segment(seg, ctx)
+            partials.append(partial)
+            scanned += matched
+
+        qt = ctx.query_type
+        if qt == QueryType.AGGREGATION:
+            rows = reduce_mod.reduce_aggregation(ctx, partials)
+        elif qt == QueryType.GROUP_BY:
+            rows = reduce_mod.reduce_group_by(ctx, partials)
+        elif qt == QueryType.DISTINCT:
+            rows = reduce_mod.reduce_distinct(ctx, partials)
+        elif qt == QueryType.SELECTION_ORDER_BY:
+            rows = reduce_mod.reduce_selection_order_by(ctx, partials)
+        else:
+            rows = reduce_mod.reduce_selection(ctx, partials)
+
+        return reduce_mod.build_result(
+            ctx,
+            rows,
+            num_docs_scanned=int(scanned),
+            total_docs=sum(s.n_docs for s in self.segments),
+            num_segments_queried=len(self.segments),
+            time_used_ms=(time.perf_counter() - t0) * 1e3,
+        )
+
+    # ------------------------------------------------------------------
+
+    def _expand_star(self, stmt) -> None:
+        """Expand SELECT * into explicit columns (selection/distinct only)."""
+        has_star = any(isinstance(it.expr, ast.Star) for it in stmt.select_list)
+        if not has_star or not self.segments:
+            return
+        schema = self.segments[0].schema
+        new_items = []
+        for it in stmt.select_list:
+            if isinstance(it.expr, ast.Star):
+                new_items.extend(ast.SelectItem(ast.Identifier(c), None) for c in schema.columns)
+            else:
+                new_items.append(it)
+        stmt.select_list = new_items
+
+    # ------------------------------------------------------------------
+
+    def _execute_segment(self, seg: ImmutableSegment, ctx: QueryContext):
+        """Returns (partial, matched_docs) for one segment."""
+        try:
+            plan = plan_segment(seg, ctx)
+        except DeviceFallback:
+            return self._host_segment(seg, ctx)
+        out = run_plan(plan, self._device_seg(seg))
+        qt = ctx.query_type
+        if qt == QueryType.AGGREGATION:
+            matched, parts = out
+            return self._convert_agg(seg, ctx, plan, parts), int(matched)
+        if qt in (QueryType.GROUP_BY, QueryType.DISTINCT):
+            matched, counts, parts = out
+            return self._convert_groups(seg, ctx, plan, np.asarray(counts), parts), int(matched)
+        if qt == QueryType.SELECTION:
+            matched, outs = out
+            return self._convert_selection(seg, ctx, plan, int(matched), outs), int(matched)
+        # SELECTION_ORDER_BY
+        matched, keys_out, outs = out
+        return (
+            self._convert_selection_ob(seg, ctx, plan, int(matched), np.asarray(keys_out), outs),
+            int(matched),
+        )
+
+    def _host_segment(self, seg: ImmutableSegment, ctx: QueryContext):
+        mask = host_exec.filter_mask(seg, ctx.filter)
+        matched = int(mask.sum())
+        qt = ctx.query_type
+        k = ctx.limit + ctx.offset
+        if qt == QueryType.AGGREGATION:
+            return host_exec.agg_partials(seg, ctx, mask), matched
+        if qt == QueryType.GROUP_BY:
+            return host_exec.group_frame(seg, ctx, mask), matched
+        if qt == QueryType.DISTINCT:
+            return host_exec.distinct_frame(seg, ctx, mask), matched
+        if qt == QueryType.SELECTION_ORDER_BY:
+            return host_exec.selection_ob_frame(seg, ctx, mask, k), matched
+        return host_exec.selection_frame(seg, ctx, mask, k), matched
+
+    # -- device output -> host partial conversions ----------------------
+
+    def _convert_agg(self, seg, ctx, plan: SegmentPlan, parts) -> list:
+        out = []
+        for a, spec_entry, p in zip(ctx.aggregations, plan.spec[3], parts):
+            if a.func == "count":
+                out.append(int(p))
+            elif a.func == "distinctcount":
+                col = spec_entry[1]
+                ci = seg.columns[col]
+                presence = np.asarray(p)[: ci.cardinality]
+                vals = ci.dictionary.values[np.nonzero(presence)[0]]
+                out.append(set(vals.tolist()))
+            elif a.func in ("avg", "minmaxrange"):
+                out.append((float(p[0]), int(p[1]) if a.func == "avg" else float(p[1])))
+            else:
+                out.append(float(p))
+        return out
+
+    def _convert_groups(self, seg, ctx, plan: SegmentPlan, counts: np.ndarray, parts) -> pd.DataFrame:
+        pg = np.nonzero(counts)[0]
+        cards = [ci.cardinality for _, ci in plan.group_cols]
+        strides = np.ones(len(cards), dtype=np.int64)
+        for i in range(len(cards) - 2, -1, -1):
+            strides[i] = strides[i + 1] * max(cards[i + 1], 1)
+        data = {}
+        for i, (col, ci) in enumerate(plan.group_cols):
+            ids = (pg // strides[i]) % max(cards[i], 1)
+            vals = ci.dictionary.get_many(ids)
+            data[f"k{i}"] = vals.astype(str) if vals.dtype == object else vals
+        if ctx.query_type == QueryType.DISTINCT:
+            return pd.DataFrame(data)
+        aggs_spec = plan.spec[3]
+        for i, (a, spec_entry, p) in enumerate(zip(ctx.aggregations, aggs_spec, parts)):
+            if a.func == "count":
+                data[f"a{i}p0"] = np.asarray(p)[pg]
+            elif a.func in ("avg", "minmaxrange"):
+                data[f"a{i}p0"] = np.asarray(p[0])[pg]
+                data[f"a{i}p1"] = np.asarray(p[1])[pg]
+            else:
+                data[f"a{i}p0"] = np.asarray(p)[pg]
+        return pd.DataFrame(data)
+
+    def _convert_selection(self, seg, ctx, plan: SegmentPlan, matched: int, outs) -> pd.DataFrame:
+        n = min(matched, plan.spec[3])
+        data = {}
+        for i, (dec, o) in enumerate(zip(plan.select_decode, outs)):
+            v = np.asarray(o)[:n]
+            data[f"c{i}"] = self._decode(seg, dec, v)
+        return pd.DataFrame(data)
+
+    def _convert_selection_ob(self, seg, ctx, plan: SegmentPlan, matched, keys_out, outs) -> pd.DataFrame:
+        n = min(matched, plan.spec[5])
+        data = {}
+        kspec = plan.spec[3]
+        keys = keys_out[:n]
+        if kspec[0] == "ids":
+            ci = seg.columns[kspec[1]]
+            kv = ci.dictionary.get_many(keys.astype(np.int64))
+            data["__key0"] = kv.astype(str) if kv.dtype == object else kv
+        else:
+            data["__key0"] = keys
+        for i, (dec, o) in enumerate(zip(plan.select_decode, outs)):
+            v = np.asarray(o)[:n]
+            data[f"c{i}"] = self._decode(seg, dec, v)
+        return pd.DataFrame(data)
+
+    def _decode(self, seg, dec, v: np.ndarray) -> np.ndarray:
+        kind = dec[0]
+        if kind == "dict":
+            ci = seg.columns[dec[1]]
+            vals = ci.dictionary.get_many(v.astype(np.int64))
+            return vals.astype(str) if vals.dtype == object else vals
+        return v

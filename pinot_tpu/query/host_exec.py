@@ -1,0 +1,230 @@
+"""Host (numpy/pandas) fallback executor.
+
+Reference parity: plays the role of Pinot's non-optimized operator paths (e.g.
+NoDictionary*GroupKeyGenerator, ExpressionFilterOperator) for query shapes the
+device lowering doesn't cover yet: high-cardinality or expression GROUP BY,
+DISTINCTCOUNT in group-by, transform functions. Produces the SAME partial
+formats as the device path (see reduce.py), so the broker reduce never knows
+which executor ran a segment. Correctness-first; the set of shapes landing
+here shrinks as device lowerings are added.
+"""
+
+from __future__ import annotations
+
+import re
+
+import numpy as np
+import pandas as pd
+
+from pinot_tpu.common.types import DataType
+from pinot_tpu.query import ast
+from pinot_tpu.query.context import QueryContext
+from pinot_tpu.query.plan import PlanError, _like_to_regex
+from pinot_tpu.query.reduce import parts_of
+from pinot_tpu.segment.segment import ImmutableSegment
+
+
+def eval_value(seg: ImmutableSegment, expr: ast.Expr) -> np.ndarray:
+    if isinstance(expr, ast.Identifier):
+        ci = seg.columns.get(expr.name)
+        if ci is None:
+            raise PlanError(f"unknown column {expr.name!r}")
+        return ci.materialize()
+    if isinstance(expr, ast.Literal):
+        return np.full(seg.n_docs, expr.value)
+    if isinstance(expr, ast.BinaryOp):
+        l = eval_value(seg, expr.left)
+        r = eval_value(seg, expr.right)
+        if expr.op == "+":
+            return l + r
+        if expr.op == "-":
+            return l - r
+        if expr.op == "*":
+            return l * r
+        if expr.op == "/":
+            return l.astype(np.float64) / r.astype(np.float64)
+        if expr.op == "%":
+            return np.mod(l, r)
+    raise PlanError(f"unsupported value expression in host executor: {expr}")
+
+
+_CMPS = {
+    ast.CompareOp.EQ: lambda a, b: a == b,
+    ast.CompareOp.NEQ: lambda a, b: a != b,
+    ast.CompareOp.LT: lambda a, b: a < b,
+    ast.CompareOp.LTE: lambda a, b: a <= b,
+    ast.CompareOp.GT: lambda a, b: a > b,
+    ast.CompareOp.GTE: lambda a, b: a >= b,
+}
+
+
+def _coerce_lit(v):
+    return v
+
+
+def filter_mask(seg: ImmutableSegment, f: ast.FilterExpr | None) -> np.ndarray:
+    n = seg.n_docs
+    if f is None:
+        return np.ones(n, dtype=bool)
+    if isinstance(f, ast.And):
+        m = np.ones(n, dtype=bool)
+        for c in f.children:
+            m &= filter_mask(seg, c)
+        return m
+    if isinstance(f, ast.Or):
+        m = np.zeros(n, dtype=bool)
+        for c in f.children:
+            m |= filter_mask(seg, c)
+        return m
+    if isinstance(f, ast.Not):
+        return ~filter_mask(seg, f.child)
+    if isinstance(f, ast.Compare):
+        left, op, right = f.left, f.op, f.right
+        if isinstance(left, ast.Literal) and not isinstance(right, ast.Literal):
+            left, right = right, left
+            from pinot_tpu.query.plan import _FLIP
+
+            op = _FLIP[op]
+        lv = eval_value(seg, left)
+        rv = eval_value(seg, right) if not isinstance(right, ast.Literal) else _coerce_lit(right.value)
+        if isinstance(rv, str) and lv.dtype == object:
+            lv = lv.astype(str)
+        return np.asarray(_CMPS[op](lv, rv), dtype=bool)
+    if isinstance(f, ast.Between):
+        v = eval_value(seg, f.expr)
+        lo = f.low.value if isinstance(f.low, ast.Literal) else None
+        hi = f.high.value if isinstance(f.high, ast.Literal) else None
+        if lo is None or hi is None:
+            raise PlanError("BETWEEN bounds must be literals")
+        if v.dtype == object:
+            v = v.astype(str)
+        m = (v >= lo) & (v <= hi)
+        return ~m if f.negated else m
+    if isinstance(f, ast.In):
+        v = eval_value(seg, f.expr)
+        vals = [x.value for x in f.values if isinstance(x, ast.Literal)]
+        if v.dtype == object:
+            v = v.astype(str)
+            vals = [str(x) for x in vals]
+        m = np.isin(v, np.asarray(vals))
+        return ~m if f.negated else m
+    if isinstance(f, ast.Like):
+        rx = re.compile(_like_to_regex(f.pattern))
+        v = eval_value(seg, f.expr).astype(str)
+        m = np.asarray([bool(rx.fullmatch(x)) for x in v])
+        return ~m if f.negated else m
+    if isinstance(f, ast.RegexpLike):
+        rx = re.compile(f.pattern)
+        v = eval_value(seg, f.expr).astype(str)
+        return np.asarray([bool(rx.search(x)) for x in v])
+    if isinstance(f, ast.IsNull):
+        return np.full(n, bool(f.negated))
+    raise PlanError(f"unsupported filter in host executor: {f}")
+
+
+# ---------------------------------------------------------------------------
+# partial producers (formats documented in reduce.py)
+# ---------------------------------------------------------------------------
+
+
+def agg_partials(seg: ImmutableSegment, ctx: QueryContext, mask: np.ndarray) -> list:
+    out = []
+    for a in ctx.aggregations:
+        if a.func == "count":
+            out.append(int(mask.sum()))
+            continue
+        if a.func == "distinctcount":
+            v = eval_value(seg, a.arg)[mask]
+            out.append(set(v.tolist()))
+            continue
+        v = eval_value(seg, a.arg)[mask].astype(np.float64)
+        if a.func == "sum":
+            out.append(float(v.sum()))
+        elif a.func == "min":
+            out.append(float(v.min()) if len(v) else float("inf"))
+        elif a.func == "max":
+            out.append(float(v.max()) if len(v) else float("-inf"))
+        elif a.func == "avg":
+            out.append((float(v.sum()), int(len(v))))
+        elif a.func == "minmaxrange":
+            out.append(
+                (float(v.min()) if len(v) else float("inf"), float(v.max()) if len(v) else float("-inf"))
+            )
+        else:
+            raise PlanError(f"unsupported aggregation in host executor: {a.func}")
+    return out
+
+
+def group_frame(seg: ImmutableSegment, ctx: QueryContext, mask: np.ndarray) -> pd.DataFrame:
+    data = {}
+    for i, g in enumerate(ctx.group_by):
+        v = eval_value(seg, g)[mask]
+        data[f"k{i}"] = v.astype(str) if v.dtype == object else v
+    for i, a in enumerate(ctx.aggregations):
+        if a.func == "count":
+            continue
+        v = eval_value(seg, a.arg)[mask]
+        data[f"v{i}"] = v
+    df = pd.DataFrame(data)
+    if len(df) == 0:
+        cols = {f"k{i}": [] for i in range(len(ctx.group_by))}
+        for i, a in enumerate(ctx.aggregations):
+            for j in range(parts_of(a.func)):
+                cols[f"a{i}p{j}"] = []
+        return pd.DataFrame(cols)
+    key_cols = [f"k{i}" for i in range(len(ctx.group_by))]
+    g = df.groupby(key_cols, sort=False, dropna=False)
+    out = g.size().rename("__size").reset_index()
+    for i, a in enumerate(ctx.aggregations):
+        if a.func == "count":
+            out[f"a{i}p0"] = out["__size"]
+        elif a.func == "sum":
+            out[f"a{i}p0"] = g[f"v{i}"].sum().values.astype(np.float64)
+        elif a.func == "min":
+            out[f"a{i}p0"] = g[f"v{i}"].min().values.astype(np.float64)
+        elif a.func == "max":
+            out[f"a{i}p0"] = g[f"v{i}"].max().values.astype(np.float64)
+        elif a.func == "avg":
+            out[f"a{i}p0"] = g[f"v{i}"].sum().values.astype(np.float64)
+            out[f"a{i}p1"] = out["__size"]
+        elif a.func == "minmaxrange":
+            out[f"a{i}p0"] = g[f"v{i}"].min().values.astype(np.float64)
+            out[f"a{i}p1"] = g[f"v{i}"].max().values.astype(np.float64)
+        elif a.func == "distinctcount":
+            out[f"a{i}p0"] = g[f"v{i}"].agg(lambda s: set(s.tolist())).values
+        else:
+            raise PlanError(f"unsupported aggregation in host executor: {a.func}")
+    return out.drop(columns=["__size"])
+
+
+def distinct_frame(seg: ImmutableSegment, ctx: QueryContext, mask: np.ndarray) -> pd.DataFrame:
+    data = {}
+    for i, it in enumerate(ctx.select_items):
+        v = eval_value(seg, it.expr)[mask]
+        data[f"k{i}"] = v.astype(str) if v.dtype == object else v
+    return pd.DataFrame(data).drop_duplicates()
+
+
+def selection_frame(seg: ImmutableSegment, ctx: QueryContext, mask: np.ndarray, k: int) -> pd.DataFrame:
+    idx = np.nonzero(mask)[0][:k]
+    data = {}
+    for i, it in enumerate(ctx.select_items):
+        v = eval_value(seg, it.expr)
+        data[f"c{i}"] = v[idx]
+    return pd.DataFrame(data)
+
+
+def selection_ob_frame(seg: ImmutableSegment, ctx: QueryContext, mask: np.ndarray, k: int) -> pd.DataFrame:
+    keys = []
+    for j, ob in enumerate(ctx.order_by):
+        v = eval_value(seg, ob.expr)
+        keys.append((f"__key{j}", v.astype(str) if v.dtype == object else v, not ob.desc))
+    df = pd.DataFrame({name: v for name, v, _ in keys})
+    df = df[mask]
+    proj = {}
+    for i, it in enumerate(ctx.select_items):
+        proj[f"c{i}"] = eval_value(seg, it.expr)[mask]
+    for c, v in proj.items():
+        df[c] = v
+    df = df.sort_values(by=[n for n, _, _ in keys], ascending=[a for _, _, a in keys], kind="mergesort")
+    return df.head(k)

@@ -1,0 +1,251 @@
+"""Spec -> compiled XLA program for per-segment query execution.
+
+Reference parity: this is the TPU-native replacement for Pinot's per-segment
+operator chain DocIdSetOperator -> ProjectionOperator -> TransformOperator ->
+AggregationOperator/GroupByOperator (call stack SURVEY.md §3.1; key files
+core/operator/DocIdSetOperator.java:59, core/operator/ProjectionOperator.java:68,
+core/query/aggregation/groupby/DefaultGroupByExecutor.java:191). Instead of
+pull-based 10k-doc blocks, the whole segment evaluates as ONE fused program:
+filter mask (vector compares + LUT gathers over dict ids), projection
+(dictionary-value gathers), aggregation (masked reductions / segment_sum with
+dense group ids). XLA fuses the chain; there are no intermediate
+materializations in HBM beyond what the compiler chooses.
+
+Compiled programs are cached per spec (plan shape), with literals as dynamic
+operands — the analog of Pinot reusing plans across identical query shapes.
+
+Accumulator dtype policy (Pinot parity: SUM/MIN/MAX/AVG return DOUBLE,
+COUNT returns LONG): float64 value accumulators, int64 counts. The TPU chip
+emulates both; a fast float32 policy is a planned bench option.
+"""
+
+from __future__ import annotations
+
+from functools import lru_cache
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+_F = jnp.float64
+_I = jnp.int64
+
+
+# ---------------------------------------------------------------------------
+# evaluation of value / filter specs (traced)
+# ---------------------------------------------------------------------------
+
+
+def _value(vspec, cols, ops):
+    kind = vspec[0]
+    if kind == "raw":
+        return cols[vspec[1]]
+    if kind == "ids":
+        return cols[vspec[1]]
+    if kind == "dictval":
+        return ops[vspec[2]][cols[vspec[1]]]
+    if kind == "lit":
+        return ops[vspec[1]]
+    if kind == "bin":
+        op = vspec[1]
+        l = _value(vspec[2], cols, ops)
+        r = _value(vspec[3], cols, ops)
+        if op == "+":
+            return l + r
+        if op == "-":
+            return l - r
+        if op == "*":
+            return l * r
+        if op == "/":
+            # Pinot DIVIDE always returns DOUBLE
+            return l.astype(_F) / r.astype(_F)
+        if op == "%":
+            return jnp.mod(l, r)
+        raise AssertionError(op)
+    raise AssertionError(vspec)
+
+
+_CMPS = {
+    "EQ": lambda a, b: a == b,
+    "NEQ": lambda a, b: a != b,
+    "LT": lambda a, b: a < b,
+    "LTE": lambda a, b: a <= b,
+    "GT": lambda a, b: a > b,
+    "GTE": lambda a, b: a >= b,
+}
+
+
+def _filter(fspec, cols, ops, n_padded):
+    kind = fspec[0]
+    if kind == "const":
+        return jnp.full((n_padded,), fspec[1], dtype=bool)
+    if kind == "and":
+        m = _filter(fspec[1][0], cols, ops, n_padded)
+        for c in fspec[1][1:]:
+            m = m & _filter(c, cols, ops, n_padded)
+        return m
+    if kind == "or":
+        m = _filter(fspec[1][0], cols, ops, n_padded)
+        for c in fspec[1][1:]:
+            m = m | _filter(c, cols, ops, n_padded)
+        return m
+    if kind == "not":
+        return ~_filter(fspec[1], cols, ops, n_padded)
+    if kind == "range_ids":
+        ids = cols[fspec[1]]
+        return (ids >= ops[fspec[2]]) & (ids <= ops[fspec[3]])
+    if kind == "in_lut":
+        return ops[fspec[2]][cols[fspec[1]]]
+    if kind == "cmp_raw":
+        v = cols[fspec[2]]
+        return _CMPS[fspec[1]](v.astype(_F), ops[fspec[3]])
+    if kind == "cmp_lit":
+        v = _value(fspec[2], cols, ops)
+        return _CMPS[fspec[1]](v.astype(_F), ops[fspec[3]])
+    if kind == "cmp2":
+        l = _value(fspec[2], cols, ops)
+        r = _value(fspec[3], cols, ops)
+        return _CMPS[fspec[1]](l.astype(_F), r.astype(_F))
+    if kind == "in_vals":
+        v = _value(fspec[1], cols, ops).astype(_F)
+        vals = ops[fspec[2]]
+        return (v[:, None] == vals[None, :]).any(axis=1)
+    raise AssertionError(fspec)
+
+
+# ---------------------------------------------------------------------------
+# aggregation partials
+# ---------------------------------------------------------------------------
+
+
+def _agg_scalar(aspec, cols, ops, mask):
+    kind = aspec[0]
+    if kind == "count":
+        return jnp.sum(mask, dtype=_I)
+    if kind == "distinct_ids":
+        col, pad = aspec[1], aspec[2]
+        presence = jnp.zeros((pad,), dtype=bool).at[cols[col]].max(mask)
+        return presence
+    v = _value(aspec[1], cols, ops).astype(_F)
+    if kind == "sum":
+        return jnp.sum(jnp.where(mask, v, 0.0))
+    if kind == "min":
+        return jnp.min(jnp.where(mask, v, jnp.inf))
+    if kind == "max":
+        return jnp.max(jnp.where(mask, v, -jnp.inf))
+    if kind == "avg":
+        return (jnp.sum(jnp.where(mask, v, 0.0)), jnp.sum(mask, dtype=_I))
+    if kind == "minmaxrange":
+        return (jnp.min(jnp.where(mask, v, jnp.inf)), jnp.max(jnp.where(mask, v, -jnp.inf)))
+    raise AssertionError(aspec)
+
+
+def _agg_grouped(aspec, cols, ops, mask, gid, ng):
+    kind = aspec[0]
+    if kind == "count":
+        return jax.ops.segment_sum(mask.astype(_I), gid, num_segments=ng)
+    v = _value(aspec[1], cols, ops).astype(_F)
+    if kind == "sum":
+        return jax.ops.segment_sum(jnp.where(mask, v, 0.0), gid, num_segments=ng)
+    if kind == "min":
+        return jax.ops.segment_min(jnp.where(mask, v, jnp.inf), gid, num_segments=ng)
+    if kind == "max":
+        return jax.ops.segment_max(jnp.where(mask, v, -jnp.inf), gid, num_segments=ng)
+    if kind == "avg":
+        return (
+            jax.ops.segment_sum(jnp.where(mask, v, 0.0), gid, num_segments=ng),
+            jax.ops.segment_sum(mask.astype(_I), gid, num_segments=ng),
+        )
+    if kind == "minmaxrange":
+        return (
+            jax.ops.segment_min(jnp.where(mask, v, jnp.inf), gid, num_segments=ng),
+            jax.ops.segment_max(jnp.where(mask, v, -jnp.inf), gid, num_segments=ng),
+        )
+    raise AssertionError(aspec)
+
+
+# ---------------------------------------------------------------------------
+# kernel construction
+# ---------------------------------------------------------------------------
+
+
+@lru_cache(maxsize=1024)
+def build_fn(spec: tuple):
+    """Build the (un-jitted) program for a plan spec. Used directly when
+    composing with vmap/shard_map in the sharded executor (parallel/mesh.py);
+    plain callers use get_kernel for the jitted form."""
+
+    kind = spec[0]
+
+    if kind == "agg":
+        _, fspec, gspec, aggs = spec
+
+        def run(cols, ops, n_docs):
+            n_padded = next(iter(cols.values())).shape[0]
+            valid = jnp.arange(n_padded, dtype=jnp.int32) < n_docs
+            mask = valid & _filter(fspec, cols, ops, n_padded)
+            matched = jnp.sum(mask, dtype=_I)
+            if gspec is None:
+                return matched, tuple(_agg_scalar(a, cols, ops, mask) for a in aggs)
+            _, gcols, ng, strides_idx = gspec
+            strides = ops[strides_idx]
+            gid = jnp.zeros((n_padded,), dtype=jnp.int32)
+            for i, c in enumerate(gcols):
+                gid = gid + cols[c] * strides[i]
+            counts = jax.ops.segment_sum(mask.astype(_I), gid, num_segments=ng)
+            return matched, counts, tuple(_agg_grouped(a, cols, ops, mask, gid, ng) for a in aggs)
+
+        return run
+
+    if kind == "select":
+        _, fspec, proj, k = spec
+
+        def run_select(cols, ops, n_docs):
+            n_padded = next(iter(cols.values())).shape[0]
+            valid = jnp.arange(n_padded, dtype=jnp.int32) < n_docs
+            mask = valid & _filter(fspec, cols, ops, n_padded)
+            matched = jnp.sum(mask, dtype=_I)
+            idx = jnp.nonzero(mask, size=k, fill_value=0)[0]
+            outs = tuple(_value(p, cols, ops)[idx] for p in proj)
+            return matched, outs
+
+        return run_select
+
+    if kind == "select_ob":
+        _, fspec, proj, kspec, desc, k = spec
+
+        def run_ob(cols, ops, n_docs):
+            n_padded = next(iter(cols.values())).shape[0]
+            valid = jnp.arange(n_padded, dtype=jnp.int32) < n_docs
+            mask = valid & _filter(fspec, cols, ops, n_padded)
+            matched = jnp.sum(mask, dtype=_I)
+            key = _value(kspec, cols, ops).astype(_F)
+            sort_key = jnp.where(mask, key if desc else -key, -jnp.inf)
+            kk = min(k, n_padded)
+            _, idx = jax.lax.top_k(sort_key, kk)
+            outs = tuple(_value(p, cols, ops)[idx] for p in proj)
+            keys_out = key[idx]
+            return matched, keys_out, outs
+
+        return run_ob
+
+    raise AssertionError(spec)
+
+
+@lru_cache(maxsize=1024)
+def get_kernel(spec: tuple):
+    """Jitted program for a plan spec. One compile per (spec, input shapes)."""
+    return jax.jit(build_fn(spec))
+
+
+def run_plan(plan, device_segment):
+    """Execute a SegmentPlan against a DeviceSegment; returns device outputs."""
+    kernel = get_kernel(plan.spec)
+    cols = {c: device_segment.arrays[c] for c in plan.columns}
+    if not cols:
+        # query touches no columns (e.g. SELECT COUNT(*) FROM t): feed a dummy
+        # array for shape discovery
+        any_col = next(iter(device_segment.arrays))
+        cols = {"__shape__": device_segment.arrays[any_col]}
+    ops = tuple(jnp.asarray(o) for o in plan.operands)
+    return kernel(cols, ops, np.int32(device_segment.n_docs))

@@ -1,0 +1,452 @@
+"""Per-segment physical planning: QueryContext -> (static spec, dynamic operands).
+
+Reference parity: InstancePlanMakerImplV2.makeSegmentPlanNode (pinot-core/.../
+plan/maker/InstancePlanMakerImplV2.java:291) + the filter operators
+(core/operator/filter/) and predicate evaluators. Redesigned for XLA:
+
+ * The *spec* is a hashable nested tuple describing the program shape
+   (predicate kinds, aggregation set, group layout, static padded sizes).
+   Kernels are compiled once per spec (compile cache ~ Pinot's plan cache).
+ * All literals/bounds/LUTs are *operands* (dynamic device inputs), so
+   `WHERE league='NL'` and `WHERE league='AL'` share one compiled program.
+ * Predicates on dictionary-encoded columns lower to integer id compares with
+   host-resolved bounds (the sorted-dictionary trick from
+   BaseDictionaryBasedPredicateEvaluator); IN/LIKE/REGEXP lower to a boolean
+   LUT over dict ids, gathered per doc. LUT/dict-value arrays are padded to
+   powers of two so different cardinalities reuse compiled programs.
+ * Dense group ids are sum(ids_i * stride_i) — the cardinality-product scheme
+   of DictionaryBasedGroupKeyGenerator.java:119-130 — fed to segment_sum with
+   a pow2-padded static group count.
+
+When a query shape has no device path yet (high-cardinality group-by,
+expression group keys, distinctcount-in-group-by), lowering raises
+`DeviceFallback` and the engine runs the host executor instead (correctness
+first; the fallback set shrinks each round).
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass, field
+from typing import Any
+
+import numpy as np
+
+from pinot_tpu.common.types import DataType
+from pinot_tpu.query import ast
+from pinot_tpu.query.ast import CompareOp, Expr, FilterExpr
+from pinot_tpu.query.context import AggregationInfo, QueryContext, QueryType
+from pinot_tpu.segment.segment import ImmutableSegment
+
+MAX_DENSE_GROUPS = 1 << 20
+
+
+class DeviceFallback(Exception):
+    """Query shape has no device lowering yet; use the host executor."""
+
+
+class PlanError(ValueError):
+    """Query is invalid against this segment/schema."""
+
+
+def _pow2(n: int) -> int:
+    return 1 if n <= 1 else 1 << (n - 1).bit_length()
+
+
+@dataclass
+class SegmentPlan:
+    spec: tuple  # static, hashable — keys the kernel compile cache
+    operands: tuple  # numpy arrays/scalars fed as dynamic inputs
+    columns: tuple[str, ...]  # device arrays the kernel reads, in order
+    # host-side decode info
+    group_cols: list[tuple[str, Any]] = field(default_factory=list)  # (col, ColumnIndex)
+    select_decode: list[tuple] = field(default_factory=list)
+    aggs: list[AggregationInfo] = field(default_factory=list)
+
+
+class _Lowering:
+    def __init__(self, seg: ImmutableSegment, ctx: QueryContext):
+        self.seg = seg
+        self.ctx = ctx
+        self.operands: list[Any] = []
+        self.columns: list[str] = []
+
+    # -- operand / column registration --------------------------------------
+
+    def op_idx(self, value) -> int:
+        self.operands.append(value)
+        return len(self.operands) - 1
+
+    def use_col(self, col: str) -> str:
+        if col not in self.seg.columns:
+            raise PlanError(f"unknown column {col!r} in table {self.ctx.table}")
+        if col not in self.columns:
+            self.columns.append(col)
+        return col
+
+    # -- value expressions ---------------------------------------------------
+
+    def value_spec(self, expr: Expr) -> tuple:
+        """Lower a value expression to a spec computing per-doc float64/int
+        values on device."""
+        if isinstance(expr, ast.Identifier):
+            ci = self.seg.columns.get(expr.name)
+            if ci is None:
+                raise PlanError(f"unknown column {expr.name!r}")
+            if ci.data_type in (DataType.STRING, DataType.BYTES, DataType.JSON):
+                raise PlanError(f"column {expr.name!r} is not numeric")
+            self.use_col(expr.name)
+            if ci.is_dict_encoded:
+                # operand: dictionary values padded to pow2 (repeat last value)
+                dv = np.asarray(ci.dictionary.values)
+                pad = _pow2(max(len(dv), 1))
+                if len(dv) == 0:
+                    dv = np.zeros(1, dtype=ci.data_type.np_dtype)
+                if len(dv) < pad:
+                    dv = np.concatenate([dv, np.full(pad - len(dv), dv[-1], dtype=dv.dtype)])
+                return ("dictval", expr.name, self.op_idx(dv))
+            return ("raw", expr.name)
+        if isinstance(expr, ast.Literal):
+            if not isinstance(expr.value, (int, float, bool)):
+                raise PlanError(f"non-numeric literal in value expression: {expr}")
+            return ("lit", self.op_idx(np.float64(expr.value)))
+        if isinstance(expr, ast.BinaryOp):
+            return ("bin", expr.op, self.value_spec(expr.left), self.value_spec(expr.right))
+        if isinstance(expr, ast.FunctionCall):
+            raise DeviceFallback(f"transform function {expr.name} has no device lowering yet")
+        raise PlanError(f"unsupported value expression: {expr}")
+
+    # -- filters -------------------------------------------------------------
+
+    def filter_spec(self, f: FilterExpr | None) -> tuple:
+        if f is None:
+            return ("const", True)
+        if isinstance(f, ast.And):
+            kids = [self.filter_spec(c) for c in f.children]
+            if any(k == ("const", False) for k in kids):
+                return ("const", False)
+            kids = [k for k in kids if k != ("const", True)]
+            if not kids:
+                return ("const", True)
+            return kids[0] if len(kids) == 1 else ("and", tuple(kids))
+        if isinstance(f, ast.Or):
+            kids = [self.filter_spec(c) for c in f.children]
+            if any(k == ("const", True) for k in kids):
+                return ("const", True)
+            kids = [k for k in kids if k != ("const", False)]
+            if not kids:
+                return ("const", False)
+            return kids[0] if len(kids) == 1 else ("or", tuple(kids))
+        if isinstance(f, ast.Not):
+            k = self.filter_spec(f.child)
+            if k[0] == "const":
+                return ("const", not k[1])
+            return ("not", k)
+        if isinstance(f, ast.Compare):
+            return self._compare(f)
+        if isinstance(f, ast.Between):
+            spec = self._range(f.expr, f.low, f.high, True, True)
+            return ("not", spec) if f.negated else spec
+        if isinstance(f, ast.In):
+            return self._in(f)
+        if isinstance(f, ast.Like):
+            pattern = _like_to_regex(f.pattern)
+            spec = self._regex_lut(f.expr, pattern, full=True)
+            return ("not", spec) if f.negated else spec
+        if isinstance(f, ast.RegexpLike):
+            return self._regex_lut(f.expr, f.pattern, full=False)
+        if isinstance(f, ast.IsNull):
+            # null handling disabled (Pinot default): IS NULL matches nothing
+            return ("const", bool(f.negated))
+        raise PlanError(f"unsupported filter: {f}")
+
+    def _compare(self, f: ast.Compare) -> tuple:
+        left, op, right = f.left, f.op, f.right
+        if isinstance(left, ast.Literal) and not isinstance(right, ast.Literal):
+            left, right = right, left
+            op = _FLIP[op]
+        if isinstance(left, ast.Literal) and isinstance(right, ast.Literal):
+            return ("const", _const_compare(op, left.value, right.value))
+        if not isinstance(right, ast.Literal):
+            # column-vs-column / expr-vs-expr compare: numeric expr compare
+            lv, rv = self.value_spec(left), self.value_spec(right)
+            return ("cmp2", op.name, lv, rv)
+        value = right.value
+        if isinstance(left, ast.Identifier):
+            ci = self.seg.columns.get(left.name)
+            if ci is None:
+                raise PlanError(f"unknown column {left.name!r}")
+            if ci.is_dict_encoded:
+                return self._dict_compare(left.name, ci, op, value)
+            return self._raw_compare(left.name, ci, op, value)
+        # predicate over computed expression, e.g. a+b > 5
+        vs = self.value_spec(left)
+        return ("cmp_lit", op.name, vs, self.op_idx(np.float64(value)))
+
+    def _dict_compare(self, col: str, ci, op: CompareOp, value) -> tuple:
+        d = ci.dictionary
+        self.use_col(col)
+        if op == CompareOp.EQ:
+            i = d.index_of(value)
+            if i < 0:
+                return ("const", False)
+            return ("range_ids", col, self.op_idx(np.int32(i)), self.op_idx(np.int32(i)))
+        if op == CompareOp.NEQ:
+            i = d.index_of(value)
+            if i < 0:
+                return ("const", True)
+            return ("not", ("range_ids", col, self.op_idx(np.int32(i)), self.op_idx(np.int32(i))))
+        if op == CompareOp.LT:
+            lo, hi = d.id_range_for(None, value, True, False)
+        elif op == CompareOp.LTE:
+            lo, hi = d.id_range_for(None, value, True, True)
+        elif op == CompareOp.GT:
+            lo, hi = d.id_range_for(value, None, False, True)
+        else:  # GTE
+            lo, hi = d.id_range_for(value, None, True, True)
+        if lo > hi:
+            return ("const", False)
+        if lo == 0 and hi == d.cardinality - 1:
+            return ("const", True)
+        return ("range_ids", col, self.op_idx(np.int32(lo)), self.op_idx(np.int32(hi)))
+
+    def _raw_compare(self, col: str, ci, op: CompareOp, value) -> tuple:
+        self.use_col(col)
+        v = self.op_idx(np.asarray(value, dtype=np.float64))
+        return ("cmp_raw", op.name, col, v)
+
+    def _range(self, expr: Expr, low: Expr, high: Expr, lo_incl: bool, hi_incl: bool) -> tuple:
+        if not isinstance(low, ast.Literal) or not isinstance(high, ast.Literal):
+            raise PlanError("BETWEEN bounds must be literals")
+        if isinstance(expr, ast.Identifier):
+            ci = self.seg.columns.get(expr.name)
+            if ci is None:
+                raise PlanError(f"unknown column {expr.name!r}")
+            if ci.is_dict_encoded:
+                self.use_col(expr.name)
+                lo, hi = ci.dictionary.id_range_for(low.value, high.value, lo_incl, hi_incl)
+                if lo > hi:
+                    return ("const", False)
+                if lo == 0 and hi == ci.dictionary.cardinality - 1:
+                    return ("const", True)
+                return ("range_ids", expr.name, self.op_idx(np.int32(lo)), self.op_idx(np.int32(hi)))
+        vs = self.value_spec(expr)
+        return (
+            "and",
+            (
+                ("cmp_lit", "GTE" if lo_incl else "GT", vs, self.op_idx(np.float64(low.value))),
+                ("cmp_lit", "LTE" if hi_incl else "LT", vs, self.op_idx(np.float64(high.value))),
+            ),
+        )
+
+    def _in(self, f: ast.In) -> tuple:
+        values = []
+        for v in f.values:
+            if not isinstance(v, ast.Literal):
+                raise PlanError("IN values must be literals")
+            values.append(v.value)
+        if isinstance(f.expr, ast.Identifier):
+            ci = self.seg.columns.get(f.expr.name)
+            if ci is None:
+                raise PlanError(f"unknown column {f.expr.name!r}")
+            if ci.is_dict_encoded:
+                self.use_col(f.expr.name)
+                ids = ci.dictionary.ids_for_values(values)
+                if len(ids) == 0:
+                    spec = ("const", False)
+                else:
+                    lut = np.zeros(_pow2(max(ci.dictionary.cardinality, 1)), dtype=bool)
+                    lut[ids] = True
+                    spec = ("in_lut", f.expr.name, self.op_idx(lut))
+                return ("not", spec) if f.negated and spec[0] != "const" else (
+                    ("const", not spec[1]) if f.negated else spec
+                )
+        # raw numeric IN: OR of equality compares against a padded value vector
+        vs = self.value_spec(f.expr)
+        vals = np.asarray([np.float64(v) for v in values], dtype=np.float64)
+        pad = _pow2(len(vals))
+        if len(vals) < pad:
+            vals = np.concatenate([vals, np.full(pad - len(vals), vals[0])])
+        spec = ("in_vals", vs, self.op_idx(vals), pad)
+        return ("not", spec) if f.negated else spec
+
+    def _regex_lut(self, expr: Expr, pattern: str, full: bool) -> tuple:
+        if not isinstance(expr, ast.Identifier):
+            raise PlanError("LIKE/REGEXP_LIKE requires a column")
+        ci = self.seg.columns.get(expr.name)
+        if ci is None:
+            raise PlanError(f"unknown column {expr.name!r}")
+        if not ci.is_dict_encoded:
+            raise PlanError("LIKE/REGEXP_LIKE requires a dictionary-encoded column")
+        self.use_col(expr.name)
+        rx = re.compile(pattern)
+        match = rx.fullmatch if full else rx.search
+        lut = np.zeros(_pow2(max(ci.dictionary.cardinality, 1)), dtype=bool)
+        for i, v in enumerate(ci.dictionary.values):
+            if match(str(v)):
+                lut[i] = True
+        if not lut.any():
+            return ("const", False)
+        return ("in_lut", expr.name, self.op_idx(lut))
+
+    # -- aggregations --------------------------------------------------------
+
+    def agg_spec(self, info: AggregationInfo, grouped: bool) -> tuple:
+        if info.func == "count":
+            return ("count",)
+        if info.func == "distinctcount":
+            if grouped:
+                raise DeviceFallback("DISTINCTCOUNT inside GROUP BY runs host-side for now")
+            if isinstance(info.arg, ast.Identifier):
+                ci = self.seg.columns.get(info.arg.name)
+                if ci is not None and ci.is_dict_encoded:
+                    self.use_col(info.arg.name)
+                    return ("distinct_ids", info.arg.name, _pow2(max(ci.cardinality, 1)))
+            raise DeviceFallback("DISTINCTCOUNT on raw/expression args runs host-side")
+        if info.func in ("sum", "min", "max", "avg", "minmaxrange"):
+            if info.arg is None:
+                raise PlanError(f"{info.func} requires an argument")
+            return (info.func, self.value_spec(info.arg))
+        raise DeviceFallback(f"aggregation {info.func} has no device lowering yet")
+
+    # -- group-by ------------------------------------------------------------
+
+    def group_spec(self) -> tuple:
+        cols = []
+        cards = []
+        for g in self.ctx.group_by:
+            if not isinstance(g, ast.Identifier):
+                raise DeviceFallback("expression GROUP BY keys run host-side for now")
+            ci = self.seg.columns.get(g.name)
+            if ci is None:
+                raise PlanError(f"unknown column {g.name!r}")
+            if not ci.is_dict_encoded:
+                raise DeviceFallback(f"GROUP BY on raw column {g.name} runs host-side for now")
+            self.use_col(g.name)
+            cols.append(g.name)
+            cards.append(ci.cardinality)
+        num_groups = 1
+        for c in cards:
+            num_groups *= max(c, 1)
+        if num_groups > MAX_DENSE_GROUPS:
+            raise DeviceFallback(
+                f"group cardinality product {num_groups} exceeds dense limit {MAX_DENSE_GROUPS}"
+            )
+        # strides: ids dot strides gives the dense group id
+        strides = np.ones(len(cols), dtype=np.int32)
+        for i in range(len(cols) - 2, -1, -1):
+            strides[i] = strides[i + 1] * max(cards[i + 1], 1)
+        return ("groups", tuple(cols), _pow2(num_groups), self.op_idx(strides))
+
+
+_FLIP = {
+    CompareOp.EQ: CompareOp.EQ,
+    CompareOp.NEQ: CompareOp.NEQ,
+    CompareOp.LT: CompareOp.GT,
+    CompareOp.LTE: CompareOp.GTE,
+    CompareOp.GT: CompareOp.LT,
+    CompareOp.GTE: CompareOp.LTE,
+}
+
+
+def _const_compare(op: CompareOp, a, b) -> bool:
+    return {
+        CompareOp.EQ: a == b,
+        CompareOp.NEQ: a != b,
+        CompareOp.LT: a < b,
+        CompareOp.LTE: a <= b,
+        CompareOp.GT: a > b,
+        CompareOp.GTE: a >= b,
+    }[op]
+
+
+def _like_to_regex(pattern: str) -> str:
+    out = []
+    for ch in pattern:
+        if ch == "%":
+            out.append(".*")
+        elif ch == "_":
+            out.append(".")
+        else:
+            out.append(re.escape(ch))
+    return "".join(out)
+
+
+def plan_segment(seg: ImmutableSegment, ctx: QueryContext) -> SegmentPlan:
+    """Lower a query against one segment. Raises DeviceFallback when the host
+    executor must take over."""
+    lo = _Lowering(seg, ctx)
+    fspec = lo.filter_spec(ctx.filter)
+
+    if ctx.query_type in (QueryType.AGGREGATION, QueryType.GROUP_BY):
+        grouped = ctx.query_type == QueryType.GROUP_BY
+        gspec = lo.group_spec() if grouped else None
+        aggs = tuple(lo.agg_spec(a, grouped) for a in ctx.aggregations)
+        spec = ("agg", fspec, gspec, aggs)
+        plan = SegmentPlan(
+            spec=spec,
+            operands=tuple(lo.operands),
+            columns=tuple(lo.columns),
+            group_cols=[(c, seg.columns[c]) for c in (gspec[1] if gspec else ())],
+            aggs=list(ctx.aggregations),
+        )
+        return plan
+
+    if ctx.query_type == QueryType.DISTINCT:
+        saved = ctx.group_by
+        ctx.group_by = [it.expr for it in ctx.select_items]
+        try:
+            gspec = lo.group_spec()
+        finally:
+            ctx.group_by = saved
+        spec = ("agg", fspec, gspec, ())
+        return SegmentPlan(
+            spec=spec,
+            operands=tuple(lo.operands),
+            columns=tuple(lo.columns),
+            group_cols=[(c, seg.columns[c]) for c in gspec[1]],
+            aggs=[],
+        )
+
+    # SELECTION / SELECTION_ORDER_BY
+    proj = []
+    decode = []
+    for item in ctx.select_items:
+        e = item.expr
+        if isinstance(e, ast.Star):
+            raise DeviceFallback("SELECT * expansion handled by engine")
+        if isinstance(e, ast.Identifier):
+            ci = seg.columns.get(e.name)
+            if ci is None:
+                raise PlanError(f"unknown column {e.name!r}")
+            lo.use_col(e.name)
+            if ci.is_dict_encoded:
+                proj.append(("ids", e.name))
+                decode.append(("dict", e.name))
+            else:
+                proj.append(("raw", e.name))
+                decode.append(("rawcol", e.name))
+        else:
+            proj.append(lo.value_spec(e))
+            decode.append(("expr", None))
+    k = ctx.limit + ctx.offset
+    if ctx.query_type == QueryType.SELECTION_ORDER_BY:
+        if len(ctx.order_by) != 1:
+            raise DeviceFallback("multi-column ORDER BY selection runs host-side for now")
+        ob = ctx.order_by[0]
+        key = ob.expr
+        if isinstance(key, ast.Identifier) and key.name in seg.columns and seg.columns[key.name].is_dict_encoded:
+            lo.use_col(key.name)
+            kspec = ("ids", key.name)  # dict id order == value order
+        else:
+            kspec = lo.value_spec(key)
+        spec = ("select_ob", fspec, tuple(proj), kspec, ob.desc, k)
+    else:
+        spec = ("select", fspec, tuple(proj), k)
+    return SegmentPlan(
+        spec=spec,
+        operands=tuple(lo.operands),
+        columns=tuple(lo.columns),
+        select_decode=decode,
+        aggs=[],
+    )

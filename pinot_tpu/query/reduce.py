@@ -1,0 +1,316 @@
+"""Broker-side reduce: merge per-segment partials into a final ResultTable.
+
+Reference parity: BrokerReduceService.reduceOnDataTable (pinot-core/.../query/
+reduce/BrokerReduceService.java:54,61) and the per-type reducers
+(GroupByDataTableReducer, AggregationDataTableReducer, SelectionDataTableReducer)
+plus HavingFilterHandler / PostAggregationHandler. Partials arrive as plain
+host structures (scalars / pandas DataFrames), whether they came off the
+device path or the host fallback executor — one merge path for both.
+
+Partial formats:
+  AGGREGATION: list aligned with ctx.aggregations; entries by func:
+      count -> int, sum -> float, min/max -> float, avg -> (sum, count),
+      minmaxrange -> (min, max), distinctcount -> set of values
+  GROUP_BY / DISTINCT: DataFrame with key columns k0..k{n-1} and partial
+      columns a{i}p{j} (agg i, part j)
+  SELECTION: DataFrame with positional columns c0..c{n-1}
+  SELECTION_ORDER_BY: same + "__key" sort column
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any
+
+import numpy as np
+import pandas as pd
+
+from pinot_tpu.query import ast
+from pinot_tpu.query.context import QueryContext, canonical
+from pinot_tpu.query.result import ResultTable
+
+# number of partial slots per aggregation function
+PART_COUNTS = {"avg": 2, "minmaxrange": 2}
+
+
+def parts_of(func: str) -> int:
+    return PART_COUNTS.get(func, 1)
+
+
+# ---------------------------------------------------------------------------
+# scalar expression evaluation over an environment (post-aggregation, having,
+# order-by on merged results)
+# ---------------------------------------------------------------------------
+
+
+def eval_scalar(expr: ast.Expr, env: dict[str, Any], aliases: dict[str, ast.Expr] | None = None):
+    if isinstance(expr, ast.Literal):
+        return expr.value
+    # a whole expression may itself be a group key (e.g. GROUP BY year-1990)
+    if not isinstance(expr, ast.Identifier):
+        cn = canonical(expr)
+        if cn in env:
+            return env[cn]
+    if isinstance(expr, ast.Identifier):
+        if expr.name in env:
+            return env[expr.name]
+        if aliases and expr.name in aliases:
+            return eval_scalar(aliases[expr.name], env, aliases)
+        raise KeyError(f"unknown reference {expr.name!r} in post-aggregation context")
+    if isinstance(expr, ast.FunctionCall):
+        name = canonical(expr)
+        if name in env:
+            return env[name]
+        # COUNT(DISTINCT x) was canonicalized to distinctcount(x)
+        if expr.name == "count" and expr.distinct:
+            alt = canonical(ast.FunctionCall("distinctcount", expr.args))
+            if alt in env:
+                return env[alt]
+        raise KeyError(f"aggregation {name!r} not computed")
+    if isinstance(expr, ast.BinaryOp):
+        l = eval_scalar(expr.left, env, aliases)
+        r = eval_scalar(expr.right, env, aliases)
+        if expr.op == "+":
+            return l + r
+        if expr.op == "-":
+            return l - r
+        if expr.op == "*":
+            return l * r
+        if expr.op == "/":
+            return float(l) / float(r) if r != 0 else float("inf") if l > 0 else float("-inf") if l < 0 else float("nan")
+        if expr.op == "%":
+            return math.fmod(l, r)
+    raise ValueError(f"cannot evaluate {expr} at reduce stage")
+
+
+def eval_having(f: ast.FilterExpr, env: dict[str, Any], aliases: dict[str, ast.Expr] | None = None) -> bool:
+    if isinstance(f, ast.And):
+        return all(eval_having(c, env, aliases) for c in f.children)
+    if isinstance(f, ast.Or):
+        return any(eval_having(c, env, aliases) for c in f.children)
+    if isinstance(f, ast.Not):
+        return not eval_having(f.child, env, aliases)
+    if isinstance(f, ast.Compare):
+        l = eval_scalar(f.left, env, aliases)
+        r = eval_scalar(f.right, env, aliases)
+        return {
+            ast.CompareOp.EQ: lambda: l == r,
+            ast.CompareOp.NEQ: lambda: l != r,
+            ast.CompareOp.LT: lambda: l < r,
+            ast.CompareOp.LTE: lambda: l <= r,
+            ast.CompareOp.GT: lambda: l > r,
+            ast.CompareOp.GTE: lambda: l >= r,
+        }[f.op]()
+    if isinstance(f, ast.Between):
+        v = eval_scalar(f.expr, env, aliases)
+        ok = eval_scalar(f.low, env, aliases) <= v <= eval_scalar(f.high, env, aliases)
+        return not ok if f.negated else ok
+    if isinstance(f, ast.In):
+        v = eval_scalar(f.expr, env, aliases)
+        vals = {eval_scalar(x, env, aliases) for x in f.values}
+        return (v not in vals) if f.negated else (v in vals)
+    raise ValueError(f"unsupported HAVING predicate: {f}")
+
+
+# ---------------------------------------------------------------------------
+# merge functions
+# ---------------------------------------------------------------------------
+
+
+def _merge_agg_partials(func: str, a, b):
+    if func == "count":
+        return a + b
+    if func == "sum":
+        return a + b
+    if func == "min":
+        return min(a, b)
+    if func == "max":
+        return max(a, b)
+    if func == "avg":
+        return (a[0] + b[0], a[1] + b[1])
+    if func == "minmaxrange":
+        return (min(a[0], b[0]), max(a[1], b[1]))
+    if func == "distinctcount":
+        return a | b
+    raise AssertionError(func)
+
+
+def _finalize(func: str, p):
+    if func == "count":
+        return int(p)
+    if func == "sum":
+        return float(p)
+    if func in ("min", "max"):
+        return float(p)
+    if func == "avg":
+        return float(p[0]) / p[1] if p[1] else float("-inf")  # Pinot: avg of 0 docs -> default
+    if func == "minmaxrange":
+        return float(p[1] - p[0])
+    if func == "distinctcount":
+        return len(p)
+    raise AssertionError(func)
+
+
+def _alias_map(ctx: QueryContext) -> dict[str, ast.Expr]:
+    return {it.alias: it.expr for it in ctx.select_items if it.alias}
+
+
+def reduce_aggregation(ctx: QueryContext, partials: list[list]) -> list[list]:
+    """Merge AGGREGATION partials -> single result row per the select list."""
+    if not partials:
+        merged = None
+    else:
+        merged = list(partials[0])
+        for p in partials[1:]:
+            merged = [_merge_agg_partials(a.func, m, x) for a, m, x in zip(ctx.aggregations, merged, p)]
+    env: dict[str, Any] = {}
+    if merged is None:
+        merged = [_empty_partial(a.func) for a in ctx.aggregations]
+    for a, p in zip(ctx.aggregations, merged):
+        env[a.name] = _finalize(a.func, p)
+    aliases = _alias_map(ctx)
+    row = [eval_scalar(it.expr, env, aliases) for it in ctx.select_items]
+    return [row]
+
+
+def _empty_partial(func: str):
+    return {
+        "count": 0,
+        "sum": 0.0,
+        "min": float("inf"),
+        "max": float("-inf"),
+        "avg": (0.0, 0),
+        "minmaxrange": (float("inf"), float("-inf")),
+        "distinctcount": set(),
+    }[func]
+
+
+def reduce_group_by(ctx: QueryContext, frames: list[pd.DataFrame]) -> list[list]:
+    nkeys = len(ctx.group_by)
+    key_cols = [f"k{i}" for i in range(nkeys)]
+    frames = [f for f in frames if len(f)]
+    if not frames:
+        return []
+    df = pd.concat(frames, ignore_index=True)
+    # merge partials per group
+    agg_map: dict[str, Any] = {}
+    for i, a in enumerate(ctx.aggregations):
+        if a.func in ("count", "sum", "avg"):
+            for j in range(parts_of(a.func)):
+                agg_map[f"a{i}p{j}"] = "sum"
+        elif a.func == "min":
+            agg_map[f"a{i}p0"] = "min"
+        elif a.func == "max":
+            agg_map[f"a{i}p0"] = "max"
+        elif a.func == "minmaxrange":
+            agg_map[f"a{i}p0"] = "min"
+            agg_map[f"a{i}p1"] = "max"
+        elif a.func == "distinctcount":
+            agg_map[f"a{i}p0"] = lambda s: set().union(*s)
+        else:
+            raise AssertionError(a.func)
+    if agg_map:
+        merged = df.groupby(key_cols, sort=False, dropna=False).agg(agg_map).reset_index()
+    else:
+        merged = df.drop_duplicates(subset=key_cols).reset_index(drop=True)
+
+    aliases = _alias_map(ctx)
+    rows = []
+    for _, r in merged.iterrows():
+        env: dict[str, Any] = {}
+        for i, g in enumerate(ctx.group_by):
+            env[canonical(g)] = r[f"k{i}"]
+        for i, a in enumerate(ctx.aggregations):
+            if parts_of(a.func) == 2:
+                p = (r[f"a{i}p0"], r[f"a{i}p1"])
+            else:
+                p = r[f"a{i}p0"]
+            env[a.name] = _finalize(a.func, p)
+        rows.append(env)
+
+    if ctx.having is not None:
+        rows = [e for e in rows if eval_having(ctx.having, e, aliases)]
+
+    if ctx.order_by:
+        def sort_key(env):
+            ks = []
+            for ob in ctx.order_by:
+                v = eval_scalar(ob.expr, env, aliases)
+                ks.append(_OrderKey(v, ob.desc))
+            return tuple(ks)
+
+        rows.sort(key=sort_key)
+
+    rows = rows[ctx.offset : ctx.offset + ctx.limit]
+    return [[eval_scalar(it.expr, env, aliases) for it in ctx.select_items] for env in rows]
+
+
+class _OrderKey:
+    """Comparable wrapper implementing DESC via reversed comparison."""
+
+    __slots__ = ("v", "desc")
+
+    def __init__(self, v, desc):
+        self.v = v
+        self.desc = desc
+
+    def __lt__(self, other):
+        if self.desc:
+            return other.v < self.v
+        return self.v < other.v
+
+    def __eq__(self, other):
+        return self.v == other.v
+
+
+def reduce_distinct(ctx: QueryContext, frames: list[pd.DataFrame]) -> list[list]:
+    frames = [f for f in frames if len(f)]
+    if not frames:
+        return []
+    nkeys = len(ctx.select_items)
+    key_cols = [f"k{i}" for i in range(nkeys)]
+    df = pd.concat(frames, ignore_index=True).drop_duplicates(subset=key_cols)
+    if ctx.order_by:
+        aliases = _alias_map(ctx)
+        name_of = {canonical(it.expr): f"k{i}" for i, it in enumerate(ctx.select_items)}
+        by, asc = [], []
+        for ob in ctx.order_by:
+            cn = canonical(ob.expr)
+            if cn not in name_of and aliases and cn in aliases:
+                cn = canonical(aliases[cn])
+            if cn not in name_of:
+                raise ValueError(f"DISTINCT ORDER BY must reference selected columns: {cn}")
+            by.append(name_of[cn])
+            asc.append(not ob.desc)
+        df = df.sort_values(by=by, ascending=asc, kind="mergesort")
+    df = df.iloc[ctx.offset : ctx.offset + ctx.limit]
+    return df[key_cols].values.tolist()
+
+
+def reduce_selection(ctx: QueryContext, frames: list[pd.DataFrame]) -> list[list]:
+    frames = [f for f in frames if len(f)]
+    if not frames:
+        return []
+    df = pd.concat(frames, ignore_index=True)
+    df = df.iloc[ctx.offset : ctx.offset + ctx.limit]
+    return df.values.tolist()
+
+
+def reduce_selection_order_by(ctx: QueryContext, frames: list[pd.DataFrame]) -> list[list]:
+    frames = [f for f in frames if len(f)]
+    if not frames:
+        return []
+    df = pd.concat(frames, ignore_index=True)
+    key_cols = [c for c in df.columns if str(c).startswith("__key")]
+    asc = [not ob.desc for ob in ctx.order_by[: len(key_cols)]]
+    df = df.sort_values(by=key_cols, ascending=asc, kind="mergesort")
+    df = df.iloc[ctx.offset : ctx.offset + ctx.limit]
+    return df.drop(columns=key_cols).values.tolist()
+
+
+def build_result(ctx: QueryContext, rows: list[list], **stats) -> ResultTable:
+    if ctx.query_type.name in ("SELECTION", "SELECTION_ORDER_BY", "DISTINCT"):
+        cols = [ctx.output_name(it) for it in ctx.select_items]
+    else:
+        cols = [ctx.output_name(it) for it in ctx.select_items]
+    return ResultTable(columns=cols, rows=rows, **stats)

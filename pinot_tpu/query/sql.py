@@ -1,0 +1,427 @@
+"""SQL lexer + recursive-descent parser for the Pinot SQL subset.
+
+Reference parity: CalciteSqlParser.compileToPinotQuery (pinot-common sql-utils,
+used at BaseSingleStageBrokerRequestHandler.java:300). Pinot delegates to
+Calcite's babel parser; here a hand-rolled parser covers the dialect the
+engine executes:
+
+    [SET key = value ;]*
+    SELECT [DISTINCT] item [, item]*
+    FROM table
+    [WHERE boolfilter]
+    [GROUP BY expr [, expr]*]
+    [HAVING boolfilter]
+    [ORDER BY expr [ASC|DESC] [, ...]]
+    [LIMIT n [OFFSET m] | LIMIT m, n]
+
+with arithmetic expressions, function calls (incl. COUNT(DISTINCT x)),
+BETWEEN / IN / LIKE / REGEXP_LIKE / IS [NOT] NULL predicates, quoted
+identifiers ("col" or `col`) and '' -escaped string literals.
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass
+
+from pinot_tpu.query.ast import (
+    And,
+    Between,
+    BinaryOp,
+    Compare,
+    CompareOp,
+    Expr,
+    FilterExpr,
+    FunctionCall,
+    Identifier,
+    In,
+    IsNull,
+    Like,
+    Literal,
+    Not,
+    Or,
+    OrderByItem,
+    RegexpLike,
+    SelectItem,
+    SelectStatement,
+    Star,
+)
+
+
+class SqlParseError(ValueError):
+    pass
+
+
+# ---------------------------------------------------------------------------
+# Lexer
+# ---------------------------------------------------------------------------
+
+_TOKEN_RE = re.compile(
+    r"""
+    (?P<ws>\s+)
+  | (?P<number>(?:\d+\.\d*|\.\d+|\d+)(?:[eE][+-]?\d+)?)
+  | (?P<string>'(?:[^']|'')*')
+  | (?P<qident>"(?:[^"]|"")*"|`(?:[^`]|``)*`)
+  | (?P<ident>[A-Za-z_][A-Za-z0-9_$.]*)
+  | (?P<op><>|!=|<=|>=|=|<|>|\+|-|\*|/|%|\(|\)|,|;)
+    """,
+    re.VERBOSE,
+)
+
+
+@dataclass
+class Token:
+    kind: str  # number | string | ident | qident | op | eof
+    text: str
+    pos: int
+
+    @property
+    def upper(self) -> str:
+        return self.text.upper()
+
+
+def tokenize(sql: str) -> list[Token]:
+    tokens: list[Token] = []
+    pos = 0
+    while pos < len(sql):
+        m = _TOKEN_RE.match(sql, pos)
+        if not m:
+            raise SqlParseError(f"unexpected character {sql[pos]!r} at position {pos}")
+        kind = m.lastgroup
+        if kind != "ws":
+            tokens.append(Token(kind, m.group(), pos))
+        pos = m.end()
+    tokens.append(Token("eof", "", pos))
+    return tokens
+
+
+_KEYWORDS = {
+    "SELECT", "FROM", "WHERE", "GROUP", "BY", "HAVING", "ORDER", "LIMIT",
+    "OFFSET", "AS", "AND", "OR", "NOT", "IN", "BETWEEN", "LIKE", "IS",
+    "NULL", "TRUE", "FALSE", "DISTINCT", "ASC", "DESC", "SET",
+}
+
+
+class Parser:
+    def __init__(self, sql: str):
+        self.tokens = tokenize(sql)
+        self.i = 0
+
+    # -- token helpers ------------------------------------------------------
+
+    def peek(self, ahead: int = 0) -> Token:
+        return self.tokens[min(self.i + ahead, len(self.tokens) - 1)]
+
+    def next(self) -> Token:
+        t = self.tokens[self.i]
+        self.i += 1
+        return t
+
+    def at_kw(self, *kws: str) -> bool:
+        t = self.peek()
+        return t.kind == "ident" and t.upper in kws
+
+    def eat_kw(self, *kws: str) -> bool:
+        if self.at_kw(*kws):
+            self.next()
+            return True
+        return False
+
+    def expect_kw(self, kw: str) -> None:
+        if not self.eat_kw(kw):
+            t = self.peek()
+            raise SqlParseError(f"expected {kw} at position {t.pos}, got {t.text!r}")
+
+    def at_op(self, *ops: str) -> bool:
+        t = self.peek()
+        return t.kind == "op" and t.text in ops
+
+    def eat_op(self, *ops: str) -> bool:
+        if self.at_op(*ops):
+            self.next()
+            return True
+        return False
+
+    def expect_op(self, op: str) -> None:
+        if not self.eat_op(op):
+            t = self.peek()
+            raise SqlParseError(f"expected {op!r} at position {t.pos}, got {t.text!r}")
+
+    # -- entry --------------------------------------------------------------
+
+    def parse(self) -> SelectStatement:
+        options: dict[str, str] = {}
+        # SET key = value; prefix statements (QueryOptionsUtils parity)
+        while self.at_kw("SET"):
+            self.next()
+            key = self._identifier_name(self.next())
+            self.expect_op("=")
+            t = self.next()
+            if t.kind == "string":
+                val = _unquote_string(t.text)
+            elif t.kind in ("number", "ident"):
+                val = t.text
+            else:
+                raise SqlParseError(f"bad SET value at {t.pos}")
+            options[key] = val
+            self.expect_op(";")
+
+        stmt = self._select()
+        stmt.options.update(options)
+        self.eat_op(";")
+        t = self.peek()
+        if t.kind != "eof":
+            raise SqlParseError(f"unexpected trailing input at position {t.pos}: {t.text!r}")
+        return stmt
+
+    def _select(self) -> SelectStatement:
+        self.expect_kw("SELECT")
+        distinct = self.eat_kw("DISTINCT")
+        items = [self._select_item()]
+        while self.eat_op(","):
+            items.append(self._select_item())
+        self.expect_kw("FROM")
+        table = self._identifier_name(self.next())
+        where = None
+        if self.eat_kw("WHERE"):
+            where = self._bool_expr()
+        group_by: list[Expr] = []
+        if self.at_kw("GROUP"):
+            self.next()
+            self.expect_kw("BY")
+            group_by.append(self._expr())
+            while self.eat_op(","):
+                group_by.append(self._expr())
+        having = None
+        if self.eat_kw("HAVING"):
+            having = self._bool_expr()
+        order_by: list[OrderByItem] = []
+        if self.at_kw("ORDER"):
+            self.next()
+            self.expect_kw("BY")
+            order_by.append(self._order_item())
+            while self.eat_op(","):
+                order_by.append(self._order_item())
+        limit = None
+        offset = 0
+        if self.eat_kw("LIMIT"):
+            n1 = self._int_literal()
+            if self.eat_op(","):  # LIMIT offset, limit (MySQL style)
+                offset = n1
+                limit = self._int_literal()
+            else:
+                limit = n1
+                if self.eat_kw("OFFSET"):
+                    offset = self._int_literal()
+        return SelectStatement(
+            select_list=items,
+            from_table=table,
+            distinct=distinct,
+            where=where,
+            group_by=group_by,
+            having=having,
+            order_by=order_by,
+            limit=limit,
+            offset=offset,
+        )
+
+    def _int_literal(self) -> int:
+        t = self.next()
+        if t.kind != "number" or not re.fullmatch(r"\d+", t.text):
+            raise SqlParseError(f"expected integer at position {t.pos}")
+        return int(t.text)
+
+    def _select_item(self) -> SelectItem:
+        expr = self._expr()
+        alias = None
+        if self.eat_kw("AS"):
+            alias = self._identifier_name(self.next())
+        elif self.peek().kind in ("ident", "qident") and not self.at_kw(*_KEYWORDS):
+            alias = self._identifier_name(self.next())
+        return SelectItem(expr, alias)
+
+    def _order_item(self) -> OrderByItem:
+        expr = self._expr()
+        desc = False
+        if self.eat_kw("DESC"):
+            desc = True
+        else:
+            self.eat_kw("ASC")
+        return OrderByItem(expr, desc)
+
+    def _identifier_name(self, t: Token) -> str:
+        if t.kind == "ident":
+            return t.text
+        if t.kind == "qident":
+            q = t.text[0]
+            return t.text[1:-1].replace(q * 2, q)
+        raise SqlParseError(f"expected identifier at position {t.pos}, got {t.text!r}")
+
+    # -- boolean expressions ------------------------------------------------
+
+    def _bool_expr(self) -> FilterExpr:
+        return self._bool_or()
+
+    def _bool_or(self) -> FilterExpr:
+        left = self._bool_and()
+        children = [left]
+        while self.eat_kw("OR"):
+            children.append(self._bool_and())
+        return Or(tuple(children)) if len(children) > 1 else left
+
+    def _bool_and(self) -> FilterExpr:
+        left = self._bool_not()
+        children = [left]
+        while self.eat_kw("AND"):
+            children.append(self._bool_not())
+        return And(tuple(children)) if len(children) > 1 else left
+
+    def _bool_not(self) -> FilterExpr:
+        if self.eat_kw("NOT"):
+            return Not(self._bool_not())
+        return self._bool_primary()
+
+    def _bool_primary(self) -> FilterExpr:
+        # Parenthesized boolean vs parenthesized value expression: try boolean.
+        if self.at_op("("):
+            save = self.i
+            self.next()
+            try:
+                inner = self._bool_expr()
+                self.expect_op(")")
+                return inner
+            except SqlParseError:
+                self.i = save  # fall through to predicate on value expr
+        # REGEXP_LIKE(col, 'pattern') and TEXT_MATCH-style boolean functions
+        if self.peek().kind == "ident" and self.peek().upper == "REGEXP_LIKE" and self.peek(1).text == "(":
+            self.next()
+            self.next()
+            expr = self._expr()
+            self.expect_op(",")
+            pat = self.next()
+            if pat.kind != "string":
+                raise SqlParseError(f"REGEXP_LIKE pattern must be a string at {pat.pos}")
+            self.expect_op(")")
+            return RegexpLike(expr, _unquote_string(pat.text))
+        return self._predicate()
+
+    def _predicate(self) -> FilterExpr:
+        left = self._expr()
+        negated = self.eat_kw("NOT")
+        if self.eat_kw("BETWEEN"):
+            low = self._expr()
+            self.expect_kw("AND")
+            high = self._expr()
+            return Between(left, low, high, negated)
+        if self.eat_kw("IN"):
+            self.expect_op("(")
+            vals = [self._expr()]
+            while self.eat_op(","):
+                vals.append(self._expr())
+            self.expect_op(")")
+            return In(left, tuple(vals), negated)
+        if self.eat_kw("LIKE"):
+            pat = self.next()
+            if pat.kind != "string":
+                raise SqlParseError(f"LIKE pattern must be a string at {pat.pos}")
+            return Like(left, _unquote_string(pat.text), negated)
+        if negated:
+            raise SqlParseError(f"expected BETWEEN/IN/LIKE after NOT at position {self.peek().pos}")
+        if self.eat_kw("IS"):
+            neg = self.eat_kw("NOT")
+            self.expect_kw("NULL")
+            return IsNull(left, neg)
+        for sym, op in (
+            ("=", CompareOp.EQ), ("!=", CompareOp.NEQ), ("<>", CompareOp.NEQ),
+            ("<=", CompareOp.LTE), (">=", CompareOp.GTE), ("<", CompareOp.LT), (">", CompareOp.GT),
+        ):
+            if self.eat_op(sym):
+                right = self._expr()
+                return Compare(op, left, right)
+        t = self.peek()
+        raise SqlParseError(f"expected predicate operator at position {t.pos}, got {t.text!r}")
+
+    # -- value expressions --------------------------------------------------
+
+    def _expr(self) -> Expr:
+        return self._additive()
+
+    def _additive(self) -> Expr:
+        left = self._multiplicative()
+        while self.at_op("+", "-"):
+            op = self.next().text
+            left = BinaryOp(op, left, self._multiplicative())
+        return left
+
+    def _multiplicative(self) -> Expr:
+        left = self._unary()
+        while self.at_op("*", "/", "%"):
+            op = self.next().text
+            left = BinaryOp(op, left, self._unary())
+        return left
+
+    def _unary(self) -> Expr:
+        if self.eat_op("-"):
+            inner = self._unary()
+            if isinstance(inner, Literal) and isinstance(inner.value, (int, float)):
+                return Literal(-inner.value)
+            return BinaryOp("-", Literal(0), inner)
+        self.eat_op("+")
+        return self._primary()
+
+    def _primary(self) -> Expr:
+        t = self.peek()
+        if t.kind == "op" and t.text == "(":
+            self.next()
+            e = self._expr()
+            self.expect_op(")")
+            return e
+        if t.kind == "op" and t.text == "*":
+            self.next()
+            return Star()
+        if t.kind == "number":
+            self.next()
+            if re.fullmatch(r"\d+", t.text):
+                return Literal(int(t.text))
+            return Literal(float(t.text))
+        if t.kind == "string":
+            self.next()
+            return Literal(_unquote_string(t.text))
+        if t.kind == "qident":
+            self.next()
+            return Identifier(self._identifier_name(t))
+        if t.kind == "ident":
+            up = t.upper
+            if up == "NULL":
+                self.next()
+                return Literal(None)
+            if up == "TRUE":
+                self.next()
+                return Literal(True)
+            if up == "FALSE":
+                self.next()
+                return Literal(False)
+            # function call?
+            if self.peek(1).kind == "op" and self.peek(1).text == "(":
+                self.next()
+                self.next()
+                distinct = self.eat_kw("DISTINCT")
+                args: list[Expr] = []
+                if not self.at_op(")"):
+                    args.append(self._expr())
+                    while self.eat_op(","):
+                        args.append(self._expr())
+                self.expect_op(")")
+                return FunctionCall(t.text.lower(), tuple(args), distinct)
+            self.next()
+            return Identifier(t.text)
+        raise SqlParseError(f"unexpected token {t.text!r} at position {t.pos}")
+
+
+def _unquote_string(s: str) -> str:
+    return s[1:-1].replace("''", "'")
+
+
+def parse_sql(sql: str) -> SelectStatement:
+    """Parse a SQL string into a SelectStatement AST."""
+    return Parser(sql).parse()

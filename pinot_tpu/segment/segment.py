@@ -75,12 +75,16 @@ class ImmutableSegment:
             raise KeyError(f"segment {self.name} has no column {name!r}")
         return self.columns[name]
 
-    def to_device(self, platform: str | None = None) -> "DeviceSegment":
-        import jax
+    def to_device(self, fast32: bool = False) -> "DeviceSegment":
+        """Stage to device memory.
+
+        Dtype policy: int64 raw columns are losslessly narrowed to int32 when
+        their min/max fit (cheaper lanes everywhere). float64 stays float64 —
+        the TPU emulates f64 and query semantics (Pinot DOUBLE) depend on it —
+        unless `fast32` opts into lossy float32 storage for speed.
+        """
         import jax.numpy as jnp
 
-        if platform is None:
-            platform = jax.default_backend()
         pad = padded_len(self.n_docs)
         arrays: dict[str, Any] = {}
         for name, ci in self.columns.items():
@@ -88,14 +92,12 @@ class ImmutableSegment:
             if len(fwd) < pad:
                 fwd = np.concatenate([fwd, np.zeros(pad - len(fwd), dtype=fwd.dtype)])
             dt = fwd.dtype
-            # TPU has no f64 compute; keep ids/ints at 32 bits where they fit.
-            if platform == "tpu":
-                if dt == np.float64:
-                    fwd = fwd.astype(np.float32)
-                elif dt == np.int64:
-                    # dict ids are already int32; this is the raw-column path
-                    if np.iinfo(np.int32).min <= ci.stats.min_value and ci.stats.max_value <= np.iinfo(np.int32).max:
-                        fwd = fwd.astype(np.int32)
+            if dt == np.int64:
+                # dict ids are already int32; this is the raw-column path
+                if np.iinfo(np.int32).min <= ci.stats.min_value and ci.stats.max_value <= np.iinfo(np.int32).max:
+                    fwd = fwd.astype(np.int32)
+            elif dt == np.float64 and fast32:
+                fwd = fwd.astype(np.float32)
             arrays[name] = jnp.asarray(fwd)
         return DeviceSegment(name=self.name, host=self, n_docs=self.n_docs, padded=pad, arrays=arrays)
 

@@ -1,0 +1,352 @@
+"""Query engine tests, modeled on Pinot's BaseQueriesTest pattern
+(pinot-core/src/test/java/org/apache/pinot/queries/BaseQueriesTest.java:74):
+build real segments from generated rows, run SQL through the real engine
+in-process, and check against an independent pandas oracle.
+
+Three segments with overlapping-but-different value sets ensure per-segment
+dictionaries differ, exercising cross-segment merge correctness.
+"""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from pinot_tpu.common import DataType, Schema
+from pinot_tpu.query import QueryEngine
+from pinot_tpu.segment import SegmentBuilder
+
+REGIONS = ["AFRICA", "AMERICA", "ASIA", "EUROPE", "MIDDLE EAST"]
+NATIONS = [f"NATION_{i:02d}" for i in range(25)]
+
+
+def _make_segment(builder, seed, n, name):
+    rng = np.random.default_rng(seed)
+    # different seeds draw from different value subsets -> distinct dictionaries
+    region_pool = rng.permutation(REGIONS)[: rng.integers(3, 6)]
+    nation_pool = rng.permutation(NATIONS)[: rng.integers(10, 25)]
+    data = {
+        "region": np.asarray(region_pool, dtype=object)[rng.integers(0, len(region_pool), n)],
+        "nation": np.asarray(nation_pool, dtype=object)[rng.integers(0, len(nation_pool), n)],
+        "year": rng.integers(1992, 1999, n).astype(np.int32),
+        "quantity": rng.integers(1, 51, n).astype(np.int32),
+        "revenue": rng.integers(100, 600_000, n).astype(np.int64),
+        "discount": np.round(rng.uniform(0, 0.1, n), 3),
+    }
+    return builder.build(data, name), pd.DataFrame({k: (v.astype(str) if v.dtype == object else v) for k, v in data.items()})
+
+
+@pytest.fixture(scope="module")
+def setup():
+    schema = Schema.build(
+        "lineorder",
+        dimensions=[("region", DataType.STRING), ("nation", DataType.STRING), ("year", DataType.INT)],
+        metrics=[("quantity", DataType.INT), ("revenue", DataType.LONG), ("discount", DataType.DOUBLE)],
+    )
+    builder = SegmentBuilder(schema)
+    segs, frames = [], []
+    for i, n in enumerate([4000, 2500, 3300]):
+        s, f = _make_segment(builder, 100 + i, n, f"lineorder_{i}")
+        segs.append(s)
+        frames.append(f)
+    engine = QueryEngine(segs)
+    table = pd.concat(frames, ignore_index=True)
+    return engine, table
+
+
+def rows_of(res):
+    return res.rows
+
+
+def to_map(res, nkeys=1):
+    out = {}
+    for r in res.rows:
+        key = tuple(r[:nkeys]) if nkeys > 1 else r[0]
+        out[key] = r[nkeys] if len(r) == nkeys + 1 else tuple(r[nkeys:])
+    return out
+
+
+# ---------------------------------------------------------------------------
+# aggregations (BASELINE.json configs 1 & 2)
+# ---------------------------------------------------------------------------
+
+
+def test_count_star_eq(setup):
+    engine, t = setup
+    res = engine.execute("SELECT COUNT(*) FROM lineorder WHERE region = 'ASIA'")
+    assert res.rows == [[int((t.region == "ASIA").sum())]]
+    assert res.total_docs == len(t)
+    assert res.num_docs_scanned == int((t.region == "ASIA").sum())
+
+
+def test_count_no_filter(setup):
+    engine, t = setup
+    res = engine.execute("SELECT COUNT(*) FROM lineorder")
+    assert res.rows == [[len(t)]]
+
+
+def test_sum_min_max_avg_with_range_and_eq(setup):
+    engine, t = setup
+    sel = t[(t.region == "EUROPE") & (t.year >= 1994) & (t.year <= 1997)]
+    res = engine.execute(
+        "SELECT SUM(revenue), MIN(quantity), MAX(discount), AVG(revenue) FROM lineorder "
+        "WHERE region = 'EUROPE' AND year BETWEEN 1994 AND 1997"
+    )
+    row = res.rows[0]
+    assert row[0] == pytest.approx(sel.revenue.sum())
+    assert row[1] == pytest.approx(sel.quantity.min())
+    assert row[2] == pytest.approx(sel.discount.max())
+    assert row[3] == pytest.approx(sel.revenue.mean())
+
+
+def test_filter_or_not_neq(setup):
+    engine, t = setup
+    sel = t[~((t.region == "ASIA") | (t.year != 1995))]
+    res = engine.execute("SELECT COUNT(*) FROM lineorder WHERE NOT (region = 'ASIA' OR year != 1995)")
+    assert res.rows == [[len(sel)]]
+
+
+def test_filter_in_not_in(setup):
+    engine, t = setup
+    sel = t[t.region.isin(["ASIA", "EUROPE"]) & ~t.year.isin([1992, 1998])]
+    res = engine.execute(
+        "SELECT COUNT(*) FROM lineorder WHERE region IN ('ASIA','EUROPE') AND year NOT IN (1992, 1998)"
+    )
+    assert res.rows == [[len(sel)]]
+
+
+def test_filter_on_raw_metric(setup):
+    engine, t = setup
+    sel = t[(t.quantity > 25) & (t.discount <= 0.05)]
+    res = engine.execute("SELECT COUNT(*) FROM lineorder WHERE quantity > 25 AND discount <= 0.05")
+    assert res.rows == [[len(sel)]]
+
+
+def test_filter_raw_in(setup):
+    engine, t = setup
+    sel = t[t.quantity.isin([1, 2, 3])]
+    res = engine.execute("SELECT COUNT(*) FROM lineorder WHERE quantity IN (1,2,3)")
+    assert res.rows == [[len(sel)]]
+
+
+def test_filter_expression(setup):
+    engine, t = setup
+    sel = t[t.quantity * 2 + 1 > 60]
+    res = engine.execute("SELECT COUNT(*) FROM lineorder WHERE quantity * 2 + 1 > 60")
+    assert res.rows == [[len(sel)]]
+
+
+def test_filter_like(setup):
+    engine, t = setup
+    sel = t[t.nation.str.match(r"NATION_0\d$")]
+    res = engine.execute("SELECT COUNT(*) FROM lineorder WHERE nation LIKE 'NATION_0_'")
+    # LIKE '_' matches exactly one char
+    assert res.rows == [[len(sel)]]
+
+
+def test_filter_regexp(setup):
+    engine, t = setup
+    sel = t[t.nation.str.contains(r"_1")]
+    res = engine.execute("SELECT COUNT(*) FROM lineorder WHERE REGEXP_LIKE(nation, '_1')")
+    assert res.rows == [[len(sel)]]
+
+
+def test_eq_absent_value(setup):
+    engine, t = setup
+    res = engine.execute("SELECT COUNT(*) FROM lineorder WHERE region = 'ATLANTIS'")
+    assert res.rows == [[0]]
+
+
+def test_post_aggregation_arithmetic(setup):
+    engine, t = setup
+    res = engine.execute("SELECT SUM(revenue) / COUNT(*) FROM lineorder")
+    assert res.rows[0][0] == pytest.approx(t.revenue.sum() / len(t))
+
+
+def test_distinctcount(setup):
+    engine, t = setup
+    res = engine.execute("SELECT DISTINCTCOUNT(nation) FROM lineorder WHERE year = 1995")
+    assert res.rows == [[t[t.year == 1995].nation.nunique()]]
+    res2 = engine.execute("SELECT COUNT(DISTINCT nation) FROM lineorder WHERE year = 1995")
+    assert res2.rows == res.rows
+
+
+def test_minmaxrange(setup):
+    engine, t = setup
+    res = engine.execute("SELECT MINMAXRANGE(revenue) FROM lineorder")
+    assert res.rows[0][0] == pytest.approx(t.revenue.max() - t.revenue.min())
+
+
+# ---------------------------------------------------------------------------
+# group-by (BASELINE.json configs 3 & 4)
+# ---------------------------------------------------------------------------
+
+
+def test_group_by_single_count(setup):
+    engine, t = setup
+    res = engine.execute("SELECT region, COUNT(*) FROM lineorder GROUP BY region LIMIT 100")
+    expected = t.groupby("region").size().to_dict()
+    assert to_map(res) == expected
+
+
+def test_group_by_sum_filtered(setup):
+    engine, t = setup
+    sel = t[t.year >= 1995]
+    res = engine.execute(
+        "SELECT region, SUM(revenue) FROM lineorder WHERE year >= 1995 GROUP BY region LIMIT 100"
+    )
+    expected = sel.groupby("region").revenue.sum().astype(float).to_dict()
+    got = to_map(res)
+    assert set(got) == set(expected)
+    for k in expected:
+        assert got[k] == pytest.approx(expected[k])
+
+
+def test_group_by_multi_dim_order_limit(setup):
+    engine, t = setup
+    res = engine.execute(
+        "SELECT year, region, SUM(revenue) FROM lineorder GROUP BY year, region "
+        "ORDER BY SUM(revenue) DESC LIMIT 5"
+    )
+    expected = (
+        t.groupby(["year", "region"]).revenue.sum().sort_values(ascending=False).head(5)
+    )
+    got = [(r[0], r[1], r[2]) for r in res.rows]
+    exp = [(y, reg, float(v)) for (y, reg), v in expected.items()]
+    assert [g[2] for g in got] == pytest.approx([e[2] for e in exp])
+    assert set(g[:2] for g in got) == set(e[:2] for e in exp)
+
+
+def test_group_by_avg_and_having(setup):
+    engine, t = setup
+    g = t.groupby("nation").agg(avg_q=("quantity", "mean"), n=("quantity", "size"))
+    expected = g[g.n > 300].avg_q.to_dict()
+    res = engine.execute(
+        "SELECT nation, AVG(quantity) FROM lineorder GROUP BY nation HAVING COUNT(*) > 300 LIMIT 100"
+    )
+    got = to_map(res)
+    assert set(got) == set(expected)
+    for k in expected:
+        assert got[k] == pytest.approx(expected[k])
+
+
+def test_group_by_order_by_key_asc(setup):
+    engine, t = setup
+    res = engine.execute(
+        "SELECT year, COUNT(*) FROM lineorder GROUP BY year ORDER BY year LIMIT 3"
+    )
+    expected = t.groupby("year").size().sort_index().head(3)
+    assert [r[0] for r in res.rows] == list(expected.index)
+    assert [r[1] for r in res.rows] == list(expected.values)
+
+
+def test_group_by_distinctcount_fallback(setup):
+    engine, t = setup
+    res = engine.execute(
+        "SELECT region, DISTINCTCOUNT(nation) FROM lineorder GROUP BY region LIMIT 100"
+    )
+    expected = t.groupby("region").nation.nunique().to_dict()
+    assert to_map(res) == expected
+
+
+def test_group_by_expression_key_fallback(setup):
+    engine, t = setup
+    res = engine.execute(
+        "SELECT year - 1990, COUNT(*) FROM lineorder GROUP BY year - 1990 LIMIT 100"
+    )
+    expected = {int(k): v for k, v in t.groupby(t.year - 1990).size().to_dict().items()}
+    got = {int(k): v for k, v in to_map(res).items()}
+    assert got == expected
+
+
+def test_group_by_empty_result(setup):
+    engine, t = setup
+    res = engine.execute("SELECT region, COUNT(*) FROM lineorder WHERE year = 1800 GROUP BY region")
+    assert res.rows == []
+
+
+# ---------------------------------------------------------------------------
+# selection / distinct
+# ---------------------------------------------------------------------------
+
+
+def test_selection_limit(setup):
+    engine, t = setup
+    res = engine.execute("SELECT region, year, quantity FROM lineorder WHERE year = 1996 LIMIT 7")
+    assert len(res.rows) == 7
+    sel = t[t.year == 1996]
+    valid = set(zip(sel.region, sel.year, sel.quantity))
+    for r in res.rows:
+        assert (r[0], r[1], r[2]) in valid
+
+
+def test_selection_order_by_desc(setup):
+    engine, t = setup
+    res = engine.execute(
+        "SELECT revenue, region FROM lineorder WHERE region='ASIA' ORDER BY revenue DESC LIMIT 5"
+    )
+    expected = t[t.region == "ASIA"].revenue.nlargest(5).tolist()
+    assert [r[0] for r in res.rows] == expected
+
+
+def test_selection_order_by_asc(setup):
+    engine, t = setup
+    res = engine.execute("SELECT quantity FROM lineorder ORDER BY quantity LIMIT 4")
+    expected = t.quantity.nsmallest(4).tolist()
+    assert [r[0] for r in res.rows] == expected
+
+
+def test_selection_order_by_string_key(setup):
+    engine, t = setup
+    res = engine.execute("SELECT nation FROM lineorder ORDER BY nation LIMIT 3")
+    expected = t.nation.sort_values().head(3).tolist()
+    assert [r[0] for r in res.rows] == expected
+
+
+def test_selection_star(setup):
+    engine, t = setup
+    res = engine.execute("SELECT * FROM lineorder LIMIT 2")
+    assert res.columns == ["region", "nation", "year", "quantity", "revenue", "discount"]
+    assert len(res.rows) == 2
+
+
+def test_selection_offset(setup):
+    engine, t = setup
+    r1 = engine.execute("SELECT quantity FROM lineorder ORDER BY quantity LIMIT 10")
+    r2 = engine.execute("SELECT quantity FROM lineorder ORDER BY quantity LIMIT 5 OFFSET 5")
+    assert [r[0] for r in r2.rows] == [r[0] for r in r1.rows[5:]]
+
+
+def test_distinct(setup):
+    engine, t = setup
+    res = engine.execute("SELECT DISTINCT region FROM lineorder LIMIT 100")
+    assert sorted(r[0] for r in res.rows) == sorted(t.region.unique())
+
+
+def test_distinct_multi_order(setup):
+    engine, t = setup
+    res = engine.execute("SELECT DISTINCT region, year FROM lineorder ORDER BY region, year DESC LIMIT 8")
+    expected = (
+        t[["region", "year"]]
+        .drop_duplicates()
+        .sort_values(["region", "year"], ascending=[True, False])
+        .head(8)
+    )
+    assert [(r[0], r[1]) for r in res.rows] == list(zip(expected.region, expected.year))
+
+
+def test_selection_order_by_multi_fallback(setup):
+    engine, t = setup
+    res = engine.execute(
+        "SELECT year, quantity FROM lineorder ORDER BY year DESC, quantity ASC LIMIT 6"
+    )
+    expected = t.sort_values(["year", "quantity"], ascending=[False, True]).head(6)
+    assert [(r[0], r[1]) for r in res.rows] == list(zip(expected.year, expected.quantity))
+
+
+def test_alias_in_order_by(setup):
+    engine, t = setup
+    res = engine.execute(
+        "SELECT region, SUM(revenue) AS rev FROM lineorder GROUP BY region ORDER BY rev DESC LIMIT 2"
+    )
+    expected = t.groupby("region").revenue.sum().sort_values(ascending=False).head(2)
+    assert [r[0] for r in res.rows] == list(expected.index)

@@ -1,0 +1,125 @@
+"""SQL parser tests (parity model: CalciteSqlCompilerTest in pinot-common)."""
+
+import pytest
+
+from pinot_tpu.query.ast import (
+    And, Between, BinaryOp, Compare, CompareOp, FunctionCall, Identifier, In,
+    IsNull, Like, Literal, Not, Or, RegexpLike, Star,
+)
+from pinot_tpu.query.sql import SqlParseError, parse_sql
+
+
+def test_basic_count():
+    s = parse_sql("SELECT COUNT(*) FROM baseballStats WHERE league='NL'")
+    assert s.from_table == "baseballStats"
+    assert s.select_list[0].expr == FunctionCall("count", (Star(),))
+    assert s.where == Compare(CompareOp.EQ, Identifier("league"), Literal("NL"))
+
+
+def test_projection_aliases():
+    s = parse_sql("SELECT a, b AS bb, a+b*2 total FROM t")
+    assert [i.alias for i in s.select_list] == [None, "bb", "total"]
+    assert s.select_list[2].expr == BinaryOp(
+        "+", Identifier("a"), BinaryOp("*", Identifier("b"), Literal(2))
+    )
+
+
+def test_where_precedence():
+    s = parse_sql("SELECT * FROM t WHERE a=1 OR b=2 AND c=3")
+    assert isinstance(s.where, Or)
+    assert isinstance(s.where.children[1], And)
+
+
+def test_not_and_parens():
+    s = parse_sql("SELECT * FROM t WHERE NOT (a=1 OR b=2)")
+    assert isinstance(s.where, Not)
+    assert isinstance(s.where.child, Or)
+
+
+def test_between_in_like():
+    s = parse_sql(
+        "SELECT * FROM t WHERE a BETWEEN 1 AND 10 AND b IN ('x','y') AND c NOT IN (1,2) "
+        "AND d LIKE 'foo%' AND e NOT BETWEEN 0 AND 1"
+    )
+    kids = s.where.children
+    assert kids[0] == Between(Identifier("a"), Literal(1), Literal(10))
+    assert kids[1] == In(Identifier("b"), (Literal("x"), Literal("y")))
+    assert kids[2] == In(Identifier("c"), (Literal(1), Literal(2)), negated=True)
+    assert kids[3] == Like(Identifier("d"), "foo%")
+    assert kids[4] == Between(Identifier("e"), Literal(0), Literal(1), negated=True)
+
+
+def test_is_null_regexp():
+    s = parse_sql("SELECT * FROM t WHERE a IS NOT NULL AND REGEXP_LIKE(b, '^x.*')")
+    assert s.where.children[0] == IsNull(Identifier("a"), negated=True)
+    assert s.where.children[1] == RegexpLike(Identifier("b"), "^x.*")
+
+
+def test_group_order_limit():
+    s = parse_sql(
+        "SELECT league, SUM(runs) FROM t GROUP BY league HAVING SUM(runs) > 10 "
+        "ORDER BY SUM(runs) DESC, league LIMIT 5 OFFSET 2"
+    )
+    assert s.group_by == [Identifier("league")]
+    assert s.having == Compare(CompareOp.GT, FunctionCall("sum", (Identifier("runs"),)), Literal(10))
+    assert s.order_by[0].desc and not s.order_by[1].desc
+    assert s.limit == 5 and s.offset == 2
+
+
+def test_mysql_limit():
+    s = parse_sql("SELECT * FROM t LIMIT 3, 7")
+    assert s.offset == 3 and s.limit == 7
+
+
+def test_distinct():
+    s = parse_sql("SELECT DISTINCT a, b FROM t")
+    assert s.distinct
+    s = parse_sql("SELECT COUNT(DISTINCT a) FROM t")
+    assert s.select_list[0].expr == FunctionCall("count", (Identifier("a"),), distinct=True)
+
+
+def test_quoted_identifiers_and_strings():
+    s = parse_sql('SELECT "wei""rd", `tick` FROM t WHERE x = \'O\'\'Brien\'')
+    assert s.select_list[0].expr == Identifier('wei"rd')
+    assert s.select_list[1].expr == Identifier("tick")
+    assert s.where.right == Literal("O'Brien")
+
+
+def test_set_options():
+    s = parse_sql("SET timeoutMs = 5000; SELECT * FROM t")
+    assert s.options == {"timeoutMs": "5000"}
+
+
+def test_negative_numbers():
+    s = parse_sql("SELECT * FROM t WHERE a > -5 AND b = -1.5e3")
+    assert s.where.children[0].right == Literal(-5)
+    assert s.where.children[1].right == Literal(-1500.0)
+
+
+def test_null_bool_literals():
+    s = parse_sql("SELECT * FROM t WHERE a = TRUE AND b != FALSE")
+    assert s.where.children[0].right == Literal(True)
+
+
+@pytest.mark.parametrize(
+    "bad",
+    [
+        "SELECT FROM t",
+        "SELECT * FROM",
+        "SELECT * FROM t WHERE",
+        "SELECT * FROM t WHERE a ==",
+        "SELECT * FROM t LIMIT x",
+        "SELECT * FROM t GROUP league",
+        "SELECT * FROM t; garbage",
+        "SELECT a FROM t WHERE a NOT 5",
+    ],
+)
+def test_parse_errors(bad):
+    with pytest.raises(SqlParseError):
+        parse_sql(bad)
+
+
+def test_roundtrip_str():
+    s = parse_sql("SELECT SUM(a) FROM t WHERE b IN ('x') AND c BETWEEN 1 AND 2 GROUP BY d")
+    assert "SUM" in str(s.select_list[0]).upper()
+    assert "BETWEEN" in str(s.where)
