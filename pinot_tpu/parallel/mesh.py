@@ -149,6 +149,10 @@ def _combine_tree(spec: tuple, matched, counts, parts, axis_name: str | None):
             out_parts.append((red_min(p[0]), red_max(p[1])))
         elif kind == "distinct_ids":
             out_parts.append(red_or(p))
+        elif kind == "hll":
+            out_parts.append(red_max(p))
+        elif kind == "hist":
+            out_parts.append(red_sum(p))
         else:
             raise AssertionError(kind)
     m = red_sum(matched)
@@ -196,6 +200,17 @@ def execute_sharded(table: ShardedTable, sql: str):
     ctx = QueryContext.from_sql(sql)
     if ctx.query_type not in (QueryType.AGGREGATION, QueryType.GROUP_BY):
         raise ValueError("sharded execution currently covers aggregation/group-by queries")
+    # global bounds hints from the table-level stats (single shared proto)
+    from pinot_tpu.query import ast as _ast
+
+    for a in ctx.aggregations:
+        if a.func == "percentileest" and isinstance(a.arg, _ast.Identifier):
+            ci = table.proto.columns.get(a.arg.name)
+            if ci is not None and isinstance(ci.stats.min_value, (int, float)):
+                ctx.hints.setdefault("est_bounds", {})[a.name] = (
+                    float(ci.stats.min_value),
+                    float(ci.stats.max_value),
+                )
     plan: SegmentPlan = plan_segment(table.proto, ctx)
     kernel = _sharded_kernel(plan.spec, table.mesh, table.mesh.axis_names[0])
     cols = {c: table.arrays[c] for c in plan.columns}

@@ -45,10 +45,13 @@ AGG_FUNCS = {
     "max",
     "avg",
     "distinctcount",
+    "distinctcountbitmap",
     "minmaxrange",
     "distinctcounthll",
     "percentile",
     "percentileest",
+    "percentiletdigest",
+    "mode",
 }
 
 
@@ -81,6 +84,7 @@ class AggregationInfo:
     func: str  # canonical lower-case function name
     arg: Expr | None  # None for count(*)
     name: str  # canonical output name
+    extra: tuple = ()  # literal args beyond the column (e.g. percentile rank)
 
     def __str__(self) -> str:
         return self.name
@@ -93,6 +97,7 @@ def _extract_aggs(expr: Expr, out: dict[str, AggregationInfo]) -> bool:
     if isinstance(expr, FunctionCall):
         fname = expr.name
         if fname in AGG_FUNCS or (fname == "count" and expr.distinct):
+            extra: tuple = ()
             if fname == "count" and expr.distinct:
                 # COUNT(DISTINCT x) is DISTINCTCOUNT(x) (Pinot rewrites the same)
                 func, arg = "distinctcount", expr.args[0]
@@ -101,7 +106,11 @@ def _extract_aggs(expr: Expr, out: dict[str, AggregationInfo]) -> bool:
                 func, arg, name = "count", None, canonical(expr)
             else:
                 func, arg, name = fname, (expr.args[0] if expr.args else None), canonical(expr)
-            out.setdefault(name, AggregationInfo(func, arg, name))
+                if fname in ("percentile", "percentileest", "percentiletdigest"):
+                    if len(expr.args) != 2 or not isinstance(expr.args[1], Literal):
+                        raise ValueError(f"{fname} requires (column, percentile) arguments")
+                    extra = (float(expr.args[1].value),)
+            out.setdefault(name, AggregationInfo(func, arg, name, extra))
             return True
         # transform function: recurse into args
         found = False
@@ -178,6 +187,9 @@ class QueryContext:
     limit: int
     offset: int
     options: dict[str, str] = field(default_factory=dict)
+    # engine-computed cross-segment planning hints (e.g. global min/max bounds
+    # for histogram-based percentile sketches)
+    hints: dict = field(default_factory=dict)
 
     @property
     def columns_used(self) -> set[str]:

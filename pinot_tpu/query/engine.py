@@ -50,6 +50,7 @@ class QueryEngine:
         stmt = parse_sql(sql)
         self._expand_star(stmt)
         ctx = QueryContext.from_statement(stmt)
+        self._compute_hints(ctx)
 
         partials = []
         scanned = 0
@@ -97,6 +98,26 @@ class QueryEngine:
 
     # ------------------------------------------------------------------
 
+    def _compute_hints(self, ctx: QueryContext) -> None:
+        """Cross-segment planning hints: global [min,max] bounds per
+        PERCENTILEEST aggregation so all segments build mergeable histograms
+        over identical bin edges."""
+        for a in ctx.aggregations:
+            if a.func != "percentileest" or not isinstance(a.arg, ast.Identifier):
+                continue
+            col = a.arg.name
+            los, his = [], []
+            ok = True
+            for seg in self.segments:
+                ci = seg.columns.get(col)
+                if ci is None or not isinstance(ci.stats.min_value, (int, float)):
+                    ok = False
+                    break
+                los.append(float(ci.stats.min_value))
+                his.append(float(ci.stats.max_value))
+            if ok and los:
+                ctx.hints.setdefault("est_bounds", {})[a.name] = (min(los), max(his))
+
     def _execute_segment(self, seg: ImmutableSegment, ctx: QueryContext):
         """Returns (partial, matched_docs) for one segment."""
         try:
@@ -143,12 +164,17 @@ class QueryEngine:
         for a, spec_entry, p in zip(ctx.aggregations, plan.spec[3], parts):
             if a.func == "count":
                 out.append(int(p))
-            elif a.func == "distinctcount":
+            elif a.func in ("distinctcount", "distinctcountbitmap"):
                 col = spec_entry[1]
                 ci = seg.columns[col]
                 presence = np.asarray(p)[: ci.cardinality]
                 vals = ci.dictionary.values[np.nonzero(presence)[0]]
                 out.append(set(vals.tolist()))
+            elif a.func == "distinctcounthll":
+                out.append(np.asarray(p))
+            elif a.func == "percentileest":
+                lo, hi = ctx.hints["est_bounds"][a.name]
+                out.append((np.asarray(p), lo, hi))
             elif a.func in ("avg", "minmaxrange"):
                 out.append((float(p[0]), int(p[1]) if a.func == "avg" else float(p[1])))
             else:

@@ -45,6 +45,30 @@ def eval_value(seg: ImmutableSegment, expr: ast.Expr) -> np.ndarray:
             return l.astype(np.float64) / r.astype(np.float64)
         if expr.op == "%":
             return np.mod(l, r)
+    if isinstance(expr, ast.FunctionCall):
+        from pinot_tpu.query.transforms import DEVICE_FUNCS, STRING_FUNCS, apply_string_func
+
+        name = expr.name
+        if name == "cast":
+            v = eval_value(seg, expr.args[0])
+            target = str(expr.args[1].value).upper()
+            if target in ("INT", "LONG", "TIMESTAMP", "BOOLEAN"):
+                return np.trunc(v.astype(np.float64)).astype(np.int64) if np.issubdtype(v.dtype, np.floating) else v
+            if target in ("FLOAT", "DOUBLE"):
+                return v.astype(np.float64)
+            if target == "STRING":
+                return np.asarray([str(x) for x in v], dtype=object)
+            raise PlanError(f"unsupported CAST target {target}")
+        if name in DEVICE_FUNCS:
+            _, fn = DEVICE_FUNCS[name]
+            # the device lambdas take the array module first — numpy works too
+            args = [eval_value(seg, a) for a in expr.args]
+            return np.asarray(fn(np, *args))
+        if name in STRING_FUNCS:
+            base = eval_value(seg, expr.args[0])
+            lit_args = tuple(a.value for a in expr.args[1:] if isinstance(a, ast.Literal))
+            derived, _ = apply_string_func(name, base, lit_args)
+            return derived
     raise PlanError(f"unsupported value expression in host executor: {expr}")
 
 
@@ -133,9 +157,40 @@ def agg_partials(seg: ImmutableSegment, ctx: QueryContext, mask: np.ndarray) -> 
         if a.func == "count":
             out.append(int(mask.sum()))
             continue
-        if a.func == "distinctcount":
+        if a.func in ("distinctcount", "distinctcountbitmap"):
             v = eval_value(seg, a.arg)[mask]
             out.append(set(v.tolist()))
+            continue
+        if a.func == "distinctcounthll":
+            from pinot_tpu.query.sketches import np_hll_registers
+
+            v = eval_value(seg, a.arg)[mask]
+            out.append(np_hll_registers(v))
+            continue
+        if a.func == "percentileest":
+            from pinot_tpu.query.sketches import EST_BINS
+
+            v = eval_value(seg, a.arg)[mask].astype(np.float64)
+            bounds = ctx.hints.get("est_bounds", {}).get(a.name)
+            if bounds is None:
+                out.append(v)  # exact-values mode (merged by concatenation)
+            else:
+                lo, hi = bounds
+                if hi > lo:
+                    b = np.clip(((v - lo) * (EST_BINS / (hi - lo))).astype(np.int64), 0, EST_BINS - 1)
+                    counts = np.bincount(b, minlength=EST_BINS).astype(np.int64)
+                else:
+                    counts = np.zeros(EST_BINS, dtype=np.int64)
+                    counts[0] = len(v)
+                out.append((counts, lo, hi))
+            continue
+        if a.func in ("percentile", "percentiletdigest"):
+            out.append(eval_value(seg, a.arg)[mask].astype(np.float64))
+            continue
+        if a.func == "mode":
+            v = eval_value(seg, a.arg)[mask]
+            vals, counts = np.unique(v, return_counts=True)
+            out.append({float(k): int(c) for k, c in zip(vals, counts)})
             continue
         v = eval_value(seg, a.arg)[mask].astype(np.float64)
         if a.func == "sum":
@@ -190,8 +245,17 @@ def group_frame(seg: ImmutableSegment, ctx: QueryContext, mask: np.ndarray) -> p
         elif a.func == "minmaxrange":
             out[f"a{i}p0"] = g[f"v{i}"].min().values.astype(np.float64)
             out[f"a{i}p1"] = g[f"v{i}"].max().values.astype(np.float64)
-        elif a.func == "distinctcount":
+        elif a.func in ("distinctcount", "distinctcountbitmap", "distinctcounthll"):
             out[f"a{i}p0"] = g[f"v{i}"].agg(lambda s: set(s.tolist())).values
+        elif a.func in ("percentile", "percentileest", "percentiletdigest"):
+            # .apply, not .agg: pandas agg rejects array-valued reducers
+            out[f"a{i}p0"] = g[f"v{i}"].apply(lambda s: np.asarray(s, dtype=np.float64)).values
+        elif a.func == "mode":
+            def _counter(s):
+                vals, counts = np.unique(np.asarray(s), return_counts=True)
+                return {float(k): int(c) for k, c in zip(vals, counts)}
+
+            out[f"a{i}p0"] = g[f"v{i}"].apply(_counter).values
         else:
             raise PlanError(f"unsupported aggregation in host executor: {a.func}")
     return out.drop(columns=["__size"])

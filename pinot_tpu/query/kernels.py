@@ -46,6 +46,18 @@ def _value(vspec, cols, ops):
         return ops[vspec[2]][cols[vspec[1]]]
     if kind == "lit":
         return ops[vspec[1]]
+    if kind == "fn":
+        from pinot_tpu.query.transforms import DEVICE_FUNCS
+
+        _, fn = DEVICE_FUNCS[vspec[1]]
+        args = [_value(a, cols, ops) for a in vspec[2]]
+        return fn(jnp, *args)
+    if kind == "cast_int":
+        v = _value(vspec[1], cols, ops)
+        # truncate toward zero (Pinot CAST AS INT/LONG semantics)
+        return jnp.trunc(v.astype(_F)).astype(_I) if jnp.issubdtype(v.dtype, jnp.floating) else v
+    if kind == "cast_float":
+        return _value(vspec[1], cols, ops).astype(_F)
     if kind == "bin":
         op = vspec[1]
         l = _value(vspec[2], cols, ops)
@@ -118,6 +130,23 @@ def _filter(fspec, cols, ops, n_padded):
 # ---------------------------------------------------------------------------
 
 
+def _hashes_for(hspec, cols, ops):
+    from pinot_tpu.query.sketches import jnp_mix32
+
+    if hspec[0] == "gather":
+        return ops[hspec[2]][cols[hspec[1]]]
+    # ("mix", vspec): hash numeric values by bit pattern. Integers hash by
+    # value; floats by their f64 bit pattern split into two u32 words so equal
+    # values hash identically across segments.
+    v = _value(hspec[1], cols, ops)
+    if jnp.issubdtype(v.dtype, jnp.floating):
+        bits = jax.lax.bitcast_convert_type(v.astype(_F), jnp.uint32)  # (..., 2)
+        return jnp_mix32(jnp, bits[..., 0] ^ jnp_mix32(jnp, bits[..., 1]))
+    lo = (v & 0xFFFFFFFF).astype(jnp.uint32)
+    hi = ((v.astype(_I) >> 32) & 0xFFFFFFFF).astype(jnp.uint32)
+    return jnp_mix32(jnp, lo ^ jnp_mix32(jnp, hi))
+
+
 def _agg_scalar(aspec, cols, ops, mask):
     kind = aspec[0]
     if kind == "count":
@@ -126,6 +155,16 @@ def _agg_scalar(aspec, cols, ops, mask):
         col, pad = aspec[1], aspec[2]
         presence = jnp.zeros((pad,), dtype=bool).at[cols[col]].max(mask)
         return presence
+    if kind == "hll":
+        from pinot_tpu.query.sketches import hll_update
+
+        hashes = _hashes_for(aspec[1], cols, ops)
+        return hll_update(jnp, jax, hashes, mask, aspec[2])
+    if kind == "hist":
+        v = _value(aspec[1], cols, ops).astype(_F)
+        lo, inv_w, nbins = ops[aspec[2]], ops[aspec[3]], aspec[4]
+        b = jnp.clip(jnp.floor((v - lo) * inv_w).astype(jnp.int32), 0, nbins - 1)
+        return jax.ops.segment_sum(mask.astype(_I), b, num_segments=nbins)
     v = _value(aspec[1], cols, ops).astype(_F)
     if kind == "sum":
         return jnp.sum(jnp.where(mask, v, 0.0))

@@ -113,8 +113,81 @@ class _Lowering:
         if isinstance(expr, ast.BinaryOp):
             return ("bin", expr.op, self.value_spec(expr.left), self.value_spec(expr.right))
         if isinstance(expr, ast.FunctionCall):
-            raise DeviceFallback(f"transform function {expr.name} has no device lowering yet")
+            return self._function_value(expr)
         raise PlanError(f"unsupported value expression: {expr}")
+
+    def _function_value(self, expr: ast.FunctionCall) -> tuple:
+        from pinot_tpu.query.transforms import DEVICE_FUNCS, STRING_FUNCS, apply_string_func
+
+        name = expr.name
+        if name == "cast":
+            if len(expr.args) != 2 or not isinstance(expr.args[1], ast.Literal):
+                raise PlanError("CAST requires CAST(expr AS type)")
+            target = str(expr.args[1].value).upper()
+            if target in ("INT", "LONG", "TIMESTAMP", "BOOLEAN"):
+                return ("cast_int", self.value_spec(expr.args[0]))
+            if target in ("FLOAT", "DOUBLE"):
+                return ("cast_float", self.value_spec(expr.args[0]))
+            raise DeviceFallback(f"CAST to {target} runs host-side")
+        if name in DEVICE_FUNCS:
+            arity, _ = DEVICE_FUNCS[name]
+            if len(expr.args) != arity:
+                raise PlanError(f"{name} expects {arity} args, got {len(expr.args)}")
+            return ("fn", name, tuple(self.value_spec(a) for a in expr.args))
+        if name in STRING_FUNCS:
+            # numeric-returning string functions (strlen, startswith, ...) over
+            # a dict column become a derived value table gathered by ids —
+            # cardinality-sized host work, doc-sized device gather.
+            derived, is_str, col = self._derived_string_values(expr)
+            if is_str:
+                raise PlanError(f"string-valued {name}(...) cannot be used in a numeric context")
+            self.use_col(col)
+            pad = _pow2(max(len(derived), 1))
+            dv = derived
+            if len(dv) == 0:
+                dv = np.zeros(1, dtype=np.float64)
+            if len(dv) < pad:
+                dv = np.concatenate([dv, np.full(pad - len(dv), dv[-1])])
+            return ("dictval", col, self.op_idx(dv))
+        raise DeviceFallback(f"transform function {name} has no device lowering yet")
+
+    def _derived_string_values(self, expr: ast.FunctionCall):
+        """Evaluate a string function over a dict column's VALUES host-side.
+        Returns (derived value array, returns_string, column name)."""
+        from pinot_tpu.query.transforms import apply_string_func
+
+        if not expr.args or not isinstance(expr.args[0], ast.Identifier):
+            raise DeviceFallback(f"{expr.name} over non-column args runs host-side")
+        col = expr.args[0].name
+        ci = self.seg.columns.get(col)
+        if ci is None:
+            raise PlanError(f"unknown column {col!r}")
+        if not ci.is_dict_encoded:
+            raise DeviceFallback(f"{expr.name} over raw column runs host-side")
+        lit_args = []
+        for a in expr.args[1:]:
+            if not isinstance(a, ast.Literal):
+                raise DeviceFallback(f"{expr.name} with non-literal args runs host-side")
+            lit_args.append(a.value)
+        derived, is_str = apply_string_func(expr.name, ci.dictionary.values, tuple(lit_args))
+        return derived, is_str, col
+
+    def _string_fn_lut(self, expr: ast.FunctionCall, pred) -> tuple:
+        """Predicate over a string-function-of-dict-column lowers to a LUT
+        over dict ids (evaluated per distinct value host-side)."""
+        derived, is_str, col = self._derived_string_values(expr)
+        if not is_str:
+            raise PlanError(f"{expr.name} is not string-valued")
+        self.use_col(col)
+        lut = np.zeros(_pow2(max(len(derived), 1)), dtype=bool)
+        for i, v in enumerate(derived):
+            if pred(str(v)):
+                lut[i] = True
+        if not lut.any():
+            return ("const", False)
+        if lut[: max(len(derived), 1)].all():
+            return ("const", True)
+        return ("in_lut", col, self.op_idx(lut))
 
     # -- filters -------------------------------------------------------------
 
@@ -179,9 +252,26 @@ class _Lowering:
             if ci.is_dict_encoded:
                 return self._dict_compare(left.name, ci, op, value)
             return self._raw_compare(left.name, ci, op, value)
+        if self._is_string_fn(left):
+            sv = str(value)
+            pred = {
+                CompareOp.EQ: lambda v: v == sv,
+                CompareOp.NEQ: lambda v: v != sv,
+                CompareOp.LT: lambda v: v < sv,
+                CompareOp.LTE: lambda v: v <= sv,
+                CompareOp.GT: lambda v: v > sv,
+                CompareOp.GTE: lambda v: v >= sv,
+            }[op]
+            return self._string_fn_lut(left, pred)
         # predicate over computed expression, e.g. a+b > 5
         vs = self.value_spec(left)
         return ("cmp_lit", op.name, vs, self.op_idx(np.float64(value)))
+
+    @staticmethod
+    def _is_string_fn(expr) -> bool:
+        from pinot_tpu.query.transforms import STRING_FUNCS
+
+        return isinstance(expr, ast.FunctionCall) and expr.name in STRING_FUNCS and STRING_FUNCS[expr.name][2]
 
     def _dict_compare(self, col: str, ci, op: CompareOp, value) -> tuple:
         d = ci.dictionary
@@ -261,6 +351,12 @@ class _Lowering:
                 return ("not", spec) if f.negated and spec[0] != "const" else (
                     ("const", not spec[1]) if f.negated else spec
                 )
+        if self._is_string_fn(f.expr):
+            vals = {str(v) for v in values}
+            spec = self._string_fn_lut(f.expr, lambda v: v in vals)
+            if f.negated:
+                return ("const", not spec[1]) if spec[0] == "const" else ("not", spec)
+            return spec
         # raw numeric IN: OR of equality compares against a padded value vector
         vs = self.value_spec(f.expr)
         vals = np.asarray([np.float64(v) for v in values], dtype=np.float64)
@@ -271,6 +367,10 @@ class _Lowering:
         return ("not", spec) if f.negated else spec
 
     def _regex_lut(self, expr: Expr, pattern: str, full: bool) -> tuple:
+        if self._is_string_fn(expr):
+            rx = re.compile(pattern)
+            match = rx.fullmatch if full else rx.search
+            return self._string_fn_lut(expr, lambda v: bool(match(v)))
         if not isinstance(expr, ast.Identifier):
             raise PlanError("LIKE/REGEXP_LIKE requires a column")
         ci = self.seg.columns.get(expr.name)
@@ -294,7 +394,7 @@ class _Lowering:
     def agg_spec(self, info: AggregationInfo, grouped: bool) -> tuple:
         if info.func == "count":
             return ("count",)
-        if info.func == "distinctcount":
+        if info.func in ("distinctcount", "distinctcountbitmap"):
             if grouped:
                 raise DeviceFallback("DISTINCTCOUNT inside GROUP BY runs host-side for now")
             if isinstance(info.arg, ast.Identifier):
@@ -303,11 +403,61 @@ class _Lowering:
                     self.use_col(info.arg.name)
                     return ("distinct_ids", info.arg.name, _pow2(max(ci.cardinality, 1)))
             raise DeviceFallback("DISTINCTCOUNT on raw/expression args runs host-side")
+        if info.func == "distinctcounthll":
+            if grouped:
+                raise DeviceFallback("DISTINCTCOUNTHLL inside GROUP BY runs host-side for now")
+            return self._hll_spec(info)
+        if info.func == "percentileest":
+            if grouped:
+                raise DeviceFallback("PERCENTILEEST inside GROUP BY runs host-side for now")
+            return self._hist_spec(info)
+        if info.func in ("percentile", "percentiletdigest", "mode"):
+            raise DeviceFallback(f"{info.func} runs host-side (full-values / counter intermediate)")
         if info.func in ("sum", "min", "max", "avg", "minmaxrange"):
             if info.arg is None:
                 raise PlanError(f"{info.func} requires an argument")
             return (info.func, self.value_spec(info.arg))
         raise DeviceFallback(f"aggregation {info.func} has no device lowering yet")
+
+    def _hll_spec(self, info: AggregationInfo) -> tuple:
+        from pinot_tpu.query.sketches import HLL_LOG2M, hash_any
+
+        if isinstance(info.arg, ast.Identifier):
+            ci = self.seg.columns.get(info.arg.name)
+            if ci is None:
+                raise PlanError(f"unknown column {info.arg.name!r}")
+            if ci.is_dict_encoded:
+                # host-hash the dictionary values once; device gathers by id
+                self.use_col(info.arg.name)
+                hv = hash_any(ci.dictionary.values)
+                pad = _pow2(max(len(hv), 1))
+                if len(hv) == 0:
+                    hv = np.zeros(1, dtype=np.uint32)
+                if len(hv) < pad:
+                    hv = np.concatenate([hv, np.zeros(pad - len(hv), dtype=np.uint32)])
+                return ("hll", ("gather", info.arg.name, self.op_idx(hv)), HLL_LOG2M)
+        # raw numeric column / numeric expression: device-side bit-mix hashing
+        if info.arg is None:
+            raise PlanError("distinctcounthll requires an argument")
+        return ("hll", ("mix", self.value_spec(info.arg)), HLL_LOG2M)
+
+    def _hist_spec(self, info: AggregationInfo) -> tuple:
+        from pinot_tpu.query.sketches import EST_BINS
+
+        bounds = self.ctx.hints.get("est_bounds", {}).get(info.name)
+        if bounds is None:
+            raise DeviceFallback("percentileest without global bounds runs host-side")
+        lo, hi = bounds
+        if not (hi > lo):
+            raise DeviceFallback("degenerate percentileest bounds run host-side")
+        inv_width = EST_BINS / (hi - lo)
+        return (
+            "hist",
+            self.value_spec(info.arg),
+            self.op_idx(np.float64(lo)),
+            self.op_idx(np.float64(inv_width)),
+            EST_BINS,
+        )
 
     # -- group-by ------------------------------------------------------------
 

@@ -118,9 +118,7 @@ def eval_having(f: ast.FilterExpr, env: dict[str, Any], aliases: dict[str, ast.E
 
 
 def _merge_agg_partials(func: str, a, b):
-    if func == "count":
-        return a + b
-    if func == "sum":
+    if func in ("count", "sum"):
         return a + b
     if func == "min":
         return min(a, b)
@@ -130,24 +128,63 @@ def _merge_agg_partials(func: str, a, b):
         return (a[0] + b[0], a[1] + b[1])
     if func == "minmaxrange":
         return (min(a[0], b[0]), max(a[1], b[1]))
-    if func == "distinctcount":
+    if func in ("distinctcount", "distinctcountbitmap"):
         return a | b
+    if func == "distinctcounthll":
+        if isinstance(a, (set, frozenset)):
+            return a | b
+        return np.maximum(a, b)
+    if func == "percentileest":
+        if isinstance(a, tuple):  # (hist counts, lo, hi)
+            return (a[0] + b[0], a[1], a[2])
+        return np.concatenate([a, b])  # exact-values fallback mode
+    if func in ("percentile", "percentiletdigest"):
+        return np.concatenate([a, b])
+    if func == "mode":
+        out = dict(a)
+        for k, v in b.items():
+            out[k] = out.get(k, 0) + v
+        return out
     raise AssertionError(func)
 
 
-def _finalize(func: str, p):
+def _exact_percentile(values: np.ndarray, pct: float) -> float:
+    if len(values) == 0:
+        return float("-inf")
+    v = np.sort(np.asarray(values, dtype=np.float64))
+    # Pinot PercentileAggregationFunction: value at (int)((len-1)*pct/100)
+    return float(v[int((len(v) - 1) * pct / 100.0)])
+
+
+def _finalize(a, p):
+    """Finalize a merged partial. `a` is the AggregationInfo."""
+    from pinot_tpu.query.sketches import hist_estimate, hll_estimate
+
+    func = a.func
     if func == "count":
         return int(p)
-    if func == "sum":
-        return float(p)
-    if func in ("min", "max"):
+    if func in ("sum", "min", "max"):
         return float(p)
     if func == "avg":
         return float(p[0]) / p[1] if p[1] else float("-inf")  # Pinot: avg of 0 docs -> default
     if func == "minmaxrange":
         return float(p[1] - p[0])
-    if func == "distinctcount":
+    if func in ("distinctcount", "distinctcountbitmap"):
         return len(p)
+    if func == "distinctcounthll":
+        # grouped/host partials are exact sets; device partials are registers
+        return len(p) if isinstance(p, (set, frozenset)) else hll_estimate(np.asarray(p))
+    if func == "percentileest":
+        if isinstance(p, tuple):
+            return hist_estimate(np.asarray(p[0]), p[1], p[2], a.extra[0])
+        return _exact_percentile(p, a.extra[0])
+    if func in ("percentile", "percentiletdigest"):
+        return _exact_percentile(p, a.extra[0])
+    if func == "mode":
+        if not p:
+            return float("-inf")
+        best = max(p.values())
+        return float(min(k for k, v in p.items() if v == best))  # Pinot MODE ties -> MIN
     raise AssertionError(func)
 
 
@@ -167,7 +204,7 @@ def reduce_aggregation(ctx: QueryContext, partials: list[list]) -> list[list]:
     if merged is None:
         merged = [_empty_partial(a.func) for a in ctx.aggregations]
     for a, p in zip(ctx.aggregations, merged):
-        env[a.name] = _finalize(a.func, p)
+        env[a.name] = _finalize(a, p)
     aliases = _alias_map(ctx)
     row = [eval_scalar(it.expr, env, aliases) for it in ctx.select_items]
     return [row]
@@ -182,6 +219,12 @@ def _empty_partial(func: str):
         "avg": (0.0, 0),
         "minmaxrange": (float("inf"), float("-inf")),
         "distinctcount": set(),
+        "distinctcountbitmap": set(),
+        "distinctcounthll": set(),
+        "percentile": np.zeros(0),
+        "percentileest": np.zeros(0),
+        "percentiletdigest": np.zeros(0),
+        "mode": {},
     }[func]
 
 
@@ -192,8 +235,19 @@ def reduce_group_by(ctx: QueryContext, frames: list[pd.DataFrame]) -> list[list]
     if not frames:
         return []
     df = pd.concat(frames, ignore_index=True)
-    # merge partials per group
+    # merge partials per group: scalar reducers via .agg, object-valued
+    # reducers (sets / value arrays / counters) via .apply (pandas agg
+    # rejects non-scalar returns)
     agg_map: dict[str, Any] = {}
+    apply_map: dict[str, Any] = {}
+
+    def _merge_counters(s):
+        out: dict = {}
+        for c in s:
+            for k, v in c.items():
+                out[k] = out.get(k, 0) + v
+        return out
+
     for i, a in enumerate(ctx.aggregations):
         if a.func in ("count", "sum", "avg"):
             for j in range(parts_of(a.func)):
@@ -205,12 +259,19 @@ def reduce_group_by(ctx: QueryContext, frames: list[pd.DataFrame]) -> list[list]
         elif a.func == "minmaxrange":
             agg_map[f"a{i}p0"] = "min"
             agg_map[f"a{i}p1"] = "max"
-        elif a.func == "distinctcount":
-            agg_map[f"a{i}p0"] = lambda s: set().union(*s)
+        elif a.func in ("distinctcount", "distinctcountbitmap", "distinctcounthll"):
+            apply_map[f"a{i}p0"] = lambda s: set().union(*s)
+        elif a.func in ("percentile", "percentileest", "percentiletdigest"):
+            apply_map[f"a{i}p0"] = lambda s: np.concatenate([np.asarray(x, dtype=np.float64) for x in s])
+        elif a.func == "mode":
+            apply_map[f"a{i}p0"] = _merge_counters
         else:
             raise AssertionError(a.func)
-    if agg_map:
-        merged = df.groupby(key_cols, sort=False, dropna=False).agg(agg_map).reset_index()
+    if agg_map or apply_map:
+        g = df.groupby(key_cols, sort=False, dropna=False)
+        merged = g.agg(agg_map).reset_index() if agg_map else g.size().reset_index().drop(columns=[0])
+        for col, fn in apply_map.items():
+            merged[col] = g[col].apply(fn).values
     else:
         merged = df.drop_duplicates(subset=key_cols).reset_index(drop=True)
 
@@ -225,7 +286,7 @@ def reduce_group_by(ctx: QueryContext, frames: list[pd.DataFrame]) -> list[list]
                 p = (r[f"a{i}p0"], r[f"a{i}p1"])
             else:
                 p = r[f"a{i}p0"]
-            env[a.name] = _finalize(a.func, p)
+            env[a.name] = _finalize(a, p)
         rows.append(env)
 
     if ctx.having is not None:

@@ -1,0 +1,143 @@
+"""Mergeable sketch aggregations: HyperLogLog and histogram quantiles.
+
+Reference parity: DistinctCountHLLAggregationFunction (pinot-core/.../query/
+aggregation/function/DistinctCountHLLAggregationFunction.java, default
+log2m=12 via clearspring HLL) and PercentileEstAggregationFunction
+(QuantileDigest-based). Redesigned TPU-first:
+
+ * HLL registers live as a dense (m,) int32 vector per (segment, agg); the
+   per-doc update is hash -> (register index, rank) -> scatter-max — exactly
+   the shape `segment_max` compiles well to. Merges (across segments, across
+   devices) are elementwise max, i.e. collectives-friendly.
+ * Percentile-EST uses a fixed-bin histogram over engine-provided global
+   [lo, hi] bounds: per-doc bin id -> segment_sum, merge = vector add,
+   estimate = cumulative scan. Bounded error = bin width; the reference's
+   QuantileDigest is likewise an approximation with different guarantees.
+
+Hashing: 32-bit avalanche (murmur3 finalizer). For dictionary-encoded columns
+the hash is precomputed HOST-SIDE over dictionary VALUES (cardinality-sized)
+and gathered by id on device, so strings never reach the device and the same
+value hashes identically across segments regardless of local dict ids.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+HLL_LOG2M = 12  # Pinot default log2m
+HLL_M = 1 << HLL_LOG2M
+EST_BINS = 4096
+
+
+def murmur_mix32(x: np.ndarray) -> np.ndarray:
+    """murmur3 fmix32 over uint32 (numpy, host side)."""
+    h = x.astype(np.uint32)
+    h ^= h >> 16
+    h = (h * np.uint32(0x85EBCA6B)) & np.uint32(0xFFFFFFFF)
+    h ^= h >> 13
+    h = (h * np.uint32(0xC2B2AE35)) & np.uint32(0xFFFFFFFF)
+    h ^= h >> 16
+    return h
+
+
+def hash_values_host(values: np.ndarray) -> np.ndarray:
+    """Hash arbitrary dictionary values to uint32 (host, cardinality-sized)."""
+    import zlib
+
+    out = np.empty(len(values), dtype=np.uint32)
+    for i, v in enumerate(values):
+        if isinstance(v, (bytes, bytearray)):
+            b = bytes(v)
+        else:
+            b = str(v).encode("utf-8")
+        out[i] = zlib.crc32(b) & 0xFFFFFFFF
+    return murmur_mix32(out)
+
+
+def jnp_mix32(jnp, x):
+    """murmur3 fmix32 in traced jnp (uint32 lanes)."""
+    h = x.astype(jnp.uint32)
+    h = h ^ (h >> 16)
+    h = h * jnp.uint32(0x85EBCA6B)
+    h = h ^ (h >> 13)
+    h = h * jnp.uint32(0xC2B2AE35)
+    h = h ^ (h >> 16)
+    return h
+
+
+def hll_update(jnp, jax, hashes_u32, mask, log2m: int = HLL_LOG2M):
+    """Per-doc HLL register update: returns (m,) int32 register vector."""
+    m = 1 << log2m
+    idx = (hashes_u32 >> (32 - log2m)).astype(jnp.int32)
+    w = (hashes_u32 << log2m).astype(jnp.uint32)
+    # rank = number of leading zeros of w (within 32-log2m bits) + 1
+    wf = w.astype(jnp.float64)
+    lg = jnp.floor(jnp.log2(jnp.maximum(wf, 1.0)))
+    clz = 31.0 - lg
+    rank = jnp.where(w == 0, 32 - log2m + 1, jnp.minimum(clz + 1, 32 - log2m + 1)).astype(jnp.int32)
+    rank = jnp.where(mask, rank, 0)
+    regs = jnp.zeros((m,), dtype=jnp.int32).at[idx].max(rank)
+    return regs
+
+
+def hll_estimate(registers: np.ndarray) -> int:
+    """Bias-corrected HLL cardinality estimate from a register vector."""
+    m = len(registers)
+    alpha = 0.7213 / (1 + 1.079 / m)
+    est = alpha * m * m / np.sum(np.exp2(-registers.astype(np.float64)))
+    zeros = int((registers == 0).sum())
+    if est <= 2.5 * m and zeros > 0:
+        est = m * np.log(m / zeros)
+    return int(round(est))
+
+
+def hash_any(values: np.ndarray) -> np.ndarray:
+    """Hash values to uint32 with type-stable schemes: strings/bytes via crc,
+    numerics via their bit pattern — matching the device-side mixers, so the
+    same logical value hashes identically whether it arrives via a dictionary
+    gather, a raw device column, or the host fallback."""
+    values = np.asarray(values)
+    if values.dtype == object or values.dtype.kind in ("U", "S"):
+        return hash_values_host(values)
+    if values.dtype.kind == "f":
+        bits = np.ascontiguousarray(values.astype(np.float64)).view(np.uint32).reshape(-1, 2)
+        return murmur_mix32(bits[:, 0] ^ murmur_mix32(bits[:, 1]))
+    v = values.astype(np.int64)
+    lo32 = (v & 0xFFFFFFFF).astype(np.uint32)
+    hi32 = ((v >> 32) & 0xFFFFFFFF).astype(np.uint32)
+    return murmur_mix32(lo32 ^ murmur_mix32(hi32))
+
+
+def np_hll_registers(values: np.ndarray, log2m: int = HLL_LOG2M) -> np.ndarray:
+    """Host (numpy) HLL register build over raw values — fallback-path analog
+    of hll_update. Produces registers identical in meaning to the device path
+    (same hash) so partials merge across paths."""
+    if len(values) == 0:
+        return np.zeros(1 << log2m, dtype=np.int32)
+    h = hash_any(values)
+    m = 1 << log2m
+    idx = (h >> (32 - log2m)).astype(np.int64)
+    w = (h << np.uint32(log2m)).astype(np.uint32)
+    maxrank = 32 - log2m + 1
+    with np.errstate(divide="ignore"):
+        lg = np.where(w > 0, np.floor(np.log2(np.maximum(w, 1).astype(np.float64))), 0)
+    rank = np.where(w == 0, maxrank, np.minimum(31 - lg + 1, maxrank)).astype(np.int32)
+    regs = np.zeros(m, dtype=np.int32)
+    np.maximum.at(regs, idx, rank)
+    return regs
+
+
+def hist_estimate(counts: np.ndarray, lo: float, hi: float, pct: float) -> float:
+    """Percentile estimate from a fixed-bin histogram (inclusive-rank rule,
+    matching sorted-array index (len-1)*pct/100)."""
+    total = int(counts.sum())
+    if total == 0:
+        return float("-inf")
+    if hi <= lo:
+        return float(lo)
+    target = int((total - 1) * pct / 100.0)
+    cum = np.cumsum(counts)
+    b = int(np.searchsorted(cum, target + 1))
+    width = (hi - lo) / len(counts)
+    # midpoint of the containing bin
+    return float(lo + (b + 0.5) * width)

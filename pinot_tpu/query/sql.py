@@ -403,6 +403,15 @@ class Parser:
                 return Literal(False)
             # function call?
             if self.peek(1).kind == "op" and self.peek(1).text == "(":
+                if up == "CAST":
+                    # CAST(expr AS TYPE) — AS + type token need special parsing
+                    self.next()
+                    self.next()
+                    inner = self._expr()
+                    self.expect_kw("AS")
+                    ty = self._identifier_name(self.next())
+                    self.expect_op(")")
+                    return FunctionCall("cast", (inner, Literal(ty.upper())))
                 self.next()
                 self.next()
                 distinct = self.eat_kw("DISTINCT")

@@ -1,0 +1,119 @@
+"""Transform (scalar) function registry.
+
+Reference parity: the 73 vectorized transform functions of
+pinot-core/.../operator/transform/function/ plus the @ScalarFunction registry
+(pinot-spi/.../annotations/ScalarFunction.java:45, FunctionRegistry.java:70).
+Redesigned in three tiers, matching where each function is cheapest on TPU:
+
+ 1. NUMERIC device functions — pure jnp elementwise ops fused into the query
+    program (abs/ceil/floor/exp/ln/sqrt/power/mod/...).
+ 2. DATETIME device functions — epoch-millis integer arithmetic (year/month/
+    day extraction via civil-from-days), still fused on device.
+ 3. STRING functions — never touch the device. A string function applied to a
+    dictionary-encoded column is rewritten HOST-SIDE as a transform of the
+    dictionary VALUES (cardinality-sized work instead of doc-count-sized),
+    producing a derived value table gathered by the existing ids. This is the
+    TPU-native answer to Pinot evaluating string transforms per-row.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+# ---------------------------------------------------------------------------
+# tier 1-2: device-side numeric/datetime functions
+# name -> (n_args, builder(jnp, *args) -> array)
+# ---------------------------------------------------------------------------
+
+
+def _civil_from_millis(jnp, ms):
+    """epoch millis -> (year, month, day) via Howard Hinnant's civil_from_days
+    algorithm (integer-only, vectorizes cleanly on the VPU)."""
+    days = jnp.floor_divide(ms, 86_400_000)
+    z = days + 719468
+    era = jnp.floor_divide(z, 146097)
+    doe = z - era * 146097
+    yoe = jnp.floor_divide(doe - jnp.floor_divide(doe, 1460) + jnp.floor_divide(doe, 36524) - jnp.floor_divide(doe, 146096), 365)
+    y = yoe + era * 400
+    doy = doe - (365 * yoe + jnp.floor_divide(yoe, 4) - jnp.floor_divide(yoe, 100))
+    mp = jnp.floor_divide(5 * doy + 2, 153)
+    d = doy - jnp.floor_divide(153 * mp + 2, 5) + 1
+    m = mp + jnp.where(mp < 10, 3, -9)
+    y = y + jnp.where(m <= 2, 1, 0)
+    return y, m, d
+
+
+DEVICE_FUNCS: dict[str, tuple[int, object]] = {
+    "abs": (1, lambda jnp, x: jnp.abs(x)),
+    "ceil": (1, lambda jnp, x: jnp.ceil(x.astype(jnp.float64))),
+    "floor": (1, lambda jnp, x: jnp.floor(x.astype(jnp.float64))),
+    "exp": (1, lambda jnp, x: jnp.exp(x.astype(jnp.float64))),
+    "ln": (1, lambda jnp, x: jnp.log(x.astype(jnp.float64))),
+    "log2": (1, lambda jnp, x: jnp.log2(x.astype(jnp.float64))),
+    "log10": (1, lambda jnp, x: jnp.log10(x.astype(jnp.float64))),
+    "sqrt": (1, lambda jnp, x: jnp.sqrt(x.astype(jnp.float64))),
+    "sign": (1, lambda jnp, x: jnp.sign(x).astype(jnp.float64)),
+    "power": (2, lambda jnp, x, y: jnp.power(x.astype(jnp.float64), y.astype(jnp.float64))),
+    "pow": (2, lambda jnp, x, y: jnp.power(x.astype(jnp.float64), y.astype(jnp.float64))),
+    "mod": (2, lambda jnp, x, y: jnp.mod(x, y)),
+    "least": (2, lambda jnp, x, y: jnp.minimum(x, y)),
+    "greatest": (2, lambda jnp, x, y: jnp.maximum(x, y)),
+    "add": (2, lambda jnp, x, y: x + y),
+    "sub": (2, lambda jnp, x, y: x - y),
+    "mult": (2, lambda jnp, x, y: x * y),
+    "div": (2, lambda jnp, x, y: x.astype(jnp.float64) / y.astype(jnp.float64)),
+    # datetime extracts over epoch millis (Pinot: year(ts), month(ts), ...)
+    "year": (1, lambda jnp, ms: _civil_from_millis(jnp, ms)[0]),
+    "month": (1, lambda jnp, ms: _civil_from_millis(jnp, ms)[1]),
+    "dayofmonth": (1, lambda jnp, ms: _civil_from_millis(jnp, ms)[2]),
+    "hour": (1, lambda jnp, ms: jnp.mod(jnp.floor_divide(ms, 3_600_000), 24)),
+    "minute": (1, lambda jnp, ms: jnp.mod(jnp.floor_divide(ms, 60_000), 60)),
+    "second": (1, lambda jnp, ms: jnp.mod(jnp.floor_divide(ms, 1_000), 60)),
+    "millissinceepoch": (1, lambda jnp, ms: ms),
+    "datetrunc_day": (1, lambda jnp, ms: jnp.floor_divide(ms, 86_400_000) * 86_400_000),
+    "datetrunc_hour": (1, lambda jnp, ms: jnp.floor_divide(ms, 3_600_000) * 3_600_000),
+}
+
+
+# ---------------------------------------------------------------------------
+# tier 3: string functions applied to dictionary values (host, card-sized)
+# name -> (n_args, fn(value:str, *literal_args) -> str|int)
+# functions returning int produce a numeric derived table (e.g. strlen).
+# ---------------------------------------------------------------------------
+
+
+def _substr(v: str, start, length=None):
+    s = int(start)
+    if length is None:
+        return v[s:]
+    return v[s : s + int(length)]
+
+
+STRING_FUNCS: dict[str, tuple[tuple[int, ...], object, bool]] = {
+    # name: (allowed arg counts (beyond the column), fn, returns_string)
+    "upper": ((0,), lambda v: v.upper(), True),
+    "lower": ((0,), lambda v: v.lower(), True),
+    "reverse": ((0,), lambda v: v[::-1], True),
+    "trim": ((0,), lambda v: v.strip(), True),
+    "ltrim": ((0,), lambda v: v.lstrip(), True),
+    "rtrim": ((0,), lambda v: v.rstrip(), True),
+    "length": ((0,), lambda v: len(v), False),
+    "strlen": ((0,), lambda v: len(v), False),
+    "substr": ((1, 2), _substr, True),
+    "replace": ((2,), lambda v, a, b: v.replace(str(a), str(b)), True),
+    "concat": ((1,), lambda v, suffix: v + str(suffix), True),
+    "startswith": ((1,), lambda v, p: int(v.startswith(str(p))), False),
+    "endswith": ((1,), lambda v, p: int(v.endswith(str(p))), False),
+}
+
+
+def apply_string_func(name: str, values: np.ndarray, args: tuple) -> tuple[np.ndarray, bool]:
+    """Apply a string function to a dictionary's value array. Returns
+    (derived values, returns_string)."""
+    counts, fn, is_str = STRING_FUNCS[name]
+    if len(args) not in counts:
+        raise ValueError(f"{name} expects {counts} extra args, got {len(args)}")
+    out = [fn(str(v), *args) for v in values]
+    if is_str:
+        return np.asarray(out, dtype=object), True
+    return np.asarray(out, dtype=np.float64), False

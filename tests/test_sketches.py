@@ -1,0 +1,105 @@
+"""Sketch aggregation tests: HLL distinct counts, percentiles, mode
+(parity: DistinctCountHLL/Percentile/Mode aggregation function tests)."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from pinot_tpu.common import DataType, Schema
+from pinot_tpu.query import QueryEngine
+from pinot_tpu.segment import SegmentBuilder
+from pinot_tpu.query.sketches import np_hll_registers, hll_estimate
+
+
+@pytest.fixture(scope="module")
+def setup():
+    rng = np.random.default_rng(11)
+    n = 60_000
+    schema = Schema.build(
+        "u",
+        dimensions=[("user", DataType.STRING), ("site", DataType.STRING)],
+        metrics=[("lat", DataType.DOUBLE), ("uid", DataType.LONG)],
+    )
+    data = {
+        "user": np.asarray([f"user_{i}" for i in rng.integers(0, 20_000, n)], dtype=object),
+        "site": np.array(["a", "b", "c"], dtype=object)[rng.integers(0, 3, n)],
+        "lat": np.round(rng.gamma(2.0, 30.0, n), 3),
+        "uid": rng.integers(0, 50_000, n).astype(np.int64),
+    }
+    segs = []
+    b = SegmentBuilder(schema)
+    for i in range(3):
+        sl = slice(i * 20_000, (i + 1) * 20_000)
+        segs.append(b.build({k: v[sl] for k, v in data.items()}, f"s{i}"))
+    t = pd.DataFrame({k: (v.astype(str) if v.dtype == object else v) for k, v in data.items()})
+    return QueryEngine(segs), t
+
+
+def test_hll_registers_estimate_accuracy():
+    vals = np.asarray([f"v{i}" for i in range(100_000)], dtype=object)
+    est = hll_estimate(np_hll_registers(vals))
+    assert abs(est - 100_000) / 100_000 < 0.05
+
+
+def test_hll_string_column(setup):
+    e, t = setup
+    r = e.execute("SELECT DISTINCTCOUNTHLL(user) FROM u")
+    truth = t.user.nunique()
+    assert abs(r.rows[0][0] - truth) / truth < 0.05
+
+
+def test_hll_numeric_raw_column(setup):
+    e, t = setup
+    r = e.execute("SELECT DISTINCTCOUNTHLL(uid) FROM u WHERE site = 'a'")
+    truth = t[t.site == "a"].uid.nunique()
+    assert abs(r.rows[0][0] - truth) / truth < 0.05
+
+
+def test_hll_in_group_by_exact_sets(setup):
+    e, t = setup
+    r = e.execute("SELECT site, DISTINCTCOUNTHLL(user) FROM u GROUP BY site LIMIT 10")
+    truth = t.groupby("site").user.nunique().to_dict()
+    got = {row[0]: row[1] for row in r.rows}
+    assert got == truth  # grouped path keeps exact sets
+
+
+def test_percentile_exact(setup):
+    e, t = setup
+    r = e.execute("SELECT PERCENTILE(lat, 95), PERCENTILE(lat, 50) FROM u")
+    v = np.sort(t.lat.to_numpy())
+    assert r.rows[0][0] == pytest.approx(v[int((len(v) - 1) * 0.95)])
+    assert r.rows[0][1] == pytest.approx(v[int((len(v) - 1) * 0.50)])
+
+
+def test_percentileest_histogram(setup):
+    e, t = setup
+    r = e.execute("SELECT PERCENTILEEST(lat, 90) FROM u")
+    v = np.sort(t.lat.to_numpy())
+    exact = v[int((len(v) - 1) * 0.90)]
+    width = (v.max() - v.min()) / 4096
+    assert abs(r.rows[0][0] - exact) <= 2 * width + 1e-9
+
+
+def test_mode(setup):
+    e, t = setup
+    r = e.execute("SELECT MODE(uid) FROM u WHERE site='b'")
+    vc = t[t.site == "b"].uid.value_counts()
+    best = vc.max()
+    expected = float(min(vc[vc == best].index))
+    assert r.rows[0][0] == expected
+
+
+def test_percentile_group_by(setup):
+    e, t = setup
+    r = e.execute("SELECT site, PERCENTILE(lat, 50) FROM u GROUP BY site LIMIT 10")
+    got = {row[0]: row[1] for row in r.rows}
+    for site, grp in t.groupby("site"):
+        v = np.sort(grp.lat.to_numpy())
+        assert got[site] == pytest.approx(v[int((len(v) - 1) * 0.5)])
+
+
+def test_count_distinct_alias(setup):
+    e, t = setup
+    a = e.execute("SELECT DISTINCTCOUNTBITMAP(site) FROM u").rows
+    b_ = e.execute("SELECT DISTINCTCOUNT(site) FROM u").rows
+    assert a == b_ == [[3]]

@@ -1,0 +1,97 @@
+"""Transform function tests (parity: pinot-core transform function tests +
+ScalarFunction registry)."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from pinot_tpu.common import DataType, Schema
+from pinot_tpu.query import QueryEngine
+from pinot_tpu.segment import SegmentBuilder
+
+
+@pytest.fixture(scope="module")
+def setup():
+    rng = np.random.default_rng(5)
+    n = 8000
+    schema = Schema.build(
+        "ev",
+        dimensions=[("name", DataType.STRING), ("code", DataType.INT)],
+        metrics=[("val", DataType.DOUBLE), ("ts", DataType.LONG)],
+        date_times=[],
+    )
+    # timestamps over 2020-2023
+    data = {
+        "name": np.array(["Alpha", "beta", "GammaLong", "dx"], dtype=object)[rng.integers(0, 4, n)],
+        "code": rng.integers(-50, 50, n).astype(np.int32),
+        "val": np.round(rng.normal(0, 10, n), 3),
+        "ts": rng.integers(1577836800000, 1704067200000, n).astype(np.int64),
+    }
+    segs = [SegmentBuilder(schema).build(data, "s0")]
+    t = pd.DataFrame({k: (v.astype(str) if v.dtype == object else v) for k, v in data.items()})
+    return QueryEngine(segs), t
+
+
+def test_abs_sum(setup):
+    e, t = setup
+    r = e.execute("SELECT SUM(ABS(val)) FROM ev")
+    assert r.rows[0][0] == pytest.approx(t.val.abs().sum())
+
+
+def test_floor_ceil_sqrt_power(setup):
+    e, t = setup
+    r = e.execute("SELECT SUM(FLOOR(val)), SUM(CEIL(val)), SUM(SQRT(ABS(val))), SUM(POWER(code, 2)) FROM ev")
+    assert r.rows[0][0] == pytest.approx(np.floor(t.val).sum())
+    assert r.rows[0][1] == pytest.approx(np.ceil(t.val).sum())
+    assert r.rows[0][2] == pytest.approx(np.sqrt(t.val.abs()).sum())
+    assert r.rows[0][3] == pytest.approx((t.code.astype(float) ** 2).sum())
+
+
+def test_filter_on_transform(setup):
+    e, t = setup
+    r = e.execute("SELECT COUNT(*) FROM ev WHERE ABS(code) > 25")
+    assert r.rows == [[int((t.code.abs() > 25).sum())]]
+
+
+def test_datetime_extract_group_by(setup):
+    e, t = setup
+    r = e.execute("SELECT COUNT(*) FROM ev WHERE YEAR(ts) = 2022")
+    years = pd.to_datetime(t.ts, unit="ms").dt.year
+    assert r.rows == [[int((years == 2022).sum())]]
+    r2 = e.execute("SELECT SUM(HOUR(ts)) FROM ev")
+    hours = pd.to_datetime(t.ts, unit="ms").dt.hour
+    assert r2.rows[0][0] == pytest.approx(hours.sum())
+
+
+def test_string_fn_numeric_strlen(setup):
+    e, t = setup
+    r = e.execute("SELECT SUM(LENGTH(name)) FROM ev")
+    assert r.rows[0][0] == pytest.approx(t.name.str.len().sum())
+
+
+def test_string_fn_predicates(setup):
+    e, t = setup
+    r = e.execute("SELECT COUNT(*) FROM ev WHERE UPPER(name) = 'ALPHA'")
+    assert r.rows == [[int((t.name.str.upper() == "ALPHA").sum())]]
+    r = e.execute("SELECT COUNT(*) FROM ev WHERE LOWER(name) IN ('beta','dx')")
+    assert r.rows == [[int(t.name.str.lower().isin(["beta", "dx"]).sum())]]
+    r = e.execute("SELECT COUNT(*) FROM ev WHERE SUBSTR(name, 0, 1) = 'G'")
+    assert r.rows == [[int(t.name.str.startswith("G").sum())]]
+    r = e.execute("SELECT COUNT(*) FROM ev WHERE REGEXP_LIKE(UPPER(name), '^G')")
+    assert r.rows == [[int(t.name.str.upper().str.startswith("G").sum())]]
+
+
+def test_cast(setup):
+    e, t = setup
+    r = e.execute("SELECT SUM(CAST(val AS LONG)) FROM ev")
+    assert r.rows[0][0] == pytest.approx(np.trunc(t.val).sum())
+    r = e.execute("SELECT COUNT(*) FROM ev WHERE CAST(val AS INT) = 0")
+    assert r.rows == [[int((np.trunc(t.val) == 0).sum())]]
+
+
+def test_mod_least_greatest(setup):
+    e, t = setup
+    r = e.execute("SELECT SUM(MOD(ts, 7)), SUM(LEAST(code, 0)), SUM(GREATEST(code, 0)) FROM ev")
+    assert r.rows[0][0] == pytest.approx(float(np.mod(t.ts, 7).sum()))
+    assert r.rows[0][1] == pytest.approx(float(np.minimum(t.code, 0).sum()))
+    assert r.rows[0][2] == pytest.approx(float(np.maximum(t.code, 0).sum()))
