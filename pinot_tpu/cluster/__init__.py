@@ -1,0 +1,6 @@
+from pinot_tpu.cluster.metadata import PropertyStore
+from pinot_tpu.cluster.controller import Controller
+from pinot_tpu.cluster.server import Server
+from pinot_tpu.cluster.broker import Broker
+
+__all__ = ["PropertyStore", "Controller", "Server", "Broker"]

@@ -1,0 +1,115 @@
+"""Broker role: route, scatter, gather, reduce.
+
+Reference parity: BaseSingleStageBrokerRequestHandler.handleRequest
+(pinot-broker/.../requesthandler/BaseSingleStageBrokerRequestHandler.java:286)
+-> routing table -> QueryRouter.submitQuery scatter (pinot-core/.../transport/
+QueryRouter.java:89) -> gather DataTables -> BrokerReduceService. Here the
+scatter fans out over a thread pool to server handles (in-process objects or
+HTTP clients over DCN), partials are the host-format DataTable analog, and
+the reduce is the shared reduce module.
+"""
+
+from __future__ import annotations
+
+import time
+from concurrent.futures import ThreadPoolExecutor
+
+from pinot_tpu.query import ast
+from pinot_tpu.query.context import QueryContext
+from pinot_tpu.query.engine import QueryEngine
+from pinot_tpu.query.reduce import build_result
+from pinot_tpu.query.result import ResultTable
+from pinot_tpu.query.sql import parse_sql
+from pinot_tpu.cluster.controller import Controller
+from pinot_tpu.cluster.routing import BalancedInstanceSelector, segment_can_match
+
+
+class Broker:
+    def __init__(self, controller: Controller, max_scatter_threads: int = 8):
+        self.controller = controller
+        self.selector = BalancedInstanceSelector()
+        self._pool = ThreadPoolExecutor(max_workers=max_scatter_threads)
+
+    def execute(self, sql: str) -> ResultTable:
+        t0 = time.perf_counter()
+        stmt = parse_sql(sql)
+        table = stmt.from_table
+        if self.controller.get_table(table) is None:
+            raise KeyError(f"no such table: {table}")  # BrokerResponse TableDoesNotExist parity
+        schema = self.controller.get_schema(table)
+        self._expand_star(stmt, schema)
+        ctx = QueryContext.from_statement(stmt)
+
+        meta = self.controller.all_segment_metadata(table)
+        ideal = self.controller.ideal_state(table)
+        self._compute_hints(ctx, meta)
+
+        # broker-side pruning on stored segment stats
+        candidates, pruned = [], 0
+        for seg_name, m in meta.items():
+            if seg_name not in ideal:
+                continue
+            if segment_can_match(ctx.filter, m.get("stats", {})):
+                candidates.append(seg_name)
+            else:
+                pruned += 1
+
+        plan, unroutable = self.selector.select(ideal, candidates)
+        if unroutable:
+            raise RuntimeError(f"no ONLINE replica for segments: {unroutable}")
+        servers = self.controller.servers()
+        hints = dict(ctx.hints)
+
+        def scatter(item):
+            sid, segs = item
+            out = servers[sid].execute_partials(table, sql, segs, hints)
+            if len(out[0]) != len(segs):
+                # a server silently skipping unhosted segments would mean
+                # missing rows; fail loudly instead (partial-response guard)
+                raise RuntimeError(
+                    f"server {sid} executed {len(out[0])}/{len(segs)} requested segments"
+                )
+            return out
+
+        results = list(self._pool.map(scatter, plan.items())) if plan else []
+        partials = []
+        scanned = 0
+        for p, matched, _total in results:
+            partials.extend(p)
+            scanned += matched
+
+        rows = QueryEngine.reduce(ctx, partials)
+        return build_result(
+            ctx,
+            rows,
+            num_docs_scanned=int(scanned),
+            total_docs=sum(m.get("numDocs", 0) for m in meta.values()),
+            num_segments_queried=len(candidates),
+            num_segments_pruned=pruned,
+            time_used_ms=(time.perf_counter() - t0) * 1e3,
+        )
+
+    @staticmethod
+    def _expand_star(stmt, schema) -> None:
+        from pinot_tpu.query.context import expand_star
+
+        expand_star(stmt, schema)
+
+    @staticmethod
+    def _compute_hints(ctx: QueryContext, meta: dict[str, dict]) -> None:
+        """Global percentile-histogram bounds from controller-stored per-
+        segment stats (the broker-side analog of QueryEngine._compute_hints)."""
+        for a in ctx.aggregations:
+            if a.func != "percentileest" or not isinstance(a.arg, ast.Identifier):
+                continue
+            los, his = [], []
+            ok = bool(meta)
+            for m in meta.values():
+                s = m.get("stats", {}).get(a.arg.name)
+                if s is None or not isinstance(s.get("min"), (int, float)):
+                    ok = False
+                    break
+                los.append(float(s["min"]))
+                his.append(float(s["max"]))
+            if ok and los:
+                ctx.hints.setdefault("est_bounds", {})[a.name] = (min(los), max(his))

@@ -1,0 +1,122 @@
+"""Controller: table/schema management, segment assignment, ideal state.
+
+Reference parity: PinotHelixResourceManager (pinot-controller/.../helix/core/
+PinotHelixResourceManager.java:192 — tables, schemas, instances, ideal
+states), segment assignment strategies (controller/helix/core/assignment/
+segment/OfflineSegmentAssignment.java: balanced instance pick by segment
+count; replica groups), and the segment upload path (addNewSegment -> ideal
+state update -> server state transition). Our state transitions are
+synchronous calls onto the server objects/endpoints (the Helix
+OFFLINE->ONLINE message analog); the external view equals the ideal state
+once those calls return.
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+
+from pinot_tpu.common.config import TableConfig
+from pinot_tpu.common.types import Schema
+from pinot_tpu.cluster.metadata import PropertyStore
+from pinot_tpu.segment.builder import write_segment
+from pinot_tpu.segment.segment import ImmutableSegment
+
+
+class Controller:
+    def __init__(self, store: PropertyStore, deep_store: str | Path):
+        """deep_store: directory holding uploaded segment dirs (the PinotFS
+        deep-store analog: segments are durable here; servers load from it)."""
+        self.store = store
+        self.deep_store = Path(deep_store)
+        self.deep_store.mkdir(parents=True, exist_ok=True)
+        self._servers: dict[str, object] = {}  # server_id -> Server handle
+
+    # -- instances -----------------------------------------------------------
+
+    def register_server(self, server_id: str, handle, host: str = "local", port: int = 0) -> None:
+        self._servers[server_id] = handle
+        self.store.set(f"/instances/{server_id}", {"host": host, "port": port, "alive": True})
+
+    def servers(self) -> dict[str, object]:
+        return dict(self._servers)
+
+    # -- schemas / tables ----------------------------------------------------
+
+    def add_schema(self, schema: Schema) -> None:
+        self.store.set(f"/schemas/{schema.name}", {"json": schema.to_json()})
+
+    def get_schema(self, name: str) -> Schema | None:
+        doc = self.store.get(f"/schemas/{name}")
+        return Schema.from_json(doc["json"]) if doc else None
+
+    def add_table(self, config: TableConfig) -> None:
+        self.store.set(f"/tables/{config.table_name}/config", {"json": config.to_json()})
+        if self.store.get(f"/tables/{config.table_name}/idealstate") is None:
+            self.store.set(f"/tables/{config.table_name}/idealstate", {})
+
+    def get_table(self, name: str) -> TableConfig | None:
+        doc = self.store.get(f"/tables/{name}/config")
+        return TableConfig.from_json(doc["json"]) if doc else None
+
+    def tables(self) -> list[str]:
+        return [p.split("/")[2] for p in self.store.list("/tables/") if p.endswith("/config")]
+
+    # -- segment upload & assignment ----------------------------------------
+
+    def upload_segment(self, table: str, segment: ImmutableSegment) -> list[str]:
+        """Write segment to the deep store, assign replicas, push state
+        transitions to the chosen servers. Returns the assigned server ids."""
+        config = self.get_table(table)
+        if config is None:
+            raise KeyError(f"no such table: {table}")
+        seg_dir = write_segment(segment, self.deep_store / table)
+        stats = {
+            col: {
+                "min": ci.stats.to_dict()["min"],
+                "max": ci.stats.to_dict()["max"],
+                "cardinality": ci.cardinality,
+            }
+            for col, ci in segment.columns.items()
+        }
+        assigned = self._assign(table, segment.name, config.replication)
+        self.store.set(
+            f"/tables/{table}/segments/{segment.name}",
+            {"numDocs": segment.n_docs, "location": str(seg_dir), "stats": stats, "servers": assigned},
+        )
+        ideal = self.store.get(f"/tables/{table}/idealstate") or {}
+        ideal[segment.name] = {s: "ONLINE" for s in assigned}
+        self.store.set(f"/tables/{table}/idealstate", ideal)
+        # state transition: servers load the segment from the deep store
+        for sid in assigned:
+            self._servers[sid].add_segment(table, segment.name, seg_dir)
+        return assigned
+
+    def _assign(self, table: str, segment_name: str, replication: int) -> list[str]:
+        """Balanced assignment: pick the `replication` servers currently
+        hosting the fewest segments of this table
+        (OfflineSegmentAssignment.assignSegment parity)."""
+        if not self._servers:
+            raise RuntimeError("no servers registered")
+        ideal = self.store.get(f"/tables/{table}/idealstate") or {}
+        load: dict[str, int] = {sid: 0 for sid in self._servers}
+        for seg, replicas in ideal.items():
+            for sid in replicas:
+                if sid in load:
+                    load[sid] += 1
+        ranked = sorted(load, key=lambda s: (load[s], s))
+        return ranked[: max(1, min(replication, len(ranked)))]
+
+    # -- views ---------------------------------------------------------------
+
+    def ideal_state(self, table: str) -> dict:
+        return self.store.get(f"/tables/{table}/idealstate") or {}
+
+    def segment_metadata(self, table: str, segment: str) -> dict | None:
+        return self.store.get(f"/tables/{table}/segments/{segment}")
+
+    def all_segment_metadata(self, table: str) -> dict[str, dict]:
+        out = {}
+        for p in self.store.list(f"/tables/{table}/segments/"):
+            name = p.split("/")[-1]
+            out[name] = self.store.get(p)
+        return out

@@ -1,0 +1,165 @@
+"""HTTP data/control plane for multi-process clusters (the DCN tier).
+
+Reference parity: Pinot's network split — broker REST SQL endpoint
+(POST /query/sql), controller REST (pinot-controller/.../api/resources/),
+and the broker<->server data plane (Netty/thrift InstanceRequest,
+pinot-core/.../transport/InstanceRequestHandler.java:69). Here each role
+exposes a ThreadingHTTPServer; the broker->server hop carries
+{table, sql, segments, hints} JSON and returns pickled host-format partials
+(the DataTable bytes analog — trusted intra-cluster links, as in Pinot).
+Intra-pod device collectives (parallel/mesh.py) stay out of this tier.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import pickle
+import threading
+import urllib.error
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from pinot_tpu.cluster.broker import Broker
+from pinot_tpu.cluster.server import Server
+
+
+def _serve(handler_cls, port: int) -> tuple[ThreadingHTTPServer, int, threading.Thread]:
+    httpd = ThreadingHTTPServer(("127.0.0.1", port), handler_cls)
+    t = threading.Thread(target=httpd.serve_forever, daemon=True)
+    t.start()
+    return httpd, httpd.server_address[1], t
+
+
+class BrokerHTTPService:
+    """POST /query/sql {"sql": ...} -> Pinot-shaped JSON broker response."""
+
+    def __init__(self, broker: Broker, port: int = 0):
+        svc = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):  # quiet
+                pass
+
+            def do_POST(self):
+                if self.path != "/query/sql":
+                    self.send_error(404)
+                    return
+                n = int(self.headers.get("Content-Length", 0))
+                body = json.loads(self.rfile.read(n) or b"{}")
+                try:
+                    res = svc.broker.execute(body["sql"])
+                    payload = json.dumps(res.to_dict()).encode()
+                    self.send_response(200)
+                except Exception as e:  # error surface parity: exceptions JSON
+                    payload = json.dumps({"exceptions": [{"message": str(e)}]}).encode()
+                    self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(payload)))
+                self.end_headers()
+                self.wfile.write(payload)
+
+            def do_GET(self):
+                if self.path == "/health":
+                    self.send_response(200)
+                    self.send_header("Content-Length", "2")
+                    self.end_headers()
+                    self.wfile.write(b"OK")
+                else:
+                    self.send_error(404)
+
+        self.broker = broker
+        self.httpd, self.port, self._thread = _serve(Handler, port)
+
+    def stop(self):
+        self.httpd.shutdown()
+
+
+class ServerHTTPService:
+    """POST /query {"table","sql","segments","hints"} -> pickled partials."""
+
+    def __init__(self, server: Server, port: int = 0):
+        svc = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def do_POST(self):
+                if self.path != "/query":
+                    self.send_error(404)
+                    return
+                n = int(self.headers.get("Content-Length", 0))
+                try:
+                    body = json.loads(self.rfile.read(n) or b"{}")
+                    out = svc.server.execute_partials(
+                        body["table"], body["sql"], body.get("segments", []), body.get("hints") or {}
+                    )
+                except Exception as e:
+                    # surface the real error to the broker instead of a
+                    # dropped connection
+                    payload = json.dumps({"error": f"{type(e).__name__}: {e}"}).encode()
+                    self.send_response(500)
+                    self.send_header("Content-Type", "application/json")
+                    self.send_header("Content-Length", str(len(payload)))
+                    self.end_headers()
+                    self.wfile.write(payload)
+                    return
+                payload = pickle.dumps(out, protocol=pickle.HIGHEST_PROTOCOL)
+                self.send_response(200)
+                self.send_header("Content-Type", "application/octet-stream")
+                self.send_header("Content-Length", str(len(payload)))
+                self.end_headers()
+                self.wfile.write(payload)
+
+            def do_GET(self):
+                if self.path == "/health":
+                    self.send_response(200)
+                    self.send_header("Content-Length", "2")
+                    self.end_headers()
+                    self.wfile.write(b"OK")
+                else:
+                    self.send_error(404)
+
+        self.server = server
+        self.httpd, self.port, self._thread = _serve(Handler, port)
+
+    def stop(self):
+        self.httpd.shutdown()
+
+
+class RemoteServerClient:
+    """Broker-side handle to a server over HTTP; mirrors Server's
+    execute_partials/add_segment surface (QueryRouter connection analog)."""
+
+    def __init__(self, base_url: str, timeout: float = 10.0):
+        """timeout: per-hop data-plane timeout (Pinot brokerTimeoutMs analog).
+        A dead/hung server must fail the query quickly, not stall the broker."""
+        self.base_url = base_url.rstrip("/")
+        self.timeout = timeout
+
+    def execute_partials(self, table: str, sql: str, segment_names: list[str], hints: dict | None = None):
+        body = json.dumps(
+            {"table": table, "sql": sql, "segments": segment_names, "hints": hints or {}}
+        ).encode()
+        req = urllib.request.Request(
+            self.base_url + "/query", data=body, headers={"Content-Type": "application/json"}
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+                return pickle.load(io.BytesIO(resp.read()))
+        except urllib.error.HTTPError as e:
+            detail = e.read().decode(errors="replace")
+            raise RuntimeError(f"server error from {self.base_url}: {detail}") from None
+        except (TimeoutError, OSError) as e:
+            raise RuntimeError(f"server {self.base_url} unreachable: {e}") from None
+
+
+def query_broker_http(base_url: str, sql: str) -> dict:
+    """Client helper: POST a SQL query to a broker endpoint."""
+    body = json.dumps({"sql": sql}).encode()
+    req = urllib.request.Request(
+        base_url.rstrip("/") + "/query/sql", data=body, headers={"Content-Type": "application/json"}
+    )
+    with urllib.request.urlopen(req, timeout=60) as resp:
+        return json.loads(resp.read())

@@ -1,0 +1,81 @@
+"""Cluster metadata store: the ZooKeeper/Helix property-store analog.
+
+Reference parity: Pinot keeps TableConfig/Schema/segment ZK metadata and
+Helix IdealState/ExternalView in ZooKeeper (orchestrated by
+PinotHelixResourceManager, pinot-controller/.../helix/core/
+PinotHelixResourceManager.java:192). Here the same shapes live in a
+path-keyed JSON store — in-memory for in-process clusters, file-backed for
+multi-process ones. Watchers/CAS are unnecessary in round 1 because the
+controller is the single writer (lead-controller analog).
+
+Layout:
+  /schemas/{name}                      -> Schema json
+  /tables/{name}/config                -> TableConfig json
+  /tables/{name}/idealstate            -> {segment: {server: "ONLINE"|"CONSUMING"}}
+  /tables/{name}/segments/{segment}    -> segment zk metadata (docs, stats, location)
+  /instances/{server}                  -> instance config (host, port, alive)
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from pathlib import Path
+
+
+class PropertyStore:
+    """Path -> JSON document store; file-backed when rooted, else in-memory."""
+
+    def __init__(self, root: str | Path | None = None):
+        self.root = Path(root) if root else None
+        self._mem: dict[str, dict] = {}
+        self._lock = threading.RLock()
+
+    _SUFFIX = ".doc.json"
+
+    def _file(self, path: str) -> Path:
+        # real nested directories: no separator encoding, so names containing
+        # any character sequence round-trip exactly
+        assert self.root is not None
+        parts = [p for p in path.split("/") if p]
+        return self.root.joinpath(*parts[:-1]) / (parts[-1] + self._SUFFIX)
+
+    def set(self, path: str, doc: dict) -> None:
+        with self._lock:
+            if self.root is None:
+                self._mem[path] = json.loads(json.dumps(doc))
+            else:
+                f = self._file(path)
+                f.parent.mkdir(parents=True, exist_ok=True)
+                f.write_text(json.dumps(doc))
+
+    def get(self, path: str) -> dict | None:
+        with self._lock:
+            if self.root is None:
+                doc = self._mem.get(path)
+                return json.loads(json.dumps(doc)) if doc is not None else None
+            f = self._file(path)
+            return json.loads(f.read_text()) if f.exists() else None
+
+    def delete(self, path: str) -> None:
+        with self._lock:
+            if self.root is None:
+                self._mem.pop(path, None)
+            else:
+                f = self._file(path)
+                if f.exists():
+                    f.unlink()
+
+    def list(self, prefix: str) -> list[str]:
+        with self._lock:
+            if self.root is None:
+                return sorted(p for p in self._mem if p.startswith(prefix))
+            if not self.root.exists():
+                return []
+            out = []
+            for f in self.root.rglob("*" + self._SUFFIX):
+                rel = f.relative_to(self.root)
+                key = "/" + "/".join(rel.parts)[: -len(self._SUFFIX)]
+                if key.startswith(prefix):
+                    out.append(key)
+            return sorted(out)

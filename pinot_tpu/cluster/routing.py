@@ -1,0 +1,117 @@
+"""Broker routing: segment pruning + replica instance selection.
+
+Reference parity: BrokerRoutingManager (pinot-broker/.../routing/
+BrokerRoutingManager.java:101), BalancedInstanceSelector (round-robin across
+replicas), and the pruners — ColumnValueSegmentPruner (min/max interval
+tests) / TimeSegmentPruner, operating here on the controller-stored per-
+segment column stats instead of on-disk metadata.
+"""
+
+from __future__ import annotations
+
+import itertools
+
+from pinot_tpu.query import ast
+from pinot_tpu.query.ast import CompareOp
+
+
+def _interval(stats: dict, col: str):
+    s = stats.get(col)
+    if s is None:
+        return None
+    mn, mx = s.get("min"), s.get("max")
+    if mn is None or mx is None:
+        return None
+    if isinstance(mn, dict) or isinstance(mx, dict):  # bytes columns: skip
+        return None
+    return mn, mx
+
+
+def _cmp_overlap(op: CompareOp, lo, hi, v) -> bool:
+    try:
+        if op == CompareOp.EQ:
+            return lo <= v <= hi
+        if op == CompareOp.NEQ:
+            return True  # only prunable when lo==hi==v; keep conservative
+        if op == CompareOp.LT:
+            return lo < v
+        if op == CompareOp.LTE:
+            return lo <= v
+        if op == CompareOp.GT:
+            return hi > v
+        if op == CompareOp.GTE:
+            return hi >= v
+    except TypeError:
+        return True
+    return True
+
+
+def segment_can_match(f: ast.FilterExpr | None, stats: dict) -> bool:
+    """Conservative test: False only when the filter PROVABLY matches no doc
+    of the segment given column [min,max] stats."""
+    if f is None:
+        return True
+    if isinstance(f, ast.And):
+        return all(segment_can_match(c, stats) for c in f.children)
+    if isinstance(f, ast.Or):
+        return any(segment_can_match(c, stats) for c in f.children)
+    if isinstance(f, ast.Compare):
+        left, op, right = f.left, f.op, f.right
+        if isinstance(left, ast.Literal) and isinstance(right, ast.Identifier):
+            from pinot_tpu.query.plan import _FLIP
+
+            left, right, op = right, left, _FLIP[op]
+        if isinstance(left, ast.Identifier) and isinstance(right, ast.Literal):
+            iv = _interval(stats, left.name)
+            if iv is not None:
+                v = right.value
+                if isinstance(v, str) != isinstance(iv[0], str):
+                    return True
+                return _cmp_overlap(op, iv[0], iv[1], v)
+        return True
+    if isinstance(f, ast.Between) and isinstance(f.expr, ast.Identifier) and not f.negated:
+        if isinstance(f.low, ast.Literal) and isinstance(f.high, ast.Literal):
+            iv = _interval(stats, f.expr.name)
+            if iv is not None:
+                try:
+                    return not (f.high.value < iv[0] or f.low.value > iv[1])
+                except TypeError:
+                    return True
+        return True
+    if isinstance(f, ast.In) and isinstance(f.expr, ast.Identifier) and not f.negated:
+        iv = _interval(stats, f.expr.name)
+        if iv is not None:
+            try:
+                return any(
+                    iv[0] <= v.value <= iv[1] for v in f.values if isinstance(v, ast.Literal)
+                )
+            except TypeError:
+                return True
+        return True
+    # NOT / LIKE / REGEXP / IsNull: never prune
+    return True
+
+
+class BalancedInstanceSelector:
+    """Round-robin replica choice per segment (BalancedInstanceSelector
+    parity; the adaptive latency-aware variant plugs in here later)."""
+
+    def __init__(self):
+        self._rr = itertools.count()
+
+    def select(
+        self, ideal_state: dict[str, dict[str, str]], segments: list[str]
+    ) -> tuple[dict[str, list[str]], list[str]]:
+        """segment list -> ({server_id: [segments]}, unroutable_segments),
+        picking one ONLINE replica per segment. Callers must surface
+        unroutable segments as an error, never as silently-missing rows."""
+        plan: dict[str, list[str]] = {}
+        unroutable: list[str] = []
+        for seg in segments:
+            replicas = sorted(s for s, st in ideal_state.get(seg, {}).items() if st == "ONLINE")
+            if not replicas:
+                unroutable.append(seg)
+                continue
+            pick = replicas[next(self._rr) % len(replicas)]
+            plan.setdefault(pick, []).append(seg)
+        return plan, unroutable

@@ -1,0 +1,74 @@
+"""Server role: hosts segments, executes the per-segment half of queries.
+
+Reference parity: BaseServerStarter/ServerInstance (pinot-server/.../starter/
+ServerInstance.java:66) + InstanceDataManager segment hosting with
+acquire/release refcounting (pinot-core/.../data/manager/BaseTableDataManager)
++ ServerQueryExecutorV1Impl execution. The server returns host-format
+partials (the DataTable analog) that the broker reduces.
+"""
+
+from __future__ import annotations
+
+import threading
+from pathlib import Path
+
+from pinot_tpu.query.engine import QueryEngine
+from pinot_tpu.segment.loader import load_segment
+from pinot_tpu.segment.segment import ImmutableSegment
+
+
+class Server:
+    def __init__(self, server_id: str, fast32: bool = False):
+        self.server_id = server_id
+        self._tables: dict[str, dict[str, ImmutableSegment]] = {}
+        self._engines: dict[str, QueryEngine] = {}
+        self._lock = threading.RLock()
+
+        self._fast32 = fast32
+
+    # -- state transitions (Helix OFFLINE->ONLINE analog) --------------------
+
+    def add_segment(self, table: str, segment_name: str, seg_dir: str | Path) -> None:
+        seg = load_segment(seg_dir)
+        with self._lock:
+            self._tables.setdefault(table, {})[segment_name] = seg
+            # engines are rebuilt lazily; drop the cached one
+            self._engines.pop(table, None)
+
+    def add_segment_object(self, table: str, seg: ImmutableSegment) -> None:
+        with self._lock:
+            self._tables.setdefault(table, {})[seg.name] = seg
+            self._engines.pop(table, None)
+
+    def remove_segment(self, table: str, segment_name: str) -> None:
+        with self._lock:
+            self._tables.get(table, {}).pop(segment_name, None)
+            self._engines.pop(table, None)
+
+    def segments_of(self, table: str) -> list[str]:
+        with self._lock:
+            return sorted(self._tables.get(table, {}))
+
+    def _engine(self, table: str) -> QueryEngine:
+        with self._lock:
+            eng = self._engines.get(table)
+            if eng is None:
+                eng = QueryEngine(list(self._tables.get(table, {}).values()), fast32=self._fast32)
+                self._engines[table] = eng
+            return eng
+
+    # -- query execution -----------------------------------------------------
+
+    def execute_partials(self, table: str, sql: str, segment_names: list[str], hints: dict | None = None):
+        """Run the per-segment half for the requested segments; returns
+        (partials, matched_docs, total_docs). The broker passes hints (e.g.
+        global percentile bounds) so partials merge across servers."""
+        with self._lock:
+            hosted = self._tables.get(table, {})
+            segs = [hosted[name] for name in segment_names if name in hosted]
+        eng = self._engine(table)
+        ctx = eng.make_context(sql)
+        if hints:
+            ctx.hints.update(hints)
+        partials, matched = eng.partials(ctx, segs)
+        return partials, matched, sum(s.n_docs for s in segs)

@@ -173,6 +173,20 @@ def _collect_filter_identifiers(f: FilterExpr | None, out: set[str]) -> None:
         _collect_identifiers(f.expr, out)
 
 
+def expand_star(stmt: SelectStatement, schema) -> None:
+    """Expand SELECT * into explicit schema columns, in place. Shared by the
+    single-node engine and the broker (one definition, one semantics)."""
+    if schema is None or not any(isinstance(it.expr, Star) for it in stmt.select_list):
+        return
+    new_items = []
+    for it in stmt.select_list:
+        if isinstance(it.expr, Star):
+            new_items.extend(SelectItem(Identifier(c), None) for c in schema.columns)
+        else:
+            new_items.append(it)
+    stmt.select_list = new_items
+
+
 @dataclass
 class QueryContext:
     statement: SelectStatement

@@ -45,32 +45,44 @@ class QueryEngine:
 
     # ------------------------------------------------------------------
 
-    def execute(self, sql: str) -> ResultTable:
-        t0 = time.perf_counter()
+    def make_context(self, sql: str) -> QueryContext:
+        """Parse + resolve a query against this engine's segments."""
         stmt = parse_sql(sql)
         self._expand_star(stmt)
         ctx = QueryContext.from_statement(stmt)
         self._compute_hints(ctx)
+        return ctx
 
-        partials = []
+    def partials(self, ctx: QueryContext, segments: list[ImmutableSegment] | None = None):
+        """Server-side half: per-segment partials + matched doc count.
+        (ServerQueryExecutorV1Impl role; the broker reduce consumes these.)"""
+        out = []
         scanned = 0
-        for seg in self.segments:
+        for seg in self.segments if segments is None else segments:
             partial, matched = self._execute_segment(seg, ctx)
-            partials.append(partial)
+            out.append(partial)
             scanned += matched
+        return out, scanned
 
+    @staticmethod
+    def reduce(ctx: QueryContext, partials: list) -> list[list]:
+        """Broker-side half: merge partials into final rows."""
         qt = ctx.query_type
         if qt == QueryType.AGGREGATION:
-            rows = reduce_mod.reduce_aggregation(ctx, partials)
-        elif qt == QueryType.GROUP_BY:
-            rows = reduce_mod.reduce_group_by(ctx, partials)
-        elif qt == QueryType.DISTINCT:
-            rows = reduce_mod.reduce_distinct(ctx, partials)
-        elif qt == QueryType.SELECTION_ORDER_BY:
-            rows = reduce_mod.reduce_selection_order_by(ctx, partials)
-        else:
-            rows = reduce_mod.reduce_selection(ctx, partials)
+            return reduce_mod.reduce_aggregation(ctx, partials)
+        if qt == QueryType.GROUP_BY:
+            return reduce_mod.reduce_group_by(ctx, partials)
+        if qt == QueryType.DISTINCT:
+            return reduce_mod.reduce_distinct(ctx, partials)
+        if qt == QueryType.SELECTION_ORDER_BY:
+            return reduce_mod.reduce_selection_order_by(ctx, partials)
+        return reduce_mod.reduce_selection(ctx, partials)
 
+    def execute(self, sql: str) -> ResultTable:
+        t0 = time.perf_counter()
+        ctx = self.make_context(sql)
+        partials, scanned = self.partials(ctx)
+        rows = self.reduce(ctx, partials)
         return reduce_mod.build_result(
             ctx,
             rows,
@@ -83,18 +95,9 @@ class QueryEngine:
     # ------------------------------------------------------------------
 
     def _expand_star(self, stmt) -> None:
-        """Expand SELECT * into explicit columns (selection/distinct only)."""
-        has_star = any(isinstance(it.expr, ast.Star) for it in stmt.select_list)
-        if not has_star or not self.segments:
-            return
-        schema = self.segments[0].schema
-        new_items = []
-        for it in stmt.select_list:
-            if isinstance(it.expr, ast.Star):
-                new_items.extend(ast.SelectItem(ast.Identifier(c), None) for c in schema.columns)
-            else:
-                new_items.append(it)
-        stmt.select_list = new_items
+        from pinot_tpu.query.context import expand_star
+
+        expand_star(stmt, self.segments[0].schema if self.segments else None)
 
     # ------------------------------------------------------------------
 
