@@ -53,6 +53,8 @@ class Broker:
                 candidates.append(seg_name)
             else:
                 pruned += 1
+        # consuming segments have no committed metadata yet: always routed
+        candidates.extend(s for s in ideal if s not in meta)
 
         plan, unroutable = self.selector.select(ideal, candidates)
         if unroutable:

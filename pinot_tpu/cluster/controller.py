@@ -106,6 +106,23 @@ class Controller:
         ranked = sorted(load, key=lambda s: (load[s], s))
         return ranked[: max(1, min(replication, len(ranked)))]
 
+    # -- realtime segment state (LLC CONSUMING entries) ----------------------
+
+    def set_segment_state(self, table: str, segment: str, server_id: str, state: str | None) -> None:
+        """Set/remove one (segment, server) ideal-state entry; state=None
+        removes the segment entry entirely when its replica map empties."""
+        ideal = self.store.get(f"/tables/{table}/idealstate") or {}
+        entry = ideal.get(segment, {})
+        if state is None:
+            entry.pop(server_id, None)
+        else:
+            entry[server_id] = state
+        if entry:
+            ideal[segment] = entry
+        else:
+            ideal.pop(segment, None)
+        self.store.set(f"/tables/{table}/idealstate", ideal)
+
     # -- views ---------------------------------------------------------------
 
     def ideal_state(self, table: str) -> dict:

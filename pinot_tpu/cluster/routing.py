@@ -108,7 +108,9 @@ class BalancedInstanceSelector:
         plan: dict[str, list[str]] = {}
         unroutable: list[str] = []
         for seg in segments:
-            replicas = sorted(s for s, st in ideal_state.get(seg, {}).items() if st == "ONLINE")
+            replicas = sorted(
+                s for s, st in ideal_state.get(seg, {}).items() if st in ("ONLINE", "CONSUMING")
+            )
             if not replicas:
                 unroutable.append(seg)
                 continue

@@ -22,9 +22,18 @@ class Server:
         self.server_id = server_id
         self._tables: dict[str, dict[str, ImmutableSegment]] = {}
         self._engines: dict[str, QueryEngine] = {}
+        self._realtime: dict[str, object] = {}  # table -> RealtimeTableManager
         self._lock = threading.RLock()
 
         self._fast32 = fast32
+
+    # -- realtime ------------------------------------------------------------
+
+    def attach_realtime(self, table: str, manager) -> None:
+        """Attach a RealtimeTableManager whose consuming segments this server
+        serves (RealtimeTableDataManager role)."""
+        with self._lock:
+            self._realtime[table] = manager
 
     # -- state transitions (Helix OFFLINE->ONLINE analog) --------------------
 
@@ -65,7 +74,22 @@ class Server:
         global percentile bounds) so partials merge across servers."""
         with self._lock:
             hosted = self._tables.get(table, {})
-            segs = [hosted[name] for name in segment_names if name in hosted]
+            rt = self._realtime.get(table)
+            segs = []
+            for name in segment_names:
+                if name in hosted:
+                    segs.append(hosted[name])
+                elif rt is not None:
+                    # consuming segment: serve the mutable snapshot by name
+                    for c in rt.consumers:
+                        if c._seg_name() == name:
+                            snap = c.consuming_snapshot()
+                            if snap is not None:
+                                segs.append(snap)
+                            else:
+                                # empty consuming segment: zero-doc partial
+                                segs.append(c._mutable.snapshot())
+                            break
         eng = self._engine(table)
         ctx = eng.make_context(sql)
         if hints:

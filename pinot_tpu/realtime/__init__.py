@@ -1,0 +1,12 @@
+from pinot_tpu.realtime.mutable import MutableSegment
+from pinot_tpu.realtime.stream import InMemoryStream, StreamMessage, get_stream_factory, register_stream_factory
+from pinot_tpu.realtime.manager import RealtimeTableManager
+
+__all__ = [
+    "MutableSegment",
+    "InMemoryStream",
+    "StreamMessage",
+    "get_stream_factory",
+    "register_stream_factory",
+    "RealtimeTableManager",
+]

@@ -1,0 +1,92 @@
+"""Stream ingestion SPI + in-memory stream implementation.
+
+Reference parity: pinot-spi stream contracts (StreamConsumerFactory,
+PartitionGroupConsumer.fetchMessages, StreamMessage, offsets) that the
+Kafka 2/3 / Kinesis / Pulsar plugins implement
+(pinot-plugins/pinot-stream-ingestion/). The InMemoryStream is the embedded-
+Kafka test analog; real connectors implement the same three methods.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass
+from typing import Any, Callable, Mapping, Protocol
+
+
+@dataclass
+class StreamMessage:
+    offset: int
+    value: Mapping[str, Any]  # decoded row
+    key: str | None = None
+    timestamp_ms: int = 0
+
+
+class PartitionGroupConsumer(Protocol):
+    """One consumer attached to one stream partition (PartitionGroupConsumer
+    parity)."""
+
+    def fetch_messages(self, start_offset: int, max_count: int) -> tuple[list[StreamMessage], int]:
+        """Returns (messages, next_start_offset)."""
+        ...
+
+
+class StreamFactory(Protocol):
+    def partition_count(self) -> int: ...
+
+    def create_consumer(self, partition: int) -> PartitionGroupConsumer: ...
+
+
+_REGISTRY: dict[str, Callable[[dict], StreamFactory]] = {}
+
+
+def register_stream_factory(stream_type: str, ctor: Callable[[dict], StreamFactory]) -> None:
+    """Plugin registration (StreamConsumerFactoryProvider parity)."""
+    _REGISTRY[stream_type] = ctor
+
+
+def get_stream_factory(stream_type: str, props: dict) -> StreamFactory:
+    if stream_type not in _REGISTRY:
+        raise KeyError(f"unknown stream type {stream_type!r}; registered: {sorted(_REGISTRY)}")
+    return _REGISTRY[stream_type](props)
+
+
+class InMemoryStream:
+    """Thread-safe in-process stream with N partitions (embedded-Kafka test
+    analog; also the default 'inmemory' factory)."""
+
+    def __init__(self, partitions: int = 1):
+        self._partitions: list[list[StreamMessage]] = [[] for _ in range(partitions)]
+        self._lock = threading.RLock()
+
+    def produce(self, partition: int, value: Mapping[str, Any], key: str | None = None) -> int:
+        with self._lock:
+            log = self._partitions[partition]
+            offset = len(log)
+            log.append(StreamMessage(offset=offset, value=dict(value), key=key))
+            return offset
+
+    def partition_count(self) -> int:
+        return len(self._partitions)
+
+    def latest_offset(self, partition: int) -> int:
+        with self._lock:
+            return len(self._partitions[partition])
+
+    def create_consumer(self, partition: int) -> "InMemoryConsumer":
+        return InMemoryConsumer(self, partition)
+
+
+class InMemoryConsumer:
+    def __init__(self, stream: InMemoryStream, partition: int):
+        self.stream = stream
+        self.partition = partition
+
+    def fetch_messages(self, start_offset: int, max_count: int) -> tuple[list[StreamMessage], int]:
+        with self.stream._lock:
+            log = self.stream._partitions[self.partition]
+            batch = log[start_offset : start_offset + max_count]
+            return list(batch), start_offset + len(batch)
+
+
+register_stream_factory("inmemory", lambda props: props["stream_object"])
