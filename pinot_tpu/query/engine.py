@@ -123,6 +123,12 @@ class QueryEngine:
 
     def _execute_segment(self, seg: ImmutableSegment, ctx: QueryContext):
         """Returns (partial, matched_docs) for one segment."""
+        if seg.extras.get("startree"):
+            from pinot_tpu.query import startree_exec
+
+            res = startree_exec.try_execute(self, seg, ctx)
+            if res is not None:
+                return res
         try:
             plan = plan_segment(seg, ctx)
         except DeviceFallback:

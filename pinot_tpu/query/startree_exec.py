@@ -1,0 +1,146 @@
+"""Query-side star-tree swap: rewrite matching queries onto pre-agg tables.
+
+Reference parity: StarTreeUtils.extractAggregationFunctionPairs + the
+executor swap in AggregationPlanNode/GroupByPlanNode (pinot-core/.../startree/
+executor/StarTreeAggregationExecutor.java:36, StarTreeGroupByExecutor.java:45).
+A query matches when its filter and group keys touch only split dimensions
+and every aggregation derives from the stored pairs; it then executes as an
+ordinary query over the star table segment (shared dictionaries keep all
+dict-id predicate lowering intact) and the partials are mapped back into the
+original aggregation layout so the broker reduce never knows.
+"""
+
+from __future__ import annotations
+
+from dataclasses import replace
+
+import numpy as np
+
+from pinot_tpu.query import ast
+from pinot_tpu.query.context import AggregationInfo, QueryContext, QueryType, _collect_filter_identifiers
+from pinot_tpu.segment.startree import StarTable, star_table_as_segment
+
+
+def _agg_arg_col(a: AggregationInfo) -> str | None:
+    if a.arg is None:
+        return None
+    if isinstance(a.arg, ast.Identifier):
+        return a.arg.name
+    return "\x00not-a-column"  # never matches
+
+
+def matches(ctx: QueryContext, st: StarTable) -> bool:
+    if ctx.query_type not in (QueryType.AGGREGATION, QueryType.GROUP_BY):
+        return False
+    if not ctx.aggregations:
+        return False
+    dims = set(st.dimensions)
+    fcols: set[str] = set()
+    _collect_filter_identifiers(ctx.filter, fcols)
+    if not fcols.issubset(dims):
+        return False
+    for g in ctx.group_by:
+        if not isinstance(g, ast.Identifier) or g.name not in dims:
+            return False
+    for a in ctx.aggregations:
+        col = _agg_arg_col(a)
+        if col == "\x00not-a-column":
+            return False
+        if not st.supports_agg(a.func, col):
+            return False
+    return True
+
+
+def _rewrite(ctx: QueryContext) -> tuple[QueryContext, list[tuple]]:
+    """Build the star-side context. Returns (star_ctx, mapping) where mapping
+    entry i describes how to rebuild original agg i from star agg partial
+    indices: (kind, star_indices...)."""
+    star_aggs: list[AggregationInfo] = []
+    mapping: list[tuple] = []
+
+    def add(func: str, col: str) -> int:
+        name = f"{func}({col})#star{len(star_aggs)}"
+        star_aggs.append(AggregationInfo(func, ast.Identifier(col), name))
+        return len(star_aggs) - 1
+
+    for a in ctx.aggregations:
+        col = _agg_arg_col(a)
+        if a.func == "count":
+            mapping.append(("count", add("sum", "__count")))
+        elif a.func == "sum":
+            mapping.append(("copy", add("sum", f"SUM__{col}")))
+        elif a.func == "min":
+            mapping.append(("copy", add("min", f"MIN__{col}")))
+        elif a.func == "max":
+            mapping.append(("copy", add("max", f"MAX__{col}")))
+        elif a.func == "avg":
+            mapping.append(("avg", add("sum", f"SUM__{col}"), add("sum", "__count")))
+        elif a.func == "minmaxrange":
+            mapping.append(("pair", add("min", f"MIN__{col}"), add("max", f"MAX__{col}")))
+        elif a.func in ("distinctcount", "distinctcountbitmap", "distinctcounthll"):
+            mapping.append(("copy", add(a.func, col)))
+        else:
+            raise AssertionError(a.func)
+    star_ctx = replace(ctx, aggregations=star_aggs, hints=dict(ctx.hints))
+    return star_ctx, mapping
+
+
+def _convert_scalar(mapping, star_partial):
+    out = []
+    for m in mapping:
+        kind = m[0]
+        if kind == "count":
+            out.append(int(star_partial[m[1]]))
+        elif kind == "copy":
+            out.append(star_partial[m[1]])
+        elif kind == "avg":
+            out.append((float(star_partial[m[1]]), int(star_partial[m[2]])))
+        elif kind == "pair":
+            out.append((float(star_partial[m[1]]), float(star_partial[m[2]])))
+    return out
+
+
+def _convert_frame(ctx, star_ctx, mapping, frame):
+    import pandas as pd
+
+    nkeys = len(ctx.group_by)
+    data = {f"k{i}": frame[f"k{i}"] for i in range(nkeys)}
+
+    def star_col(j, part=0):
+        from pinot_tpu.query.reduce import parts_of
+
+        return frame[f"a{j}p{part}"]
+
+    for i, m in enumerate(mapping):
+        kind = m[0]
+        if kind == "count":
+            data[f"a{i}p0"] = star_col(m[1]).astype(np.int64)
+        elif kind == "copy":
+            data[f"a{i}p0"] = star_col(m[1])
+        elif kind == "avg":
+            data[f"a{i}p0"] = star_col(m[1]).astype(np.float64)
+            data[f"a{i}p1"] = star_col(m[2]).astype(np.int64)
+        elif kind == "pair":
+            data[f"a{i}p0"] = star_col(m[1]).astype(np.float64)
+            data[f"a{i}p1"] = star_col(m[2]).astype(np.float64)
+    return pd.DataFrame(data)
+
+
+def try_execute(engine, seg, ctx: QueryContext):
+    """Attempt star-tree execution for one segment. Returns (partial, matched)
+    in the ORIGINAL context's format, or None when no star table matches."""
+    tables = seg.extras.get("startree") or []
+    for idx, st in enumerate(tables):
+        if not matches(ctx, st):
+            continue
+        cache_key = f"startree_seg:{idx}"
+        star_seg = seg.extras.get(cache_key)
+        if star_seg is None:
+            star_seg = star_table_as_segment(seg, st)
+            seg.extras[cache_key] = star_seg
+        star_ctx, mapping = _rewrite(ctx)
+        partial, matched = engine._execute_segment(star_seg, star_ctx)
+        if ctx.query_type == QueryType.AGGREGATION:
+            return _convert_scalar(mapping, partial), matched
+        return _convert_frame(ctx, star_ctx, mapping, partial), matched
+    return None

@@ -99,6 +99,10 @@ class SegmentBuilder:
                 stats = ColumnStats.collect(col, dt, vals, card)
                 fwd = vals
             seg.columns[col] = ColumnIndex(col, dt, dictionary, fwd, stats)
+        for st_cfg in self.config.indexing.star_tree_configs:
+            from pinot_tpu.segment.startree import build_star_table
+
+            seg.extras.setdefault("startree", []).append(build_star_table(seg, st_cfg))
         return seg
 
     # -- persistence ---------------------------------------------------------
@@ -133,6 +137,13 @@ def write_segment(seg: ImmutableSegment, out_dir: str | Path) -> Path:
                 "stats": ci.stats.to_dict(),
             }
         )
+    star_meta = []
+    for i, st in enumerate(seg.extras.get("startree", [])):
+        for k, arr in st.arrays.items():
+            arrays[f"star{i}::{k}"] = arr
+        star_meta.append(
+            {"dimensions": st.dimensions, "pairs": st.function_column_pairs, "nRows": st.n_rows}
+        )
     np.savez(seg_dir / "columns.npz", **arrays)
     meta = {
         "formatVersion": FORMAT_VERSION,
@@ -140,6 +151,7 @@ def write_segment(seg: ImmutableSegment, out_dir: str | Path) -> Path:
         "numDocs": seg.n_docs,
         "schema": json.loads(seg.schema.to_json()),
         "columns": col_meta,
+        "starTrees": star_meta,
     }
     (seg_dir / "metadata.json").write_text(json.dumps(meta, indent=1))
     return seg_dir

@@ -44,4 +44,15 @@ def load_segment(seg_dir: str | Path) -> ImmutableSegment:
                     dv = dv.astype(object)
                 dictionary = Dictionary(dt, dv)
             seg.columns[col] = ColumnIndex(col, dt, dictionary, fwd, stats)
+        for i, sm in enumerate(meta.get("starTrees", [])):
+            from pinot_tpu.segment.startree import StarTable
+
+            names = ["__count", *sm["dimensions"], *sm["pairs"]]
+            st = StarTable(
+                dimensions=sm["dimensions"],
+                function_column_pairs=sm["pairs"],
+                n_rows=sm["nRows"],
+                arrays={k: npz[f"star{i}::{k}"] for k in names},
+            )
+            seg.extras.setdefault("startree", []).append(st)
     return seg
