@@ -56,9 +56,15 @@ class QueryEngine:
     def partials(self, ctx: QueryContext, segments: list[ImmutableSegment] | None = None):
         """Server-side half: per-segment partials + matched doc count.
         (ServerQueryExecutorV1Impl role; the broker reduce consumes these.)"""
+        from pinot_tpu.query import pruner
+
         out = []
         scanned = 0
         for seg in self.segments if segments is None else segments:
+            if not pruner.can_match(seg, ctx):
+                # bloom/min-max pruned: contribute a canonical empty partial
+                out.append(pruner.empty_partial(ctx))
+                continue
             partial, matched = self._execute_segment(seg, ctx)
             out.append(partial)
             scanned += matched

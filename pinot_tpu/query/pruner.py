@@ -1,0 +1,93 @@
+"""Server-side segment pruning before kernel execution.
+
+Reference parity: SegmentPrunerService (pinot-core/.../query/pruner/):
+ColumnValueSegmentPruner (min/max interval tests) + BloomFilterSegmentPruner
+(EQ/IN probes against per-segment bloom filters). Runs host-side per segment;
+a pruned segment contributes a canonical empty partial so cluster-level
+segment accounting stays exact.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pandas as pd
+
+from pinot_tpu.query import ast
+from pinot_tpu.query.context import QueryContext, QueryType
+from pinot_tpu.query.reduce import _empty_partial, parts_of
+from pinot_tpu.segment.segment import ImmutableSegment
+
+
+def _stats_map(seg: ImmutableSegment) -> dict:
+    return {
+        col: {"min": ci.stats.min_value, "max": ci.stats.max_value} for col, ci in seg.columns.items()
+    }
+
+
+def _bloom_rejects(seg: ImmutableSegment, f: ast.FilterExpr | None) -> bool:
+    """True when a bloom filter PROVES a conjunctive EQ/IN predicate matches
+    nothing in this segment."""
+    blooms = seg.extras.get("bloom")
+    if not blooms or f is None:
+        return False
+    if isinstance(f, ast.And):
+        return any(_bloom_rejects(seg, c) for c in f.children)
+    if isinstance(f, ast.Compare) and f.op == ast.CompareOp.EQ:
+        left, right = f.left, f.right
+        if isinstance(left, ast.Literal) and isinstance(right, ast.Identifier):
+            left, right = right, left
+        if isinstance(left, ast.Identifier) and isinstance(right, ast.Literal) and left.name in blooms:
+            return not blooms[left.name].might_contain(right.value)
+    if isinstance(f, ast.In) and not f.negated and isinstance(f.expr, ast.Identifier):
+        if f.expr.name in blooms:
+            bf = blooms[f.expr.name]
+            return not any(
+                bf.might_contain(v.value) for v in f.values if isinstance(v, ast.Literal)
+            )
+    return False
+
+
+def can_match(seg: ImmutableSegment, ctx: QueryContext) -> bool:
+    from pinot_tpu.cluster.routing import segment_can_match
+
+    if seg.n_docs == 0:
+        return False
+    if not segment_can_match(ctx.filter, _stats_map(seg)):
+        return False
+    if _bloom_rejects(seg, ctx.filter):
+        return False
+    return True
+
+
+def empty_partial(ctx: QueryContext):
+    """Canonical zero-result partial per query type (keeps per-segment
+    partial counts exact for the cluster accounting invariants)."""
+    qt = ctx.query_type
+    if qt == QueryType.AGGREGATION:
+        out = []
+        for a in ctx.aggregations:
+            if a.func == "distinctcounthll":
+                from pinot_tpu.query.sketches import HLL_M
+
+                out.append(np.zeros(HLL_M, dtype=np.int32))  # registers merge by max
+            elif a.func == "percentileest" and a.name in ctx.hints.get("est_bounds", {}):
+                from pinot_tpu.query.sketches import EST_BINS
+
+                lo, hi = ctx.hints["est_bounds"][a.name]
+                out.append((np.zeros(EST_BINS, dtype=np.int64), lo, hi))
+            else:
+                out.append(_empty_partial(a.func))
+        return out
+    if qt in (QueryType.GROUP_BY,):
+        cols: dict = {f"k{i}": [] for i in range(len(ctx.group_by))}
+        for i, a in enumerate(ctx.aggregations):
+            for j in range(parts_of(a.func)):
+                cols[f"a{i}p{j}"] = []
+        return pd.DataFrame(cols)
+    if qt == QueryType.DISTINCT:
+        return pd.DataFrame({f"k{i}": [] for i in range(len(ctx.select_items))})
+    if qt == QueryType.SELECTION_ORDER_BY:
+        cols = {f"__key{j}": [] for j in range(len(ctx.order_by))}
+        cols.update({f"c{i}": [] for i in range(len(ctx.select_items))})
+        return pd.DataFrame(cols)
+    return pd.DataFrame({f"c{i}": [] for i in range(len(ctx.select_items))})

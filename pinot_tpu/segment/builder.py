@@ -103,7 +103,29 @@ class SegmentBuilder:
             from pinot_tpu.segment.startree import build_star_table
 
             seg.extras.setdefault("startree", []).append(build_star_table(seg, st_cfg))
+        self._build_aux_indexes(seg)
         return seg
+
+    def _build_aux_indexes(self, seg: ImmutableSegment) -> None:
+        from pinot_tpu.segment.indexes import BloomFilter, InvertedIndex, RangeIndex
+
+        idx = self.config.indexing
+        for col in idx.bloom_filter_columns:
+            ci = seg.columns.get(col)
+            if ci is None:
+                continue
+            vals = ci.dictionary.values if ci.is_dict_encoded else np.unique(ci.forward)
+            seg.extras.setdefault("bloom", {})[col] = BloomFilter.build(np.asarray(vals))
+        for col in idx.inverted_index_columns:
+            ci = seg.columns.get(col)
+            if ci is None or not ci.is_dict_encoded:
+                continue
+            seg.extras.setdefault("inverted", {})[col] = InvertedIndex.build(ci.forward, ci.cardinality)
+        for col in idx.range_index_columns:
+            ci = seg.columns.get(col)
+            if ci is None:
+                continue
+            seg.extras.setdefault("range", {})[col] = RangeIndex.build(ci.forward)
 
     # -- persistence ---------------------------------------------------------
 
@@ -144,6 +166,18 @@ def write_segment(seg: ImmutableSegment, out_dir: str | Path) -> Path:
         star_meta.append(
             {"dimensions": st.dimensions, "pairs": st.function_column_pairs, "nRows": st.n_rows}
         )
+    aux_meta: dict = {"bloom": {}, "inverted": [], "range": []}
+    for col, bf in seg.extras.get("bloom", {}).items():
+        arrays[f"bloom::{col}"] = bf.bits
+        aux_meta["bloom"][col] = bf.n_hashes
+    for col, inv in seg.extras.get("inverted", {}).items():
+        arrays[f"inv_off::{col}"] = inv.offsets
+        arrays[f"inv_doc::{col}"] = inv.doc_ids
+        aux_meta["inverted"].append(col)
+    for col, ri in seg.extras.get("range", {}).items():
+        arrays[f"range_doc::{col}"] = ri.sorted_doc_ids
+        arrays[f"range_val::{col}"] = ri.sorted_values
+        aux_meta["range"].append(col)
     np.savez(seg_dir / "columns.npz", **arrays)
     meta = {
         "formatVersion": FORMAT_VERSION,
@@ -152,6 +186,7 @@ def write_segment(seg: ImmutableSegment, out_dir: str | Path) -> Path:
         "schema": json.loads(seg.schema.to_json()),
         "columns": col_meta,
         "starTrees": star_meta,
+        "auxIndexes": aux_meta,
     }
     (seg_dir / "metadata.json").write_text(json.dumps(meta, indent=1))
     return seg_dir

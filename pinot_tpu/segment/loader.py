@@ -55,4 +55,18 @@ def load_segment(seg_dir: str | Path) -> ImmutableSegment:
                 arrays={k: npz[f"star{i}::{k}"] for k in names},
             )
             seg.extras.setdefault("startree", []).append(st)
+        aux = meta.get("auxIndexes", {})
+        if aux:
+            from pinot_tpu.segment.indexes import BloomFilter, InvertedIndex, RangeIndex
+
+            for col, n_hashes in aux.get("bloom", {}).items():
+                seg.extras.setdefault("bloom", {})[col] = BloomFilter(npz[f"bloom::{col}"], n_hashes)
+            for col in aux.get("inverted", []):
+                seg.extras.setdefault("inverted", {})[col] = InvertedIndex(
+                    npz[f"inv_off::{col}"], npz[f"inv_doc::{col}"]
+                )
+            for col in aux.get("range", []):
+                seg.extras.setdefault("range", {})[col] = RangeIndex(
+                    npz[f"range_doc::{col}"], npz[f"range_val::{col}"]
+                )
     return seg
