@@ -24,6 +24,31 @@ from pinot_tpu.cluster.controller import Controller
 from pinot_tpu.cluster.routing import BalancedInstanceSelector, segment_can_match
 
 
+def _collect_tables(stmt) -> list[str]:
+    """All physical table names referenced by a (possibly nested) statement."""
+    out: list[str] = []
+
+    def rel(r):
+        if isinstance(r, ast.TableRef):
+            if r.name not in out:
+                out.append(r.name)
+        elif isinstance(r, ast.SubqueryRef):
+            walk(r.stmt)
+        elif isinstance(r, ast.JoinRel):
+            rel(r.left)
+            rel(r.right)
+
+    def walk(s):
+        if isinstance(s, ast.SetOpStatement):
+            walk(s.left)
+            walk(s.right)
+        else:
+            rel(s.relation)
+
+    walk(stmt)
+    return out
+
+
 class Broker:
     def __init__(self, controller: Controller, max_scatter_threads: int = 8):
         self.controller = controller
@@ -33,6 +58,11 @@ class Broker:
     def execute(self, sql: str) -> ResultTable:
         t0 = time.perf_counter()
         stmt = parse_sql(sql)
+        # v2 engine selection (MultiStageBrokerRequestHandler.java:88 parity):
+        # joins/subqueries/set-ops/windows, or explicit SET useMultistageEngine
+        use_v2 = stmt.needs_multistage or stmt.options.get("useMultistageEngine", "").lower() == "true"
+        if use_v2:
+            return self._execute_multistage(stmt, sql)
         table = stmt.from_table
         if self.controller.get_table(table) is None:
             raise KeyError(f"no such table: {table}")  # BrokerResponse TableDoesNotExist parity
@@ -90,6 +120,38 @@ class Broker:
             num_segments_pruned=pruned,
             time_used_ms=(time.perf_counter() - t0) * 1e3,
         )
+
+    def _execute_multistage(self, stmt, sql: str) -> ResultTable:
+        """Dispatch to the v2 engine over one replica of each segment.
+
+        Reference parity: QueryDispatcher.submitAndReduce
+        (pinot-query-runtime/.../QueryDispatcher.java:128) — the broker builds
+        the catalog from routing state; leaf scans acquire hosted segments."""
+        from pinot_tpu.multistage import MultistageEngine
+
+        servers = self.controller.servers()
+        catalog: dict[str, list] = {}
+        schemas: dict[str, list[str]] = {}
+        for table in _collect_tables(stmt):
+            if self.controller.get_table(table) is None:
+                raise KeyError(f"no such table: {table}")
+            schema = self.controller.get_schema(table)
+            if schema is not None:
+                schemas[table] = list(schema.columns)
+            ideal = self.controller.ideal_state(table)
+            segs = []
+            for seg_name, replicas in sorted(ideal.items()):
+                online = [sid for sid, st in replicas.items() if st == "ONLINE" and sid in servers]
+                got = None
+                for sid in sorted(online):
+                    got = servers[sid].get_segment_object(table, seg_name)
+                    if got is not None:
+                        break
+                if got is not None:
+                    segs.append(got)
+            catalog[table] = segs
+        engine = MultistageEngine(catalog, n_workers=4, schemas=schemas)
+        return engine.execute(sql, stmt=stmt)
 
     @staticmethod
     def _expand_star(stmt, schema) -> None:

@@ -58,6 +58,12 @@ class Server:
         with self._lock:
             return sorted(self._tables.get(table, {}))
 
+    def get_segment_object(self, table: str, segment_name: str) -> ImmutableSegment | None:
+        """Hand out a hosted segment for multistage leaf scans
+        (LeafStageTransferableBlockOperator acquires segments the same way)."""
+        with self._lock:
+            return self._tables.get(table, {}).get(segment_name)
+
     def _engine(self, table: str) -> QueryEngine:
         with self._lock:
             eng = self._engines.get(table)

@@ -1,0 +1,698 @@
+"""Multistage (v2) runtime: mailboxes, operators, OpChain workers.
+
+Reference parity:
+- MailboxService / GrpcSendingMailbox / InMemorySendingMailbox
+  (pinot-query-runtime/.../mailbox/MailboxService.java:40) -> in-process
+  MailboxService with per-(receiver stage, worker, sender stage) queues.
+- BlockExchange strategies (runtime/operator/exchange/BlockExchange.java:50-59)
+  -> singleton / hash / broadcast / random senders.
+- OpChainSchedulerService (runtime/executor/OpChainSchedulerService.java:37)
+  -> one thread per (stage, worker); blocks stream through queues, so stages
+  pipeline naturally.
+- Operators (runtime/operator/: HashJoinOperator, AggregateOperator,
+  SortOperator, WindowAggregateOperator, set ops, LeafStageTransferableBlock-
+  Operator) -> columnar (pandas/numpy) implementations; the leaf Scan+Filter
+  runs the single-stage path per segment (device mask kernels via host_exec
+  fallback today).
+
+Intermediate blocks are columnar DataFrames with positional integer column
+labels aligned to each logical node's `fields`.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from dataclasses import dataclass
+
+import numpy as np
+import pandas as pd
+
+from pinot_tpu.multistage import logical as L
+from pinot_tpu.query import ast, host_exec
+from pinot_tpu.query.context import canonical
+from pinot_tpu.query.result import ResultTable
+
+_EOS = ("__eos__",)
+
+
+class MailboxService:
+    """In-process mailbox fabric: queues keyed by
+    (receiver stage, receiver worker, sender stage)."""
+
+    def __init__(self):
+        self._queues: dict[tuple, queue.Queue] = {}
+        self._lock = threading.Lock()
+
+    def _q(self, recv_stage: int, recv_worker: int, send_stage: int) -> queue.Queue:
+        key = (recv_stage, recv_worker, send_stage)
+        with self._lock:
+            q = self._queues.get(key)
+            if q is None:
+                q = self._queues[key] = queue.Queue()
+            return q
+
+    def send(self, send_stage: int, recv_stage: int, recv_worker: int, payload) -> None:
+        self._q(recv_stage, recv_worker, send_stage).put(payload)
+
+    def receive_all(self, recv_stage: int, recv_worker: int, send_stage: int, n_senders: int):
+        """Drain blocks from n_senders until each sent EOS. Raises on error."""
+        q = self._q(recv_stage, recv_worker, send_stage)
+        blocks: list[pd.DataFrame] = []
+        eos = 0
+        while eos < n_senders:
+            item = q.get()
+            if item is _EOS or (isinstance(item, tuple) and item and item[0] == "__eos__"):
+                eos += 1
+            elif isinstance(item, tuple) and item and item[0] == "__err__":
+                raise RuntimeError(f"upstream stage {send_stage} failed: {item[1]}")
+            else:
+                blocks.append(item)
+        return blocks
+
+
+# ---------------------------------------------------------------------------
+# Expression evaluation over blocks
+# ---------------------------------------------------------------------------
+
+
+def _series(v, n: int) -> pd.Series:
+    return pd.Series(np.full(n, v), dtype=object if isinstance(v, str) else None)
+
+
+def eval_expr(expr: ast.Expr, fields: list[L.Field], df: pd.DataFrame) -> pd.Series:
+    if not isinstance(expr, ast.Literal):
+        c = canonical(expr)
+        hits = [i for i, f in enumerate(fields) if f.canon == c]
+        if len(hits) == 1:
+            return df.iloc[:, hits[0]]
+    if isinstance(expr, ast.Identifier):
+        return df.iloc[:, L.resolve(fields, expr.name)]
+    if isinstance(expr, ast.Literal):
+        return _series(expr.value, len(df))
+    if isinstance(expr, ast.BinaryOp):
+        l = eval_expr(expr.left, fields, df)
+        r = eval_expr(expr.right, fields, df)
+        if expr.op == "+":
+            return l + r
+        if expr.op == "-":
+            return l - r
+        if expr.op == "*":
+            return l * r
+        if expr.op == "/":
+            return l.astype(np.float64) / r.astype(np.float64)
+        if expr.op == "%":
+            return l % r
+        raise L.PlanV2Error(f"unknown operator {expr.op}")
+    if isinstance(expr, ast.FunctionCall):
+        from pinot_tpu.query.transforms import DEVICE_FUNCS, STRING_FUNCS, apply_string_func
+
+        name = expr.name
+        if name == "cast":
+            v = eval_expr(expr.args[0], fields, df)
+            target = str(expr.args[1].value).upper()
+            if target in ("INT", "LONG", "TIMESTAMP", "BOOLEAN"):
+                return pd.Series(np.trunc(v.to_numpy(dtype=np.float64)).astype(np.int64), index=v.index)
+            if target in ("FLOAT", "DOUBLE"):
+                return v.astype(np.float64)
+            if target == "STRING":
+                return v.map(str)
+            raise L.PlanV2Error(f"unsupported CAST target {target}")
+        if name in DEVICE_FUNCS:
+            _, fn = DEVICE_FUNCS[name]
+            args = [eval_expr(a, fields, df).to_numpy() for a in expr.args]
+            out = np.asarray(fn(np, *args))
+            return pd.Series(out, index=df.index)
+        if name in STRING_FUNCS:
+            base = eval_expr(expr.args[0], fields, df).to_numpy()
+            lit_args = tuple(a.value for a in expr.args[1:] if isinstance(a, ast.Literal))
+            derived, _ = apply_string_func(name, base, lit_args)
+            return pd.Series(derived, index=df.index)
+    raise L.PlanV2Error(f"unsupported expression in multistage runtime: {expr}")
+
+
+_CMPS = {
+    ast.CompareOp.EQ: lambda a, b: a == b,
+    ast.CompareOp.NEQ: lambda a, b: a != b,
+    ast.CompareOp.LT: lambda a, b: a < b,
+    ast.CompareOp.LTE: lambda a, b: a <= b,
+    ast.CompareOp.GT: lambda a, b: a > b,
+    ast.CompareOp.GTE: lambda a, b: a >= b,
+}
+
+
+def eval_filter(f: ast.FilterExpr, fields: list[L.Field], df: pd.DataFrame) -> np.ndarray:
+    if isinstance(f, ast.And):
+        m = eval_filter(f.children[0], fields, df)
+        for c in f.children[1:]:
+            m = m & eval_filter(c, fields, df)
+        return m
+    if isinstance(f, ast.Or):
+        m = eval_filter(f.children[0], fields, df)
+        for c in f.children[1:]:
+            m = m | eval_filter(c, fields, df)
+        return m
+    if isinstance(f, ast.Not):
+        return ~eval_filter(f.child, fields, df)
+    if isinstance(f, ast.Compare):
+        l = eval_expr(f.left, fields, df)
+        r = eval_expr(f.right, fields, df)
+        with np.errstate(invalid="ignore"):
+            return np.asarray(_CMPS[f.op](l.to_numpy(), r.to_numpy())).astype(bool)
+    if isinstance(f, ast.Between):
+        v = eval_expr(f.expr, fields, df).to_numpy()
+        lo = eval_expr(f.low, fields, df).to_numpy()
+        hi = eval_expr(f.high, fields, df).to_numpy()
+        with np.errstate(invalid="ignore"):
+            m = (v >= lo) & (v <= hi)
+        return ~m if f.negated else m
+    if isinstance(f, ast.In):
+        v = eval_expr(f.expr, fields, df)
+        vals = [x.value for x in f.values if isinstance(x, ast.Literal)]
+        m = v.isin(vals).to_numpy()
+        return ~m if f.negated else m
+    if isinstance(f, ast.Like):
+        from pinot_tpu.query.plan import _like_to_regex
+
+        v = eval_expr(f.expr, fields, df).map(str)
+        m = v.str.fullmatch(_like_to_regex(f.pattern)).fillna(False).to_numpy()
+        return ~m if f.negated else m
+    if isinstance(f, ast.RegexpLike):
+        v = eval_expr(f.expr, fields, df).map(str)
+        return v.str.contains(f.pattern, regex=True).fillna(False).to_numpy()
+    if isinstance(f, ast.IsNull):
+        m = eval_expr(f.expr, fields, df).isna().to_numpy()
+        return ~m if f.negated else m
+    raise L.PlanV2Error(f"unsupported filter {f}")
+
+
+# ---------------------------------------------------------------------------
+# Key normalization + hashing (consistent across both join sides)
+# ---------------------------------------------------------------------------
+
+
+def _norm_key(s: pd.Series) -> pd.Series:
+    # all numerics widen to double so INT = DOUBLE joins hash/compare equal on
+    # both sides (Pinot widens numeric comparisons the same way)
+    if s.dtype.kind in "iubf":
+        return s.astype(np.float64)
+    out = s.astype(object).copy()
+    nn = s.notna()
+    out[nn] = out[nn].map(str)
+    return out
+
+
+def _key_frame(exprs: list[ast.Expr], fields: list[L.Field], df: pd.DataFrame) -> pd.DataFrame:
+    return pd.DataFrame({f"__k{i}": _norm_key(eval_expr(e, fields, df)) for i, e in enumerate(exprs)})
+
+
+def _hash_partition(keydf: pd.DataFrame, n: int) -> np.ndarray:
+    if n == 1 or keydf.empty:
+        return np.zeros(len(keydf), dtype=np.int64)
+    h = pd.util.hash_pandas_object(keydf.fillna(0), index=False).to_numpy()
+    return (h % np.uint64(n)).astype(np.int64)
+
+
+# ---------------------------------------------------------------------------
+# Aggregation over blocks
+# ---------------------------------------------------------------------------
+
+
+def _agg_series(func: str, g, vals_col: str, extra: tuple):
+    if func == "count":
+        return g.size() if vals_col is None else g[vals_col].size()
+    sel = g[vals_col]
+    if func == "sum":
+        return sel.sum(min_count=1)
+    if func == "min":
+        return sel.min()
+    if func == "max":
+        return sel.max()
+    if func == "avg":
+        return sel.mean()
+    if func in ("distinctcount", "distinctcountbitmap", "distinctcounthll"):
+        return sel.nunique()
+    if func == "minmaxrange":
+        return sel.max() - sel.min()
+    if func in ("percentile", "percentileest", "percentiletdigest"):
+        return sel.quantile(extra[0] / 100.0)
+    if func == "mode":
+        return sel.agg(lambda s: float(s.mode().iloc[0]) if len(s.mode()) else np.nan)
+    raise L.PlanV2Error(f"unsupported aggregation {func} in multistage runtime")
+
+
+def _agg_scalar(func: str, s: pd.Series, extra: tuple):
+    if func == "count":
+        return len(s)
+    if len(s) == 0:
+        return np.nan
+    if func == "sum":
+        return s.sum()
+    if func == "min":
+        return s.min()
+    if func == "max":
+        return s.max()
+    if func == "avg":
+        return s.mean()
+    if func in ("distinctcount", "distinctcountbitmap", "distinctcounthll"):
+        return s.nunique()
+    if func == "minmaxrange":
+        return s.max() - s.min()
+    if func in ("percentile", "percentileest", "percentiletdigest"):
+        return s.quantile(extra[0] / 100.0)
+    if func == "mode":
+        m = s.mode()
+        return float(m.iloc[0]) if len(m) else np.nan
+    raise L.PlanV2Error(f"unsupported aggregation {func} in multistage runtime")
+
+
+# ---------------------------------------------------------------------------
+# Node execution
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class RunCtx:
+    stage: L.Stage
+    worker: int
+    mailbox: MailboxService
+    stages: dict[int, L.Stage]
+    segments: dict[str, list]  # table -> segments
+    n_senders: dict[int, int]  # stage id -> parallelism
+
+
+def _empty_df(n_cols: int) -> pd.DataFrame:
+    return pd.DataFrame({i: pd.Series(dtype=object) for i in range(n_cols)})
+
+
+def exec_node(node: L.Node, ctx: RunCtx) -> pd.DataFrame:
+    if isinstance(node, L.StageInput):
+        blocks = ctx.mailbox.receive_all(
+            ctx.stage.id, ctx.worker, node.stage_id, ctx.n_senders[node.stage_id]
+        )
+        blocks = [b for b in blocks if len(b)]
+        if not blocks:
+            return _empty_df(len(node.fields))
+        return pd.concat(blocks, ignore_index=True)
+
+    if isinstance(node, L.Scan):
+        segs = ctx.segments.get(node.table, [])
+        mine = segs[ctx.worker :: ctx.stage.parallelism]
+        frames = []
+        for seg in mine:
+            mask = host_exec.filter_mask(seg, node.filter) if node.filter is not None else None
+            data = {}
+            for i, col in enumerate(node.columns):
+                v = seg.columns[col].materialize()
+                data[i] = v[mask] if mask is not None else v
+            frames.append(pd.DataFrame(data))
+        if not frames:
+            return _empty_df(len(node.fields))
+        return pd.concat(frames, ignore_index=True)
+
+    if isinstance(node, L._RootCollect):
+        return exec_node(node.input, ctx)
+
+    if isinstance(node, L.FilterNode):
+        df = exec_node(node.input, ctx)
+        if df.empty:
+            return df
+        m = eval_filter(node.condition, node.input.fields, df)
+        return df[m].reset_index(drop=True)
+
+    if isinstance(node, L.Project):
+        df = exec_node(node.input, ctx)
+        out = {}
+        for i, e in enumerate(node.exprs):
+            out[i] = eval_expr(e, node.input.fields, df).reset_index(drop=True)
+        return pd.DataFrame(out) if out else _empty_df(0)
+
+    if isinstance(node, L.Rename):
+        df = exec_node(node.input, ctx)
+        sub = df.iloc[:, : node.n_visible].copy()
+        sub.columns = range(node.n_visible)
+        return sub
+
+    if isinstance(node, L.Aggregate):
+        return _exec_aggregate(node, ctx)
+
+    if isinstance(node, L.Distinct):
+        df = exec_node(node.input, ctx)
+        return df.drop_duplicates(ignore_index=True)
+
+    if isinstance(node, L.Join):
+        return _exec_join(node, ctx)
+
+    if isinstance(node, L.WindowNode):
+        return _exec_window(node, ctx)
+
+    if isinstance(node, L.Sort):
+        df = exec_node(node.input, ctx)
+        if node.keys and len(df):
+            by = [k for k, _ in node.keys]
+            asc = [not d for _, d in node.keys]
+            df = df.sort_values(by=by, ascending=asc, kind="mergesort", ignore_index=True)
+        if node.offset or node.limit is not None:
+            end = None if node.limit is None else node.offset + node.limit
+            df = df.iloc[node.offset : end].reset_index(drop=True)
+        if node.drop_hidden_after is not None:
+            df = df.iloc[:, : node.drop_hidden_after]
+        return df
+
+    if isinstance(node, L.SetOp):
+        l = exec_node(node.left, ctx)
+        r = exec_node(node.right, ctx)
+        r.columns = l.columns = range(l.shape[1])
+        if node.kind == "union":
+            out = pd.concat([l, r], ignore_index=True)
+            return out if node.all else out.drop_duplicates(ignore_index=True)
+        cols = list(l.columns)
+        if node.all:
+            # bag semantics via per-duplicate ordinals: the k-th copy on the
+            # left pairs with the k-th copy on the right
+            l = l.assign(__ord=l.groupby(cols, dropna=False).cumcount())
+            r = r.assign(__ord=r.groupby(cols, dropna=False).cumcount())
+            on = cols + ["__ord"]
+            if node.kind == "intersect":
+                return l.merge(r, how="inner", on=on)[cols].reset_index(drop=True)
+            m = l.merge(r, how="left", on=on, indicator=True)
+            return m[m["_merge"] == "left_only"][cols].reset_index(drop=True)
+        lu = l.drop_duplicates()
+        ru = r.drop_duplicates()
+        if node.kind == "intersect":
+            return lu.merge(ru, how="inner", on=cols).reset_index(drop=True)
+        # except
+        m = lu.merge(ru, how="left", on=cols, indicator=True)
+        return (
+            m[m["_merge"] == "left_only"].drop(columns="_merge").reset_index(drop=True)
+        )
+
+    raise L.PlanV2Error(f"cannot execute node {type(node).__name__}")
+
+
+def _exec_aggregate(node: L.Aggregate, ctx: RunCtx) -> pd.DataFrame:
+    df = exec_node(node.input, ctx)
+    infields = node.input.fields
+    n_groups = len(node.group_exprs)
+    if n_groups == 0:
+        row = []
+        for a in node.aggs:
+            s = eval_expr(a.arg, infields, df) if a.arg is not None else pd.Series(np.zeros(len(df)))
+            row.append(_agg_scalar(a.func, s, a.extra))
+        return pd.DataFrame({i: [v] for i, v in enumerate(row)})
+    if df.empty:
+        return _empty_df(len(node.fields))
+    work = {}
+    for i, g in enumerate(node.group_exprs):
+        work[f"g{i}"] = eval_expr(g, infields, df).reset_index(drop=True)
+    for j, a in enumerate(node.aggs):
+        if a.arg is not None:
+            work[f"v{j}"] = eval_expr(a.arg, infields, df).reset_index(drop=True)
+    wdf = pd.DataFrame(work)
+    gb = wdf.groupby([f"g{i}" for i in range(n_groups)], dropna=False, sort=False)
+    outs = []
+    for j, a in enumerate(node.aggs):
+        col = f"v{j}" if a.arg is not None else None
+        outs.append(_agg_series(a.func, gb, col, a.extra).rename(f"a{j}"))
+    if outs:
+        res = pd.concat(outs, axis=1).reset_index()
+    else:
+        res = gb.size().reset_index().iloc[:, :n_groups]
+    res.columns = range(res.shape[1])
+    return res
+
+
+def _exec_join(node: L.Join, ctx: RunCtx) -> pd.DataFrame:
+    l = exec_node(node.left, ctx)
+    r = exec_node(node.right, ctx)
+    nl, nr = len(node.left.fields), len(node.right.fields)
+    l.columns = [f"l{i}" for i in range(nl)]
+    r.columns = [f"r{i}" for i in range(nr)]
+    keys = [f"__k{i}" for i in range(len(node.left_keys))]
+    if keys:
+        lk = _key_frame(node.left_keys, node.left.fields, l.rename(columns=dict(zip(l.columns, range(nl)))))
+        rk = _key_frame(node.right_keys, node.right.fields, r.rename(columns=dict(zip(r.columns, range(nr)))))
+        lk.index = l.index
+        rk.index = r.index
+        l = pd.concat([l, lk], axis=1)
+        r = pd.concat([r, rk], axis=1)
+        l_null = lk.isna().any(axis=1).to_numpy() if len(l) else np.zeros(0, bool)
+        r_null = rk.isna().any(axis=1).to_numpy() if len(r) else np.zeros(0, bool)
+    else:
+        keys = ["__cross"]
+        l["__cross"] = 1
+        r["__cross"] = 1
+        l_null = np.zeros(len(l), bool)
+        r_null = np.zeros(len(r), bool)
+
+    lcols = [f"l{i}" for i in range(nl)]
+    rcols = [f"r{i}" for i in range(nr)]
+
+    def _positional(m: pd.DataFrame) -> pd.DataFrame:
+        v = m[lcols + rcols].copy()
+        v.columns = range(nl + nr)
+        return v.reset_index(drop=True)
+
+    kind = node.kind if node.kind != "cross" else "inner"
+    if kind == "inner":
+        m = l[~l_null].merge(r[~r_null], how="inner", on=keys)
+        out = _positional(m)
+        if node.post_filter is not None and len(out):
+            out = out[eval_filter(node.post_filter, node.fields, out)].reset_index(drop=True)
+        return out
+
+    # outer joins: the ON residual participates in MATCHING (a failed residual
+    # null-extends the row, it must not drop it) — so: inner-match with the
+    # full ON condition first, then append unmatched rows null-extended.
+    l = l.assign(__lid=np.arange(len(l)))
+    r = r.assign(__rid=np.arange(len(r)))
+    inner = l[~l_null].merge(r[~r_null], how="inner", on=keys)
+    if node.post_filter is not None and len(inner):
+        view = inner[lcols + rcols].copy()
+        view.columns = range(nl + nr)
+        inner = inner[eval_filter(node.post_filter, node.fields, view)]
+    parts = [inner]
+    if kind in ("left", "full"):
+        parts.append(l[~l["__lid"].isin(inner["__lid"])])
+    if kind in ("right", "full"):
+        parts.append(r[~r["__rid"].isin(inner["__rid"])])
+    m = pd.concat(parts, ignore_index=True)
+    return _positional(m)
+
+
+_WINDOW_AGGS = {"sum", "min", "max", "avg", "count"}
+_WINDOW_RANKS = {"row_number", "rank", "dense_rank"}
+
+
+def _exec_window(node: L.WindowNode, ctx: RunCtx) -> pd.DataFrame:
+    df = exec_node(node.input, ctx)
+    infields = node.input.fields
+    base_n = len(infields)
+    out = df.copy()
+    for wi, wf in enumerate(node.windows):
+        fname = wf.func.name
+        n = len(df)
+        if n == 0:
+            out[base_n + wi] = pd.Series(dtype=float)
+            continue
+        pcols = [eval_expr(p, infields, df).reset_index(drop=True) for p in wf.partition_by]
+        ocols = [eval_expr(o.expr, infields, df).reset_index(drop=True) for o in wf.order_by]
+        odesc = [o.desc for o in wf.order_by]
+        wdf = pd.DataFrame(
+            {**{f"p{i}": c for i, c in enumerate(pcols)}, **{f"o{i}": c for i, c in enumerate(ocols)}}
+        )
+        if wf.func.args and not isinstance(wf.func.args[0], ast.Star):
+            wdf["v"] = eval_expr(wf.func.args[0], infields, df).reset_index(drop=True)
+        pnames = [f"p{i}" for i in range(len(pcols))] or None
+        if fname in _WINDOW_AGGS and not ocols:
+            if pnames is None:
+                if fname == "count":
+                    res = pd.Series(np.full(n, int(wdf["v"].notna().sum()) if "v" in wdf else n))
+                else:
+                    res = pd.Series(np.full(n, _agg_scalar(fname, wdf["v"], ())))
+            else:
+                g = wdf.groupby(pnames, dropna=False)
+                if fname == "count":
+                    res = g["v"].transform("count") if "v" in wdf else g["p0"].transform("size")
+                else:
+                    res = g["v"].transform(fname if fname != "avg" else "mean")
+        else:
+            onames = [f"o{i}" for i in range(len(ocols))]
+            sf = wdf.sort_values(
+                by=(pnames or []) + onames,
+                ascending=[True] * len(pcols) + [not d for d in odesc],
+                kind="mergesort",
+            )
+            if pnames is None:
+                sf["__grp"] = 0
+                gname = "__grp"
+                g = sf.groupby(gname)
+            else:
+                g = sf.groupby(pnames, dropna=False)
+            rn = g.cumcount() + 1
+            if fname == "row_number":
+                res = rn
+            elif fname in ("rank", "dense_rank"):
+                first = rn == 1
+                if onames:
+                    changed = np.zeros(len(sf), dtype=bool)
+                    for o in onames:
+                        col = sf[o].to_numpy()
+                        prev = np.roll(col, 1)
+                        with np.errstate(invalid="ignore"):
+                            neq = col != prev
+                        both_nan = pd.isna(col) & pd.isna(np.roll(col, 1))
+                        changed |= neq & ~both_nan
+                    changed[0] = True
+                    newkey = first.to_numpy() | changed
+                else:
+                    newkey = first.to_numpy()
+                if fname == "rank":
+                    vals = np.where(newkey, rn.to_numpy(), 0)
+                    filled = pd.Series(vals, index=sf.index).replace(0, np.nan)
+                    grp_keys = g.ngroup()
+                    res = filled.groupby(grp_keys.to_numpy()).ffill().astype(np.int64)
+                else:
+                    grp_keys = g.ngroup().to_numpy()
+                    inc = newkey.astype(np.int64)
+                    res = pd.Series(inc, index=sf.index).groupby(grp_keys).cumsum()
+            elif fname in _WINDOW_AGGS:
+                if fname == "count":
+                    res = rn if "v" not in sf else sf["v"].notna().astype(np.int64).groupby(g.ngroup().to_numpy()).cumsum()
+                elif fname == "avg":
+                    gk = g.ngroup().to_numpy()
+                    cs = sf["v"].groupby(gk).cumsum()
+                    cnt = pd.Series(np.ones(len(sf)), index=sf.index).groupby(gk).cumsum()
+                    res = cs / cnt
+                else:
+                    gk = g.ngroup().to_numpy()
+                    if fname == "sum":
+                        res = sf["v"].groupby(gk).cumsum()
+                    elif fname == "min":
+                        res = sf["v"].groupby(gk).cummin()
+                    else:
+                        res = sf["v"].groupby(gk).cummax()
+            else:
+                raise L.PlanV2Error(f"unsupported window function {fname}")
+            res = res.reindex(df.index)
+        out[base_n + wi] = pd.Series(np.asarray(res), index=df.index) if len(res) == n else res
+    out.columns = range(out.shape[1])
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Stage workers + engine
+# ---------------------------------------------------------------------------
+
+
+def _send_output(df: pd.DataFrame, stage: L.Stage, parent_id: int, parent_par: int, mailbox: MailboxService, worker: int):
+    if stage.dist == L.SINGLETON:
+        mailbox.send(stage.id, parent_id, 0, df)
+    elif stage.dist == L.BROADCAST:
+        for w in range(parent_par):
+            mailbox.send(stage.id, parent_id, w, df)
+    elif stage.dist == L.RANDOM:
+        mailbox.send(stage.id, parent_id, worker % parent_par, df)
+    elif stage.dist == L.HASH:
+        keydf = _key_frame(stage.key_exprs, stage.root.fields, df)
+        part = _hash_partition(keydf, parent_par)
+        for w in range(parent_par):
+            sub = df[part == w]
+            if len(sub):
+                mailbox.send(stage.id, parent_id, w, sub.reset_index(drop=True))
+    else:
+        raise L.PlanV2Error(f"unknown distribution {stage.dist}")
+    for w in range(parent_par):
+        mailbox.send(stage.id, parent_id, w, _EOS)
+
+
+class MultistageEngine:
+    """In-process v2 engine: plans SQL into stages and runs OpChains on
+    threads, leaf stages scanning the catalog's segments.
+
+    Reference parity: QueryDispatcher.submitAndReduce
+    (pinot-query-runtime/.../QueryDispatcher.java:128) + worker QueryServer.
+    """
+
+    def __init__(
+        self,
+        catalog: dict[str, list],
+        n_workers: int = 2,
+        schemas: dict[str, list[str]] | None = None,
+    ):
+        """schemas: optional table -> column names, needed for tables whose
+        segment list is empty (a valid empty table must plan, not error)."""
+        self.catalog = dict(catalog)
+        self.n_workers = n_workers
+        self.schemas = dict(schemas) if schemas else {}
+
+    def execute(self, sql: str, stmt=None) -> ResultTable:
+        import time
+
+        from pinot_tpu.query.sql import parse_sql
+
+        t0 = time.perf_counter()
+        if stmt is None:
+            stmt = parse_sql(sql)
+        cols = dict(self.schemas)
+        for t, segs in self.catalog.items():
+            if t not in cols and segs:
+                cols[t] = list(segs[0].schema.columns)
+        cat = L.Catalog(cols)
+        plan = L.build_stage_plan(stmt, cat, self.n_workers)
+        # singleton-fed stages collapse to one worker
+        for s in plan.stages.values():
+            for inp in s.inputs:
+                if plan.stages[inp].dist == L.SINGLETON:
+                    s.parallelism = 1
+        df = self._run(plan)
+        df = df.astype(object).where(pd.notna(df), None)
+        rows = df.values.tolist()
+        total_docs = sum(s.n_docs for segs in self.catalog.values() for s in segs)
+        return ResultTable(
+            columns=list(plan.visible_names),
+            rows=rows,
+            total_docs=total_docs,
+            time_used_ms=(time.perf_counter() - t0) * 1e3,
+        )
+
+    def _run(self, plan: L.StagePlan) -> pd.DataFrame:
+        mailbox = MailboxService()
+        parent_of: dict[int, int] = {}
+        for s in plan.stages.values():
+            for inp in s.inputs:
+                parent_of[inp] = s.id
+        n_senders = {sid: s.parallelism for sid, s in plan.stages.items()}
+        errors: list[BaseException] = []
+
+        def worker_fn(stage: L.Stage, w: int):
+            ctx = RunCtx(stage, w, mailbox, plan.stages, self.catalog, n_senders)
+            parent = parent_of[stage.id]
+            parent_par = plan.stages[parent].parallelism
+            try:
+                df = exec_node(stage.root, ctx)
+                _send_output(df, stage, parent, parent_par, mailbox, w)
+            except BaseException as e:  # propagate to receivers
+                errors.append(e)
+                for pw in range(parent_par):
+                    mailbox.send(stage.id, parent, pw, ("__err__", repr(e)))
+
+        threads = []
+        for sid in sorted(plan.stages):
+            if sid == 0:
+                continue
+            s = plan.stages[sid]
+            for w in range(s.parallelism):
+                t = threading.Thread(target=worker_fn, args=(s, w), daemon=True)
+                t.start()
+                threads.append(t)
+        root = plan.stages[0]
+        ctx = RunCtx(root, 0, mailbox, plan.stages, self.catalog, n_senders)
+        try:
+            out = exec_node(root.root, ctx)
+        finally:
+            for t in threads:
+                t.join(timeout=30)
+        if errors:
+            raise errors[0]
+        return out

@@ -177,11 +177,73 @@ class Not(FilterExpr):
 
 @dataclass(frozen=True)
 class OrderByItem:
-    expr: Expr
+    expr: "Expr"
     desc: bool = False
 
     def __str__(self) -> str:
         return f"{self.expr} {'DESC' if self.desc else 'ASC'}"
+
+
+@dataclass(frozen=True)
+class WindowFunction(Expr):
+    """fn(args) OVER (PARTITION BY ... ORDER BY ...).
+
+    Reference parity: WindowNode / WindowAggregateOperator
+    (pinot-query-runtime/.../runtime/operator/WindowAggregateOperator.java).
+    """
+
+    func: FunctionCall
+    partition_by: tuple[Expr, ...] = ()
+    order_by: tuple[OrderByItem, ...] = ()
+
+    def __str__(self) -> str:
+        parts = []
+        if self.partition_by:
+            parts.append("PARTITION BY " + ",".join(map(str, self.partition_by)))
+        if self.order_by:
+            parts.append("ORDER BY " + ",".join(map(str, self.order_by)))
+        return f"{self.func} OVER ({' '.join(parts)})"
+
+
+# ---------------------------------------------------------------------------
+# Relations (FROM clause) — multistage engine surface. Reference parity: the
+# Calcite relational tree QueryEnvironment plans over
+# (pinot-query-planner/.../query/QueryEnvironment.java:100).
+# ---------------------------------------------------------------------------
+
+
+class Relation:
+    """Base class for FROM-clause relations."""
+
+
+@dataclass(frozen=True)
+class TableRef(Relation):
+    name: str
+    alias: str | None = None
+
+    def __str__(self) -> str:
+        return f"{self.name} AS {self.alias}" if self.alias else self.name
+
+
+@dataclass(frozen=True)
+class SubqueryRef(Relation):
+    stmt: "SelectStatement | SetOpStatement"
+    alias: str
+
+    def __str__(self) -> str:
+        return f"(<subquery>) AS {self.alias}"
+
+
+@dataclass(frozen=True)
+class JoinRel(Relation):
+    left: Relation
+    right: Relation
+    kind: str  # inner | left | right | full | cross
+    condition: FilterExpr | None
+
+    def __str__(self) -> str:
+        on = f" ON {self.condition}" if self.condition is not None else ""
+        return f"({self.left} {self.kind.upper()} JOIN {self.right}{on})"
 
 
 @dataclass(frozen=True)
@@ -196,7 +258,7 @@ class SelectItem:
 @dataclass
 class SelectStatement:
     select_list: list[SelectItem]
-    from_table: str
+    from_table: str  # simple-table name ("" when relation is a join/subquery)
     distinct: bool = False
     where: FilterExpr | None = None
     group_by: list[Expr] = field(default_factory=list)
@@ -205,3 +267,42 @@ class SelectStatement:
     limit: int | None = None
     offset: int = 0
     options: dict[str, str] = field(default_factory=dict)
+    relation: Relation | None = None  # full FROM tree (multistage engine)
+
+    @property
+    def needs_multistage(self) -> bool:
+        """True when the statement requires the v2 engine (joins, subqueries,
+        aliased tables, window functions)."""
+        if self.relation is not None and not (
+            isinstance(self.relation, TableRef) and self.relation.alias is None
+        ):
+            return True
+        return any(_has_window(it.expr) for it in self.select_list)
+
+
+def _has_window(expr: Expr) -> bool:
+    if isinstance(expr, WindowFunction):
+        return True
+    if isinstance(expr, FunctionCall):
+        return any(_has_window(a) for a in expr.args)
+    if isinstance(expr, BinaryOp):
+        return _has_window(expr.left) or _has_window(expr.right)
+    return False
+
+
+@dataclass
+class SetOpStatement:
+    """UNION / INTERSECT / EXCEPT of two queries.
+
+    Reference parity: SetOpNode → Union/Intersect/MinusOperator
+    (pinot-query-runtime/.../runtime/operator/set/)."""
+
+    kind: str  # union | intersect | except
+    all: bool
+    left: "SelectStatement | SetOpStatement"
+    right: "SelectStatement | SetOpStatement"
+    options: dict[str, str] = field(default_factory=dict)
+
+    @property
+    def needs_multistage(self) -> bool:
+        return True

@@ -40,11 +40,17 @@ from pinot_tpu.query.ast import (
     Literal,
     Not,
     Or,
+    JoinRel,
     OrderByItem,
     RegexpLike,
+    Relation,
     SelectItem,
     SelectStatement,
+    SetOpStatement,
     Star,
+    SubqueryRef,
+    TableRef,
+    WindowFunction,
 )
 
 
@@ -99,6 +105,8 @@ _KEYWORDS = {
     "SELECT", "FROM", "WHERE", "GROUP", "BY", "HAVING", "ORDER", "LIMIT",
     "OFFSET", "AS", "AND", "OR", "NOT", "IN", "BETWEEN", "LIKE", "IS",
     "NULL", "TRUE", "FALSE", "DISTINCT", "ASC", "DESC", "SET",
+    "JOIN", "INNER", "LEFT", "RIGHT", "FULL", "OUTER", "CROSS", "ON",
+    "UNION", "INTERSECT", "EXCEPT", "ALL", "OVER", "PARTITION",
 }
 
 
@@ -166,13 +174,87 @@ class Parser:
             options[key] = val
             self.expect_op(";")
 
-        stmt = self._select()
+        stmt = self._query()
         stmt.options.update(options)
         self.eat_op(";")
         t = self.peek()
         if t.kind != "eof":
             raise SqlParseError(f"unexpected trailing input at position {t.pos}: {t.text!r}")
         return stmt
+
+    def _query(self):
+        """select [UNION/INTERSECT/EXCEPT [ALL] select]* (left-associative)."""
+        left = self._select_or_paren()
+        while self.at_kw("UNION", "INTERSECT", "EXCEPT"):
+            kind = self.next().upper.lower()
+            all_ = self.eat_kw("ALL")
+            right = self._select_or_paren()
+            left = SetOpStatement(kind, all_, left, right)
+        return left
+
+    def _select_or_paren(self):
+        if self.at_op("(") :
+            self.next()
+            inner = self._query()
+            self.expect_op(")")
+            return inner
+        return self._select()
+
+    # -- FROM relations -----------------------------------------------------
+
+    _JOIN_STOP = {
+        "JOIN", "INNER", "LEFT", "RIGHT", "FULL", "CROSS", "ON", "WHERE",
+        "GROUP", "HAVING", "ORDER", "LIMIT", "UNION", "INTERSECT", "EXCEPT",
+    }
+
+    def _maybe_alias(self) -> str | None:
+        if self.eat_kw("AS"):
+            return self._identifier_name(self.next())
+        t = self.peek()
+        if t.kind == "qident" or (t.kind == "ident" and t.upper not in _KEYWORDS):
+            return self._identifier_name(self.next())
+        return None
+
+    def _relation_primary(self) -> Relation:
+        if self.at_op("("):
+            # subquery: ( SELECT ... ) alias
+            self.next()
+            inner = self._query()
+            self.expect_op(")")
+            alias = self._maybe_alias()
+            if alias is None:
+                raise SqlParseError(f"subquery requires an alias at position {self.peek().pos}")
+            return SubqueryRef(inner, alias)
+        name = self._identifier_name(self.next())
+        alias = self._maybe_alias()
+        return TableRef(name, alias)
+
+    def _relation(self) -> Relation:
+        left = self._relation_primary()
+        while True:
+            kind = None
+            if self.at_kw("JOIN"):
+                self.next()
+                kind = "inner"
+            elif self.at_kw("INNER") and self.peek(1).upper == "JOIN":
+                self.next(); self.next()
+                kind = "inner"
+            elif self.at_kw("LEFT", "RIGHT", "FULL"):
+                kind = self.peek().upper.lower()
+                self.next()
+                self.eat_kw("OUTER")
+                self.expect_kw("JOIN")
+            elif self.at_kw("CROSS") and self.peek(1).upper == "JOIN":
+                self.next(); self.next()
+                kind = "cross"
+            else:
+                return left
+            right = self._relation_primary()
+            cond = None
+            if kind != "cross":
+                self.expect_kw("ON")
+                cond = self._bool_expr()
+            left = JoinRel(left, right, kind, cond)
 
     def _select(self) -> SelectStatement:
         self.expect_kw("SELECT")
@@ -181,7 +263,8 @@ class Parser:
         while self.eat_op(","):
             items.append(self._select_item())
         self.expect_kw("FROM")
-        table = self._identifier_name(self.next())
+        relation = self._relation()
+        table = relation.name if isinstance(relation, TableRef) and relation.alias is None else ""
         where = None
         if self.eat_kw("WHERE"):
             where = self._bool_expr()
@@ -223,6 +306,7 @@ class Parser:
             order_by=order_by,
             limit=limit,
             offset=offset,
+            relation=relation,
         )
 
     def _int_literal(self) -> int:
@@ -248,6 +332,26 @@ class Parser:
         else:
             self.eat_kw("ASC")
         return OrderByItem(expr, desc)
+
+    def _window(self, fc: FunctionCall) -> WindowFunction:
+        self.expect_kw("OVER")
+        self.expect_op("(")
+        partition_by: list[Expr] = []
+        order_by: list[OrderByItem] = []
+        if self.at_kw("PARTITION"):
+            self.next()
+            self.expect_kw("BY")
+            partition_by.append(self._expr())
+            while self.eat_op(","):
+                partition_by.append(self._expr())
+        if self.at_kw("ORDER"):
+            self.next()
+            self.expect_kw("BY")
+            order_by.append(self._order_item())
+            while self.eat_op(","):
+                order_by.append(self._order_item())
+        self.expect_op(")")
+        return WindowFunction(fc, tuple(partition_by), tuple(order_by))
 
     def _identifier_name(self, t: Token) -> str:
         if t.kind == "ident":
@@ -421,7 +525,10 @@ class Parser:
                     while self.eat_op(","):
                         args.append(self._expr())
                 self.expect_op(")")
-                return FunctionCall(t.text.lower(), tuple(args), distinct)
+                fc = FunctionCall(t.text.lower(), tuple(args), distinct)
+                if self.at_kw("OVER"):
+                    return self._window(fc)
+                return fc
             self.next()
             return Identifier(t.text)
         raise SqlParseError(f"unexpected token {t.text!r} at position {t.pos}")

@@ -182,3 +182,24 @@ def test_remote_server_error_surfaces(cluster):
             remote.execute_partials("lineorder", "SELEC bogus", [])
     finally:
         svc.stop()
+
+
+def test_broker_routes_multistage(cluster):
+    """Joins/subqueries auto-route to the v2 engine through the broker
+    (MultiStageBrokerRequestHandler.java:88 selection parity)."""
+    controller, broker, servers, t = cluster
+    res = broker.execute(
+        "SELECT region, total FROM (SELECT region, SUM(revenue) AS total FROM lineorder "
+        "GROUP BY region) s ORDER BY total DESC LIMIT 10"
+    )
+    exp = t.groupby("region").revenue.sum().sort_values(ascending=False)
+    assert [(r[0], int(r[1])) for r in res.rows] == [(k, int(v)) for k, v in exp.items()]
+
+
+def test_broker_multistage_self_join(cluster):
+    controller, broker, servers, t = cluster
+    res = broker.execute(
+        "SELECT COUNT(*) FROM (SELECT DISTINCT region FROM lineorder) a CROSS JOIN "
+        "(SELECT DISTINCT year FROM lineorder) b"
+    )
+    assert int(res.rows[0][0]) == t.region.nunique() * t.year.nunique()
