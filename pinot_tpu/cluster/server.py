@@ -40,6 +40,12 @@ class Server:
     def add_segment(self, table: str, segment_name: str, seg_dir: str | Path) -> None:
         seg = load_segment(seg_dir)
         with self._lock:
+            rt = self._realtime.get(table)
+            if rt is not None and hasattr(rt, "on_segment_loaded"):
+                # upsert tables: validity mask must be attached BEFORE the
+                # segment becomes queryable, or a concurrent query would see
+                # superseded rows (validDocIds attach-then-online ordering)
+                rt.on_segment_loaded(seg)
             self._tables.setdefault(table, {})[segment_name] = seg
             # engines are rebuilt lazily; drop the cached one
             self._engines.pop(table, None)

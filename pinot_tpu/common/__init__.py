@@ -1,12 +1,22 @@
 from pinot_tpu.common.types import DataType, FieldSpec, FieldType, Schema
-from pinot_tpu.common.config import IndexingConfig, TableConfig, TableType
+from pinot_tpu.common.config import (
+    DedupConfig,
+    IndexingConfig,
+    StarTreeIndexConfig,
+    TableConfig,
+    TableType,
+    UpsertConfig,
+)
 
 __all__ = [
     "DataType",
     "FieldSpec",
     "FieldType",
     "Schema",
+    "DedupConfig",
     "IndexingConfig",
+    "StarTreeIndexConfig",
     "TableConfig",
     "TableType",
+    "UpsertConfig",
 ]

@@ -130,6 +130,8 @@ class Schema:
 
     name: str
     fields: dict[str, FieldSpec] = field(default_factory=dict)
+    # Pinot Schema.java primaryKeyColumns parity (upsert/dedup key)
+    primary_key_columns: list[str] = field(default_factory=list)
 
     @staticmethod
     def build(
@@ -137,8 +139,9 @@ class Schema:
         dimensions: Iterable[tuple[str, DataType]] = (),
         metrics: Iterable[tuple[str, DataType]] = (),
         date_times: Iterable[tuple[str, DataType]] = (),
+        primary_key_columns: Iterable[str] = (),
     ) -> "Schema":
-        s = Schema(name)
+        s = Schema(name, primary_key_columns=list(primary_key_columns))
         for col, dt in dimensions:
             s.add(FieldSpec(col, dt, FieldType.DIMENSION))
         for col, dt in metrics:
@@ -172,12 +175,18 @@ class Schema:
         return [c for c, f in self.fields.items() if f.field_type == FieldType.METRIC]
 
     def to_json(self) -> str:
-        return json.dumps({"schemaName": self.name, "fields": [f.to_dict() for f in self.fields.values()]})
+        return json.dumps(
+            {
+                "schemaName": self.name,
+                "fields": [f.to_dict() for f in self.fields.values()],
+                "primaryKeyColumns": self.primary_key_columns,
+            }
+        )
 
     @staticmethod
     def from_json(s: str) -> "Schema":
         d = json.loads(s)
-        schema = Schema(d["schemaName"])
+        schema = Schema(d["schemaName"], primary_key_columns=d.get("primaryKeyColumns", []))
         for fd in d["fields"]:
             schema.add(FieldSpec.from_dict(fd))
         return schema

@@ -301,6 +301,10 @@ def exec_node(node: L.Node, ctx: RunCtx) -> pd.DataFrame:
         frames = []
         for seg in mine:
             mask = host_exec.filter_mask(seg, node.filter) if node.filter is not None else None
+            valid = seg.extras.get("valid_docs")
+            if valid is not None:
+                vm = valid(seg.n_docs)
+                mask = vm if mask is None else (mask & vm)
             data = {}
             for i, col in enumerate(node.columns):
                 v = seg.columns[col].materialize()

@@ -129,6 +129,11 @@ class QueryEngine:
 
     def _execute_segment(self, seg: ImmutableSegment, ctx: QueryContext):
         """Returns (partial, matched_docs) for one segment."""
+        valid = seg.extras.get("valid_docs")
+        if valid is not None:
+            # upsert table: only latest-per-PK docs are visible; the validity
+            # mask ANDs into the filter (host path; device mask operand later)
+            return self._host_segment(seg, ctx, extra_mask=valid(seg.n_docs))
         if seg.extras.get("startree"):
             from pinot_tpu.query import startree_exec
 
@@ -157,8 +162,10 @@ class QueryEngine:
             int(matched),
         )
 
-    def _host_segment(self, seg: ImmutableSegment, ctx: QueryContext):
+    def _host_segment(self, seg: ImmutableSegment, ctx: QueryContext, extra_mask=None):
         mask = host_exec.filter_mask(seg, ctx.filter)
+        if extra_mask is not None:
+            mask = mask & extra_mask
         matched = int(mask.sum())
         qt = ctx.query_type
         k = ctx.limit + ctx.offset

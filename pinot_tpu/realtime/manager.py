@@ -41,8 +41,15 @@ class PartitionConsumer:
         max_rows_per_segment: int = 100_000,
         poll_interval_s: float = 0.01,
         batch_size: int = 1000,
+        upsert=None,  # PartitionUpsertMetadataManager
+        dedup=None,  # PartitionDedupMetadataManager
     ):
         self.table = table
+        self.upsert = upsert
+        self.dedup = dedup
+        self.upsert_partial = bool(
+            upsert is not None and config.upsert is not None and config.upsert.mode.upper() == "PARTIAL"
+        )
         self.partition = partition
         self.schema = schema
         self.config = config
@@ -66,7 +73,11 @@ class PartitionConsumer:
         return f"{self.table}__{self.partition}__{self.sequence}"
 
     def _new_mutable(self) -> MutableSegment:
-        return MutableSegment(self._seg_name(), self.schema, self.config)
+        seg = MutableSegment(self._seg_name(), self.schema, self.config)
+        if self.upsert is not None:
+            seg.valid_provider = self.upsert.valid_provider(seg.name)
+            self.upsert.register_reader(seg.name, seg.get_row)
+        return seg
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -95,7 +106,29 @@ class PartitionConsumer:
         budget = max(0, self.max_rows - self._mutable.n_docs)
         msgs, next_off = self.consumer.fetch_messages(self.offset, min(self.batch_size, budget))
         for m in msgs:
-            self._mutable.index(m.value)
+            row = m.value
+            if self.dedup is not None and not self.dedup.check_and_add(row):
+                continue  # duplicate PK: dropped at ingestion
+            if self.upsert is not None:
+                if self.upsert_partial:
+                    prev = self.upsert.previous_row(row)
+                    if prev is not None:
+                        from pinot_tpu.upsert import merge_partial
+
+                        cfg = self.config.upsert
+                        row = merge_partial(
+                            prev,
+                            dict(row),
+                            self.upsert.pk_columns,
+                            self.upsert.comparison_column,
+                            cfg.partial_strategies,
+                            cfg.default_partial_strategy,
+                        )
+                doc_id = self._mutable.n_docs
+                self._mutable.index(row)
+                self.upsert.add_row(self._mutable.name, doc_id, dict(row))
+            else:
+                self._mutable.index(row)
         with self._lock:
             self.offset = next_off
         return len(msgs)
@@ -149,12 +182,38 @@ class RealtimeTableManager:
         self.schema = schema
         self.config = config
         self.table = config.table_name
+        if config.upsert is not None and config.dedup is not None and config.dedup.enabled:
+            # Pinot rejects this combination at table-config validation:
+            # dedup would drop every PK-repeated row before upsert sees it
+            raise ValueError("a table cannot enable both upsert and dedup")
         self.stream = stream
         self.max_rows = max_rows_per_segment
         self.consumers: list[PartitionConsumer] = []
+        self.upsert_managers: dict[int, object] = {}
+        self.dedup_managers: dict[int, object] = {}
         server.attach_realtime(self.table, self)
         for p in range(stream.partition_count()):
+            upsert = dedup = None
+            if config.upsert is not None:
+                from pinot_tpu.upsert import PartitionUpsertMetadataManager
+
+                upsert = PartitionUpsertMetadataManager(
+                    schema.primary_key_columns,
+                    comparison_column=config.upsert.comparison_column or config.time_column,
+                    delete_column=config.upsert.delete_record_column,
+                )
+                self.upsert_managers[p] = upsert
+            if config.dedup is not None and config.dedup.enabled:
+                from pinot_tpu.upsert import PartitionDedupMetadataManager
+
+                dedup = PartitionDedupMetadataManager(
+                    schema.primary_key_columns,
+                    metadata_ttl=config.dedup.metadata_ttl,
+                    time_column=config.dedup.dedup_time_column or config.time_column,
+                )
+                self.dedup_managers[p] = dedup
             start_offset, start_seq = self._recover(p)
+            self._bootstrap_upsert(p, upsert)
             self.consumers.append(
                 PartitionConsumer(
                     self.table,
@@ -167,6 +226,8 @@ class RealtimeTableManager:
                     start_offset=start_offset,
                     start_sequence=start_seq,
                     max_rows_per_segment=max_rows_per_segment,
+                    upsert=upsert,
+                    dedup=dedup,
                 )
             )
 
@@ -193,8 +254,59 @@ class RealtimeTableManager:
                     best_seq = int(parts[2]) + 1
         return best_end, best_seq
 
+    def _bootstrap_upsert(self, partition: int, upsert) -> None:
+        """On restart, replay committed segments of this partition into the
+        upsert metadata (addSegment replay in docId order; SURVEY §5.4)."""
+        if upsert is None:
+            return
+        metas = []
+        for name, meta in self.controller.all_segment_metadata(self.table).items():
+            parts = name.rsplit("__", 2)
+            if len(parts) == 3 and parts[0] == self.table and int(parts[1]) == partition:
+                metas.append((int(parts[2]), name))
+        for _, name in sorted(metas):
+            seg = self.server.get_segment_object(self.table, name)
+            if seg is not None:
+                upsert.add_segment(seg)
+                self._attach_upsert(seg, upsert)
+
+    def _partition_of(self, segment_name: str) -> int | None:
+        parts = segment_name.rsplit("__", 2)
+        if len(parts) == 3 and parts[0] == self.table:
+            try:
+                return int(parts[1])
+            except ValueError:
+                return None
+        return None
+
+    def on_segment_loaded(self, seg: ImmutableSegment) -> None:
+        """Server hook, called under the server lock BEFORE the loaded segment
+        becomes queryable: attach the live validity mask (and, for PARTIAL
+        mode, a lazy row reader) under the segment's unchanged LLC name."""
+        p = self._partition_of(seg.name)
+        if p is None:
+            return
+        upsert = self.upsert_managers.get(p)
+        if upsert is None:
+            return
+        self._attach_upsert(seg, upsert)
+
+    def _attach_upsert(self, seg: ImmutableSegment, upsert) -> None:
+        seg.extras["valid_docs"] = upsert.valid_provider(seg.name)
+        if self.config.upsert is not None and self.config.upsert.mode.upper() == "PARTIAL":
+            # lazy per-doc reader: only PARTIAL merges ever read previous rows
+            import numpy as np
+
+            def reader(doc_id: int, _s=seg) -> dict:
+                idx = np.asarray([doc_id])
+                return {c: ci.materialize(idx)[0] for c, ci in _s.columns.items()}
+
+            upsert.register_reader(seg.name, reader)
+
     def _make_commit(self, partition: int):
         def commit(segment: ImmutableSegment, start_off: int, end_off: int) -> None:
+            # upload triggers Server.add_segment, whose on_segment_loaded hook
+            # attaches the validity mask before the copy becomes queryable
             self.controller.upload_segment(self.table, segment)
             meta = self.controller.segment_metadata(self.table, segment.name) or {}
             meta["startOffset"] = start_off

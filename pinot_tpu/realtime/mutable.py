@@ -60,6 +60,8 @@ class MutableSegment:
                 self._cols[col] = _GrowBuf(dt.np_dtype)
         self._snapshot: ImmutableSegment | None = None
         self._snapshot_docs = -1
+        # upsert integration: fn(n_docs) -> bool mask attached to snapshots
+        self.valid_provider = None
 
     @property
     def n_docs(self) -> int:
@@ -82,6 +84,17 @@ class MutableSegment:
                 else:
                     self._cols[col].append(v)
 
+    def get_row(self, doc_id: int) -> dict:
+        """Read back one indexed row (partial-upsert merges need the previous
+        full row; MutableSegmentImpl exposes the same via its readers)."""
+        with self._lock:
+            row = {}
+            for col, buf in self._cols.items():
+                row[col] = buf.view()[doc_id].item()
+            for col, lst in self._obj_cols.items():
+                row[col] = lst[doc_id]
+            return row
+
     def snapshot(self) -> ImmutableSegment:
         """Engine-compatible immutable view at the current doc watermark.
         Cached until more rows arrive."""
@@ -95,6 +108,8 @@ class MutableSegment:
             for col, lst in self._obj_cols.items():
                 data[col] = np.asarray(list(lst), dtype=object)
             snap = SegmentBuilder(self.schema, self.config).build(data, self.name)
+            if self.valid_provider is not None:
+                snap.extras["valid_docs"] = self.valid_provider
             self._snapshot = snap
             self._snapshot_docs = n
             return snap

@@ -1,0 +1,25 @@
+"""Upsert & dedup: primary-key -> latest-doc tracking with valid-doc masks.
+
+Reference parity: pinot-segment-local/.../upsert/
+ConcurrentMapPartitionUpsertMetadataManager + PartialUpsertHandler, and
+pinot-segment-local/.../dedup/ConcurrentMapPartitionDedupMetadataManager.
+
+TPU-first design note: Pinot tracks validDocIds as ThreadSafeMutableRoaring-
+Bitmaps; here they are dense boolean masks — the same representation the
+filter kernels consume — so upsert visibility is one elementwise AND fused
+into the per-segment filter mask (no bitmap decode on the hot path).
+"""
+
+from pinot_tpu.upsert.metadata import (
+    PartitionDedupMetadataManager,
+    PartitionUpsertMetadataManager,
+    RecordLocation,
+)
+from pinot_tpu.upsert.partial import merge_partial
+
+__all__ = [
+    "PartitionDedupMetadataManager",
+    "PartitionUpsertMetadataManager",
+    "RecordLocation",
+    "merge_partial",
+]
