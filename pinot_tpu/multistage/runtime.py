@@ -218,7 +218,21 @@ def _hash_partition(keydf: pd.DataFrame, n: int) -> np.ndarray:
 # ---------------------------------------------------------------------------
 
 
-def _agg_series(func: str, g, vals_col: str, extra: tuple):
+def _agg_series(func: str, g, vals_col: str, extra: tuple, vals2_col: str | None = None):
+    from pinot_tpu.query.aggregates import EXT_AGGS
+
+    if func in EXT_AGGS:
+        spec = EXT_AGGS[func]
+        if vals2_col is not None:
+            return g.apply(
+                lambda sub: spec.finalize(
+                    spec.compute(sub[vals_col].to_numpy(), sub[vals2_col].to_numpy(), extra), extra
+                ),
+                include_groups=False,
+            )
+        return g[vals_col].apply(
+            lambda s: spec.finalize(spec.compute(s.to_numpy(), None, extra), extra)
+        )
     if func == "count":
         return g.size() if vals_col is None else g[vals_col].size()
     sel = g[vals_col]
@@ -241,7 +255,19 @@ def _agg_series(func: str, g, vals_col: str, extra: tuple):
     raise L.PlanV2Error(f"unsupported aggregation {func} in multistage runtime")
 
 
-def _agg_scalar(func: str, s: pd.Series, extra: tuple):
+def _agg_scalar(func: str, s: pd.Series, extra: tuple, s2: pd.Series | None = None):
+    from pinot_tpu.query.aggregates import EXT_AGGS
+
+    if func in EXT_AGGS:
+        spec = EXT_AGGS[func]
+        return spec.finalize(
+            spec.compute(
+                s.to_numpy() if s is not None else None,
+                s2.to_numpy() if s2 is not None else None,
+                extra,
+            ),
+            extra,
+        )
     if func == "count":
         return len(s)
     if len(s) == 0:
@@ -402,7 +428,8 @@ def _exec_aggregate(node: L.Aggregate, ctx: RunCtx) -> pd.DataFrame:
         row = []
         for a in node.aggs:
             s = eval_expr(a.arg, infields, df) if a.arg is not None else pd.Series(np.zeros(len(df)))
-            row.append(_agg_scalar(a.func, s, a.extra))
+            s2 = eval_expr(a.arg2, infields, df) if a.arg2 is not None else None
+            row.append(_agg_scalar(a.func, s, a.extra, s2))
         return pd.DataFrame({i: [v] for i, v in enumerate(row)})
     if df.empty:
         return _empty_df(len(node.fields))
@@ -412,12 +439,15 @@ def _exec_aggregate(node: L.Aggregate, ctx: RunCtx) -> pd.DataFrame:
     for j, a in enumerate(node.aggs):
         if a.arg is not None:
             work[f"v{j}"] = eval_expr(a.arg, infields, df).reset_index(drop=True)
+        if a.arg2 is not None:
+            work[f"w{j}"] = eval_expr(a.arg2, infields, df).reset_index(drop=True)
     wdf = pd.DataFrame(work)
     gb = wdf.groupby([f"g{i}" for i in range(n_groups)], dropna=False, sort=False)
     outs = []
     for j, a in enumerate(node.aggs):
         col = f"v{j}" if a.arg is not None else None
-        outs.append(_agg_series(a.func, gb, col, a.extra).rename(f"a{j}"))
+        col2 = f"w{j}" if a.arg2 is not None else None
+        outs.append(_agg_series(a.func, gb, col, a.extra, col2).rename(f"a{j}"))
     if outs:
         res = pd.concat(outs, axis=1).reset_index()
     else:

@@ -1,0 +1,383 @@
+"""Extended aggregation function registry.
+
+Reference parity: the long tail of pinot-core/.../query/aggregation/function/
+(94 AggregationFunction classes). Each entry defines the mergeable-partial
+contract the engine's three execution sites share (per-segment scalar
+aggregation, per-segment group-by frames, broker reduce):
+
+    compute(values, values2, extra) -> partial     # over one segment's rows
+    merge(a, b) -> partial                          # associative+commutative
+    finalize(partial, extra) -> result value
+    empty(extra) -> partial                         # zero-row identity
+
+Partials are single objects (scalars, tuples, ndarrays, sets), stored in one
+group-by frame column — mergeable across segments, servers, and devices.
+
+Functions covered (reference class in parens):
+  variance/stddev (VarianceAggregationFunction — Welford-merge via power sums),
+  covar_pop/covar_samp (CovarianceAggregationFunction), skewness/kurtosis
+  (FourthMomentAggregationFunction), firstwithtime/lastwithtime
+  (FirstWithTimeAggregationFunction:40), distinctsum/distinctavg
+  (DistinctSumAggregationFunction), bool_and/bool_or
+  (BoolAndAggregationFunction), histogram (HistogramAggregationFunction),
+  percentilekll (PercentileKLLAggregationFunction — exact-values stand-in),
+  distinctcounttheta (DistinctCountThetaSketchAggregationFunction — KMV
+  bottom-k sketch), distinctcounthllplus/cpc/ull (HLL-register stand-ins),
+  segmentpartitioneddistinctcount
+  (SegmentPartitionedDistinctCountAggregationFunction).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Callable
+
+import numpy as np
+
+from pinot_tpu.query.sketches import hash_any, murmur_mix32, np_hll_registers, hll_estimate
+
+THETA_K = 4096  # KMV bottom-k size (Pinot theta default nominal entries)
+
+
+@dataclass(frozen=True)
+class AggSpec:
+    n_args: int  # number of value-expression arguments (1 or 2)
+    compute: Callable[[np.ndarray | None, np.ndarray | None, tuple], Any]
+    merge: Callable[[Any, Any], Any]
+    finalize: Callable[[Any, tuple], Any]
+    empty: Callable[[tuple], Any]
+
+
+def _f64(v) -> np.ndarray:
+    return np.asarray(v, dtype=np.float64)
+
+
+# -- moments: variance / stddev / skewness / kurtosis ------------------------
+# partial = central moments (n, mean, M2[, M3[, M4]]) merged with Chan's
+# parallel algorithm — numerically stable for data with large mean/spread
+# ratios (epoch millis, big IDs), matching Pinot's VarianceAggregationFunction
+# merge-by-moments approach.
+
+
+def _moments_compute(order: int):
+    def compute(v, _v2, _extra):
+        x = _f64(v)
+        n = len(x)
+        if n == 0:
+            return (0.0,) * (order + 1)
+        mean = float(x.mean())
+        d = x - mean
+        parts = [float(n), mean, float(np.sum(d * d))]
+        if order >= 3:
+            parts.append(float(np.sum(d**3)))
+        if order >= 4:
+            parts.append(float(np.sum(d**4)))
+        return tuple(parts)
+
+    return compute
+
+
+def _moments_merge(a, b):
+    na = a[0]
+    nb = b[0]
+    if na == 0:
+        return b
+    if nb == 0:
+        return a
+    n = na + nb
+    d = b[1] - a[1]
+    mean = a[1] + d * nb / n
+    m2 = a[2] + b[2] + d * d * na * nb / n
+    out = [n, mean, m2]
+    if len(a) >= 4:
+        m3 = (
+            a[3]
+            + b[3]
+            + d**3 * na * nb * (na - nb) / (n * n)
+            + 3 * d * (na * b[2] - nb * a[2]) / n
+        )
+        out.append(m3)
+    if len(a) >= 5:
+        m4 = (
+            a[4]
+            + b[4]
+            + d**4 * na * nb * (na * na - na * nb + nb * nb) / n**3
+            + 6 * d * d * (na * na * b[2] + nb * nb * a[2]) / (n * n)
+            + 4 * d * (na * b[3] - nb * a[3]) / n
+        )
+        out.append(m4)
+    return tuple(out)
+
+
+def _var_finalize(sample: bool):
+    def fin(p, _extra):
+        n, _mean, m2 = p[0], p[1], p[2]
+        if n < (2.0 if sample else 1.0):
+            return float("nan") if n == 0 or sample else 0.0
+        return m2 / (n - 1) if sample else m2 / n
+
+    return fin
+
+
+def _std_finalize(sample: bool):
+    vf = _var_finalize(sample)
+
+    def fin(p, extra):
+        v = vf(p, extra)
+        return float(np.sqrt(v)) if v == v and v >= 0 else float("nan")
+
+    return fin
+
+
+def _skew_finalize(p, _extra):
+    n, _mean, m2s, m3s = p
+    if n < 1:
+        return float("nan")
+    m2 = m2s / n
+    m3 = m3s / n
+    return float(m3 / m2**1.5) if m2 > 0 else float("nan")
+
+
+def _kurt_finalize(p, _extra):
+    n, _mean, m2s, _m3s, m4s = p
+    if n < 1:
+        return float("nan")
+    m2 = m2s / n
+    m4 = m4s / n
+    return float(m4 / (m2 * m2)) if m2 > 0 else float("nan")
+
+
+# -- covariance --------------------------------------------------------------
+# partial = (n, mean_x, mean_y, C) with C = sum((x-mx)(y-my)); Chan-style merge
+
+
+def _covar_compute(v, v2, _extra):
+    x, y = _f64(v), _f64(v2)
+    n = len(x)
+    if n == 0:
+        return (0.0, 0.0, 0.0, 0.0)
+    mx, my = float(x.mean()), float(y.mean())
+    return (float(n), mx, my, float(np.sum((x - mx) * (y - my))))
+
+
+def _covar_merge(a, b):
+    na, nb = a[0], b[0]
+    if na == 0:
+        return b
+    if nb == 0:
+        return a
+    n = na + nb
+    dx = b[1] - a[1]
+    dy = b[2] - a[2]
+    return (
+        n,
+        a[1] + dx * nb / n,
+        a[2] + dy * nb / n,
+        a[3] + b[3] + dx * dy * na * nb / n,
+    )
+
+
+def _covar_finalize(sample: bool):
+    def fin(p, _extra):
+        n, _mx, _my, c = p
+        if n < (2.0 if sample else 1.0):
+            return float("nan")
+        return c / (n - 1) if sample else c / n
+
+    return fin
+
+
+# -- first/last with time ----------------------------------------------------
+# partial = (value, time) or None
+
+
+def _fwt_compute(pick_last: bool):
+    def compute(v, times, _extra):
+        t = _f64(times)
+        if len(t) == 0:
+            return None
+        i = int(np.argmax(t)) if pick_last else int(np.argmin(t))
+        val = v[i]
+        return (val.item() if hasattr(val, "item") else val, float(t[i]))
+
+    return compute
+
+
+def _fwt_merge(pick_last: bool):
+    def merge(a, b):
+        if a is None:
+            return b
+        if b is None:
+            return a
+        if pick_last:
+            return a if a[1] >= b[1] else b
+        return a if a[1] <= b[1] else b
+
+    return merge
+
+
+def _fwt_finalize(p, _extra):
+    return p[0] if p is not None else None
+
+
+# -- distinct sum / avg ------------------------------------------------------
+
+
+def _set_compute(v, _v2, _extra):
+    return set(np.asarray(v).tolist())
+
+
+def _distinctsum_finalize(p, _extra):
+    return float(sum(p)) if p else 0.0
+
+
+def _distinctavg_finalize(p, _extra):
+    return float(sum(p)) / len(p) if p else float("nan")
+
+
+# -- booleans ----------------------------------------------------------------
+
+
+def _bool_compute(all_mode: bool):
+    def compute(v, _v2, _extra):
+        x = np.asarray(v).astype(bool)
+        if len(x) == 0:
+            return None
+        return bool(x.all()) if all_mode else bool(x.any())
+
+    return compute
+
+
+def _bool_merge(all_mode: bool):
+    def merge(a, b):
+        if a is None:
+            return b
+        if b is None:
+            return a
+        return (a and b) if all_mode else (a or b)
+
+    return merge
+
+
+# -- histogram ---------------------------------------------------------------
+# extra = (lo, hi, n_bins); partial = int64 counts vector; result = list
+
+
+def _hist_compute(v, _v2, extra):
+    lo, hi, bins = float(extra[0]), float(extra[1]), int(extra[2])
+    x = _f64(v)
+    if hi <= lo:
+        c = np.zeros(bins, dtype=np.int64)
+        c[0] = len(x)
+        return c
+    b = np.clip(((x - lo) * (bins / (hi - lo))).astype(np.int64), 0, bins - 1)
+    return np.bincount(b, minlength=bins).astype(np.int64)
+
+
+# -- theta sketch (KMV bottom-k) ---------------------------------------------
+# partial = sorted uint64 array of the k smallest hashes
+
+
+def _hash64(values: np.ndarray) -> np.ndarray:
+    h1 = hash_any(values)
+    h2 = murmur_mix32(h1 ^ np.uint32(0x9E3779B9))
+    return (h1.astype(np.uint64) << np.uint64(32)) | h2.astype(np.uint64)
+
+
+def _theta_compute(v, _v2, _extra):
+    h = np.unique(_hash64(np.asarray(v)))
+    return h[:THETA_K]
+
+
+def _theta_merge(a, b):
+    u = np.union1d(a, b)
+    return u[:THETA_K]
+
+
+def _theta_finalize(p, _extra):
+    k = len(p)
+    if k < THETA_K:
+        return k  # exact below sketch capacity
+    theta = float(p[-1]) / float(2**64)
+    return int(round((k - 1) / theta))
+
+
+# -- HLL-family stand-ins ----------------------------------------------------
+
+
+def _hll_compute(v, _v2, _extra):
+    return np_hll_registers(np.asarray(v))
+
+
+def _hll_finalize(p, _extra):
+    return hll_estimate(np.asarray(p))
+
+
+# -- segment-partitioned distinct count --------------------------------------
+# partial = per-segment distinct count (int); merge = sum (assumes values are
+# partitioned by segment, the function's documented contract)
+
+
+def _spdc_compute(v, _v2, _extra):
+    return int(len(np.unique(np.asarray(v))))
+
+
+# ---------------------------------------------------------------------------
+
+EXT_AGGS: dict[str, AggSpec] = {
+    "variance": AggSpec(1, _moments_compute(2), _moments_merge, _var_finalize(False), lambda e: (0.0, 0.0, 0.0)),
+    "var_pop": AggSpec(1, _moments_compute(2), _moments_merge, _var_finalize(False), lambda e: (0.0, 0.0, 0.0)),
+    "var_samp": AggSpec(1, _moments_compute(2), _moments_merge, _var_finalize(True), lambda e: (0.0, 0.0, 0.0)),
+    "stddev_pop": AggSpec(1, _moments_compute(2), _moments_merge, _std_finalize(False), lambda e: (0.0, 0.0, 0.0)),
+    "stddev_samp": AggSpec(1, _moments_compute(2), _moments_merge, _std_finalize(True), lambda e: (0.0, 0.0, 0.0)),
+    "skewness": AggSpec(
+        1, _moments_compute(3), _moments_merge, _skew_finalize, lambda e: (0.0, 0.0, 0.0, 0.0)
+    ),
+    "kurtosis": AggSpec(
+        1, _moments_compute(4), _moments_merge, _kurt_finalize, lambda e: (0.0, 0.0, 0.0, 0.0, 0.0)
+    ),
+    "covar_pop": AggSpec(2, _covar_compute, _covar_merge, _covar_finalize(False), lambda e: (0.0,) * 4),
+    "covar_samp": AggSpec(2, _covar_compute, _covar_merge, _covar_finalize(True), lambda e: (0.0,) * 4),
+    "firstwithtime": AggSpec(2, _fwt_compute(False), _fwt_merge(False), _fwt_finalize, lambda e: None),
+    "lastwithtime": AggSpec(2, _fwt_compute(True), _fwt_merge(True), _fwt_finalize, lambda e: None),
+    "distinctsum": AggSpec(1, _set_compute, lambda a, b: a | b, _distinctsum_finalize, lambda e: set()),
+    "distinctavg": AggSpec(1, _set_compute, lambda a, b: a | b, _distinctavg_finalize, lambda e: set()),
+    "bool_and": AggSpec(1, _bool_compute(True), _bool_merge(True), lambda p, e: p, lambda e: None),
+    "bool_or": AggSpec(1, _bool_compute(False), _bool_merge(False), lambda p, e: p, lambda e: None),
+    "histogram": AggSpec(
+        1,
+        _hist_compute,
+        lambda a, b: a + b,
+        lambda p, e: [int(x) for x in p],
+        lambda e: np.zeros(int(e[2]), dtype=np.int64),
+    ),
+    "percentilekll": AggSpec(
+        1,
+        lambda v, _v2, e: _f64(v),
+        lambda a, b: np.concatenate([a, b]),
+        lambda p, e: _kll_percentile(p, e),
+        lambda e: np.zeros(0),
+    ),
+    "distinctcounttheta": AggSpec(1, _theta_compute, _theta_merge, _theta_finalize, lambda e: np.zeros(0, np.uint64)),
+    "distinctcounthllplus": AggSpec(1, _hll_compute, lambda a, b: np.maximum(a, b), _hll_finalize, lambda e: np_hll_registers(np.zeros(0))),
+    "distinctcountcpc": AggSpec(1, _hll_compute, lambda a, b: np.maximum(a, b), _hll_finalize, lambda e: np_hll_registers(np.zeros(0))),
+    "distinctcountull": AggSpec(1, _hll_compute, lambda a, b: np.maximum(a, b), _hll_finalize, lambda e: np_hll_registers(np.zeros(0))),
+    "segmentpartitioneddistinctcount": AggSpec(1, _spdc_compute, lambda a, b: a + b, lambda p, e: int(p), lambda e: 0),
+}
+
+
+def exact_percentile(values: np.ndarray, pct: float) -> float:
+    """Pinot PercentileAggregationFunction: value at (int)((len-1)*pct/100).
+    Shared by PERCENTILE/PERCENTILETDIGEST (reduce.py) and PERCENTILEKLL."""
+    if len(values) == 0:
+        return float("-inf")
+    v = np.sort(np.asarray(values, dtype=np.float64))
+    return float(v[int((len(v) - 1) * pct / 100.0)])
+
+
+def _kll_percentile(values: np.ndarray, extra: tuple) -> float:
+    return exact_percentile(values, extra[0])
+
+
+# funcs whose second SQL argument is a value expression (not a literal extra)
+TWO_ARG_AGGS = {f for f, s in EXT_AGGS.items() if s.n_args == 2}

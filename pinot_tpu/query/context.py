@@ -36,8 +36,9 @@ from pinot_tpu.query.sql import parse_sql
 
 DEFAULT_LIMIT = 10  # Pinot's default broker LIMIT
 
-# Aggregation functions the engine recognizes (subset of the 94 in
-# pinot-core/.../query/aggregation/function/; grows each round).
+# Aggregation functions the engine recognizes (the core set plus the
+# extended registry in aggregates.py; reference: the 94 classes in
+# pinot-core/.../query/aggregation/function/).
 AGG_FUNCS = {
     "count",
     "sum",
@@ -52,6 +53,29 @@ AGG_FUNCS = {
     "percentileest",
     "percentiletdigest",
     "mode",
+    # extended registry (query/aggregates.py)
+    "variance",
+    "var_pop",
+    "var_samp",
+    "stddev_pop",
+    "stddev_samp",
+    "skewness",
+    "kurtosis",
+    "covar_pop",
+    "covar_samp",
+    "firstwithtime",
+    "lastwithtime",
+    "distinctsum",
+    "distinctavg",
+    "bool_and",
+    "bool_or",
+    "histogram",
+    "percentilekll",
+    "distinctcounttheta",
+    "distinctcounthllplus",
+    "distinctcountcpc",
+    "distinctcountull",
+    "segmentpartitioneddistinctcount",
 }
 
 
@@ -85,6 +109,7 @@ class AggregationInfo:
     arg: Expr | None  # None for count(*)
     name: str  # canonical output name
     extra: tuple = ()  # literal args beyond the column (e.g. percentile rank)
+    arg2: Expr | None = None  # second value expression (covar, firstwithtime)
 
     def __str__(self) -> str:
         return self.name
@@ -97,7 +122,10 @@ def _extract_aggs(expr: Expr, out: dict[str, AggregationInfo]) -> bool:
     if isinstance(expr, FunctionCall):
         fname = expr.name
         if fname in AGG_FUNCS or (fname == "count" and expr.distinct):
+            from pinot_tpu.query.aggregates import TWO_ARG_AGGS
+
             extra: tuple = ()
+            arg2: Expr | None = None
             if fname == "count" and expr.distinct:
                 # COUNT(DISTINCT x) is DISTINCTCOUNT(x) (Pinot rewrites the same)
                 func, arg = "distinctcount", expr.args[0]
@@ -106,11 +134,21 @@ def _extract_aggs(expr: Expr, out: dict[str, AggregationInfo]) -> bool:
                 func, arg, name = "count", None, canonical(expr)
             else:
                 func, arg, name = fname, (expr.args[0] if expr.args else None), canonical(expr)
-                if fname in ("percentile", "percentileest", "percentiletdigest"):
+                if fname in ("percentile", "percentileest", "percentiletdigest", "percentilekll"):
                     if len(expr.args) != 2 or not isinstance(expr.args[1], Literal):
                         raise ValueError(f"{fname} requires (column, percentile) arguments")
                     extra = (float(expr.args[1].value),)
-            out.setdefault(name, AggregationInfo(func, arg, name, extra))
+                elif fname == "histogram":
+                    if len(expr.args) != 4 or not all(isinstance(a, Literal) for a in expr.args[1:]):
+                        raise ValueError("histogram requires (column, lo, hi, numBins) arguments")
+                    extra = tuple(float(a.value) for a in expr.args[1:])
+                elif fname in TWO_ARG_AGGS:
+                    if len(expr.args) < 2:
+                        raise ValueError(f"{fname} requires two column arguments")
+                    arg2 = expr.args[1]
+                    # trailing literal args (e.g. firstwithtime dataType) -> extra
+                    extra = tuple(a.value for a in expr.args[2:] if isinstance(a, Literal))
+            out.setdefault(name, AggregationInfo(func, arg, name, extra, arg2))
             return True
         # transform function: recurse into args
         found = False

@@ -152,10 +152,18 @@ def filter_mask(seg: ImmutableSegment, f: ast.FilterExpr | None) -> np.ndarray:
 
 
 def agg_partials(seg: ImmutableSegment, ctx: QueryContext, mask: np.ndarray) -> list:
+    from pinot_tpu.query.aggregates import EXT_AGGS
+
     out = []
     for a in ctx.aggregations:
         if a.func == "count":
             out.append(int(mask.sum()))
+            continue
+        if a.func in EXT_AGGS:
+            spec = EXT_AGGS[a.func]
+            v = eval_value(seg, a.arg)[mask] if a.arg is not None else None
+            v2 = eval_value(seg, a.arg2)[mask] if a.arg2 is not None else None
+            out.append(spec.compute(v, v2, a.extra))
             continue
         if a.func in ("distinctcount", "distinctcountbitmap"):
             v = eval_value(seg, a.arg)[mask]
@@ -211,6 +219,8 @@ def agg_partials(seg: ImmutableSegment, ctx: QueryContext, mask: np.ndarray) -> 
 
 
 def group_frame(seg: ImmutableSegment, ctx: QueryContext, mask: np.ndarray) -> pd.DataFrame:
+    from pinot_tpu.query.aggregates import EXT_AGGS
+
     data = {}
     for i, g in enumerate(ctx.group_by):
         v = eval_value(seg, g)[mask]
@@ -220,6 +230,8 @@ def group_frame(seg: ImmutableSegment, ctx: QueryContext, mask: np.ndarray) -> p
             continue
         v = eval_value(seg, a.arg)[mask]
         data[f"v{i}"] = v
+        if a.arg2 is not None:
+            data[f"w{i}"] = eval_value(seg, a.arg2)[mask]
     df = pd.DataFrame(data)
     if len(df) == 0:
         cols = {f"k{i}": [] for i in range(len(ctx.group_by))}
@@ -256,6 +268,20 @@ def group_frame(seg: ImmutableSegment, ctx: QueryContext, mask: np.ndarray) -> p
                 return {float(k): int(c) for k, c in zip(vals, counts)}
 
             out[f"a{i}p0"] = g[f"v{i}"].apply(_counter).values
+        elif a.func in EXT_AGGS:
+            spec = EXT_AGGS[a.func]
+            if a.arg2 is not None:
+                parts = g.apply(
+                    lambda sub, _i=i, _s=spec, _a=a: _s.compute(
+                        sub[f"v{_i}"].to_numpy(), sub[f"w{_i}"].to_numpy(), _a.extra
+                    ),
+                    include_groups=False,
+                )
+            else:
+                parts = g[f"v{i}"].apply(
+                    lambda s, _s=spec, _a=a: _s.compute(s.to_numpy(), None, _a.extra)
+                )
+            out[f"a{i}p0"] = parts.values
         else:
             raise PlanError(f"unsupported aggregation in host executor: {a.func}")
     return out.drop(columns=["__size"])

@@ -76,7 +76,7 @@ def empty_partial(ctx: QueryContext):
                 lo, hi = ctx.hints["est_bounds"][a.name]
                 out.append((np.zeros(EST_BINS, dtype=np.int64), lo, hi))
             else:
-                out.append(_empty_partial(a.func))
+                out.append(_empty_partial(a.func, a.extra))
         return out
     if qt in (QueryType.GROUP_BY,):
         cols: dict = {f"k{i}": [] for i in range(len(ctx.group_by))}

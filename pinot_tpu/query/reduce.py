@@ -118,6 +118,10 @@ def eval_having(f: ast.FilterExpr, env: dict[str, Any], aliases: dict[str, ast.E
 
 
 def _merge_agg_partials(func: str, a, b):
+    from pinot_tpu.query.aggregates import EXT_AGGS
+
+    if func in EXT_AGGS:
+        return EXT_AGGS[func].merge(a, b)
     if func in ("count", "sum"):
         return a + b
     if func == "min":
@@ -149,18 +153,20 @@ def _merge_agg_partials(func: str, a, b):
 
 
 def _exact_percentile(values: np.ndarray, pct: float) -> float:
-    if len(values) == 0:
-        return float("-inf")
-    v = np.sort(np.asarray(values, dtype=np.float64))
-    # Pinot PercentileAggregationFunction: value at (int)((len-1)*pct/100)
-    return float(v[int((len(v) - 1) * pct / 100.0)])
+    from pinot_tpu.query.aggregates import exact_percentile
+
+    return exact_percentile(values, pct)
 
 
 def _finalize(a, p):
     """Finalize a merged partial. `a` is the AggregationInfo."""
     from pinot_tpu.query.sketches import hist_estimate, hll_estimate
 
+    from pinot_tpu.query.aggregates import EXT_AGGS
+
     func = a.func
+    if func in EXT_AGGS:
+        return EXT_AGGS[func].finalize(p, a.extra)
     if func == "count":
         return int(p)
     if func in ("sum", "min", "max"):
@@ -202,7 +208,7 @@ def reduce_aggregation(ctx: QueryContext, partials: list[list]) -> list[list]:
             merged = [_merge_agg_partials(a.func, m, x) for a, m, x in zip(ctx.aggregations, merged, p)]
     env: dict[str, Any] = {}
     if merged is None:
-        merged = [_empty_partial(a.func) for a in ctx.aggregations]
+        merged = [_empty_partial(a.func, a.extra) for a in ctx.aggregations]
     for a, p in zip(ctx.aggregations, merged):
         env[a.name] = _finalize(a, p)
     aliases = _alias_map(ctx)
@@ -210,7 +216,11 @@ def reduce_aggregation(ctx: QueryContext, partials: list[list]) -> list[list]:
     return [row]
 
 
-def _empty_partial(func: str):
+def _empty_partial(func: str, extra: tuple = ()):
+    from pinot_tpu.query.aggregates import EXT_AGGS
+
+    if func in EXT_AGGS:
+        return EXT_AGGS[func].empty(extra)
     return {
         "count": 0,
         "sum": 0.0,
@@ -266,7 +276,13 @@ def reduce_group_by(ctx: QueryContext, frames: list[pd.DataFrame]) -> list[list]
         elif a.func == "mode":
             apply_map[f"a{i}p0"] = _merge_counters
         else:
-            raise AssertionError(a.func)
+            from functools import reduce as _reduce
+
+            from pinot_tpu.query.aggregates import EXT_AGGS
+
+            if a.func not in EXT_AGGS:
+                raise AssertionError(a.func)
+            apply_map[f"a{i}p0"] = lambda s, _m=EXT_AGGS[a.func].merge: _reduce(_m, s)
     if agg_map or apply_map:
         g = df.groupby(key_cols, sort=False, dropna=False)
         merged = g.agg(agg_map).reset_index() if agg_map else g.size().reset_index().drop(columns=[0])

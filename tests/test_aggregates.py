@@ -1,0 +1,197 @@
+"""Extended aggregation function tests (registry in query/aggregates.py),
+cross-checked against numpy/pandas oracles — including the cross-segment
+merge path (partials computed per segment, merged at reduce), the group-by
+path, and the multistage path."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from pinot_tpu.common import DataType, Schema
+from pinot_tpu.query import QueryEngine
+from pinot_tpu.segment import SegmentBuilder
+
+
+@pytest.fixture(scope="module")
+def setup():
+    schema = Schema.build(
+        "m",
+        dimensions=[("g", DataType.STRING), ("active", DataType.INT)],
+        metrics=[("x", DataType.DOUBLE), ("y", DataType.DOUBLE)],
+        date_times=[("ts", DataType.LONG)],
+    )
+    b = SegmentBuilder(schema)
+    rng = np.random.default_rng(3)
+    segs, frames = [], []
+    for i, n in enumerate([900, 1100, 700]):
+        data = {
+            "g": np.asarray(["a", "b", "c"], dtype=object)[rng.integers(0, 3, n)],
+            "active": rng.integers(0, 2, n).astype(np.int32),
+            "x": np.round(rng.normal(50, 12, n), 4),
+            "y": np.round(rng.normal(-3, 5, n), 4),
+            "ts": rng.integers(0, 1_000_000, n).astype(np.int64),
+        }
+        segs.append(b.build(data, f"m_{i}"))
+        frames.append(pd.DataFrame({k: (v.astype(str) if v.dtype == object else v) for k, v in data.items()}))
+    return QueryEngine(segs), pd.concat(frames, ignore_index=True)
+
+
+def one(engine, sql):
+    return engine.execute(sql).rows[0][0]
+
+
+def test_variance_stddev(setup):
+    engine, t = setup
+    assert one(engine, "SELECT VAR_POP(x) FROM m") == pytest.approx(t.x.var(ddof=0), rel=1e-9)
+    assert one(engine, "SELECT VAR_SAMP(x) FROM m") == pytest.approx(t.x.var(ddof=1), rel=1e-9)
+    assert one(engine, "SELECT VARIANCE(x) FROM m") == pytest.approx(t.x.var(ddof=0), rel=1e-9)
+    assert one(engine, "SELECT STDDEV_POP(x) FROM m") == pytest.approx(t.x.std(ddof=0), rel=1e-9)
+    assert one(engine, "SELECT STDDEV_SAMP(x) FROM m") == pytest.approx(t.x.std(ddof=1), rel=1e-9)
+
+
+def test_skew_kurtosis(setup):
+    engine, t = setup
+    assert one(engine, "SELECT SKEWNESS(x) FROM m") == pytest.approx(_skew(t.x), rel=1e-6)
+    assert one(engine, "SELECT KURTOSIS(x) FROM m") == pytest.approx(_kurt(t.x), rel=1e-6)
+
+
+def _skew(s):
+    x = s.to_numpy()
+    m = x.mean()
+    m2 = ((x - m) ** 2).mean()
+    m3 = ((x - m) ** 3).mean()
+    return m3 / m2**1.5
+
+
+def _kurt(s):
+    x = s.to_numpy()
+    m = x.mean()
+    m2 = ((x - m) ** 2).mean()
+    m4 = ((x - m) ** 4).mean()
+    return m4 / m2**2
+
+
+def test_covariance(setup):
+    engine, t = setup
+    assert one(engine, "SELECT COVAR_POP(x, y) FROM m") == pytest.approx(np.cov(t.x, t.y, ddof=0)[0, 1], rel=1e-8)
+    assert one(engine, "SELECT COVAR_SAMP(x, y) FROM m") == pytest.approx(np.cov(t.x, t.y, ddof=1)[0, 1], rel=1e-8)
+
+
+def test_first_last_with_time(setup):
+    engine, t = setup
+    first = t.loc[t.ts.idxmin(), "x"]
+    last = t.loc[t.ts.idxmax(), "x"]
+    assert one(engine, "SELECT FIRSTWITHTIME(x, ts, 'DOUBLE') FROM m") == pytest.approx(first)
+    assert one(engine, "SELECT LASTWITHTIME(x, ts, 'DOUBLE') FROM m") == pytest.approx(last)
+
+
+def test_distinct_sum_avg(setup):
+    engine, t = setup
+    du = t.x.unique()
+    assert one(engine, "SELECT DISTINCTSUM(x) FROM m") == pytest.approx(du.sum(), rel=1e-9)
+    assert one(engine, "SELECT DISTINCTAVG(x) FROM m") == pytest.approx(du.mean(), rel=1e-9)
+
+
+def test_bool_and_or(setup):
+    engine, t = setup
+    assert one(engine, "SELECT BOOL_AND(active) FROM m") == bool(t.active.all())
+    assert one(engine, "SELECT BOOL_OR(active) FROM m") == bool(t.active.any())
+
+
+def test_histogram(setup):
+    engine, t = setup
+    res = one(engine, "SELECT HISTOGRAM(x, 0, 100, 10) FROM m")
+    b = np.clip(((t.x.to_numpy() - 0) * (10 / 100)).astype(np.int64), 0, 9)
+    want = np.bincount(b, minlength=10).tolist()
+    assert res == want
+    assert sum(res) == len(t)
+
+
+def test_percentile_kll(setup):
+    engine, t = setup
+    got = one(engine, "SELECT PERCENTILEKLL(x, 90) FROM m")
+    v = np.sort(t.x.to_numpy())
+    assert got == pytest.approx(v[int((len(v) - 1) * 0.9)])
+
+
+def test_theta_and_hll_family(setup):
+    engine, t = setup
+    true_card = t.ts.nunique()
+    for fn in ("DISTINCTCOUNTTHETA", "DISTINCTCOUNTHLLPLUS", "DISTINCTCOUNTCPC", "DISTINCTCOUNTULL"):
+        got = one(engine, f"SELECT {fn}(ts) FROM m")
+        assert abs(got - true_card) / true_card < 0.1, (fn, got, true_card)
+
+
+def test_segment_partitioned_distinct_count(setup):
+    engine, t = setup
+    # sums per-segment distinct counts: >= global distinct (values span segments)
+    got = one(engine, "SELECT SEGMENTPARTITIONEDDISTINCTCOUNT(g) FROM m")
+    assert got == 9  # 3 values in each of 3 segments
+
+
+def test_grouped_ext_aggs(setup):
+    engine, t = setup
+    res = engine.execute(
+        "SELECT g, VAR_POP(x), COVAR_POP(x, y), LASTWITHTIME(y, ts, 'DOUBLE') "
+        "FROM m GROUP BY g ORDER BY g LIMIT 10"
+    )
+    for row in res.rows:
+        sub = t[t.g == row[0]]
+        assert row[1] == pytest.approx(sub.x.var(ddof=0), rel=1e-8)
+        assert row[2] == pytest.approx(np.cov(sub.x, sub.y, ddof=0)[0, 1], rel=1e-7)
+        assert row[3] == pytest.approx(sub.loc[sub.ts.idxmax(), "y"])
+
+
+def test_ext_aggs_with_filter_and_having(setup):
+    engine, t = setup
+    res = engine.execute(
+        "SELECT g, STDDEV_SAMP(x) FROM m WHERE active = 1 GROUP BY g "
+        "HAVING COUNT(*) > 10 ORDER BY g LIMIT 10"
+    )
+    sub = t[t.active == 1]
+    for row in res.rows:
+        gg = sub[sub.g == row[0]]
+        assert row[1] == pytest.approx(gg.x.std(ddof=1), rel=1e-8)
+
+
+def test_ext_aggs_multistage(setup):
+    engine, t = setup
+    from pinot_tpu.multistage import MultistageEngine
+
+    eng = MultistageEngine({"m": engine.segments}, n_workers=3)
+    res = eng.execute("SELECT g, VAR_POP(x) FROM m GROUP BY g ORDER BY g LIMIT 10")
+    for row in res.rows:
+        sub = t[t.g == row[0]]
+        assert row[1] == pytest.approx(sub.x.var(ddof=0), rel=1e-8)
+    res = eng.execute("SELECT COVAR_POP(x, y) FROM m t1")
+    assert res.rows[0][0] == pytest.approx(np.cov(t.x, t.y, ddof=0)[0, 1], rel=1e-7)
+
+
+def test_empty_result_ext_aggs(setup):
+    engine, t = setup
+    res = engine.execute("SELECT VAR_POP(x), BOOL_AND(active), DISTINCTSUM(x) FROM m WHERE g = 'zzz'")
+    row = res.rows[0]
+    assert row[0] is None or np.isnan(row[0])
+    assert row[1] is None
+    assert row[2] == 0.0
+
+
+def test_variance_large_mean_stability():
+    """Catastrophic-cancellation regression: N(1e9, 1) data must still give
+    variance ~1 (Chan-merge central moments, not raw power sums)."""
+    schema = Schema.build("big", dimensions=[("g", DataType.STRING)], metrics=[("x", DataType.DOUBLE)])
+    b = SegmentBuilder(schema)
+    rng = np.random.default_rng(11)
+    segs, alls = [], []
+    for i in range(2):
+        x = rng.normal(1e9, 1.0, 5000)
+        segs.append(b.build({"g": np.asarray(["a"] * 5000, dtype=object), "x": x}, f"big_{i}"))
+        alls.append(x)
+    allx = np.concatenate(alls)
+    eng = QueryEngine(segs)
+    got = eng.execute("SELECT VAR_POP(x) FROM big").rows[0][0]
+    assert got == pytest.approx(allx.var(ddof=0), rel=1e-6)
+    got = eng.execute("SELECT STDDEV_POP(x) FROM big").rows[0][0]
+    assert got == pytest.approx(allx.std(ddof=0), rel=1e-6)
+    got = eng.execute("SELECT g, VAR_SAMP(x) FROM big GROUP BY g").rows[0][1]
+    assert got == pytest.approx(allx.var(ddof=1), rel=1e-6)
