@@ -134,8 +134,24 @@ class SegmentBuilder:
         return write_segment(seg, out_dir)
 
 
-def write_segment(seg: ImmutableSegment, out_dir: str | Path) -> Path:
-    """Write segment to `<out_dir>/<segment_name>/{metadata.json, columns.npz}`."""
+def write_segment(seg: ImmutableSegment, out_dir: str | Path, fmt: str = "ptseg") -> Path:
+    """Write a segment under `<out_dir>/<segment_name>/`.
+
+    fmt="ptseg" (default): single-file V3-analog format with fixed-bit packed
+    dict ids + LZ4 chunks + per-entry CRC (segment/store.py).
+    fmt="npz": the v1 numpy archive layout (metadata.json + columns.npz).
+    """
+    if fmt == "ptseg":
+        from pinot_tpu.segment.store import write_segment_file
+
+        return write_segment_file(seg, Path(out_dir) / seg.name)
+    if fmt != "npz":
+        raise ValueError(f"unknown segment format {fmt!r}; expected 'ptseg' or 'npz'")
+    return _write_segment_npz(seg, out_dir)
+
+
+def _write_segment_npz(seg: ImmutableSegment, out_dir: str | Path) -> Path:
+    """v1 layout: `<out_dir>/<segment_name>/{metadata.json, columns.npz}`."""
     seg_dir = Path(out_dir) / seg.name
     seg_dir.mkdir(parents=True, exist_ok=True)
     arrays: dict[str, np.ndarray] = {}

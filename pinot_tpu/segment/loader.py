@@ -2,71 +2,85 @@
 
 Reference parity: ImmutableSegmentLoader + SegmentPreProcessor
 (pinot-segment-local/.../segment/index/loader/SegmentPreProcessor.java:59) and
-mmap via PinotDataBuffer. Redesigned: numpy-mmap the npz members, reconstruct
-dictionaries/stats from metadata, and stage to device with `to_device()` when
-the segment is assigned to a query-serving mesh.
+mmap via PinotDataBuffer. Redesigned: decode the single-file .ptseg (fixed-bit
+unpack + LZ4 via native C++ kernels) or numpy-load the legacy npz members,
+reconstruct dictionaries/stats from metadata, and stage to device with
+`to_device()` when the segment is assigned to a query-serving mesh.
 """
 
 from __future__ import annotations
 
 import json
 from pathlib import Path
+from typing import Callable
 
 import numpy as np
 
 from pinot_tpu.common.types import DataType, Schema
-from pinot_tpu.segment.builder import FORMAT_VERSION
 from pinot_tpu.segment.dictionary import Dictionary
 from pinot_tpu.segment.segment import ColumnIndex, ImmutableSegment
 from pinot_tpu.segment.stats import ColumnStats
+from pinot_tpu.segment.store import SEGMENT_FILE, SegmentFileReader
 
 
 def load_segment(seg_dir: str | Path) -> ImmutableSegment:
     seg_dir = Path(seg_dir)
+    if (seg_dir / SEGMENT_FILE).exists():
+        r = SegmentFileReader(seg_dir / SEGMENT_FILE)
+        return _reconstruct(r.meta, r.read, strings_decoded=True)
     meta = json.loads((seg_dir / "metadata.json").read_text())
     version = meta.get("formatVersion")
-    if version != FORMAT_VERSION:
-        raise ValueError(f"segment {seg_dir} has formatVersion {version}, expected {FORMAT_VERSION}")
+    if version != 1:
+        raise ValueError(f"segment {seg_dir} has formatVersion {version}, expected 1 (npz) or a {SEGMENT_FILE}")
+    with np.load(seg_dir / "columns.npz", allow_pickle=False) as npz:
+        cached = {k: npz[k] for k in npz.files}
+    return _reconstruct(meta, cached.__getitem__, strings_decoded=False)
+
+
+def _reconstruct(
+    meta: dict, read: Callable[[str], np.ndarray], strings_decoded: bool
+) -> ImmutableSegment:
     schema = Schema.from_json(json.dumps(meta["schema"]))
     seg = ImmutableSegment(name=meta["segmentName"], schema=schema, n_docs=meta["numDocs"])
-    with np.load(seg_dir / "columns.npz", allow_pickle=False) as npz:
-        for cm in meta["columns"]:
-            col = cm["name"]
-            stats = ColumnStats.from_dict(cm["stats"])
-            dt = DataType(cm["stats"]["dataType"])
-            fwd = npz[f"fwd::{col}"]
-            dictionary = None
-            if cm["encoding"] == "DICT":
-                dv = npz[f"dict::{col}"]
+    for cm in meta["columns"]:
+        col = cm["name"]
+        stats = ColumnStats.from_dict(cm["stats"])
+        dt = DataType(cm["stats"]["dataType"])
+        fwd = read(f"fwd::{col}")
+        dictionary = None
+        if cm["encoding"] == "DICT":
+            dv = read(f"dict::{col}")
+            if not strings_decoded:
+                # npz stores strings fixed-width and bytes hex-encoded
                 if dt == DataType.BYTES:
                     dv = np.asarray([bytes.fromhex(str(v)) for v in dv], dtype=object)
                 elif dt in (DataType.STRING, DataType.JSON):
                     dv = dv.astype(object)
-                dictionary = Dictionary(dt, dv)
-            seg.columns[col] = ColumnIndex(col, dt, dictionary, fwd, stats)
-        for i, sm in enumerate(meta.get("starTrees", [])):
-            from pinot_tpu.segment.startree import StarTable
+            dictionary = Dictionary(dt, dv)
+        seg.columns[col] = ColumnIndex(col, dt, dictionary, fwd, stats)
+    for i, sm in enumerate(meta.get("starTrees", [])):
+        from pinot_tpu.segment.startree import StarTable
 
-            names = ["__count", *sm["dimensions"], *sm["pairs"]]
-            st = StarTable(
-                dimensions=sm["dimensions"],
-                function_column_pairs=sm["pairs"],
-                n_rows=sm["nRows"],
-                arrays={k: npz[f"star{i}::{k}"] for k in names},
+        names = ["__count", *sm["dimensions"], *sm["pairs"]]
+        st = StarTable(
+            dimensions=sm["dimensions"],
+            function_column_pairs=sm["pairs"],
+            n_rows=sm["nRows"],
+            arrays={k: read(f"star{i}::{k}") for k in names},
+        )
+        seg.extras.setdefault("startree", []).append(st)
+    aux = meta.get("auxIndexes", {})
+    if aux:
+        from pinot_tpu.segment.indexes import BloomFilter, InvertedIndex, RangeIndex
+
+        for col, n_hashes in aux.get("bloom", {}).items():
+            seg.extras.setdefault("bloom", {})[col] = BloomFilter(read(f"bloom::{col}"), n_hashes)
+        for col in aux.get("inverted", []):
+            seg.extras.setdefault("inverted", {})[col] = InvertedIndex(
+                read(f"inv_off::{col}"), read(f"inv_doc::{col}")
             )
-            seg.extras.setdefault("startree", []).append(st)
-        aux = meta.get("auxIndexes", {})
-        if aux:
-            from pinot_tpu.segment.indexes import BloomFilter, InvertedIndex, RangeIndex
-
-            for col, n_hashes in aux.get("bloom", {}).items():
-                seg.extras.setdefault("bloom", {})[col] = BloomFilter(npz[f"bloom::{col}"], n_hashes)
-            for col in aux.get("inverted", []):
-                seg.extras.setdefault("inverted", {})[col] = InvertedIndex(
-                    npz[f"inv_off::{col}"], npz[f"inv_doc::{col}"]
-                )
-            for col in aux.get("range", []):
-                seg.extras.setdefault("range", {})[col] = RangeIndex(
-                    npz[f"range_doc::{col}"], npz[f"range_val::{col}"]
-                )
+        for col in aux.get("range", []):
+            seg.extras.setdefault("range", {})[col] = RangeIndex(
+                read(f"range_doc::{col}"), read(f"range_val::{col}")
+            )
     return seg

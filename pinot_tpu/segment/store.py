@@ -1,0 +1,210 @@
+"""Single-file segment store: all column/index data in one `segment.ptseg`.
+
+Reference parity: Pinot V3 segment format — one `columns.psf` with an index
+map of (column, indexType) -> (offset, size) entries plus
+`metadata.properties` (SegmentDirectory / SingleFileIndexDirectory.java:88).
+Here: one file holding back-to-back encoded entries, a JSON index map at the
+tail, and a fixed footer. Per-entry CRC32 gives integrity; dict-id forward
+indexes are fixed-bit packed and chunks are LZ4-compressed via the native C++
+kernels (pinot_tpu/native) exactly where the reference leans on
+FixedBitSVForwardIndexReaderV2 + ChunkCompressionType.LZ4.
+
+Layout:
+    magic "PTSEGv02"
+    entry blobs (back-to-back, 8-byte aligned)
+    index-map JSON (utf-8)
+    footer: uint64 index_off, uint64 index_len, magic "PTSEGv02"
+
+Entry kinds:
+    arr  — numeric ndarray: dtype + shape, codec raw|lz4
+    ids  — int32 dict ids fixed-bit packed into uint64 words, codec raw|lz4
+    str  — var-length strings/bytes: int32 length array entry + blob entry
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+
+import numpy as np
+
+from pinot_tpu import native
+
+MAGIC = b"PTSEGv02"
+SEGMENT_FILE = "segment.ptseg"
+
+
+def _maybe_compress(raw: bytes) -> tuple[str, bytes]:
+    """LZ4 when native is available and it actually helps, else raw."""
+    if native.available() and len(raw) >= 64:
+        comp = native.lz4_compress(raw)
+        if len(comp) < len(raw) * 0.9:
+            return "lz4", comp
+    return "raw", raw
+
+
+class SegmentFileWriter:
+    def __init__(self):
+        self._blobs: list[bytes] = []
+        self._entries: dict[str, dict] = {}
+        self._pos = len(MAGIC)
+
+    def _add(self, key: str, kind: str, raw: bytes, **meta) -> None:
+        codec, stored = _maybe_compress(raw)
+        pad = (-self._pos) % 8
+        self._blobs.append(b"\x00" * pad + stored)
+        self._pos += pad
+        self._entries[key] = {
+            "kind": kind,
+            "off": self._pos,
+            "stored": len(stored),
+            "raw": len(raw),
+            "codec": codec,
+            "crc": native.crc32(raw),
+            **meta,
+        }
+        self._pos += len(stored)
+
+    def write_array(self, key: str, arr: np.ndarray) -> None:
+        arr = np.ascontiguousarray(arr)
+        self._add(key, "arr", arr.tobytes(), dtype=arr.dtype.str, shape=list(arr.shape))
+
+    def write_ids(self, key: str, ids: np.ndarray, cardinality: int) -> None:
+        bits = native.bits_needed(cardinality)
+        packed = native.bitpack(ids, bits)
+        self._add(key, "ids", packed.tobytes(), bits=bits, n=len(ids))
+
+    def write_strings(self, key: str, values: np.ndarray, is_bytes: bool) -> None:
+        encoded = [v if is_bytes else str(v).encode("utf-8") for v in values]
+        lens = np.asarray([len(b) for b in encoded], dtype=np.int32)
+        self.write_array(key + "~len", lens)
+        self._add(key, "str", b"".join(encoded), bytes=is_bytes, n=len(values))
+
+    def finish(self, path: Path, meta: dict) -> None:
+        meta = dict(meta)
+        meta["entries"] = self._entries
+        index = json.dumps(meta).encode("utf-8")
+        with open(path, "wb") as f:
+            f.write(MAGIC)
+            for b in self._blobs:
+                f.write(b)
+            index_off = self._pos
+            f.write(index)
+            f.write(
+                np.asarray([index_off, len(index)], dtype="<u8").tobytes() + MAGIC
+            )
+
+
+def write_segment_file(seg, seg_dir: Path) -> Path:
+    """Serialize an ImmutableSegment (including star-trees and aux indexes)."""
+    from pinot_tpu.common.types import DataType
+
+    w = SegmentFileWriter()
+    col_meta = []
+    for col, ci in seg.columns.items():
+        if ci.dictionary is not None:
+            w.write_ids(f"fwd::{col}", ci.forward, ci.dictionary.cardinality)
+            dv = ci.dictionary.values
+            if ci.data_type == DataType.BYTES:
+                w.write_strings(f"dict::{col}", dv, is_bytes=True)
+            elif ci.data_type in (DataType.STRING, DataType.JSON):
+                w.write_strings(f"dict::{col}", dv, is_bytes=False)
+            else:
+                w.write_array(f"dict::{col}", dv)
+        else:
+            w.write_array(f"fwd::{col}", ci.forward)
+        col_meta.append(
+            {
+                "name": col,
+                "encoding": "DICT" if ci.dictionary is not None else "RAW",
+                "stats": ci.stats.to_dict(),
+            }
+        )
+    star_meta = []
+    for i, st in enumerate(seg.extras.get("startree", [])):
+        for k, arr in st.arrays.items():
+            w.write_array(f"star{i}::{k}", arr)
+        star_meta.append(
+            {"dimensions": st.dimensions, "pairs": st.function_column_pairs, "nRows": st.n_rows}
+        )
+    aux_meta: dict = {"bloom": {}, "inverted": [], "range": []}
+    for col, bf in seg.extras.get("bloom", {}).items():
+        w.write_array(f"bloom::{col}", bf.bits)
+        aux_meta["bloom"][col] = bf.n_hashes
+    for col, inv in seg.extras.get("inverted", {}).items():
+        w.write_array(f"inv_off::{col}", inv.offsets)
+        w.write_array(f"inv_doc::{col}", inv.doc_ids)
+        aux_meta["inverted"].append(col)
+    for col, ri in seg.extras.get("range", {}).items():
+        w.write_array(f"range_doc::{col}", ri.sorted_doc_ids)
+        w.write_array(f"range_val::{col}", ri.sorted_values)
+        aux_meta["range"].append(col)
+    meta = {
+        "formatVersion": 2,
+        "segmentName": seg.name,
+        "numDocs": seg.n_docs,
+        "schema": json.loads(seg.schema.to_json()),
+        "columns": col_meta,
+        "starTrees": star_meta,
+        "auxIndexes": aux_meta,
+    }
+    seg_dir.mkdir(parents=True, exist_ok=True)
+    out = seg_dir / SEGMENT_FILE
+    w.finish(out, meta)
+    return seg_dir
+
+
+class SegmentFileReader:
+    """Reads a .ptseg file; entries decode lazily on access."""
+
+    def __init__(self, path: Path):
+        self.path = Path(path)
+        self._buf = np.fromfile(self.path, dtype=np.uint8)
+        nm = len(MAGIC)
+        if (
+            len(self._buf) < 2 * nm + 16
+            or self._buf[:nm].tobytes() != MAGIC
+            or self._buf[-nm:].tobytes() != MAGIC
+        ):
+            raise ValueError(f"{path}: not a PTSEG file")
+        index_off, index_len = np.frombuffer(self._buf[-nm - 16 : -nm].tobytes(), dtype="<u8")
+        self.meta = json.loads(
+            self._buf[int(index_off) : int(index_off) + int(index_len)].tobytes().decode("utf-8")
+        )
+        self.entries = self.meta["entries"]
+
+    def _raw_bytes(self, e: dict) -> bytes:
+        stored = self._buf[e["off"] : e["off"] + e["stored"]].tobytes()
+        raw = native.lz4_decompress(stored, e["raw"]) if e["codec"] == "lz4" else stored
+        if native.crc32(raw) != e["crc"]:
+            raise ValueError(f"{self.path}: CRC mismatch on entry")
+        return raw
+
+    def keys(self):
+        return self.entries.keys()
+
+    def __contains__(self, key: str) -> bool:
+        return key in self.entries
+
+    def read(self, key: str) -> np.ndarray:
+        e = self.entries[key]
+        raw = self._raw_bytes(e)
+        if e["kind"] == "arr":
+            return np.frombuffer(raw, dtype=np.dtype(e["dtype"])).reshape(e["shape"]).copy()
+        if e["kind"] == "ids":
+            words = np.frombuffer(raw, dtype=np.uint64)
+            return native.bitunpack(words, e["n"], e["bits"]).astype(np.int32)
+        if e["kind"] == "str":
+            lens = self.read(key + "~len")
+            out = np.empty(e["n"], dtype=object)
+            pos = 0
+            if e["bytes"]:
+                for i, l in enumerate(lens):
+                    out[i] = raw[pos : pos + l]
+                    pos += l
+            else:
+                for i, l in enumerate(lens):
+                    out[i] = raw[pos : pos + l].decode("utf-8")
+                    pos += l
+            return out
+        raise AssertionError(e["kind"])

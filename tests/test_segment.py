@@ -137,7 +137,7 @@ def test_float_predicate_on_int_dictionary():
 def test_loader_rejects_future_format(tmp_path, schema, data):
     import json
     seg = SegmentBuilder(schema).build(data, "seg0")
-    d = write_segment(seg, tmp_path)
+    d = write_segment(seg, tmp_path, fmt="npz")
     meta = json.loads((d / "metadata.json").read_text())
     meta["formatVersion"] = 999
     (d / "metadata.json").write_text(json.dumps(meta))
