@@ -53,6 +53,16 @@ class IndexingConfig:
     bloom_filter_columns: list[str] = field(default_factory=list)
     sorted_column: str | None = None
     star_tree_configs: list[StarTreeIndexConfig] = field(default_factory=list)
+    # Text / JSON / geo / vector index declarations (StandardIndexes parity:
+    # text_index, json_index, h3_index, vector_index).
+    text_index_columns: list[str] = field(default_factory=list)
+    json_index_columns: list[str] = field(default_factory=list)
+    # geo: list of [lat_col, lng_col] pairs; the grid index is built per pair
+    geo_index_columns: list[list[str]] = field(default_factory=list)
+    # vector: columns whose input is a 2D (n_docs, dim) float array
+    vector_index_columns: list[str] = field(default_factory=list)
+    # null handling: build per-column null bitmaps (nullvalue_vector parity)
+    null_handling: bool = False
 
     def to_dict(self) -> dict:
         return {
@@ -63,6 +73,11 @@ class IndexingConfig:
             "bloomFilterColumns": self.bloom_filter_columns,
             "sortedColumn": self.sorted_column,
             "starTreeConfigs": [c.to_dict() for c in self.star_tree_configs],
+            "textIndexColumns": self.text_index_columns,
+            "jsonIndexColumns": self.json_index_columns,
+            "geoIndexColumns": self.geo_index_columns,
+            "vectorIndexColumns": self.vector_index_columns,
+            "nullHandlingEnabled": self.null_handling,
         }
 
     @staticmethod
@@ -75,6 +90,11 @@ class IndexingConfig:
             bloom_filter_columns=d.get("bloomFilterColumns", []),
             sorted_column=d.get("sortedColumn"),
             star_tree_configs=[StarTreeIndexConfig.from_dict(c) for c in d.get("starTreeConfigs", [])],
+            text_index_columns=d.get("textIndexColumns", []),
+            json_index_columns=d.get("jsonIndexColumns", []),
+            geo_index_columns=d.get("geoIndexColumns", []),
+            vector_index_columns=d.get("vectorIndexColumns", []),
+            null_handling=d.get("nullHandlingEnabled", False),
         )
 
 

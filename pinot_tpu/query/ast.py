@@ -139,6 +139,32 @@ class RegexpLike(FilterExpr):
 
 
 @dataclass(frozen=True)
+class ArrayLiteral(Expr):
+    """ARRAY[1.0, 2.0, ...] — vector literals for VECTOR_SIMILARITY etc."""
+
+    values: tuple
+
+    def __str__(self) -> str:
+        return "ARRAY[" + ",".join(map(str, self.values)) + "]"
+
+
+@dataclass(frozen=True)
+class PredicateFunction(FilterExpr):
+    """Boolean index-probe functions used as WHERE predicates: TEXT_MATCH,
+    JSON_MATCH, VECTOR_SIMILARITY, ST_WITHIN-style geo probes.
+
+    Reference parity: Pinot models these as function-call filter contexts
+    lowering to TextMatchFilterOperator / JsonMatchFilterOperator /
+    VectorSimilarityFilterOperator (core/operator/filter/)."""
+
+    name: str  # canonical lower-case
+    args: tuple[Expr, ...]
+
+    def __str__(self) -> str:
+        return f"{self.name}({','.join(map(str, self.args))})"
+
+
+@dataclass(frozen=True)
 class IsNull(FilterExpr):
     expr: Expr
     negated: bool = False  # negated => IS NOT NULL

@@ -175,6 +175,7 @@ def _filter_agg_scan(f: FilterExpr, out: dict[str, AggregationInfo]) -> None:
         _extract_aggs(f.expr, out)
     elif isinstance(f, (In, Like, RegexpLike, IsNull)):
         _extract_aggs(f.expr, out)
+    # PredicateFunction args never contain aggregates (index probes only)
 
 
 def _collect_identifiers(expr: Expr, out: set[str]) -> None:
@@ -209,6 +210,12 @@ def _collect_filter_identifiers(f: FilterExpr | None, out: set[str]) -> None:
         _collect_identifiers(f.expr, out)
     elif isinstance(f, (Like, RegexpLike, IsNull)):
         _collect_identifiers(f.expr, out)
+    else:
+        from pinot_tpu.query.ast import PredicateFunction
+
+        if isinstance(f, PredicateFunction):
+            for a in f.args:
+                _collect_identifiers(a, out)
 
 
 def expand_star(stmt: SelectStatement, schema) -> None:

@@ -257,4 +257,13 @@ class QueryEngine:
             ci = seg.columns[dec[1]]
             vals = ci.dictionary.get_many(v.astype(np.int64))
             return vals.astype(str) if vals.dtype == object else vals
+        if kind == "virt":
+            # virtual columns: v carries the selected doc ids
+            if dec[1] == "$docId":
+                return v.astype(np.int64)
+            if dec[1] == "$segmentName":
+                return np.full(len(v), seg.name, dtype=object)
+            import socket
+
+            return np.full(len(v), socket.gethostname(), dtype=object)
         return v

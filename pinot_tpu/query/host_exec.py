@@ -26,6 +26,14 @@ from pinot_tpu.segment.segment import ImmutableSegment
 
 def eval_value(seg: ImmutableSegment, expr: ast.Expr) -> np.ndarray:
     if isinstance(expr, ast.Identifier):
+        if expr.name == "$docId":
+            return np.arange(seg.n_docs, dtype=np.int64)
+        if expr.name == "$segmentName":
+            return np.full(seg.n_docs, seg.name, dtype=object)
+        if expr.name == "$hostName":
+            import socket
+
+            return np.full(seg.n_docs, socket.gethostname(), dtype=object)
         ci = seg.columns.get(expr.name)
         if ci is None:
             raise PlanError(f"unknown column {expr.name!r}")
@@ -142,8 +150,77 @@ def filter_mask(seg: ImmutableSegment, f: ast.FilterExpr | None) -> np.ndarray:
         v = eval_value(seg, f.expr).astype(str)
         return np.asarray([bool(rx.search(x)) for x in v])
     if isinstance(f, ast.IsNull):
+        if isinstance(f.expr, ast.Identifier):
+            nv = seg.extras.get("null", {}).get(f.expr.name)
+            if nv is not None:
+                from pinot_tpu import native
+
+                nulls = native.bm_to_bool(nv, n)
+                return ~nulls if f.negated else nulls
         return np.full(n, bool(f.negated))
+    if isinstance(f, ast.PredicateFunction):
+        return predicate_function_mask(seg, f)
     raise PlanError(f"unsupported filter in host executor: {f}")
+
+
+def predicate_function_mask(seg: ImmutableSegment, f: "ast.PredicateFunction") -> np.ndarray:
+    """Index-probe predicates -> bool doc mask (TextMatch/JsonMatch/
+    VectorSimilarity filter-operator parity; shared by device + host paths)."""
+    n = seg.n_docs
+
+    def _col(i: int) -> str:
+        if len(f.args) <= i or not isinstance(f.args[i], ast.Identifier):
+            raise PlanError(f"{f.name} argument {i} must be a column")
+        return f.args[i].name
+
+    def _lit(i: int):
+        if len(f.args) <= i or not isinstance(f.args[i], ast.Literal):
+            raise PlanError(f"{f.name} argument {i} must be a literal")
+        return f.args[i].value
+
+    if f.name == "text_match":
+        col = _col(0)
+        ti = seg.extras.get("text", {}).get(col)
+        if ti is None:
+            raise PlanError(f"TEXT_MATCH requires a text index on column {col!r}")
+        return ti.search(str(_lit(1)))
+    if f.name == "json_match":
+        col = _col(0)
+        ji = seg.extras.get("json", {}).get(col)
+        if ji is None:
+            raise PlanError(f"JSON_MATCH requires a json index on column {col!r}")
+        return ji.match(str(_lit(1)))
+    if f.name == "vector_similarity":
+        col = _col(0)
+        vi = seg.extras.get("vector", {}).get(col)
+        if vi is None:
+            raise PlanError(f"VECTOR_SIMILARITY requires a vector index on column {col!r}")
+        if len(f.args) < 2 or not isinstance(f.args[1], ast.ArrayLiteral):
+            raise PlanError("VECTOR_SIMILARITY(col, ARRAY[...], topK)")
+        k = int(_lit(2)) if len(f.args) > 2 else 10
+        mask = np.zeros(n, dtype=bool)
+        mask[vi.top_k(np.asarray(f.args[1].values, dtype=np.float32), k)] = True
+        return mask
+    if f.name == "st_within_distance":
+        from pinot_tpu.segment.indexes import haversine_m
+
+        qlat, qlng, radius = float(_lit(2)), float(_lit(3)), float(_lit(4))
+        if isinstance(f.args[0], ast.Identifier) and isinstance(f.args[1], ast.Identifier):
+            gi = seg.extras.get("geo", {}).get(f"{f.args[0].name},{f.args[1].name}")
+            if gi is not None:
+                # grid-cell candidates first, exact haversine refine on the
+                # (usually tiny) candidate set only
+                cand = gi.candidate_docs(qlat, qlng, radius)
+                mask = np.zeros(n, dtype=bool)
+                if len(cand):
+                    lat_c = seg.columns[f.args[0].name].materialize(cand).astype(np.float64)
+                    lng_c = seg.columns[f.args[1].name].materialize(cand).astype(np.float64)
+                    mask[cand[haversine_m(lat_c, lng_c, qlat, qlng) <= radius]] = True
+                return mask
+        lat = eval_value(seg, f.args[0]).astype(np.float64)
+        lng = eval_value(seg, f.args[1]).astype(np.float64)
+        return haversine_m(lat, lng, qlat, qlng) <= radius
+    raise PlanError(f"unknown predicate function {f.name}")
 
 
 # ---------------------------------------------------------------------------

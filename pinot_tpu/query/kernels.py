@@ -42,6 +42,9 @@ def _value(vspec, cols, ops):
         return cols[vspec[1]]
     if kind == "ids":
         return cols[vspec[1]]
+    if kind == "docid":
+        n_padded = next(iter(cols.values())).shape[0]
+        return jnp.arange(n_padded, dtype=jnp.int32)
     if kind == "dictval":
         return ops[vspec[2]][cols[vspec[1]]]
     if kind == "lit":
@@ -106,6 +109,13 @@ def _filter(fspec, cols, ops, n_padded):
     if kind == "range_ids":
         ids = cols[fspec[1]]
         return (ids >= ops[fspec[2]]) & (ids <= ops[fspec[3]])
+    if kind == "docmask":
+        # host-computed index-probe mask (text/json/vector/null), DMA'd once
+        return ops[fspec[1]]
+    if kind == "doc_range":
+        # sorted-column predicate: [start, end) doc interval, no column read
+        i = jnp.arange(n_padded, dtype=jnp.int32)
+        return (i >= ops[fspec[1]]) & (i < ops[fspec[2]])
     if kind == "in_lut":
         return ops[fspec[2]][cols[fspec[1]]]
     if kind == "cmp_raw":

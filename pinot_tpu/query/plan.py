@@ -40,6 +40,10 @@ from pinot_tpu.segment.segment import ImmutableSegment
 
 MAX_DENSE_GROUPS = 1 << 20
 
+# Virtual columns provided at query time (VirtualColumnProvider parity,
+# pinot-segment-local/.../segment/virtualcolumn/VirtualColumnProvider.java).
+VIRTUAL_COLUMNS = ("$docId", "$segmentName", "$hostName")
+
 
 class DeviceFallback(Exception):
     """Query shape has no device lowering yet; use the host executor."""
@@ -84,12 +88,26 @@ class _Lowering:
             self.columns.append(col)
         return col
 
+    def docmask_spec(self, mask: np.ndarray) -> tuple:
+        """Host-computed doc mask -> device filter operand (the TPU analog of
+        Pinot's index filter operators handing a RoaringBitmap to the tree)."""
+        from pinot_tpu.segment.segment import padded_len
+
+        pad = padded_len(self.seg.n_docs)
+        m = np.zeros(pad, dtype=bool)
+        m[: len(mask)] = mask
+        return ("docmask", self.op_idx(m))
+
     # -- value expressions ---------------------------------------------------
 
     def value_spec(self, expr: Expr) -> tuple:
         """Lower a value expression to a spec computing per-doc float64/int
         values on device."""
         if isinstance(expr, ast.Identifier):
+            if expr.name == "$docId":
+                return ("docid",)
+            if expr.name in VIRTUAL_COLUMNS:
+                raise DeviceFallback(f"virtual column {expr.name} in value context runs host-side")
             ci = self.seg.columns.get(expr.name)
             if ci is None:
                 raise PlanError(f"unknown column {expr.name!r}")
@@ -229,9 +247,31 @@ class _Lowering:
         if isinstance(f, ast.RegexpLike):
             return self._regex_lut(f.expr, f.pattern, full=False)
         if isinstance(f, ast.IsNull):
-            # null handling disabled (Pinot default): IS NULL matches nothing
+            if isinstance(f.expr, ast.Identifier):
+                nv = self.seg.extras.get("null", {}).get(f.expr.name)
+                if nv is not None:
+                    from pinot_tpu import native
+
+                    nulls = native.bm_to_bool(nv, self.seg.n_docs)
+                    return self.docmask_spec(~nulls if f.negated else nulls)
+            # no null vector (Pinot default null handling): IS NULL matches nothing
             return ("const", bool(f.negated))
+        if isinstance(f, ast.PredicateFunction):
+            return self._predicate_function(f)
         raise PlanError(f"unsupported filter: {f}")
+
+    def _predicate_function(self, f: ast.PredicateFunction) -> tuple:
+        from pinot_tpu.query.host_exec import predicate_function_mask
+
+        if f.name == "st_within_distance":
+            # ST_WITHIN_DISTANCE(lat, lng, qlat, qlng, radius_m): pure device
+            # compare over the vectorized haversine; geo index prunes segments
+            if len(f.args) != 5 or not isinstance(f.args[4], ast.Literal):
+                raise PlanError("ST_WITHIN_DISTANCE(lat, lng, qlat, qlng, radius_m)")
+            dist = ast.FunctionCall("st_distance", tuple(f.args[:4]))
+            return ("cmp_lit", "LTE", self.value_spec(dist), self.op_idx(np.float64(f.args[4].value)))
+        # TEXT_MATCH / JSON_MATCH / VECTOR_SIMILARITY: host index probe -> mask
+        return self.docmask_spec(predicate_function_mask(self.seg, f))
 
     def _compare(self, f: ast.Compare) -> tuple:
         left, op, right = f.left, f.op, f.right
@@ -245,7 +285,7 @@ class _Lowering:
             lv, rv = self.value_spec(left), self.value_spec(right)
             return ("cmp2", op.name, lv, rv)
         value = right.value
-        if isinstance(left, ast.Identifier):
+        if isinstance(left, ast.Identifier) and left.name not in VIRTUAL_COLUMNS:
             ci = self.seg.columns.get(left.name)
             if ci is None:
                 raise PlanError(f"unknown column {left.name!r}")
@@ -275,17 +315,16 @@ class _Lowering:
 
     def _dict_compare(self, col: str, ci, op: CompareOp, value) -> tuple:
         d = ci.dictionary
-        self.use_col(col)
         if op == CompareOp.EQ:
             i = d.index_of(value)
             if i < 0:
                 return ("const", False)
-            return ("range_ids", col, self.op_idx(np.int32(i)), self.op_idx(np.int32(i)))
+            return self._id_range_filter(col, ci, i, i)
         if op == CompareOp.NEQ:
             i = d.index_of(value)
             if i < 0:
                 return ("const", True)
-            return ("not", ("range_ids", col, self.op_idx(np.int32(i)), self.op_idx(np.int32(i))))
+            return ("not", self._id_range_filter(col, ci, i, i))
         if op == CompareOp.LT:
             lo, hi = d.id_range_for(None, value, True, False)
         elif op == CompareOp.LTE:
@@ -298,9 +337,35 @@ class _Lowering:
             return ("const", False)
         if lo == 0 and hi == d.cardinality - 1:
             return ("const", True)
+        return self._id_range_filter(col, ci, lo, hi)
+
+    def _id_range_filter(self, col: str, ci, lo: int, hi: int) -> tuple:
+        """Dict-id interval filter. On a sorted column (SortedIndexReader
+        parity: the forward index IS the index) the id interval maps to one
+        contiguous doc range via two binary searches — the kernel then tests
+        iota bounds and the column never needs to be read on device."""
+        if ci.stats.is_sorted:
+            start = int(np.searchsorted(ci.forward, lo, side="left"))
+            end = int(np.searchsorted(ci.forward, hi, side="right"))
+            return ("doc_range", self.op_idx(np.int32(start)), self.op_idx(np.int32(end)))
+        self.use_col(col)
         return ("range_ids", col, self.op_idx(np.int32(lo)), self.op_idx(np.int32(hi)))
 
     def _raw_compare(self, col: str, ci, op: CompareOp, value) -> tuple:
+        if ci.stats.is_sorted and op != CompareOp.NEQ:
+            n = len(ci.forward)
+            left = int(np.searchsorted(ci.forward, value, side="left"))
+            right = int(np.searchsorted(ci.forward, value, side="right"))
+            start, end = {
+                CompareOp.EQ: (left, right),
+                CompareOp.LT: (0, left),
+                CompareOp.LTE: (0, right),
+                CompareOp.GT: (right, n),
+                CompareOp.GTE: (left, n),
+            }[op]
+            if start >= end:
+                return ("const", False)
+            return ("doc_range", self.op_idx(np.int32(start)), self.op_idx(np.int32(end)))
         self.use_col(col)
         v = self.op_idx(np.asarray(value, dtype=np.float64))
         return ("cmp_raw", op.name, col, v)
@@ -313,13 +378,12 @@ class _Lowering:
             if ci is None:
                 raise PlanError(f"unknown column {expr.name!r}")
             if ci.is_dict_encoded:
-                self.use_col(expr.name)
                 lo, hi = ci.dictionary.id_range_for(low.value, high.value, lo_incl, hi_incl)
                 if lo > hi:
                     return ("const", False)
                 if lo == 0 and hi == ci.dictionary.cardinality - 1:
                     return ("const", True)
-                return ("range_ids", expr.name, self.op_idx(np.int32(lo)), self.op_idx(np.int32(hi)))
+                return self._id_range_filter(expr.name, ci, lo, hi)
         vs = self.value_spec(expr)
         return (
             "and",
@@ -467,6 +531,8 @@ class _Lowering:
         for g in self.ctx.group_by:
             if not isinstance(g, ast.Identifier):
                 raise DeviceFallback("expression GROUP BY keys run host-side for now")
+            if g.name in VIRTUAL_COLUMNS:
+                raise DeviceFallback(f"GROUP BY virtual column {g.name} runs host-side")
             ci = self.seg.columns.get(g.name)
             if ci is None:
                 raise PlanError(f"unknown column {g.name!r}")
@@ -566,6 +632,12 @@ def plan_segment(seg: ImmutableSegment, ctx: QueryContext) -> SegmentPlan:
         if isinstance(e, ast.Star):
             raise DeviceFallback("SELECT * expansion handled by engine")
         if isinstance(e, ast.Identifier):
+            if e.name in VIRTUAL_COLUMNS:
+                # $docId / $segmentName / $hostName (VirtualColumnProvider
+                # parity): docids come off-device, constants decode host-side
+                proj.append(("docid",))
+                decode.append(("virt", e.name))
+                continue
             ci = seg.columns.get(e.name)
             if ci is None:
                 raise PlanError(f"unknown column {e.name!r}")

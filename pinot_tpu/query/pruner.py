@@ -47,6 +47,26 @@ def _bloom_rejects(seg: ImmutableSegment, f: ast.FilterExpr | None) -> bool:
     return False
 
 
+def _geo_rejects(seg: ImmutableSegment, f: ast.FilterExpr | None) -> bool:
+    """True when a geo grid index's bbox PROVES a conjunctive
+    ST_WITHIN_DISTANCE probe matches nothing (H3IndexFilterOperator's
+    segment-prune role)."""
+    geos = seg.extras.get("geo")
+    if not geos or f is None:
+        return False
+    if isinstance(f, ast.And):
+        return any(_geo_rejects(seg, c) for c in f.children)
+    if isinstance(f, ast.PredicateFunction) and f.name == "st_within_distance" and len(f.args) == 5:
+        if not (isinstance(f.args[0], ast.Identifier) and isinstance(f.args[1], ast.Identifier)):
+            return False
+        gi = geos.get(f"{f.args[0].name},{f.args[1].name}")
+        if gi is None or not all(isinstance(a, ast.Literal) for a in f.args[2:]):
+            return False
+        qlat, qlng, radius = (float(a.value) for a in f.args[2:])
+        return gi.min_distance_m(qlat, qlng) > radius
+    return False
+
+
 def can_match(seg: ImmutableSegment, ctx: QueryContext) -> bool:
     from pinot_tpu.cluster.routing import segment_can_match
 
@@ -55,6 +75,8 @@ def can_match(seg: ImmutableSegment, ctx: QueryContext) -> bool:
     if not segment_can_match(ctx.filter, _stats_map(seg)):
         return False
     if _bloom_rejects(seg, ctx.filter):
+        return False
+    if _geo_rejects(seg, ctx.filter):
         return False
     return True
 

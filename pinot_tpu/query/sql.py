@@ -26,6 +26,7 @@ from dataclasses import dataclass
 
 from pinot_tpu.query.ast import (
     And,
+    ArrayLiteral,
     Between,
     BinaryOp,
     Compare,
@@ -41,6 +42,7 @@ from pinot_tpu.query.ast import (
     Not,
     Or,
     JoinRel,
+    PredicateFunction,
     OrderByItem,
     RegexpLike,
     Relation,
@@ -68,8 +70,8 @@ _TOKEN_RE = re.compile(
   | (?P<number>(?:\d+\.\d*|\.\d+|\d+)(?:[eE][+-]?\d+)?)
   | (?P<string>'(?:[^']|'')*')
   | (?P<qident>"(?:[^"]|"")*"|`(?:[^`]|``)*`)
-  | (?P<ident>[A-Za-z_][A-Za-z0-9_$.]*)
-  | (?P<op><>|!=|<=|>=|=|<|>|\+|-|\*|/|%|\(|\)|,|;)
+  | (?P<ident>[A-Za-z_$][A-Za-z0-9_$.]*)
+  | (?P<op><>|!=|<=|>=|=|<|>|\+|-|\*|/|%|\(|\)|\[|\]|,|;)
     """,
     re.VERBOSE,
 )
@@ -353,6 +355,14 @@ class Parser:
         self.expect_op(")")
         return WindowFunction(fc, tuple(partition_by), tuple(order_by))
 
+    def _array_element(self):
+        neg = self.eat_op("-")
+        t = self.next()
+        if t.kind != "number":
+            raise SqlParseError(f"ARRAY elements must be numeric literals at {t.pos}")
+        v = int(t.text) if re.fullmatch(r"\d+", t.text) else float(t.text)
+        return -v if neg else v
+
     def _identifier_name(self, t: Token) -> str:
         if t.kind == "ident":
             return t.text
@@ -407,6 +417,20 @@ class Parser:
                 raise SqlParseError(f"REGEXP_LIKE pattern must be a string at {pat.pos}")
             self.expect_op(")")
             return RegexpLike(expr, _unquote_string(pat.text))
+        if (
+            self.peek().kind == "ident"
+            and self.peek().text.lower() in _PREDICATE_FUNCS
+            and self.peek(1).text == "("
+        ):
+            name = self.next().text.lower()
+            self.next()
+            args: list[Expr] = []
+            if not self.at_op(")"):
+                args.append(self._expr())
+                while self.eat_op(","):
+                    args.append(self._expr())
+            self.expect_op(")")
+            return PredicateFunction(name, tuple(args))
         return self._predicate()
 
     def _predicate(self) -> FilterExpr:
@@ -496,6 +520,16 @@ class Parser:
             return Identifier(self._identifier_name(t))
         if t.kind == "ident":
             up = t.upper
+            if up == "ARRAY" and self.peek(1).text == "[":
+                self.next()
+                self.next()
+                vals: list = []
+                if not self.at_op("]"):
+                    vals.append(self._array_element())
+                    while self.eat_op(","):
+                        vals.append(self._array_element())
+                self.expect_op("]")
+                return ArrayLiteral(tuple(vals))
             if up == "NULL":
                 self.next()
                 return Literal(None)
@@ -536,6 +570,11 @@ class Parser:
 
 def _unquote_string(s: str) -> str:
     return s[1:-1].replace("''", "'")
+
+
+# Boolean index-probe functions accepted in WHERE position (parity:
+# Pinot's TEXT_MATCH / JSON_MATCH / VECTOR_SIMILARITY filter functions).
+_PREDICATE_FUNCS = {"text_match", "json_match", "vector_similarity", "st_within_distance"}
 
 
 def parse_sql(sql: str) -> SelectStatement:

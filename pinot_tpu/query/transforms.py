@@ -72,7 +72,18 @@ DEVICE_FUNCS: dict[str, tuple[int, object]] = {
     "millissinceepoch": (1, lambda jnp, ms: ms),
     "datetrunc_day": (1, lambda jnp, ms: jnp.floor_divide(ms, 86_400_000) * 86_400_000),
     "datetrunc_hour": (1, lambda jnp, ms: jnp.floor_divide(ms, 3_600_000) * 3_600_000),
+    # geo: great-circle distance in meters over (lat, lng, qlat, qlng) degrees
+    # (Pinot ST_DISTANCE parity; vectorized haversine instead of H3 walks;
+    # the SAME formula backs the host pruner via indexes.haversine_m)
+    "st_distance": (4, lambda jnp, lat, lng, qlat, qlng: _st_distance(jnp, lat, lng, qlat, qlng)),
 }
+
+
+def _st_distance(jnp, lat, lng, qlat, qlng):
+    from pinot_tpu.segment.indexes import haversine
+
+    f64 = lambda x: x.astype(jnp.float64) if hasattr(x, "astype") else x
+    return haversine(jnp, f64(lat), f64(lng), f64(qlat), f64(qlng))
 
 
 # ---------------------------------------------------------------------------

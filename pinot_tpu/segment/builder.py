@@ -37,19 +37,24 @@ def _pivot(rows: Sequence[Mapping[str, Any]], schema: Schema) -> dict[str, np.nd
     cols: dict[str, list] = {c: [] for c in schema.columns}
     for r in rows:
         for c in schema.columns:
-            spec = schema[c]
-            v = r.get(c)
-            if v is None:
-                v = spec.data_type.default_null
-            cols[c].append(v)
-    out = {}
-    for c, vals in cols.items():
-        dt = schema[c].data_type
-        if dt in (DataType.STRING, DataType.BYTES, DataType.JSON):
-            out[c] = np.asarray(vals, dtype=object)
-        else:
-            out[c] = np.asarray(vals, dtype=dt.np_dtype)
-    return out
+            cols[c].append(r.get(c))
+    return {c: np.asarray(vals, dtype=object) for c, vals in cols.items()}
+
+
+def _separate_nulls(raw: np.ndarray, dt: DataType, spec) -> tuple[np.ndarray, np.ndarray | None]:
+    """Replace None entries with the type's default null placeholder
+    (FieldSpec DEFAULT_* parity) and return (values, null bool mask or None)."""
+    if not spec.single_value:
+        return np.asarray(raw), None  # MV/vector columns: no null vector
+    if raw.dtype != object:
+        return raw, None
+    nulls = np.asarray([v is None for v in raw], dtype=bool)
+    if nulls.any():
+        raw = raw.copy()
+        raw[nulls] = dt.default_null
+    if dt in (DataType.STRING, DataType.BYTES, DataType.JSON):
+        return raw, (nulls if nulls.any() else None)
+    return raw.astype(dt.np_dtype), (nulls if nulls.any() else None)
 
 
 class SegmentBuilder:
@@ -81,13 +86,26 @@ class SegmentBuilder:
             columns = _pivot(data, self.schema)
         n_docs = len(next(iter(columns.values()))) if columns else 0
         seg = ImmutableSegment(name=segment_name, schema=self.schema, n_docs=n_docs)
+        vector_cols = set(self.config.indexing.vector_index_columns)
         for col in self.schema.columns:
             if col not in columns:
                 raise ValueError(f"missing column {col!r} in input data")
             raw = columns[col]
             if len(raw) != n_docs:
                 raise ValueError(f"column {col!r} length {len(raw)} != {n_docs}")
-            dt = self.schema[col].data_type
+            spec = self.schema[col]
+            dt = spec.data_type
+            if col in vector_cols or (not spec.single_value and np.asarray(raw).ndim == 2):
+                # embedding column: (n_docs, dim) matrix -> vector index only
+                from pinot_tpu.segment.indexes import VectorIndex
+
+                seg.extras.setdefault("vector", {})[col] = VectorIndex.build(np.asarray(raw))
+                continue
+            raw, nulls = _separate_nulls(raw, dt, spec)
+            if nulls is not None and self.config.indexing.null_handling:
+                from pinot_tpu import native
+
+                seg.extras.setdefault("null", {})[col] = native.bm_from_bool(nulls)
             if self._use_dictionary(col):
                 dictionary, ids = Dictionary.from_column(dt, raw)
                 stats = ColumnStats.from_dictionary(col, dt, ids, dictionary)
@@ -126,6 +144,27 @@ class SegmentBuilder:
             if ci is None:
                 continue
             seg.extras.setdefault("range", {})[col] = RangeIndex.build(ci.forward)
+        if idx.text_index_columns or idx.json_index_columns or idx.geo_index_columns:
+            from pinot_tpu.segment.indexes import GeoGridIndex, JsonIndex, TextIndex
+
+            for col in idx.text_index_columns:
+                ci = seg.columns.get(col)
+                if ci is None or not ci.is_dict_encoded:
+                    continue
+                seg.extras.setdefault("text", {})[col] = TextIndex.build(ci.materialize())
+            for col in idx.json_index_columns:
+                ci = seg.columns.get(col)
+                if ci is None or not ci.is_dict_encoded:
+                    continue
+                seg.extras.setdefault("json", {})[col] = JsonIndex.build(ci.materialize())
+            for pair in idx.geo_index_columns:
+                lat_col, lng_col = pair
+                la, ln = seg.columns.get(lat_col), seg.columns.get(lng_col)
+                if la is None or ln is None:
+                    continue
+                seg.extras.setdefault("geo", {})[f"{lat_col},{lng_col}"] = GeoGridIndex.build(
+                    lat_col, lng_col, la.materialize().astype(np.float64), ln.materialize().astype(np.float64)
+                )
 
     # -- persistence ---------------------------------------------------------
 

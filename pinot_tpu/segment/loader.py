@@ -83,4 +83,26 @@ def _reconstruct(
             seg.extras.setdefault("range", {})[col] = RangeIndex(
                 read(f"range_doc::{col}"), read(f"range_val::{col}")
             )
+        if any(k in aux for k in ("text", "json", "geo", "vector", "null")):
+            from pinot_tpu.segment.indexes import GeoGridIndex, JsonIndex, TextIndex, VectorIndex
+
+            for col in aux.get("text", []):
+                seg.extras.setdefault("text", {})[col] = TextIndex(
+                    read(f"text_vocab::{col}"), read(f"text_off::{col}"), read(f"text_doc::{col}"), seg.n_docs
+                )
+            for col in aux.get("json", []):
+                seg.extras.setdefault("json", {})[col] = JsonIndex(
+                    read(f"json_keys::{col}"), read(f"json_off::{col}"), read(f"json_doc::{col}"), seg.n_docs
+                )
+            for key, gm in aux.get("geo", {}).items():
+                lat_col, lng_col = key.split(",")
+                seg.extras.setdefault("geo", {})[key] = GeoGridIndex(
+                    lat_col, lng_col, gm["resDeg"],
+                    read(f"geo_cells::{key}"), read(f"geo_off::{key}"), read(f"geo_doc::{key}"),
+                    tuple(gm["bbox"]),
+                )
+            for col in aux.get("vector", []):
+                seg.extras.setdefault("vector", {})[col] = VectorIndex(read(f"vector::{col}"))
+            for col in aux.get("null", []):
+                seg.extras.setdefault("null", {})[col] = read(f"null::{col}")
     return seg

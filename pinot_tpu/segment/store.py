@@ -139,6 +139,27 @@ def write_segment_file(seg, seg_dir: Path) -> Path:
         w.write_array(f"range_doc::{col}", ri.sorted_doc_ids)
         w.write_array(f"range_val::{col}", ri.sorted_values)
         aux_meta["range"].append(col)
+    for col, ti in seg.extras.get("text", {}).items():
+        w.write_strings(f"text_vocab::{col}", ti.vocab, is_bytes=False)
+        w.write_array(f"text_off::{col}", ti.offsets)
+        w.write_array(f"text_doc::{col}", ti.doc_ids)
+        aux_meta.setdefault("text", []).append(col)
+    for col, ji in seg.extras.get("json", {}).items():
+        w.write_strings(f"json_keys::{col}", ji.keys, is_bytes=False)
+        w.write_array(f"json_off::{col}", ji.offsets)
+        w.write_array(f"json_doc::{col}", ji.doc_ids)
+        aux_meta.setdefault("json", []).append(col)
+    for key, gi in seg.extras.get("geo", {}).items():
+        w.write_array(f"geo_cells::{key}", gi.cells)
+        w.write_array(f"geo_off::{key}", gi.offsets)
+        w.write_array(f"geo_doc::{key}", gi.doc_ids)
+        aux_meta.setdefault("geo", {})[key] = {"resDeg": gi.res_deg, "bbox": list(gi.bbox)}
+    for col, vi in seg.extras.get("vector", {}).items():
+        w.write_array(f"vector::{col}", vi.vectors)
+        aux_meta.setdefault("vector", []).append(col)
+    for col, bm in seg.extras.get("null", {}).items():
+        w.write_array(f"null::{col}", bm)
+        aux_meta.setdefault("null", []).append(col)
     meta = {
         "formatVersion": 2,
         "segmentName": seg.name,

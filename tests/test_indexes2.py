@@ -1,0 +1,315 @@
+"""Text / JSON / geo / vector / sorted / null-vector / virtual-column tests.
+
+Mirrors the reference's coverage of TextMatch/JsonMatch/H3/VectorSimilarity
+filter operators and SortedIndexReader in pinot-core queries tests.
+"""
+
+import numpy as np
+import pytest
+
+from pinot_tpu.common import DataType, Schema
+from pinot_tpu.common.config import IndexingConfig, TableConfig
+from pinot_tpu.query.engine import QueryEngine
+from pinot_tpu.segment import SegmentBuilder, load_segment
+from pinot_tpu.segment.builder import write_segment
+from pinot_tpu.segment.indexes import GeoGridIndex, JsonIndex, TextIndex, VectorIndex, haversine_m
+
+
+# ---------------------------------------------------------------------------
+# unit: index structures
+# ---------------------------------------------------------------------------
+
+
+def test_text_index_basic():
+    docs = np.asarray(
+        ["Java coffee shop", "coffee roaster", "tea house", "the java language", ""], dtype=object
+    )
+    ti = TextIndex.build(docs)
+    np.testing.assert_array_equal(ti.search("coffee"), [True, True, False, False, False])
+    np.testing.assert_array_equal(ti.search("java AND coffee"), [True, False, False, False, False])
+    np.testing.assert_array_equal(ti.search("java OR tea"), [True, False, True, True, False])
+    np.testing.assert_array_equal(ti.search("coffee tea"), [True, True, True, False, False])  # OR default
+    np.testing.assert_array_equal(ti.search("jav*"), [True, False, False, True, False])
+    np.testing.assert_array_equal(ti.search('"coffee shop"'), [True, False, False, False, False])
+    np.testing.assert_array_equal(ti.search("missing"), [False] * 5)
+
+
+def test_text_index_precedence_and_empty_phrase():
+    docs = np.asarray(["apple", "banana cherry", "banana"], dtype=object)
+    ti = TextIndex.build(docs)
+    # AND binds tighter than OR: apple OR (banana AND cherry)
+    np.testing.assert_array_equal(ti.search("apple OR banana AND cherry"), [True, True, False])
+    # punctuation-only phrase matches nothing (not everything)
+    np.testing.assert_array_equal(ti.search('"--"'), [False, False, False])
+    np.testing.assert_array_equal(ti.search(""), [False, False, False])
+
+
+def test_geo_min_distance_antimeridian():
+    # bbox near lng +179; a query just across the antimeridian must NOT be
+    # pruned as far away
+    lat = np.asarray([0.0, 0.1])
+    lng = np.asarray([179.0, 179.5])
+    gi = GeoGridIndex.build("lat", "lng", lat, lng, res_deg=0.5)
+    d = gi.min_distance_m(0.0, -179.5)
+    true_min = haversine_m(lat, lng, 0.0, -179.5).min()
+    assert d <= true_min + 1.0
+    assert d < 200_000  # ~111km to 179.5E across the seam, not ~39,000km
+
+
+def test_virtual_column_in_where_and_group_by():
+    schema = Schema.build("t", dimensions=[("name", DataType.STRING)])
+    seg = SegmentBuilder(schema).build(
+        {"name": np.asarray(["a", "b", "c", "d"], dtype=object)}, "segY"
+    )
+    engine = QueryEngine([seg])
+    r = engine.execute("SELECT name FROM t WHERE $docId < 2 LIMIT 10")
+    assert [row[0] for row in r.rows] == ["a", "b"]
+    r2 = engine.execute("SELECT $segmentName, COUNT(*) FROM t GROUP BY $segmentName")
+    assert r2.rows == [["segY", 4]]
+
+
+def test_json_index_basic():
+    docs = np.asarray(
+        [
+            '{"a": {"b": "x"}, "tags": ["red", "blue"], "n": 5}',
+            '{"a": {"b": "y"}, "tags": ["red"]}',
+            '{"a": {"c": 1}}',
+            "not json at all {",
+        ],
+        dtype=object,
+    )
+    ji = JsonIndex.build(docs)
+    np.testing.assert_array_equal(ji.match("\"$.a.b\"='x'"), [True, False, False, False])
+    np.testing.assert_array_equal(ji.match("\"$.tags[*]\"='red'"), [True, True, False, False])
+    np.testing.assert_array_equal(ji.match('"$.a.b" IS NOT NULL'), [True, True, False, False])
+    np.testing.assert_array_equal(ji.match('"$.a.c" IS NULL'), [True, True, False, True])
+    np.testing.assert_array_equal(
+        ji.match("\"$.a.b\"='x' OR \"$.a.c\"='1'"), [True, False, True, False]
+    )
+    np.testing.assert_array_equal(
+        ji.match("\"$.tags[*]\"='red' AND \"$.tags[*]\"='blue'"), [True, False, False, False]
+    )
+    np.testing.assert_array_equal(ji.match("\"$.n\"='5'"), [True, False, False, False])
+
+
+def test_geo_grid_index():
+    rng = np.random.default_rng(3)
+    lat = rng.uniform(37.0, 38.0, 1000)
+    lng = rng.uniform(-122.5, -121.5, 1000)
+    gi = GeoGridIndex.build("lat", "lng", lat, lng, res_deg=0.25)
+    # a point far away is provably out of reach
+    assert gi.min_distance_m(0.0, 0.0) > 5_000_000
+    assert gi.min_distance_m(37.5, -122.0) == 0.0
+    # candidate docs superset the exact in-radius set
+    qlat, qlng, r = 37.5, -122.0, 20_000.0
+    exact = np.nonzero(haversine_m(lat, lng, qlat, qlng) <= r)[0]
+    cand = set(gi.candidate_docs(qlat, qlng, r).tolist())
+    assert set(exact.tolist()) <= cand
+
+
+def test_vector_index_topk_exact():
+    rng = np.random.default_rng(5)
+    vecs = rng.normal(size=(500, 16)).astype(np.float32)
+    vi = VectorIndex.build(vecs)
+    q = rng.normal(size=16).astype(np.float32)
+    got = vi.top_k(q, 10)
+    norm = vecs / np.linalg.norm(vecs, axis=1, keepdims=True)
+    scores = norm @ (q / np.linalg.norm(q))
+    want = np.argsort(-scores)[:10]
+    np.testing.assert_array_equal(np.sort(got), np.sort(want))
+    assert list(got) == list(want)  # ordered by similarity
+
+
+# ---------------------------------------------------------------------------
+# end-to-end: SQL through the engine
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture
+def rich_engine(tmp_path):
+    rng = np.random.default_rng(11)
+    n = 2000
+    schema = Schema.build(
+        "products",
+        dimensions=[
+            ("descr", DataType.STRING),
+            ("attrs", DataType.JSON),
+            ("city", DataType.STRING),
+        ],
+        metrics=[
+            ("price", DataType.DOUBLE),
+            ("lat", DataType.DOUBLE),
+            ("lng", DataType.DOUBLE),
+        ],
+    )
+    words = ["espresso", "latte", "tea", "juice", "bagel", "muffin"]
+    descr = np.asarray(
+        [" ".join(rng.choice(words, size=3, replace=False)) for _ in range(n)], dtype=object
+    )
+    colors = ["red", "green", "blue"]
+    attrs = np.asarray(
+        ['{"color": "%s", "size": %d}' % (colors[i % 3], i % 5) for i in range(n)], dtype=object
+    )
+    data = {
+        "descr": descr,
+        "attrs": attrs,
+        "city": np.asarray(["sf", "nyc"], dtype=object)[rng.integers(0, 2, n)],
+        "price": rng.uniform(1, 20, n),
+        "lat": rng.uniform(37.0, 38.0, n),
+        "lng": rng.uniform(-122.5, -121.5, n),
+    }
+    cfg = TableConfig(
+        "products",
+        indexing=IndexingConfig(
+            text_index_columns=["descr"],
+            json_index_columns=["attrs"],
+            geo_index_columns=[["lat", "lng"]],
+        ),
+    )
+    seg_dir = write_segment(SegmentBuilder(schema, cfg).build(data, "p0"), tmp_path)
+    seg = load_segment(seg_dir)  # exercises persistence of all new indexes
+    return QueryEngine([seg]), data
+
+
+def test_text_match_sql(rich_engine):
+    engine, data = rich_engine
+    r = engine.execute("SELECT COUNT(*) FROM products WHERE TEXT_MATCH(descr, 'espresso')")
+    expected = sum("espresso" in d for d in data["descr"])
+    assert r.rows[0][0] == expected
+
+
+def test_text_match_combined_with_predicate(rich_engine):
+    engine, data = rich_engine
+    r = engine.execute(
+        "SELECT COUNT(*) FROM products WHERE TEXT_MATCH(descr, 'latte AND tea') AND price > 10"
+    )
+    expected = sum(
+        ("latte" in d and "tea" in d) and p > 10 for d, p in zip(data["descr"], data["price"])
+    )
+    assert r.rows[0][0] == expected
+
+
+def test_json_match_sql(rich_engine):
+    engine, data = rich_engine
+    r = engine.execute(
+        "SELECT COUNT(*) FROM products WHERE JSON_MATCH(attrs, '\"$.color\"=''red''')"
+    )
+    expected = sum('"color": "red"' in a for a in data["attrs"])
+    assert r.rows[0][0] == expected
+
+
+def test_geo_within_distance_sql(rich_engine):
+    engine, data = rich_engine
+    r = engine.execute(
+        "SELECT COUNT(*) FROM products WHERE ST_WITHIN_DISTANCE(lat, lng, 37.5, -122.0, 20000)"
+    )
+    expected = int((haversine_m(data["lat"], data["lng"], 37.5, -122.0) <= 20000).sum())
+    assert r.rows[0][0] == expected
+
+
+def test_geo_prunes_far_segment(rich_engine):
+    engine, _ = rich_engine
+    r = engine.execute(
+        "SELECT COUNT(*) FROM products WHERE ST_WITHIN_DISTANCE(lat, lng, -33.8, 151.2, 50000)"
+    )
+    assert r.rows[0][0] == 0
+    assert r.num_docs_scanned == 0  # pruned via geo bbox, no scan
+
+
+def test_st_distance_projection(rich_engine):
+    engine, data = rich_engine
+    r = engine.execute(
+        "SELECT MIN(ST_DISTANCE(lat, lng, 37.5, -122.0)) FROM products"
+    )
+    expected = haversine_m(data["lat"], data["lng"], 37.5, -122.0).min()
+    assert abs(r.rows[0][0] - expected) < 1.0
+
+
+def test_vector_similarity_sql(tmp_path):
+    rng = np.random.default_rng(9)
+    n, dim = 300, 8
+    schema = Schema.build(
+        "docs", dimensions=[("title", DataType.STRING)], metrics=[("score", DataType.DOUBLE)]
+    )
+    schema.add(
+        __import__("pinot_tpu.common.types", fromlist=["FieldSpec"]).FieldSpec(
+            "embedding", DataType.FLOAT, single_value=False
+        )
+    )
+    vecs = rng.normal(size=(n, dim)).astype(np.float32)
+    data = {
+        "title": np.asarray([f"t{i}" for i in range(n)], dtype=object),
+        "score": rng.uniform(0, 1, n),
+        "embedding": vecs,
+    }
+    cfg = TableConfig("docs", indexing=IndexingConfig(vector_index_columns=["embedding"]))
+    seg_dir = write_segment(SegmentBuilder(schema, cfg).build(data, "d0"), tmp_path)
+    seg = load_segment(seg_dir)
+    engine = QueryEngine([seg])
+    q = vecs[7]
+    arr = ",".join(f"{x:.6f}" for x in q)
+    r = engine.execute(
+        f"SELECT title FROM docs WHERE VECTOR_SIMILARITY(embedding, ARRAY[{arr}], 5) LIMIT 50"
+    )
+    titles = {row[0] for row in r.rows}
+    assert "t7" in titles and len(titles) == 5
+
+
+def test_sorted_column_doc_range(tmp_path):
+    # a sorted time-like column lowers to a doc-range filter (no device read)
+    n = 10_000
+    ts = np.sort(np.random.default_rng(1).integers(0, 1_000_000, n)).astype(np.int64)
+    vals = np.random.default_rng(2).integers(0, 100, n).astype(np.int32)
+    schema = Schema.build("events", dimensions=[("ts", DataType.LONG)], metrics=[("v", DataType.INT)])
+    seg = SegmentBuilder(schema).build({"ts": ts, "v": vals}, "e0")
+    assert seg.columns["ts"].stats.is_sorted
+    from pinot_tpu.query.context import QueryContext
+    from pinot_tpu.query.plan import plan_segment
+
+    ctx = QueryContext.from_sql("SELECT SUM(v) FROM events WHERE ts BETWEEN 100000 AND 500000")
+    plan = plan_segment(seg, ctx)
+    assert plan.spec[1][0] == "doc_range"
+    assert "ts" not in plan.columns  # the sorted column itself is never read
+    engine = QueryEngine([seg])
+    r = engine.execute("SELECT SUM(v) FROM events WHERE ts BETWEEN 100000 AND 500000")
+    expected = vals[(ts >= 100000) & (ts <= 500000)].sum()
+    assert r.rows[0][0] == expected
+
+
+def test_null_vectors_is_null(tmp_path):
+    schema = Schema.build(
+        "t", dimensions=[("name", DataType.STRING)], metrics=[("v", DataType.DOUBLE)]
+    )
+    rows = [
+        {"name": "a", "v": 1.0},
+        {"name": None, "v": 2.0},
+        {"name": "b", "v": None},
+        {"name": None, "v": None},
+    ]
+    cfg = TableConfig("t", indexing=IndexingConfig(null_handling=True))
+    seg_dir = write_segment(SegmentBuilder(schema, cfg).build(rows, "n0"), tmp_path)
+    seg = load_segment(seg_dir)
+    engine = QueryEngine([seg])
+    assert engine.execute("SELECT COUNT(*) FROM t WHERE name IS NULL").rows[0][0] == 2
+    assert engine.execute("SELECT COUNT(*) FROM t WHERE name IS NOT NULL").rows[0][0] == 2
+    assert engine.execute("SELECT COUNT(*) FROM t WHERE v IS NULL").rows[0][0] == 2
+    assert engine.execute("SELECT COUNT(*) FROM t WHERE name IS NULL AND v IS NULL").rows[0][0] == 1
+
+
+def test_null_handling_disabled_matches_nothing():
+    schema = Schema.build("t", dimensions=[("name", DataType.STRING)])
+    seg = SegmentBuilder(schema).build([{"name": "a"}, {"name": None}], "n1")
+    engine = QueryEngine([seg])
+    assert engine.execute("SELECT COUNT(*) FROM t WHERE name IS NULL").rows[0][0] == 0
+
+
+def test_virtual_columns():
+    schema = Schema.build("t", dimensions=[("name", DataType.STRING)])
+    seg = SegmentBuilder(schema).build(
+        {"name": np.asarray(["a", "b", "c"], dtype=object)}, "segX"
+    )
+    engine = QueryEngine([seg])
+    r = engine.execute("SELECT $docId, $segmentName, name FROM t WHERE name != 'b' LIMIT 10")
+    assert [row[0] for row in r.rows] == [0, 2]
+    assert all(row[1] == "segX" for row in r.rows)
+    assert [row[2] for row in r.rows] == ["a", "c"]
