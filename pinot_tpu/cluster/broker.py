@@ -11,6 +11,7 @@ the reduce is the shared reduce module.
 
 from __future__ import annotations
 
+import itertools
 import time
 from concurrent.futures import ThreadPoolExecutor
 
@@ -49,6 +50,9 @@ def _collect_tables(stmt) -> list[str]:
     return out
 
 
+_request_seq = itertools.count()
+
+
 class Broker:
     def __init__(self, controller: Controller, max_scatter_threads: int = 8):
         self.controller = controller
@@ -56,8 +60,26 @@ class Broker:
         self._pool = ThreadPoolExecutor(max_workers=max_scatter_threads)
 
     def execute(self, sql: str) -> ResultTable:
+        from pinot_tpu.common.metrics import BrokerMeter, broker_metrics
+        from pinot_tpu.common.trace import start_trace
+
+        bm = broker_metrics()
+        bm.meter(BrokerMeter.QUERIES).mark()
+        try:
+            stmt = parse_sql(sql)
+            if stmt.options.get("trace", "").lower() == "true":
+                # per-query tracing (Tracing.java + `trace=true` query option)
+                with start_trace(request_id=f"q{next(_request_seq)}") as tr:
+                    result = self._execute(stmt, sql)
+                result.trace = tr.to_dict()
+                return result
+            return self._execute(stmt, sql)
+        except Exception:
+            bm.meter(BrokerMeter.REQUEST_FAILURES).mark()
+            raise
+
+    def _execute(self, stmt, sql: str) -> ResultTable:
         t0 = time.perf_counter()
-        stmt = parse_sql(sql)
         # v2 engine selection (MultiStageBrokerRequestHandler.java:88 parity):
         # joins/subqueries/set-ops/windows, or explicit SET useMultistageEngine
         use_v2 = stmt.needs_multistage or stmt.options.get("useMultistageEngine", "").lower() == "true"
@@ -92,9 +114,13 @@ class Broker:
         servers = self.controller.servers()
         hints = dict(ctx.hints)
 
+        from pinot_tpu.common.trace import active_trace, run_traced
+
+        trace = active_trace()
+
         def scatter(item):
             sid, segs = item
-            out = servers[sid].execute_partials(table, sql, segs, hints)
+            out = run_traced(trace, servers[sid].execute_partials, table, sql, segs, hints)
             if len(out[0]) != len(segs):
                 # a server silently skipping unhosted segments would mean
                 # missing rows; fail loudly instead (partial-response guard)
@@ -151,7 +177,14 @@ class Broker:
                     segs.append(got)
             catalog[table] = segs
         engine = MultistageEngine(catalog, n_workers=4, schemas=schemas)
-        return engine.execute(sql, stmt=stmt)
+        from pinot_tpu.common.trace import InvocationScope
+
+        # v2 operators are not yet individually instrumented; record one
+        # dispatch-level span so traced v2 responses are honest about scope
+        with InvocationScope("multistage:dispatch", tables=list(catalog)) as scope:
+            result = engine.execute(sql, stmt=stmt)
+            scope.set_attr("numRows", len(result.rows))
+        return result
 
     @staticmethod
     def _expand_star(stmt, schema) -> None:

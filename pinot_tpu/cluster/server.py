@@ -9,12 +9,17 @@ partials (the DataTable analog) that the broker reduces.
 
 from __future__ import annotations
 
+import itertools
 import threading
 from pathlib import Path
 
 from pinot_tpu.query.engine import QueryEngine
 from pinot_tpu.segment.loader import load_segment
 from pinot_tpu.segment.segment import ImmutableSegment
+
+
+# process-wide query sequence for accounting ids (requestId generator parity)
+_query_seq = itertools.count()
 
 
 class Server:
@@ -102,9 +107,20 @@ class Server:
                                 # empty consuming segment: zero-doc partial
                                 segs.append(c._mutable.snapshot())
                             break
-        eng = self._engine(table)
-        ctx = eng.make_context(sql)
-        if hints:
-            ctx.hints.update(hints)
-        partials, matched = eng.partials(ctx, segs)
+        from pinot_tpu.common.accounting import default_accountant
+        from pinot_tpu.common.metrics import ServerMeter, ServerTimer, server_metrics
+        from pinot_tpu.common.trace import ServerQueryPhase, phase_timer
+
+        m = server_metrics()
+        m.meter(ServerMeter.QUERIES).mark()
+        qid = f"{self.server_id}-{next(_query_seq)}"
+        with m.timer(ServerTimer.QUERY_EXECUTION).time(), default_accountant.scope(qid):
+            eng = self._engine(table)
+            with phase_timer(ServerQueryPhase.BUILD_QUERY_PLAN):
+                ctx = eng.make_context(sql)
+            if hints:
+                ctx.hints.update(hints)
+            with phase_timer(ServerQueryPhase.QUERY_PLAN_EXECUTION):
+                partials, matched = eng.partials(ctx, segs)
+        m.meter(ServerMeter.NUM_DOCS_SCANNED).mark(matched)
         return partials, matched, sum(s.n_docs for s in segs)

@@ -1,0 +1,161 @@
+"""Per-query resource accounting with watermark-based query killing.
+
+Reference parity: pinot-spi/.../accounting/ThreadResourceUsageAccountant +
+PerQueryCPUMemAccountantFactory (pinot-core/.../accounting/): worker threads
+sample their CPU time and allocated bytes against the query they serve; an
+accountant aggregates per query and, when the process crosses a critical
+memory watermark, kills the most expensive query (the reference raises
+QueryCancelledException inside operator checkpoints — here operators call
+`checkpoint()` between segment blocks). The same trackers back the REST debug
+endpoints (ThreadResourceTracker/QueryResourceTracker).
+"""
+
+from __future__ import annotations
+
+import contextvars
+import threading
+import time
+from dataclasses import dataclass, field
+
+
+class QueryKilledError(RuntimeError):
+    """Raised inside operator checkpoints when the accountant cancels the
+    query (QueryCancelledException parity)."""
+
+
+@dataclass
+class QueryResourceTracker:
+    query_id: str
+    start_ts: float = field(default_factory=time.time)
+    cpu_ns: int = 0
+    allocated_bytes: int = 0
+    segments_executed: int = 0
+    killed: bool = False
+    kill_reason: str = ""
+
+    def to_dict(self) -> dict:
+        return {
+            "queryId": self.query_id,
+            "cpuTimeNs": self.cpu_ns,
+            "allocatedBytes": self.allocated_bytes,
+            "segmentsExecuted": self.segments_executed,
+            "ageSec": round(time.time() - self.start_ts, 3),
+            "killed": self.killed,
+        }
+
+
+_current_query: contextvars.ContextVar[str | None] = contextvars.ContextVar("pinot_query_id", default=None)
+
+
+class ResourceAccountant:
+    """Aggregates per-query usage; enforces a byte budget across in-flight
+    queries. `heap_limit_bytes` is the critical watermark: when total tracked
+    allocation exceeds it, the largest query is killed (the reference's
+    "kill most expensive query on critical heap usage" policy)."""
+
+    def __init__(self, heap_limit_bytes: int | None = None, per_query_limit_bytes: int | None = None):
+        self.heap_limit_bytes = heap_limit_bytes
+        self.per_query_limit_bytes = per_query_limit_bytes
+        self._queries: dict[str, QueryResourceTracker] = {}
+        self._lock = threading.Lock()
+
+    # -- query lifecycle ----------------------------------------------------
+
+    def register(self, query_id: str) -> QueryResourceTracker:
+        with self._lock:
+            tr = self._queries.get(query_id)
+            if tr is None:
+                tr = QueryResourceTracker(query_id)
+                self._queries[query_id] = tr
+            return tr
+
+    def unregister(self, query_id: str) -> None:
+        with self._lock:
+            self._queries.pop(query_id, None)
+
+    class _Scope:
+        def __init__(self, acct, query_id):
+            self._acct = acct
+            self._qid = query_id
+
+        def __enter__(self):
+            self._token = _current_query.set(self._qid)
+            return self._acct.register(self._qid)
+
+        def __exit__(self, *exc):
+            _current_query.reset(self._token)
+            self._acct.unregister(self._qid)
+            return False
+
+    def scope(self, query_id: str) -> "_Scope":
+        """Context manager: register + bind the query to this thread."""
+        return ResourceAccountant._Scope(self, query_id)
+
+    # -- sampling (called by worker threads) --------------------------------
+
+    def sample(self, query_id: str | None = None, cpu_ns: int = 0, allocated_bytes: int = 0, segments: int = 0) -> None:
+        qid = query_id or _current_query.get()
+        if qid is None:
+            return
+        with self._lock:
+            tr = self._queries.get(qid)
+            if tr is None:
+                return
+            tr.cpu_ns += cpu_ns
+            tr.allocated_bytes += allocated_bytes
+            tr.segments_executed += segments
+        self._enforce()
+
+    def checkpoint(self, query_id: str | None = None) -> None:
+        """Operator checkpoint: raise if this query has been killed
+        (Tracing.ThreadAccountantOps.sampleAndCheckInterruption parity)."""
+        qid = query_id or _current_query.get()
+        if qid is None:
+            return
+        with self._lock:
+            tr = self._queries.get(qid)
+            if tr is not None and tr.killed:
+                raise QueryKilledError(f"query {qid} killed: {tr.kill_reason}")
+
+    # -- enforcement --------------------------------------------------------
+
+    def kill(self, query_id: str, reason: str) -> bool:
+        with self._lock:
+            tr = self._queries.get(query_id)
+            if tr is None or tr.killed:
+                return False
+            tr.killed = True
+            tr.kill_reason = reason
+            return True
+
+    def _enforce(self) -> None:
+        with self._lock:
+            live = [t for t in self._queries.values() if not t.killed]
+            victims = []
+            if self.per_query_limit_bytes is not None:
+                for t in live:
+                    if t.allocated_bytes > self.per_query_limit_bytes:
+                        victims.append((t, f"per-query memory {t.allocated_bytes}B > limit {self.per_query_limit_bytes}B"))
+            if self.heap_limit_bytes is not None:
+                total = sum(t.allocated_bytes for t in live)
+                if total > self.heap_limit_bytes and live:
+                    worst = max(live, key=lambda t: t.allocated_bytes)
+                    victims.append((worst, f"total memory {total}B > watermark {self.heap_limit_bytes}B; killing most expensive"))
+            for t, reason in victims:
+                if not t.killed:
+                    t.killed = True
+                    t.kill_reason = reason
+        if victims:
+            from pinot_tpu.common.metrics import ServerMeter, server_metrics
+
+            server_metrics().meter(ServerMeter.QUERIES_KILLED).mark(len({id(t) for t, _ in victims}))
+
+    # -- debug endpoints (REST /debug/query/resourceUsage parity) -----------
+
+    def query_trackers(self) -> list[dict]:
+        with self._lock:
+            return [t.to_dict() for t in self._queries.values()]
+
+
+# default process-wide accountant (no limits => tracking only)
+default_accountant = ResourceAccountant()

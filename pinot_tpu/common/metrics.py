@@ -1,0 +1,237 @@
+"""Metrics registry: typed meters / gauges / timers per node role.
+
+Reference parity: PinotMetricsRegistry SPI (pinot-spi/.../metrics/) with the
+yammer/dropwizard plugins collapsed into one thread-safe in-process registry,
+and the typed per-role metric enums of pinot-common/.../metrics/
+(ServerMeter, ServerGauge, ServerTimer, BrokerMeter, BrokerGauge,
+ControllerMeter, MinionMeter). Only the metric *kinds* the TPU build emits are
+enumerated; arbitrary names are still accepted (the reference allows dynamic
+table-suffixed metric names the same way).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from enum import Enum
+
+
+class MetricKind(Enum):
+    METER = "meter"
+    GAUGE = "gauge"
+    TIMER = "timer"
+
+
+class Meter:
+    """Monotone event counter (yammer Meter parity, without rate decay —
+    rates are derived by scrapers from (count, first_ts, last_ts))."""
+
+    __slots__ = ("count", "first_ts", "last_ts", "_lock")
+
+    def __init__(self):
+        self.count = 0
+        self.first_ts = None
+        self.last_ts = None
+        self._lock = threading.Lock()
+
+    def mark(self, n: int = 1) -> None:
+        now = time.time()
+        with self._lock:
+            self.count += n
+            if self.first_ts is None:
+                self.first_ts = now
+            self.last_ts = now
+
+    def one_minute_rate(self) -> float:
+        with self._lock:
+            if not self.count or self.first_ts is None or self.last_ts == self.first_ts:
+                return 0.0
+            return self.count / max(self.last_ts - self.first_ts, 1e-9)
+
+
+class Gauge:
+    """Settable point-in-time value (ServerGauge.LLC_PARTITION_CONSUMING style)."""
+
+    __slots__ = ("value", "_lock")
+
+    def __init__(self):
+        self.value = 0
+        self._lock = threading.Lock()
+
+    def set(self, v) -> None:
+        with self._lock:
+            self.value = v
+
+    def add(self, delta) -> None:
+        with self._lock:
+            self.value += delta
+
+
+class Timer:
+    """Duration recorder with count/total/min/max (yammer Timer parity)."""
+
+    __slots__ = ("count", "total_ms", "min_ms", "max_ms", "_lock")
+
+    def __init__(self):
+        self.count = 0
+        self.total_ms = 0.0
+        self.min_ms = float("inf")
+        self.max_ms = 0.0
+        self._lock = threading.Lock()
+
+    def update_ms(self, ms: float) -> None:
+        with self._lock:
+            self.count += 1
+            self.total_ms += ms
+            self.min_ms = min(self.min_ms, ms)
+            self.max_ms = max(self.max_ms, ms)
+
+    def mean_ms(self) -> float:
+        with self._lock:
+            return self.total_ms / self.count if self.count else 0.0
+
+    class _Ctx:
+        __slots__ = ("_timer", "_t0")
+
+        def __init__(self, timer):
+            self._timer = timer
+
+        def __enter__(self):
+            self._t0 = time.perf_counter()
+            return self
+
+        def __exit__(self, *exc):
+            self._timer.update_ms((time.perf_counter() - self._t0) * 1e3)
+            return False
+
+    def time(self) -> "_Ctx":
+        return Timer._Ctx(self)
+
+
+class MetricsRegistry:
+    """Thread-safe name -> metric registry (PinotMetricsRegistry parity)."""
+
+    def __init__(self, role: str = ""):
+        self.role = role
+        self._metrics: dict[str, object] = {}
+        self._lock = threading.Lock()
+
+    def _get(self, name, cls):
+        key = name.value if isinstance(name, Enum) else str(name)
+        with self._lock:
+            m = self._metrics.get(key)
+            if m is None:
+                m = cls()
+                self._metrics[key] = m
+            elif not isinstance(m, cls):
+                raise TypeError(f"metric {key} already registered as {type(m).__name__}")
+            return m
+
+    def meter(self, name) -> Meter:
+        return self._get(name, Meter)
+
+    def gauge(self, name) -> Gauge:
+        return self._get(name, Gauge)
+
+    def timer(self, name) -> Timer:
+        return self._get(name, Timer)
+
+    def snapshot(self) -> dict:
+        """Flat JSON-able dump (the JMX/exposition analog)."""
+        out = {}
+        with self._lock:
+            items = list(self._metrics.items())
+        for k, m in items:
+            if isinstance(m, Meter):
+                out[k] = {"type": "meter", "count": m.count}
+            elif isinstance(m, Gauge):
+                out[k] = {"type": "gauge", "value": m.value}
+            elif isinstance(m, Timer):
+                out[k] = {
+                    "type": "timer",
+                    "count": m.count,
+                    "meanMs": m.mean_ms(),
+                    "maxMs": m.max_ms if m.count else 0.0,
+                }
+        return out
+
+
+# -- typed metric names (subset of pinot-common/.../metrics enums) -----------
+
+
+class ServerMeter(Enum):
+    QUERIES = "server.queries"
+    NUM_DOCS_SCANNED = "server.numDocsScanned"
+    NUM_SEGMENTS_QUERIED = "server.numSegmentsQueried"
+    NUM_SEGMENTS_PRUNED = "server.numSegmentsPruned"
+    DEVICE_FALLBACKS = "server.deviceFallbacks"
+    REALTIME_ROWS_CONSUMED = "server.realtimeRowsConsumed"
+    QUERIES_KILLED = "server.queriesKilled"
+    SCHEDULING_TIMEOUTS = "server.schedulingTimeouts"
+
+
+class ServerGauge(Enum):
+    SEGMENT_COUNT = "server.segmentCount"
+    LLC_PARTITION_CONSUMING = "server.llcPartitionConsuming"
+    UPSERT_PRIMARY_KEYS = "server.upsertPrimaryKeysCount"
+    DEVICE_BYTES_RESIDENT = "server.deviceBytesResident"
+
+
+class ServerTimer(Enum):
+    QUERY_EXECUTION = "server.queryExecutionMs"
+    SEGMENT_LOAD = "server.segmentLoadMs"
+    DEVICE_EXECUTION = "server.deviceExecutionMs"
+
+
+class BrokerMeter(Enum):
+    QUERIES = "broker.queries"
+    NO_SERVING_HOST = "broker.noServingHostForSegment"
+    REQUEST_FAILURES = "broker.requestFailures"
+    DOCS_SCANNED = "broker.docsScanned"
+
+
+class BrokerGauge(Enum):
+    ONLINE_SERVERS = "broker.onlineServers"
+
+
+class BrokerTimer(Enum):
+    QUERY_TOTAL = "broker.queryTotalMs"
+    REDUCE = "broker.reduceMs"
+    SCATTER_GATHER = "broker.scatterGatherMs"
+
+
+class ControllerMeter(Enum):
+    SEGMENT_UPLOADS = "controller.segmentUploads"
+    TABLE_ADDS = "controller.tableAdds"
+
+
+class MinionMeter(Enum):
+    TASKS_EXECUTED = "minion.tasksExecuted"
+    TASKS_FAILED = "minion.tasksFailed"
+
+
+# global per-role registries (the reference holds one registry per started
+# service; in-process multi-role tests share by role name)
+_registries: dict[str, MetricsRegistry] = {}
+_reg_lock = threading.Lock()
+
+
+def get_registry(role: str) -> MetricsRegistry:
+    with _reg_lock:
+        r = _registries.get(role)
+        if r is None:
+            r = MetricsRegistry(role)
+            _registries[role] = r
+        return r
+
+
+def reset_registries() -> None:
+    """Test hook."""
+    with _reg_lock:
+        _registries.clear()
+
+
+server_metrics = lambda: get_registry("server")  # noqa: E731
+broker_metrics = lambda: get_registry("broker")  # noqa: E731
+controller_metrics = lambda: get_registry("controller")  # noqa: E731
+minion_metrics = lambda: get_registry("minion")  # noqa: E731

@@ -1,0 +1,164 @@
+"""Request tracing: pluggable tracer, spans, per-phase timers.
+
+Reference parity: pinot-spi/.../trace/Tracing.java (atomic global Tracer
+registration, default no-op), InvocationScope spans around operators,
+TraceRunnable-style context propagation across combine threads
+(pinot-core/.../util/trace/TraceRunnable.java — here via contextvars, which
+thread pools propagate when the submitting code copies the context), and
+per-phase timers TimerContext/ServerQueryPhase
+(ServerQueryExecutorV1Impl.java:161-166). Tracing is enabled per query via
+the `trace=true` query option; spans surface in the broker response the way
+the reference attaches a trace JSON blob.
+"""
+
+from __future__ import annotations
+
+import contextvars
+import threading
+import time
+from dataclasses import dataclass, field
+from enum import Enum
+
+
+class ServerQueryPhase(Enum):
+    REQUEST_DESERIALIZATION = "requestDeserialization"
+    TOTAL_QUERY_TIME = "totalQueryTime"
+    SEGMENT_PRUNING = "segmentPruning"
+    BUILD_QUERY_PLAN = "buildQueryPlan"
+    QUERY_PLAN_EXECUTION = "queryPlanExecution"
+    RESPONSE_SERIALIZATION = "responseSerialization"
+    SCHEDULER_WAIT = "schedulerWait"
+
+
+@dataclass
+class Span:
+    name: str
+    start_ms: float
+    duration_ms: float = 0.0
+    children: list = field(default_factory=list)
+    attrs: dict = field(default_factory=dict)
+
+    def to_dict(self) -> dict:
+        d = {"name": self.name, "startMs": round(self.start_ms, 3), "durationMs": round(self.duration_ms, 3)}
+        if self.attrs:
+            d["attrs"] = dict(self.attrs)
+        if self.children:
+            d["children"] = [c.to_dict() for c in self.children]
+        return d
+
+
+class RequestTrace:
+    """Per-request span tree. Thread-safe: combine workers append concurrently."""
+
+    def __init__(self, request_id: str = ""):
+        self.request_id = request_id
+        self.root = Span("request", 0.0)
+        self._t0 = time.perf_counter()
+        self._lock = threading.Lock()
+        self.phase_ms: dict[str, float] = {}
+
+    def now_ms(self) -> float:
+        return (time.perf_counter() - self._t0) * 1e3
+
+    def add_span(self, span: Span, parent: Span | None = None) -> None:
+        with self._lock:
+            (parent or self.root).children.append(span)
+
+    def record_phase(self, phase: ServerQueryPhase, ms: float) -> None:
+        with self._lock:
+            self.phase_ms[phase.value] = self.phase_ms.get(phase.value, 0.0) + ms
+
+    def to_dict(self) -> dict:
+        with self._lock:
+            return {
+                "requestId": self.request_id,
+                "phaseTimesMs": {k: round(v, 3) for k, v in self.phase_ms.items()},
+                "spans": [c.to_dict() for c in self.root.children],
+            }
+
+
+# active trace for the current execution context (None = tracing disabled,
+# the no-op default). contextvars gives TraceRunnable-style propagation into
+# threads when callers copy_context() (ThreadPoolExecutor map does not copy
+# automatically; the combine path passes the trace explicitly instead).
+_active: contextvars.ContextVar[RequestTrace | None] = contextvars.ContextVar("pinot_trace", default=None)
+
+
+def active_trace() -> RequestTrace | None:
+    return _active.get()
+
+
+class start_trace:
+    """Context manager enabling tracing for the dynamic extent of a request."""
+
+    def __init__(self, request_id: str = ""):
+        self.trace = RequestTrace(request_id)
+
+    def __enter__(self) -> RequestTrace:
+        self._token = _active.set(self.trace)
+        return self.trace
+
+    def __exit__(self, *exc):
+        _active.reset(self._token)
+        return False
+
+
+class InvocationScope:
+    """Span around an operator/kernel invocation. No-op when tracing is off
+    (Tracing.java default NoOpTracer parity: near-zero overhead)."""
+
+    __slots__ = ("name", "attrs", "_trace", "_span", "_t0", "_parent")
+
+    def __init__(self, name: str, parent: Span | None = None, **attrs):
+        self.name = name
+        self.attrs = attrs
+        self._parent = parent
+        self._trace = _active.get()
+
+    def __enter__(self) -> "InvocationScope":
+        if self._trace is not None:
+            self._t0 = time.perf_counter()
+            self._span = Span(self.name, self._trace.now_ms(), attrs=self.attrs)
+        return self
+
+    def set_attr(self, key: str, value) -> None:
+        if self._trace is not None:
+            self._span.attrs[key] = value
+
+    def __exit__(self, *exc):
+        if self._trace is not None:
+            self._span.duration_ms = (time.perf_counter() - self._t0) * 1e3
+            self._trace.add_span(self._span, self._parent)
+        return False
+
+
+class phase_timer:
+    """Times one ServerQueryPhase into the active trace (TimerContext parity).
+    Always times; only records when tracing is active."""
+
+    def __init__(self, phase: ServerQueryPhase):
+        self.phase = phase
+
+    def __enter__(self):
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        tr = _active.get()
+        if tr is not None:
+            tr.record_phase(self.phase, (time.perf_counter() - self._t0) * 1e3)
+        return False
+
+
+def run_traced(trace: RequestTrace | None, fn, *args, **kwargs):
+    """Run fn with `trace` active — the TraceRunnable analog for worker
+    threads that did not inherit the submitting context."""
+    if trace is None:
+        return fn(*args, **kwargs)
+    ctx = contextvars.copy_context()
+
+    def _inner():
+        _active.set(trace)
+        return fn(*args, **kwargs)
+
+    return ctx.run(_inner)

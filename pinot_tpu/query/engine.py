@@ -56,18 +56,31 @@ class QueryEngine:
     def partials(self, ctx: QueryContext, segments: list[ImmutableSegment] | None = None):
         """Server-side half: per-segment partials + matched doc count.
         (ServerQueryExecutorV1Impl role; the broker reduce consumes these.)"""
+        from pinot_tpu.common.accounting import default_accountant
+        from pinot_tpu.common.metrics import ServerMeter, server_metrics
+        from pinot_tpu.common.trace import InvocationScope
         from pinot_tpu.query import pruner
 
         out = []
         scanned = 0
+        pruned = 0
         for seg in self.segments if segments is None else segments:
+            default_accountant.checkpoint()
             if not pruner.can_match(seg, ctx):
                 # bloom/min-max pruned: contribute a canonical empty partial
                 out.append(pruner.empty_partial(ctx))
+                pruned += 1
                 continue
-            partial, matched = self._execute_segment(seg, ctx)
+            with InvocationScope(f"segment:{seg.name}") as scope:
+                partial, matched = self._execute_segment(seg, ctx)
+                scope.set_attr("numDocsMatched", matched)
+            default_accountant.sample(segments=1, allocated_bytes=seg.size_bytes)
             out.append(partial)
             scanned += matched
+        m = server_metrics()
+        m.meter(ServerMeter.NUM_SEGMENTS_QUERIED).mark(len(out) - pruned)
+        if pruned:
+            m.meter(ServerMeter.NUM_SEGMENTS_PRUNED).mark(pruned)
         return out, scanned
 
     @staticmethod

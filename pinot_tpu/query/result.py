@@ -23,6 +23,9 @@ class ResultTable:
     num_segments_queried: int = 0
     num_segments_pruned: int = 0
     time_used_ms: float = 0.0
+    # populated when the query ran with `SET trace=true` (the reference
+    # attaches a trace JSON blob to BrokerResponse the same way)
+    trace: dict | None = None
 
     def __post_init__(self):
         self.rows = [[_plain(v) for v in row] for row in self.rows]
@@ -30,7 +33,7 @@ class ResultTable:
             self.column_types = [_infer_type(self.rows, i) for i in range(len(self.columns))]
 
     def to_dict(self) -> dict:
-        return {
+        d = {
             "resultTable": {
                 "dataSchema": {"columnNames": self.columns, "columnDataTypes": self.column_types},
                 "rows": self.rows,
@@ -41,6 +44,9 @@ class ResultTable:
             "numSegmentsPrunedByServer": self.num_segments_pruned,
             "timeUsedMs": self.time_used_ms,
         }
+        if self.trace is not None:
+            d["traceInfo"] = self.trace
+        return d
 
     def __repr__(self) -> str:  # human-friendly table
         head = " | ".join(self.columns)

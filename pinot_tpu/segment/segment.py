@@ -75,6 +75,22 @@ class ImmutableSegment:
             raise KeyError(f"segment {self.name} has no column {name!r}")
         return self.columns[name]
 
+    @property
+    def size_bytes(self) -> int:
+        """Resident host-memory estimate (forward arrays + dictionaries);
+        feeds resource accounting the way segment sizes feed the reference's
+        memory accountant."""
+        total = 0
+        for ci in self.columns.values():
+            fwd = getattr(ci, "forward", None)
+            if isinstance(fwd, np.ndarray):
+                total += fwd.nbytes
+            d = getattr(ci, "dictionary", None)
+            vals = getattr(d, "values", None)
+            if isinstance(vals, np.ndarray) and vals.dtype != object:
+                total += vals.nbytes
+        return total
+
     def to_device(self, fast32: bool = False) -> "DeviceSegment":
         """Stage to device memory.
 

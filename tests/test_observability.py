@@ -1,0 +1,222 @@
+"""Metrics registry, tracing spans/phases, resource accounting + query kill.
+
+Reference test model: metrics enum usage across pinot-common/.../metrics,
+Tracing.java default no-op tracer, PerQueryCPUMemAccountantFactory killing
+semantics (SURVEY.md §5.1/§5.5).
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+from pinot_tpu.common.accounting import QueryKilledError, ResourceAccountant
+from pinot_tpu.common.metrics import (
+    BrokerMeter,
+    MetricsRegistry,
+    ServerMeter,
+    get_registry,
+    reset_registries,
+)
+from pinot_tpu.common.trace import (
+    InvocationScope,
+    ServerQueryPhase,
+    active_trace,
+    phase_timer,
+    run_traced,
+    start_trace,
+)
+
+
+# -- metrics ----------------------------------------------------------------
+
+
+def test_meter_gauge_timer_basics():
+    reg = MetricsRegistry("test")
+    reg.meter("m").mark()
+    reg.meter("m").mark(4)
+    assert reg.meter("m").count == 5
+    reg.gauge("g").set(7)
+    reg.gauge("g").add(3)
+    assert reg.gauge("g").value == 10
+    with reg.timer("t").time():
+        pass
+    assert reg.timer("t").count == 1
+    snap = reg.snapshot()
+    assert snap["m"]["count"] == 5
+    assert snap["g"]["value"] == 10
+    assert snap["t"]["type"] == "timer"
+
+
+def test_metric_kind_conflict_raises():
+    reg = MetricsRegistry("test")
+    reg.meter("x")
+    with pytest.raises(TypeError):
+        reg.gauge("x")
+
+
+def test_registry_thread_safety():
+    reg = MetricsRegistry("test")
+
+    def work():
+        for _ in range(1000):
+            reg.meter("c").mark()
+
+    ts = [threading.Thread(target=work) for _ in range(8)]
+    [t.start() for t in ts]
+    [t.join() for t in ts]
+    assert reg.meter("c").count == 8000
+
+
+def test_role_registries_shared():
+    reset_registries()
+    get_registry("server").meter(ServerMeter.QUERIES).mark()
+    assert get_registry("server").meter(ServerMeter.QUERIES).count == 1
+    assert get_registry("broker").meter(BrokerMeter.QUERIES).count == 0
+    reset_registries()
+
+
+# -- tracing ----------------------------------------------------------------
+
+
+def test_tracing_disabled_is_noop():
+    assert active_trace() is None
+    with InvocationScope("op") as s:
+        s.set_attr("k", 1)  # must not blow up with tracing off
+    with phase_timer(ServerQueryPhase.BUILD_QUERY_PLAN):
+        pass
+    assert active_trace() is None
+
+
+def test_trace_spans_and_phases():
+    with start_trace("q1") as tr:
+        with phase_timer(ServerQueryPhase.BUILD_QUERY_PLAN):
+            pass
+        with InvocationScope("segment:s0", numDocs=10) as s:
+            s.set_attr("matched", 3)
+    d = tr.to_dict()
+    assert d["requestId"] == "q1"
+    assert "buildQueryPlan" in d["phaseTimesMs"]
+    assert d["spans"][0]["name"] == "segment:s0"
+    assert d["spans"][0]["attrs"]["matched"] == 3
+
+
+def test_run_traced_propagates_to_worker_thread():
+    """TraceRunnable parity: worker threads record into the submitting
+    request's trace."""
+    results = []
+
+    def worker():
+        with InvocationScope("inner"):
+            pass
+        results.append(active_trace())
+
+    with start_trace("q2") as tr:
+        t = threading.Thread(target=run_traced, args=(tr, worker))
+        t.start()
+        t.join()
+    assert results[0] is tr
+    assert tr.to_dict()["spans"][0]["name"] == "inner"
+
+
+def test_traced_cluster_query(tmp_path):
+    """End-to-end: SET trace=true surfaces per-segment spans in the response."""
+    from pinot_tpu.cluster import Broker, Controller, PropertyStore, Server
+    from pinot_tpu.common import DataType, Schema, TableConfig
+    from pinot_tpu.segment import SegmentBuilder
+
+    controller = Controller(PropertyStore(), tmp_path / "deepstore")
+    for i in range(2):
+        controller.register_server(f"server_{i}", Server(f"server_{i}"))
+    schema = Schema.build("t", dimensions=[("d", DataType.INT)], metrics=[("v", DataType.LONG)])
+    controller.add_schema(schema)
+    controller.add_table(TableConfig("t"))
+    b = SegmentBuilder(schema)
+    for i in range(3):
+        controller.upload_segment(
+            "t",
+            b.build({"d": np.arange(50, dtype=np.int32) % 5, "v": np.arange(50, dtype=np.int64)}, f"t_{i}"),
+        )
+    broker = Broker(controller)
+    res = broker.execute("SET trace=true; SELECT COUNT(*) FROM t WHERE v > 0")
+    assert res.rows[0][0] == 3 * 49
+    assert res.trace is not None
+    names = [s["name"] for s in res.trace["spans"]]
+    assert any(n.startswith("segment:") for n in names)
+    # plain query carries no trace
+    res2 = broker.execute("SELECT COUNT(*) FROM t")
+    assert res2.trace is None
+
+
+# -- accounting -------------------------------------------------------------
+
+
+def test_accountant_tracks_and_unregisters():
+    acct = ResourceAccountant()
+    with acct.scope("q1"):
+        acct.sample(allocated_bytes=100, segments=2)
+        trackers = acct.query_trackers()
+        assert trackers[0]["allocatedBytes"] == 100
+        assert trackers[0]["segmentsExecuted"] == 2
+    assert acct.query_trackers() == []
+
+
+def test_per_query_limit_kills():
+    acct = ResourceAccountant(per_query_limit_bytes=50)
+    with acct.scope("q1"):
+        acct.sample(allocated_bytes=100)
+        with pytest.raises(QueryKilledError):
+            acct.checkpoint()
+
+
+def test_watermark_kills_most_expensive():
+    acct = ResourceAccountant(heap_limit_bytes=150)
+    acct.register("small")
+    acct.register("big")
+    acct.sample("small", allocated_bytes=40)
+    acct.sample("big", allocated_bytes=90)
+    # total 130 < 150: both alive
+    acct.checkpoint("big")
+    acct.sample("small", allocated_bytes=40)  # total 170 > 150
+    with pytest.raises(QueryKilledError):
+        acct.checkpoint("big")  # 90 is the most expensive -> killed
+    acct.checkpoint("small")  # survivor unaffected
+
+
+def test_accounting_wired_through_server_path(tmp_path):
+    """The server registers each query with the default accountant, so a
+    per-query byte limit kills real queries mid-execution (the reference's
+    operator-checkpoint cancellation)."""
+    from pinot_tpu.cluster import Broker, Controller, PropertyStore, Server
+    from pinot_tpu.common import DataType, Schema, TableConfig
+    from pinot_tpu.common.accounting import default_accountant
+    from pinot_tpu.segment import SegmentBuilder
+
+    controller = Controller(PropertyStore(), tmp_path / "deepstore")
+    controller.register_server("server_0", Server("server_0"))
+    schema = Schema.build("t", dimensions=[("d", DataType.INT)], metrics=[("v", DataType.LONG)])
+    controller.add_schema(schema)
+    controller.add_table(TableConfig("t"))
+    b = SegmentBuilder(schema)
+    for i in range(3):
+        controller.upload_segment(
+            "t", b.build({"d": np.arange(64, dtype=np.int32), "v": np.arange(64, dtype=np.int64)}, f"t_{i}")
+        )
+    broker = Broker(controller)
+    assert broker.execute("SELECT COUNT(*) FROM t").rows[0][0] == 192
+    default_accountant.per_query_limit_bytes = 1  # below any segment size
+    try:
+        with pytest.raises(Exception) as ei:
+            broker.execute("SELECT COUNT(*) FROM t")
+        assert "killed" in str(ei.value)
+    finally:
+        default_accountant.per_query_limit_bytes = None
+
+
+def test_explicit_kill():
+    acct = ResourceAccountant()
+    acct.register("q")
+    assert acct.kill("q", "admin") is True
+    assert acct.kill("q", "again") is False
+    with pytest.raises(QueryKilledError):
+        acct.checkpoint("q")
