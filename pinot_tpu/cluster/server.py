@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import itertools
 import threading
+import time
 from pathlib import Path
 
 from pinot_tpu.query.engine import QueryEngine
@@ -23,7 +24,10 @@ _query_seq = itertools.count()
 
 
 class Server:
-    def __init__(self, server_id: str, fast32: bool = False):
+    def __init__(self, server_id: str, fast32: bool = False, scheduler=None):
+        """`scheduler`: optional QueryScheduler (query/scheduler.py). When set,
+        execute_partials routes through it (QueryScheduler.submit parity);
+        None executes inline (the in-process test default)."""
         self.server_id = server_id
         self._tables: dict[str, dict[str, ImmutableSegment]] = {}
         self._engines: dict[str, QueryEngine] = {}
@@ -31,6 +35,13 @@ class Server:
         self._lock = threading.RLock()
 
         self._fast32 = fast32
+        self._scheduler = scheduler
+        if scheduler is not None:
+            scheduler.start()
+
+    def shutdown(self) -> None:
+        if self._scheduler is not None:
+            self._scheduler.stop()
 
     # -- realtime ------------------------------------------------------------
 
@@ -85,10 +96,30 @@ class Server:
 
     # -- query execution -----------------------------------------------------
 
-    def execute_partials(self, table: str, sql: str, segment_names: list[str], hints: dict | None = None):
+    def execute_partials(
+        self, table: str, sql: str, segment_names: list[str], hints: dict | None = None, workload: str = "PRIMARY"
+    ):
         """Run the per-segment half for the requested segments; returns
         (partials, matched_docs, total_docs). The broker passes hints (e.g.
-        global percentile bounds) so partials merge across servers."""
+        global percentile bounds) so partials merge across servers. With a
+        scheduler configured, execution queues behind its policy; the caller
+        blocks on the future (QueryScheduler.submit parity)."""
+        if self._scheduler is not None:
+            from pinot_tpu.common.trace import ServerQueryPhase, active_trace, run_traced
+
+            trace = active_trace()
+            t_sub = time.perf_counter()
+
+            def run():
+                if trace is not None:
+                    trace.record_phase(ServerQueryPhase.SCHEDULER_WAIT, (time.perf_counter() - t_sub) * 1e3)
+                return self._execute_partials(table, sql, segment_names, hints)
+
+            fut = self._scheduler.submit(run_traced, trace, run, table=table, workload=workload)
+            return fut.result()
+        return self._execute_partials(table, sql, segment_names, hints)
+
+    def _execute_partials(self, table: str, sql: str, segment_names: list[str], hints: dict | None = None):
         with self._lock:
             hosted = self._tables.get(table, {})
             rt = self._realtime.get(table)

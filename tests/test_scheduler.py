@@ -1,0 +1,210 @@
+"""Query schedulers: FCFS, priority token-bucket, binary workload.
+
+Reference test model: pinot-core scheduler tests (PrioritySchedulerTest,
+MultiLevelPriorityQueueTest, BinaryWorkloadSchedulerTest patterns).
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from pinot_tpu.query.scheduler import (
+    BinaryWorkloadScheduler,
+    FCFSScheduler,
+    PriorityScheduler,
+    SchedulerRejectedError,
+    make_scheduler,
+)
+
+
+def test_fcfs_runs_and_returns():
+    s = FCFSScheduler(num_runners=2)
+    s.start()
+    try:
+        futs = [s.submit(lambda i=i: i * i) for i in range(10)]
+        assert [f.result(timeout=5) for f in futs] == [i * i for i in range(10)]
+    finally:
+        s.stop()
+
+
+def test_fcfs_propagates_exceptions():
+    s = FCFSScheduler(num_runners=1)
+    s.start()
+    try:
+        fut = s.submit(lambda: 1 / 0)
+        with pytest.raises(ZeroDivisionError):
+            fut.result(timeout=5)
+    finally:
+        s.stop()
+
+
+def test_fcfs_preserves_arrival_order_single_runner():
+    s = FCFSScheduler(num_runners=1)
+    order = []
+    gate = threading.Event()
+
+    def job(i):
+        gate.wait(5)
+        order.append(i)
+
+    s.start()
+    try:
+        futs = [s.submit(job, i) for i in range(5)]
+        gate.set()
+        [f.result(timeout=5) for f in futs]
+        assert order == list(range(5))
+    finally:
+        s.stop()
+
+
+def test_submit_after_stop_rejects():
+    s = FCFSScheduler(num_runners=1)
+    s.start()
+    s.stop()
+    with pytest.raises(SchedulerRejectedError):
+        s.submit(lambda: 1)
+
+
+def test_priority_group_queue_overflow_rejects():
+    s = PriorityScheduler(num_runners=1, max_pending_per_group=2)
+    gate = threading.Event()
+    started = threading.Event()
+
+    def block():
+        started.set()
+        gate.wait(5)
+
+    s.start()
+    try:
+        blocker = s.submit(block, table="t")
+        assert started.wait(5)  # blocker occupies the runner, queue is empty
+        s.submit(lambda: 1, table="t")
+        s.submit(lambda: 2, table="t")
+        with pytest.raises(SchedulerRejectedError):
+            s.submit(lambda: 3, table="t")
+        gate.set()
+        blocker.result(timeout=5)
+    finally:
+        s.stop()
+
+
+def test_priority_tokens_throttle_heavy_group():
+    """After group A burns wall-clock on the runner, group B (fresh tokens)
+    is served first from the backlog."""
+    s = PriorityScheduler(num_runners=1, tokens_per_sec=0.01, token_burst_sec=5.0)
+    order = []
+    gate = threading.Event()
+    s.start()
+    try:
+        # occupy the single runner while we build a backlog
+        blocker = s.submit(gate.wait, 5, table="A")
+        # burn A's tokens synthetically (as if A ran for 10s)
+        with s._lock:
+            s._bucket("A").spend(10.0)
+        futs = [s.submit(order.append, ("A", i), table="A") for i in range(3)]
+        futs += [s.submit(order.append, ("B", i), table="B") for i in range(3)]
+        gate.set()
+        blocker.result(timeout=5)
+        [f.result(timeout=5) for f in futs]
+        # all of B's backlog drains before any of A's
+        assert order[:3] == [("B", 0), ("B", 1), ("B", 2)], order
+        toks = s.group_tokens()
+        assert toks["A"] < toks["B"]
+    finally:
+        s.stop()
+
+
+def test_binary_workload_secondary_capped():
+    """SECONDARY jobs never occupy more than secondary_runners threads even
+    with idle runners available."""
+    s = BinaryWorkloadScheduler(num_runners=3, secondary_runners=1)
+    running = []
+    peak = []
+    lock = threading.Lock()
+    gate = threading.Event()
+
+    def job():
+        with lock:
+            running.append(1)
+            peak.append(len(running))
+        gate.wait(5)
+        with lock:
+            running.pop()
+
+    s.start()
+    try:
+        futs = [s.submit(job, workload="SECONDARY") for _ in range(4)]
+        time.sleep(0.3)
+        gate.set()
+        [f.result(timeout=5) for f in futs]
+        assert max(peak) == 1
+    finally:
+        s.stop()
+
+
+def test_binary_workload_primary_unblocked_by_secondary():
+    s = BinaryWorkloadScheduler(num_runners=2, secondary_runners=1)
+    gate = threading.Event()
+    s.start()
+    try:
+        sec = s.submit(gate.wait, 5, workload="SECONDARY")
+        # primary gets the remaining runner immediately
+        assert s.submit(lambda: "p", workload="PRIMARY").result(timeout=2) == "p"
+        gate.set()
+        sec.result(timeout=5)
+    finally:
+        s.stop()
+
+
+def test_binary_workload_secondary_queue_overflow():
+    s = BinaryWorkloadScheduler(num_runners=1, secondary_runners=1, max_secondary_pending=1)
+    gate = threading.Event()
+    s.start()
+    try:
+        blocker = s.submit(gate.wait, 5, workload="PRIMARY")  # occupy runner
+        s.submit(lambda: 1, workload="SECONDARY")
+        with pytest.raises(SchedulerRejectedError):
+            s.submit(lambda: 2, workload="SECONDARY")
+        gate.set()
+        blocker.result(timeout=5)
+    finally:
+        s.stop()
+
+
+def test_make_scheduler_factory():
+    assert isinstance(make_scheduler("fcfs"), FCFSScheduler)
+    assert isinstance(make_scheduler("priority"), PriorityScheduler)
+    assert isinstance(make_scheduler("binary_workload"), BinaryWorkloadScheduler)
+    with pytest.raises(ValueError):
+        make_scheduler("nope")
+
+
+def test_server_routes_through_scheduler(tmp_path):
+    """Server(scheduler=...) executes queries on scheduler runners and
+    records SCHEDULER_WAIT when traced."""
+    from pinot_tpu.cluster import Broker, Controller, PropertyStore, Server
+    from pinot_tpu.common import DataType, Schema, TableConfig
+    from pinot_tpu.segment import SegmentBuilder
+
+    controller = Controller(PropertyStore(), tmp_path / "deepstore")
+    server = Server("server_0", scheduler=FCFSScheduler(num_runners=2))
+    controller.register_server("server_0", server)
+    schema = Schema.build("t", dimensions=[("d", DataType.INT)], metrics=[("v", DataType.LONG)])
+    controller.add_schema(schema)
+    controller.add_table(TableConfig("t"))
+    controller.upload_segment(
+        "t",
+        SegmentBuilder(schema).build(
+            {"d": np.arange(32, dtype=np.int32), "v": np.arange(32, dtype=np.int64)}, "t_0"
+        ),
+    )
+    broker = Broker(controller)
+    try:
+        assert broker.execute("SELECT COUNT(*) FROM t").rows[0][0] == 32
+        res = broker.execute("SET trace=true; SELECT SUM(v) FROM t")
+        assert res.rows[0][0] == float(np.arange(32).sum())
+        assert "schedulerWait" in res.trace["phaseTimesMs"]
+    finally:
+        server.shutdown()
