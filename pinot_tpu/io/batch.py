@@ -1,0 +1,129 @@
+"""Batch ingestion: segment-generation job spec + standalone runner.
+
+Reference parity: pinot-spi/.../ingestion/batch/spec/SegmentGenerationJobSpec
+(inputDirURI, includeFileNamePattern, outputDirURI, jobType, recordReaderSpec,
+segmentNameGeneratorSpec, pushJobSpec) executed by
+pinot-plugins/pinot-batch-ingestion/ runners (standalone/Hadoop/Spark —
+here one threaded standalone runner; a distributed runner is a map of this
+same per-file function, which is exactly what the Spark/Hadoop runners do).
+Job types: SegmentCreation, SegmentCreationAndTarPush (push = hand the built
+segment to the controller, the tar-upload analog).
+"""
+
+from __future__ import annotations
+
+import fnmatch
+from concurrent.futures import ThreadPoolExecutor
+from dataclasses import dataclass, field
+from pathlib import Path, PurePosixPath
+
+import numpy as np
+
+from pinot_tpu.common.types import DataType, Schema
+from pinot_tpu.io.fs import LocalFS, get_fs
+from pinot_tpu.io.readers import open_record_reader
+
+
+@dataclass
+class SegmentGenerationJobSpec:
+    table_name: str
+    schema: Schema
+    input_dir_uri: str
+    job_type: str = "SegmentCreation"  # or SegmentCreationAndTarPush
+    include_file_name_pattern: str = "*"
+    input_format: str | None = None  # None = by extension
+    output_dir_uri: str | None = None
+    segment_name_prefix: str | None = None  # default: table name
+    table_config: object | None = None
+    parallelism: int = 1
+    # optional row-level transform applied before building (the
+    # RecordTransformer/ingestion-transform analog): cols dict -> cols dict
+    transform: object | None = None
+    extra: dict = field(default_factory=dict)
+
+
+def _coerce(schema: Schema, cols: dict[str, np.ndarray]) -> dict[str, np.ndarray]:
+    """Project to schema columns and cast to declared types
+    (DataTypeTransformer parity)."""
+    out = {}
+    for name, spec in schema.fields.items():
+        if name not in cols:
+            raise KeyError(f"input missing schema column {name!r}")
+        v = cols[name]
+        dt = spec.data_type
+        if dt == DataType.INT:
+            out[name] = np.asarray(v, dtype=np.int32) if v.dtype != np.int32 else v
+        elif dt == DataType.LONG:
+            out[name] = np.asarray(v, dtype=np.int64) if v.dtype != np.int64 else v
+        elif dt == DataType.FLOAT:
+            out[name] = np.asarray(v, dtype=np.float32) if v.dtype != np.float32 else v
+        elif dt == DataType.DOUBLE:
+            out[name] = np.asarray(v, dtype=np.float64) if v.dtype != np.float64 else v
+        elif dt == DataType.STRING:
+            out[name] = v if v.dtype == object else np.asarray([str(x) for x in v], dtype=object)
+        else:
+            out[name] = v
+    return out
+
+
+def run_segment_generation_job(spec: SegmentGenerationJobSpec, controller=None) -> list[str]:
+    """Execute the job; returns written segment directories (SegmentCreation)
+    and pushes to `controller` when job_type ends with TarPush
+    (LaunchDataIngestionJobCommand -> SegmentGenerationJobRunner parity)."""
+    from pinot_tpu.segment.builder import SegmentBuilder, write_segment
+
+    fs = get_fs(spec.input_dir_uri)
+    files = [
+        f
+        for f in fs.list_files(spec.input_dir_uri, recursive=True)
+        if fnmatch.fnmatch(PurePosixPath(f).name, spec.include_file_name_pattern)
+    ]
+    if not files:
+        raise FileNotFoundError(
+            f"no input files matching {spec.include_file_name_pattern!r} under {spec.input_dir_uri}"
+        )
+    push = spec.job_type.endswith("TarPush")
+    if push and controller is None:
+        raise ValueError(f"job type {spec.job_type} requires a controller to push to")
+    if not push and spec.output_dir_uri is None:
+        raise ValueError("SegmentCreation requires output_dir_uri")
+    prefix = spec.segment_name_prefix or spec.table_name
+    builder = SegmentBuilder(spec.schema, spec.table_config)
+
+    local = isinstance(fs, LocalFS)
+
+    def one(idx_file):
+        i, fpath = idx_file
+        if local:
+            reader = open_record_reader(fpath, spec.input_format)
+        else:
+            # non-local FS (object store / mem): stage through a temp file,
+            # the copyToLocal step every non-standalone runner performs
+            import tempfile
+
+            suffix = PurePosixPath(fpath).suffix or (f".{spec.input_format}" if spec.input_format else "")
+            with tempfile.NamedTemporaryFile(suffix=suffix, delete=False) as tmp:
+                tmp.write(fs.read_bytes(fpath))
+                staged = tmp.name
+            reader = open_record_reader(staged, spec.input_format)
+        try:
+            cols = reader.read_columns()
+        finally:
+            reader.close()
+            if not local:
+                Path(staged).unlink(missing_ok=True)
+        if spec.transform is not None:
+            cols = spec.transform(cols)
+        cols = _coerce(spec.schema, cols)
+        # sequence id in the segment name (SimpleSegmentNameGenerator parity)
+        seg = builder.build(cols, f"{prefix}_{i}")
+        if push:
+            controller.upload_segment(spec.table_name, seg)
+            return seg.name
+        out = write_segment(seg, Path(spec.output_dir_uri))
+        return str(out)
+
+    if spec.parallelism > 1:
+        with ThreadPoolExecutor(max_workers=spec.parallelism) as pool:
+            return list(pool.map(one, enumerate(files)))
+    return [one(x) for x in enumerate(files)]

@@ -1,0 +1,239 @@
+"""Filesystem SPI, record readers, batch segment-generation jobs.
+
+Reference test model: pinot-spi filesystem tests, pinot-input-format reader
+tests, batch-ingestion standalone runner tests (SURVEY.md §2.4).
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from pinot_tpu.common import DataType, Schema, TableConfig
+from pinot_tpu.io import (
+    CSVRecordReader,
+    JSONRecordReader,
+    LocalFS,
+    MemFS,
+    SegmentGenerationJobSpec,
+    get_fs,
+    open_record_reader,
+    register_fs,
+    run_segment_generation_job,
+)
+
+
+# -- filesystems ------------------------------------------------------------
+
+
+def test_local_fs_roundtrip(tmp_path):
+    fs = LocalFS()
+    root = str(tmp_path)
+    fs.mkdir(f"{root}/a/b")
+    fs.write_bytes(f"{root}/a/b/x.txt", b"hello")
+    assert fs.exists(f"{root}/a/b/x.txt")
+    assert fs.length(f"{root}/a/b/x.txt") == 5
+    assert fs.read_bytes(f"{root}/a/b/x.txt") == b"hello"
+    assert fs.is_directory(f"{root}/a")
+    assert fs.list_files(f"{root}/a", recursive=True) == [f"{root}/a/b/x.txt"]
+    assert fs.copy(f"{root}/a/b/x.txt", f"{root}/y.txt")
+    assert fs.move(f"{root}/y.txt", f"{root}/z.txt")
+    assert not fs.exists(f"{root}/y.txt")
+    assert fs.delete(f"{root}/z.txt")
+    # non-empty dir needs force
+    assert not fs.delete(f"{root}/a")
+    assert fs.delete(f"{root}/a", force=True)
+
+
+def test_local_fs_file_uri_scheme(tmp_path):
+    fs = get_fs("file:///")
+    fs.write_bytes(f"file://{tmp_path}/u.txt", b"via-uri")
+    assert fs.read_bytes(f"file://{tmp_path}/u.txt") == b"via-uri"
+
+
+def test_mem_fs_roundtrip():
+    fs = MemFS()
+    fs.write_bytes("mem://bucket/dir/a.csv", b"1,2")
+    fs.write_bytes("mem://bucket/dir/sub/b.csv", b"3,4")
+    assert fs.exists("mem://bucket/dir/a.csv")
+    assert fs.length("mem://bucket/dir/a.csv") == 3
+    assert fs.is_directory("mem://bucket/dir")
+    files = fs.list_files("mem://bucket/dir")
+    assert len(files) == 1 and files[0].endswith("a.csv")
+    assert len(fs.list_files("mem://bucket/dir", recursive=True)) == 2
+    assert fs.move("mem://bucket/dir/a.csv", "mem://bucket/dir/c.csv")
+    assert not fs.exists("mem://bucket/dir/a.csv")
+    assert fs.delete("mem://bucket/dir", force=True)
+    assert not fs.exists("mem://bucket/dir/c.csv")
+
+
+def test_get_fs_unknown_scheme_raises():
+    with pytest.raises(ValueError, match="no PinotFS"):
+        get_fs("s3-unregistered://bucket/x")
+
+
+def test_register_custom_fs():
+    fs = MemFS()
+    register_fs("customscheme", fs)
+    assert get_fs("customscheme://x/y") is fs
+
+
+# -- record readers ---------------------------------------------------------
+
+
+def test_csv_reader(tmp_path):
+    p = tmp_path / "d.csv"
+    p.write_text("name,age,score\nalice,30,1.5\nbob,41,2.25\n")
+    rows = list(CSVRecordReader(p))
+    assert rows == [
+        {"name": "alice", "age": 30, "score": 1.5},
+        {"name": "bob", "age": 41, "score": 2.25},
+    ]
+    cols = CSVRecordReader(p).read_columns()
+    assert cols["age"].dtype == np.int64
+    assert cols["score"].dtype == np.float64
+    assert cols["name"].dtype == object
+
+
+def test_json_array_and_jsonl(tmp_path):
+    arr = tmp_path / "a.json"
+    arr.write_text(json.dumps([{"x": 1, "meta": {"k": "v"}}, {"x": 2, "meta": {"k": "w"}}]))
+    rows = list(JSONRecordReader(arr))
+    assert rows[0]["x"] == 1
+    assert json.loads(rows[0]["meta"]) == {"k": "v"}  # nested stays JSON text
+    jl = tmp_path / "b.jsonl"
+    jl.write_text('{"x": 3}\n{"x": 4}\n')
+    assert [r["x"] for r in JSONRecordReader(jl)] == [3, 4]
+
+
+def test_parquet_reader(tmp_path):
+    pa = pytest.importorskip("pyarrow")
+    import pyarrow.parquet as pq
+
+    t = pa.table({"k": ["a", "b", "c"], "v": [1, 2, 3]})
+    p = tmp_path / "t.parquet"
+    pq.write_table(t, p)
+    cols = open_record_reader(p).read_columns()
+    assert list(cols["v"]) == [1, 2, 3]
+    assert list(cols["k"]) == ["a", "b", "c"]
+
+
+def test_open_record_reader_by_format_and_unknown(tmp_path):
+    p = tmp_path / "data.txt"
+    p.write_text("a,b\n1,2\n")
+    assert isinstance(open_record_reader(p, fmt="csv"), CSVRecordReader)
+    with pytest.raises(ValueError, match="no RecordReader"):
+        open_record_reader(p)
+
+
+def test_avro_gated():
+    with pytest.raises((ImportError, ValueError)):
+        open_record_reader("x.avro")
+
+
+# -- batch jobs -------------------------------------------------------------
+
+
+def _schema():
+    return Schema.build(
+        "events",
+        dimensions=[("kind", DataType.STRING)],
+        metrics=[("value", DataType.LONG)],
+    )
+
+
+def test_segment_creation_job_local(tmp_path):
+    for i in range(3):
+        (tmp_path / f"in{i}.csv").write_text("kind,value\n" + "".join(f"k{j % 2},{j + i}\n" for j in range(10)))
+    spec = SegmentGenerationJobSpec(
+        table_name="events",
+        schema=_schema(),
+        input_dir_uri=str(tmp_path),
+        include_file_name_pattern="in*.csv",
+        output_dir_uri=str(tmp_path / "out"),
+        parallelism=2,
+    )
+    seg_dirs = run_segment_generation_job(spec)
+    assert len(seg_dirs) == 3
+    from pinot_tpu.query.engine import QueryEngine
+    from pinot_tpu.segment import load_segment
+
+    engine = QueryEngine([load_segment(d) for d in seg_dirs])
+    assert engine.execute("SELECT COUNT(*) FROM events").rows[0][0] == 30
+    assert engine.execute("SELECT SUM(value) FROM events WHERE kind = 'k0'").rows[0][0] > 0
+
+
+def test_segment_creation_and_push_job(tmp_path):
+    """SegmentCreationAndTarPush: built segments land on cluster servers and
+    are queryable through the broker."""
+    from pinot_tpu.cluster import Broker, Controller, PropertyStore, Server
+
+    (tmp_path / "in.jsonl").write_text("\n".join(json.dumps({"kind": f"k{i % 3}", "value": i}) for i in range(20)))
+    controller = Controller(PropertyStore(), tmp_path / "deepstore")
+    controller.register_server("server_0", Server("server_0"))
+    schema = _schema()
+    controller.add_schema(schema)
+    controller.add_table(TableConfig("events"))
+    spec = SegmentGenerationJobSpec(
+        table_name="events",
+        schema=schema,
+        input_dir_uri=str(tmp_path),
+        job_type="SegmentCreationAndTarPush",
+        include_file_name_pattern="*.jsonl",
+    )
+    names = run_segment_generation_job(spec, controller=controller)
+    assert names == ["events_0"]
+    res = Broker(controller).execute("SELECT kind, COUNT(*) FROM events GROUP BY kind ORDER BY kind")
+    assert [r[1] for r in res.rows] == [7, 7, 6]
+
+
+def test_job_from_mem_fs():
+    """Inputs on a non-local PinotFS stage through copy-to-local."""
+    fs = MemFS()
+    register_fs("memjob", fs)
+    fs.write_bytes("memjob://in/part.csv", b"kind,value\nk0,5\nk1,6\n")
+    spec = SegmentGenerationJobSpec(
+        table_name="events",
+        schema=_schema(),
+        input_dir_uri="memjob://in",
+        job_type="SegmentCreationAndTarPush",
+    )
+    from pinot_tpu.cluster import Controller, PropertyStore, Server
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as d:
+        controller = Controller(PropertyStore(), d)
+        controller.register_server("server_0", Server("server_0"))
+        controller.add_schema(_schema())
+        controller.add_table(TableConfig("events"))
+        assert run_segment_generation_job(spec, controller=controller) == ["events_0"]
+
+
+def test_job_transform_hook(tmp_path):
+    """Ingestion transform (RecordTransformer analog) runs before build."""
+    (tmp_path / "x.csv").write_text("kind,value\nk0,1\nk1,2\n")
+
+    def double(cols):
+        cols["value"] = cols["value"] * 2
+        return cols
+
+    spec = SegmentGenerationJobSpec(
+        table_name="events",
+        schema=_schema(),
+        input_dir_uri=str(tmp_path),
+        output_dir_uri=str(tmp_path / "out"),
+        transform=double,
+    )
+    [d] = run_segment_generation_job(spec)
+    from pinot_tpu.query.engine import QueryEngine
+    from pinot_tpu.segment import load_segment
+
+    assert QueryEngine([load_segment(d)]).execute("SELECT SUM(value) FROM events").rows[0][0] == 6.0
+
+
+def test_job_no_inputs_raises(tmp_path):
+    spec = SegmentGenerationJobSpec(
+        table_name="t", schema=_schema(), input_dir_uri=str(tmp_path), output_dir_uri=str(tmp_path / "o")
+    )
+    with pytest.raises(FileNotFoundError):
+        run_segment_generation_job(spec)
