@@ -106,6 +106,31 @@ class Controller:
         ranked = sorted(load, key=lambda s: (load[s], s))
         return ranked[: max(1, min(replication, len(ranked)))]
 
+    def delete_segment(self, table: str, segment_name: str, remove_from_deep_store: bool = True) -> None:
+        """Drop a segment: server unload transitions, ideal-state removal,
+        metadata + deep-store cleanup (SegmentDeletionManager parity)."""
+        ideal = self.store.get(f"/tables/{table}/idealstate") or {}
+        for sid in ideal.pop(segment_name, {}):
+            srv = self._servers.get(sid)
+            if srv is not None:
+                srv.remove_segment(table, segment_name)
+        self.store.set(f"/tables/{table}/idealstate", ideal)
+        meta = self.store.get(f"/tables/{table}/segments/{segment_name}")
+        self.store.delete(f"/tables/{table}/segments/{segment_name}")
+        if remove_from_deep_store and meta and meta.get("location"):
+            import shutil
+
+            shutil.rmtree(meta["location"], ignore_errors=True)
+
+    def replace_segments(self, table: str, old_names: list[str], new_segments: list[ImmutableSegment]) -> None:
+        """Atomic-enough swap (segment-lineage startReplaceSegments/
+        endReplaceSegments parity): upload replacements first, then drop the
+        originals, so readers always see a complete data set."""
+        for seg in new_segments:
+            self.upload_segment(table, seg)
+        for name in old_names:
+            self.delete_segment(table, name)
+
     # -- realtime segment state (LLC CONSUMING entries) ----------------------
 
     def set_segment_state(self, table: str, segment: str, server_id: str, state: str | None) -> None:

@@ -1,0 +1,20 @@
+from pinot_tpu.minion.framework import (
+    Minion,
+    PinotTaskExecutor,
+    PinotTaskManager,
+    TaskConfig,
+    TaskGenerator,
+    TaskState,
+)
+from pinot_tpu.minion.processing import SegmentProcessorConfig, process_segments
+
+__all__ = [
+    "Minion",
+    "PinotTaskExecutor",
+    "PinotTaskManager",
+    "TaskConfig",
+    "TaskGenerator",
+    "TaskState",
+    "SegmentProcessorConfig",
+    "process_segments",
+]

@@ -1,0 +1,130 @@
+"""Segment processing framework: map (partition/filter) -> reduce (rollup /
+concat / dedup) -> rebuild segments.
+
+Reference parity: pinot-core/.../segment/processing/framework/
+SegmentProcessorFramework — mappers apply time filtering + partitioning
+(SegmentMapper), reducers concat or rollup rows per partition
+(ConcatReducer/RollupReducer), then SegmentIndexCreationDriver rebuilds
+output segments. Used by the merge/rollup/purge/realtime-to-offline minion
+tasks. Here the whole pipeline is columnar (numpy), not row-by-row: the TPU
+build's segments decode to columns, and rollup is a pandas groupby —
+the same dense-group-id reduction the query engine uses on device.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Callable
+
+import numpy as np
+import pandas as pd
+
+from pinot_tpu.common.types import Schema
+from pinot_tpu.segment.segment import ImmutableSegment
+
+# metric rollup aggregators (RollupReducer's ValueAggregators)
+_AGGS = {"SUM": "sum", "MIN": "min", "MAX": "max", "COUNT": "sum"}
+
+
+@dataclass
+class SegmentProcessorConfig:
+    schema: Schema
+    table_config: object | None = None
+    # MAP phase ------------------------------------------------------------
+    # keep rows where time_column in [window_start, window_end)
+    time_column: str | None = None
+    window_start: float | None = None
+    window_end: float | None = None
+    # arbitrary row filter: cols dict -> bool mask (purge / record filter)
+    filter_fn: Callable[[dict[str, np.ndarray]], np.ndarray] | None = None
+    # partition output by column value hash into N parts (PartitionerConfig)
+    partition_column: str | None = None
+    num_partitions: int = 1
+    # REDUCE phase ---------------------------------------------------------
+    merge_type: str = "CONCAT"  # CONCAT | ROLLUP | DEDUP
+    # rollup: metric column -> SUM/MIN/MAX (default SUM)
+    rollup_aggregates: dict[str, str] = field(default_factory=dict)
+    # output --------------------------------------------------------------
+    max_rows_per_segment: int = 5_000_000
+    segment_name_prefix: str = "processed"
+
+
+def _segment_columns(seg: ImmutableSegment) -> dict[str, np.ndarray]:
+    """Decode a segment back to raw column values (reader-side of the map)."""
+    return {name: ci.materialize() for name, ci in seg.columns.items()}
+
+
+def process_segments(segments: list[ImmutableSegment], cfg: SegmentProcessorConfig) -> list[ImmutableSegment]:
+    """Run the full map/reduce over input segments; returns new segments."""
+    from pinot_tpu.segment.builder import SegmentBuilder
+
+    # MAP: decode + filter each input segment
+    parts: list[dict[str, np.ndarray]] = []
+    for seg in segments:
+        cols = _segment_columns(seg)
+        n = seg.n_docs
+        mask = np.ones(n, dtype=bool)
+        if cfg.time_column is not None and (cfg.window_start is not None or cfg.window_end is not None):
+            t = cols[cfg.time_column].astype(np.float64)
+            if cfg.window_start is not None:
+                mask &= t >= cfg.window_start
+            if cfg.window_end is not None:
+                mask &= t < cfg.window_end
+        if cfg.filter_fn is not None:
+            mask &= np.asarray(cfg.filter_fn(cols), dtype=bool)
+        if not mask.all():
+            cols = {k: v[mask] for k, v in cols.items()}
+        if len(next(iter(cols.values()), [])):
+            parts.append(cols)
+    if not parts:
+        return []
+
+    merged = {k: np.concatenate([p[k] for p in parts]) for k in parts[0]}
+
+    # REDUCE
+    if cfg.merge_type.upper() in ("ROLLUP", "DEDUP"):
+        df = pd.DataFrame({k: (v if v.dtype != object else v.astype(object)) for k, v in merged.items()})
+        dims = [c for c in cfg.schema.dimension_columns if c in df.columns]
+        if cfg.time_column and cfg.time_column not in dims and cfg.time_column in df.columns:
+            dims.append(cfg.time_column)
+        if cfg.merge_type.upper() == "DEDUP":
+            df = df.drop_duplicates(subset=dims or None, keep="first")
+        else:
+            metrics = [c for c in df.columns if c not in dims]
+            how = {m: _AGGS.get(cfg.rollup_aggregates.get(m, "SUM").upper(), "sum") for m in metrics}
+            df = df.groupby(dims, as_index=False, sort=True).agg(how) if dims else df.agg(how).to_frame().T
+        merged = {}
+        for c in df.columns:
+            v = df[c].to_numpy()
+            orig = next((p[c] for p in parts if c in p), None)
+            if orig is not None and orig.dtype != object and v.dtype == object:
+                v = v.astype(orig.dtype)
+            elif orig is not None and orig.dtype != object and v.dtype != orig.dtype:
+                v = v.astype(orig.dtype)
+            merged[c] = v
+
+    # PARTITION + split into output segments
+    builder = SegmentBuilder(cfg.schema, cfg.table_config)
+    groups: list[tuple[str, dict[str, np.ndarray]]] = []
+    if cfg.partition_column is not None and cfg.num_partitions > 1:
+        pc = merged[cfg.partition_column]
+        if pc.dtype == object:
+            h = np.asarray([hash(x) for x in pc], dtype=np.int64)
+        else:
+            h = pc.astype(np.int64)
+        pid = np.abs(h) % cfg.num_partitions
+        for p in range(cfg.num_partitions):
+            sel = pid == p
+            if sel.any():
+                groups.append((f"p{p}", {k: v[sel] for k, v in merged.items()}))
+    else:
+        groups.append(("", merged))
+
+    out: list[ImmutableSegment] = []
+    for tag, cols in groups:
+        n = len(next(iter(cols.values())))
+        for start in range(0, n, cfg.max_rows_per_segment):
+            chunk = {k: v[start : start + cfg.max_rows_per_segment] for k, v in cols.items()}
+            name = f"{cfg.segment_name_prefix}{('_' + tag) if tag else ''}_{len(out)}"
+            out.append(builder.build(chunk, name))
+    return out

@@ -1,0 +1,297 @@
+"""Minion task framework, segment processing, built-in tasks.
+
+Reference test model: pinot-minion executor tests + builtin-task integration
+tests (MergeRollupMinionClusterIntegrationTest, PurgeMinionClusterIntegrationTest,
+RealtimeToOfflineSegmentsMinionClusterIntegrationTest patterns, SURVEY.md §2.4).
+"""
+
+import numpy as np
+import pytest
+
+from pinot_tpu.cluster import Broker, Controller, PropertyStore, Server
+from pinot_tpu.common import DataType, Schema, TableConfig, TableType
+from pinot_tpu.minion import (
+    Minion,
+    PinotTaskManager,
+    SegmentProcessorConfig,
+    TaskConfig,
+    TaskState,
+    process_segments,
+)
+from pinot_tpu.minion.tasks import (
+    RECORD_PURGER_REGISTRY,
+    make_minion_with_builtins,
+)
+from pinot_tpu.segment import SegmentBuilder
+
+
+def _schema(name="events"):
+    return Schema.build(
+        name,
+        dimensions=[("kind", DataType.STRING)],
+        metrics=[("value", DataType.LONG)],
+        date_times=[("ts", DataType.LONG)],
+    )
+
+
+def _cluster(tmp_path, table_cfg: TableConfig, schema=None):
+    controller = Controller(PropertyStore(), tmp_path / "deepstore")
+    server = Server("server_0")
+    controller.register_server("server_0", server)
+    schema = schema or _schema(table_cfg.table_name)
+    controller.add_schema(schema)
+    controller.add_table(table_cfg)
+    tm = PinotTaskManager(controller)
+    minion = make_minion_with_builtins("minion_0", tm, controller)
+    return controller, server, tm, minion, schema
+
+
+def _seg(schema, name, kinds, values, ts=None):
+    data = {
+        "kind": np.asarray(kinds, dtype=object),
+        "value": np.asarray(values, dtype=np.int64),
+        "ts": np.asarray(ts if ts is not None else np.zeros(len(values)), dtype=np.int64),
+    }
+    return SegmentBuilder(schema).build(data, name)
+
+
+# -- segment processing framework -------------------------------------------
+
+
+def test_process_concat_and_split():
+    schema = _schema()
+    a = _seg(schema, "a", ["x", "y"], [1, 2])
+    b = _seg(schema, "b", ["z"], [3])
+    out = process_segments([a, b], SegmentProcessorConfig(schema=schema, max_rows_per_segment=2))
+    assert [s.n_docs for s in out] == [2, 1]
+    assert sum(s.n_docs for s in out) == 3
+
+
+def test_process_rollup():
+    schema = _schema()
+    a = _seg(schema, "a", ["x", "x", "y"], [1, 2, 5], ts=[10, 10, 10])
+    cfg = SegmentProcessorConfig(schema=schema, merge_type="ROLLUP", time_column="ts")
+    [seg] = process_segments([a], cfg)
+    assert seg.n_docs == 2  # (x,10) rolled up
+    vals = dict(zip(seg.columns["kind"].materialize(), seg.columns["value"].materialize()))
+    assert vals == {"x": 3, "y": 5}
+
+
+def test_process_rollup_min_max():
+    schema = _schema()
+    a = _seg(schema, "a", ["x", "x"], [4, 9], ts=[1, 1])
+    cfg = SegmentProcessorConfig(
+        schema=schema, merge_type="ROLLUP", time_column="ts", rollup_aggregates={"value": "MAX"}
+    )
+    [seg] = process_segments([a], cfg)
+    assert list(seg.columns["value"].materialize()) == [9]
+
+
+def test_process_time_window_filter():
+    schema = _schema()
+    a = _seg(schema, "a", ["x", "y", "z"], [1, 2, 3], ts=[5, 15, 25])
+    cfg = SegmentProcessorConfig(schema=schema, time_column="ts", window_start=10, window_end=20)
+    [seg] = process_segments([a], cfg)
+    assert list(seg.columns["kind"].materialize()) == ["y"]
+
+
+def test_process_partition():
+    schema = _schema()
+    a = _seg(schema, "a", ["x"] * 10, list(range(10)), ts=list(range(10)))
+    cfg = SegmentProcessorConfig(schema=schema, partition_column="ts", num_partitions=2)
+    out = process_segments([a], cfg)
+    assert len(out) == 2
+    assert sum(s.n_docs for s in out) == 10
+    # partition by ts % 2
+    for seg in out:
+        ts = seg.columns["ts"].materialize()
+        assert len(set(t % 2 for t in ts)) == 1
+
+
+def test_process_dedup():
+    schema = _schema()
+    a = _seg(schema, "a", ["x", "x", "y"], [7, 7, 8], ts=[1, 1, 2])
+    cfg = SegmentProcessorConfig(schema=schema, merge_type="DEDUP", time_column="ts")
+    [seg] = process_segments([a], cfg)
+    assert seg.n_docs == 2
+
+
+# -- framework ---------------------------------------------------------------
+
+
+def test_task_lifecycle_and_failure(tmp_path):
+    controller, server, tm, minion, schema = _cluster(tmp_path, TableConfig("events", time_column="ts"))
+
+    class BoomExecutor:
+        task_type = "BoomTask"
+
+        def execute(self, task, controller):
+            raise RuntimeError("boom")
+
+    minion.register_executor(BoomExecutor())
+    t = tm.submit(TaskConfig("BoomTask", "events"))
+    assert tm.task_state(t.task_id) == TaskState.WAITING
+    assert minion.run_pending() == 1
+    assert tm.task_state(t.task_id) == TaskState.FAILED
+    assert "boom" in t.error
+
+
+def test_minion_background_thread(tmp_path):
+    import time
+
+    controller, server, tm, minion, schema = _cluster(tmp_path, TableConfig("events", time_column="ts"))
+
+    class OkExecutor:
+        task_type = "OkTask"
+
+        def execute(self, task, controller):
+            return 42
+
+    minion.register_executor(OkExecutor())
+    minion.start(poll_interval=0.01)
+    try:
+        t = tm.submit(TaskConfig("OkTask", "events"))
+        for _ in range(200):
+            if tm.task_state(t.task_id) == TaskState.COMPLETED:
+                break
+            time.sleep(0.01)
+        assert tm.task_state(t.task_id) == TaskState.COMPLETED
+        assert t.result == 42
+    finally:
+        minion.stop()
+
+
+# -- built-in tasks ----------------------------------------------------------
+
+
+def test_merge_rollup_task(tmp_path):
+    tc = TableConfig("events", time_column="ts")
+    tc.extra = {"mergeRollup": {"mergeType": "ROLLUP", "minNumSegments": 2}}
+    controller, server, tm, minion, schema = _cluster(tmp_path, tc)
+    controller.upload_segment("events", _seg(schema, "s0", ["x", "y"], [1, 2], ts=[1, 1]))
+    controller.upload_segment("events", _seg(schema, "s1", ["x"], [10], ts=[1]))
+
+    tasks = tm.schedule_tasks()
+    assert [t.task_type for t in tasks] == ["MergeRollupTask"]
+    assert minion.run_pending() == 1
+    assert tasks[0].state == TaskState.COMPLETED, tasks[0].error
+
+    broker = Broker(controller)
+    res = broker.execute("SELECT kind, SUM(value) FROM events GROUP BY kind ORDER BY kind")
+    assert [list(r) for r in res.rows] == [["x", 11.0], ["y", 2.0]]
+    # originals replaced by the merged segment
+    assert all(not n.startswith("s") for n in controller.ideal_state("events"))
+
+
+def test_purge_task(tmp_path):
+    tc = TableConfig("events", time_column="ts")
+    controller, server, tm, minion, schema = _cluster(tmp_path, tc)
+    controller.upload_segment("events", _seg(schema, "s0", ["keep", "drop", "keep"], [1, 2, 3], ts=[1, 2, 3]))
+    RECORD_PURGER_REGISTRY["events"] = lambda cols: cols["kind"] == "drop"
+    try:
+        tasks = tm.schedule_tasks("PurgeTask")
+        assert len(tasks) == 1
+        minion.run_pending()
+        assert tasks[0].state == TaskState.COMPLETED, tasks[0].error
+        res = Broker(controller).execute("SELECT COUNT(*) FROM events")
+        assert res.rows[0][0] == 2
+    finally:
+        del RECORD_PURGER_REGISTRY["events"]
+
+
+def test_realtime_to_offline_task(tmp_path):
+    rt = TableConfig("events_rt", TableType.REALTIME, time_column="ts")
+    rt.extra = {
+        "realtimeToOffline": {"bucketTimeMs": 100, "startTimeMs": 0, "offlineTable": "events"}
+    }
+    controller, server, tm, minion, schema = _cluster(tmp_path, rt, schema=_schema("events_rt"))
+    controller.add_schema(_schema("events"))
+    controller.add_table(TableConfig("events", time_column="ts"))
+    # window [0,100) is complete because a row exists at ts=150
+    controller.upload_segment("events_rt", _seg(schema, "r0", ["x", "y"], [1, 2], ts=[10, 150]))
+
+    tasks = tm.schedule_tasks("RealtimeToOfflineSegmentsTask")
+    assert len(tasks) == 1
+    minion.run_pending()
+    assert tasks[0].state == TaskState.COMPLETED, tasks[0].error
+    res = Broker(controller).execute("SELECT COUNT(*) FROM events")
+    assert res.rows[0][0] == 1  # only ts=10 moved
+    # watermark advanced; next schedule finds nothing new
+    assert controller.store.get("/tables/events_rt/r2o_watermark")["ts"] == 100
+    assert tm.schedule_tasks("RealtimeToOfflineSegmentsTask") == []
+
+
+def test_refresh_segment_task(tmp_path):
+    tc = TableConfig("events", time_column="ts")
+    tc.extra = {"refreshEpoch": 1}
+    controller, server, tm, minion, schema = _cluster(tmp_path, tc)
+    controller.upload_segment("events", _seg(schema, "s0", ["x"], [1], ts=[1]))
+    tasks = tm.schedule_tasks("RefreshSegmentTask")
+    assert len(tasks) == 1
+    minion.run_pending()
+    assert tasks[0].state == TaskState.COMPLETED, tasks[0].error
+    assert controller.segment_metadata("events", "s0")["refreshEpoch"] == 1
+    # second schedule is a no-op (epoch recorded)
+    assert tm.schedule_tasks("RefreshSegmentTask") == []
+    assert Broker(controller).execute("SELECT COUNT(*) FROM events").rows[0][0] == 1
+
+
+def test_upsert_compaction_task(tmp_path):
+    from pinot_tpu.common import UpsertConfig
+
+    schema = Schema.build(
+        "ups",
+        dimensions=[("pk", DataType.STRING)],
+        metrics=[("value", DataType.LONG)],
+        date_times=[("ts", DataType.LONG)],
+        primary_key_columns=["pk"],
+    )
+    tc = TableConfig("ups", time_column="ts", upsert=UpsertConfig())
+    tc.extra = {"upsertCompaction": {"invalidRecordsThresholdPercent": 30.0}}
+    controller, server, tm, minion, _ = _cluster(tmp_path, tc, schema=schema)
+    seg = SegmentBuilder(schema).build(
+        {
+            "pk": np.asarray(["a", "a", "a", "b"], dtype=object),
+            "value": np.asarray([1, 2, 3, 9], dtype=np.int64),
+            "ts": np.asarray([1, 2, 3, 1], dtype=np.int64),
+        },
+        "u0",
+    )
+    controller.upload_segment("ups", seg)
+    # attach a validity mask on the server's live object: only the latest
+    # per-PK docs valid (2 of 4)
+    live = server.get_segment_object("ups", "u0")
+    live.extras["valid_docs"] = lambda n: np.asarray([False, False, True, True])
+
+    tasks = tm.schedule_tasks("UpsertCompactionTask")
+    assert len(tasks) == 1
+    minion.run_pending()
+    assert tasks[0].state == TaskState.COMPLETED, tasks[0].error
+    assert tasks[0].result["keptDocs"] == 2
+    res = Broker(controller).execute("SELECT pk, value FROM ups ORDER BY pk LIMIT 10")
+    assert [list(r) for r in res.rows] == [["a", 3], ["b", 9]]
+
+
+def test_segment_generation_and_push_task(tmp_path):
+    controller, server, tm, minion, schema = _cluster(tmp_path, TableConfig("events", time_column="ts"))
+    (tmp_path / "in.csv").write_text("kind,value,ts\nk0,1,5\nk1,2,6\n")
+    t = tm.submit(
+        TaskConfig(
+            "SegmentGenerationAndPushTask",
+            "events",
+            {"inputDirURI": str(tmp_path), "includeFileNamePattern": "*.csv"},
+        )
+    )
+    minion.run_pending()
+    assert t.state == TaskState.COMPLETED, t.error
+    assert Broker(controller).execute("SELECT COUNT(*) FROM events").rows[0][0] == 2
+
+
+def test_table_task_type_gating(tmp_path):
+    """A table restricting taskTypes only gets those tasks."""
+    tc = TableConfig("events", time_column="ts")
+    tc.extra = {"mergeRollup": {"minNumSegments": 1}, "refreshEpoch": 1, "taskTypes": ["RefreshSegmentTask"]}
+    controller, server, tm, minion, schema = _cluster(tmp_path, tc)
+    controller.upload_segment("events", _seg(schema, "s0", ["x"], [1], ts=[1]))
+    kinds = {t.task_type for t in tm.schedule_tasks()}
+    assert kinds == {"RefreshSegmentTask"}
