@@ -1,0 +1,16 @@
+from pinot_tpu.timeseries.plan import (
+    LeafTimeSeriesPlanNode,
+    TimeSeriesBlock,
+    TransformNode,
+    parse_timeseries,
+)
+from pinot_tpu.timeseries.engine import RangeTimeSeriesRequest, TimeSeriesEngine
+
+__all__ = [
+    "LeafTimeSeriesPlanNode",
+    "TimeSeriesBlock",
+    "TransformNode",
+    "parse_timeseries",
+    "RangeTimeSeriesRequest",
+    "TimeSeriesEngine",
+]

@@ -1,0 +1,165 @@
+"""Timeseries engine: language parsing, leaf execution, series transforms.
+
+Reference test model: pinot-timeseries SPI + m3ql plugin tests and the
+runtime tests in pinot-query-runtime/.../timeseries (SURVEY.md §2.4).
+"""
+
+import numpy as np
+import pytest
+
+from pinot_tpu.common import DataType, Schema
+from pinot_tpu.query.engine import QueryEngine
+from pinot_tpu.segment import SegmentBuilder
+from pinot_tpu.timeseries import (
+    LeafTimeSeriesPlanNode,
+    RangeTimeSeriesRequest,
+    TimeSeriesEngine,
+    TransformNode,
+    parse_timeseries,
+)
+
+
+@pytest.fixture(scope="module")
+def engine():
+    schema = Schema.build(
+        "metrics",
+        dimensions=[("host", DataType.STRING), ("dc", DataType.STRING)],
+        metrics=[("value", DataType.LONG)],
+        date_times=[("ts", DataType.LONG)],
+    )
+    # two hosts, 2 DCs, points at t=0..39
+    n = 40
+    data = {
+        "host": np.array(["h1", "h2"], dtype=object)[np.arange(n) % 2],
+        "dc": np.array(["east", "west"], dtype=object)[(np.arange(n) // 2) % 2],
+        "value": np.arange(n, dtype=np.int64),
+        "ts": np.arange(n, dtype=np.int64),
+    }
+    return TimeSeriesEngine(QueryEngine([SegmentBuilder(schema).build(data, "m0")]))
+
+
+# -- parsing ----------------------------------------------------------------
+
+
+def test_parse_fetch_and_pipeline():
+    root = parse_timeseries(
+        "fetch table=metrics value=value time=ts filter=\"host = 'h1'\" agg=max groupBy=host,dc"
+        " | groupBy host | sum | rate"
+    )
+    assert isinstance(root, TransformNode) and root.kind == "rate"
+    assert root.child.kind == "sum"
+    assert root.child.child.kind == "groupby" and root.child.child.args == ["host"]
+    leaf = root.child.child.child
+    assert isinstance(leaf, LeafTimeSeriesPlanNode)
+    assert leaf.agg == "max" and leaf.filter_sql == "host = 'h1'"
+    assert leaf.group_by == ["host", "dc"]
+
+
+def test_parse_errors():
+    with pytest.raises(ValueError, match="must start with 'fetch'"):
+        parse_timeseries("sum | rate")
+    with pytest.raises(ValueError, match="requires table"):
+        parse_timeseries("fetch value=v")
+    with pytest.raises(ValueError, match="unknown timeseries transform"):
+        parse_timeseries("fetch table=t value=v | frobnicate")
+    with pytest.raises(ValueError, match="agg=count"):
+        parse_timeseries("fetch table=t")  # no value => needs agg=count
+
+
+# -- leaf execution ---------------------------------------------------------
+
+
+def test_leaf_count_buckets(engine):
+    block = engine.execute(RangeTimeSeriesRequest("fetch table=metrics agg=count", 0, 40, 10))
+    assert list(block.buckets) == [0.0, 10.0, 20.0, 30.0]
+    assert list(block.series[()]) == [10.0, 10.0, 10.0, 10.0]
+
+
+def test_leaf_sum_with_tags_and_filter(engine):
+    block = engine.execute(
+        RangeTimeSeriesRequest(
+            "fetch table=metrics value=value groupBy=host filter=\"dc = 'east'\"", 0, 40, 20
+        )
+    )
+    assert block.tag_names == ["host"]
+    # east rows: ts%4 in {0,1}; h1 gets even ts, h2 odd
+    east_h1 = [t for t in range(40) if (t // 2) % 2 == 0 and t % 2 == 0]
+    assert list(block.series[("h1",)]) == [
+        float(sum(t for t in east_h1 if t < 20)),
+        float(sum(t for t in east_h1 if t >= 20)),
+    ]
+
+
+def test_leaf_time_range_clips(engine):
+    block = engine.execute(RangeTimeSeriesRequest("fetch table=metrics agg=count", 10, 30, 10))
+    assert list(block.buckets) == [10.0, 20.0]
+    assert list(block.series[()]) == [10.0, 10.0]
+
+
+def test_empty_bucket_is_nan(engine):
+    block = engine.execute(
+        RangeTimeSeriesRequest("fetch table=metrics value=value filter=\"ts < 10\"", 0, 40, 10)
+    )
+    v = block.series[()]
+    assert v[0] == 45.0
+    assert np.isnan(v[1:]).all()
+
+
+# -- transforms -------------------------------------------------------------
+
+
+def test_groupby_reaggregates(engine):
+    req = RangeTimeSeriesRequest("fetch table=metrics value=value groupBy=host,dc | groupBy dc", 0, 40, 40)
+    block = engine.execute(req)
+    assert set(block.series) == {("east",), ("west",)}
+    total = sum(np.nansum(v) for v in block.series.values())
+    assert total == float(np.arange(40).sum())
+
+
+def test_cross_series_sum_and_avg(engine):
+    base = "fetch table=metrics value=value groupBy=host"
+    s = engine.execute(RangeTimeSeriesRequest(base + " | sum", 0, 40, 10)).series[()]
+    assert list(s) == [45.0, 145.0, 245.0, 345.0]
+    a = engine.execute(RangeTimeSeriesRequest(base + " | avg", 0, 40, 10)).series[()]
+    assert list(a) == [22.5, 72.5, 122.5, 172.5]
+
+
+def test_rate(engine):
+    block = engine.execute(RangeTimeSeriesRequest("fetch table=metrics value=value | rate", 0, 40, 10))
+    v = block.series[()]
+    assert np.isnan(v[0])
+    assert list(v[1:]) == [10.0, 10.0, 10.0]  # sums rise 100 per 10s bucket
+
+
+def test_shift_scale_movingavg(engine):
+    base = "fetch table=metrics agg=count"
+    sh = engine.execute(RangeTimeSeriesRequest(base + " | shift 1", 0, 40, 10)).series[()]
+    assert np.isnan(sh[0]) and list(sh[1:]) == [10.0, 10.0, 10.0]
+    sc = engine.execute(RangeTimeSeriesRequest(base + " | scale 2.5", 0, 40, 10)).series[()]
+    assert list(sc) == [25.0] * 4
+    ma = engine.execute(RangeTimeSeriesRequest(base + " | movingAvg 2", 0, 40, 10)).series[()]
+    assert list(ma) == [10.0] * 4
+
+
+def test_topk(engine):
+    block = engine.execute(
+        RangeTimeSeriesRequest("fetch table=metrics value=value groupBy=host | topk 1", 0, 40, 40)
+    )
+    assert list(block.series) == [("h2",)]  # odd ts sum > even ts sum
+
+
+def test_keep_last_value(engine):
+    block = engine.execute(
+        RangeTimeSeriesRequest(
+            "fetch table=metrics value=value filter=\"ts < 10\" | keepLastValue", 0, 40, 10
+        )
+    )
+    assert list(block.series[()]) == [45.0, 45.0, 45.0, 45.0]
+
+
+def test_to_dict_json_surface(engine):
+    d = engine.execute_dict(RangeTimeSeriesRequest("fetch table=metrics agg=count groupBy=dc", 0, 40, 20))
+    assert d["timeBuckets"] == [0.0, 20.0]
+    assert d["tagNames"] == ["dc"]
+    assert {s["tags"]["dc"] for s in d["series"]} == {"east", "west"}
+    assert all(len(s["values"]) == 2 for s in d["series"])
