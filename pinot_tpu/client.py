@@ -1,0 +1,176 @@
+"""Python client: connections, broker selection, result sets, DB-API cursor.
+
+Reference parity: pinot-clients/pinot-java-client (ConnectionFactory,
+SimpleBrokerSelector round-robin over a static list, DynamicBrokerSelector
+refreshing the broker list from cluster metadata, JSON-over-HTTP transport
+JsonAsyncHttpPinotClientTransport) and pinot-jdbc-client (cursor surface,
+here PEP-249-shaped: cursor().execute/fetchall/description).
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+import time
+from typing import Any
+
+from pinot_tpu.cluster.http import query_broker_http
+
+
+class PinotClientError(RuntimeError):
+    pass
+
+
+class ResultSet:
+    """Broker response wrapper (org.apache.pinot.client.ResultSet parity)."""
+
+    def __init__(self, response: dict):
+        self._resp = response
+        if response.get("exceptions"):
+            raise PinotClientError("; ".join(e.get("message", "") for e in response["exceptions"]))
+        rt = response.get("resultTable") or {}
+        schema = rt.get("dataSchema") or {}
+        self.columns: list[str] = schema.get("columnNames", [])
+        self.column_types: list[str] = schema.get("columnDataTypes", [])
+        self.rows: list[list[Any]] = rt.get("rows", [])
+
+    def __len__(self) -> int:
+        return len(self.rows)
+
+    def __iter__(self):
+        return iter(self.rows)
+
+    @property
+    def execution_stats(self) -> dict:
+        return {
+            k: self._resp.get(k)
+            for k in ("numDocsScanned", "totalDocs", "numSegmentsQueried", "timeUsedMs")
+        }
+
+    def to_pandas(self):
+        import pandas as pd
+
+        return pd.DataFrame(self.rows, columns=self.columns or None)
+
+
+class _BrokerSelector:
+    """Round-robin with failover skip (SimpleBrokerSelector parity)."""
+
+    def __init__(self, broker_urls: list[str]):
+        if not broker_urls:
+            raise PinotClientError("no brokers available")
+        self._urls = list(broker_urls)
+        self._rr = itertools.cycle(range(len(self._urls)))
+        self._lock = threading.Lock()
+
+    def urls_in_order(self) -> list[str]:
+        with self._lock:
+            start = next(self._rr)
+        return [self._urls[(start + i) % len(self._urls)] for i in range(len(self._urls))]
+
+
+class Connection:
+    def __init__(self, broker_urls: list[str] | None = None, controller_url: str | None = None):
+        """Static broker list (SimpleBrokerSelector) or controller discovery
+        (DynamicBrokerSelector). With a controller, the broker list refreshes
+        on failure."""
+        self._controller_url = controller_url
+        if broker_urls is None:
+            if controller_url is None:
+                raise PinotClientError("need broker_urls or controller_url")
+            broker_urls = self._discover()
+        self._selector = _BrokerSelector(broker_urls)
+
+    def _discover(self) -> list[str]:
+        from pinot_tpu.cluster.http import RemoteControllerClient
+
+        brokers = RemoteControllerClient(self._controller_url).brokers()
+        return sorted(brokers.values())
+
+    def execute(self, sql: str, retries_per_broker: int = 1) -> ResultSet:
+        last_err: Exception | None = None
+        for attempt in range(retries_per_broker + 1):
+            for url in self._selector.urls_in_order():
+                try:
+                    return ResultSet(query_broker_http(url, sql))
+                except PinotClientError:
+                    raise  # server-side SQL error: do not retry elsewhere
+                except OSError as e:
+                    last_err = e  # connection-level: try next broker
+            if self._controller_url is not None:
+                try:
+                    self._selector = _BrokerSelector(self._discover())
+                except Exception:
+                    pass
+            if attempt < retries_per_broker:
+                time.sleep(0.05 * (attempt + 1))
+        raise PinotClientError(f"all brokers unreachable: {last_err}")
+
+    # -- PEP-249 shim (pinot-jdbc-client parity) -----------------------------
+
+    def cursor(self) -> "Cursor":
+        return Cursor(self)
+
+    def close(self) -> None:
+        pass
+
+
+class Cursor:
+    def __init__(self, conn: Connection):
+        self._conn = conn
+        self._rs: ResultSet | None = None
+        self._idx = 0
+
+    @property
+    def description(self):
+        if self._rs is None:
+            return None
+        return [(c, t, None, None, None, None, None) for c, t in zip(self._rs.columns, self._rs.column_types)]
+
+    @property
+    def rowcount(self) -> int:
+        return -1 if self._rs is None else len(self._rs)
+
+    def execute(self, sql: str, params: tuple | None = None) -> "Cursor":
+        if params:
+            sql = sql % tuple(_quote(p) for p in params)
+        self._rs = self._conn.execute(sql)
+        self._idx = 0
+        return self
+
+    def fetchone(self):
+        if self._rs is None or self._idx >= len(self._rs.rows):
+            return None
+        row = self._rs.rows[self._idx]
+        self._idx += 1
+        return tuple(row)
+
+    def fetchmany(self, size: int = 1):
+        out = []
+        for _ in range(size):
+            r = self.fetchone()
+            if r is None:
+                break
+            out.append(r)
+        return out
+
+    def fetchall(self):
+        out = [tuple(r) for r in (self._rs.rows[self._idx :] if self._rs else [])]
+        self._idx = len(self._rs.rows) if self._rs else 0
+        return out
+
+    def close(self) -> None:
+        self._rs = None
+
+
+def _quote(p) -> str:
+    if isinstance(p, str):
+        return "'" + p.replace("'", "''") + "'"
+    return str(p)
+
+
+def connect(broker_urls: list[str] | str | None = None, controller_url: str | None = None) -> Connection:
+    """ConnectionFactory.fromHostList / fromController parity."""
+    if isinstance(broker_urls, str):
+        broker_urls = [broker_urls]
+    return Connection(broker_urls, controller_url)

@@ -173,6 +173,15 @@ class Broker:
                     got = servers[sid].get_segment_object(table, seg_name)
                     if got is not None:
                         break
+                if got is None and online:
+                    # remote servers don't ship objects; leaf stages scan the
+                    # deep-store copy (the segment fetch the reference's leaf
+                    # workers do from their local segment dirs)
+                    meta = self.controller.segment_metadata(table, seg_name)
+                    if meta and meta.get("location"):
+                        from pinot_tpu.segment.loader import load_segment
+
+                        got = load_segment(meta["location"])
                 if got is not None:
                     segs.append(got)
             catalog[table] = segs

@@ -33,12 +33,39 @@ class Controller:
 
     # -- instances -----------------------------------------------------------
 
-    def register_server(self, server_id: str, handle, host: str = "local", port: int = 0) -> None:
-        self._servers[server_id] = handle
+    def register_server(self, server_id: str, handle=None, host: str = "local", port: int = 0) -> None:
+        """handle=None with a port registers a remote (HTTP) server — the
+        cross-process Helix-participant analog; a RemoteServerClient is built
+        lazily from the instance doc."""
+        if handle is not None:
+            self._servers[server_id] = handle
         self.store.set(f"/instances/{server_id}", {"host": host, "port": port, "alive": True})
 
     def servers(self) -> dict[str, object]:
-        return dict(self._servers)
+        out = dict(self._servers)
+        for path in self.store.list("/instances/"):
+            sid = path.split("/")[-1]
+            if sid in out:
+                continue
+            doc = self.store.get(path) or {}
+            if doc.get("port"):
+                from pinot_tpu.cluster.http import RemoteServerClient
+
+                out[sid] = self._servers[sid] = RemoteServerClient(f"http://{doc['host']}:{doc['port']}")
+        return out
+
+    # -- brokers (DynamicBrokerSelector's ZK external-view analog) -----------
+
+    def register_broker(self, broker_id: str, host: str, port: int) -> None:
+        self.store.set(f"/brokers/{broker_id}", {"host": host, "port": port})
+
+    def brokers(self) -> dict[str, str]:
+        """broker_id -> base URL."""
+        out = {}
+        for path in self.store.list("/brokers/"):
+            doc = self.store.get(path) or {}
+            out[path.split("/")[-1]] = f"http://{doc['host']}:{doc['port']}"
+        return out
 
     # -- schemas / tables ----------------------------------------------------
 
@@ -87,18 +114,20 @@ class Controller:
         ideal[segment.name] = {s: "ONLINE" for s in assigned}
         self.store.set(f"/tables/{table}/idealstate", ideal)
         # state transition: servers load the segment from the deep store
+        handles = self.servers()
         for sid in assigned:
-            self._servers[sid].add_segment(table, segment.name, seg_dir)
+            handles[sid].add_segment(table, segment.name, str(seg_dir))
         return assigned
 
     def _assign(self, table: str, segment_name: str, replication: int) -> list[str]:
         """Balanced assignment: pick the `replication` servers currently
         hosting the fewest segments of this table
         (OfflineSegmentAssignment.assignSegment parity)."""
-        if not self._servers:
+        handles = self.servers()
+        if not handles:
             raise RuntimeError("no servers registered")
         ideal = self.store.get(f"/tables/{table}/idealstate") or {}
-        load: dict[str, int] = {sid: 0 for sid in self._servers}
+        load: dict[str, int] = {sid: 0 for sid in handles}
         for seg, replicas in ideal.items():
             for sid in replicas:
                 if sid in load:
@@ -110,8 +139,9 @@ class Controller:
         """Drop a segment: server unload transitions, ideal-state removal,
         metadata + deep-store cleanup (SegmentDeletionManager parity)."""
         ideal = self.store.get(f"/tables/{table}/idealstate") or {}
+        handles = self.servers()
         for sid in ideal.pop(segment_name, {}):
-            srv = self._servers.get(sid)
+            srv = handles.get(sid)
             if srv is not None:
                 srv.remove_segment(table, segment_name)
         self.store.set(f"/tables/{table}/idealstate", ideal)

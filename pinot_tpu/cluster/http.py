@@ -19,6 +19,7 @@ import threading
 import urllib.error
 import urllib.request
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from pathlib import Path
 
 from pinot_tpu.cluster.broker import Broker
 from pinot_tpu.cluster.server import Server
@@ -76,7 +77,10 @@ class BrokerHTTPService:
 
 
 class ServerHTTPService:
-    """POST /query {"table","sql","segments","hints"} -> pickled partials."""
+    """POST /query {"table","sql","segments","hints"} -> pickled partials.
+    POST /segments/add|/segments/remove carry the Helix state-transition
+    messages for cross-process clusters (segment dirs live on a filesystem
+    both processes see — the deep-store mount assumption)."""
 
     def __init__(self, server: Server, port: int = 0):
         svc = self
@@ -86,6 +90,24 @@ class ServerHTTPService:
                 pass
 
             def do_POST(self):
+                if self.path in ("/segments/add", "/segments/remove"):
+                    n = int(self.headers.get("Content-Length", 0))
+                    body = json.loads(self.rfile.read(n) or b"{}")
+                    try:
+                        if self.path == "/segments/add":
+                            svc.server.add_segment(body["table"], body["segment"], body["dir"])
+                        else:
+                            svc.server.remove_segment(body["table"], body["segment"])
+                        payload = b'{"status": "ok"}'
+                        self.send_response(200)
+                    except Exception as e:
+                        payload = json.dumps({"error": f"{type(e).__name__}: {e}"}).encode()
+                        self.send_response(500)
+                    self.send_header("Content-Type", "application/json")
+                    self.send_header("Content-Length", str(len(payload)))
+                    self.end_headers()
+                    self.wfile.write(payload)
+                    return
                 if self.path != "/query":
                     self.send_error(404)
                     return
@@ -153,6 +175,249 @@ class RemoteServerClient:
             raise RuntimeError(f"server error from {self.base_url}: {detail}") from None
         except (TimeoutError, OSError) as e:
             raise RuntimeError(f"server {self.base_url} unreachable: {e}") from None
+
+    def _post_json(self, path: str, doc: dict) -> dict:
+        body = json.dumps(doc).encode()
+        req = urllib.request.Request(
+            self.base_url + path, data=body, headers={"Content-Type": "application/json"}
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+                return json.loads(resp.read())
+        except urllib.error.HTTPError as e:
+            detail = e.read().decode(errors="replace")
+            raise RuntimeError(f"server error from {self.base_url}: {detail}") from None
+        except (TimeoutError, OSError) as e:
+            raise RuntimeError(f"server {self.base_url} unreachable: {e}") from None
+
+    def add_segment(self, table: str, segment_name: str, seg_dir) -> None:
+        self._post_json("/segments/add", {"table": table, "segment": segment_name, "dir": str(seg_dir)})
+
+    def remove_segment(self, table: str, segment_name: str) -> None:
+        self._post_json("/segments/remove", {"table": table, "segment": segment_name})
+
+    def get_segment_object(self, table: str, segment_name: str):
+        """Remote servers don't ship segment objects over HTTP; multistage
+        leaf scans fall back to the deep-store copy (broker side)."""
+        return None
+
+
+class ControllerHTTPService:
+    """Controller REST surface (pinot-controller/.../api/resources/ parity,
+    the subset that matters for clients/CLI):
+
+      GET  /health | /tables | /tables/{t} | /tables/{t}/schema
+           /tables/{t}/idealstate | /tables/{t}/segments | /brokers | /instances
+           /tasks?state=...
+      POST /schemas            {schema json}
+      POST /tables             {table config json}
+      POST /instances          {"type": "server"|"broker", "id", "host", "port"}
+      POST /segments/{table}   raw ptseg segment-dir tarball (upload path)
+      POST /tasks/schedule     {"taskType": optional}
+    """
+
+    def __init__(self, controller, port: int = 0, task_manager=None):
+        svc = self
+        self.controller = controller
+        self.task_manager = task_manager
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def _json(self, doc, code=200):
+                payload = json.dumps(doc).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(payload)))
+                self.end_headers()
+                self.wfile.write(payload)
+
+            def do_GET(self):
+                c = svc.controller
+                try:
+                    parts = [p for p in self.path.split("?")[0].split("/") if p]
+                    if self.path == "/health":
+                        self._json({"status": "OK"})
+                    elif self.path == "/tables":
+                        self._json({"tables": c.tables()})
+                    elif len(parts) == 2 and parts[0] == "tables":
+                        tc = c.get_table(parts[1])
+                        if tc is None:
+                            self._json({"error": "not found"}, 404)
+                        else:
+                            self._json(json.loads(tc.to_json()))
+                    elif len(parts) == 3 and parts[0] == "tables" and parts[2] == "schema":
+                        sch = c.get_schema(parts[1])
+                        self._json(json.loads(sch.to_json()) if sch else {"error": "not found"}, 200 if sch else 404)
+                    elif len(parts) == 3 and parts[0] == "tables" and parts[2] == "idealstate":
+                        self._json(c.ideal_state(parts[1]))
+                    elif len(parts) == 3 and parts[0] == "tables" and parts[2] == "segments":
+                        self._json(c.all_segment_metadata(parts[1]))
+                    elif self.path == "/brokers":
+                        self._json(c.brokers())
+                    elif self.path == "/instances":
+                        self._json({p.split("/")[-1]: c.store.get(p) for p in c.store.list("/instances/")})
+                    elif parts and parts[0] == "tasks" and svc.task_manager is not None:
+                        self._json(
+                            [
+                                {"taskId": t.task_id, "type": t.task_type, "state": t.state.value}
+                                for t in svc.task_manager.tasks()
+                            ]
+                        )
+                    else:
+                        self._json({"error": "not found"}, 404)
+                except Exception as e:
+                    self._json({"error": f"{type(e).__name__}: {e}"}, 500)
+
+            def do_POST(self):
+                from pinot_tpu.common.config import TableConfig
+                from pinot_tpu.common.types import Schema
+
+                c = svc.controller
+                n = int(self.headers.get("Content-Length", 0))
+                raw = self.rfile.read(n)
+                try:
+                    parts = [p for p in self.path.split("/") if p]
+                    if self.path == "/schemas":
+                        c.add_schema(Schema.from_json(raw.decode()))
+                        self._json({"status": "ok"})
+                    elif self.path == "/tables":
+                        c.add_table(TableConfig.from_json(raw.decode()))
+                        self._json({"status": "ok"})
+                    elif self.path == "/instances":
+                        body = json.loads(raw)
+                        if body.get("type") == "broker":
+                            c.register_broker(body["id"], body["host"], int(body["port"]))
+                        else:
+                            c.register_server(body["id"], host=body["host"], port=int(body["port"]))
+                        self._json({"status": "ok"})
+                    elif len(parts) == 2 and parts[0] == "segments":
+                        # segment upload: tarball of the segment directory
+                        import io as _io
+                        import tarfile
+                        import tempfile
+
+                        from pinot_tpu.segment.loader import load_segment
+
+                        with tempfile.TemporaryDirectory() as tmp:
+                            with tarfile.open(fileobj=_io.BytesIO(raw), mode="r:gz") as tf:
+                                tf.extractall(tmp, filter="data")
+                            entries = list(Path(tmp).iterdir())
+                            seg_root = entries[0] if len(entries) == 1 and entries[0].is_dir() else Path(tmp)
+                            seg = load_segment(seg_root)
+                            assigned = c.upload_segment(parts[1], seg)
+                        self._json({"status": "ok", "segment": seg.name, "servers": assigned})
+                    elif self.path == "/tasks/schedule" and svc.task_manager is not None:
+                        body = json.loads(raw or b"{}")
+                        tasks = svc.task_manager.schedule_tasks(body.get("taskType"))
+                        self._json({"scheduled": [t.task_id for t in tasks]})
+                    else:
+                        self._json({"error": "not found"}, 404)
+                except Exception as e:
+                    self._json({"error": f"{type(e).__name__}: {e}"}, 500)
+
+        self.httpd, self.port, self._thread = _serve(Handler, port)
+
+    def stop(self):
+        self.httpd.shutdown()
+
+
+class RemoteControllerClient:
+    """Client-side controller handle over REST (used by CLI/clients and by
+    broker processes running apart from the controller)."""
+
+    def __init__(self, base_url: str, timeout: float = 30.0):
+        self.base_url = base_url.rstrip("/")
+        self.timeout = timeout
+
+    def _get(self, path: str) -> dict:
+        with urllib.request.urlopen(self.base_url + path, timeout=self.timeout) as resp:
+            return json.loads(resp.read())
+
+    def _post(self, path: str, data: bytes, content_type: str = "application/json") -> dict:
+        req = urllib.request.Request(
+            self.base_url + path, data=data, headers={"Content-Type": content_type}
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+                return json.loads(resp.read())
+        except urllib.error.HTTPError as e:
+            raise RuntimeError(f"controller error: {e.read().decode(errors='replace')}") from None
+
+    def health(self) -> bool:
+        try:
+            return self._get("/health").get("status") == "OK"
+        except OSError:
+            return False
+
+    def tables(self) -> list[str]:
+        return self._get("/tables")["tables"]
+
+    def brokers(self) -> dict[str, str]:
+        return self._get("/brokers")
+
+    def ideal_state(self, table: str) -> dict:
+        return self._get(f"/tables/{table}/idealstate")
+
+    def all_segment_metadata(self, table: str) -> dict:
+        return self._get(f"/tables/{table}/segments")
+
+    def segment_metadata(self, table: str, segment: str) -> dict | None:
+        return self.all_segment_metadata(table).get(segment)
+
+    def get_table(self, name: str):
+        from pinot_tpu.common.config import TableConfig
+
+        try:
+            return TableConfig.from_json(json.dumps(self._get(f"/tables/{name}")))
+        except (urllib.error.HTTPError, RuntimeError):
+            return None
+
+    def get_schema(self, name: str):
+        from pinot_tpu.common.types import Schema
+
+        try:
+            return Schema.from_json(json.dumps(self._get(f"/tables/{name}/schema")))
+        except (urllib.error.HTTPError, RuntimeError):
+            return None
+
+    def servers(self) -> dict[str, object]:
+        """Server handles from the instance registry (a Broker running in its
+        own process builds its routing table from these)."""
+        out = {}
+        for sid, doc in self._get("/instances").items():
+            if doc and doc.get("port"):
+                out[sid] = RemoteServerClient(f"http://{doc['host']}:{doc['port']}")
+        return out
+
+    def add_schema(self, schema) -> None:
+        self._post("/schemas", schema.to_json().encode())
+
+    def add_table(self, config) -> None:
+        self._post("/tables", config.to_json().encode())
+
+    def register_instance(self, kind: str, instance_id: str, host: str, port: int) -> None:
+        self._post(
+            "/instances",
+            json.dumps({"type": kind, "id": instance_id, "host": host, "port": port}).encode(),
+        )
+
+    def upload_segment_dir(self, table: str, seg_dir: str | Path) -> dict:
+        """Tar up a written segment directory and push it (the tar.gz segment
+        upload REST path)."""
+        import io as _io
+        import tarfile
+
+        buf = _io.BytesIO()
+        seg_dir = Path(seg_dir)
+        with tarfile.open(fileobj=buf, mode="w:gz") as tf:
+            tf.add(seg_dir, arcname=seg_dir.name)
+        return self._post(f"/segments/{table}", buf.getvalue(), "application/gzip")
+
+    def schedule_tasks(self, task_type: str | None = None) -> list[str]:
+        body = json.dumps({"taskType": task_type} if task_type else {}).encode()
+        return self._post("/tasks/schedule", body)["scheduled"]
 
 
 def query_broker_http(base_url: str, sql: str) -> dict:

@@ -1,0 +1,304 @@
+"""pinot-tpu-admin: multi-command CLI for cluster ops.
+
+Reference parity: pinot-tools PinotAdministrator
+(pinot-tools/.../admin/PinotAdministrator.java:93) subcommands —
+StartController/StartBroker/StartServer, QuickStart, AddTable,
+LaunchDataIngestionJob (ImportData), PostQuery, ScheduleTasks. Roles run as
+separate OS processes sharing a file-backed property store path and a
+deep-store directory (the ZK + deep-store pair), wired over HTTP.
+
+Usage:
+    python -m pinot_tpu.tools.admin QuickStart [--rows 1000] [--exit]
+    python -m pinot_tpu.tools.admin StartController --store-dir S --deep-store D [--port P]
+    python -m pinot_tpu.tools.admin StartServer --controller-url U [--server-id s1]
+    python -m pinot_tpu.tools.admin StartBroker --controller-url U [--port P]
+    python -m pinot_tpu.tools.admin AddTable --controller-url U --schema-file F --config-file F
+    python -m pinot_tpu.tools.admin ImportData --controller-url U --table T --input-dir D [--pattern '*.csv']
+    python -m pinot_tpu.tools.admin PostQuery --broker-url U --query SQL
+    python -m pinot_tpu.tools.admin ScheduleTasks --controller-url U [--task-type T]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+from pathlib import Path
+
+
+def _block(services, seconds: float):
+    """Run until interrupted (or for `seconds` when >= 0, for tests)."""
+    try:
+        if seconds >= 0:
+            time.sleep(seconds)
+        else:
+            while True:
+                time.sleep(3600)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        for s in services:
+            stop = getattr(s, "stop", None)
+            if stop:
+                stop()
+
+
+def cmd_start_controller(args) -> dict:
+    from pinot_tpu.cluster import Controller, PropertyStore
+    from pinot_tpu.cluster.http import ControllerHTTPService
+    from pinot_tpu.minion import PinotTaskManager
+    from pinot_tpu.minion.tasks import BUILTIN_GENERATORS
+
+    store = PropertyStore(args.store_dir)
+    controller = Controller(store, args.deep_store)
+    tm = PinotTaskManager(controller)
+    for g in BUILTIN_GENERATORS:
+        tm.register_generator(g())
+    svc = ControllerHTTPService(controller, port=args.port, task_manager=tm)
+    print(f"controller listening on http://127.0.0.1:{svc.port}", flush=True)
+    return {"controller": controller, "service": svc, "task_manager": tm}
+
+
+def cmd_start_server(args) -> dict:
+    from pinot_tpu.cluster import Server
+    from pinot_tpu.cluster.http import RemoteControllerClient, ServerHTTPService
+    from pinot_tpu.query.scheduler import make_scheduler
+
+    scheduler = make_scheduler(args.scheduler, num_runners=args.runners) if args.scheduler else None
+    server = Server(args.server_id, scheduler=scheduler)
+    svc = ServerHTTPService(server, port=args.port)
+    RemoteControllerClient(args.controller_url).register_instance(
+        "server", args.server_id, "127.0.0.1", svc.port
+    )
+    print(f"server {args.server_id} listening on http://127.0.0.1:{svc.port}", flush=True)
+    return {"server": server, "service": svc}
+
+
+def cmd_start_broker(args) -> dict:
+    from pinot_tpu.cluster.broker import Broker
+    from pinot_tpu.cluster.http import BrokerHTTPService, RemoteControllerClient
+
+    rc = RemoteControllerClient(args.controller_url)
+    broker = Broker(rc)
+    svc = BrokerHTTPService(broker, port=args.port)
+    rc.register_instance("broker", args.broker_id, "127.0.0.1", svc.port)
+    print(f"broker listening on http://127.0.0.1:{svc.port}", flush=True)
+    return {"broker": broker, "service": svc}
+
+
+def cmd_add_table(args) -> dict:
+    from pinot_tpu.cluster.http import RemoteControllerClient
+    from pinot_tpu.common.config import TableConfig
+    from pinot_tpu.common.types import Schema
+
+    rc = RemoteControllerClient(args.controller_url)
+    schema = Schema.from_json(Path(args.schema_file).read_text())
+    config = TableConfig.from_json(Path(args.config_file).read_text())
+    rc.add_schema(schema)
+    rc.add_table(config)
+    print(f"added table {config.table_name}", flush=True)
+    return {"table": config.table_name}
+
+
+def cmd_import_data(args) -> dict:
+    """Build segments locally from input files and push them
+    (LaunchDataIngestionJob standalone parity)."""
+    import tempfile
+
+    from pinot_tpu.cluster.http import RemoteControllerClient
+    from pinot_tpu.common.types import Schema
+    from pinot_tpu.io.batch import SegmentGenerationJobSpec, run_segment_generation_job
+
+    rc = RemoteControllerClient(args.controller_url)
+    schema_doc = rc._get(f"/tables/{args.table}/schema")
+    schema = Schema.from_json(json.dumps(schema_doc))
+    with tempfile.TemporaryDirectory() as tmp:
+        spec = SegmentGenerationJobSpec(
+            table_name=args.table,
+            schema=schema,
+            input_dir_uri=args.input_dir,
+            include_file_name_pattern=args.pattern,
+            input_format=args.format,
+            output_dir_uri=tmp,
+            segment_name_prefix=args.segment_prefix or args.table,
+        )
+        seg_dirs = run_segment_generation_job(spec)
+        pushed = [rc.upload_segment_dir(args.table, d)["segment"] for d in seg_dirs]
+    print(f"pushed {len(pushed)} segment(s): {pushed}", flush=True)
+    return {"pushed": pushed}
+
+
+def cmd_post_query(args) -> dict:
+    from pinot_tpu.client import connect
+
+    conn = (
+        connect(controller_url=args.controller_url)
+        if args.controller_url
+        else connect(args.broker_url)
+    )
+    rs = conn.execute(args.query)
+    out = {"columns": rs.columns, "rows": rs.rows, **rs.execution_stats}
+    print(json.dumps(out, default=str), flush=True)
+    return out
+
+
+def cmd_schedule_tasks(args) -> dict:
+    from pinot_tpu.cluster.http import RemoteControllerClient
+
+    scheduled = RemoteControllerClient(args.controller_url).schedule_tasks(args.task_type)
+    print(json.dumps({"scheduled": scheduled}), flush=True)
+    return {"scheduled": scheduled}
+
+
+def cmd_quickstart(args) -> dict:
+    """All-in-one in-process cluster with a sample table
+    (QuickStartCommand parity: baseballStats-flavored demo data)."""
+    import numpy as np
+
+    from pinot_tpu.cluster import Broker, Controller, PropertyStore, Server
+    from pinot_tpu.cluster.http import BrokerHTTPService, ControllerHTTPService, ServerHTTPService
+    from pinot_tpu.common import DataType, Schema, TableConfig
+    from pinot_tpu.minion import PinotTaskManager
+    from pinot_tpu.minion.tasks import make_minion_with_builtins
+    from pinot_tpu.segment import SegmentBuilder
+
+    import tempfile
+
+    workdir = Path(args.dir) if args.dir else Path(tempfile.mkdtemp(prefix="pinot-tpu-quickstart-"))
+    controller = Controller(PropertyStore(workdir / "store"), workdir / "deepstore")
+    tm = PinotTaskManager(controller)
+    minion = make_minion_with_builtins("minion_0", tm, controller)
+    servers = {}
+    for i in range(args.servers):
+        sid = f"server_{i}"
+        servers[sid] = Server(sid)
+        controller.register_server(sid, servers[sid])
+
+    schema = Schema.build(
+        "baseballStats",
+        dimensions=[("playerName", DataType.STRING), ("teamID", DataType.STRING), ("league", DataType.STRING)],
+        metrics=[("runs", DataType.LONG), ("homeRuns", DataType.LONG)],
+        date_times=[("yearID", DataType.INT)],
+    )
+    controller.add_schema(schema)
+    controller.add_table(TableConfig("baseballStats", time_column="yearID"))
+
+    rng = np.random.default_rng(7)
+    n = args.rows
+    builder = SegmentBuilder(schema)
+    teams = np.array(["BOS", "NYA", "CHA", "SFN", "LAN", "SLN"], dtype=object)
+    for i in range(2):
+        data = {
+            "playerName": np.array([f"player {j:04d}" for j in rng.integers(0, max(n // 4, 1), n)], dtype=object),
+            "teamID": teams[rng.integers(0, len(teams), n)],
+            "league": np.array(["NL", "AL"], dtype=object)[rng.integers(0, 2, n)],
+            "runs": rng.integers(0, 130, n).astype(np.int64),
+            "homeRuns": rng.integers(0, 45, n).astype(np.int64),
+            "yearID": rng.integers(1990, 2024, n).astype(np.int32),
+        }
+        controller.upload_segment("baseballStats", builder.build(data, f"baseballStats_{i}"))
+
+    broker = Broker(controller)
+    c_svc = ControllerHTTPService(controller, port=args.controller_port, task_manager=tm)
+    b_svc = BrokerHTTPService(broker, port=args.broker_port)
+    s_svcs = [ServerHTTPService(s, port=0) for s in servers.values()]
+    controller.register_broker("broker_0", "127.0.0.1", b_svc.port)
+    minion.start(poll_interval=0.5)
+
+    sample = "SELECT league, SUM(runs) FROM baseballStats GROUP BY league ORDER BY SUM(runs) DESC LIMIT 10"
+    res = broker.execute(sample)
+    print(f"controller: http://127.0.0.1:{c_svc.port}")
+    print(f"broker:     http://127.0.0.1:{b_svc.port}  (POST /query/sql)")
+    print(f"sample query: {sample}")
+    print(res, flush=True)
+    handles = {
+        "controller": controller,
+        "broker": broker,
+        "servers": servers,
+        "minion": minion,
+        "services": [c_svc, b_svc, *s_svcs],
+        "workdir": workdir,
+    }
+    return handles
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="pinot-tpu-admin", description=__doc__.split("\n")[0])
+    sub = p.add_subparsers(dest="command", required=True)
+
+    q = sub.add_parser("QuickStart", help="all-in-one demo cluster")
+    q.add_argument("--rows", type=int, default=1000)
+    q.add_argument("--servers", type=int, default=2)
+    q.add_argument("--dir", default=None)
+    q.add_argument("--controller-port", type=int, default=0)
+    q.add_argument("--broker-port", type=int, default=0)
+    q.add_argument("--exit", action="store_true", help="exit after sample query (tests)")
+    q.set_defaults(fn=cmd_quickstart, blocking=True)
+
+    c = sub.add_parser("StartController")
+    c.add_argument("--store-dir", required=True)
+    c.add_argument("--deep-store", required=True)
+    c.add_argument("--port", type=int, default=0)
+    c.set_defaults(fn=cmd_start_controller, blocking=True)
+
+    s = sub.add_parser("StartServer")
+    s.add_argument("--controller-url", required=True)
+    s.add_argument("--server-id", default="server_0")
+    s.add_argument("--port", type=int, default=0)
+    s.add_argument("--scheduler", default="", help="fcfs|priority|binary_workload (default: none)")
+    s.add_argument("--runners", type=int, default=4)
+    s.set_defaults(fn=cmd_start_server, blocking=True)
+
+    b = sub.add_parser("StartBroker")
+    b.add_argument("--controller-url", required=True)
+    b.add_argument("--broker-id", default="broker_0")
+    b.add_argument("--port", type=int, default=0)
+    b.set_defaults(fn=cmd_start_broker, blocking=True)
+
+    a = sub.add_parser("AddTable")
+    a.add_argument("--controller-url", required=True)
+    a.add_argument("--schema-file", required=True)
+    a.add_argument("--config-file", required=True)
+    a.set_defaults(fn=cmd_add_table, blocking=False)
+
+    i = sub.add_parser("ImportData")
+    i.add_argument("--controller-url", required=True)
+    i.add_argument("--table", required=True)
+    i.add_argument("--input-dir", required=True)
+    i.add_argument("--pattern", default="*")
+    i.add_argument("--format", default=None)
+    i.add_argument("--segment-prefix", default=None)
+    i.set_defaults(fn=cmd_import_data, blocking=False)
+
+    pq = sub.add_parser("PostQuery")
+    pq.add_argument("--broker-url", default=None)
+    pq.add_argument("--controller-url", default=None)
+    pq.add_argument("--query", required=True)
+    pq.set_defaults(fn=cmd_post_query, blocking=False)
+
+    st = sub.add_parser("ScheduleTasks")
+    st.add_argument("--controller-url", required=True)
+    st.add_argument("--task-type", default=None)
+    st.set_defaults(fn=cmd_schedule_tasks, blocking=False)
+
+    return p
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = build_parser().parse_args(argv)
+    handles = args.fn(args)
+    if args.blocking and not getattr(args, "exit", False):
+        services = handles.get("services") or [handles.get("service")]
+        _block([s for s in services if s is not None], -1)
+    elif getattr(args, "exit", False):
+        for s in handles.get("services", []):
+            s.stop()
+        m = handles.get("minion")
+        if m:
+            m.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,203 @@
+"""Python client (broker selection, ResultSet, DB-API cursor), admin CLI,
+and the multi-process-shaped controller REST + role wiring.
+
+Reference test model: pinot-clients tests + PinotAdministrator command tests
+(SURVEY.md §2.4); the multi-role leg mirrors ClusterTest but over the real
+HTTP services in one process.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from pinot_tpu.client import Cursor, PinotClientError, connect
+from pinot_tpu.cluster import Broker, Controller, PropertyStore, Server
+from pinot_tpu.cluster.http import (
+    BrokerHTTPService,
+    ControllerHTTPService,
+    RemoteControllerClient,
+    ServerHTTPService,
+)
+from pinot_tpu.common import DataType, Schema, TableConfig
+from pinot_tpu.segment import SegmentBuilder
+from pinot_tpu.tools.admin import build_parser, cmd_quickstart, main
+
+
+@pytest.fixture(scope="module")
+def stack(tmp_path_factory):
+    """controller + server + broker all over real HTTP, plus REST service."""
+    root = tmp_path_factory.mktemp("stack")
+    store = PropertyStore(root / "store")  # file-backed: multi-process shape
+    controller = Controller(store, root / "deepstore")
+    c_svc = ControllerHTTPService(controller)
+    c_url = f"http://127.0.0.1:{c_svc.port}"
+
+    # server registers itself via REST, like StartServer does
+    server = Server("server_0")
+    s_svc = ServerHTTPService(server)
+    rc = RemoteControllerClient(c_url)
+    rc.register_instance("server", "server_0", "127.0.0.1", s_svc.port)
+
+    schema = Schema.build(
+        "hits", dimensions=[("page", DataType.STRING)], metrics=[("n", DataType.LONG)]
+    )
+    rc.add_schema(schema)
+    rc.add_table(TableConfig("hits"))
+
+    # broker built against the REMOTE controller client (cross-process shape)
+    broker = Broker(RemoteControllerClient(c_url))
+    b_svc = BrokerHTTPService(broker)
+    rc.register_instance("broker", "broker_0", "127.0.0.1", b_svc.port)
+
+    # push one segment through the REST upload path
+    seg = SegmentBuilder(schema).build(
+        {"page": np.array(["a", "b", "a"], dtype=object), "n": np.array([1, 2, 3], dtype=np.int64)},
+        "hits_0",
+    )
+    from pinot_tpu.segment.builder import write_segment
+
+    seg_dir = write_segment(seg, root / "built")
+    out = rc.upload_segment_dir("hits", seg_dir)
+    assert out["segment"] == "hits_0"
+
+    yield {"c_url": c_url, "b_url": f"http://127.0.0.1:{b_svc.port}", "rc": rc, "root": root}
+    for svc in (b_svc, s_svc, c_svc):
+        svc.stop()
+
+
+# -- controller REST + remote roles -----------------------------------------
+
+
+def test_rest_reads(stack):
+    rc = stack["rc"]
+    assert rc.health()
+    assert rc.tables() == ["hits"]
+    assert rc.get_table("hits").table_name == "hits"
+    assert rc.get_schema("hits").name == "hits"
+    assert rc.get_table("nope") is None
+    assert "hits_0" in rc.ideal_state("hits")
+    assert rc.all_segment_metadata("hits")["hits_0"]["numDocs"] == 3
+    assert rc.brokers() == {"broker_0": stack["b_url"]}
+
+
+def test_remote_broker_executes_via_remote_server(stack):
+    """Broker(RemoteControllerClient) scatters to the HTTP server."""
+    rs = connect(stack["b_url"]).execute("SELECT page, SUM(n) FROM hits GROUP BY page ORDER BY page")
+    assert rs.rows == [["a", 4.0], ["b", 2.0]]
+
+
+# -- client -----------------------------------------------------------------
+
+
+def test_connect_via_controller_discovery(stack):
+    conn = connect(controller_url=stack["c_url"])
+    rs = conn.execute("SELECT COUNT(*) FROM hits")
+    assert rs.rows[0][0] == 3
+    assert rs.execution_stats["numDocsScanned"] == 3
+
+
+def test_client_sql_error_raises(stack):
+    with pytest.raises(PinotClientError):
+        connect(stack["b_url"]).execute("SELECT COUNT(*) FROM missing_table")
+
+
+def test_client_failover_skips_dead_broker(stack):
+    conn = connect(["http://127.0.0.1:1", stack["b_url"]])
+    assert conn.execute("SELECT COUNT(*) FROM hits").rows[0][0] == 3
+
+
+def test_client_all_brokers_dead():
+    with pytest.raises(PinotClientError, match="unreachable"):
+        connect(["http://127.0.0.1:1"]).execute("SELECT 1 FROM t")
+
+
+def test_cursor_dbapi(stack):
+    cur = connect(stack["b_url"]).cursor()
+    cur.execute("SELECT page, SUM(n) FROM hits GROUP BY page ORDER BY page")
+    assert [d[0] for d in cur.description] == ["page", "sum(n)"]
+    assert cur.fetchone() == ("a", 4.0)
+    assert cur.fetchall() == [("b", 2.0)]
+    assert cur.fetchone() is None
+    cur.execute("SELECT COUNT(*) FROM hits WHERE page = %s", ("a",))
+    assert cur.fetchall() == [(2,)]
+
+
+def test_resultset_to_pandas(stack):
+    df = connect(stack["b_url"]).execute("SELECT page, n FROM hits LIMIT 10").to_pandas()
+    assert list(df.columns) == ["page", "n"]
+    assert len(df) == 3
+
+
+# -- CLI --------------------------------------------------------------------
+
+
+def test_cli_add_table_import_query(stack, tmp_path):
+    schema = Schema.build("clicks", dimensions=[("k", DataType.STRING)], metrics=[("v", DataType.LONG)])
+    (tmp_path / "schema.json").write_text(schema.to_json())
+    (tmp_path / "table.json").write_text(TableConfig("clicks").to_json())
+    (tmp_path / "data.csv").write_text("k,v\nx,1\ny,2\nx,3\n")
+
+    assert (
+        main(
+            [
+                "AddTable",
+                "--controller-url",
+                stack["c_url"],
+                "--schema-file",
+                str(tmp_path / "schema.json"),
+                "--config-file",
+                str(tmp_path / "table.json"),
+            ]
+        )
+        == 0
+    )
+    assert (
+        main(
+            [
+                "ImportData",
+                "--controller-url",
+                stack["c_url"],
+                "--table",
+                "clicks",
+                "--input-dir",
+                str(tmp_path),
+                "--pattern",
+                "*.csv",
+            ]
+        )
+        == 0
+    )
+    assert (
+        main(["PostQuery", "--controller-url", stack["c_url"], "--query", "SELECT SUM(v) FROM clicks"]) == 0
+    )
+    rs = connect(stack["b_url"]).execute("SELECT k, SUM(v) FROM clicks GROUP BY k ORDER BY k")
+    assert rs.rows == [["x", 4.0], ["y", 2.0]]
+
+
+def test_cli_schedule_tasks(stack):
+    # controller service in this stack has no task manager -> 404 path
+    with pytest.raises(RuntimeError):
+        RemoteControllerClient(stack["c_url"]).schedule_tasks()
+
+
+def test_quickstart_boots_and_serves(capsys):
+    args = build_parser().parse_args(["QuickStart", "--rows", "200", "--servers", "1", "--exit"])
+    handles = cmd_quickstart(args)
+    try:
+        b_port = handles["services"][1].port
+        rs = connect(f"http://127.0.0.1:{b_port}").execute(
+            "SELECT league, COUNT(*) FROM baseballStats GROUP BY league ORDER BY league"
+        )
+        assert [r[0] for r in rs.rows] == ["AL", "NL"]
+        assert sum(r[1] for r in rs.rows) == 400
+        c_port = handles["services"][0].port
+        rc = RemoteControllerClient(f"http://127.0.0.1:{c_port}")
+        assert rc.tables() == ["baseballStats"]
+        assert rc.schedule_tasks() == []  # no task configs on the demo table
+    finally:
+        for svc in handles["services"]:
+            svc.stop()
+        handles["minion"].stop()
+    out = capsys.readouterr().out
+    assert "broker:" in out and "sample query" in out
