@@ -54,9 +54,20 @@ _request_seq = itertools.count()
 
 
 class Broker:
-    def __init__(self, controller: Controller, max_scatter_threads: int = 8):
+    def __init__(
+        self,
+        controller: Controller,
+        max_scatter_threads: int = 8,
+        selector=None,
+        failure_detector=None,
+    ):
+        """selector: instance selector (Balanced default; ReplicaGroup /
+        Adaptive from cluster.routing). failure_detector: optional
+        cluster.failure.FailureDetector enabling routing exclusion + one-round
+        connection-failure failover."""
         self.controller = controller
-        self.selector = BalancedInstanceSelector()
+        self.selector = selector if selector is not None else BalancedInstanceSelector()
+        self.failure_detector = failure_detector
         self._pool = ThreadPoolExecutor(max_workers=max_scatter_threads)
 
     def execute(self, sql: str) -> ResultTable:
@@ -86,29 +97,82 @@ class Broker:
         if use_v2:
             return self._execute_multistage(stmt, sql)
         table = stmt.from_table
-        if self.controller.get_table(table) is None:
+        offline_cfg = self.controller.get_table(table)
+        rt_name = f"{table}_REALTIME"
+        rt_cfg = self.controller.get_table(rt_name) if not table.endswith("_REALTIME") else None
+        if offline_cfg is None and rt_cfg is None:
             raise KeyError(f"no such table: {table}")  # BrokerResponse TableDoesNotExist parity
-        schema = self.controller.get_schema(table)
+        schema = self.controller.get_schema(table) or self.controller.get_schema(rt_name)
         self._expand_star(stmt, schema)
         ctx = QueryContext.from_statement(stmt)
 
+        # legs: (physical table, sql text). Hybrid tables split on the time
+        # boundary (TimeBoundaryManager parity): offline <= boundary < realtime
+        if offline_cfg is not None and rt_cfg is not None and offline_cfg.time_column:
+            from pinot_tpu.cluster.routing import TimeBoundary
+
+            offline_meta = self.controller.all_segment_metadata(table)
+            tb = TimeBoundary.compute(offline_meta, offline_cfg.time_column)
+            if tb is None:
+                legs = [(rt_name, sql)]
+            else:
+                legs = [(table, tb.offline_sql(sql)), (rt_name, tb.realtime_sql(sql))]
+        elif offline_cfg is not None:
+            legs = [(table, sql)]
+        else:
+            legs = [(rt_name, sql)]
+
+        all_meta: dict[str, dict] = {}
+        for leg_table, _ in legs:
+            all_meta.update(self.controller.all_segment_metadata(leg_table))
+        self._compute_hints(ctx, all_meta)
+
+        partials, scanned, queried, pruned = [], 0, 0, 0
+        for leg_table, leg_sql in legs:
+            p, s, q, pr = self._scatter_leg(ctx, leg_table, leg_sql)
+            partials.extend(p)
+            scanned += s
+            queried += q
+            pruned += pr
+
+        rows = QueryEngine.reduce(ctx, partials)
+        return build_result(
+            ctx,
+            rows,
+            num_docs_scanned=int(scanned),
+            total_docs=sum(m.get("numDocs", 0) for m in all_meta.values()),
+            num_segments_queried=queried,
+            num_segments_pruned=pruned,
+            time_used_ms=(time.perf_counter() - t0) * 1e3,
+        )
+
+    def _scatter_leg(self, ctx: QueryContext, table: str, sql: str):
+        """Route + scatter one physical table: prune on stats/partitions,
+        select replicas (excluding failure-detected servers), fan out, retry
+        connection failures on other replicas once. Returns
+        (partials, scanned, num_segments_queried, num_segments_pruned)."""
+        from pinot_tpu.cluster.routing import AdaptiveServerSelector, segment_partitions_match
+
         meta = self.controller.all_segment_metadata(table)
         ideal = self.controller.ideal_state(table)
-        self._compute_hints(ctx, meta)
 
-        # broker-side pruning on stored segment stats
         candidates, pruned = [], 0
         for seg_name, m in meta.items():
             if seg_name not in ideal:
                 continue
-            if segment_can_match(ctx.filter, m.get("stats", {})):
+            if segment_can_match(ctx.filter, m.get("stats", {})) and segment_partitions_match(
+                ctx.filter, m.get("partitions", {})
+            ):
                 candidates.append(seg_name)
             else:
                 pruned += 1
         # consuming segments have no committed metadata yet: always routed
         candidates.extend(s for s in ideal if s not in meta)
 
-        plan, unroutable = self.selector.select(ideal, candidates)
+        routable_ideal = (
+            self.failure_detector.filter_ideal_state(ideal) if self.failure_detector else ideal
+        )
+        plan, unroutable = self.selector.select(routable_ideal, candidates)
         if unroutable:
             raise RuntimeError(f"no ONLINE replica for segments: {unroutable}")
         servers = self.controller.servers()
@@ -117,10 +181,22 @@ class Broker:
         from pinot_tpu.common.trace import active_trace, run_traced
 
         trace = active_trace()
+        adaptive = self.selector if isinstance(self.selector, AdaptiveServerSelector) else None
 
         def scatter(item):
             sid, segs = item
-            out = run_traced(trace, servers[sid].execute_partials, table, sql, segs, hints)
+            t0 = time.perf_counter()
+            try:
+                out = run_traced(trace, servers[sid].execute_partials, table, sql, segs, hints)
+            except RuntimeError as e:
+                if self.failure_detector is not None and "unreachable" in str(e):
+                    self.failure_detector.mark_failure(sid)
+                    return ("__failed__", sid, segs, e)
+                raise
+            if self.failure_detector is not None:
+                self.failure_detector.mark_success(sid)
+            if adaptive is not None:
+                adaptive.record(sid, (time.perf_counter() - t0) * 1e3)
             if len(out[0]) != len(segs):
                 # a server silently skipping unhosted segments would mean
                 # missing rows; fail loudly instead (partial-response guard)
@@ -130,22 +206,33 @@ class Broker:
             return out
 
         results = list(self._pool.map(scatter, plan.items())) if plan else []
-        partials = []
-        scanned = 0
+        failed = [r for r in results if isinstance(r, tuple) and r and r[0] == "__failed__"]
+        results = [r for r in results if not (isinstance(r, tuple) and r and r[0] == "__failed__")]
+        if failed:
+            # one retry round on surviving replicas (connection-failure
+            # failover; a second failure is a hard error)
+            bad_servers = {f[1] for f in failed}
+            retry_segs = [s for f in failed for s in f[2]]
+            retry_ideal = {
+                seg: {s: st for s, st in ideal.get(seg, {}).items() if s not in bad_servers}
+                for seg in retry_segs
+            }
+            plan2, unroutable2 = self.selector.select(retry_ideal, retry_segs)
+            if unroutable2:
+                raise RuntimeError(
+                    f"servers {sorted(bad_servers)} unreachable and no surviving replica for {unroutable2}"
+                ) from failed[0][3]
+            retry_results = list(self._pool.map(scatter, plan2.items()))
+            still = [r for r in retry_results if isinstance(r, tuple) and r and r[0] == "__failed__"]
+            if still:
+                raise RuntimeError(f"retry failed for servers {[f[1] for f in still]}") from still[0][3]
+            results.extend(retry_results)
+
+        partials, scanned = [], 0
         for p, matched, _total in results:
             partials.extend(p)
             scanned += matched
-
-        rows = QueryEngine.reduce(ctx, partials)
-        return build_result(
-            ctx,
-            rows,
-            num_docs_scanned=int(scanned),
-            total_docs=sum(m.get("numDocs", 0) for m in meta.values()),
-            num_segments_queried=len(candidates),
-            num_segments_pruned=pruned,
-            time_used_ms=(time.perf_counter() - t0) * 1e3,
-        )
+        return partials, scanned, len(candidates), pruned
 
     def _execute_multistage(self, stmt, sql: str) -> ResultTable:
         """Dispatch to the v2 engine over one replica of each segment.

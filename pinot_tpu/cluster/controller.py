@@ -106,10 +106,11 @@ class Controller:
             for col, ci in segment.columns.items()
         }
         assigned = self._assign(table, segment.name, config.replication)
-        self.store.set(
-            f"/tables/{table}/segments/{segment.name}",
-            {"numDocs": segment.n_docs, "location": str(seg_dir), "stats": stats, "servers": assigned},
-        )
+        seg_meta = {"numDocs": segment.n_docs, "location": str(seg_dir), "stats": stats, "servers": assigned}
+        partitions = self._compute_partitions(segment, config)
+        if partitions:
+            seg_meta["partitions"] = partitions
+        self.store.set(f"/tables/{table}/segments/{segment.name}", seg_meta)
         ideal = self.store.get(f"/tables/{table}/idealstate") or {}
         ideal[segment.name] = {s: "ONLINE" for s in assigned}
         self.store.set(f"/tables/{table}/idealstate", ideal)
@@ -118,6 +119,31 @@ class Controller:
         for sid in assigned:
             handles[sid].add_segment(table, segment.name, str(seg_dir))
         return assigned
+
+    @staticmethod
+    def _compute_partitions(segment: ImmutableSegment, config: TableConfig) -> dict:
+        """Per-segment partition metadata (SegmentPartitionConfig parity):
+        for each declared partition column, the set of partition ids present —
+        the broker's MultiPartitionColumnsSegmentPruner consumes this."""
+        ppc = (config.extra or {}).get("segmentPartitionConfig") or {}
+        out = {}
+        for col, n_parts in ppc.items():
+            ci = segment.columns.get(col)
+            if ci is None:
+                continue
+            from pinot_tpu.cluster.routing import partition_of
+
+            if ci.dictionary is not None:
+                distinct = ci.dictionary.values
+            else:
+                import numpy as np
+
+                distinct = np.unique(ci.forward)
+                if len(distinct) > 100_000:  # unpartitioned high-cardinality raw column
+                    continue
+            ids = sorted({partition_of(v, int(n_parts)) for v in distinct.tolist()})
+            out[col] = {"numPartitions": int(n_parts), "partitionIds": ids}
+        return out
 
     def _assign(self, table: str, segment_name: str, replication: int) -> list[str]:
         """Balanced assignment: pick the `replication` servers currently

@@ -1,0 +1,66 @@
+"""Broker-side failure detection with exponential-backoff retry.
+
+Reference parity: pinot-broker/.../failuredetector/FailureDetector +
+BaseExponentialBackoffRetryFailureDetector: servers that fail a connection
+are marked unhealthy and excluded from routing; a retry schedule with
+exponentially growing delays probes them; a successful probe (or successful
+query) restores them. The broker consults `healthy()` before routing and
+calls `mark_failure/mark_success` from the scatter path.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+
+class FailureDetector:
+    def __init__(
+        self,
+        initial_delay_sec: float = 0.5,
+        backoff_factor: float = 2.0,
+        max_delay_sec: float = 60.0,
+    ):
+        self._initial = initial_delay_sec
+        self._factor = backoff_factor
+        self._max = max_delay_sec
+        # server -> (next_retry_ts, current_delay)
+        self._down: dict[str, tuple[float, float]] = {}
+        self._lock = threading.Lock()
+
+    def mark_failure(self, server_id: str) -> None:
+        now = time.monotonic()
+        with self._lock:
+            prev = self._down.get(server_id)
+            delay = self._initial if prev is None else min(prev[1] * self._factor, self._max)
+            self._down[server_id] = (now + delay, delay)
+
+    def mark_success(self, server_id: str) -> None:
+        with self._lock:
+            self._down.pop(server_id, None)
+
+    def is_healthy(self, server_id: str) -> bool:
+        """Healthy, or unhealthy-but-due-for-retry (the probe slot)."""
+        with self._lock:
+            entry = self._down.get(server_id)
+            if entry is None:
+                return True
+            return time.monotonic() >= entry[0]
+
+    def unhealthy_servers(self) -> list[str]:
+        now = time.monotonic()
+        with self._lock:
+            return sorted(s for s, (ts, _) in self._down.items() if now < ts)
+
+    def filter_ideal_state(self, ideal_state: dict[str, dict[str, str]]) -> dict[str, dict[str, str]]:
+        """Drop replicas on currently-unhealthy servers (routing exclusion).
+        Segments whose every replica is down keep their full replica map —
+        better to try a down server than to fail unroutable."""
+        bad = set(self.unhealthy_servers())
+        if not bad:
+            return ideal_state
+        out = {}
+        for seg, replicas in ideal_state.items():
+            kept = {s: st for s, st in replicas.items() if s not in bad}
+            out[seg] = kept if kept else replicas
+        return out

@@ -312,6 +312,19 @@ class ControllerHTTPService:
                         body = json.loads(raw or b"{}")
                         tasks = svc.task_manager.schedule_tasks(body.get("taskType"))
                         self._json({"scheduled": [t.task_id for t in tasks]})
+                    elif len(parts) == 3 and parts[0] == "tables" and parts[2] == "rebalance":
+                        from pinot_tpu.cluster.rebalance import rebalance_table
+
+                        body = json.loads(raw or b"{}")
+                        r = rebalance_table(c, parts[1], dry_run=bool(body.get("dryRun")))
+                        self._json(
+                            {
+                                "status": r.status,
+                                "adds": r.adds,
+                                "drops": r.drops,
+                                "target": r.target,
+                            }
+                        )
                     else:
                         self._json({"error": "not found"}, 404)
                 except Exception as e:
@@ -418,6 +431,9 @@ class RemoteControllerClient:
     def schedule_tasks(self, task_type: str | None = None) -> list[str]:
         body = json.dumps({"taskType": task_type} if task_type else {}).encode()
         return self._post("/tasks/schedule", body)["scheduled"]
+
+    def rebalance_table(self, table: str, dry_run: bool = False) -> dict:
+        return self._post(f"/tables/{table}/rebalance", json.dumps({"dryRun": dry_run}).encode())
 
 
 def query_broker_http(base_url: str, sql: str) -> dict:

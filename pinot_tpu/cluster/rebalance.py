@@ -1,0 +1,91 @@
+"""Table rebalancing: converge segment placement to target replication with
+minimal movement.
+
+Reference parity: TableRebalancer (pinot-controller/.../helix/core/rebalance/
+TableRebalancer.java) — recompute the target assignment for the current
+server set, then move segments incrementally, keeping existing replicas
+wherever possible (minimal-movement property) and never dropping below the
+current replica count mid-move (downtime=false semantics: add the new
+replica before removing the old). Progress is observable via the returned
+move list (ZkBasedTableRebalanceObserver analog).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+
+@dataclass
+class RebalanceResult:
+    status: str  # NO_OP | DONE
+    adds: list[tuple[str, str]] = field(default_factory=list)  # (segment, server)
+    drops: list[tuple[str, str]] = field(default_factory=list)
+    target: dict[str, list[str]] = field(default_factory=dict)
+
+
+def compute_target_assignment(
+    segments: list[str], servers: list[str], replication: int, current: dict[str, dict[str, str]]
+) -> dict[str, list[str]]:
+    """Balanced target keeping current replicas when still valid."""
+    servers = sorted(servers)
+    replication = max(1, min(replication, len(servers)))
+    load = {s: 0 for s in servers}
+    target: dict[str, list[str]] = {}
+    # first pass: retain existing replicas on live servers (minimal movement)
+    for seg in sorted(segments):
+        keep = [s for s in sorted(current.get(seg, {})) if s in load][:replication]
+        target[seg] = keep
+        for s in keep:
+            load[s] += 1
+    # second pass: top up to replication on least-loaded servers
+    for seg in sorted(segments):
+        have = set(target[seg])
+        while len(target[seg]) < replication:
+            pick = min((s for s in servers if s not in have), key=lambda s: (load[s], s))
+            target[seg].append(pick)
+            have.add(pick)
+            load[pick] += 1
+    return target
+
+
+def rebalance_table(controller, table: str, dry_run: bool = False) -> RebalanceResult:
+    """Compute and (unless dry_run) apply moves: add new replicas first, then
+    drop extras (no-downtime ordering)."""
+    config = controller.get_table(table)
+    if config is None:
+        raise KeyError(f"no such table: {table}")
+    ideal = controller.ideal_state(table)
+    servers = sorted(controller.servers())
+    target = compute_target_assignment(list(ideal), servers, config.replication, ideal)
+
+    adds: list[tuple[str, str]] = []
+    drops: list[tuple[str, str]] = []
+    for seg, replicas in ideal.items():
+        want = set(target[seg])
+        have = set(replicas)
+        adds.extend((seg, s) for s in sorted(want - have))
+        drops.extend((seg, s) for s in sorted(have - want))
+    if not adds and not drops:
+        return RebalanceResult("NO_OP", target=target)
+    if dry_run:
+        return RebalanceResult("DONE", adds, drops, target)
+
+    handles = controller.servers()
+    for seg, sid in adds:
+        meta = controller.segment_metadata(table, seg) or {}
+        loc = meta.get("location")
+        if loc:
+            handles[sid].add_segment(table, seg, loc)
+        controller.set_segment_state(table, seg, sid, "ONLINE")
+    for seg, sid in drops:
+        srv = handles.get(sid)
+        if srv is not None:
+            srv.remove_segment(table, seg)
+        controller.set_segment_state(table, seg, sid, None)
+    # refresh stored replica lists in segment metadata
+    for seg in target:
+        meta = controller.segment_metadata(table, seg)
+        if meta is not None:
+            meta["servers"] = sorted(target[seg])
+            controller.store.set(f"/tables/{table}/segments/{seg}", meta)
+    return RebalanceResult("DONE", adds, drops, target)

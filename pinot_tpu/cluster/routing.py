@@ -1,15 +1,24 @@
 """Broker routing: segment pruning + replica instance selection.
 
 Reference parity: BrokerRoutingManager (pinot-broker/.../routing/
-BrokerRoutingManager.java:101), BalancedInstanceSelector (round-robin across
-replicas), and the pruners — ColumnValueSegmentPruner (min/max interval
-tests) / TimeSegmentPruner, operating here on the controller-stored per-
-segment column stats instead of on-disk metadata.
+BrokerRoutingManager.java:101); instance selectors BalancedInstanceSelector /
+ReplicaGroupInstanceSelector / StrictReplicaGroupInstanceSelector
+(pinot-broker/.../routing/instanceselector/); AdaptiveServerSelector
+(routing/adaptiveserverselector/ — latency-aware replica ranking); the
+pruners — ColumnValueSegmentPruner (min/max interval tests),
+TimeSegmentPruner, MultiPartitionColumnsSegmentPruner (partition membership
+on EQ/IN predicates) — operating here on controller-stored per-segment
+stats/partition metadata instead of on-disk metadata; and the
+TimeBoundaryManager for hybrid offline+realtime tables
+(broker/routing/timeboundary/).
 """
 
 from __future__ import annotations
 
 import itertools
+import threading
+import time
+import zlib
 
 from pinot_tpu.query import ast
 from pinot_tpu.query.ast import CompareOp
@@ -117,3 +126,154 @@ class BalancedInstanceSelector:
             pick = replicas[next(self._rr) % len(replicas)]
             plan.setdefault(pick, []).append(seg)
         return plan, unroutable
+
+
+class ReplicaGroupInstanceSelector:
+    """Route each query to ONE replica index across all segments
+    (ReplicaGroupInstanceSelector parity): minimal fan-out when replicas are
+    placed as complete copies. Segments missing from the chosen replica fall
+    through to any other ONLINE replica (non-strict)."""
+
+    def __init__(self, strict: bool = False):
+        self._rr = itertools.count()
+        self.strict = strict
+
+    def select(self, ideal_state, segments):
+        group = next(self._rr)
+        plan: dict[str, list[str]] = {}
+        unroutable: list[str] = []
+        for seg in segments:
+            replicas = sorted(
+                s for s, st in ideal_state.get(seg, {}).items() if st in ("ONLINE", "CONSUMING")
+            )
+            if not replicas:
+                unroutable.append(seg)
+                continue
+            pick = replicas[group % len(replicas)]
+            plan.setdefault(pick, []).append(seg)
+        if self.strict and len(plan) > 1:
+            # StrictReplicaGroup: every segment must come from the same
+            # group index; mixed placement means the grouping is broken
+            counts = {s: len(v) for s, v in plan.items()}
+            raise RuntimeError(f"strict replica-group routing failed: segments span servers {counts}")
+        return plan, unroutable
+
+
+class AdaptiveServerSelector:
+    """Latency-aware replica choice (AdaptiveServerSelector parity, the
+    LATENCY strategy): EWMA of observed per-server latency; each segment goes
+    to its lowest-score ONLINE replica. Brokers call `record()` after every
+    scatter; unobserved servers score 0 (get traffic to gather data)."""
+
+    def __init__(self, alpha: float = 0.3):
+        self.alpha = alpha
+        self._ewma: dict[str, float] = {}
+        self._lock = threading.Lock()
+
+    def record(self, server_id: str, latency_ms: float) -> None:
+        with self._lock:
+            cur = self._ewma.get(server_id)
+            self._ewma[server_id] = (
+                latency_ms if cur is None else self.alpha * latency_ms + (1 - self.alpha) * cur
+            )
+
+    def score(self, server_id: str) -> float:
+        with self._lock:
+            return self._ewma.get(server_id, 0.0)
+
+    def select(self, ideal_state, segments):
+        plan: dict[str, list[str]] = {}
+        unroutable: list[str] = []
+        for seg in segments:
+            replicas = sorted(
+                s for s, st in ideal_state.get(seg, {}).items() if st in ("ONLINE", "CONSUMING")
+            )
+            if not replicas:
+                unroutable.append(seg)
+                continue
+            pick = min(replicas, key=lambda s: (self.score(s), s))
+            plan.setdefault(pick, []).append(seg)
+        return plan, unroutable
+
+
+# -- partition pruning (MultiPartitionColumnsSegmentPruner parity) -----------
+
+
+def partition_of(value, num_partitions: int) -> int:
+    """Stable partition function (Murmur-role; crc32 for strings, modulo for
+    ints — matches the builder side writing segment partition metadata)."""
+    if isinstance(value, (int, float)) and not isinstance(value, bool):
+        return int(value) % num_partitions
+    return zlib.crc32(str(value).encode()) % num_partitions
+
+
+def segment_partitions_match(f: ast.FilterExpr | None, partitions: dict) -> bool:
+    """False only when every EQ/IN value on a partitioned column hashes
+    outside this segment's partition set."""
+    if not partitions or f is None:
+        return True
+    if isinstance(f, ast.And):
+        return all(segment_partitions_match(c, partitions) for c in f.children)
+    if isinstance(f, ast.Or):
+        return any(segment_partitions_match(c, partitions) for c in f.children)
+    if isinstance(f, ast.Compare) and f.op == CompareOp.EQ:
+        left, right = f.left, f.right
+        if isinstance(left, ast.Literal) and isinstance(right, ast.Identifier):
+            left, right = right, left
+        if isinstance(left, ast.Identifier) and isinstance(right, ast.Literal):
+            p = partitions.get(left.name)
+            if p:
+                return partition_of(right.value, p["numPartitions"]) in set(p["partitionIds"])
+        return True
+    if isinstance(f, ast.In) and isinstance(f.expr, ast.Identifier) and not f.negated:
+        p = partitions.get(f.expr.name)
+        if p:
+            ids = set(p["partitionIds"])
+            return any(
+                partition_of(v.value, p["numPartitions"]) in ids
+                for v in f.values
+                if isinstance(v, ast.Literal)
+            )
+        return True
+    return True
+
+
+# -- time boundary (hybrid offline+realtime routing) -------------------------
+
+
+class TimeBoundary:
+    """Hybrid-table split (TimeBoundaryManager parity): offline serves
+    time <= boundary, realtime serves time > boundary, where boundary is the
+    max time value committed to the offline table."""
+
+    def __init__(self, time_column: str, boundary):
+        self.time_column = time_column
+        self.boundary = boundary
+
+    @staticmethod
+    def compute(offline_meta: dict[str, dict], time_column: str) -> "TimeBoundary | None":
+        hi = None
+        for m in offline_meta.values():
+            s = (m.get("stats") or {}).get(time_column)
+            if s and isinstance(s.get("max"), (int, float)):
+                hi = s["max"] if hi is None else max(hi, s["max"])
+        return TimeBoundary(time_column, hi) if hi is not None else None
+
+    def offline_sql(self, sql: str) -> str:
+        return _with_time_predicate(sql, f"{self.time_column} <= {self.boundary}")
+
+    def realtime_sql(self, sql: str) -> str:
+        return _with_time_predicate(sql, f"{self.time_column} > {self.boundary}")
+
+
+def _with_time_predicate(sql: str, predicate: str) -> str:
+    """Inject an AND predicate into the (single-table, v1) query text — the
+    string-level analog of attaching the time filter to BrokerRequest."""
+    import re
+
+    m = re.search(r"\bWHERE\b", sql, re.IGNORECASE)
+    if m:
+        return sql[: m.end()] + f" ({predicate}) AND" + sql[m.end() :]
+    tail = re.search(r"\b(GROUP\s+BY|ORDER\s+BY|LIMIT|HAVING)\b", sql, re.IGNORECASE)
+    pos = tail.start() if tail else len(sql)
+    return sql[:pos].rstrip() + f" WHERE {predicate} " + sql[pos:]

@@ -151,6 +151,14 @@ def cmd_schedule_tasks(args) -> dict:
     return {"scheduled": scheduled}
 
 
+def cmd_rebalance_table(args) -> dict:
+    from pinot_tpu.cluster.http import RemoteControllerClient
+
+    out = RemoteControllerClient(args.controller_url).rebalance_table(args.table, dry_run=args.dry_run)
+    print(json.dumps(out), flush=True)
+    return out
+
+
 def cmd_quickstart(args) -> dict:
     """All-in-one in-process cluster with a sample table
     (QuickStartCommand parity: baseballStats-flavored demo data)."""
@@ -281,6 +289,12 @@ def build_parser() -> argparse.ArgumentParser:
     st.add_argument("--controller-url", required=True)
     st.add_argument("--task-type", default=None)
     st.set_defaults(fn=cmd_schedule_tasks, blocking=False)
+
+    rb = sub.add_parser("RebalanceTable")
+    rb.add_argument("--controller-url", required=True)
+    rb.add_argument("--table", required=True)
+    rb.add_argument("--dry-run", action="store_true")
+    rb.set_defaults(fn=cmd_rebalance_table, blocking=False)
 
     return p
 

@@ -1,0 +1,299 @@
+"""Routing extras: replica-group/adaptive selectors, failure detector with
+failover, partition pruning, hybrid time-boundary routing, table rebalance.
+
+Reference test model: instance-selector tests
+(pinot-broker InstanceSelectorTest), FailureDetectorTest,
+SegmentPartitionConfig pruner tests, hybrid TimeBoundary tests,
+TableRebalancerTest (SURVEY.md §2.3/§5.3).
+"""
+
+import numpy as np
+import pytest
+
+from pinot_tpu.cluster import Broker, Controller, PropertyStore, Server
+from pinot_tpu.cluster.failure import FailureDetector
+from pinot_tpu.cluster.rebalance import compute_target_assignment, rebalance_table
+from pinot_tpu.cluster.routing import (
+    AdaptiveServerSelector,
+    BalancedInstanceSelector,
+    ReplicaGroupInstanceSelector,
+    TimeBoundary,
+    partition_of,
+    segment_partitions_match,
+)
+from pinot_tpu.common import DataType, Schema, TableConfig, TableType
+from pinot_tpu.query.sql import parse_sql
+from pinot_tpu.segment import SegmentBuilder
+
+
+def _ideal(n_segs=4, servers=("s0", "s1")):
+    return {f"seg{i}": {s: "ONLINE" for s in servers} for i in range(n_segs)}
+
+
+# -- selectors ---------------------------------------------------------------
+
+
+def test_replica_group_selector_single_server_per_query():
+    sel = ReplicaGroupInstanceSelector()
+    plan, un = sel.select(_ideal(), [f"seg{i}" for i in range(4)])
+    assert not un
+    assert len(plan) == 1  # whole query on one replica group
+    plan2, _ = sel.select(_ideal(), [f"seg{i}" for i in range(4)])
+    assert list(plan2) != list(plan)  # round-robins groups across queries
+
+
+def test_adaptive_selector_prefers_fast_server():
+    sel = AdaptiveServerSelector()
+    sel.record("s0", 100.0)
+    sel.record("s1", 5.0)
+    plan, _ = sel.select(_ideal(), ["seg0", "seg1"])
+    assert set(plan) == {"s1"}
+    # s1 degrades -> traffic shifts
+    for _ in range(10):
+        sel.record("s1", 500.0)
+    plan2, _ = sel.select(_ideal(), ["seg0"])
+    assert set(plan2) == {"s0"}
+
+
+# -- failure detector --------------------------------------------------------
+
+
+def test_failure_detector_backoff_and_recovery():
+    fd = FailureDetector(initial_delay_sec=0.05, backoff_factor=2.0)
+    assert fd.is_healthy("s0")
+    fd.mark_failure("s0")
+    assert not fd.is_healthy("s0")
+    assert fd.unhealthy_servers() == ["s0"]
+    import time
+
+    time.sleep(0.06)
+    assert fd.is_healthy("s0")  # retry slot open
+    fd.mark_failure("s0")  # second failure: longer backoff
+    time.sleep(0.06)
+    assert not fd.is_healthy("s0")
+    fd.mark_success("s0")
+    assert fd.is_healthy("s0")
+
+
+def test_filter_ideal_state_keeps_last_replica():
+    fd = FailureDetector(initial_delay_sec=10)
+    fd.mark_failure("s0")
+    ideal = {"a": {"s0": "ONLINE", "s1": "ONLINE"}, "b": {"s0": "ONLINE"}}
+    out = fd.filter_ideal_state(ideal)
+    assert out["a"] == {"s1": "ONLINE"}
+    assert out["b"] == {"s0": "ONLINE"}  # sole replica retained
+
+
+class _FlakyServer:
+    """Wraps a real Server; fails the first N execute_partials calls the way
+    a dead TCP peer does."""
+
+    def __init__(self, inner, failures=1):
+        self.inner = inner
+        self.failures = failures
+
+    def __getattr__(self, name):
+        return getattr(self.inner, name)
+
+    def execute_partials(self, *a, **kw):
+        if self.failures > 0:
+            self.failures -= 1
+            raise RuntimeError("server http://flaky unreachable: connection refused")
+        return self.inner.execute_partials(*a, **kw)
+
+
+def test_broker_failover_retries_on_surviving_replica(tmp_path):
+    controller = Controller(PropertyStore(), tmp_path / "ds")
+    good = Server("s_good")
+    flaky_inner = Server("s_flaky")
+    flaky = _FlakyServer(flaky_inner, failures=1)
+    controller.register_server("s_flaky", flaky)
+    controller.register_server("s_good", good)
+    schema = Schema.build("t", dimensions=[("d", DataType.INT)], metrics=[("v", DataType.LONG)])
+    controller.add_schema(schema)
+    controller.add_table(TableConfig("t", replication=2))
+    b = SegmentBuilder(schema)
+    for i in range(2):
+        controller.upload_segment(
+            "t", b.build({"d": np.arange(10, dtype=np.int32), "v": np.full(10, i, dtype=np.int64)}, f"t_{i}")
+        )
+    fd = FailureDetector(initial_delay_sec=30)
+    broker = Broker(controller, failure_detector=fd)
+    res = broker.execute("SELECT COUNT(*) FROM t")
+    assert res.rows[0][0] == 20  # failover covered the flaky server's share
+    assert fd.unhealthy_servers() == ["s_flaky"]
+    # subsequent queries route around the down server entirely
+    assert broker.execute("SELECT COUNT(*) FROM t").rows[0][0] == 20
+
+
+def test_broker_failover_exhausted_raises(tmp_path):
+    controller = Controller(PropertyStore(), tmp_path / "ds")
+    controller.register_server("s0", _FlakyServer(Server("s0"), failures=99))
+    schema = Schema.build("t", dimensions=[("d", DataType.INT)], metrics=[("v", DataType.LONG)])
+    controller.add_schema(schema)
+    controller.add_table(TableConfig("t"))
+    controller.upload_segment(
+        "t",
+        SegmentBuilder(schema).build(
+            {"d": np.arange(4, dtype=np.int32), "v": np.arange(4, dtype=np.int64)}, "t_0"
+        ),
+    )
+    broker = Broker(controller, failure_detector=FailureDetector())
+    with pytest.raises(RuntimeError, match="unreachable|no surviving"):
+        broker.execute("SELECT COUNT(*) FROM t")
+
+
+# -- partition pruning -------------------------------------------------------
+
+
+def test_partition_of_stability():
+    assert partition_of(17, 8) == 1
+    assert partition_of("abc", 8) == partition_of("abc", 8)
+    assert 0 <= partition_of("xyz", 5) < 5
+
+
+def test_segment_partitions_match_eq_and_in():
+    stmt = parse_sql("SELECT COUNT(*) FROM t WHERE k = 'a'")
+    p_yes = {"k": {"numPartitions": 4, "partitionIds": [partition_of("a", 4)]}}
+    p_no = {"k": {"numPartitions": 4, "partitionIds": [(partition_of("a", 4) + 1) % 4]}}
+    assert segment_partitions_match(stmt.where, p_yes)
+    assert not segment_partitions_match(stmt.where, p_no)
+    stmt_in = parse_sql("SELECT COUNT(*) FROM t WHERE k IN ('a', 'b')")
+    p_b = {"k": {"numPartitions": 4, "partitionIds": [partition_of("b", 4)]}}
+    assert segment_partitions_match(stmt_in.where, p_b)
+
+
+def test_partitioned_table_prunes_at_broker(tmp_path):
+    controller = Controller(PropertyStore(), tmp_path / "ds")
+    controller.register_server("s0", Server("s0"))
+    schema = Schema.build("t", dimensions=[("k", DataType.INT)], metrics=[("v", DataType.LONG)])
+    controller.add_schema(schema)
+    tc = TableConfig("t")
+    tc.extra = {"segmentPartitionConfig": {"k": 2}}
+    controller.add_table(tc)
+    b = SegmentBuilder(schema)
+    # segment 0: even k; segment 1: odd k
+    controller.upload_segment(
+        "t", b.build({"k": np.arange(0, 20, 2, dtype=np.int32), "v": np.ones(10, dtype=np.int64)}, "even")
+    )
+    controller.upload_segment(
+        "t", b.build({"k": np.arange(1, 21, 2, dtype=np.int32), "v": np.ones(10, dtype=np.int64)}, "odd")
+    )
+    assert controller.segment_metadata("t", "even")["partitions"]["k"]["partitionIds"] == [0]
+    broker = Broker(controller)
+    res = broker.execute("SELECT COUNT(*) FROM t WHERE k = 4")
+    assert res.rows[0][0] == 1
+    assert res.num_segments_pruned == 1  # odd segment pruned by partition id
+    assert broker.execute("SELECT COUNT(*) FROM t").rows[0][0] == 20
+
+
+# -- hybrid time boundary ----------------------------------------------------
+
+
+def test_time_boundary_sql_rewrites():
+    tb = TimeBoundary("ts", 100)
+    assert tb.offline_sql("SELECT COUNT(*) FROM t WHERE x = 1 LIMIT 5") == (
+        "SELECT COUNT(*) FROM t WHERE (ts <= 100) AND x = 1 LIMIT 5"
+    )
+    assert tb.realtime_sql("SELECT COUNT(*) FROM t GROUP BY k") == (
+        "SELECT COUNT(*) FROM t WHERE ts > 100 GROUP BY k"
+    )
+
+
+def test_hybrid_table_query_splits_on_boundary(tmp_path):
+    controller = Controller(PropertyStore(), tmp_path / "ds")
+    controller.register_server("s0", Server("s0"))
+    schema = Schema.build(
+        "web", dimensions=[("k", DataType.STRING)], metrics=[("v", DataType.LONG)], date_times=[("ts", DataType.LONG)]
+    )
+    controller.add_schema(schema)
+    controller.add_schema(
+        Schema.build(
+            "web_REALTIME",
+            dimensions=[("k", DataType.STRING)],
+            metrics=[("v", DataType.LONG)],
+            date_times=[("ts", DataType.LONG)],
+        )
+    )
+    controller.add_table(TableConfig("web", time_column="ts"))
+    controller.add_table(TableConfig("web_REALTIME", TableType.REALTIME, time_column="ts"))
+    b = SegmentBuilder(schema)
+    # offline has ts 0..9; realtime overlaps 5..14 (committed-but-not-moved)
+    controller.upload_segment(
+        "web",
+        b.build(
+            {"k": np.array(["a"] * 10, dtype=object), "v": np.ones(10, dtype=np.int64), "ts": np.arange(10, dtype=np.int64)},
+            "off_0",
+        ),
+    )
+    controller.upload_segment(
+        "web_REALTIME",
+        b.build(
+            {"k": np.array(["a"] * 10, dtype=object), "v": np.ones(10, dtype=np.int64), "ts": np.arange(5, 15, dtype=np.int64)},
+            "rt_0",
+        ),
+    )
+    broker = Broker(controller)
+    # boundary = 9 (offline max): offline serves ts<=9 (10 rows), realtime
+    # serves ts>9 (5 rows) -> overlap NOT double-counted
+    res = broker.execute("SELECT COUNT(*), SUM(v) FROM web")
+    assert res.rows[0] == [15, 15.0]
+    # realtime table still directly queryable under its full name
+    assert broker.execute("SELECT COUNT(*) FROM web_REALTIME").rows[0][0] == 10
+
+
+# -- rebalance ---------------------------------------------------------------
+
+
+def test_compute_target_minimal_movement():
+    current = {"a": {"s0": "ONLINE"}, "b": {"s0": "ONLINE"}}
+    target = compute_target_assignment(["a", "b"], ["s0", "s1"], 1, current)
+    # existing placement kept; nothing moves for replication=1
+    assert target == {"a": ["s0"], "b": ["s0"]}
+    target2 = compute_target_assignment(["a", "b"], ["s0", "s1"], 2, current)
+    assert target2 == {"a": ["s0", "s1"], "b": ["s0", "s1"]}
+
+
+def test_rebalance_after_server_addition(tmp_path):
+    controller = Controller(PropertyStore(), tmp_path / "ds")
+    s0 = Server("s0")
+    controller.register_server("s0", s0)
+    schema = Schema.build("t", dimensions=[("d", DataType.INT)], metrics=[("v", DataType.LONG)])
+    controller.add_schema(schema)
+    controller.add_table(TableConfig("t", replication=2))
+    b = SegmentBuilder(schema)
+    for i in range(3):
+        controller.upload_segment(
+            "t", b.build({"d": np.arange(5, dtype=np.int32), "v": np.arange(5, dtype=np.int64)}, f"t_{i}")
+        )
+    # single server: replication clamped to 1
+    assert all(len(r) == 1 for r in controller.ideal_state("t").values())
+    s1 = Server("s1")
+    controller.register_server("s1", s1)
+    r = rebalance_table(controller, "t")
+    assert r.status == "DONE"
+    assert {a[1] for a in r.adds} == {"s1"}
+    ideal = controller.ideal_state("t")
+    assert all(set(v) == {"s0", "s1"} for v in ideal.values())
+    assert s1.segments_of("t") == ["t_0", "t_1", "t_2"]
+    assert Broker(controller).execute("SELECT COUNT(*) FROM t").rows[0][0] == 15
+    # idempotent
+    assert rebalance_table(controller, "t").status == "NO_OP"
+
+
+def test_rebalance_dry_run_moves_nothing(tmp_path):
+    controller = Controller(PropertyStore(), tmp_path / "ds")
+    controller.register_server("s0", Server("s0"))
+    schema = Schema.build("t", dimensions=[("d", DataType.INT)], metrics=[("v", DataType.LONG)])
+    controller.add_schema(schema)
+    controller.add_table(TableConfig("t", replication=2))
+    controller.upload_segment(
+        "t",
+        SegmentBuilder(schema).build(
+            {"d": np.arange(3, dtype=np.int32), "v": np.arange(3, dtype=np.int64)}, "t_0"
+        ),
+    )
+    controller.register_server("s1", Server("s1"))
+    r = rebalance_table(controller, "t", dry_run=True)
+    assert r.status == "DONE" and r.adds == [("t_0", "s1")]
+    assert set(controller.ideal_state("t")["t_0"]) == {"s0"}  # unchanged
