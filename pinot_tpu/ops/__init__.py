@@ -1,0 +1,17 @@
+from pinot_tpu.ops.groupby_pallas import (
+    pallas_enabled,
+    pallas_grouped_count,
+    pallas_grouped_max,
+    pallas_grouped_min,
+    pallas_grouped_sum,
+    pallas_presence,
+)
+
+__all__ = [
+    "pallas_enabled",
+    "pallas_grouped_sum",
+    "pallas_grouped_count",
+    "pallas_grouped_min",
+    "pallas_grouped_max",
+    "pallas_presence",
+]

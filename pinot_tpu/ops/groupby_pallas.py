@@ -1,0 +1,201 @@
+"""Pallas TPU kernels for the group-by hot path: segment aggregation as
+one-hot matmul on the MXU.
+
+Reference parity: the inner loops of DefaultGroupByExecutor +
+DictionaryBasedGroupKeyGenerator (pinot-core/.../query/aggregation/groupby/
+DefaultGroupByExecutor.java:191, DictionaryBasedGroupKeyGenerator.java:119-130)
+and the count/sum/min/max result holders. On TPU the dense-group-id
+reduction maps to the systolic array: for a doc chunk of C docs and a group
+tile of G groups, the one-hot matrix onehot[c, g] = (gid[c] == g) turns
+
+    out[g] += sum_c masked_values[c] * onehot[c, g]
+
+into a (1, C) x (C, G) matmul — the MXU does the scatter-add. MIN/MAX and
+DISTINCT presence use the same one-hot tile with a VPU column reduction.
+The grid walks (group_tile, chunk) with the chunk axis innermost so each
+output tile stays resident in VMEM while all chunks accumulate into it.
+
+These kernels are the bench/fast path (float32 accumulation); the default
+engine path keeps XLA segment_sum with float64 parity accumulators. Enable
+with PINOT_TPU_PALLAS=1 (TPU backend) — kernels.py consults pallas_enabled().
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+CHUNK = 1024  # docs per grid step (sublane-friendly, fits VMEM one-hot tile)
+GROUP_TILE = 256  # groups per output tile (one-hot tile = CHUNK x GROUP_TILE)
+
+
+def pallas_enabled() -> bool:
+    """Fast path opt-in: PINOT_TPU_PALLAS=1 and a TPU-like backend (interpret
+    mode makes it work anywhere, but it only pays off on TPU)."""
+    return os.environ.get("PINOT_TPU_PALLAS", "") == "1"
+
+
+def _interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def _pad_inputs(gid, values, mask):
+    n = gid.shape[0]
+    pad = (-n) % CHUNK
+    if pad:
+        gid = jnp.pad(gid, (0, pad))
+        mask = jnp.pad(mask, (0, pad))
+        if values is not None:
+            values = jnp.pad(values, (0, pad))
+    return gid, values, mask, n + pad
+
+
+def _grids(n_padded: int, ng: int):
+    ng_pad = max(GROUP_TILE, ((ng + GROUP_TILE - 1) // GROUP_TILE) * GROUP_TILE)
+    return n_padded // CHUNK, ng_pad // GROUP_TILE, ng_pad
+
+
+# -- sum / count: MXU one-hot matmul ----------------------------------------
+
+
+def _sum_kernel(gid_ref, val_ref, out_ref):
+    from jax.experimental import pallas as pl
+
+    ci = pl.program_id(1)  # chunk index (innermost: accumulates in VMEM)
+    gi = pl.program_id(0)  # group-tile index
+
+    @pl.when(ci == 0)
+    def _():
+        out_ref[:] = jnp.zeros_like(out_ref)
+
+    gid = gid_ref[0, :]  # (CHUNK,) int32, already offset to this tile
+    vals = val_ref[0:1, :]  # (1, CHUNK) f32, mask pre-applied
+    base = gi * GROUP_TILE
+    onehot = (
+        gid[:, None] == (base + jax.lax.broadcasted_iota(jnp.int32, (CHUNK, GROUP_TILE), 1))
+    ).astype(jnp.float32)
+    # (1, CHUNK) @ (CHUNK, GROUP_TILE): the MXU performs the scatter-add
+    out_ref[:] = out_ref[:] + jnp.dot(vals, onehot, preferred_element_type=jnp.float32)
+
+
+@functools.partial(jax.jit, static_argnames=("ng",))
+def _grouped_sum_impl(gid, masked_vals, ng: int):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    n_padded = gid.shape[0]
+    n_chunks, n_gtiles, ng_pad = _grids(n_padded, ng)
+    gid2 = gid.reshape(1, n_padded)
+    vals2 = masked_vals.reshape(1, n_padded)
+    out = pl.pallas_call(
+        _sum_kernel,
+        grid=(n_gtiles, n_chunks),
+        in_specs=[
+            pl.BlockSpec((1, CHUNK), lambda g, c: (jnp.int32(0), c), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, CHUNK), lambda g, c: (jnp.int32(0), c), memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((1, GROUP_TILE), lambda g, c: (jnp.int32(0), g), memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((1, ng_pad), jnp.float32),
+        interpret=_interpret(),
+    )(gid2, vals2)
+    return out[0, :ng]
+
+
+def pallas_grouped_sum(values, gid, mask, ng: int):
+    """sum of values per group id in [0, ng); masked docs contribute 0."""
+    gid, values, mask, _ = _pad_inputs(
+        gid.astype(jnp.int32), values.astype(jnp.float32), mask
+    )
+    masked = jnp.where(mask, values, 0.0)
+    return _grouped_sum_impl(gid, masked, ng)
+
+
+def pallas_grouped_count(gid, mask, ng: int):
+    """count of masked docs per group (COUNT result holder)."""
+    gid, _, mask, _ = _pad_inputs(gid.astype(jnp.int32), None, mask)
+    return _grouped_sum_impl(gid, mask.astype(jnp.float32), ng)
+
+
+# -- min / max / presence: one-hot select + VPU column reduce ----------------
+
+
+def _make_extreme_kernel(is_min: bool):
+    from jax.experimental import pallas as pl
+
+    fill = jnp.inf if is_min else -jnp.inf
+
+    def kernel(gid_ref, val_ref, mask_ref, out_ref):
+        ci = pl.program_id(1)
+        gi = pl.program_id(0)
+
+        @pl.when(ci == 0)
+        def _():
+            out_ref[:] = jnp.full_like(out_ref, fill)
+
+        gid = gid_ref[0, :]
+        vals = val_ref[0, :]
+        base = gi * GROUP_TILE
+        hit = gid[:, None] == (
+            base + jax.lax.broadcasted_iota(jnp.int32, (CHUNK, GROUP_TILE), 1)
+        )
+        # minor-dim insertion must happen on 32-bit values (Mosaic tiling
+        # constraint): broadcast the int32 mask, then compare
+        maskcol = mask_ref[0, :][:, None] != 0
+        w = jnp.where(hit & maskcol, vals[:, None], fill)
+        # keepdims: the (1, GROUP_TILE) shape matches out_ref's block layout
+        col = jnp.min(w, axis=0, keepdims=True) if is_min else jnp.max(w, axis=0, keepdims=True)
+        out_ref[:] = jnp.minimum(out_ref[:], col) if is_min else jnp.maximum(out_ref[:], col)
+
+    return kernel
+
+
+_MIN_KERNEL = _make_extreme_kernel(True)
+_MAX_KERNEL = _make_extreme_kernel(False)
+
+
+@functools.partial(jax.jit, static_argnames=("ng", "is_min"))
+def _grouped_extreme_impl(gid, values, mask, ng: int, is_min: bool):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    n_padded = gid.shape[0]
+    n_chunks, n_gtiles, ng_pad = _grids(n_padded, ng)
+    out = pl.pallas_call(
+        _MIN_KERNEL if is_min else _MAX_KERNEL,
+        grid=(n_gtiles, n_chunks),
+        in_specs=[
+            pl.BlockSpec((1, CHUNK), lambda g, c: (jnp.int32(0), c), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, CHUNK), lambda g, c: (jnp.int32(0), c), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, CHUNK), lambda g, c: (jnp.int32(0), c), memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((1, GROUP_TILE), lambda g, c: (jnp.int32(0), g), memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((1, ng_pad), jnp.float32),
+        interpret=_interpret(),
+    )(
+        gid.reshape(1, n_padded),
+        values.reshape(1, n_padded),
+        mask.astype(jnp.int32).reshape(1, n_padded),
+    )
+    return out[0, :ng]
+
+
+def pallas_grouped_min(values, gid, mask, ng: int):
+    gid, values, mask, _ = _pad_inputs(gid.astype(jnp.int32), values.astype(jnp.float32), mask)
+    return _grouped_extreme_impl(gid, values, mask, ng, True)
+
+
+def pallas_grouped_max(values, gid, mask, ng: int):
+    gid, values, mask, _ = _pad_inputs(gid.astype(jnp.int32), values.astype(jnp.float32), mask)
+    return _grouped_extreme_impl(gid, values, mask, ng, False)
+
+
+def pallas_presence(dict_ids, mask, cardinality: int):
+    """DISTINCTCOUNT presence bitmap: presence[d] = any masked doc with
+    dict id d (the scatter-max over the valid-doc mask)."""
+    ids, _, mask, _ = _pad_inputs(dict_ids.astype(jnp.int32), None, mask)
+    counts = _grouped_sum_impl(ids, mask.astype(jnp.float32), cardinality)
+    return counts > 0

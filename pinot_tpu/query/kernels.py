@@ -190,17 +190,33 @@ def _agg_scalar(aspec, cols, ops, mask):
 
 
 def _agg_grouped(aspec, cols, ops, mask, gid, ng):
+    from pinot_tpu.ops import groupby_pallas as gp
+
+    use_pallas = gp.pallas_enabled()
     kind = aspec[0]
     if kind == "count":
+        if use_pallas:
+            return gp.pallas_grouped_count(gid, mask, ng).astype(_I)
         return jax.ops.segment_sum(mask.astype(_I), gid, num_segments=ng)
     v = _value(aspec[1], cols, ops).astype(_F)
     if kind == "sum":
+        if use_pallas:
+            return gp.pallas_grouped_sum(v, gid, mask, ng).astype(_F)
         return jax.ops.segment_sum(jnp.where(mask, v, 0.0), gid, num_segments=ng)
     if kind == "min":
+        if use_pallas:
+            return gp.pallas_grouped_min(v, gid, mask, ng).astype(_F)
         return jax.ops.segment_min(jnp.where(mask, v, jnp.inf), gid, num_segments=ng)
     if kind == "max":
+        if use_pallas:
+            return gp.pallas_grouped_max(v, gid, mask, ng).astype(_F)
         return jax.ops.segment_max(jnp.where(mask, v, -jnp.inf), gid, num_segments=ng)
     if kind == "avg":
+        if use_pallas:
+            return (
+                gp.pallas_grouped_sum(v, gid, mask, ng).astype(_F),
+                gp.pallas_grouped_count(gid, mask, ng).astype(_I),
+            )
         return (
             jax.ops.segment_sum(jnp.where(mask, v, 0.0), gid, num_segments=ng),
             jax.ops.segment_sum(mask.astype(_I), gid, num_segments=ng),
@@ -241,7 +257,12 @@ def build_fn(spec: tuple):
             gid = jnp.zeros((n_padded,), dtype=jnp.int32)
             for i, c in enumerate(gcols):
                 gid = gid + cols[c] * strides[i]
-            counts = jax.ops.segment_sum(mask.astype(_I), gid, num_segments=ng)
+            from pinot_tpu.ops import groupby_pallas as gp
+
+            if gp.pallas_enabled():
+                counts = gp.pallas_grouped_count(gid, mask, ng).astype(_I)
+            else:
+                counts = jax.ops.segment_sum(mask.astype(_I), gid, num_segments=ng)
             return matched, counts, tuple(_agg_grouped(a, cols, ops, mask, gid, ng) for a in aggs)
 
         return run
