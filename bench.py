@@ -73,8 +73,7 @@ def main():
     lat = []
     for _ in range(iters):
         t0 = time.perf_counter()
-        ctx, plan, out = execute_sharded(table, sql)
-        jax.block_until_ready(out)
+        res = execute_sharded_result(table, sql)  # full query: rows on host
         lat.append((time.perf_counter() - t0) * 1e3)
     device_p50 = float(np.percentile(lat, 50))
     log(f"device latencies ms: {[round(x, 2) for x in lat]}")

@@ -34,9 +34,20 @@ GROUP_TILE = 256  # groups per output tile (one-hot tile = CHUNK x GROUP_TILE)
 
 
 def pallas_enabled() -> bool:
-    """Fast path opt-in: PINOT_TPU_PALLAS=1 and a TPU-like backend (interpret
-    mode makes it work anywhere, but it only pays off on TPU)."""
+    """Lossy-f32 fast path opt-in: PINOT_TPU_PALLAS=1 (the exact byte-plane
+    kernels below are governed by pallas_auto and need no opt-in)."""
     return os.environ.get("PINOT_TPU_PALLAS", "") == "1"
+
+
+def pallas_auto() -> bool:
+    """Exact pallas kernels: on by default on TPU, off elsewhere (interpret
+    mode works but XLA is faster on CPU). PINOT_TPU_PALLAS=1/0 overrides."""
+    env = os.environ.get("PINOT_TPU_PALLAS", "")
+    if env == "1":
+        return True
+    if env == "0":
+        return False
+    return jax.default_backend() == "tpu"
 
 
 def _interpret() -> bool:
@@ -191,6 +202,102 @@ def pallas_grouped_min(values, gid, mask, ng: int):
 def pallas_grouped_max(values, gid, mask, ng: int):
     gid, values, mask, _ = _pad_inputs(gid.astype(jnp.int32), values.astype(jnp.float32), mask)
     return _grouped_extreme_impl(gid, values, mask, ng, False)
+
+
+# -- exact integer sum+count: byte-plane one-hot matmul ----------------------
+#
+# f32 MXU accumulation is inexact past 2^24, so a lossless integer SUM splits
+# each int32 value into four signed byte planes (v = b3*2^24 + b2*2^16 +
+# b1*2^8 + b0, arithmetic shifts keep the sign in b3). Each chunk's per-plane
+# dot product is <= 1024*255 < 2^24 (exact in f32); the cross-chunk
+# accumulator is int32 (exact to 2^31 — plane totals stay under it for
+# segment sets below ~8M docs). One (8, CHUNK) x (CHUNK, GROUP_TILE) matmul
+# yields byte-plane sums AND the group count (mask rides as a 5th plane);
+# the tiny (5, ng) recombination runs in f64 outside the kernel.
+
+def _make_planes_kernel(r: int):
+    from jax.experimental import pallas as pl
+
+    def kernel(gid_ref, planes_ref, out_ref):
+        ci = pl.program_id(1)
+        gi = pl.program_id(0)
+
+        @pl.when(ci == 0)
+        def _():
+            out_ref[:] = jnp.zeros_like(out_ref)
+
+        gid = gid_ref[0, :]
+        planes = planes_ref[:]  # (r, CHUNK) f32, pre-masked
+        base = gi * GROUP_TILE
+        onehot = (
+            gid[:, None] == (base + jax.lax.broadcasted_iota(jnp.int32, (CHUNK, GROUP_TILE), 1))
+        ).astype(jnp.float32)
+        acc = jnp.dot(planes, onehot, preferred_element_type=jnp.float32)  # exact per chunk
+        out_ref[:] = out_ref[:] + acc.astype(jnp.int32)
+
+    return kernel
+
+
+@functools.partial(jax.jit, static_argnames=("ng", "r"))
+def _planes_impl(gid, planes, ng: int, r: int):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    n_padded = gid.shape[0]
+    n_chunks, n_gtiles, ng_pad = _grids(n_padded, ng)
+    return pl.pallas_call(
+        _make_planes_kernel(r),
+        grid=(n_gtiles, n_chunks),
+        in_specs=[
+            pl.BlockSpec((1, CHUNK), lambda g, c: (jnp.int32(0), c), memory_space=pltpu.VMEM),
+            pl.BlockSpec((r, CHUNK), lambda g, c: (jnp.int32(0), c), memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((r, GROUP_TILE), lambda g, c: (jnp.int32(0), g), memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((r, ng_pad), jnp.int32),
+        interpret=_interpret(),
+    )(gid.reshape(1, n_padded), planes)
+
+
+def pallas_grouped_multi_sum(values_list, gid, mask, ng: int):
+    """Fused lossless group-by reduction: byte-plane sums for every int32
+    value array plus the group count, in ONE pallas pass. Returns
+    ([f64 (ng,) sum per input], i64 (ng,) counts)."""
+    k = len(values_list)
+    gid, _, mask, n_padded = _pad_inputs(gid.astype(jnp.int32), None, mask)
+    rows = []
+    for v in values_list:
+        v = jnp.pad(v.astype(jnp.int32), (0, n_padded - v.shape[0]))
+        v = jnp.where(mask, v, 0)
+        rows.extend(
+            [
+                (v & 0xFF).astype(jnp.float32),
+                ((v >> 8) & 0xFF).astype(jnp.float32),
+                ((v >> 16) & 0xFF).astype(jnp.float32),
+                (v >> 24).astype(jnp.float32),  # signed high byte
+            ]
+        )
+    rows.append(mask.astype(jnp.float32))
+    r = -(-len(rows) // 8) * 8  # pad plane rows to the f32 sublane tile
+    while len(rows) < r:
+        rows.append(jnp.zeros((n_padded,), jnp.float32))
+    out = _planes_impl(gid, jnp.stack(rows), ng, r)
+    sums = []
+    for i in range(k):
+        p = out[4 * i : 4 * i + 4, :ng].astype(jnp.float64)
+        sums.append(p[0] + p[1] * 256.0 + p[2] * 65536.0 + p[3] * 16777216.0)
+    counts = out[4 * k, :ng].astype(jnp.int64)
+    return sums, counts
+
+
+def pallas_grouped_sum_count_exact(values_i32, gid, mask, ng: int):
+    """Lossless (sum, count) per group for one int32 value array."""
+    sums, counts = pallas_grouped_multi_sum([values_i32], gid, mask, ng)
+    return sums[0], counts
+
+
+def pallas_grouped_count_exact(gid, mask, ng: int):
+    """Lossless count per group (mask plane only, i32 accumulator)."""
+    return pallas_grouped_multi_sum([], gid, mask, ng)[1]
 
 
 def pallas_presence(dict_ids, mask, cardinality: int):

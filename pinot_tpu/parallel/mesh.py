@@ -87,6 +87,12 @@ def build_sharded_table(
     sharding = NamedSharding(mesh, P(axis, None))
     for col, ci in proto.columns.items():
         fwd = ci.forward
+        if fwd.dtype == np.int64 and len(fwd):
+            # lossless narrowing (DeviceSegment.to_device parity): i64 is
+            # software-emulated on TPU, i32 unlocks the native integer paths
+            lo, hi = int(fwd.min()), int(fwd.max())
+            if np.iinfo(np.int32).min <= lo and hi <= np.iinfo(np.int32).max:
+                fwd = fwd.astype(np.int32)
         stacked = np.zeros((n_seg, pad), dtype=fwd.dtype)
         for s in range(n_seg):
             chunk = fwd[s * rows_per_segment : (s + 1) * rows_per_segment]
@@ -113,24 +119,25 @@ def build_sharded_table(
 # ---------------------------------------------------------------------------
 
 
-def _combine_tree(spec: tuple, matched, counts, parts, axis_name: str | None):
-    """Reduce vmapped per-segment partials over the leading axis, optionally
-    followed by a collective over the mesh axis."""
+def _combine_tree(spec: tuple, matched, counts, parts, axis_name: str | None, local_axis: bool = True):
+    """Reduce per-segment partials over the leading axis (when the kernel ran
+    vmapped; the flat path sets local_axis=False), then a collective over the
+    mesh axis."""
 
     def red_sum(x):
-        y = jnp.sum(x, axis=0)
+        y = jnp.sum(x, axis=0) if local_axis else x
         return jax.lax.psum(y, axis_name) if axis_name else y
 
     def red_min(x):
-        y = jnp.min(x, axis=0)
+        y = jnp.min(x, axis=0) if local_axis else x
         return jax.lax.pmin(y, axis_name) if axis_name else y
 
     def red_max(x):
-        y = jnp.max(x, axis=0)
+        y = jnp.max(x, axis=0) if local_axis else x
         return jax.lax.pmax(y, axis_name) if axis_name else y
 
     def red_or(x):
-        y = jnp.max(x.astype(jnp.int32), axis=0)
+        y = jnp.max(x.astype(jnp.int32), axis=0) if local_axis else x.astype(jnp.int32)
         if axis_name:
             y = jax.lax.pmax(y, axis_name)
         return y.astype(bool)
@@ -163,20 +170,40 @@ def _combine_tree(spec: tuple, matched, counts, parts, axis_name: str | None):
 @lru_cache(maxsize=256)
 def _sharded_kernel(spec: tuple, mesh: Mesh, axis: str):
     """vmapped per-segment kernel + local reduce + ICI collective, wrapped in
-    shard_map over the segment axis and jitted."""
-    base = build_fn(spec)
+    shard_map over the segment axis and jitted.
+
+    The jitted function returns ONE packed float64 vector holding every
+    output leaf (matched count, group counts, agg partials). A query result
+    then costs a single device->host transfer: on tunneled/remote TPU
+    attachments each host sync is a full round trip (~tens of ms), so
+    blocking on a pytree of N arrays costs N round trips — packing collapses
+    that to one (the DataTable-bytes-in-one-response analog).
+
+    Returns (jitted_fn, unpack) where unpack(np_vector) restores the
+    original (matched[, counts], parts) tree with proper dtypes."""
+    from pinot_tpu.query.kernels import build_masked_fn
+
+    base = build_masked_fn(spec)
     grouped = spec[2] is not None
+    pack_meta: dict = {}
 
     def per_shard(cols, ops, n_docs):
-        # cols: (S_local, P); vmap the 1-D kernel over local segments
-        vm = jax.vmap(base, in_axes=({k: 0 for k in cols}, None, 0))
-        out = vm(cols, ops, n_docs)
+        # cols: (S_local, P). Aggregates are order-independent, so flatten
+        # the local segments into ONE doc vector with a per-segment validity
+        # mask — one wide kernel call instead of a vmap over segments.
+        some = next(iter(cols.values()))
+        s_local, p_len = some.shape
+        flat = {k: v.reshape(s_local * p_len) for k, v in cols.items()}
+        valid = (
+            jnp.arange(p_len, dtype=jnp.int32)[None, :] < n_docs[:, None]
+        ).reshape(s_local * p_len)
+        out = base(flat, ops, valid)
         if grouped:
             matched, counts, parts = out
         else:
             matched, parts = out
             counts = None
-        m, c, p = _combine_tree(spec, matched, counts, parts, axis)
+        m, c, p = _combine_tree(spec, matched, counts, parts, axis, local_axis=False)
         return (m, c, p) if grouped else (m, p)
 
     def run(cols, ops, n_docs):
@@ -188,9 +215,25 @@ def _sharded_kernel(spec: tuple, mesh: Mesh, axis: str):
             out_specs=P(),  # partials are replicated after collectives
             check_vma=False,
         )
-        return f(cols, ops, n_docs)
+        out = f(cols, ops, n_docs)
+        leaves, treedef = jax.tree.flatten(out)
+        # output shapes depend only on the plan spec, so the metadata
+        # captured at (first) trace time is valid for every call
+        pack_meta["treedef"] = treedef
+        pack_meta["leaves"] = [(tuple(l.shape), np.dtype(l.dtype)) for l in leaves]
+        return jnp.concatenate([jnp.ravel(l).astype(jnp.float64) for l in leaves])
 
-    return jax.jit(run)
+    def unpack(vec: np.ndarray):
+        out = []
+        i = 0
+        for shape, dtype in pack_meta["leaves"]:
+            size = int(np.prod(shape, dtype=np.int64)) if shape else 1
+            chunk = vec[i : i + size].reshape(shape)
+            out.append(chunk.astype(dtype) if dtype != np.float64 else chunk)
+            i += size
+        return jax.tree.unflatten(pack_meta["treedef"], out)
+
+    return jax.jit(run), unpack
 
 
 def execute_sharded(table: ShardedTable, sql: str):
@@ -212,12 +255,12 @@ def execute_sharded(table: ShardedTable, sql: str):
                     float(ci.stats.max_value),
                 )
     plan: SegmentPlan = plan_segment(table.proto, ctx)
-    kernel = _sharded_kernel(plan.spec, table.mesh, table.mesh.axis_names[0])
+    kernel, _unpack = _sharded_kernel(plan.spec, table.mesh, table.mesh.axis_names[0])
     cols = {c: table.arrays[c] for c in plan.columns}
     if not cols:
         cols = {"__shape__": next(iter(table.arrays.values()))}
     ops = tuple(jnp.asarray(o) for o in plan.operands)
-    out = kernel(cols, ops, table.n_docs)
+    out = kernel(cols, ops, table.n_docs)  # ONE packed f64 vector on device
     return ctx, plan, out
 
 
@@ -227,13 +270,15 @@ def execute_sharded_result(table: ShardedTable, sql: str):
     from pinot_tpu.query.engine import QueryEngine
 
     ctx, plan, out = execute_sharded(table, sql)
+    _, unpack = _sharded_kernel(plan.spec, table.mesh, table.mesh.axis_names[0])
+    host = unpack(np.asarray(out))  # single device->host round trip
     e = QueryEngine([])
     if ctx.query_type == QueryType.AGGREGATION:
-        matched, parts = out
+        matched, parts = host
         partial = e._convert_agg(table.proto, ctx, plan, parts)
         rows = reduce_mod.reduce_aggregation(ctx, [partial])
     else:
-        matched, counts, parts = out
+        matched, counts, parts = host
         frame = e._convert_groups(table.proto, ctx, plan, np.asarray(counts), parts)
         rows = reduce_mod.reduce_group_by(ctx, [frame])
     return reduce_mod.build_result(

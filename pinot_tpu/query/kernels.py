@@ -120,7 +120,12 @@ def _filter(fspec, cols, ops, n_padded):
         return ops[fspec[2]][cols[fspec[1]]]
     if kind == "cmp_raw":
         v = cols[fspec[2]]
-        return _CMPS[fspec[1]](v.astype(_F), ops[fspec[3]])
+        o = ops[fspec[3]]
+        if jnp.issubdtype(v.dtype, jnp.integer) and jnp.issubdtype(o.dtype, jnp.integer):
+            # native integer compare: avoids materializing a 64-bit float
+            # copy of the column (f64 is software-emulated on TPU)
+            return _CMPS[fspec[1]](v, o.astype(v.dtype))
+        return _CMPS[fspec[1]](v.astype(_F), o)
     if kind == "cmp_lit":
         v = _value(fspec[2], cols, ops)
         return _CMPS[fspec[1]](v.astype(_F), ops[fspec[3]])
@@ -138,6 +143,60 @@ def _filter(fspec, cols, ops, n_padded):
 # ---------------------------------------------------------------------------
 # aggregation partials
 # ---------------------------------------------------------------------------
+
+# Exact integer summation without 64-bit arithmetic on the hot path: TPU
+# emulates f64/i64, so a 4M-doc f64 segment_sum costs ~8x its i32 twin. For
+# int32 values we split docs into blocks and each value into 16-bit halves;
+# per-block per-group i32 partial sums are exact (|half| * BLOCK < 2^31), and
+# only the tiny (n_blocks, ng) second-level reduction runs in f64.
+_BLOCK = 8192
+
+
+def _blocked(v):
+    n = v.shape[0]
+    nb = -(-n // _BLOCK)
+    pad = nb * _BLOCK - n
+    if pad:
+        v = jnp.pad(v, (0, pad))
+    return v.reshape(nb, _BLOCK)
+
+
+def _exact_int_grouped_sum(v, gid, mask, ng):
+    v2 = _blocked(v.astype(jnp.int32))
+    g2 = _blocked(gid)
+    m2 = _blocked(mask)
+    lo = jnp.where(m2, v2 & 0xFFFF, 0)
+    hi = jnp.where(m2, v2 >> 16, 0)  # arithmetic shift keeps sign: v = hi*2^16 + lo
+    seg = jax.vmap(lambda a, g: jax.ops.segment_sum(a, g, num_segments=ng))
+    lo_s = seg(lo, g2)
+    hi_s = seg(hi, g2)
+    return lo_s.astype(_F).sum(0) + hi_s.astype(_F).sum(0) * 65536.0
+
+
+def _exact_int_sum(v, mask):
+    v2 = _blocked(v.astype(jnp.int32))
+    m2 = _blocked(mask)
+    lo = jnp.sum(jnp.where(m2, v2 & 0xFFFF, 0), axis=1)
+    hi = jnp.sum(jnp.where(m2, v2 >> 16, 0), axis=1)
+    return jnp.sum(lo.astype(_F)) + jnp.sum(hi.astype(_F)) * 65536.0
+
+
+def _count_grouped(mask, gid, ng):
+    # counts fit i32 (segment docs < 2^31); widen after the reduction
+    return jax.ops.segment_sum(mask.astype(jnp.int32), gid, num_segments=ng).astype(_I)
+
+
+_I32_MAX = np.int32(np.iinfo(np.int32).max)
+_I32_MIN = np.int32(np.iinfo(np.int32).min)
+
+
+def _int_grouped_extreme(v, gid, mask, ng, is_min):
+    sentinel = _I32_MAX if is_min else _I32_MIN
+    red = jax.ops.segment_min if is_min else jax.ops.segment_max
+    r = red(jnp.where(mask, v.astype(jnp.int32), sentinel), gid, num_segments=ng)
+    hit = jax.ops.segment_max(mask.astype(jnp.int32), gid, num_segments=ng) > 0
+    empty = jnp.inf if is_min else -jnp.inf
+    return jnp.where(hit, r.astype(_F), empty)
 
 
 def _hashes_for(hspec, cols, ops):
@@ -160,7 +219,7 @@ def _hashes_for(hspec, cols, ops):
 def _agg_scalar(aspec, cols, ops, mask):
     kind = aspec[0]
     if kind == "count":
-        return jnp.sum(mask, dtype=_I)
+        return jnp.sum(mask, dtype=jnp.int32).astype(_I)
     if kind == "distinct_ids":
         col, pad = aspec[1], aspec[2]
         presence = jnp.zeros((pad,), dtype=bool).at[cols[col]].max(mask)
@@ -175,58 +234,103 @@ def _agg_scalar(aspec, cols, ops, mask):
         lo, inv_w, nbins = ops[aspec[2]], ops[aspec[3]], aspec[4]
         b = jnp.clip(jnp.floor((v - lo) * inv_w).astype(jnp.int32), 0, nbins - 1)
         return jax.ops.segment_sum(mask.astype(_I), b, num_segments=nbins)
-    v = _value(aspec[1], cols, ops).astype(_F)
+    v_raw = _value(aspec[1], cols, ops)
+    is_i32 = v_raw.dtype == jnp.int32
+    v = v_raw.astype(_F)
     if kind == "sum":
+        if is_i32:
+            return _exact_int_sum(v_raw, mask)
         return jnp.sum(jnp.where(mask, v, 0.0))
     if kind == "min":
+        if is_i32:
+            return _int_scalar_extreme(v_raw, mask, True)
         return jnp.min(jnp.where(mask, v, jnp.inf))
     if kind == "max":
+        if is_i32:
+            return _int_scalar_extreme(v_raw, mask, False)
         return jnp.max(jnp.where(mask, v, -jnp.inf))
     if kind == "avg":
-        return (jnp.sum(jnp.where(mask, v, 0.0)), jnp.sum(mask, dtype=_I))
+        cnt = jnp.sum(mask, dtype=jnp.int32).astype(_I)
+        if is_i32:
+            return (_exact_int_sum(v_raw, mask), cnt)
+        return (jnp.sum(jnp.where(mask, v, 0.0)), cnt)
     if kind == "minmaxrange":
+        if is_i32:
+            return (_int_scalar_extreme(v_raw, mask, True), _int_scalar_extreme(v_raw, mask, False))
         return (jnp.min(jnp.where(mask, v, jnp.inf)), jnp.max(jnp.where(mask, v, -jnp.inf)))
     raise AssertionError(aspec)
 
 
-def _agg_grouped(aspec, cols, ops, mask, gid, ng):
-    from pinot_tpu.ops import groupby_pallas as gp
+def _int_scalar_extreme(v, mask, is_min):
+    sentinel = _I32_MAX if is_min else _I32_MIN
+    r = (jnp.min if is_min else jnp.max)(jnp.where(mask, v.astype(jnp.int32), sentinel))
+    empty = jnp.inf if is_min else -jnp.inf
+    return jnp.where(jnp.any(mask), r.astype(_F), empty)
 
-    use_pallas = gp.pallas_enabled()
+
+def _agg_grouped(aspec, cols, ops, mask, gid, ng):
     kind = aspec[0]
     if kind == "count":
-        if use_pallas:
-            return gp.pallas_grouped_count(gid, mask, ng).astype(_I)
-        return jax.ops.segment_sum(mask.astype(_I), gid, num_segments=ng)
-    v = _value(aspec[1], cols, ops).astype(_F)
+        return _count_grouped(mask, gid, ng)
+    v_raw = _value(aspec[1], cols, ops)
+    is_i32 = v_raw.dtype == jnp.int32
+    v = v_raw.astype(_F)
     if kind == "sum":
-        if use_pallas:
-            return gp.pallas_grouped_sum(v, gid, mask, ng).astype(_F)
+        if is_i32:
+            return _exact_int_grouped_sum(v_raw, gid, mask, ng)
         return jax.ops.segment_sum(jnp.where(mask, v, 0.0), gid, num_segments=ng)
     if kind == "min":
-        if use_pallas:
-            return gp.pallas_grouped_min(v, gid, mask, ng).astype(_F)
+        if is_i32:
+            return _int_grouped_extreme(v_raw, gid, mask, ng, True)
         return jax.ops.segment_min(jnp.where(mask, v, jnp.inf), gid, num_segments=ng)
     if kind == "max":
-        if use_pallas:
-            return gp.pallas_grouped_max(v, gid, mask, ng).astype(_F)
+        if is_i32:
+            return _int_grouped_extreme(v_raw, gid, mask, ng, False)
         return jax.ops.segment_max(jnp.where(mask, v, -jnp.inf), gid, num_segments=ng)
     if kind == "avg":
-        if use_pallas:
-            return (
-                gp.pallas_grouped_sum(v, gid, mask, ng).astype(_F),
-                gp.pallas_grouped_count(gid, mask, ng).astype(_I),
-            )
-        return (
-            jax.ops.segment_sum(jnp.where(mask, v, 0.0), gid, num_segments=ng),
-            jax.ops.segment_sum(mask.astype(_I), gid, num_segments=ng),
+        s = _exact_int_grouped_sum(v_raw, gid, mask, ng) if is_i32 else jax.ops.segment_sum(
+            jnp.where(mask, v, 0.0), gid, num_segments=ng
         )
+        return (s, _count_grouped(mask, gid, ng))
     if kind == "minmaxrange":
+        if is_i32:
+            return (
+                _int_grouped_extreme(v_raw, gid, mask, ng, True),
+                _int_grouped_extreme(v_raw, gid, mask, ng, False),
+            )
         return (
             jax.ops.segment_min(jnp.where(mask, v, jnp.inf), gid, num_segments=ng),
             jax.ops.segment_max(jnp.where(mask, v, -jnp.inf), gid, num_segments=ng),
         )
     raise AssertionError(aspec)
+
+
+def _grouped_all(aggs, cols, ops, mask, gid, ng):
+    """Group counts + every agg partial. On TPU the count and ALL int32
+    SUM/AVG aggs fuse into ONE pallas byte-plane matmul pass; remaining aggs
+    (min/max/f64/hll/...) use their per-agg reductions."""
+    from pinot_tpu.ops import groupby_pallas as gp
+
+    if gp.pallas_auto():
+        vals, owner = [], {}
+        for i, a in enumerate(aggs):
+            if a[0] in ("sum", "avg"):
+                v_raw = _value(a[1], cols, ops)
+                if v_raw.dtype == jnp.int32:
+                    owner[i] = len(vals)
+                    vals.append(v_raw)
+        sums, counts = gp.pallas_grouped_multi_sum(vals, gid, mask, ng)
+        parts = []
+        for i, a in enumerate(aggs):
+            if a[0] == "count":
+                parts.append(counts)
+            elif i in owner:
+                parts.append(sums[owner[i]] if a[0] == "sum" else (sums[owner[i]], counts))
+            else:
+                parts.append(_agg_grouped(a, cols, ops, mask, gid, ng))
+        return counts, tuple(parts)
+    counts = _count_grouped(mask, gid, ng)
+    return counts, tuple(_agg_grouped(a, cols, ops, mask, gid, ng) for a in aggs)
 
 
 # ---------------------------------------------------------------------------
@@ -249,7 +353,7 @@ def build_fn(spec: tuple):
             n_padded = next(iter(cols.values())).shape[0]
             valid = jnp.arange(n_padded, dtype=jnp.int32) < n_docs
             mask = valid & _filter(fspec, cols, ops, n_padded)
-            matched = jnp.sum(mask, dtype=_I)
+            matched = jnp.sum(mask, dtype=jnp.int32).astype(_I)
             if gspec is None:
                 return matched, tuple(_agg_scalar(a, cols, ops, mask) for a in aggs)
             _, gcols, ng, strides_idx = gspec
@@ -257,13 +361,8 @@ def build_fn(spec: tuple):
             gid = jnp.zeros((n_padded,), dtype=jnp.int32)
             for i, c in enumerate(gcols):
                 gid = gid + cols[c] * strides[i]
-            from pinot_tpu.ops import groupby_pallas as gp
-
-            if gp.pallas_enabled():
-                counts = gp.pallas_grouped_count(gid, mask, ng).astype(_I)
-            else:
-                counts = jax.ops.segment_sum(mask.astype(_I), gid, num_segments=ng)
-            return matched, counts, tuple(_agg_grouped(a, cols, ops, mask, gid, ng) for a in aggs)
+            counts, parts = _grouped_all(aggs, cols, ops, mask, gid, ng)
+            return matched, counts, parts
 
         return run
 
@@ -300,6 +399,35 @@ def build_fn(spec: tuple):
         return run_ob
 
     raise AssertionError(spec)
+
+
+@lru_cache(maxsize=1024)
+def build_masked_fn(spec: tuple):
+    """Aggregation variant of build_fn taking an explicit validity mask
+    instead of an n_docs scalar. Used by the sharded executor, which flattens
+    a device's (S_local, P) stacked segments into ONE doc vector — aggregates
+    are order-independent, so a single wide kernel call replaces a vmap over
+    segments (vmap lowers poorly around pallas_call, and bigger flat ops fuse
+    better anyway)."""
+    kind = spec[0]
+    assert kind == "agg", spec
+    _, fspec, gspec, aggs = spec
+
+    def run(cols, ops, valid):
+        n_padded = next(iter(cols.values())).shape[0]
+        mask = valid & _filter(fspec, cols, ops, n_padded)
+        matched = jnp.sum(mask, dtype=jnp.int32).astype(_I)
+        if gspec is None:
+            return matched, tuple(_agg_scalar(a, cols, ops, mask) for a in aggs)
+        _, gcols, ng, strides_idx = gspec
+        strides = ops[strides_idx]
+        gid = jnp.zeros((n_padded,), dtype=jnp.int32)
+        for i, c in enumerate(gcols):
+            gid = gid + cols[c] * strides[i]
+        counts, parts = _grouped_all(aggs, cols, ops, mask, gid, ng)
+        return matched, counts, parts
+
+    return run
 
 
 @lru_cache(maxsize=1024)

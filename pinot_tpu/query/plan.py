@@ -367,10 +367,44 @@ class _Lowering:
                 return ("const", False)
             return ("doc_range", self.op_idx(np.int32(start)), self.op_idx(np.int32(end)))
         self.use_col(col)
+        # integer columns compare natively (f64 is emulated on TPU): rewrite
+        # fractional literals into equivalent integer bounds first
+        fwd_dtype = ci.forward.dtype
+        if np.issubdtype(fwd_dtype, np.integer) and isinstance(value, (int, float)) and not isinstance(value, bool):
+            iop, ival = _int_compare(op, float(value))
+            if iop is None:
+                return ("const", ival)
+            info = np.iinfo(fwd_dtype)
+            if info.min <= ival <= info.max:
+                return ("cmp_raw", iop.name, col, self.op_idx(np.asarray(ival, dtype=fwd_dtype)))
+            # literal out of the column dtype's range: statically decidable
+            if iop in (CompareOp.LT, CompareOp.LTE):
+                return ("const", ival > info.max)
+            if iop in (CompareOp.GT, CompareOp.GTE):
+                return ("const", ival < info.min)
+            return ("const", op == CompareOp.NEQ)
         v = self.op_idx(np.asarray(value, dtype=np.float64))
         return ("cmp_raw", op.name, col, v)
 
     def _range(self, expr: Expr, low: Expr, high: Expr, lo_incl: bool, hi_incl: bool) -> tuple:
+        if (
+            isinstance(expr, ast.Identifier)
+            and isinstance(low, ast.Literal)
+            and isinstance(high, ast.Literal)
+        ):
+            ci0 = self.seg.columns.get(expr.name)
+            if ci0 is not None and not ci0.is_dict_encoded and np.issubdtype(ci0.forward.dtype, np.integer):
+                # raw integer column: two native integer compares
+                return (
+                    "and",
+                    (
+                        self._raw_compare(expr.name, ci0, CompareOp.GTE if lo_incl else CompareOp.GT, low.value),
+                        self._raw_compare(expr.name, ci0, CompareOp.LTE if hi_incl else CompareOp.LT, high.value),
+                    ),
+                )
+        return self._range_generic(expr, low, high, lo_incl, hi_incl)
+
+    def _range_generic(self, expr: Expr, low: Expr, high: Expr, lo_incl: bool, hi_incl: bool) -> tuple:
         if not isinstance(low, ast.Literal) or not isinstance(high, ast.Literal):
             raise PlanError("BETWEEN bounds must be literals")
         if isinstance(expr, ast.Identifier):
@@ -552,7 +586,12 @@ class _Lowering:
         strides = np.ones(len(cols), dtype=np.int32)
         for i in range(len(cols) - 2, -1, -1):
             strides[i] = strides[i + 1] * max(cards[i + 1], 1)
-        return ("groups", tuple(cols), _pow2(num_groups), self.op_idx(strides))
+        # round ng to the pallas GROUP_TILE granularity: a pow2 bucket would
+        # nearly double the one-hot work at e.g. 4375 groups, while 256-step
+        # buckets still keep the kernel compile cache warm across near-alike
+        # queries (the Pinot plan-cache normalization tradeoff)
+        ng = ((max(num_groups, 1) + 255) // 256) * 256
+        return ("groups", tuple(cols), ng, self.op_idx(strides))
 
 
 _FLIP = {
@@ -563,6 +602,27 @@ _FLIP = {
     CompareOp.GT: CompareOp.LT,
     CompareOp.GTE: CompareOp.LTE,
 }
+
+
+def _int_compare(op: CompareOp, x: float):
+    """Rewrite `int_col <op> x` into an equivalent integer-literal compare.
+    Returns (op, int literal), or (None, bool) when statically decided
+    (fractional EQ/NEQ)."""
+    import math
+
+    if x == int(x):
+        return op, int(x)
+    if op == CompareOp.EQ:
+        return None, False
+    if op == CompareOp.NEQ:
+        return None, True
+    if op == CompareOp.GT:  # v > 5.5  <=>  v > 5
+        return CompareOp.GT, math.floor(x)
+    if op == CompareOp.GTE:  # v >= 5.5 <=>  v >= 6
+        return CompareOp.GTE, math.ceil(x)
+    if op == CompareOp.LT:  # v < 5.5  <=>  v < 6
+        return CompareOp.LT, math.ceil(x)
+    return CompareOp.LTE, math.floor(x)  # v <= 5.5 <=> v <= 5
 
 
 def _const_compare(op: CompareOp, a, b) -> bool:
