@@ -60,14 +60,22 @@ class Broker:
         max_scatter_threads: int = 8,
         selector=None,
         failure_detector=None,
+        enable_quota: bool = True,
+        query_logger=None,
     ):
         """selector: instance selector (Balanced default; ReplicaGroup /
         Adaptive from cluster.routing). failure_detector: optional
         cluster.failure.FailureDetector enabling routing exclusion + one-round
-        connection-failure failover."""
+        connection-failure failover. Per-table QPS quotas come from
+        TableConfig.extra['queryQuotaQps']; query_logger is an optional
+        cluster.quota.QueryLogger."""
+        from pinot_tpu.cluster.quota import QueryQuotaManager
+
         self.controller = controller
         self.selector = selector if selector is not None else BalancedInstanceSelector()
         self.failure_detector = failure_detector
+        self.quota = QueryQuotaManager(controller) if enable_quota else None
+        self.query_logger = query_logger
         self._pool = ThreadPoolExecutor(max_workers=max_scatter_threads)
 
     def execute(self, sql: str) -> ResultTable:
@@ -76,17 +84,26 @@ class Broker:
 
         bm = broker_metrics()
         bm.meter(BrokerMeter.QUERIES).mark()
+        table = ""
         try:
             stmt = parse_sql(sql)
+            table = getattr(stmt, "from_table", None) or ""
+            if self.quota is not None and table:
+                self.quota.acquire(table)
             if stmt.options.get("trace", "").lower() == "true":
                 # per-query tracing (Tracing.java + `trace=true` query option)
                 with start_trace(request_id=f"q{next(_request_seq)}") as tr:
                     result = self._execute(stmt, sql)
                 result.trace = tr.to_dict()
-                return result
-            return self._execute(stmt, sql)
-        except Exception:
+            else:
+                result = self._execute(stmt, sql)
+            if self.query_logger is not None:
+                self.query_logger.log(sql, table, result.time_used_ms, result.num_docs_scanned)
+            return result
+        except Exception as e:
             bm.meter(BrokerMeter.REQUEST_FAILURES).mark()
+            if self.query_logger is not None:
+                self.query_logger.log(sql, table, 0.0, 0, exception=type(e).__name__)
             raise
 
     def _execute(self, stmt, sql: str) -> ResultTable:

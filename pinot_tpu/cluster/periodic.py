@@ -1,0 +1,186 @@
+"""Controller periodic tasks: status checking, retention, rebalance checking,
+missing-consuming-segment detection.
+
+Reference parity: ControllerPeriodicTask (pinot-controller/.../helix/core/
+periodictask/ControllerPeriodicTask.java) subclasses SegmentStatusChecker,
+RetentionManager, RebalanceChecker, MissingConsumingSegmentFinder
+(controller/helix/core/realtime/) — each runs per-table on a fixed interval
+under the lead controller. Here a PeriodicTaskScheduler drives registered
+tasks on daemon timers; run_once() is the deterministic test entry.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from pinot_tpu.common.metrics import controller_metrics
+
+
+class ControllerPeriodicTask:
+    name = "periodic"
+    interval_sec = 300.0
+
+    def __init__(self, controller):
+        self.controller = controller
+
+    def run_once(self) -> dict:
+        """Process all tables; returns a result summary (test/observability)."""
+        out = {}
+        for table in self.controller.tables():
+            try:
+                out[table] = self.process_table(table)
+            except Exception as e:  # noqa: BLE001 — one bad table must not stop the sweep
+                out[table] = {"error": f"{type(e).__name__}: {e}"}
+        return out
+
+    def process_table(self, table: str) -> dict:
+        raise NotImplementedError
+
+
+class SegmentStatusChecker(ControllerPeriodicTask):
+    """Per-table segment/replica health -> controller gauges
+    (SegmentStatusChecker parity: segmentCount, replica counts, percent
+    online)."""
+
+    name = "SegmentStatusChecker"
+    interval_sec = 300.0
+
+    def process_table(self, table: str) -> dict:
+        ideal = self.controller.ideal_state(table)
+        config = self.controller.get_table(table)
+        expected = max(1, config.replication if config else 1)
+        n_segs = len(ideal)
+        min_replicas = expected
+        online_total = 0
+        for replicas in ideal.values():
+            online = sum(1 for st in replicas.values() if st in ("ONLINE", "CONSUMING"))
+            online_total += online
+            min_replicas = min(min_replicas, online)
+        pct = 100 if not n_segs else int(100 * min_replicas / expected)
+        m = controller_metrics()
+        m.gauge(f"controller.{table}.segmentCount").set(n_segs)
+        m.gauge(f"controller.{table}.percentOfReplicas").set(pct)
+        m.gauge(f"controller.{table}.minReplicas").set(min_replicas if n_segs else expected)
+        return {"segments": n_segs, "minReplicas": min_replicas if n_segs else expected, "percent": pct}
+
+
+class RetentionManager(ControllerPeriodicTask):
+    """Drop segments past the table's retention window
+    (RetentionManager parity). Retention config lives in
+    TableConfig.extra["retention"] = {"value": N, "timeColumn": optional}
+    where N is in the time column's native units; a segment is purged when
+    its max(time) < now_fn() - N."""
+
+    name = "RetentionManager"
+    interval_sec = 21600.0
+
+    def __init__(self, controller, now_fn=None):
+        super().__init__(controller)
+        self.now_fn = now_fn or (lambda: time.time() * 1000.0)
+
+    def process_table(self, table: str) -> dict:
+        config = self.controller.get_table(table)
+        ret = (config.extra or {}).get("retention") if config else None
+        if not ret:
+            return {"purged": []}
+        tcol = ret.get("timeColumn") or config.time_column
+        if not tcol:
+            return {"purged": []}
+        cutoff = self.now_fn() - float(ret["value"])
+        purged = []
+        for name, meta in sorted(self.controller.all_segment_metadata(table).items()):
+            s = (meta.get("stats") or {}).get(tcol)
+            if s and isinstance(s.get("max"), (int, float)) and s["max"] < cutoff:
+                self.controller.delete_segment(table, name)
+                purged.append(name)
+        return {"purged": purged}
+
+
+class RebalanceChecker(ControllerPeriodicTask):
+    """Detect (and optionally repair) under-replicated tables
+    (RebalanceChecker parity; auto_fix mirrors its retry of failed
+    rebalances)."""
+
+    name = "RebalanceChecker"
+    interval_sec = 1800.0
+
+    def __init__(self, controller, auto_fix: bool = False):
+        super().__init__(controller)
+        self.auto_fix = auto_fix
+
+    def process_table(self, table: str) -> dict:
+        from pinot_tpu.cluster.rebalance import rebalance_table
+
+        r = rebalance_table(self.controller, table, dry_run=True)
+        needs = r.status != "NO_OP"
+        if needs and self.auto_fix:
+            applied = rebalance_table(self.controller, table)
+            return {"needsRebalance": True, "fixed": True, "adds": applied.adds, "drops": applied.drops}
+        return {"needsRebalance": needs, "adds": r.adds, "drops": r.drops}
+
+
+class MissingConsumingSegmentFinder(ControllerPeriodicTask):
+    """Realtime tables must keep one CONSUMING segment per stream partition
+    (MissingConsumingSegmentFinder parity). Expected partition count comes
+    from TableConfig.extra["streamPartitions"]."""
+
+    name = "MissingConsumingSegmentFinder"
+    interval_sec = 300.0
+
+    def process_table(self, table: str) -> dict:
+        config = self.controller.get_table(table)
+        if config is None or config.table_type.value != "REALTIME":
+            return {"missingPartitions": []}
+        expected = int((config.extra or {}).get("streamPartitions", 0))
+        if not expected:
+            return {"missingPartitions": []}
+        consuming = set()
+        for seg, replicas in self.controller.ideal_state(table).items():
+            if any(st == "CONSUMING" for st in replicas.values()):
+                # segment names carry the partition: <table>__<partition>__<seq>
+                parts = seg.split("__")
+                if len(parts) >= 2 and parts[1].isdigit():
+                    consuming.add(int(parts[1]))
+        missing = sorted(set(range(expected)) - consuming)
+        controller_metrics().gauge(f"controller.{table}.missingConsumingPartitions").set(len(missing))
+        return {"missingPartitions": missing}
+
+
+class PeriodicTaskScheduler:
+    """Daemon-timer driver for registered tasks (the lead-controller's
+    periodic task executor)."""
+
+    def __init__(self):
+        self._tasks: list[ControllerPeriodicTask] = []
+        self._threads: list[threading.Thread] = []
+        self._running = False
+
+    def register(self, task: ControllerPeriodicTask) -> None:
+        self._tasks.append(task)
+
+    @property
+    def tasks(self) -> list[ControllerPeriodicTask]:
+        return list(self._tasks)
+
+    def run_all_once(self) -> dict:
+        return {t.name: t.run_once() for t in self._tasks}
+
+    def start(self) -> None:
+        self._running = True
+        for task in self._tasks:
+            def loop(t=task):
+                while self._running:
+                    t.run_once()
+                    deadline = time.monotonic() + t.interval_sec
+                    while self._running and time.monotonic() < deadline:
+                        time.sleep(min(0.2, t.interval_sec))
+            th = threading.Thread(target=loop, name=f"periodic-{task.name}", daemon=True)
+            th.start()
+            self._threads.append(th)
+
+    def stop(self) -> None:
+        self._running = False
+        for th in self._threads:
+            th.join(timeout=5)
+        self._threads.clear()

@@ -1,0 +1,92 @@
+"""Broker-side query quotas and rate-limited query logging.
+
+Reference parity: HelixExternalViewBasedQueryQuotaManager
+(pinot-broker/.../queryquota/) — per-table QPS quotas from TableConfig
+(extra["queryQuotaQps"], the quota.maxQueriesPerSecond analog) enforced with
+a sliding-window rate check; and QueryLogger (broker/querylog/QueryLogger)
+— per-query log lines rate-limited to maxRatePerSecond with a dropped-count
+carried on the next emitted line.
+"""
+
+from __future__ import annotations
+
+import collections
+import logging
+import threading
+import time
+
+
+class QuotaExceededError(RuntimeError):
+    """Surfaced to clients as the 429-style quota-exceeded broker error."""
+
+
+class QueryQuotaManager:
+    def __init__(self, controller):
+        self._controller = controller
+        self._hits: dict[str, collections.deque] = {}
+        self._lock = threading.Lock()
+
+    def _qps_limit(self, table: str) -> float | None:
+        config = self._controller.get_table(table)
+        if config is None:
+            return None
+        q = (config.extra or {}).get("queryQuotaQps")
+        return float(q) if q else None
+
+    def acquire(self, table: str) -> None:
+        """Admit or reject one query against the table's QPS quota."""
+        limit = self._qps_limit(table)
+        if limit is None:
+            return
+        now = time.monotonic()
+        with self._lock:
+            dq = self._hits.setdefault(table, collections.deque())
+            while dq and now - dq[0] > 1.0:
+                dq.popleft()
+            if len(dq) >= limit:
+                from pinot_tpu.common.metrics import broker_metrics
+
+                broker_metrics().meter(f"broker.{table}.queryQuotaExceeded").mark()
+                raise QuotaExceededError(
+                    f"table {table!r} exceeded query quota of {limit} QPS"
+                )
+            dq.append(now)
+
+
+class QueryLogger:
+    """Rate-limited query logging (QueryLogger parity)."""
+
+    def __init__(self, max_rate_per_sec: float = 10_000.0, logger: logging.Logger | None = None):
+        self.max_rate = max_rate_per_sec
+        self._logger = logger or logging.getLogger("pinot_tpu.querylog")
+        self._window = collections.deque()
+        self._dropped = 0
+        self._lock = threading.Lock()
+        self.emitted = 0  # test/observability counters
+        self.dropped_total = 0
+
+    def log(self, sql: str, table: str, time_ms: float, num_docs_scanned: int, exception: str | None = None) -> bool:
+        """Returns True when the line was emitted (False = rate-dropped)."""
+        now = time.monotonic()
+        with self._lock:
+            while self._window and now - self._window[0] > 1.0:
+                self._window.popleft()
+            if len(self._window) >= self.max_rate:
+                self._dropped += 1
+                self.dropped_total += 1
+                return False
+            self._window.append(now)
+            dropped, self._dropped = self._dropped, 0
+            self.emitted += 1
+        suffix = f" droppedSince={dropped}" if dropped else ""
+        status = f" exception={exception}" if exception else ""
+        self._logger.info(
+            "table=%s timeMs=%.1f docsScanned=%d%s%s query=%s",
+            table,
+            time_ms,
+            num_docs_scanned,
+            status,
+            suffix,
+            sql,
+        )
+        return True
