@@ -12,9 +12,7 @@ Intra-pod device collectives (parallel/mesh.py) stay out of this tier.
 
 from __future__ import annotations
 
-import io
 import json
-import pickle
 import threading
 import urllib.error
 import urllib.request
@@ -23,6 +21,7 @@ from pathlib import Path
 
 from pinot_tpu.cluster.broker import Broker
 from pinot_tpu.cluster.server import Server
+from pinot_tpu.common import datatable
 
 
 def _serve(handler_cls, port: int) -> tuple[ThreadingHTTPServer, int, threading.Thread]:
@@ -127,9 +126,9 @@ class ServerHTTPService:
                     self.end_headers()
                     self.wfile.write(payload)
                     return
-                payload = pickle.dumps(out, protocol=pickle.HIGHEST_PROTOCOL)
+                payload = datatable.encode(out)
                 self.send_response(200)
-                self.send_header("Content-Type", "application/octet-stream")
+                self.send_header("Content-Type", "application/x-pinot-datatable")
                 self.send_header("Content-Length", str(len(payload)))
                 self.end_headers()
                 self.wfile.write(payload)
@@ -169,7 +168,7 @@ class RemoteServerClient:
         )
         try:
             with urllib.request.urlopen(req, timeout=self.timeout) as resp:
-                return pickle.load(io.BytesIO(resp.read()))
+                return datatable.decode(resp.read())
         except urllib.error.HTTPError as e:
             detail = e.read().decode(errors="replace")
             raise RuntimeError(f"server error from {self.base_url}: {detail}") from None

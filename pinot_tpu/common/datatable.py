@@ -1,0 +1,198 @@
+"""DataTable wire format: versioned, typed binary serde for query partials.
+
+Reference parity: DataTableImplV4 (pinot-common/.../datatable/
+DataTableImplV4.java:51-82 — versioned header, typed columnar payload,
+custom-object serde registry) and the DataBlock zero-copy serde
+(pinot-common/.../datablock/ZeroCopyDataBlockSerde). The server's partial
+results cross the wire in this format instead of pickle: decoding is pure
+data (no code execution), the layout is versioned, and numpy buffers are
+written contiguously so the hot path is one memcpy per column.
+
+Supported values: None, bool, int, float, str, bytes, list, tuple, set,
+dict, numpy scalars/arrays (object arrays encode element-wise), and pandas
+DataFrames (encoded columnar: the DataBlock analog).
+"""
+
+from __future__ import annotations
+
+import struct
+from io import BytesIO
+
+import numpy as np
+import pandas as pd
+
+MAGIC = b"PTDT"
+VERSION = 1
+
+_T_NONE = 0
+_T_BOOL = 1
+_T_INT = 2
+_T_FLOAT = 3
+_T_STR = 4
+_T_BYTES = 5
+_T_LIST = 6
+_T_TUPLE = 7
+_T_SET = 8
+_T_DICT = 9
+_T_NDARRAY = 10
+_T_OBJARRAY = 11
+_T_DATAFRAME = 12
+
+
+class DataTableError(ValueError):
+    pass
+
+
+def _w_u32(out: BytesIO, v: int) -> None:
+    out.write(struct.pack("<I", v))
+
+
+def _w_str(out: BytesIO, s: str) -> None:
+    b = s.encode()
+    _w_u32(out, len(b))
+    out.write(b)
+
+
+def _encode_value(out: BytesIO, v) -> None:
+    if v is None:
+        out.write(bytes([_T_NONE]))
+    elif isinstance(v, (bool, np.bool_)):
+        out.write(bytes([_T_BOOL, 1 if v else 0]))
+    elif isinstance(v, (int, np.integer)):
+        out.write(bytes([_T_INT]))
+        out.write(struct.pack("<q", int(v)))
+    elif isinstance(v, (float, np.floating)):
+        out.write(bytes([_T_FLOAT]))
+        out.write(struct.pack("<d", float(v)))
+    elif isinstance(v, str):
+        out.write(bytes([_T_STR]))
+        _w_str(out, v)
+    elif isinstance(v, (bytes, bytearray)):
+        out.write(bytes([_T_BYTES]))
+        _w_u32(out, len(v))
+        out.write(v)
+    elif isinstance(v, pd.DataFrame):
+        out.write(bytes([_T_DATAFRAME]))
+        _w_u32(out, len(v.columns))
+        for col in v.columns:
+            _w_str(out, str(col))
+            _encode_value(out, v[col].to_numpy())
+    elif isinstance(v, np.ndarray):
+        if v.dtype == object:
+            out.write(bytes([_T_OBJARRAY]))
+            _w_u32(out, v.ndim)
+            for d in v.shape:
+                _w_u32(out, d)
+            for item in v.ravel():
+                _encode_value(out, item)
+        else:
+            out.write(bytes([_T_NDARRAY]))
+            _w_str(out, v.dtype.str)  # includes endianness, e.g. '<i8'
+            _w_u32(out, v.ndim)
+            for d in v.shape:
+                _w_u32(out, d)
+            data = np.ascontiguousarray(v).tobytes()
+            _w_u32(out, len(data))
+            out.write(data)
+    elif isinstance(v, (list, tuple, set)):
+        tag = _T_LIST if isinstance(v, list) else _T_TUPLE if isinstance(v, tuple) else _T_SET
+        out.write(bytes([tag]))
+        items = sorted(v, key=repr) if isinstance(v, set) else v
+        _w_u32(out, len(items))
+        for item in items:
+            _encode_value(out, item)
+    elif isinstance(v, dict):
+        out.write(bytes([_T_DICT]))
+        _w_u32(out, len(v))
+        for k, val in v.items():
+            _encode_value(out, k)
+            _encode_value(out, val)
+    else:
+        raise DataTableError(f"unsupported type for DataTable encoding: {type(v).__name__}")
+
+
+def encode(value) -> bytes:
+    """Serialize any supported partial-result structure."""
+    out = BytesIO()
+    out.write(MAGIC)
+    out.write(struct.pack("<H", VERSION))
+    _encode_value(out, value)
+    return out.getvalue()
+
+
+class _Reader:
+    __slots__ = ("buf", "pos")
+
+    def __init__(self, buf: bytes):
+        self.buf = buf
+        self.pos = 0
+
+    def take(self, n: int) -> bytes:
+        if self.pos + n > len(self.buf):
+            raise DataTableError("truncated DataTable payload")
+        b = self.buf[self.pos : self.pos + n]
+        self.pos += n
+        return b
+
+    def u8(self) -> int:
+        return self.take(1)[0]
+
+    def u32(self) -> int:
+        return struct.unpack("<I", self.take(4))[0]
+
+    def s(self) -> str:
+        return self.take(self.u32()).decode()
+
+
+def _decode_value(r: _Reader):
+    tag = r.u8()
+    if tag == _T_NONE:
+        return None
+    if tag == _T_BOOL:
+        return r.u8() != 0
+    if tag == _T_INT:
+        return struct.unpack("<q", r.take(8))[0]
+    if tag == _T_FLOAT:
+        return struct.unpack("<d", r.take(8))[0]
+    if tag == _T_STR:
+        return r.s()
+    if tag == _T_BYTES:
+        return r.take(r.u32())
+    if tag == _T_LIST:
+        return [_decode_value(r) for _ in range(r.u32())]
+    if tag == _T_TUPLE:
+        return tuple(_decode_value(r) for _ in range(r.u32()))
+    if tag == _T_SET:
+        return {_decode_value(r) for _ in range(r.u32())}
+    if tag == _T_DICT:
+        return {_decode_value(r): _decode_value(r) for _ in range(r.u32())}
+    if tag == _T_NDARRAY:
+        dt = np.dtype(r.s())
+        shape = tuple(r.u32() for _ in range(r.u32()))
+        data = r.take(r.u32())
+        return np.frombuffer(data, dtype=dt).reshape(shape).copy()
+    if tag == _T_OBJARRAY:
+        shape = tuple(r.u32() for _ in range(r.u32()))
+        n = int(np.prod(shape, dtype=np.int64)) if shape else 1
+        arr = np.empty(n, dtype=object)
+        for i in range(n):
+            arr[i] = _decode_value(r)
+        return arr.reshape(shape)
+    if tag == _T_DATAFRAME:
+        data = {}
+        for _ in range(r.u32()):
+            name = r.s()
+            data[name] = _decode_value(r)
+        return pd.DataFrame(data)
+    raise DataTableError(f"unknown DataTable tag {tag}")
+
+
+def decode(payload: bytes):
+    if payload[:4] != MAGIC:
+        raise DataTableError("bad DataTable magic")
+    (version,) = struct.unpack("<H", payload[4:6])
+    if version != VERSION:
+        raise DataTableError(f"unsupported DataTable version {version}")
+    r = _Reader(payload)
+    r.pos = 6
+    return _decode_value(r)

@@ -1,0 +1,106 @@
+"""DataTable binary wire format: roundtrips, partial shapes, error handling.
+
+Reference test model: DataTableSerDeTest (pinot-core) covering every column
+type + custom objects (SURVEY.md §2.2 DataTable wire format).
+"""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from pinot_tpu.common.datatable import DataTableError, decode, encode
+
+
+def rt(v):
+    return decode(encode(v))
+
+
+def test_scalars():
+    assert rt(None) is None
+    assert rt(True) is True and rt(False) is False
+    assert rt(42) == 42 and isinstance(rt(42), int)
+    assert rt(-(2**62)) == -(2**62)
+    assert rt(3.5) == 3.5
+    assert rt("héllo") == "héllo"
+    assert rt(b"\x00\xff") == b"\x00\xff"
+
+
+def test_containers():
+    assert rt([1, "a", None]) == [1, "a", None]
+    assert rt((1, (2, 3))) == (1, (2, 3))
+    assert rt({1, "x", 2.5}) == {1, "x", 2.5}
+    assert rt({"k": [1, 2], ("t", 1): "v"}) == {"k": [1, 2], ("t", 1): "v"}
+
+
+def test_numpy_arrays():
+    for dt in (np.int32, np.int64, np.float32, np.float64, np.uint8, np.bool_):
+        a = np.arange(12, dtype=dt).reshape(3, 4) if dt != np.bool_ else np.ones((3, 4), bool)
+        out = rt(a)
+        assert out.dtype == a.dtype and out.shape == a.shape
+        np.testing.assert_array_equal(out, a)
+    # numpy scalars decode as python scalars
+    assert rt(np.int64(7)) == 7
+    assert rt(np.float64(2.5)) == 2.5
+
+
+def test_object_array():
+    a = np.array(["x", None, "z"], dtype=object)
+    out = rt(a)
+    assert out.dtype == object and list(out) == ["x", None, "z"]
+
+
+def test_dataframe_roundtrip():
+    df = pd.DataFrame(
+        {"k": np.array(["a", "b"], dtype=object), "v": np.array([1, 2], dtype=np.int64), "f": [1.5, 2.5]}
+    )
+    out = rt(df)
+    pd.testing.assert_frame_equal(out, df)
+
+
+def test_partial_shapes():
+    """The actual shapes servers ship: agg partial lists, group frames."""
+    partial = [3, 12.5, {"a", "b"}, (1.0, 2), np.arange(16, dtype=np.float64)]
+    out = rt(partial)
+    assert out[0] == 3 and out[2] == {"a", "b"} and out[3] == (1.0, 2)
+    np.testing.assert_array_equal(out[4], np.arange(16, dtype=np.float64))
+
+
+def test_errors():
+    with pytest.raises(DataTableError, match="magic"):
+        decode(b"XXXX\x01\x00\x00")
+    with pytest.raises(DataTableError, match="version"):
+        decode(b"PTDT\xff\x00\x00")
+    with pytest.raises(DataTableError, match="truncated"):
+        decode(encode([1, 2, 3])[:-2])
+    with pytest.raises(DataTableError, match="unsupported type"):
+        encode(object())
+
+
+def test_http_data_plane_uses_datatable(tmp_path):
+    """Broker <-> remote server hop carries DataTable bytes, not pickle."""
+    from pinot_tpu.cluster import Broker, Controller, PropertyStore, Server
+    from pinot_tpu.cluster.http import RemoteServerClient, ServerHTTPService
+    from pinot_tpu.common import DataType, Schema, TableConfig
+    from pinot_tpu.segment import SegmentBuilder
+
+    controller = Controller(PropertyStore(), tmp_path / "ds")
+    server = Server("s0")
+    svc = ServerHTTPService(server)
+    try:
+        controller.register_server("s0", RemoteServerClient(f"http://127.0.0.1:{svc.port}"))
+        schema = Schema.build("t", dimensions=[("k", DataType.STRING)], metrics=[("v", DataType.LONG)])
+        controller.add_schema(schema)
+        controller.add_table(TableConfig("t"))
+        seg = SegmentBuilder(schema).build(
+            {"k": np.array(["a", "b", "a"], dtype=object), "v": np.array([1, 2, 3], dtype=np.int64)}, "t_0"
+        )
+        from pinot_tpu.segment.builder import write_segment
+
+        d = write_segment(seg, tmp_path / "built")
+        server.add_segment("t", "t_0", d)
+        controller.set_segment_state("t", "t_0", "s0", "ONLINE")
+        controller.store.set("/tables/t/segments/t_0", {"numDocs": 3, "location": str(d), "stats": {}})
+        res = Broker(controller).execute("SELECT k, SUM(v) FROM t GROUP BY k ORDER BY k")
+        assert res.rows == [["a", 4.0], ["b", 2.0]]
+    finally:
+        svc.stop()
