@@ -253,6 +253,14 @@ class ControllerHTTPService:
                         self._json(c.ideal_state(parts[1]))
                     elif len(parts) == 3 and parts[0] == "tables" and parts[2] == "segments":
                         self._json(c.all_segment_metadata(parts[1]))
+                    elif len(parts) == 3 and parts[0] == "tables" and parts[2] == "consumingSegmentsInfo":
+                        info = {}
+                        for sid, srv in c.servers().items():
+                            fn = getattr(srv, "consumption_status", None)
+                            st = fn(parts[1]) if fn is not None else []
+                            if st:
+                                info[sid] = st
+                        self._json(info)
                     elif self.path == "/brokers":
                         self._json(c.brokers())
                     elif self.path == "/instances":
@@ -311,6 +319,17 @@ class ControllerHTTPService:
                         body = json.loads(raw or b"{}")
                         tasks = svc.task_manager.schedule_tasks(body.get("taskType"))
                         self._json({"scheduled": [t.task_id for t in tasks]})
+                    elif len(parts) == 3 and parts[0] == "tables" and parts[2] in (
+                        "pauseConsumption",
+                        "resumeConsumption",
+                    ):
+                        pause = parts[2] == "pauseConsumption"
+                        hit = []
+                        for sid, srv in c.servers().items():
+                            fn = getattr(srv, "pause_consumption" if pause else "resume_consumption", None)
+                            if fn is not None and fn(parts[1]):
+                                hit.append(sid)
+                        self._json({"status": "ok", "servers": hit, "paused": pause})
                     elif len(parts) == 3 and parts[0] == "tables" and parts[2] == "rebalance":
                         from pinot_tpu.cluster.rebalance import rebalance_table
 

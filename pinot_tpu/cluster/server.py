@@ -51,6 +51,24 @@ class Server:
         with self._lock:
             self._realtime[table] = manager
 
+    def pause_consumption(self, table: str) -> bool:
+        rt = self._realtime.get(table)
+        if rt is None:
+            return False
+        rt.pause()
+        return True
+
+    def resume_consumption(self, table: str) -> bool:
+        rt = self._realtime.get(table)
+        if rt is None:
+            return False
+        rt.resume()
+        return True
+
+    def consumption_status(self, table: str) -> list[dict]:
+        rt = self._realtime.get(table)
+        return rt.consumption_status() if rt is not None else []
+
     # -- state transitions (Helix OFFLINE->ONLINE analog) --------------------
 
     def add_segment(self, table: str, segment_name: str, seg_dir: str | Path) -> None:

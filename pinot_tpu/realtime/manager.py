@@ -65,6 +65,8 @@ class PartitionConsumer:
         self._segment_start_offset = start_offset
         self._mutable = self._new_mutable()
         self._stop = threading.Event()
+        self._resume = threading.Event()
+        self._resume.set()  # not paused
         self._thread: threading.Thread | None = None
         self._lock = threading.RLock()
         self.on_open(self._seg_name())
@@ -90,9 +92,28 @@ class PartitionConsumer:
         if self._thread:
             self._thread.join(timeout)
 
+    def pause(self) -> None:
+        """Stop fetching without losing the consuming segment (the
+        pauseConsumption REST / PauselessSegmentCompletionFSM hold state)."""
+        self._resume.clear()
+
+    def resume(self) -> None:
+        self._resume.set()
+
+    @property
+    def paused(self) -> bool:
+        return not self._resume.is_set()
+
     def _run(self) -> None:
         self.state = "CONSUMING"
         while not self._stop.is_set():
+            if not self._resume.is_set():
+                self.state = "PAUSED"
+                while not self._stop.is_set() and not self._resume.wait(timeout=0.1):
+                    pass
+                if self._stop.is_set():
+                    break
+                self.state = "CONSUMING"
             consumed = self._consume_batch()
             if self._mutable.n_docs >= self.max_rows:
                 self._rollover()
@@ -313,8 +334,39 @@ class RealtimeTableManager:
             meta["endOffset"] = end_off
             meta["partition"] = partition
             self.controller.store.set(f"/tables/{self.table}/segments/{segment.name}", meta)
+            self._record_stats_history(segment)
 
         return commit
+
+    # -- stats history (RealtimeSegmentStatsHistory parity: per-column stats
+    # persisted across seals, used to provision the next consuming segment) --
+
+    _STATS_HISTORY_DEPTH = 20
+
+    def _record_stats_history(self, segment: ImmutableSegment) -> None:
+        path = f"/tables/{self.table}/statsHistory"
+        doc = self.controller.store.get(path) or {"entries": []}
+        entry = {
+            "segment": segment.name,
+            "numDocs": segment.n_docs,
+            "columns": {c: {"cardinality": ci.cardinality} for c, ci in segment.columns.items()},
+        }
+        doc["entries"] = (doc["entries"] + [entry])[-self._STATS_HISTORY_DEPTH :]
+        self.controller.store.set(path, doc)
+
+    def stats_history(self) -> list[dict]:
+        doc = self.controller.store.get(f"/tables/{self.table}/statsHistory") or {"entries": []}
+        return doc["entries"]
+
+    def estimated_cardinality(self, column: str) -> int | None:
+        """Average committed cardinality — the provisioning estimate the
+        reference feeds into mutable-segment sizing."""
+        vals = [
+            e["columns"][column]["cardinality"]
+            for e in self.stats_history()
+            if column in e.get("columns", {})
+        ]
+        return int(sum(vals) / len(vals)) if vals else None
 
     def start(self) -> None:
         for c in self.consumers:
@@ -323,6 +375,46 @@ class RealtimeTableManager:
     def stop(self) -> None:
         for c in self.consumers:
             c.stop()
+
+    def pause(self) -> None:
+        """Pause ingestion on every partition (pauseConsumption REST parity);
+        consuming segments stay queryable."""
+        for c in self.consumers:
+            c.pause()
+        self.controller.store.set(f"/tables/{self.table}/pauseStatus", {"paused": True})
+
+    def resume(self) -> None:
+        for c in self.consumers:
+            c.resume()
+        self.controller.store.set(f"/tables/{self.table}/pauseStatus", {"paused": False})
+
+    @property
+    def paused(self) -> bool:
+        return all(c.paused for c in self.consumers) if self.consumers else False
+
+    def consumption_status(self) -> list[dict]:
+        """Per-partition ingestion status incl. lag (ingestion-delay tracking
+        + /consumingSegmentsInfo REST parity)."""
+        out = []
+        for c in self.consumers:
+            latest = None
+            lag = None
+            latest_fn = getattr(self.stream, "latest_offset", None)
+            if latest_fn is not None:
+                latest = latest_fn(c.partition)
+                lag = max(0, latest - c.current_offset)
+            out.append(
+                {
+                    "partition": c.partition,
+                    "state": c.state,
+                    "currentOffset": c.current_offset,
+                    "latestOffset": latest,
+                    "offsetLag": lag,
+                    "consumingSegment": c._seg_name(),
+                    "consumingDocs": c._mutable.n_docs,
+                }
+            )
+        return out
 
     def consuming_snapshots(self) -> list[ImmutableSegment]:
         return [s for c in self.consumers if (s := c.consuming_snapshot()) is not None]

@@ -1,0 +1,175 @@
+"""Chaos + pause/resume + stats-history integration tests.
+
+Reference test model: ChaosMonkeyIntegrationTest
+(pinot-integration-tests/.../ChaosMonkeyIntegrationTest.java:47 — random
+component kills during ingestion, then a correctness check) plus the
+pauseless/pause-resume ingestion REST tests and
+RealtimeSegmentStatsHistory persistence (SURVEY.md §5.3/§5.4).
+"""
+
+import random
+import time
+
+import numpy as np
+import pytest
+
+from pinot_tpu.cluster import Broker, Controller, PropertyStore, Server
+from pinot_tpu.common import DataType, Schema, TableConfig, TableType
+from pinot_tpu.realtime import InMemoryStream, RealtimeTableManager
+
+
+def _schema():
+    return Schema.build(
+        "events",
+        dimensions=[("kind", DataType.STRING)],
+        metrics=[("value", DataType.LONG)],
+    )
+
+
+def _mk(tmp_path, partitions=2, max_rows=50):
+    store = PropertyStore()
+    controller = Controller(store, tmp_path / "deep")
+    server = Server("server_rt")
+    controller.register_server("server_rt", server)
+    schema = _schema()
+    controller.add_schema(schema)
+    config = TableConfig("events", TableType.REALTIME)
+    controller.add_table(config)
+    stream = InMemoryStream(partitions=partitions)
+    mgr = RealtimeTableManager(controller, server, schema, config, stream, max_rows_per_segment=max_rows)
+    return controller, server, stream, mgr, config, schema
+
+
+def _produce(stream, partition, n, start):
+    for i in range(start, start + n):
+        stream.produce(partition, {"kind": f"k{i % 5}", "value": i})
+
+
+def test_pause_resume_consumption(tmp_path):
+    controller, server, stream, mgr, config, schema = _mk(tmp_path, partitions=1)
+    mgr.start()
+    try:
+        _produce(stream, 0, 30, 0)
+        assert mgr.wait_until_caught_up([30], timeout=10)
+        mgr.pause()
+        # wait until the loop actually parks
+        for _ in range(100):
+            if mgr.consumers[0].state == "PAUSED":
+                break
+            time.sleep(0.02)
+        assert mgr.paused
+        assert controller.store.get("/tables/events/pauseStatus") == {"paused": True}
+        _produce(stream, 0, 20, 30)
+        time.sleep(0.2)
+        assert mgr.consumers[0].current_offset == 30  # nothing consumed while paused
+        status = mgr.consumption_status()[0]
+        assert status["state"] == "PAUSED" and status["offsetLag"] == 20
+        mgr.resume()
+        assert mgr.wait_until_caught_up([50], timeout=10)
+        assert not mgr.paused
+        broker = Broker(controller)
+        assert broker.execute("SELECT COUNT(*) FROM events").rows[0][0] == 50
+    finally:
+        mgr.stop()
+
+
+def test_stats_history_recorded_on_commit(tmp_path):
+    controller, server, stream, mgr, config, schema = _mk(tmp_path, partitions=1, max_rows=20)
+    mgr.start()
+    try:
+        _produce(stream, 0, 65, 0)  # 3 committed segments of 20 + 5 consuming
+        assert mgr.wait_until_caught_up([65], timeout=10)
+        for _ in range(200):
+            if len(mgr.stats_history()) >= 3:
+                break
+            time.sleep(0.02)
+        hist = mgr.stats_history()
+        assert len(hist) >= 3
+        assert all(e["numDocs"] == 20 for e in hist)
+        assert mgr.estimated_cardinality("kind") == 5
+        assert mgr.estimated_cardinality("nope") is None
+    finally:
+        mgr.stop()
+
+
+def test_pause_resume_via_controller_rest(tmp_path):
+    """pauseConsumption / resumeConsumption / consumingSegmentsInfo REST."""
+    from pinot_tpu.cluster.http import ControllerHTTPService, RemoteControllerClient
+
+    controller, server, stream, mgr, config, schema = _mk(tmp_path, partitions=1)
+    svc = ControllerHTTPService(controller)
+    rc = RemoteControllerClient(f"http://127.0.0.1:{svc.port}")
+    mgr.start()
+    try:
+        _produce(stream, 0, 10, 0)
+        assert mgr.wait_until_caught_up([10], timeout=10)
+        out = rc._post("/tables/events/pauseConsumption", b"{}")
+        assert out["servers"] == ["server_rt"]
+        for _ in range(100):
+            if mgr.paused:
+                break
+            time.sleep(0.02)
+        assert mgr.paused
+        info = rc._get("/tables/events/consumingSegmentsInfo")
+        assert info["server_rt"][0]["currentOffset"] == 10
+        rc._post("/tables/events/resumeConsumption", b"{}")
+        _produce(stream, 0, 5, 10)
+        assert mgr.wait_until_caught_up([15], timeout=10)
+    finally:
+        mgr.stop()
+        svc.stop()
+
+
+def test_chaos_monkey_ingestion_correctness(tmp_path):
+    """Random component disruption during ingestion — pause/resume storms,
+    manager restarts (checkpoint recovery), server segment reloads — must
+    end with exactly-once results at the broker."""
+    rng = random.Random(1234)
+    controller, server, stream, mgr, config, schema = _mk(tmp_path, partitions=2, max_rows=40)
+    mgr.start()
+    total = [0, 0]
+    try:
+        for round_no in range(6):
+            for p in range(2):
+                n = rng.randint(10, 60)
+                _produce(stream, p, n, total[p])
+                total[p] += n
+            action = rng.choice(["pause_resume", "restart_manager", "reload_segment", "none"])
+            if action == "pause_resume":
+                mgr.pause()
+                time.sleep(0.05)
+                mgr.resume()
+            elif action == "restart_manager":
+                # kill the consumers mid-stream; a new manager must resume
+                # from committed checkpoints without loss or duplication
+                mgr.stop()
+                mgr = RealtimeTableManager(
+                    controller, server, schema, config, stream, max_rows_per_segment=40
+                )
+                mgr.start()
+            elif action == "reload_segment":
+                # drop a committed segment replica from the server and
+                # re-add it from the deep store (segment reload)
+                metas = controller.all_segment_metadata("events")
+                if metas:
+                    name, meta = sorted(metas.items())[rng.randrange(len(metas))]
+                    server.remove_segment("events", name)
+                    server.add_segment("events", name, meta["location"])
+        assert mgr.wait_until_caught_up(total, timeout=20)
+        # allow in-flight rollovers to settle
+        time.sleep(0.3)
+        broker = Broker(controller)
+        res = broker.execute("SELECT COUNT(*), SUM(value) FROM events")
+        expect_n = sum(total)
+        expect_sum = float(sum(sum(range(t)) for t in total))
+        assert res.rows[0][0] == expect_n, f"lost/duplicated rows: {res.rows[0][0]} != {expect_n}"
+        assert res.rows[0][1] == expect_sum
+        # group-by correctness too
+        g = broker.execute("SELECT kind, COUNT(*) FROM events GROUP BY kind ORDER BY kind LIMIT 10")
+        per_kind = {f"k{k}": 0 for k in range(5)}
+        for p in range(2):
+            for i in range(total[p]):
+                per_kind[f"k{i % 5}"] += 1
+        assert {r[0]: r[1] for r in g.rows} == per_kind
+    finally:
+        mgr.stop()
