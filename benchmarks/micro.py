@@ -1,0 +1,205 @@
+"""Microbenchmark suite: per-kernel harnesses mirroring the reference's JMH
+benchmarks (pinot-perf/src/main/java/org/apache/pinot/perf/ — 57 harnesses,
+SURVEY.md §6). Each bench prints one JSON line; `python -m benchmarks.micro`
+runs all (or a name filter) on whatever backend JAX resolves.
+
+On tunneled TPU attachments every device->host sync costs a full round trip,
+so device benches time N dispatches ending in ONE readback and amortize.
+
+Covered (JMH analog in parens):
+  filter_mask          (BenchmarkScanDocIdIterators / BenchmarkAndDocIdIterator)
+  grouped_sum_xla      (BenchmarkCombineGroupBy — XLA segment_sum path)
+  grouped_sum_blocked  (exact int blocked path)
+  grouped_sum_pallas   (fused byte-plane pallas kernel)
+  fwd_unpack_native    (BenchmarkFixedBitSVForwardIndexReader — C++ bitunpack)
+  lz4_native           (no-dictionary compression benches)
+  query_e2e            (BenchmarkQueries — full engine over one segment)
+  datatable_serde      (DataTable serialization benches)
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def _time_host(fn, iters=10):
+    fn()  # warm
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        fn()
+    return (time.perf_counter() - t0) / iters * 1e3
+
+
+def _time_device(make_out, iters=10):
+    """N dispatches, one trailing readback (tunnel-RTT amortization)."""
+    np.asarray(make_out())  # warm + sync
+    t0 = time.perf_counter()
+    out = None
+    for _ in range(iters):
+        out = make_out()
+    np.asarray(out)
+    return (time.perf_counter() - t0) / iters * 1e3
+
+
+def bench_filter_mask(n=4_000_000):
+    import jax, jax.numpy as jnp
+
+    rng = np.random.default_rng(0)
+    v = jnp.asarray(rng.integers(0, 100, n).astype(np.int32))
+    y = jnp.asarray(rng.integers(1992, 1999, n).astype(np.int32))
+
+    f = jax.jit(lambda v, y: jnp.sum((v > 5) & (y >= 1993) & (y <= 1997), dtype=jnp.int32))
+    return {"metric": "filter_mask_2col", "value": _time_device(lambda: f(v, y)), "unit": "ms", "n": n}
+
+
+def _group_inputs(n, ng):
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(0)
+    return (
+        jnp.asarray(rng.integers(0, ng, n).astype(np.int32)),
+        jnp.asarray(rng.integers(100, 600_000, n).astype(np.int32)),
+        jnp.asarray(rng.random(n) < 0.9),
+    )
+
+
+def bench_grouped_sum_xla(n=4_000_000, ng=1024):
+    import jax, jax.numpy as jnp
+
+    gid, v, m = _group_inputs(n, ng)
+    f = jax.jit(
+        lambda g, v, m: jax.ops.segment_sum(jnp.where(m, v.astype(jnp.float64), 0.0), g, num_segments=ng)
+    )
+    return {"metric": "grouped_sum_xla_f64", "value": _time_device(lambda: f(gid, v, m)), "unit": "ms", "n": n}
+
+
+def bench_grouped_sum_blocked(n=4_000_000, ng=1024):
+    import jax
+
+    from pinot_tpu.query.kernels import _exact_int_grouped_sum
+
+    gid, v, m = _group_inputs(n, ng)
+    f = jax.jit(lambda g, v, m: _exact_int_grouped_sum(v, g, m, ng))
+    return {"metric": "grouped_sum_blocked_int", "value": _time_device(lambda: f(gid, v, m)), "unit": "ms", "n": n}
+
+
+def bench_grouped_sum_pallas(n=4_000_000, ng=1024):
+    from pinot_tpu.ops.groupby_pallas import pallas_grouped_sum_count_exact
+
+    gid, v, m = _group_inputs(n, ng)
+    return {
+        "metric": "grouped_sum_pallas_exact",
+        "value": _time_device(lambda: pallas_grouped_sum_count_exact(v, gid, m, ng)[0]),
+        "unit": "ms",
+        "n": n,
+    }
+
+
+def bench_fwd_unpack_native(n=4_000_000, bits=7):
+    from pinot_tpu import native
+
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, 1 << bits, n).astype(np.int32)
+    packed = native.bitpack(ids, bits)
+    return {
+        "metric": "fwd_index_bitunpack_native",
+        "value": _time_host(lambda: native.bitunpack(packed, n, bits)),
+        "unit": "ms",
+        "n": n,
+    }
+
+
+def bench_lz4_native(n=8_000_000):
+    from pinot_tpu import native
+
+    rng = np.random.default_rng(0)
+    # dict-id-like data: low-cardinality small ints with runs (compressible)
+    raw = np.repeat(rng.integers(0, 16, n // 8).astype(np.uint8), 8).tobytes()
+    comp = native.lz4_compress(raw)
+    return {
+        "metric": "lz4_decompress_native",
+        "value": _time_host(lambda: native.lz4_decompress(comp, len(raw))),
+        "unit": "ms",
+        "bytes": len(raw),
+        "ratio": round(len(raw) / max(len(comp), 1), 2),
+    }
+
+
+def bench_query_e2e(n=1_000_000):
+    from pinot_tpu.common import DataType, Schema
+    from pinot_tpu.query.engine import QueryEngine
+    from pinot_tpu.segment import SegmentBuilder
+
+    rng = np.random.default_rng(0)
+    schema = Schema.build(
+        "t",
+        dimensions=[("k", DataType.STRING), ("y", DataType.INT)],
+        metrics=[("v", DataType.LONG)],
+    )
+    data = {
+        "k": np.array([f"g{i:02d}" for i in range(40)], dtype=object)[rng.integers(0, 40, n)],
+        "y": rng.integers(1992, 1999, n).astype(np.int32),
+        "v": rng.integers(0, 1000, n).astype(np.int64),
+    }
+    engine = QueryEngine([SegmentBuilder(schema).build(data, "s0")])
+    sql = "SELECT k, SUM(v) FROM t WHERE y >= 1993 GROUP BY k ORDER BY SUM(v) DESC LIMIT 10"
+    return {"metric": "query_e2e_groupby", "value": _time_host(lambda: engine.execute(sql), iters=5), "unit": "ms", "n": n}
+
+
+def bench_datatable_serde(n=200_000):
+    import pandas as pd
+
+    from pinot_tpu.common import datatable
+
+    rng = np.random.default_rng(0)
+    frame = pd.DataFrame(
+        {
+            "k0": np.array([f"key{i % 997}" for i in range(n)], dtype=object),
+            "a0p0": rng.integers(0, 10**9, n),
+            "a1p0": rng.random(n),
+        }
+    )
+    payload = datatable.encode(frame)
+    return {
+        "metric": "datatable_roundtrip",
+        "value": _time_host(lambda: datatable.decode(datatable.encode(frame)), iters=5),
+        "unit": "ms",
+        "bytes": len(payload),
+    }
+
+
+ALL = [
+    bench_filter_mask,
+    bench_grouped_sum_xla,
+    bench_grouped_sum_blocked,
+    bench_grouped_sum_pallas,
+    bench_fwd_unpack_native,
+    bench_lz4_native,
+    bench_query_e2e,
+    bench_datatable_serde,
+]
+
+
+def main(argv=None):
+    import pinot_tpu  # noqa: F401 — x64/platform setup before jax use
+
+    names = (argv or sys.argv[1:]) or None
+    for b in ALL:
+        tag = b.__name__.removeprefix("bench_")
+        if names and not any(f in tag for f in names):
+            continue
+        try:
+            out = b()
+            out["value"] = round(out["value"], 3)
+        except Exception as e:  # noqa: BLE001 — report, keep going
+            out = {"metric": tag, "error": f"{type(e).__name__}: {e}"}
+        print(json.dumps(out), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -37,6 +37,7 @@ _T_DICT = 9
 _T_NDARRAY = 10
 _T_OBJARRAY = 11
 _T_DATAFRAME = 12
+_T_STRARRAY = 13  # all-string object array: offsets + one utf8 blob
 
 
 class DataTableError(ValueError):
@@ -79,11 +80,27 @@ def _encode_value(out: BytesIO, v) -> None:
             _encode_value(out, v[col].to_numpy())
     elif isinstance(v, np.ndarray):
         if v.dtype == object:
+            flat = v.ravel()
+            if flat.size and all(isinstance(x, str) for x in flat):
+                # var-byte string column (VarByteChunk forward index analog):
+                # one length array + one concatenated utf8 blob, no per-item
+                # tag overhead — the hot shape for group keys on the wire
+                out.write(bytes([_T_STRARRAY]))
+                _w_u32(out, v.ndim)
+                for d in v.shape:
+                    _w_u32(out, d)
+                encoded = [x.encode() for x in flat]
+                lengths = np.asarray([len(b) for b in encoded], dtype=np.uint32)
+                out.write(lengths.tobytes())
+                blob = b"".join(encoded)
+                _w_u32(out, len(blob))
+                out.write(blob)
+                return
             out.write(bytes([_T_OBJARRAY]))
             _w_u32(out, v.ndim)
             for d in v.shape:
                 _w_u32(out, d)
-            for item in v.ravel():
+            for item in flat:
                 _encode_value(out, item)
         else:
             out.write(bytes([_T_NDARRAY]))
@@ -171,6 +188,17 @@ def _decode_value(r: _Reader):
         shape = tuple(r.u32() for _ in range(r.u32()))
         data = r.take(r.u32())
         return np.frombuffer(data, dtype=dt).reshape(shape).copy()
+    if tag == _T_STRARRAY:
+        shape = tuple(r.u32() for _ in range(r.u32()))
+        n = int(np.prod(shape, dtype=np.int64)) if shape else 1
+        lengths = np.frombuffer(r.take(4 * n), dtype=np.uint32)
+        blob = r.take(r.u32())
+        arr = np.empty(n, dtype=object)
+        pos = 0
+        for i, ln in enumerate(lengths):
+            arr[i] = blob[pos : pos + ln].decode()
+            pos += ln
+        return arr.reshape(shape)
     if tag == _T_OBJARRAY:
         shape = tuple(r.u32() for _ in range(r.u32()))
         n = int(np.prod(shape, dtype=np.int64)) if shape else 1

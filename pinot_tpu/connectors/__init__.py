@@ -1,0 +1,3 @@
+from pinot_tpu.connectors.dataframe import read_table, write_table
+
+__all__ = ["read_table", "write_table"]

@@ -1,0 +1,84 @@
+"""Batch read/write connector: tables <-> pandas DataFrames.
+
+Reference parity: pinot-connectors/ (Spark/Flink read + write connectors).
+The Spark read connector plans one input split per segment and reads segment
+data directly (server gRPC scan); here read_table fans a thread pool over the
+deep-store segment copies — the same segment-level parallelism — and
+write_table is the write connector: chunk a DataFrame into segments and push
+them through the controller. Both work against an in-process Controller or a
+RemoteControllerClient (controller REST), so external jobs can use them the
+way Spark executors use the reference connector.
+"""
+
+from __future__ import annotations
+
+from concurrent.futures import ThreadPoolExecutor
+from pathlib import Path
+
+import numpy as np
+import pandas as pd
+
+
+def read_table(
+    controller,
+    table: str,
+    columns: list[str] | None = None,
+    parallelism: int = 4,
+) -> pd.DataFrame:
+    """Full-table scan into a DataFrame, one task per segment."""
+    from pinot_tpu.segment.loader import load_segment
+
+    meta = controller.all_segment_metadata(table)
+    locations = [m["location"] for _, m in sorted(meta.items()) if m.get("location")]
+
+    def one(loc: str) -> pd.DataFrame:
+        seg = load_segment(loc)
+        cols = columns or list(seg.columns)
+        return pd.DataFrame({c: seg.columns[c].materialize() for c in cols})
+
+    if not locations:
+        return pd.DataFrame(columns=columns or [])
+    with ThreadPoolExecutor(max_workers=max(1, parallelism)) as pool:
+        frames = list(pool.map(one, locations))
+    return pd.concat(frames, ignore_index=True)
+
+
+def write_table(
+    controller,
+    table: str,
+    df: pd.DataFrame,
+    rows_per_segment: int = 1_000_000,
+    segment_name_prefix: str | None = None,
+) -> list[str]:
+    """Chunk a DataFrame into segments and push them. The controller must
+    already know the table's schema/config (AddTable first)."""
+    from pinot_tpu.segment.builder import SegmentBuilder, write_segment
+
+    schema = controller.get_schema(table)
+    if schema is None:
+        raise KeyError(f"no schema for table {table!r}")
+    config = controller.get_table(table)
+    builder = SegmentBuilder(schema, config)
+    prefix = segment_name_prefix or f"{table}_df"
+    pushed = []
+    remote = not hasattr(controller, "upload_segment")
+    for i, start in enumerate(range(0, len(df), rows_per_segment)):
+        chunk = df.iloc[start : start + rows_per_segment]
+        data = {}
+        for name in schema.columns:
+            if name not in chunk.columns:
+                raise KeyError(f"DataFrame missing schema column {name!r}")
+            v = chunk[name].to_numpy()
+            data[name] = v if v.dtype != object else np.asarray(v, dtype=object)
+        seg = builder.build(data, f"{prefix}_{i}")
+        if remote:
+            # RemoteControllerClient: write locally, push the tarball
+            import tempfile
+
+            with tempfile.TemporaryDirectory() as tmp:
+                seg_dir = write_segment(seg, Path(tmp))
+                controller.upload_segment_dir(table, seg_dir)
+        else:
+            controller.upload_segment(table, seg)
+        pushed.append(seg.name)
+    return pushed

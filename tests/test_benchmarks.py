@@ -1,0 +1,14 @@
+"""The microbenchmark suite must stay runnable (JMH-suite parity, SURVEY §6)."""
+
+import json
+
+import benchmarks.micro as micro
+
+
+def test_micro_benches_run(capsys):
+    assert micro.main(["fwd_unpack", "datatable"]) == 0
+    lines = [json.loads(l) for l in capsys.readouterr().out.strip().splitlines()]
+    metrics = {l["metric"] for l in lines}
+    assert "fwd_index_bitunpack_native" in metrics
+    assert "datatable_roundtrip" in metrics
+    assert all("error" not in l for l in lines), lines

@@ -1,0 +1,130 @@
+"""Stream plugins (file stream, gated kafka) and the DataFrame connector.
+
+Reference test model: pinot-stream-ingestion plugin tests +
+pinot-connectors read/write tests (SURVEY.md §2.4).
+"""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+import pinot_tpu.realtime.plugins  # noqa: F401 — registers factories
+from pinot_tpu.cluster import Broker, Controller, PropertyStore, Server
+from pinot_tpu.common import DataType, Schema, TableConfig, TableType
+from pinot_tpu.connectors import read_table, write_table
+from pinot_tpu.realtime import RealtimeTableManager
+from pinot_tpu.realtime.stream import get_stream_factory
+
+
+def _schema():
+    return Schema.build(
+        "events", dimensions=[("kind", DataType.STRING)], metrics=[("value", DataType.LONG)]
+    )
+
+
+# -- file stream -------------------------------------------------------------
+
+
+def test_file_stream_produce_consume(tmp_path):
+    fs = get_stream_factory("file", {"stream.file.root": str(tmp_path / "s"), "stream.file.partitions": 2})
+    fs.produce(0, {"kind": "a", "value": 1})
+    fs.produce(0, {"kind": "b", "value": 2})
+    fs.produce(1, {"kind": "c", "value": 3})
+    assert fs.partition_count() == 2
+    assert fs.latest_offset(0) == 2
+    c = fs.create_consumer(0)
+    msgs, nxt = c.fetch_messages(0, 10)
+    assert [m.value["kind"] for m in msgs] == ["a", "b"] and nxt == 2
+    # tail continues after append
+    fs.produce(0, {"kind": "d", "value": 4})
+    msgs, nxt = c.fetch_messages(nxt, 10)
+    assert [m.value["kind"] for m in msgs] == ["d"] and nxt == 3
+
+
+def test_file_stream_feeds_realtime_table(tmp_path):
+    controller = Controller(PropertyStore(), tmp_path / "deep")
+    server = Server("s0")
+    controller.register_server("s0", server)
+    schema = _schema()
+    controller.add_schema(schema)
+    config = TableConfig("events", TableType.REALTIME)
+    controller.add_table(config)
+    fs = get_stream_factory("file", {"stream.file.root": str(tmp_path / "stream")})
+    for i in range(25):
+        fs.produce(0, {"kind": f"k{i % 3}", "value": i})
+    mgr = RealtimeTableManager(controller, server, schema, config, fs, max_rows_per_segment=10)
+    mgr.start()
+    try:
+        assert mgr.wait_until_caught_up([25], timeout=10)
+        res = Broker(controller).execute("SELECT COUNT(*), SUM(value) FROM events")
+        assert res.rows[0] == [25, float(sum(range(25)))]
+    finally:
+        mgr.stop()
+
+
+def test_kafka_factory_gated():
+    with pytest.raises(ImportError, match="Kafka ingestion requires"):
+        get_stream_factory("kafka", {})
+
+
+# -- dataframe connector -----------------------------------------------------
+
+
+def _offline_cluster(tmp_path):
+    controller = Controller(PropertyStore(), tmp_path / "deep")
+    controller.register_server("s0", Server("s0"))
+    schema = _schema()
+    controller.add_schema(schema)
+    controller.add_table(TableConfig("events"))
+    return controller
+
+
+def test_write_then_read_roundtrip(tmp_path):
+    controller = _offline_cluster(tmp_path)
+    df = pd.DataFrame(
+        {
+            "kind": np.array([f"k{i % 4}" for i in range(100)], dtype=object),
+            "value": np.arange(100, dtype=np.int64),
+            "extra_ignored": np.zeros(100),
+        }
+    )
+    names = write_table(controller, "events", df[["kind", "value"]], rows_per_segment=30)
+    assert names == [f"events_df_{i}" for i in range(4)]
+    out = read_table(controller, "events")
+    assert len(out) == 100
+    assert sorted(out.columns) == ["kind", "value"]
+    assert out["value"].sum() == df["value"].sum()
+    # column pruning + queryable through the broker
+    only = read_table(controller, "events", columns=["value"], parallelism=2)
+    assert list(only.columns) == ["value"]
+    res = Broker(controller).execute("SELECT kind, COUNT(*) FROM events GROUP BY kind ORDER BY kind")
+    assert [r[1] for r in res.rows] == [25, 25, 25, 25]
+
+
+def test_write_missing_column_raises(tmp_path):
+    controller = _offline_cluster(tmp_path)
+    with pytest.raises(KeyError, match="missing schema column"):
+        write_table(controller, "events", pd.DataFrame({"kind": ["a"]}))
+
+
+def test_read_empty_table(tmp_path):
+    controller = _offline_cluster(tmp_path)
+    assert read_table(controller, "events").empty
+
+
+def test_connector_against_rest_controller(tmp_path):
+    """write_table through RemoteControllerClient (the external-job shape)."""
+    from pinot_tpu.cluster.http import ControllerHTTPService, RemoteControllerClient
+
+    controller = _offline_cluster(tmp_path)
+    svc = ControllerHTTPService(controller)
+    try:
+        rc = RemoteControllerClient(f"http://127.0.0.1:{svc.port}")
+        df = pd.DataFrame(
+            {"kind": np.array(["x", "y"], dtype=object), "value": np.array([5, 6], dtype=np.int64)}
+        )
+        write_table(rc, "events", df)
+        out = read_table(rc, "events")
+        assert sorted(out["value"].tolist()) == [5, 6]
+    finally:
+        svc.stop()
