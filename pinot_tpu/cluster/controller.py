@@ -178,6 +178,39 @@ class Controller:
 
             shutil.rmtree(meta["location"], ignore_errors=True)
 
+    def reload_segments(self, table: str, segment_name: str | None = None) -> list[str]:
+        """Rebuild segments from deep-store data under the CURRENT table
+        config/schema (segment reload REST + SegmentPreProcessor parity:
+        index config changes take effect on reload). Preserves realtime
+        offset metadata across the rebuild."""
+        from pinot_tpu.segment.builder import SegmentBuilder
+        from pinot_tpu.segment.loader import load_segment
+
+        schema = self.get_schema(table)
+        config = self.get_table(table)
+        if schema is None or config is None:
+            raise KeyError(f"no such table: {table}")
+        builder = SegmentBuilder(schema, config)
+        reloaded = []
+        for name, meta in sorted(self.all_segment_metadata(table).items()):
+            if segment_name is not None and name != segment_name:
+                continue
+            loc = meta.get("location")
+            if not loc:
+                continue
+            seg = load_segment(loc)
+            cols = {c: ci.materialize() for c, ci in seg.columns.items()}
+            rebuilt = builder.build(cols, name)
+            keep = {k: v for k, v in meta.items() if k in ("startOffset", "endOffset", "partition", "refreshEpoch")}
+            self.delete_segment(table, name)
+            self.upload_segment(table, rebuilt)
+            if keep:
+                new_meta = self.segment_metadata(table, name) or {}
+                new_meta.update(keep)
+                self.store.set(f"/tables/{table}/segments/{name}", new_meta)
+            reloaded.append(name)
+        return reloaded
+
     def replace_segments(self, table: str, old_names: list[str], new_segments: list[ImmutableSegment]) -> None:
         """Atomic-enough swap (segment-lineage startReplaceSegments/
         endReplaceSegments parity): upload replacements first, then drop the

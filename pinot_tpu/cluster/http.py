@@ -139,6 +139,25 @@ class ServerHTTPService:
                     self.send_header("Content-Length", "2")
                     self.end_headers()
                     self.wfile.write(b"OK")
+                elif self.path == "/debug/queries":
+                    # ThreadResourceTracker/QueryResourceTracker REST parity
+                    from pinot_tpu.common.accounting import default_accountant
+
+                    payload = json.dumps(default_accountant.query_trackers()).encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type", "application/json")
+                    self.send_header("Content-Length", str(len(payload)))
+                    self.end_headers()
+                    self.wfile.write(payload)
+                elif self.path == "/metrics":
+                    from pinot_tpu.common.metrics import server_metrics
+
+                    payload = json.dumps(server_metrics().snapshot()).encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type", "application/json")
+                    self.send_header("Content-Length", str(len(payload)))
+                    self.end_headers()
+                    self.wfile.write(payload)
                 else:
                     self.send_error(404)
 
@@ -236,7 +255,34 @@ class ControllerHTTPService:
                 c = svc.controller
                 try:
                     parts = [p for p in self.path.split("?")[0].split("/") if p]
-                    if self.path == "/health":
+                    if self.path in ("/", "/index.html"):
+                        # minimal status page (the controller UI's round-1
+                        # analog of the React SPA home)
+                        rows = []
+                        for t in c.tables():
+                            ideal = c.ideal_state(t)
+                            docs = sum(m.get("numDocs", 0) for m in c.all_segment_metadata(t).values())
+                            rows.append(f"<tr><td>{t}</td><td>{len(ideal)}</td><td>{docs}</td></tr>")
+                        instances = ", ".join(sorted(p.split("/")[-1] for p in c.store.list("/instances/")))
+                        html = (
+                            "<html><head><title>pinot-tpu controller</title></head><body>"
+                            "<h2>pinot-tpu cluster</h2>"
+                            f"<p>instances: {instances or 'none'}</p>"
+                            "<table border=1 cellpadding=4><tr><th>table</th>"
+                            "<th>segments</th><th>docs</th></tr>" + "".join(rows) + "</table>"
+                            "<p>REST: /tables /brokers /instances /tables/{t}/segments "
+                            "/tables/{t}/idealstate /metrics</p></body></html>"
+                        ).encode()
+                        self.send_response(200)
+                        self.send_header("Content-Type", "text/html")
+                        self.send_header("Content-Length", str(len(html)))
+                        self.end_headers()
+                        self.wfile.write(html)
+                    elif self.path == "/metrics":
+                        from pinot_tpu.common.metrics import controller_metrics
+
+                        self._json(controller_metrics().snapshot())
+                    elif self.path == "/health":
                         self._json({"status": "OK"})
                     elif self.path == "/tables":
                         self._json({"tables": c.tables()})
@@ -299,6 +345,10 @@ class ControllerHTTPService:
                         else:
                             c.register_server(body["id"], host=body["host"], port=int(body["port"]))
                         self._json({"status": "ok"})
+                    elif len(parts) == 3 and parts[0] == "segments" and parts[2] == "reload":
+                        body = json.loads(raw or b"{}")
+                        names = c.reload_segments(parts[1], body.get("segment"))
+                        self._json({"status": "ok", "reloaded": names})
                     elif len(parts) == 2 and parts[0] == "segments":
                         # segment upload: tarball of the segment directory
                         import io as _io

@@ -1,0 +1,86 @@
+"""Segment reload with config changes, debug/metrics endpoints, status page.
+
+Reference test model: segment reload REST tests (index build on reload via
+SegmentPreProcessor), /debug REST resources, controller UI availability
+(SURVEY.md §2.1 segment loading / §5.5).
+"""
+
+import json
+import urllib.request
+
+import numpy as np
+
+from pinot_tpu.cluster import Broker, Controller, PropertyStore, Server
+from pinot_tpu.cluster.http import ControllerHTTPService, RemoteControllerClient, ServerHTTPService
+from pinot_tpu.common import DataType, IndexingConfig, Schema, TableConfig
+from pinot_tpu.segment import SegmentBuilder
+
+
+def _mk(tmp_path):
+    controller = Controller(PropertyStore(), tmp_path / "ds")
+    server = Server("s0")
+    controller.register_server("s0", server)
+    schema = Schema.build("t", dimensions=[("k", DataType.STRING)], metrics=[("v", DataType.LONG)])
+    controller.add_schema(schema)
+    controller.add_table(TableConfig("t"))
+    seg = SegmentBuilder(schema).build(
+        {"k": np.array(["a", "b", "a"], dtype=object), "v": np.array([1, 2, 3], dtype=np.int64)}, "t_0"
+    )
+    controller.upload_segment("t", seg)
+    return controller, server, schema
+
+
+def test_reload_applies_new_index_config(tmp_path):
+    controller, server, schema = _mk(tmp_path)
+    # flip config: add a bloom filter + inverted index on k
+    tc = TableConfig("t", indexing=IndexingConfig(bloom_filter_columns=["k"], inverted_index_columns=["k"]))
+    controller.add_table(tc)
+    hosted = server.get_segment_object("t", "t_0")
+    assert "bloom" not in hosted.extras or not hosted.extras.get("bloom")
+    reloaded = controller.reload_segments("t")
+    assert reloaded == ["t_0"]
+    hosted = server.get_segment_object("t", "t_0")
+    assert hosted.extras.get("bloom"), "reload must build the newly-configured bloom filter"
+    # data intact + queryable
+    res = Broker(controller).execute("SELECT k, SUM(v) FROM t GROUP BY k ORDER BY k")
+    assert res.rows == [["a", 4.0], ["b", 2.0]]
+
+
+def test_reload_preserves_offset_metadata(tmp_path):
+    controller, server, schema = _mk(tmp_path)
+    meta = controller.segment_metadata("t", "t_0")
+    meta.update({"startOffset": 5, "endOffset": 9, "partition": 0})
+    controller.store.set("/tables/t/segments/t_0", meta)
+    controller.reload_segments("t")
+    meta2 = controller.segment_metadata("t", "t_0")
+    assert (meta2["startOffset"], meta2["endOffset"], meta2["partition"]) == (5, 9, 0)
+
+
+def test_reload_via_rest_and_status_page(tmp_path):
+    controller, server, schema = _mk(tmp_path)
+    svc = ControllerHTTPService(controller)
+    try:
+        rc = RemoteControllerClient(f"http://127.0.0.1:{svc.port}")
+        out = rc._post("/segments/t/reload", b"{}")
+        assert out["reloaded"] == ["t_0"]
+        with urllib.request.urlopen(f"http://127.0.0.1:{svc.port}/") as resp:
+            html = resp.read().decode()
+        assert "pinot-tpu cluster" in html and "<td>t</td>" in html
+        with urllib.request.urlopen(f"http://127.0.0.1:{svc.port}/metrics") as resp:
+            json.loads(resp.read())
+    finally:
+        svc.stop()
+
+
+def test_server_debug_and_metrics_endpoints(tmp_path):
+    controller, server, schema = _mk(tmp_path)
+    svc = ServerHTTPService(server)
+    try:
+        base = f"http://127.0.0.1:{svc.port}"
+        with urllib.request.urlopen(f"{base}/debug/queries") as resp:
+            assert json.loads(resp.read()) == []  # no in-flight queries
+        with urllib.request.urlopen(f"{base}/metrics") as resp:
+            snap = json.loads(resp.read())
+        assert isinstance(snap, dict)
+    finally:
+        svc.stop()
