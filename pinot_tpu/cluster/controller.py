@@ -118,7 +118,26 @@ class Controller:
         handles = self.servers()
         for sid in assigned:
             handles[sid].add_segment(table, segment.name, str(seg_dir))
+        self._refresh_dim_table(table, config)
         return assigned
+
+    def _refresh_dim_table(self, table: str, config: TableConfig | None = None) -> None:
+        """Dimension tables reload their in-memory PK map on any segment
+        change (DimensionTableDataManager refresh semantics)."""
+        config = config or self.get_table(table)
+        if config is None or not (config.extra or {}).get("isDimTable"):
+            return
+        from pinot_tpu.cluster.dimension import DimensionTableDataManager, register_dim_table
+        from pinot_tpu.segment.loader import load_segment
+
+        schema = self.get_schema(table)
+        mgr = DimensionTableDataManager(table, schema.primary_key_columns if schema else [])
+        segs = []
+        for _, meta in sorted(self.all_segment_metadata(table).items()):
+            if meta.get("location"):
+                segs.append(load_segment(meta["location"]))
+        mgr.load_segments(segs)
+        register_dim_table(mgr)
 
     @staticmethod
     def _compute_partitions(segment: ImmutableSegment, config: TableConfig) -> dict:
@@ -177,6 +196,7 @@ class Controller:
             import shutil
 
             shutil.rmtree(meta["location"], ignore_errors=True)
+        self._refresh_dim_table(table)
 
     def reload_segments(self, table: str, segment_name: str | None = None) -> list[str]:
         """Rebuild segments from deep-store data under the CURRENT table

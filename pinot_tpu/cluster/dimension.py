@@ -1,0 +1,83 @@
+"""Dimension tables: fully-in-memory PK-keyed lookup tables + LOOKUP UDF.
+
+Reference parity: DimensionTableDataManager (pinot-core/.../data/manager/
+offline/DimensionTableDataManager.java) — a table flagged dimTable is loaded
+entirely into a primary-key map on every server, powering the lookUp() UDF
+(LookupTransformFunction): lookUp('dimTable', 'destColumn', 'pkCol', pkExpr,
+...). The controller refreshes the registry on every segment upload/delete;
+the host expression evaluator consumes it.
+"""
+
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+
+
+class DimensionTableDataManager:
+    def __init__(self, table: str, pk_columns: list[str]):
+        if not pk_columns:
+            raise ValueError(f"dimension table {table!r} needs primaryKeyColumns in its schema")
+        self.table = table
+        self.pk_columns = list(pk_columns)
+        self._rows: dict[tuple, dict] = {}
+        self._lock = threading.Lock()
+
+    def load_segments(self, segments) -> None:
+        """Full rebuild from the table's current segments (the reference
+        reloads the whole map on segment changes too)."""
+        rows: dict[tuple, dict] = {}
+        for seg in segments:
+            cols = {c: ci.materialize() for c, ci in seg.columns.items()}
+            n = seg.n_docs
+            for i in range(n):
+                row = {c: v[i] for c, v in cols.items()}
+                pk = tuple(row[c] for c in self.pk_columns)
+                rows[pk] = row  # later segments win (refresh semantics)
+        with self._lock:
+            self._rows = rows
+
+    def lookup(self, pk: tuple):
+        with self._lock:
+            return self._rows.get(pk)
+
+    def lookup_column(self, dest_column: str, keys: list[tuple]) -> np.ndarray:
+        """Misses take the null substitute of the destination's type
+        ('null' for strings, NaN for numerics — FieldSpec default-null
+        parity)."""
+        with self._lock:
+            out = [(self._rows.get(k) or {}).get(dest_column) for k in keys]
+        is_str = any(isinstance(x, str) for x in out)
+        if is_str:
+            return np.asarray(["null" if x is None else x for x in out], dtype=object)
+        return np.asarray([np.nan if x is None else float(x) for x in out], dtype=np.float64)
+
+    @property
+    def size(self) -> int:
+        with self._lock:
+            return len(self._rows)
+
+
+_registry: dict[str, DimensionTableDataManager] = {}
+_registry_lock = threading.Lock()
+
+
+def register_dim_table(manager: DimensionTableDataManager) -> None:
+    with _registry_lock:
+        _registry[manager.table] = manager
+
+
+def get_dim_table(table: str) -> DimensionTableDataManager:
+    with _registry_lock:
+        m = _registry.get(table)
+    if m is None:
+        raise KeyError(
+            f"no dimension table {table!r} loaded (set extra.isDimTable=true on its table config)"
+        )
+    return m
+
+
+def unregister_dim_table(table: str) -> None:
+    with _registry_lock:
+        _registry.pop(table, None)
