@@ -1,9 +1,15 @@
-"""North-star benchmark (BASELINE.md config 4): SSB Q4.x-style multi-dimension
-GROUP BY with dictionary-encoded keys + ORDER BY LIMIT, device engine vs a
-pandas CPU reference on identical data.
+"""North-star benchmark: the 5 BASELINE.md configs, device engine vs a pandas
+CPU reference on identical data.
 
-Prints ONE JSON line:
-  {"metric": ..., "value": <device p50 ms>, "unit": "ms", "vs_baseline": <cpu_p50/device_p50>}
+Headline (config 4, SSB Q4.x-style multi-dimension GROUP BY + ORDER BY LIMIT)
+prints ONE JSON line:
+  {"metric": ..., "value": <device p50 ms>, "unit": "ms", "vs_baseline": <cpu_p50/device_p50>,
+   "backend": ..., "configs": {per-config p50/p99/speedup}}
+
+Resilience contract (VERDICT r1 item 1b): backend init is retried with
+backoff, falls back to CPU if the TPU tunnel stays unavailable, and a JSON
+line is ALWAYS emitted — even on unrecoverable failure — so no round loses
+its perf evidence to one transient init error.
 
 Env knobs: PINOT_TPU_BENCH_ROWS (default 4_000_000), PINOT_TPU_BENCH_ITERS (7).
 """
@@ -12,26 +18,126 @@ import json
 import os
 import sys
 import time
+import traceback
 
 import numpy as np
+
+HEADLINE = "ssb_q4_groupby_p50_latency"
 
 
 def log(msg):
     print(msg, file=sys.stderr, flush=True)
 
 
+def _probe_backend(timeout_s: float) -> tuple[bool, str]:
+    """Init the ambient jax backend in a THROWAWAY subprocess with a hard
+    timeout. Round-1 lost all perf evidence to one init error (rc=1), and the
+    axon tunnel can also HANG instead of erroring — a subprocess probe is the
+    only way to bound that without risking the parent."""
+    import subprocess
+
+    try:
+        p = subprocess.run(
+            [sys.executable, "-c", "import jax; jax.devices(); print('BACKEND_OK')"],
+            capture_output=True,
+            text=True,
+            timeout=timeout_s,
+        )
+        if "BACKEND_OK" in p.stdout:
+            return True, ""
+        return False, (p.stderr or p.stdout).strip()[-400:]
+    except subprocess.TimeoutExpired:
+        return False, f"init timed out after {timeout_s:.0f}s"
+    except Exception as e:
+        return False, str(e)
+
+
+def init_backend(max_tries: int = 2):
+    """Bring up a jax backend: probe the ambient (TPU) platform in a
+    subprocess with retry/backoff; fall back to CPU when it stays
+    unavailable. Never hangs, never raises."""
+    import jax
+
+    probe_timeout = float(os.environ.get("PINOT_TPU_BENCH_INIT_TIMEOUT", 180))
+    last = None
+    for attempt in range(max_tries):
+        ok, err = _probe_backend(probe_timeout)
+        if ok:
+            devs = jax.devices()
+            return jax.default_backend(), devs, None
+        last = err
+        log(f"backend probe {attempt + 1}/{max_tries} failed: {err}")
+        time.sleep(min(3.0 * (2**attempt), 12.0))
+    log("TPU backend unavailable after retries -> CPU fallback")
+    import pinot_tpu
+
+    pinot_tpu.force_cpu_backend()
+    devs = jax.devices()
+    return jax.default_backend(), devs, f"tpu_init_failed: {last}"
+
+
+def _time(fn, iters):
+    lat = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        fn()
+        lat.append((time.perf_counter() - t0) * 1e3)
+    return {
+        "p50": round(float(np.percentile(lat, 50)), 3),
+        "p99": round(float(np.percentile(lat, 99)), 3),
+    }
+
+
+def _bench_pair(name, run_dev, run_cpu, iters, check=None):
+    """warmup+time the device path and the pandas reference; optional result
+    check. A check failure is RECORDED next to the timings, never instead of
+    them — measured latencies are round evidence and must survive."""
+    run_dev()  # compile
+    run_dev()
+    dev = _time(run_dev, iters)
+    cpu = _time(run_cpu, max(3, iters // 2))
+    out = {**dev, "cpu_p50": cpu["p50"], "speedup": round(cpu["p50"] / dev["p50"], 3)}
+    if check is not None:
+        try:
+            check()
+        except Exception as e:
+            log(f"[{name}] RESULT CHECK FAILED: {e}")
+            out["check_error"] = str(e)
+    log(f"[{name}] device p50={dev['p50']}ms p99={dev['p99']}ms  cpu p50={cpu['p50']}ms  speedup={out['speedup']}x")
+    return out
+
+
 def main():
     import pinot_tpu  # noqa: F401  (x64 + platform setup)
+
+    backend, devices, init_err = init_backend()
+    result = {
+        "metric": HEADLINE,
+        "value": None,
+        "unit": "ms",
+        "vs_baseline": None,
+        "backend": backend,
+        "n_devices": len(devices),
+        "configs": {},
+    }
+    if init_err:
+        result["tpu_init_error"] = init_err
+
     import jax
+    import pandas as pd
 
     from pinot_tpu.common import DataType, Schema
     from pinot_tpu.parallel import build_sharded_table, make_mesh
-    from pinot_tpu.parallel.mesh import execute_sharded, execute_sharded_result
+    from pinot_tpu.parallel.mesh import execute_sharded_result
 
     n = int(os.environ.get("PINOT_TPU_BENCH_ROWS", 4_000_000))
+    if init_err and n > 1_000_000:
+        # bound the *fallback* round only; a deliberate CPU run keeps the knob
+        log(f"TPU-init fallback: clamping rows {n} -> 1000000")
+        n = 1_000_000
     iters = int(os.environ.get("PINOT_TPU_BENCH_ITERS", 7))
     rng = np.random.default_rng(0)
-    log(f"backend={jax.default_backend()} devices={len(jax.devices())} rows={n}")
+    log(f"backend={backend} devices={len(devices)} rows={n}")
 
     schema = Schema.build(
         "lineorder",
@@ -40,7 +146,11 @@ def main():
             ("c_nation", DataType.STRING),
             ("p_category", DataType.STRING),
         ],
-        metrics=[("lo_revenue", DataType.LONG), ("lo_supplycost", DataType.LONG), ("lo_quantity", DataType.INT)],
+        metrics=[
+            ("lo_revenue", DataType.LONG),
+            ("lo_supplycost", DataType.LONG),
+            ("lo_quantity", DataType.INT),
+        ],
     )
     data = {
         "d_year": rng.integers(1992, 1999, n).astype(np.int32),
@@ -52,61 +162,200 @@ def main():
         "lo_supplycost": rng.integers(50, 100_000, n).astype(np.int64),
         "lo_quantity": rng.integers(1, 51, n).astype(np.int32),
     }
-    # SSB Q4.2-flavored: profit by (year, nation, category) with a filter
-    sql = (
+    t = pd.DataFrame({k: (v.astype(str) if v.dtype == object else v) for k, v in data.items()})
+
+    mesh = make_mesh()
+    t0 = time.perf_counter()
+    table = build_sharded_table(
+        schema, data, mesh, rows_per_segment=max(1, n // max(4, len(devices)))
+    )
+    log(f"table built+staged in {time.perf_counter() - t0:.1f}s ({table.n_segments} segments)")
+
+    # ---- config 4 (HEADLINE): SSB Q4.2-flavored profit group-by -------------
+    q4 = (
         "SELECT d_year, c_nation, p_category, SUM(lo_revenue - lo_supplycost) "
         "FROM lineorder WHERE lo_quantity > 5 AND d_year BETWEEN 1993 AND 1997 "
         "GROUP BY d_year, c_nation, p_category ORDER BY SUM(lo_revenue - lo_supplycost) DESC LIMIT 10"
     )
+    state = {}
 
-    mesh = make_mesh()
-    t0 = time.perf_counter()
-    table = build_sharded_table(schema, data, mesh, rows_per_segment=max(1, n // max(4, len(jax.devices()))))
-    log(f"table built+staged in {time.perf_counter() - t0:.1f}s ({table.n_segments} segments)")
+    def dev4():
+        state["res"] = execute_sharded_result(table, q4)
 
-    # warmup (compile)
-    t0 = time.perf_counter()
-    res = execute_sharded_result(table, sql)
-    log(f"first query (compile): {time.perf_counter() - t0:.1f}s; top row: {res.rows[0] if res.rows else None}")
-    execute_sharded_result(table, sql)
-
-    lat = []
-    for _ in range(iters):
-        t0 = time.perf_counter()
-        res = execute_sharded_result(table, sql)  # full query: rows on host
-        lat.append((time.perf_counter() - t0) * 1e3)
-    device_p50 = float(np.percentile(lat, 50))
-    log(f"device latencies ms: {[round(x, 2) for x in lat]}")
-
-    # CPU reference: pandas on identical data (the role of Pinot's CPU engine)
-    import pandas as pd
-
-    t = pd.DataFrame({k: (v.astype(str) if v.dtype == object else v) for k, v in data.items()})
-    cpu = []
-    for _ in range(3):
-        t0 = time.perf_counter()
+    def cpu4():
         sel = t[(t.lo_quantity > 5) & (t.d_year >= 1993) & (t.d_year <= 1997)]
         profit = sel.lo_revenue - sel.lo_supplycost
-        g = profit.groupby([sel.d_year, sel.c_nation, sel.p_category]).sum().nlargest(10)
-        cpu.append((time.perf_counter() - t0) * 1e3)
-    cpu_p50 = float(np.percentile(cpu, 50))
-    log(f"cpu(pandas) latencies ms: {[round(x, 2) for x in cpu]}")
+        state["cpu"] = profit.groupby([sel.d_year, sel.c_nation, sel.p_category]).sum().nlargest(10)
 
-    # sanity: results agree
-    top = g.iloc[0]
-    assert res.rows[0][3] == float(top), f"result mismatch: {res.rows[0][3]} vs {float(top)}"
-
-    print(
-        json.dumps(
-            {
-                "metric": "ssb_q4_groupby_p50_latency",
-                "value": round(device_p50, 3),
-                "unit": "ms",
-                "vs_baseline": round(cpu_p50 / device_p50, 3),
-            }
+    def check4():
+        assert state["res"].rows[0][3] == float(state["cpu"].iloc[0]), (
+            f"result mismatch: {state['res'].rows[0][3]} vs {float(state['cpu'].iloc[0])}"
         )
+
+    try:
+        c4 = _bench_pair("config4 Q4.x group-by", dev4, cpu4, iters, check4)
+        result["configs"]["4_q4_groupby_orderby"] = c4
+        result["value"] = c4["p50"]
+        result["vs_baseline"] = c4["speedup"]
+    except Exception as e:
+        log(f"config 4 FAILED: {traceback.format_exc()}")
+        result["configs"]["4_q4_groupby_orderby"] = {"error": str(e)}
+
+    # ---- config 1: quickstart COUNT(*) with equality filter -----------------
+    q1 = "SELECT COUNT(*) FROM lineorder WHERE c_nation = 'NATION_07'"
+
+    def dev1():
+        state["res"] = execute_sharded_result(table, q1)
+
+    def cpu1():
+        state["cpu"] = int((t.c_nation == "NATION_07").sum())
+
+    try:
+        result["configs"]["1_count_filter"] = _bench_pair(
+            "config1 COUNT filter", dev1, cpu1, iters,
+            lambda: _assert_eq(state["res"].rows[0][0], state["cpu"]),
+        )
+    except Exception as e:
+        log(f"config 1 FAILED: {traceback.format_exc()}")
+        result["configs"]["1_count_filter"] = {"error": str(e)}
+
+    # ---- config 2: SUM/MIN/MAX/AVG with range+equality filter ---------------
+    q2 = (
+        "SELECT SUM(lo_revenue), MIN(lo_quantity), MAX(lo_revenue), AVG(lo_supplycost) "
+        "FROM lineorder WHERE d_year BETWEEN 1994 AND 1996 AND c_nation = 'NATION_03'"
     )
+
+    def dev2():
+        state["res"] = execute_sharded_result(table, q2)
+
+    def cpu2():
+        sel = t[(t.d_year >= 1994) & (t.d_year <= 1996) & (t.c_nation == "NATION_03")]
+        state["cpu"] = (
+            int(sel.lo_revenue.sum()),
+            int(sel.lo_quantity.min()),
+            int(sel.lo_revenue.max()),
+            float(sel.lo_supplycost.mean()),
+        )
+
+    try:
+        result["configs"]["2_filtered_agg"] = _bench_pair(
+            "config2 filtered agg", dev2, cpu2, iters,
+            lambda: _assert_eq(state["res"].rows[0][0], state["cpu"][0]),
+        )
+    except Exception as e:
+        log(f"config 2 FAILED: {traceback.format_exc()}")
+        result["configs"]["2_filtered_agg"] = {"error": str(e)}
+
+    # ---- config 3: Q1.x-flavored AND/OR filter + single-column group-by -----
+    q3 = (
+        "SELECT d_year, SUM(lo_revenue) FROM lineorder "
+        "WHERE (c_nation = 'NATION_01' OR c_nation = 'NATION_02') AND lo_quantity < 25 "
+        "GROUP BY d_year ORDER BY d_year LIMIT 20"
+    )
+
+    def dev3():
+        state["res"] = execute_sharded_result(table, q3)
+
+    def cpu3():
+        sel = t[((t.c_nation == "NATION_01") | (t.c_nation == "NATION_02")) & (t.lo_quantity < 25)]
+        state["cpu"] = sel.groupby(sel.d_year).lo_revenue.sum().sort_index()
+
+    try:
+        result["configs"]["3_q1_groupby"] = _bench_pair(
+            "config3 Q1.x group-by", dev3, cpu3, iters,
+            lambda: _assert_eq(state["res"].rows[0][1], float(state["cpu"].iloc[0])),
+        )
+    except Exception as e:
+        log(f"config 3 FAILED: {traceback.format_exc()}")
+        result["configs"]["3_q1_groupby"] = {"error": str(e)}
+
+    # ---- config 5: star-tree pre-agg + DISTINCTCOUNTHLL ---------------------
+    try:
+        result["configs"]["5_startree_hll"] = _bench_config5(rng, min(n, 2_000_000), iters)
+    except Exception as e:
+        log(f"config 5 FAILED: {traceback.format_exc()}")
+        result["configs"]["5_startree_hll"] = {"error": str(e)}
+
+    print(json.dumps(result))
+
+
+def _assert_eq(a, b):
+    assert float(a) == float(b), f"result mismatch: {a} vs {b}"
+
+
+def _bench_config5(rng, n, iters):
+    """Star-tree pre-aggregated scan + DISTINCTCOUNTHLL on a high-cardinality
+    column (BASELINE config 5), via the per-segment QueryEngine."""
+    import pandas as pd
+
+    from pinot_tpu.common import DataType, IndexingConfig, Schema, TableConfig
+    from pinot_tpu.common.config import StarTreeIndexConfig
+    from pinot_tpu.query import QueryEngine
+    from pinot_tpu.segment import SegmentBuilder
+
+    schema = Schema.build(
+        "events",
+        dimensions=[
+            ("country", DataType.STRING),
+            ("device", DataType.STRING),
+            ("user_id", DataType.LONG),
+        ],
+        metrics=[("impressions", DataType.LONG)],
+    )
+    cfg = TableConfig(
+        "events",
+        indexing=IndexingConfig(
+            star_tree_configs=[
+                StarTreeIndexConfig(
+                    dimensions_split_order=["country", "device"],
+                    function_column_pairs=["SUM__impressions", "COUNT__*"],
+                )
+            ]
+        ),
+    )
+    data = {
+        "country": np.array([f"C{i:02d}" for i in range(30)], dtype=object)[rng.integers(0, 30, n)],
+        "device": np.array(["phone", "desktop", "tablet"], dtype=object)[rng.integers(0, 3, n)],
+        "user_id": rng.integers(0, 5_000_000, n).astype(np.int64),
+        "impressions": rng.integers(1, 1000, n).astype(np.int64),
+    }
+    seg = SegmentBuilder(schema, cfg).build(data, "s0")
+    eng = QueryEngine([seg])
+    t = pd.DataFrame({k: (v.astype(str) if v.dtype == object else v) for k, v in data.items()})
+    q_star = "SELECT country, SUM(impressions) FROM events GROUP BY country ORDER BY SUM(impressions) DESC LIMIT 5"
+    q_hll = "SELECT DISTINCTCOUNTHLL(user_id) FROM events"
+    state = {}
+
+    def dev():
+        state["star"] = eng.execute(q_star)
+        state["hll"] = eng.execute(q_hll)
+
+    def cpu():
+        state["cpu_star"] = t.groupby("country").impressions.sum().nlargest(5)
+        state["cpu_hll"] = int(t.user_id.nunique())
+
+    def check():
+        assert state["star"].rows[0][1] == float(state["cpu_star"].iloc[0])
+        est, exact = float(state["hll"].rows[0][0]), state["cpu_hll"]
+        assert abs(est - exact) / exact < 0.1, f"HLL estimate off: {est} vs {exact}"
+
+    return _bench_pair("config5 star-tree+HLL", dev, cpu, iters, check)
 
 
 if __name__ == "__main__":
-    main()
+    try:
+        main()
+    except Exception as e:  # emit evidence even on unrecoverable failure
+        log(traceback.format_exc())
+        print(
+            json.dumps(
+                {
+                    "metric": HEADLINE,
+                    "value": None,
+                    "unit": "ms",
+                    "vs_baseline": None,
+                    "error": f"{type(e).__name__}: {e}",
+                }
+            )
+        )
+        sys.exit(0)

@@ -28,4 +28,37 @@ if os.environ.get("PINOT_TPU_NO_X64", "0") != "1":
 
     jax.config.update("jax_enable_x64", True)
 
+
+def force_cpu_backend(n_devices: int | None = None) -> None:
+    """Route jax to the CPU platform, safely, under the ambient axon TPU env.
+
+    The environment presets JAX_PLATFORMS=axon (experimental TPU tunnel
+    plugin). Overriding that env var to "cpu" HANGS during plugin init, so the
+    only safe recipe is: (a) remove the env var entirely, (b) select cpu via
+    jax.config, and optionally (c) force N virtual host devices — all BEFORE
+    any jax client is created. Shared by tests/conftest.py,
+    __graft_entry__.dryrun_multichip and bench.py so the hang-avoidance
+    workaround lives in exactly one place.
+    """
+    import re
+
+    os.environ.pop("JAX_PLATFORMS", None)
+    if n_devices is not None:
+        flags = os.environ.get("XLA_FLAGS", "")
+        m = re.search(r"--xla_force_host_platform_device_count=(\d+)", flags)
+        if m:
+            if int(m.group(1)) < n_devices:
+                flags = flags.replace(
+                    m.group(0), f"--xla_force_host_platform_device_count={n_devices}"
+                )
+                os.environ["XLA_FLAGS"] = flags
+        else:
+            os.environ["XLA_FLAGS"] = (
+                flags + f" --xla_force_host_platform_device_count={n_devices}"
+            ).strip()
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+
 __version__ = "0.1.0"

@@ -76,7 +76,13 @@ def build_star_table(seg: ImmutableSegment, config: StarTreeIndexConfig) -> Star
         func, col = p.split("__", 1)
         return f"{func.upper()}__{col}"  # uppercase the FUNC, preserve the column
 
-    pairs = list(dict.fromkeys(_norm(p) for p in config.function_column_pairs))
+    # COUNT__* (Pinot's AggregationFunctionColumnPair.COUNT_STAR) is served by
+    # the always-present __count column; accept and drop it from the pair list.
+    pairs = list(
+        dict.fromkeys(
+            _norm(p) for p in config.function_column_pairs if not _norm(p).startswith("COUNT__")
+        )
+    )
     df = pd.DataFrame({d: seg.columns[d].forward for d in dims})
     needed_cols = {}
     for p in pairs:

@@ -1,21 +1,14 @@
 """Test env: force CPU platform with 8 virtual devices so multi-chip sharding
 paths compile and execute without TPU hardware (SURVEY environment notes).
 
-NOTE: the environment presets JAX_PLATFORMS=axon (the experimental TPU tunnel
-plugin). Overriding that env var to "cpu" HANGS during plugin init, so we must
-(a) remove the env var entirely and (b) select cpu via jax.config — before any
-jax client is created.
+The hang-avoidance recipe for the ambient axon TPU env lives in
+pinot_tpu.force_cpu_backend (see its docstring).
 """
 
-import os
+import pinot_tpu  # noqa: F401  (enables x64, must precede jax use)
 
-os.environ.pop("JAX_PLATFORMS", None)
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+pinot_tpu.force_cpu_backend(n_devices=8)
 
-import pinot_tpu  # noqa: E402,F401  (enables x64, must precede jax use)
 import jax  # noqa: E402
 
-jax.config.update("jax_platforms", "cpu")
 assert jax.default_backend() == "cpu", f"tests must run on cpu, got {jax.default_backend()}"
