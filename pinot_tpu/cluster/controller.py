@@ -131,7 +131,9 @@ class Controller:
         from pinot_tpu.segment.loader import load_segment
 
         schema = self.get_schema(table)
-        mgr = DimensionTableDataManager(table, schema.primary_key_columns if schema else [])
+        mgr = DimensionTableDataManager(
+            table, schema.primary_key_columns if schema else [], schema=schema
+        )
         segs = []
         for _, meta in sorted(self.all_segment_metadata(table).items()):
             if meta.get("location"):

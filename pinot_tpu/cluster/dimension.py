@@ -16,20 +16,37 @@ import numpy as np
 
 
 class DimensionTableDataManager:
-    def __init__(self, table: str, pk_columns: list[str]):
+    def __init__(self, table: str, pk_columns: list[str], schema=None):
         if not pk_columns:
             raise ValueError(f"dimension table {table!r} needs primaryKeyColumns in its schema")
         self.table = table
         self.pk_columns = list(pk_columns)
         self._rows: dict[tuple, dict] = {}
+        # schema-declared string columns: authoritative even before any
+        # segment loads (an all-miss lookup must already return 'null'
+        # strings, not NaNs). Segment loads add to this set as a fallback
+        # when no schema was provided.
+        self._str_cols: set[str] = (
+            {c for c, f in schema.fields.items() if f.data_type.np_dtype == np.dtype(object)}
+            if schema is not None
+            else set()
+        )
         self._lock = threading.Lock()
 
     def load_segments(self, segments) -> None:
         """Full rebuild from the table's current segments (the reference
         reloads the whole map on segment changes too)."""
         rows: dict[tuple, dict] = {}
+        str_cols: set[str] = set()
         for seg in segments:
             cols = {c: ci.materialize() for c, ci in seg.columns.items()}
+            for c, ci in seg.columns.items():
+                dt = getattr(ci, "data_type", None)
+                if dt is not None:
+                    if dt.np_dtype == np.dtype(object):
+                        str_cols.add(c)
+                elif cols[c].dtype.kind in "USO":
+                    str_cols.add(c)
             n = seg.n_docs
             for i in range(n):
                 row = {c: v[i] for c, v in cols.items()}
@@ -37,6 +54,7 @@ class DimensionTableDataManager:
                 rows[pk] = row  # later segments win (refresh semantics)
         with self._lock:
             self._rows = rows
+            self._str_cols |= str_cols
 
     def lookup(self, pk: tuple):
         with self._lock:
@@ -45,10 +63,12 @@ class DimensionTableDataManager:
     def lookup_column(self, dest_column: str, keys: list[tuple]) -> np.ndarray:
         """Misses take the null substitute of the destination's type
         ('null' for strings, NaN for numerics — FieldSpec default-null
-        parity)."""
+        parity). String-ness comes from the dim table's SCHEMA, not from the
+        per-batch hit values, so an all-miss batch on a string column still
+        returns 'null' strings instead of NaNs."""
         with self._lock:
             out = [(self._rows.get(k) or {}).get(dest_column) for k in keys]
-        is_str = any(isinstance(x, str) for x in out)
+            is_str = dest_column in self._str_cols
         if is_str:
             return np.asarray(["null" if x is None else x for x in out], dtype=object)
         return np.asarray([np.nan if x is None else float(x) for x in out], dtype=np.float64)

@@ -266,14 +266,38 @@ class TimeBoundary:
         return _with_time_predicate(sql, f"{self.time_column} > {self.boundary}")
 
 
+def _search_outside_quotes(pattern: str, sql: str, start: int = 0):
+    """re.search that ignores matches inside single-quoted SQL string
+    literals ('' is the escaped quote) — 'WHERE msg = ''over the limit'''
+    must not split at the LIMIT inside the literal."""
+    import re
+
+    masked = list(sql)
+    in_str = False
+    for i, ch in enumerate(sql):
+        if ch == "'":
+            in_str = not in_str  # '' escape toggles twice: net unchanged
+        elif in_str:
+            masked[i] = "\0"
+    return re.search(pattern, "".join(masked[start:]), re.IGNORECASE)
+
+
 def _with_time_predicate(sql: str, predicate: str) -> str:
     """Inject an AND predicate into the (single-table, v1) query text — the
     string-level analog of attaching the time filter to BrokerRequest."""
-    import re
-
-    m = re.search(r"\bWHERE\b", sql, re.IGNORECASE)
+    _TAIL = r"\b(GROUP\s+BY|ORDER\s+BY|LIMIT|HAVING)\b"
+    m = _search_outside_quotes(r"\bWHERE\b", sql)
     if m:
-        return sql[: m.end()] + f" ({predicate}) AND" + sql[m.end() :]
-    tail = re.search(r"\b(GROUP\s+BY|ORDER\s+BY|LIMIT|HAVING)\b", sql, re.IGNORECASE)
+        # Parenthesize the ORIGINAL predicate too: 'a=1 OR b=2' must become
+        # '(boundary) AND (a=1 OR b=2)', otherwise AND binds tighter than OR
+        # and the boundary no longer constrains the OR branch (rows in the
+        # offline/realtime overlap window would be returned by BOTH legs).
+        tail = _search_outside_quotes(_TAIL, sql, m.end())
+        end = m.end() + (tail.start() if tail else len(sql) - m.end())
+        rest = sql[m.end() : end].strip()
+        tail_str = sql[end:].strip()
+        out = sql[: m.end()] + f" ({predicate}) AND ({rest})"
+        return out + (" " + tail_str if tail_str else "")
+    tail = _search_outside_quotes(_TAIL, sql)
     pos = tail.start() if tail else len(sql)
     return sql[:pos].rstrip() + f" WHERE {predicate} " + sql[pos:]

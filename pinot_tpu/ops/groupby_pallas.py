@@ -258,10 +258,24 @@ def _planes_impl(gid, planes, ng: int, r: int):
     )(gid.reshape(1, n_padded), planes)
 
 
+# Byte-plane totals accumulate in int32: a group holding n masked docs can
+# reach 255*n per plane, so n must stay below 2^31/255 (~8.42M) for the
+# accumulator to be exact. Callers must fall back to the two-level XLA path
+# (kernels._exact_int_grouped_sum) beyond this; build_masked_fn flattens ALL
+# local segments into one doc vector, so the bound is easy to exceed.
+SAFE_DOCS = (2**31 - 2**24) // 255
+
+
 def pallas_grouped_multi_sum(values_list, gid, mask, ng: int):
     """Fused lossless group-by reduction: byte-plane sums for every int32
     value array plus the group count, in ONE pallas pass. Returns
-    ([f64 (ng,) sum per input], i64 (ng,) counts)."""
+    ([f64 (ng,) sum per input], i64 (ng,) counts).
+
+    Exactness requires the flat doc count <= SAFE_DOCS (asserted)."""
+    assert gid.shape[0] <= SAFE_DOCS, (
+        f"pallas byte-plane accumulator overflows past {SAFE_DOCS} docs; "
+        "use the XLA two-level path for larger inputs"
+    )
     k = len(values_list)
     gid, _, mask, n_padded = _pad_inputs(gid.astype(jnp.int32), None, mask)
     rows = []

@@ -93,6 +93,11 @@ def build_sharded_table(
             lo, hi = int(fwd.min()), int(fwd.max())
             if np.iinfo(np.int32).min <= lo and hi <= np.iinfo(np.int32).max:
                 fwd = fwd.astype(np.int32)
+                # keep the proto's dtype in sync: plan-time literal range
+                # checks (_raw_compare) consult proto.forward.dtype, and an
+                # i64 literal outside i32 range must be statically decided,
+                # not silently wrapped by the kernel's o.astype(v.dtype)
+                ci.forward = fwd
         stacked = np.zeros((n_seg, pad), dtype=fwd.dtype)
         for s in range(n_seg):
             chunk = fwd[s * rows_per_segment : (s + 1) * rows_per_segment]

@@ -311,7 +311,7 @@ def _grouped_all(aggs, cols, ops, mask, gid, ng):
     (min/max/f64/hll/...) use their per-agg reductions."""
     from pinot_tpu.ops import groupby_pallas as gp
 
-    if gp.pallas_auto():
+    if gp.pallas_auto() and mask.shape[0] <= gp.SAFE_DOCS:
         vals, owner = [], {}
         for i, a in enumerate(aggs):
             if a[0] in ("sum", "avg"):

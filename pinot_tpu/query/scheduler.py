@@ -78,6 +78,11 @@ class QueryScheduler:
     def stop(self) -> None:
         with self._lock:
             self._running = False
+            # drain queued jobs so callers blocked on their Futures unblock
+            # instead of hanging forever (runners only finish in-flight work)
+            for job in self._drain():
+                if not job.future.cancel():
+                    job.future.set_exception(SchedulerRejectedError("scheduler stopped"))
             self._wake.notify_all()
         for t in self._threads:
             t.join(timeout=5)
@@ -104,6 +109,18 @@ class QueryScheduler:
 
     def _on_finish(self, job: _Job, elapsed_s: float) -> None:
         pass
+
+    def _drain(self) -> list["_Job"]:
+        """Remove and return ALL queued jobs (stop-time only). The default
+        loops _dequeue; schedulers whose _dequeue gates on run caps (e.g.
+        binary workload's secondary lane) MUST override with a policy-free
+        drain, or capped jobs would be left queued with waiters hung."""
+        out = []
+        while True:
+            job = self._dequeue()
+            if job is None:
+                return out
+            out.append(job)
 
     # -- runner -------------------------------------------------------------
 
@@ -250,6 +267,12 @@ class BinaryWorkloadScheduler(QueryScheduler):
     def _on_finish(self, job: _Job, elapsed_s: float) -> None:
         if job.workload == "SECONDARY":
             self._secondary_running -= 1
+
+    def _drain(self) -> list[_Job]:
+        out = self._primary + self._secondary
+        self._primary.clear()
+        self._secondary.clear()
+        return out
 
 
 def make_scheduler(kind: str, num_runners: int = 4, **kwargs) -> QueryScheduler:

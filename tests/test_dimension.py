@@ -121,3 +121,41 @@ def test_dim_manager_direct():
     assert m.lookup(("a",))["v"] == 1.5
     out = m.lookup_column("v", [("a",), ("zz",), ("b",)])
     assert out[0] == 1.5 and np.isnan(out[1]) and out[2] == 2.5
+
+
+def test_lookup_column_all_miss_string_stays_string():
+    """String-ness comes from the table schema, not per-batch hit values: an
+    all-miss batch on a string column must return 'null' strings, not NaNs."""
+    m = DimensionTableDataManager("d", ["k"])
+
+    class FakeSeg:
+        n_docs = 2
+
+        class _CI:
+            def __init__(self, vals):
+                self._v = np.asarray(vals)
+
+            def materialize(self):
+                return self._v
+
+        columns = {"k": _CI(["a", "b"]), "name": _CI(["x", "y"])}
+
+    m.load_segments([FakeSeg()])
+    out = m.lookup_column("name", [("zz",), ("zw",)])
+    assert list(out) == ["null", "null"]
+
+
+def test_lookup_column_schema_string_before_any_segment_load():
+    """Schema-declared string columns return 'null' strings on all-miss
+    lookups even when ZERO segments are loaded."""
+    from pinot_tpu.common import DataType, Schema
+
+    schema = Schema.build(
+        "d", dimensions=[("k", DataType.STRING), ("name", DataType.STRING)],
+        metrics=[("v", DataType.DOUBLE)], primary_key_columns=["k"],
+    )
+    m = DimensionTableDataManager("d", ["k"], schema=schema)
+    out = m.lookup_column("name", [("zz",)])
+    assert list(out) == ["null"]
+    out = m.lookup_column("v", [("zz",)])
+    assert np.isnan(out[0])

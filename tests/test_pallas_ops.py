@@ -119,3 +119,31 @@ def test_engine_group_by_with_pallas_path(monkeypatch):
         assert a[0] == b[0] and a[1] == b[1]
         assert a[2] == pytest.approx(b[2], rel=1e-4)  # f32 accumulation
         assert a[3] == b[3] and a[4] == b[4]
+
+
+def test_multi_sum_rejects_overflowing_doc_count():
+    """The byte-plane int32 accumulator is exact only below SAFE_DOCS; the
+    kernel must refuse larger inputs (callers fall back to the XLA path)."""
+    from pinot_tpu.ops import groupby_pallas as gp
+
+    n = gp.SAFE_DOCS + 1
+    gid = np.zeros(n, np.int32)
+    with pytest.raises(AssertionError, match="overflows"):
+        gp.pallas_grouped_multi_sum([], jnp.asarray(gid), jnp.ones(n, bool), 4)
+
+
+def test_grouped_all_falls_back_beyond_safe_docs(monkeypatch):
+    """kernels._grouped_all must route oversized inputs to the XLA path
+    instead of tripping the pallas guard."""
+    from pinot_tpu.ops import groupby_pallas as gp
+    from pinot_tpu.query import kernels as K
+
+    monkeypatch.setattr(gp, "SAFE_DOCS", 16)  # make 'oversized' cheap
+    n, ng = 64, 4
+    gid = jnp.asarray(np.arange(n, dtype=np.int32) % ng)
+    mask = jnp.ones(n, bool)
+    vals = jnp.asarray(np.arange(n, dtype=np.int32))
+    aggs = (("sum", ("raw", "v")),)
+    counts, parts = K._grouped_all(aggs, {"v": vals}, (), mask, gid, ng)
+    truth = np.bincount(np.arange(n) % ng, weights=np.arange(n), minlength=ng)
+    np.testing.assert_allclose(np.asarray(parts[0]), truth)

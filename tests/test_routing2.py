@@ -193,7 +193,7 @@ def test_partitioned_table_prunes_at_broker(tmp_path):
 def test_time_boundary_sql_rewrites():
     tb = TimeBoundary("ts", 100)
     assert tb.offline_sql("SELECT COUNT(*) FROM t WHERE x = 1 LIMIT 5") == (
-        "SELECT COUNT(*) FROM t WHERE (ts <= 100) AND x = 1 LIMIT 5"
+        "SELECT COUNT(*) FROM t WHERE (ts <= 100) AND (x = 1) LIMIT 5"
     )
     assert tb.realtime_sql("SELECT COUNT(*) FROM t GROUP BY k") == (
         "SELECT COUNT(*) FROM t WHERE ts > 100 GROUP BY k"
@@ -297,3 +297,26 @@ def test_rebalance_dry_run_moves_nothing(tmp_path):
     r = rebalance_table(controller, "t", dry_run=True)
     assert r.status == "DONE" and r.adds == [("t_0", "s1")]
     assert set(controller.ideal_state("t")["t_0"]) == {"s0"}  # unchanged
+
+
+def test_time_boundary_parenthesizes_or_predicates():
+    """AND binds tighter than OR: the boundary must wrap the ORIGINAL
+    predicate, or rows in the offline/realtime overlap window matching the
+    OR branch are returned by BOTH legs (double-counted aggregates)."""
+    tb = TimeBoundary("ts", 100)
+    assert tb.offline_sql("SELECT COUNT(*) FROM t WHERE a = 1 OR b = 2") == (
+        "SELECT COUNT(*) FROM t WHERE (ts <= 100) AND (a = 1 OR b = 2)"
+    )
+    assert tb.realtime_sql("SELECT COUNT(*) FROM t WHERE a = 1 OR b = 2 GROUP BY k LIMIT 5") == (
+        "SELECT COUNT(*) FROM t WHERE (ts > 100) AND (a = 1 OR b = 2) GROUP BY k LIMIT 5"
+    )
+
+
+def test_time_boundary_ignores_keywords_in_string_literals():
+    tb = TimeBoundary("ts", 100)
+    assert tb.offline_sql("SELECT COUNT(*) FROM t WHERE msg = 'over the limit'") == (
+        "SELECT COUNT(*) FROM t WHERE (ts <= 100) AND (msg = 'over the limit')"
+    )
+    assert tb.offline_sql("SELECT COUNT(*) FROM t WHERE msg = 'group by order by' LIMIT 3") == (
+        "SELECT COUNT(*) FROM t WHERE (ts <= 100) AND (msg = 'group by order by') LIMIT 3"
+    )

@@ -208,3 +208,66 @@ def test_server_routes_through_scheduler(tmp_path):
         assert "schedulerWait" in res.trace["phaseTimesMs"]
     finally:
         server.shutdown()
+
+
+def test_stop_unblocks_pending_futures():
+    """stop() must drain queued jobs and cancel their futures so waiters
+    don't hang forever (the single runner is busy, so the queued job can
+    only disappear via the stop-time drain)."""
+    from concurrent.futures import CancelledError
+
+    s = FCFSScheduler(num_runners=1)
+    gate = threading.Event()
+    started = threading.Event()
+
+    def block():
+        started.set()
+        gate.wait(5)
+
+    s.start()
+    running = s.submit(block)
+    started.wait(5)
+    pending = s.submit(lambda: 1)  # queued behind the blocker
+    stopper = threading.Thread(target=s.stop)
+    stopper.start()
+    # wait for the drain to cancel the queued job, then release the blocker
+    for _ in range(100):
+        if pending.cancelled():
+            break
+        time.sleep(0.02)
+    gate.set()
+    stopper.join(5)
+    with pytest.raises((CancelledError, SchedulerRejectedError)):
+        pending.result(timeout=5)
+    running.result(timeout=5)  # in-flight work finishes normally
+
+
+def test_binary_workload_stop_drains_capped_secondary_lane():
+    """Secondary jobs beyond the run cap must still be cancelled at stop —
+    the policy-gated _dequeue would leave them queued forever."""
+    from concurrent.futures import CancelledError
+
+    s = BinaryWorkloadScheduler(num_runners=1, secondary_runners=1)
+    gate = threading.Event()
+    started = threading.Event()
+
+    def block():
+        started.set()
+        gate.wait(5)
+
+    s.start()
+    running = s.submit(block, workload="SECONDARY")
+    started.wait(5)
+    pend = [s.submit(lambda: 1, workload="SECONDARY") for _ in range(3)]
+    stopper = threading.Thread(target=s.stop)
+    stopper.start()
+    for _ in range(100):
+        if all(f.cancelled() for f in pend):
+            break
+        time.sleep(0.02)
+    gate.set()
+    stopper.join(5)
+    for f in pend:
+        with pytest.raises((CancelledError, SchedulerRejectedError)):
+            f.result(timeout=5)
+    running.result(timeout=5)

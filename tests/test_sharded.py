@@ -109,3 +109,25 @@ def test_sharded_matches_per_segment_engine(sharded):
     a = execute_sharded_result(table, q)
     b_ = engine.execute(q)
     assert a.rows == b_.rows
+
+
+def test_narrowed_i64_literal_out_of_i32_range():
+    """i64 columns narrowed to i32 on device must narrow the proto too, so a
+    literal outside i32 range is statically decided instead of wrapping
+    (e.g. 'x < 5000000000' must match ALL rows, not wrap to 705032704)."""
+    schema = Schema.build(
+        "t", dimensions=[("k", DataType.STRING)], metrics=[("x", DataType.LONG)]
+    )
+    n = 64
+    data = {
+        "k": np.array(["a", "b"] * (n // 2), dtype=object),
+        "x": np.arange(n, dtype=np.int64) * 1_000_000,  # fits i32 -> narrowed
+    }
+    mesh = make_mesh(jax.devices()[:2])
+    table = build_sharded_table(schema, data, mesh)
+    res = execute_sharded_result(table, "SELECT COUNT(*) FROM t WHERE x < 5000000000")
+    assert res.rows[0][0] == n
+    res = execute_sharded_result(table, "SELECT COUNT(*) FROM t WHERE x > 5000000000")
+    assert res.rows[0][0] == 0
+    res = execute_sharded_result(table, "SELECT COUNT(*) FROM t WHERE x >= -5000000000")
+    assert res.rows[0][0] == n
