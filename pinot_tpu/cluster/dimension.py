@@ -26,11 +26,10 @@ class DimensionTableDataManager:
         # segment loads (an all-miss lookup must already return 'null'
         # strings, not NaNs). Segment loads add to this set as a fallback
         # when no schema was provided.
-        self._str_cols: set[str] = (
-            {c for c, f in schema.fields.items() if f.data_type.np_dtype == np.dtype(object)}
-            if schema is not None
-            else set()
-        )
+        self._schema_str_cols: frozenset[str] = frozenset(
+            c for c, f in schema.fields.items() if f.data_type.np_dtype == np.dtype(object)
+        ) if schema is not None else frozenset()
+        self._str_cols: set[str] = set(self._schema_str_cols)
         self._lock = threading.Lock()
 
     def load_segments(self, segments) -> None:
@@ -54,7 +53,9 @@ class DimensionTableDataManager:
                 rows[pk] = row  # later segments win (refresh semantics)
         with self._lock:
             self._rows = rows
-            self._str_cols |= str_cols
+            # full rebuild: schema-declared string columns plus what THIS
+            # segment set shows (stale dtype observations don't survive)
+            self._str_cols = set(self._schema_str_cols) | str_cols
 
     def lookup(self, pk: tuple):
         with self._lock:

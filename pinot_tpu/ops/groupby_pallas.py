@@ -272,10 +272,11 @@ def pallas_grouped_multi_sum(values_list, gid, mask, ng: int):
     ([f64 (ng,) sum per input], i64 (ng,) counts).
 
     Exactness requires the flat doc count <= SAFE_DOCS (asserted)."""
-    assert gid.shape[0] <= SAFE_DOCS, (
-        f"pallas byte-plane accumulator overflows past {SAFE_DOCS} docs; "
-        "use the XLA two-level path for larger inputs"
-    )
+    if gid.shape[0] > SAFE_DOCS:  # not assert: must survive python -O
+        raise ValueError(
+            f"pallas byte-plane accumulator overflows past {SAFE_DOCS} docs; "
+            "use the XLA two-level path for larger inputs"
+        )
     k = len(values_list)
     gid, _, mask, n_padded = _pad_inputs(gid.astype(jnp.int32), None, mask)
     rows = []

@@ -143,20 +143,21 @@ class QueryEngine:
     def _execute_segment(self, seg: ImmutableSegment, ctx: QueryContext):
         """Returns (partial, matched_docs) for one segment."""
         valid = seg.extras.get("valid_docs")
-        if valid is not None:
-            # upsert table: only latest-per-PK docs are visible; the validity
-            # mask ANDs into the filter (host path; device mask operand later)
-            return self._host_segment(seg, ctx, extra_mask=valid(seg.n_docs))
-        if seg.extras.get("startree"):
+        if seg.extras.get("startree") and valid is None:
+            # star-tree pre-aggregates over ALL docs; unusable under upsert
+            # visibility (invalidated docs are baked into the agg table)
             from pinot_tpu.query import startree_exec
 
             res = startree_exec.try_execute(self, seg, ctx)
             if res is not None:
                 return res
+        vmask = valid(seg.n_docs) if valid is not None else None
         try:
-            plan = plan_segment(seg, ctx)
+            # plan_segment threads valid_docs into the kernel as a docmask
+            # operand, so upsert tables run the fused device path too
+            plan = plan_segment(seg, ctx, valid_mask=vmask)
         except DeviceFallback:
-            return self._host_segment(seg, ctx)
+            return self._host_segment(seg, ctx, extra_mask=vmask)
         out = run_plan(plan, self._device_seg(seg))
         qt = ctx.query_type
         if qt == QueryType.AGGREGATION:

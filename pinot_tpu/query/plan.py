@@ -648,11 +648,28 @@ def _like_to_regex(pattern: str) -> str:
     return "".join(out)
 
 
-def plan_segment(seg: ImmutableSegment, ctx: QueryContext) -> SegmentPlan:
+def plan_segment(seg: ImmutableSegment, ctx: QueryContext, valid_mask=None) -> SegmentPlan:
     """Lower a query against one segment. Raises DeviceFallback when the host
-    executor must take over."""
+    executor must take over. `valid_mask` lets the caller pass an
+    already-materialized upsert validity snapshot (avoids computing the
+    bitmap twice when lowering later falls back to the host path)."""
     lo = _Lowering(seg, ctx)
     fspec = lo.filter_spec(ctx.filter)
+
+    if valid_mask is None:
+        valid = seg.extras.get("valid_docs") if seg.extras else None
+        if valid is not None:
+            valid_mask = valid(seg.n_docs)
+    if valid_mask is not None:
+        # upsert/dedup visibility: only latest-per-PK docs count. The CURRENT
+        # validDocIds bitmap rides as a mask OPERAND (docmask), not a baked-in
+        # constant: operands are runtime inputs, so concurrent ingestion
+        # flipping validity never recompiles the kernel (the spec tuple —
+        # the compile-cache key — is unchanged). Parity:
+        # ConcurrentMapPartitionUpsertMetadataManager validDocIds snapshots
+        # consulted per query by the filter operators.
+        vm = lo.docmask_spec(np.asarray(valid_mask, dtype=bool))
+        fspec = ("and", (vm, fspec))
 
     if ctx.query_type in (QueryType.AGGREGATION, QueryType.GROUP_BY):
         grouped = ctx.query_type == QueryType.GROUP_BY

@@ -128,7 +128,7 @@ def test_multi_sum_rejects_overflowing_doc_count():
 
     n = gp.SAFE_DOCS + 1
     gid = np.zeros(n, np.int32)
-    with pytest.raises(AssertionError, match="overflows"):
+    with pytest.raises(ValueError, match="overflows"):
         gp.pallas_grouped_multi_sum([], jnp.asarray(gid), jnp.ones(n, bool), 4)
 
 

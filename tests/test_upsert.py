@@ -321,3 +321,76 @@ def test_dedup_ttl_amortized_eviction():
     # keys older than max_time - ttl are eventually evicted
     assert d.num_primary_keys < 1000
     assert d.num_primary_keys >= 100
+
+
+# -- device-resident upsert (validDocIds as kernel mask operand) -------------
+
+
+def test_upsert_query_runs_on_device_path(tmp_path, monkeypatch):
+    """Sealed upsert segments must run the fused device kernel (validity as a
+    docmask operand), not the host detour."""
+    from pinot_tpu.query.engine import QueryEngine as QE
+
+    config = TableConfig(
+        "players",
+        table_type=TableType.REALTIME,
+        time_column="ts",
+        upsert=UpsertConfig(mode="FULL"),
+    )
+    controller, server, broker, stream, mgr = _cluster(tmp_path, config)
+    for i in range(50):
+        stream.produce(0, _row(i % 10, f"p{i % 10}", 100 + i, ts=i))
+    mgr.start()
+    try:
+        assert mgr.wait_until_caught_up([50])
+
+        def no_host(self, seg, ctx, extra_mask=None):
+            raise AssertionError("upsert aggregation took the host path")
+
+        monkeypatch.setattr(QE, "_host_segment", no_host)
+        res = broker.execute("SELECT SUM(score) FROM players")
+        assert int(res.rows[0][0]) == sum(range(140, 150))
+        res = broker.execute("SELECT pid, COUNT(*) FROM players GROUP BY pid ORDER BY pid LIMIT 20")
+        assert all(r[1] == 1 for r in res.rows) and len(res.rows) == 10
+    finally:
+        mgr.stop()
+
+
+def test_device_upsert_mask_tracks_concurrent_invalidation():
+    """The validity mask is a runtime operand: flipping validity between
+    queries changes results with the SAME compiled kernel (no respecialize),
+    exactly like a query racing concurrent upsert ingestion."""
+    import numpy as np
+
+    from pinot_tpu.common import DataType, Schema
+    from pinot_tpu.query.engine import QueryEngine
+    from pinot_tpu.query.kernels import get_kernel
+    from pinot_tpu.query.plan import plan_segment
+    from pinot_tpu.segment import SegmentBuilder
+
+    schema = Schema.build(
+        "t", dimensions=[("pid", DataType.INT)], metrics=[("v", DataType.LONG)],
+        primary_key_columns=["pid"],
+    )
+    n = 100
+    data = {
+        "pid": (np.arange(n) % 10).astype(np.int32),
+        "v": np.arange(n, dtype=np.int64),
+    }
+    seg = SegmentBuilder(schema).build(data, "s0")
+    live = np.zeros(n, dtype=bool)
+    live[90:] = True  # latest row per PK
+    seg.extras["valid_docs"] = lambda nd: live[:nd]
+
+    eng = QueryEngine([seg])
+    ctx = eng.make_context("SELECT SUM(v) FROM t")
+    spec0 = plan_segment(seg, ctx).spec
+    before = get_kernel.cache_info().misses
+    assert eng.execute("SELECT SUM(v) FROM t").rows[0][0] == sum(range(90, 100))
+
+    # concurrent upsert flips validity: pid rows 80..89 become the live set
+    live[:] = False
+    live[80:90] = True
+    assert eng.execute("SELECT SUM(v) FROM t").rows[0][0] == sum(range(80, 90))
+    assert plan_segment(seg, ctx).spec == spec0  # same spec -> same kernel
+    assert get_kernel.cache_info().misses <= before + 1  # at most first compile
