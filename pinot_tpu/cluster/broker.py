@@ -12,6 +12,7 @@ the reduce is the shared reduce module.
 from __future__ import annotations
 
 import itertools
+import threading
 import time
 from concurrent.futures import ThreadPoolExecutor
 
@@ -77,6 +78,8 @@ class Broker:
         self.quota = QueryQuotaManager(controller) if enable_quota else None
         self.query_logger = query_logger
         self._pool = ThreadPoolExecutor(max_workers=max_scatter_threads)
+        self._dispatcher = None
+        self._dispatcher_lock = threading.Lock()
 
     def execute(self, sql: str) -> ResultTable:
         from pinot_tpu.common.metrics import BrokerMeter, broker_metrics
@@ -252,16 +255,28 @@ class Broker:
         return partials, scanned, len(candidates), pruned
 
     def _execute_multistage(self, stmt, sql: str) -> ResultTable:
-        """Dispatch to the v2 engine over one replica of each segment.
+        """Dispatch the v2 engine over one replica of each segment.
 
         Reference parity: QueryDispatcher.submitAndReduce
-        (pinot-query-runtime/.../QueryDispatcher.java:128) — the broker builds
-        the catalog from routing state; leaf scans acquire hosted segments."""
-        from pinot_tpu.multistage import MultistageEngine
+        (pinot-query-runtime/.../QueryDispatcher.java:128). Two modes:
+        - all participating servers remote (HTTP): TRUE distributed dispatch —
+          stages run on the server processes, blocks shuffle over the
+          /mailbox transport, broker runs the root stage
+          (multistage/distributed.py).
+        - in-process servers (tests / all-in-one): local engine over acquired
+          segment objects."""
+        from pinot_tpu.common.trace import InvocationScope
+
+        import zlib
 
         servers = self.controller.servers()
-        catalog: dict[str, list] = {}
         schemas: dict[str, list[str]] = {}
+        # table -> server -> [(segment name, deep-store location)]
+        seg_assign: dict[str, dict[str, list]] = {}
+        seg_info: dict[str, list] = {}  # table -> [(name, online sids, location)]
+        table_servers: dict[str, list[str]] = {}
+        participating: set[str] = set()
+        total_docs = 0
         for table in _collect_tables(stmt):
             if self.controller.get_table(table) is None:
                 raise KeyError(f"no such table: {table}")
@@ -269,35 +284,91 @@ class Broker:
             if schema is not None:
                 schemas[table] = list(schema.columns)
             ideal = self.controller.ideal_state(table)
-            segs = []
+            assign: dict[str, list] = {}
+            info: list = []
             for seg_name, replicas in sorted(ideal.items()):
-                online = [sid for sid, st in replicas.items() if st == "ONLINE" and sid in servers]
+                online = sorted(
+                    sid for sid, st in replicas.items() if st == "ONLINE" and sid in servers
+                )
+                if not online:
+                    continue
+                meta = self.controller.segment_metadata(table, seg_name)
+                location = (meta or {}).get("location")
+                info.append((seg_name, online, location))
+                # replica spread must be stable across processes/restarts:
+                # crc32, not hash() (PYTHONHASHSEED-salted)
+                sid = online[zlib.crc32(seg_name.encode()) % len(online)]
+                assign.setdefault(sid, []).append([seg_name, location])
+                total_docs += int((meta or {}).get("numDocs") or 0)
+            seg_assign[table] = assign
+            seg_info[table] = info
+            table_servers[table] = sorted(assign)
+            participating |= set(assign)
+
+        distributed = bool(participating) and all(
+            getattr(servers[sid], "base_url", None) for sid in participating
+        )
+        if distributed:
+            dispatcher = self._multistage_dispatcher()
+            server_urls = {sid: servers[sid].base_url for sid in participating}
+            with InvocationScope("multistage:dispatch", tables=list(seg_assign)) as scope:
+                result = dispatcher.execute(
+                    sql,
+                    stmt,
+                    schemas,
+                    table_servers,
+                    seg_assign,
+                    server_submit=lambda sid, doc: servers[sid].multistage_submit(
+                        {**doc, "target": sid}
+                    ),
+                    server_urls=server_urls,
+                    total_docs=total_docs,
+                )
+                scope.set_attr("numRows", len(result.rows))
+            return result
+
+        from pinot_tpu.multistage import MultistageEngine
+
+        catalog: dict[str, list] = {}
+        for table, info in seg_info.items():
+            segs = []
+            for seg_name, online, location in info:
                 got = None
-                for sid in sorted(online):
+                # try EVERY online replica's object, then the deep store —
+                # one stale replica must not silently drop the segment
+                for sid in online:
                     got = servers[sid].get_segment_object(table, seg_name)
                     if got is not None:
                         break
-                if got is None and online:
-                    # remote servers don't ship objects; leaf stages scan the
-                    # deep-store copy (the segment fetch the reference's leaf
-                    # workers do from their local segment dirs)
-                    meta = self.controller.segment_metadata(table, seg_name)
-                    if meta and meta.get("location"):
-                        from pinot_tpu.segment.loader import load_segment
+                if got is None and location:
+                    from pinot_tpu.segment.loader import load_segment
 
-                        got = load_segment(meta["location"])
-                if got is not None:
-                    segs.append(got)
+                    got = load_segment(location)
+                if got is None:
+                    raise RuntimeError(
+                        f"segment {table}/{seg_name} unavailable on all replicas "
+                        f"{online} and has no deep-store copy"
+                    )
+                segs.append(got)
             catalog[table] = segs
         engine = MultistageEngine(catalog, n_workers=4, schemas=schemas)
-        from pinot_tpu.common.trace import InvocationScope
-
         # v2 operators are not yet individually instrumented; record one
         # dispatch-level span so traced v2 responses are honest about scope
         with InvocationScope("multistage:dispatch", tables=list(catalog)) as scope:
             result = engine.execute(sql, stmt=stmt)
             scope.set_attr("numRows", len(result.rows))
         return result
+
+    def _multistage_dispatcher(self):
+        # double-checked: a lost construction race would leak the loser's
+        # mailbox listener socket + thread for the process lifetime
+        if self._dispatcher is None:
+            with self._dispatcher_lock:
+                if self._dispatcher is None:
+                    from pinot_tpu.multistage.distributed import DistributedDispatcher
+
+                    self._dispatcher = DistributedDispatcher()
+        return self._dispatcher
 
     @staticmethod
     def _expand_star(stmt, schema) -> None:

@@ -89,6 +89,29 @@ class ServerHTTPService:
                 pass
 
             def do_POST(self):
+                if self.path == "/mailbox":
+                    # cross-process multistage shuffle delivery
+                    # (PinotMailbox.open stream analog, mailbox.proto:24-25)
+                    from pinot_tpu.multistage.transport import handle_mailbox_post
+
+                    handle_mailbox_post(svc.server.mailbox_registry, self)
+                    return
+                if self.path == "/multistage/submit":
+                    # distributed stage dispatch (PinotQueryWorker.Submit analog)
+                    n = int(self.headers.get("Content-Length", 0))
+                    try:
+                        body = json.loads(self.rfile.read(n) or b"{}")
+                        svc.server.multistage_submit(body)
+                        payload = b'{"status": "started"}'
+                        self.send_response(200)
+                    except Exception as e:
+                        payload = json.dumps({"error": f"{type(e).__name__}: {e}"}).encode()
+                        self.send_response(500)
+                    self.send_header("Content-Type", "application/json")
+                    self.send_header("Content-Length", str(len(payload)))
+                    self.end_headers()
+                    self.wfile.write(payload)
+                    return
                 if self.path in ("/segments/add", "/segments/remove"):
                     n = int(self.headers.get("Content-Length", 0))
                     body = json.loads(self.rfile.read(n) or b"{}")
@@ -216,8 +239,11 @@ class RemoteServerClient:
 
     def get_segment_object(self, table: str, segment_name: str):
         """Remote servers don't ship segment objects over HTTP; multistage
-        leaf scans fall back to the deep-store copy (broker side)."""
+        leaf scans run ON the server via multistage_submit instead."""
         return None
+
+    def multistage_submit(self, doc: dict) -> None:
+        self._post_json("/multistage/submit", doc)
 
 
 class ControllerHTTPService:

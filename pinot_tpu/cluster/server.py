@@ -104,6 +104,60 @@ class Server:
         with self._lock:
             return self._tables.get(table, {}).get(segment_name)
 
+    # -- distributed multistage ----------------------------------------------
+
+    @property
+    def mailbox_registry(self):
+        """Per-server mailbox registry for cross-process stage shuffle
+        (ReceivingMailbox registry parity)."""
+        with self._lock:
+            reg = getattr(self, "_mailbox_registry", None)
+            if reg is None:
+                from pinot_tpu.multistage.transport import MailboxRegistry
+
+                reg = self._mailbox_registry = MailboxRegistry()
+            return reg
+
+    def multistage_submit(self, body: dict) -> None:
+        """Accept a distributed stage-plan submission (QueryServer.submit
+        parity, worker.proto:24-32): rebuild the plan and run this server's
+        assigned (stage, worker) OpChains on background threads."""
+        from pinot_tpu.multistage.distributed import run_assigned_stages
+
+        placement = {(int(s), int(w)): owner for s, w, owner in body["placement"]}
+        segments: dict[str, list] = {}
+        for table, entries in (body.get("segments") or {}).items():
+            objs = []
+            for entry in entries:
+                name, location = entry if isinstance(entry, (list, tuple)) else (entry, None)
+                got = self.get_segment_object(table, name)
+                if got is None and location:
+                    # stale local state (concurrent remove/reload): scan the
+                    # deep-store copy rather than silently shrinking results
+                    from pinot_tpu.segment.loader import load_segment
+
+                    got = load_segment(location)
+                if got is None:
+                    raise RuntimeError(
+                        f"assigned segment {table}/{name} not hosted here and no "
+                        "deep-store copy available"
+                    )
+                objs.append(got)
+            segments[table] = objs
+        run_assigned_stages(
+            qid=body["query_id"],
+            my_id=body.get("target", self.server_id),
+            sql=body["sql"],
+            schemas=body["schemas"],
+            n_workers=int(body.get("n_workers", 4)),
+            parallelism={int(k): int(v) for k, v in body["parallelism"].items()},
+            placement=placement,
+            addresses=body["addresses"],
+            segments=segments,
+            registry=self.mailbox_registry,
+            receive_timeout=float(body.get("receive_timeout", 60.0)),
+        )
+
     def _engine(self, table: str) -> QueryEngine:
         with self._lock:
             eng = self._engines.get(table)

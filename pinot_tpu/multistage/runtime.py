@@ -55,13 +55,25 @@ class MailboxService:
     def send(self, send_stage: int, recv_stage: int, recv_worker: int, payload) -> None:
         self._q(recv_stage, recv_worker, send_stage).put(payload)
 
+    #: receive deadline; None blocks forever (in-process engine). The
+    #: distributed engine sets one so a dead remote sender fails the query
+    #: instead of hanging the receiving OpChain (GrpcMailbox deadline parity).
+    receive_timeout: float | None = None
+
     def receive_all(self, recv_stage: int, recv_worker: int, send_stage: int, n_senders: int):
         """Drain blocks from n_senders until each sent EOS. Raises on error."""
         q = self._q(recv_stage, recv_worker, send_stage)
         blocks: list[pd.DataFrame] = []
         eos = 0
         while eos < n_senders:
-            item = q.get()
+            try:
+                item = q.get(timeout=self.receive_timeout)
+            except queue.Empty:
+                raise RuntimeError(
+                    f"mailbox receive timed out after {self.receive_timeout}s: stage "
+                    f"{send_stage} -> ({recv_stage}, w{recv_worker}), "
+                    f"{eos}/{n_senders} senders finished"
+                ) from None
             if item is _EOS or (isinstance(item, tuple) and item and item[0] == "__eos__"):
                 eos += 1
             elif isinstance(item, tuple) and item and item[0] == "__err__":
@@ -305,6 +317,10 @@ class RunCtx:
     stages: dict[int, L.Stage]
     segments: dict[str, list]  # table -> segments
     n_senders: dict[int, int]  # stage id -> parallelism
+    # distributed leaf mode: this worker's segment dict already holds ONLY
+    # its share (the server's assigned replicas), so Scan takes all of them
+    # instead of modulo-splitting by worker index
+    scan_local_all: bool = False
 
 
 def _empty_df(n_cols: int) -> pd.DataFrame:
@@ -323,7 +339,7 @@ def exec_node(node: L.Node, ctx: RunCtx) -> pd.DataFrame:
 
     if isinstance(node, L.Scan):
         segs = ctx.segments.get(node.table, [])
-        mine = segs[ctx.worker :: ctx.stage.parallelism]
+        mine = segs if ctx.scan_local_all else segs[ctx.worker :: ctx.stage.parallelism]
         frames = []
         for seg in mine:
             mask = host_exec.filter_mask(seg, node.filter) if node.filter is not None else None
@@ -640,6 +656,36 @@ def _send_output(df: pd.DataFrame, stage: L.Stage, parent_id: int, parent_par: i
         mailbox.send(stage.id, parent_id, w, _EOS)
 
 
+def run_stage_worker(
+    stage: L.Stage,
+    w: int,
+    mailbox: MailboxService,
+    stages: dict[int, L.Stage],
+    segments: dict[str, list],
+    n_senders: dict[int, int],
+    parent_of: dict[int, int],
+    scan_local_all: bool = False,
+    errors: list | None = None,
+) -> None:
+    """Run ONE (stage, worker) OpChain to completion: execute the stage
+    subtree and ship its output (or an error marker) to every parent worker.
+    Shared by the in-process engine and the distributed server runtime."""
+    ctx = RunCtx(stage, w, mailbox, stages, segments, n_senders, scan_local_all=scan_local_all)
+    parent = parent_of[stage.id]
+    parent_par = stages[parent].parallelism
+    try:
+        df = exec_node(stage.root, ctx)
+        _send_output(df, stage, parent, parent_par, mailbox, w)
+    except BaseException as e:  # propagate to receivers
+        if errors is not None:
+            errors.append(e)
+        for pw in range(parent_par):
+            try:
+                mailbox.send(stage.id, parent, pw, ("__err__", repr(e)))
+            except Exception:
+                pass  # receiver's timeout reports the loss
+
+
 class MultistageEngine:
     """In-process v2 engine: plans SQL into stages and runs OpChains on
     threads, leaf stages scanning the catalog's segments.
@@ -700,16 +746,9 @@ class MultistageEngine:
         errors: list[BaseException] = []
 
         def worker_fn(stage: L.Stage, w: int):
-            ctx = RunCtx(stage, w, mailbox, plan.stages, self.catalog, n_senders)
-            parent = parent_of[stage.id]
-            parent_par = plan.stages[parent].parallelism
-            try:
-                df = exec_node(stage.root, ctx)
-                _send_output(df, stage, parent, parent_par, mailbox, w)
-            except BaseException as e:  # propagate to receivers
-                errors.append(e)
-                for pw in range(parent_par):
-                    mailbox.send(stage.id, parent, pw, ("__err__", repr(e)))
+            run_stage_worker(
+                stage, w, mailbox, plan.stages, self.catalog, n_senders, parent_of, errors=errors
+            )
 
         threads = []
         for sid in sorted(plan.stages):

@@ -1,0 +1,185 @@
+"""Cross-process mailbox transport for the multistage (v2) engine.
+
+Reference parity: GrpcSendingMailbox / ReceivingMailbox + the PinotMailbox
+bidi stream (pinot-common/src/main/proto/mailbox.proto:24-25,
+pinot-query-runtime/.../mailbox/GrpcSendingMailbox.java:42). The TPU build's
+DCN tier is HTTP (cluster/http.py is the Netty analog), so stage-to-stage
+blocks travel as DataTable-encoded payloads POSTed to the receiving process's
+/mailbox endpoint; same-process pairs short-circuit through the in-memory
+queues exactly like InMemorySendingMailbox.
+
+Envelope format (one POST per block):
+    4-byte little-endian header length | JSON header | body bytes
+    header: {"qid", "rs", "rw", "ss", "kind": "block"|"eos"|"err", "msg"?}
+    body:   datatable.encode(DataFrame) for kind=block, empty otherwise
+"""
+
+from __future__ import annotations
+
+import json
+import struct
+import threading
+import time
+import urllib.request
+
+import pandas as pd
+
+from pinot_tpu.common import datatable
+from pinot_tpu.multistage import runtime as R
+
+
+def encode_envelope(qid: str, rs: int, rw: int, ss: int, payload) -> bytes:
+    """payload: DataFrame | runtime._EOS | ("__err__", msg)."""
+    if isinstance(payload, pd.DataFrame):
+        header = {"qid": qid, "rs": rs, "rw": rw, "ss": ss, "kind": "block"}
+        body = datatable.encode(payload)
+    elif isinstance(payload, tuple) and payload and payload[0] == "__err__":
+        header = {"qid": qid, "rs": rs, "rw": rw, "ss": ss, "kind": "err", "msg": str(payload[1])}
+        body = b""
+    else:  # EOS
+        header = {"qid": qid, "rs": rs, "rw": rw, "ss": ss, "kind": "eos"}
+        body = b""
+    hb = json.dumps(header).encode()
+    return struct.pack("<I", len(hb)) + hb + body
+
+
+def decode_envelope(data: bytes):
+    """-> (header dict, payload as used by MailboxService queues)."""
+    (hlen,) = struct.unpack_from("<I", data, 0)
+    header = json.loads(data[4 : 4 + hlen].decode())
+    kind = header["kind"]
+    if kind == "block":
+        df = datatable.decode(data[4 + hlen :])
+        # wire format stringifies column labels; runtime blocks use
+        # positional ints
+        df.columns = range(len(df.columns))
+        payload = df
+    elif kind == "err":
+        payload = ("__err__", header.get("msg", "remote stage failed"))
+    else:
+        payload = R._EOS
+    return header, payload
+
+
+class MailboxRegistry:
+    """Per-process registry: query id -> DistributedMailbox. Entries are
+    created on first touch (blocks may arrive before the local workers
+    start) and expire after `ttl_s` to bound leakage from abandoned
+    queries."""
+
+    def __init__(self, ttl_s: float = 600.0):
+        self._boxes: dict[str, tuple[float, "DistributedMailbox"]] = {}
+        self._lock = threading.Lock()
+        self._ttl = ttl_s
+
+    def get(self, qid: str) -> "DistributedMailbox":
+        now = time.monotonic()
+        with self._lock:
+            for k in [k for k, (t, _) in self._boxes.items() if now - t > self._ttl]:
+                if k != qid:
+                    del self._boxes[k]
+            ent = self._boxes.get(qid)
+            if ent is None:
+                ent = (now, DistributedMailbox())
+            # refresh the timestamp on every touch: the TTL bounds ABANDONED
+            # queries only — an actively streaming query must never lose its
+            # mailbox mid-flight to creation-time eviction
+            self._boxes[qid] = (now, ent[1])
+            return ent[1]
+
+    def close(self, qid: str) -> None:
+        with self._lock:
+            self._boxes.pop(qid, None)
+
+    def deliver(self, data: bytes) -> None:
+        """HTTP-handler entry: route one envelope into the right mailbox."""
+        header, payload = decode_envelope(data)
+        box = self.get(header["qid"])
+        box.deliver_local(header["rs"], header["rw"], header["ss"], payload)
+
+
+class DistributedMailbox(R.MailboxService):
+    """MailboxService whose send() routes by worker placement: local
+    (stage, worker) pairs use the in-process queues, remote pairs POST the
+    DataTable envelope to the owner's /mailbox endpoint."""
+
+    def __init__(self):
+        super().__init__()
+        self.qid: str = ""
+        self.my_id: str = ""
+        self.placement: dict[tuple[int, int], str] = {}  # (stage, worker) -> participant
+        self.addresses: dict[str, str] = {}  # participant -> base URL
+        self.timeout: float = 30.0
+
+    def configure(self, qid, my_id, placement, addresses, timeout=30.0) -> None:
+        self.qid, self.my_id = qid, my_id
+        self.placement, self.addresses = dict(placement), dict(addresses)
+        self.timeout = timeout
+
+    def deliver_local(self, rs: int, rw: int, ss: int, payload) -> None:
+        super().send(ss, rs, rw, payload)
+
+    def send(self, send_stage: int, recv_stage: int, recv_worker: int, payload) -> None:
+        owner = self.placement.get((recv_stage, recv_worker), self.my_id)
+        if owner == self.my_id:
+            super().send(send_stage, recv_stage, recv_worker, payload)
+            return
+        data = encode_envelope(self.qid, recv_stage, recv_worker, send_stage, payload)
+        url = self.addresses[owner].rstrip("/") + "/mailbox"
+        req = urllib.request.Request(
+            url, data=data, headers={"Content-Type": "application/x-pinot-mailbox"}
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+                resp.read()
+        except Exception as e:
+            raise RuntimeError(f"mailbox send to {owner} ({url}) failed: {e}") from None
+
+
+def handle_mailbox_post(registry: MailboxRegistry, handler) -> None:
+    """Shared /mailbox POST handling for every participant's HTTP service
+    (ServerHTTPService and MailboxHTTPService): read the envelope, deliver,
+    answer 200 'ok' or a 500 JSON error."""
+    n = int(handler.headers.get("Content-Length", 0))
+    try:
+        registry.deliver(handler.rfile.read(n))
+        handler.send_response(200)
+        handler.send_header("Content-Length", "2")
+        handler.end_headers()
+        handler.wfile.write(b"ok")
+    except Exception as e:
+        msg = json.dumps({"error": f"{type(e).__name__}: {e}"}).encode()
+        handler.send_response(500)
+        handler.send_header("Content-Length", str(len(msg)))
+        handler.end_headers()
+        handler.wfile.write(msg)
+
+
+class MailboxHTTPService:
+    """Standalone /mailbox listener for participants without a server HTTP
+    service (the broker's root stage). Servers reuse their existing
+    ServerHTTPService port instead."""
+
+    def __init__(self, registry: MailboxRegistry, port: int = 0):
+        from http.server import BaseHTTPRequestHandler
+
+        from pinot_tpu.cluster.http import _serve
+
+        reg = registry
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def do_POST(self):
+                if self.path != "/mailbox":
+                    self.send_error(404)
+                    return
+                handle_mailbox_post(reg, self)
+
+        self.registry = registry
+        self.httpd, self.port, self._thread = _serve(Handler, port)
+        self.url = f"http://127.0.0.1:{self.port}"
+
+    def stop(self):
+        self.httpd.shutdown()

@@ -1,0 +1,144 @@
+"""Cross-process multistage: stages on HTTP servers, shuffle via /mailbox.
+
+Reference test model: pinot-query-runtime QueryRunnerTestBase dispatching
+real gRPC/mailbox traffic between in-JVM workers (SURVEY.md §4 tier 3) —
+here the workers are real HTTP server endpoints on localhost sockets, so
+every stage-to-stage block crosses a real socket boundary.
+"""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from pinot_tpu.cluster import Broker, Controller, PropertyStore, Server
+from pinot_tpu.cluster.http import RemoteServerClient, ServerHTTPService
+from pinot_tpu.common import DataType, Schema, TableConfig
+from pinot_tpu.segment import SegmentBuilder
+
+
+@pytest.fixture(scope="module")
+def dist_cluster(tmp_path_factory):
+    root = tmp_path_factory.mktemp("msdist")
+    controller = Controller(PropertyStore(), root / "deepstore")
+    inner = {f"server_{i}": Server(f"server_{i}") for i in range(2)}
+    services = {sid: ServerHTTPService(s, port=0) for sid, s in inner.items()}
+    clients = {
+        sid: RemoteServerClient(f"http://127.0.0.1:{svc.port}") for sid, svc in services.items()
+    }
+    for sid, client in clients.items():
+        controller.register_server(sid, client)
+
+    rng = np.random.default_rng(7)
+    n_orders, n_cust = 4000, 50
+    orders_schema = Schema.build(
+        "orders",
+        dimensions=[("ocid", DataType.INT), ("status", DataType.STRING)],
+        metrics=[("amount", DataType.LONG)],
+    )
+    cust_schema = Schema.build(
+        "customers",
+        dimensions=[("cid", DataType.INT), ("cnation", DataType.STRING)],
+        metrics=[("credit", DataType.LONG)],
+    )
+    controller.add_schema(orders_schema)
+    controller.add_schema(cust_schema)
+    controller.add_table(TableConfig("orders", replication=1))
+    controller.add_table(TableConfig("customers", replication=1))
+
+    odata = {
+        "ocid": rng.integers(0, n_cust, n_orders).astype(np.int32),
+        "status": np.array(["OPEN", "SHIPPED", "CLOSED"], dtype=object)[
+            rng.integers(0, 3, n_orders)
+        ],
+        "amount": rng.integers(1, 10_000, n_orders).astype(np.int64),
+    }
+    cdata = {
+        "cid": np.arange(n_cust, dtype=np.int32),
+        "cnation": np.array([f"N{i % 7}" for i in range(n_cust)], dtype=object),
+        "credit": rng.integers(0, 100_000, n_cust).astype(np.int64),
+    }
+    ob = SegmentBuilder(orders_schema)
+    for i in range(4):  # spread across both servers
+        part = {k: v[i * 1000 : (i + 1) * 1000] for k, v in odata.items()}
+        controller.upload_segment("orders", ob.build(part, f"orders_{i}"))
+    controller.upload_segment("customers", SegmentBuilder(cust_schema).build(cdata, "customers_0"))
+
+    ot = pd.DataFrame({k: (v.astype(str) if v.dtype == object else v) for k, v in odata.items()})
+    ct = pd.DataFrame({k: (v.astype(str) if v.dtype == object else v) for k, v in cdata.items()})
+    broker = Broker(controller)
+    yield controller, broker, inner, ot, ct
+    for svc in services.values():
+        svc.stop()
+    if getattr(broker, "_dispatcher", None) is not None:
+        broker._dispatcher.stop()
+
+
+def test_segments_span_both_servers(dist_cluster):
+    _, _, inner, _, _ = dist_cluster
+    hosted = {sid: s.segments_of("orders") for sid, s in inner.items()}
+    assert all(hosted.values()), f"orders segments must span both servers: {hosted}"
+
+
+def test_distributed_join_with_hash_exchange(dist_cluster):
+    """The headline: a JOIN whose hash exchange crosses server boundaries
+    (every block POSTs through /mailbox), reduced at the broker root stage."""
+    _, broker, _, ot, ct = dist_cluster
+    res = broker.execute(
+        "SELECT c.cnation, SUM(o.amount) FROM orders o JOIN customers c ON o.ocid = c.cid "
+        "GROUP BY c.cnation ORDER BY c.cnation LIMIT 20"
+    )
+    truth = (
+        ot.merge(ct, left_on="ocid", right_on="cid")
+        .groupby("cnation")
+        .amount.sum()
+        .sort_index()
+    )
+    assert [r[0] for r in res.rows] == list(truth.index)
+    assert [r[1] for r in res.rows] == [float(v) for v in truth.to_numpy()]
+    # the DISTRIBUTED path must have run (not the in-process fallback)
+    assert getattr(broker, "_dispatcher", None) is not None
+
+
+def test_distributed_single_table_groupby(dist_cluster):
+    _, broker, _, ot, _ = dist_cluster
+    res = broker.execute(
+        "SET useMultistageEngine=true; "
+        "SELECT status, COUNT(*) FROM orders GROUP BY status ORDER BY status LIMIT 10"
+    )
+    truth = ot.groupby("status").size().sort_index()
+    assert [(r[0], r[1]) for r in res.rows] == [(k, v) for k, v in truth.items()]
+
+
+def test_distributed_join_filter_pushdown(dist_cluster):
+    _, broker, _, ot, ct = dist_cluster
+    res = broker.execute(
+        "SELECT COUNT(*) FROM orders o JOIN customers c ON o.ocid = c.cid "
+        "WHERE o.status = 'OPEN' AND c.credit > 50000"
+    )
+    truth = len(
+        ot[ot.status == "OPEN"].merge(ct[ct.credit > 50000], left_on="ocid", right_on="cid")
+    )
+    assert res.rows[0][0] == truth
+
+
+def test_envelope_roundtrip():
+    from pinot_tpu.multistage import runtime as R
+    from pinot_tpu.multistage.transport import decode_envelope, encode_envelope
+
+    df = pd.DataFrame({0: np.arange(5, dtype=np.int64), 1: ["a", "b", "c", "d", "e"]})
+    h, out = decode_envelope(encode_envelope("q1", 2, 1, 3, df))
+    assert (h["rs"], h["rw"], h["ss"]) == (2, 1, 3)
+    pd.testing.assert_frame_equal(out, df)
+    h, out = decode_envelope(encode_envelope("q1", 0, 0, 1, R._EOS))
+    assert out is R._EOS or out == R._EOS
+    h, out = decode_envelope(encode_envelope("q1", 0, 0, 1, ("__err__", "boom")))
+    assert out == ("__err__", "boom")
+
+
+def test_mailbox_receive_timeout():
+    from pinot_tpu.multistage.transport import DistributedMailbox
+
+    box = DistributedMailbox()
+    box.receive_timeout = 0.2
+    with pytest.raises(RuntimeError, match="timed out"):
+        box.receive_all(1, 0, 2, n_senders=1)
