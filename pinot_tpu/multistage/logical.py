@@ -230,6 +230,14 @@ def _idents_expr(e: ast.Expr, out: set[str]) -> None:
     elif isinstance(e, ast.FunctionCall):
         for a in e.args:
             _idents_expr(a, out)
+        if e.filter is not None:
+            _idents_filter(e.filter, out)
+    elif isinstance(e, ast.CaseWhen):
+        for cond, val in e.whens:
+            _idents_filter(cond, out)
+            _idents_expr(val, out)
+        if e.else_ is not None:
+            _idents_expr(e.else_, out)
     elif isinstance(e, ast.BinaryOp):
         _idents_expr(e.left, out)
         _idents_expr(e.right, out)
@@ -358,9 +366,15 @@ def _strip_qualifiers(f, scan: Scan):
         if isinstance(e, ast.Identifier):
             return ast.Identifier(scan.fields[resolve(scan.fields, e.name)].name)
         if isinstance(e, ast.FunctionCall):
-            return ast.FunctionCall(e.name, tuple(fix_e(a) for a in e.args), e.distinct)
+            f = fix_f(e.filter) if e.filter is not None else None
+            return ast.FunctionCall(e.name, tuple(fix_e(a) for a in e.args), e.distinct, f)
         if isinstance(e, ast.BinaryOp):
             return ast.BinaryOp(e.op, fix_e(e.left), fix_e(e.right))
+        if isinstance(e, ast.CaseWhen):
+            return ast.CaseWhen(
+                tuple((fix_f(c), fix_e(v)) for c, v in e.whens),
+                fix_e(e.else_) if e.else_ is not None else None,
+            )
         return e
 
     def fix_f(x):
@@ -510,7 +524,9 @@ class PlanBuilder:
                 wnames.append(name)
                 return ast.Identifier(name)
             if isinstance(e, ast.FunctionCall):
-                return ast.FunctionCall(e.name, tuple(strip_windows(a) for a in e.args), e.distinct)
+                return ast.FunctionCall(
+                    e.name, tuple(strip_windows(a) for a in e.args), e.distinct, e.filter
+                )
             if isinstance(e, ast.BinaryOp):
                 return ast.BinaryOp(e.op, strip_windows(e.left), strip_windows(e.right))
             return e

@@ -116,6 +116,19 @@ def eval_expr(expr: ast.Expr, fields: list[L.Field], df: pd.DataFrame) -> pd.Ser
         if expr.op == "%":
             return l % r
         raise L.PlanV2Error(f"unknown operator {expr.op}")
+    if isinstance(expr, ast.CaseWhen):
+        n = len(df)
+        conds = [np.asarray(eval_filter(c, fields, df), bool) for c, _ in expr.whens]
+        vals = [np.asarray(eval_expr(v, fields, df)) for _, v in expr.whens]
+        if expr.else_ is not None:
+            default = np.asarray(eval_expr(expr.else_, fields, df))
+        else:
+            is_str = any(v.dtype == object or v.dtype.kind in "US" for v in vals)
+            default = np.full(n, "null" if is_str else 0, dtype=object if is_str else np.float64)
+        if any(v.dtype == object or v.dtype.kind in "US" for v in vals):
+            vals = [v.astype(object) for v in vals]
+            default = default.astype(object)
+        return pd.Series(np.select(conds, vals, default=default))
     if isinstance(expr, ast.FunctionCall):
         from pinot_tpu.query.transforms import DEVICE_FUNCS, STRING_FUNCS, apply_string_func
 
@@ -436,6 +449,9 @@ def exec_node(node: L.Node, ctx: RunCtx) -> pd.DataFrame:
     raise L.PlanV2Error(f"cannot execute node {type(node).__name__}")
 
 
+_FILTERED_AGGS = {"count", "sum", "min", "max", "avg"}
+
+
 def _exec_aggregate(node: L.Aggregate, ctx: RunCtx) -> pd.DataFrame:
     df = exec_node(node.input, ctx)
     infields = node.input.fields
@@ -443,8 +459,11 @@ def _exec_aggregate(node: L.Aggregate, ctx: RunCtx) -> pd.DataFrame:
     if n_groups == 0:
         row = []
         for a in node.aggs:
-            s = eval_expr(a.arg, infields, df) if a.arg is not None else pd.Series(np.zeros(len(df)))
-            s2 = eval_expr(a.arg2, infields, df) if a.arg2 is not None else None
+            sub = df
+            if a.filter is not None and len(df):
+                sub = df[np.asarray(eval_filter(a.filter, infields, df), bool)]
+            s = eval_expr(a.arg, infields, sub) if a.arg is not None else pd.Series(np.zeros(len(sub)))
+            s2 = eval_expr(a.arg2, infields, sub) if a.arg2 is not None else None
             row.append(_agg_scalar(a.func, s, a.extra, s2))
         return pd.DataFrame({i: [v] for i, v in enumerate(row)})
     if df.empty:
@@ -453,16 +472,30 @@ def _exec_aggregate(node: L.Aggregate, ctx: RunCtx) -> pd.DataFrame:
     for i, g in enumerate(node.group_exprs):
         work[f"g{i}"] = eval_expr(g, infields, df).reset_index(drop=True)
     for j, a in enumerate(node.aggs):
+        fm = None
+        if a.filter is not None:
+            if a.func not in _FILTERED_AGGS:
+                raise L.PlanV2Error(f"FILTER(WHERE) on {a.func} inside GROUP BY is not supported")
+            fm = np.asarray(eval_filter(a.filter, infields, df), bool)
         if a.arg is not None:
-            work[f"v{j}"] = eval_expr(a.arg, infields, df).reset_index(drop=True)
+            v = eval_expr(a.arg, infields, df).reset_index(drop=True)
+            if fm is not None:
+                # excluded rows -> NaN; pandas reducers skip them
+                v = pd.Series(np.where(fm, v.to_numpy(np.float64), np.nan))
+            work[f"v{j}"] = v
+        elif fm is not None:
+            work[f"v{j}"] = pd.Series(fm.astype(np.int64))  # COUNT indicator
         if a.arg2 is not None:
             work[f"w{j}"] = eval_expr(a.arg2, infields, df).reset_index(drop=True)
     wdf = pd.DataFrame(work)
     gb = wdf.groupby([f"g{i}" for i in range(n_groups)], dropna=False, sort=False)
     outs = []
     for j, a in enumerate(node.aggs):
-        col = f"v{j}" if a.arg is not None else None
+        col = f"v{j}" if f"v{j}" in work else None
         col2 = f"w{j}" if a.arg2 is not None else None
+        if a.filter is not None and a.func == "count":
+            outs.append(gb[col].sum().rename(f"a{j}"))
+            continue
         outs.append(_agg_series(a.func, gb, col, a.extra, col2).rename(f"a{j}"))
     if outs:
         res = pd.concat(outs, axis=1).reset_index()

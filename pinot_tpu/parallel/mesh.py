@@ -151,6 +151,9 @@ def _combine_tree(spec: tuple, matched, counts, parts, axis_name: str | None, lo
     out_parts = []
     for a, p in zip(aggs, parts):
         kind = a[0]
+        while kind == "masked":  # FILTER(WHERE) wrapper: combine by inner kind
+            a = a[2]
+            kind = a[0]
         if kind in ("count", "sum", "avg"):
             out_parts.append(jax.tree.map(red_sum, p))
         elif kind == "min":

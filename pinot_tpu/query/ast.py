@@ -47,10 +47,34 @@ class FunctionCall(Expr):
     name: str  # canonical lower-case
     args: tuple[Expr, ...]
     distinct: bool = False
+    # FILTER (WHERE ...) on an aggregation call
+    # (parity: FilteredAggregationFunction,
+    #  pinot-core/.../aggregation/function/FilteredAggregationFunction.java)
+    filter: "FilterExpr | None" = None
 
     def __str__(self) -> str:
         d = "DISTINCT " if self.distinct else ""
-        return f"{self.name}({d}{','.join(map(str, self.args))})"
+        base = f"{self.name}({d}{','.join(map(str, self.args))})"
+        if self.filter is not None:
+            base += f" FILTER(WHERE {self.filter})"
+        return base
+
+
+@dataclass(frozen=True)
+class CaseWhen(Expr):
+    """Searched CASE (parity: CaseTransformFunction,
+    pinot-core/.../operator/transform/function/CaseTransformFunction.java).
+    Simple CASE (`CASE x WHEN v ...`) is desugared to equality compares at
+    parse time. A missing ELSE takes the type's default value (Pinot's
+    null-handling-disabled behavior: 0 for numerics, 'null' for strings)."""
+
+    whens: tuple  # ((FilterExpr, Expr), ...)
+    else_: "Expr | None" = None
+
+    def __str__(self) -> str:
+        parts = " ".join(f"WHEN {c} THEN {v}" for c, v in self.whens)
+        e = f" ELSE {self.else_}" if self.else_ is not None else ""
+        return f"CASE {parts}{e} END"
 
 
 @dataclass(frozen=True)

@@ -92,7 +92,11 @@ def canonical(expr: Expr) -> str:
     function names with raw args, e.g. `sum(runs)`, `count(*)`)."""
     if isinstance(expr, FunctionCall):
         d = "distinct " if expr.distinct else ""
-        return f"{expr.name}({d}{','.join(canonical(a) for a in expr.args)})"
+        base = f"{expr.name}({d}{','.join(canonical(a) for a in expr.args)})"
+        if expr.filter is not None:
+            # two aggs differing only in FILTER must not merge by name
+            base += f" filter(where {expr.filter})"
+        return base
     if isinstance(expr, Star):
         return "*"
     if isinstance(expr, Identifier):
@@ -110,6 +114,9 @@ class AggregationInfo:
     name: str  # canonical output name
     extra: tuple = ()  # literal args beyond the column (e.g. percentile rank)
     arg2: Expr | None = None  # second value expression (covar, firstwithtime)
+    # FILTER (WHERE ...) clause (FilteredAggregationFunction parity): the
+    # aggregation sees only docs matching BOTH the query filter and this
+    filter: object | None = None
 
     def __str__(self) -> str:
         return self.name
@@ -148,7 +155,7 @@ def _extract_aggs(expr: Expr, out: dict[str, AggregationInfo]) -> bool:
                     arg2 = expr.args[1]
                     # trailing literal args (e.g. firstwithtime dataType) -> extra
                     extra = tuple(a.value for a in expr.args[2:] if isinstance(a, Literal))
-            out.setdefault(name, AggregationInfo(func, arg, name, extra, arg2))
+            out.setdefault(name, AggregationInfo(func, arg, name, extra, arg2, expr.filter))
             return True
         # transform function: recurse into args
         found = False
@@ -186,9 +193,20 @@ def _collect_identifiers(expr: Expr, out: set[str]) -> None:
     elif isinstance(expr, FunctionCall):
         for a in expr.args:
             _collect_identifiers(a, out)
+        if expr.filter is not None:
+            _collect_filter_identifiers(expr.filter, out)
     elif isinstance(expr, BinaryOp):
         _collect_identifiers(expr.left, out)
         _collect_identifiers(expr.right, out)
+    else:
+        from pinot_tpu.query.ast import CaseWhen
+
+        if isinstance(expr, CaseWhen):
+            for cond, val in expr.whens:
+                _collect_filter_identifiers(cond, out)
+                _collect_identifiers(val, out)
+            if expr.else_ is not None:
+                _collect_identifiers(expr.else_, out)
 
 
 def _collect_filter_identifiers(f: FilterExpr | None, out: set[str]) -> None:
@@ -232,6 +250,22 @@ def expand_star(stmt: SelectStatement, schema) -> None:
     stmt.select_list = new_items
 
 
+@dataclass(frozen=True)
+class GapfillSpec:
+    """Broker-side gap filling for time-bucketed results (simplified
+    GapfillProcessor parity, pinot-core/.../reduce/GapfillProcessor.java):
+    `GAPFILL(time_expr, start, end, step [, FILL(col, 'MODE')...])` in the
+    SELECT list emits one row per [start, end) step bucket, synthesizing
+    missing buckets. Modes: FILL_PREVIOUS_VALUE, FILL_DEFAULT_VALUE
+    (0 / 'null'), default null. Times are numeric epoch buckets."""
+
+    col_index: int
+    start: float
+    end: float
+    step: float
+    fills: dict[int, str]  # select-column index -> fill mode
+
+
 @dataclass
 class QueryContext:
     statement: SelectStatement
@@ -249,6 +283,7 @@ class QueryContext:
     # engine-computed cross-segment planning hints (e.g. global min/max bounds
     # for histogram-based percentile sketches)
     hints: dict = field(default_factory=dict)
+    gapfill: "GapfillSpec | None" = None
 
     @property
     def columns_used(self) -> set[str]:
@@ -272,6 +307,7 @@ class QueryContext:
 
     @staticmethod
     def from_statement(stmt: SelectStatement) -> "QueryContext":
+        gapfill = _extract_gapfill(stmt)
         aggs: dict[str, AggregationInfo] = {}
         has_agg = False
         for item in stmt.select_list:
@@ -308,4 +344,5 @@ class QueryContext:
             limit=limit,
             offset=stmt.offset,
             options=dict(stmt.options),
+            gapfill=gapfill,
         )

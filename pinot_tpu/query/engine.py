@@ -198,6 +198,8 @@ class QueryEngine:
     def _convert_agg(self, seg, ctx, plan: SegmentPlan, parts) -> list:
         out = []
         for a, spec_entry, p in zip(ctx.aggregations, plan.spec[3], parts):
+            while spec_entry[0] == "masked":  # FILTER(WHERE) wrapper
+                spec_entry = spec_entry[2]
             if a.func == "count":
                 out.append(int(p))
             elif a.func in ("distinctcount", "distinctcountbitmap"):

@@ -53,6 +53,23 @@ def eval_value(seg: ImmutableSegment, expr: ast.Expr) -> np.ndarray:
             return l.astype(np.float64) / r.astype(np.float64)
         if expr.op == "%":
             return np.mod(l, r)
+    if isinstance(expr, ast.CaseWhen):
+        conds = [filter_mask(seg, c) for c, _ in expr.whens]
+        vals = [np.asarray(eval_value(seg, v)) for _, v in expr.whens]
+        n = seg.n_docs
+        vals = [np.broadcast_to(v, (n,)) if v.ndim == 0 else v for v in vals]
+        if expr.else_ is not None:
+            default = np.asarray(eval_value(seg, expr.else_))
+            default = np.broadcast_to(default, (n,)) if default.ndim == 0 else default
+        else:
+            # null-handling-disabled default (CaseTransformFunction parity):
+            # 0 for numeric branches, 'null' for string branches
+            is_str = any(v.dtype == object or v.dtype.kind in "US" for v in vals)
+            default = np.full(n, "null" if is_str else 0, dtype=object if is_str else np.float64)
+        if any(v.dtype == object or v.dtype.kind in "US" for v in vals):
+            vals = [v.astype(object) for v in vals]
+            default = default.astype(object)
+        return np.select(conds, vals, default=default)
     if isinstance(expr, ast.FunctionCall):
         from pinot_tpu.query.transforms import DEVICE_FUNCS, STRING_FUNCS, apply_string_func
 
@@ -248,11 +265,13 @@ def predicate_function_mask(seg: ImmutableSegment, f: "ast.PredicateFunction") -
 # ---------------------------------------------------------------------------
 
 
-def agg_partials(seg: ImmutableSegment, ctx: QueryContext, mask: np.ndarray) -> list:
+def agg_partials(seg: ImmutableSegment, ctx: QueryContext, query_mask: np.ndarray) -> list:
     from pinot_tpu.query.aggregates import EXT_AGGS
 
     out = []
     for a in ctx.aggregations:
+        # FILTER (WHERE ...) intersects into the query mask per aggregation
+        mask = query_mask if a.filter is None else (query_mask & filter_mask(seg, a.filter))
         if a.func == "count":
             out.append(int(mask.sum()))
             continue
@@ -322,10 +341,19 @@ def group_frame(seg: ImmutableSegment, ctx: QueryContext, mask: np.ndarray) -> p
     for i, g in enumerate(ctx.group_by):
         v = eval_value(seg, g)[mask]
         data[f"k{i}"] = v.astype(str) if v.dtype == object else v
+    filtered_ok = {"count", "sum", "min", "max", "avg", "minmaxrange"}
     for i, a in enumerate(ctx.aggregations):
+        if a.filter is not None:
+            if a.func not in filtered_ok:
+                raise PlanError(f"FILTER(WHERE) on {a.func} inside GROUP BY is not supported")
+            data[f"f{i}"] = filter_mask(seg, a.filter)[mask]
         if a.func == "count":
             continue
         v = eval_value(seg, a.arg)[mask]
+        if a.filter is not None:
+            # excluded docs become NaN; pandas reducers skip them and the
+            # empty-group defaults are patched to match the device kernel
+            v = np.where(data[f"f{i}"], v.astype(np.float64), np.nan)
         data[f"v{i}"] = v
         if a.arg2 is not None:
             data[f"w{i}"] = eval_value(seg, a.arg2)[mask]
@@ -340,20 +368,28 @@ def group_frame(seg: ImmutableSegment, ctx: QueryContext, mask: np.ndarray) -> p
     g = df.groupby(key_cols, sort=False, dropna=False)
     out = g.size().rename("__size").reset_index()
     for i, a in enumerate(ctx.aggregations):
+        filtered = a.filter is not None
         if a.func == "count":
-            out[f"a{i}p0"] = out["__size"]
+            out[f"a{i}p0"] = g[f"f{i}"].sum().values if filtered else out["__size"]
         elif a.func == "sum":
-            out[f"a{i}p0"] = g[f"v{i}"].sum().values.astype(np.float64)
+            out[f"a{i}p0"] = np.nan_to_num(g[f"v{i}"].sum().values.astype(np.float64))
         elif a.func == "min":
-            out[f"a{i}p0"] = g[f"v{i}"].min().values.astype(np.float64)
+            v = g[f"v{i}"].min().values.astype(np.float64)
+            out[f"a{i}p0"] = np.where(np.isnan(v), np.inf, v) if filtered else v
         elif a.func == "max":
-            out[f"a{i}p0"] = g[f"v{i}"].max().values.astype(np.float64)
+            v = g[f"v{i}"].max().values.astype(np.float64)
+            out[f"a{i}p0"] = np.where(np.isnan(v), -np.inf, v) if filtered else v
         elif a.func == "avg":
-            out[f"a{i}p0"] = g[f"v{i}"].sum().values.astype(np.float64)
-            out[f"a{i}p1"] = out["__size"]
+            out[f"a{i}p0"] = np.nan_to_num(g[f"v{i}"].sum().values.astype(np.float64))
+            out[f"a{i}p1"] = g[f"f{i}"].sum().values if filtered else out["__size"]
         elif a.func == "minmaxrange":
-            out[f"a{i}p0"] = g[f"v{i}"].min().values.astype(np.float64)
-            out[f"a{i}p1"] = g[f"v{i}"].max().values.astype(np.float64)
+            lo = g[f"v{i}"].min().values.astype(np.float64)
+            hi = g[f"v{i}"].max().values.astype(np.float64)
+            if filtered:
+                lo = np.where(np.isnan(lo), np.inf, lo)
+                hi = np.where(np.isnan(hi), -np.inf, hi)
+            out[f"a{i}p0"] = lo
+            out[f"a{i}p1"] = hi
         elif a.func in ("distinctcount", "distinctcountbitmap", "distinctcounthll"):
             out[f"a{i}p0"] = g[f"v{i}"].agg(lambda s: set(s.tolist())).values
         elif a.func in ("percentile", "percentileest", "percentiletdigest"):

@@ -55,6 +55,15 @@ def _value(vspec, cols, ops):
         _, fn = DEVICE_FUNCS[vspec[1]]
         args = [_value(a, cols, ops) for a in vspec[2]]
         return fn(jnp, *args)
+    if kind == "case":
+        # reversed fold: first matching WHEN wins
+        n_padded = next(iter(cols.values())).shape[0]
+        out = _value(vspec[2], cols, ops)
+        out = jnp.broadcast_to(out.astype(_F), (n_padded,))
+        for fspec, branch in reversed(vspec[1]):
+            cond = _filter(fspec, cols, ops, n_padded)
+            out = jnp.where(cond, _value(branch, cols, ops).astype(_F), out)
+        return out
     if kind == "cast_int":
         v = _value(vspec[1], cols, ops)
         # truncate toward zero (Pinot CAST AS INT/LONG semantics)
@@ -218,6 +227,10 @@ def _hashes_for(hspec, cols, ops):
 
 def _agg_scalar(aspec, cols, ops, mask):
     kind = aspec[0]
+    if kind == "masked":
+        # FILTER (WHERE ...): intersect the per-agg mask, delegate
+        m2 = mask & _filter(aspec[1], cols, ops, mask.shape[0])
+        return _agg_scalar(aspec[2], cols, ops, m2)
     if kind == "count":
         return jnp.sum(mask, dtype=jnp.int32).astype(_I)
     if kind == "distinct_ids":
@@ -270,6 +283,9 @@ def _int_scalar_extreme(v, mask, is_min):
 
 def _agg_grouped(aspec, cols, ops, mask, gid, ng):
     kind = aspec[0]
+    if kind == "masked":
+        m2 = mask & _filter(aspec[1], cols, ops, mask.shape[0])
+        return _agg_grouped(aspec[2], cols, ops, m2, gid, ng)
     if kind == "count":
         return _count_grouped(mask, gid, ng)
     v_raw = _value(aspec[1], cols, ops)

@@ -132,6 +132,26 @@ class _Lowering:
             return ("bin", expr.op, self.value_spec(expr.left), self.value_spec(expr.right))
         if isinstance(expr, ast.FunctionCall):
             return self._function_value(expr)
+        if isinstance(expr, ast.CaseWhen):
+            # CASE -> chained jnp.where over the branch masks
+            # (CaseTransformFunction parity). Missing ELSE takes the numeric
+            # default 0 (Pinot's null-handling-disabled behavior); string
+            # results don't lower (host path handles them).
+            branch_vals = [v for _, v in expr.whens] + (
+                [expr.else_] if expr.else_ is not None else []
+            )
+            for val in branch_vals:
+                if isinstance(val, ast.Literal) and not isinstance(val.value, (int, float, bool)):
+                    raise DeviceFallback("non-numeric CASE branches run host-side")
+            whens = tuple(
+                (self.filter_spec(cond), self.value_spec(val)) for cond, val in expr.whens
+            )
+            else_spec = (
+                self.value_spec(expr.else_)
+                if expr.else_ is not None
+                else ("lit", self.op_idx(np.float64(0.0)))
+            )
+            return ("case", whens, else_spec)
         raise PlanError(f"unsupported value expression: {expr}")
 
     def _function_value(self, expr: ast.FunctionCall) -> tuple:
@@ -490,6 +510,14 @@ class _Lowering:
     # -- aggregations --------------------------------------------------------
 
     def agg_spec(self, info: AggregationInfo, grouped: bool) -> tuple:
+        if info.filter is not None:
+            # FILTER (WHERE ...): the per-agg mask ANDs into the query mask
+            # (FilteredAggregationFunction parity) — the wrapper carries the
+            # extra filter spec around the inner aggregation spec
+            import dataclasses
+
+            inner = dataclasses.replace(info, filter=None)
+            return ("masked", self.filter_spec(info.filter), self.agg_spec(inner, grouped))
         if info.func == "count":
             return ("count",)
         if info.func in ("distinctcount", "distinctcountbitmap"):

@@ -29,6 +29,7 @@ from pinot_tpu.query.ast import (
     ArrayLiteral,
     Between,
     BinaryOp,
+    CaseWhen,
     Compare,
     CompareOp,
     Expr,
@@ -530,6 +531,8 @@ class Parser:
                         vals.append(self._array_element())
                 self.expect_op("]")
                 return ArrayLiteral(tuple(vals))
+            if up == "CASE":
+                return self._case()
             if up == "NULL":
                 self.next()
                 return Literal(None)
@@ -560,12 +563,44 @@ class Parser:
                         args.append(self._expr())
                 self.expect_op(")")
                 fc = FunctionCall(t.text.lower(), tuple(args), distinct)
+                if self.at_kw("FILTER"):
+                    # agg(x) FILTER (WHERE cond) — FilteredAggregationFunction
+                    self.next()
+                    self.expect_op("(")
+                    self.expect_kw("WHERE")
+                    cond = self._bool_expr()
+                    self.expect_op(")")
+                    fc = FunctionCall(fc.name, fc.args, fc.distinct, cond)
                 if self.at_kw("OVER"):
                     return self._window(fc)
                 return fc
             self.next()
             return Identifier(t.text)
         raise SqlParseError(f"unexpected token {t.text!r} at position {t.pos}")
+
+    def _case(self) -> Expr:
+        """CASE [operand] WHEN ... THEN ... [ELSE ...] END. The simple form
+        (with operand) desugars into equality compares on the operand."""
+        self.next()  # CASE
+        operand = None
+        if not self.at_kw("WHEN"):
+            operand = self._expr()
+        whens: list[tuple] = []
+        while self.eat_kw("WHEN"):
+            if operand is None:
+                cond: FilterExpr = self._bool_expr()
+            else:
+                cond = Compare(CompareOp.EQ, operand, self._expr())
+            self.expect_kw("THEN")
+            whens.append((cond, self._expr()))
+        if not whens:
+            t = self.peek()
+            raise SqlParseError(f"CASE requires at least one WHEN at position {t.pos}")
+        else_ = None
+        if self.eat_kw("ELSE"):
+            else_ = self._expr()
+        self.expect_kw("END")
+        return CaseWhen(tuple(whens), else_)
 
 
 def _unquote_string(s: str) -> str:

@@ -1,0 +1,199 @@
+"""CASE WHEN + FILTER(WHERE) across execution sites (device / host / v2).
+
+Reference parity: CaseTransformFunction
+(pinot-core/.../operator/transform/function/CaseTransformFunction.java) and
+FilteredAggregationFunction
+(pinot-core/.../aggregation/function/FilteredAggregationFunction.java).
+"""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from pinot_tpu.common import DataType, Schema
+from pinot_tpu.multistage import MultistageEngine
+from pinot_tpu.query import QueryEngine
+from pinot_tpu.segment import SegmentBuilder
+
+
+@pytest.fixture(scope="module")
+def setup():
+    rng = np.random.default_rng(11)
+    n = 20_000
+    schema = Schema.build(
+        "t",
+        dimensions=[("cat", DataType.STRING), ("year", DataType.INT)],
+        metrics=[("v", DataType.LONG), ("w", DataType.DOUBLE)],
+    )
+    data = {
+        "cat": np.array(["a", "b", "c", "d"], dtype=object)[rng.integers(0, 4, n)],
+        "year": rng.integers(2018, 2024, n).astype(np.int32),
+        "v": rng.integers(0, 1000, n).astype(np.int64),
+        "w": rng.random(n).astype(np.float64) * 100,
+    }
+    seg = SegmentBuilder(schema).build(data, "s0")
+    t = pd.DataFrame({k: (v.astype(str) if v.dtype == object else v) for k, v in data.items()})
+    return QueryEngine([seg]), seg, t
+
+
+# -- CASE WHEN ---------------------------------------------------------------
+
+
+def test_case_in_agg_device(setup):
+    eng, _, t = setup
+    res = eng.execute("SELECT SUM(CASE WHEN year >= 2021 THEN v ELSE 0 END) FROM t")
+    truth = int(t.v.where(t.year >= 2021, 0).sum())
+    assert res.rows[0][0] == truth
+
+
+def test_case_multi_branch_first_match_wins(setup):
+    eng, _, t = setup
+    res = eng.execute(
+        "SELECT SUM(CASE WHEN v > 900 THEN 3 WHEN v > 500 THEN 2 WHEN v > 500 THEN 99 ELSE 1 END) FROM t"
+    )
+    truth = int(np.select([t.v > 900, t.v > 500], [3, 2], default=1).sum())
+    assert res.rows[0][0] == truth
+
+
+def test_case_no_else_defaults_zero(setup):
+    eng, _, t = setup
+    res = eng.execute("SELECT SUM(CASE WHEN cat = 'a' THEN v END) FROM t")
+    truth = int(t.v.where(t.cat == "a", 0).sum())
+    assert res.rows[0][0] == truth
+
+
+def test_case_in_group_by_select(setup):
+    eng, _, t = setup
+    res = eng.execute(
+        "SELECT cat, SUM(CASE WHEN year = 2020 THEN v ELSE 0 END) FROM t "
+        "GROUP BY cat ORDER BY cat LIMIT 10"
+    )
+    truth = t.v.where(t.year == 2020, 0).groupby(t.cat).sum().sort_index()
+    assert [r[0] for r in res.rows] == list(truth.index)
+    assert [r[1] for r in res.rows] == [float(v) for v in truth]
+
+
+def test_case_string_result_selection(setup):
+    eng, _, t = setup
+    res = eng.execute(
+        "SELECT CASE WHEN v > 500 THEN 'high' ELSE 'low' END, v FROM t LIMIT 5"
+    )
+    for label, v in res.rows:
+        assert label == ("high" if v > 500 else "low")
+
+
+def test_simple_case_desugars(setup):
+    eng, _, t = setup
+    res = eng.execute("SELECT SUM(CASE cat WHEN 'a' THEN 1 WHEN 'b' THEN 1 ELSE 0 END) FROM t")
+    truth = int(t.cat.isin(["a", "b"]).sum())
+    assert res.rows[0][0] == truth
+
+
+# -- FILTER (WHERE) ----------------------------------------------------------
+
+
+def test_filtered_count_sum_scalar(setup):
+    eng, _, t = setup
+    res = eng.execute(
+        "SELECT COUNT(*) FILTER (WHERE cat = 'a'), SUM(v) FILTER (WHERE year > 2020), "
+        "COUNT(*) FROM t"
+    )
+    assert res.rows[0][0] == int((t.cat == "a").sum())
+    assert res.rows[0][1] == int(t.v[t.year > 2020].sum())
+    assert res.rows[0][2] == len(t)
+
+
+def test_filtered_avg_min_max_scalar(setup):
+    eng, _, t = setup
+    res = eng.execute(
+        "SELECT AVG(w) FILTER (WHERE cat = 'b'), MIN(v) FILTER (WHERE year = 2019), "
+        "MAX(v) FILTER (WHERE cat = 'c') FROM t"
+    )
+    assert res.rows[0][0] == pytest.approx(float(t.w[t.cat == "b"].mean()))
+    assert res.rows[0][1] == float(t.v[t.year == 2019].min())
+    assert res.rows[0][2] == float(t.v[t.cat == "c"].max())
+
+
+def test_filtered_aggs_in_group_by(setup):
+    eng, _, t = setup
+    res = eng.execute(
+        "SELECT year, COUNT(*) FILTER (WHERE cat = 'a'), SUM(v) FILTER (WHERE cat = 'b'), COUNT(*) "
+        "FROM t GROUP BY year ORDER BY year LIMIT 10"
+    )
+    ca = t[t.cat == "a"].groupby("year").size()
+    sb = t.v.where(t.cat == "b", np.nan).groupby(t.year).sum()
+    tot = t.groupby("year").size()
+    for year, c, s, n in res.rows:
+        assert c == int(ca.get(year, 0))
+        assert s == float(sb.get(year, 0.0))
+        assert n == int(tot[year])
+
+
+def test_filtered_agg_with_query_where(setup):
+    """FILTER intersects the query WHERE, not replaces it."""
+    eng, _, t = setup
+    res = eng.execute(
+        "SELECT SUM(v) FILTER (WHERE cat = 'a') FROM t WHERE year >= 2021"
+    )
+    truth = int(t.v[(t.cat == "a") & (t.year >= 2021)].sum())
+    assert res.rows[0][0] == truth
+
+
+def test_filtered_aggs_differ_only_in_filter(setup):
+    """Two same-function aggs with different FILTERs must not merge by name."""
+    eng, _, t = setup
+    res = eng.execute(
+        "SELECT SUM(v) FILTER (WHERE cat = 'a'), SUM(v) FILTER (WHERE cat = 'b') FROM t"
+    )
+    assert res.rows[0][0] == int(t.v[t.cat == "a"].sum())
+    assert res.rows[0][1] == int(t.v[t.cat == "b"].sum())
+
+
+# -- host path consistency ---------------------------------------------------
+
+
+def test_case_and_filter_host_matches_device(setup, monkeypatch):
+    eng, seg, t = setup
+    queries = [
+        "SELECT SUM(CASE WHEN year >= 2021 THEN v ELSE 0 END) FROM t",
+        "SELECT COUNT(*) FILTER (WHERE cat = 'a'), SUM(v) FILTER (WHERE year > 2020) FROM t",
+        "SELECT year, SUM(v) FILTER (WHERE cat = 'b'), COUNT(*) FILTER (WHERE cat = 'a') "
+        "FROM t GROUP BY year ORDER BY year LIMIT 10",
+    ]
+    device = [eng.execute(q).rows for q in queries]
+    import pinot_tpu.query.plan as plan_mod
+    from pinot_tpu.query.plan import DeviceFallback
+
+    def no_device(*a, **kw):
+        raise DeviceFallback("forced host")
+
+    h_eng = QueryEngine([seg])
+    monkeypatch.setattr(plan_mod, "plan_segment", no_device)
+    monkeypatch.setattr("pinot_tpu.query.engine.plan_segment", no_device)
+    host = [h_eng.execute(q).rows for q in queries]
+    assert device == host
+
+
+# -- multistage (v2) ---------------------------------------------------------
+
+
+def test_case_and_filter_multistage(setup):
+    _, seg, t = setup
+    engine = MultistageEngine({"t": [seg]})
+    res = engine.execute(
+        "SELECT t1.cat, SUM(CASE WHEN t1.year >= 2021 THEN t1.v ELSE 0 END) FROM t t1 "
+        "GROUP BY t1.cat ORDER BY t1.cat LIMIT 10"
+    )
+    truth = t.v.where(t.year >= 2021, 0).groupby(t.cat).sum().sort_index()
+    assert [r[0] for r in res.rows] == list(truth.index)
+    assert [float(r[1]) for r in res.rows] == [float(v) for v in truth]
+
+    res = engine.execute(
+        "SELECT t1.year, COUNT(*) FILTER (WHERE t1.cat = 'a'), SUM(t1.v) FROM t t1 "
+        "GROUP BY t1.year ORDER BY t1.year LIMIT 10"
+    )
+    ca = t[t.cat == "a"].groupby("year").size()
+    sv = t.groupby("year").v.sum()
+    for year, c, s in res.rows:
+        assert int(c) == int(ca.get(year, 0))
+        assert float(s) == float(sv[year])
