@@ -128,7 +128,7 @@ def eval_expr(expr: ast.Expr, fields: list[L.Field], df: pd.DataFrame) -> pd.Ser
         if any(v.dtype == object or v.dtype.kind in "US" for v in vals):
             vals = [v.astype(object) for v in vals]
             default = default.astype(object)
-        return pd.Series(np.select(conds, vals, default=default))
+        return pd.Series(np.select(conds, vals, default=default), index=df.index)
     if isinstance(expr, ast.FunctionCall):
         from pinot_tpu.query.transforms import DEVICE_FUNCS, STRING_FUNCS, apply_string_func
 
@@ -496,7 +496,12 @@ def _exec_aggregate(node: L.Aggregate, ctx: RunCtx) -> pd.DataFrame:
         if a.filter is not None and a.func == "count":
             outs.append(gb[col].sum().rename(f"a{j}"))
             continue
-        outs.append(_agg_series(a.func, gb, col, a.extra, col2).rename(f"a{j}"))
+        s = _agg_series(a.func, gb, col, a.extra, col2)
+        if a.filter is not None and a.func in ("min", "max"):
+            # all-NaN groups (FILTER matched no rows): same +/-inf sentinels
+            # as the v1 host path / device kernel (host_exec.group_frame)
+            s = s.fillna(np.inf if a.func == "min" else -np.inf)
+        outs.append(s.rename(f"a{j}"))
     if outs:
         res = pd.concat(outs, axis=1).reset_index()
     else:

@@ -266,6 +266,69 @@ class GapfillSpec:
     fills: dict[int, str]  # select-column index -> fill mode
 
 
+def _extract_gapfill(stmt: SelectStatement) -> "GapfillSpec | None":
+    """Find `GAPFILL(time_expr, start, end, step [, FILL(col,'MODE')...])` in
+    the SELECT list. When present, unwrap the call to its inner time expression
+    (so planning/execution see a normal bucketed time column) and return the
+    GapfillSpec the broker reduce applies; otherwise return None.
+
+    Reference parity: GapfillQueryContext extraction feeding GapfillProcessor
+    (pinot-core/.../query/reduce/GapfillProcessor.java).
+    """
+    gf_index = -1
+    gf_call: FunctionCall | None = None
+    for i, item in enumerate(stmt.select_list):
+        e = item.expr
+        if isinstance(e, FunctionCall) and e.name.lower() == "gapfill":
+            if gf_call is not None:
+                raise ValueError("only one GAPFILL() call is supported")
+            gf_index, gf_call = i, e
+    if gf_call is None:
+        return None
+    if len(gf_call.args) < 4:
+        raise ValueError("GAPFILL requires (time_expr, start, end, step [, FILL(col,'MODE')...])")
+    time_expr = gf_call.args[0]
+    bounds = []
+    for arg in gf_call.args[1:4]:
+        if not isinstance(arg, Literal) or isinstance(arg.value, str):
+            raise ValueError("GAPFILL start/end/step must be numeric literals")
+        bounds.append(float(arg.value))
+    start, end, step = bounds
+    if step <= 0:
+        raise ValueError("GAPFILL step must be positive")
+
+    # Unwrap in the select list (and any matching group-by entry) in place.
+    old_canonical = canonical(gf_call)
+    stmt.select_list[gf_index] = SelectItem(time_expr, stmt.select_list[gf_index].alias)
+    stmt.group_by = [
+        time_expr if canonical(g) == old_canonical else g for g in stmt.group_by
+    ]
+
+    # Output-name -> select index, for resolving FILL(col, ...) targets.
+    name_to_idx: dict[str, int] = {}
+    for i, item in enumerate(stmt.select_list):
+        name_to_idx[canonical(item.expr)] = i
+        if item.alias:
+            name_to_idx[item.alias] = i
+
+    fills: dict[int, str] = {}
+    for arg in gf_call.args[4:]:
+        if not (isinstance(arg, FunctionCall) and arg.name.lower() == "fill" and len(arg.args) == 2):
+            raise ValueError("GAPFILL extra args must be FILL(col, 'MODE') calls")
+        col, mode = arg.args
+        if not isinstance(mode, Literal) or not isinstance(mode.value, str):
+            raise ValueError("FILL mode must be a string literal")
+        key = col.name if isinstance(col, Identifier) else canonical(col)
+        if key not in name_to_idx:
+            raise ValueError(f"FILL column {key!r} is not in the SELECT list")
+        mode_u = mode.value.upper()
+        if mode_u not in ("FILL_PREVIOUS_VALUE", "FILL_DEFAULT_VALUE"):
+            raise ValueError(f"unsupported FILL mode {mode.value!r}")
+        fills[name_to_idx[key]] = mode_u
+
+    return GapfillSpec(col_index=gf_index, start=start, end=end, step=step, fills=fills)
+
+
 @dataclass
 class QueryContext:
     statement: SelectStatement
@@ -307,6 +370,24 @@ class QueryContext:
 
     @staticmethod
     def from_statement(stmt: SelectStatement) -> "QueryContext":
+        # GROUP BY alias substitution (reference: alias replacement in
+        # QueryContextConverterUtils.getQueryContext, pinot-core/.../request/
+        # context/utils/QueryContextConverterUtils.java): `GROUP BY c` where c
+        # aliases a select expression groups by that expression.
+        alias_sub = {
+            it.alias: it.expr
+            for it in stmt.select_list
+            if it.alias and not isinstance(it.expr, Star)
+        }
+        if alias_sub:
+            def _sub(e: Expr) -> Expr:
+                if isinstance(e, Identifier):
+                    rep = alias_sub.get(e.name)
+                    if rep is not None and canonical(rep) != e.name:
+                        return rep
+                return e
+
+            stmt.group_by = [_sub(g) for g in stmt.group_by]
         gapfill = _extract_gapfill(stmt)
         aggs: dict[str, AggregationInfo] = {}
         has_agg = False

@@ -143,6 +143,14 @@ class _Lowering:
             for val in branch_vals:
                 if isinstance(val, ast.Literal) and not isinstance(val.value, (int, float, bool)):
                     raise DeviceFallback("non-numeric CASE branches run host-side")
+                if isinstance(val, ast.Identifier):
+                    ci = self.seg.columns.get(val.name)
+                    if ci is not None and ci.data_type in (
+                        DataType.STRING,
+                        DataType.BYTES,
+                        DataType.JSON,
+                    ):
+                        raise DeviceFallback("string-typed CASE branches run host-side")
             whens = tuple(
                 (self.filter_spec(cond), self.value_spec(val)) for cond, val in expr.whens
             )

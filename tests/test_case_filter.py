@@ -197,3 +197,47 @@ def test_case_and_filter_multistage(setup):
     for year, c, s in res.rows:
         assert int(c) == int(ca.get(year, 0))
         assert float(s) == float(sv[year])
+
+
+# -- round-2 advisor regression fixes ----------------------------------------
+
+
+def test_case_string_column_branch_falls_to_host(setup):
+    # advisor r2: string COLUMN branches (not just literals) must DeviceFallback
+    eng, _, t = setup
+    res = eng.execute(
+        "SELECT CASE WHEN v > 500 THEN cat ELSE 'low' END AS c, COUNT(*) FROM t "
+        "GROUP BY c ORDER BY c LIMIT 10"
+    )
+    truth = t.cat.where(t.v > 500, "low").value_counts().sort_index()
+    assert [r[0] for r in res.rows] == list(truth.index)
+    assert [int(r[1]) for r in res.rows] == [int(v) for v in truth]
+
+
+def test_v2_filtered_min_max_empty_group_sentinels(setup):
+    # advisor r2: filtered MIN/MAX over an empty-filter group must match the
+    # v1 host path's +/-inf sentinels, not NaN
+    _, seg, t = setup
+    engine = MultistageEngine({"t": [seg]})
+    res = engine.execute(
+        "SELECT t1.cat, MIN(t1.v) FILTER (WHERE t1.year >= 2030), MAX(t1.v) FILTER (WHERE t1.year >= 2030) "
+        "FROM t t1 GROUP BY t1.cat ORDER BY t1.cat LIMIT 10"
+    )
+    for _, lo, hi in res.rows:
+        assert float(lo) == float("inf")
+        assert float(hi) == float("-inf")
+
+
+def test_v2_case_inside_binop_over_filtered_frame(setup):
+    # advisor r2: CaseWhen result must preserve the source frame's index so
+    # nested BinaryOp evaluation over a filtered (non-contiguous) frame aligns
+    _, seg, t = setup
+    engine = MultistageEngine({"t": [seg]})
+    res = engine.execute(
+        "SELECT t1.cat, SUM((CASE WHEN t1.year >= 2021 THEN t1.v ELSE 0 END) + t1.v) "
+        "FILTER (WHERE t1.v > 100) FROM t t1 GROUP BY t1.cat ORDER BY t1.cat LIMIT 10"
+    )
+    sub = t[t.v > 100]
+    truth = (sub.v.where(sub.year >= 2021, 0) + sub.v).groupby(sub.cat).sum().sort_index()
+    assert [r[0] for r in res.rows] == list(truth.index)
+    assert [float(r[1]) for r in res.rows] == [float(v) for v in truth]

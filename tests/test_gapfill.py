@@ -1,0 +1,88 @@
+"""GAPFILL broker-side gap filling.
+
+Reference parity: GapfillProcessor
+(pinot-core/.../query/reduce/GapfillProcessor.java) and the GAPFILL select
+expression (pinot-core/.../query/request/context/utils/QueryContextConverterUtils).
+Simplified surface: GAPFILL(time_expr, start, end, step [, FILL(col,'MODE')...])
+in the SELECT list emits one row per [start, end) step bucket.
+"""
+
+import numpy as np
+import pytest
+
+from pinot_tpu.common import DataType, Schema
+from pinot_tpu.query import QueryEngine
+from pinot_tpu.query.context import QueryContext
+from pinot_tpu.segment import SegmentBuilder
+
+
+@pytest.fixture(scope="module")
+def setup():
+    # time buckets 0,10,30,40 present; 20 and 50 missing in [0, 60)
+    ts = np.array([0, 0, 10, 30, 30, 40], dtype=np.int64)
+    v = np.array([1, 2, 3, 4, 5, 6], dtype=np.int64)
+    schema = Schema.build(
+        "t",
+        dimensions=[("ts", DataType.LONG)],
+        metrics=[("v", DataType.LONG)],
+    )
+    seg = SegmentBuilder(schema).build({"ts": ts, "v": v}, "s0")
+    return QueryEngine([seg])
+
+
+def test_gapfill_basic_null_fill(setup):
+    res = setup.execute(
+        "SELECT GAPFILL(ts, 0, 60, 10), SUM(v) FROM t GROUP BY ts ORDER BY ts LIMIT 100"
+    )
+    assert [r[0] for r in res.rows] == [0, 10, 20, 30, 40, 50]
+    assert [r[1] for r in res.rows] == [3, 3, None, 9, 6, None]
+
+
+def test_gapfill_fill_previous_value(setup):
+    res = setup.execute(
+        "SELECT GAPFILL(ts, 0, 60, 10, FILL(s, 'FILL_PREVIOUS_VALUE')), SUM(v) AS s "
+        "FROM t GROUP BY ts ORDER BY ts LIMIT 100"
+    )
+    assert [r[1] for r in res.rows] == [3, 3, 3, 9, 6, 6]
+
+
+def test_gapfill_fill_default_value(setup):
+    res = setup.execute(
+        "SELECT GAPFILL(ts, 0, 60, 10, FILL(s, 'FILL_DEFAULT_VALUE')), SUM(v) AS s "
+        "FROM t GROUP BY ts ORDER BY ts LIMIT 100"
+    )
+    assert [r[1] for r in res.rows] == [3, 3, 0, 9, 6, 0]
+
+
+def test_gapfill_drops_out_of_range(setup):
+    res = setup.execute(
+        "SELECT GAPFILL(ts, 10, 40, 10), SUM(v) FROM t GROUP BY ts ORDER BY ts LIMIT 100"
+    )
+    assert [r[0] for r in res.rows] == [10, 20, 30]
+
+
+def test_gapfill_absent_returns_none():
+    ctx = QueryContext.from_sql("SELECT ts, SUM(v) FROM t GROUP BY ts")
+    assert ctx.gapfill is None
+
+
+def test_gapfill_spec_extraction():
+    ctx = QueryContext.from_sql(
+        "SELECT GAPFILL(ts, 0, 100, 5, FILL(s, 'FILL_DEFAULT_VALUE')), SUM(v) AS s "
+        "FROM t GROUP BY ts"
+    )
+    gf = ctx.gapfill
+    assert gf is not None
+    assert (gf.col_index, gf.start, gf.end, gf.step) == (0, 0.0, 100.0, 5.0)
+    assert gf.fills == {1: "FILL_DEFAULT_VALUE"}
+    # the select item was unwrapped to the plain time expression
+    assert ctx.output_name(ctx.select_items[0]) == "ts"
+
+
+def test_gapfill_bad_args_raise():
+    with pytest.raises(ValueError):
+        QueryContext.from_sql("SELECT GAPFILL(ts, 0, 60) FROM t GROUP BY ts")
+    with pytest.raises(ValueError):
+        QueryContext.from_sql(
+            "SELECT GAPFILL(ts, 0, 60, 10, FILL(nope, 'FILL_DEFAULT_VALUE')) FROM t GROUP BY ts"
+        )
