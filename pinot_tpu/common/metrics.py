@@ -165,6 +165,7 @@ class ServerMeter(Enum):
     NUM_SEGMENTS_QUERIED = "server.numSegmentsQueried"
     NUM_SEGMENTS_PRUNED = "server.numSegmentsPruned"
     DEVICE_FALLBACKS = "server.deviceFallbacks"
+    MULTISTAGE_LEAF_DEVICE_SCANS = "server.multistageLeafDeviceScans"
     REALTIME_ROWS_CONSUMED = "server.realtimeRowsConsumed"
     QUERIES_KILLED = "server.queriesKilled"
     SCHEDULING_TIMEOUTS = "server.schedulingTimeouts"

@@ -340,6 +340,25 @@ def _empty_df(n_cols: int) -> pd.DataFrame:
     return pd.DataFrame({i: pd.Series(dtype=object) for i in range(n_cols)})
 
 
+def _leaf_filter_mask(seg, filt) -> np.ndarray:
+    """Leaf Scan filter on the fused device kernel (LeafStageTransferableBlock-
+    Operator.java:87 parity: the v2 leaf runs the v1 engine's path). Falls
+    back to the host numpy evaluator for host-only predicates; each side is
+    counted in server metrics so tests/operators can assert which path ran."""
+    from pinot_tpu.common.metrics import ServerMeter, server_metrics
+    from pinot_tpu.query.kernels import run_plan
+    from pinot_tpu.query.plan import DeviceFallback, PlanError, plan_filter_mask
+
+    try:
+        plan = plan_filter_mask(seg, filt)
+        mask = np.asarray(run_plan(plan, seg.to_device_cached()))[: seg.n_docs]
+    except (DeviceFallback, PlanError):
+        server_metrics().meter(ServerMeter.DEVICE_FALLBACKS).mark()
+        return host_exec.filter_mask(seg, filt)
+    server_metrics().meter(ServerMeter.MULTISTAGE_LEAF_DEVICE_SCANS).mark()
+    return mask
+
+
 def exec_node(node: L.Node, ctx: RunCtx) -> pd.DataFrame:
     if isinstance(node, L.StageInput):
         blocks = ctx.mailbox.receive_all(
@@ -355,7 +374,7 @@ def exec_node(node: L.Node, ctx: RunCtx) -> pd.DataFrame:
         mine = segs if ctx.scan_local_all else segs[ctx.worker :: ctx.stage.parallelism]
         frames = []
         for seg in mine:
-            mask = host_exec.filter_mask(seg, node.filter) if node.filter is not None else None
+            mask = _leaf_filter_mask(seg, node.filter) if node.filter is not None else None
             valid = seg.extras.get("valid_docs")
             if valid is not None:
                 vm = valid(seg.n_docs)

@@ -40,7 +40,7 @@ def _try_build_and_load():
             # per-process tmp name: concurrent first imports must not tear the .so
             tmp = _BUILD / f"libpinot_native.so.tmp.{os.getpid()}"
             subprocess.run(
-                ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", str(_SRC), "-o", str(tmp)],
+                ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", str(_SRC), "-o", str(tmp), "-ldl"],
                 check=True,
                 capture_output=True,
                 timeout=300,
@@ -70,6 +70,20 @@ def _declare(lib):
     lib.pt_lz4_compress.argtypes = [p, i64, p, i64]
     lib.pt_lz4_decompress.restype = i64
     lib.pt_lz4_decompress.argtypes = [p, i64, p, i64]
+    # system chunk codecs (dlopen'd zstd/zlib/snappy; -2 = lib unavailable)
+    for name, has_level in (
+        ("pt_zstd", True),
+        ("pt_gzip", True),
+        ("pt_snappy", False),
+    ):
+        getattr(lib, f"{name}_bound").restype = i64
+        getattr(lib, f"{name}_bound").argtypes = [i64]
+        comp = getattr(lib, f"{name}_compress")
+        comp.restype = i64
+        comp.argtypes = [p, i64, p, i64] + ([i32] if has_level else [])
+        dec = getattr(lib, f"{name}_decompress")
+        dec.restype = i64
+        dec.argtypes = [p, i64, p, i64]
     for nm in ("pt_bm_and", "pt_bm_or", "pt_bm_andnot"):
         fn = getattr(lib, nm)
         fn.restype = None
@@ -197,6 +211,74 @@ def lz4_decompress(data: bytes, raw_len: int) -> bytes:
     if k != raw_len:
         raise RuntimeError(f"lz4 decompress: got {k}, want {raw_len}")
     return out.tobytes()
+
+
+# -- system chunk codecs (ZSTD / GZIP / Snappy) ------------------------------
+# ChunkCompressionType parity (pinot-segment-spi/.../compression/
+# ChunkCompressionType.java:22): ZSTANDARD, GZIP, SNAPPY via dlopen'd system
+# libraries. Like the reference, a reading host must have the codec a segment
+# was written with — except lz4 (pure-python decoder below) and gzip (stdlib
+# zlib fallback); zstd/snappy segments require the system library to load.
+
+_CODEC_LEVELS = {"zstd": 3, "gzip": 6}
+
+
+def codec_available(codec: str) -> bool:
+    """True when `codec` can round-trip on this host."""
+    if codec in ("raw",):
+        return True
+    if _lib is None:
+        return False
+    if codec == "lz4":
+        return True
+    if codec not in ("zstd", "gzip", "snappy"):
+        return False
+    return int(getattr(_lib, f"pt_{codec}_bound")(1)) > 0
+
+
+def chunk_compress(data: bytes, codec: str) -> bytes:
+    """Compress with the named codec ('lz4'/'zstd'/'gzip'/'snappy')."""
+    if codec == "lz4":
+        return lz4_compress(data)
+    if _lib is None:
+        raise RuntimeError(f"native {codec} unavailable")
+    buf = np.frombuffer(data, dtype=np.uint8)
+    cap = int(getattr(_lib, f"pt_{codec}_bound")(len(buf)))
+    if cap < 0:
+        raise RuntimeError(f"{codec} library unavailable")
+    out = np.empty(max(cap, 16), dtype=np.uint8)
+    args = [_ptr(buf), len(buf), _ptr(out), len(out)]
+    if codec in _CODEC_LEVELS:
+        args.append(_CODEC_LEVELS[codec])
+    k = int(getattr(_lib, f"pt_{codec}_compress")(*args))
+    if k < 0:
+        raise RuntimeError(f"{codec} compress failed ({k})")
+    return out[:k].tobytes()
+
+
+def chunk_decompress(data: bytes, raw_len: int, codec: str) -> bytes:
+    """Decompress `codec`-encoded bytes to exactly raw_len."""
+    if codec == "raw":
+        return bytes(data)
+    if codec == "lz4":
+        return lz4_decompress(data, raw_len)
+    if _lib is None or int(getattr(_lib, f"pt_{codec}_bound")(1)) < 0:
+        if codec == "gzip":
+            # toolchain-less / libz-less hosts: stdlib zlib reads the same
+            # zlib-format stream pt_gzip_compress writes
+            import zlib
+
+            out_b = zlib.decompress(bytes(data))
+            if len(out_b) != raw_len:
+                raise RuntimeError(f"gzip decompress: got {len(out_b)}, want {raw_len}")
+            return out_b
+        raise RuntimeError(f"native {codec} unavailable")
+    buf = np.frombuffer(data, dtype=np.uint8)
+    out = np.empty(max(raw_len, 1), dtype=np.uint8)
+    k = int(getattr(_lib, f"pt_{codec}_decompress")(_ptr(buf), len(buf), _ptr(out), raw_len))
+    if k != raw_len:
+        raise RuntimeError(f"{codec} decompress: got {k}, want {raw_len}")
+    return out[:raw_len].tobytes()
 
 
 def _lz4_decompress_py(src: bytes, cap: int) -> bytes:

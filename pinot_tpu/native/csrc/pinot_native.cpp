@@ -480,3 +480,153 @@ PT_EXPORT void pt_prefix_sum_i64(const int32_t* lens, int64_t n,
 }
 
 PT_EXPORT int32_t pt_abi_version() { return 1; }
+
+// ---------------------------------------------------------------------------
+// system chunk codecs via dlopen: ZSTD / GZIP(zlib) / Snappy
+// (ChunkCompressionType.java:22 parity — ZSTANDARD, GZIP, SNAPPY). Lazily
+// resolved so the library builds and runs without any of them installed;
+// unavailable codecs return -2 and the Python layer falls back.
+// ---------------------------------------------------------------------------
+
+#include <dlfcn.h>
+#include <stddef.h>
+
+namespace {
+
+void* dl_open_first(const char* a, const char* b) {
+  void* h = dlopen(a, RTLD_NOW | RTLD_GLOBAL);
+  if (!h && b) h = dlopen(b, RTLD_NOW | RTLD_GLOBAL);
+  return h;
+}
+
+// zstd
+typedef size_t (*zstd_bound_t)(size_t);
+typedef size_t (*zstd_compress_t)(void*, size_t, const void*, size_t, int);
+typedef size_t (*zstd_decompress_t)(void*, size_t, const void*, size_t);
+typedef unsigned (*zstd_iserror_t)(size_t);
+struct ZstdApi {
+  zstd_bound_t bound = nullptr;
+  zstd_compress_t compress = nullptr;
+  zstd_decompress_t decompress = nullptr;
+  zstd_iserror_t is_error = nullptr;
+  bool ok = false;
+  ZstdApi() {
+    void* h = dl_open_first("libzstd.so.1", "libzstd.so");
+    if (!h) return;
+    bound = (zstd_bound_t)dlsym(h, "ZSTD_compressBound");
+    compress = (zstd_compress_t)dlsym(h, "ZSTD_compress");
+    decompress = (zstd_decompress_t)dlsym(h, "ZSTD_decompress");
+    is_error = (zstd_iserror_t)dlsym(h, "ZSTD_isError");
+    ok = bound && compress && decompress && is_error;
+  }
+};
+ZstdApi& zstd() { static ZstdApi api; return api; }
+
+// zlib (GZIP analog: zlib stream format)
+typedef unsigned long (*z_bound_t)(unsigned long);
+typedef int (*z_compress2_t)(uint8_t*, unsigned long*, const uint8_t*, unsigned long, int);
+typedef int (*z_uncompress_t)(uint8_t*, unsigned long*, const uint8_t*, unsigned long);
+struct ZlibApi {
+  z_bound_t bound = nullptr;
+  z_compress2_t compress2 = nullptr;
+  z_uncompress_t uncompress = nullptr;
+  bool ok = false;
+  ZlibApi() {
+    void* h = dl_open_first("libz.so.1", "libz.so");
+    if (!h) return;
+    bound = (z_bound_t)dlsym(h, "compressBound");
+    compress2 = (z_compress2_t)dlsym(h, "compress2");
+    uncompress = (z_uncompress_t)dlsym(h, "uncompress");
+    ok = bound && compress2 && uncompress;
+  }
+};
+ZlibApi& zlib() { static ZlibApi api; return api; }
+
+// snappy C bindings
+typedef int (*sn_compress_t)(const char*, size_t, char*, size_t*);
+typedef int (*sn_uncompress_t)(const char*, size_t, char*, size_t*);
+typedef size_t (*sn_maxlen_t)(size_t);
+struct SnappyApi {
+  sn_compress_t compress = nullptr;
+  sn_uncompress_t uncompress = nullptr;
+  sn_maxlen_t maxlen = nullptr;
+  bool ok = false;
+  SnappyApi() {
+    void* h = dl_open_first("libsnappy.so.1", "libsnappy.so");
+    if (!h) return;
+    compress = (sn_compress_t)dlsym(h, "snappy_compress");
+    uncompress = (sn_uncompress_t)dlsym(h, "snappy_uncompress");
+    maxlen = (sn_maxlen_t)dlsym(h, "snappy_max_compressed_length");
+    ok = compress && uncompress && maxlen;
+  }
+};
+SnappyApi& snappy() { static SnappyApi api; return api; }
+
+}  // namespace
+
+PT_EXPORT int64_t pt_zstd_bound(int64_t n) {
+  if (!zstd().ok) return -2;
+  return (int64_t)zstd().bound((size_t)n);
+}
+
+PT_EXPORT int64_t pt_zstd_compress(const uint8_t* src, int64_t n, uint8_t* dst,
+                                   int64_t cap, int32_t level) {
+  if (!zstd().ok) return -2;
+  size_t k = zstd().compress(dst, (size_t)cap, src, (size_t)n, level);
+  if (zstd().is_error(k)) return -1;
+  return (int64_t)k;
+}
+
+PT_EXPORT int64_t pt_zstd_decompress(const uint8_t* src, int64_t n,
+                                     uint8_t* dst, int64_t cap) {
+  if (!zstd().ok) return -2;
+  size_t k = zstd().decompress(dst, (size_t)cap, src, (size_t)n);
+  if (zstd().is_error(k)) return -1;
+  return (int64_t)k;
+}
+
+PT_EXPORT int64_t pt_gzip_bound(int64_t n) {
+  if (!zlib().ok) return -2;
+  return (int64_t)zlib().bound((unsigned long)n);
+}
+
+PT_EXPORT int64_t pt_gzip_compress(const uint8_t* src, int64_t n, uint8_t* dst,
+                                   int64_t cap, int32_t level) {
+  if (!zlib().ok) return -2;
+  unsigned long out_len = (unsigned long)cap;
+  int rc = zlib().compress2(dst, &out_len, src, (unsigned long)n, level);
+  if (rc != 0) return -1;
+  return (int64_t)out_len;
+}
+
+PT_EXPORT int64_t pt_gzip_decompress(const uint8_t* src, int64_t n,
+                                     uint8_t* dst, int64_t cap) {
+  if (!zlib().ok) return -2;
+  unsigned long out_len = (unsigned long)cap;
+  int rc = zlib().uncompress(dst, &out_len, src, (unsigned long)n);
+  if (rc != 0) return -1;
+  return (int64_t)out_len;
+}
+
+PT_EXPORT int64_t pt_snappy_bound(int64_t n) {
+  if (!snappy().ok) return -2;
+  return (int64_t)snappy().maxlen((size_t)n);
+}
+
+PT_EXPORT int64_t pt_snappy_compress(const uint8_t* src, int64_t n,
+                                     uint8_t* dst, int64_t cap) {
+  if (!snappy().ok) return -2;
+  size_t out_len = (size_t)cap;
+  int rc = snappy().compress((const char*)src, (size_t)n, (char*)dst, &out_len);
+  if (rc != 0) return -1;
+  return (int64_t)out_len;
+}
+
+PT_EXPORT int64_t pt_snappy_decompress(const uint8_t* src, int64_t n,
+                                       uint8_t* dst, int64_t cap) {
+  if (!snappy().ok) return -2;
+  size_t out_len = (size_t)cap;
+  int rc = snappy().uncompress((const char*)src, (size_t)n, (char*)dst, &out_len);
+  if (rc != 0) return -1;
+  return (int64_t)out_len;
+}

@@ -86,6 +86,11 @@ def build_sharded_table(
     axis = mesh.axis_names[0]
     sharding = NamedSharding(mesh, P(axis, None))
     for col, ci in proto.columns.items():
+        if ci.is_mv:
+            raise ValueError(
+                f"sharded tables do not support MV column {col!r} yet; "
+                "use per-segment QueryEngine for MV queries"
+            )
         fwd = ci.forward
         if fwd.dtype == np.int64 and len(fwd):
             # lossless narrowing (DeviceSegment.to_device parity): i64 is
@@ -133,18 +138,22 @@ def _combine_tree(spec: tuple, matched, counts, parts, axis_name: str | None, lo
         y = jnp.sum(x, axis=0) if local_axis else x
         return jax.lax.psum(y, axis_name) if axis_name else y
 
+    # min/max/or collectives ride all_gather + local reduce instead of
+    # pmin/pmax: the axon AOT TPU compiler lowers ONLY Sum all-reduces
+    # ("Supported lowering only of Sum all reduce"), and partials are small,
+    # so gathering then reducing costs ~the same ICI bytes as an all-reduce.
     def red_min(x):
         y = jnp.min(x, axis=0) if local_axis else x
-        return jax.lax.pmin(y, axis_name) if axis_name else y
+        return jnp.min(jax.lax.all_gather(y, axis_name), axis=0) if axis_name else y
 
     def red_max(x):
         y = jnp.max(x, axis=0) if local_axis else x
-        return jax.lax.pmax(y, axis_name) if axis_name else y
+        return jnp.max(jax.lax.all_gather(y, axis_name), axis=0) if axis_name else y
 
     def red_or(x):
         y = jnp.max(x.astype(jnp.int32), axis=0) if local_axis else x.astype(jnp.int32)
         if axis_name:
-            y = jax.lax.pmax(y, axis_name)
+            y = jnp.max(jax.lax.all_gather(y, axis_name), axis=0)
         return y.astype(bool)
 
     aggs = spec[3]
@@ -154,15 +163,15 @@ def _combine_tree(spec: tuple, matched, counts, parts, axis_name: str | None, lo
         while kind == "masked":  # FILTER(WHERE) wrapper: combine by inner kind
             a = a[2]
             kind = a[0]
-        if kind in ("count", "sum", "avg"):
+        if kind in ("count", "sum", "avg", "mv_count", "mv_sum", "mv_avg"):
             out_parts.append(jax.tree.map(red_sum, p))
-        elif kind == "min":
+        elif kind in ("min", "mv_min"):
             out_parts.append(red_min(p))
-        elif kind == "max":
+        elif kind in ("max", "mv_max"):
             out_parts.append(red_max(p))
         elif kind == "minmaxrange":
             out_parts.append((red_min(p[0]), red_max(p[1])))
-        elif kind == "distinct_ids":
+        elif kind in ("distinct_ids", "mv_distinct_ids"):
             out_parts.append(red_or(p))
         elif kind == "hll":
             out_parts.append(red_max(p))
@@ -211,7 +220,10 @@ def _sharded_kernel(spec: tuple, mesh: Mesh, axis: str):
         else:
             matched, parts = out
             counts = None
-        m, c, p = _combine_tree(spec, matched, counts, parts, axis, local_axis=False)
+        # a size-1 mesh axis (the single-chip bench) needs no collective at
+        # all — skip them so the program never emits an all-reduce/all-gather
+        coll_axis = axis if mesh.shape[axis] > 1 else None
+        m, c, p = _combine_tree(spec, matched, counts, parts, coll_axis, local_axis=False)
         return (m, c, p) if grouped else (m, p)
 
     def run(cols, ops, n_docs):

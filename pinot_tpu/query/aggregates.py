@@ -322,9 +322,165 @@ def _spdc_compute(v, _v2, _extra):
     return int(len(np.unique(np.asarray(v))))
 
 
+# -- smart variants ----------------------------------------------------------
+# DistinctCountSmartHLLAggregationFunction: exact set until a threshold, HLL
+# registers beyond; PercentileSmartTDigestAggregationFunction: exact values
+# until a threshold, then a bounded quantile summary.
+
+SMART_HLL_THRESHOLD = 100_000
+SMART_TDIGEST_CAP = 4096
+
+
+def _smarthll_compute(v, _v2, _extra):
+    s = set(np.asarray(v).tolist())
+    if len(s) > SMART_HLL_THRESHOLD:
+        return np_hll_registers(np.asarray(list(s)))
+    return s
+
+
+def _smarthll_regs(p):
+    return p if not isinstance(p, (set, frozenset)) else np_hll_registers(np.asarray(list(p)))
+
+
+def _smarthll_merge(a, b):
+    if isinstance(a, (set, frozenset)) and isinstance(b, (set, frozenset)):
+        u = a | b
+        if len(u) > SMART_HLL_THRESHOLD:
+            return np_hll_registers(np.asarray(list(u)))
+        return u
+    return np.maximum(_smarthll_regs(a), _smarthll_regs(b))
+
+
+def _smarthll_finalize(p, _extra):
+    return len(p) if isinstance(p, (set, frozenset)) else hll_estimate(np.asarray(p))
+
+
+def _td_compress(x: np.ndarray) -> np.ndarray:
+    """Bounded sorted quantile summary: evenly-spaced order statistics."""
+    x = np.sort(np.asarray(x, dtype=np.float64))
+    if len(x) <= SMART_TDIGEST_CAP:
+        return x
+    idx = np.linspace(0, len(x) - 1, SMART_TDIGEST_CAP).astype(np.int64)
+    return x[idx]
+
+
+# -- raw sketch variants -----------------------------------------------------
+# DistinctCountRaw*/PercentileRaw* return the SERIALIZED sketch (hex string)
+# instead of the estimate, for client-side merging.
+
+
+def _hex(arr: np.ndarray) -> str:
+    return np.ascontiguousarray(arr).tobytes().hex()
+
+
+# -- frequent items (Misra-Gries summary) ------------------------------------
+# FrequentLongs/StringsSketchAggregationFunction: partial = value -> count
+# dict capped at maxMapSize (extra[0]); deterministic decrement-on-overflow.
+
+
+def _freq_cap(counts: dict, cap: int) -> dict:
+    """Batch Misra-Gries reduction: subtract the (cap+1)-th largest count
+    from every entry and drop non-positives. Counts become underestimates
+    with error bounded by n/cap (the sketch's documented guarantee)."""
+    if len(counts) <= cap:
+        return counts
+    thresh = sorted(counts.values(), reverse=True)[cap]
+    return {k: c - thresh for k, c in counts.items() if c > thresh}
+
+
+# partial = (cap, counts) so merges honor the query's maxMapSize without
+# access to `extra` (AggSpec merge takes only the two partials)
+
+
+def _freq_compute(v, _v2, extra):
+    cap = int(extra[0]) if extra else 64
+    vals, counts = np.unique(np.asarray(v), return_counts=True)
+    d = {(int(k) if isinstance(k, (np.integer, int)) else str(k)): int(c) for k, c in zip(vals, counts)}
+    return (cap, _freq_cap(d, cap))
+
+
+def _freq_merge(a, b):
+    cap = max(a[0], b[0])
+    out = dict(a[1])
+    for k, c in b[1].items():
+        out[k] = out.get(k, 0) + c
+    return (cap, _freq_cap(out, cap))
+
+
+def _freq_finalize(p, extra):
+    cap, counts = p
+    top = sorted(counts.items(), key=lambda kv: (-kv[1], str(kv[0])))[:cap]
+    return {str(k): int(c) for k, c in top}
+
+
+# -- sum with full precision -------------------------------------------------
+# SumPrecisionAggregationFunction: BigDecimal accumulation — python ints are
+# arbitrary precision, so integer inputs sum exactly; floats use math.fsum.
+
+
+def _sumprecision_compute(v, _v2, _extra):
+    x = np.asarray(v)
+    if np.issubdtype(x.dtype, np.integer):
+        return int(x.astype(object).sum()) if len(x) else 0
+    import math
+
+    return math.fsum(x.astype(np.float64))
+
+
+# -- idset -------------------------------------------------------------------
+# IdSetAggregationFunction: collects the distinct id set; the reference
+# returns a serialized IdSet — we emit the sorted id list.
+
+
 # ---------------------------------------------------------------------------
 
 EXT_AGGS: dict[str, AggSpec] = {
+    "distinctcountsmarthll": AggSpec(1, _smarthll_compute, _smarthll_merge, _smarthll_finalize, lambda e: set()),
+    "percentilesmarttdigest": AggSpec(
+        1,
+        lambda v, _v2, e: _td_compress(_f64(v)),
+        lambda a, b: _td_compress(np.concatenate([a, b])),
+        lambda p, e: exact_percentile(p, e[0]),
+        lambda e: np.zeros(0),
+    ),
+    "sumprecision": AggSpec(1, _sumprecision_compute, lambda a, b: a + b, lambda p, e: p, lambda e: 0),
+    "idset": AggSpec(
+        1,
+        _set_compute,
+        lambda a, b: a | b,
+        lambda p, e: sorted(str(x) for x in p),
+        lambda e: set(),
+    ),
+    "frequentlongssketch": AggSpec(1, _freq_compute, _freq_merge, _freq_finalize, lambda e: (int(e[0]) if e else 64, {})),
+    "frequentstringssketch": AggSpec(1, _freq_compute, _freq_merge, _freq_finalize, lambda e: (int(e[0]) if e else 64, {})),
+    "distinctcountrawhll": AggSpec(
+        1,
+        _hll_compute,
+        lambda a, b: np.maximum(a, b),
+        lambda p, e: _hex(np.asarray(p, dtype=np.int8)),
+        lambda e: np_hll_registers(np.zeros(0)),
+    ),
+    "distinctcountrawthetasketch": AggSpec(
+        1,
+        _theta_compute,
+        _theta_merge,
+        lambda p, e: _hex(np.asarray(p, dtype=np.uint64)),
+        lambda e: np.zeros(0, np.uint64),
+    ),
+    "percentilerawest": AggSpec(
+        1,
+        lambda v, _v2, e: _td_compress(_f64(v)),
+        lambda a, b: _td_compress(np.concatenate([a, b])),
+        lambda p, e: _hex(np.asarray(p, dtype=np.float64)),
+        lambda e: np.zeros(0),
+    ),
+    "percentilerawtdigest": AggSpec(
+        1,
+        lambda v, _v2, e: _td_compress(_f64(v)),
+        lambda a, b: _td_compress(np.concatenate([a, b])),
+        lambda p, e: _hex(np.asarray(p, dtype=np.float64)),
+        lambda e: np.zeros(0),
+    ),
     "variance": AggSpec(1, _moments_compute(2), _moments_merge, _var_finalize(False), lambda e: (0.0, 0.0, 0.0)),
     "var_pop": AggSpec(1, _moments_compute(2), _moments_merge, _var_finalize(False), lambda e: (0.0, 0.0, 0.0)),
     "var_samp": AggSpec(1, _moments_compute(2), _moments_merge, _var_finalize(True), lambda e: (0.0, 0.0, 0.0)),

@@ -173,6 +173,20 @@ class ArrayLiteral(Expr):
 
 
 @dataclass(frozen=True)
+class PredicateExpr(Expr):
+    """A boolean predicate used in VALUE position — function arguments that
+    are conditions, e.g. the step conditions of the funnel aggregations:
+    FUNNELCOUNT(STEPS(url = '/cart', url = '/buy'), CORRELATE_BY(uid)).
+    Reference parity: Pinot passes funnel steps as filter-context arguments
+    (core/query/aggregation/function/funnel/)."""
+
+    pred: "FilterExpr"
+
+    def __str__(self) -> str:
+        return str(self.pred)
+
+
+@dataclass(frozen=True)
 class PredicateFunction(FilterExpr):
     """Boolean index-probe functions used as WHERE predicates: TEXT_MATCH,
     JSON_MATCH, VECTOR_SIMILARITY, ST_WITHIN-style geo probes.

@@ -76,6 +76,44 @@ AGG_FUNCS = {
     "distinctcountcpc",
     "distinctcountull",
     "segmentpartitioneddistinctcount",
+    # MV variants (Count/Sum/Min/Max/Avg/DistinctCount-MVAggregationFunction)
+    "countmv",
+    "summv",
+    "minmv",
+    "maxmv",
+    "avgmv",
+    "distinctcountmv",
+    "minmaxrangemv",
+    "distinctsummv",
+    "distinctavgmv",
+    "distinctcountbitmapmv",
+    "distinctcounthllmv",
+    "percentilemv",
+    # funnel family (core/query/aggregation/function/funnel/)
+    "funnelcount",
+    "funnelcompletecount",
+    "funnelmatchstep",
+    "funnelmaxstep",
+    "funnelstepdurationstats",
+    # smart / raw-sketch / misc long tail
+    "distinctcountsmarthll",
+    "percentilesmarttdigest",
+    "sumprecision",
+    "idset",
+    "frequentlongssketch",
+    "frequentstringssketch",
+    "distinctcountrawhll",
+    "distinctcountrawthetasketch",
+    "percentilerawest",
+    "percentilerawtdigest",
+}
+
+FUNNEL_AGGS = {
+    "funnelcount",
+    "funnelcompletecount",
+    "funnelmatchstep",
+    "funnelmaxstep",
+    "funnelstepdurationstats",
 }
 
 
@@ -122,6 +160,45 @@ class AggregationInfo:
         return self.name
 
 
+def _parse_funnel_args(fname: str, expr: FunctionCall):
+    """Parse the funnel dialect (see query/funnel.py docstring). Returns
+    (arg, arg2, extra): count variants -> (correlate, None, ('steps', steps));
+    windowed -> (ts_expr, correlate, ('steps', window, steps))."""
+    from pinot_tpu.query.ast import PredicateExpr
+
+    windowed = fname in ("funnelmatchstep", "funnelmaxstep", "funnelstepdurationstats")
+    pos = list(expr.args)
+    ts = None
+    window = 0.0
+    if windowed:
+        if len(pos) < 3 or not isinstance(pos[1], Literal):
+            raise ValueError(f"{fname} requires (ts_expr, window, STEPS(...), CORRELATE_BY(col))")
+        ts, window, pos = pos[0], float(pos[1].value), pos[2:]
+    steps = None
+    corr = None
+    for a in pos:
+        if isinstance(a, FunctionCall) and a.name == "steps":
+            parsed = []
+            for x in a.args:
+                if not isinstance(x, PredicateExpr):
+                    raise ValueError(f"{fname} STEPS entries must be predicates (col = value)")
+                parsed.append(x.pred)
+            steps = tuple(parsed)
+        elif isinstance(a, FunctionCall) and a.name == "correlate_by":
+            if len(a.args) != 1:
+                raise ValueError("CORRELATE_BY takes one column")
+            corr = a.args[0]
+        elif isinstance(a, FunctionCall) and a.name == "settings":
+            continue  # accepted, currently advisory
+        else:
+            raise ValueError(f"unexpected {fname} argument: {a}")
+    if not steps or corr is None:
+        raise ValueError(f"{fname} requires STEPS(...) and CORRELATE_BY(col)")
+    if windowed:
+        return ts, corr, ("steps", window, steps)
+    return corr, None, ("steps", steps)
+
+
 def _extract_aggs(expr: Expr, out: dict[str, AggregationInfo]) -> bool:
     """Collect aggregations in expr; returns True if expr contains any."""
     from pinot_tpu.query.ast import BinaryOp
@@ -139,12 +216,31 @@ def _extract_aggs(expr: Expr, out: dict[str, AggregationInfo]) -> bool:
                 name = canonical(FunctionCall("distinctcount", expr.args))
             elif fname == "count":
                 func, arg, name = "count", None, canonical(expr)
+            elif fname in FUNNEL_AGGS:
+                func, name = fname, canonical(expr)
+                arg, arg2, extra = _parse_funnel_args(fname, expr)
             else:
                 func, arg, name = fname, (expr.args[0] if expr.args else None), canonical(expr)
-                if fname in ("percentile", "percentileest", "percentiletdigest", "percentilekll"):
+                if fname in (
+                    "percentile",
+                    "percentileest",
+                    "percentiletdigest",
+                    "percentilekll",
+                    "percentilemv",
+                    "percentilesmarttdigest",
+                    "percentilerawest",
+                    "percentilerawtdigest",
+                ):
                     if len(expr.args) != 2 or not isinstance(expr.args[1], Literal):
                         raise ValueError(f"{fname} requires (column, percentile) arguments")
                     extra = (float(expr.args[1].value),)
+                elif fname in ("frequentlongssketch", "frequentstringssketch"):
+                    # optional maxMapSize literal (FrequentItems sketch size)
+                    extra = (
+                        int(expr.args[1].value)
+                        if len(expr.args) > 1 and isinstance(expr.args[1], Literal)
+                        else 64,
+                    )
                 elif fname == "histogram":
                     if len(expr.args) != 4 or not all(isinstance(a, Literal) for a in expr.args[1:]):
                         raise ValueError("histogram requires (column, lo, hi, numBins) arguments")
@@ -186,10 +282,12 @@ def _filter_agg_scan(f: FilterExpr, out: dict[str, AggregationInfo]) -> None:
 
 
 def _collect_identifiers(expr: Expr, out: set[str]) -> None:
-    from pinot_tpu.query.ast import BinaryOp
+    from pinot_tpu.query.ast import BinaryOp, PredicateExpr
 
     if isinstance(expr, Identifier):
         out.add(expr.name)
+    elif isinstance(expr, PredicateExpr):
+        _collect_filter_identifiers(expr.pred, out)
     elif isinstance(expr, FunctionCall):
         for a in expr.args:
             _collect_identifiers(a, out)

@@ -200,9 +200,9 @@ class QueryEngine:
         for a, spec_entry, p in zip(ctx.aggregations, plan.spec[3], parts):
             while spec_entry[0] == "masked":  # FILTER(WHERE) wrapper
                 spec_entry = spec_entry[2]
-            if a.func == "count":
+            if a.func in ("count", "countmv"):
                 out.append(int(p))
-            elif a.func in ("distinctcount", "distinctcountbitmap"):
+            elif a.func in ("distinctcount", "distinctcountbitmap", "distinctcountmv"):
                 col = spec_entry[1]
                 ci = seg.columns[col]
                 presence = np.asarray(p)[: ci.cardinality]
@@ -213,8 +213,8 @@ class QueryEngine:
             elif a.func == "percentileest":
                 lo, hi = ctx.hints["est_bounds"][a.name]
                 out.append((np.asarray(p), lo, hi))
-            elif a.func in ("avg", "minmaxrange"):
-                out.append((float(p[0]), int(p[1]) if a.func == "avg" else float(p[1])))
+            elif a.func in ("avg", "avgmv", "minmaxrange"):
+                out.append((float(p[0]), int(p[1]) if a.func in ("avg", "avgmv") else float(p[1])))
             else:
                 out.append(float(p))
         return out
@@ -234,9 +234,9 @@ class QueryEngine:
             return pd.DataFrame(data)
         aggs_spec = plan.spec[3]
         for i, (a, spec_entry, p) in enumerate(zip(ctx.aggregations, aggs_spec, parts)):
-            if a.func == "count":
+            if a.func in ("count", "countmv"):
                 data[f"a{i}p0"] = np.asarray(p)[pg]
-            elif a.func in ("avg", "minmaxrange"):
+            elif a.func in ("avg", "avgmv", "minmaxrange"):
                 data[f"a{i}p0"] = np.asarray(p[0])[pg]
                 data[f"a{i}p1"] = np.asarray(p[1])[pg]
             else:

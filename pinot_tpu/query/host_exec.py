@@ -131,6 +131,27 @@ def _coerce_lit(v):
     return v
 
 
+def _mv_column(seg: ImmutableSegment, expr) -> "object | None":
+    """ColumnIndex when expr is an MV identifier, else None."""
+    if isinstance(expr, ast.Identifier):
+        ci = seg.columns.get(expr.name)
+        if ci is not None and ci.is_mv:
+            return ci
+    return None
+
+
+def _mv_flat_values(ci) -> np.ndarray:
+    return ci.dictionary.get_many(ci.forward) if ci.dictionary is not None else ci.forward
+
+
+def _mv_any_match(ci, flat_pred: np.ndarray) -> np.ndarray:
+    """Reduce a flat per-value predicate to per-doc any-match (the host twin
+    of the kernel's mv_any scatter-or)."""
+    m = np.zeros(len(ci.lens), dtype=bool)
+    np.logical_or.at(m, ci.flat_docids(), np.asarray(flat_pred, dtype=bool))
+    return m
+
+
 def filter_mask(seg: ImmutableSegment, f: ast.FilterExpr | None) -> np.ndarray:
     n = seg.n_docs
     if f is None:
@@ -154,24 +175,50 @@ def filter_mask(seg: ImmutableSegment, f: ast.FilterExpr | None) -> np.ndarray:
             from pinot_tpu.query.plan import _FLIP
 
             op = _FLIP[op]
+        mvci = _mv_column(seg, left)
+        if mvci is not None and isinstance(right, ast.Literal):
+            # MV semantics: positive predicates = any value matches; NEQ
+            # matches docs where NO value equals (exclusion)
+            flat = _mv_flat_values(mvci)
+            rv = right.value
+            if isinstance(rv, str) and flat.dtype == object:
+                flat = flat.astype(str)
+            pos_op = ast.CompareOp.EQ if op == ast.CompareOp.NEQ else op
+            m = _mv_any_match(mvci, _CMPS[pos_op](flat, rv))
+            return ~m if op == ast.CompareOp.NEQ else m
         lv = eval_value(seg, left)
         rv = eval_value(seg, right) if not isinstance(right, ast.Literal) else _coerce_lit(right.value)
         if isinstance(rv, str) and lv.dtype == object:
             lv = lv.astype(str)
         return np.asarray(_CMPS[op](lv, rv), dtype=bool)
     if isinstance(f, ast.Between):
-        v = eval_value(seg, f.expr)
         lo = f.low.value if isinstance(f.low, ast.Literal) else None
         hi = f.high.value if isinstance(f.high, ast.Literal) else None
         if lo is None or hi is None:
             raise PlanError("BETWEEN bounds must be literals")
+        mvci = _mv_column(seg, f.expr)
+        if mvci is not None:
+            v = _mv_flat_values(mvci)
+            if v.dtype == object:
+                v = v.astype(str)
+            m = _mv_any_match(mvci, (v >= lo) & (v <= hi))
+            return ~m if f.negated else m
+        v = eval_value(seg, f.expr)
         if v.dtype == object:
             v = v.astype(str)
         m = (v >= lo) & (v <= hi)
         return ~m if f.negated else m
     if isinstance(f, ast.In):
-        v = eval_value(seg, f.expr)
         vals = [x.value for x in f.values if isinstance(x, ast.Literal)]
+        mvci = _mv_column(seg, f.expr)
+        if mvci is not None:
+            v = _mv_flat_values(mvci)
+            if v.dtype == object:
+                v = v.astype(str)
+                vals = [str(x) for x in vals]
+            m = _mv_any_match(mvci, np.isin(v, np.asarray(vals)))
+            return ~m if f.negated else m
+        v = eval_value(seg, f.expr)
         if v.dtype == object:
             v = v.astype(str)
             vals = [str(x) for x in vals]
@@ -265,6 +312,107 @@ def predicate_function_mask(seg: ImmutableSegment, f: "ast.PredicateFunction") -
 # ---------------------------------------------------------------------------
 
 
+_MV_AGGS = (
+    "countmv",
+    "summv",
+    "minmv",
+    "maxmv",
+    "avgmv",
+    "distinctcountmv",
+    "minmaxrangemv",
+    "distinctsummv",
+    "distinctavgmv",
+    "distinctcountbitmapmv",
+    "distinctcounthllmv",
+    "percentilemv",
+)
+_MV_SET_AGGS = ("distinctcountmv", "distinctsummv", "distinctavgmv", "distinctcountbitmapmv", "distinctcounthllmv")
+
+
+def _funnel_mod():
+    from pinot_tpu.query import funnel
+
+    return funnel
+
+
+def _mv_agg_column(seg: ImmutableSegment, a) -> "object":
+    if not isinstance(a.arg, ast.Identifier):
+        raise PlanError(f"{a.func} requires an MV column argument")
+    ci = seg.columns.get(a.arg.name)
+    if ci is None or not ci.is_mv:
+        raise PlanError(f"{a.func} requires a multi-value column")
+    return ci
+
+
+def _mv_scalar_partial(func: str, flat: np.ndarray):
+    """Partial over the matched flat values, shaped like the SV twin's."""
+    if func == "countmv":
+        return int(len(flat))
+    if func in _MV_SET_AGGS:
+        return set(flat.tolist())
+    if func == "percentilemv":
+        return flat.astype(np.float64)
+    v = flat.astype(np.float64)
+    if func == "summv":
+        return float(v.sum())
+    if func == "minmv":
+        return float(v.min()) if len(v) else float("inf")
+    if func == "maxmv":
+        return float(v.max()) if len(v) else float("-inf")
+    if func == "minmaxrangemv":
+        return (
+            float(v.min()) if len(v) else float("inf"),
+            float(v.max()) if len(v) else float("-inf"),
+        )
+    # avgmv
+    return (float(v.sum()), int(len(v)))
+
+
+def _mv_doc_partials(func: str, ci, mask: np.ndarray) -> dict[str, np.ndarray]:
+    """Per-doc pre-aggregates for MV group-by (masked-doc aligned):
+    the group merge then only needs the SV twin's sum/min/max/union."""
+    n = len(ci.lens)
+    docids = ci.flat_docids()
+    if func == "countmv":
+        return {"p0": ci.lens[mask].astype(np.int64)}
+    flat = _mv_flat_values(ci)
+    if func in _MV_SET_AGGS or func == "percentilemv":
+        # build cells only for masked docs — a selective filter must not pay
+        # a python loop over the whole segment
+        sel = np.nonzero(mask)[0]
+        cells = np.empty(len(sel), dtype=object)
+        off = ci.offsets()
+        for i, d in enumerate(sel):
+            chunk = flat[off[d] : off[d + 1]]
+            cells[i] = (
+                chunk.astype(np.float64) if func == "percentilemv" else set(chunk.tolist())
+            )
+        return {"p0": cells}
+    v = flat.astype(np.float64)
+    if func == "summv":
+        s = np.zeros(n, dtype=np.float64)
+        np.add.at(s, docids, v)
+        return {"p0": s[mask]}
+    if func == "minmv":
+        m = np.full(n, np.inf)
+        np.minimum.at(m, docids, v)
+        return {"p0": m[mask]}
+    if func == "maxmv":
+        m = np.full(n, -np.inf)
+        np.maximum.at(m, docids, v)
+        return {"p0": m[mask]}
+    if func == "minmaxrangemv":
+        lo = np.full(n, np.inf)
+        hi = np.full(n, -np.inf)
+        np.minimum.at(lo, docids, v)
+        np.maximum.at(hi, docids, v)
+        return {"p0": lo[mask], "p1": hi[mask]}
+    # avgmv
+    s = np.zeros(n, dtype=np.float64)
+    np.add.at(s, docids, v)
+    return {"p0": s[mask], "p1": ci.lens[mask].astype(np.int64)}
+
+
 def agg_partials(seg: ImmutableSegment, ctx: QueryContext, query_mask: np.ndarray) -> list:
     from pinot_tpu.query.aggregates import EXT_AGGS
 
@@ -274,6 +422,15 @@ def agg_partials(seg: ImmutableSegment, ctx: QueryContext, query_mask: np.ndarra
         mask = query_mask if a.filter is None else (query_mask & filter_mask(seg, a.filter))
         if a.func == "count":
             out.append(int(mask.sum()))
+            continue
+        if a.func in _MV_AGGS:
+            ci = _mv_agg_column(seg, a)
+            vm = mask[ci.flat_docids()]
+            flat = _mv_flat_values(ci)[vm]
+            out.append(_mv_scalar_partial(a.func, flat))
+            continue
+        if a.func in _funnel_mod().FUNNEL_AGGS:
+            out.append(_funnel_mod().segment_partial(seg, a, mask))
             continue
         if a.func in EXT_AGGS:
             spec = EXT_AGGS[a.func]
@@ -342,12 +499,34 @@ def group_frame(seg: ImmutableSegment, ctx: QueryContext, mask: np.ndarray) -> p
         v = eval_value(seg, g)[mask]
         data[f"k{i}"] = v.astype(str) if v.dtype == object else v
     filtered_ok = {"count", "sum", "min", "max", "avg", "minmaxrange"}
+    mv_docaggs: dict[int, dict[str, np.ndarray]] = {}
     for i, a in enumerate(ctx.aggregations):
         if a.filter is not None:
             if a.func not in filtered_ok:
                 raise PlanError(f"FILTER(WHERE) on {a.func} inside GROUP BY is not supported")
             data[f"f{i}"] = filter_mask(seg, a.filter)[mask]
         if a.func == "count":
+            continue
+        if a.func in _MV_AGGS:
+            # per-doc pre-aggregation over the flat layout; the group merge
+            # then reuses the SV twin's reducers (sum/min/max/union)
+            ci = _mv_agg_column(seg, a)
+            for suffix, arr in _mv_doc_partials(a.func, ci, mask).items():
+                data[f"m{i}{suffix}"] = arr
+            mv_docaggs[i] = True
+            continue
+        if a.func in _funnel_mod().FUNNEL_AGGS:
+            fun = _funnel_mod()
+            steps = a.extra[-1]
+            bits = np.zeros(int(mask.sum()), dtype=np.int64)
+            for k, s in enumerate(steps):
+                bits |= filter_mask(seg, s)[mask].astype(np.int64) << k
+            data[f"fb{i}"] = bits
+            if fun.is_windowed(a.func):
+                data[f"fc{i}"] = eval_value(seg, a.arg2)[mask]
+                data[f"ft{i}"] = np.asarray(eval_value(seg, a.arg), dtype=np.float64)[mask]
+            else:
+                data[f"fc{i}"] = eval_value(seg, a.arg)[mask]
             continue
         v = eval_value(seg, a.arg)[mask]
         if a.filter is not None:
@@ -369,6 +548,45 @@ def group_frame(seg: ImmutableSegment, ctx: QueryContext, mask: np.ndarray) -> p
     out = g.size().rename("__size").reset_index()
     for i, a in enumerate(ctx.aggregations):
         filtered = a.filter is not None
+        if i in mv_docaggs:
+            if a.func in ("countmv", "summv"):
+                out[f"a{i}p0"] = g[f"m{i}p0"].sum().values
+            elif a.func == "minmv":
+                out[f"a{i}p0"] = g[f"m{i}p0"].min().values
+            elif a.func == "maxmv":
+                out[f"a{i}p0"] = g[f"m{i}p0"].max().values
+            elif a.func == "avgmv":
+                out[f"a{i}p0"] = g[f"m{i}p0"].sum().values
+                out[f"a{i}p1"] = g[f"m{i}p1"].sum().values
+            elif a.func == "minmaxrangemv":
+                out[f"a{i}p0"] = g[f"m{i}p0"].min().values
+                out[f"a{i}p1"] = g[f"m{i}p1"].max().values
+            elif a.func == "percentilemv":
+                out[f"a{i}p0"] = g[f"m{i}p0"].apply(
+                    lambda s: np.concatenate([np.asarray(x, dtype=np.float64) for x in s])
+                ).values
+            else:  # distinct*-mv set partials
+                out[f"a{i}p0"] = g[f"m{i}p0"].agg(lambda s: set().union(*s)).values
+            continue
+        if a.func in _funnel_mod().FUNNEL_AGGS:
+            fun = _funnel_mod()
+            nsteps = len(a.extra[-1])
+            if fun.is_windowed(a.func):
+                def _fpart(sub, _i=i):
+                    b = sub[f"fb{_i}"].to_numpy(np.int64)
+                    keep = b != 0
+                    return fun.events_partial(
+                        sub[f"fc{_i}"].to_numpy()[keep],
+                        sub[f"ft{_i}"].to_numpy(np.float64)[keep],
+                        b[keep],
+                    )
+            else:
+                def _fpart(sub, _i=i, _n=nsteps):
+                    b = sub[f"fb{_i}"].to_numpy(np.int64)
+                    c = sub[f"fc{_i}"].to_numpy()
+                    return [set(c[(b & (1 << k)) != 0].tolist()) for k in range(_n)]
+            out[f"a{i}p0"] = g.apply(_fpart, include_groups=False).values
+            continue
         if a.func == "count":
             out[f"a{i}p0"] = g[f"f{i}"].sum().values if filtered else out["__size"]
         elif a.func == "sum":

@@ -36,14 +36,16 @@ _I = jnp.int64
 # ---------------------------------------------------------------------------
 
 
-def _value(vspec, cols, ops):
+def _value(vspec, cols, ops, n_padded):
+    """Evaluate a value spec over doc-aligned arrays of length n_padded.
+    n_padded is threaded explicitly: cols may also hold MV flat arrays, so
+    the doc length cannot be inferred from an arbitrary cols entry."""
     kind = vspec[0]
     if kind == "raw":
         return cols[vspec[1]]
     if kind == "ids":
         return cols[vspec[1]]
     if kind == "docid":
-        n_padded = next(iter(cols.values())).shape[0]
         return jnp.arange(n_padded, dtype=jnp.int32)
     if kind == "dictval":
         return ops[vspec[2]][cols[vspec[1]]]
@@ -53,27 +55,26 @@ def _value(vspec, cols, ops):
         from pinot_tpu.query.transforms import DEVICE_FUNCS
 
         _, fn = DEVICE_FUNCS[vspec[1]]
-        args = [_value(a, cols, ops) for a in vspec[2]]
+        args = [_value(a, cols, ops, n_padded) for a in vspec[2]]
         return fn(jnp, *args)
     if kind == "case":
         # reversed fold: first matching WHEN wins
-        n_padded = next(iter(cols.values())).shape[0]
-        out = _value(vspec[2], cols, ops)
+        out = _value(vspec[2], cols, ops, n_padded)
         out = jnp.broadcast_to(out.astype(_F), (n_padded,))
         for fspec, branch in reversed(vspec[1]):
             cond = _filter(fspec, cols, ops, n_padded)
-            out = jnp.where(cond, _value(branch, cols, ops).astype(_F), out)
+            out = jnp.where(cond, _value(branch, cols, ops, n_padded).astype(_F), out)
         return out
     if kind == "cast_int":
-        v = _value(vspec[1], cols, ops)
+        v = _value(vspec[1], cols, ops, n_padded)
         # truncate toward zero (Pinot CAST AS INT/LONG semantics)
         return jnp.trunc(v.astype(_F)).astype(_I) if jnp.issubdtype(v.dtype, jnp.floating) else v
     if kind == "cast_float":
-        return _value(vspec[1], cols, ops).astype(_F)
+        return _value(vspec[1], cols, ops, n_padded).astype(_F)
     if kind == "bin":
         op = vspec[1]
-        l = _value(vspec[2], cols, ops)
-        r = _value(vspec[3], cols, ops)
+        l = _value(vspec[2], cols, ops, n_padded)
+        r = _value(vspec[3], cols, ops, n_padded)
         if op == "+":
             return l + r
         if op == "-":
@@ -136,16 +137,43 @@ def _filter(fspec, cols, ops, n_padded):
             return _CMPS[fspec[1]](v, o.astype(v.dtype))
         return _CMPS[fspec[1]](v.astype(_F), o)
     if kind == "cmp_lit":
-        v = _value(fspec[2], cols, ops)
+        v = _value(fspec[2], cols, ops, n_padded)
         return _CMPS[fspec[1]](v.astype(_F), ops[fspec[3]])
     if kind == "cmp2":
-        l = _value(fspec[2], cols, ops)
-        r = _value(fspec[3], cols, ops)
+        l = _value(fspec[2], cols, ops, n_padded)
+        r = _value(fspec[3], cols, ops, n_padded)
         return _CMPS[fspec[1]](l.astype(_F), r.astype(_F))
     if kind == "in_vals":
-        v = _value(fspec[1], cols, ops).astype(_F)
+        v = _value(fspec[1], cols, ops, n_padded).astype(_F)
         vals = ops[fspec[2]]
         return (v[:, None] == vals[None, :]).any(axis=1)
+    if kind == "in_sorted":
+        # membership via sorted probe: searchsorted + one gather — flat in
+        # IN-list length (vals operand is sorted, padded by repeating the max)
+        v = _value(fspec[1], cols, ops, n_padded)
+        vals = ops[fspec[2]]
+        if not (jnp.issubdtype(v.dtype, jnp.integer) and jnp.issubdtype(vals.dtype, jnp.integer)):
+            v = v.astype(_F)
+            vals = vals.astype(_F)
+        elif v.dtype != vals.dtype:
+            # widen the narrower side — narrowing the sorted probe list could
+            # wrap out-of-range literals and break its ordering
+            if jnp.iinfo(vals.dtype).bits > jnp.iinfo(v.dtype).bits:
+                v = v.astype(vals.dtype)
+            else:
+                vals = vals.astype(v.dtype)
+        pos = jnp.clip(jnp.searchsorted(vals, v), 0, vals.shape[0] - 1)
+        return vals[pos] == v
+    if kind == "mv_any":
+        # flattened-MV any-match: evaluate the inner predicate over the flat
+        # value vector, then scatter-or into doc space (padding docids point
+        # past the doc range and are dropped by the scatter)
+        _, col, inner, nv_idx = fspec
+        flat = cols[col]
+        pred = _filter(inner, cols, ops, flat.shape[0])
+        pred = pred & (jnp.arange(flat.shape[0], dtype=jnp.int32) < ops[nv_idx])
+        docids = cols[f"{col}!docs"]
+        return jnp.zeros((n_padded,), dtype=bool).at[docids].max(pred, mode="drop")
     raise AssertionError(fspec)
 
 
@@ -208,7 +236,7 @@ def _int_grouped_extreme(v, gid, mask, ng, is_min):
     return jnp.where(hit, r.astype(_F), empty)
 
 
-def _hashes_for(hspec, cols, ops):
+def _hashes_for(hspec, cols, ops, n_padded):
     from pinot_tpu.query.sketches import jnp_mix32
 
     if hspec[0] == "gather":
@@ -216,13 +244,23 @@ def _hashes_for(hspec, cols, ops):
     # ("mix", vspec): hash numeric values by bit pattern. Integers hash by
     # value; floats by their f64 bit pattern split into two u32 words so equal
     # values hash identically across segments.
-    v = _value(hspec[1], cols, ops)
+    v = _value(hspec[1], cols, ops, n_padded)
     if jnp.issubdtype(v.dtype, jnp.floating):
         bits = jax.lax.bitcast_convert_type(v.astype(_F), jnp.uint32)  # (..., 2)
         return jnp_mix32(jnp, bits[..., 0] ^ jnp_mix32(jnp, bits[..., 1]))
     lo = (v & 0xFFFFFFFF).astype(jnp.uint32)
     hi = ((v.astype(_I) >> 32) & 0xFFFFFFFF).astype(jnp.uint32)
     return jnp_mix32(jnp, lo ^ jnp_mix32(jnp, hi))
+
+
+def _mv_vmask(col, nv_idx, cols, ops, mask):
+    """Per-flat-value mask for MV aggregations: the doc mask gathered to each
+    value position, ANDed with flat-padding validity. Padding docids point
+    past the doc range (gathers clip, but validity zeroes them)."""
+    flat = cols[col]
+    docids = cols[f"{col}!docs"]
+    vvalid = jnp.arange(flat.shape[0], dtype=jnp.int32) < ops[nv_idx]
+    return mask[docids] & vvalid
 
 
 def _agg_scalar(aspec, cols, ops, mask):
@@ -233,6 +271,18 @@ def _agg_scalar(aspec, cols, ops, mask):
         return _agg_scalar(aspec[2], cols, ops, m2)
     if kind == "count":
         return jnp.sum(mask, dtype=jnp.int32).astype(_I)
+    if kind == "mv_count":
+        vm = _mv_vmask(aspec[1], aspec[2], cols, ops, mask)
+        return jnp.sum(vm, dtype=jnp.int32).astype(_I)
+    if kind == "mv_distinct_ids":
+        col, pad = aspec[1], aspec[2]
+        vm = _mv_vmask(col, aspec[3], cols, ops, mask)
+        return jnp.zeros((pad,), dtype=bool).at[cols[col]].max(vm)
+    if kind in ("mv_sum", "mv_min", "mv_max", "mv_avg"):
+        vspec, col, nv_idx = aspec[1], aspec[2], aspec[3]
+        vm = _mv_vmask(col, nv_idx, cols, ops, mask)
+        inner = {"mv_sum": "sum", "mv_min": "min", "mv_max": "max", "mv_avg": "avg"}[kind]
+        return _agg_scalar((inner, vspec), cols, ops, vm)
     if kind == "distinct_ids":
         col, pad = aspec[1], aspec[2]
         presence = jnp.zeros((pad,), dtype=bool).at[cols[col]].max(mask)
@@ -240,14 +290,14 @@ def _agg_scalar(aspec, cols, ops, mask):
     if kind == "hll":
         from pinot_tpu.query.sketches import hll_update
 
-        hashes = _hashes_for(aspec[1], cols, ops)
+        hashes = _hashes_for(aspec[1], cols, ops, mask.shape[0])
         return hll_update(jnp, jax, hashes, mask, aspec[2])
     if kind == "hist":
-        v = _value(aspec[1], cols, ops).astype(_F)
+        v = _value(aspec[1], cols, ops, mask.shape[0]).astype(_F)
         lo, inv_w, nbins = ops[aspec[2]], ops[aspec[3]], aspec[4]
         b = jnp.clip(jnp.floor((v - lo) * inv_w).astype(jnp.int32), 0, nbins - 1)
         return jax.ops.segment_sum(mask.astype(_I), b, num_segments=nbins)
-    v_raw = _value(aspec[1], cols, ops)
+    v_raw = _value(aspec[1], cols, ops, mask.shape[0])
     is_i32 = v_raw.dtype == jnp.int32
     v = v_raw.astype(_F)
     if kind == "sum":
@@ -288,7 +338,18 @@ def _agg_grouped(aspec, cols, ops, mask, gid, ng):
         return _agg_grouped(aspec[2], cols, ops, m2, gid, ng)
     if kind == "count":
         return _count_grouped(mask, gid, ng)
-    v_raw = _value(aspec[1], cols, ops)
+    if kind == "mv_count":
+        col, nv_idx = aspec[1], aspec[2]
+        vm = _mv_vmask(col, nv_idx, cols, ops, mask)
+        gid_v = gid[cols[f"{col}!docs"]]  # padding positions masked by vm
+        return _count_grouped(vm, gid_v, ng)
+    if kind in ("mv_sum", "mv_min", "mv_max", "mv_avg"):
+        vspec, col, nv_idx = aspec[1], aspec[2], aspec[3]
+        vm = _mv_vmask(col, nv_idx, cols, ops, mask)
+        gid_v = gid[cols[f"{col}!docs"]]
+        inner = {"mv_sum": "sum", "mv_min": "min", "mv_max": "max", "mv_avg": "avg"}[kind]
+        return _agg_grouped((inner, vspec), cols, ops, vm, gid_v, ng)
+    v_raw = _value(aspec[1], cols, ops, mask.shape[0])
     is_i32 = v_raw.dtype == jnp.int32
     v = v_raw.astype(_F)
     if kind == "sum":
@@ -331,7 +392,7 @@ def _grouped_all(aggs, cols, ops, mask, gid, ng):
         vals, owner = [], {}
         for i, a in enumerate(aggs):
             if a[0] in ("sum", "avg"):
-                v_raw = _value(a[1], cols, ops)
+                v_raw = _value(a[1], cols, ops, mask.shape[0])
                 if v_raw.dtype == jnp.int32:
                     owner[i] = len(vals)
                     vals.append(v_raw)
@@ -365,8 +426,7 @@ def build_fn(spec: tuple):
     if kind == "agg":
         _, fspec, gspec, aggs = spec
 
-        def run(cols, ops, n_docs):
-            n_padded = next(iter(cols.values())).shape[0]
+        def run(cols, ops, n_docs, n_padded):
             valid = jnp.arange(n_padded, dtype=jnp.int32) < n_docs
             mask = valid & _filter(fspec, cols, ops, n_padded)
             matched = jnp.sum(mask, dtype=jnp.int32).astype(_I)
@@ -382,16 +442,27 @@ def build_fn(spec: tuple):
 
         return run
 
+    if kind == "mask":
+        # filter-only program: the multistage leaf Scan's fused filter
+        # (plan.plan_filter_mask). Returns the bool doc mask; caller trims
+        # the padding tail.
+        _, fspec = spec
+
+        def run_mask(cols, ops, n_docs, n_padded):
+            valid = jnp.arange(n_padded, dtype=jnp.int32) < n_docs
+            return valid & _filter(fspec, cols, ops, n_padded)
+
+        return run_mask
+
     if kind == "select":
         _, fspec, proj, k = spec
 
-        def run_select(cols, ops, n_docs):
-            n_padded = next(iter(cols.values())).shape[0]
+        def run_select(cols, ops, n_docs, n_padded):
             valid = jnp.arange(n_padded, dtype=jnp.int32) < n_docs
             mask = valid & _filter(fspec, cols, ops, n_padded)
             matched = jnp.sum(mask, dtype=_I)
             idx = jnp.nonzero(mask, size=k, fill_value=0)[0]
-            outs = tuple(_value(p, cols, ops)[idx] for p in proj)
+            outs = tuple(_value(p, cols, ops, n_padded)[idx] for p in proj)
             return matched, outs
 
         return run_select
@@ -399,16 +470,15 @@ def build_fn(spec: tuple):
     if kind == "select_ob":
         _, fspec, proj, kspec, desc, k = spec
 
-        def run_ob(cols, ops, n_docs):
-            n_padded = next(iter(cols.values())).shape[0]
+        def run_ob(cols, ops, n_docs, n_padded):
             valid = jnp.arange(n_padded, dtype=jnp.int32) < n_docs
             mask = valid & _filter(fspec, cols, ops, n_padded)
             matched = jnp.sum(mask, dtype=_I)
-            key = _value(kspec, cols, ops).astype(_F)
+            key = _value(kspec, cols, ops, n_padded).astype(_F)
             sort_key = jnp.where(mask, key if desc else -key, -jnp.inf)
             kk = min(k, n_padded)
             _, idx = jax.lax.top_k(sort_key, kk)
-            outs = tuple(_value(p, cols, ops)[idx] for p in proj)
+            outs = tuple(_value(p, cols, ops, n_padded)[idx] for p in proj)
             keys_out = key[idx]
             return matched, keys_out, outs
 
@@ -448,8 +518,10 @@ def build_masked_fn(spec: tuple):
 
 @lru_cache(maxsize=1024)
 def get_kernel(spec: tuple):
-    """Jitted program for a plan spec. One compile per (spec, input shapes)."""
-    return jax.jit(build_fn(spec))
+    """Jitted program for a plan spec. One compile per (spec, input shapes).
+    n_padded (the doc-pad length) is static: cols may contain MV flat arrays,
+    so the doc shape cannot be inferred from an arbitrary entry."""
+    return jax.jit(build_fn(spec), static_argnums=3)
 
 
 def run_plan(plan, device_segment):
@@ -462,4 +534,4 @@ def run_plan(plan, device_segment):
         any_col = next(iter(device_segment.arrays))
         cols = {"__shape__": device_segment.arrays[any_col]}
     ops = tuple(jnp.asarray(o) for o in plan.operands)
-    return kernel(cols, ops, np.int32(device_segment.n_docs))
+    return kernel(cols, ops, np.int32(device_segment.n_docs), device_segment.padded)

@@ -26,11 +26,30 @@ import numpy as np
 import pandas as pd
 
 from pinot_tpu.query import ast
+from pinot_tpu.query import funnel as _funnel
 from pinot_tpu.query.context import QueryContext, canonical
 from pinot_tpu.query.result import ResultTable
 
 # number of partial slots per aggregation function
-PART_COUNTS = {"avg": 2, "minmaxrange": 2}
+PART_COUNTS = {"avg": 2, "minmaxrange": 2, "avgmv": 2, "minmaxrangemv": 2}
+
+# MV aggregations produce partials shaped exactly like their single-value
+# twins (CountMVAggregationFunction et al. reuse the SV merge logic in the
+# reference too) — reduce-side handling maps through this table.
+MV_TWIN = {
+    "countmv": "count",
+    "summv": "sum",
+    "minmv": "min",
+    "maxmv": "max",
+    "avgmv": "avg",
+    "distinctcountmv": "distinctcount",
+    "minmaxrangemv": "minmaxrange",
+    "distinctsummv": "distinctsum",
+    "distinctavgmv": "distinctavg",
+    "distinctcountbitmapmv": "distinctcountbitmap",
+    "distinctcounthllmv": "distinctcounthll",
+    "percentilemv": "percentile",
+}
 
 
 def parts_of(func: str) -> int:
@@ -119,7 +138,11 @@ def eval_having(f: ast.FilterExpr, env: dict[str, Any], aliases: dict[str, ast.E
 
 def _merge_agg_partials(func: str, a, b):
     from pinot_tpu.query.aggregates import EXT_AGGS
+    from pinot_tpu.query.funnel import FUNNEL_AGGS, merge as funnel_merge
 
+    if func in FUNNEL_AGGS:
+        return funnel_merge(func, a, b)
+    func = MV_TWIN.get(func, func)
     if func in EXT_AGGS:
         return EXT_AGGS[func].merge(a, b)
     if func in ("count", "sum"):
@@ -164,7 +187,11 @@ def _finalize(a, p):
 
     from pinot_tpu.query.aggregates import EXT_AGGS
 
-    func = a.func
+    from pinot_tpu.query.funnel import FUNNEL_AGGS, finalize as funnel_finalize
+
+    if a.func in FUNNEL_AGGS:
+        return funnel_finalize(a.func, p, a.extra)
+    func = MV_TWIN.get(a.func, a.func)
     if func in EXT_AGGS:
         return EXT_AGGS[func].finalize(p, a.extra)
     if func == "count":
@@ -218,7 +245,11 @@ def reduce_aggregation(ctx: QueryContext, partials: list[list]) -> list[list]:
 
 def _empty_partial(func: str, extra: tuple = ()):
     from pinot_tpu.query.aggregates import EXT_AGGS
+    from pinot_tpu.query.funnel import FUNNEL_AGGS, empty_partial as funnel_empty
 
+    if func in FUNNEL_AGGS:
+        return funnel_empty(func, extra)
+    func = MV_TWIN.get(func, func)
     if func in EXT_AGGS:
         return EXT_AGGS[func].empty(extra)
     return {
@@ -259,22 +290,29 @@ def reduce_group_by(ctx: QueryContext, frames: list[pd.DataFrame]) -> list[list]
         return out
 
     for i, a in enumerate(ctx.aggregations):
-        if a.func in ("count", "sum", "avg"):
+        func = MV_TWIN.get(a.func, a.func)
+        if func in ("count", "sum", "avg"):
             for j in range(parts_of(a.func)):
                 agg_map[f"a{i}p{j}"] = "sum"
-        elif a.func == "min":
+        elif func == "min":
             agg_map[f"a{i}p0"] = "min"
-        elif a.func == "max":
+        elif func == "max":
             agg_map[f"a{i}p0"] = "max"
-        elif a.func == "minmaxrange":
+        elif func == "minmaxrange":
             agg_map[f"a{i}p0"] = "min"
             agg_map[f"a{i}p1"] = "max"
-        elif a.func in ("distinctcount", "distinctcountbitmap", "distinctcounthll"):
+        elif func in ("distinctcount", "distinctcountbitmap", "distinctcounthll"):
             apply_map[f"a{i}p0"] = lambda s: set().union(*s)
-        elif a.func in ("percentile", "percentileest", "percentiletdigest"):
+        elif func in ("percentile", "percentileest", "percentiletdigest"):
             apply_map[f"a{i}p0"] = lambda s: np.concatenate([np.asarray(x, dtype=np.float64) for x in s])
-        elif a.func == "mode":
+        elif func == "mode":
             apply_map[f"a{i}p0"] = _merge_counters
+        elif func in _funnel.FUNNEL_AGGS:
+            from functools import reduce as _reduce
+
+            apply_map[f"a{i}p0"] = lambda s, _f=a.func: _reduce(
+                lambda x, y: _funnel.merge(_f, x, y), s
+            )
         else:
             from functools import reduce as _reduce
 

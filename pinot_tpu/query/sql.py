@@ -472,6 +472,20 @@ class Parser:
 
     # -- value expressions --------------------------------------------------
 
+    def _fn_arg(self) -> Expr:
+        """A function argument: a value expression, optionally continued into
+        a comparison predicate (funnel STEPS conditions: `url = '/cart'`)."""
+        left = self._expr()
+        for sym, op in (
+            ("=", CompareOp.EQ), ("!=", CompareOp.NEQ), ("<>", CompareOp.NEQ),
+            ("<=", CompareOp.LTE), (">=", CompareOp.GTE), ("<", CompareOp.LT), (">", CompareOp.GT),
+        ):
+            if self.eat_op(sym):
+                from pinot_tpu.query.ast import PredicateExpr
+
+                return PredicateExpr(Compare(op, left, self._expr()))
+        return left
+
     def _expr(self) -> Expr:
         return self._additive()
 
@@ -558,9 +572,9 @@ class Parser:
                 distinct = self.eat_kw("DISTINCT")
                 args: list[Expr] = []
                 if not self.at_op(")"):
-                    args.append(self._expr())
+                    args.append(self._fn_arg())
                     while self.eat_op(","):
-                        args.append(self._expr())
+                        args.append(self._fn_arg())
                 self.expect_op(")")
                 fc = FunctionCall(t.text.lower(), tuple(args), distinct)
                 if self.at_kw("FILTER"):

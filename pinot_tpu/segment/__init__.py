@@ -1,6 +1,6 @@
 from pinot_tpu.segment.dictionary import Dictionary
 from pinot_tpu.segment.stats import ColumnStats
-from pinot_tpu.segment.builder import SegmentBuilder
+from pinot_tpu.segment.builder import SegmentBuilder, write_segment
 from pinot_tpu.segment.segment import ColumnIndex, DeviceSegment, ImmutableSegment
 from pinot_tpu.segment.loader import load_segment
 
@@ -8,6 +8,7 @@ __all__ = [
     "Dictionary",
     "ColumnStats",
     "SegmentBuilder",
+    "write_segment",
     "ColumnIndex",
     "DeviceSegment",
     "ImmutableSegment",

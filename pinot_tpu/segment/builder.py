@@ -95,11 +95,14 @@ class SegmentBuilder:
                 raise ValueError(f"column {col!r} length {len(raw)} != {n_docs}")
             spec = self.schema[col]
             dt = spec.data_type
-            if col in vector_cols or (not spec.single_value and np.asarray(raw).ndim == 2):
+            if col in vector_cols:
                 # embedding column: (n_docs, dim) matrix -> vector index only
                 from pinot_tpu.segment.indexes import VectorIndex
 
                 seg.extras.setdefault("vector", {})[col] = VectorIndex.build(np.asarray(raw))
+                continue
+            if not spec.single_value:
+                seg.columns[col] = self._build_mv_column(col, dt, raw)
                 continue
             raw, nulls = _separate_nulls(raw, dt, spec)
             if nulls is not None and self.config.indexing.null_handling:
@@ -123,6 +126,31 @@ class SegmentBuilder:
             seg.extras.setdefault("startree", []).append(build_star_table(seg, st_cfg))
         self._build_aux_indexes(seg)
         return seg
+
+    def _build_mv_column(self, col: str, dt: DataType, raw) -> ColumnIndex:
+        """Multi-value column -> flattened CSR ColumnIndex (per-doc value
+        lists flattened into one vector + int32 lens). Reference: the MV
+        forward index creators behind ForwardIndexReader.java:200-332."""
+        lens = np.asarray([0 if v is None else len(v) for v in raw], dtype=np.int32)
+        parts = [np.asarray(v) for v in raw if v is not None and len(v)]
+        if parts:
+            flat = np.concatenate([p.astype(object) if p.dtype == object else p for p in parts])
+        else:
+            flat = np.zeros(0, dtype=dt.np_dtype)
+        if self._use_dictionary(col):
+            dictionary, ids = Dictionary.from_column(dt, flat)
+            stats = ColumnStats.from_dictionary(col, dt, ids, dictionary)
+            fwd = ids
+        else:
+            dictionary = None
+            vals = np.asarray(flat, dtype=dt.np_dtype)
+            card = len(np.unique(vals))
+            stats = ColumnStats.collect(col, dt, vals, card)
+            fwd = vals
+        # a sorted flat vector does NOT mean sorted docs — never let the
+        # doc-range fast path fire on an MV column
+        stats.is_sorted = False
+        return ColumnIndex(col, dt, dictionary, fwd, stats, lens=lens)
 
     def _build_aux_indexes(self, seg: ImmutableSegment) -> None:
         from pinot_tpu.segment.indexes import BloomFilter, InvertedIndex, RangeIndex
@@ -197,6 +225,8 @@ def _write_segment_npz(seg: ImmutableSegment, out_dir: str | Path) -> Path:
     col_meta = []
     for col, ci in seg.columns.items():
         arrays[f"fwd::{col}"] = ci.forward
+        if ci.lens is not None:
+            arrays[f"mvlens::{col}"] = ci.lens
         if ci.dictionary is not None:
             dv = ci.dictionary.values
             if ci.data_type == DataType.BYTES:
@@ -212,6 +242,7 @@ def _write_segment_npz(seg: ImmutableSegment, out_dir: str | Path) -> Path:
                 "name": col,
                 "encoding": "DICT" if ci.dictionary is not None else "RAW",
                 "stats": ci.stats.to_dict(),
+                **({"mv": True} if ci.lens is not None else {}),
             }
         )
     star_meta = []

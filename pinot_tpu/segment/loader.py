@@ -57,7 +57,8 @@ def _reconstruct(
                 elif dt in (DataType.STRING, DataType.JSON):
                     dv = dv.astype(object)
             dictionary = Dictionary(dt, dv)
-        seg.columns[col] = ColumnIndex(col, dt, dictionary, fwd, stats)
+        lens = read(f"mvlens::{col}") if cm.get("mv") else None
+        seg.columns[col] = ColumnIndex(col, dt, dictionary, fwd, stats, lens=lens)
     for i, sm in enumerate(meta.get("starTrees", [])):
         from pinot_tpu.segment.startree import StarTable
 

@@ -37,24 +37,61 @@ def padded_len(n_docs: int) -> int:
 
 @dataclass
 class ColumnIndex:
-    """All materialized per-column data for one segment column."""
+    """All materialized per-column data for one segment column.
+
+    Multi-value columns (reference: the MV read API of ForwardIndexReader,
+    pinot-segment-spi/.../index/reader/ForwardIndexReader.java:200-332) use a
+    flattened CSR layout — `forward` holds ALL values back to back and `lens`
+    the per-doc value counts. On device this keeps every kernel a dense 1-D
+    op: predicates evaluate over the flat vector and scatter-max into doc
+    space; MV aggregations gather the doc mask to value positions."""
 
     name: str
     data_type: DataType
     dictionary: Dictionary | None  # None => raw-encoded column
     forward: np.ndarray  # int32 dict ids, or raw values (np dtype of the type)
     stats: ColumnStats
+    lens: np.ndarray | None = None  # MV only: int32 per-doc value count
 
     @property
     def is_dict_encoded(self) -> bool:
         return self.dictionary is not None
 
     @property
+    def is_mv(self) -> bool:
+        return self.lens is not None
+
+    @property
     def cardinality(self) -> int:
         return self.dictionary.cardinality if self.dictionary else self.stats.cardinality
 
+    def offsets(self) -> np.ndarray:
+        """MV: value-range start offsets per doc, length n_docs+1."""
+        out = np.zeros(len(self.lens) + 1, dtype=np.int64)
+        np.cumsum(self.lens, out=out[1:])
+        return out
+
+    def flat_docids(self) -> np.ndarray:
+        """MV: owning doc id per flat value position (int32)."""
+        return np.repeat(
+            np.arange(len(self.lens), dtype=np.int32), self.lens
+        )
+
     def materialize(self, doc_ids: np.ndarray | None = None) -> np.ndarray:
-        """Decode to raw values (optionally only for given docIds)."""
+        """Decode to raw values (optionally only for given docIds). MV columns
+        return an object array of per-doc value arrays."""
+        if self.is_mv:
+            flat = (
+                self.dictionary.get_many(self.forward)
+                if self.dictionary is not None
+                else self.forward
+            )
+            off = self.offsets()
+            docs = range(len(self.lens)) if doc_ids is None else np.asarray(doc_ids)
+            out = np.empty(len(off) - 1 if doc_ids is None else len(docs), dtype=object)
+            for i, d in enumerate(docs):
+                out[i] = flat[off[d] : off[d + 1]]
+            return out
         fwd = self.forward if doc_ids is None else self.forward[doc_ids]
         if self.dictionary is not None:
             return self.dictionary.get_many(fwd)
@@ -91,6 +128,16 @@ class ImmutableSegment:
                 total += vals.nbytes
         return total
 
+    def to_device_cached(self) -> "DeviceSegment":
+        """Memoized default staging (fast32=False). Callers outside a
+        QueryEngine (e.g. the multistage leaf Scan) share one staged copy per
+        segment instead of re-uploading columns every query."""
+        ds = getattr(self, "_device_cache", None)
+        if ds is None:
+            ds = self.to_device()
+            self._device_cache = ds
+        return ds
+
     def to_device(self, fast32: bool = False) -> "DeviceSegment":
         """Stage to device memory.
 
@@ -105,6 +152,26 @@ class ImmutableSegment:
         arrays: dict[str, Any] = {}
         for name, ci in self.columns.items():
             fwd = ci.forward
+            if ci.is_mv:
+                # flattened MV: flat value vector + owning-doc-id vector, both
+                # padded to the doc-pad granule. Padding docids point one past
+                # the padded doc range: scatters drop them, and gathers through
+                # them are masked by the per-plan n_values operand.
+                vpad = padded_len(len(fwd))
+                docids = ci.flat_docids()
+                docids = np.concatenate(
+                    [docids, np.full(vpad - len(docids), pad, dtype=np.int32)]
+                )
+                if len(fwd) < vpad:
+                    fwd = np.concatenate([fwd, np.zeros(vpad - len(fwd), dtype=fwd.dtype)])
+                if fwd.dtype == np.int64 and (
+                    np.iinfo(np.int32).min <= ci.stats.min_value
+                    and ci.stats.max_value <= np.iinfo(np.int32).max
+                ):
+                    fwd = fwd.astype(np.int32)
+                arrays[name] = jnp.asarray(fwd)
+                arrays[f"{name}!docs"] = jnp.asarray(docids)
+                continue
             if len(fwd) < pad:
                 fwd = np.concatenate([fwd, np.zeros(pad - len(fwd), dtype=fwd.dtype)])
             dt = fwd.dtype

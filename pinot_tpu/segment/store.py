@@ -34,23 +34,34 @@ MAGIC = b"PTSEGv02"
 SEGMENT_FILE = "segment.ptseg"
 
 
-def _maybe_compress(raw: bytes) -> tuple[str, bytes]:
-    """LZ4 when native is available and it actually helps, else raw."""
-    if native.available() and len(raw) >= 64:
-        comp = native.lz4_compress(raw)
+import os
+
+
+def default_chunk_codec() -> str:
+    """Segment chunk codec (ChunkCompressionType parity): lz4 (default),
+    zstd, gzip, snappy, or raw — via PINOT_TPU_CHUNK_CODEC or per-writer."""
+    return os.environ.get("PINOT_TPU_CHUNK_CODEC", "lz4")
+
+
+def _maybe_compress(raw: bytes, codec: str) -> tuple[str, bytes]:
+    """Compress with the requested codec when available and it actually
+    helps, else raw."""
+    if codec != "raw" and native.codec_available(codec) and len(raw) >= 64:
+        comp = native.chunk_compress(raw, codec)
         if len(comp) < len(raw) * 0.9:
-            return "lz4", comp
+            return codec, comp
     return "raw", raw
 
 
 class SegmentFileWriter:
-    def __init__(self):
+    def __init__(self, codec: str | None = None):
         self._blobs: list[bytes] = []
         self._entries: dict[str, dict] = {}
         self._pos = len(MAGIC)
+        self._codec = codec or default_chunk_codec()
 
     def _add(self, key: str, kind: str, raw: bytes, **meta) -> None:
-        codec, stored = _maybe_compress(raw)
+        codec, stored = _maybe_compress(raw, self._codec)
         pad = (-self._pos) % 8
         self._blobs.append(b"\x00" * pad + stored)
         self._pos += pad
@@ -113,11 +124,14 @@ def write_segment_file(seg, seg_dir: Path) -> Path:
                 w.write_array(f"dict::{col}", dv)
         else:
             w.write_array(f"fwd::{col}", ci.forward)
+        if ci.lens is not None:
+            w.write_array(f"mvlens::{col}", ci.lens)
         col_meta.append(
             {
                 "name": col,
                 "encoding": "DICT" if ci.dictionary is not None else "RAW",
                 "stats": ci.stats.to_dict(),
+                **({"mv": True} if ci.lens is not None else {}),
             }
         )
     star_meta = []
@@ -196,7 +210,7 @@ class SegmentFileReader:
 
     def _raw_bytes(self, e: dict) -> bytes:
         stored = self._buf[e["off"] : e["off"] + e["stored"]].tobytes()
-        raw = native.lz4_decompress(stored, e["raw"]) if e["codec"] == "lz4" else stored
+        raw = native.chunk_decompress(stored, e["raw"], e["codec"])
         if native.crc32(raw) != e["crc"]:
             raise ValueError(f"{self.path}: CRC mismatch on entry")
         return raw

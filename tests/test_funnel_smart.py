@@ -1,0 +1,206 @@
+"""Funnel family + smart/raw/long-tail aggregations (round-3 registry push).
+
+Reference parity: core/query/aggregation/function/funnel/ (FunnelCount +
+windowed FUNNEL_MAX_STEP family), DistinctCountSmartHLL, SumPrecision,
+IdSet, FrequentLongs/StringsSketch, the Raw* sketch-returning variants, and
+the remaining MV variants.
+"""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from pinot_tpu.common import DataType, FieldSpec, Schema
+from pinot_tpu.query import QueryEngine
+from pinot_tpu.segment import SegmentBuilder
+
+
+@pytest.fixture(scope="module")
+def events():
+    # 5 users walking a view -> cart -> buy funnel with timestamps
+    rows = [
+        # uid, ts, event
+        (1, 10, "view"), (1, 20, "cart"), (1, 30, "buy"),      # full funnel
+        (2, 10, "view"), (2, 500, "cart"),                      # cart outside window for w=100
+        (3, 10, "view"),                                        # view only
+        (4, 5, "cart"), (4, 6, "buy"),                          # skips view: no funnel
+        (5, 1, "view"), (5, 2, "cart"),                         # view+cart
+    ]
+    uid = np.asarray([r[0] for r in rows], dtype=np.int64)
+    ts = np.asarray([r[1] for r in rows], dtype=np.int64)
+    ev = np.asarray([r[2] for r in rows], dtype=object)
+    schema = Schema.build(
+        "events",
+        dimensions=[("uid", DataType.LONG), ("event", DataType.STRING)],
+        metrics=[("ts", DataType.LONG)],
+    )
+    seg = SegmentBuilder(schema).build({"uid": uid, "event": ev, "ts": ts}, "e0")
+    return QueryEngine([seg])
+
+
+STEPS = "STEPS(event = 'view', event = 'cart', event = 'buy')"
+
+
+def test_funnelcount(events):
+    res = events.execute(f"SELECT FUNNELCOUNT({STEPS}, CORRELATE_BY(uid)) FROM events")
+    # step1: uids with view = {1,2,3,5}; step2: ∩ cart = {1,2,5}; step3: ∩ buy = {1}
+    assert res.rows[0][0] == [4, 3, 1]
+
+
+def test_funnelcompletecount(events):
+    res = events.execute(f"SELECT FUNNELCOMPLETECOUNT({STEPS}, CORRELATE_BY(uid)) FROM events")
+    assert res.rows[0][0] == 1
+
+
+def test_funnelmaxstep_window(events):
+    res = events.execute(
+        f"SELECT FUNNELMAXSTEP(ts, 100, {STEPS}, CORRELATE_BY(uid)) FROM events"
+    )
+    assert res.rows[0][0] == 3  # user 1 completes within 20 time units
+    res2 = events.execute(
+        f"SELECT FUNNELMAXSTEP(ts, 5, {STEPS}, CORRELATE_BY(uid)) FROM events"
+    )
+    assert res2.rows[0][0] == 2  # window 5: user 5 reaches cart (1->2); buy chain too slow
+
+
+def test_funnelmatchstep(events):
+    res = events.execute(
+        f"SELECT FUNNELMATCHSTEP(ts, 100, {STEPS}, CORRELATE_BY(uid)) FROM events"
+    )
+    assert res.rows[0][0] == [1, 1, 1]
+
+
+def test_funnelstepdurationstats(events):
+    res = events.execute(
+        f"SELECT FUNNELSTEPDURATIONSTATS(ts, 100, {STEPS}, CORRELATE_BY(uid)) FROM events"
+    )
+    durs = res.rows[0][0]
+    assert len(durs) == 2 and durs[0] > 0
+
+
+def test_funnelcount_group_by(events):
+    res = events.execute(
+        f"SELECT event, FUNNELCOUNT(STEPS(ts >= 10, ts >= 20), CORRELATE_BY(uid)) "
+        f"FROM events GROUP BY event ORDER BY event LIMIT 10"
+    )
+    assert len(res.rows) == 3  # one funnel array per event group
+    for _, arr in res.rows:
+        assert isinstance(arr, list) and len(arr) == 2
+
+
+@pytest.fixture(scope="module")
+def numbers():
+    rng = np.random.default_rng(7)
+    n = 30_000
+    schema = Schema.build(
+        "t",
+        dimensions=[("k", DataType.STRING)],
+        metrics=[("v", DataType.LONG), ("x", DataType.DOUBLE)],
+    )
+    data = {
+        "k": np.asarray([f"k{i % 100}" for i in range(n)], dtype=object),
+        "v": rng.integers(0, 5000, n).astype(np.int64),
+        "x": rng.random(n) * 1000,
+    }
+    seg = SegmentBuilder(schema).build(data, "s0")
+    return QueryEngine([seg]), pd.DataFrame({k: (v.astype(str) if v.dtype == object else v) for k, v in data.items()})
+
+
+def test_distinctcountsmarthll_exact_below_threshold(numbers):
+    eng, t = numbers
+    res = eng.execute("SELECT DISTINCTCOUNTSMARTHLL(v) FROM t")
+    assert res.rows[0][0] == t.v.nunique()
+
+
+def test_percentilesmarttdigest(numbers):
+    eng, t = numbers
+    res = eng.execute("SELECT PERCENTILESMARTTDIGEST(x, 90) FROM t")
+    truth = np.sort(t.x.to_numpy())[int((len(t) - 1) * 0.9)]
+    assert abs(res.rows[0][0] - truth) < np.ptp(t.x.to_numpy()) * 0.01
+
+
+def test_sumprecision_exact(numbers):
+    eng, t = numbers
+    res = eng.execute("SELECT SUMPRECISION(v) FROM t")
+    assert res.rows[0][0] == int(t.v.sum())
+    assert isinstance(res.rows[0][0], int)
+
+
+def test_idset(numbers):
+    eng, t = numbers
+    res = eng.execute("SELECT IDSET(v) FROM t WHERE v < 5")
+    truth = sorted(str(x) for x in set(t.v[t.v < 5]))
+    assert res.rows[0][0] == truth
+
+
+def test_frequent_sketches():
+    # skewed stream: Misra-Gries must surface the heavy hitters, with counts
+    # underestimated by at most n/cap
+    rng = np.random.default_rng(11)
+    n = 20_000
+    # ~half the stream is 'hot0'..'hot2', the rest spread over 200 cold keys
+    hot = np.asarray(["hot0", "hot1", "hot2"], dtype=object)[rng.integers(0, 3, n // 2)]
+    cold = np.asarray([f"c{i}" for i in range(200)], dtype=object)[rng.integers(0, 200, n - n // 2)]
+    ks = np.concatenate([hot, cold])
+    schema = Schema.build("s", dimensions=[("k", DataType.STRING)], metrics=[])
+    seg = SegmentBuilder(schema).build({"k": ks}, "f0")
+    eng = QueryEngine([seg])
+    res = eng.execute("SELECT FREQUENTSTRINGSSKETCH(k, 16) FROM s")
+    top = res.rows[0][0]
+    assert isinstance(top, dict) and top
+    true_counts = pd.Series(ks).value_counts()
+    for h in ("hot0", "hot1", "hot2"):
+        assert h in top
+        assert 0 < top[h] <= int(true_counts[h])
+        assert int(true_counts[h]) - top[h] <= n / 16
+
+
+def test_raw_sketch_variants_return_hex(numbers):
+    eng, _ = numbers
+    for q in (
+        "SELECT DISTINCTCOUNTRAWHLL(v) FROM t",
+        "SELECT DISTINCTCOUNTRAWTHETASKETCH(v) FROM t",
+        "SELECT PERCENTILERAWEST(x, 50) FROM t",
+        "SELECT PERCENTILERAWTDIGEST(x, 50) FROM t",
+    ):
+        out = eng.execute(q).rows[0][0]
+        assert isinstance(out, str) and len(out) > 0
+        bytes.fromhex(out)  # valid hex
+
+
+@pytest.fixture(scope="module")
+def mv_setup():
+    rng = np.random.default_rng(9)
+    n = 3000
+    nums = np.empty(n, dtype=object)
+    for i in range(n):
+        k = int(rng.integers(0, 4))
+        nums[i] = rng.integers(0, 50, size=k).astype(np.int64).tolist()
+    year = rng.integers(2020, 2023, n).astype(np.int32)
+    schema = Schema.build("t", dimensions=[("year", DataType.INT)], metrics=[])
+    schema.add(FieldSpec("nums", DataType.LONG, single_value=False))
+    seg = SegmentBuilder(schema).build({"nums": nums, "year": year}, "s0")
+    return QueryEngine([seg]), pd.DataFrame({"nums": nums, "year": year})
+
+
+def test_more_mv_variants(mv_setup):
+    eng, df = mv_setup
+    flat = np.concatenate([np.asarray(v, dtype=np.float64) for v in df.nums if len(v)])
+    distinct = {v for vs in df.nums for v in vs}
+    res = eng.execute(
+        "SELECT MINMAXRANGEMV(nums), DISTINCTSUMMV(nums), DISTINCTAVGMV(nums), "
+        "DISTINCTCOUNTBITMAPMV(nums), DISTINCTCOUNTHLLMV(nums), PERCENTILEMV(nums, 50) FROM t"
+    )
+    row = res.rows[0]
+    assert row[0] == float(flat.max() - flat.min())
+    assert row[1] == float(sum(distinct))
+    assert abs(row[2] - sum(distinct) / len(distinct)) < 1e-9
+    assert row[3] == len(distinct)
+    assert row[4] == len(distinct)  # host exact-set partial
+    assert row[5] == float(np.sort(flat)[int((len(flat) - 1) * 0.5)])
+
+
+def test_agg_registry_size():
+    from pinot_tpu.query.context import AGG_FUNCS
+
+    assert len(AGG_FUNCS) >= 55, len(AGG_FUNCS)
