@@ -17,7 +17,7 @@ import time
 from concurrent.futures import ThreadPoolExecutor
 
 from pinot_tpu.query import ast
-from pinot_tpu.query.context import QueryContext
+from pinot_tpu.query.context import QueryContext, QueryType
 from pinot_tpu.query.engine import QueryEngine
 from pinot_tpu.query.reduce import build_result
 from pinot_tpu.query.result import ResultTable
@@ -147,6 +147,13 @@ class Broker:
             all_meta.update(self.controller.all_segment_metadata(leg_table))
         self._compute_hints(ctx, all_meta)
 
+        if ctx.query_type == QueryType.SELECTION and ctx.gapfill is None:
+            # plain SELECT: framed streaming with incremental reduce — broker
+            # memory stays bounded by (needed rows + one frame), and servers
+            # stop producing once the LIMIT is satisfied
+            # (StreamingReduceService parity)
+            return self._execute_streaming(ctx, legs, all_meta, t0)
+
         partials, scanned, queried, pruned = [], 0, 0, 0
         for leg_table, leg_sql in legs:
             p, s, q, pr = self._scatter_leg(ctx, leg_table, leg_sql)
@@ -166,12 +173,140 @@ class Broker:
             time_used_ms=(time.perf_counter() - t0) * 1e3,
         )
 
-    def _scatter_leg(self, ctx: QueryContext, table: str, sql: str):
-        """Route + scatter one physical table: prune on stats/partitions,
-        select replicas (excluding failure-detected servers), fan out, retry
-        connection failures on other replicas once. Returns
-        (partials, scanned, num_segments_queried, num_segments_pruned)."""
-        from pinot_tpu.cluster.routing import AdaptiveServerSelector, segment_partitions_match
+    def _execute_streaming(self, ctx: QueryContext, legs, all_meta, t0) -> ResultTable:
+        """Selection-only streaming scatter/gather: all servers stream in
+        parallel into one bounded frame queue (memory stays bounded by
+        queue depth x frame size); the incremental reduce appends rows and
+        signals every stream to stop the moment offset+limit rows are
+        gathered. Connection failures fail over to a surviving replica once,
+        like the non-streaming scatter."""
+        need = ctx.offset + ctx.limit
+        rows: list[list] = []
+        state = {"scanned": 0, "frames": 0}
+        queried = 0
+        pruned = 0
+        for leg_table, leg_sql in legs:
+            plan, servers, ideal, n_candidates, leg_pruned = self._route_leg(ctx, leg_table)
+            queried += n_candidates
+            pruned += leg_pruned
+            hints = dict(ctx.hints)
+            failed = self._drain_streams(
+                plan, servers, leg_table, leg_sql, hints, need, rows, state
+            )
+            if failed and len(rows) < need:
+                # one failover round on surviving replicas (connection-failure
+                # parity with _scatter_leg)
+                bad = {sid for sid, _, _ in failed}
+                retry_segs = [s for _, segs, _ in failed for s in segs]
+                retry_ideal = {
+                    seg: {s: st for s, st in ideal.get(seg, {}).items() if s not in bad}
+                    for seg in retry_segs
+                }
+                plan2, unroutable = self.selector.select(retry_ideal, retry_segs)
+                if unroutable:
+                    raise RuntimeError(
+                        f"servers {sorted(bad)} unreachable and no surviving replica for {unroutable}"
+                    ) from failed[0][2]
+                still = self._drain_streams(
+                    plan2, servers, leg_table, leg_sql, hints, need, rows, state
+                )
+                if still:
+                    raise RuntimeError(
+                        f"streaming retry failed for servers {[sid for sid, _, _ in still]}"
+                    ) from still[0][2]
+            if len(rows) >= need:
+                break
+        rows = rows[ctx.offset : need]
+        return build_result(
+            ctx,
+            rows,
+            num_docs_scanned=int(state["scanned"]),
+            total_docs=sum(m.get("numDocs", 0) for m in all_meta.values()),
+            num_segments_queried=queried,
+            num_segments_pruned=pruned,
+            num_stream_frames=state["frames"],
+            time_used_ms=(time.perf_counter() - t0) * 1e3,
+        )
+
+    def _drain_streams(self, plan, servers, table, sql, hints, need, rows, state):
+        """Pump every server's stream concurrently into a bounded queue and
+        append rows until `need` is reached. Returns [(sid, segs, exc)] for
+        servers that failed with a connection-class error; other exceptions
+        propagate."""
+        import queue as _queue
+
+        from pinot_tpu.cluster.routing import AdaptiveServerSelector
+
+        if not plan:
+            return []
+        adaptive = self.selector if isinstance(self.selector, AdaptiveServerSelector) else None
+        stop = threading.Event()
+        out_q: _queue.Queue = _queue.Queue(maxsize=8)
+
+        def pump(sid, segs):
+            srv = servers[sid]
+            t0 = time.perf_counter()
+            try:
+                stream = srv.execute_partials_stream(table, sql, segs, hints, max_rows=need)
+                try:
+                    for item in stream:
+                        if stop.is_set():
+                            break
+                        while not stop.is_set():
+                            try:
+                                out_q.put(("frame", item), timeout=0.05)
+                                break
+                            except _queue.Full:
+                                continue
+                finally:
+                    stream.close()
+                if self.failure_detector is not None:
+                    self.failure_detector.mark_success(sid)
+                if adaptive is not None:
+                    adaptive.record(sid, (time.perf_counter() - t0) * 1e3)
+                out_q.put(("done", sid))
+            except Exception as e:
+                if isinstance(e, (RuntimeError, OSError)) and (
+                    "unreachable" in str(e) or "truncated" in str(e) or isinstance(e, OSError)
+                ):
+                    if self.failure_detector is not None:
+                        self.failure_detector.mark_failure(sid)
+                    out_q.put(("failed", sid, segs, e))
+                else:
+                    out_q.put(("error", e))
+
+        futures = [self._pool.submit(pump, sid, segs) for sid, segs in plan.items()]
+        pending = len(futures)
+        failed = []
+        error = None
+        while pending:
+            msg = out_q.get()
+            kind = msg[0]
+            if kind == "frame":
+                frame, matched, _seg_docs = msg[1]
+                state["frames"] += 1
+                state["scanned"] += int(matched)
+                if error is None and hasattr(frame, "values") and len(frame):
+                    rows.extend(frame.values.tolist())
+                if len(rows) >= need:
+                    stop.set()
+            elif kind == "done":
+                pending -= 1
+            elif kind == "failed":
+                pending -= 1
+                failed.append((msg[1], msg[2], msg[3]))
+            else:  # hard error: stop the fleet, then raise
+                pending -= 1
+                stop.set()
+                error = msg[1]
+        if error is not None:
+            raise error
+        return failed
+
+    def _route_leg(self, ctx: QueryContext, table: str):
+        """Prune on stats/partitions and pick replicas. Returns
+        (plan {server: [segments]}, servers, ideal, n_candidates, pruned)."""
+        from pinot_tpu.cluster.routing import segment_partitions_match
 
         meta = self.controller.all_segment_metadata(table)
         ideal = self.controller.ideal_state(table)
@@ -195,7 +330,16 @@ class Broker:
         plan, unroutable = self.selector.select(routable_ideal, candidates)
         if unroutable:
             raise RuntimeError(f"no ONLINE replica for segments: {unroutable}")
-        servers = self.controller.servers()
+        return plan, self.controller.servers(), ideal, len(candidates), pruned
+
+    def _scatter_leg(self, ctx: QueryContext, table: str, sql: str):
+        """Route + scatter one physical table: prune on stats/partitions,
+        select replicas (excluding failure-detected servers), fan out, retry
+        connection failures on other replicas once. Returns
+        (partials, scanned, num_segments_queried, num_segments_pruned)."""
+        from pinot_tpu.cluster.routing import AdaptiveServerSelector
+
+        plan, servers, ideal, n_candidates, pruned = self._route_leg(ctx, table)
         hints = dict(ctx.hints)
 
         from pinot_tpu.common.trace import active_trace, run_traced
@@ -252,7 +396,7 @@ class Broker:
         for p, matched, _total in results:
             partials.extend(p)
             scanned += matched
-        return partials, scanned, len(candidates), pruned
+        return partials, scanned, n_candidates, pruned
 
     def _execute_multistage(self, stmt, sql: str) -> ResultTable:
         """Dispatch the v2 engine over one replica of each segment.

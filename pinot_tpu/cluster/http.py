@@ -130,6 +130,46 @@ class ServerHTTPService:
                     self.end_headers()
                     self.wfile.write(payload)
                     return
+                if self.path == "/query/stream":
+                    # framed streaming results (GrpcQueryServer.submit parity,
+                    # server.proto:24-26): [u32 len][DataTable frame]...,
+                    # terminated by [u32 0] on success or [u32 0xFFFFFFFF]
+                    # [u32 len][error] on mid-stream failure. No
+                    # Content-Length — the broker reads frames incrementally
+                    # and may close early once its LIMIT is satisfied,
+                    # bounding memory on BOTH sides. EOF without a terminator
+                    # is a protocol error the client must surface, never a
+                    # silently-truncated success.
+                    import struct as _struct
+
+                    n = int(self.headers.get("Content-Length", 0))
+                    body = json.loads(self.rfile.read(n) or b"{}")
+                    self.send_response(200)
+                    self.send_header("Content-Type", "application/x-pinot-datatable-stream")
+                    self.send_header("Connection", "close")
+                    self.end_headers()
+                    try:
+                        try:
+                            for frame in svc.server.execute_partials_stream(
+                                body["table"],
+                                body["sql"],
+                                body.get("segments", []),
+                                body.get("hints") or {},
+                                max_rows=body.get("maxRows"),
+                            ):
+                                payload = datatable.encode(frame)
+                                self.wfile.write(_struct.pack("<I", len(payload)))
+                                self.wfile.write(payload)
+                        except Exception as e:  # mid-stream failure marker
+                            msg = f"{type(e).__name__}: {e}".encode()
+                            self.wfile.write(_struct.pack("<I", 0xFFFFFFFF))
+                            self.wfile.write(_struct.pack("<I", len(msg)))
+                            self.wfile.write(msg)
+                            return
+                        self.wfile.write(_struct.pack("<I", 0))
+                    except (BrokenPipeError, ConnectionResetError):
+                        pass  # broker closed early: expected fast-path exit
+                    return
                 if self.path != "/query":
                     self.send_error(404)
                     return
@@ -216,6 +256,45 @@ class RemoteServerClient:
             raise RuntimeError(f"server error from {self.base_url}: {detail}") from None
         except (TimeoutError, OSError) as e:
             raise RuntimeError(f"server {self.base_url} unreachable: {e}") from None
+
+    def execute_partials_stream(
+        self, table: str, sql: str, segment_names: list[str], hints: dict | None = None, max_rows: int | None = None
+    ):
+        """Generator over streamed (frame, matched, seg_docs) tuples. Closing
+        the generator closes the HTTP response, telling the server to stop."""
+        import struct as _struct
+
+        body = json.dumps(
+            {
+                "table": table,
+                "sql": sql,
+                "segments": segment_names,
+                "hints": hints or {},
+                "maxRows": max_rows,
+            }
+        ).encode()
+        req = urllib.request.Request(
+            self.base_url + "/query/stream", data=body, headers={"Content-Type": "application/json"}
+        )
+        resp = urllib.request.urlopen(req, timeout=self.timeout)
+        try:
+            while True:
+                hdr = resp.read(4)
+                if len(hdr) < 4:
+                    # EOF without a terminator = truncated stream (server
+                    # died mid-write): NEVER a silent success
+                    raise RuntimeError(f"server {self.base_url} stream truncated mid-response")
+                n = _struct.unpack("<I", hdr)[0]
+                if n == 0:
+                    break
+                if n == 0xFFFFFFFF:  # mid-stream server error marker
+                    (elen,) = _struct.unpack("<I", resp.read(4))
+                    raise RuntimeError(
+                        f"server error from {self.base_url}: {resp.read(elen).decode(errors='replace')}"
+                    )
+                yield datatable.decode(resp.read(n))
+        finally:
+            resp.close()
 
     def _post_json(self, path: str, doc: dict) -> dict:
         body = json.dumps(doc).encode()

@@ -168,6 +168,74 @@ class Server:
 
     # -- query execution -----------------------------------------------------
 
+    #: rows per streamed selection frame (GrpcConfig maxBlockRowSize analog)
+    STREAM_FRAME_ROWS = 65_536
+
+    def execute_partials_stream(
+        self,
+        table: str,
+        sql: str,
+        segment_names: list[str],
+        hints: dict | None = None,
+        max_rows: int | None = None,
+    ):
+        """Streaming selection execution: yields (frame, matched, seg_docs)
+        per ≤STREAM_FRAME_ROWS chunk as segments finish, stopping once
+        max_rows selection rows have been emitted. The server never holds
+        more than one segment's result; the broker can close the stream
+        early (server.proto:24-26 streaming Submit parity)."""
+        segs = self._resolve_segments(table, segment_names)
+        if len(segs) != len(segment_names):
+            # a silently-dropped unhosted segment would mean missing rows
+            # reported as success (the partial-response guard _scatter_leg
+            # applies client-side); the stream fails loudly instead
+            hosted = {s.name for s in segs}
+            raise RuntimeError(
+                f"server {self.server_id} does not host segments "
+                f"{sorted(set(segment_names) - hosted)} of table {table!r}"
+            )
+        eng = self._engine(table)
+        ctx = eng.make_context(sql)
+        if hints:
+            ctx.hints.update(hints)
+        from pinot_tpu.common.metrics import ServerMeter, server_metrics
+
+        server_metrics().meter(ServerMeter.QUERIES).mark()
+        emitted = 0
+        for seg, partial, matched in eng.partials_iter(ctx, segs):
+            if hasattr(partial, "iloc"):  # selection frame: chunk it
+                start = 0
+                n = len(partial)
+                while start < n:
+                    chunk = partial.iloc[start : start + self.STREAM_FRAME_ROWS]
+                    yield chunk, (matched if start == 0 else 0), (seg.n_docs if start == 0 else 0)
+                    emitted += len(chunk)
+                    start += self.STREAM_FRAME_ROWS
+                    if max_rows is not None and emitted >= max_rows:
+                        return
+                if n == 0:
+                    yield partial, matched, seg.n_docs
+            else:
+                yield partial, matched, seg.n_docs
+            if max_rows is not None and emitted >= max_rows:
+                return
+
+    def _resolve_segments(self, table: str, segment_names: list[str]):
+        with self._lock:
+            hosted = self._tables.get(table, {})
+            rt = self._realtime.get(table)
+            segs = []
+            for name in segment_names:
+                if name in hosted:
+                    segs.append(hosted[name])
+                elif rt is not None:
+                    for c in rt.consumers:
+                        if c._seg_name() == name:
+                            snap = c.consuming_snapshot()
+                            segs.append(snap if snap is not None else c._mutable.snapshot())
+                            break
+            return segs
+
     def execute_partials(
         self, table: str, sql: str, segment_names: list[str], hints: dict | None = None, workload: str = "PRIMARY"
     ):
@@ -192,24 +260,7 @@ class Server:
         return self._execute_partials(table, sql, segment_names, hints)
 
     def _execute_partials(self, table: str, sql: str, segment_names: list[str], hints: dict | None = None):
-        with self._lock:
-            hosted = self._tables.get(table, {})
-            rt = self._realtime.get(table)
-            segs = []
-            for name in segment_names:
-                if name in hosted:
-                    segs.append(hosted[name])
-                elif rt is not None:
-                    # consuming segment: serve the mutable snapshot by name
-                    for c in rt.consumers:
-                        if c._seg_name() == name:
-                            snap = c.consuming_snapshot()
-                            if snap is not None:
-                                segs.append(snap)
-                            else:
-                                # empty consuming segment: zero-doc partial
-                                segs.append(c._mutable.snapshot())
-                            break
+        segs = self._resolve_segments(table, segment_names)
         from pinot_tpu.common.accounting import default_accountant
         from pinot_tpu.common.metrics import ServerMeter, ServerTimer, server_metrics
         from pinot_tpu.common.trace import ServerQueryPhase, phase_timer

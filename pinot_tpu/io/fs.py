@@ -256,9 +256,14 @@ def get_fs(uri: str) -> PinotFS:
         elif scheme == "mem":
             fs = MemFS()
             register_fs("mem", fs)
+        elif scheme == "s3":
+            from pinot_tpu.io.s3 import S3FS
+
+            fs = S3FS()  # endpoint/credentials from env (S3_ENDPOINT, AWS_*)
+            register_fs("s3", fs)
         else:
             raise ValueError(
                 f"no PinotFS registered for scheme {scheme!r} "
-                f"(s3/gs/abfs/hdfs plugins require egress; register your own via register_fs)"
+                f"(gs/abfs/hdfs plugins require egress; register your own via register_fs)"
             )
     return fs

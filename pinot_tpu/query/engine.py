@@ -83,6 +83,19 @@ class QueryEngine:
             m.meter(ServerMeter.NUM_SEGMENTS_PRUNED).mark(pruned)
         return out, scanned
 
+    def partials_iter(self, ctx: QueryContext, segments: list[ImmutableSegment] | None = None):
+        """Per-segment streaming variant of partials(): yields
+        (partial, matched) as each segment finishes, so callers can frame
+        results out incrementally and stop early (GrpcQueryServer.submit
+        streaming parity, core/transport/grpc/GrpcQueryServer.java:65,165)."""
+        from pinot_tpu.query import pruner
+
+        for seg in self.segments if segments is None else segments:
+            if not pruner.can_match(seg, ctx):
+                continue
+            partial, matched = self._execute_segment(seg, ctx)
+            yield seg, partial, int(matched)
+
     @staticmethod
     def reduce(ctx: QueryContext, partials: list) -> list[list]:
         """Broker-side half: merge partials into final rows."""

@@ -22,6 +22,8 @@ class ResultTable:
     total_docs: int = 0
     num_segments_queried: int = 0
     num_segments_pruned: int = 0
+    # streamed selection path: how many wire frames carried the rows
+    num_stream_frames: int = 0
     time_used_ms: float = 0.0
     # populated when the query ran with `SET trace=true` (the reference
     # attaches a trace JSON blob to BrokerResponse the same way)

@@ -70,35 +70,14 @@ def _file_factory(props: dict) -> FileStream:
     return FileStream(props["stream.file.root"], int(props.get("stream.file.partitions", 1)))
 
 
-class KafkaStreamFactory:
-    """Kafka consumer factory (KafkaConsumerFactory parity). Gated: requires
-    confluent_kafka or kafka-python, neither of which ships in this image."""
+def _kafka_factory(props: dict):
+    """Kafka consumer factory (KafkaConsumerFactory parity): native
+    wire-protocol client (realtime/kafka.py), no client library needed.
+    Gated only on broker reachability — construction connects."""
+    from pinot_tpu.realtime.kafka import KafkaStreamFactory
 
-    def __init__(self, props: dict):
-        self.props = props
-        self._client = None
-        try:
-            import confluent_kafka  # noqa: F401
-
-            self._client = "confluent"
-        except ImportError:
-            try:
-                import kafka  # noqa: F401
-
-                self._client = "kafka-python"
-            except ImportError as e:
-                raise ImportError(
-                    "Kafka ingestion requires confluent_kafka or kafka-python "
-                    "(not in this image); use the 'file' or 'inmemory' stream, "
-                    "or register a custom factory via register_stream_factory"
-                ) from e
-
-    def partition_count(self) -> int:
-        raise NotImplementedError("kafka client wiring lands with a reachable broker")
-
-    def create_consumer(self, partition: int):
-        raise NotImplementedError("kafka client wiring lands with a reachable broker")
+    return KafkaStreamFactory(props)
 
 
 register_stream_factory("file", _file_factory)
-register_stream_factory("kafka", KafkaStreamFactory)
+register_stream_factory("kafka", _kafka_factory)

@@ -63,8 +63,15 @@ def test_file_stream_feeds_realtime_table(tmp_path):
 
 
 def test_kafka_factory_gated():
-    with pytest.raises(ImportError, match="Kafka ingestion requires"):
+    # kafka is now a native wire-protocol client (realtime/kafka.py); it is
+    # gated on connection config / broker reachability, not a client library
+    with pytest.raises(ValueError, match="kafka stream requires"):
         get_stream_factory("kafka", {})
+    with pytest.raises(OSError):
+        get_stream_factory(
+            "kafka",
+            {"stream.kafka.broker.list": "127.0.0.1:1", "stream.kafka.topic.name": "t"},
+        )
 
 
 # -- dataframe connector -----------------------------------------------------
