@@ -23,13 +23,48 @@ from pinot_tpu.segment.segment import ImmutableSegment
 
 
 class Controller:
-    def __init__(self, store: PropertyStore, deep_store: str | Path):
+    def __init__(self, store: PropertyStore, deep_store: str | Path, controller_id: str = "controller_0"):
         """deep_store: directory holding uploaded segment dirs (the PinotFS
         deep-store analog: segments are durable here; servers load from it)."""
         self.store = store
         self.deep_store = Path(deep_store)
         self.deep_store.mkdir(parents=True, exist_ok=True)
+        self.controller_id = controller_id
         self._servers: dict[str, object] = {}  # server_id -> Server handle
+        self._election = None
+        self._transitions = None
+
+    # -- high availability (cluster/ha.py) -----------------------------------
+
+    def enable_ha(self, lease_ttl: float = 2.0, renew_every: float = 0.4) -> None:
+        """Join lead-controller election and start the async transition
+        worker (lead-controller partitioning + Helix message queue analog;
+        PinotHelixResourceManager.java:192). Safe on multiple controllers
+        sharing one store: only the lease holder acts."""
+        from pinot_tpu.cluster.ha import LeaderElection, TransitionManager
+
+        if self._election is not None:
+            self.stop_ha()  # re-enable replaces, never leaks threads
+        self._election = LeaderElection(self.store, self.controller_id, lease_ttl, renew_every)
+        self._transitions = TransitionManager(self, self._election)
+        self._election.start()
+        self._transitions.start()
+
+    def stop_ha(self, release_lease: bool = True) -> None:
+        """Stop participating (simulates controller death when
+        release_lease=False: standbys must wait out the lease TTL). Clears
+        the transition manager too: with no worker to drain it, routing
+        upload failures into the queue would silently lose replicas."""
+        if self._transitions is not None:
+            self._transitions.stop()
+            self._transitions = None
+        if self._election is not None:
+            self._election.stop(release=release_lease)
+            self._election = None
+
+    @property
+    def is_leader(self) -> bool:
+        return self._election is None or self._election.is_leader
 
     # -- instances -----------------------------------------------------------
 
@@ -106,7 +141,15 @@ class Controller:
             for col, ci in segment.columns.items()
         }
         assigned = self._assign(table, segment.name, config.replication)
-        seg_meta = {"numDocs": segment.n_docs, "location": str(seg_dir), "stats": stats, "servers": assigned}
+        import time as _time
+
+        seg_meta = {
+            "numDocs": segment.n_docs,
+            "location": str(seg_dir),
+            "stats": stats,
+            "servers": assigned,
+            "uploadedAt": _time.time(),
+        }
         partitions = self._compute_partitions(segment, config)
         if partitions:
             seg_meta["partitions"] = partitions
@@ -114,10 +157,19 @@ class Controller:
         ideal = self.store.get(f"/tables/{table}/idealstate") or {}
         ideal[segment.name] = {s: "ONLINE" for s in assigned}
         self.store.set(f"/tables/{table}/idealstate", ideal)
-        # state transition: servers load the segment from the deep store
+        # state transition: servers load the segment from the deep store.
+        # With HA enabled, a failing server falls back to the durable retry
+        # queue instead of failing the upload (Helix async transition analog).
         handles = self.servers()
         for sid in assigned:
-            handles[sid].add_segment(table, segment.name, str(seg_dir))
+            if self._transitions is not None:
+                try:
+                    handles[sid].add_segment(table, segment.name, str(seg_dir))
+                    self._transitions.record_external_view(table, segment.name, sid, "ONLINE")
+                except Exception:
+                    self._transitions.enqueue(table, segment.name, sid, "add", str(seg_dir))
+            else:
+                handles[sid].add_segment(table, segment.name, str(seg_dir))
         self._refresh_dim_table(table, config)
         return assigned
 
@@ -184,7 +236,12 @@ class Controller:
 
     def delete_segment(self, table: str, segment_name: str, remove_from_deep_store: bool = True) -> None:
         """Drop a segment: server unload transitions, ideal-state removal,
-        metadata + deep-store cleanup (SegmentDeletionManager parity)."""
+        metadata + deep-store cleanup (SegmentDeletionManager parity). Any
+        queued ADD transitions for the segment are cancelled and its
+        external-view entry cleared — a surviving add would otherwise retry
+        forever against a deleted deep-store dir, or resurrect the segment."""
+        if self._transitions is not None:
+            self._transitions.cancel(table, segment_name)
         ideal = self.store.get(f"/tables/{table}/idealstate") or {}
         handles = self.servers()
         for sid in ideal.pop(segment_name, {}):
@@ -236,9 +293,20 @@ class Controller:
     def replace_segments(self, table: str, old_names: list[str], new_segments: list[ImmutableSegment]) -> None:
         """Atomic-enough swap (segment-lineage startReplaceSegments/
         endReplaceSegments parity): upload replacements first, then drop the
-        originals, so readers always see a complete data set."""
+        originals, so readers always see a complete data set. Under HA, a
+        replacement whose ADD was only queued (server transiently down) must
+        come ONLINE before the originals are dropped — deleting early would
+        leave readers seeing neither old nor new rows."""
         for seg in new_segments:
             self.upload_segment(table, seg)
+        if self._transitions is not None:
+            if not self._transitions.await_online(
+                table, [s.name for s in new_segments], timeout=30.0
+            ):
+                raise RuntimeError(
+                    f"replacement segments for {table!r} did not come ONLINE; "
+                    "originals kept (swap aborted, retry when servers recover)"
+                )
         for name in old_names:
             self.delete_segment(table, name)
 

@@ -1,0 +1,273 @@
+"""Controller high availability: lead-controller lease + async state
+transitions with retry + ideal/external-view reconciliation.
+
+Reference parity:
+- Lead-controller partitioning (pinot-controller/.../LeadControllerManager
+  and the lead-controller resource): exactly one controller acts on the
+  cluster at a time; standbys take over when the lead stops renewing its
+  lease. Here: a TTL lease document in the property store, acquired and
+  renewed via the store's atomic update (ZK ephemeral-node analog).
+- Helix async state transitions: segment ADD/DELETE messages to servers are
+  queued durably in the store and delivered by a worker with exponential
+  backoff, so a transiently-failing server converges instead of permanently
+  missing a segment (Helix message queue + retry analog).
+- External view: per-table `/tables/{t}/externalview` records what servers
+  ACTUALLY hold (vs the ideal state's intent); the reconciler re-enqueues
+  transitions for any ideal-vs-external drift
+  (SegmentStatusChecker / RealtimeSegmentValidationManager analog).
+
+Scope note: lease atomicity relies on the shared PropertyStore lock, which
+spans threads in one process (the chaos-test deployment shape). A
+multi-process store would supply the same `update` contract via file locks
+or a real ZK/etcd.
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+import time
+
+LEASE_PATH = "/controllers/lease"
+_msg_seq = itertools.count()
+
+
+class LeaderElection:
+    """TTL-lease leader election over PropertyStore.update."""
+
+    def __init__(self, store, controller_id: str, ttl: float = 2.0, renew_every: float = 0.4):
+        self.store = store
+        self.controller_id = controller_id
+        self.ttl = ttl
+        self.renew_every = renew_every
+        self._leader = False
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    def start(self) -> None:
+        self._tick()  # try to become leader immediately
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def stop(self, release: bool = True) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+        if release and self._leader:
+            # graceful handoff: drop the lease so a standby takes over NOW
+            self.store.update(
+                LEASE_PATH,
+                lambda doc: {"owner": "", "expires": 0.0}
+                if doc and doc.get("owner") == self.controller_id
+                else None,
+            )
+        self._leader = False
+
+    @property
+    def is_leader(self) -> bool:
+        return self._leader
+
+    def _tick(self) -> None:
+        now = time.time()
+        cid = self.controller_id
+
+        def claim(doc):
+            if doc is None or doc.get("expires", 0) < now or doc.get("owner") == cid:
+                return {"owner": cid, "expires": now + self.ttl}
+            return None
+
+        got = self.store.update(LEASE_PATH, claim)
+        self._leader = bool(got) and got.get("owner") == cid
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.renew_every):
+            self._tick()
+
+
+class TransitionManager:
+    """Durable segment state-transition queue + delivery worker +
+    ideal/external reconciler. Runs (delivers) only while this controller
+    holds the lease; the queue itself lives in the shared store, so a new
+    lead resumes exactly where the old one stopped."""
+
+    BACKOFF_BASE = 0.2
+    BACKOFF_MAX = 5.0
+
+    def __init__(self, controller, election: LeaderElection | None, poll_every: float = 0.1):
+        self.controller = controller
+        self.store = controller.store
+        self.election = election
+        self.poll_every = poll_every
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    # -- enqueue ---------------------------------------------------------------
+
+    def enqueue(self, table: str, segment: str, server_id: str, action: str, seg_dir: str = "") -> None:
+        msg_id = f"{int(time.time() * 1000):013d}-{next(_msg_seq):06d}"
+        self.store.set(
+            f"/transitions/{msg_id}",
+            {
+                "table": table,
+                "segment": segment,
+                "server": server_id,
+                "action": action,  # "add" | "remove"
+                "dir": seg_dir,
+                "attempts": 0,
+                "notBefore": 0.0,
+            },
+        )
+
+    # -- lifecycle -------------------------------------------------------------
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+
+    def _run(self) -> None:
+        last_reconcile = 0.0
+        while not self._stop.wait(self.poll_every):
+            if self.election is not None and not self.election.is_leader:
+                continue
+            self.drain_once()
+            if time.time() - last_reconcile > 1.0:
+                self.reconcile()
+                last_reconcile = time.time()
+
+    def cancel(self, table: str, segment: str) -> int:
+        """Drop queued transitions for a segment (called on delete) and clear
+        its external-view entry. Returns how many messages were cancelled."""
+        n = 0
+        for path in self.store.list("/transitions/"):
+            msg = self.store.get(path)
+            if msg is not None and msg["table"] == table and msg["segment"] == segment:
+                self.store.delete(path)
+                n += 1
+        self.store.update(
+            f"/tables/{table}/externalview",
+            lambda doc: ({k: v for k, v in (doc or {}).items() if k != segment}),
+        )
+        return n
+
+    def await_online(self, table: str, segments: list[str], timeout: float) -> bool:
+        """Block until every (segment, replica) the ideal state wants is
+        ONLINE in the external view, or timeout."""
+        deadline = time.time() + timeout
+        while True:
+            ideal = self.store.get(f"/tables/{table}/idealstate") or {}
+            ev = self.store.get(f"/tables/{table}/externalview") or {}
+            ok = all(
+                ev.get(seg, {}).get(sid) == "ONLINE"
+                for seg in segments
+                for sid, want in ideal.get(seg, {}).items()
+                if want == "ONLINE"
+            )
+            if ok:
+                return True
+            if time.time() >= deadline:
+                return False
+            time.sleep(0.05)
+
+    # -- delivery --------------------------------------------------------------
+
+    #: attempts before a message parks as a dead letter (reconcile re-enqueues
+    #: if the drift persists, so a recovered server still converges)
+    MAX_ATTEMPTS = 12
+
+    def drain_once(self) -> int:
+        """Attempt every due queued transition once. Returns deliveries."""
+        delivered = 0
+        now = time.time()
+        for path in self.store.list("/transitions/"):
+            msg = self.store.get(path)
+            if msg is None or msg.get("notBefore", 0) > now:
+                continue
+            if self._deliver(msg):
+                self.store.delete(path)
+                delivered += 1
+            else:
+                attempts = msg["attempts"] + 1
+                if attempts >= self.MAX_ATTEMPTS:
+                    # dead-letter: stop hammering a permanently-failing
+                    # delivery; the drift stays visible via ideal-vs-external
+                    self.store.delete(path)
+                    self.store.set(f"/deadletters/{path.split('/')[-1]}", msg)
+                    continue
+                backoff = min(self.BACKOFF_BASE * (2 ** attempts), self.BACKOFF_MAX)
+                msg["attempts"] = attempts
+                msg["notBefore"] = time.time() + backoff
+                self.store.set(path, msg)
+        return delivered
+
+    def _deliver(self, msg: dict) -> bool:
+        handles = self.controller.servers()
+        srv = handles.get(msg["server"])
+        if srv is None:
+            return False
+        try:
+            if msg["action"] == "add":
+                srv.add_segment(msg["table"], msg["segment"], msg["dir"])
+            else:
+                srv.remove_segment(msg["table"], msg["segment"])
+        except Exception:
+            return False
+        self.record_external_view(
+            msg["table"], msg["segment"], msg["server"], "ONLINE" if msg["action"] == "add" else None
+        )
+        return True
+
+    def record_external_view(self, table: str, segment: str, server_id: str, state: str | None) -> None:
+        def upd(doc):
+            doc = doc or {}
+            entry = doc.setdefault(segment, {})
+            if state is None:
+                entry.pop(server_id, None)
+                if not entry:
+                    doc.pop(segment, None)
+            else:
+                entry[server_id] = state
+            return doc
+
+        self.store.update(f"/tables/{table}/externalview", upd)
+
+    # -- reconciliation --------------------------------------------------------
+
+    #: drift younger than this is presumed an in-flight upload, not loss —
+    #: prevents racing upload_segment between its idealstate write and its
+    #: synchronous add_segment/record_external_view
+    RECONCILE_GRACE_S = 5.0
+
+    def reconcile(self) -> int:
+        """Re-enqueue transitions for ideal-vs-external drift (a segment the
+        ideal state places on a server that never confirmed it). Returns how
+        many were enqueued. Segment metadata is only read once drift is
+        detected (the converged steady state costs two store reads/table)."""
+        enqueued = 0
+        now = time.time()
+        pending = {
+            (m["table"], m["segment"], m["server"])
+            for m in (self.store.get(p) for p in self.store.list("/transitions/"))
+            if m is not None
+        }
+        for table in self.controller.tables():
+            ideal = self.store.get(f"/tables/{table}/idealstate") or {}
+            ev = self.store.get(f"/tables/{table}/externalview") or {}
+            for segment, replicas in ideal.items():
+                for sid, want in replicas.items():
+                    if want != "ONLINE":
+                        continue  # CONSUMING segments converge via ingestion
+                    if ev.get(segment, {}).get(sid) == "ONLINE":
+                        continue
+                    if (table, segment, sid) in pending:
+                        continue
+                    meta = self.store.get(f"/tables/{table}/segments/{segment}") or {}
+                    if now - meta.get("uploadedAt", 0.0) < self.RECONCILE_GRACE_S:
+                        continue
+                    self.enqueue(table, segment, sid, "add", meta.get("location", ""))
+                    enqueued += 1
+        return enqueued

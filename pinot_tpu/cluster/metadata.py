@@ -57,6 +57,17 @@ class PropertyStore:
             f = self._file(path)
             return json.loads(f.read_text()) if f.exists() else None
 
+    def update(self, path: str, fn) -> dict | None:
+        """Atomic read-modify-write under the store lock: fn(current_doc) ->
+        new doc to write, or None to leave unchanged. Returns what was
+        written (or None). This is the CAS primitive leader leases and
+        external-view updates build on (ZK versioned-write analog)."""
+        with self._lock:
+            new = fn(self.get(path))
+            if new is not None:
+                self.set(path, new)
+            return new
+
     def delete(self, path: str) -> None:
         with self._lock:
             if self.root is None:
@@ -70,10 +81,17 @@ class PropertyStore:
         with self._lock:
             if self.root is None:
                 return sorted(p for p in self._mem if p.startswith(prefix))
-            if not self.root.exists():
+            # walk only the subtree the prefix names: hot polls (e.g. the HA
+            # transition queue) must not rglob every document in the store
+            parts = [p for p in prefix.split("/") if p]
+            if prefix.endswith("/"):
+                base = self.root.joinpath(*parts)
+            else:
+                base = self.root.joinpath(*parts[:-1]) if parts else self.root
+            if not base.exists():
                 return []
             out = []
-            for f in self.root.rglob("*" + self._SUFFIX):
+            for f in base.rglob("*" + self._SUFFIX):
                 rel = f.relative_to(self.root)
                 key = "/" + "/".join(rel.parts)[: -len(self._SUFFIX)]
                 if key.startswith(prefix):

@@ -188,12 +188,19 @@ class Server:
         if len(segs) != len(segment_names):
             # a silently-dropped unhosted segment would mean missing rows
             # reported as success (the partial-response guard _scatter_leg
-            # applies client-side); the stream fails loudly instead
-            hosted = {s.name for s in segs}
-            raise RuntimeError(
-                f"server {self.server_id} does not host segments "
-                f"{sorted(set(segment_names) - hosted)} of table {table!r}"
-            )
+            # applies client-side); the stream fails loudly instead.
+            # Exception: tables with live ingestion — a routed CONSUMING name
+            # can be transiently unresolvable during segment rollover, which
+            # must not fail the query (the reference serves the same window
+            # from whatever replicas are ready).
+            with self._lock:
+                has_realtime = table in self._realtime
+            if not has_realtime:
+                hosted = {s.name for s in segs}
+                raise RuntimeError(
+                    f"server {self.server_id} does not host segments "
+                    f"{sorted(set(segment_names) - hosted)} of table {table!r}"
+                )
         eng = self._engine(table)
         ctx = eng.make_context(sql)
         if hints:

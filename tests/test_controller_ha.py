@@ -1,0 +1,174 @@
+"""Controller HA: lead-controller lease failover + async state transitions
+with retry + ideal/external-view reconciliation, chaos-tested.
+
+Reference parity: lead-controller partitioning (LeadControllerManager),
+Helix async state transitions with retry, and the validator periodic tasks
+(SegmentStatusChecker / RealtimeSegmentValidationManager) that converge
+ideal vs external view; chaos shape follows ChaosMonkeyIntegrationTest
+(pinot-integration-tests/.../ChaosMonkeyIntegrationTest.java:47).
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+from pinot_tpu.cluster import Broker, Controller, PropertyStore, Server
+from pinot_tpu.common import DataType, Schema, TableConfig
+from pinot_tpu.segment import SegmentBuilder
+
+
+def _schema():
+    return Schema.build(
+        "t", dimensions=[("k", DataType.STRING)], metrics=[("v", DataType.LONG)]
+    )
+
+
+def _segment(b, i, n=500):
+    rng = np.random.default_rng(i)
+    return b.build(
+        {
+            "k": np.asarray([f"k{j % 4}" for j in range(n)], dtype=object),
+            "v": rng.integers(0, 100, n).astype(np.int64),
+        },
+        f"t_{i}",
+    )
+
+
+class FlakyServer(Server):
+    """Fails the first `fail_n` add_segment calls (transient outage)."""
+
+    def __init__(self, server_id, fail_n=0):
+        super().__init__(server_id)
+        self.fail_n = fail_n
+        self.failures_injected = 0
+
+    def add_segment(self, table, segment, seg_dir):
+        if self.failures_injected < self.fail_n:
+            self.failures_injected += 1
+            raise RuntimeError(f"server {self.server_id} unreachable (injected)")
+        return super().add_segment(table, segment, seg_dir)
+
+
+def test_lease_failover(tmp_path):
+    store = PropertyStore()
+    c1 = Controller(store, tmp_path / "deep", controller_id="c1")
+    c2 = Controller(store, tmp_path / "deep", controller_id="c2")
+    c1.enable_ha(lease_ttl=0.6, renew_every=0.1)
+    time.sleep(0.2)
+    c2.enable_ha(lease_ttl=0.6, renew_every=0.1)
+    time.sleep(0.3)
+    assert c1.is_leader and not c2.is_leader
+    # lead dies WITHOUT releasing (crash): standby must wait out the TTL
+    c1.stop_ha(release_lease=False)
+    deadline = time.time() + 5
+    while time.time() < deadline and not c2.is_leader:
+        time.sleep(0.05)
+    assert c2.is_leader
+    c2.stop_ha()
+
+
+def test_transition_retry_converges(tmp_path):
+    """A server down at upload time converges once it recovers — the upload
+    neither fails nor silently loses the replica."""
+    store = PropertyStore()
+    controller = Controller(store, tmp_path / "deep", controller_id="c1")
+    flaky = FlakyServer("s0", fail_n=3)
+    controller.register_server("s0", flaky)
+    controller.add_schema(_schema())
+    controller.add_table(TableConfig("t", replication=1))
+    controller.enable_ha(lease_ttl=2.0, renew_every=0.2)
+    try:
+        b = SegmentBuilder(_schema())
+        controller.upload_segment("t", _segment(b, 0))  # add fails, queued
+        assert flaky.failures_injected >= 1
+        deadline = time.time() + 10
+        broker = Broker(controller)
+        rows = None
+        while time.time() < deadline:
+            ev = store.get("/tables/t/externalview") or {}
+            if ev.get("t_0", {}).get("s0") == "ONLINE":
+                rows = broker.execute("SELECT COUNT(*) FROM t").rows
+                break
+            time.sleep(0.1)
+        assert rows == [[500]], f"transition never converged: {store.get('/tables/t/externalview')}"
+    finally:
+        controller.stop_ha()
+
+
+def test_reconciler_heals_missing_replica(tmp_path):
+    """External-view drift (server restarted empty) is re-converged by the
+    reconciler without any new upload."""
+    store = PropertyStore()
+    controller = Controller(store, tmp_path / "deep", controller_id="c1")
+    server = Server("s0")
+    controller.register_server("s0", server)
+    controller.add_schema(_schema())
+    controller.add_table(TableConfig("t", replication=1))
+    b = SegmentBuilder(_schema())
+    controller.upload_segment("t", _segment(b, 0))
+    # simulate a server that lost its state: drop the segment + no external view
+    server.remove_segment("t", "t_0")
+    store.delete("/tables/t/externalview")
+    controller.enable_ha(lease_ttl=2.0, renew_every=0.2)
+    try:
+        broker = Broker(controller)
+        deadline = time.time() + 10
+        count = 0
+        while time.time() < deadline:
+            try:
+                count = broker.execute("SELECT COUNT(*) FROM t").rows[0][0]
+            except RuntimeError:
+                count = 0
+            if count == 500:
+                break
+            time.sleep(0.1)
+        assert count == 500
+    finally:
+        controller.stop_ha()
+
+
+def test_chaos_lead_death_mid_ingestion(tmp_path):
+    """Kill the lead controller between uploads while a server is flaking:
+    the standby takes over the lease AND the pending transition queue; every
+    uploaded segment ends up queryable (no data loss)."""
+    store = PropertyStore()
+    deep = tmp_path / "deep"
+    c1 = Controller(store, deep, controller_id="c1")
+    c2 = Controller(store, deep, controller_id="c2")
+    flaky = FlakyServer("s0", fail_n=4)
+    # both controllers see the same server handle (same participant)
+    c1.register_server("s0", flaky)
+    c2.register_server("s0", flaky)
+    schema = _schema()
+    c1.add_schema(schema)
+    c1.add_table(TableConfig("t", replication=1))
+    c1.enable_ha(lease_ttl=0.6, renew_every=0.1)
+    c2.enable_ha(lease_ttl=0.6, renew_every=0.1)
+    b = SegmentBuilder(schema)
+    try:
+        # lead uploads 3 segments; the flaky server drops the adds -> queued
+        for i in range(3):
+            c1.upload_segment("t", _segment(b, i))
+        # the lead CRASHES before the queue drains
+        c1.stop_ha(release_lease=False)
+        # standby must claim the lease, then drain c1's pending transitions
+        deadline = time.time() + 15
+        broker = Broker(c2)
+        total = 0
+        while time.time() < deadline:
+            if c2.is_leader:
+                try:
+                    total = broker.execute("SELECT COUNT(*) FROM t").rows[0][0]
+                except RuntimeError:
+                    total = 0
+                if total == 1500:
+                    break
+            time.sleep(0.1)
+        assert c2.is_leader, "standby never took the lease"
+        assert total == 1500, f"data loss after failover: {total} rows"
+        # queue fully drained
+        assert store.list("/transitions/") == []
+    finally:
+        c1.stop_ha()
+        c2.stop_ha()
