@@ -240,15 +240,19 @@ class Controller:
         queued ADD transitions for the segment are cancelled and its
         external-view entry cleared — a surviving add would otherwise retry
         forever against a deleted deep-store dir, or resurrect the segment."""
+        # order matters: drop the ideal-state intent FIRST so the reconciler
+        # and the delivery worker's obsolete-message guard both stop wanting
+        # the segment, THEN cancel queued messages, then unload
+        ideal = self.store.get(f"/tables/{table}/idealstate") or {}
+        replicas = ideal.pop(segment_name, {})
+        self.store.set(f"/tables/{table}/idealstate", ideal)
         if self._transitions is not None:
             self._transitions.cancel(table, segment_name)
-        ideal = self.store.get(f"/tables/{table}/idealstate") or {}
         handles = self.servers()
-        for sid in ideal.pop(segment_name, {}):
+        for sid in replicas:
             srv = handles.get(sid)
             if srv is not None:
                 srv.remove_segment(table, segment_name)
-        self.store.set(f"/tables/{table}/idealstate", ideal)
         meta = self.store.get(f"/tables/{table}/segments/{segment_name}")
         self.store.delete(f"/tables/{table}/segments/{segment_name}")
         if remove_from_deep_store and meta and meta.get("location"):

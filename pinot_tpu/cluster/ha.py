@@ -201,10 +201,20 @@ class TransitionManager:
                 backoff = min(self.BACKOFF_BASE * (2 ** attempts), self.BACKOFF_MAX)
                 msg["attempts"] = attempts
                 msg["notBefore"] = time.time() + backoff
-                self.store.set(path, msg)
+                # write back ONLY if still queued — a concurrent cancel()
+                # (segment delete) must not be resurrected by a retry update
+                self.store.update(path, lambda cur, _m=msg: _m if cur is not None else None)
         return delivered
 
     def _deliver(self, msg: dict) -> bool:
+        if msg["action"] == "add":
+            # obsolete-message guard: the ideal state may have dropped this
+            # (segment, server) since the message was queued (delete_segment
+            # racing an in-flight retry) — delivering would resurrect a
+            # deleted segment. Treated as success with nothing to do.
+            ideal = self.store.get(f"/tables/{msg['table']}/idealstate") or {}
+            if ideal.get(msg["segment"], {}).get(msg["server"]) != "ONLINE":
+                return True
         handles = self.controller.servers()
         srv = handles.get(msg["server"])
         if srv is None:

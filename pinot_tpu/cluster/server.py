@@ -189,17 +189,26 @@ class Server:
             # a silently-dropped unhosted segment would mean missing rows
             # reported as success (the partial-response guard _scatter_leg
             # applies client-side); the stream fails loudly instead.
-            # Exception: tables with live ingestion — a routed CONSUMING name
-            # can be transiently unresolvable during segment rollover, which
-            # must not fail the query (the reference serves the same window
-            # from whatever replicas are ready).
+            # Exception: names of the ACTIVE consuming generation — during
+            # segment rollover the routed CONSUMING name can be transiently
+            # unresolvable (the committed replacement serves the data). A
+            # missing COMMITTED segment of a realtime table still errors.
+            hosted = {s.name for s in segs}
+            missing = set(segment_names) - hosted
             with self._lock:
-                has_realtime = table in self._realtime
-            if not has_realtime:
-                hosted = {s.name for s in segs}
+                rt = self._realtime.get(table)
+                active = set()
+                if rt is not None:
+                    for c in rt.consumers:
+                        # previous/current/next sequence of each partition are
+                        # the rollover window (seal -> commit -> reopen)
+                        for seq in (c.sequence - 1, c.sequence, c.sequence + 1):
+                            active.add(f"{c.table}__{c.partition}__{seq}")
+            truly_missing = missing - active
+            if truly_missing:
                 raise RuntimeError(
                     f"server {self.server_id} does not host segments "
-                    f"{sorted(set(segment_names) - hosted)} of table {table!r}"
+                    f"{sorted(truly_missing)} of table {table!r}"
                 )
         eng = self._engine(table)
         ctx = eng.make_context(sql)

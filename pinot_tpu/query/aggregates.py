@@ -302,6 +302,159 @@ def _theta_finalize(p, _extra):
     return int(round((k - 1) / theta))
 
 
+# -- theta sketch set algebra -------------------------------------------------
+# DistinctCountThetaSketchAggregationFunction parity: filtered sketches plus
+# a post-aggregation set expression SET_UNION/SET_INTERSECT/SET_DIFF($1..$N).
+# KMV semantics: a sketch is (sorted uint64 hashes, theta); theta for a
+# bottom-k sketch is its largest retained hash when full, else 1.0 (exact).
+
+
+def _theta_theta(s: np.ndarray) -> float:
+    return float(s[-1]) / float(2**64) if len(s) >= THETA_K else 1.0
+
+
+def _theta_cut(a: np.ndarray, b: np.ndarray, theta: float | None):
+    th = min(_theta_theta(a), _theta_theta(b)) if theta is None else theta
+    cut = np.uint64(int(th * 2**64) - 1) if th < 1.0 else np.uint64(2**64 - 1)
+    return a[a <= cut], b[b <= cut]
+
+
+def theta_union(a: np.ndarray, b: np.ndarray, theta: float | None = None) -> np.ndarray:
+    a, b = _theta_cut(a, b, theta)
+    return np.union1d(a, b)
+
+
+def theta_intersect(a: np.ndarray, b: np.ndarray, theta: float | None = None) -> np.ndarray:
+    a, b = _theta_cut(a, b, theta)
+    return np.intersect1d(a, b)
+
+
+def theta_diff(a: np.ndarray, b: np.ndarray, theta: float | None = None) -> np.ndarray:
+    a, b = _theta_cut(a, b, theta)
+    return np.setdiff1d(a, b)
+
+
+def theta_estimate(s: np.ndarray, theta: float | None = None) -> int:
+    th = _theta_theta(s) if theta is None else theta
+    if th >= 1.0:
+        return int(len(s))
+    return int(round(len(s) / th))
+
+
+def eval_theta_expression(expr: str, sketches: list[np.ndarray]) -> int:
+    """Evaluate SET_UNION/SET_INTERSECT/SET_DIFF over $1..$N placeholders
+    (nested calls allowed) and estimate the resulting cardinality. Internally
+    every node is (hashes, theta): set ops can shrink the hash set below
+    capacity while theta stays < 1, so theta is tracked explicitly."""
+    import re as _re
+
+    tokens = _re.findall(
+        r"SET_UNION|SET_INTERSECT|SET_DIFF|\$\d+|\(|\)|,", expr.upper().replace(" ", "")
+    )
+    pos = 0
+
+    def peek() -> str:
+        return tokens[pos] if pos < len(tokens) else ""
+
+    def take() -> str:
+        nonlocal pos
+        if pos >= len(tokens):
+            raise ValueError(f"truncated theta expression {expr!r}")
+        tok = tokens[pos]
+        pos += 1
+        return tok
+
+    _OPS = {"SET_UNION": theta_union, "SET_INTERSECT": theta_intersect, "SET_DIFF": theta_diff}
+
+    def parse() -> tuple[np.ndarray, float]:
+        tok = take()
+        if tok.startswith("$"):
+            idx = int(tok[1:]) - 1
+            if not 0 <= idx < len(sketches):
+                raise ValueError(
+                    f"theta expression references ${idx + 1} but only {len(sketches)} filters exist"
+                )
+            s = sketches[idx]
+            return s, _theta_theta(s)
+        if tok not in _OPS:
+            raise ValueError(f"bad theta expression token {tok!r} in {expr!r}")
+        if take() != "(":
+            raise ValueError(f"expected '(' after {tok} in {expr!r}")
+        args = [parse()]
+        while peek() == ",":
+            take()
+            args.append(parse())
+        if take() != ")":
+            raise ValueError(f"expected ')' in {expr!r}")
+        th = min(a_th for _, a_th in args)
+        hashes, _ = args[0]
+        for other, _ in args[1:]:
+            hashes = _OPS[tok](hashes, other, th)
+        if tok == "SET_UNION" and len(hashes) > THETA_K:
+            hashes = hashes[:THETA_K]
+            th = min(th, _theta_theta(hashes))
+        return hashes, th
+
+    hashes, th = parse()
+    if pos != len(tokens):
+        raise ValueError(f"trailing tokens in theta expression {expr!r}")
+    return theta_estimate(hashes, th)
+
+
+_THETA_PARAM_KEYS = {
+    "nominalentries",
+    "samplingprobability",
+    "accumulatorthreshold",
+    "intermediatebuffersize",
+}
+
+
+def parse_theta_extra(extra: tuple) -> tuple[list[str], list[str], str | None]:
+    """Classify DISTINCTCOUNTTHETASKETCH trailing string args into
+    (params, filter predicates, post-aggregation set expression)."""
+    import re as _re
+
+    params: list[str] = []
+    filters: list[str] = []
+    postagg: str | None = None
+    for s in extra:
+        stripped = s.strip()
+        if _re.match(r"(?i)^SET_(UNION|INTERSECT|DIFF)\s*\(", stripped):
+            postagg = stripped
+        elif (
+            _re.fullmatch(r"\s*\w+\s*=\s*[\w.]+\s*", stripped)
+            and stripped.split("=")[0].strip().lower() in _THETA_PARAM_KEYS
+        ):
+            params.append(stripped)
+        else:
+            filters.append(stripped)
+    return params, filters, postagg
+
+
+def _theta_is_multi(p) -> bool:
+    return isinstance(p, tuple) and len(p) == 2 and p[0] == "multi"
+
+
+def _theta_merge_any(a, b):
+    am, bm = _theta_is_multi(a), _theta_is_multi(b)
+    if am or bm:
+        if not am:
+            a = ("multi", [np.zeros(0, np.uint64)] * len(b[1]))
+        if not bm:
+            b = ("multi", [np.zeros(0, np.uint64)] * len(a[1]))
+        return ("multi", [_theta_merge(x, y) for x, y in zip(a[1], b[1])])
+    return _theta_merge(a, b)
+
+
+def _theta_finalize_any(p, extra):
+    if _theta_is_multi(p):
+        _params, _filters, postagg = parse_theta_extra(extra)
+        if postagg:
+            return eval_theta_expression(postagg, p[1])
+        return theta_estimate(p[1][0]) if p[1] else 0
+    return _theta_finalize(p, extra)
+
+
 # -- HLL-family stand-ins ----------------------------------------------------
 
 
@@ -514,7 +667,7 @@ EXT_AGGS: dict[str, AggSpec] = {
         lambda p, e: _kll_percentile(p, e),
         lambda e: np.zeros(0),
     ),
-    "distinctcounttheta": AggSpec(1, _theta_compute, _theta_merge, _theta_finalize, lambda e: np.zeros(0, np.uint64)),
+    "distinctcounttheta": AggSpec(1, _theta_compute, _theta_merge_any, _theta_finalize_any, lambda e: np.zeros(0, np.uint64)),
     "distinctcounthllplus": AggSpec(1, _hll_compute, lambda a, b: np.maximum(a, b), _hll_finalize, lambda e: np_hll_registers(np.zeros(0))),
     "distinctcountcpc": AggSpec(1, _hll_compute, lambda a, b: np.maximum(a, b), _hll_finalize, lambda e: np_hll_registers(np.zeros(0))),
     "distinctcountull": AggSpec(1, _hll_compute, lambda a, b: np.maximum(a, b), _hll_finalize, lambda e: np_hll_registers(np.zeros(0))),

@@ -234,6 +234,14 @@ def _extract_aggs(expr: Expr, out: dict[str, AggregationInfo]) -> bool:
                     if len(expr.args) != 2 or not isinstance(expr.args[1], Literal):
                         raise ValueError(f"{fname} requires (column, percentile) arguments")
                     extra = (float(expr.args[1].value),)
+                elif fname == "distinctcounttheta" and len(expr.args) > 1:
+                    # DISTINCTCOUNTTHETASKETCH(col, 'params', 'pred1', ...,
+                    # 'SET_OP($1,$2)') — trailing string literals carry the
+                    # filtered-sketch definitions + post-agg set expression
+                    # (DistinctCountThetaSketchAggregationFunction parity)
+                    extra = tuple(
+                        str(a.value) for a in expr.args[1:] if isinstance(a, Literal)
+                    )
                 elif fname in ("frequentlongssketch", "frequentstringssketch"):
                     # optional maxMapSize literal (FrequentItems sketch size)
                     extra = (

@@ -269,7 +269,22 @@ class QueryEngine:
         data = {}
         kspec = plan.spec[3]
         keys = keys_out[:n]
-        if kspec[0] == "ids":
+        if plan.ob_decomp:
+            # composite rank -> per-key sort values (most significant first)
+            comp = keys.astype(np.int64)
+            strides = [1] * len(plan.ob_decomp)
+            for i in range(len(plan.ob_decomp) - 2, -1, -1):
+                strides[i] = strides[i + 1] * plan.ob_decomp[i + 1][1]
+            for i, (col, card, desc, kind, off) in enumerate(plan.ob_decomp):
+                rank = (comp // strides[i]) % card
+                if desc:
+                    rank = card - 1 - rank
+                if kind == "ids":
+                    kv = seg.columns[col].dictionary.get_many(rank)
+                    data[f"__key{i}"] = kv.astype(str) if kv.dtype == object else kv
+                else:
+                    data[f"__key{i}"] = rank + off
+        elif kspec[0] == "ids":
             ci = seg.columns[kspec[1]]
             kv = ci.dictionary.get_many(keys.astype(np.int64))
             data["__key0"] = kv.astype(str) if kv.dtype == object else kv

@@ -335,6 +335,25 @@ def _funnel_mod():
     return funnel
 
 
+def _theta_filtered_partial(seg: ImmutableSegment, a, mask: np.ndarray):
+    """DISTINCTCOUNTTHETASKETCH with filter expressions: one KMV sketch per
+    filter predicate, combined at reduce by the SET_* post-aggregation
+    (DistinctCountThetaSketchAggregationFunction parity)."""
+    from pinot_tpu.query.aggregates import _theta_compute, parse_theta_extra
+    from pinot_tpu.query.sql import parse_sql
+
+    _params, filters, _postagg = parse_theta_extra(a.extra)
+    v = eval_value(seg, a.arg)
+    if not filters:
+        return _theta_compute(v[mask], None, ())
+    sketches = []
+    for fstr in filters:
+        pred = parse_sql(f"SELECT * FROM _t WHERE {fstr}").where
+        fmask = mask & filter_mask(seg, pred)
+        sketches.append(_theta_compute(v[fmask], None, ()))
+    return ("multi", sketches)
+
+
 def _mv_agg_column(seg: ImmutableSegment, a) -> "object":
     if not isinstance(a.arg, ast.Identifier):
         raise PlanError(f"{a.func} requires an MV column argument")
@@ -432,6 +451,9 @@ def agg_partials(seg: ImmutableSegment, ctx: QueryContext, query_mask: np.ndarra
         if a.func in _funnel_mod().FUNNEL_AGGS:
             out.append(_funnel_mod().segment_partial(seg, a, mask))
             continue
+        if a.func == "distinctcounttheta" and a.extra:
+            out.append(_theta_filtered_partial(seg, a, mask))
+            continue
         if a.func in EXT_AGGS:
             spec = EXT_AGGS[a.func]
             v = eval_value(seg, a.arg)[mask] if a.arg is not None else None
@@ -515,6 +537,10 @@ def group_frame(seg: ImmutableSegment, ctx: QueryContext, mask: np.ndarray) -> p
                 data[f"m{i}{suffix}"] = arr
             mv_docaggs[i] = True
             continue
+        if a.func == "distinctcounttheta" and a.extra:
+            raise PlanError(
+                "filtered DISTINCTCOUNTTHETASKETCH inside GROUP BY is not supported"
+            )
         if a.func in _funnel_mod().FUNNEL_AGGS:
             fun = _funnel_mod()
             steps = a.extra[-1]

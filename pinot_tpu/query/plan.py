@@ -66,6 +66,10 @@ class SegmentPlan:
     group_cols: list[tuple[str, Any]] = field(default_factory=list)  # (col, ColumnIndex)
     select_decode: list[tuple] = field(default_factory=list)
     aggs: list[AggregationInfo] = field(default_factory=list)
+    # multi-key ORDER BY composite: [(col, card, desc, kind, offset)], most
+    # significant key first — the host decomposes the composite rank back
+    # into per-key sort values
+    ob_decomp: list[tuple] | None = None
 
 
 class _Lowering:
@@ -569,6 +573,59 @@ class _Lowering:
             return ("const", False)
         return ("in_lut", expr.name, self.op_idx(lut))
 
+    def multi_ob_spec(self, order_by) -> tuple:
+        """Composite rank key for multi-key ORDER BY (the sorting twin of
+        DictionaryBasedGroupKeyGenerator's cardinality product,
+        DictionaryBasedGroupKeyGenerator.java:119-130): ascending composite
+        order == the requested multi-key order. Returns (kspec, decomp)."""
+        entries = []  # (col, card, desc, kind, offset)
+        total = 1
+        for ob in order_by:
+            if not isinstance(ob.expr, ast.Identifier):
+                raise DeviceFallback("expression ORDER BY keys run host-side")
+            ci = self.seg.columns.get(ob.expr.name)
+            if ci is None:
+                raise PlanError(f"unknown column {ob.expr.name!r}")
+            if ci.is_mv:
+                raise DeviceFallback("MV ORDER BY keys run host-side")
+            if ci.is_dict_encoded:
+                entries.append((ob.expr.name, max(ci.cardinality, 1), ob.desc, "ids", 0))
+            elif np.issubdtype(ci.forward.dtype, np.integer):
+                lo_v, hi_v = int(ci.stats.min_value), int(ci.stats.max_value)
+                card = hi_v - lo_v + 1
+                i32 = np.iinfo(np.int32)
+                # the offset/extreme literals ride as int32 operands: values
+                # outside int32 (a narrow range at a huge base still has a
+                # huge offset) must fall back, not overflow
+                if card <= 0 or card > (1 << 31) or lo_v < i32.min or hi_v > i32.max:
+                    raise DeviceFallback("wide-range int ORDER BY key runs host-side")
+                entries.append((ob.expr.name, card, ob.desc, "rawoff", lo_v))
+            else:
+                raise DeviceFallback("float/string-raw multi-key ORDER BY runs host-side")
+            total *= entries[-1][1]
+            if total > (1 << 31) - 1:
+                raise DeviceFallback("ORDER BY key-rank product exceeds int32; host-side")
+
+        # composite = sum(rank_i * stride_i), most significant key first
+        strides = [1] * len(entries)
+        for i in range(len(entries) - 2, -1, -1):
+            strides[i] = strides[i + 1] * entries[i + 1][1]
+        kspec = None
+        for (col, card, desc, kind, off), stride in zip(entries, strides):
+            self.use_col(col)
+            base: tuple = ("ids" if kind == "ids" else "raw", col)
+            if kind == "rawoff" and off != 0:
+                base = ("bin", "-", base, ("lit", self.op_idx(np.int32(off))))
+            if desc:
+                base = ("bin", "-", ("lit", self.op_idx(np.int32(card - 1))), base)
+            term = (
+                base
+                if stride == 1
+                else ("bin", "*", base, ("lit", self.op_idx(np.int32(stride))))
+            )
+            kspec = term if kspec is None else ("bin", "+", kspec, term)
+        return kspec, entries
+
     # -- aggregations --------------------------------------------------------
 
     def agg_spec(self, info: AggregationInfo, grouped: bool) -> tuple:
@@ -886,17 +943,25 @@ def plan_segment(seg: ImmutableSegment, ctx: QueryContext, valid_mask=None) -> S
             proj.append(lo.value_spec(e))
             decode.append(("expr", None))
     k = ctx.limit + ctx.offset
+    ob_decomp = None
     if ctx.query_type == QueryType.SELECTION_ORDER_BY:
         if len(ctx.order_by) != 1:
-            raise DeviceFallback("multi-column ORDER BY selection runs host-side for now")
-        ob = ctx.order_by[0]
-        key = ob.expr
-        if isinstance(key, ast.Identifier) and key.name in seg.columns and seg.columns[key.name].is_dict_encoded:
-            lo.use_col(key.name)
-            kspec = ("ids", key.name)  # dict id order == value order
+            # multi-key ORDER BY: composite rank key on device — each key
+            # maps to its rank (dict id IS rank order; bounded ints shift by
+            # min), ranks combine by cardinality-product strides exactly like
+            # dense group ids, and ONE top_k sorts all keys at once.
+            # Per-key DESC flips the rank (card-1 - rank).
+            kspec, ob_decomp = lo.multi_ob_spec(ctx.order_by)
+            spec = ("select_ob", fspec, tuple(proj), kspec, False, k)
         else:
-            kspec = lo.value_spec(key)
-        spec = ("select_ob", fspec, tuple(proj), kspec, ob.desc, k)
+            ob = ctx.order_by[0]
+            key = ob.expr
+            if isinstance(key, ast.Identifier) and key.name in seg.columns and seg.columns[key.name].is_dict_encoded:
+                lo.use_col(key.name)
+                kspec = ("ids", key.name)  # dict id order == value order
+            else:
+                kspec = lo.value_spec(key)
+            spec = ("select_ob", fspec, tuple(proj), kspec, ob.desc, k)
     else:
         spec = ("select", fspec, tuple(proj), k)
     return SegmentPlan(
@@ -905,4 +970,5 @@ def plan_segment(seg: ImmutableSegment, ctx: QueryContext, valid_mask=None) -> S
         columns=tuple(lo.columns),
         select_decode=decode,
         aggs=[],
+        ob_decomp=ob_decomp,
     )

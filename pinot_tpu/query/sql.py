@@ -576,7 +576,7 @@ class Parser:
                     while self.eat_op(","):
                         args.append(self._fn_arg())
                 self.expect_op(")")
-                fc = FunctionCall(t.text.lower(), tuple(args), distinct)
+                fc = FunctionCall(_FUNC_ALIASES.get(t.text.lower(), t.text.lower()), tuple(args), distinct)
                 if self.at_kw("FILTER"):
                     # agg(x) FILTER (WHERE cond) — FilteredAggregationFunction
                     self.next()
@@ -624,6 +624,19 @@ def _unquote_string(s: str) -> str:
 # Boolean index-probe functions accepted in WHERE position (parity:
 # Pinot's TEXT_MATCH / JSON_MATCH / VECTOR_SIMILARITY filter functions).
 _PREDICATE_FUNCS = {"text_match", "json_match", "vector_similarity", "st_within_distance"}
+
+
+# SQL-name aliases for registry names (Pinot accepts several spellings of
+# the sketch aggregations; the registry uses one canonical name each)
+_FUNC_ALIASES = {
+    "distinctcountthetasketch": "distinctcounttheta",
+    "distinct_count_theta_sketch": "distinctcounttheta",
+    "funnel_count": "funnelcount",
+    "funnel_complete_count": "funnelcompletecount",
+    "funnel_max_step": "funnelmaxstep",
+    "funnel_match_step": "funnelmatchstep",
+    "funnel_step_duration_stats": "funnelstepdurationstats",
+}
 
 
 def parse_sql(sql: str) -> SelectStatement:

@@ -103,3 +103,96 @@ def test_count_distinct_alias(setup):
     a = e.execute("SELECT DISTINCTCOUNTBITMAP(site) FROM u").rows
     b_ = e.execute("SELECT DISTINCTCOUNT(site) FROM u").rows
     assert a == b_ == [[3]]
+
+
+# -- theta sketch set expressions (VERDICT r2 weak #7) ------------------------
+
+
+def test_theta_sketch_set_expressions():
+    """DISTINCTCOUNTTHETASKETCH(col, filters..., SET_OP($1,$2)) — filtered
+    sketches with intersection/difference post-aggregation
+    (DistinctCountThetaSketchAggregationFunction parity)."""
+    import numpy as np
+
+    from pinot_tpu.common import DataType, Schema
+    from pinot_tpu.query import QueryEngine
+    from pinot_tpu.segment import SegmentBuilder
+
+    rng = np.random.default_rng(12)
+    n = 40_000
+    schema = Schema.build(
+        "t",
+        dimensions=[("country", DataType.STRING), ("device", DataType.STRING)],
+        metrics=[("uid", DataType.LONG)],
+    )
+    data = {
+        "country": np.asarray(["US", "DE", "JP"], dtype=object)[rng.integers(0, 3, n)],
+        "device": np.asarray(["phone", "desktop"], dtype=object)[rng.integers(0, 2, n)],
+        "uid": rng.integers(0, 3000, n).astype(np.int64),
+    }
+    # split across two segments so partials must merge
+    b = SegmentBuilder(schema)
+    half = n // 2
+    eng = QueryEngine(
+        [
+            b.build({k: v[:half] for k, v in data.items()}, "s0"),
+            b.build({k: v[half:] for k, v in data.items()}, "s1"),
+        ]
+    )
+    us = set(data["uid"][data["country"] == "US"].tolist())
+    phone = set(data["uid"][data["device"] == "phone"].tolist())
+
+    def run(postagg):
+        q = (
+            "SELECT DISTINCTCOUNTTHETASKETCH(uid, 'nominalEntries=4096', "
+            f"'country = ''US''', 'device = ''phone''', '{postagg}') FROM t"
+        )
+        return eng.execute(q).rows[0][0]
+
+    n_inter = run("SET_INTERSECT($1, $2)")
+    n_union = run("SET_UNION($1, $2)")
+    n_diff = run("SET_DIFF($1, $2)")
+    # sketches are exact below nominalEntries=4096? uid cardinality 3000 < 4096
+    assert n_inter == len(us & phone)
+    assert n_union == len(us | phone)
+    assert n_diff == len(us - phone)
+
+
+def test_theta_sketch_single_filter_and_plain():
+    import numpy as np
+
+    from pinot_tpu.common import DataType, Schema
+    from pinot_tpu.query import QueryEngine
+    from pinot_tpu.segment import SegmentBuilder
+
+    rng = np.random.default_rng(13)
+    n = 10_000
+    schema = Schema.build(
+        "t", dimensions=[("k", DataType.STRING)], metrics=[("uid", DataType.LONG)]
+    )
+    data = {
+        "k": np.asarray(["a", "b"], dtype=object)[rng.integers(0, 2, n)],
+        "uid": rng.integers(0, 500, n).astype(np.int64),
+    }
+    eng = QueryEngine([SegmentBuilder(schema).build(data, "s0")])
+    res = eng.execute("SELECT DISTINCTCOUNTTHETASKETCH(uid, 'k = ''a''') FROM t")
+    assert res.rows[0][0] == len(set(data["uid"][data["k"] == "a"].tolist()))
+    res2 = eng.execute("SELECT DISTINCTCOUNTTHETA(uid) FROM t")
+    assert res2.rows[0][0] == len(set(data["uid"].tolist()))
+
+
+def test_theta_malformed_expression_raises_valueerror():
+    # review r3: truncated expressions must raise ValueError, not IndexError
+    import numpy as np
+
+    from pinot_tpu.query.aggregates import eval_theta_expression
+
+    s = [np.arange(10, dtype=np.uint64), np.arange(5, dtype=np.uint64)]
+    import pytest as _pytest
+
+    with _pytest.raises(ValueError):
+        eval_theta_expression("SET_UNION($1", s)
+    with _pytest.raises(ValueError):
+        eval_theta_expression("SET_INTERSECT($1, $3)", s)
+    with _pytest.raises(ValueError):
+        eval_theta_expression("$1 $2", s)
