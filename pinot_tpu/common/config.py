@@ -61,6 +61,12 @@ class IndexingConfig:
     geo_index_columns: list[list[str]] = field(default_factory=list)
     # vector: columns whose input is a 2D (n_docs, dim) float array
     vector_index_columns: list[str] = field(default_factory=list)
+    # vector index flavor: EXACT (TPU matmul top-k, default) or HNSW (host
+    # graph probes; StandardIndexes vector parity)
+    vector_index_type: str = "EXACT"
+    # FST index (fast LIKE/REGEXP over sorted dictionaries) + map index
+    fst_index_columns: list[str] = field(default_factory=list)
+    map_index_columns: list[str] = field(default_factory=list)
     # null handling: build per-column null bitmaps (nullvalue_vector parity)
     null_handling: bool = False
 
@@ -77,6 +83,9 @@ class IndexingConfig:
             "jsonIndexColumns": self.json_index_columns,
             "geoIndexColumns": self.geo_index_columns,
             "vectorIndexColumns": self.vector_index_columns,
+            "vectorIndexType": self.vector_index_type,
+            "fstIndexColumns": self.fst_index_columns,
+            "mapIndexColumns": self.map_index_columns,
             "nullHandlingEnabled": self.null_handling,
         }
 
@@ -94,6 +103,9 @@ class IndexingConfig:
             json_index_columns=d.get("jsonIndexColumns", []),
             geo_index_columns=d.get("geoIndexColumns", []),
             vector_index_columns=d.get("vectorIndexColumns", []),
+            vector_index_type=d.get("vectorIndexType", "EXACT"),
+            fst_index_columns=d.get("fstIndexColumns", []),
+            map_index_columns=d.get("mapIndexColumns", []),
             null_handling=d.get("nullHandlingEnabled", False),
         )
 

@@ -186,6 +186,135 @@ class AvroRecordReader(RecordReader):
             yield from fastavro.reader(f)
 
 
+class ProtobufRecordReader(RecordReader):
+    """ProtoBufRecordReader parity: length-delimited protobuf messages
+    decoded through a caller-supplied message class (the descriptor stands in
+    for Pinot's descriptorFile config). google.protobuf ships in this image;
+    only the message class is caller-provided."""
+
+    def __init__(self, path: str | Path, message_cls=None):
+        if message_cls is None:
+            raise ValueError(
+                "protobuf input requires message_cls (the generated Message class; "
+                "ProtoBufRecordReader's descriptorFile analog)"
+            )
+        self._path = path
+        self._cls = message_cls
+
+    def __iter__(self):
+        from google.protobuf.internal.decoder import _DecodeVarint32
+
+        buf = Path(self._path).read_bytes()
+        pos = 0
+        while pos < len(buf):
+            size, pos = _DecodeVarint32(buf, pos)
+            msg = self._cls()
+            msg.ParseFromString(buf[pos : pos + size])
+            pos += size
+            yield {f.name: getattr(msg, f.name) for f in msg.DESCRIPTOR.fields}
+
+
+class ThriftRecordReader(RecordReader):
+    """ThriftRecordReader parity. Gated: no thrift library in this image;
+    raises with guidance (plugin model)."""
+
+    def __init__(self, path: str | Path, thrift_cls=None):
+        try:
+            import thriftpy2  # noqa: F401
+        except ImportError as e:
+            raise ImportError(
+                "Thrift input requires thriftpy2 (not in this image); "
+                "convert to parquet/jsonl or register a custom reader"
+            ) from e
+        self._path = path
+        self._cls = thrift_cls
+
+
+class CLPRecordReader(RecordReader):
+    """CLP (Compressed Log Processing) reader parity: free-text log lines
+    split into logtype (the template with variables blanked), dictionary
+    variables, and encoded numeric variables — the three-column encoding
+    CLPLogRecordReader emits (pinot-plugins/pinot-input-format/pinot-clp-log/).
+    """
+
+    _VAR = None  # compiled lazily
+
+    def __init__(self, path: str | Path | None = None, *, text: str | None = None):
+        self._path = path
+        self._text = text
+
+    @classmethod
+    def encode_line(cls, line: str) -> dict[str, Any]:
+        import re as _re
+
+        if cls._VAR is None:
+            # CLP variable heuristic: any token containing a digit becomes a
+            # variable; the whole dotted/dashed token matches at once so IPs,
+            # versions, and timestamps stay intact
+            cls._VAR = _re.compile(r"(?<![\w.:/\-])[\w./:\-]*\d[\w./:\-]*")
+            cls._INT = _re.compile(r"-?(?:0|[1-9]\d*)")
+            cls._FLT = _re.compile(r"-?\d+\.\d+")
+        dict_vars: list[str] = []
+        encoded_vars: list[float] = []
+
+        def repl(m):
+            tok = m.group(0)
+            # float-encode ONLY when the decode path reproduces the token
+            # exactly (leading zeros, IPs, ints past 2^53, '-0', and ids with
+            # separators all stay dictionary vars)
+            if cls._INT.fullmatch(tok):
+                f = float(tok)
+                if str(int(f)) == tok:
+                    encoded_vars.append(f)
+                    return "\\f"
+            elif (
+                cls._FLT.fullmatch(tok)
+                and repr(float(tok)) == tok
+                and not float(tok).is_integer()
+            ):
+                encoded_vars.append(float(tok))
+                return "\\f"
+            dict_vars.append(tok)
+            return "\\d"
+
+        logtype = cls._VAR.sub(repl, line.rstrip("\n"))
+        return {
+            "logtype": logtype,
+            "dictionaryVars": dict_vars,
+            "encodedVars": encoded_vars,
+        }
+
+    @classmethod
+    def decode_row(cls, row: dict[str, Any]) -> str:
+        """Reassemble the original line from the three columns."""
+        out = []
+        d = iter(row["dictionaryVars"])
+        e = iter(row["encodedVars"])
+        i = 0
+        s = row["logtype"]
+        while i < len(s):
+            if s.startswith("\\d", i):
+                out.append(next(d))
+                i += 2
+            elif s.startswith("\\f", i):
+                v = next(e)
+                out.append(str(int(v)) if float(v).is_integer() and "e" not in repr(v) else str(v))
+                i += 2
+            else:
+                out.append(s[i])
+                i += 1
+        return "".join(out)
+
+    def __iter__(self):
+        if self._text is not None:
+            lines = self._text.splitlines()
+        else:
+            lines = Path(self._path).read_text().splitlines()
+        for line in lines:
+            if line.strip():
+                yield self.encode_line(line)
+
+
 _BY_EXT = {
     ".csv": CSVRecordReader,
     ".json": JSONRecordReader,
@@ -194,6 +323,10 @@ _BY_EXT = {
     ".parquet": ParquetRecordReader,
     ".orc": ORCRecordReader,
     ".avro": AvroRecordReader,
+    ".pb": ProtobufRecordReader,
+    ".thrift": ThriftRecordReader,
+    ".log": CLPRecordReader,
+    ".clp": CLPRecordReader,
 }
 
 

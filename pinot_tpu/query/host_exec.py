@@ -74,6 +74,37 @@ def eval_value(seg: ImmutableSegment, expr: ast.Expr) -> np.ndarray:
         from pinot_tpu.query.transforms import DEVICE_FUNCS, STRING_FUNCS, apply_string_func
 
         name = expr.name
+        if name == "map_value":
+            # map_value(col, 'key'): dense per-key column via the map index
+            # when present, else per-row document parse (StandardIndexes map
+            # entry parity)
+            if (
+                len(expr.args) != 2
+                or not isinstance(expr.args[0], ast.Identifier)
+                or not isinstance(expr.args[1], ast.Literal)
+            ):
+                raise PlanError("map_value requires (column, 'key')")
+            col, key = expr.args[0].name, str(expr.args[1].value)
+            mi = seg.extras.get("map", {}).get(col)
+            if mi is not None:
+                return mi.value_column(key)
+            import json as _json
+
+            ci = seg.columns.get(col)
+            if ci is None:
+                raise PlanError(f"unknown column {col!r}")
+            out = np.full(seg.n_docs, None, dtype=object)
+            for i, v in enumerate(ci.materialize()):
+                if isinstance(v, dict):
+                    doc = v
+                else:
+                    try:
+                        doc = _json.loads(v) if v else {}
+                    except (ValueError, TypeError):
+                        continue  # non-JSON row -> None
+                if isinstance(doc, dict):
+                    out[i] = doc.get(key)
+            return out
         if name == "lookup":
             # lookUp('dimTable','destColumn','pk1',expr1[,'pk2',expr2...])
             # (LookupTransformFunction parity; host-side PK-map probes)

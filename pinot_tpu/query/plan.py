@@ -189,6 +189,9 @@ class _Lowering:
         from pinot_tpu.query.transforms import DEVICE_FUNCS, STRING_FUNCS, apply_string_func
 
         name = expr.name
+        if name == "map_value":
+            # map-index key reads return object values: host-side
+            raise DeviceFallback("map_value runs host-side (map index probe)")
         if name == "cast":
             if len(expr.args) != 2 or not isinstance(expr.args[1], ast.Literal):
                 raise PlanError("CAST requires CAST(expr AS type)")
@@ -563,12 +566,20 @@ class _Lowering:
         if not ci.is_dict_encoded:
             raise PlanError("LIKE/REGEXP_LIKE requires a dictionary-encoded column")
         self.use_col(expr.name)
-        rx = re.compile(pattern)
-        match = rx.fullmatch if full else rx.search
-        lut = np.zeros(_pow2(max(ci.dictionary.cardinality, 1)), dtype=bool)
-        for i, v in enumerate(ci.dictionary.values):
-            if match(str(v)):
-                lut[i] = True
+        fst = self.seg.extras.get("fst", {}).get(expr.name)
+        if fst is not None:
+            # FST index: prefix patterns are two binary searches; general
+            # regexes memoize their dict-id LUT (nativefst parity)
+            ids = fst.matching_ids(pattern, full)
+            lut = np.zeros(_pow2(max(ci.dictionary.cardinality, 1)), dtype=bool)
+            lut[: len(ids)] = ids
+        else:
+            rx = re.compile(pattern)
+            match = rx.fullmatch if full else rx.search
+            lut = np.zeros(_pow2(max(ci.dictionary.cardinality, 1)), dtype=bool)
+            for i, v in enumerate(ci.dictionary.values):
+                if match(str(v)):
+                    lut[i] = True
         if not lut.any():
             return ("const", False)
         return ("in_lut", expr.name, self.op_idx(lut))

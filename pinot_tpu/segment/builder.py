@@ -96,10 +96,17 @@ class SegmentBuilder:
             spec = self.schema[col]
             dt = spec.data_type
             if col in vector_cols:
-                # embedding column: (n_docs, dim) matrix -> vector index only
-                from pinot_tpu.segment.indexes import VectorIndex
+                # embedding column: (n_docs, dim) matrix -> vector index only.
+                # EXACT (default) = brute-force matmul top-k, the TPU fast
+                # path; HNSW = host graph probes (Lucene HNSW parity)
+                if self.config.indexing.vector_index_type.upper() == "HNSW":
+                    from pinot_tpu.segment.indexes import HnswIndex
 
-                seg.extras.setdefault("vector", {})[col] = VectorIndex.build(np.asarray(raw))
+                    seg.extras.setdefault("vector", {})[col] = HnswIndex.build(np.asarray(raw))
+                else:
+                    from pinot_tpu.segment.indexes import VectorIndex
+
+                    seg.extras.setdefault("vector", {})[col] = VectorIndex.build(np.asarray(raw))
                 continue
             if not spec.single_value:
                 seg.columns[col] = self._build_mv_column(col, dt, raw)
@@ -193,6 +200,25 @@ class SegmentBuilder:
                 seg.extras.setdefault("geo", {})[f"{lat_col},{lng_col}"] = GeoGridIndex.build(
                     lat_col, lng_col, la.materialize().astype(np.float64), ln.materialize().astype(np.float64)
                 )
+        for col in idx.fst_index_columns:
+            ci = seg.columns.get(col)
+            if ci is None or not ci.is_dict_encoded:
+                continue
+            from pinot_tpu.segment.indexes import FstIndex
+
+            seg.extras.setdefault("fst", {})[col] = FstIndex.build(ci.dictionary.values)
+        for col in idx.map_index_columns:
+            ci = seg.columns.get(col)
+            if ci is None:
+                continue
+            from pinot_tpu.segment.indexes import MapIndex
+
+            seg.extras.setdefault("map", {})[col] = MapIndex.build(ci.materialize())
+        # third-party index types (IndexPlugin / StandardIndexes SPI parity)
+        if (self.config.extra or {}).get("customIndexes"):
+            from pinot_tpu.segment.index_spi import build_custom_indexes
+
+            build_custom_indexes(seg, self.config)
 
     # -- persistence ---------------------------------------------------------
 

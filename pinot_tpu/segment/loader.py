@@ -102,8 +102,29 @@ def _reconstruct(
                     read(f"geo_cells::{key}"), read(f"geo_off::{key}"), read(f"geo_doc::{key}"),
                     tuple(gm["bbox"]),
                 )
-            for col in aux.get("vector", []):
-                seg.extras.setdefault("vector", {})[col] = VectorIndex(read(f"vector::{col}"))
-            for col in aux.get("null", []):
-                seg.extras.setdefault("null", {})[col] = read(f"null::{col}")
+            vec_meta = aux.get("vector", [])
+            for col in vec_meta:
+                kind = vec_meta[col] if isinstance(vec_meta, dict) else "VectorIndex"
+                if kind == "HnswIndex":
+                    # graphs rebuild deterministically from the persisted
+                    # vectors (SegmentPreProcessor on-load build parity)
+                    from pinot_tpu.segment.indexes import HnswIndex
+
+                    seg.extras.setdefault("vector", {})[col] = HnswIndex.build(read(f"vector::{col}"))
+                else:
+                    seg.extras.setdefault("vector", {})[col] = VectorIndex(read(f"vector::{col}"))
+        for col in aux.get("fst", []):
+            ci = seg.columns.get(col)
+            if ci is not None and ci.is_dict_encoded:
+                from pinot_tpu.segment.indexes import FstIndex
+
+                seg.extras.setdefault("fst", {})[col] = FstIndex.build(ci.dictionary.values)
+        for col in aux.get("map", []):
+            ci = seg.columns.get(col)
+            if ci is not None:
+                from pinot_tpu.segment.indexes import MapIndex
+
+                seg.extras.setdefault("map", {})[col] = MapIndex.build(ci.materialize())
+        for col in aux.get("null", []):
+            seg.extras.setdefault("null", {})[col] = read(f"null::{col}")
     return seg

@@ -170,7 +170,13 @@ def write_segment_file(seg, seg_dir: Path) -> Path:
         aux_meta.setdefault("geo", {})[key] = {"resDeg": gi.res_deg, "bbox": list(gi.bbox)}
     for col, vi in seg.extras.get("vector", {}).items():
         w.write_array(f"vector::{col}", vi.vectors)
-        aux_meta.setdefault("vector", []).append(col)
+        # HNSW graphs rebuild deterministically on load (SegmentPreProcessor
+        # on-load index build parity); only the vectors persist
+        aux_meta.setdefault("vector", {})[col] = type(vi).__name__
+    for col in seg.extras.get("fst", {}):
+        aux_meta.setdefault("fst", []).append(col)  # rebuilt from the dictionary
+    for col in seg.extras.get("map", {}):
+        aux_meta.setdefault("map", []).append(col)  # rebuilt from the column
     for col, bm in seg.extras.get("null", {}).items():
         w.write_array(f"null::{col}", bm)
         aux_meta.setdefault("null", []).append(col)
