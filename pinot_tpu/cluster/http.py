@@ -361,23 +361,12 @@ class ControllerHTTPService:
                 try:
                     parts = [p for p in self.path.split("?")[0].split("/") if p]
                     if self.path in ("/", "/index.html"):
-                        # minimal status page (the controller UI's round-1
-                        # analog of the React SPA home)
-                        rows = []
-                        for t in c.tables():
-                            ideal = c.ideal_state(t)
-                            docs = sum(m.get("numDocs", 0) for m in c.all_segment_metadata(t).values())
-                            rows.append(f"<tr><td>{t}</td><td>{len(ideal)}</td><td>{docs}</td></tr>")
-                        instances = ", ".join(sorted(p.split("/")[-1] for p in c.store.list("/instances/")))
-                        html = (
-                            "<html><head><title>pinot-tpu controller</title></head><body>"
-                            "<h2>pinot-tpu cluster</h2>"
-                            f"<p>instances: {instances or 'none'}</p>"
-                            "<table border=1 cellpadding=4><tr><th>table</th>"
-                            "<th>segments</th><th>docs</th></tr>" + "".join(rows) + "</table>"
-                            "<p>REST: /tables /brokers /instances /tables/{t}/segments "
-                            "/tables/{t}/idealstate /metrics</p></body></html>"
-                        ).encode()
+                        # single-page controller UI (React SPA analog,
+                        # cluster/ui.py): tables drill-down, instances,
+                        # metrics, query console
+                        from pinot_tpu.cluster.ui import UI_HTML
+
+                        html = UI_HTML.encode()
                         self.send_response(200)
                         self.send_header("Content-Type", "text/html")
                         self.send_header("Content-Length", str(len(html)))

@@ -261,9 +261,19 @@ def get_fs(uri: str) -> PinotFS:
 
             fs = S3FS()  # endpoint/credentials from env (S3_ENDPOINT, AWS_*)
             register_fs("s3", fs)
+        elif scheme == "gs":
+            # GCS serves the S3-compatible XML API (interoperability mode):
+            # the S3 plugin against storage.googleapis.com with HMAC keys
+            # (GCS_ENDPOINT / AWS_ACCESS_KEY_ID overrideable via env)
+            import os
+
+            from pinot_tpu.io.s3 import S3FS
+
+            fs = S3FS(endpoint=os.environ.get("GCS_ENDPOINT", "https://storage.googleapis.com"))
+            register_fs("gs", fs)
         else:
             raise ValueError(
                 f"no PinotFS registered for scheme {scheme!r} "
-                f"(gs/abfs/hdfs plugins require egress; register your own via register_fs)"
+                f"(abfs/hdfs plugins require egress; register your own via register_fs)"
             )
     return fs

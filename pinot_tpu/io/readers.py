@@ -277,7 +277,9 @@ class CLPRecordReader(RecordReader):
             dict_vars.append(tok)
             return "\\d"
 
-        logtype = cls._VAR.sub(repl, line.rstrip("\n"))
+        # pre-escape literal backslashes so placeholder markers in the
+        # ORIGINAL text ("regex \\d matched") never collide with ours
+        logtype = cls._VAR.sub(repl, line.rstrip("\n").replace("\\", "\\\\"))
         return {
             "logtype": logtype,
             "dictionaryVars": dict_vars,
@@ -293,12 +295,17 @@ class CLPRecordReader(RecordReader):
         i = 0
         s = row["logtype"]
         while i < len(s):
-            if s.startswith("\\d", i):
+            if s.startswith("\\\\", i):
+                out.append("\\")
+                i += 2
+            elif s.startswith("\\d", i):
                 out.append(next(d))
                 i += 2
             elif s.startswith("\\f", i):
-                v = next(e)
-                out.append(str(int(v)) if float(v).is_integer() and "e" not in repr(v) else str(v))
+                v = float(next(e))
+                # integral floats were encoded from exact int tokens (guard in
+                # encode): int formatting restores them even past 1e16
+                out.append(str(int(v)) if v.is_integer() else str(v))
                 i += 2
             else:
                 out.append(s[i])

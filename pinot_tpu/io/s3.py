@@ -29,9 +29,14 @@ from pinot_tpu.io.fs import PinotFS
 
 def _uri_parts(uri: str) -> tuple[str, str]:
     p = urllib.parse.urlparse(uri)
-    if p.scheme != "s3":
-        raise ValueError(f"not an s3 uri: {uri}")
+    if p.scheme not in ("s3", "gs"):
+        # gs:// rides the same plugin via GCS's S3-compatible XML API
+        raise ValueError(f"not an s3/gs uri: {uri}")
     return p.netloc, p.path.lstrip("/")
+
+
+def _uri_scheme(uri: str) -> str:
+    return urllib.parse.urlparse(uri).scheme or "s3"
 
 
 class S3FS(PinotFS):
@@ -220,13 +225,14 @@ class S3FS(PinotFS):
 
     def list_files(self, uri: str, recursive: bool = False) -> list[str]:
         bucket, key = _uri_parts(uri)
+        scheme = _uri_scheme(uri)
         prefix = key.rstrip("/") + "/" if key else ""
         keys = self._list_keys(bucket, prefix)
         out = []
         for k in keys:
             rel = k[len(prefix):]
             if recursive or "/" not in rel:
-                out.append(f"s3://{bucket}/{k}")
+                out.append(f"{scheme}://{bucket}/{k}")
         return sorted(out)
 
     def _list_keys(self, bucket: str, prefix: str, max_keys: int | None = None) -> list[str]:
@@ -261,10 +267,11 @@ class S3FS(PinotFS):
             super().copy_to_local(uri, local_path)
             return
         base = key.rstrip("/")
+        scheme = _uri_scheme(uri)
         for child in children:
             dst = Path(local_path) / child[len(base) + 1 :]
             dst.parent.mkdir(parents=True, exist_ok=True)
-            dst.write_bytes(self.read_bytes(f"s3://{bucket}/{child}"))
+            dst.write_bytes(self.read_bytes(f"{scheme}://{bucket}/{child}"))
 
     def copy_from_local(self, local_path: str | Path, uri: str) -> None:
         local_path = Path(local_path)

@@ -202,7 +202,9 @@ class SegmentBuilder:
                 )
         for col in idx.fst_index_columns:
             ci = seg.columns.get(col)
-            if ci is None or not ci.is_dict_encoded:
+            # STRING dictionaries only: numeric dicts sort numerically, so
+            # lexicographic prefix intervals would be wrong
+            if ci is None or not ci.is_dict_encoded or ci.data_type != DataType.STRING:
                 continue
             from pinot_tpu.segment.indexes import FstIndex
 
@@ -290,6 +292,8 @@ def _write_segment_npz(seg: ImmutableSegment, out_dir: str | Path) -> Path:
         arrays[f"range_doc::{col}"] = ri.sorted_doc_ids
         arrays[f"range_val::{col}"] = ri.sorted_values
         aux_meta["range"].append(col)
+    if seg.extras.get("__custom_indexes__"):
+        aux_meta["custom"] = seg.extras["__custom_indexes__"]
     np.savez(seg_dir / "columns.npz", **arrays)
     meta = {
         "formatVersion": FORMAT_VERSION,

@@ -28,6 +28,13 @@ import numpy as np
 class IndexTypeSpec:
     name: str
     build: Callable[[Any, str, Any], Any]  # (segment, column, IndexingConfig) -> index
+    # where results land in seg.extras — standard types alias to the short
+    # keys the query engine and the store actually consult
+    extras_key: str | None = None
+
+    @property
+    def target_key(self) -> str:
+        return self.extras_key or self.name
 
 
 _REGISTRY: dict[str, IndexTypeSpec] = {}
@@ -49,14 +56,36 @@ def registered_index_types() -> list[str]:
 
 def build_custom_indexes(seg, table_config) -> None:
     """Run third-party index builders declared in
-    TableConfig.extra['customIndexes'] = {type: [columns]}."""
+    TableConfig.extra['customIndexes'] = {type: [columns]}. The declaration
+    is recorded on the segment so persistence can rebuild the indexes on
+    load (SegmentPreProcessor on-load build parity)."""
     declared = (table_config.extra or {}).get("customIndexes", {})
+    built: dict = {}
     for type_name, cols in declared.items():
         spec = get_index_type(type_name)
         for col in cols:
             idx = spec.build(seg, col, table_config.indexing)
             if idx is not None:
-                seg.extras.setdefault(type_name, {})[col] = idx
+                seg.extras.setdefault(spec.target_key, {})[col] = idx
+                built.setdefault(type_name, []).append(col)
+    if built:
+        seg.extras["__custom_indexes__"] = built
+
+
+def rebuild_custom_indexes(seg, declared: dict) -> None:
+    """Loader-side rebuild of custom indexes from the persisted declaration
+    {type: [columns]} — plugin indexes survive a write/load cycle without a
+    plugin serde contract."""
+    for type_name, cols in declared.items():
+        try:
+            spec = get_index_type(type_name)
+        except KeyError:
+            continue  # plugin not registered in this process: skip quietly
+        for col in cols:
+            idx = spec.build(seg, col, None)
+            if idx is not None:
+                seg.extras.setdefault(spec.target_key, {})[col] = idx
+    seg.extras["__custom_indexes__"] = dict(declared)
 
 
 # -- standard registrations ---------------------------------------------------
@@ -110,10 +139,13 @@ def _build_json(seg, col, _cfg):
 
 
 def _build_fst(seg, col, _cfg):
+    from pinot_tpu.common.types import DataType
     from pinot_tpu.segment.indexes import FstIndex
 
     ci = _dict_col(seg, col)
-    return FstIndex.build(ci.dictionary.values) if ci else None
+    if ci is None or ci.data_type != DataType.STRING:
+        return None  # numeric dicts sort numerically: prefix intervals invalid
+    return FstIndex.build(ci.dictionary.values)
 
 
 def _build_map(seg, col, _cfg):
@@ -123,13 +155,17 @@ def _build_map(seg, col, _cfg):
     return MapIndex.build(ci.materialize()) if ci is not None else None
 
 
-_std("bloom_filter", _build_bloom)
-_std("inverted_index", _build_inverted)
-_std("range_index", _build_range)
-_std("text_index", _build_text)
-_std("json_index", _build_json)
-_std("fst_index", _build_fst)
-_std("map_index", _build_map)
+def _std2(name, fn, key):
+    register_index_type(IndexTypeSpec(name, fn, extras_key=key))
+
+
+_std2("bloom_filter", _build_bloom, "bloom")
+_std2("inverted_index", _build_inverted, "inverted")
+_std2("range_index", _build_range, "range")
+_std2("text_index", _build_text, "text")
+_std2("json_index", _build_json, "json")
+_std2("fst_index", _build_fst, "fst")
+_std2("map_index", _build_map, "map")
 # forward / dictionary / nullvalue_vector / star_tree / h3 / vector are wired
 # structurally by SegmentBuilder (they need build-time inputs beyond one
 # column); they register as named types for discoverability

@@ -735,12 +735,12 @@ class FstIndex:
             return hit
         import re as _re
 
-        # prefix fast path: ^literal.* or LIKE-style literal% compiles to
-        # a pure-prefix regex "lit.*" with no other metacharacters
-        m = _re.fullmatch(r"([^.\\^$*+?()\[\]{}|]+)\.\*", pattern)
+        # prefix fast path: a literal prefix (plain or backslash-escaped
+        # characters — LIKE 'user-00%' lowers to 'user\-00.*') followed by .*
+        m = _re.fullmatch(r"((?:\\.|[^.\\^$*+?()\[\]{}|])+)\.\*", pattern)
         lut = None
         if full and m:
-            lo, hi = self.prefix_id_range(m.group(1))
+            lo, hi = self.prefix_id_range(_re.sub(r"\\(.)", r"\1", m.group(1)))
             lut = np.zeros(len(self.values), dtype=bool)
             lut[lo:hi] = True
         else:

@@ -115,7 +115,7 @@ def _reconstruct(
                     seg.extras.setdefault("vector", {})[col] = VectorIndex(read(f"vector::{col}"))
         for col in aux.get("fst", []):
             ci = seg.columns.get(col)
-            if ci is not None and ci.is_dict_encoded:
+            if ci is not None and ci.is_dict_encoded and ci.data_type == DataType.STRING:
                 from pinot_tpu.segment.indexes import FstIndex
 
                 seg.extras.setdefault("fst", {})[col] = FstIndex.build(ci.dictionary.values)
@@ -127,4 +127,8 @@ def _reconstruct(
                 seg.extras.setdefault("map", {})[col] = MapIndex.build(ci.materialize())
         for col in aux.get("null", []):
             seg.extras.setdefault("null", {})[col] = read(f"null::{col}")
+        if aux.get("custom"):
+            from pinot_tpu.segment.index_spi import rebuild_custom_indexes
+
+            rebuild_custom_indexes(seg, aux["custom"])
     return seg

@@ -177,6 +177,9 @@ def write_segment_file(seg, seg_dir: Path) -> Path:
         aux_meta.setdefault("fst", []).append(col)  # rebuilt from the dictionary
     for col in seg.extras.get("map", {}):
         aux_meta.setdefault("map", []).append(col)  # rebuilt from the column
+    if seg.extras.get("__custom_indexes__"):
+        # plugin indexes rebuild on load via the SPI registry
+        aux_meta["custom"] = seg.extras["__custom_indexes__"]
     for col, bm in seg.extras.get("null", {}).items():
         w.write_array(f"null::{col}", bm)
         aux_meta.setdefault("null", []).append(col)

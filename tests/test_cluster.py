@@ -203,3 +203,21 @@ def test_broker_multistage_self_join(cluster):
         "(SELECT DISTINCT year FROM lineorder) b"
     )
     assert int(res.rows[0][0]) == t.region.nunique() * t.year.nunique()
+
+
+def test_controller_ui_page(cluster):
+    """The controller serves the single-page UI at / (React SPA analog)."""
+    import urllib.request
+
+    controller, broker, _servers, _t = cluster
+    from pinot_tpu.cluster.http import ControllerHTTPService
+
+    svc = ControllerHTTPService(controller)
+    try:
+        with urllib.request.urlopen(f"http://127.0.0.1:{svc.port}/", timeout=10) as r:
+            html = r.read().decode()
+        assert "pinot-tpu" in html
+        for needle in ("Tables", "Query Console", "/tables", "runQuery"):
+            assert needle in html, needle
+    finally:
+        svc.stop()

@@ -205,3 +205,65 @@ def test_fst_prefix_astral_plane():
 
     truth = [bool(re.fullmatch("ab.*", v)) for v in vals]
     assert lut.tolist() == truth
+
+
+def test_clp_literal_backslash_and_1e16():
+    from pinot_tpu.io.readers import CLPRecordReader
+
+    for line in (
+        r"regex \d matched 3 times",
+        "bytes 10000000000000000 sent",
+        r"path C:\tmp\file2 loaded",
+    ):
+        row = CLPRecordReader.encode_line(line)
+        assert CLPRecordReader.decode_row(row) == line, line
+
+
+def test_fst_skips_numeric_dictionaries():
+    schema = Schema.build("t", dimensions=[("n", DataType.INT)], metrics=[])
+    cfg = TableConfig("t", indexing=IndexingConfig(fst_index_columns=["n"]))
+    seg = SegmentBuilder(schema, cfg).build({"n": np.asarray([1, 2, 10], dtype=np.int32)}, "s0")
+    assert "n" not in seg.extras.get("fst", {})
+
+
+def test_fst_fast_path_escaped_prefix():
+    vals = np.asarray(sorted([f"user-{i:03d}" for i in range(300)]), dtype=object)
+    fst = FstIndex.build(vals)
+    import re
+
+    # LIKE 'user-00%' lowers to the escaped regex 'user\-00.*'
+    lut = fst.matching_ids(re.escape("user-00") + ".*", full=True)
+    assert lut.sum() == 10
+
+
+def test_custom_index_survives_write_load(tmp_path):
+    from pinot_tpu.segment.index_spi import IndexTypeSpec, register_index_type
+
+    class CountIndex:
+        def __init__(self, n):
+            self.n = n
+
+    register_index_type(
+        IndexTypeSpec("count_test", lambda seg, col, cfg: CountIndex(seg.n_docs))
+    )
+    schema = Schema.build("t", dimensions=[], metrics=[("v", DataType.LONG)])
+    cfg = TableConfig("t", extra={"customIndexes": {"count_test": ["v"]}})
+    seg = SegmentBuilder(schema, cfg).build({"v": np.arange(25, dtype=np.int64)}, "s0")
+    for fmt in ("ptseg", "npz"):
+        seg_dir = write_segment(seg, tmp_path / fmt, fmt=fmt)
+        seg2 = load_segment(seg_dir)
+        assert seg2.extras["count_test"]["v"].n == 25, fmt
+
+
+def test_spi_standard_alias_targets_engine_key():
+    from pinot_tpu.segment.index_spi import build_custom_indexes
+
+    schema = Schema.build("t", dimensions=[("city", DataType.STRING)], metrics=[])
+    cfg = TableConfig("t", extra={"customIndexes": {"inverted_index": ["city"]}})
+    seg = SegmentBuilder(schema).build(
+        {"city": np.asarray(["a", "b", "a"], dtype=object)}, "s0"
+    )
+    build_custom_indexes(seg, cfg)
+    # lands under the key the query engine consults
+    assert "city" in seg.extras.get("inverted", {})
+    assert "inverted_index" not in seg.extras

@@ -65,7 +65,10 @@ def test_reload_via_rest_and_status_page(tmp_path):
         assert out["reloaded"] == ["t_0"]
         with urllib.request.urlopen(f"http://127.0.0.1:{svc.port}/") as resp:
             html = resp.read().decode()
-        assert "pinot-tpu cluster" in html and "<td>t</td>" in html
+        # the SPA shell renders tables client-side; assert the shell + REST
+        assert "pinot-tpu" in html and "Query Console" in html
+        with urllib.request.urlopen(f"http://127.0.0.1:{svc.port}/tables") as resp:
+            assert "t" in json.loads(resp.read())["tables"]
         with urllib.request.urlopen(f"http://127.0.0.1:{svc.port}/metrics") as resp:
             json.loads(resp.read())
     finally:

@@ -196,3 +196,18 @@ def test_get_fs_resolves_s3_scheme(monkeypatch):
     got = fs_mod.get_fs("s3://bucket/key")
     assert type(got).__name__ == "S3FS"
     fs_mod._registry.pop("s3", None)
+
+
+def test_gs_scheme_rides_s3_plugin(s3, monkeypatch):
+    """gs:// resolves to the S3 plugin against the GCS-interop endpoint."""
+    stub, _ = s3
+    from pinot_tpu.io import fs as fs_mod
+
+    fs_mod._registry.pop("gs", None)
+    monkeypatch.setenv("GCS_ENDPOINT", f"http://127.0.0.1:{stub.port}")
+    g = fs_mod.get_fs("gs://bkt/obj")
+    assert type(g).__name__ == "S3FS"
+    g.write_bytes("gs://bkt/a/b", b"gcs")
+    assert g.read_bytes("gs://bkt/a/b") == b"gcs"
+    assert g.list_files("gs://bkt/a") == ["gs://bkt/a/b"]
+    fs_mod._registry.pop("gs", None)
