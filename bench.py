@@ -132,7 +132,11 @@ def main():
     from pinot_tpu.parallel import build_sharded_table, make_mesh
     from pinot_tpu.parallel.mesh import execute_sharded_result
 
-    n = int(os.environ.get("PINOT_TPU_BENCH_ROWS", 4_000_000))
+    # BASELINE.json's north star is 1B-row SSB; 16M is the largest default
+    # that builds host-side in reasonable time while amortizing the axon
+    # tunnel's ~64ms per-query round-trip floor (at 4M rows the floor alone
+    # caps config-1-style queries below CPU parity)
+    n = int(os.environ.get("PINOT_TPU_BENCH_ROWS", 16_000_000))
     if init_err and n > 1_000_000:
         # bound the *fallback* round only; a deliberate CPU run keeps the knob
         log(f"TPU-init fallback: clamping rows {n} -> 1000000")

@@ -29,8 +29,20 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-CHUNK = 1024  # docs per grid step (sublane-friendly, fits VMEM one-hot tile)
-GROUP_TILE = 256  # groups per output tile (one-hot tile = CHUNK x GROUP_TILE)
+# Tile geometry. Each grid step costs ~2us of fixed dispatch overhead on TPU,
+# so for a (chunks x group-tiles) grid the step count — not the MACs — is the
+# dominant cost at bench shapes (4M docs x 4.4k groups was 74k steps at
+# 1024/256). CHUNK=4096 keeps the per-chunk plane dot exact (4096*255 < 2^24)
+# and the one-hot VMEM tile at 4MB while cutting steps 4x. Overridable for
+# hardware sweeps (benchmarks/pallas_sweep.py).
+CHUNK = int(os.environ.get("PINOT_TPU_PALLAS_CHUNK", "4096"))
+GROUP_TILE = int(os.environ.get("PINOT_TPU_PALLAS_GTILE", "256"))
+# exactness invariant of the byte-plane SUM: one chunk's plane dot must stay
+# below the f32 exact-integer bound. Fail loudly on bad sweep overrides.
+if CHUNK * 255 >= 2**24:
+    raise ValueError(f"PINOT_TPU_PALLAS_CHUNK={CHUNK}: CHUNK*255 must stay < 2^24 for lossless sums")
+if CHUNK % 128 or GROUP_TILE % 128:
+    raise ValueError("PINOT_TPU_PALLAS_CHUNK/GTILE must be multiples of 128 (lane tiling)")
 
 
 def pallas_enabled() -> bool:
@@ -209,7 +221,7 @@ def pallas_grouped_max(values, gid, mask, ng: int):
 # f32 MXU accumulation is inexact past 2^24, so a lossless integer SUM splits
 # each int32 value into four signed byte planes (v = b3*2^24 + b2*2^16 +
 # b1*2^8 + b0, arithmetic shifts keep the sign in b3). Each chunk's per-plane
-# dot product is <= 1024*255 < 2^24 (exact in f32); the cross-chunk
+# dot product is <= CHUNK*255 < 2^24 (enforced at module load); the cross-chunk
 # accumulator is int32 (exact to 2^31 — plane totals stay under it for
 # segment sets below ~8M docs). One (8, CHUNK) x (CHUNK, GROUP_TILE) matmul
 # yields byte-plane sums AND the group count (mask rides as a 5th plane);
@@ -302,6 +314,30 @@ def pallas_grouped_multi_sum(values_list, gid, mask, ng: int):
         sums.append(p[0] + p[1] * 256.0 + p[2] * 65536.0 + p[3] * 16777216.0)
     counts = out[4 * k, :ng].astype(jnp.int64)
     return sums, counts
+
+
+def pallas_grouped_multi_sum_blocked(values_list, gid, mask, ng: int):
+    """SAFE_DOCS-unbounded variant: statically slices the doc axis into
+    blocks that each respect the int32 plane-accumulator bound and sums the
+    per-block results in f64/i64. Two slices cover 16M docs; per-slice cost
+    is one extra kernel launch."""
+    n = gid.shape[0]
+    if n <= SAFE_DOCS:
+        return pallas_grouped_multi_sum(values_list, gid, mask, ng)
+    block = (SAFE_DOCS // CHUNK) * CHUNK
+    sums_acc = None
+    counts_acc = None
+    for start in range(0, n, block):
+        end = min(start + block, n)
+        s, c = pallas_grouped_multi_sum(
+            [v[start:end] for v in values_list], gid[start:end], mask[start:end], ng
+        )
+        if sums_acc is None:
+            sums_acc, counts_acc = list(s), c
+        else:
+            sums_acc = [a + b for a, b in zip(sums_acc, s)]
+            counts_acc = counts_acc + c
+    return sums_acc, counts_acc
 
 
 def pallas_grouped_sum_count_exact(values_i32, gid, mask, ng: int):

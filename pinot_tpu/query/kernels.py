@@ -388,7 +388,7 @@ def _grouped_all(aggs, cols, ops, mask, gid, ng):
     (min/max/f64/hll/...) use their per-agg reductions."""
     from pinot_tpu.ops import groupby_pallas as gp
 
-    if gp.pallas_auto() and mask.shape[0] <= gp.SAFE_DOCS:
+    if gp.pallas_auto():
         vals, owner = [], {}
         for i, a in enumerate(aggs):
             if a[0] in ("sum", "avg"):
@@ -396,7 +396,10 @@ def _grouped_all(aggs, cols, ops, mask, gid, ng):
                 if v_raw.dtype == jnp.int32:
                     owner[i] = len(vals)
                     vals.append(v_raw)
-        sums, counts = gp.pallas_grouped_multi_sum(vals, gid, mask, ng)
+        # _blocked splits doc sets past the int32 plane-accumulator bound
+        # (SAFE_DOCS) into exact sub-ranges, so big flattened segment sets
+        # (16M-row bench) still ride the MXU path
+        sums, counts = gp.pallas_grouped_multi_sum_blocked(vals, gid, mask, ng)
         parts = []
         for i, a in enumerate(aggs):
             if a[0] == "count":

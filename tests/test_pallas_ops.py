@@ -147,3 +147,26 @@ def test_grouped_all_falls_back_beyond_safe_docs(monkeypatch):
     counts, parts = K._grouped_all(aggs, {"v": vals}, (), mask, gid, ng)
     truth = np.bincount(np.arange(n) % ng, weights=np.arange(n), minlength=ng)
     np.testing.assert_allclose(np.asarray(parts[0]), truth)
+
+
+def test_blocked_multi_sum_past_safe_docs(monkeypatch):
+    """review r3: doc sets past SAFE_DOCS split into exact blocks instead of
+    silently abandoning the pallas path."""
+    import jax.numpy as jnp
+
+    from pinot_tpu.ops import groupby_pallas as gp
+
+    monkeypatch.setattr(gp, "SAFE_DOCS", 9000)
+    rng = np.random.default_rng(8)
+    n, ng = 25_000, 300
+    v = jnp.asarray(rng.integers(-500_000, 500_000, n).astype(np.int32))
+    g = jnp.asarray(rng.integers(0, ng, n).astype(np.int32))
+    m = jnp.asarray(rng.random(n) < 0.7)
+    sums, counts = gp.pallas_grouped_multi_sum_blocked([v], g, m, ng)
+    vm = np.where(np.asarray(m), np.asarray(v, dtype=np.float64), 0.0)
+    truth = np.zeros(ng)
+    np.add.at(truth, np.asarray(g), vm)
+    tc = np.zeros(ng, dtype=np.int64)
+    np.add.at(tc, np.asarray(g), np.asarray(m).astype(np.int64))
+    assert np.allclose(np.asarray(sums[0]), truth)
+    assert np.array_equal(np.asarray(counts), tc)
