@@ -104,9 +104,15 @@ class Project(Node):
 
 @dataclass
 class Aggregate(Node):
+    """mode (two-phase aggregation, AggregateOperator partial/final parity):
+    - direct:  single-phase, computes final values (pre-split behavior)
+    - partial: emits mergeable partials [keys..., per-agg part columns]
+    - final:   merges partial columns per group and finalizes"""
+
     input: Node
     group_exprs: list[ast.Expr]
     aggs: list[AggregationInfo]
+    mode: str = "direct"
 
     def __post_init__(self):
         gf = []
@@ -117,7 +123,16 @@ class Aggregate(Node):
                 gf.append(Field(q, n, c))
             else:
                 gf.append(Field(None, c, c))
-        self.fields = gf + [Field(None, a.name, a.name) for a in self.aggs]
+        if self.mode == "partial":
+            from pinot_tpu.query.reduce import parts_of
+
+            pf = []
+            for a in self.aggs:
+                for j in range(parts_of(a.func)):
+                    pf.append(Field(None, f"{a.name}#p{j}", f"{a.name}#p{j}"))
+            self.fields = gf + pf
+        else:
+            self.fields = gf + [Field(None, a.name, a.name) for a in self.aggs]
 
 
 @dataclass
@@ -637,6 +652,35 @@ def _all_field_exprs(node: Node) -> list[ast.Expr]:
     return [ast.Identifier(f.canon if f.qualifier is None else f"{f.qualifier}.{f.name}") for f in node.fields]
 
 
+# funcs with a mergeable-partial layout the v2 runtime implements (the v1
+# reduce formats); others run single-phase
+SPLITTABLE_AGGS = {
+    "count",
+    "sum",
+    "min",
+    "max",
+    "avg",
+    "minmaxrange",
+    "distinctcount",
+    "distinctcountbitmap",
+    "distinctcounthll",
+    "percentile",
+    "percentiletdigest",
+}
+_SPLIT_FILTERED = {"count", "sum", "min", "max", "avg", "minmaxrange"}
+
+
+def _splittable(aggs) -> bool:
+    for a in aggs:
+        if a.func not in SPLITTABLE_AGGS:
+            return False
+        if a.filter is not None and a.func not in _SPLIT_FILTERED:
+            return False
+        if a.func in ("percentile", "percentiletdigest") and a.arg2 is not None:
+            return False
+    return True
+
+
 def insert_exchanges(node: Node) -> Node:
     """Recursively insert Exchange nodes where distribution must change."""
     if isinstance(node, Scan):
@@ -652,6 +696,22 @@ def insert_exchanges(node: Node) -> Node:
         return node
     if isinstance(node, Aggregate):
         inp = insert_exchanges(node.input)
+        if _splittable(node.aggs):
+            # two-phase aggregation (AggregateOperator LEAF/FINAL parity):
+            # partials compute on the data's side of the exchange — the
+            # shuffle then carries one row per (worker, group) instead of
+            # every input row, and leaf partials can run the fused v1
+            # device path (LeafStageTransferableBlockOperator parity)
+            partial = Aggregate(inp, list(node.group_exprs), list(node.aggs), mode="partial")
+            node.mode = "final"
+            if node.group_exprs:
+                # canon (qualified) names: bare names collide when two group
+                # keys share one (GROUP BY a.k, b.k after a self-join)
+                keys = [ast.Identifier(f.canon) for f in partial.fields[: len(node.group_exprs)]]
+                node.input = Exchange(partial, HASH, keys)
+            else:
+                node.input = Exchange(partial, SINGLETON)
+            return node
         if node.group_exprs:
             node.input = Exchange(inp, HASH, list(node.group_exprs))
         else:

@@ -11,9 +11,12 @@ Reference parity:
   pipeline naturally.
 - Operators (runtime/operator/: HashJoinOperator, AggregateOperator,
   SortOperator, WindowAggregateOperator, set ops, LeafStageTransferableBlock-
-  Operator) -> columnar (pandas/numpy) implementations; the leaf Scan+Filter
-  runs the single-stage path per segment (device mask kernels via host_exec
-  fallback today).
+  Operator) -> columnar (pandas/numpy) implementations for intermediate
+  stages; LEAF work runs the fused v1 DEVICE engine: Scan filters execute
+  the mask kernel (_leaf_filter_mask) and partial aggregates over a Scan run
+  whole-segment fused programs (_try_leaf_device_partial). Aggregation is
+  two-phase (partial below the exchange, final above — AggregateOperator
+  LEAF/FINAL parity) whenever every function has a mergeable partial.
 
 Intermediate blocks are columnar DataFrames with positional integer column
 labels aligned to each logical node's `fields`.
@@ -472,6 +475,15 @@ _FILTERED_AGGS = {"count", "sum", "min", "max", "avg"}
 
 
 def _exec_aggregate(node: L.Aggregate, ctx: RunCtx) -> pd.DataFrame:
+    if node.mode == "partial":
+        # leaf pattern first: Scan input + plain-column keys/args runs the
+        # fused v1 device engine WITHOUT materializing scan rows
+        leaf = _try_leaf_device_partial(node, ctx)
+        if leaf is not None:
+            return leaf
+        return _exec_partial_aggregate(node, exec_node(node.input, ctx))
+    if node.mode == "final":
+        return _exec_final_aggregate(node, exec_node(node.input, ctx))
     df = exec_node(node.input, ctx)
     infields = node.input.fields
     n_groups = len(node.group_exprs)
@@ -527,6 +539,205 @@ def _exec_aggregate(node: L.Aggregate, ctx: RunCtx) -> pd.DataFrame:
         res = gb.size().reset_index().iloc[:, :n_groups]
     res.columns = range(res.shape[1])
     return res
+
+
+def _try_leaf_device_partial(node: L.Aggregate, ctx: RunCtx) -> pd.DataFrame | None:
+    """PartialAggregate directly over a Scan with plain-column keys/args:
+    run the fused v1 device engine per segment (LeafStageTransferableBlock-
+    Operator.java:87 parity — the leaf stage IS the single-stage engine) and
+    emit its mergeable group frames as the partial block. Returns None when
+    the pattern doesn't match (pandas partial takes over)."""
+    scan = node.input
+    if not isinstance(scan, L.Scan):
+        return None
+    for g in node.group_exprs:
+        if not isinstance(g, ast.Identifier):
+            return None
+    for a in node.aggs:
+        if a.arg is not None and not isinstance(a.arg, ast.Identifier):
+            return None
+        if a.arg2 is not None:
+            return None
+    from pinot_tpu.query.context import QueryContext, QueryType
+    from pinot_tpu.query.engine import QueryEngine
+    from pinot_tpu.query.reduce import parts_of
+
+    segs = ctx.segments.get(scan.table, [])
+    mine = segs if ctx.scan_local_all else segs[ctx.worker :: ctx.stage.parallelism]
+    strip = lambda e: ast.Identifier(e.name.split(".", 1)[1]) if "." in e.name else e  # noqa: E731
+    import dataclasses as _dc
+
+    aggs = [
+        _dc.replace(
+            a,
+            arg=strip(a.arg) if isinstance(a.arg, ast.Identifier) else a.arg,
+        )
+        for a in node.aggs
+    ]
+    qctx = QueryContext(
+        statement=None,
+        table=scan.table,
+        query_type=QueryType.GROUP_BY if node.group_exprs else QueryType.AGGREGATION,
+        select_items=[],
+        aggregations=aggs,
+        group_by=[strip(g) for g in node.group_exprs],
+        filter=scan.filter,
+        having=None,
+        order_by=[],
+        limit=1 << 30,
+        offset=0,
+    )
+    eng = QueryEngine(mine)
+    try:
+        partials, _matched = eng.partials(qctx, mine)
+    except Exception:
+        return None  # column/type not lowerable: pandas partial takes over
+    from pinot_tpu.common.metrics import ServerMeter, server_metrics
+
+    server_metrics().meter(ServerMeter.MULTISTAGE_LEAF_DEVICE_SCANS).mark(max(len(mine), 1))
+    k = len(node.group_exprs)
+    if not node.group_exprs:
+        # scalar partials: one row of part columns per segment
+        rows = []
+        for p in partials:
+            row = []
+            for a, part in zip(node.aggs, p):
+                row.extend(part if parts_of(a.func) == 2 else [part])
+            rows.append(row)
+        if not rows:
+            return _empty_df(len(node.fields))
+        return pd.DataFrame({i: [r[i] for r in rows] for i in range(len(node.fields))})
+    frames = [f for f in partials if hasattr(f, "columns") and len(f)]
+    if not frames:
+        return _empty_df(len(node.fields))
+    out = pd.concat(frames, ignore_index=True)
+    # k0..kN + a{i}p{j} -> positional columns matching node.fields
+    order = [f"k{i}" for i in range(k)]
+    for i, a in enumerate(node.aggs):
+        order.extend(f"a{i}p{j}" for j in range(parts_of(a.func)))
+    out = out[order]
+    out.columns = range(out.shape[1])
+    return out
+
+
+def _exec_partial_aggregate(node: L.Aggregate, df: pd.DataFrame) -> pd.DataFrame:
+    """Pandas partial over an arbitrary input block: emits the v1 mergeable
+    partial layout [keys..., per-agg parts...] (host_exec.group_frame's
+    column formats)."""
+    from pinot_tpu.query.reduce import parts_of
+
+    infields = node.input.fields
+    k = len(node.group_exprs)
+    if df.empty:
+        return _empty_df(len(node.fields))
+    work: dict = {}
+    for i, g in enumerate(node.group_exprs):
+        work[f"g{i}"] = eval_expr(g, infields, df).reset_index(drop=True)
+    masks = []
+    vals = []
+    for a in node.aggs:
+        fm = None
+        if a.filter is not None:
+            fm = np.asarray(eval_filter(a.filter, infields, df), bool)
+        masks.append(fm)
+        vals.append(
+            eval_expr(a.arg, infields, df).reset_index(drop=True) if a.arg is not None else None
+        )
+
+    def _partial_cols(sub_idx=None):
+        cols: list = []
+        for a, fm, v in zip(node.aggs, masks, vals):
+            vv = None if v is None else (v if sub_idx is None else v.iloc[sub_idx])
+            mm = fm if sub_idx is None else (None if fm is None else fm[sub_idx])
+            if vv is not None and mm is not None:
+                vv = pd.Series(np.where(mm, vv.to_numpy(np.float64), np.nan))
+            if a.func == "count":
+                n = (
+                    int(mm.sum())
+                    if mm is not None
+                    else (len(df) if sub_idx is None else len(sub_idx))
+                )
+                cols.append(n)
+            elif a.func == "sum":
+                cols.append(float(np.nansum(vv.to_numpy(np.float64))))
+            elif a.func in ("min", "max"):
+                arr = vv.to_numpy(np.float64)
+                arr = arr[~np.isnan(arr)]
+                if a.func == "min":
+                    cols.append(float(arr.min()) if len(arr) else float("inf"))
+                else:
+                    cols.append(float(arr.max()) if len(arr) else float("-inf"))
+            elif a.func == "avg":
+                arr = vv.to_numpy(np.float64)
+                cols.append(float(np.nansum(arr)))
+                cols.append(int(np.count_nonzero(~np.isnan(arr))))
+            elif a.func == "minmaxrange":
+                arr = vv.to_numpy(np.float64)
+                arr = arr[~np.isnan(arr)]
+                cols.append(float(arr.min()) if len(arr) else float("inf"))
+                cols.append(float(arr.max()) if len(arr) else float("-inf"))
+            elif a.func in ("distinctcount", "distinctcountbitmap", "distinctcounthll"):
+                cols.append(set(vv.dropna().tolist()))
+            else:  # percentile / percentiletdigest: exact-values partial
+                cols.append(np.asarray(vv.dropna(), dtype=np.float64))
+        return cols
+
+    if k == 0:
+        cols = _partial_cols()
+        return pd.DataFrame({i: [v] for i, v in enumerate(cols)})
+    key_df = pd.DataFrame({f"g{i}": work[f"g{i}"] for i in range(k)})
+    by = [f"g{i}" for i in range(k)] if k > 1 else "g0"
+    rows = []
+    for key, idx in key_df.groupby(by, dropna=False, sort=False).groups.items():
+        key_vals = list(key) if isinstance(key, tuple) else [key]
+        pos = key_df.index.get_indexer(idx)
+        rows.append(key_vals + _partial_cols(pos))
+    ncols = k + sum(parts_of(a.func) for a in node.aggs)
+    return pd.DataFrame({i: [r[i] for r in rows] for i in range(ncols)})
+
+
+def _exec_final_aggregate(node: L.Aggregate, df: pd.DataFrame) -> pd.DataFrame:
+    """Merge partial columns per group and finalize. The per-function merge
+    is reduce._merge_agg_partials — the SAME table the broker reduce uses —
+    so partial formats (sets vs HLL registers, value arrays, counters) never
+    drift between the v1 and v2 engines."""
+    from functools import reduce as _fold
+
+    from pinot_tpu.query.reduce import _empty_partial, _finalize, _merge_agg_partials, parts_of
+
+    k = len(node.group_exprs)
+    if df.empty:
+        if k == 0:
+            row = [_finalize(a, _empty_partial(a.func, a.extra)) for a in node.aggs]
+            return pd.DataFrame({i: [v] for i, v in enumerate(row)})
+        return _empty_df(len(node.fields))
+
+    # column offsets of each agg's parts
+    offs = []
+    pos = k
+    for a in node.aggs:
+        offs.append(pos)
+        pos += parts_of(a.func)
+
+    def _merge_rows(sub: pd.DataFrame) -> list:
+        out = []
+        for a, off in zip(node.aggs, offs):
+            if parts_of(a.func) == 2:
+                parts = [(row[off], row[off + 1]) for _, row in sub.iterrows()]
+            else:
+                parts = list(sub[off])
+            merged = _fold(lambda x, y, _f=a.func: _merge_agg_partials(_f, x, y), parts)
+            out.append(_finalize(a, merged))
+        return out
+
+    if k == 0:
+        return pd.DataFrame({i: [v] for i, v in enumerate(_merge_rows(df))})
+    rows = []
+    by = list(range(k)) if k > 1 else 0
+    for key, idx in df.groupby(by, dropna=False, sort=False).groups.items():
+        key_vals = list(key) if isinstance(key, tuple) else [key]
+        rows.append(key_vals + _merge_rows(df.loc[idx]))
+    return pd.DataFrame({i: [r[i] for r in rows] for i in range(len(node.fields))})
 
 
 def _exec_join(node: L.Join, ctx: RunCtx) -> pd.DataFrame:

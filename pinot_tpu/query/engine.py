@@ -37,6 +37,12 @@ class QueryEngine:
         self.segments.append(seg)
 
     def _device_seg(self, seg: ImmutableSegment) -> DeviceSegment:
+        if not self.fast32:
+            # default staging shares the per-segment cache: every engine
+            # instance (including ad-hoc ones the multistage leaf path
+            # builds per query) reuses ONE staged copy instead of
+            # re-uploading columns to HBM
+            return seg.to_device_cached()
         ds = self._device.get(seg.name)
         if ds is None:
             ds = seg.to_device(fast32=self.fast32)

@@ -422,3 +422,138 @@ def test_leaf_scan_filter_runs_device_kernel():
     truth = j.groupby("label").v.sum().sort_index()
     assert [r[0] for r in res.rows] == list(truth.index)
     assert [float(r[1]) for r in res.rows] == [float(x) for x in truth]
+
+
+def test_two_phase_aggregate_plan_and_device_leaf():
+    """Two-phase aggregation (AggregateOperator LEAF/FINAL parity): the plan
+    splits partial-below-exchange / final-above, leaf partials run the fused
+    v1 device engine, results match pandas."""
+    import numpy as np
+    import pandas as pd
+
+    from pinot_tpu.common import DataType, Schema
+    from pinot_tpu.common.metrics import ServerMeter, server_metrics
+    from pinot_tpu.multistage import MultistageEngine
+    from pinot_tpu.multistage import logical as L
+    from pinot_tpu.segment import SegmentBuilder
+
+    rng = np.random.default_rng(21)
+    n = 30_000
+    schema = Schema.build(
+        "t",
+        dimensions=[("cat", DataType.STRING)],
+        metrics=[("v", DataType.LONG)],
+    )
+    data = {
+        "cat": np.asarray([f"c{i % 7}" for i in range(n)], dtype=object),
+        "v": rng.integers(0, 1000, n).astype(np.int64),
+    }
+    segs = [
+        SegmentBuilder(schema).build({k: x[: n // 2] for k, x in data.items()}, "s0"),
+        SegmentBuilder(schema).build({k: x[n // 2 :] for k, x in data.items()}, "s1"),
+    ]
+    engine = MultistageEngine({"t": segs})
+
+    # plan shape: final Aggregate over Exchange over partial Aggregate
+    from pinot_tpu.query.sql import parse_sql
+
+    plan = L.build_stage_plan(
+        parse_sql("SELECT t1.cat, SUM(t1.v), COUNT(*), AVG(t1.v), MIN(t1.v) FROM t t1 GROUP BY t1.cat"),
+        L.Catalog({"t": list(segs[0].schema.columns)}),
+        2,
+    )
+    modes = set()
+
+    def walk(node):
+        if isinstance(node, L.Aggregate):
+            modes.add(node.mode)
+        for attr in ("input", "left", "right"):
+            child = getattr(node, attr, None)
+            if isinstance(child, L.Node):
+                walk(child)
+
+    for s in plan.stages.values():
+        walk(s.root)
+    assert modes == {"partial", "final"}, modes
+
+    before = server_metrics().meter(ServerMeter.MULTISTAGE_LEAF_DEVICE_SCANS).count
+    res = engine.execute(
+        "SELECT t1.cat, SUM(t1.v), COUNT(*), AVG(t1.v), MIN(t1.v) FROM t t1 "
+        "WHERE t1.v > 100 GROUP BY t1.cat ORDER BY t1.cat LIMIT 20"
+    )
+    after = server_metrics().meter(ServerMeter.MULTISTAGE_LEAF_DEVICE_SCANS).count
+    assert after > before, "leaf partial aggregate did not run the device engine"
+
+    t = pd.DataFrame({"cat": data["cat"].astype(str), "v": data["v"]})
+    sel = t[t.v > 100]
+    g = sel.groupby("cat").v
+    truth = pd.DataFrame({"s": g.sum(), "c": g.count(), "a": g.mean(), "m": g.min()}).sort_index()
+    assert [r[0] for r in res.rows] == list(truth.index)
+    assert [float(r[1]) for r in res.rows] == [float(x) for x in truth.s]
+    assert [int(r[2]) for r in res.rows] == [int(x) for x in truth.c]
+    assert [round(float(r[3]), 9) for r in res.rows] == [round(float(x), 9) for x in truth.a]
+    assert [float(r[4]) for r in res.rows] == [float(x) for x in truth.m]
+
+
+def test_two_phase_scalar_and_distinct():
+    import numpy as np
+    import pandas as pd
+
+    from pinot_tpu.common import DataType, Schema
+    from pinot_tpu.multistage import MultistageEngine
+    from pinot_tpu.segment import SegmentBuilder
+
+    rng = np.random.default_rng(22)
+    n = 8000
+    schema = Schema.build(
+        "t", dimensions=[("k", DataType.STRING)], metrics=[("v", DataType.LONG)]
+    )
+    data = {
+        "k": np.asarray([f"k{i % 30}" for i in range(n)], dtype=object),
+        "v": rng.integers(0, 50, n).astype(np.int64),
+    }
+    engine = MultistageEngine({"t": [SegmentBuilder(schema).build(data, "s0")]})
+    res = engine.execute("SELECT COUNT(*), SUM(t1.v), DISTINCTCOUNT(t1.v) FROM t t1")
+    t = pd.DataFrame({"k": data["k"].astype(str), "v": data["v"]})
+    assert res.rows[0][0] == n
+    assert float(res.rows[0][1]) == float(t.v.sum())
+    assert res.rows[0][2] == t.v.nunique()
+    # join feeding a two-phase agg (partial over non-Scan input: pandas path)
+    res2 = engine.execute(
+        "SELECT a.k, COUNT(*) FROM t a JOIN t b ON a.k = b.k "
+        "WHERE a.v = 0 AND b.v = 1 GROUP BY a.k ORDER BY a.k LIMIT 5"
+    )
+    av = t[t.v == 0].groupby("k").size()
+    bv = t[t.v == 1].groupby("k").size()
+    truth = (av * bv).dropna().sort_index().head(5)
+    assert [r[0] for r in res2.rows] == list(truth.index)
+    assert [int(r[1]) for r in res2.rows] == [int(x) for x in truth]
+
+
+def test_two_phase_hll_and_dual_key_regressions():
+    """review r3: HLL register partials merge via the shared reduce table
+    (not set-union of registers); duplicate bare group-key names hash on
+    qualified canon names."""
+    import numpy as np
+
+    from pinot_tpu.common import DataType, Schema
+    from pinot_tpu.multistage import MultistageEngine
+    from pinot_tpu.segment import SegmentBuilder
+
+    rng = np.random.default_rng(23)
+    n = 20_000
+    schema = Schema.build(
+        "t", dimensions=[("k", DataType.STRING)], metrics=[("v", DataType.LONG)]
+    )
+    data = {
+        "k": np.asarray([f"k{i % 3}" for i in range(n)], dtype=object),
+        "v": rng.integers(0, 1000, n).astype(np.int64),
+    }
+    eng = MultistageEngine({"t": [SegmentBuilder(schema).build(data, "s0")]})
+    r = eng.execute("SELECT DISTINCTCOUNTHLL(t1.v) FROM t t1")
+    assert 900 <= r.rows[0][0] <= 1100, r.rows
+    r2 = eng.execute(
+        "SELECT a.k, b.k, COUNT(*) FROM t a JOIN t b ON a.k = b.k "
+        "WHERE a.v = 1 AND b.v = 2 GROUP BY a.k, b.k ORDER BY a.k LIMIT 5"
+    )
+    assert r2.rows and all(row[0] == row[1] for row in r2.rows)
