@@ -594,7 +594,8 @@ def _try_leaf_device_partial(node: L.Aggregate, ctx: RunCtx) -> pd.DataFrame | N
         return None  # column/type not lowerable: pandas partial takes over
     from pinot_tpu.common.metrics import ServerMeter, server_metrics
 
-    server_metrics().meter(ServerMeter.MULTISTAGE_LEAF_DEVICE_SCANS).mark(max(len(mine), 1))
+    if mine:
+        server_metrics().meter(ServerMeter.MULTISTAGE_LEAF_DEVICE_SCANS).mark(len(mine))
     k = len(node.group_exprs)
     if not node.group_exprs:
         # scalar partials: one row of part columns per segment

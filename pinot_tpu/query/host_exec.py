@@ -548,7 +548,19 @@ def group_frame(seg: ImmutableSegment, ctx: QueryContext, mask: np.ndarray) -> p
     from pinot_tpu.query.aggregates import EXT_AGGS
 
     data = {}
+    mv_key_cols: list[str] = []
+    mv_key_str: dict[str, bool] = {}
     for i, g in enumerate(ctx.group_by):
+        ci_g = seg.columns.get(g.name) if isinstance(g, ast.Identifier) else None
+        if ci_g is not None and ci_g.is_mv:
+            # MV group key: keep per-doc value arrays; explode below so each
+            # doc contributes once per value (per cartesian combination when
+            # several MV keys group together — Pinot MV group-by semantics)
+            v = eval_value(seg, g)[mask]
+            data[f"k{i}"] = [list(x) for x in v]
+            mv_key_cols.append(f"k{i}")
+            mv_key_str[f"k{i}"] = ci_g.data_type.value in ("STRING", "JSON", "BYTES")
+            continue
         v = eval_value(seg, g)[mask]
         data[f"k{i}"] = v.astype(str) if v.dtype == object else v
     filtered_ok = {"count", "sum", "min", "max", "avg", "minmaxrange"}
@@ -594,6 +606,13 @@ def group_frame(seg: ImmutableSegment, ctx: QueryContext, mask: np.ndarray) -> p
         if a.arg2 is not None:
             data[f"w{i}"] = eval_value(seg, a.arg2)[mask]
     df = pd.DataFrame(data)
+    for c in mv_key_cols:
+        df = df.explode(c, ignore_index=True)
+    if mv_key_cols and len(df):
+        # docs with empty value lists join no group
+        df = df.dropna(subset=mv_key_cols).reset_index(drop=True)
+        for c in mv_key_cols:
+            df[c] = df[c].astype(str) if mv_key_str[c] else pd.to_numeric(df[c])
     if len(df) == 0:
         cols = {f"k{i}": [] for i in range(len(ctx.group_by))}
         for i, a in enumerate(ctx.aggregations):
@@ -697,10 +716,28 @@ def group_frame(seg: ImmutableSegment, ctx: QueryContext, mask: np.ndarray) -> p
 
 def distinct_frame(seg: ImmutableSegment, ctx: QueryContext, mask: np.ndarray) -> pd.DataFrame:
     data = {}
+    mv_cols: list[str] = []
+    mv_str: dict[str, bool] = {}
     for i, it in enumerate(ctx.select_items):
+        ci_s = seg.columns.get(it.expr.name) if isinstance(it.expr, ast.Identifier) else None
+        if ci_s is not None and ci_s.is_mv:
+            # SELECT DISTINCT mv_col: one row per VALUE (mirrors the device
+            # path's value-space group ids and group_frame's explode)
+            v = eval_value(seg, it.expr)[mask]
+            data[f"k{i}"] = [list(x) for x in v]
+            mv_cols.append(f"k{i}")
+            mv_str[f"k{i}"] = ci_s.data_type.value in ("STRING", "JSON", "BYTES")
+            continue
         v = eval_value(seg, it.expr)[mask]
         data[f"k{i}"] = v.astype(str) if v.dtype == object else v
-    return pd.DataFrame(data).drop_duplicates()
+    df = pd.DataFrame(data)
+    for c in mv_cols:
+        df = df.explode(c, ignore_index=True)
+    if mv_cols and len(df):
+        df = df.dropna(subset=mv_cols).reset_index(drop=True)
+        for c in mv_cols:
+            df[c] = df[c].astype(str) if mv_str[c] else pd.to_numeric(df[c])
+    return df.drop_duplicates()
 
 
 def selection_frame(seg: ImmutableSegment, ctx: QueryContext, mask: np.ndarray, k: int) -> pd.DataFrame:

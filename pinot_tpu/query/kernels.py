@@ -331,11 +331,15 @@ def _int_scalar_extreme(v, mask, is_min):
     return jnp.where(jnp.any(mask), r.astype(_F), empty)
 
 
-def _agg_grouped(aspec, cols, ops, mask, gid, ng):
+def _agg_grouped(aspec, cols, ops, mask, gid, ng, gather=None, doc_pad=None):
+    """gather/doc_pad: MV GROUP BY evaluates in VALUE space — doc-space
+    value/filter vectors gather through the owning-doc ids first."""
     kind = aspec[0]
     if kind == "masked":
-        m2 = mask & _filter(aspec[1], cols, ops, mask.shape[0])
-        return _agg_grouped(aspec[2], cols, ops, m2, gid, ng)
+        fm = _filter(aspec[1], cols, ops, doc_pad if gather is not None else mask.shape[0])
+        if gather is not None:
+            fm = fm[gather]
+        return _agg_grouped(aspec[2], cols, ops, mask & fm, gid, ng, gather, doc_pad)
     if kind == "count":
         return _count_grouped(mask, gid, ng)
     if kind == "mv_count":
@@ -349,7 +353,9 @@ def _agg_grouped(aspec, cols, ops, mask, gid, ng):
         gid_v = gid[cols[f"{col}!docs"]]
         inner = {"mv_sum": "sum", "mv_min": "min", "mv_max": "max", "mv_avg": "avg"}[kind]
         return _agg_grouped((inner, vspec), cols, ops, vm, gid_v, ng)
-    v_raw = _value(aspec[1], cols, ops, mask.shape[0])
+    v_raw = _value(aspec[1], cols, ops, doc_pad if gather is not None else mask.shape[0])
+    if gather is not None:
+        v_raw = v_raw[gather]
     is_i32 = v_raw.dtype == jnp.int32
     v = v_raw.astype(_F)
     if kind == "sum":
@@ -382,20 +388,21 @@ def _agg_grouped(aspec, cols, ops, mask, gid, ng):
     raise AssertionError(aspec)
 
 
-def _grouped_all(aggs, cols, ops, mask, gid, ng):
+def _grouped_all(aggs, cols, ops, mask, gid, ng, gather=None, doc_pad=None):
     """Group counts + every agg partial. On TPU the count and ALL int32
     SUM/AVG aggs fuse into ONE pallas byte-plane matmul pass; remaining aggs
-    (min/max/f64/hll/...) use their per-agg reductions."""
+    (min/max/f64/hll/...) use their per-agg reductions. gather/doc_pad: MV
+    GROUP BY (value-space gids) gathers doc-space values first."""
     from pinot_tpu.ops import groupby_pallas as gp
 
     if gp.pallas_auto():
         vals, owner = [], {}
         for i, a in enumerate(aggs):
             if a[0] in ("sum", "avg"):
-                v_raw = _value(a[1], cols, ops, mask.shape[0])
+                v_raw = _value(a[1], cols, ops, doc_pad if gather is not None else mask.shape[0])
                 if v_raw.dtype == jnp.int32:
                     owner[i] = len(vals)
-                    vals.append(v_raw)
+                    vals.append(v_raw if gather is None else v_raw[gather])
         # _blocked splits doc sets past the int32 plane-accumulator bound
         # (SAFE_DOCS) into exact sub-ranges, so big flattened segment sets
         # (16M-row bench) still ride the MXU path
@@ -407,10 +414,10 @@ def _grouped_all(aggs, cols, ops, mask, gid, ng):
             elif i in owner:
                 parts.append(sums[owner[i]] if a[0] == "sum" else (sums[owner[i]], counts))
             else:
-                parts.append(_agg_grouped(a, cols, ops, mask, gid, ng))
+                parts.append(_agg_grouped(a, cols, ops, mask, gid, ng, gather, doc_pad))
         return counts, tuple(parts)
     counts = _count_grouped(mask, gid, ng)
-    return counts, tuple(_agg_grouped(a, cols, ops, mask, gid, ng) for a in aggs)
+    return counts, tuple(_agg_grouped(a, cols, ops, mask, gid, ng, gather, doc_pad) for a in aggs)
 
 
 # ---------------------------------------------------------------------------
@@ -435,6 +442,21 @@ def build_fn(spec: tuple):
             matched = jnp.sum(mask, dtype=jnp.int32).astype(_I)
             if gspec is None:
                 return matched, tuple(_agg_scalar(a, cols, ops, mask) for a in aggs)
+            if gspec[0] == "groups_mv":
+                # one MV group key: gids live in VALUE space — each doc
+                # contributes once per value (Pinot MV group-by semantics)
+                _, gcols, ng, strides_idx, mv_col, nv_idx = gspec
+                docids = cols[f"{mv_col}!docs"]
+                vmask = _mv_vmask(mv_col, nv_idx, cols, ops, mask)
+                strides = ops[strides_idx]
+                gid = jnp.zeros((cols[mv_col].shape[0],), dtype=jnp.int32)
+                for i, c in enumerate(gcols):
+                    ids = cols[c] if c == mv_col else cols[c][docids]
+                    gid = gid + ids * strides[i]
+                counts, parts = _grouped_all(
+                    aggs, cols, ops, vmask, gid, ng, gather=docids, doc_pad=n_padded
+                )
+                return matched, counts, parts
             _, gcols, ng, strides_idx = gspec
             strides = ops[strides_idx]
             gid = jnp.zeros((n_padded,), dtype=jnp.int32)
@@ -508,6 +530,7 @@ def build_masked_fn(spec: tuple):
         matched = jnp.sum(mask, dtype=jnp.int32).astype(_I)
         if gspec is None:
             return matched, tuple(_agg_scalar(a, cols, ops, mask) for a in aggs)
+        assert gspec[0] == "groups", gspec  # sharded tables reject MV columns
         _, gcols, ng, strides_idx = gspec
         strides = ops[strides_idx]
         gid = jnp.zeros((n_padded,), dtype=jnp.int32)

@@ -758,6 +758,7 @@ class _Lowering:
     def group_spec(self) -> tuple:
         cols = []
         cards = []
+        mv_col = None
         for g in self.ctx.group_by:
             if not isinstance(g, ast.Identifier):
                 raise DeviceFallback("expression GROUP BY keys run host-side for now")
@@ -769,7 +770,12 @@ class _Lowering:
             if not ci.is_dict_encoded:
                 raise DeviceFallback(f"GROUP BY on raw column {g.name} runs host-side for now")
             if ci.is_mv:
-                raise PlanError(f"GROUP BY on MV column {g.name} is not supported")
+                # one MV key lowers: group ids live in VALUE space (each doc
+                # contributes once per value — Pinot MV group-by semantics).
+                # Two MV keys = per-doc cartesian products: host explode.
+                if mv_col is not None:
+                    raise DeviceFallback("multiple MV GROUP BY keys run host-side (explode)")
+                mv_col = g.name
             self.use_col(g.name)
             cols.append(g.name)
             cards.append(ci.cardinality)
@@ -789,6 +795,9 @@ class _Lowering:
         # buckets still keep the kernel compile cache warm across near-alike
         # queries (the Pinot plan-cache normalization tradeoff)
         ng = ((max(num_groups, 1) + 255) // 256) * 256
+        if mv_col is not None:
+            nv = self.op_idx(np.int32(len(self.seg.columns[mv_col].forward)))
+            return ("groups_mv", tuple(cols), ng, self.op_idx(strides), mv_col, nv)
         return ("groups", tuple(cols), ng, self.op_idx(strides))
 
 
@@ -898,6 +907,15 @@ def plan_segment(seg: ImmutableSegment, ctx: QueryContext, valid_mask=None) -> S
         grouped = ctx.query_type == QueryType.GROUP_BY
         gspec = lo.group_spec() if grouped else None
         aggs = tuple(lo.agg_spec(a, grouped) for a in ctx.aggregations)
+        if gspec is not None and gspec[0] == "groups_mv":
+            # MV group ids are value-space; *MV aggregations are themselves
+            # value-space over a (possibly different) MV column — the
+            # combined gather semantics run host-side (explode)
+            def _has_mv(a):
+                return a[0].startswith("mv_") or (a[0] == "masked" and _has_mv(a[2]))
+
+            if any(_has_mv(a) for a in aggs):
+                raise DeviceFallback("MV aggregations under an MV GROUP BY run host-side")
         spec = ("agg", fspec, gspec, aggs)
         plan = SegmentPlan(
             spec=spec,
