@@ -677,8 +677,14 @@ def _exec_partial_aggregate(node: L.Aggregate, df: pd.DataFrame) -> pd.DataFrame
                 arr = arr[~np.isnan(arr)]
                 cols.append(float(arr.min()) if len(arr) else float("inf"))
                 cols.append(float(arr.max()) if len(arr) else float("-inf"))
-            elif a.func in ("distinctcount", "distinctcountbitmap", "distinctcounthll"):
+            elif a.func in ("distinctcount", "distinctcountbitmap"):
                 cols.append(set(vv.dropna().tolist()))
+            elif a.func == "distinctcounthll":
+                # registers, matching the leaf device partial format (a mixed
+                # set|registers merge would crash in the final stage)
+                from pinot_tpu.query.sketches import np_hll_registers
+
+                cols.append(np_hll_registers(vv.dropna().to_numpy()))
             else:  # percentile / percentiletdigest: exact-values partial
                 cols.append(np.asarray(vv.dropna(), dtype=np.float64))
         return cols

@@ -253,11 +253,29 @@ class QueryEngine:
             return pd.DataFrame(data)
         aggs_spec = plan.spec[3]
         for i, (a, spec_entry, p) in enumerate(zip(ctx.aggregations, aggs_spec, parts)):
+            while spec_entry[0] == "masked":
+                spec_entry = spec_entry[2]
             if a.func in ("count", "countmv"):
                 data[f"a{i}p0"] = np.asarray(p)[pg]
             elif a.func in ("avg", "avgmv", "minmaxrange"):
                 data[f"a{i}p0"] = np.asarray(p[0])[pg]
                 data[f"a{i}p1"] = np.asarray(p[1])[pg]
+            elif a.func in ("distinctcount", "distinctcountbitmap"):
+                # per-group presence rows -> exact value sets (the v1
+                # mergeable partial format)
+                ci = seg.columns[spec_entry[1]]
+                pres = np.asarray(p)[pg][:, : ci.cardinality]
+                vals = ci.dictionary.values
+                cells = np.empty(len(pg), dtype=object)
+                for j in range(len(pg)):
+                    cells[j] = set(vals[np.nonzero(pres[j])[0]].tolist())
+                data[f"a{i}p0"] = cells
+            elif a.func == "distinctcounthll":
+                regs = np.asarray(p)[pg]
+                cells = np.empty(len(pg), dtype=object)
+                for j in range(len(pg)):
+                    cells[j] = regs[j]
+                data[f"a{i}p0"] = cells
             else:
                 data[f"a{i}p0"] = np.asarray(p)[pg]
         return pd.DataFrame(data)

@@ -684,8 +684,15 @@ def group_frame(seg: ImmutableSegment, ctx: QueryContext, mask: np.ndarray) -> p
                 hi = np.where(np.isnan(hi), -np.inf, hi)
             out[f"a{i}p0"] = lo
             out[f"a{i}p1"] = hi
-        elif a.func in ("distinctcount", "distinctcountbitmap", "distinctcounthll"):
+        elif a.func in ("distinctcount", "distinctcountbitmap"):
             out[f"a{i}p0"] = g[f"v{i}"].agg(lambda s: set(s.tolist())).values
+        elif a.func == "distinctcounthll":
+            # register partials, SAME format as the device matrix path: a
+            # host-fallback segment then merges with device segments via
+            # np.maximum instead of crashing on set|ndarray
+            from pinot_tpu.query.sketches import np_hll_registers
+
+            out[f"a{i}p0"] = g[f"v{i}"].apply(lambda s: np_hll_registers(s.to_numpy())).values
         elif a.func in ("percentile", "percentileest", "percentiletdigest"):
             # .apply, not .agg: pandas agg rejects array-valued reducers
             out[f"a{i}p0"] = g[f"v{i}"].apply(lambda s: np.asarray(s, dtype=np.float64)).values

@@ -342,6 +342,20 @@ def _agg_grouped(aspec, cols, ops, mask, gid, ng, gather=None, doc_pad=None):
         return _agg_grouped(aspec[2], cols, ops, mask & fm, gid, ng, gather, doc_pad)
     if kind == "count":
         return _count_grouped(mask, gid, ng)
+    if kind == "distinct_ids":
+        # grouped DISTINCTCOUNT: per-group presence matrix via 2-D
+        # scatter-or; the plan gates ng*pad under the device budget
+        col, pad = aspec[1], aspec[2]
+        ids = cols[col] if gather is None else cols[col][gather]
+        return jnp.zeros((ng, pad), dtype=bool).at[gid, ids].max(mask)
+    if kind == "hll":
+        # grouped DISTINCTCOUNTHLL: per-group register matrix
+        from pinot_tpu.query.sketches import hll_update_grouped
+
+        hashes = _hashes_for(aspec[1], cols, ops, doc_pad if gather is not None else mask.shape[0])
+        if gather is not None:
+            hashes = hashes[gather]
+        return hll_update_grouped(jnp, jax, hashes, mask, gid, ng, aspec[2])
     if kind == "mv_count":
         col, nv_idx = aspec[1], aspec[2]
         vm = _mv_vmask(col, nv_idx, cols, ops, mask)

@@ -78,6 +78,7 @@ class _Lowering:
         self.ctx = ctx
         self.operands: list[Any] = []
         self.columns: list[str] = []
+        self._group_ng = 1  # set by group_spec; agg budget checks consult it
 
     # -- operand / column registration --------------------------------------
 
@@ -651,17 +652,24 @@ class _Lowering:
         if info.func == "count":
             return ("count",)
         if info.func in ("distinctcount", "distinctcountbitmap"):
-            if grouped:
-                raise DeviceFallback("DISTINCTCOUNT inside GROUP BY runs host-side for now")
             if isinstance(info.arg, ast.Identifier):
                 ci = self.seg.columns.get(info.arg.name)
-                if ci is not None and ci.is_dict_encoded:
+                if ci is not None and ci.is_dict_encoded and not ci.is_mv:
+                    pad = _pow2(max(ci.cardinality, 1))
+                    if grouped and self._group_ng * pad > (1 << 24):
+                        # per-group presence matrix over budget: host sets
+                        raise DeviceFallback(
+                            "grouped DISTINCTCOUNT presence matrix exceeds device budget"
+                        )
                     self.use_col(info.arg.name)
-                    return ("distinct_ids", info.arg.name, _pow2(max(ci.cardinality, 1)))
+                    return ("distinct_ids", info.arg.name, pad)
             raise DeviceFallback("DISTINCTCOUNT on raw/expression args runs host-side")
         if info.func == "distinctcounthll":
             if grouped:
-                raise DeviceFallback("DISTINCTCOUNTHLL inside GROUP BY runs host-side for now")
+                from pinot_tpu.query.sketches import HLL_LOG2M
+
+                if self._group_ng * (1 << HLL_LOG2M) > (1 << 22):
+                    raise DeviceFallback("grouped HLL register matrix exceeds device budget")
             return self._hll_spec(info)
         if info.func == "percentileest":
             if grouped:
@@ -795,6 +803,7 @@ class _Lowering:
         # buckets still keep the kernel compile cache warm across near-alike
         # queries (the Pinot plan-cache normalization tradeoff)
         ng = ((max(num_groups, 1) + 255) // 256) * 256
+        self._group_ng = ng
         if mv_col is not None:
             nv = self.op_idx(np.int32(len(self.seg.columns[mv_col].forward)))
             return ("groups_mv", tuple(cols), ng, self.op_idx(strides), mv_col, nv)

@@ -301,8 +301,16 @@ def reduce_group_by(ctx: QueryContext, frames: list[pd.DataFrame]) -> list[list]
         elif func == "minmaxrange":
             agg_map[f"a{i}p0"] = "min"
             agg_map[f"a{i}p1"] = "max"
-        elif func in ("distinctcount", "distinctcountbitmap", "distinctcounthll"):
-            apply_map[f"a{i}p0"] = lambda s: set().union(*s)
+        elif func in ("distinctcount", "distinctcountbitmap"):
+            apply_map[f"a{i}p0"] = lambda s: set().union(*s)  # single-pass
+        elif func == "distinctcounthll":
+            # shared merge table: register rows (device + host paths) and
+            # legacy exact sets both merge correctly
+            from functools import reduce as _reduce
+
+            apply_map[f"a{i}p0"] = lambda s: _reduce(
+                lambda x, y: _merge_agg_partials("distinctcounthll", x, y), s
+            )
         elif func in ("percentile", "percentileest", "percentiletdigest"):
             apply_map[f"a{i}p0"] = lambda s: np.concatenate([np.asarray(x, dtype=np.float64) for x in s])
         elif func == "mode":

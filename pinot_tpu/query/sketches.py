@@ -65,19 +65,29 @@ def jnp_mix32(jnp, x):
     return h
 
 
-def hll_update(jnp, jax, hashes_u32, mask, log2m: int = HLL_LOG2M):
-    """Per-doc HLL register update: returns (m,) int32 register vector."""
-    m = 1 << log2m
+def _hll_ranks(jnp, hashes_u32, mask, log2m: int):
+    """Shared HLL update math: (register index, masked rank) per hash.
+    rank = leading zeros of the remaining bits + 1 (float-log2 clz trick)."""
     idx = (hashes_u32 >> (32 - log2m)).astype(jnp.int32)
     w = (hashes_u32 << log2m).astype(jnp.uint32)
-    # rank = number of leading zeros of w (within 32-log2m bits) + 1
     wf = w.astype(jnp.float64)
     lg = jnp.floor(jnp.log2(jnp.maximum(wf, 1.0)))
     clz = 31.0 - lg
     rank = jnp.where(w == 0, 32 - log2m + 1, jnp.minimum(clz + 1, 32 - log2m + 1)).astype(jnp.int32)
-    rank = jnp.where(mask, rank, 0)
-    regs = jnp.zeros((m,), dtype=jnp.int32).at[idx].max(rank)
-    return regs
+    return idx, jnp.where(mask, rank, 0)
+
+
+def hll_update(jnp, jax, hashes_u32, mask, log2m: int = HLL_LOG2M):
+    """Per-doc HLL register update: returns (m,) int32 register vector."""
+    idx, rank = _hll_ranks(jnp, hashes_u32, mask, log2m)
+    return jnp.zeros((1 << log2m,), dtype=jnp.int32).at[idx].max(rank)
+
+
+def hll_update_grouped(jnp, jax, hashes_u32, mask, gid, ng: int, log2m: int = HLL_LOG2M):
+    """Per-group HLL registers: (ng, m) int32 via a 2-D scatter-max — the
+    grouped twin of hll_update (DISTINCTCOUNTHLL inside GROUP BY)."""
+    idx, rank = _hll_ranks(jnp, hashes_u32, mask, log2m)
+    return jnp.zeros((ng, 1 << log2m), dtype=jnp.int32).at[gid, idx].max(rank)
 
 
 def hll_estimate(registers: np.ndarray) -> int:

@@ -436,3 +436,39 @@ def test_multi_key_order_by_huge_base_falls_back(tmp_path):
     t = pd.DataFrame({"g": data["g"].astype(str), "big": data["big"]})
     truth = t.sort_values(by=["g", "big"], ascending=[True, False], kind="mergesort").head(7)
     assert [tuple(r) for r in res.rows] == list(zip(truth.g, truth.big))
+
+
+def test_grouped_distinctcount_and_hll_device(setup, monkeypatch):
+    """DISTINCTCOUNT + DISTINCTCOUNTHLL inside GROUP BY run on device
+    (presence / register matrices), matching the host path and pandas."""
+    engine, table = setup
+
+    def no_host(*a, **k):
+        raise AssertionError("grouped distinct fell back to host")
+
+    q = (
+        "SELECT region, DISTINCTCOUNT(nation), DISTINCTCOUNTHLL(quantity) "
+        "FROM lineorder GROUP BY region ORDER BY region LIMIT 10"
+    )
+    monkeypatch.setattr(type(engine), "_host_segment", no_host)
+    res = engine.execute(q)
+    monkeypatch.undo()
+    g = table.groupby("region")
+    truth_dc = g.nation.nunique().sort_index()
+    truth_q = g.quantity.nunique().sort_index()
+    assert [r[0] for r in res.rows] == list(truth_dc.index)
+    assert [r[1] for r in res.rows] == [int(x) for x in truth_dc]
+    # HLL is approximate: within 5% at these cardinalities
+    for got, want in zip((r[2] for r in res.rows), truth_q):
+        assert abs(got - want) <= max(3, 0.05 * want), (got, want)
+
+    # host parity
+    from pinot_tpu.query import plan as plan_mod
+
+    def no_device(*a, **k):
+        raise plan_mod.DeviceFallback("forced host")
+
+    h_engine = QueryEngine(engine.segments)
+    monkeypatch.setattr("pinot_tpu.query.engine.plan_segment", no_device)
+    host = h_engine.execute(q)
+    assert [r[:2] for r in host.rows] == [r[:2] for r in res.rows]

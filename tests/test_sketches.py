@@ -55,12 +55,17 @@ def test_hll_numeric_raw_column(setup):
     assert abs(r.rows[0][0] - truth) / truth < 0.05
 
 
-def test_hll_in_group_by_exact_sets(setup):
+def test_hll_in_group_by_estimates(setup):
+    # grouped HLL now runs the device register-matrix path: approximate
+    # within HLL error bounds (matching Pinot, where grouped
+    # DISTINCTCOUNTHLL is also sketch-approximate)
     e, t = setup
     r = e.execute("SELECT site, DISTINCTCOUNTHLL(user) FROM u GROUP BY site LIMIT 10")
     truth = t.groupby("site").user.nunique().to_dict()
     got = {row[0]: row[1] for row in r.rows}
-    assert got == truth  # grouped path keeps exact sets
+    assert set(got) == set(truth)
+    for k, want in truth.items():
+        assert abs(got[k] - want) <= max(5, 0.05 * want), (k, got[k], want)
 
 
 def test_percentile_exact(setup):
