@@ -19,9 +19,9 @@ plan/maker/InstancePlanMakerImplV2.java:291) + the filter operators
    a pow2-padded static group count.
 
 When a query shape has no device path yet (high-cardinality group-by,
-expression group keys, distinctcount-in-group-by), lowering raises
-`DeviceFallback` and the engine runs the host executor instead (correctness
-first; the fallback set shrinks each round).
+expression group keys, over-budget grouped distinct matrices), lowering
+raises `DeviceFallback` and the engine runs the host executor instead
+(correctness first; the fallback set shrinks each round).
 """
 
 from __future__ import annotations
