@@ -276,6 +276,13 @@ class QueryEngine:
                 for j in range(len(pg)):
                     cells[j] = regs[j]
                 data[f"a{i}p0"] = cells
+            elif a.func == "percentileest":
+                lo, hi = ctx.hints["est_bounds"][a.name]
+                hists = np.asarray(p)[pg]
+                cells = np.empty(len(pg), dtype=object)
+                for j in range(len(pg)):
+                    cells[j] = (hists[j].astype(np.int64), lo, hi)
+                data[f"a{i}p0"] = cells
             else:
                 data[f"a{i}p0"] = np.asarray(p)[pg]
         return pd.DataFrame(data)

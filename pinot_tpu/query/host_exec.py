@@ -509,14 +509,10 @@ def agg_partials(seg: ImmutableSegment, ctx: QueryContext, query_mask: np.ndarra
             if bounds is None:
                 out.append(v)  # exact-values mode (merged by concatenation)
             else:
+                from pinot_tpu.query.sketches import np_est_hist
+
                 lo, hi = bounds
-                if hi > lo:
-                    b = np.clip(((v - lo) * (EST_BINS / (hi - lo))).astype(np.int64), 0, EST_BINS - 1)
-                    counts = np.bincount(b, minlength=EST_BINS).astype(np.int64)
-                else:
-                    counts = np.zeros(EST_BINS, dtype=np.int64)
-                    counts[0] = len(v)
-                out.append((counts, lo, hi))
+                out.append((np_est_hist(v, lo, hi), lo, hi))
             continue
         if a.func in ("percentile", "percentiletdigest"):
             out.append(eval_value(seg, a.arg)[mask].astype(np.float64))
@@ -693,6 +689,15 @@ def group_frame(seg: ImmutableSegment, ctx: QueryContext, mask: np.ndarray) -> p
             from pinot_tpu.query.sketches import np_hll_registers
 
             out[f"a{i}p0"] = g[f"v{i}"].apply(lambda s: np_hll_registers(s.to_numpy())).values
+        elif a.func == "percentileest" and ctx.hints.get("est_bounds", {}).get(a.name):
+            # histogram tuples over the engine's global bounds, matching the
+            # device matrix path's partial format
+            from pinot_tpu.query.sketches import np_est_hist
+
+            lo_b, hi_b = ctx.hints["est_bounds"][a.name]
+            out[f"a{i}p0"] = g[f"v{i}"].apply(
+                lambda s, _lo=lo_b, _hi=hi_b: (np_est_hist(np.asarray(s), _lo, _hi), _lo, _hi)
+            ).values
         elif a.func in ("percentile", "percentileest", "percentiletdigest"):
             # .apply, not .agg: pandas agg rejects array-valued reducers
             out[f"a{i}p0"] = g[f"v{i}"].apply(lambda s: np.asarray(s, dtype=np.float64)).values

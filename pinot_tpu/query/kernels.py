@@ -356,6 +356,14 @@ def _agg_grouped(aspec, cols, ops, mask, gid, ng, gather=None, doc_pad=None):
         if gather is not None:
             hashes = hashes[gather]
         return hll_update_grouped(jnp, jax, hashes, mask, gid, ng, aspec[2])
+    if kind == "hist":
+        # grouped PERCENTILEEST: per-group fixed-bin histogram matrix
+        v = _value(aspec[1], cols, ops, doc_pad if gather is not None else mask.shape[0]).astype(_F)
+        if gather is not None:
+            v = v[gather]
+        lo, inv_w, nbins = ops[aspec[2]], ops[aspec[3]], aspec[4]
+        b = jnp.clip(jnp.floor((v - lo) * inv_w).astype(jnp.int32), 0, nbins - 1)
+        return jnp.zeros((ng, nbins), dtype=jnp.int32).at[gid, b].add(mask.astype(jnp.int32)).astype(_I)
     if kind == "mv_count":
         col, nv_idx = aspec[1], aspec[2]
         vm = _mv_vmask(col, nv_idx, cols, ops, mask)

@@ -673,7 +673,10 @@ class _Lowering:
             return self._hll_spec(info)
         if info.func == "percentileest":
             if grouped:
-                raise DeviceFallback("PERCENTILEEST inside GROUP BY runs host-side for now")
+                from pinot_tpu.query.sketches import EST_BINS
+
+                if self._group_ng * EST_BINS > (1 << 22):
+                    raise DeviceFallback("grouped percentileest histogram matrix exceeds device budget")
             return self._hist_spec(info)
         if info.func in ("percentile", "percentiletdigest", "mode"):
             raise DeviceFallback(f"{info.func} runs host-side (full-values / counter intermediate)")

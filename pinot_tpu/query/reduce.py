@@ -303,15 +303,15 @@ def reduce_group_by(ctx: QueryContext, frames: list[pd.DataFrame]) -> list[list]
             agg_map[f"a{i}p1"] = "max"
         elif func in ("distinctcount", "distinctcountbitmap"):
             apply_map[f"a{i}p0"] = lambda s: set().union(*s)  # single-pass
-        elif func == "distinctcounthll":
-            # shared merge table: register rows (device + host paths) and
-            # legacy exact sets both merge correctly
+        elif func in ("distinctcounthll", "percentileest"):
+            # shared merge table: HLL register rows / histogram tuples and
+            # their legacy set / exact-value forms all merge correctly
             from functools import reduce as _reduce
 
-            apply_map[f"a{i}p0"] = lambda s: _reduce(
-                lambda x, y: _merge_agg_partials("distinctcounthll", x, y), s
+            apply_map[f"a{i}p0"] = lambda s, _f=func: _reduce(
+                lambda x, y: _merge_agg_partials(_f, x, y), s
             )
-        elif func in ("percentile", "percentileest", "percentiletdigest"):
+        elif func in ("percentile", "percentiletdigest"):
             apply_map[f"a{i}p0"] = lambda s: np.concatenate([np.asarray(x, dtype=np.float64) for x in s])
         elif func == "mode":
             apply_map[f"a{i}p0"] = _merge_counters

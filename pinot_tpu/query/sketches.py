@@ -137,6 +137,19 @@ def np_hll_registers(values: np.ndarray, log2m: int = HLL_LOG2M) -> np.ndarray:
     return regs
 
 
+def np_est_hist(values: np.ndarray, lo: float, hi: float) -> np.ndarray:
+    """Fixed-bin histogram counts over the engine's global [lo, hi] bounds —
+    the ONE binning formula all percentileest partial producers share (host
+    scalar, host grouped, and the device kernel mirror it)."""
+    v = np.asarray(values, dtype=np.float64)
+    if hi > lo:
+        b = np.clip(((v - lo) * (EST_BINS / (hi - lo))).astype(np.int64), 0, EST_BINS - 1)
+        return np.bincount(b, minlength=EST_BINS).astype(np.int64)
+    counts = np.zeros(EST_BINS, dtype=np.int64)
+    counts[0] = len(v)
+    return counts
+
+
 def hist_estimate(counts: np.ndarray, lo: float, hi: float, pct: float) -> float:
     """Percentile estimate from a fixed-bin histogram (inclusive-rank rule,
     matching sorted-array index (len-1)*pct/100)."""

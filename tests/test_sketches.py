@@ -201,3 +201,17 @@ def test_theta_malformed_expression_raises_valueerror():
         eval_theta_expression("SET_INTERSECT($1, $3)", s)
     with _pytest.raises(ValueError):
         eval_theta_expression("$1 $2", s)
+
+
+def test_percentileest_in_group_by_device(setup):
+    """PERCENTILEEST inside GROUP BY runs the device histogram-matrix path,
+    consistent with the host tuple format across segments."""
+    e, t = setup
+    r = e.execute("SELECT site, PERCENTILEEST(lat, 90) FROM u GROUP BY site ORDER BY site LIMIT 10")
+    g = t.groupby("site").lat
+    lo, hi = t.lat.min(), t.lat.max()
+    binw = (hi - lo) / 4096
+    for row, (site, vals) in zip(r.rows, g):
+        assert row[0] == site
+        exact = np.sort(vals.to_numpy())[int((len(vals) - 1) * 0.9)]
+        assert abs(row[1] - exact) <= 2 * binw + 1e-9, (row, exact)
