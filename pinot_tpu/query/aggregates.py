@@ -566,6 +566,114 @@ def _freq_finalize(p, extra):
     return {str(k): int(c) for k, c in top}
 
 
+# -- expr min/max ------------------------------------------------------------
+# ExprMinMaxAggregationFunction (parent/child pair in the reference): EXPRMIN
+# (projCol, measureCol) returns projCol's value on the row where measureCol is
+# minimal. partial = (measure, projection) or None; ties keep the first seen.
+
+
+def _exprmm_compute(pick_max: bool):
+    def compute(v, v2, _extra):
+        m = _f64(v2)
+        if len(m) == 0:
+            return None
+        i = int(np.argmax(m)) if pick_max else int(np.argmin(m))
+        val = v[i]
+        return (float(m[i]), val.item() if hasattr(val, "item") else val)
+
+    return compute
+
+
+def _exprmm_merge(pick_max: bool):
+    def merge(a, b):
+        if a is None:
+            return b
+        if b is None:
+            return a
+        if pick_max:
+            return a if a[0] >= b[0] else b
+        return a if a[0] <= b[0] else b
+
+    return merge
+
+
+def _exprmm_finalize(p, _extra):
+    return p[1] if p is not None else None
+
+
+# -- integer-sum tuple sketch family ------------------------------------------
+# DistinctCountIntegerTupleSketch / SumValuesIntegerSumTupleSketch /
+# AvgValueIntegerSumTupleSketch (+Raw). The reference consumes pre-serialized
+# sketches from BYTES columns; here (as with our theta KMV) the sketch is built
+# from raw (key, value) columns: partial = (sorted uint64 key hashes bottom-k,
+# aligned int64 value sums). Same key twice -> values sum (integer-sum mode).
+
+
+def _tuple_pack(h: np.ndarray, vals: np.ndarray):
+    uh, inv = np.unique(h, return_inverse=True)
+    sums = np.zeros(len(uh), dtype=np.int64)
+    np.add.at(sums, inv, vals.astype(np.int64))
+    return uh[:THETA_K], sums[:THETA_K]
+
+
+def _tuple_compute(v, v2, _extra):
+    h = _hash64(np.asarray(v))
+    vals = np.asarray(v2, dtype=np.int64) if v2 is not None else np.ones(len(h), np.int64)
+    return _tuple_pack(h, vals)
+
+
+def _tuple_merge(a, b):
+    return _tuple_pack(np.concatenate([a[0], b[0]]), np.concatenate([a[1], b[1]]))
+
+
+def _tuple_theta(p) -> float:
+    return _theta_theta(p[0])
+
+
+def _tuple_distinct_finalize(p, _extra):
+    k = len(p[0])
+    th = _tuple_theta(p)
+    if th >= 1.0:
+        return k
+    return int(round((k - 1) / th))
+
+
+def _tuple_sum_finalize(p, _extra):
+    return int(round(float(p[1].sum()) / _tuple_theta(p)))
+
+
+def _tuple_avg_finalize(p, _extra):
+    return int(round(float(p[1].mean()))) if len(p[1]) else 0
+
+
+def _tuple_raw_finalize(p, _extra):
+    return _hex(np.asarray(p[0], dtype=np.uint64)) + ":" + _hex(np.asarray(p[1], dtype=np.int64))
+
+
+_TUPLE_EMPTY = lambda e: (np.zeros(0, np.uint64), np.zeros(0, np.int64))  # noqa: E731
+
+
+# -- ST_UNION -----------------------------------------------------------------
+# StUnionAggregationFunction unions geometries (JTS) from a BYTES column. The
+# framework keeps geo as lat/lng numerics or WKT strings, so the union is the
+# distinct value set, rendered as WKT: POINT entries collapse into one
+# MULTIPOINT; anything else becomes a GEOMETRYCOLLECTION of the raw members.
+
+
+def _stunion_finalize(p, _extra):
+    import re as _re
+
+    if not p:
+        return "GEOMETRYCOLLECTION EMPTY"
+    vals = sorted(str(x) for x in p)
+    pts = [_re.fullmatch(r"(?i)\s*POINT\s*\(([^)]+)\)\s*", v) for v in vals]
+    if all(m is not None for m in pts):
+        return "MULTIPOINT (" + ", ".join("(" + m.group(1).strip() + ")" for m in pts) + ")"
+    if all(_re.fullmatch(r"-?\d+(\.\d+)?", v) for v in vals):
+        return "MULTIPOINT (" + ", ".join("(" + v + " 0)" for v in vals) + ")"
+    return "GEOMETRYCOLLECTION (" + ", ".join(vals) + ")"
+
+
 # -- sum with full precision -------------------------------------------------
 # SumPrecisionAggregationFunction: BigDecimal accumulation — python ints are
 # arbitrary precision, so integer inputs sum exactly; floats use math.fsum.
@@ -587,6 +695,16 @@ def _sumprecision_compute(v, _v2, _extra):
 
 # ---------------------------------------------------------------------------
 
+# one shared spec for every raw-HLL-register stand-in (AggSpec is frozen, so
+# the four SQL names can share the instance): registers in, hex registers out
+_RAW_HLL_SPEC = AggSpec(
+    1,
+    _hll_compute,
+    lambda a, b: np.maximum(a, b),
+    lambda p, e: _hex(np.asarray(p, dtype=np.int8)),
+    lambda e: np_hll_registers(np.zeros(0)),
+)
+
 EXT_AGGS: dict[str, AggSpec] = {
     "distinctcountsmarthll": AggSpec(1, _smarthll_compute, _smarthll_merge, _smarthll_finalize, lambda e: set()),
     "percentilesmarttdigest": AggSpec(
@@ -606,13 +724,7 @@ EXT_AGGS: dict[str, AggSpec] = {
     ),
     "frequentlongssketch": AggSpec(1, _freq_compute, _freq_merge, _freq_finalize, lambda e: (int(e[0]) if e else 64, {})),
     "frequentstringssketch": AggSpec(1, _freq_compute, _freq_merge, _freq_finalize, lambda e: (int(e[0]) if e else 64, {})),
-    "distinctcountrawhll": AggSpec(
-        1,
-        _hll_compute,
-        lambda a, b: np.maximum(a, b),
-        lambda p, e: _hex(np.asarray(p, dtype=np.int8)),
-        lambda e: np_hll_registers(np.zeros(0)),
-    ),
+    "distinctcountrawhll": _RAW_HLL_SPEC,
     "distinctcountrawthetasketch": AggSpec(
         1,
         _theta_compute,
@@ -668,6 +780,24 @@ EXT_AGGS: dict[str, AggSpec] = {
         lambda e: np.zeros(0),
     ),
     "distinctcounttheta": AggSpec(1, _theta_compute, _theta_merge_any, _theta_finalize_any, lambda e: np.zeros(0, np.uint64)),
+    "exprmin": AggSpec(2, _exprmm_compute(False), _exprmm_merge(False), _exprmm_finalize, lambda e: None),
+    "exprmax": AggSpec(2, _exprmm_compute(True), _exprmm_merge(True), _exprmm_finalize, lambda e: None),
+    "distinctcounttuplesketch": AggSpec(2, _tuple_compute, _tuple_merge, _tuple_distinct_finalize, _TUPLE_EMPTY),
+    "distinctcountrawintegersumtuplesketch": AggSpec(2, _tuple_compute, _tuple_merge, _tuple_raw_finalize, _TUPLE_EMPTY),
+    "sumvaluesintegersumtuplesketch": AggSpec(2, _tuple_compute, _tuple_merge, _tuple_sum_finalize, _TUPLE_EMPTY),
+    "avgvalueintegersumtuplesketch": AggSpec(2, _tuple_compute, _tuple_merge, _tuple_avg_finalize, _TUPLE_EMPTY),
+    "fasthll": AggSpec(1, _hll_compute, lambda a, b: np.maximum(a, b), _hll_finalize, lambda e: np_hll_registers(np.zeros(0))),
+    "stunion": AggSpec(1, _set_compute, lambda a, b: a | b, _stunion_finalize, lambda e: set()),
+    "percentilerawkll": AggSpec(
+        1,
+        lambda v, _v2, e: _f64(v),
+        lambda a, b: np.concatenate([a, b]),
+        lambda p, e: _hex(np.asarray(np.sort(p), dtype=np.float64)),
+        lambda e: np.zeros(0),
+    ),
+    "distinctcountrawhllplus": _RAW_HLL_SPEC,
+    "distinctcountrawull": _RAW_HLL_SPEC,
+    "distinctcountrawcpcsketch": _RAW_HLL_SPEC,
     "distinctcounthllplus": AggSpec(1, _hll_compute, lambda a, b: np.maximum(a, b), _hll_finalize, lambda e: np_hll_registers(np.zeros(0))),
     "distinctcountcpc": AggSpec(1, _hll_compute, lambda a, b: np.maximum(a, b), _hll_finalize, lambda e: np_hll_registers(np.zeros(0))),
     "distinctcountull": AggSpec(1, _hll_compute, lambda a, b: np.maximum(a, b), _hll_finalize, lambda e: np_hll_registers(np.zeros(0))),

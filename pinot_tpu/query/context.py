@@ -106,6 +106,30 @@ AGG_FUNCS = {
     "distinctcountrawthetasketch",
     "percentilerawest",
     "percentilerawtdigest",
+    # expr min/max, tuple sketches, ST_UNION, remaining raw variants
+    # (ExprMinMax / *IntegerTupleSketch / StUnion / DistinctCountRaw*)
+    "exprmin",
+    "exprmax",
+    "distinctcounttuplesketch",
+    "distinctcountrawintegersumtuplesketch",
+    "sumvaluesintegersumtuplesketch",
+    "avgvalueintegersumtuplesketch",
+    "fasthll",
+    "stunion",
+    "percentilerawkll",
+    "distinctcountrawhllplus",
+    "distinctcountrawull",
+    "distinctcountrawcpcsketch",
+    # additional MV variants riding the MV-twin reduce machinery
+    "percentileestmv",
+    "percentiletdigestmv",
+    "percentilekllmv",
+    "percentilerawestmv",
+    "percentilerawtdigestmv",
+    "percentilerawkllmv",
+    "distinctcounthllplusmv",
+    "distinctcountrawhllmv",
+    "distinctcountrawhllplusmv",
 }
 
 FUNNEL_AGGS = {
@@ -230,6 +254,13 @@ def _extract_aggs(expr: Expr, out: dict[str, AggregationInfo]) -> bool:
                     "percentilesmarttdigest",
                     "percentilerawest",
                     "percentilerawtdigest",
+                    "percentilerawkll",
+                    "percentileestmv",
+                    "percentiletdigestmv",
+                    "percentilekllmv",
+                    "percentilerawestmv",
+                    "percentilerawtdigestmv",
+                    "percentilerawkllmv",
                 ):
                     if len(expr.args) != 2 or not isinstance(expr.args[1], Literal):
                         raise ValueError(f"{fname} requires (column, percentile) arguments")
@@ -255,6 +286,13 @@ def _extract_aggs(expr: Expr, out: dict[str, AggregationInfo]) -> bool:
                     extra = tuple(float(a.value) for a in expr.args[1:])
                 elif fname in TWO_ARG_AGGS:
                     if len(expr.args) < 2:
+                        # distinct tuple-sketch counts don't need a value column
+                        if fname in (
+                            "distinctcounttuplesketch",
+                            "distinctcountrawintegersumtuplesketch",
+                        ):
+                            out.setdefault(name, AggregationInfo(func, arg, name, (), None, expr.filter))
+                            return True
                         raise ValueError(f"{fname} requires two column arguments")
                     arg2 = expr.args[1]
                     # trailing literal args (e.g. firstwithtime dataType) -> extra

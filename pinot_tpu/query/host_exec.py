@@ -356,8 +356,29 @@ _MV_AGGS = (
     "distinctcountbitmapmv",
     "distinctcounthllmv",
     "percentilemv",
+    "percentileestmv",
+    "percentiletdigestmv",
+    "percentilekllmv",
+    "percentilerawestmv",
+    "percentilerawtdigestmv",
+    "percentilerawkllmv",
+    "distinctcounthllplusmv",
+    "distinctcountrawhllmv",
+    "distinctcountrawhllplusmv",
 )
 _MV_SET_AGGS = ("distinctcountmv", "distinctsummv", "distinctavgmv", "distinctcountbitmapmv", "distinctcounthllmv")
+# flat matched values as the partial (the SV twins merge by concatenation)
+_MV_VALUES_AGGS = (
+    "percentilemv",
+    "percentileestmv",
+    "percentiletdigestmv",
+    "percentilekllmv",
+    "percentilerawestmv",
+    "percentilerawtdigestmv",
+    "percentilerawkllmv",
+)
+# HLL-register partials (the SV twins merge via elementwise np.maximum)
+_MV_REG_AGGS = ("distinctcounthllplusmv", "distinctcountrawhllmv", "distinctcountrawhllplusmv")
 
 
 def _funnel_mod():
@@ -400,8 +421,12 @@ def _mv_scalar_partial(func: str, flat: np.ndarray):
         return int(len(flat))
     if func in _MV_SET_AGGS:
         return set(flat.tolist())
-    if func == "percentilemv":
+    if func in _MV_VALUES_AGGS:
         return flat.astype(np.float64)
+    if func in _MV_REG_AGGS:
+        from pinot_tpu.query.sketches import np_hll_registers
+
+        return np_hll_registers(flat)
     v = flat.astype(np.float64)
     if func == "summv":
         return float(v.sum())
@@ -426,17 +451,17 @@ def _mv_doc_partials(func: str, ci, mask: np.ndarray) -> dict[str, np.ndarray]:
     if func == "countmv":
         return {"p0": ci.lens[mask].astype(np.int64)}
     flat = _mv_flat_values(ci)
-    if func in _MV_SET_AGGS or func == "percentilemv":
+    if func in _MV_SET_AGGS or func in _MV_VALUES_AGGS or func in _MV_REG_AGGS:
         # build cells only for masked docs — a selective filter must not pay
-        # a python loop over the whole segment
+        # a python loop over the whole segment; register-family docs carry
+        # value sets too (converted to registers once per merged group)
         sel = np.nonzero(mask)[0]
         cells = np.empty(len(sel), dtype=object)
         off = ci.offsets()
+        values_mode = func in _MV_VALUES_AGGS
         for i, d in enumerate(sel):
             chunk = flat[off[d] : off[d + 1]]
-            cells[i] = (
-                chunk.astype(np.float64) if func == "percentilemv" else set(chunk.tolist())
-            )
+            cells[i] = chunk.astype(np.float64) if values_mode else set(chunk.tolist())
         return {"p0": cells}
     v = flat.astype(np.float64)
     if func == "summv":
@@ -502,8 +527,6 @@ def agg_partials(seg: ImmutableSegment, ctx: QueryContext, query_mask: np.ndarra
             out.append(np_hll_registers(v))
             continue
         if a.func == "percentileest":
-            from pinot_tpu.query.sketches import EST_BINS
-
             v = eval_value(seg, a.arg)[mask].astype(np.float64)
             bounds = ctx.hints.get("est_bounds", {}).get(a.name)
             if bounds is None:
@@ -633,9 +656,17 @@ def group_frame(seg: ImmutableSegment, ctx: QueryContext, mask: np.ndarray) -> p
             elif a.func == "minmaxrangemv":
                 out[f"a{i}p0"] = g[f"m{i}p0"].min().values
                 out[f"a{i}p1"] = g[f"m{i}p1"].max().values
-            elif a.func == "percentilemv":
+            elif a.func in _MV_VALUES_AGGS:
                 out[f"a{i}p0"] = g[f"m{i}p0"].apply(
                     lambda s: np.concatenate([np.asarray(x, dtype=np.float64) for x in s])
+                ).values
+            elif a.func in _MV_REG_AGGS:
+                # group-merged value set -> registers, matching the SV twin's
+                # partial format so reduce merges via np.maximum
+                from pinot_tpu.query.sketches import np_hll_registers
+
+                out[f"a{i}p0"] = g[f"m{i}p0"].apply(
+                    lambda s: np_hll_registers(np.asarray(list(set().union(*s))))
                 ).values
             else:  # distinct*-mv set partials
                 out[f"a{i}p0"] = g[f"m{i}p0"].agg(lambda s: set().union(*s)).values

@@ -49,6 +49,15 @@ MV_TWIN = {
     "distinctcountbitmapmv": "distinctcountbitmap",
     "distinctcounthllmv": "distinctcounthll",
     "percentilemv": "percentile",
+    "percentileestmv": "percentileest",
+    "percentiletdigestmv": "percentiletdigest",
+    "percentilekllmv": "percentilekll",
+    "percentilerawestmv": "percentilerawest",
+    "percentilerawtdigestmv": "percentilerawtdigest",
+    "percentilerawkllmv": "percentilerawkll",
+    "distinctcounthllplusmv": "distinctcounthllplus",
+    "distinctcountrawhllmv": "distinctcountrawhll",
+    "distinctcountrawhllplusmv": "distinctcountrawhllplus",
 }
 
 
@@ -326,9 +335,9 @@ def reduce_group_by(ctx: QueryContext, frames: list[pd.DataFrame]) -> list[list]
 
             from pinot_tpu.query.aggregates import EXT_AGGS
 
-            if a.func not in EXT_AGGS:
+            if func not in EXT_AGGS:
                 raise AssertionError(a.func)
-            apply_map[f"a{i}p0"] = lambda s, _m=EXT_AGGS[a.func].merge: _reduce(_m, s)
+            apply_map[f"a{i}p0"] = lambda s, _m=EXT_AGGS[func].merge: _reduce(_m, s)
     if agg_map or apply_map:
         g = df.groupby(key_cols, sort=False, dropna=False)
         merged = g.agg(agg_map).reset_index() if agg_map else g.size().reset_index().drop(columns=[0])

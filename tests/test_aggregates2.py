@@ -1,0 +1,224 @@
+"""Long-tail aggregation functions round 3: EXPRMIN/EXPRMAX, the integer-sum
+tuple sketch family, FASTHLL, ST_UNION, the remaining raw sketch variants, and
+the new MV percentile/HLL variants — cross-checked against pandas oracles over
+multiple segments (exercising the partial-merge path).
+
+Reference parity: pinot-core/.../query/aggregation/function/
+{ParentExprMinMax,DistinctCountIntegerTupleSketch,SumValuesIntegerSumTupleSketch,
+AvgValueIntegerSumTupleSketch,FastHLL,StUnion,DistinctCountRawHLLPlus,
+PercentileRawKLL}AggregationFunction.java and the *MVAggregationFunction family.
+"""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from pinot_tpu.common import DataType, FieldSpec, Schema
+from pinot_tpu.query import QueryEngine
+from pinot_tpu.segment import SegmentBuilder
+
+
+@pytest.fixture(scope="module")
+def setup():
+    schema = Schema.build(
+        "m",
+        dimensions=[("g", DataType.STRING), ("k", DataType.INT)],
+        metrics=[("x", DataType.DOUBLE), ("v", DataType.LONG)],
+        date_times=[("ts", DataType.LONG)],
+    )
+    b = SegmentBuilder(schema)
+    rng = np.random.default_rng(7)
+    segs, frames = [], []
+    for i, n in enumerate([800, 1200]):
+        data = {
+            "g": np.asarray(["a", "b", "c"], dtype=object)[rng.integers(0, 3, n)],
+            "k": rng.integers(0, 500, n).astype(np.int32),
+            "x": np.round(rng.normal(50, 12, n), 4),
+            "v": rng.integers(1, 20, n).astype(np.int64),
+            "ts": rng.permutation(np.arange(i * 10_000, i * 10_000 + n)).astype(np.int64),
+        }
+        segs.append(b.build(data, f"m_{i}"))
+        frames.append(pd.DataFrame({c: (a.astype(str) if a.dtype == object else a) for c, a in data.items()}))
+    return QueryEngine(segs), pd.concat(frames, ignore_index=True)
+
+
+def one(engine, sql):
+    return engine.execute(sql).rows[0][0]
+
+
+# -- EXPRMIN / EXPRMAX --------------------------------------------------------
+
+
+def test_exprmin_exprmax(setup):
+    engine, t = setup
+    assert one(engine, "SELECT EXPRMIN(g, ts) FROM m") == t.loc[t.ts.idxmin(), "g"]
+    assert one(engine, "SELECT EXPRMAX(g, ts) FROM m") == t.loc[t.ts.idxmax(), "g"]
+    assert one(engine, "SELECT EXPRMAX(x, v) FROM m") == pytest.approx(
+        t.loc[t.v.idxmax(), "x"], rel=1e-9
+    )
+
+
+def test_exprminmax_group_by(setup):
+    engine, t = setup
+    res = engine.execute("SELECT g, EXPRMIN(ts, x) FROM m GROUP BY g ORDER BY g LIMIT 10")
+    want = t.loc[t.groupby("g").x.idxmin(), ["g", "ts"]].sort_values("g")
+    assert [[r[0], int(r[1])] for r in res.rows] == [
+        [g, int(ts)] for g, ts in want.itertuples(index=False)
+    ]
+
+
+def test_exprmin_filtered(setup):
+    engine, t = setup
+    sub = t[t.k < 100]
+    assert one(engine, "SELECT EXPRMIN(g, ts) FROM m WHERE k < 100") == sub.loc[sub.ts.idxmin(), "g"]
+
+
+# -- integer-sum tuple sketch family ------------------------------------------
+
+
+def test_tuple_sketch_distinct(setup):
+    engine, t = setup
+    got = one(engine, "SELECT DISTINCTCOUNTTUPLESKETCH(k) FROM m")
+    assert got == t.k.nunique()  # below sketch capacity -> exact
+    got2 = one(engine, "SELECT DISTINCTCOUNTTUPLESKETCH(k, v) FROM m")
+    assert got2 == t.k.nunique()
+
+
+def test_tuple_sketch_sum_avg(setup):
+    engine, t = setup
+    per_key = t.groupby("k").v.sum()
+    got_sum = one(engine, "SELECT SUMVALUESINTEGERSUMTUPLESKETCH(k, v) FROM m")
+    assert got_sum == int(per_key.sum())  # exact below capacity
+    got_avg = one(engine, "SELECT AVGVALUEINTEGERSUMTUPLESKETCH(k, v) FROM m")
+    assert got_avg == int(round(per_key.mean()))
+
+
+def test_tuple_sketch_raw(setup):
+    engine, _ = setup
+    raw = one(engine, "SELECT DISTINCTCOUNTRAWINTEGERSUMTUPLESKETCH(k, v) FROM m")
+    assert isinstance(raw, str) and ":" in raw
+    h, vals = raw.split(":")
+    assert len(h) % 16 == 0 and len(vals) % 16 == 0  # uint64/int64 hex words
+
+
+# -- FASTHLL and raw sketch variants -----------------------------------------
+
+
+def test_fasthll(setup):
+    engine, t = setup
+    got = one(engine, "SELECT FASTHLL(k) FROM m")
+    assert got == pytest.approx(t.k.nunique(), rel=0.05)
+
+
+def test_raw_hll_variants_hex(setup):
+    engine, _ = setup
+    for fn in (
+        "DISTINCTCOUNTRAWHLLPLUS",
+        "DISTINCTCOUNTRAWULL",
+        "DISTINCTCOUNTRAWCPCSKETCH",
+    ):
+        raw = one(engine, f"SELECT {fn}(k) FROM m")
+        assert isinstance(raw, str) and len(raw) > 0
+        bytes.fromhex(raw)  # must round-trip as hex
+
+
+def test_percentile_raw_kll(setup):
+    engine, t = setup
+    raw = one(engine, "SELECT PERCENTILERAWKLL(x, 50) FROM m")
+    vals = np.frombuffer(bytes.fromhex(raw), dtype=np.float64)
+    assert len(vals) == len(t)
+    assert vals[0] == pytest.approx(t.x.min()) and vals[-1] == pytest.approx(t.x.max())
+
+
+# -- ST_UNION -----------------------------------------------------------------
+
+
+def test_stunion(setup):
+    engine, t = setup
+    got = one(engine, "SELECT STUNION(g) FROM m")
+    assert got == "GEOMETRYCOLLECTION (a, b, c)"
+
+
+def test_stunion_points():
+    schema = Schema.build("geo", dimensions=[("wkt", DataType.STRING)], metrics=[])
+    pts = np.asarray(
+        ["POINT (1 2)", "POINT (3 4)", "POINT (1 2)", "POINT (0 0)"], dtype=object
+    )
+    seg = SegmentBuilder(schema).build({"wkt": pts}, "g0")
+    eng = QueryEngine([seg])
+    got = eng.execute("SELECT STUNION(wkt) FROM geo").rows[0][0]
+    assert got == "MULTIPOINT ((0 0), (1 2), (3 4))"
+
+
+# -- MV variants --------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def mv_setup():
+    schema = Schema.build("t", dimensions=[("year", DataType.INT)], metrics=[])
+    schema.add(FieldSpec("nums", DataType.LONG, single_value=False))
+    rng = np.random.default_rng(11)
+    n = 3000
+    nums = np.empty(n, dtype=object)
+    for i in range(n):
+        k = int(rng.integers(0, 5))
+        nums[i] = rng.integers(0, 200, size=k).astype(np.int64).tolist()
+    year = rng.integers(2018, 2022, n).astype(np.int32)
+    seg = SegmentBuilder(schema).build({"nums": nums, "year": year}, "s0")
+    df = pd.DataFrame({"nums": nums, "year": year})
+    return QueryEngine([seg]), df
+
+
+def _flat(df, col="nums"):
+    return np.concatenate([np.asarray(v, dtype=np.float64) for v in df[col] if len(v)])
+
+
+def test_percentile_mv_variants(mv_setup):
+    eng, df = mv_setup
+    flat = np.sort(_flat(df))
+    want = flat[int((len(flat) - 1) * 0.75)]
+    for fn in ("PERCENTILEESTMV", "PERCENTILETDIGESTMV", "PERCENTILEKLLMV"):
+        got = eng.execute(f"SELECT {fn}(nums, 75) FROM t").rows[0][0]
+        assert got == pytest.approx(want), fn
+
+
+def test_percentile_raw_mv_variants(mv_setup):
+    eng, df = mv_setup
+    for fn in ("PERCENTILERAWESTMV", "PERCENTILERAWTDIGESTMV", "PERCENTILERAWKLLMV"):
+        raw = eng.execute(f"SELECT {fn}(nums, 75) FROM t").rows[0][0]
+        assert isinstance(raw, str)
+        bytes.fromhex(raw)
+
+
+def test_hllplus_mv_and_raws(mv_setup):
+    eng, df = mv_setup
+    true_card = len(np.unique(_flat(df)))
+    got = eng.execute("SELECT DISTINCTCOUNTHLLPLUSMV(nums) FROM t").rows[0][0]
+    assert got == pytest.approx(true_card, rel=0.06)
+    for fn in ("DISTINCTCOUNTRAWHLLMV", "DISTINCTCOUNTRAWHLLPLUSMV"):
+        raw = eng.execute(f"SELECT {fn}(nums) FROM t").rows[0][0]
+        assert isinstance(raw, str)
+        bytes.fromhex(raw)
+
+
+def test_mv_group_by_new_percentiles(mv_setup):
+    eng, df = mv_setup
+    res = eng.execute(
+        "SELECT year, PERCENTILEKLLMV(nums, 50) FROM t GROUP BY year ORDER BY year LIMIT 10"
+    )
+    for year, got in res.rows:
+        sub = df[df.year == year]
+        flat = np.sort(_flat(sub))
+        want = flat[int((len(flat) - 1) * 0.5)]
+        assert got == pytest.approx(want), year
+
+
+def test_mv_group_by_hllplus(mv_setup):
+    eng, df = mv_setup
+    res = eng.execute(
+        "SELECT year, DISTINCTCOUNTHLLPLUSMV(nums) FROM t GROUP BY year ORDER BY year LIMIT 10"
+    )
+    for year, got in res.rows:
+        sub = df[df.year == year]
+        true_card = len(np.unique(_flat(sub)))
+        assert got == pytest.approx(true_card, rel=0.08), year
