@@ -695,8 +695,16 @@ def _sumprecision_compute(v, _v2, _extra):
 
 # ---------------------------------------------------------------------------
 
-# one shared spec for every raw-HLL-register stand-in (AggSpec is frozen, so
-# the four SQL names can share the instance): registers in, hex registers out
+# shared specs for the HLL-register stand-in families (AggSpec is frozen, so
+# multiple SQL names can share one instance): estimate-returning and
+# hex-serialized-raw variants
+_HLL_SPEC = AggSpec(
+    1,
+    _hll_compute,
+    lambda a, b: np.maximum(a, b),
+    _hll_finalize,
+    lambda e: np_hll_registers(np.zeros(0)),
+)
 _RAW_HLL_SPEC = AggSpec(
     1,
     _hll_compute,
@@ -786,7 +794,7 @@ EXT_AGGS: dict[str, AggSpec] = {
     "distinctcountrawintegersumtuplesketch": AggSpec(2, _tuple_compute, _tuple_merge, _tuple_raw_finalize, _TUPLE_EMPTY),
     "sumvaluesintegersumtuplesketch": AggSpec(2, _tuple_compute, _tuple_merge, _tuple_sum_finalize, _TUPLE_EMPTY),
     "avgvalueintegersumtuplesketch": AggSpec(2, _tuple_compute, _tuple_merge, _tuple_avg_finalize, _TUPLE_EMPTY),
-    "fasthll": AggSpec(1, _hll_compute, lambda a, b: np.maximum(a, b), _hll_finalize, lambda e: np_hll_registers(np.zeros(0))),
+    "fasthll": _HLL_SPEC,
     "stunion": AggSpec(1, _set_compute, lambda a, b: a | b, _stunion_finalize, lambda e: set()),
     "percentilerawkll": AggSpec(
         1,
@@ -798,9 +806,9 @@ EXT_AGGS: dict[str, AggSpec] = {
     "distinctcountrawhllplus": _RAW_HLL_SPEC,
     "distinctcountrawull": _RAW_HLL_SPEC,
     "distinctcountrawcpcsketch": _RAW_HLL_SPEC,
-    "distinctcounthllplus": AggSpec(1, _hll_compute, lambda a, b: np.maximum(a, b), _hll_finalize, lambda e: np_hll_registers(np.zeros(0))),
-    "distinctcountcpc": AggSpec(1, _hll_compute, lambda a, b: np.maximum(a, b), _hll_finalize, lambda e: np_hll_registers(np.zeros(0))),
-    "distinctcountull": AggSpec(1, _hll_compute, lambda a, b: np.maximum(a, b), _hll_finalize, lambda e: np_hll_registers(np.zeros(0))),
+    "distinctcounthllplus": _HLL_SPEC,
+    "distinctcountcpc": _HLL_SPEC,
+    "distinctcountull": _HLL_SPEC,
     "segmentpartitioneddistinctcount": AggSpec(1, _spdc_compute, lambda a, b: a + b, lambda p, e: int(p), lambda e: 0),
 }
 

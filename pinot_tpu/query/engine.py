@@ -227,6 +227,16 @@ class QueryEngine:
                 presence = np.asarray(p)[: ci.cardinality]
                 vals = ci.dictionary.values[np.nonzero(presence)[0]]
                 out.append(set(vals.tolist()))
+            elif a.func in ("funnelcount", "funnelcompletecount"):
+                # (K, pad) presence rows -> per-step value sets (the host
+                # partial format funnel.merge/finalize consume)
+                col = spec_entry[1]
+                ci = seg.columns[col]
+                pres = np.asarray(p)[:, : ci.cardinality]
+                vals = ci.dictionary.values
+                out.append(
+                    [set(vals[np.nonzero(pres[k])[0]].tolist()) for k in range(pres.shape[0])]
+                )
             elif a.func == "distinctcounthll":
                 out.append(np.asarray(p))
             elif a.func == "percentileest":

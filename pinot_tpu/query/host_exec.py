@@ -600,9 +600,18 @@ def group_frame(seg: ImmutableSegment, ctx: QueryContext, mask: np.ndarray) -> p
             mv_docaggs[i] = True
             continue
         if a.func == "distinctcounttheta" and a.extra:
-            raise PlanError(
-                "filtered DISTINCTCOUNTTHETASKETCH inside GROUP BY is not supported"
-            )
+            # filtered sketches per group: one bool column per filter clause;
+            # the group apply below builds a ("multi", [sketch...]) partial the
+            # shared _theta_merge_any/_theta_finalize_any reducers understand
+            from pinot_tpu.query.aggregates import parse_theta_extra
+            from pinot_tpu.query.sql import parse_sql
+
+            _params, tfilters, _postagg = parse_theta_extra(a.extra)
+            for j, fstr in enumerate(tfilters):
+                pred = parse_sql(f"SELECT * FROM _t WHERE {fstr}").where
+                data[f"tf{i}_{j}"] = filter_mask(seg, pred)[mask]
+            data[f"v{i}"] = eval_value(seg, a.arg)[mask]
+            continue
         if a.func in _funnel_mod().FUNNEL_AGGS:
             fun = _funnel_mod()
             steps = a.extra[-1]
@@ -738,6 +747,24 @@ def group_frame(seg: ImmutableSegment, ctx: QueryContext, mask: np.ndarray) -> p
                 return {float(k): int(c) for k, c in zip(vals, counts)}
 
             out[f"a{i}p0"] = g[f"v{i}"].apply(_counter).values
+        elif a.func == "distinctcounttheta" and a.extra:
+            from pinot_tpu.query.aggregates import _theta_compute, parse_theta_extra
+
+            _params, tfilters, _postagg = parse_theta_extra(a.extra)
+
+            def _theta_multi(sub, _i=i, _nf=len(tfilters)):
+                v = sub[f"v{_i}"].to_numpy()
+                if _nf == 0:
+                    return _theta_compute(v, None, ())
+                return (
+                    "multi",
+                    [
+                        _theta_compute(v[sub[f"tf{_i}_{_j}"].to_numpy(bool)], None, ())
+                        for _j in range(_nf)
+                    ],
+                )
+
+            out[f"a{i}p0"] = g.apply(_theta_multi, include_groups=False).values
         elif a.func in EXT_AGGS:
             spec = EXT_AGGS[a.func]
             if a.arg2 is not None:

@@ -287,6 +287,19 @@ def _agg_scalar(aspec, cols, ops, mask):
         col, pad = aspec[1], aspec[2]
         presence = jnp.zeros((pad,), dtype=bool).at[cols[col]].max(mask)
         return presence
+    if kind == "funnel_steps":
+        # un-ordered funnel: per-step presence of correlation ids — K
+        # scatter-or rows stacked into one (K, pad) matrix
+        col, pad, stepspecs = aspec[1], aspec[2], aspec[3]
+        ids = cols[col]
+        return jnp.stack(
+            [
+                jnp.zeros((pad,), dtype=bool)
+                .at[ids]
+                .max(mask & _filter(s, cols, ops, mask.shape[0]))
+                for s in stepspecs
+            ]
+        )
     if kind == "hll":
         from pinot_tpu.query.sketches import hll_update
 
@@ -477,6 +490,43 @@ def build_fn(spec: tuple):
                     gid = gid + ids * strides[i]
                 counts, parts = _grouped_all(
                     aggs, cols, ops, vmask, gid, ng, gather=docids, doc_pad=n_padded
+                )
+                return matched, counts, parts
+            if gspec[0] == "groups_mv2":
+                # two MV keys: dense (base flat values x other max-len) pair
+                # space — each pair is one cartesian (a_val, b_val) combination
+                # of one doc (Pinot MV group-by cartesian semantics)
+                _, gcols, ng, strides_idx, mv_a, nv_a, mv_b, off_idx, len_idx, lb = gspec
+                docids = cols[f"{mv_a}!docs"]  # (va,)
+                vmask_a = _mv_vmask(mv_a, nv_a, cols, ops, mask)
+                d_off = ops[off_idx][docids]  # (va,)
+                d_len = ops[len_idx][docids]
+                j = jnp.arange(lb, dtype=jnp.int32)
+                fidx = d_off[:, None] + j[None, :]  # (va, lb)
+                pvalid = vmask_a[:, None] & (j[None, :] < d_len[:, None])
+                nb = cols[mv_b].shape[0]
+                ids_b = cols[mv_b][jnp.clip(fidx, 0, nb - 1)]
+                strides = ops[strides_idx]
+                va = docids.shape[0]
+                gid2 = jnp.zeros((va, lb), dtype=jnp.int32)
+                for i, c in enumerate(gcols):
+                    if c == mv_a:
+                        idc = cols[c][:, None]
+                    elif c == mv_b:
+                        idc = ids_b
+                    else:
+                        idc = cols[c][docids][:, None]
+                    gid2 = gid2 + idc * strides[i]
+                pair_docids = jnp.broadcast_to(docids[:, None], (va, lb)).reshape(-1)
+                counts, parts = _grouped_all(
+                    aggs,
+                    cols,
+                    ops,
+                    pvalid.reshape(-1),
+                    gid2.reshape(-1),
+                    ng,
+                    gather=pair_docids,
+                    doc_pad=n_padded,
                 )
                 return matched, counts, parts
             _, gcols, ng, strides_idx = gspec

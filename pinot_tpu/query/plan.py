@@ -686,6 +686,22 @@ class _Lowering:
             return (info.func, self.value_spec(info.arg))
         if info.func in ("countmv", "summv", "minmv", "maxmv", "avgmv", "distinctcountmv"):
             return self._mv_agg_spec(info, grouped)
+        if info.func in ("funnelcount", "funnelcompletecount"):
+            # un-ordered bitmap-strategy funnel (FunnelCountAggregationFunction
+            # set/bitmap strategy): per-step presence vectors over the
+            # correlation column's dict-id space — K scatter-or passes fused
+            # into the segment program; the host converts rows to value sets
+            if grouped:
+                raise DeviceFallback("funnel aggregations inside GROUP BY run host-side")
+            if not isinstance(info.arg, ast.Identifier):
+                raise DeviceFallback("FUNNELCOUNT correlation expression runs host-side")
+            ci = self.seg.columns.get(info.arg.name)
+            if ci is None or not ci.is_dict_encoded or ci.is_mv:
+                raise DeviceFallback("FUNNELCOUNT needs a dict-encoded SV correlation column")
+            steps = info.extra[-1]
+            stepspecs = tuple(self.filter_spec(s) for s in steps)
+            col = self.use_col(info.arg.name)
+            return ("funnel_steps", col, _pow2(max(ci.cardinality, 1)), stepspecs)
         raise DeviceFallback(f"aggregation {info.func} has no device lowering yet")
 
     def _mv_agg_spec(self, info: AggregationInfo, grouped: bool) -> tuple:
@@ -766,10 +782,14 @@ class _Lowering:
 
     # -- group-by ------------------------------------------------------------
 
+    # cap on the (base MV flat values x other MV max-len) pair space of a
+    # two-MV-key device group-by
+    MAX_MV2_PAIRS = 1 << 23
+
     def group_spec(self) -> tuple:
         cols = []
         cards = []
-        mv_col = None
+        mv_cols: list[str] = []
         for g in self.ctx.group_by:
             if not isinstance(g, ast.Identifier):
                 raise DeviceFallback("expression GROUP BY keys run host-side for now")
@@ -781,15 +801,12 @@ class _Lowering:
             if not ci.is_dict_encoded:
                 raise DeviceFallback(f"GROUP BY on raw column {g.name} runs host-side for now")
             if ci.is_mv:
-                # one MV key lowers: group ids live in VALUE space (each doc
-                # contributes once per value — Pinot MV group-by semantics).
-                # Two MV keys = per-doc cartesian products: host explode.
-                if mv_col is not None:
-                    raise DeviceFallback("multiple MV GROUP BY keys run host-side (explode)")
-                mv_col = g.name
+                mv_cols.append(g.name)
             self.use_col(g.name)
             cols.append(g.name)
             cards.append(ci.cardinality)
+        if len(mv_cols) > 2:
+            raise DeviceFallback("3+ MV GROUP BY keys run host-side (explode)")
         num_groups = 1
         for c in cards:
             num_groups *= max(c, 1)
@@ -807,10 +824,65 @@ class _Lowering:
         # queries (the Pinot plan-cache normalization tradeoff)
         ng = ((max(num_groups, 1) + 255) // 256) * 256
         self._group_ng = ng
-        if mv_col is not None:
-            nv = self.op_idx(np.int32(len(self.seg.columns[mv_col].forward)))
-            return ("groups_mv", tuple(cols), ng, self.op_idx(strides), mv_col, nv)
+        if len(mv_cols) == 2:
+            return self._group_spec_mv2(cols, ng, strides, mv_cols)
+        if mv_cols:
+            # one MV key lowers: group ids live in VALUE space (each doc
+            # contributes once per value — Pinot MV group-by semantics)
+            nv = self.op_idx(np.int32(len(self.seg.columns[mv_cols[0]].forward)))
+            return ("groups_mv", tuple(cols), ng, self.op_idx(strides), mv_cols[0], nv)
         return ("groups", tuple(cols), ng, self.op_idx(strides))
+
+    def _group_spec_mv2(self, cols, ng, strides, mv_cols) -> tuple:
+        """Two MV keys: per-doc cartesian pairs in a dense (base flat values x
+        other max-len) pair space. The base's flat layout supplies one axis;
+        the other column contributes Lb padded positions per pair row, masked
+        by its per-doc length (DictionaryBasedGroupKeyGenerator MV cartesian
+        semantics, pinot-core/.../groupby/DictionaryBasedGroupKeyGenerator.java)."""
+        from pinot_tpu.segment.segment import padded_len
+
+        def _maxlen(name: str) -> int:
+            lens = self.seg.columns[name].lens
+            return int(lens.max()) if len(lens) else 0
+
+        a, b = mv_cols
+        # pick the base that minimizes the pair space
+        if padded_len(len(self.seg.columns[b].forward)) * _maxlen(a) < padded_len(
+            len(self.seg.columns[a].forward)
+        ) * _maxlen(b):
+            a, b = b, a
+        lb = _maxlen(b)
+        if lb == 0:
+            # other column has no values anywhere: no doc joins any group
+            raise DeviceFallback("MV GROUP BY key with no values runs host-side")
+        ci_b = self.seg.columns[b]
+        pairs = padded_len(len(self.seg.columns[a].forward)) * lb
+        if pairs > self.MAX_MV2_PAIRS:
+            raise DeviceFallback(
+                f"two-MV-key pair space {pairs} exceeds device budget {self.MAX_MV2_PAIRS}"
+            )
+        pad = padded_len(self.seg.n_docs)
+        off = ci_b.offsets()[: self.seg.n_docs].astype(np.int32)
+        lens = ci_b.lens.astype(np.int32)
+        # pad+1 entries: flat-padding docids point one past the padded doc
+        # range; zero lengths there make every such pair invalid
+        off_p = np.zeros(pad + 1, dtype=np.int32)
+        len_p = np.zeros(pad + 1, dtype=np.int32)
+        off_p[: self.seg.n_docs] = off
+        len_p[: self.seg.n_docs] = lens
+        nv_a = self.op_idx(np.int32(len(self.seg.columns[a].forward)))
+        return (
+            "groups_mv2",
+            tuple(cols),
+            ng,
+            self.op_idx(strides),
+            a,
+            nv_a,
+            b,
+            self.op_idx(off_p),
+            self.op_idx(len_p),
+            lb,
+        )
 
 
 _FLIP = {
@@ -919,7 +991,7 @@ def plan_segment(seg: ImmutableSegment, ctx: QueryContext, valid_mask=None) -> S
         grouped = ctx.query_type == QueryType.GROUP_BY
         gspec = lo.group_spec() if grouped else None
         aggs = tuple(lo.agg_spec(a, grouped) for a in ctx.aggregations)
-        if gspec is not None and gspec[0] == "groups_mv":
+        if gspec is not None and gspec[0] in ("groups_mv", "groups_mv2"):
             # MV group ids are value-space; *MV aggregations are themselves
             # value-space over a (possibly different) MV column — the
             # combined gather semantics run host-side (explode)

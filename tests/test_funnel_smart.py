@@ -88,6 +88,59 @@ def test_funnelcount_group_by(events):
         assert isinstance(arr, list) and len(arr) == 2
 
 
+def test_funnelcount_device_lowering(events):
+    """The un-ordered funnel count variants compile into the fused device
+    program (per-step presence rows over the correlation dict-id space)
+    instead of falling back to the host executor."""
+    from pinot_tpu.query.plan import plan_segment
+
+    ctx = events.make_context(
+        f"SELECT FUNNELCOUNT({STEPS}, CORRELATE_BY(uid)) FROM events"
+    )
+    plan = plan_segment(events.segments[0], ctx)  # must NOT raise DeviceFallback
+    aggs = plan.spec[3]
+    assert aggs[0][0] == "funnel_steps" and len(aggs[0][3]) == 3
+
+
+def test_funnelcount_device_multiseg_oracle():
+    """Device funnel partials from several segments merge to the same result
+    as the host path (pandas oracle)."""
+    rng = np.random.default_rng(5)
+    n = 6000
+    uid = rng.integers(0, 800, n).astype(np.int64)
+    ev = np.asarray(["view", "cart", "buy", "other"], dtype=object)[
+        rng.integers(0, 4, n)
+    ]
+    schema = Schema.build(
+        "ev2", dimensions=[("uid", DataType.LONG), ("event", DataType.STRING)], metrics=[]
+    )
+    b = SegmentBuilder(schema)
+    half = n // 2
+    eng = QueryEngine(
+        [
+            b.build({"uid": uid[:half], "event": ev[:half]}, "s0"),
+            b.build({"uid": uid[half:], "event": ev[half:]}, "s1"),
+        ]
+    )
+    res = eng.execute(
+        "SELECT FUNNELCOUNT(STEPS(event = 'view', event = 'cart', event = 'buy'), "
+        "CORRELATE_BY(uid)) FROM ev2"
+    )
+    df = pd.DataFrame({"uid": uid, "event": ev})
+    sets = [set(df.uid[df.event == e]) for e in ("view", "cart", "buy")]
+    want = [
+        len(sets[0]),
+        len(sets[0] & sets[1]),
+        len(sets[0] & sets[1] & sets[2]),
+    ]
+    assert res.rows[0][0] == want
+    res2 = eng.execute(
+        "SELECT FUNNELCOMPLETECOUNT(STEPS(event = 'view', event = 'cart', event = 'buy'), "
+        "CORRELATE_BY(uid)) FROM ev2"
+    )
+    assert res2.rows[0][0] == want[-1]
+
+
 @pytest.fixture(scope="module")
 def numbers():
     rng = np.random.default_rng(7)

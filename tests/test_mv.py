@@ -245,17 +245,59 @@ def test_mv_group_by_mixed_with_sv_key(setup):
         assert got.get((y, tag)) == c, (y, tag)
 
 
-def test_mv_group_by_two_mv_keys_host(setup):
-    """Two MV keys = per-doc cartesian product (host explode)."""
-    eng, _, df = setup
-    res = eng.execute(
-        "SELECT tags, nums, COUNT(*) FROM t GROUP BY tags, nums ORDER BY COUNT(*) DESC LIMIT 5"
-    )
+def test_mv_group_by_two_mv_keys_device(setup, monkeypatch):
+    """Two MV keys = per-doc cartesian product. Round 3: lowers to the dense
+    pair-space device kernel (groups_mv2); host explode must agree."""
+    eng, seg, df = setup
+    q = "SELECT tags, nums, COUNT(*) FROM t GROUP BY tags, nums ORDER BY COUNT(*) DESC, tags, nums LIMIT 5"
+    from pinot_tpu.query.plan import plan_segment
+
+    plan = plan_segment(seg, eng.make_context(q))
+    assert plan.spec[2][0] == "groups_mv2"  # device lowering engaged
+
+    res = eng.execute(q)
     ex = df.explode("tags").dropna(subset=["tags"]).explode("nums").dropna(subset=["nums"])
     truth = ex.groupby(["tags", "nums"]).size()
     got = {(r[0], r[1]): r[2] for r in res.rows}
     for (tag, num), c in got.items():
         assert truth.get((tag, float(num))) == c or truth.get((tag, int(num))) == c, (tag, num)
+
+    # host explode path must produce identical rows
+    from pinot_tpu.query import plan as plan_mod
+
+    def no_device(*a, **k):
+        raise plan_mod.DeviceFallback("forced host")
+
+    h_eng = QueryEngine([seg])
+    monkeypatch.setattr("pinot_tpu.query.engine.plan_segment", no_device)
+    assert h_eng.execute(q).rows == res.rows
+
+
+def test_mv_group_by_two_mv_keys_with_sum(setup, monkeypatch):
+    """Two MV keys with a SUM over an SV column: each cartesian pair
+    contributes the doc's value once (explode semantics)."""
+    eng, seg, df = setup
+    q = (
+        "SELECT tags, nums, COUNT(*), SUM(year) FROM t WHERE year >= 2019 "
+        "GROUP BY tags, nums ORDER BY tags, nums LIMIT 300"
+    )
+    res = eng.execute(q)
+    ex = (
+        df[df.year >= 2019]
+        .explode("tags")
+        .dropna(subset=["tags"])
+        .explode("nums")
+        .dropna(subset=["nums"])
+    )
+    ex = ex.assign(nums=ex.nums.astype(np.int64))
+    g = ex.groupby(["tags", "nums"])
+    truth_c = g.size()
+    truth_s = g.year.sum()
+    assert len(res.rows) > 0
+    for tag, num, c, s in res.rows:
+        key = (tag, int(num))
+        assert truth_c.get(key) == c, key
+        assert float(truth_s.get(key)) == float(s), key
 
 
 def test_mv_distinct_host_device_parity(setup, monkeypatch):

@@ -163,6 +163,49 @@ def test_theta_sketch_set_expressions():
     assert n_diff == len(us - phone)
 
 
+def test_theta_sketch_set_expressions_group_by():
+    """Filtered theta sketches with SET_* post-aggregation inside GROUP BY:
+    per-group multi-sketch partials merged across segments (round-3 close of
+    the 'scalar only' limit)."""
+    import numpy as np
+
+    from pinot_tpu.common import DataType, Schema
+    from pinot_tpu.query import QueryEngine
+    from pinot_tpu.segment import SegmentBuilder
+
+    rng = np.random.default_rng(21)
+    n = 30_000
+    schema = Schema.build(
+        "t",
+        dimensions=[("country", DataType.STRING), ("device", DataType.STRING)],
+        metrics=[("uid", DataType.LONG)],
+    )
+    data = {
+        "country": np.asarray(["US", "DE", "JP"], dtype=object)[rng.integers(0, 3, n)],
+        "device": np.asarray(["phone", "desktop"], dtype=object)[rng.integers(0, 2, n)],
+        "uid": rng.integers(0, 2500, n).astype(np.int64),
+    }
+    b = SegmentBuilder(schema)
+    half = n // 2
+    eng = QueryEngine(
+        [
+            b.build({k: v[:half] for k, v in data.items()}, "s0"),
+            b.build({k: v[half:] for k, v in data.items()}, "s1"),
+        ]
+    )
+    q = (
+        "SELECT country, DISTINCTCOUNTTHETASKETCH(uid, "
+        "'device = ''phone''', 'uid < 1000', 'SET_INTERSECT($1, $2)') "
+        "FROM t GROUP BY country ORDER BY country LIMIT 10"
+    )
+    got = {r[0]: r[1] for r in eng.execute(q).rows}
+    for c in ("DE", "JP", "US"):
+        in_c = data["country"] == c
+        phone = set(data["uid"][in_c & (data["device"] == "phone")].tolist())
+        low = set(data["uid"][in_c & (data["uid"] < 1000)].tolist())
+        assert got[c] == len(phone & low), c  # exact below sketch capacity
+
+
 def test_theta_sketch_single_filter_and_plain():
     import numpy as np
 
