@@ -242,6 +242,70 @@ def _hash_partition(keydf: pd.DataFrame, n: int) -> np.ndarray:
 
 
 # ---------------------------------------------------------------------------
+# Device paths for intermediate operators (SortOperator / LookupJoinOperator
+# parity on the TPU): engaged for large numeric blocks, pandas otherwise.
+# Counters let tests assert which path ran.
+# ---------------------------------------------------------------------------
+
+#: minimum rows before a device dispatch beats host pandas (sync overhead)
+DEVICE_SORT_MIN = 1 << 16
+DEVICE_JOIN_MIN = 1 << 16
+
+DEVICE_OP_STATS = {"sort": 0, "join": 0}
+
+
+def _device_sort_perm(keys: list[np.ndarray], descs: list[bool]) -> "np.ndarray | None":
+    """Stable multi-key sort permutation computed on device (lax.sort under
+    jnp.lexsort). Returns None when a key is non-numeric or float-with-NaN
+    (pandas NaN-last semantics differ) — caller falls back to pandas.
+    DESC uses lossless monotone flips: bitwise NOT for ints, negation for
+    floats (int64 negation could overflow at INT64_MIN; ~v cannot)."""
+    import jax.numpy as jnp
+
+    prepped = []
+    for v, desc in zip(keys, descs):
+        if not np.issubdtype(v.dtype, np.number):
+            return None
+        if np.issubdtype(v.dtype, np.floating):
+            if np.isnan(v).any():
+                return None
+            prepped.append(-v if desc else v)
+        else:
+            prepped.append(~v if desc else v)
+    # jnp.lexsort: LAST key is primary -> reverse significance order
+    perm = jnp.lexsort(tuple(jnp.asarray(k) for k in reversed(prepped)))
+    DEVICE_OP_STATS["sort"] += 1
+    return np.asarray(perm)
+
+
+def _device_lookup_join(lk: np.ndarray, rk: np.ndarray) -> "tuple[np.ndarray, np.ndarray] | None":
+    """Inner equi-join probe against a UNIQUE numeric right key (the
+    dimension/lookup-join case, LookupJoinOperator parity): sorted right keys
+    + device searchsorted + equality. Returns (left row mask, right row index
+    per matched left row), or None when the shape doesn't fit."""
+    import jax.numpy as jnp
+
+    if not (np.issubdtype(lk.dtype, np.number) and np.issubdtype(rk.dtype, np.number)):
+        return None
+    if (np.issubdtype(lk.dtype, np.floating) and np.isnan(lk).any()) or (
+        np.issubdtype(rk.dtype, np.floating) and np.isnan(rk).any()
+    ):
+        return None
+    if len(rk) == 0:
+        return np.zeros(len(lk), dtype=bool), np.zeros(0, dtype=np.int64)
+    order = np.argsort(rk, kind="stable")
+    srk = rk[order]
+    if len(srk) > 1 and (srk[1:] == srk[:-1]).any():
+        return None  # duplicate build keys: not a lookup join
+    j_srk = jnp.asarray(srk)
+    j_lk = jnp.asarray(lk)
+    pos = jnp.clip(jnp.searchsorted(j_srk, j_lk), 0, len(srk) - 1)
+    match = j_srk[pos] == j_lk
+    DEVICE_OP_STATS["join"] += 1
+    return np.asarray(match), order[np.asarray(pos)]
+
+
+# ---------------------------------------------------------------------------
 # Aggregation over blocks
 # ---------------------------------------------------------------------------
 
@@ -432,7 +496,15 @@ def exec_node(node: L.Node, ctx: RunCtx) -> pd.DataFrame:
         if node.keys and len(df):
             by = [k for k, _ in node.keys]
             asc = [not d for _, d in node.keys]
-            df = df.sort_values(by=by, ascending=asc, kind="mergesort", ignore_index=True)
+            perm = None
+            if len(df) >= DEVICE_SORT_MIN:
+                perm = _device_sort_perm(
+                    [df[k].to_numpy() for k in by], [d for _, d in node.keys]
+                )
+            if perm is not None:
+                df = df.take(perm).reset_index(drop=True)
+            else:
+                df = df.sort_values(by=by, ascending=asc, kind="mergesort", ignore_index=True)
         if node.offset or node.limit is not None:
             end = None if node.limit is None else node.offset + node.limit
             df = df.iloc[node.offset : end].reset_index(drop=True)
@@ -780,6 +852,28 @@ def _exec_join(node: L.Join, ctx: RunCtx) -> pd.DataFrame:
 
     kind = node.kind if node.kind != "cross" else "inner"
     if kind == "inner":
+        if (
+            len(keys) == 1
+            and keys[0] != "__cross"
+            and len(l) >= DEVICE_JOIN_MIN
+            and len(r)
+        ):
+            # large probe side, single equi-key: try the device lookup-join
+            # (sorted-unique build keys + device searchsorted probe)
+            dev = _device_lookup_join(
+                l[keys[0]].to_numpy(), r[keys[0]].to_numpy()
+            )
+            if dev is not None:
+                lmask, ridx = dev
+                lmask = lmask & ~l_null
+                lm = l[lmask]
+                rm = r.iloc[ridx[lmask]]
+                rm.index = lm.index
+                m = pd.concat([lm[lcols], rm[rcols]], axis=1)
+                out = _positional(m)
+                if node.post_filter is not None and len(out):
+                    out = out[eval_filter(node.post_filter, node.fields, out)].reset_index(drop=True)
+                return out
         m = l[~l_null].merge(r[~r_null], how="inner", on=keys)
         out = _positional(m)
         if node.post_filter is not None and len(out):

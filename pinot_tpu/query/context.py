@@ -533,6 +533,16 @@ class QueryContext:
 
             stmt.group_by = [_sub(g) for g in stmt.group_by]
         gapfill = _extract_gapfill(stmt)
+        # dedup identical GROUP BY expressions (GROUP BY a, a == GROUP BY a):
+        # duplicate canonical keys would collide in the reduce row env
+        seen_gb: set[str] = set()
+        deduped_gb = []
+        for g in stmt.group_by:
+            cn = canonical(g)
+            if cn not in seen_gb:
+                seen_gb.add(cn)
+                deduped_gb.append(g)
+        stmt.group_by = deduped_gb
         aggs: dict[str, AggregationInfo] = {}
         has_agg = False
         for item in stmt.select_list:

@@ -387,23 +387,30 @@ def _funnel_mod():
     return funnel
 
 
+def _theta_filter_masks(seg: ImmutableSegment, extra: tuple) -> list[np.ndarray]:
+    """Doc masks for a filtered DISTINCTCOUNTTHETASKETCH's filter predicates
+    (one per clause) — the single shared parse site for the scalar and
+    grouped paths."""
+    from pinot_tpu.query.aggregates import parse_theta_extra
+    from pinot_tpu.query.sql import parse_sql
+
+    _params, filters, _postagg = parse_theta_extra(extra)
+    return [
+        filter_mask(seg, parse_sql(f"SELECT * FROM _t WHERE {f}").where) for f in filters
+    ]
+
+
 def _theta_filtered_partial(seg: ImmutableSegment, a, mask: np.ndarray):
     """DISTINCTCOUNTTHETASKETCH with filter expressions: one KMV sketch per
     filter predicate, combined at reduce by the SET_* post-aggregation
     (DistinctCountThetaSketchAggregationFunction parity)."""
-    from pinot_tpu.query.aggregates import _theta_compute, parse_theta_extra
-    from pinot_tpu.query.sql import parse_sql
+    from pinot_tpu.query.aggregates import _theta_compute
 
-    _params, filters, _postagg = parse_theta_extra(a.extra)
+    fmasks = _theta_filter_masks(seg, a.extra)
     v = eval_value(seg, a.arg)
-    if not filters:
+    if not fmasks:
         return _theta_compute(v[mask], None, ())
-    sketches = []
-    for fstr in filters:
-        pred = parse_sql(f"SELECT * FROM _t WHERE {fstr}").where
-        fmask = mask & filter_mask(seg, pred)
-        sketches.append(_theta_compute(v[fmask], None, ()))
-    return ("multi", sketches)
+    return ("multi", [_theta_compute(v[mask & fm], None, ()) for fm in fmasks])
 
 
 def _mv_agg_column(seg: ImmutableSegment, a) -> "object":
@@ -584,6 +591,7 @@ def group_frame(seg: ImmutableSegment, ctx: QueryContext, mask: np.ndarray) -> p
         data[f"k{i}"] = v.astype(str) if v.dtype == object else v
     filtered_ok = {"count", "sum", "min", "max", "avg", "minmaxrange"}
     mv_docaggs: dict[int, dict[str, np.ndarray]] = {}
+    theta_nf: dict[int, int] = {}  # agg index -> number of theta filter clauses
     for i, a in enumerate(ctx.aggregations):
         if a.filter is not None:
             if a.func not in filtered_ok:
@@ -603,13 +611,10 @@ def group_frame(seg: ImmutableSegment, ctx: QueryContext, mask: np.ndarray) -> p
             # filtered sketches per group: one bool column per filter clause;
             # the group apply below builds a ("multi", [sketch...]) partial the
             # shared _theta_merge_any/_theta_finalize_any reducers understand
-            from pinot_tpu.query.aggregates import parse_theta_extra
-            from pinot_tpu.query.sql import parse_sql
-
-            _params, tfilters, _postagg = parse_theta_extra(a.extra)
-            for j, fstr in enumerate(tfilters):
-                pred = parse_sql(f"SELECT * FROM _t WHERE {fstr}").where
-                data[f"tf{i}_{j}"] = filter_mask(seg, pred)[mask]
+            fmasks = _theta_filter_masks(seg, a.extra)
+            for j, fm in enumerate(fmasks):
+                data[f"tf{i}_{j}"] = fm[mask]
+            theta_nf[i] = len(fmasks)
             data[f"v{i}"] = eval_value(seg, a.arg)[mask]
             continue
         if a.func in _funnel_mod().FUNNEL_AGGS:
@@ -748,11 +753,9 @@ def group_frame(seg: ImmutableSegment, ctx: QueryContext, mask: np.ndarray) -> p
 
             out[f"a{i}p0"] = g[f"v{i}"].apply(_counter).values
         elif a.func == "distinctcounttheta" and a.extra:
-            from pinot_tpu.query.aggregates import _theta_compute, parse_theta_extra
+            from pinot_tpu.query.aggregates import _theta_compute
 
-            _params, tfilters, _postagg = parse_theta_extra(a.extra)
-
-            def _theta_multi(sub, _i=i, _nf=len(tfilters)):
+            def _theta_multi(sub, _i=i, _nf=theta_nf[i]):
                 v = sub[f"v{_i}"].to_numpy()
                 if _nf == 0:
                     return _theta_compute(v, None, ())

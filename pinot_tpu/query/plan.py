@@ -807,6 +807,10 @@ class _Lowering:
             cards.append(ci.cardinality)
         if len(mv_cols) > 2:
             raise DeviceFallback("3+ MV GROUP BY keys run host-side (explode)")
+        if len(mv_cols) == 2 and mv_cols[0] == mv_cols[1]:
+            # repeated MV key: the pair kernel would only produce diagonal
+            # (v, v) combinations, not the full cartesian square
+            raise DeviceFallback("repeated MV GROUP BY key runs host-side (explode)")
         num_groups = 1
         for c in cards:
             num_groups *= max(c, 1)
