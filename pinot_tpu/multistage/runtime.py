@@ -133,9 +133,18 @@ def eval_expr(expr: ast.Expr, fields: list[L.Field], df: pd.DataFrame) -> pd.Ser
             default = default.astype(object)
         return pd.Series(np.select(conds, vals, default=default), index=df.index)
     if isinstance(expr, ast.FunctionCall):
-        from pinot_tpu.query.transforms import DEVICE_FUNCS, STRING_FUNCS, apply_string_func
+        from pinot_tpu.query.transforms import (
+            DEVICE_FUNCS,
+            STRING_FUNCS,
+            apply_string_func,
+            rewrite_time_convert,
+        )
 
         name = expr.name
+        if name in ("timeconvert", "datetimeconvert"):
+            rw = rewrite_time_convert(expr)
+            if rw is not None:
+                return eval_expr(rw, fields, df)
         if name == "cast":
             v = eval_expr(expr.args[0], fields, df)
             target = str(expr.args[1].value).upper()

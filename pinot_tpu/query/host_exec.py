@@ -71,9 +71,18 @@ def eval_value(seg: ImmutableSegment, expr: ast.Expr) -> np.ndarray:
             default = default.astype(object)
         return np.select(conds, vals, default=default)
     if isinstance(expr, ast.FunctionCall):
-        from pinot_tpu.query.transforms import DEVICE_FUNCS, STRING_FUNCS, apply_string_func
+        from pinot_tpu.query.transforms import (
+            DEVICE_FUNCS,
+            STRING_FUNCS,
+            apply_string_func,
+            rewrite_time_convert,
+        )
 
         name = expr.name
+        if name in ("timeconvert", "datetimeconvert"):
+            rw = rewrite_time_convert(expr)
+            if rw is not None:
+                return eval_value(seg, rw)
         if name == "map_value":
             # map_value(col, 'key'): dense per-key column via the map index
             # when present, else per-row document parse (StandardIndexes map

@@ -187,9 +187,18 @@ class _Lowering:
         raise PlanError(f"unsupported value expression: {expr}")
 
     def _function_value(self, expr: ast.FunctionCall) -> tuple:
-        from pinot_tpu.query.transforms import DEVICE_FUNCS, STRING_FUNCS, apply_string_func
+        from pinot_tpu.query.transforms import (
+            DEVICE_FUNCS,
+            STRING_FUNCS,
+            apply_string_func,
+            rewrite_time_convert,
+        )
 
         name = expr.name
+        if name in ("timeconvert", "datetimeconvert"):
+            rw = rewrite_time_convert(expr)
+            if rw is not None:
+                return self.value_spec(rw)
         if name == "map_value":
             # map-index key reads return object values: host-side
             raise DeviceFallback("map_value runs host-side (map index probe)")
@@ -213,7 +222,9 @@ class _Lowering:
             # cardinality-sized host work, doc-sized device gather.
             derived, is_str, col = self._derived_string_values(expr)
             if is_str:
-                raise PlanError(f"string-valued {name}(...) cannot be used in a numeric context")
+                # string-valued projection: the host executor evaluates it
+                # (device selections return numeric/id columns only)
+                raise DeviceFallback(f"string-valued {name}(...) runs host-side")
             self.use_col(col)
             pad = _pow2(max(len(derived), 1))
             dv = derived
@@ -369,7 +380,13 @@ class _Lowering:
     def _is_string_fn(expr) -> bool:
         from pinot_tpu.query.transforms import STRING_FUNCS
 
-        return isinstance(expr, ast.FunctionCall) and expr.name in STRING_FUNCS and STRING_FUNCS[expr.name][2]
+        if not (isinstance(expr, ast.FunctionCall) and expr.name in STRING_FUNCS):
+            return False
+        is_str = STRING_FUNCS[expr.name][2]
+        if callable(is_str):  # arg-dependent result type (jsonextractscalar)
+            args = tuple(a.value for a in expr.args[1:] if isinstance(a, ast.Literal))
+            return is_str(args)
+        return is_str
 
     def _dict_compare(self, col: str, ci, op: CompareOp, value) -> tuple:
         d = ci.dictionary

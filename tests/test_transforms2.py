@@ -1,0 +1,278 @@
+"""Round-3 transform registry push: trig/rounding device functions, extended
+datetime extracts (dayofweek/dayofyear/quarter/week, datetrunc month/year),
+TIMECONVERT/DATETIMECONVERT rewrites, and the new string/hash/url/base64/
+regexp/JSON scalar functions — oracle-checked on device and host paths.
+
+Reference parity: pinot-core/.../operator/transform/function/ (73 classes)
+and the @ScalarFunction registry (StringFunctions, DateTimeFunctions,
+JsonFunctions in pinot-common/.../function/scalar/).
+"""
+
+import datetime as dt
+import json
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from pinot_tpu.common import DataType, Schema
+from pinot_tpu.query import QueryEngine
+from pinot_tpu.segment import SegmentBuilder
+
+
+@pytest.fixture(scope="module")
+def setup():
+    rng = np.random.default_rng(23)
+    n = 2000
+    schema = Schema.build(
+        "t",
+        dimensions=[("name", DataType.STRING), ("doc", DataType.JSON)],
+        metrics=[("x", DataType.DOUBLE)],
+        date_times=[("ts", DataType.LONG)],
+    )
+    # timestamps spanning several years around epoch-interesting boundaries
+    base = int(dt.datetime(2019, 12, 28, tzinfo=dt.timezone.utc).timestamp() * 1000)
+    ts = base + rng.integers(0, int(3.2e10), n)
+    docs = np.asarray(
+        [json.dumps({"a": int(i % 7), "b": {"c": f"s{i % 4}"}, "arr": [int(i % 3)]}) for i in range(n)],
+        dtype=object,
+    )
+    data = {
+        "name": np.asarray([f"User_{i % 50:02d}" for i in range(n)], dtype=object),
+        "doc": docs,
+        "x": np.round(rng.normal(0, 10, n), 4),
+        "ts": ts.astype(np.int64),
+    }
+    seg = SegmentBuilder(schema).build(data, "s0")
+    df = pd.DataFrame({k: (v.astype(str) if v.dtype == object else v) for k, v in data.items()})
+    return QueryEngine([seg]), df
+
+
+def col(engine, sql):
+    return [r[0] for r in engine.execute(sql).rows]
+
+
+def test_trig_functions(setup):
+    eng, df = setup
+    got = col(eng, "SELECT SIN(x) FROM t ORDER BY $docId LIMIT 20")
+    want = np.sin(df.x.to_numpy()[:20])
+    assert np.allclose(got, want)
+    got2 = col(eng, "SELECT ATAN2(x, 2.0) FROM t ORDER BY $docId LIMIT 20")
+    assert np.allclose(got2, np.arctan2(df.x.to_numpy()[:20], 2.0))
+
+
+def test_round_truncate(setup):
+    eng, df = setup
+    got = col(eng, "SELECT ROUNDDECIMAL(x, 1) FROM t ORDER BY $docId LIMIT 30")
+    want = np.round(df.x.to_numpy()[:30] * 10) / 10
+    assert np.allclose(got, want)
+    got2 = col(eng, "SELECT TRUNCATE(x, 1) FROM t ORDER BY $docId LIMIT 30")
+    assert np.allclose(got2, np.trunc(df.x.to_numpy()[:30] * 10) / 10)
+
+
+def _pydt(ms):
+    return dt.datetime.fromtimestamp(ms / 1000, tz=dt.timezone.utc)
+
+
+def test_datetime_extracts(setup):
+    eng, df = setup
+    ts = df.ts.to_numpy()[:200]
+    checks = {
+        "DAYOFWEEK(ts)": [d.isoweekday() for d in map(_pydt, ts)],
+        "DAYOFYEAR(ts)": [d.timetuple().tm_yday for d in map(_pydt, ts)],
+        "QUARTER(ts)": [(d.month + 2) // 3 for d in map(_pydt, ts)],
+        "WEEKOFYEAR(ts)": [d.isocalendar()[1] for d in map(_pydt, ts)],
+        "MILLISECOND(ts)": [int(m % 1000) for m in ts],
+    }
+    for expr, want in checks.items():
+        got = col(eng, f"SELECT {expr} FROM t ORDER BY $docId LIMIT 200")
+        assert [int(x) for x in got] == want, expr
+
+
+def test_datetrunc_month_year(setup):
+    eng, df = setup
+    ts = df.ts.to_numpy()[:100]
+    got_m = col(eng, "SELECT DATETRUNC_MONTH(ts) FROM t ORDER BY $docId LIMIT 100")
+    got_y = col(eng, "SELECT DATETRUNC_YEAR(ts) FROM t ORDER BY $docId LIMIT 100")
+    for g_m, g_y, m in zip(got_m, got_y, ts):
+        d = _pydt(m)
+        first = dt.datetime(d.year, d.month, 1, tzinfo=dt.timezone.utc)
+        jan1 = dt.datetime(d.year, 1, 1, tzinfo=dt.timezone.utc)
+        assert int(g_m) == int(first.timestamp() * 1000)
+        assert int(g_y) == int(jan1.timestamp() * 1000)
+
+
+def test_timeconvert(setup):
+    eng, df = setup
+    got = col(eng, "SELECT TIMECONVERT(ts, 'MILLISECONDS', 'HOURS') FROM t ORDER BY $docId LIMIT 50")
+    want = (df.ts.to_numpy()[:50] // 3_600_000).tolist()
+    assert [int(x) for x in got] == [int(x) for x in want]
+
+
+def test_datetimeconvert_bucketing(setup):
+    eng, df = setup
+    q = (
+        "SELECT DATETIMECONVERT(ts, '1:MILLISECONDS:EPOCH', '1:MINUTES:EPOCH', "
+        "'15:MINUTES') FROM t ORDER BY $docId LIMIT 50"
+    )
+    got = col(eng, q)
+    bucket = 15 * 60_000
+    want = ((df.ts.to_numpy()[:50] // bucket) * bucket // 60_000).tolist()
+    assert [int(x) for x in got] == [int(x) for x in want]
+
+
+def test_timeconvert_group_by(setup):
+    """TIMECONVERT as a GROUP BY key must work on the device path (rewritten
+    to integer arithmetic, dense dict-id groups no longer required)."""
+    eng, df = setup
+    res = eng.execute(
+        "SELECT TIMECONVERT(ts, 'MILLISECONDS', 'DAYS') AS d, COUNT(*) FROM t "
+        "GROUP BY d ORDER BY COUNT(*) DESC, d LIMIT 5"
+    )
+    truth = (df.ts // 86_400_000).value_counts()
+    for day, c in res.rows:
+        assert truth[int(day)] == c
+
+
+def test_string_functions(setup):
+    eng, df = setup
+    names = df.name.tolist()
+    checks = {
+        "LPAD(name, 12, '*')": [v.rjust(12, "*")[:12] for v in names],
+        "REPEAT(name, 2)": [v * 2 for v in names],
+        "REMOVE(name, '_')": [v.replace("_", "") for v in names],
+        "URLENCODE(name)": [__import__("urllib.parse", fromlist=["quote"]).quote(v, safe="") for v in names],
+        "REGEXPREPLACE(name, '[0-9]+', '#')": [__import__("re").sub(r"[0-9]+", "#", v) for v in names],
+        "REGEXPEXTRACT(name, '[0-9]+')": [__import__("re").search(r"[0-9]+", v).group(0) for v in names],
+    }
+    for expr, want in checks.items():
+        got = col(eng, f"SELECT {expr} FROM t ORDER BY $docId LIMIT 2000")
+        assert got == want, expr
+
+
+def test_hash_and_base64(setup):
+    import base64
+    import hashlib
+
+    eng, df = setup
+    names = df.name.tolist()[:100]
+    got = col(eng, "SELECT MD5(name) FROM t ORDER BY $docId LIMIT 100")
+    assert got == [hashlib.md5(v.encode()).hexdigest() for v in names]
+    got2 = col(eng, "SELECT SHA256(name) FROM t ORDER BY $docId LIMIT 100")
+    assert got2 == [hashlib.sha256(v.encode()).hexdigest() for v in names]
+    got3 = col(eng, "SELECT TOBASE64(name) FROM t ORDER BY $docId LIMIT 100")
+    assert got3 == [base64.b64encode(v.encode()).decode() for v in names]
+    got4 = col(eng, "SELECT FROMBASE64(TOBASE64(name)) FROM t ORDER BY $docId LIMIT 100")
+    assert got4 == names
+
+
+def test_strpos_ascii_numeric_context(setup):
+    eng, df = setup
+    got = col(eng, "SELECT SUM(STRPOS(name, '_')) FROM t")
+    want = float(sum(v.find("_") for v in df.name))
+    assert got[0] == pytest.approx(want)
+    got2 = col(eng, "SELECT MAX(ASCII(name)) FROM t")
+    assert got2[0] == max(ord(v[0]) for v in df.name)
+
+
+def test_json_extract_scalar(setup):
+    eng, df = setup
+    got = col(eng, "SELECT JSONEXTRACTSCALAR(doc, '$.a', 'INT') FROM t ORDER BY $docId LIMIT 100")
+    want = [json.loads(v)["a"] for v in df.doc[:100]]
+    assert [int(x) for x in got] == want
+    got2 = col(
+        eng, "SELECT JSONEXTRACTSCALAR(doc, '$.b.c', 'STRING') FROM t ORDER BY $docId LIMIT 100"
+    )
+    assert got2 == [json.loads(v)["b"]["c"] for v in df.doc[:100]]
+    got3 = col(
+        eng, "SELECT JSONEXTRACTSCALAR(doc, '$.arr[0]', 'LONG') FROM t ORDER BY $docId LIMIT 100"
+    )
+    assert [int(x) for x in got3] == [json.loads(v)["arr"][0] for v in df.doc[:100]]
+
+
+def test_json_extract_in_aggregation(setup):
+    eng, df = setup
+    got = col(eng, "SELECT SUM(JSONEXTRACTSCALAR(doc, '$.a', 'DOUBLE')) FROM t")
+    want = float(sum(json.loads(v)["a"] for v in df.doc))
+    assert got[0] == pytest.approx(want)
+
+
+def test_weekofyear_iso_boundaries():
+    """Early-January dates in ISO week 52/53 of the previous year (review
+    finding: the overflow check must test the pre-substitution value)."""
+    import numpy as np
+
+    from pinot_tpu.query.transforms import DEVICE_FUNCS
+
+    _, weekfn = DEVICE_FUNCS["weekofyear"]
+    cases = [
+        dt.datetime(2010, 1, 1),  # ISO week 53 of 2009
+        dt.datetime(2049, 1, 1),  # ISO week 53 of 2048
+        dt.datetime(2021, 1, 1),  # ISO week 53 of 2020
+        dt.datetime(2024, 12, 30),  # ISO week 1 of 2025
+        dt.datetime(2020, 12, 31),  # ISO week 53
+        dt.datetime(2019, 12, 30),  # ISO week 1 of 2020
+    ]
+    ms = np.asarray(
+        [int(c.replace(tzinfo=dt.timezone.utc).timestamp() * 1000) for c in cases], dtype=np.int64
+    )
+    got = np.asarray(weekfn(np, ms))
+    want = [c.isocalendar()[1] for c in cases]
+    assert got.tolist() == want
+
+
+def test_round_half_up():
+    from pinot_tpu.query.transforms import DEVICE_FUNCS
+
+    _, roundfn = DEVICE_FUNCS["round"]
+    _, rdfn = DEVICE_FUNCS["rounddecimal"]
+    x = np.asarray([2.5, 3.5, -2.5, 1.25, -1.25])
+    assert np.asarray(roundfn(np, x)).tolist() == [3.0, 4.0, -3.0, 1.0, -1.0]
+    got = np.asarray(rdfn(np, np.asarray([1.25, 2.345, -1.25]), np.asarray([1, 2, 1])))
+    assert got.tolist() == pytest.approx([1.3, 2.35, -1.3])
+
+
+def test_lpad_multichar_and_no_truncate():
+    from pinot_tpu.query.transforms import apply_string_func
+
+    vals = np.asarray(["hello", "ab"], dtype=object)
+    got, _ = apply_string_func("lpad", vals, (7, "xy"))
+    assert got.tolist() == ["xyhello", "xyxyxab"]
+    got2, _ = apply_string_func("lpad", vals, (3, "x"))
+    assert got2.tolist() == ["hello", "xab"]  # no truncation of longer inputs
+    got3, _ = apply_string_func("rpad", vals, (6, "zw"), )
+    assert got3.tolist() == ["helloz", "abzwzw"]
+
+
+def test_json_path_rejects_unsupported_syntax():
+    from pinot_tpu.query.transforms import json_extract_scalar
+
+    with pytest.raises(ValueError):
+        json_extract_scalar('{"a": [1]}', "$.a[*].b", "STRING")
+
+
+def test_timeconvert_in_multistage(setup):
+    """TIMECONVERT must evaluate in v2 intermediate expressions too (the
+    rewrite is wired into all three evaluators)."""
+    from pinot_tpu.multistage import MultistageEngine
+
+    eng, df = setup
+    m_eng = MultistageEngine({"t": eng.segments}, n_workers=2)
+    res = m_eng.execute(
+        "SELECT TIMECONVERT(ts, 'MILLISECONDS', 'DAYS'), COUNT(*) FROM t "
+        "GROUP BY TIMECONVERT(ts, 'MILLISECONDS', 'DAYS') ORDER BY COUNT(*) DESC LIMIT 3"
+    )
+    truth = (df.ts // 86_400_000).value_counts()
+    for day, c in res.rows:
+        assert truth[int(day)] == c
+
+
+def test_json_extract_group_by(setup):
+    eng, df = setup
+    res = eng.execute(
+        "SELECT JSONEXTRACTSCALAR(doc, '$.b.c', 'STRING') AS k, COUNT(*) FROM t "
+        "GROUP BY k ORDER BY k LIMIT 10"
+    )
+    truth = pd.Series([json.loads(v)["b"]["c"] for v in df.doc]).value_counts().sort_index()
+    assert [r[0] for r in res.rows] == list(truth.index)
+    assert [r[1] for r in res.rows] == [int(x) for x in truth]
