@@ -271,9 +271,19 @@ def get_fs(uri: str) -> PinotFS:
 
             fs = S3FS(endpoint=os.environ.get("GCS_ENDPOINT", "https://storage.googleapis.com"))
             register_fs("gs", fs)
+        elif scheme in ("abfs", "abfss", "adl"):
+            from pinot_tpu.io.adls import AdlsGen2FS
+
+            fs = AdlsGen2FS()  # endpoint/key from env (ADLS_ENDPOINT, ADLS_*)
+            for s in ("abfs", "abfss", "adl"):
+                register_fs(s, fs)
+        elif scheme == "hdfs":
+            from pinot_tpu.io.hdfs import WebHdfsFS
+
+            fs = WebHdfsFS()  # endpoint from env (HDFS_ENDPOINT / HDFS_HTTP_PORT)
+            register_fs("hdfs", fs)
         else:
             raise ValueError(
-                f"no PinotFS registered for scheme {scheme!r} "
-                f"(abfs/hdfs plugins require egress; register your own via register_fs)"
+                f"no PinotFS registered for scheme {scheme!r}; register via register_fs"
             )
     return fs

@@ -321,7 +321,9 @@ def _b64_decode(v: str) -> str:
 def _regexp_replace(v: str, pattern, repl) -> str:
     import re
 
-    return re.sub(str(pattern), str(repl), v)
+    # Pinot (Java Matcher.replaceAll) uses $N group references
+    py_repl = re.sub(r"\$(\d+)", r"\\\1", str(repl))
+    return re.sub(str(pattern), py_repl, v)
 
 
 def _regexp_extract(v: str, pattern, group=0, default=""):

@@ -1,0 +1,429 @@
+"""ADLS Gen2 + WebHDFS PinotFS plugins against in-process protocol stubs
+(same pattern as tests/test_s3fs.py — no egress in this image, so the stubs
+are the conformance targets).
+
+Reference parity: ADLSGen2PinotFS (pinot-plugins/pinot-file-system/
+pinot-adls/) and HadoopPinotFS (pinot-plugins/pinot-file-system/pinot-hdfs/).
+Both suites run the same PinotFS contract exercise: write/read/exists/length/
+list/move/copy/delete plus segment-directory round-trips through
+copy_from_local/copy_to_local.
+"""
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs, unquote, urlparse
+
+import pytest
+
+from pinot_tpu.io.adls import AdlsGen2FS
+from pinot_tpu.io.hdfs import WebHdfsFS
+
+
+# ---------------------------------------------------------------------------
+# ADLS Gen2 dfs stub
+# ---------------------------------------------------------------------------
+
+
+class _AdlsStub:
+    """Minimal ADLS Gen2 dfs endpoint: path-addressed files + directories."""
+
+    def __init__(self):
+        self.files: dict[tuple[str, str], bytes] = {}  # (fs, path) -> content
+        self.dirs: set[tuple[str, str]] = set()
+        self.auth_failures: list[str] = []
+        stub = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def _fp(self):
+                p = urlparse(self.path)
+                parts = unquote(p.path).lstrip("/").split("/", 1)
+                return parts[0], (parts[1] if len(parts) > 1 else ""), parse_qs(p.query)
+
+            def _check_auth(self):
+                a = self.headers.get("Authorization", "")
+                if not (a.startswith("SharedKey ") and ":" in a and self.headers.get("x-ms-date")):
+                    stub.auth_failures.append(self.path)
+
+            def do_PUT(self):
+                self._check_auth()
+                fs, path, q = self._fp()
+                src = self.headers.get("x-ms-rename-source")
+                if src:
+                    sfs, spath = unquote(src).lstrip("/").split("/", 1)
+                    moved = False
+                    if (sfs, spath) in stub.files:
+                        stub.files[(fs, path)] = stub.files.pop((sfs, spath))
+                        moved = True
+                    for (f2, p2) in [k for k in list(stub.files) if k[0] == sfs and k[1].startswith(spath + "/")]:
+                        stub.files[(fs, path + p2[len(spath):])] = stub.files.pop((f2, p2))
+                        moved = True
+                    if (sfs, spath) in stub.dirs:
+                        stub.dirs.discard((sfs, spath))
+                        stub.dirs.add((fs, path))
+                        moved = True
+                    self.send_response(201 if moved else 404)
+                    self.end_headers()
+                    return
+                res = q.get("resource", [""])[0]
+                if res == "directory":
+                    stub.dirs.add((fs, path))
+                elif res == "file":
+                    stub.files[(fs, path)] = b""
+                self.send_response(201)
+                self.end_headers()
+
+            def do_PATCH(self):
+                self._check_auth()
+                fs, path, q = self._fp()
+                action = q.get("action", [""])[0]
+                if action == "append":
+                    n = int(self.headers.get("Content-Length", 0))
+                    pos = int(q.get("position", ["0"])[0])
+                    cur = stub.files.get((fs, path), b"")
+                    stub.files[(fs, path)] = cur[:pos] + self.rfile.read(n)
+                self.send_response(202 if action == "append" else 200)
+                self.end_headers()
+
+            def do_GET(self):
+                self._check_auth()
+                fs, path, q = self._fp()
+                if q.get("resource") == ["filesystem"]:
+                    directory = q.get("directory", [""])[0]
+                    recursive = q.get("recursive", ["false"])[0] == "true"
+                    prefix = directory.rstrip("/") + "/" if directory else ""
+                    paths = []
+                    names = set()
+                    for (f2, p2), content in stub.files.items():
+                        if f2 != fs or not p2.startswith(prefix):
+                            continue
+                        rel = p2[len(prefix):]
+                        if not recursive and "/" in rel:
+                            continue
+                        names.add(p2)
+                        paths.append({"name": p2, "contentLength": len(content)})
+                    for (f2, d2) in stub.dirs:
+                        if f2 == fs and d2.startswith(prefix) and d2 not in names and d2 != directory:
+                            rel = d2[len(prefix):]
+                            if recursive or "/" not in rel:
+                                paths.append({"name": d2, "isDirectory": "true"})
+                    body = json.dumps({"paths": paths}).encode()
+                    self.send_response(200)
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                    return
+                data = stub.files.get((fs, path))
+                if data is None:
+                    self.send_response(404)
+                    self.end_headers()
+                    return
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def do_HEAD(self):
+                self._check_auth()
+                fs, path, _ = self._fp()
+                if (fs, path) in stub.files:
+                    self.send_response(200)
+                    self.send_header("Content-Length", str(len(stub.files[(fs, path)])))
+                    self.send_header("Last-Modified", "Wed, 01 Jan 2025 00:00:00 GMT")
+                    self.send_header("x-ms-resource-type", "file")
+                    self.end_headers()
+                elif (fs, path) in stub.dirs or any(
+                    f2 == fs and p2.startswith(path.rstrip("/") + "/") for (f2, p2) in stub.files
+                ):
+                    self.send_response(200)
+                    self.send_header("x-ms-resource-type", "directory")
+                    self.end_headers()
+                else:
+                    self.send_response(404)
+                    self.end_headers()
+
+            def do_DELETE(self):
+                self._check_auth()
+                fs, path, _ = self._fp()
+                hit = False
+                if (fs, path) in stub.files:
+                    del stub.files[(fs, path)]
+                    hit = True
+                for k in [k for k in list(stub.files) if k[0] == fs and k[1].startswith(path.rstrip("/") + "/")]:
+                    del stub.files[k]
+                    hit = True
+                if (fs, path) in stub.dirs:
+                    stub.dirs.discard((fs, path))
+                    hit = True
+                self.send_response(200 if hit else 404)
+                self.end_headers()
+
+        self.server = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self.thread = threading.Thread(target=self.server.serve_forever, daemon=True)
+        self.thread.start()
+
+    @property
+    def url(self):
+        return f"http://127.0.0.1:{self.server.server_address[1]}"
+
+    def stop(self):
+        self.server.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# WebHDFS stub
+# ---------------------------------------------------------------------------
+
+
+class _HdfsStub:
+    """Minimal WebHDFS namenode: /webhdfs/v1{path}?op=..."""
+
+    def __init__(self, redirect_create: bool = False):
+        self.files: dict[str, bytes] = {}
+        self.dirs: set[str] = {"/"}
+        self.redirect_create = redirect_create
+        stub = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def _pq(self):
+                p = urlparse(self.path)
+                path = unquote(p.path)
+                assert path.startswith("/webhdfs/v1")
+                return path[len("/webhdfs/v1"):] or "/", parse_qs(p.query)
+
+            def _json(self, doc, code=200):
+                body = json.dumps(doc).encode()
+                self.send_response(code)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_PUT(self):
+                path, q = self._pq()
+                op = q.get("op", [""])[0].upper()
+                if op == "MKDIRS":
+                    stub.dirs.add(path.rstrip("/") or "/")
+                    self._json({"boolean": True})
+                elif op == "CREATE":
+                    if stub.redirect_create and "datanode" not in q:
+                        self.send_response(307)
+                        self.send_header(
+                            "Location",
+                            f"http://127.0.0.1:{stub.server.server_address[1]}/webhdfs/v1"
+                            + path + "?op=CREATE&datanode=1",
+                        )
+                        self.send_header("Content-Length", "0")
+                        self.end_headers()
+                        return
+                    n = int(self.headers.get("Content-Length", 0))
+                    stub.files[path] = self.rfile.read(n)
+                    self.send_response(201)
+                    self.send_header("Content-Length", "0")
+                    self.end_headers()
+                elif op == "RENAME":
+                    dst = q.get("destination", [""])[0]
+                    moved = False
+                    if path in stub.files:
+                        stub.files[dst] = stub.files.pop(path)
+                        moved = True
+                    for p2 in [p for p in list(stub.files) if p.startswith(path.rstrip("/") + "/")]:
+                        stub.files[dst + p2[len(path.rstrip("/")):]] = stub.files.pop(p2)
+                        moved = True
+                    if path in stub.dirs:
+                        stub.dirs.discard(path)
+                        stub.dirs.add(dst)
+                        moved = True
+                    self._json({"boolean": moved})
+
+            def do_GET(self):
+                path, q = self._pq()
+                op = q.get("op", [""])[0].upper()
+                if op == "OPEN":
+                    data = stub.files.get(path)
+                    if data is None:
+                        self._json({"RemoteException": {"message": "not found"}}, 404)
+                        return
+                    self.send_response(200)
+                    self.send_header("Content-Length", str(len(data)))
+                    self.end_headers()
+                    self.wfile.write(data)
+                elif op == "GETFILESTATUS":
+                    if path in stub.files:
+                        self._json({"FileStatus": {"type": "FILE", "length": len(stub.files[path]), "modificationTime": 1735689600000, "pathSuffix": ""}})
+                    elif path.rstrip("/") in stub.dirs or path == "/" or any(
+                        p.startswith(path.rstrip("/") + "/") for p in stub.files
+                    ):
+                        self._json({"FileStatus": {"type": "DIRECTORY", "length": 0, "modificationTime": 1735689600000, "pathSuffix": ""}})
+                    else:
+                        self._json({"RemoteException": {"message": "not found"}}, 404)
+                elif op == "LISTSTATUS":
+                    base = path.rstrip("/")
+                    entries = {}
+                    for p, content in stub.files.items():
+                        if p.startswith(base + "/"):
+                            rel = p[len(base) + 1 :]
+                            head = rel.split("/", 1)[0]
+                            if "/" in rel:
+                                entries[head] = {"pathSuffix": head, "type": "DIRECTORY", "length": 0, "modificationTime": 1735689600000}
+                            else:
+                                entries[head] = {"pathSuffix": head, "type": "FILE", "length": len(content), "modificationTime": 1735689600000}
+                    for d in stub.dirs:
+                        if d.startswith(base + "/"):
+                            head = d[len(base) + 1 :].split("/", 1)[0]
+                            entries.setdefault(head, {"pathSuffix": head, "type": "DIRECTORY", "length": 0, "modificationTime": 1735689600000})
+                    if not entries and base not in stub.dirs and base != "":
+                        self._json({"RemoteException": {"message": "not found"}}, 404)
+                        return
+                    self._json({"FileStatuses": {"FileStatus": sorted(entries.values(), key=lambda e: e["pathSuffix"])}})
+
+            def do_DELETE(self):
+                path, q = self._pq()
+                hit = False
+                if path in stub.files:
+                    del stub.files[path]
+                    hit = True
+                for p2 in [p for p in list(stub.files) if p.startswith(path.rstrip("/") + "/")]:
+                    del stub.files[p2]
+                    hit = True
+                if path.rstrip("/") in stub.dirs:
+                    stub.dirs.discard(path.rstrip("/"))
+                    hit = True
+                self._json({"boolean": hit})
+
+        self.server = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self.thread = threading.Thread(target=self.server.serve_forever, daemon=True)
+        self.thread.start()
+
+    @property
+    def url(self):
+        return f"http://127.0.0.1:{self.server.server_address[1]}"
+
+    def stop(self):
+        self.server.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# contract exercises
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture()
+def adls():
+    stub = _AdlsStub()
+    fs = AdlsGen2FS(endpoint=stub.url, account="testacct", account_key="a2V5a2V5")
+    yield fs, stub
+    stub.stop()
+
+
+@pytest.fixture(params=[False, True], ids=["direct", "redirect"])
+def hdfs(request):
+    stub = _HdfsStub(redirect_create=request.param)
+    fs = WebHdfsFS(endpoint=stub.url)
+    yield fs, stub
+    stub.stop()
+
+
+def _contract_exercise(fs, base: str):
+    fs.write_bytes(f"{base}/a/x.bin", b"hello")
+    fs.write_bytes(f"{base}/a/y.bin", b"world!")
+    assert fs.exists(f"{base}/a/x.bin")
+    assert not fs.exists(f"{base}/a/zzz.bin")
+    assert fs.read_bytes(f"{base}/a/y.bin") == b"world!"
+    assert fs.length(f"{base}/a/y.bin") == 6
+    assert fs.last_modified(f"{base}/a/x.bin") > 0
+    files = fs.list_files(f"{base}/a")
+    assert any(f.endswith("x.bin") for f in files) and any(f.endswith("y.bin") for f in files)
+    assert fs.is_directory(f"{base}/a")
+    assert not fs.is_directory(f"{base}/a/x.bin")
+    # move + copy + delete
+    assert fs.move(f"{base}/a/x.bin", f"{base}/b/x2.bin")
+    assert not fs.exists(f"{base}/a/x.bin")
+    assert fs.read_bytes(f"{base}/b/x2.bin") == b"hello"
+    assert fs.copy(f"{base}/b/x2.bin", f"{base}/c/x3.bin")
+    assert fs.read_bytes(f"{base}/c/x3.bin") == b"hello"
+    assert fs.delete(f"{base}/c/x3.bin", force=True)
+    assert not fs.exists(f"{base}/c/x3.bin")
+
+
+def _segment_roundtrip(fs, base: str, tmp_path):
+    src = tmp_path / "seg"
+    (src / "sub").mkdir(parents=True)
+    (src / "meta.json").write_bytes(b'{"n": 1}')
+    (src / "sub" / "data.npz").write_bytes(b"\x00" * 128)
+    fs.copy_from_local(src, f"{base}/segments/seg1")
+    assert fs.exists(f"{base}/segments/seg1/meta.json")
+    dst = tmp_path / "back"
+    fs.copy_to_local(f"{base}/segments/seg1", dst)
+    assert (dst / "meta.json").read_bytes() == b'{"n": 1}'
+    assert (dst / "sub" / "data.npz").read_bytes() == b"\x00" * 128
+
+
+def test_adls_contract(adls):
+    fs, stub = adls
+    _contract_exercise(fs, "abfs://deepstore")
+    assert stub.auth_failures == []  # every request carried a SharedKey header
+
+
+def test_adls_segment_roundtrip(adls, tmp_path):
+    fs, _ = adls
+    _segment_roundtrip(fs, "abfs://deepstore", tmp_path)
+
+
+def test_hdfs_contract(hdfs):
+    fs, _ = hdfs
+    _contract_exercise(fs, "hdfs://nn1/data")
+
+
+def test_hdfs_segment_roundtrip(hdfs, tmp_path):
+    fs, _ = hdfs
+    _segment_roundtrip(fs, "hdfs://nn1/data", tmp_path)
+
+
+def test_adls_copy_directory_with_subdir(adls, tmp_path):
+    """Review finding: directory copy must skip subdirectory entries."""
+    fs, _ = adls
+    fs.mkdir("abfs://deepstore/src/sub")
+    fs.write_bytes("abfs://deepstore/src/top.bin", b"t")
+    fs.write_bytes("abfs://deepstore/src/sub/deep.bin", b"d")
+    assert fs.copy("abfs://deepstore/src", "abfs://deepstore/dst")
+    assert fs.read_bytes("abfs://deepstore/dst/top.bin") == b"t"
+    assert fs.read_bytes("abfs://deepstore/dst/sub/deep.bin") == b"d"
+
+
+def test_adls_container_root_copy_to_local(adls, tmp_path):
+    """Review finding: copy_to_local from the bare container root must keep
+    full path names (no first-character stripping)."""
+    fs, _ = adls
+    fs.write_bytes("abfs://deepstore/rootfile.bin", b"r")
+    fs.write_bytes("abfs://deepstore/d/nested.bin", b"n")
+    dst = tmp_path / "out"
+    fs.copy_to_local("abfs://deepstore", dst)
+    assert (dst / "rootfile.bin").read_bytes() == b"r"
+    assert (dst / "d" / "nested.bin").read_bytes() == b"n"
+
+
+def test_regexpreplace_java_group_refs():
+    from pinot_tpu.query.transforms import apply_string_func
+
+    import numpy as np
+
+    vals = np.asarray(["ab"], dtype=object)
+    got, _ = apply_string_func("regexpreplace", vals, ("(a)(b)", "$2$1"))
+    assert got.tolist() == ["ba"]
+
+
+def test_scheme_registry(adls, hdfs, monkeypatch):
+    from pinot_tpu.io import fs as fsmod
+
+    a_fs, _ = adls
+    h_fs, _ = hdfs
+    fsmod.register_fs("abfs", a_fs)
+    fsmod.register_fs("hdfs", h_fs)
+    assert fsmod.get_fs("abfs://deepstore/x") is a_fs
+    assert fsmod.get_fs("hdfs://nn1/x") is h_fs
