@@ -202,34 +202,33 @@ class AdlsGen2FS(PinotFS):
             return False
         sfs, spath = _uri_parts(src)
         dfs, dpath = _uri_parts(dst)
-        with self._request(
-            "PUT",
-            f"/{dfs}/{dpath}",
-            {"mode": "legacy"},
-            extra_headers={"x-ms-rename-source": f"/{sfs}/{spath}"},
-        ):
-            return True
+        try:
+            with self._request(
+                "PUT",
+                f"/{dfs}/{dpath}",
+                {"mode": "legacy"},
+                extra_headers={"x-ms-rename-source": f"/{sfs}/{spath}"},
+            ):
+                return True
+        except urllib.error.HTTPError as e:
+            if e.code == 404:
+                return False  # PinotFS contract: missing source -> False
+            raise
 
-    def copy(self, src: str, dst: str) -> bool:
-        # the dfs API has no server-side copy; read+write (ADLSGen2PinotFS
-        # does a download/upload pair the same way)
-        if self.is_directory(src):
-            for f in self.list_files(src, recursive=True):
-                if self.is_directory(f):
-                    continue
-                rel = f[len(src.rstrip("/")) + 1 :]
-                self.write_bytes(dst.rstrip("/") + "/" + rel, self.read_bytes(f))
-            return True
-        self.write_bytes(dst, self.read_bytes(src))
-        return True
+    # copy/copy_to_local/copy_from_local: directory-aware PinotFS defaults
+    # (the dfs API has no server-side copy; ADLSGen2PinotFS downloads and
+    # re-uploads the same way)
 
     def list_files(self, uri: str, recursive: bool = False) -> list[str]:
+        return [f for f, _ in self.list_entries(uri, recursive)]
+
+    def list_entries(self, uri: str, recursive: bool = False) -> list[tuple[str, bool]]:
         fs, path = _uri_parts(uri)
         scheme = urllib.parse.urlparse(uri).scheme
         base_query = {"resource": "filesystem", "recursive": "true" if recursive else "false"}
         if path:
             base_query["directory"] = path
-        names: list[str] = []
+        entries: list[tuple[str, bool]] = []
         continuation: str | None = None
         while True:  # follow x-ms-continuation (5000-path pages)
             query = dict(base_query)
@@ -243,31 +242,13 @@ class AdlsGen2FS(PinotFS):
                 if e.code == 404:
                     return []
                 raise
-            names.extend(p["name"] for p in doc.get("paths", []))
+            entries.extend(
+                (
+                    f"{scheme}://{fs}/{p['name']}",
+                    str(p.get("isDirectory", "false")).lower() == "true",
+                )
+                for p in doc.get("paths", [])
+            )
             if not continuation:
                 break
-        return sorted(f"{scheme}://{fs}/{n}" for n in names)
-
-    def copy_to_local(self, uri: str, local_path: str | Path) -> None:
-        if self.is_directory(uri):
-            base = _uri_parts(uri)[1].rstrip("/")
-            skip = len(base) + 1 if base else 0  # container root: keep full names
-            for f in self.list_files(uri, recursive=True):
-                if self.is_directory(f):
-                    continue
-                rel = _uri_parts(f)[1][skip:]
-                dst = Path(local_path) / rel
-                dst.parent.mkdir(parents=True, exist_ok=True)
-                dst.write_bytes(self.read_bytes(f))
-            return
-        super().copy_to_local(uri, local_path)
-
-    def copy_from_local(self, local_path: str | Path, uri: str) -> None:
-        local_path = Path(local_path)
-        if local_path.is_dir():
-            for f in sorted(local_path.rglob("*")):
-                if f.is_file():
-                    rel = f.relative_to(local_path)
-                    self.write_bytes(uri.rstrip("/") + "/" + str(rel), f.read_bytes())
-            return
-        self.write_bytes(uri, local_path.read_bytes())
+        return sorted(entries)

@@ -29,9 +29,6 @@ class PinotFS:
     def move(self, src: str, dst: str, overwrite: bool = True) -> bool:
         raise NotImplementedError
 
-    def copy(self, src: str, dst: str) -> bool:
-        raise NotImplementedError
-
     def exists(self, uri: str) -> bool:
         raise NotImplementedError
 
@@ -53,12 +50,52 @@ class PinotFS:
     def write_bytes(self, uri: str, data: bytes) -> None:
         raise NotImplementedError
 
+    def list_entries(self, uri: str, recursive: bool = False) -> list[tuple[str, bool]]:
+        """(child uri, is_directory) pairs. Default re-probes each entry;
+        plugins whose listing already carries the type (ADLS isDirectory,
+        WebHDFS type) override to avoid a round-trip per entry."""
+        return [(f, self.is_directory(f)) for f in self.list_files(uri, recursive)]
+
+    # -- directory-aware transfer defaults (shared by the remote plugins;
+    # built on the primitives above, so any PinotFS gets them for free) ------
+
+    @staticmethod
+    def _rel_path(base_uri: str, child_uri: str) -> str:
+        base = urlparse(base_uri).path.strip("/")
+        child = urlparse(child_uri).path.lstrip("/")
+        return child[len(base) + 1 :] if base else child
+
+    def copy(self, src: str, dst: str) -> bool:
+        if self.is_directory(src):
+            for f, is_dir in self.list_entries(src, recursive=True):
+                if is_dir:
+                    continue
+                self.write_bytes(dst.rstrip("/") + "/" + self._rel_path(src, f), self.read_bytes(f))
+            return True
+        self.write_bytes(dst, self.read_bytes(src))
+        return True
+
     def copy_to_local(self, uri: str, local_path: str | Path) -> None:
+        if self.is_directory(uri):
+            for f, is_dir in self.list_entries(uri, recursive=True):
+                if is_dir:
+                    continue
+                target = Path(local_path) / self._rel_path(uri, f)
+                target.parent.mkdir(parents=True, exist_ok=True)
+                target.write_bytes(self.read_bytes(f))
+            return
         Path(local_path).parent.mkdir(parents=True, exist_ok=True)
         Path(local_path).write_bytes(self.read_bytes(uri))
 
     def copy_from_local(self, local_path: str | Path, uri: str) -> None:
-        self.write_bytes(uri, Path(local_path).read_bytes())
+        local_path = Path(local_path)
+        if local_path.is_dir():
+            for f in sorted(local_path.rglob("*")):
+                if f.is_file():
+                    rel = f.relative_to(local_path)
+                    self.write_bytes(uri.rstrip("/") + "/" + str(rel), f.read_bytes())
+            return
+        self.write_bytes(uri, local_path.read_bytes())
 
 
 def _local_path(uri: str) -> Path:

@@ -122,22 +122,21 @@ class WebHdfsFS(PinotFS):
     def move(self, src: str, dst: str, overwrite: bool = True) -> bool:
         if not overwrite and self.exists(dst):
             return False
-        _netloc, dpath = _uri_path(dst)
+        s_netloc, _spath = _uri_path(src)
+        d_netloc, dpath = _uri_path(dst)
+        if s_netloc and d_netloc and s_netloc != d_netloc:
+            # WebHDFS RENAME is path-only within one namenode; a silent
+            # same-cluster rename would misreport a cross-cluster move
+            raise ValueError(f"cross-namenode move not supported: {src} -> {dst}")
         with self._request("PUT", src, "RENAME", {"destination": dpath}) as r:
             return bool(json.loads(r.read()).get("boolean", False))
 
-    def copy(self, src: str, dst: str) -> bool:
-        if self.is_directory(src):
-            for f in self.list_files(src, recursive=True):
-                if self.is_directory(f):
-                    continue
-                rel = _uri_path(f)[1][len(_uri_path(src)[1].rstrip("/")) + 1 :]
-                self.write_bytes(dst.rstrip("/") + "/" + rel, self.read_bytes(f))
-            return True
-        self.write_bytes(dst, self.read_bytes(src))
-        return True
+    # copy/copy_to_local/copy_from_local: directory-aware PinotFS defaults
 
     def list_files(self, uri: str, recursive: bool = False) -> list[str]:
+        return [f for f, _ in self.list_entries(uri, recursive)]
+
+    def list_entries(self, uri: str, recursive: bool = False) -> list[tuple[str, bool]]:
         netloc, path = _uri_path(uri)
         try:
             with self._request("GET", uri, "LISTSTATUS") as r:
@@ -146,34 +145,12 @@ class WebHdfsFS(PinotFS):
             if e.code == 404:
                 return []
             raise
-        out = []
+        out: list[tuple[str, bool]] = []
         prefix = f"hdfs://{netloc}" if netloc else "hdfs://"
         for st in statuses:
             child = prefix + path.rstrip("/") + "/" + st["pathSuffix"]
-            out.append(child)
-            if recursive and st.get("type") == "DIRECTORY":
-                out.extend(self.list_files(child, recursive=True))
+            is_dir = st.get("type") == "DIRECTORY"
+            out.append((child, is_dir))
+            if recursive and is_dir:
+                out.extend(self.list_entries(child, recursive=True))
         return sorted(out)
-
-    def copy_to_local(self, uri: str, local_path: str | Path) -> None:
-        if self.is_directory(uri):
-            base = _uri_path(uri)[1].rstrip("/")
-            for f in self.list_files(uri, recursive=True):
-                if self.is_directory(f):
-                    continue
-                rel = _uri_path(f)[1][len(base) + 1 :]
-                dst = Path(local_path) / rel
-                dst.parent.mkdir(parents=True, exist_ok=True)
-                dst.write_bytes(self.read_bytes(f))
-            return
-        super().copy_to_local(uri, local_path)
-
-    def copy_from_local(self, local_path: str | Path, uri: str) -> None:
-        local_path = Path(local_path)
-        if local_path.is_dir():
-            for f in sorted(local_path.rglob("*")):
-                if f.is_file():
-                    rel = f.relative_to(local_path)
-                    self.write_bytes(uri.rstrip("/") + "/" + str(rel), f.read_bytes())
-            return
-        self.write_bytes(uri, local_path.read_bytes())

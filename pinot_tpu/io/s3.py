@@ -273,12 +273,8 @@ class S3FS(PinotFS):
             dst.parent.mkdir(parents=True, exist_ok=True)
             dst.write_bytes(self.read_bytes(f"{scheme}://{bucket}/{child}"))
 
-    def copy_from_local(self, local_path: str | Path, uri: str) -> None:
-        local_path = Path(local_path)
-        if local_path.is_dir():
-            for f in sorted(local_path.rglob("*")):
-                if f.is_file():
-                    rel = f.relative_to(local_path)
-                    self.write_bytes(uri.rstrip("/") + "/" + str(rel), f.read_bytes())
-            return
-        self.write_bytes(uri, local_path.read_bytes())
+    def list_entries(self, uri: str, recursive: bool = False) -> list[tuple[str, bool]]:
+        # object stores list objects only — never directories
+        return [(f, False) for f in self.list_files(uri, recursive)]
+
+    # copy_from_local: the directory-aware PinotFS default

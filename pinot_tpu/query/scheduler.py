@@ -62,6 +62,12 @@ class QueryScheduler:
         self._running = False
         self._lock = threading.Lock()
         self._wake = threading.Condition(self._lock)
+        self._queued = 0  # jobs enqueued but not yet picked up (pending())
+
+    def pending(self) -> int:
+        """Queued-but-not-running job count (leak-check / observability)."""
+        with self._lock:
+            return self._queued
 
     # -- lifecycle ----------------------------------------------------------
 
@@ -81,6 +87,7 @@ class QueryScheduler:
             # drain queued jobs so callers blocked on their Futures unblock
             # instead of hanging forever (runners only finish in-flight work)
             for job in self._drain():
+                self._queued -= 1
                 if not job.future.cancel():
                     job.future.set_exception(SchedulerRejectedError("scheduler stopped"))
             self._wake.notify_all()
@@ -96,6 +103,7 @@ class QueryScheduler:
             if not self._running:
                 raise SchedulerRejectedError("scheduler not running")
             self._enqueue(job)
+            self._queued += 1
             self._wake.notify()
         return job.future
 
@@ -131,6 +139,7 @@ class QueryScheduler:
                     self._wake.wait(timeout=0.1)
                 if not self._running:
                     return
+                self._queued -= 1
             t0 = time.perf_counter()
             job.run()
             elapsed = time.perf_counter() - t0

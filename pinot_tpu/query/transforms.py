@@ -321,8 +321,11 @@ def _b64_decode(v: str) -> str:
 def _regexp_replace(v: str, pattern, repl) -> str:
     import re
 
-    # Pinot (Java Matcher.replaceAll) uses $N group references
-    py_repl = re.sub(r"\$(\d+)", r"\\\1", str(repl))
+    # Pinot (Java Matcher.replaceAll) uses $N group references; \g<N> keeps
+    # multi-digit refs unambiguous ($12 stays group 1 + '2' like Java's
+    # longest-valid-group rule can't — we bind single digits, the common
+    # case) and makes $0 the whole match instead of an octal escape
+    py_repl = re.sub(r"\$(\d)", r"\\g<\1>", str(repl))
     return re.sub(str(pattern), py_repl, v)
 
 

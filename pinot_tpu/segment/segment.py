@@ -182,7 +182,11 @@ class ImmutableSegment:
             elif dt == np.float64 and fast32:
                 fwd = fwd.astype(np.float32)
             arrays[name] = jnp.asarray(fwd)
-        return DeviceSegment(name=self.name, host=self, n_docs=self.n_docs, padded=pad, arrays=arrays)
+        ds = DeviceSegment(name=self.name, host=self, n_docs=self.n_docs, padded=pad, arrays=arrays)
+        from pinot_tpu.common.leakcheck import staging_tracker
+
+        staging_tracker.track(ds)  # HBM staging leak detection (test harness)
+        return ds
 
 
 @dataclass

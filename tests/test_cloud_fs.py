@@ -416,6 +416,23 @@ def test_regexpreplace_java_group_refs():
     vals = np.asarray(["ab"], dtype=object)
     got, _ = apply_string_func("regexpreplace", vals, ("(a)(b)", "$2$1"))
     assert got.tolist() == ["ba"]
+    # review r3: $N followed by a digit, and $0 as whole-match
+    got2, _ = apply_string_func("regexpreplace", vals, ("(a)(b)", "$12"))
+    assert got2.tolist() == ["a2"]
+    got3, _ = apply_string_func("regexpreplace", np.asarray(["a"], dtype=object), ("(a)", "$0x"))
+    assert got3.tolist() == ["ax"]
+
+
+def test_hdfs_cross_namenode_move_rejected(hdfs):
+    fs, _ = hdfs
+    fs.write_bytes("hdfs://nn1/data/f.bin", b"x")
+    with pytest.raises(ValueError, match="cross-namenode"):
+        fs.move("hdfs://nn1/data/f.bin", "hdfs://nn2/data/f.bin")
+
+
+def test_adls_move_missing_source_returns_false(adls):
+    fs, _ = adls
+    assert fs.move("abfs://deepstore/missing.bin", "abfs://deepstore/dst.bin") is False
 
 
 def test_scheme_registry(adls, hdfs, monkeypatch):

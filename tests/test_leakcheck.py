@@ -1,0 +1,115 @@
+"""Leak-detection harness tests (NettyLeakListener analog, SURVEY §5.2).
+
+The resources that can leak in this framework: staged device (HBM) copies of
+segments after unhosting, accountant query registrations, mailbox queues
+after a multistage query, and queued scheduler work. Each check has a
+positive case (clean run passes) and a negative case (an injected leak
+trips the assertion).
+"""
+
+import numpy as np
+import pytest
+
+from pinot_tpu.common import DataType, Schema
+from pinot_tpu.common.leakcheck import leak_check, staging_tracker
+from pinot_tpu.query import QueryEngine
+from pinot_tpu.segment import SegmentBuilder
+
+
+def _segment(name="ls0", n=500):
+    schema = Schema.build(
+        "t", dimensions=[("k", DataType.STRING)], metrics=[("v", DataType.LONG)]
+    )
+    rng = np.random.default_rng(3)
+    data = {
+        "k": np.asarray(["a", "b"], dtype=object)[rng.integers(0, 2, n)],
+        "v": rng.integers(0, 100, n).astype(np.int64),
+    }
+    return SegmentBuilder(schema).build(data, name)
+
+
+def test_staging_collected_after_unhost():
+    seg = _segment("leak_a")
+    eng = QueryEngine([seg])
+    assert eng.execute("SELECT COUNT(*) FROM t").rows[0][0] == 500
+    # unhost: drop every reference; the staged device copy must be collectable
+    del eng, seg
+    staging_tracker.assert_staging_collectable(keep=set())
+
+
+def test_staging_leak_detected():
+    seg = _segment("leak_b")
+    eng = QueryEngine([seg])
+    eng.execute("SELECT COUNT(*) FROM t")
+    pinned = seg.to_device_cached()  # simulate a component pinning staging
+    del eng, seg
+    with pytest.raises(AssertionError, match="leak_b"):
+        staging_tracker.assert_staging_collectable(keep=set())
+    del pinned
+    staging_tracker.assert_staging_collectable(keep=set())
+
+
+def test_accountant_clean_after_queries():
+    from pinot_tpu.cluster.server import Server
+
+    seg = _segment("leak_c")
+    srv = Server("s1")
+    srv.add_segment_object("t", seg)
+    with leak_check():
+        partials, matched, total = srv.execute_partials("t", "SELECT COUNT(*) FROM t", ["leak_c"])
+        assert total == 500
+
+
+def test_accountant_leak_detected():
+    from pinot_tpu.common.accounting import default_accountant
+
+    with pytest.raises(AssertionError, match="stuck-query"):
+        with leak_check():
+            default_accountant.register("stuck-query")
+    default_accountant.unregister("stuck-query")
+
+
+def test_mailbox_drained_after_multistage():
+    from pinot_tpu.multistage import MultistageEngine
+
+    seg = _segment("leak_d")
+    eng = MultistageEngine({"t": [seg]}, n_workers=2)
+    with leak_check(mailbox_services=[eng.mailboxes] if hasattr(eng, "mailboxes") else []):
+        res = eng.execute("SELECT k, SUM(v) FROM t GROUP BY k ORDER BY k LIMIT 10")
+        assert len(res.rows) == 2
+
+
+def test_mailbox_leak_detected():
+    from pinot_tpu.multistage.runtime import MailboxService
+
+    svc = MailboxService()
+    svc.send(1, 0, 0, "stuck-block")
+    with pytest.raises(AssertionError, match="not drained"):
+        with leak_check(mailbox_services=[svc]):
+            pass
+
+
+def test_scheduler_pending_counter():
+    import threading
+
+    from pinot_tpu.query.scheduler import FCFSScheduler
+
+    sched = FCFSScheduler(num_runners=1)
+    sched.start()
+    gate = threading.Event()
+    f1 = sched.submit(lambda: gate.wait(5))
+    import time
+
+    time.sleep(0.1)  # let the runner pick up f1
+    f2 = sched.submit(lambda: None)
+    assert sched.pending() == 1  # f2 queued behind the blocked runner
+    with pytest.raises(AssertionError, match="pending"):
+        with leak_check(schedulers=[sched]):
+            pass
+    gate.set()
+    f1.result(5)
+    f2.result(5)
+    assert sched.pending() == 0
+    with leak_check(schedulers=[sched]):
+        pass
+    sched.stop()
