@@ -141,6 +141,16 @@ FUNNEL_AGGS = {
 }
 
 
+def null_handling_enabled(options: dict) -> bool:
+    """`SET enableNullHandling = true` (case-insensitive key lookup —
+    QueryOptionsUtils.isNullHandlingEnabled parity). When on, aggregations
+    skip rows whose argument column is null (per the null vector index)."""
+    for k, v in options.items():
+        if k.lower() == "enablenullhandling":
+            return str(v).lower() in ("true", "1")
+    return False
+
+
 class QueryType(Enum):
     SELECTION = "SELECTION"
     SELECTION_ORDER_BY = "SELECTION_ORDER_BY"
@@ -239,7 +249,11 @@ def _extract_aggs(expr: Expr, out: dict[str, AggregationInfo]) -> bool:
                 func, arg = "distinctcount", expr.args[0]
                 name = canonical(FunctionCall("distinctcount", expr.args))
             elif fname == "count":
-                func, arg, name = "count", None, canonical(expr)
+                # COUNT(col) keeps its argument: identical to COUNT(*) in
+                # default mode, but with enableNullHandling it counts only
+                # non-null rows of that column (Pinot parity)
+                carg = expr.args[0] if expr.args and not isinstance(expr.args[0], Star) else None
+                func, arg, name = "count", carg, canonical(expr)
             elif fname in FUNNEL_AGGS:
                 func, name = fname, canonical(expr)
                 arg, arg2, extra = _parse_funnel_args(fname, expr)

@@ -504,13 +504,36 @@ def _mv_doc_partials(func: str, ci, mask: np.ndarray) -> dict[str, np.ndarray]:
     return {"p0": s[mask], "p1": ci.lens[mask].astype(np.int64)}
 
 
+def _null_doc_mask(seg: ImmutableSegment, a) -> "np.ndarray | None":
+    """Docs where any arg column of aggregation `a` is null (null vector
+    index), or None when no arg has one."""
+    from pinot_tpu.native import bm_to_bool
+    from pinot_tpu.query.ast import Identifier
+
+    nulls = None
+    for arg in (a.arg, a.arg2):
+        if not isinstance(arg, Identifier):
+            continue
+        nv = (seg.extras or {}).get("null", {}).get(arg.name)
+        if nv is not None:
+            b = bm_to_bool(nv, seg.n_docs)
+            nulls = b if nulls is None else (nulls | b)
+    return nulls
+
+
 def agg_partials(seg: ImmutableSegment, ctx: QueryContext, query_mask: np.ndarray) -> list:
     from pinot_tpu.query.aggregates import EXT_AGGS
+    from pinot_tpu.query.context import null_handling_enabled
 
+    null_on = null_handling_enabled(ctx.options)
     out = []
     for a in ctx.aggregations:
         # FILTER (WHERE ...) intersects into the query mask per aggregation
         mask = query_mask if a.filter is None else (query_mask & filter_mask(seg, a.filter))
+        if null_on:
+            nulls = _null_doc_mask(seg, a)
+            if nulls is not None:
+                mask = mask & ~nulls
         if a.func == "count":
             out.append(int(mask.sum()))
             continue
@@ -601,12 +624,25 @@ def group_frame(seg: ImmutableSegment, ctx: QueryContext, mask: np.ndarray) -> p
     filtered_ok = {"count", "sum", "min", "max", "avg", "minmaxrange"}
     mv_docaggs: dict[int, dict[str, np.ndarray]] = {}
     theta_nf: dict[int, int] = {}  # agg index -> number of theta filter clauses
+    from pinot_tpu.query.context import null_handling_enabled
+
+    null_on = null_handling_enabled(ctx.options)
+    null_aggs: set[int] = set()  # agg indices with null rows substituted
     for i, a in enumerate(ctx.aggregations):
         if a.filter is not None:
             if a.func not in filtered_ok:
                 raise PlanError(f"FILTER(WHERE) on {a.func} inside GROUP BY is not supported")
             data[f"f{i}"] = filter_mask(seg, a.filter)[mask]
         if a.func == "count":
+            # COUNT(col) under null handling counts non-null rows only
+            if null_on and a.arg is not None:
+                nulls = _null_doc_mask(seg, a)
+                if nulls is not None and nulls.any():
+                    cn = ~nulls[mask]
+                    if a.filter is not None:
+                        cn = cn & data[f"f{i}"]
+                    data[f"cn{i}"] = cn
+                    null_aggs.add(i)
             continue
         if a.func in _MV_AGGS:
             # per-doc pre-aggregation over the flat layout; the group merge
@@ -644,6 +680,24 @@ def group_frame(seg: ImmutableSegment, ctx: QueryContext, mask: np.ndarray) -> p
             # excluded docs become NaN; pandas reducers skip them and the
             # empty-group defaults are patched to match the device kernel
             v = np.where(data[f"f{i}"], v.astype(np.float64), np.nan)
+        if null_on:
+            nulls = _null_doc_mask(seg, a)
+            if nulls is not None and nulls.any():
+                nm = nulls[mask]
+                exact_ints = (
+                    v.dtype.kind in "iu"
+                    and len(v)
+                    and (int(v.min()) < -(1 << 53) or int(v.max()) > (1 << 53))
+                    and (a.func.startswith("distinct") or a.func in ("idset", "mode", "sumprecision"))
+                )
+                if v.dtype == object or v.dtype.kind in "US" or exact_ints:
+                    # object cells keep exact int identity (a float64 cast
+                    # would collapse distinct values above 2^53)
+                    v = v.astype(object)
+                    v[nm] = None
+                else:
+                    v = np.where(nm, np.nan, v.astype(np.float64))
+                null_aggs.add(i)
         data[f"v{i}"] = v
         if a.arg2 is not None:
             data[f"w{i}"] = eval_value(seg, a.arg2)[mask]
@@ -714,18 +768,31 @@ def group_frame(seg: ImmutableSegment, ctx: QueryContext, mask: np.ndarray) -> p
             out[f"a{i}p0"] = g.apply(_fpart, include_groups=False).values
             continue
         if a.func == "count":
-            out[f"a{i}p0"] = g[f"f{i}"].sum().values if filtered else out["__size"]
+            if i in null_aggs:
+                out[f"a{i}p0"] = g[f"cn{i}"].sum().values
+            elif filtered:
+                out[f"a{i}p0"] = g[f"f{i}"].sum().values
+            else:
+                out[f"a{i}p0"] = out["__size"]
         elif a.func == "sum":
             out[f"a{i}p0"] = np.nan_to_num(g[f"v{i}"].sum().values.astype(np.float64))
         elif a.func == "min":
             v = g[f"v{i}"].min().values.astype(np.float64)
-            out[f"a{i}p0"] = np.where(np.isnan(v), np.inf, v) if filtered else v
+            out[f"a{i}p0"] = np.where(np.isnan(v), np.inf, v) if (filtered or i in null_aggs) else v
         elif a.func == "max":
             v = g[f"v{i}"].max().values.astype(np.float64)
-            out[f"a{i}p0"] = np.where(np.isnan(v), -np.inf, v) if filtered else v
+            out[f"a{i}p0"] = np.where(np.isnan(v), -np.inf, v) if (filtered or i in null_aggs) else v
         elif a.func == "avg":
             out[f"a{i}p0"] = np.nan_to_num(g[f"v{i}"].sum().values.astype(np.float64))
-            out[f"a{i}p1"] = g[f"f{i}"].sum().values if filtered else out["__size"]
+            if i in null_aggs:
+                # null handling: count non-NaN rows — v already folds in the
+                # FILTER mask (excluded rows were NaN-ed first), so this is
+                # filter-passing AND non-null
+                out[f"a{i}p1"] = g[f"v{i}"].count().values
+            elif filtered:
+                out[f"a{i}p1"] = g[f"f{i}"].sum().values
+            else:
+                out[f"a{i}p1"] = out["__size"]
         elif a.func == "minmaxrange":
             lo = g[f"v{i}"].min().values.astype(np.float64)
             hi = g[f"v{i}"].max().values.astype(np.float64)
@@ -735,14 +802,21 @@ def group_frame(seg: ImmutableSegment, ctx: QueryContext, mask: np.ndarray) -> p
             out[f"a{i}p0"] = lo
             out[f"a{i}p1"] = hi
         elif a.func in ("distinctcount", "distinctcountbitmap"):
-            out[f"a{i}p0"] = g[f"v{i}"].agg(lambda s: set(s.tolist())).values
+            if i in null_aggs:
+                out[f"a{i}p0"] = g[f"v{i}"].agg(lambda s: set(s.dropna().tolist())).values
+            else:
+                out[f"a{i}p0"] = g[f"v{i}"].agg(lambda s: set(s.tolist())).values
         elif a.func == "distinctcounthll":
             # register partials, SAME format as the device matrix path: a
             # host-fallback segment then merges with device segments via
             # np.maximum instead of crashing on set|ndarray
             from pinot_tpu.query.sketches import np_hll_registers
 
-            out[f"a{i}p0"] = g[f"v{i}"].apply(lambda s: np_hll_registers(s.to_numpy())).values
+            out[f"a{i}p0"] = g[f"v{i}"].apply(
+                lambda s, _na=(i in null_aggs): np_hll_registers(
+                    (s.dropna() if _na else s).to_numpy()
+                )
+            ).values
         elif a.func == "percentileest" and ctx.hints.get("est_bounds", {}).get(a.name):
             # histogram tuples over the engine's global bounds, matching the
             # device matrix path's partial format
@@ -750,14 +824,22 @@ def group_frame(seg: ImmutableSegment, ctx: QueryContext, mask: np.ndarray) -> p
 
             lo_b, hi_b = ctx.hints["est_bounds"][a.name]
             out[f"a{i}p0"] = g[f"v{i}"].apply(
-                lambda s, _lo=lo_b, _hi=hi_b: (np_est_hist(np.asarray(s), _lo, _hi), _lo, _hi)
+                lambda s, _lo=lo_b, _hi=hi_b, _na=(i in null_aggs): (
+                    np_est_hist(np.asarray(s.dropna() if _na else s), _lo, _hi),
+                    _lo,
+                    _hi,
+                )
             ).values
         elif a.func in ("percentile", "percentileest", "percentiletdigest"):
             # .apply, not .agg: pandas agg rejects array-valued reducers
-            out[f"a{i}p0"] = g[f"v{i}"].apply(lambda s: np.asarray(s, dtype=np.float64)).values
+            out[f"a{i}p0"] = g[f"v{i}"].apply(
+                lambda s, _na=(i in null_aggs): np.asarray(
+                    s.dropna() if _na else s, dtype=np.float64
+                )
+            ).values
         elif a.func == "mode":
-            def _counter(s):
-                vals, counts = np.unique(np.asarray(s), return_counts=True)
+            def _counter(s, _na=(i in null_aggs)):
+                vals, counts = np.unique(np.asarray(s.dropna() if _na else s), return_counts=True)
                 return {float(k): int(c) for k, c in zip(vals, counts)}
 
             out[f"a{i}p0"] = g[f"v{i}"].apply(_counter).values
@@ -779,16 +861,22 @@ def group_frame(seg: ImmutableSegment, ctx: QueryContext, mask: np.ndarray) -> p
             out[f"a{i}p0"] = g.apply(_theta_multi, include_groups=False).values
         elif a.func in EXT_AGGS:
             spec = EXT_AGGS[a.func]
+            na = i in null_aggs
             if a.arg2 is not None:
                 parts = g.apply(
-                    lambda sub, _i=i, _s=spec, _a=a: _s.compute(
-                        sub[f"v{_i}"].to_numpy(), sub[f"w{_i}"].to_numpy(), _a.extra
+                    lambda sub, _i=i, _s=spec, _a=a, _na=na: _s.compute(
+                        *(
+                            lambda s2: (s2[f"v{_i}"].to_numpy(), s2[f"w{_i}"].to_numpy())
+                        )(sub.dropna(subset=[f"v{_i}"]) if _na else sub),
+                        _a.extra,
                     ),
                     include_groups=False,
                 )
             else:
                 parts = g[f"v{i}"].apply(
-                    lambda s, _s=spec, _a=a: _s.compute(s.to_numpy(), None, _a.extra)
+                    lambda s, _s=spec, _a=a, _na=na: _s.compute(
+                        (s.dropna() if _na else s).to_numpy(), None, _a.extra
+                    )
                 )
             out[f"a{i}p0"] = parts.values
         else:

@@ -108,6 +108,24 @@ class _Lowering:
         nv = self.op_idx(np.int32(len(self.seg.columns[col].forward)))
         return ("mv_any", col, spec, nv)
 
+    def null_wrap(self, info: AggregationInfo, spec: tuple) -> tuple:
+        """enableNullHandling: AND a non-null doc mask over the aggregation
+        (rows whose arg column is null are skipped — NullableSingleInput-
+        AggregationFunction parity). No null vector -> spec unchanged."""
+        from pinot_tpu.native import bm_to_bool
+
+        nulls = None
+        for arg in (info.arg, info.arg2):
+            if not isinstance(arg, ast.Identifier):
+                continue
+            nv = self.seg.extras.get("null", {}).get(arg.name)
+            if nv is not None:
+                b = bm_to_bool(nv, self.seg.n_docs)
+                nulls = b if nulls is None else (nulls | b)
+        if nulls is None or not nulls.any():
+            return spec
+        return ("masked", self.docmask_spec(~nulls), spec)
+
     def docmask_spec(self, mask: np.ndarray) -> tuple:
         """Host-computed doc mask -> device filter operand (the TPU analog of
         Pinot's index filter operators handing a RoaringBitmap to the tree)."""
@@ -1009,9 +1027,13 @@ def plan_segment(seg: ImmutableSegment, ctx: QueryContext, valid_mask=None) -> S
         fspec = ("and", (vm, fspec))
 
     if ctx.query_type in (QueryType.AGGREGATION, QueryType.GROUP_BY):
+        from pinot_tpu.query.context import null_handling_enabled
+
         grouped = ctx.query_type == QueryType.GROUP_BY
         gspec = lo.group_spec() if grouped else None
         aggs = tuple(lo.agg_spec(a, grouped) for a in ctx.aggregations)
+        if null_handling_enabled(ctx.options):
+            aggs = tuple(lo.null_wrap(a, s) for a, s in zip(ctx.aggregations, aggs))
         if gspec is not None and gspec[0] in ("groups_mv", "groups_mv2"):
             # MV group ids are value-space; *MV aggregations are themselves
             # value-space over a (possibly different) MV column — the

@@ -1,0 +1,178 @@
+"""enableNullHandling for aggregations: null rows (per the null vector
+index) are skipped by aggregation functions on both the device and host
+paths, scalar and grouped.
+
+Reference parity: NullableSingleInputAggregationFunction (pinot-core/.../
+query/aggregation/function/NullableSingleInputAggregationFunction.java) and
+QueryOptionsUtils.isNullHandlingEnabled — `SET enableNullHandling = true`.
+Default mode (off) keeps Pinot's substituted-default behavior.
+"""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from pinot_tpu.common import DataType, Schema
+from pinot_tpu.common.config import IndexingConfig, TableConfig
+from pinot_tpu.query import QueryEngine
+from pinot_tpu.segment import SegmentBuilder
+
+SET_ON = "SET enableNullHandling = true; "
+
+
+@pytest.fixture(scope="module")
+def setup():
+    rng = np.random.default_rng(29)
+    n = 3000
+    schema = Schema.build(
+        "t",
+        dimensions=[("g", DataType.STRING)],
+        metrics=[("v", DataType.LONG), ("x", DataType.DOUBLE)],
+    )
+    v = rng.integers(1, 100, n).astype(object)
+    x = np.round(rng.normal(10, 3, n), 3).astype(object)
+    null_mask = rng.random(n) < 0.2
+    v[null_mask] = None
+    x[null_mask] = None
+    data = {
+        "g": np.asarray(["a", "b", "c"], dtype=object)[rng.integers(0, 3, n)],
+        "v": v,
+        "x": x,
+    }
+    cfg = TableConfig("t", indexing=IndexingConfig(null_handling=True))
+    b = SegmentBuilder(schema, cfg)
+    half = n // 2
+    segs = [
+        b.build({k: a[:half] for k, a in data.items()}, "n0"),
+        b.build({k: a[half:] for k, a in data.items()}, "n1"),
+    ]
+    df = pd.DataFrame(
+        {
+            "g": [str(s) for s in data["g"]],
+            "v": [np.nan if e is None else float(e) for e in v],
+            "x": [np.nan if e is None else float(e) for e in x],
+        }
+    )
+    return QueryEngine(segs), df, ~null_mask
+
+
+def test_scalar_aggs_skip_nulls(setup):
+    eng, df, nn = setup
+    r = eng.execute(SET_ON + "SELECT SUM(v), MIN(v), MAX(v), AVG(v) FROM t").rows[0]
+    assert r[0] == pytest.approx(df.v.sum())  # pandas sum skips NaN
+    assert r[1] == df.v.min() and r[2] == df.v.max()
+    assert r[3] == pytest.approx(df.v.mean())
+
+
+def test_default_mode_uses_null_placeholder(setup):
+    eng, df, nn = setup
+    # null handling OFF: nulls were stored as the type's null placeholder
+    # (LONG -> Long.MIN_VALUE) and participate in aggregations
+    from pinot_tpu.common.types import DataType
+
+    placeholder = float(DataType.LONG.default_null)
+    r = eng.execute("SELECT SUM(v), MIN(v) FROM t").rows[0]
+    assert r[0] == pytest.approx(df.v.fillna(placeholder).sum(), rel=1e-12)
+    assert r[1] == placeholder  # the null placeholder participates
+
+
+def test_group_by_aggs_skip_nulls(setup):
+    eng, df, nn = setup
+    res = eng.execute(
+        SET_ON + "SELECT g, SUM(v), AVG(v), COUNT(*) FROM t GROUP BY g ORDER BY g LIMIT 10"
+    )
+    gb = df.groupby("g")
+    for g, s, a, c in res.rows:
+        assert s == pytest.approx(gb.v.sum()[g]), g
+        assert a == pytest.approx(gb.v.mean()[g]), g
+        assert c == int(gb.size()[g])  # COUNT(*) counts all rows
+
+
+def test_group_by_distinctcount_skips_nulls(setup):
+    eng, df, nn = setup
+    res = eng.execute(
+        SET_ON + "SELECT g, DISTINCTCOUNT(v) FROM t GROUP BY g ORDER BY g LIMIT 10"
+    )
+    for g, d in res.rows:
+        assert d == df[df.g == g].v.nunique(), g
+
+
+def test_host_path_parity(setup, monkeypatch):
+    """Forced host execution must agree with the device path."""
+    eng, df, nn = setup
+    q = SET_ON + "SELECT g, SUM(x), MIN(v), AVG(x) FROM t GROUP BY g ORDER BY g LIMIT 10"
+    want = eng.execute(q).rows
+
+    from pinot_tpu.query import plan as plan_mod
+
+    def no_device(*a, **k):
+        raise plan_mod.DeviceFallback("forced host")
+
+    h_eng = QueryEngine(eng.segments)
+    monkeypatch.setattr("pinot_tpu.query.engine.plan_segment", no_device)
+    got = h_eng.execute(q).rows
+    assert [r[0] for r in got] == [r[0] for r in want]
+    for rg, rw in zip(got, want):
+        for a, b in zip(rg[1:], rw[1:]):
+            assert a == pytest.approx(b)
+
+
+def test_count_col_counts_non_null(setup):
+    """COUNT(col) with null handling counts non-null rows (review r3)."""
+    eng, df, nn = setup
+    r = eng.execute(SET_ON + "SELECT COUNT(v), COUNT(*) FROM t").rows[0]
+    assert r[0] == int(df.v.count()) and r[1] == len(df)
+    res = eng.execute(SET_ON + "SELECT g, COUNT(v) FROM t GROUP BY g ORDER BY g LIMIT 10")
+    gb = df.groupby("g")
+    for g, c in res.rows:
+        assert c == int(gb.v.count()[g]), g
+    # default mode: COUNT(col) == COUNT(*)
+    r2 = eng.execute("SELECT COUNT(v) FROM t").rows[0][0]
+    assert r2 == len(df)
+
+
+def test_avg_filter_with_nulls(setup, monkeypatch):
+    """AVG FILTER(WHERE ...) divisor must count filter-passing AND non-null
+    rows, identically on device and host (review r3)."""
+    eng, df, nn = setup
+    q = SET_ON + "SELECT g, AVG(v) FILTER (WHERE x > 10) FROM t GROUP BY g ORDER BY g LIMIT 10"
+    res = eng.execute(q)
+    sub = df[df.x > 10]
+    gb = sub.groupby("g")
+    for g, a in res.rows:
+        assert a == pytest.approx(gb.v.mean()[g]), g
+
+    from pinot_tpu.query import plan as plan_mod
+
+    def no_device(*a, **k):
+        raise plan_mod.DeviceFallback("forced host")
+
+    h_eng = QueryEngine(eng.segments)
+    monkeypatch.setattr("pinot_tpu.query.engine.plan_segment", no_device)
+    got = h_eng.execute(q).rows
+    for rg, rw in zip(got, res.rows):
+        assert rg[1] == pytest.approx(rw[1])
+
+
+def test_distinctcount_big_ints_with_nulls():
+    """int64 values above 2^53 must not collapse under null substitution
+    (review r3: the float64 cast loses integer identity)."""
+    schema = Schema.build("b", dimensions=[("g", DataType.STRING)], metrics=[("v", DataType.LONG)])
+    big = 1 << 53
+    v = np.asarray([big, big + 1, big + 1, None, big + 2, None], dtype=object)
+    g = np.asarray(["a", "a", "a", "a", "b", "b"], dtype=object)
+    cfg = TableConfig("b", indexing=IndexingConfig(null_handling=True))
+    seg = SegmentBuilder(schema, cfg).build({"g": g, "v": v}, "big0")
+    eng = QueryEngine([seg])
+    res = eng.execute(SET_ON + "SELECT g, DISTINCTCOUNT(v) FROM b GROUP BY g ORDER BY g LIMIT 10")
+    assert res.rows == [["a", 2], ["b", 1]]
+
+
+def test_variance_ext_agg_skips_nulls(setup):
+    eng, df, nn = setup
+    got = eng.execute(SET_ON + "SELECT VAR_POP(x) FROM t").rows[0][0]
+    assert got == pytest.approx(df.x.var(ddof=0), rel=1e-9)
+    res = eng.execute(SET_ON + "SELECT g, VAR_POP(x) FROM t GROUP BY g ORDER BY g LIMIT 10")
+    gb = df.groupby("g")
+    for g, vv in res.rows:
+        assert vv == pytest.approx(gb.x.var(ddof=0)[g], rel=1e-9), g
