@@ -421,6 +421,7 @@ class Broker:
         table_servers: dict[str, list[str]] = {}
         participating: set[str] = set()
         total_docs = 0
+        table_docs: dict[str, int] = {}  # cost-model row counts per table
         for table in _collect_tables(stmt):
             if self.controller.get_table(table) is None:
                 raise KeyError(f"no such table: {table}")
@@ -443,7 +444,9 @@ class Broker:
                 # crc32, not hash() (PYTHONHASHSEED-salted)
                 sid = online[zlib.crc32(seg_name.encode()) % len(online)]
                 assign.setdefault(sid, []).append([seg_name, location])
-                total_docs += int((meta or {}).get("numDocs") or 0)
+                n_docs = int((meta or {}).get("numDocs") or 0)
+                total_docs += n_docs
+                table_docs[table] = table_docs.get(table, 0) + n_docs
             seg_assign[table] = assign
             seg_info[table] = info
             table_servers[table] = sorted(assign)
@@ -467,6 +470,7 @@ class Broker:
                     ),
                     server_urls=server_urls,
                     total_docs=total_docs,
+                    row_counts=table_docs,
                 )
                 scope.set_attr("numRows", len(result.rows))
             return result

@@ -156,6 +156,7 @@ class Server:
             segments=segments,
             registry=self.mailbox_registry,
             receive_timeout=float(body.get("receive_timeout", 60.0)),
+            row_counts={k: int(v) for k, v in (body.get("row_counts") or {}).items()},
         )
 
     def _engine(self, table: str) -> QueryEngine:

@@ -35,9 +35,18 @@ def _scan_tables(node: L.Node, out: set[str]) -> None:
             _scan_tables(child, out)
 
 
-def build_plan(sql_stmt, schemas: dict[str, list[str]], n_workers: int) -> L.StagePlan:
-    """Deterministic plan construction shared by broker and servers."""
-    plan = L.build_stage_plan(sql_stmt, L.Catalog(dict(schemas)), n_workers)
+def build_plan(
+    sql_stmt,
+    schemas: dict[str, list[str]],
+    n_workers: int,
+    row_counts: dict[str, int] | None = None,
+) -> L.StagePlan:
+    """Deterministic plan construction shared by broker and servers: the
+    broker ships its row-count snapshot in the submit body so every process
+    makes the SAME cost-based exchange decisions."""
+    plan = L.build_stage_plan(
+        sql_stmt, L.Catalog(dict(schemas), row_counts=row_counts), n_workers
+    )
     return plan
 
 
@@ -103,13 +112,14 @@ def run_assigned_stages(
     registry: MailboxRegistry,
     receive_timeout: float = 60.0,
     block: bool = False,
+    row_counts: dict[str, int] | None = None,
 ) -> None:
     """Server-side half of a distributed query: rebuild the plan, then run
     every (stage, worker) assigned to `my_id` on daemon threads."""
     from pinot_tpu.query.sql import parse_sql
 
     stmt = parse_sql(sql)
-    plan = build_plan(stmt, schemas, n_workers)
+    plan = build_plan(stmt, schemas, n_workers, row_counts)
     apply_parallelism(plan, parallelism)
     mailbox: DistributedMailbox = registry.get(qid)
     mailbox.configure(qid, my_id, placement, addresses)
@@ -130,7 +140,7 @@ def run_assigned_stages(
             has_scan = bool(stage.is_leaf)
             R.run_stage_worker(
                 stage, w, mailbox, plan.stages, segments, n_senders, parent_of,
-                scan_local_all=has_scan,
+                scan_local_all=has_scan, options=plan.options,
             )
         finally:
             done.release()
@@ -179,6 +189,7 @@ class DistributedDispatcher:
         n_workers: int = 4,
         receive_timeout: float = 60.0,
         total_docs: int = 0,
+        row_counts: dict[str, int] | None = None,
     ):
         """Returns the root-stage DataFrame-shaped ResultTable rows."""
         import time as _time
@@ -189,7 +200,7 @@ class DistributedDispatcher:
 
         t0 = _time.perf_counter()
         qid = uuid.uuid4().hex
-        plan = build_plan(stmt, schemas, n_workers)
+        plan = build_plan(stmt, schemas, n_workers, row_counts)
         all_servers = sorted(server_urls)
         parallelism, placement = plan_placement(plan, table_servers, all_servers, n_workers)
         apply_parallelism(plan, parallelism)
@@ -203,6 +214,7 @@ class DistributedDispatcher:
             "placement": [[sid, w, owner] for (sid, w), owner in placement.items()],
             "addresses": addresses,
             "receive_timeout": receive_timeout,
+            "row_counts": dict(row_counts or {}),
         }
         participants = sorted({owner for owner in placement.values() if owner != BROKER_ID})
         try:
@@ -223,7 +235,7 @@ class DistributedDispatcher:
                     parent_of[inp] = s.id
             n_senders = {sid: plan.stages[sid].parallelism for sid in plan.stages}
             root = plan.stages[0]
-            ctx = R.RunCtx(root, 0, mailbox, plan.stages, {}, n_senders)
+            ctx = R.RunCtx(root, 0, mailbox, plan.stages, {}, n_senders, options=plan.options)
             df = R.exec_node(root.root, ctx)
         finally:
             self.registry.close(qid)

@@ -317,10 +317,12 @@ def _statement_idents(stmt: ast.SelectStatement) -> set[str] | None:
 
 
 class Catalog:
-    """table name -> list of column names (from the segment schema)."""
+    """table name -> list of column names (from the segment schema), plus
+    optional row counts feeding the cost-based exchange decisions."""
 
-    def __init__(self, tables: dict[str, list[str]]):
+    def __init__(self, tables: dict[str, list[str]], row_counts: dict[str, int] | None = None):
         self.tables = tables
+        self.row_counts = dict(row_counts or {})
 
     def columns(self, table: str) -> list[str]:
         cols = self.tables.get(table)
@@ -681,21 +683,53 @@ def _splittable(aggs) -> bool:
     return True
 
 
-def insert_exchanges(node: Node) -> Node:
+# cost model (the cost-based slice of QueryEnvironment's optimizer): row
+# estimates from catalog counts drive the broadcast-vs-hash join decision
+_FILTER_SELECTIVITY = 0.25
+_UNKNOWN_ROWS = 1 << 40  # unknown tables never qualify for broadcast
+#: build sides estimated at or below this broadcast instead of hashing
+BROADCAST_ROW_LIMIT = 50_000
+#: and the probe side must be at least this many times larger
+BROADCAST_SKEW = 4.0
+
+
+def estimate_rows(node: Node, row_counts: dict[str, int]) -> float:
+    """Conservative row estimate for a subtree (selectivity heuristics in
+    the style of Calcite's default RelMdRowCount)."""
+    if isinstance(node, Scan):
+        n = float(row_counts.get(node.table, _UNKNOWN_ROWS))
+        return n * _FILTER_SELECTIVITY if node.filter is not None else n
+    if isinstance(node, FilterNode):
+        return _FILTER_SELECTIVITY * estimate_rows(node.input, row_counts)
+    if isinstance(node, Join):
+        # conservative: no reduction assumed from the join itself
+        return max(
+            estimate_rows(node.left, row_counts), estimate_rows(node.right, row_counts)
+        )
+    if isinstance(node, SetOp):
+        return estimate_rows(node.left, row_counts) + estimate_rows(node.right, row_counts)
+    child = getattr(node, "input", None)
+    if isinstance(child, Node):
+        return estimate_rows(child, row_counts)
+    return float(_UNKNOWN_ROWS)
+
+
+def insert_exchanges(node: Node, row_counts: dict[str, int] | None = None) -> Node:
     """Recursively insert Exchange nodes where distribution must change."""
+    rc = row_counts or {}
     if isinstance(node, Scan):
         return node
     if isinstance(node, FilterNode):
-        node.input = insert_exchanges(node.input)
+        node.input = insert_exchanges(node.input, rc)
         return node
     if isinstance(node, Project):
-        node.input = insert_exchanges(node.input)
+        node.input = insert_exchanges(node.input, rc)
         return node
     if isinstance(node, Rename):
-        node.input = insert_exchanges(node.input)
+        node.input = insert_exchanges(node.input, rc)
         return node
     if isinstance(node, Aggregate):
-        inp = insert_exchanges(node.input)
+        inp = insert_exchanges(node.input, rc)
         if _splittable(node.aggs):
             # two-phase aggregation (AggregateOperator LEAF/FINAL parity):
             # partials compute on the data's side of the exchange — the
@@ -718,15 +752,29 @@ def insert_exchanges(node: Node) -> Node:
             node.input = Exchange(inp, SINGLETON)
         return node
     if isinstance(node, Distinct):
-        inp = insert_exchanges(node.input)
+        inp = insert_exchanges(node.input, rc)
         node.input = Exchange(inp, HASH, _all_field_exprs(inp))
         return node
     if isinstance(node, Join):
-        left = insert_exchanges(node.left)
-        right = insert_exchanges(node.right)
+        left = insert_exchanges(node.left, rc)
+        right = insert_exchanges(node.right, rc)
         if node.left_keys:
-            node.left = Exchange(left, HASH, list(node.left_keys))
-            node.right = Exchange(right, HASH, list(node.right_keys))
+            # cost-based broadcast: a small build side replicates to every
+            # worker so the (large) probe side never reshuffles. Correct for
+            # inner/left joins only: each probe row lives on exactly one
+            # worker, and the broadcast side is complete everywhere.
+            est_r = estimate_rows(right, rc)
+            est_l = estimate_rows(left, rc)
+            if (
+                node.kind in ("inner", "left")
+                and est_r <= BROADCAST_ROW_LIMIT
+                and est_l >= BROADCAST_SKEW * est_r
+            ):
+                node.left = Exchange(left, RANDOM)
+                node.right = Exchange(right, BROADCAST)
+            else:
+                node.left = Exchange(left, HASH, list(node.left_keys))
+                node.right = Exchange(right, HASH, list(node.right_keys))
         elif node.kind in ("right", "full"):
             # key-less outer joins must see both sides whole, or broadcast-side
             # unmatched rows would duplicate per worker
@@ -738,19 +786,19 @@ def insert_exchanges(node: Node) -> Node:
             node.right = Exchange(right, BROADCAST)
         return node
     if isinstance(node, WindowNode):
-        inp = insert_exchanges(node.input)
+        inp = insert_exchanges(node.input, rc)
         if node.windows and node.windows[0].partition_by:
             node.input = Exchange(inp, HASH, list(node.windows[0].partition_by))
         else:
             node.input = Exchange(inp, SINGLETON)
         return node
     if isinstance(node, Sort):
-        inp = insert_exchanges(node.input)
+        inp = insert_exchanges(node.input, rc)
         node.input = Exchange(inp, SINGLETON)
         return node
     if isinstance(node, SetOp):
-        left = insert_exchanges(node.left)
-        right = insert_exchanges(node.right)
+        left = insert_exchanges(node.left, rc)
+        right = insert_exchanges(node.right, rc)
         if node.all and node.kind == "union":
             node.left = Exchange(left, RANDOM)
             node.right = Exchange(right, RANDOM)
@@ -791,6 +839,9 @@ class StagePlan:
     def __init__(self, stages: dict[int, Stage], visible_names: list[str]):
         self.stages = stages
         self.visible_names = visible_names
+        # per-query SET options (enableNullHandling etc.) — threaded into
+        # leaf-stage QueryContexts so v1 and v2 answer identically
+        self.options: dict[str, str] = {}
 
     def __repr__(self) -> str:
         lines = []
@@ -858,5 +909,7 @@ def build_stage_plan(stmt, catalog: Catalog, n_workers: int = 2) -> StagePlan:
     root = builder.build(stmt)
     nvis = _visible_count(root)
     visible = [f.name for f in root.fields[:nvis]]
-    root = insert_exchanges(root)
-    return cut_stages(root, n_workers, visible)
+    root = insert_exchanges(root, catalog.row_counts)
+    plan = cut_stages(root, n_workers, visible)
+    plan.options = dict(getattr(stmt, "options", None) or {})
+    return plan

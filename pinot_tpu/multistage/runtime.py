@@ -26,7 +26,7 @@ from __future__ import annotations
 
 import queue
 import threading
-from dataclasses import dataclass
+from dataclasses import dataclass, field as dfield
 
 import numpy as np
 import pandas as pd
@@ -410,6 +410,8 @@ class RunCtx:
     # its share (the server's assigned replicas), so Scan takes all of them
     # instead of modulo-splitting by worker index
     scan_local_all: bool = False
+    # per-query SET options (threaded from StagePlan.options)
+    options: dict = dfield(default_factory=dict)
 
 
 def _empty_df(n_cols: int) -> pd.DataFrame:
@@ -589,14 +591,18 @@ def _exec_aggregate(node: L.Aggregate, ctx: RunCtx) -> pd.DataFrame:
             if a.func not in _FILTERED_AGGS:
                 raise L.PlanV2Error(f"FILTER(WHERE) on {a.func} inside GROUP BY is not supported")
             fm = np.asarray(eval_filter(a.filter, infields, df), bool)
-        if a.arg is not None:
+        if a.func == "count":
+            # COUNT(*)/COUNT(col) both count rows here (the v2 engine has no
+            # null handling); the indicator folds in FILTER — the arg column
+            # must NOT be summed (COUNT(col) keeps its arg since round 3)
+            ind = fm if fm is not None else np.ones(len(df), dtype=bool)
+            work[f"v{j}"] = pd.Series(ind.astype(np.int64))
+        elif a.arg is not None:
             v = eval_expr(a.arg, infields, df).reset_index(drop=True)
             if fm is not None:
                 # excluded rows -> NaN; pandas reducers skip them
                 v = pd.Series(np.where(fm, v.to_numpy(np.float64), np.nan))
             work[f"v{j}"] = v
-        elif fm is not None:
-            work[f"v{j}"] = pd.Series(fm.astype(np.int64))  # COUNT indicator
         if a.arg2 is not None:
             work[f"w{j}"] = eval_expr(a.arg2, infields, df).reset_index(drop=True)
     wdf = pd.DataFrame(work)
@@ -605,7 +611,7 @@ def _exec_aggregate(node: L.Aggregate, ctx: RunCtx) -> pd.DataFrame:
     for j, a in enumerate(node.aggs):
         col = f"v{j}" if f"v{j}" in work else None
         col2 = f"w{j}" if a.arg2 is not None else None
-        if a.filter is not None and a.func == "count":
+        if a.func == "count":
             outs.append(gb[col].sum().rename(f"a{j}"))
             continue
         s = _agg_series(a.func, gb, col, a.extra, col2)
@@ -667,6 +673,7 @@ def _try_leaf_device_partial(node: L.Aggregate, ctx: RunCtx) -> pd.DataFrame | N
         order_by=[],
         limit=1 << 30,
         offset=0,
+        options=dict(ctx.options),
     )
     eng = QueryEngine(mine)
     try:
@@ -1044,11 +1051,15 @@ def run_stage_worker(
     parent_of: dict[int, int],
     scan_local_all: bool = False,
     errors: list | None = None,
+    options: dict | None = None,
 ) -> None:
     """Run ONE (stage, worker) OpChain to completion: execute the stage
     subtree and ship its output (or an error marker) to every parent worker.
     Shared by the in-process engine and the distributed server runtime."""
-    ctx = RunCtx(stage, w, mailbox, stages, segments, n_senders, scan_local_all=scan_local_all)
+    ctx = RunCtx(
+        stage, w, mailbox, stages, segments, n_senders,
+        scan_local_all=scan_local_all, options=dict(options or {}),
+    )
     parent = parent_of[stage.id]
     parent_par = stages[parent].parallelism
     try:
@@ -1096,7 +1107,8 @@ class MultistageEngine:
         for t, segs in self.catalog.items():
             if t not in cols and segs:
                 cols[t] = list(segs[0].schema.columns)
-        cat = L.Catalog(cols)
+        rows = {t: sum(s.n_docs for s in segs) for t, segs in self.catalog.items()}
+        cat = L.Catalog(cols, row_counts=rows)
         plan = L.build_stage_plan(stmt, cat, self.n_workers)
         # singleton-fed stages collapse to one worker
         for s in plan.stages.values():
@@ -1125,7 +1137,8 @@ class MultistageEngine:
 
         def worker_fn(stage: L.Stage, w: int):
             run_stage_worker(
-                stage, w, mailbox, plan.stages, self.catalog, n_senders, parent_of, errors=errors
+                stage, w, mailbox, plan.stages, self.catalog, n_senders, parent_of,
+                errors=errors, options=plan.options,
             )
 
         threads = []
@@ -1138,7 +1151,7 @@ class MultistageEngine:
                 t.start()
                 threads.append(t)
         root = plan.stages[0]
-        ctx = RunCtx(root, 0, mailbox, plan.stages, self.catalog, n_senders)
+        ctx = RunCtx(root, 0, mailbox, plan.stages, self.catalog, n_senders, options=plan.options)
         try:
             out = exec_node(root.root, ctx)
         finally:

@@ -162,7 +162,15 @@ class QueryEngine:
     def _execute_segment(self, seg: ImmutableSegment, ctx: QueryContext):
         """Returns (partial, matched_docs) for one segment."""
         valid = seg.extras.get("valid_docs")
-        if seg.extras.get("startree") and valid is None:
+        from pinot_tpu.query.context import null_handling_enabled
+
+        if (
+            seg.extras.get("startree")
+            and valid is None
+            # star-tree pre-agg tables bake null-placeholder rows in; under
+            # enableNullHandling the per-doc path must run instead
+            and not (null_handling_enabled(ctx.options) and seg.extras.get("null"))
+        ):
             # star-tree pre-aggregates over ALL docs; unusable under upsert
             # visibility (invalidated docs are baked into the agg table)
             from pinot_tpu.query import startree_exec

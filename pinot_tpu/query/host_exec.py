@@ -506,18 +506,26 @@ def _mv_doc_partials(func: str, ci, mask: np.ndarray) -> dict[str, np.ndarray]:
 
 def _null_doc_mask(seg: ImmutableSegment, a) -> "np.ndarray | None":
     """Docs where any arg column of aggregation `a` is null (null vector
-    index), or None when no arg has one."""
+    index), or None when no arg has one. Decompressed bool masks are cached
+    per (segment, column): one bitmap expansion however many aggregations
+    read the column."""
     from pinot_tpu.native import bm_to_bool
     from pinot_tpu.query.ast import Identifier
 
+    cache = getattr(seg, "_null_bool_cache", None)
+    if cache is None:
+        cache = seg._null_bool_cache = {}
     nulls = None
     for arg in (a.arg, a.arg2):
         if not isinstance(arg, Identifier):
             continue
         nv = (seg.extras or {}).get("null", {}).get(arg.name)
-        if nv is not None:
-            b = bm_to_bool(nv, seg.n_docs)
-            nulls = b if nulls is None else (nulls | b)
+        if nv is None:
+            continue
+        b = cache.get(arg.name)
+        if b is None:
+            b = cache[arg.name] = bm_to_bool(nv, seg.n_docs)
+        nulls = b if nulls is None else (nulls | b)
     return nulls
 
 

@@ -111,17 +111,12 @@ class _Lowering:
     def null_wrap(self, info: AggregationInfo, spec: tuple) -> tuple:
         """enableNullHandling: AND a non-null doc mask over the aggregation
         (rows whose arg column is null are skipped — NullableSingleInput-
-        AggregationFunction parity). No null vector -> spec unchanged."""
-        from pinot_tpu.native import bm_to_bool
+        AggregationFunction parity). No null vector -> spec unchanged.
+        The mask comes from the SAME helper the host executor uses, so the
+        two paths cannot diverge."""
+        from pinot_tpu.query.host_exec import _null_doc_mask
 
-        nulls = None
-        for arg in (info.arg, info.arg2):
-            if not isinstance(arg, ast.Identifier):
-                continue
-            nv = self.seg.extras.get("null", {}).get(arg.name)
-            if nv is not None:
-                b = bm_to_bool(nv, self.seg.n_docs)
-                nulls = b if nulls is None else (nulls | b)
+        nulls = _null_doc_mask(self.seg, info)
         if nulls is None or not nulls.any():
             return spec
         return ("masked", self.docmask_spec(~nulls), spec)

@@ -115,6 +115,60 @@ def test_duplicate_build_keys_fall_back(setup):
     assert res.rows[0][0] == len(m)
 
 
+def test_cost_based_broadcast_join(setup):
+    """The planner broadcasts a small build side (dim: 300 rows) under a big
+    probe side (fact: 5000 rows) instead of hash-repartitioning both — the
+    cost-based slice of QueryEnvironment's optimizer — and results match the
+    hash plan exactly."""
+    from pinot_tpu.multistage import logical as L
+    from pinot_tpu.query.sql import parse_sql
+
+    engine, fdf, ddf = setup
+    stmt = parse_sql(
+        "SELECT d.dname, SUM(f.val) FROM fact f JOIN dim d ON f.fdid = d.did "
+        "GROUP BY d.dname ORDER BY d.dname LIMIT 500"
+    )
+    cat = L.Catalog(
+        {"fact": ["fid", "fdid", "val"], "dim": ["did", "dname", "weight"]},
+        row_counts={"fact": N_FACT, "dim": N_DIM},
+    )
+    plan = L.build_stage_plan(stmt, cat, n_workers=2)
+    dists = sorted(s.dist for s in plan.stages.values() if s.dist)
+    assert "broadcast" in dists  # small dim side broadcast
+    # and the full engine path (which now feeds row counts) stays correct
+    res = engine.execute(
+        "SELECT d.dname, SUM(f.val) FROM fact f JOIN dim d ON f.fdid = d.did "
+        "GROUP BY d.dname ORDER BY d.dname LIMIT 500"
+    )
+    m = fdf.merge(ddf, left_on="fdid", right_on="did", how="inner")
+    want = m.groupby("dname").val.sum().sort_index()
+    assert [r[0] for r in res.rows] == list(want.index)
+    assert [float(r[1]) for r in res.rows] == [float(x) for x in want]
+
+
+def test_broadcast_not_used_for_balanced_sides(setup):
+    from pinot_tpu.multistage import logical as L
+    from pinot_tpu.query.sql import parse_sql
+
+    stmt = parse_sql("SELECT COUNT(*) FROM fact a JOIN fact b ON a.fdid = b.fdid")
+    cat = L.Catalog(
+        {"fact": ["fid", "fdid", "val"]}, row_counts={"fact": N_FACT}
+    )
+    plan = L.build_stage_plan(stmt, cat, n_workers=2)
+    dists = [s.dist for s in plan.stages.values() if s.dist]
+    assert "broadcast" not in dists  # equal sides: hash both
+
+
+def test_left_outer_broadcast_correct(setup):
+    """LEFT JOIN with a broadcast build side must keep unmatched probe rows."""
+    engine, fdf, ddf = setup
+    res = engine.execute(
+        "SELECT COUNT(*) FROM fact f LEFT JOIN dim d ON f.fdid = d.did WHERE d.did IS NULL"
+    )
+    unmatched = (~fdf.fdid.isin(ddf.did)).sum()
+    assert res.rows[0][0] == int(unmatched)
+
+
 def test_string_sort_falls_back(setup):
     engine, fdf, ddf = setup
     before = runtime.DEVICE_OP_STATS["sort"]

@@ -168,6 +168,89 @@ def test_distinctcount_big_ints_with_nulls():
     assert res.rows == [["a", 2], ["b", 1]]
 
 
+def test_multistage_leaf_respects_null_handling(setup):
+    """v2 leaf stages must honor enableNullHandling (review r3: options were
+    dropped on the multistage path)."""
+    from pinot_tpu.multistage import MultistageEngine
+
+    eng, df, nn = setup
+    m_eng = MultistageEngine({"t": eng.segments}, n_workers=2)
+    got = m_eng.execute(SET_ON + "SELECT SUM(v) FROM t").rows[0][0]
+    assert got == pytest.approx(df.v.sum())  # NaN-skipping oracle
+    got2 = m_eng.execute(
+        SET_ON + "SELECT g, AVG(v) FROM t GROUP BY g ORDER BY g LIMIT 10"
+    )
+    gb = df.groupby("g")
+    for g, a in got2.rows:
+        assert a == pytest.approx(gb.v.mean()[g]), g
+
+
+def test_multistage_count_col_filter_counts_rows():
+    """v2 plain grouped path: COUNT(col) FILTER(...) counts rows, not the
+    column sum (review r3 regression from keeping COUNT's argument)."""
+    from pinot_tpu.multistage import MultistageEngine
+
+    rng = np.random.default_rng(31)
+    n = 500
+    schema = Schema.build(
+        "p", dimensions=[("g", DataType.STRING)], metrics=[("v", DataType.LONG), ("x", DataType.LONG)]
+    )
+    data = {
+        "g": np.asarray(["a", "b"], dtype=object)[rng.integers(0, 2, n)],
+        "v": rng.integers(10, 100, n).astype(np.int64),
+        "x": rng.integers(0, 2, n).astype(np.int64),
+    }
+    seg = SegmentBuilder(schema).build(data, "p0")
+    m_eng = MultistageEngine({"p": [seg]}, n_workers=2)
+    # MODE in the agg list forces the non-splittable plain grouped path
+    res = m_eng.execute(
+        "SELECT g, COUNT(v) FILTER (WHERE x = 1), MODE(v) FROM p GROUP BY g ORDER BY g LIMIT 10"
+    )
+    df = pd.DataFrame({k: (a.astype(str) if a.dtype == object else a) for k, a in data.items()})
+    gb = df[df.x == 1].groupby("g")
+    for g, c, _m in res.rows:
+        assert c == int(gb.size()[g]), g
+
+
+def test_startree_bypassed_under_null_handling():
+    """A star-tree segment must not serve null-handling queries: placeholder
+    rows are baked into the pre-agg table (review r3)."""
+    from pinot_tpu.common.config import StarTreeIndexConfig
+
+    rng = np.random.default_rng(33)
+    n = 2000
+    schema = Schema.build(
+        "s", dimensions=[("d", DataType.STRING)], metrics=[("v", DataType.LONG)]
+    )
+    v = rng.integers(1, 50, n).astype(object)
+    nulls = rng.random(n) < 0.3
+    v[nulls] = None
+    data = {"d": np.asarray(["x", "y"], dtype=object)[rng.integers(0, 2, n)], "v": v}
+    cfg = TableConfig(
+        "s",
+        indexing=IndexingConfig(
+            null_handling=True,
+            star_tree_configs=[
+                StarTreeIndexConfig(
+                    dimensions_split_order=["d"],
+                    function_column_pairs=["SUM__v"],
+                )
+            ],
+        ),
+    )
+    seg = SegmentBuilder(schema, cfg).build(data, "st0")
+    assert seg.extras.get("startree") is not None
+    eng = QueryEngine([seg])
+    df_v = pd.Series([np.nan if e is None else float(e) for e in v])
+    got = eng.execute(SET_ON + "SELECT SUM(v) FROM s").rows[0][0]
+    assert got == pytest.approx(df_v.sum())  # nulls skipped, not placeholders
+    # default mode still uses the star-tree (placeholder participates)
+    from pinot_tpu.common.types import DataType as DT
+
+    got_def = eng.execute("SELECT SUM(v) FROM s").rows[0][0]
+    assert got_def == pytest.approx(df_v.fillna(float(DT.LONG.default_null)).sum(), rel=1e-12)
+
+
 def test_variance_ext_agg_skips_nulls(setup):
     eng, df, nn = setup
     got = eng.execute(SET_ON + "SELECT VAR_POP(x) FROM t").rows[0][0]
