@@ -918,12 +918,32 @@ def distinct_frame(seg: ImmutableSegment, ctx: QueryContext, mask: np.ndarray) -
     return df.drop_duplicates()
 
 
+def _selection_nulls(seg: ImmutableSegment, ctx: QueryContext, expr) -> "np.ndarray | None":
+    """Null mask for a selected column under enableNullHandling, else None
+    (selection rows then emit None instead of the stored placeholder —
+    BaseResultsBlock null-handling parity)."""
+    from pinot_tpu.native import bm_to_bool
+    from pinot_tpu.query.context import null_handling_enabled
+
+    if not null_handling_enabled(ctx.options) or not isinstance(expr, ast.Identifier):
+        return None
+    nv = (seg.extras or {}).get("null", {}).get(expr.name)
+    return bm_to_bool(nv, seg.n_docs) if nv is not None else None
+
+
+def _null_subst(v: np.ndarray, nm: np.ndarray) -> np.ndarray:
+    out = v.astype(object)
+    out[nm] = None
+    return out
+
+
 def selection_frame(seg: ImmutableSegment, ctx: QueryContext, mask: np.ndarray, k: int) -> pd.DataFrame:
     idx = np.nonzero(mask)[0][:k]
     data = {}
     for i, it in enumerate(ctx.select_items):
-        v = eval_value(seg, it.expr)
-        data[f"c{i}"] = v[idx]
+        v = eval_value(seg, it.expr)[idx]
+        nm = _selection_nulls(seg, ctx, it.expr)
+        data[f"c{i}"] = _null_subst(v, nm[idx]) if nm is not None else v
     return pd.DataFrame(data)
 
 
@@ -936,7 +956,9 @@ def selection_ob_frame(seg: ImmutableSegment, ctx: QueryContext, mask: np.ndarra
     df = df[mask]
     proj = {}
     for i, it in enumerate(ctx.select_items):
-        proj[f"c{i}"] = eval_value(seg, it.expr)[mask]
+        v = eval_value(seg, it.expr)[mask]
+        nm = _selection_nulls(seg, ctx, it.expr)
+        proj[f"c{i}"] = _null_subst(v, nm[mask]) if nm is not None else v
     for c, v in proj.items():
         df[c] = v
     df = df.sort_values(by=[n for n, _, _ in keys], ascending=[a for _, _, a in keys], kind="mergesort")

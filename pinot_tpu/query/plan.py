@@ -1065,6 +1065,17 @@ def plan_segment(seg: ImmutableSegment, ctx: QueryContext, valid_mask=None) -> S
         )
 
     # SELECTION / SELECTION_ORDER_BY
+    from pinot_tpu.query.context import null_handling_enabled
+
+    if null_handling_enabled(ctx.options):
+        for item in ctx.select_items:
+            if (
+                isinstance(item.expr, ast.Identifier)
+                and (seg.extras or {}).get("null", {}).get(item.expr.name) is not None
+            ):
+                # rows must emit None, not the stored placeholder: the host
+                # decode path substitutes via the null vector
+                raise DeviceFallback("null-handling selection runs host-side")
     proj = []
     decode = []
     for item in ctx.select_items:

@@ -168,6 +168,28 @@ def test_distinctcount_big_ints_with_nulls():
     assert res.rows == [["a", 2], ["b", 1]]
 
 
+def test_selection_emits_none_for_null_rows(setup):
+    """SELECT with null handling returns None for null cells instead of the
+    stored placeholder (BaseResultsBlock null-handling parity)."""
+    eng, df, nn = setup
+    res = eng.execute(SET_ON + "SELECT v, x FROM t LIMIT 3000")
+    got_nulls = sum(1 for r in res.rows if r[0] is None)
+    assert got_nulls == int(df.v.isna().sum())
+    # non-null rows keep their values
+    vals = [r[0] for r in res.rows if r[0] is not None]
+    assert len(vals) == int(df.v.count())
+    # default mode: placeholders, not None
+    res2 = eng.execute("SELECT v FROM t LIMIT 3000")
+    assert all(r[0] is not None for r in res2.rows)
+
+
+def test_selection_order_by_emits_none(setup):
+    eng, df, nn = setup
+    res = eng.execute(SET_ON + "SELECT v FROM t ORDER BY g LIMIT 3000")
+    got_nulls = sum(1 for r in res.rows if r[0] is None)
+    assert got_nulls == int(df.v.isna().sum())
+
+
 def test_multistage_leaf_respects_null_handling(setup):
     """v2 leaf stages must honor enableNullHandling (review r3: options were
     dropped on the multistage path)."""
