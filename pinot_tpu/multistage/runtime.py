@@ -458,8 +458,19 @@ def exec_node(node: L.Node, ctx: RunCtx) -> pd.DataFrame:
                 vm = valid(seg.n_docs)
                 mask = vm if mask is None else (mask & vm)
             data = {}
+            from pinot_tpu.query.context import null_handling_enabled
+
+            null_on = null_handling_enabled(ctx.options)
             for i, col in enumerate(node.columns):
                 v = seg.columns[col].materialize()
+                if null_on:
+                    nv = (seg.extras or {}).get("null", {}).get(col)
+                    if nv is not None:
+                        from pinot_tpu.native import bm_to_bool
+
+                        nm = bm_to_bool(nv, seg.n_docs)
+                        v = v.astype(object)
+                        v[nm] = None  # None cells, not stored placeholders
                 data[i] = v[mask] if mask is not None else v
             frames.append(pd.DataFrame(data))
         if not frames:

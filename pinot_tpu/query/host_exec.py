@@ -918,17 +918,34 @@ def distinct_frame(seg: ImmutableSegment, ctx: QueryContext, mask: np.ndarray) -
     return df.drop_duplicates()
 
 
-def _selection_nulls(seg: ImmutableSegment, ctx: QueryContext, expr) -> "np.ndarray | None":
-    """Null mask for a selected column under enableNullHandling, else None
-    (selection rows then emit None instead of the stored placeholder —
-    BaseResultsBlock null-handling parity)."""
+def expr_null_mask(seg: ImmutableSegment, expr) -> "np.ndarray | None":
+    """Docs where ANY column referenced by expr is null (null-propagation:
+    an expression over a null input is null), or None when no referenced
+    column has a null vector."""
     from pinot_tpu.native import bm_to_bool
+    from pinot_tpu.query.context import _collect_identifiers
+
+    idents: set[str] = set()
+    _collect_identifiers(expr, idents)
+    nulls = None
+    for name in idents:
+        nv = (seg.extras or {}).get("null", {}).get(name)
+        if nv is None:
+            continue
+        b = bm_to_bool(nv, seg.n_docs)
+        nulls = b if nulls is None else (nulls | b)
+    return nulls
+
+
+def _selection_nulls(seg: ImmutableSegment, ctx: QueryContext, expr) -> "np.ndarray | None":
+    """Null mask for a selected expression under enableNullHandling, else
+    None (selection rows then emit None instead of the stored placeholder —
+    BaseResultsBlock null-handling parity)."""
     from pinot_tpu.query.context import null_handling_enabled
 
-    if not null_handling_enabled(ctx.options) or not isinstance(expr, ast.Identifier):
+    if not null_handling_enabled(ctx.options):
         return None
-    nv = (seg.extras or {}).get("null", {}).get(expr.name)
-    return bm_to_bool(nv, seg.n_docs) if nv is not None else None
+    return expr_null_mask(seg, expr)
 
 
 def _null_subst(v: np.ndarray, nm: np.ndarray) -> np.ndarray:
@@ -951,7 +968,20 @@ def selection_ob_frame(seg: ImmutableSegment, ctx: QueryContext, mask: np.ndarra
     keys = []
     for j, ob in enumerate(ctx.order_by):
         v = eval_value(seg, ob.expr)
-        keys.append((f"__key{j}", v.astype(str) if v.dtype == object else v, not ob.desc))
+        nm = _selection_nulls(seg, ctx, ob.expr)
+        if nm is not None:
+            # nulls-last ordering (Pinot null-handling ORDER BY): NaN/None
+            # sort keys land last under pandas regardless of direction
+            # (pandas separates missing values before comparing, so object
+            # columns must keep None — no astype(str) which would emit 'None')
+            if v.dtype == object or v.dtype.kind in "US":
+                v = v.astype(object)
+                v[nm] = None
+            else:
+                v = np.where(nm, np.nan, v.astype(np.float64))
+            keys.append((f"__key{j}", v, not ob.desc))
+        else:
+            keys.append((f"__key{j}", v.astype(str) if v.dtype == object else v, not ob.desc))
     df = pd.DataFrame({name: v for name, v, _ in keys})
     df = df[mask]
     proj = {}

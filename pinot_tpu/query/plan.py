@@ -1068,14 +1068,14 @@ def plan_segment(seg: ImmutableSegment, ctx: QueryContext, valid_mask=None) -> S
     from pinot_tpu.query.context import null_handling_enabled
 
     if null_handling_enabled(ctx.options):
-        for item in ctx.select_items:
-            if (
-                isinstance(item.expr, ast.Identifier)
-                and (seg.extras or {}).get("null", {}).get(item.expr.name) is not None
-            ):
-                # rows must emit None, not the stored placeholder: the host
-                # decode path substitutes via the null vector
-                raise DeviceFallback("null-handling selection runs host-side")
+        from pinot_tpu.query.host_exec import expr_null_mask
+
+        exprs = [it.expr for it in ctx.select_items] + [ob.expr for ob in ctx.order_by]
+        if any(expr_null_mask(seg, e) is not None for e in exprs):
+            # rows must emit None (null-propagating through expressions) and
+            # ORDER BY must sort nulls last: the host path substitutes via
+            # the null vector
+            raise DeviceFallback("null-handling selection runs host-side")
     proj = []
     decode = []
     for item in ctx.select_items:

@@ -190,6 +190,47 @@ def test_selection_order_by_emits_none(setup):
     assert got_nulls == int(df.v.isna().sum())
 
 
+def test_selection_expression_null_propagation(setup):
+    """Expressions over a null column emit None, not placeholder arithmetic
+    (review r3: SELECT v + 1 must not fabricate placeholder+1)."""
+    eng, df, nn = setup
+    res = eng.execute(SET_ON + "SELECT v + 1 FROM t LIMIT 3000")
+    got_nulls = sum(1 for r in res.rows if r[0] is None)
+    assert got_nulls == int(df.v.isna().sum())
+    vals = sorted(r[0] for r in res.rows if r[0] is not None)
+    want = sorted((df.v.dropna() + 1).tolist())
+    assert vals == pytest.approx(want)
+
+
+def test_order_by_nulls_last(setup):
+    """ORDER BY a nullable column sorts nulls last in both directions
+    (review r3: placeholder values must not drive the sort)."""
+    eng, df, nn = setup
+    n = len(df)
+    res = eng.execute(SET_ON + f"SELECT v FROM t ORDER BY v LIMIT {n}")
+    vals = [r[0] for r in res.rows]
+    n_null = int(df.v.isna().sum())
+    assert all(x is None for x in vals[n - n_null :])  # nulls at the end
+    non_null = vals[: n - n_null]
+    assert non_null == sorted(non_null)
+    res_d = eng.execute(SET_ON + f"SELECT v FROM t ORDER BY v DESC LIMIT {n}")
+    vals_d = [r[0] for r in res_d.rows]
+    assert all(x is None for x in vals_d[n - n_null :])
+    assert vals_d[: n - n_null] == sorted(vals_d[: n - n_null], reverse=True)
+
+
+def test_v2_selection_emits_none(setup):
+    """The v2 engine's leaf Scan substitutes None cells too (review r3:
+    v1/v2 must agree on selection content)."""
+    from pinot_tpu.multistage import MultistageEngine
+
+    eng, df, nn = setup
+    m_eng = MultistageEngine({"t": eng.segments}, n_workers=2)
+    res = m_eng.execute(SET_ON + "SELECT v FROM t LIMIT 5000")
+    got_nulls = sum(1 for r in res.rows if r[0] is None)
+    assert got_nulls == int(df.v.isna().sum())
+
+
 def test_multistage_leaf_respects_null_handling(setup):
     """v2 leaf stages must honor enableNullHandling (review r3: options were
     dropped on the multistage path)."""
