@@ -191,6 +191,46 @@ def _st_distance(jnp, lat, lng, qlat, qlng):
 
 
 # ---------------------------------------------------------------------------
+# Scalar-function registration SPI (FunctionRegistry / @ScalarFunction
+# parity, pinot-spi/.../annotations/ScalarFunction.java:45): user functions
+# plug into the SAME registries the built-ins live in, so they run on every
+# execution path (fused device program, host fallback, v2 runtime).
+# ---------------------------------------------------------------------------
+
+
+def register_device_function(name: str, arity: int, fn) -> None:
+    """Register a numeric scalar function: fn(xp, *arrays) -> array, where
+    xp is the array module (jnp on device, numpy on host). The function must
+    be traceable under jit (no data-dependent Python control flow)."""
+    key = name.lower()
+    if key in DEVICE_FUNCS:
+        raise ValueError(f"device function {name!r} already registered")
+    if key in STRING_FUNCS:
+        raise ValueError(f"{name!r} is already a string function")
+    DEVICE_FUNCS[key] = (int(arity), fn)
+
+
+def register_string_function(
+    name: str, arg_counts: tuple[int, ...], fn, returns_string: bool
+) -> None:
+    """Register a string scalar function: fn(value: str, *literal_args).
+    Applied to dictionary VALUES host-side (cardinality-sized work); numeric
+    results become device-gatherable derived tables."""
+    key = name.lower()
+    if key in STRING_FUNCS:
+        raise ValueError(f"string function {name!r} already registered")
+    if key in DEVICE_FUNCS:
+        raise ValueError(f"{name!r} is already a device function")
+    STRING_FUNCS[key] = (tuple(int(c) for c in arg_counts), fn, returns_string)
+
+
+def unregister_function(name: str) -> None:
+    key = name.lower()
+    DEVICE_FUNCS.pop(key, None)
+    STRING_FUNCS.pop(key, None)
+
+
+# ---------------------------------------------------------------------------
 # TIMECONVERT / DATETIMECONVERT: epoch-unit conversions rewritten at plan
 # time into integer arithmetic ASTs shared by the device and host lowerings
 # (TimeConversionTransformFunction / DateTimeConversionTransformFunction).
