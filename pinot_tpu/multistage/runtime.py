@@ -263,6 +263,20 @@ DEVICE_JOIN_MIN = 1 << 16
 DEVICE_OP_STATS = {"sort": 0, "join": 0}
 
 
+def sorted_frame(df: pd.DataFrame, by: list, descs: list[bool], reset_index: bool = False) -> pd.DataFrame:
+    """Stable multi-key sort with device dispatch above DEVICE_SORT_MIN and
+    pandas mergesort fallback — the ONE sort implementation the Sort node
+    and the window operator share."""
+    perm = None
+    if len(df) >= DEVICE_SORT_MIN:
+        perm = _device_sort_perm([df[c].to_numpy() for c in by], descs)
+    if perm is not None:
+        out = df.take(perm)
+    else:
+        out = df.sort_values(by=by, ascending=[not d for d in descs], kind="mergesort")
+    return out.reset_index(drop=True) if reset_index else out
+
+
 def _device_sort_perm(keys: list[np.ndarray], descs: list[bool]) -> "np.ndarray | None":
     """Stable multi-key sort permutation computed on device (lax.sort under
     jnp.lexsort). Returns None when a key is non-numeric or float-with-NaN
@@ -516,17 +530,9 @@ def exec_node(node: L.Node, ctx: RunCtx) -> pd.DataFrame:
     if isinstance(node, L.Sort):
         df = exec_node(node.input, ctx)
         if node.keys and len(df):
-            by = [k for k, _ in node.keys]
-            asc = [not d for _, d in node.keys]
-            perm = None
-            if len(df) >= DEVICE_SORT_MIN:
-                perm = _device_sort_perm(
-                    [df[k].to_numpy() for k in by], [d for _, d in node.keys]
-                )
-            if perm is not None:
-                df = df.take(perm).reset_index(drop=True)
-            else:
-                df = df.sort_values(by=by, ascending=asc, kind="mergesort", ignore_index=True)
+            df = sorted_frame(
+                df, [k for k, _ in node.keys], [d for _, d in node.keys], reset_index=True
+            )
         if node.offset or node.limit is not None:
             end = None if node.limit is None else node.offset + node.limit
             df = df.iloc[node.offset : end].reset_index(drop=True)
@@ -964,11 +970,9 @@ def _exec_window(node: L.WindowNode, ctx: RunCtx) -> pd.DataFrame:
                     res = g["v"].transform(fname if fname != "avg" else "mean")
         else:
             onames = [f"o{i}" for i in range(len(ocols))]
-            sf = wdf.sort_values(
-                by=(pnames or []) + onames,
-                ascending=[True] * len(pcols) + [not d for d in odesc],
-                kind="mergesort",
-            )
+            # the sort is the window operator's cost center: shared dispatch
+            # (device lexsort above threshold, pandas mergesort otherwise)
+            sf = sorted_frame(wdf, (pnames or []) + onames, [False] * len(pcols) + list(odesc))
             if pnames is None:
                 sf["__grp"] = 0
                 gname = "__grp"

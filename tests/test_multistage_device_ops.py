@@ -169,6 +169,27 @@ def test_left_outer_broadcast_correct(setup):
     assert res.rows[0][0] == int(unmatched)
 
 
+def test_device_window_sort_engages(setup):
+    """Window functions over numeric partition/order keys sort on device.
+    The query ALSO has an outer ORDER BY device sort, so the counter must
+    advance by at least 2 to prove the window sort itself engaged."""
+    engine, fdf, _ = setup
+    before = runtime.DEVICE_OP_STATS["sort"]
+    res = engine.execute(
+        "SELECT fid, val, ROW_NUMBER() OVER (PARTITION BY fdid ORDER BY val DESC) "
+        "FROM fact ORDER BY fid LIMIT 100"
+    )
+    assert runtime.DEVICE_OP_STATS["sort"] >= before + 2
+    want_rn = (
+        fdf.sort_values(["fdid", "val"], ascending=[True, False], kind="mergesort")
+        .groupby("fdid")
+        .cumcount()
+        + 1
+    )
+    for fid, val, rn in res.rows:
+        assert rn == int(want_rn[fid]), fid
+
+
 def test_string_sort_falls_back(setup):
     engine, fdf, ddf = setup
     before = runtime.DEVICE_OP_STATS["sort"]
