@@ -53,8 +53,19 @@ class QueryEngine:
 
     def make_context(self, sql: str) -> QueryContext:
         """Parse + resolve a query against this engine's segments."""
+        from pinot_tpu.query.optimizer import optimize_filter
+
         stmt = parse_sql(sql)
         self._expand_star(stmt)
+        # filter rewrites (QueryOptimizer parity) run here, where the schema
+        # is known: range merging must skip MV columns (any-match semantics)
+        mv_cols = {
+            name
+            for seg in self.segments
+            for name, ci in seg.columns.items()
+            if ci.is_mv
+        }
+        stmt.where = optimize_filter(stmt.where, mv_cols=mv_cols)
         ctx = QueryContext.from_statement(stmt)
         self._compute_hints(ctx)
         return ctx

@@ -1,0 +1,136 @@
+"""Filter optimizer rewrites (QueryOptimizer filter rules parity):
+flatten AND/OR, merge conjunctive ranges, merge disjunctive EQ/IN — checked
+structurally on the AST and end-to-end against pandas oracles."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from pinot_tpu.common import DataType, Schema
+from pinot_tpu.query import QueryEngine
+from pinot_tpu.query.ast import And, Between, Compare, CompareOp, In, Or
+from pinot_tpu.query.optimizer import MATCH_NOTHING, optimize_filter
+from pinot_tpu.query.sql import parse_sql
+from pinot_tpu.segment import SegmentBuilder
+
+
+def _where(sql: str):
+    return parse_sql(f"SELECT * FROM t WHERE {sql}").where
+
+
+def test_flatten_nested_and():
+    f = optimize_filter(_where("(a > 1 AND b > 2) AND (c > 3 AND d > 4)"))
+    assert isinstance(f, And) and len(f.children) == 4
+
+
+def test_merge_ranges_to_between():
+    f = optimize_filter(_where("v >= 10 AND v <= 20"))
+    assert isinstance(f, Between)
+    assert float(f.low.value) == 10 and float(f.high.value) == 20
+
+
+def test_merge_ranges_tightest_bound():
+    f = optimize_filter(_where("v > 5 AND v > 8 AND v < 30 AND v <= 25"))
+    # (8, 25] exclusive-low: AND of GT 8 and LTE 25
+    assert isinstance(f, And) and len(f.children) == 2
+    ops = {c.op for c in f.children}
+    assert ops == {CompareOp.GT, CompareOp.LTE}
+
+
+def test_contradictory_range_is_match_nothing():
+    f = optimize_filter(_where("v > 10 AND v < 5"))
+    assert f == MATCH_NOTHING
+    f2 = optimize_filter(_where("v > 10 AND v <= 10"))
+    assert f2 == MATCH_NOTHING
+
+
+def test_merge_eq_or_to_in():
+    f = optimize_filter(_where("d = 'a' OR d = 'b' OR d IN ('c', 'a')"))
+    assert isinstance(f, In)
+    assert {v.value for v in f.values} == {"a", "b", "c"}
+
+
+def test_mixed_or_keeps_rest():
+    f = optimize_filter(_where("d = 'a' OR d = 'b' OR v > 5"))
+    assert isinstance(f, Or) and len(f.children) == 2  # v>5 + IN(d)
+
+
+def test_end_to_end_results_unchanged():
+    rng = np.random.default_rng(51)
+    n = 4000
+    schema = Schema.build(
+        "t", dimensions=[("d", DataType.STRING)], metrics=[("v", DataType.LONG)]
+    )
+    data = {
+        "d": np.asarray(["a", "b", "c", "e"], dtype=object)[rng.integers(0, 4, n)],
+        "v": rng.integers(0, 100, n).astype(np.int64),
+    }
+    eng = QueryEngine([SegmentBuilder(schema).build(data, "s0")])
+    df = pd.DataFrame({"d": data["d"].astype(str), "v": data["v"]})
+    cases = [
+        ("v >= 10 AND v <= 20 AND v >= 12", (df.v >= 12) & (df.v <= 20)),
+        ("d = 'a' OR d = 'b' OR d = 'c'", df.d.isin(["a", "b", "c"])),
+        ("v > 50 AND v < 40", pd.Series(False, index=df.index)),
+        ("(v > 5 AND v > 8) AND (d = 'a' OR d IN ('b'))", (df.v > 8) & df.d.isin(["a", "b"])),
+    ]
+    for cond, mask in cases:
+        got = eng.execute(f"SELECT COUNT(*) FROM t WHERE {cond}").rows[0][0]
+        assert got == int(mask.sum()), cond
+
+
+def test_mv_ranges_never_merge():
+    """Review r3: range merging on an MV column would be unsound — any-match
+    lets DIFFERENT values of one doc satisfy each predicate."""
+    from pinot_tpu.common import FieldSpec
+
+    schema = Schema.build("t", dimensions=[], metrics=[])
+    schema.add(FieldSpec("mv", DataType.LONG, single_value=False))
+    vals = np.empty(3, dtype=object)
+    vals[:] = [[1, 10], [6, 7], [2]]
+    eng = QueryEngine([SegmentBuilder(schema).build({"mv": vals}, "s0")])
+    # doc0 has a value > 5 (10) AND a value < 3 (1): must match
+    got = eng.execute("SELECT COUNT(*) FROM t WHERE mv > 5 AND mv < 3").rows[0][0]
+    assert got == 1
+    # non-contradictory pair: doc0 matches via 10>5 and 1<10
+    got2 = eng.execute("SELECT COUNT(*) FROM t WHERE mv > 5 AND mv < 10").rows[0][0]
+    assert got2 == 2  # doc0 (10>5, 1<10) and doc1 (6,7 both in range)
+
+
+def test_fuzz_optimizer_equivalence():
+    """Random AND/OR trees of ranges and EQs: optimized filter must select
+    the same rows as the raw pandas interpretation."""
+    rng = np.random.default_rng(53)
+    n = 3000
+    schema = Schema.build(
+        "t", dimensions=[("d", DataType.STRING)], metrics=[("v", DataType.LONG)]
+    )
+    data = {
+        "d": np.asarray(["a", "b", "c"], dtype=object)[rng.integers(0, 3, n)],
+        "v": rng.integers(0, 60, n).astype(np.int64),
+    }
+    eng = QueryEngine([SegmentBuilder(schema).build(data, "s0")])
+    df = pd.DataFrame({"d": data["d"].astype(str), "v": data["v"]})
+
+    def pred(r):
+        k = r.integers(0, 3)
+        if k == 0:
+            x = int(r.integers(0, 60))
+            op = [("<", lambda t: t.v < x), (">", lambda t: t.v > x), (">=", lambda t: t.v >= x)][
+                r.integers(0, 3)
+            ]
+            return f"v {op[0]} {x}", op[1]
+        if k == 1:
+            lo = int(r.integers(0, 40))
+            hi = lo + int(r.integers(0, 30))
+            return f"v BETWEEN {lo} AND {hi}", lambda t: (t.v >= lo) & (t.v <= hi)
+        c = ["a", "b", "c"][r.integers(0, 3)]
+        return f"d = '{c}'", lambda t: t.d == c
+
+    for _ in range(40):
+        ps = [pred(rng) for _ in range(int(rng.integers(2, 5)))]
+        op = "AND" if rng.random() < 0.5 else "OR"
+        sql = f" {op} ".join(f"({p[0]})" for p in ps)
+        reduce_fn = np.logical_and.reduce if op == "AND" else np.logical_or.reduce
+        want = int(reduce_fn([np.asarray(p[1](df), bool) for p in ps]).sum())
+        got = eng.execute(f"SELECT COUNT(*) FROM t WHERE {sql}").rows[0][0]
+        assert got == want, sql
