@@ -111,6 +111,13 @@ class Broker:
 
     def _execute(self, stmt, sql: str) -> ResultTable:
         t0 = time.perf_counter()
+        if getattr(stmt, "explain", False):
+            # failing loudly beats silently executing the query and returning
+            # its rows as if they were a plan
+            raise ValueError(
+                "EXPLAIN PLAN FOR is supported on the embedded engines "
+                "(QueryEngine / MultistageEngine), not through the broker yet"
+            )
         # v2 engine selection (MultiStageBrokerRequestHandler.java:88 parity):
         # joins/subqueries/set-ops/windows, or explicit SET useMultistageEngine
         use_v2 = stmt.needs_multistage or stmt.options.get("useMultistageEngine", "").lower() == "true"

@@ -1125,11 +1125,33 @@ class MultistageEngine:
         rows = {t: sum(s.n_docs for s in segs) for t, segs in self.catalog.items()}
         cat = L.Catalog(cols, row_counts=rows)
         plan = L.build_stage_plan(stmt, cat, self.n_workers)
-        # singleton-fed stages collapse to one worker
+        # singleton-fed stages collapse to one worker BEFORE explain so the
+        # reported parallelism matches what actually runs
         for s in plan.stages.values():
             for inp in s.inputs:
                 if plan.stages[inp].dist == L.SINGLETON:
                     s.parallelism = 1
+        if getattr(stmt, "explain", False):
+            # EXPLAIN PLAN FOR: one row per stage (PinotQueryWorker Explain
+            # parity) — [stage, parallelism, distribution, plan]
+            parent_of: dict[int, int] = {}
+            for s in plan.stages.values():
+                for inp in s.inputs:
+                    parent_of[inp] = s.id
+            out_rows = [
+                [
+                    sid,
+                    s.parallelism,
+                    s.dist or "root",
+                    parent_of.get(sid, -1),
+                    L._explain(s.root),
+                ]
+                for sid, s in sorted(plan.stages.items())
+            ]
+            return ResultTable(
+                columns=["stage", "parallelism", "distribution", "parent_stage", "plan"],
+                rows=out_rows,
+            )
         df = self._run(plan)
         df = df.astype(object).where(pd.notna(df), None)
         rows = df.values.tolist()

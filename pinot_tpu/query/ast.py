@@ -332,6 +332,8 @@ class SelectStatement:
     offset: int = 0
     options: dict[str, str] = field(default_factory=dict)
     relation: Relation | None = None  # full FROM tree (multistage engine)
+    # EXPLAIN PLAN FOR ... : return the operator tree instead of executing
+    explain: bool = False
 
     @property
     def needs_multistage(self) -> bool:

@@ -26,15 +26,72 @@ from pinot_tpu.query.sql import parse_sql
 from pinot_tpu.segment.segment import DeviceSegment, ImmutableSegment
 
 
+def _describe_spec(spec: tuple, next_id: int, parent: int) -> list[list]:
+    """Flatten a compiled plan spec into [operator, id, parent] rows."""
+    rows: list[list] = []
+    counter = [next_id]
+
+    def emit(label: str, par: int) -> int:
+        oid = counter[0]
+        counter[0] += 1
+        rows.append([label, oid, par])
+        return oid
+
+    def walk_filter(f, par: int) -> None:
+        kind = f[0]
+        if kind in ("and", "or"):
+            oid = emit(f"FILTER_{kind.upper()}", par)
+            for c in f[1]:
+                walk_filter(c, oid)
+        elif kind == "not":
+            oid = emit("FILTER_NOT", par)
+            walk_filter(f[1], oid)
+        elif kind == "const":
+            emit(f"FILTER_CONST({f[1]})", par)
+        else:
+            emit(f"FILTER_{kind.upper()}", par)
+
+    def walk_agg(a, par: int) -> None:
+        if a[0] == "masked":
+            oid = emit("AGG_FILTERED", par)
+            walk_filter(a[1], oid)
+            walk_agg(a[2], oid)
+        else:
+            emit(f"AGGREGATE_{a[0].upper()}", par)
+
+    kind = spec[0]
+    if kind == "agg":
+        _, fspec, gspec, aggs = spec
+        walk_filter(fspec, parent)
+        if gspec is not None:
+            gid = emit(f"GROUP_BY(keys={list(gspec[1])}, ng={gspec[2]})", parent)
+            for a in aggs:
+                walk_agg(a, gid)
+        else:
+            for a in aggs:
+                walk_agg(a, parent)
+    elif kind == "select":
+        emit(f"SELECT(columns={len(spec[2])}, limit={spec[3]})", parent)
+        walk_filter(spec[1], parent)
+    elif kind == "select_ob":
+        emit(f"SELECT_ORDER_BY(columns={len(spec[2])}, limit={spec[5]})", parent)
+        walk_filter(spec[1], parent)
+    return rows
+
+
 class QueryEngine:
     def __init__(self, segments: list[ImmutableSegment], fast32: bool = False):
         """fast32=True stages DOUBLE columns as float32 (lossy) for speed."""
         self.segments = list(segments)
         self.fast32 = fast32
         self._device: dict[str, DeviceSegment] = {}
+        self._mv_cols = {
+            name for seg in self.segments for name, ci in seg.columns.items() if ci.is_mv
+        }
 
     def add_segment(self, seg: ImmutableSegment) -> None:
         self.segments.append(seg)
+        self._mv_cols |= {name for name, ci in seg.columns.items() if ci.is_mv}
 
     def _device_seg(self, seg: ImmutableSegment) -> DeviceSegment:
         if not self.fast32:
@@ -59,13 +116,7 @@ class QueryEngine:
         self._expand_star(stmt)
         # filter rewrites (QueryOptimizer parity) run here, where the schema
         # is known: range merging must skip MV columns (any-match semantics)
-        mv_cols = {
-            name
-            for seg in self.segments
-            for name, ci in seg.columns.items()
-            if ci.is_mv
-        }
-        stmt.where = optimize_filter(stmt.where, mv_cols=mv_cols)
+        stmt.where = optimize_filter(stmt.where, mv_cols=self._mv_cols)
         ctx = QueryContext.from_statement(stmt)
         self._compute_hints(ctx)
         return ctx
@@ -127,9 +178,40 @@ class QueryEngine:
             return reduce_mod.reduce_selection_order_by(ctx, partials)
         return reduce_mod.reduce_selection(ctx, partials)
 
+    def explain(self, ctx: QueryContext) -> ResultTable:
+        """EXPLAIN PLAN FOR: the operator tree the query would execute
+        (ExplainPlanQueryExecutor parity) as [operator, operator_id,
+        parent_id] rows, based on the first segment's lowering."""
+        rows: list[list] = [["BROKER_REDUCE(" + ctx.query_type.value + ")", 0, -1]]
+        if not self.segments:
+            return ResultTable(columns=["operator", "operator_id", "parent_id"], rows=rows)
+        seg = self.segments[0]
+        st = seg.extras.get("startree")
+        from pinot_tpu.query.context import null_handling_enabled
+
+        if (
+            st is not None
+            and seg.extras.get("valid_docs") is None
+            and not (null_handling_enabled(ctx.options) and seg.extras.get("null"))
+        ):
+            from pinot_tpu.query import startree_exec
+
+            if any(startree_exec.matches(ctx, t) for t in st):
+                rows.append(["STARTREE_SWAP(pre-aggregated table scan)", 1, 0])
+                return ResultTable(columns=["operator", "operator_id", "parent_id"], rows=rows)
+        try:
+            plan = plan_segment(seg, ctx)
+            rows.append(["DEVICE_FUSED_PROGRAM(segment=" + seg.name + ")", 1, 0])
+            rows.extend(_describe_spec(plan.spec, next_id=2, parent=1))
+        except DeviceFallback as e:
+            rows.append([f"HOST_EXECUTOR(reason={e})", 1, 0])
+        return ResultTable(columns=["operator", "operator_id", "parent_id"], rows=rows)
+
     def execute(self, sql: str) -> ResultTable:
         t0 = time.perf_counter()
         ctx = self.make_context(sql)
+        if getattr(ctx.statement, "explain", False):
+            return self.explain(ctx)
         partials, scanned = self.partials(ctx)
         rows = self.reduce(ctx, partials)
         return reduce_mod.build_result(

@@ -70,7 +70,12 @@ _INF = float("inf")
 
 
 def _num_lit(e) -> "float | None":
+    """Numeric literal as float, or None when absent OR when a float
+    round-trip would corrupt an int bound (|v| > 2^53): such predicates are
+    left unmerged rather than rewritten with a rounded literal."""
     if isinstance(e, Literal) and isinstance(e.value, (int, float)) and not isinstance(e.value, bool):
+        if isinstance(e.value, int) and abs(e.value) > 2**53:
+            return None
         return float(e.value)
     return None
 
@@ -135,13 +140,14 @@ def _merge_ranges(f: FilterExpr, mv_cols: "set[str]" = frozenset()) -> FilterExp
         if iv is None or iv[0] in mv_cols:
             rest.append(c)
         else:
-            by_col.setdefault(iv[0], []).append(iv[1:])
+            by_col.setdefault(iv[0], []).append((iv[1:], c))
     merged: list[FilterExpr] = []
-    for col, ivs in by_col.items():
-        if len(ivs) == 1:
-            (lo, li, hi, hic) = ivs[0]
-            merged.append(_interval_to_filter(col, lo, li, hi, hic))
+    for col, entries in by_col.items():
+        if len(entries) == 1:
+            # single range: keep the ORIGINAL predicate (no literal rebuild)
+            merged.append(entries[0][1])
             continue
+        ivs = [iv for iv, _c in entries]
         lo, lo_inc = max((l, linc) for (l, linc, _h, _hc) in ivs)  # noqa: E741
         # tightest bound: larger lo wins; on equal lo, EXCLUSIVE is tighter
         lo_inc = all(linc for (l, linc, _h, _hc) in ivs if l == lo)

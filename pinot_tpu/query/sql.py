@@ -177,8 +177,19 @@ class Parser:
             options[key] = val
             self.expect_op(";")
 
+        explain = False
+        if self.at_kw("EXPLAIN"):
+            # EXPLAIN PLAN FOR <query> (CalciteSqlParser explain parity)
+            self.next()
+            if not self.eat_kw("PLAN"):
+                raise SqlParseError("expected PLAN after EXPLAIN")
+            if not self.eat_kw("FOR"):
+                raise SqlParseError("expected FOR after EXPLAIN PLAN")
+            explain = True
         stmt = self._query()
         stmt.options.update(options)
+        if explain:
+            stmt.explain = True
         self.eat_op(";")
         t = self.peek()
         if t.kind != "eof":

@@ -1,0 +1,121 @@
+"""EXPLAIN PLAN FOR on both engines (CalciteSqlParser explain + worker
+Explain parity): the v1 engine returns the [operator, operator_id,
+parent_id] tree of the fused program (or the host fallback with its reason);
+the v2 engine returns one row per stage with its distribution and plan."""
+
+import numpy as np
+import pytest
+
+from pinot_tpu.common import DataType, Schema
+from pinot_tpu.multistage import MultistageEngine
+from pinot_tpu.query import QueryEngine
+from pinot_tpu.segment import SegmentBuilder
+
+
+@pytest.fixture(scope="module")
+def setup():
+    rng = np.random.default_rng(61)
+    n = 1000
+    schema = Schema.build(
+        "t", dimensions=[("d", DataType.STRING)], metrics=[("v", DataType.LONG)]
+    )
+    data = {
+        "d": np.asarray(["a", "b"], dtype=object)[rng.integers(0, 2, n)],
+        "v": rng.integers(0, 100, n).astype(np.int64),
+    }
+    seg = SegmentBuilder(schema).build(data, "s0")
+    return QueryEngine([seg]), seg
+
+
+def test_explain_group_by(setup):
+    eng, _ = setup
+    res = eng.execute(
+        "EXPLAIN PLAN FOR SELECT d, SUM(v), COUNT(*) FROM t WHERE v > 10 GROUP BY d"
+    )
+    assert res.columns == ["operator", "operator_id", "parent_id"]
+    ops = [r[0] for r in res.rows]
+    assert ops[0].startswith("BROKER_REDUCE")
+    assert any(o.startswith("DEVICE_FUSED_PROGRAM") for o in ops)
+    assert any(o.startswith("GROUP_BY") for o in ops)
+    assert any(o == "AGGREGATE_SUM" for o in ops)
+    assert any(o == "AGGREGATE_COUNT" for o in ops)
+    # parent ids form a tree rooted at -1
+    ids = {r[1] for r in res.rows}
+    assert all(r[2] in ids or r[2] == -1 for r in res.rows)
+
+
+def test_explain_host_fallback(setup):
+    eng, _ = setup
+    res = eng.execute("EXPLAIN PLAN FOR SELECT MODE(v) FROM t")
+    ops = [r[0] for r in res.rows]
+    assert any(o.startswith("HOST_EXECUTOR") for o in ops)
+
+
+def test_explain_selection(setup):
+    eng, _ = setup
+    res = eng.execute("EXPLAIN PLAN FOR SELECT d, v FROM t WHERE d = 'a' LIMIT 5")
+    ops = [r[0] for r in res.rows]
+    assert any(o.startswith("SELECT(") for o in ops)
+
+
+def test_explain_does_not_execute(setup):
+    eng, _ = setup
+    res = eng.execute("EXPLAIN PLAN FOR SELECT COUNT(*) FROM t")
+    assert all(isinstance(r[0], str) for r in res.rows)  # operators, not counts
+
+
+def test_explain_multistage(setup):
+    _, seg = setup
+    m = MultistageEngine({"t": [seg]}, n_workers=2)
+    res = m.execute(
+        "EXPLAIN PLAN FOR SELECT d, SUM(v) FROM t GROUP BY d ORDER BY d LIMIT 10"
+    )
+    assert res.columns[0] == "stage"
+    assert len(res.rows) >= 2  # root + at least one worker stage
+    plans = " ".join(r[4] for r in res.rows)
+    assert "Aggregate" in plans and "Scan" in plans
+    dists = {r[2] for r in res.rows}
+    assert "root" in dists
+
+
+def test_explain_startree_swap():
+    from pinot_tpu.common.config import IndexingConfig, StarTreeIndexConfig, TableConfig
+
+    rng = np.random.default_rng(67)
+    n = 1000
+    schema = Schema.build(
+        "s", dimensions=[("d", DataType.STRING)], metrics=[("v", DataType.LONG)]
+    )
+    cfg = TableConfig(
+        "s",
+        indexing=IndexingConfig(
+            star_tree_configs=[
+                StarTreeIndexConfig(dimensions_split_order=["d"], function_column_pairs=["SUM__v"])
+            ]
+        ),
+    )
+    data = {
+        "d": np.asarray(["a", "b"], dtype=object)[rng.integers(0, 2, n)],
+        "v": rng.integers(0, 100, n).astype(np.int64),
+    }
+    eng = QueryEngine([SegmentBuilder(schema, cfg).build(data, "st0")])
+    res = eng.execute("EXPLAIN PLAN FOR SELECT d, SUM(v) FROM s GROUP BY d")
+    ops = [r[0] for r in res.rows]
+    assert any(o.startswith("STARTREE_SWAP") for o in ops)
+
+
+def test_explain_rejected_by_broker():
+    from pinot_tpu.cluster import Broker, Controller, PropertyStore
+
+    broker = Broker(Controller(PropertyStore(), "/tmp/_explain_ds"))
+    with pytest.raises(Exception, match="EXPLAIN"):
+        broker.execute("EXPLAIN PLAN FOR SELECT COUNT(*) FROM t")
+
+
+def test_explain_parse_errors():
+    from pinot_tpu.query.sql import SqlParseError, parse_sql
+
+    with pytest.raises(SqlParseError):
+        parse_sql("EXPLAIN SELECT 1 FROM t")
+    stmt = parse_sql("EXPLAIN PLAN FOR SELECT COUNT(*) FROM t")
+    assert stmt.explain

@@ -78,6 +78,22 @@ def test_end_to_end_results_unchanged():
         assert got == int(mask.sum()), cond
 
 
+def test_big_int_literals_not_corrupted():
+    """Review r3: literals beyond 2^53 must not round-trip through float.
+    Single ranges keep the original predicate; unmergeable big-literal pairs
+    stay unmerged."""
+    big = (1 << 53) + 1
+    f = optimize_filter(_where(f"v >= {big} AND d = 'a'"))
+    comp = next(c for c in f.children if isinstance(c, Compare) and c.op == CompareOp.GTE)
+    assert comp.right.value == big and isinstance(comp.right.value, int)
+    f2 = optimize_filter(_where(f"v >= {big} AND v <= {big + 10}"))
+    lits = set()
+    for c in f2.children if isinstance(f2, And) else [f2]:
+        if isinstance(c, Compare):
+            lits.add(c.right.value)
+    assert lits == {big, big + 10}  # exact ints preserved, no merge
+
+
 def test_mv_ranges_never_merge():
     """Review r3: range merging on an MV column would be unsound — any-match
     lets DIFFERENT values of one doc satisfy each predicate."""
