@@ -285,6 +285,9 @@ def _idents_filter(f: ast.FilterExpr | None, out: set[str]) -> None:
             _idents_expr(v, out)
     elif isinstance(f, (ast.Like, ast.RegexpLike, ast.IsNull)):
         _idents_expr(f.expr, out)
+    elif isinstance(f, ast.DistinctFrom):
+        _idents_expr(f.left, out)
+        _idents_expr(f.right, out)
 
 
 def _statement_idents(stmt: ast.SelectStatement) -> set[str] | None:
@@ -413,6 +416,8 @@ def _strip_qualifiers(f, scan: Scan):
             return ast.RegexpLike(fix_e(x.expr), x.pattern)
         if isinstance(x, ast.IsNull):
             return ast.IsNull(fix_e(x.expr), x.negated)
+        if isinstance(x, ast.DistinctFrom):
+            return ast.DistinctFrom(fix_e(x.left), fix_e(x.right), x.negated)
         return x
 
     return fix_f(f)

@@ -196,6 +196,15 @@ def eval_filter(f: ast.FilterExpr, fields: list[L.Field], df: pd.DataFrame) -> n
         r = eval_expr(f.right, fields, df)
         with np.errstate(invalid="ignore"):
             return np.asarray(_CMPS[f.op](l.to_numpy(), r.to_numpy())).astype(bool)
+    if isinstance(f, ast.DistinctFrom):
+        l = eval_expr(f.left, fields, df)
+        r = eval_expr(f.right, fields, df)
+        nl = pd.isna(l).to_numpy()
+        nr = pd.isna(r).to_numpy()
+        with np.errstate(invalid="ignore"):
+            neq = np.asarray(l.to_numpy() != r.to_numpy(), dtype=bool)
+        m = (neq & ~nl & ~nr) | (nl ^ nr)
+        return ~m if f.negated else m
     if isinstance(f, ast.Between):
         v = eval_expr(f.expr, fields, df).to_numpy()
         lo = eval_expr(f.low, fields, df).to_numpy()

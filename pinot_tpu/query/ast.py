@@ -212,6 +212,19 @@ class IsNull(FilterExpr):
 
 
 @dataclass(frozen=True)
+class DistinctFrom(FilterExpr):
+    """Null-aware inequality: `a IS DISTINCT FROM b` is true when the values
+    differ OR exactly one side is null; never null itself."""
+
+    left: Expr
+    right: Expr
+    negated: bool = False  # negated => IS NOT DISTINCT FROM
+
+    def __str__(self) -> str:
+        return f"{self.left} IS {'NOT ' if self.negated else ''}DISTINCT FROM {self.right}"
+
+
+@dataclass(frozen=True)
 class And(FilterExpr):
     children: tuple[FilterExpr, ...]
 

@@ -338,6 +338,12 @@ def _filter_agg_scan(f: FilterExpr, out: dict[str, AggregationInfo]) -> None:
         _extract_aggs(f.expr, out)
     elif isinstance(f, (In, Like, RegexpLike, IsNull)):
         _extract_aggs(f.expr, out)
+    else:
+        from pinot_tpu.query.ast import DistinctFrom
+
+        if isinstance(f, DistinctFrom):
+            _extract_aggs(f.left, out)
+            _extract_aggs(f.right, out)
     # PredicateFunction args never contain aggregates (index probes only)
 
 
@@ -387,11 +393,14 @@ def _collect_filter_identifiers(f: FilterExpr | None, out: set[str]) -> None:
     elif isinstance(f, (Like, RegexpLike, IsNull)):
         _collect_identifiers(f.expr, out)
     else:
-        from pinot_tpu.query.ast import PredicateFunction
+        from pinot_tpu.query.ast import DistinctFrom, PredicateFunction
 
         if isinstance(f, PredicateFunction):
             for a in f.args:
                 _collect_identifiers(a, out)
+        elif isinstance(f, DistinctFrom):
+            _collect_identifiers(f.left, out)
+            _collect_identifiers(f.right, out)
 
 
 def expand_star(stmt: SelectStatement, schema) -> None:

@@ -282,6 +282,17 @@ def filter_mask(seg: ImmutableSegment, f: ast.FilterExpr | None) -> np.ndarray:
                 nulls = native.bm_to_bool(nv, n)
                 return ~nulls if f.negated else nulls
         return np.full(n, bool(f.negated))
+    if isinstance(f, ast.DistinctFrom):
+        l = eval_value(seg, f.left)
+        r = eval_value(seg, f.right)
+        nl = expr_null_mask(seg, f.left)
+        nr = expr_null_mask(seg, f.right)
+        nl = nl if nl is not None else np.zeros(n, dtype=bool)
+        nr = nr if nr is not None else np.zeros(n, dtype=bool)
+        with np.errstate(invalid="ignore"):
+            neq = np.asarray(l != r, dtype=bool)
+        m = (neq & ~nl & ~nr) | (nl ^ nr)
+        return ~m if f.negated else m
     if isinstance(f, ast.PredicateFunction):
         return predicate_function_mask(seg, f)
     raise PlanError(f"unsupported filter in host executor: {f}")

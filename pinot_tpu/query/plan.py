@@ -335,9 +335,34 @@ class _Lowering:
                     return self.docmask_spec(~nulls if f.negated else nulls)
             # no null vector (Pinot default null handling): IS NULL matches nothing
             return ("const", bool(f.negated))
+        if isinstance(f, ast.DistinctFrom):
+            return self._distinct_from(f)
         if isinstance(f, ast.PredicateFunction):
             return self._predicate_function(f)
         raise PlanError(f"unsupported filter: {f}")
+
+    def _distinct_from(self, f: "ast.DistinctFrom") -> tuple:
+        """IS [NOT] DISTINCT FROM: (l != r AND both non-null) OR (exactly one
+        null) — composed from the NEQ compare lowering plus null docmasks."""
+        from pinot_tpu.query.host_exec import expr_null_mask
+
+        neq = self._compare(ast.Compare(ast.CompareOp.NEQ, f.left, f.right))
+        nl = expr_null_mask(self.seg, f.left)
+        nr = expr_null_mask(self.seg, f.right)
+        if nl is None and nr is None:
+            spec = neq
+        else:
+            nl_spec = self.docmask_spec(nl) if nl is not None else ("const", False)
+            nr_spec = self.docmask_spec(nr) if nr is not None else ("const", False)
+            xor = (
+                "or",
+                (
+                    ("and", (nl_spec, ("not", nr_spec))),
+                    ("and", (nr_spec, ("not", nl_spec))),
+                ),
+            )
+            spec = ("or", (("and", (neq, ("not", nl_spec), ("not", nr_spec))), xor))
+        return ("not", spec) if f.negated else spec
 
     def _predicate_function(self, f: ast.PredicateFunction) -> tuple:
         from pinot_tpu.query.host_exec import predicate_function_mask

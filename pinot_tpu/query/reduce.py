@@ -137,6 +137,13 @@ def eval_having(f: ast.FilterExpr, env: dict[str, Any], aliases: dict[str, ast.E
         v = eval_scalar(f.expr, env, aliases)
         vals = {eval_scalar(x, env, aliases) for x in f.values}
         return (v not in vals) if f.negated else (v in vals)
+    if isinstance(f, ast.DistinctFrom):
+        l = eval_scalar(f.left, env, aliases)
+        r = eval_scalar(f.right, env, aliases)
+        ln = l is None or (isinstance(l, float) and l != l)
+        rn = r is None or (isinstance(r, float) and r != r)
+        m = (ln != rn) or (not ln and not rn and l != r)
+        return not m if f.negated else m
     raise ValueError(f"unsupported HAVING predicate: {f}")
 
 

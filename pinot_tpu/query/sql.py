@@ -469,6 +469,12 @@ class Parser:
             raise SqlParseError(f"expected BETWEEN/IN/LIKE after NOT at position {self.peek().pos}")
         if self.eat_kw("IS"):
             neg = self.eat_kw("NOT")
+            if self.eat_kw("DISTINCT"):
+                self.expect_kw("FROM")
+                right = self._expr()
+                from pinot_tpu.query.ast import DistinctFrom
+
+                return DistinctFrom(left, right, neg)
             self.expect_kw("NULL")
             return IsNull(left, neg)
         for sym, op in (

@@ -29,10 +29,27 @@ def _agg_arg_col(a: AggregationInfo) -> str | None:
     return "\x00not-a-column"  # never matches
 
 
+def _null_dependent(f) -> bool:
+    """Predicates whose truth depends on the NULL VECTOR (IS NULL /
+    IS DISTINCT FROM): the star table bakes nulls into placeholder values,
+    so these must run the per-doc path."""
+    if f is None:
+        return False
+    if isinstance(f, (ast.IsNull, ast.DistinctFrom)):
+        return True
+    if isinstance(f, (ast.And, ast.Or)):
+        return any(_null_dependent(c) for c in f.children)
+    if isinstance(f, ast.Not):
+        return _null_dependent(f.child)
+    return False
+
+
 def matches(ctx: QueryContext, st: StarTable) -> bool:
     if ctx.query_type not in (QueryType.AGGREGATION, QueryType.GROUP_BY):
         return False
     if not ctx.aggregations:
+        return False
+    if _null_dependent(ctx.filter):
         return False
     dims = set(st.dimensions)
     fcols: set[str] = set()

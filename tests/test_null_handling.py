@@ -314,6 +314,93 @@ def test_startree_bypassed_under_null_handling():
     assert got_def == pytest.approx(df_v.fillna(float(DT.LONG.default_null)).sum(), rel=1e-12)
 
 
+def test_is_distinct_from(setup, monkeypatch):
+    """IS [NOT] DISTINCT FROM: null-aware inequality on device and host.
+    Null rows ARE distinct from any literal; two non-null values compare
+    normally."""
+    eng, df, nn = setup
+    some_v = int(df.v.dropna().iloc[0])
+    q = f"SELECT COUNT(*) FROM t WHERE v IS DISTINCT FROM {some_v}"
+    got = eng.execute(q).rows[0][0]
+    want = int((df.v.isna() | (df.v != some_v)).sum())
+    assert got == want
+    q2 = f"SELECT COUNT(*) FROM t WHERE v IS NOT DISTINCT FROM {some_v}"
+    got2 = eng.execute(q2).rows[0][0]
+    assert got2 == int((df.v == some_v).sum())
+    assert got + got2 == len(df)  # the predicate is never null itself
+
+    from pinot_tpu.query import plan as plan_mod
+
+    def no_device(*a, **k):
+        raise plan_mod.DeviceFallback("forced host")
+
+    h_eng = QueryEngine(eng.segments)
+    monkeypatch.setattr("pinot_tpu.query.engine.plan_segment", no_device)
+    assert h_eng.execute(q).rows[0][0] == got
+    assert h_eng.execute(q2).rows[0][0] == got2
+
+
+def test_is_distinct_from_two_columns(setup):
+    eng, df, nn = setup
+    got = eng.execute("SELECT COUNT(*) FROM t WHERE v IS DISTINCT FROM x").rows[0][0]
+    # both columns share the same null rows in this fixture: both-null rows
+    # are NOT distinct; value rows distinct when v != x
+    both = df.v.notna() & df.x.notna()
+    want = int((both & (df.v != df.x)).sum() + (df.v.isna() ^ df.x.isna()).sum())
+    assert got == want
+
+
+def test_is_distinct_from_having_and_v2_join(setup):
+    """Review r3: DISTINCT FROM must work in HAVING (v1 reduce) and as a
+    cross-table v2 predicate (identifier collection + qualifier stripping)."""
+    eng, df, nn = setup
+    res = eng.execute(
+        "SELECT g, COUNT(*) FROM t GROUP BY g HAVING COUNT(*) IS DISTINCT FROM 0 ORDER BY g LIMIT 10"
+    )
+    assert len(res.rows) == df.g.nunique()
+
+    from pinot_tpu.multistage import MultistageEngine
+
+    m = MultistageEngine({"t": eng.segments}, n_workers=2)
+    got = m.execute(
+        "SELECT COUNT(*) FROM t a JOIN t b ON a.g = b.g WHERE a.v IS DISTINCT FROM b.v LIMIT 5"
+    )
+    assert isinstance(got.rows[0][0], int)
+
+
+def test_startree_not_used_for_null_dependent_filters():
+    """Review r3: IS NULL / IS DISTINCT FROM filters must bypass the
+    star-tree swap (nulls are baked into placeholder rows there)."""
+    from pinot_tpu.common.config import StarTreeIndexConfig
+
+    rng = np.random.default_rng(71)
+    n = 2000
+    schema = Schema.build(
+        "sd", dimensions=[("d", DataType.STRING)], metrics=[("v", DataType.LONG)]
+    )
+    d = np.asarray(["a", "b"], dtype=object)[rng.integers(0, 2, n)].astype(object)
+    nulls = rng.random(n) < 0.3
+    d[nulls] = None
+    cfg = TableConfig(
+        "sd",
+        indexing=IndexingConfig(
+            null_handling=True,
+            star_tree_configs=[
+                StarTreeIndexConfig(dimensions_split_order=["d"], function_column_pairs=["SUM__v"])
+            ],
+        ),
+    )
+    v = rng.integers(1, 50, n).astype(np.int64)
+    seg = SegmentBuilder(schema, cfg).build({"d": d, "v": v}, "sd0")
+    eng = QueryEngine([seg])
+    got = eng.execute("SELECT SUM(v) FROM sd WHERE d IS DISTINCT FROM 'a'").rows[0][0]
+    is_a = np.asarray([x == "a" for x in d])
+    want = float(v[~is_a].sum())  # null rows ARE distinct from 'a'
+    assert got == pytest.approx(want)
+    got2 = eng.execute("SELECT SUM(v) FROM sd WHERE d IS NULL").rows[0][0]
+    assert got2 == pytest.approx(float(v[nulls].sum()))
+
+
 def test_variance_ext_agg_skips_nulls(setup):
     eng, df, nn = setup
     got = eng.execute(SET_ON + "SELECT VAR_POP(x) FROM t").rows[0][0]
