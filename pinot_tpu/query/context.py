@@ -31,6 +31,7 @@ from pinot_tpu.query.ast import (
     Like,
     RegexpLike,
     IsNull,
+    DistinctFrom,
 )
 from pinot_tpu.query.sql import parse_sql
 
@@ -338,12 +339,9 @@ def _filter_agg_scan(f: FilterExpr, out: dict[str, AggregationInfo]) -> None:
         _extract_aggs(f.expr, out)
     elif isinstance(f, (In, Like, RegexpLike, IsNull)):
         _extract_aggs(f.expr, out)
-    else:
-        from pinot_tpu.query.ast import DistinctFrom
-
-        if isinstance(f, DistinctFrom):
-            _extract_aggs(f.left, out)
-            _extract_aggs(f.right, out)
+    elif isinstance(f, DistinctFrom):
+        _extract_aggs(f.left, out)
+        _extract_aggs(f.right, out)
     # PredicateFunction args never contain aggregates (index probes only)
 
 
@@ -392,15 +390,15 @@ def _collect_filter_identifiers(f: FilterExpr | None, out: set[str]) -> None:
         _collect_identifiers(f.expr, out)
     elif isinstance(f, (Like, RegexpLike, IsNull)):
         _collect_identifiers(f.expr, out)
+    elif isinstance(f, DistinctFrom):
+        _collect_identifiers(f.left, out)
+        _collect_identifiers(f.right, out)
     else:
-        from pinot_tpu.query.ast import DistinctFrom, PredicateFunction
+        from pinot_tpu.query.ast import PredicateFunction
 
         if isinstance(f, PredicateFunction):
             for a in f.args:
                 _collect_identifiers(a, out)
-        elif isinstance(f, DistinctFrom):
-            _collect_identifiers(f.left, out)
-            _collect_identifiers(f.right, out)
 
 
 def expand_star(stmt: SelectStatement, schema) -> None:

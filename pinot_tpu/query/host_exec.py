@@ -540,6 +540,23 @@ def _null_doc_mask(seg: ImmutableSegment, a) -> "np.ndarray | None":
     return nulls
 
 
+def _nan_mask_values(v: np.ndarray, excluded: np.ndarray, func: str) -> np.ndarray:
+    """Substitute excluded rows with NaN/None so pandas reducers skip them.
+    Strings and exactness-critical big-int distinct funcs use object/None (a
+    float64 cast would collapse int identities above 2^53)."""
+    exact_ints = (
+        v.dtype.kind in "iu"
+        and len(v)
+        and (int(v.min()) < -(1 << 53) or int(v.max()) > (1 << 53))
+        and (func.startswith("distinct") or func in ("idset", "mode", "sumprecision"))
+    )
+    if v.dtype == object or v.dtype.kind in "US" or exact_ints:
+        v = v.astype(object)
+        v[excluded] = None
+        return v
+    return np.where(excluded, np.nan, v.astype(np.float64))
+
+
 def agg_partials(seg: ImmutableSegment, ctx: QueryContext, query_mask: np.ndarray) -> list:
     from pinot_tpu.query.aggregates import EXT_AGGS
     from pinot_tpu.query.context import null_handling_enabled
@@ -649,7 +666,7 @@ def group_frame(seg: ImmutableSegment, ctx: QueryContext, mask: np.ndarray) -> p
     null_aggs: set[int] = set()  # agg indices with null rows substituted
     for i, a in enumerate(ctx.aggregations):
         if a.filter is not None:
-            if a.func not in filtered_ok:
+            if a.func in _MV_AGGS or a.func in _funnel_mod().FUNNEL_AGGS:
                 raise PlanError(f"FILTER(WHERE) on {a.func} inside GROUP BY is not supported")
             data[f"f{i}"] = filter_mask(seg, a.filter)[mask]
         if a.func == "count":
@@ -674,10 +691,14 @@ def group_frame(seg: ImmutableSegment, ctx: QueryContext, mask: np.ndarray) -> p
         if a.func == "distinctcounttheta" and a.extra:
             # filtered sketches per group: one bool column per filter clause;
             # the group apply below builds a ("multi", [sketch...]) partial the
-            # shared _theta_merge_any/_theta_finalize_any reducers understand
+            # shared _theta_merge_any/_theta_finalize_any reducers understand.
+            # A FILTER(WHERE) clause intersects every sketch mask.
             fmasks = _theta_filter_masks(seg, a.extra)
             for j, fm in enumerate(fmasks):
-                data[f"tf{i}_{j}"] = fm[mask]
+                fmm = fm[mask]
+                if a.filter is not None:
+                    fmm = fmm & data[f"f{i}"]
+                data[f"tf{i}_{j}"] = fmm
             theta_nf[i] = len(fmasks)
             data[f"v{i}"] = eval_value(seg, a.arg)[mask]
             continue
@@ -696,26 +717,17 @@ def group_frame(seg: ImmutableSegment, ctx: QueryContext, mask: np.ndarray) -> p
             continue
         v = eval_value(seg, a.arg)[mask]
         if a.filter is not None:
-            # excluded docs become NaN; pandas reducers skip them and the
-            # empty-group defaults are patched to match the device kernel
-            v = np.where(data[f"f{i}"], v.astype(np.float64), np.nan)
+            # excluded docs become NaN/None; pandas reducers skip them and
+            # the empty-group defaults are patched to match the device kernel
+            v = _nan_mask_values(v, ~data[f"f{i}"], a.func)
+            if a.func not in filtered_ok:
+                # non-core functions (distinctcount/percentile/mode/EXT/...)
+                # reuse the NaN-skipping reducers the null-handling path added
+                null_aggs.add(i)
         if null_on:
             nulls = _null_doc_mask(seg, a)
             if nulls is not None and nulls.any():
-                nm = nulls[mask]
-                exact_ints = (
-                    v.dtype.kind in "iu"
-                    and len(v)
-                    and (int(v.min()) < -(1 << 53) or int(v.max()) > (1 << 53))
-                    and (a.func.startswith("distinct") or a.func in ("idset", "mode", "sumprecision"))
-                )
-                if v.dtype == object or v.dtype.kind in "US" or exact_ints:
-                    # object cells keep exact int identity (a float64 cast
-                    # would collapse distinct values above 2^53)
-                    v = v.astype(object)
-                    v[nm] = None
-                else:
-                    v = np.where(nm, np.nan, v.astype(np.float64))
+                v = _nan_mask_values(v, nulls[mask], a.func)
                 null_aggs.add(i)
         data[f"v{i}"] = v
         if a.arg2 is not None:

@@ -60,6 +60,9 @@ def matches(ctx: QueryContext, st: StarTable) -> bool:
         if not isinstance(g, ast.Identifier) or g.name not in dims:
             return False
     for a in ctx.aggregations:
+        if a.filter is not None:
+            # FILTER(WHERE ...) cannot be applied to pre-aggregated rows
+            return False
         col = _agg_arg_col(a)
         if col == "\x00not-a-column":
             return False

@@ -150,6 +150,39 @@ def test_stunion_points():
     assert got == "MULTIPOINT ((0 0), (1 2), (3 4))"
 
 
+# -- FILTER(WHERE) on non-core aggregations inside GROUP BY -------------------
+
+
+def test_filtered_ext_aggs_in_group_by(setup):
+    """FILTER(WHERE ...) now works for distinctcount/percentile/EXT/theta
+    aggregations inside GROUP BY (was a PlanError; round-3 close)."""
+    engine, t = setup
+    res = engine.execute(
+        "SELECT g, DISTINCTCOUNT(k) FILTER (WHERE v > 10), "
+        "VAR_POP(x) FILTER (WHERE v <= 10), "
+        "PERCENTILE(x, 50) FILTER (WHERE k < 250) "
+        "FROM m GROUP BY g ORDER BY g LIMIT 10"
+    )
+    for g, dc, vp, p50 in res.rows:
+        sub = t[t.g == g]
+        assert dc == sub[sub.v > 10].k.nunique(), g
+        lo = sub[sub.v <= 10].x
+        assert vp == pytest.approx(lo.var(ddof=0), rel=1e-9), g
+        ks = np.sort(sub[sub.k < 250].x.to_numpy())
+        assert p50 == pytest.approx(ks[int((len(ks) - 1) * 0.5)]), g
+
+
+def test_filtered_theta_groupby(setup):
+    engine, t = setup
+    res = engine.execute(
+        "SELECT g, DISTINCTCOUNTTHETASKETCH(k, 'v > 10', 'v <= 10', "
+        "'SET_UNION($1,$2)') FILTER (WHERE k < 400) FROM m GROUP BY g ORDER BY g LIMIT 10"
+    )
+    for g, n in res.rows:
+        sub = t[(t.g == g) & (t.k < 400)]
+        assert n == sub.k.nunique(), g
+
+
 # -- MV variants --------------------------------------------------------------
 
 

@@ -401,6 +401,50 @@ def test_startree_not_used_for_null_dependent_filters():
     assert got2 == pytest.approx(float(v[nulls].sum()))
 
 
+def test_startree_rejects_agg_filter():
+    """Review r3: star-tree pre-aggregated rows cannot apply per-agg
+    FILTER(WHERE); the swap must bail to the per-doc path."""
+    from pinot_tpu.common.config import StarTreeIndexConfig
+
+    rng = np.random.default_rng(73)
+    n = 2000
+    schema = Schema.build(
+        "sf", dimensions=[("d", DataType.STRING)], metrics=[("v", DataType.LONG)]
+    )
+    cfg = TableConfig(
+        "sf",
+        indexing=IndexingConfig(
+            star_tree_configs=[
+                StarTreeIndexConfig(dimensions_split_order=["d"], function_column_pairs=["SUM__v"])
+            ]
+        ),
+    )
+    d = np.asarray(["a", "b"], dtype=object)[rng.integers(0, 2, n)]
+    v = rng.integers(1, 50, n).astype(np.int64)
+    eng = QueryEngine([SegmentBuilder(schema, cfg).build({"d": d, "v": v}, "sf0")])
+    got = eng.execute("SELECT SUM(v) FILTER (WHERE d = 'a') FROM sf").rows[0][0]
+    assert got == pytest.approx(float(v[d == "a"].sum()))
+
+
+def test_filtered_distinctcount_big_ints():
+    """Review r3: FILTER substitution must not collapse int64 identities
+    above 2^53."""
+    schema = Schema.build("bb", dimensions=[("g", DataType.STRING)], metrics=[("v", DataType.LONG)])
+    big = 1 << 53
+    v = np.asarray([big, big + 1, big + 2, big + 1], dtype=np.int64)
+    g = np.asarray(["a", "a", "a", "a"], dtype=object)
+    k = np.asarray([1, 1, 0, 1], dtype=np.int64)
+    schema2 = Schema.build(
+        "bb", dimensions=[("g", DataType.STRING)], metrics=[("v", DataType.LONG), ("k", DataType.LONG)]
+    )
+    seg = SegmentBuilder(schema2).build({"g": g, "v": v, "k": k}, "bb0")
+    eng = QueryEngine([seg])
+    res = eng.execute(
+        "SELECT g, DISTINCTCOUNT(v) FILTER (WHERE k = 1) FROM bb GROUP BY g LIMIT 10"
+    )
+    assert res.rows == [["a", 2]]  # big and big+1; big+2 filtered out
+
+
 def test_variance_ext_agg_skips_nulls(setup):
     eng, df, nn = setup
     got = eng.execute(SET_ON + "SELECT VAR_POP(x) FROM t").rows[0][0]
