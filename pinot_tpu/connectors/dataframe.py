@@ -24,16 +24,37 @@ def read_table(
     table: str,
     columns: list[str] | None = None,
     parallelism: int = 4,
+    where: str | None = None,
 ) -> pd.DataFrame:
-    """Full-table scan into a DataFrame, one task per segment."""
+    """Table scan into a DataFrame, one task per segment. `where` pushes a
+    SQL predicate into each segment scan (the reference Spark connector's
+    filter pushdown): bloom/min-max pruning skips whole segments, and only
+    matching rows materialize."""
+    from pinot_tpu.query.sql import parse_sql
     from pinot_tpu.segment.loader import load_segment
+
+    pred = parse_sql(f"SELECT * FROM _t WHERE {where}").where if where else None
 
     meta = controller.all_segment_metadata(table)
     locations = [m["location"] for _, m in sorted(meta.items()) if m.get("location")]
 
     def one(loc: str) -> pd.DataFrame:
+        from pinot_tpu.query import host_exec, pruner
+
         seg = load_segment(loc)
         cols = columns or list(seg.columns)
+        if pred is not None:
+            if not pruner.filter_can_match(seg, pred):
+                # empty frames keep real column dtypes: a default float64
+                # empty column would widen int64 ids across the concat
+                def _empty(ci):
+                    if ci.is_mv or ci.data_type.value in ("STRING", "JSON", "BYTES"):
+                        return np.empty(0, dtype=object)
+                    return np.empty(0, dtype=ci.data_type.np_dtype)
+
+                return pd.DataFrame({c: _empty(seg.columns[c]) for c in cols})
+            mask = host_exec.filter_mask(seg, pred)
+            return pd.DataFrame({c: seg.columns[c].materialize()[mask] for c in cols})
         return pd.DataFrame({c: seg.columns[c].materialize() for c in cols})
 
     if not locations:

@@ -542,19 +542,29 @@ def _null_doc_mask(seg: ImmutableSegment, a) -> "np.ndarray | None":
 
 def _nan_mask_values(v: np.ndarray, excluded: np.ndarray, func: str) -> np.ndarray:
     """Substitute excluded rows with NaN/None so pandas reducers skip them.
-    Strings and exactness-critical big-int distinct funcs use object/None (a
-    float64 cast would collapse int identities above 2^53)."""
-    exact_ints = (
-        v.dtype.kind in "iu"
-        and len(v)
-        and (int(v.min()) < -(1 << 53) or int(v.max()) > (1 << 53))
-        and (func.startswith("distinct") or func in ("idset", "mode", "sumprecision"))
+    Strings and identity-sensitive functions keep object/None cells: a
+    float64 cast would collapse int values above 2^53 AND change the hash
+    bit-pattern HLL/theta sketches use (device partials hash the INT
+    pattern — a float-hashed host partial would double-count on merge)."""
+    identity = v.dtype.kind in "iu" and (
+        func.startswith("distinct") or func in ("idset", "mode", "sumprecision")
     )
-    if v.dtype == object or v.dtype.kind in "US" or exact_ints:
+    if v.dtype == object or v.dtype.kind in "US" or identity:
         v = v.astype(object)
         v[excluded] = None
         return v
     return np.where(excluded, np.nan, v.astype(np.float64))
+
+
+def _dropna_typed(s: "pd.Series") -> np.ndarray:
+    """dropna() that restores int64 dtype for object cells holding ints —
+    hash-based sketches must see the original integer bit patterns."""
+    s2 = s.dropna()
+    if s2.dtype == object and len(s2):
+        first = s2.iloc[0]
+        if isinstance(first, (int, np.integer)) and not isinstance(first, bool):
+            return s2.to_numpy().astype(np.int64)
+    return s2.to_numpy()
 
 
 def agg_partials(seg: ImmutableSegment, ctx: QueryContext, query_mask: np.ndarray) -> list:
@@ -845,7 +855,7 @@ def group_frame(seg: ImmutableSegment, ctx: QueryContext, mask: np.ndarray) -> p
 
             out[f"a{i}p0"] = g[f"v{i}"].apply(
                 lambda s, _na=(i in null_aggs): np_hll_registers(
-                    (s.dropna() if _na else s).to_numpy()
+                    _dropna_typed(s) if _na else s.to_numpy()
                 )
             ).values
         elif a.func == "percentileest" and ctx.hints.get("est_bounds", {}).get(a.name):
@@ -906,7 +916,7 @@ def group_frame(seg: ImmutableSegment, ctx: QueryContext, mask: np.ndarray) -> p
             else:
                 parts = g[f"v{i}"].apply(
                     lambda s, _s=spec, _a=a, _na=na: _s.compute(
-                        (s.dropna() if _na else s).to_numpy(), None, _a.extra
+                        _dropna_typed(s) if _na else s.to_numpy(), None, _a.extra
                     )
                 )
             out[f"a{i}p0"] = parts.values

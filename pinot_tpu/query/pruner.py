@@ -67,18 +67,24 @@ def _geo_rejects(seg: ImmutableSegment, f: ast.FilterExpr | None) -> bool:
     return False
 
 
-def can_match(seg: ImmutableSegment, ctx: QueryContext) -> bool:
+def filter_can_match(seg: ImmutableSegment, f: "ast.FilterExpr | None") -> bool:
+    """Segment-level pruning for a bare filter tree (min-max stats, bloom,
+    geo bbox) — shared by query execution and connector pushdown scans."""
     from pinot_tpu.cluster.routing import segment_can_match
 
     if seg.n_docs == 0:
         return False
-    if not segment_can_match(ctx.filter, _stats_map(seg)):
+    if not segment_can_match(f, _stats_map(seg)):
         return False
-    if _bloom_rejects(seg, ctx.filter):
+    if _bloom_rejects(seg, f):
         return False
-    if _geo_rejects(seg, ctx.filter):
+    if _geo_rejects(seg, f):
         return False
     return True
+
+
+def can_match(seg: ImmutableSegment, ctx: QueryContext) -> bool:
+    return filter_can_match(seg, ctx.filter)
 
 
 def empty_partial(ctx: QueryContext):

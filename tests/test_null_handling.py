@@ -445,6 +445,34 @@ def test_filtered_distinctcount_big_ints():
     assert res.rows == [["a", 2]]  # big and big+1; big+2 filtered out
 
 
+def test_filtered_hll_hash_parity():
+    """Review r3: filtered HLL host partials must hash the ORIGINAL int bit
+    patterns — a float64-masked column would land values in different
+    registers than the device path and double-count on merge."""
+    from pinot_tpu.query import host_exec
+    from pinot_tpu.query.context import QueryContext
+    from pinot_tpu.query.sketches import np_hll_registers
+
+    rng = np.random.default_rng(77)
+    n = 4000
+    schema = Schema.build(
+        "hp", dimensions=[("g", DataType.STRING)], metrics=[("v", DataType.LONG), ("k", DataType.LONG)]
+    )
+    data = {
+        "g": np.asarray(["a"], dtype=object)[np.zeros(n, dtype=int)],
+        "v": rng.integers(0, 3000, n).astype(np.int64),
+        "k": rng.integers(0, 2, n).astype(np.int64),
+    }
+    seg = SegmentBuilder(schema).build(data, "hp0")
+    ctx = QueryContext.from_sql(
+        "SELECT g, DISTINCTCOUNTHLL(v) FILTER (WHERE k = 1) FROM hp GROUP BY g LIMIT 10"
+    )
+    frame = host_exec.group_frame(seg, ctx, np.ones(n, dtype=bool))
+    got_regs = frame["a0p0"].iloc[0]
+    want_regs = np_hll_registers(data["v"][data["k"] == 1])
+    np.testing.assert_array_equal(np.asarray(got_regs), np.asarray(want_regs))
+
+
 def test_variance_ext_agg_skips_nulls(setup):
     eng, df, nn = setup
     got = eng.execute(SET_ON + "SELECT VAR_POP(x) FROM t").rows[0][0]
