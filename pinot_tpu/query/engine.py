@@ -297,7 +297,14 @@ class QueryEngine:
         )
 
     def _host_segment(self, seg: ImmutableSegment, ctx: QueryContext, extra_mask=None):
-        mask = host_exec.filter_mask(seg, ctx.filter)
+        from pinot_tpu.query.context import null_handling_enabled
+
+        if null_handling_enabled(ctx.options):
+            # three-valued WHERE: predicates over null inputs are UNKNOWN,
+            # only definitely-true rows survive (Kleene combination)
+            mask = host_exec.filter_mask_null_aware(seg, ctx.filter)
+        else:
+            mask = host_exec.filter_mask(seg, ctx.filter)
         if extra_mask is not None:
             mask = mask & extra_mask
         matched = int(mask.sum())

@@ -540,6 +540,64 @@ def _null_doc_mask(seg: ImmutableSegment, a) -> "np.ndarray | None":
     return nulls
 
 
+def filter_mask_null_aware(seg: ImmutableSegment, f: "ast.FilterExpr | None") -> np.ndarray:
+    """Three-valued (Kleene) filter evaluation under enableNullHandling
+    (Pinot null-handling WHERE semantics): a predicate over a null input is
+    UNKNOWN, AND/OR/NOT combine by Kleene logic, and only definitely-TRUE
+    rows survive. IS NULL / IS [NOT] DISTINCT FROM are never unknown."""
+    t, _n = _filter3(seg, f)
+    return t
+
+
+def _filter3(seg: ImmutableSegment, f: "ast.FilterExpr | None") -> tuple:
+    """(true_mask, unknown_mask) pair for one filter node."""
+    n_docs = seg.n_docs
+    if f is None:
+        return np.ones(n_docs, dtype=bool), np.zeros(n_docs, dtype=bool)
+    if isinstance(f, ast.And):
+        t = np.ones(n_docs, dtype=bool)
+        u = np.zeros(n_docs, dtype=bool)
+        any_false = np.zeros(n_docs, dtype=bool)
+        for c in f.children:
+            ct, cu = _filter3(seg, c)
+            t &= ct
+            u |= cu
+            any_false |= ~ct & ~cu
+        return t, u & ~any_false  # Kleene AND: FALSE dominates UNKNOWN
+    if isinstance(f, ast.Or):
+        t = np.zeros(n_docs, dtype=bool)
+        u = np.zeros(n_docs, dtype=bool)
+        for c in f.children:
+            ct, cu = _filter3(seg, c)
+            t |= ct
+            u |= cu
+        return t, u & ~t  # Kleene OR: TRUE dominates UNKNOWN
+    if isinstance(f, ast.Not):
+        ct, cu = _filter3(seg, f.child)
+        return ~ct & ~cu, cu  # NOT(unknown) = unknown
+    if isinstance(f, (ast.IsNull, ast.DistinctFrom)):
+        return filter_mask(seg, f), np.zeros(n_docs, dtype=bool)  # never unknown
+    # leaf predicate: unknown wherever ANY referenced column is null
+    # (tested expression, BETWEEN bounds, IN values, predicate args)
+    from pinot_tpu.query.context import _collect_filter_identifiers
+
+    t = filter_mask(seg, f)
+    refs: set[str] = set()
+    _collect_filter_identifiers(f, refs)
+    nulls = None
+    for name in refs:
+        nv = (seg.extras or {}).get("null", {}).get(name)
+        if nv is None:
+            continue
+        from pinot_tpu.native import bm_to_bool
+
+        b = bm_to_bool(nv, n_docs)
+        nulls = b if nulls is None else (nulls | b)
+    if nulls is None or not nulls.any():
+        return t, np.zeros(n_docs, dtype=bool)
+    return t & ~nulls, nulls
+
+
 def _nan_mask_values(v: np.ndarray, excluded: np.ndarray, func: str) -> np.ndarray:
     """Substitute excluded rows with NaN/None so pandas reducers skip them.
     Strings and identity-sensitive functions keep object/None cells: a
@@ -575,7 +633,13 @@ def agg_partials(seg: ImmutableSegment, ctx: QueryContext, query_mask: np.ndarra
     out = []
     for a in ctx.aggregations:
         # FILTER (WHERE ...) intersects into the query mask per aggregation
-        mask = query_mask if a.filter is None else (query_mask & filter_mask(seg, a.filter))
+        # (Kleene evaluation under null handling, matching the WHERE clause)
+        if a.filter is None:
+            mask = query_mask
+        elif null_on:
+            mask = query_mask & filter_mask_null_aware(seg, a.filter)
+        else:
+            mask = query_mask & filter_mask(seg, a.filter)
         if null_on:
             nulls = _null_doc_mask(seg, a)
             if nulls is not None:
@@ -678,7 +742,12 @@ def group_frame(seg: ImmutableSegment, ctx: QueryContext, mask: np.ndarray) -> p
         if a.filter is not None:
             if a.func in _MV_AGGS or a.func in _funnel_mod().FUNNEL_AGGS:
                 raise PlanError(f"FILTER(WHERE) on {a.func} inside GROUP BY is not supported")
-            data[f"f{i}"] = filter_mask(seg, a.filter)[mask]
+            fmask = (
+                filter_mask_null_aware(seg, a.filter)
+                if null_on
+                else filter_mask(seg, a.filter)
+            )
+            data[f"f{i}"] = fmask[mask]
         if a.func == "count":
             # COUNT(col) under null handling counts non-null rows only
             if null_on and a.arg is not None:

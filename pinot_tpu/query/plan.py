@@ -1029,6 +1029,20 @@ def plan_segment(seg: ImmutableSegment, ctx: QueryContext, valid_mask=None) -> S
     already-materialized upsert validity snapshot (avoids computing the
     bitmap twice when lowering later falls back to the host path)."""
     lo = _Lowering(seg, ctx)
+    from pinot_tpu.query.context import null_handling_enabled as _nhe
+
+    if _nhe(ctx.options):
+        from pinot_tpu.query.context import _collect_filter_identifiers
+
+        refs: set[str] = set()
+        if ctx.filter is not None:
+            _collect_filter_identifiers(ctx.filter, refs)
+        for a in ctx.aggregations:
+            if a.filter is not None:
+                _collect_filter_identifiers(a.filter, refs)
+        if any((seg.extras or {}).get("null", {}).get(c) is not None for c in refs):
+            # three-valued WHERE/FILTER semantics run on the host executor
+            raise DeviceFallback("null-handling filter runs host-side (Kleene logic)")
     fspec = lo.filter_spec(ctx.filter)
 
     if valid_mask is None:

@@ -445,6 +445,51 @@ def test_filtered_distinctcount_big_ints():
     assert res.rows == [["a", 2]]  # big and big+1; big+2 filtered out
 
 
+def test_three_valued_where(setup):
+    """With enableNullHandling, WHERE predicates over null inputs are
+    UNKNOWN: excluded by themselves, excluded under NOT, recoverable via OR
+    with a TRUE branch, matched only by IS NULL."""
+    eng, df, nn = setup
+    nn_df = df[df.v.notna()]
+    # plain predicate: null rows never match
+    got = eng.execute(SET_ON + "SELECT COUNT(*) FROM t WHERE v < 1000").rows[0][0]
+    assert got == len(nn_df)  # all non-null v are < 1000; null rows excluded
+    # NOT(unknown) is still unknown: null rows excluded both ways
+    a = eng.execute(SET_ON + "SELECT COUNT(*) FROM t WHERE v > 50").rows[0][0]
+    b = eng.execute(SET_ON + "SELECT COUNT(*) FROM t WHERE NOT (v > 50)").rows[0][0]
+    assert a == int((nn_df.v > 50).sum())
+    assert b == int((nn_df.v <= 50).sum())
+    assert a + b == len(nn_df)  # null rows in NEITHER side
+    # OR with a definitely-true branch recovers the row
+    g0 = str(df.g.iloc[0])
+    got_or = eng.execute(
+        SET_ON + f"SELECT COUNT(*) FROM t WHERE v > 50 OR g = '{g0}'"
+    ).rows[0][0]
+    want_or = int(((df.v > 50) & df.v.notna() | (df.g == g0)).sum())
+    assert got_or == want_or
+    # IS NULL still matches null rows under Kleene evaluation
+    got_null = eng.execute(SET_ON + "SELECT COUNT(*) FROM t WHERE v IS NULL OR v > 50").rows[0][0]
+    assert got_null == int(df.v.isna().sum() + (nn_df.v > 50).sum())
+    # default mode unchanged: placeholder rows match ordinary predicates
+    got_def = eng.execute("SELECT COUNT(*) FROM t WHERE v < 1000").rows[0][0]
+    assert got_def == len(df)  # placeholder LONG_MIN < 1000 matches all
+
+
+def test_agg_filter_kleene(setup):
+    """Review r3: FILTER(WHERE ...) clauses evaluate with Kleene semantics
+    under null handling — null rows never match via their placeholder."""
+    eng, df, nn = setup
+    got = eng.execute(SET_ON + "SELECT COUNT(*) FILTER (WHERE v < 0) FROM t").rows[0][0]
+    assert got == 0  # placeholders (LONG_MIN) are null rows -> UNKNOWN
+    got2 = eng.execute(
+        SET_ON + "SELECT g, SUM(x) FILTER (WHERE v > 50) FROM t GROUP BY g ORDER BY g LIMIT 10"
+    )
+    sub = df[(df.v > 50) & df.v.notna()]
+    gb = sub.groupby("g")
+    for g, s in got2.rows:
+        assert s == pytest.approx(gb.x.sum()[g]), g
+
+
 def test_filtered_hll_hash_parity():
     """Review r3: filtered HLL host partials must hash the ORIGINAL int bit
     patterns — a float64-masked column would land values in different
