@@ -441,7 +441,7 @@ def _empty_df(n_cols: int) -> pd.DataFrame:
     return pd.DataFrame({i: pd.Series(dtype=object) for i in range(n_cols)})
 
 
-def _leaf_filter_mask(seg, filt) -> np.ndarray:
+def _leaf_filter_mask(seg, filt, null_on: bool = False) -> np.ndarray:
     """Leaf Scan filter on the fused device kernel (LeafStageTransferableBlock-
     Operator.java:87 parity: the v2 leaf runs the v1 engine's path). Falls
     back to the host numpy evaluator for host-only predicates; each side is
@@ -450,6 +450,14 @@ def _leaf_filter_mask(seg, filt) -> np.ndarray:
     from pinot_tpu.query.kernels import run_plan
     from pinot_tpu.query.plan import DeviceFallback, PlanError, plan_filter_mask
 
+    if null_on:
+        from pinot_tpu.query.context import _collect_filter_identifiers
+
+        refs: set = set()
+        _collect_filter_identifiers(filt, refs)
+        if any((seg.extras or {}).get("null", {}).get(c) is not None for c in refs):
+            # three-valued evaluation (same Kleene semantics as the v1 path)
+            return host_exec.filter_mask_null_aware(seg, filt)
     try:
         plan = plan_filter_mask(seg, filt)
         mask = np.asarray(run_plan(plan, seg.to_device_cached()))[: seg.n_docs]
@@ -471,19 +479,23 @@ def exec_node(node: L.Node, ctx: RunCtx) -> pd.DataFrame:
         return pd.concat(blocks, ignore_index=True)
 
     if isinstance(node, L.Scan):
+        from pinot_tpu.query.context import null_handling_enabled
+
+        null_on = null_handling_enabled(ctx.options)
         segs = ctx.segments.get(node.table, [])
         mine = segs if ctx.scan_local_all else segs[ctx.worker :: ctx.stage.parallelism]
         frames = []
         for seg in mine:
-            mask = _leaf_filter_mask(seg, node.filter) if node.filter is not None else None
+            mask = (
+                _leaf_filter_mask(seg, node.filter, null_on=null_on)
+                if node.filter is not None
+                else None
+            )
             valid = seg.extras.get("valid_docs")
             if valid is not None:
                 vm = valid(seg.n_docs)
                 mask = vm if mask is None else (mask & vm)
             data = {}
-            from pinot_tpu.query.context import null_handling_enabled
-
-            null_on = null_handling_enabled(ctx.options)
             for i, col in enumerate(node.columns):
                 v = seg.columns[col].materialize()
                 if null_on:

@@ -475,6 +475,19 @@ def test_three_valued_where(setup):
     assert got_def == len(df)  # placeholder LONG_MIN < 1000 matches all
 
 
+def test_v2_where_kleene(setup):
+    """v2 leaf WHERE filters over nullable columns use the same Kleene
+    evaluation as v1 (placeholder rows never match)."""
+    from pinot_tpu.multistage import MultistageEngine
+
+    eng, df, nn = setup
+    m = MultistageEngine({"t": eng.segments}, n_workers=2)
+    got = m.execute(SET_ON + "SELECT COUNT(*) FROM t WHERE v < 1000").rows[0][0]
+    assert got == int(df.v.notna().sum())
+    got2 = m.execute(SET_ON + "SELECT COUNT(*) FROM t WHERE NOT (v > 50)").rows[0][0]
+    assert got2 == int((df.v <= 50).sum())
+
+
 def test_agg_filter_kleene(setup):
     """Review r3: FILTER(WHERE ...) clauses evaluate with Kleene semantics
     under null handling — null rows never match via their placeholder."""
