@@ -456,7 +456,9 @@ def _leaf_filter_mask(seg, filt, null_on: bool = False) -> np.ndarray:
         refs: set = set()
         _collect_filter_identifiers(filt, refs)
         if any((seg.extras or {}).get("null", {}).get(c) is not None for c in refs):
-            # three-valued evaluation (same Kleene semantics as the v1 path)
+            # three-valued evaluation (same Kleene semantics as the v1 path);
+            # counts as a device fallback for path-assertion metrics
+            server_metrics().meter(ServerMeter.DEVICE_FALLBACKS).mark()
             return host_exec.filter_mask_null_aware(seg, filt)
     try:
         plan = plan_filter_mask(seg, filt)

@@ -486,6 +486,11 @@ def test_v2_where_kleene(setup):
     assert got == int(df.v.notna().sum())
     got2 = m.execute(SET_ON + "SELECT COUNT(*) FROM t WHERE NOT (v > 50)").rows[0][0]
     assert got2 == int((df.v <= 50).sum())
+    # a SELECTION drives the leaf Scan's _leaf_filter_mask Kleene branch
+    # (aggregations route through the leaf-partial engine path instead)
+    sel = m.execute(SET_ON + "SELECT v FROM t WHERE v < 1000 LIMIT 10000")
+    assert len(sel.rows) == int(df.v.notna().sum())
+    assert all(r[0] is not None for r in sel.rows)
 
 
 def test_agg_filter_kleene(setup):
