@@ -487,10 +487,15 @@ def test_v2_where_kleene(setup):
     got2 = m.execute(SET_ON + "SELECT COUNT(*) FROM t WHERE NOT (v > 50)").rows[0][0]
     assert got2 == int((df.v <= 50).sum())
     # a SELECTION drives the leaf Scan's _leaf_filter_mask Kleene branch
-    # (aggregations route through the leaf-partial engine path instead)
+    # (aggregations route through the leaf-partial engine path instead);
+    # the host fallback must mark DEVICE_FALLBACKS
+    from pinot_tpu.common.metrics import ServerMeter, server_metrics
+
+    before = server_metrics().meter(ServerMeter.DEVICE_FALLBACKS).count
     sel = m.execute(SET_ON + "SELECT v FROM t WHERE v < 1000 LIMIT 10000")
     assert len(sel.rows) == int(df.v.notna().sum())
     assert all(r[0] is not None for r in sel.rows)
+    assert server_metrics().meter(ServerMeter.DEVICE_FALLBACKS).count > before
 
 
 def test_agg_filter_kleene(setup):
