@@ -172,6 +172,54 @@ def bench_datatable_serde(n=200_000):
     }
 
 
+def bench_device_lexsort(n=4_000_000):
+    """Stable two-key device sort (v2 Sort node / window operator path) vs
+    pandas mergesort on the same keys."""
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(5)
+    k1 = rng.integers(0, 1000, n).astype(np.int64)
+    k2 = rng.normal(0, 1, n)
+    j1, j2 = jnp.asarray(k1), jnp.asarray(k2)
+    dev = _time_device(lambda: jnp.lexsort((j2, j1)))
+    import pandas as pd
+
+    df = pd.DataFrame({"a": k1, "b": k2})
+    host = _time_host(
+        lambda: df.sort_values(["a", "b"], kind="mergesort"), iters=3
+    )
+    return {"metric": "device_lexsort_2key", "value": dev, "unit": "ms", "n": n, "pandas_ms": round(host, 3)}
+
+
+def bench_device_lookup_join(n=4_000_000, dim=100_000):
+    """searchsorted probe against a unique sorted build side (v2 lookup-join
+    path) vs pandas hash merge."""
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(7)
+    probe = rng.integers(0, dim, n).astype(np.int64)
+    build = np.arange(dim, dtype=np.int64)
+    jp, jb = jnp.asarray(probe), jnp.asarray(build)
+
+    def probe_fn():
+        pos = jnp.clip(jnp.searchsorted(jb, jp), 0, dim - 1)
+        return jb[pos] == jp
+
+    dev = _time_device(probe_fn)
+    import pandas as pd
+
+    left = pd.DataFrame({"k": probe})
+    right = pd.DataFrame({"k": build, "v": build})
+    host = _time_host(lambda: left.merge(right, on="k", how="inner"), iters=3)
+    return {
+        "metric": "device_lookup_join_probe",
+        "value": dev,
+        "unit": "ms",
+        "n": n,
+        "pandas_merge_ms": round(host, 3),
+    }
+
+
 ALL = [
     bench_filter_mask,
     bench_grouped_sum_xla,
@@ -181,6 +229,8 @@ ALL = [
     bench_lz4_native,
     bench_query_e2e,
     bench_datatable_serde,
+    bench_device_lexsort,
+    bench_device_lookup_join,
 ]
 
 
