@@ -121,6 +121,31 @@ def test_distributed_join_filter_pushdown(dist_cluster):
     assert res.rows[0][0] == truth
 
 
+def test_plan_determinism_with_row_counts():
+    """The broker ships its row-count snapshot so every process rebuilds the
+    IDENTICAL plan — including the cost-based broadcast decision. Without the
+    shipped counts the server would pick hash-hash and the shuffle wiring
+    would disagree."""
+    from pinot_tpu.multistage import logical as L
+    from pinot_tpu.multistage.distributed import build_plan
+    from pinot_tpu.query.sql import parse_sql
+
+    schemas = {"fact": ["fid", "fdid", "val"], "dim": ["did", "dname"]}
+    rc = {"fact": 1_000_000, "dim": 500}
+    stmt = lambda: parse_sql(  # noqa: E731
+        "SELECT d.dname, SUM(f.val) FROM fact f JOIN dim d ON f.fdid = d.did GROUP BY d.dname"
+    )
+    broker_plan = build_plan(stmt(), schemas, 4, rc)
+    server_plan = build_plan(stmt(), schemas, 4, dict(rc))
+    b_dists = {sid: s.dist for sid, s in broker_plan.stages.items()}
+    s_dists = {sid: s.dist for sid, s in server_plan.stages.items()}
+    assert b_dists == s_dists
+    assert "broadcast" in b_dists.values()  # cost model engaged identically
+    # WITHOUT counts: a different (hash-hash) plan — shipping them matters
+    no_rc = build_plan(stmt(), schemas, 4, None)
+    assert "broadcast" not in {s.dist for s in no_rc.stages.values()}
+
+
 def test_envelope_roundtrip():
     from pinot_tpu.multistage import runtime as R
     from pinot_tpu.multistage.transport import decode_envelope, encode_envelope
