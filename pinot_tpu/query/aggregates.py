@@ -674,6 +674,69 @@ def _stunion_finalize(p, _extra):
     return "GEOMETRYCOLLECTION (" + ", ".join(vals) + ")"
 
 
+# -- array / list collection aggregations -------------------------------------
+# ArrayAgg / ListAgg (ARRAYAGG(col, 'dataType'[, distinct]), LISTAGG(col,
+# separator)): partial = python list of values, merged by concatenation.
+
+
+def _collect_compute(v, _v2, _extra):
+    return list(np.asarray(v).tolist())
+
+
+def _arrayagg_finalize(p, extra):
+    distinct = len(extra) > 1 and str(extra[1]).lower() in ("true", "1")
+    vals = list(dict.fromkeys(p)) if distinct else p
+    dt = str(extra[0]).upper() if extra else "DOUBLE"
+    if dt in ("INT", "LONG", "TIMESTAMP", "BOOLEAN"):
+        return [int(x) for x in vals]
+    if dt in ("FLOAT", "DOUBLE"):
+        return [float(x) for x in vals]
+    return [str(x) for x in vals]
+
+
+def _listagg_finalize(p, extra):
+    sep = str(extra[0]) if extra else ","
+    return sep.join(str(x) for x in p)
+
+
+# -- element-wise MV array sums ------------------------------------------------
+# SumArrayLong / SumArrayDouble: element-wise vector sum over an MV column;
+# shorter arrays pad with zero (the reference requires equal lengths).
+
+
+def _sumarray_compute(dtype):
+    def compute(v, _v2, _extra):
+        # int64 accumulation keeps long arithmetic exact (values above 2^53
+        # would lose precision in a float64 accumulator)
+        out = np.zeros(0, dtype=dtype)
+        for arr in v:
+            a = np.asarray(arr, dtype=dtype)
+            if len(a) > len(out):
+                out = np.pad(out, (0, len(a) - len(out)))
+            out[: len(a)] += a
+        return out
+
+    return compute
+
+
+def _sumarray_merge(a, b):
+    if len(a) < len(b):
+        a, b = b, a
+    a = a.copy()
+    a[: len(b)] += b.astype(a.dtype)
+    return a
+
+
+# -- fourth moment -------------------------------------------------------------
+# FourthMomentAggregationFunction: SQL FOURTHMOMENT(col) returns the central
+# fourth moment m4 = sum((x-mean)^4)/n (the building block kurtosis shares).
+
+
+def _m4_finalize(p, _extra):
+    n = p[0]
+    return float(p[4] / n) if n else float("nan")
+
+
 # -- sum with full precision -------------------------------------------------
 # SumPrecisionAggregationFunction: BigDecimal accumulation — python ints are
 # arbitrary precision, so integer inputs sum exactly; floats use math.fsum.
@@ -788,6 +851,32 @@ EXT_AGGS: dict[str, AggSpec] = {
         lambda e: np.zeros(0),
     ),
     "distinctcounttheta": AggSpec(1, _theta_compute, _theta_merge_any, _theta_finalize_any, lambda e: np.zeros(0, np.uint64)),
+    "arrayagg": AggSpec(1, _collect_compute, lambda a, b: a + b, _arrayagg_finalize, lambda e: []),
+    "listagg": AggSpec(1, _collect_compute, lambda a, b: a + b, _listagg_finalize, lambda e: []),
+    "sum0": AggSpec(
+        1,
+        lambda v, _v2, e: float(_f64(v).sum()),
+        lambda a, b: a + b,
+        lambda p, e: float(p),
+        lambda e: 0.0,  # Calcite SUM0: empty input -> 0, not null/default
+    ),
+    "sumarraylong": AggSpec(
+        1,
+        _sumarray_compute(np.int64),
+        _sumarray_merge,
+        lambda p, e: [int(x) for x in p],
+        lambda e: np.zeros(0, dtype=np.int64),
+    ),
+    "sumarraydouble": AggSpec(
+        1,
+        _sumarray_compute(np.float64),
+        _sumarray_merge,
+        lambda p, e: [float(x) for x in p],
+        lambda e: np.zeros(0, dtype=np.float64),
+    ),
+    "fourthmoment": AggSpec(
+        1, _moments_compute(4), _moments_merge, _m4_finalize, lambda e: (0.0,) * 5
+    ),
     "exprmin": AggSpec(2, _exprmm_compute(False), _exprmm_merge(False), _exprmm_finalize, lambda e: None),
     "exprmax": AggSpec(2, _exprmm_compute(True), _exprmm_merge(True), _exprmm_finalize, lambda e: None),
     "distinctcounttuplesketch": AggSpec(2, _tuple_compute, _tuple_merge, _tuple_distinct_finalize, _TUPLE_EMPTY),
@@ -808,6 +897,7 @@ EXT_AGGS: dict[str, AggSpec] = {
     "distinctcountrawcpcsketch": _RAW_HLL_SPEC,
     "distinctcounthllplus": _HLL_SPEC,
     "distinctcountcpc": _HLL_SPEC,
+    "distinctcountcpcsketch": _HLL_SPEC,  # SQL alias (DISTINCTCOUNTCPCSKETCH)
     "distinctcountull": _HLL_SPEC,
     "segmentpartitioneddistinctcount": AggSpec(1, _spdc_compute, lambda a, b: a + b, lambda p, e: int(p), lambda e: 0),
 }

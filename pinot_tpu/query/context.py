@@ -121,6 +121,13 @@ AGG_FUNCS = {
     "distinctcountrawhllplus",
     "distinctcountrawull",
     "distinctcountrawcpcsketch",
+    "distinctcountcpcsketch",
+    "arrayagg",
+    "listagg",
+    "sum0",
+    "sumarraylong",
+    "sumarraydouble",
+    "fourthmoment",
     # additional MV variants riding the MV-twin reduce machinery
     "percentileestmv",
     "percentiletdigestmv",
@@ -288,6 +295,13 @@ def _extract_aggs(expr: Expr, out: dict[str, AggregationInfo]) -> bool:
                     extra = tuple(
                         str(a.value) for a in expr.args[1:] if isinstance(a, Literal)
                     )
+                elif fname in ("arrayagg", "listagg"):
+                    # trailing literals: dataType[/distinct] or the separator
+                    extra = tuple(
+                        a.value for a in expr.args[1:] if isinstance(a, Literal)
+                    )
+                    if fname == "arrayagg" and not extra:
+                        raise ValueError("arrayagg requires (column, 'dataType'[, distinct]) arguments")
                 elif fname in ("frequentlongssketch", "frequentstringssketch"):
                     # optional maxMapSize literal (FrequentItems sketch size)
                     extra = (

@@ -150,6 +150,65 @@ def test_stunion_points():
     assert got == "MULTIPOINT ((0 0), (1 2), (3 4))"
 
 
+# -- collection / array / Calcite-surface aggregations ------------------------
+
+
+def test_arrayagg_listagg(setup):
+    engine, t = setup
+    got = one(engine, "SELECT ARRAYAGG(g, 'STRING', true) FROM m")
+    assert sorted(got) == sorted(t.g.unique().tolist())
+    got2 = one(engine, "SELECT LISTAGG(g, '|') FROM m WHERE k < 3")
+    want = t[t.k < 3].g.tolist()
+    assert sorted(got2.split("|")) == sorted(want)
+
+
+def test_sum0_empty_is_zero(setup):
+    engine, t = setup
+    assert one(engine, "SELECT SUM0(v) FROM m WHERE k < 0") == 0.0
+    assert one(engine, "SELECT SUM0(v) FROM m") == pytest.approx(float(t.v.sum()))
+
+
+def test_fourthmoment(setup):
+    engine, t = setup
+    x = t.x.to_numpy()
+    want = float(((x - x.mean()) ** 4).mean())
+    assert one(engine, "SELECT FOURTHMOMENT(x) FROM m") == pytest.approx(want, rel=1e-6)
+
+
+def test_sumarray(mv_setup):
+    eng, df = mv_setup
+    got = eng.execute("SELECT SUMARRAYLONG(nums) FROM t").rows[0][0]
+    maxlen = max((len(v) for v in df.nums), default=0)
+    want = np.zeros(maxlen)
+    for v in df.nums:
+        want[: len(v)] += np.asarray(v, dtype=np.float64)
+    assert got == [int(x) for x in want]
+
+
+def test_sumarraylong_exact_big_ints(mv_setup):
+    """Review r3: int64 accumulation — no float53 precision loss."""
+    from pinot_tpu.query.aggregates import EXT_AGGS
+
+    spec = EXT_AGGS["sumarraylong"]
+    v = np.empty(2, dtype=object)
+    v[:] = [[1 << 62, 1], [3, 1]]
+    p = spec.compute(v, None, ())
+    assert spec.finalize(p, ()) == [(1 << 62) + 3, 2]
+
+
+def test_arrayagg_requires_datatype(setup):
+    engine, _ = setup
+    with pytest.raises(ValueError, match="arrayagg requires"):
+        engine.execute("SELECT ARRAYAGG(g) FROM m")
+
+
+def test_cpcsketch_alias(setup):
+    engine, t = setup
+    a = one(engine, "SELECT DISTINCTCOUNTCPCSKETCH(k) FROM m")
+    b = one(engine, "SELECT DISTINCTCOUNTCPC(k) FROM m")
+    assert a == b
+
+
 # -- FILTER(WHERE) on non-core aggregations inside GROUP BY -------------------
 
 
