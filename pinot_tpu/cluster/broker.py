@@ -340,8 +340,25 @@ class Broker:
         return plan, self.controller.servers(), ideal, len(candidates), pruned
 
     def _scatter_leg(self, ctx: QueryContext, table: str, sql: str):
-        """Route + scatter one physical table: prune on stats/partitions,
-        select replicas (excluding failure-detected servers), fan out, retry
+        """Route + scatter one physical table, re-routing briefly when a
+        query lands exactly in a segment-rollover commit window (the routed
+        CONSUMING name is transiently unresolvable on a single replica —
+        SegmentCompletionManager's commit interval). Connection failures
+        fail over to other replicas inside the single attempt."""
+        last: RuntimeError | None = None
+        for attempt in range(4):
+            try:
+                return self._scatter_leg_once(ctx, table, sql)
+            except RuntimeError as e:
+                if "does not host segments" not in str(e):
+                    raise
+                last = e
+                time.sleep(0.05 * (attempt + 1))  # commit windows are short
+        raise last
+
+    def _scatter_leg_once(self, ctx: QueryContext, table: str, sql: str):
+        """One route + scatter pass: prune on stats/partitions, select
+        replicas (excluding failure-detected servers), fan out, retry
         connection failures on other replicas once. Returns
         (partials, scanned, num_segments_queried, num_segments_pruned)."""
         from pinot_tpu.cluster.routing import AdaptiveServerSelector

@@ -310,11 +310,17 @@ def _device_sort_perm(keys: list[np.ndarray], descs: list[bool]) -> "np.ndarray 
     return np.asarray(perm)
 
 
-def _device_lookup_join(lk: np.ndarray, rk: np.ndarray) -> "tuple[np.ndarray, np.ndarray] | None":
-    """Inner equi-join probe against a UNIQUE numeric right key (the
-    dimension/lookup-join case, LookupJoinOperator parity): sorted right keys
-    + device searchsorted + equality. Returns (left row mask, right row index
-    per matched left row), or None when the shape doesn't fit."""
+#: pair-count blowup guard for device equi-joins (many-to-many keys)
+DEVICE_JOIN_MAX_PAIRS = 1 << 25
+
+
+def _device_equi_join(lk: np.ndarray, rk: np.ndarray) -> "tuple[np.ndarray, np.ndarray] | None":
+    """General inner equi-join on a numeric key: device sort of the build
+    side + device searchsorted range probe, then one vectorized host
+    expansion of the match ranges. Handles duplicate build keys (the unique
+    case degenerates to ranges of width <= 1 — LookupJoinOperator's shape).
+    Returns (left row indices, right row indices) of matched pairs, or None
+    when dtypes/NaNs/pair-count don't fit."""
     import jax.numpy as jnp
 
     if not (np.issubdtype(lk.dtype, np.number) and np.issubdtype(rk.dtype, np.number)):
@@ -324,17 +330,23 @@ def _device_lookup_join(lk: np.ndarray, rk: np.ndarray) -> "tuple[np.ndarray, np
     ):
         return None
     if len(rk) == 0:
-        return np.zeros(len(lk), dtype=bool), np.zeros(0, dtype=np.int64)
+        return np.zeros(0, dtype=np.int64), np.zeros(0, dtype=np.int64)
     order = np.argsort(rk, kind="stable")
     srk = rk[order]
-    if len(srk) > 1 and (srk[1:] == srk[:-1]).any():
-        return None  # duplicate build keys: not a lookup join
     j_srk = jnp.asarray(srk)
     j_lk = jnp.asarray(lk)
-    pos = jnp.clip(jnp.searchsorted(j_srk, j_lk), 0, len(srk) - 1)
-    match = j_srk[pos] == j_lk
+    lo = np.asarray(jnp.searchsorted(j_srk, j_lk, side="left"))
+    hi = np.asarray(jnp.searchsorted(j_srk, j_lk, side="right"))
+    counts = hi - lo
+    total = int(counts.sum())
+    if total > DEVICE_JOIN_MAX_PAIRS:
+        return None  # many-to-many blowup: pandas hash join handles it
+    lidx = np.repeat(np.arange(len(lk), dtype=np.int64), counts)
+    starts = np.repeat(lo, counts)
+    offs = np.arange(total, dtype=np.int64) - np.repeat(np.cumsum(counts) - counts, counts)
+    ridx = order[starts + offs]
     DEVICE_OP_STATS["join"] += 1
-    return np.asarray(match), order[np.asarray(pos)]
+    return lidx, ridx
 
 
 # ---------------------------------------------------------------------------
@@ -914,16 +926,14 @@ def _exec_join(node: L.Join, ctx: RunCtx) -> pd.DataFrame:
             and len(l) >= DEVICE_JOIN_MIN
             and len(r)
         ):
-            # large probe side, single equi-key: try the device lookup-join
-            # (sorted-unique build keys + device searchsorted probe)
-            dev = _device_lookup_join(
-                l[keys[0]].to_numpy(), r[keys[0]].to_numpy()
-            )
+            # large probe side, single equi-key: device sort + range probe
+            # (general equi-join; unique build keys = the lookup-join shape)
+            dev = _device_equi_join(l[keys[0]].to_numpy(), r[keys[0]].to_numpy())
             if dev is not None:
-                lmask, ridx = dev
-                lmask = lmask & ~l_null
-                lm = l[lmask]
-                rm = r.iloc[ridx[lmask]]
+                lidx, ridx = dev
+                keep = ~l_null[lidx] if len(lidx) else np.zeros(0, dtype=bool)
+                lm = l.iloc[lidx[keep]]
+                rm = r.iloc[ridx[keep]]
                 rm.index = lm.index
                 m = pd.concat([lm[lcols], rm[rcols]], axis=1)
                 out = _positional(m)

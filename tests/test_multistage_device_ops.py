@@ -104,15 +104,32 @@ def test_device_join_group_by_oracle(setup):
     assert [float(r[1]) for r in res.rows] == [float(x) for x in want]
 
 
-def test_duplicate_build_keys_fall_back(setup):
-    """Self-join on a non-unique key must take the pandas hash-join path and
-    still be correct."""
+def test_duplicate_build_keys_device_join(setup):
+    """Self-join on a NON-unique key rides the general device equi-join
+    (sort + range probe + expansion) and matches the pandas oracle."""
     engine, fdf, ddf = setup
-    res = engine.execute(
-        "SELECT COUNT(*) FROM fact a JOIN fact b ON a.fdid = b.fdid WHERE a.val > 990"
-    )
-    m = fdf[fdf.val > 990].merge(fdf, on="fdid", how="inner")
+    before = runtime.DEVICE_OP_STATS["join"]
+    # no WHERE: the probe side must stay above DEVICE_JOIN_MIN (a pushed-down
+    # filter would shrink it below the device threshold)
+    res = engine.execute("SELECT COUNT(*) FROM fact a JOIN fact b ON a.fdid = b.fdid")
+    m = fdf.merge(fdf, on="fdid", how="inner")
     assert res.rows[0][0] == len(m)
+    assert runtime.DEVICE_OP_STATS["join"] > before
+
+
+def test_many_to_many_blowup_falls_back(setup, monkeypatch):
+    """A pair count past the guard falls back to the pandas hash join. No
+    WHERE: the probe must stay above DEVICE_JOIN_MIN so the guard itself
+    (not the size threshold) is what rejects the device path."""
+    engine, fdf, ddf = setup
+    pairs = len(fdf.merge(fdf, on="fdid", how="inner"))
+    # the join runs per worker over hash partitions: the cap must sit below
+    # EVERY worker's pair share, so use a tiny value
+    monkeypatch.setattr(runtime, "DEVICE_JOIN_MAX_PAIRS", 10)
+    before = runtime.DEVICE_OP_STATS["join"]
+    res = engine.execute("SELECT COUNT(*) FROM fact a JOIN fact b ON a.fdid = b.fdid")
+    assert res.rows[0][0] == pairs
+    assert runtime.DEVICE_OP_STATS["join"] == before  # guard engaged
 
 
 def test_cost_based_broadcast_join(setup):

@@ -171,25 +171,13 @@ def test_upsert_across_rollover(tmp_path):
                 break
             time.sleep(0.05)
 
-        def query_retrying(sql: str):
-            # a query landing exactly in a rollover commit window can see a
-            # transiently unresolvable consuming-segment name; retry briefly
-            # (the broker's replica failover covers this in multi-replica
-            # clusters — this single-server test rides the retry instead)
-            last: Exception | None = None
-            for _ in range(40):
-                try:
-                    return broker.execute(sql)
-                except RuntimeError as e:
-                    last = e
-                    time.sleep(0.05)
-            raise last
-
-        res = query_retrying("SELECT COUNT(*) FROM players")
+        # the broker re-routes queries landing in a rollover commit window
+        # (_scatter_leg retry), so plain queries are race-safe here
+        res = broker.execute("SELECT COUNT(*) FROM players")
         assert int(res.rows[0][0]) == 10
-        res = query_retrying("SELECT MAX(score) FROM players")
+        res = broker.execute("SELECT MAX(score) FROM players")
         assert int(res.rows[0][0]) == 1059
-        res = query_retrying("SELECT MIN(score) FROM players")
+        res = broker.execute("SELECT MIN(score) FROM players")
         assert int(res.rows[0][0]) == 1050
     finally:
         mgr.stop()
