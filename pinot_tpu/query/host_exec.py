@@ -470,13 +470,19 @@ def _mv_scalar_partial(func: str, flat: np.ndarray):
     return (float(v.sum()), int(len(v)))
 
 
-def _mv_doc_partials(func: str, ci, mask: np.ndarray) -> dict[str, np.ndarray]:
-    """Per-doc pre-aggregates for MV group-by (masked-doc aligned):
-    the group merge then only needs the SV twin's sum/min/max/union."""
+def _mv_doc_partials(
+    func: str, ci, mask: np.ndarray, value_mask: "np.ndarray | None" = None
+) -> dict[str, np.ndarray]:
+    """Per-doc pre-aggregates for MV group-by (masked-doc aligned): the
+    group merge then only needs the SV twin's sum/min/max/union. `value_mask`
+    (FILTER(WHERE) clauses) excludes a doc's VALUES while keeping its row
+    aligned with the frame — excluded docs contribute neutral partials."""
     n = len(ci.lens)
     docids = ci.flat_docids()
+    vm = value_mask if value_mask is not None else mask
     if func == "countmv":
-        return {"p0": ci.lens[mask].astype(np.int64)}
+        lens = ci.lens if value_mask is None else np.where(vm, ci.lens, 0)
+        return {"p0": lens[mask].astype(np.int64)}
     flat = _mv_flat_values(ci)
     if func in _MV_SET_AGGS or func in _MV_VALUES_AGGS or func in _MV_REG_AGGS:
         # build cells only for masked docs — a selective filter must not pay
@@ -486,11 +492,18 @@ def _mv_doc_partials(func: str, ci, mask: np.ndarray) -> dict[str, np.ndarray]:
         cells = np.empty(len(sel), dtype=object)
         off = ci.offsets()
         values_mode = func in _MV_VALUES_AGGS
+        empty_chunk = flat[:0]
         for i, d in enumerate(sel):
-            chunk = flat[off[d] : off[d + 1]]
+            chunk = flat[off[d] : off[d + 1]] if vm[d] else empty_chunk
             cells[i] = chunk.astype(np.float64) if values_mode else set(chunk.tolist())
         return {"p0": cells}
     v = flat.astype(np.float64)
+    if value_mask is not None:
+        # filtered: scatter only the included docs' values (the unfiltered
+        # path below keeps its zero-copy direct scatter)
+        vv = vm[docids]
+        docids = docids[vv]
+        v = v[vv]
     if func == "summv":
         s = np.zeros(n, dtype=np.float64)
         np.add.at(s, docids, v)
@@ -512,7 +525,8 @@ def _mv_doc_partials(func: str, ci, mask: np.ndarray) -> dict[str, np.ndarray]:
     # avgmv
     s = np.zeros(n, dtype=np.float64)
     np.add.at(s, docids, v)
-    return {"p0": s[mask], "p1": ci.lens[mask].astype(np.int64)}
+    lens = ci.lens if value_mask is None else np.where(vm, ci.lens, 0)
+    return {"p0": s[mask], "p1": lens[mask].astype(np.int64)}
 
 
 def _null_doc_mask(seg: ImmutableSegment, a) -> "np.ndarray | None":
@@ -740,7 +754,7 @@ def group_frame(seg: ImmutableSegment, ctx: QueryContext, mask: np.ndarray) -> p
     null_aggs: set[int] = set()  # agg indices with null rows substituted
     for i, a in enumerate(ctx.aggregations):
         if a.filter is not None:
-            if a.func in _MV_AGGS or a.func in _funnel_mod().FUNNEL_AGGS:
+            if a.func in _funnel_mod().FUNNEL_AGGS:
                 raise PlanError(f"FILTER(WHERE) on {a.func} inside GROUP BY is not supported")
             fmask = (
                 filter_mask_null_aware(seg, a.filter)
@@ -761,9 +775,11 @@ def group_frame(seg: ImmutableSegment, ctx: QueryContext, mask: np.ndarray) -> p
             continue
         if a.func in _MV_AGGS:
             # per-doc pre-aggregation over the flat layout; the group merge
-            # then reuses the SV twin's reducers (sum/min/max/union)
+            # then reuses the SV twin's reducers (sum/min/max/union).
+            # FILTER(WHERE) excludes values doc-wise via the value mask.
             ci = _mv_agg_column(seg, a)
-            for suffix, arr in _mv_doc_partials(a.func, ci, mask).items():
+            vmask = (fmask & mask) if a.filter is not None else None
+            for suffix, arr in _mv_doc_partials(a.func, ci, mask, vmask).items():
                 data[f"m{i}{suffix}"] = arr
             mv_docaggs[i] = True
             continue

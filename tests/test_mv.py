@@ -161,6 +161,32 @@ def test_mv_device_host_parity(setup, monkeypatch):
 # -- persistence + selection -------------------------------------------------
 
 
+def test_mv_agg_filter_in_group_by(setup, monkeypatch):
+    """FILTER(WHERE) on MV aggregations inside GROUP BY (round-3 close):
+    excluded docs contribute no values, device and host paths agree."""
+    eng, seg, df = setup
+    q = (
+        "SELECT year, SUMMV(nums) FILTER (WHERE year >= 2020), COUNTMV(tags) "
+        "FROM t GROUP BY year ORDER BY year LIMIT 10"
+    )
+    res = eng.execute(q)
+    for year, s, c in res.rows:
+        sub = df[df.year == year]
+        want_s = sum(sum(v) for v in sub[sub.year >= 2020].nums)
+        want_c = sum(len(v) for v in sub.tags)
+        assert s == pytest.approx(float(want_s)), year
+        assert c == want_c, year
+
+    from pinot_tpu.query import plan as plan_mod
+
+    def no_device(*a, **k):
+        raise plan_mod.DeviceFallback("forced host")
+
+    h_eng = QueryEngine([seg])
+    monkeypatch.setattr("pinot_tpu.query.engine.plan_segment", no_device)
+    assert h_eng.execute(q).rows == res.rows
+
+
 def test_mv_segment_roundtrip(tmp_path, setup):
     _, seg, df = setup
     for fmt in ("ptseg", "npz"):
