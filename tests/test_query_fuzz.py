@@ -162,6 +162,49 @@ def test_fuzz_selection_order_by(setup):
         assert got == pytest.approx(want, rel=1e-12), sql
 
 
+def test_fuzz_device_host_parity(setup, monkeypatch):
+    """Every random query runs twice — device-preferred and forced-host —
+    and must return byte-identical rows: the fused kernels and the numpy
+    interpreter are mutual oracles across random query shapes."""
+    from pinot_tpu.query import QueryEngine as QE
+    from pinot_tpu.query import plan as plan_mod
+
+    eng, df = setup
+    h_eng = QE(eng.segments)
+    rng = np.random.default_rng(23)
+    queries = []
+    for _ in range(25):
+        fsql, _ = _gen_filter(rng)
+        kind = rng.integers(0, 3)
+        if kind == 0:
+            picks = rng.choice(len(AGGS), size=2, replace=False)
+            queries.append(f"SELECT {', '.join(AGGS[i][0] for i in picks)} FROM f WHERE {fsql}")
+        elif kind == 1:
+            keys = [["d1"], ["d2", "k"]][rng.integers(0, 2)]
+            agg = AGGS[rng.integers(1, len(AGGS))][0]
+            queries.append(
+                f"SELECT {', '.join(keys)}, {agg} FROM f WHERE {fsql} "
+                f"GROUP BY {', '.join(keys)} ORDER BY {', '.join(keys)} LIMIT 300"
+            )
+        else:
+            queries.append(f"SELECT m1 FROM f WHERE {fsql} ORDER BY m1 LIMIT 25")
+    device_rows = [eng.execute(q).rows for q in queries]
+
+    def no_device(*a, **k):
+        raise plan_mod.DeviceFallback("forced host")
+
+    monkeypatch.setattr("pinot_tpu.query.engine.plan_segment", no_device)
+    for q, want in zip(queries, device_rows):
+        got = h_eng.execute(q).rows
+        assert len(got) == len(want), q
+        for rg, rw in zip(got, want):
+            for a, b in zip(rg, rw):
+                if isinstance(a, float) or isinstance(b, float):
+                    assert float(a) == pytest.approx(float(b), rel=1e-9), q
+                else:
+                    assert a == b, q
+
+
 def test_fuzz_distinct(setup):
     eng, df = setup
     rng = np.random.default_rng(19)
