@@ -6,17 +6,22 @@ Calcite's babel parser; here a hand-rolled parser covers the dialect the
 engine executes:
 
     [SET key = value ;]*
+    [EXPLAIN PLAN FOR]
     SELECT [DISTINCT] item [, item]*
-    FROM table
+    FROM relation (table | joins | subqueries — multistage engine)
     [WHERE boolfilter]
     [GROUP BY expr [, expr]*]
     [HAVING boolfilter]
     [ORDER BY expr [ASC|DESC] [, ...]]
     [LIMIT n [OFFSET m] | LIMIT m, n]
+    [UNION/INTERSECT/EXCEPT [ALL] select]*
 
-with arithmetic expressions, function calls (incl. COUNT(DISTINCT x)),
-BETWEEN / IN / LIKE / REGEXP_LIKE / IS [NOT] NULL predicates, quoted
-identifiers ("col" or `col`) and '' -escaped string literals.
+with arithmetic expressions, function calls (incl. COUNT(DISTINCT x),
+agg FILTER (WHERE ...), window functions OVER (...)), BETWEEN / IN / LIKE /
+REGEXP_LIKE / IS [NOT] NULL / IS [NOT] DISTINCT FROM predicates, CASE WHEN,
+GAPFILL(...), quoted identifiers ("col" or `col`) and '' -escaped string
+literals. SET options include enableNullHandling (null-skipping aggregations
++ three-valued WHERE), useMultistageEngine, and trace.
 """
 
 from __future__ import annotations
