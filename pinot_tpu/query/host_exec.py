@@ -754,8 +754,6 @@ def group_frame(seg: ImmutableSegment, ctx: QueryContext, mask: np.ndarray) -> p
     null_aggs: set[int] = set()  # agg indices with null rows substituted
     for i, a in enumerate(ctx.aggregations):
         if a.filter is not None:
-            if a.func in _funnel_mod().FUNNEL_AGGS:
-                raise PlanError(f"FILTER(WHERE) on {a.func} inside GROUP BY is not supported")
             fmask = (
                 filter_mask_null_aware(seg, a.filter)
                 if null_on
@@ -802,7 +800,11 @@ def group_frame(seg: ImmutableSegment, ctx: QueryContext, mask: np.ndarray) -> p
             steps = a.extra[-1]
             bits = np.zeros(int(mask.sum()), dtype=np.int64)
             for k, s in enumerate(steps):
-                bits |= filter_mask(seg, s)[mask].astype(np.int64) << k
+                sm = filter_mask(seg, s)
+                if a.filter is not None:
+                    # FILTER(WHERE): excluded docs join no step (bits stay 0)
+                    sm = sm & fmask
+                bits |= sm[mask].astype(np.int64) << k
             data[f"fb{i}"] = bits
             if fun.is_windowed(a.func):
                 data[f"fc{i}"] = eval_value(seg, a.arg2)[mask]

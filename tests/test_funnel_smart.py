@@ -88,6 +88,20 @@ def test_funnelcount_group_by(events):
         assert isinstance(arr, list) and len(arr) == 2
 
 
+def test_funnelcount_filter_in_group_by(events):
+    """FILTER(WHERE ...) on a funnel aggregation inside GROUP BY: excluded
+    docs join no step."""
+    res = events.execute(
+        "SELECT event, FUNNELCOUNT(STEPS(ts >= 10, ts >= 20), CORRELATE_BY(uid)) "
+        "FILTER (WHERE uid <= 3) FROM events GROUP BY event ORDER BY event LIMIT 10"
+    )
+    assert len(res.rows) == 3
+    # 'view' group: uids 1,2,3,5 have views; FILTER keeps 1,2,3; their view
+    # rows all have ts >= 10 -> step1 = {1,2,3}; ts >= 20 among those: none
+    by_event = {r[0]: r[1] for r in res.rows}
+    assert by_event["view"] == [3, 0]
+
+
 def test_funnelcount_device_lowering(events):
     """The un-ordered funnel count variants compile into the fused device
     program (per-step presence rows over the correlation dict-id space)
