@@ -221,6 +221,22 @@ class ServerHTTPService:
                     self.send_header("Content-Length", str(len(payload)))
                     self.end_headers()
                     self.wfile.write(payload)
+                elif self.path == "/debug/resources":
+                    # leak-tracker + scheduler backlog (NettyLeakListener-
+                    # style observability surfaced as a REST debug endpoint)
+                    from pinot_tpu.common.leakcheck import staging_tracker
+
+                    sched = getattr(server, "_scheduler", None)
+                    doc = {
+                        "stagedDeviceSegments": staging_tracker.live(),
+                        "schedulerPending": sched.pending() if sched is not None else None,
+                    }
+                    payload = json.dumps(doc).encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type", "application/json")
+                    self.send_header("Content-Length", str(len(payload)))
+                    self.end_headers()
+                    self.wfile.write(payload)
                 else:
                     self.send_error(404)
 

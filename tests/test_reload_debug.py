@@ -85,5 +85,8 @@ def test_server_debug_and_metrics_endpoints(tmp_path):
         with urllib.request.urlopen(f"{base}/metrics") as resp:
             snap = json.loads(resp.read())
         assert isinstance(snap, dict)
+        with urllib.request.urlopen(f"{base}/debug/resources") as resp:
+            res = json.loads(resp.read())
+        assert "stagedDeviceSegments" in res and "schedulerPending" in res
     finally:
         svc.stop()
