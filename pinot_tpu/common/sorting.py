@@ -1,0 +1,30 @@
+"""Null-aware multi-key sorting shared by the v1 broker reduce, the host
+executor, and the v2 sort operator.
+
+Reference semantics (OrderByExpressionContext, pinot-common/src/main/java/
+org/apache/pinot/common/request/context/OrderByExpressionContext.java):
+the default ordering treats nulls as the LARGEST value, so nulls land last
+under ASC but FIRST under DESC. pandas' single na_position flag cannot
+express a per-key direction, so we compose stable single-key sorts."""
+from __future__ import annotations
+
+import pandas as pd
+
+
+def sort_nulls_largest(
+    df: pd.DataFrame,
+    by: list,
+    ascending: list,
+    kind: str = "mergesort",
+) -> pd.DataFrame:
+    """Stable multi-key sort where missing values (None/NaN) rank as the
+    largest value: last for ASC keys, first for DESC keys."""
+    out = df
+    for col, asc in reversed(list(zip(by, ascending))):
+        out = out.sort_values(
+            by=col,
+            ascending=asc,
+            kind=kind,
+            na_position="last" if asc else "first",
+        )
+    return out

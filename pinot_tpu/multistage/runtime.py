@@ -108,6 +108,13 @@ def eval_expr(expr: ast.Expr, fields: list[L.Field], df: pd.DataFrame) -> pd.Ser
     if isinstance(expr, ast.BinaryOp):
         l = eval_expr(expr.left, fields, df)
         r = eval_expr(expr.right, fields, df)
+        # object cells holding None (null-handling scans / NULL aggregates)
+        # would TypeError under arithmetic: coerce to float with NaN, which
+        # propagates and is emitted as None at the result boundary
+        if l.dtype == object:
+            l = pd.to_numeric(l, errors="coerce")
+        if r.dtype == object:
+            r = pd.to_numeric(r, errors="coerce")
         if expr.op == "+":
             return l + r
         if expr.op == "-":
@@ -194,6 +201,19 @@ def eval_filter(f: ast.FilterExpr, fields: list[L.Field], df: pd.DataFrame) -> n
     if isinstance(f, ast.Compare):
         l = eval_expr(f.left, fields, df)
         r = eval_expr(f.right, fields, df)
+        na = (pd.isna(l) | pd.isna(r)).to_numpy()
+        if na.any():
+            # NULL comparison is unknown -> row filtered (three-valued
+            # semantics collapse to False here; exact Kleene NOT is only on
+            # the leaf WHERE path). Object cells holding None would
+            # TypeError under elementwise comparison, hence the split.
+            out = np.zeros(len(df), dtype=bool)
+            keep = ~na
+            with np.errstate(invalid="ignore"):
+                out[keep] = np.asarray(
+                    _CMPS[f.op](l.to_numpy()[keep], r.to_numpy()[keep])
+                ).astype(bool)
+            return out
         with np.errstate(invalid="ignore"):
             return np.asarray(_CMPS[f.op](l.to_numpy(), r.to_numpy())).astype(bool)
     if isinstance(f, ast.DistinctFrom):
@@ -282,7 +302,9 @@ def sorted_frame(df: pd.DataFrame, by: list, descs: list[bool], reset_index: boo
     if perm is not None:
         out = df.take(perm)
     else:
-        out = df.sort_values(by=by, ascending=[not d for d in descs], kind="mergesort")
+        from pinot_tpu.common.sorting import sort_nulls_largest
+
+        out = sort_nulls_largest(df, by, [not d for d in descs])
     return out.reset_index(drop=True) if reset_index else out
 
 
@@ -616,9 +638,16 @@ def _exec_aggregate(node: L.Aggregate, ctx: RunCtx) -> pd.DataFrame:
         leaf = _try_leaf_device_partial(node, ctx)
         if leaf is not None:
             return leaf
-        return _exec_partial_aggregate(node, exec_node(node.input, ctx))
+        from pinot_tpu.query.context import null_handling_enabled as _nhe
+
+        return _exec_partial_aggregate(node, exec_node(node.input, ctx), _nhe(ctx.options))
     if node.mode == "final":
-        return _exec_final_aggregate(node, exec_node(node.input, ctx))
+        from pinot_tpu.query.context import null_handling_enabled as _nhe
+
+        return _exec_final_aggregate(node, exec_node(node.input, ctx), _nhe(ctx.options))
+    from pinot_tpu.query.context import null_handling_enabled
+
+    null_on = null_handling_enabled(ctx.options)
     df = exec_node(node.input, ctx)
     infields = node.input.fields
     n_groups = len(node.group_exprs)
@@ -630,6 +659,11 @@ def _exec_aggregate(node: L.Aggregate, ctx: RunCtx) -> pd.DataFrame:
                 sub = df[np.asarray(eval_filter(a.filter, infields, df), bool)]
             s = eval_expr(a.arg, infields, sub) if a.arg is not None else pd.Series(np.zeros(len(sub)))
             s2 = eval_expr(a.arg2, infields, sub) if a.arg2 is not None else None
+            if null_on and a.arg is not None and a.func in ("count", "sum", "min", "max", "avg", "minmaxrange"):
+                s = s[pd.notna(s)]  # null-handling: aggregate non-null cells only
+            if null_on and a.func == "sum" and len(s) == 0:
+                row.append(None)  # all-null/empty SUM -> NULL (holder never set)
+                continue
             row.append(_agg_scalar(a.func, s, a.extra, s2))
         return pd.DataFrame({i: [v] for i, v in enumerate(row)})
     if df.empty:
@@ -644,10 +678,13 @@ def _exec_aggregate(node: L.Aggregate, ctx: RunCtx) -> pd.DataFrame:
                 raise L.PlanV2Error(f"FILTER(WHERE) on {a.func} inside GROUP BY is not supported")
             fm = np.asarray(eval_filter(a.filter, infields, df), bool)
         if a.func == "count":
-            # COUNT(*)/COUNT(col) both count rows here (the v2 engine has no
-            # null handling); the indicator folds in FILTER — the arg column
-            # must NOT be summed (COUNT(col) keeps its arg since round 3)
+            # the indicator folds in FILTER — the arg column must NOT be
+            # summed (COUNT(col) keeps its arg since round 3). Under
+            # enableNullHandling, COUNT(col) counts non-null cells only
+            # (v2 scans materialize None cells), matching v1.
             ind = fm if fm is not None else np.ones(len(df), dtype=bool)
+            if a.arg is not None and null_on:
+                ind = ind & pd.notna(eval_expr(a.arg, infields, df)).to_numpy()
             work[f"v{j}"] = pd.Series(ind.astype(np.int64))
         elif a.arg is not None:
             v = eval_expr(a.arg, infields, df).reset_index(drop=True)
@@ -761,10 +798,12 @@ def _try_leaf_device_partial(node: L.Aggregate, ctx: RunCtx) -> pd.DataFrame | N
     return out
 
 
-def _exec_partial_aggregate(node: L.Aggregate, df: pd.DataFrame) -> pd.DataFrame:
+def _exec_partial_aggregate(node: L.Aggregate, df: pd.DataFrame, null_on: bool = False) -> pd.DataFrame:
     """Pandas partial over an arbitrary input block: emits the v1 mergeable
     partial layout [keys..., per-agg parts...] (host_exec.group_frame's
-    column formats)."""
+    column formats). Under enableNullHandling (null_on), COUNT(col) skips
+    null cells and SUM emits NaN for all-null input (review r4 — this path
+    must agree with the plain grouped path and the v1 engine)."""
     from pinot_tpu.query.reduce import parts_of
 
     infields = node.input.fields
@@ -793,14 +832,20 @@ def _exec_partial_aggregate(node: L.Aggregate, df: pd.DataFrame) -> pd.DataFrame
             if vv is not None and mm is not None:
                 vv = pd.Series(np.where(mm, vv.to_numpy(np.float64), np.nan))
             if a.func == "count":
-                n = (
-                    int(mm.sum())
-                    if mm is not None
-                    else (len(df) if sub_idx is None else len(sub_idx))
-                )
-                cols.append(n)
+                if null_on and vv is not None:
+                    nn = pd.notna(vv).to_numpy()  # COUNT(col) skips nulls
+                    cols.append(int((nn & mm).sum() if mm is not None else nn.sum()))
+                else:
+                    cols.append(
+                        int(mm.sum())
+                        if mm is not None
+                        else (len(df) if sub_idx is None else len(sub_idx))
+                    )
             elif a.func == "sum":
-                cols.append(float(np.nansum(vv.to_numpy(np.float64))))
+                arr = vv.to_numpy(np.float64)
+                nn = arr[~np.isnan(arr)]
+                # NaN partial = "no non-null rows" under null handling
+                cols.append(float(nn.sum()) if len(nn) else (float("nan") if null_on else 0.0))
             elif a.func in ("min", "max"):
                 arr = vv.to_numpy(np.float64)
                 arr = arr[~np.isnan(arr)]
@@ -843,7 +888,7 @@ def _exec_partial_aggregate(node: L.Aggregate, df: pd.DataFrame) -> pd.DataFrame
     return pd.DataFrame({i: [r[i] for r in rows] for i in range(ncols)})
 
 
-def _exec_final_aggregate(node: L.Aggregate, df: pd.DataFrame) -> pd.DataFrame:
+def _exec_final_aggregate(node: L.Aggregate, df: pd.DataFrame, null_on: bool = False) -> pd.DataFrame:
     """Merge partial columns per group and finalize. The per-function merge
     is reduce._merge_agg_partials — the SAME table the broker reduce uses —
     so partial formats (sets vs HLL registers, value arrays, counters) never
@@ -855,7 +900,14 @@ def _exec_final_aggregate(node: L.Aggregate, df: pd.DataFrame) -> pd.DataFrame:
     k = len(node.group_exprs)
     if df.empty:
         if k == 0:
-            row = [_finalize(a, _empty_partial(a.func, a.extra)) for a in node.aggs]
+            row = [
+                _finalize(
+                    a,
+                    None if null_on and a.func == "sum" else _empty_partial(a.func, a.extra),
+                    null_on,
+                )
+                for a in node.aggs
+            ]
             return pd.DataFrame({i: [v] for i, v in enumerate(row)})
         return _empty_df(len(node.fields))
 
@@ -873,8 +925,8 @@ def _exec_final_aggregate(node: L.Aggregate, df: pd.DataFrame) -> pd.DataFrame:
                 parts = [(row[off], row[off + 1]) for _, row in sub.iterrows()]
             else:
                 parts = list(sub[off])
-            merged = _fold(lambda x, y, _f=a.func: _merge_agg_partials(_f, x, y), parts)
-            out.append(_finalize(a, merged))
+            merged = _fold(lambda x, y, _f=a.func: _merge_agg_partials(_f, x, y, null_on), parts)
+            out.append(_finalize(a, merged, null_on))
         return out
 
     if k == 0:
@@ -1165,24 +1217,23 @@ class MultistageEngine:
                 if plan.stages[inp].dist == L.SINGLETON:
                     s.parallelism = 1
         if getattr(stmt, "explain", False):
-            # EXPLAIN PLAN FOR: one row per stage (PinotQueryWorker Explain
-            # parity) — [stage, parallelism, distribution, plan]
+            # EXPLAIN PLAN FOR: one row per stage in the documented
+            # [Operator, Operator_Id, Parent_Id] schema (DataSchema.java:70) —
+            # Operator carries the stage plan text with parallelism/dist
             parent_of: dict[int, int] = {}
             for s in plan.stages.values():
                 for inp in s.inputs:
                     parent_of[inp] = s.id
             out_rows = [
                 [
+                    f"[{s.dist or 'root'} x{s.parallelism}] {L._explain(s.root)}",
                     sid,
-                    s.parallelism,
-                    s.dist or "root",
                     parent_of.get(sid, -1),
-                    L._explain(s.root),
                 ]
                 for sid, s in sorted(plan.stages.items())
             ]
             return ResultTable(
-                columns=["stage", "parallelism", "distribution", "parent_stage", "plan"],
+                columns=["Operator", "Operator_Id", "Parent_Id"],
                 rows=out_rows,
             )
         df = self._run(plan)

@@ -156,14 +156,30 @@ def _combine_tree(spec: tuple, matched, counts, parts, axis_name: str | None, lo
             y = jnp.max(jax.lax.all_gather(y, axis_name), axis=0)
         return y.astype(bool)
 
+    def red_nansum(x):
+        # masked_nan_empty SUM partials: NaN = "no non-null rows on this
+        # shard/segment" — skip it in the combine, but keep NaN when EVERY
+        # contribution is NaN so the reduce still finalizes to NULL
+        seen = (~jnp.isnan(x)).astype(jnp.int32)
+        s = jnp.where(jnp.isnan(x), 0.0, x)
+        if local_axis:
+            s, seen = jnp.sum(s, axis=0), jnp.sum(seen, axis=0)
+        if axis_name:
+            s, seen = jax.lax.psum(s, axis_name), jax.lax.psum(seen, axis_name)
+        return jnp.where(seen == 0, jnp.nan, s)
+
     aggs = spec[3]
     out_parts = []
     for a, p in zip(aggs, parts):
         kind = a[0]
-        while kind == "masked":  # FILTER(WHERE) wrapper: combine by inner kind
+        nan_empty = False
+        while kind in ("masked", "masked_nan_empty"):  # FILTER(WHERE)/null wrapper: combine by inner kind
+            nan_empty = nan_empty or kind == "masked_nan_empty"
             a = a[2]
             kind = a[0]
-        if kind in ("count", "sum", "avg", "mv_count", "mv_sum", "mv_avg"):
+        if kind == "sum" and nan_empty:
+            out_parts.append(red_nansum(p))
+        elif kind in ("count", "sum", "avg", "mv_count", "mv_sum", "mv_avg"):
             out_parts.append(jax.tree.map(red_sum, p))
         elif kind in ("min", "mv_min"):
             out_parts.append(red_min(p))

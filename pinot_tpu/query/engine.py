@@ -52,7 +52,7 @@ def _describe_spec(spec: tuple, next_id: int, parent: int) -> list[list]:
             emit(f"FILTER_{kind.upper()}", par)
 
     def walk_agg(a, par: int) -> None:
-        if a[0] == "masked":
+        if a[0] in ("masked", "masked_nan_empty"):
             oid = emit("AGG_FILTERED", par)
             walk_filter(a[1], oid)
             walk_agg(a[2], oid)
@@ -180,11 +180,11 @@ class QueryEngine:
 
     def explain(self, ctx: QueryContext) -> ResultTable:
         """EXPLAIN PLAN FOR: the operator tree the query would execute
-        (ExplainPlanQueryExecutor parity) as [operator, operator_id,
-        parent_id] rows, based on the first segment's lowering."""
+        (ExplainPlanQueryExecutor parity) as [Operator, Operator_Id,
+        Parent_Id] rows, based on the first segment's lowering."""
         rows: list[list] = [["BROKER_REDUCE(" + ctx.query_type.value + ")", 0, -1]]
         if not self.segments:
-            return ResultTable(columns=["operator", "operator_id", "parent_id"], rows=rows)
+            return ResultTable(columns=["Operator", "Operator_Id", "Parent_Id"], rows=rows)
         seg = self.segments[0]
         st = seg.extras.get("startree")
         from pinot_tpu.query.context import null_handling_enabled
@@ -198,14 +198,14 @@ class QueryEngine:
 
             if any(startree_exec.matches(ctx, t) for t in st):
                 rows.append(["STARTREE_SWAP(pre-aggregated table scan)", 1, 0])
-                return ResultTable(columns=["operator", "operator_id", "parent_id"], rows=rows)
+                return ResultTable(columns=["Operator", "Operator_Id", "Parent_Id"], rows=rows)
         try:
             plan = plan_segment(seg, ctx)
             rows.append(["DEVICE_FUSED_PROGRAM(segment=" + seg.name + ")", 1, 0])
             rows.extend(_describe_spec(plan.spec, next_id=2, parent=1))
         except DeviceFallback as e:
             rows.append([f"HOST_EXECUTOR(reason={e})", 1, 0])
-        return ResultTable(columns=["operator", "operator_id", "parent_id"], rows=rows)
+        return ResultTable(columns=["Operator", "Operator_Id", "Parent_Id"], rows=rows)
 
     def execute(self, sql: str) -> ResultTable:
         t0 = time.perf_counter()
@@ -325,7 +325,7 @@ class QueryEngine:
     def _convert_agg(self, seg, ctx, plan: SegmentPlan, parts) -> list:
         out = []
         for a, spec_entry, p in zip(ctx.aggregations, plan.spec[3], parts):
-            while spec_entry[0] == "masked":  # FILTER(WHERE) wrapper
+            while spec_entry[0] in ("masked", "masked_nan_empty"):  # FILTER(WHERE)/null wrapper
                 spec_entry = spec_entry[2]
             if a.func in ("count", "countmv"):
                 out.append(int(p))
@@ -371,7 +371,7 @@ class QueryEngine:
             return pd.DataFrame(data)
         aggs_spec = plan.spec[3]
         for i, (a, spec_entry, p) in enumerate(zip(ctx.aggregations, aggs_spec, parts)):
-            while spec_entry[0] == "masked":
+            while spec_entry[0] in ("masked", "masked_nan_empty"):
                 spec_entry = spec_entry[2]
             if a.func in ("count", "countmv"):
                 data[f"a{i}p0"] = np.asarray(p)[pg]

@@ -710,7 +710,9 @@ def agg_partials(seg: ImmutableSegment, ctx: QueryContext, query_mask: np.ndarra
             continue
         v = eval_value(seg, a.arg)[mask].astype(np.float64)
         if a.func == "sum":
-            out.append(float(v.sum()))
+            # None partial = "no non-null rows" under null handling; merge
+            # treats it as identity and _finalize yields NULL
+            out.append(float(v.sum()) if len(v) else (None if null_on else 0.0))
         elif a.func == "min":
             out.append(float(v.min()) if len(v) else float("inf"))
         elif a.func == "max":
@@ -728,7 +730,9 @@ def agg_partials(seg: ImmutableSegment, ctx: QueryContext, query_mask: np.ndarra
 
 def group_frame(seg: ImmutableSegment, ctx: QueryContext, mask: np.ndarray) -> pd.DataFrame:
     from pinot_tpu.query.aggregates import EXT_AGGS
+    from pinot_tpu.query.context import null_handling_enabled
 
+    null_on = null_handling_enabled(ctx.options)
     data = {}
     mv_key_cols: list[str] = []
     mv_key_str: dict[str, bool] = {}
@@ -744,13 +748,21 @@ def group_frame(seg: ImmutableSegment, ctx: QueryContext, mask: np.ndarray) -> p
             mv_key_str[f"k{i}"] = ci_g.data_type.value in ("STRING", "JSON", "BYTES")
             continue
         v = eval_value(seg, g)[mask]
+        if null_on:
+            nm = expr_null_mask(seg, g)
+            if nm is not None and nm.any():
+                # null keys form their own group (reference group-by null
+                # semantics): substitute None over the stored placeholder.
+                # Object dtype keeps int64 keys exact (no float widening);
+                # groupby(dropna=False) below keeps the None group.
+                v = v.astype(object)
+                v[nm[mask]] = None
+                data[f"k{i}"] = v
+                continue
         data[f"k{i}"] = v.astype(str) if v.dtype == object else v
     filtered_ok = {"count", "sum", "min", "max", "avg", "minmaxrange"}
     mv_docaggs: dict[int, dict[str, np.ndarray]] = {}
     theta_nf: dict[int, int] = {}  # agg index -> number of theta filter clauses
-    from pinot_tpu.query.context import null_handling_enabled
-
-    null_on = null_handling_enabled(ctx.options)
     null_aggs: set[int] = set()  # agg indices with null rows substituted
     for i, a in enumerate(ctx.aggregations):
         if a.filter is not None:
@@ -903,7 +915,12 @@ def group_frame(seg: ImmutableSegment, ctx: QueryContext, mask: np.ndarray) -> p
             else:
                 out[f"a{i}p0"] = out["__size"]
         elif a.func == "sum":
-            out[f"a{i}p0"] = np.nan_to_num(g[f"v{i}"].sum().values.astype(np.float64))
+            if null_on:
+                # min_count=1 keeps all-null (or all-filter-excluded) groups
+                # NaN -> finalized to NULL, matching the device kernel
+                out[f"a{i}p0"] = g[f"v{i}"].sum(min_count=1).values.astype(np.float64)
+            else:
+                out[f"a{i}p0"] = np.nan_to_num(g[f"v{i}"].sum().values.astype(np.float64))
         elif a.func == "min":
             v = g[f"v{i}"].min().values.astype(np.float64)
             out[f"a{i}p0"] = np.where(np.isnan(v), np.inf, v) if (filtered or i in null_aggs) else v
@@ -911,7 +928,10 @@ def group_frame(seg: ImmutableSegment, ctx: QueryContext, mask: np.ndarray) -> p
             v = g[f"v{i}"].max().values.astype(np.float64)
             out[f"a{i}p0"] = np.where(np.isnan(v), -np.inf, v) if (filtered or i in null_aggs) else v
         elif a.func == "avg":
-            out[f"a{i}p0"] = np.nan_to_num(g[f"v{i}"].sum().values.astype(np.float64))
+            if null_on:
+                out[f"a{i}p0"] = g[f"v{i}"].sum(min_count=1).values.astype(np.float64)
+            else:
+                out[f"a{i}p0"] = np.nan_to_num(g[f"v{i}"].sum().values.astype(np.float64))
             if i in null_aggs:
                 # null handling: count non-NaN rows — v already folds in the
                 # FILTER mask (excluded rows were NaN-ed first), so this is
@@ -1090,10 +1110,10 @@ def selection_ob_frame(seg: ImmutableSegment, ctx: QueryContext, mask: np.ndarra
         v = eval_value(seg, ob.expr)
         nm = _selection_nulls(seg, ctx, ob.expr)
         if nm is not None:
-            # nulls-last ordering (Pinot null-handling ORDER BY): NaN/None
-            # sort keys land last under pandas regardless of direction
-            # (pandas separates missing values before comparing, so object
-            # columns must keep None — no astype(str) which would emit 'None')
+            # null keys become NaN/None; sort_nulls_largest below ranks them
+            # as the largest value (last for ASC, FIRST for DESC) per the
+            # reference default. Object columns must keep None — no
+            # astype(str) which would emit 'None'.
             if v.dtype == object or v.dtype.kind in "US":
                 v = v.astype(object)
                 v[nm] = None
@@ -1111,5 +1131,7 @@ def selection_ob_frame(seg: ImmutableSegment, ctx: QueryContext, mask: np.ndarra
         proj[f"c{i}"] = _null_subst(v, nm[mask]) if nm is not None else v
     for c, v in proj.items():
         df[c] = v
-    df = df.sort_values(by=[n for n, _, _ in keys], ascending=[a for _, _, a in keys], kind="mergesort")
+    from pinot_tpu.common.sorting import sort_nulls_largest
+
+    df = sort_nulls_largest(df, [n for n, _, _ in keys], [a for _, _, a in keys])
     return df.head(k)

@@ -265,6 +265,18 @@ def _mv_vmask(col, nv_idx, cols, ops, mask):
 
 def _agg_scalar(aspec, cols, ops, mask):
     kind = aspec[0]
+    if kind == "masked_nan_empty":
+        # null-handling SUM: intersect the non-null mask AND every inner
+        # FILTER(WHERE) mask, then emit NaN when zero rows survive (the
+        # empty-check must see the FULL effective mask, not just the null
+        # mask — review r4). NaN finalizes to NULL at reduce.
+        m2 = mask & _filter(aspec[1], cols, ops, mask.shape[0])
+        inner = aspec[2]
+        while inner[0] == "masked":
+            m2 = m2 & _filter(inner[1], cols, ops, mask.shape[0])
+            inner = inner[2]
+        r = _agg_scalar(inner, cols, ops, m2)
+        return jnp.where(jnp.any(m2), r.astype(_F), jnp.nan)
     if kind == "masked":
         # FILTER (WHERE ...): intersect the per-agg mask, delegate
         m2 = mask & _filter(aspec[1], cols, ops, mask.shape[0])
@@ -348,6 +360,21 @@ def _agg_grouped(aspec, cols, ops, mask, gid, ng, gather=None, doc_pad=None):
     """gather/doc_pad: MV GROUP BY evaluates in VALUE space — doc-space
     value/filter vectors gather through the owning-doc ids first."""
     kind = aspec[0]
+    if kind == "masked_nan_empty":
+        # null-handling SUM: the per-group empty check must see the FULL
+        # effective mask (non-null AND every inner FILTER mask — review r4);
+        # empty groups emit NaN partials, finalized to NULL at reduce.
+        m2 = mask
+        node = aspec
+        while node[0] in ("masked", "masked_nan_empty"):
+            fm = _filter(node[1], cols, ops, doc_pad if gather is not None else mask.shape[0])
+            if gather is not None:
+                fm = fm[gather]
+            m2 = m2 & fm
+            node = node[2]
+        r = _agg_grouped(node, cols, ops, m2, gid, ng, gather, doc_pad)
+        cnt = _count_grouped(m2, gid, ng)
+        return jnp.where(cnt == 0, jnp.nan, r.astype(_F))
     if kind == "masked":
         fm = _filter(aspec[1], cols, ops, doc_pad if gather is not None else mask.shape[0])
         if gather is not None:

@@ -117,6 +117,17 @@ class _Lowering:
         from pinot_tpu.query.host_exec import _null_doc_mask
 
         nulls = _null_doc_mask(self.seg, info)
+        inner = spec
+        while inner[0] == "masked":
+            inner = inner[2]
+        if inner[0] == "sum":
+            # SUM cannot distinguish "all rows null" (or "no rows matched" —
+            # both NULL under null handling) from a genuine 0 via a sentinel
+            # (min/max use +/-inf); the kernel emits NaN for empty groups so
+            # the reduce finalizes them to NULL. Wrapped even without a null
+            # vector: a FILTER/WHERE matching zero rows must also yield NULL.
+            nn = ("const", True) if nulls is None or not nulls.any() else self.docmask_spec(~nulls)
+            return ("masked_nan_empty", nn, spec)
         if nulls is None or not nulls.any():
             return spec
         return ("masked", self.docmask_spec(~nulls), spec)
@@ -1043,6 +1054,12 @@ def plan_segment(seg: ImmutableSegment, ctx: QueryContext, valid_mask=None) -> S
         if any((seg.extras or {}).get("null", {}).get(c) is not None for c in refs):
             # three-valued WHERE/FILTER semantics run on the host executor
             raise DeviceFallback("null-handling filter runs host-side (Kleene logic)")
+        from pinot_tpu.query.host_exec import expr_null_mask as _enm
+
+        if any(_enm(seg, g) is not None for g in ctx.group_by):
+            # null keys must form their own group (reference group-by null
+            # semantics); the host path substitutes None into the key column
+            raise DeviceFallback("null-handling group-by key runs host-side")
     fspec = lo.filter_spec(ctx.filter)
 
     if valid_mask is None:
@@ -1073,7 +1090,7 @@ def plan_segment(seg: ImmutableSegment, ctx: QueryContext, valid_mask=None) -> S
             # value-space over a (possibly different) MV column — the
             # combined gather semantics run host-side (explode)
             def _has_mv(a):
-                return a[0].startswith("mv_") or (a[0] == "masked" and _has_mv(a[2]))
+                return a[0].startswith("mv_") or (a[0] in ("masked", "masked_nan_empty") and _has_mv(a[2]))
 
             if any(_has_mv(a) for a in aggs):
                 raise DeviceFallback("MV aggregations under an MV GROUP BY run host-side")

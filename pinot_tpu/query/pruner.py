@@ -104,7 +104,15 @@ def empty_partial(ctx: QueryContext):
                 lo, hi = ctx.hints["est_bounds"][a.name]
                 out.append((np.zeros(EST_BINS, dtype=np.int64), lo, hi))
             else:
-                out.append(_empty_partial(a.func, a.extra))
+                from pinot_tpu.query.context import null_handling_enabled
+                from pinot_tpu.query.reduce import MV_TWIN
+
+                if null_handling_enabled(ctx.options) and MV_TWIN.get(a.func, a.func) == "sum":
+                    # pruned segment contributes the null-handling SUM
+                    # identity (None), not 0 — review r4
+                    out.append(None)
+                else:
+                    out.append(_empty_partial(a.func, a.extra))
         return out
     if qt in (QueryType.GROUP_BY,):
         cols: dict = {f"k{i}": [] for i in range(len(ctx.group_by))}

@@ -98,6 +98,8 @@ def eval_scalar(expr: ast.Expr, env: dict[str, Any], aliases: dict[str, ast.Expr
     if isinstance(expr, ast.BinaryOp):
         l = eval_scalar(expr.left, env, aliases)
         r = eval_scalar(expr.right, env, aliases)
+        if l is None or r is None:
+            return None  # null propagates through post-aggregation arithmetic
         if expr.op == "+":
             return l + r
         if expr.op == "-":
@@ -111,16 +113,29 @@ def eval_scalar(expr: ast.Expr, env: dict[str, Any], aliases: dict[str, ast.Expr
     raise ValueError(f"cannot evaluate {expr} at reduce stage")
 
 
-def eval_having(f: ast.FilterExpr, env: dict[str, Any], aliases: dict[str, ast.Expr] | None = None) -> bool:
+def eval_having(f: ast.FilterExpr, env: dict[str, Any], aliases: dict[str, ast.Expr] | None = None) -> "bool | None":
+    """Three-valued HAVING evaluation: returns None for unknown (a NULL
+    aggregate compared to anything). The filtering caller treats None as
+    falsy, but NOT(unknown) stays unknown (Kleene), so unknown must
+    propagate rather than collapse to False early."""
     if isinstance(f, ast.And):
-        return all(eval_having(c, env, aliases) for c in f.children)
+        vals = [eval_having(c, env, aliases) for c in f.children]
+        if any(v is False for v in vals):
+            return False
+        return None if any(v is None for v in vals) else True
     if isinstance(f, ast.Or):
-        return any(eval_having(c, env, aliases) for c in f.children)
+        vals = [eval_having(c, env, aliases) for c in f.children]
+        if any(v is True for v in vals):
+            return True
+        return None if any(v is None for v in vals) else False
     if isinstance(f, ast.Not):
-        return not eval_having(f.child, env, aliases)
+        v = eval_having(f.child, env, aliases)
+        return None if v is None else not v
     if isinstance(f, ast.Compare):
         l = eval_scalar(f.left, env, aliases)
         r = eval_scalar(f.right, env, aliases)
+        if l is None or r is None:
+            return None  # NULL comparison is unknown
         return {
             ast.CompareOp.EQ: lambda: l == r,
             ast.CompareOp.NEQ: lambda: l != r,
@@ -131,17 +146,21 @@ def eval_having(f: ast.FilterExpr, env: dict[str, Any], aliases: dict[str, ast.E
         }[f.op]()
     if isinstance(f, ast.Between):
         v = eval_scalar(f.expr, env, aliases)
+        if v is None:
+            return None  # unknown
         ok = eval_scalar(f.low, env, aliases) <= v <= eval_scalar(f.high, env, aliases)
         return not ok if f.negated else ok
     if isinstance(f, ast.In):
         v = eval_scalar(f.expr, env, aliases)
+        if v is None:
+            return None  # unknown
         vals = {eval_scalar(x, env, aliases) for x in f.values}
         return (v not in vals) if f.negated else (v in vals)
     if isinstance(f, ast.DistinctFrom):
         l = eval_scalar(f.left, env, aliases)
         r = eval_scalar(f.right, env, aliases)
-        ln = l is None or (isinstance(l, float) and l != l)
-        rn = r is None or (isinstance(r, float) and r != r)
+        ln = _is_null_partial(l)
+        rn = _is_null_partial(r)
         m = (ln != rn) or (not ln and not rn and l != r)
         return not m if f.negated else m
     raise ValueError(f"unsupported HAVING predicate: {f}")
@@ -152,7 +171,13 @@ def eval_having(f: ast.FilterExpr, env: dict[str, Any], aliases: dict[str, ast.E
 # ---------------------------------------------------------------------------
 
 
-def _merge_agg_partials(func: str, a, b):
+def _is_null_partial(x) -> bool:
+    """True when a partial is the null-handling "no non-null rows" sentinel:
+    None (host paths) or NaN (device kernels / pandas min_count merges)."""
+    return x is None or (isinstance(x, float) and x != x)
+
+
+def _merge_agg_partials(func: str, a, b, null_on: bool = False):
     from pinot_tpu.query.aggregates import EXT_AGGS
     from pinot_tpu.query.funnel import FUNNEL_AGGS, merge as funnel_merge
 
@@ -161,7 +186,18 @@ def _merge_agg_partials(func: str, a, b):
     func = MV_TWIN.get(func, func)
     if func in EXT_AGGS:
         return EXT_AGGS[func].merge(a, b)
-    if func in ("count", "sum"):
+    if func == "sum":
+        # null partial (see _is_null_partial) = "no non-null rows seen":
+        # identity under merge, finalized to NULL only if it survives.
+        # None is always the sentinel; NaN only under null handling (with
+        # null handling OFF a stored-NaN DOUBLE sum must keep IEEE
+        # propagation — review r4)
+        if a is None or (null_on and _is_null_partial(a)):
+            return b
+        if b is None or (null_on and _is_null_partial(b)):
+            return a
+        return a + b
+    if func == "count":
         return a + b
     if func == "min":
         return min(a, b)
@@ -197,8 +233,13 @@ def _exact_percentile(values: np.ndarray, pct: float) -> float:
     return exact_percentile(values, pct)
 
 
-def _finalize(a, p):
-    """Finalize a merged partial. `a` is the AggregationInfo."""
+def _finalize(a, p, null_on: bool = False):
+    """Finalize a merged partial. `a` is the AggregationInfo. Under
+    enableNullHandling (null_on), aggregations that never saw a non-null
+    value yield NULL instead of the neutral default — reference
+    NullableSingleInputAggregationFunction keeps an Object holder that
+    stays null over all-null input (SumAggregationFunction.java with
+    nullHandlingEnabled)."""
     from pinot_tpu.query.sketches import hist_estimate, hll_estimate
 
     from pinot_tpu.query.aggregates import EXT_AGGS
@@ -212,12 +253,27 @@ def _finalize(a, p):
         return EXT_AGGS[func].finalize(p, a.extra)
     if func == "count":
         return int(p)
-    if func in ("sum", "min", "max"):
+    if func == "sum":
+        if null_on and _is_null_partial(p):
+            return None
         return float(p)
+    if func in ("min", "max"):
+        v = float(p)
+        if null_on and (_is_null_partial(v) or v == (math.inf if func == "min" else -math.inf)):
+            return None
+        return v
     if func == "avg":
-        return float(p[0]) / p[1] if p[1] else float("-inf")  # Pinot: avg of 0 docs -> default
+        if not p[1]:
+            return None if null_on else float("-inf")  # Pinot: avg of 0 docs -> default
+        s = p[0]
+        if null_on and _is_null_partial(s):
+            return None
+        return float(s) / p[1]
     if func == "minmaxrange":
-        return float(p[1] - p[0])
+        lo, hi = float(p[0]), float(p[1])
+        if null_on and (_is_null_partial(lo) or _is_null_partial(hi) or (lo == math.inf and hi == -math.inf)):
+            return None
+        return hi - lo
     if func in ("distinctcount", "distinctcountbitmap"):
         return len(p)
     if func == "distinctcounthll":
@@ -226,12 +282,16 @@ def _finalize(a, p):
     if func == "percentileest":
         if isinstance(p, tuple):
             return hist_estimate(np.asarray(p[0]), p[1], p[2], a.extra[0])
+        if null_on and len(p) == 0:
+            return None
         return _exact_percentile(p, a.extra[0])
     if func in ("percentile", "percentiletdigest"):
+        if null_on and len(p) == 0:
+            return None
         return _exact_percentile(p, a.extra[0])
     if func == "mode":
         if not p:
-            return float("-inf")
+            return None if null_on else float("-inf")
         best = max(p.values())
         return float(min(k for k, v in p.items() if v == best))  # Pinot MODE ties -> MIN
     raise AssertionError(func)
@@ -243,17 +303,28 @@ def _alias_map(ctx: QueryContext) -> dict[str, ast.Expr]:
 
 def reduce_aggregation(ctx: QueryContext, partials: list[list]) -> list[list]:
     """Merge AGGREGATION partials -> single result row per the select list."""
+    from pinot_tpu.query.context import null_handling_enabled
+
+    null_on = null_handling_enabled(ctx.options)
     if not partials:
         merged = None
     else:
         merged = list(partials[0])
         for p in partials[1:]:
-            merged = [_merge_agg_partials(a.func, m, x) for a, m, x in zip(ctx.aggregations, merged, p)]
+            merged = [
+                _merge_agg_partials(a.func, m, x, null_on)
+                for a, m, x in zip(ctx.aggregations, merged, p)
+            ]
     env: dict[str, Any] = {}
     if merged is None:
-        merged = [_empty_partial(a.func, a.extra) for a in ctx.aggregations]
+        # zero segments contributed (all pruned): under null handling the
+        # SUM holder was never set -> None partial -> NULL
+        merged = [
+            None if null_on and MV_TWIN.get(a.func, a.func) == "sum" else _empty_partial(a.func, a.extra)
+            for a in ctx.aggregations
+        ]
     for a, p in zip(ctx.aggregations, merged):
-        env[a.name] = _finalize(a, p)
+        env[a.name] = _finalize(a, p, null_on)
     aliases = _alias_map(ctx)
     row = [eval_scalar(it.expr, env, aliases) for it in ctx.select_items]
     return [row]
@@ -305,11 +376,20 @@ def reduce_group_by(ctx: QueryContext, frames: list[pd.DataFrame]) -> list[list]
                 out[k] = out.get(k, 0) + v
         return out
 
+    from pinot_tpu.query.context import null_handling_enabled
+
+    null_on = null_handling_enabled(ctx.options)
     for i, a in enumerate(ctx.aggregations):
         func = MV_TWIN.get(a.func, a.func)
         if func in ("count", "sum", "avg"):
             for j in range(parts_of(a.func)):
-                agg_map[f"a{i}p{j}"] = "sum"
+                if null_on and func in ("sum", "avg") and j == 0:
+                    # min_count=1: an all-NaN (all-null) group merges to NaN,
+                    # which _finalize turns into NULL — plain "sum" would
+                    # collapse it to 0
+                    agg_map[f"a{i}p{j}"] = lambda s: s.sum(min_count=1)
+                else:
+                    agg_map[f"a{i}p{j}"] = "sum"
         elif func == "min":
             agg_map[f"a{i}p0"] = "min"
         elif func == "max":
@@ -358,13 +438,16 @@ def reduce_group_by(ctx: QueryContext, frames: list[pd.DataFrame]) -> list[list]
     for _, r in merged.iterrows():
         env: dict[str, Any] = {}
         for i, g in enumerate(ctx.group_by):
-            env[canonical(g)] = r[f"k{i}"]
+            k = r[f"k{i}"]
+            if null_on and _is_null_partial(k):
+                k = None  # NaN key = the null group (host NaN substitution)
+            env[canonical(g)] = k
         for i, a in enumerate(ctx.aggregations):
             if parts_of(a.func) == 2:
                 p = (r[f"a{i}p0"], r[f"a{i}p1"])
             else:
                 p = r[f"a{i}p0"]
-            env[a.name] = _finalize(a, p)
+            env[a.name] = _finalize(a, p, null_on)
         rows.append(env)
 
     if ctx.having is not None:
@@ -394,9 +477,14 @@ class _OrderKey:
         self.desc = desc
 
     def __lt__(self, other):
-        if self.desc:
-            return other.v < self.v
-        return self.v < other.v
+        a, b = (other.v, self.v) if self.desc else (self.v, other.v)
+        # nulls rank as the largest value (OrderByExpressionContext default):
+        # None is never < anything; anything non-null is < None
+        if a is None:
+            return False
+        if b is None:
+            return True
+        return a < b
 
     def __eq__(self, other):
         return self.v == other.v
@@ -421,7 +509,9 @@ def reduce_distinct(ctx: QueryContext, frames: list[pd.DataFrame]) -> list[list]
                 raise ValueError(f"DISTINCT ORDER BY must reference selected columns: {cn}")
             by.append(name_of[cn])
             asc.append(not ob.desc)
-        df = df.sort_values(by=by, ascending=asc, kind="mergesort")
+        from pinot_tpu.common.sorting import sort_nulls_largest
+
+        df = sort_nulls_largest(df, by, asc)
     df = df.iloc[ctx.offset : ctx.offset + ctx.limit]
     return df[key_cols].values.tolist()
 
@@ -442,7 +532,9 @@ def reduce_selection_order_by(ctx: QueryContext, frames: list[pd.DataFrame]) -> 
     df = pd.concat(frames, ignore_index=True)
     key_cols = [c for c in df.columns if str(c).startswith("__key")]
     asc = [not ob.desc for ob in ctx.order_by[: len(key_cols)]]
-    df = df.sort_values(by=key_cols, ascending=asc, kind="mergesort")
+    from pinot_tpu.common.sorting import sort_nulls_largest
+
+    df = sort_nulls_largest(df, key_cols, asc)
     df = df.iloc[ctx.offset : ctx.offset + ctx.limit]
     return df.drop(columns=key_cols).values.tolist()
 

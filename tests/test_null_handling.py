@@ -202,21 +202,22 @@ def test_selection_expression_null_propagation(setup):
     assert vals == pytest.approx(want)
 
 
-def test_order_by_nulls_last(setup):
-    """ORDER BY a nullable column sorts nulls last in both directions
-    (review r3: placeholder values must not drive the sort)."""
+def test_order_by_nulls_as_largest(setup):
+    """ORDER BY a nullable column ranks nulls as the LARGEST value: last
+    under ASC, first under DESC (OrderByExpressionContext.isNullsLast()
+    default — advisor r3: DESC must put nulls first, not last)."""
     eng, df, nn = setup
     n = len(df)
     res = eng.execute(SET_ON + f"SELECT v FROM t ORDER BY v LIMIT {n}")
     vals = [r[0] for r in res.rows]
     n_null = int(df.v.isna().sum())
-    assert all(x is None for x in vals[n - n_null :])  # nulls at the end
+    assert all(x is None for x in vals[n - n_null :])  # ASC: nulls at the end
     non_null = vals[: n - n_null]
     assert non_null == sorted(non_null)
     res_d = eng.execute(SET_ON + f"SELECT v FROM t ORDER BY v DESC LIMIT {n}")
     vals_d = [r[0] for r in res_d.rows]
-    assert all(x is None for x in vals_d[n - n_null :])
-    assert vals_d[: n - n_null] == sorted(vals_d[: n - n_null], reverse=True)
+    assert all(x is None for x in vals_d[:n_null])  # DESC: nulls first
+    assert vals_d[n_null:] == sorted(vals_d[n_null:], reverse=True)
 
 
 def test_v2_selection_emits_none(setup):
@@ -549,3 +550,205 @@ def test_variance_ext_agg_skips_nulls(setup):
     gb = df.groupby("g")
     for g, vv in res.rows:
         assert vv == pytest.approx(gb.x.var(ddof=0)[g], rel=1e-9), g
+
+
+def test_group_by_null_key_forms_null_group(setup):
+    """GROUP BY on a nullable key: null rows form their OWN group instead of
+    grouping under the stored placeholder (advisor r3 — reference group-by
+    null semantics, GroupByUtils null key handling)."""
+    eng, df, nn = setup
+    res = eng.execute(SET_ON + "SELECT v, COUNT(*) FROM t GROUP BY v LIMIT 200")
+    by_key = {r[0]: r[1] for r in res.rows}
+    n_null = int(df.v.isna().sum())
+    assert None in by_key
+    assert by_key[None] == n_null
+    # no group at the LONG placeholder value
+    from pinot_tpu.common.types import DataType
+
+    assert float(DataType.LONG.default_null) not in by_key
+    # non-null groups match the pandas oracle
+    counts = df.v.dropna().value_counts()
+    for k, c in by_key.items():
+        if k is not None:
+            assert c == int(counts[float(k)]), k
+
+
+def test_all_null_aggregates_yield_null(setup):
+    """Aggregations over all-null input return NULL (advisor r3 —
+    SumAggregationFunction nullHandlingEnabled keeps a null holder)."""
+    eng, df, nn = setup
+    # the filter selects only null-v rows: v IS NULL
+    r = eng.execute(
+        SET_ON + "SELECT SUM(v), MIN(v), MAX(v), AVG(v), MINMAXRANGE(v) "
+        "FROM t WHERE v IS NULL"
+    ).rows[0]
+    assert all(x is None for x in r), r
+
+
+def test_all_null_group_aggregates_yield_null():
+    """Per-group all-null input yields NULL for that group only."""
+    schema = Schema.build(
+        "t2", dimensions=[("g", DataType.STRING)], metrics=[("v", DataType.LONG)]
+    )
+    v = np.asarray([1, 2, None, None, 5, None], dtype=object)
+    g = np.asarray(["a", "a", "b", "b", "a", "b"], dtype=object)
+    cfg = TableConfig("t2", indexing=IndexingConfig(null_handling=True))
+    seg = SegmentBuilder(schema, cfg).build({"g": g, "v": v}, "s0")
+    eng = QueryEngine([seg])
+    res = eng.execute(SET_ON + "SELECT g, SUM(v), AVG(v), MIN(v) FROM t2 GROUP BY g ORDER BY g LIMIT 10")
+    rows = {r[0]: list(r[1:]) for r in res.rows}
+    assert rows["a"] == [8.0, 8.0 / 3, 1.0]
+    assert rows["b"] == [None, None, None]
+
+
+def test_v2_count_col_skips_nulls_plain_path(setup):
+    """v2 non-splittable grouped path: COUNT(col) skips null cells under
+    enableNullHandling (advisor r3)."""
+    from pinot_tpu.multistage import MultistageEngine
+
+    eng, df, nn = setup
+    m_eng = MultistageEngine({"t": eng.segments}, n_workers=2)
+    # MODE forces the plain (non-splittable) grouped path
+    res = m_eng.execute(
+        SET_ON + "SELECT g, COUNT(v), MODE(v) FROM t GROUP BY g ORDER BY g LIMIT 10"
+    )
+    gb = df.groupby("g")
+    for g, c, _m in res.rows:
+        assert c == int(gb.v.count()[g]), g
+    # scalar (no GROUP BY) plain path
+    res2 = m_eng.execute(SET_ON + "SELECT COUNT(v), MODE(v) FROM t")
+    assert res2.rows[0][0] == int(df.v.count())
+
+
+def test_sum_null_filter_and_empty_where(setup):
+    """Review r4: (a) SUM FILTER(WHERE no match) yields NULL under null
+    handling even when the null mask is non-empty; (b) SUM over a WHERE
+    matching zero rows yields NULL even on a column with no null vector."""
+    eng, df, nn = setup
+    r = eng.execute(SET_ON + "SELECT SUM(v) FILTER (WHERE g = 'nomatch') FROM t").rows[0]
+    assert r[0] is None
+    r = eng.execute(SET_ON + "SELECT SUM(x) FROM t WHERE g = 'nomatch'").rows[0]
+    assert r[0] is None
+    # null handling OFF keeps the 0 default
+    r = eng.execute("SELECT SUM(x) FROM t WHERE g = 'nomatch'").rows[0]
+    assert r[0] == 0.0
+
+
+def test_sum_merges_across_all_null_segment():
+    """Review r4: a segment whose values are ALL null must act as merge
+    identity, not poison the cross-segment SUM with NaN."""
+    schema = Schema.build(
+        "t3", dimensions=[("g", DataType.STRING)], metrics=[("v", DataType.LONG)]
+    )
+    cfg = TableConfig("t3", indexing=IndexingConfig(null_handling=True))
+    b = SegmentBuilder(schema, cfg)
+    seg_null = b.build(
+        {"g": np.asarray(["a", "a"], dtype=object), "v": np.asarray([None, None], dtype=object)},
+        "s_null",
+    )
+    seg_vals = b.build(
+        {"g": np.asarray(["a", "b"], dtype=object), "v": np.asarray([3, 4], dtype=object)},
+        "s_vals",
+    )
+    eng = QueryEngine([seg_null, seg_vals])
+    assert eng.execute(SET_ON + "SELECT SUM(v) FROM t3").rows[0][0] == 7.0
+    res = eng.execute(SET_ON + "SELECT g, SUM(v) FROM t3 GROUP BY g ORDER BY g LIMIT 10")
+    assert [list(r) for r in res.rows] == [["a", 3.0], ["b", 4.0]]
+
+
+def test_having_and_postagg_over_null_aggregate():
+    """Review r4: HAVING over a NULL aggregate filters the group (unknown),
+    NOT(unknown) stays unknown, and post-aggregation arithmetic propagates
+    NULL instead of raising TypeError."""
+    schema = Schema.build(
+        "t4", dimensions=[("g", DataType.STRING)], metrics=[("v", DataType.LONG)]
+    )
+    cfg = TableConfig("t4", indexing=IndexingConfig(null_handling=True))
+    seg = SegmentBuilder(schema, cfg).build(
+        {
+            "g": np.asarray(["a", "a", "b"], dtype=object),
+            "v": np.asarray([1, 2, None], dtype=object),
+        },
+        "s0",
+    )
+    eng = QueryEngine([seg])
+    res = eng.execute(SET_ON + "SELECT g, SUM(v) FROM t4 GROUP BY g HAVING SUM(v) > 0 LIMIT 10")
+    assert [list(r) for r in res.rows] == [["a", 3.0]]
+    # NOT(unknown) = unknown: group b still filtered out
+    res = eng.execute(SET_ON + "SELECT g, SUM(v) FROM t4 GROUP BY g HAVING NOT (SUM(v) > 0) LIMIT 10")
+    assert res.rows == []
+    # post-aggregation arithmetic propagates NULL
+    res = eng.execute(SET_ON + "SELECT g, SUM(v) + 1 FROM t4 GROUP BY g ORDER BY g LIMIT 10")
+    assert [list(r) for r in res.rows] == [["a", 4.0], ["b", None]]
+
+
+def test_v2_final_aggregate_null_partials():
+    """Review r4 second pass: v2 final-aggregate must finalize None/NaN SUM
+    partials to NULL (not crash), and the v2 pandas partial path must skip
+    null cells in COUNT(expr) and emit NULL for all-null SUM."""
+    from pinot_tpu.multistage import MultistageEngine
+
+    schema = Schema.build(
+        "t5", dimensions=[("g", DataType.STRING)], metrics=[("v", DataType.LONG)]
+    )
+    cfg = TableConfig("t5", indexing=IndexingConfig(null_handling=True))
+    seg = SegmentBuilder(schema, cfg).build(
+        {
+            "g": np.asarray(["a", "a", "a", "b", "b", "b"], dtype=object),
+            "v": np.asarray([1, 2, None, None, None, None], dtype=object),
+        },
+        "s0",
+    )
+    m = MultistageEngine({"t5": [seg]}, n_workers=2)
+    # pruned/empty leaf -> None partial -> NULL (used to TypeError)
+    assert m.execute(SET_ON + "SELECT SUM(v) FROM t5 WHERE g = 'zzz'").rows[0][0] is None
+    assert m.execute(SET_ON + "SELECT SUM(v) FROM t5 WHERE v IS NULL").rows[0][0] is None
+    # expression arg forces the pandas partial path: COUNT skips nulls,
+    # all-null SUM yields NULL
+    res = m.execute(
+        SET_ON + "SELECT g, COUNT(v + 0), SUM(v + 0) FROM t5 GROUP BY g ORDER BY g LIMIT 10"
+    )
+    assert [list(r) for r in res.rows] == [["a", 2, 3.0], ["b", 0, None]]
+
+
+def test_v2_having_and_postagg_over_null_aggregate():
+    """Review r4 third pass: v2 HAVING / post-agg arithmetic over NULL
+    aggregate cells must not TypeError; NULL comparisons filter the group."""
+    from pinot_tpu.multistage import MultistageEngine
+
+    schema = Schema.build(
+        "t6", dimensions=[("g", DataType.STRING)], metrics=[("v", DataType.LONG)]
+    )
+    cfg = TableConfig("t6", indexing=IndexingConfig(null_handling=True))
+    seg = SegmentBuilder(schema, cfg).build(
+        {
+            "g": np.asarray(["a", "a", "b"], dtype=object),
+            "v": np.asarray([1, 2, None], dtype=object),
+        },
+        "s0",
+    )
+    m = MultistageEngine({"t6": [seg]}, n_workers=2)
+    res = m.execute(SET_ON + "SELECT g, SUM(v) FROM t6 GROUP BY g HAVING SUM(v) > 0 ORDER BY g LIMIT 10")
+    assert [list(r) for r in res.rows] == [["a", 3.0]]
+    res = m.execute(SET_ON + "SELECT g, SUM(v) + 1 FROM t6 GROUP BY g ORDER BY g LIMIT 10")
+    assert [list(r) for r in res.rows] == [["a", 4.0], ["b", None]]
+    # plain (non-splittable) scalar path: SUM over all-null -> NULL
+    res = m.execute(SET_ON + "SELECT SUM(v), MODE(v) FROM t6 WHERE v IS NULL")
+    assert res.rows[0][0] is None
+
+
+def test_nan_data_propagates_when_null_handling_off():
+    """Review r4 third pass: with null handling OFF, a stored NaN DOUBLE
+    keeps IEEE propagation through cross-segment SUM merges (the NaN merge
+    identity only applies under null handling)."""
+    schema = Schema.build("t7", dimensions=[("g", DataType.STRING)], metrics=[("x", DataType.DOUBLE)])
+    b = SegmentBuilder(schema)
+    segA = b.build(
+        {"g": np.asarray(["a"], dtype=object), "x": np.asarray([np.nan], dtype=np.float64)}, "sA"
+    )
+    segB = b.build(
+        {"g": np.asarray(["a"], dtype=object), "x": np.asarray([5.0], dtype=np.float64)}, "sB"
+    )
+    eng = QueryEngine([segA, segB])
+    got = eng.execute("SELECT SUM(x) FROM t7").rows[0][0]
+    assert got != got  # NaN propagates
