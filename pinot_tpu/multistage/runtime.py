@@ -201,19 +201,21 @@ def eval_filter(f: ast.FilterExpr, fields: list[L.Field], df: pd.DataFrame) -> n
     if isinstance(f, ast.Compare):
         l = eval_expr(f.left, fields, df)
         r = eval_expr(f.right, fields, df)
-        na = (pd.isna(l) | pd.isna(r)).to_numpy()
-        if na.any():
-            # NULL comparison is unknown -> row filtered (three-valued
-            # semantics collapse to False here; exact Kleene NOT is only on
-            # the leaf WHERE path). Object cells holding None would
-            # TypeError under elementwise comparison, hence the split.
-            out = np.zeros(len(df), dtype=bool)
-            keep = ~na
-            with np.errstate(invalid="ignore"):
-                out[keep] = np.asarray(
-                    _CMPS[f.op](l.to_numpy()[keep], r.to_numpy()[keep])
-                ).astype(bool)
-            return out
+        if l.dtype == object or r.dtype == object:
+            # None cells (null-handling scans / NULL aggregates) would
+            # TypeError under elementwise comparison: NULL comparison is
+            # unknown -> row filtered. Restricted to object dtype so
+            # stored-NaN DOUBLEs keep IEEE comparison semantics when null
+            # handling is off (review r4).
+            na = (pd.isna(l) | pd.isna(r)).to_numpy()
+            if na.any():
+                out = np.zeros(len(df), dtype=bool)
+                keep = ~na
+                with np.errstate(invalid="ignore"):
+                    out[keep] = np.asarray(
+                        _CMPS[f.op](l.to_numpy()[keep], r.to_numpy()[keep])
+                    ).astype(bool)
+                return out
         with np.errstate(invalid="ignore"):
             return np.asarray(_CMPS[f.op](l.to_numpy(), r.to_numpy())).astype(bool)
     if isinstance(f, ast.DistinctFrom):
@@ -870,7 +872,12 @@ def _exec_partial_aggregate(node: L.Aggregate, df: pd.DataFrame, null_on: bool =
                 from pinot_tpu.query.sketches import np_hll_registers
 
                 cols.append(np_hll_registers(vv.dropna().to_numpy()))
-            else:  # percentile / percentiletdigest: exact-values partial
+            elif a.func == "percentiletdigest":
+                from pinot_tpu.query.aggregates import _td_comp
+                from pinot_tpu.query.quantile_sketch import td_from_values
+
+                cols.append(td_from_values(np.asarray(vv.dropna(), dtype=np.float64), _td_comp(a.extra)))
+            else:  # percentile: exact-values partial
                 cols.append(np.asarray(vv.dropna(), dtype=np.float64))
         return cols
 

@@ -20,9 +20,11 @@ Functions covered (reference class in parens):
   (FirstWithTimeAggregationFunction:40), distinctsum/distinctavg
   (DistinctSumAggregationFunction), bool_and/bool_or
   (BoolAndAggregationFunction), histogram (HistogramAggregationFunction),
-  percentilekll (PercentileKLLAggregationFunction — exact-values stand-in),
-  distinctcounttheta (DistinctCountThetaSketchAggregationFunction — KMV
-  bottom-k sketch), distinctcounthllplus/cpc/ull (HLL-register stand-ins),
+  percentilekll (PercentileKLLAggregationFunction — real KLL compactor
+  sketch, quantile_sketch.py), distinctcounttheta
+  (DistinctCountThetaSketchAggregationFunction — KMV bottom-k sketch),
+  distinctcounthllplus/cpc/ull (distinct_sketch.py: dense HLL++, FM85/PCSA
+  bit matrix, and Ertl UltraLogLog with an ML estimator),
   segmentpartitioneddistinctcount
   (SegmentPartitionedDistinctCountAggregationFunction).
 """
@@ -34,6 +36,29 @@ from typing import Any, Callable
 
 import numpy as np
 
+from pinot_tpu.query.distinct_sketch import (
+    cpc_estimate,
+    cpc_matrix,
+    cpc_merge,
+    hllplus_estimate,
+    hllplus_merge,
+    hllplus_registers,
+    ull_estimate,
+    ull_merge,
+    ull_registers,
+)
+from pinot_tpu.query.quantile_sketch import (
+    kll_create,
+    kll_from_values,
+    kll_merge,
+    kll_quantile,
+    kll_serialize,
+    td_create,
+    td_from_values,
+    td_merge,
+    td_quantile,
+    td_serialize,
+)
 from pinot_tpu.query.sketches import hash_any, murmur_mix32, np_hll_registers, hll_estimate
 
 THETA_K = 4096  # KMV bottom-k size (Pinot theta default nominal entries)
@@ -481,7 +506,6 @@ def _spdc_compute(v, _v2, _extra):
 # until a threshold, then a bounded quantile summary.
 
 SMART_HLL_THRESHOLD = 100_000
-SMART_TDIGEST_CAP = 4096
 
 
 def _smarthll_compute(v, _v2, _extra):
@@ -506,15 +530,6 @@ def _smarthll_merge(a, b):
 
 def _smarthll_finalize(p, _extra):
     return len(p) if isinstance(p, (set, frozenset)) else hll_estimate(np.asarray(p))
-
-
-def _td_compress(x: np.ndarray) -> np.ndarray:
-    """Bounded sorted quantile summary: evenly-spaced order statistics."""
-    x = np.sort(np.asarray(x, dtype=np.float64))
-    if len(x) <= SMART_TDIGEST_CAP:
-        return x
-    idx = np.linspace(0, len(x) - 1, SMART_TDIGEST_CAP).astype(np.int64)
-    return x[idx]
 
 
 # -- raw sketch variants -----------------------------------------------------
@@ -776,14 +791,79 @@ _RAW_HLL_SPEC = AggSpec(
     lambda e: np_hll_registers(np.zeros(0)),
 )
 
+
+def _kll_k(extra: tuple) -> int:
+    """PERCENTILEKLL(col, pct[, k]) — k rides behind the percentile."""
+    from pinot_tpu.query.quantile_sketch import KLL_DEFAULT_K
+
+    return int(extra[1]) if len(extra) > 1 and extra[1] else KLL_DEFAULT_K
+
+
+def _td_comp(extra: tuple) -> float:
+    """PERCENTILETDIGEST(col, pct[, compression])."""
+    from pinot_tpu.query.quantile_sketch import TD_DEFAULT_COMPRESSION
+
+    return float(extra[1]) if len(extra) > 1 and extra[1] else TD_DEFAULT_COMPRESSION
+
+
+def _hpp_p(extra: tuple) -> int:
+    """DISTINCTCOUNTHLLPLUS(col[, p[, sp]])."""
+    from pinot_tpu.query.distinct_sketch import HLLPLUS_P
+
+    return int(extra[0]) if extra and extra[0] else HLLPLUS_P
+
+
+_HLLPLUS_SPEC = AggSpec(
+    1,
+    lambda v, _v2, e: hllplus_registers(np.asarray(v), _hpp_p(e)),
+    hllplus_merge,
+    lambda p, e: hllplus_estimate(p),
+    lambda e: hllplus_registers(np.zeros(0), _hpp_p(e)),
+)
+_RAW_HLLPLUS_SPEC = AggSpec(
+    1,
+    lambda v, _v2, e: hllplus_registers(np.asarray(v), _hpp_p(e)),
+    hllplus_merge,
+    lambda p, e: _hex(np.asarray(p, dtype=np.int8)),
+    lambda e: hllplus_registers(np.zeros(0), _hpp_p(e)),
+)
+_ULL_SPEC = AggSpec(
+    1,
+    lambda v, _v2, e: ull_registers(np.asarray(v)),
+    ull_merge,
+    lambda p, e: ull_estimate(p),
+    lambda e: ull_registers(np.zeros(0)),
+)
+_RAW_ULL_SPEC = AggSpec(
+    1,
+    lambda v, _v2, e: ull_registers(np.asarray(v)),
+    ull_merge,
+    lambda p, e: _hex(np.asarray(p, dtype=np.int16)),
+    lambda e: ull_registers(np.zeros(0)),
+)
+_CPC_SPEC = AggSpec(
+    1,
+    lambda v, _v2, e: cpc_matrix(np.asarray(v)),
+    cpc_merge,
+    lambda p, e: cpc_estimate(p),
+    lambda e: cpc_matrix(np.zeros(0)),
+)
+_RAW_CPC_SPEC = AggSpec(
+    1,
+    lambda v, _v2, e: cpc_matrix(np.asarray(v)),
+    cpc_merge,
+    lambda p, e: _hex(np.asarray(p, dtype=np.uint64)),
+    lambda e: cpc_matrix(np.zeros(0)),
+)
+
 EXT_AGGS: dict[str, AggSpec] = {
     "distinctcountsmarthll": AggSpec(1, _smarthll_compute, _smarthll_merge, _smarthll_finalize, lambda e: set()),
     "percentilesmarttdigest": AggSpec(
         1,
-        lambda v, _v2, e: _td_compress(_f64(v)),
-        lambda a, b: _td_compress(np.concatenate([a, b])),
-        lambda p, e: exact_percentile(p, e[0]),
-        lambda e: np.zeros(0),
+        lambda v, _v2, e: td_from_values(_f64(v), _td_comp(e)),
+        td_merge,
+        lambda p, e: td_quantile(p, e[0]),
+        lambda e: td_create(_td_comp(e)),
     ),
     "sumprecision": AggSpec(1, _sumprecision_compute, lambda a, b: a + b, lambda p, e: p, lambda e: 0),
     "idset": AggSpec(
@@ -805,17 +885,17 @@ EXT_AGGS: dict[str, AggSpec] = {
     ),
     "percentilerawest": AggSpec(
         1,
-        lambda v, _v2, e: _td_compress(_f64(v)),
-        lambda a, b: _td_compress(np.concatenate([a, b])),
-        lambda p, e: _hex(np.asarray(p, dtype=np.float64)),
-        lambda e: np.zeros(0),
+        lambda v, _v2, e: td_from_values(_f64(v), _td_comp(e)),
+        td_merge,
+        lambda p, e: td_serialize(p).hex(),
+        lambda e: td_create(_td_comp(e)),
     ),
     "percentilerawtdigest": AggSpec(
         1,
-        lambda v, _v2, e: _td_compress(_f64(v)),
-        lambda a, b: _td_compress(np.concatenate([a, b])),
-        lambda p, e: _hex(np.asarray(p, dtype=np.float64)),
-        lambda e: np.zeros(0),
+        lambda v, _v2, e: td_from_values(_f64(v), _td_comp(e)),
+        td_merge,
+        lambda p, e: td_serialize(p).hex(),
+        lambda e: td_create(_td_comp(e)),
     ),
     "variance": AggSpec(1, _moments_compute(2), _moments_merge, _var_finalize(False), lambda e: (0.0, 0.0, 0.0)),
     "var_pop": AggSpec(1, _moments_compute(2), _moments_merge, _var_finalize(False), lambda e: (0.0, 0.0, 0.0)),
@@ -845,10 +925,10 @@ EXT_AGGS: dict[str, AggSpec] = {
     ),
     "percentilekll": AggSpec(
         1,
-        lambda v, _v2, e: _f64(v),
-        lambda a, b: np.concatenate([a, b]),
-        lambda p, e: _kll_percentile(p, e),
-        lambda e: np.zeros(0),
+        lambda v, _v2, e: kll_from_values(_f64(v), _kll_k(e)),
+        kll_merge,
+        lambda p, e: kll_quantile(p, e[0]),
+        lambda e: kll_create(_kll_k(e)),
     ),
     "distinctcounttheta": AggSpec(1, _theta_compute, _theta_merge_any, _theta_finalize_any, lambda e: np.zeros(0, np.uint64)),
     "arrayagg": AggSpec(1, _collect_compute, lambda a, b: a + b, _arrayagg_finalize, lambda e: []),
@@ -887,33 +967,29 @@ EXT_AGGS: dict[str, AggSpec] = {
     "stunion": AggSpec(1, _set_compute, lambda a, b: a | b, _stunion_finalize, lambda e: set()),
     "percentilerawkll": AggSpec(
         1,
-        lambda v, _v2, e: _f64(v),
-        lambda a, b: np.concatenate([a, b]),
-        lambda p, e: _hex(np.asarray(np.sort(p), dtype=np.float64)),
-        lambda e: np.zeros(0),
+        lambda v, _v2, e: kll_from_values(_f64(v), _kll_k(e)),
+        kll_merge,
+        lambda p, e: kll_serialize(p).hex(),
+        lambda e: kll_create(_kll_k(e)),
     ),
-    "distinctcountrawhllplus": _RAW_HLL_SPEC,
-    "distinctcountrawull": _RAW_HLL_SPEC,
-    "distinctcountrawcpcsketch": _RAW_HLL_SPEC,
-    "distinctcounthllplus": _HLL_SPEC,
-    "distinctcountcpc": _HLL_SPEC,
-    "distinctcountcpcsketch": _HLL_SPEC,  # SQL alias (DISTINCTCOUNTCPCSKETCH)
-    "distinctcountull": _HLL_SPEC,
+    "distinctcountrawhllplus": _RAW_HLLPLUS_SPEC,
+    "distinctcountrawull": _RAW_ULL_SPEC,
+    "distinctcountrawcpcsketch": _RAW_CPC_SPEC,
+    "distinctcounthllplus": _HLLPLUS_SPEC,
+    "distinctcountcpc": _CPC_SPEC,
+    "distinctcountcpcsketch": _CPC_SPEC,  # SQL alias (DISTINCTCOUNTCPCSKETCH)
+    "distinctcountull": _ULL_SPEC,
     "segmentpartitioneddistinctcount": AggSpec(1, _spdc_compute, lambda a, b: a + b, lambda p, e: int(p), lambda e: 0),
 }
 
 
 def exact_percentile(values: np.ndarray, pct: float) -> float:
     """Pinot PercentileAggregationFunction: value at (int)((len-1)*pct/100).
-    Shared by PERCENTILE/PERCENTILETDIGEST (reduce.py) and PERCENTILEKLL."""
+    Used by the exact PERCENTILE path (reduce.py)."""
     if len(values) == 0:
         return float("-inf")
     v = np.sort(np.asarray(values, dtype=np.float64))
     return float(v[int((len(v) - 1) * pct / 100.0)])
-
-
-def _kll_percentile(values: np.ndarray, extra: tuple) -> float:
-    return exact_percentile(values, extra[0])
 
 
 # funcs whose second SQL argument is a value expression (not a literal extra)

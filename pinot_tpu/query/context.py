@@ -284,9 +284,25 @@ def _extract_aggs(expr: Expr, out: dict[str, AggregationInfo]) -> bool:
                     "percentilerawtdigestmv",
                     "percentilerawkllmv",
                 ):
-                    if len(expr.args) != 2 or not isinstance(expr.args[1], Literal):
+                    if len(expr.args) < 2 or not isinstance(expr.args[1], Literal):
                         raise ValueError(f"{fname} requires (column, percentile) arguments")
-                    extra = (float(expr.args[1].value),)
+                    # optional 3rd literal: t-digest compression / KLL k
+                    # (PercentileTDigestAggregationFunction(col, pct, compression),
+                    #  PercentileKLLAggregationFunction(col, pct, kValue))
+                    extra = (float(expr.args[1].value),) + tuple(
+                        float(a.value) for a in expr.args[2:3] if isinstance(a, Literal)
+                    )
+                elif fname in (
+                    "distinctcounthllplus",
+                    "distinctcountrawhllplus",
+                    "distinctcounthllplusmv",
+                    "distinctcountrawhllplusmv",
+                ):
+                    # DISTINCTCOUNTHLLPLUS(col[, p[, sp]]) — sp accepted and
+                    # ignored (no sparse mode in the dense implementation)
+                    extra = tuple(
+                        int(a.value) for a in expr.args[1:3] if isinstance(a, Literal)
+                    )
                 elif fname == "distinctcounttheta" and len(expr.args) > 1:
                     # DISTINCTCOUNTTHETASKETCH(col, 'params', 'pred1', ...,
                     # 'SET_OP($1,$2)') — trailing string literals carry the

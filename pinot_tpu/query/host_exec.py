@@ -442,15 +442,39 @@ def _mv_agg_column(seg: ImmutableSegment, a) -> "object":
     return ci
 
 
-def _mv_scalar_partial(func: str, flat: np.ndarray):
+def _mv_values_to_twin(func: str, arr: np.ndarray, extra: tuple):
+    """Matched flat values -> the SV twin's partial format. The sketch
+    twins (tdigest/kll and their raw variants) now keep real bounded
+    sketches, so the MV path must build the same partial shape or the
+    reduce merge would mix value arrays with sketch tuples."""
+    arr = np.asarray(arr, dtype=np.float64)
+    if func in ("percentiletdigestmv", "percentilerawtdigestmv", "percentilerawestmv"):
+        from pinot_tpu.query.aggregates import _td_comp
+        from pinot_tpu.query.quantile_sketch import td_from_values
+
+        return td_from_values(arr, _td_comp(extra))
+    if func in ("percentilekllmv", "percentilerawkllmv"):
+        from pinot_tpu.query.aggregates import _kll_k
+        from pinot_tpu.query.quantile_sketch import kll_from_values
+
+        return kll_from_values(arr, _kll_k(extra))
+    return arr
+
+
+def _mv_scalar_partial(func: str, flat: np.ndarray, extra: tuple = ()):
     """Partial over the matched flat values, shaped like the SV twin's."""
     if func == "countmv":
         return int(len(flat))
     if func in _MV_SET_AGGS:
         return set(flat.tolist())
     if func in _MV_VALUES_AGGS:
-        return flat.astype(np.float64)
+        return _mv_values_to_twin(func, flat, extra)
     if func in _MV_REG_AGGS:
+        if func in ("distinctcounthllplusmv", "distinctcountrawhllplusmv"):
+            from pinot_tpu.query.aggregates import _hpp_p
+            from pinot_tpu.query.distinct_sketch import hllplus_registers
+
+            return hllplus_registers(flat, _hpp_p(extra))
         from pinot_tpu.query.sketches import np_hll_registers
 
         return np_hll_registers(flat)
@@ -665,7 +689,7 @@ def agg_partials(seg: ImmutableSegment, ctx: QueryContext, query_mask: np.ndarra
             ci = _mv_agg_column(seg, a)
             vm = mask[ci.flat_docids()]
             flat = _mv_flat_values(ci)[vm]
-            out.append(_mv_scalar_partial(a.func, flat))
+            out.append(_mv_scalar_partial(a.func, flat, a.extra))
             continue
         if a.func in _funnel_mod().FUNNEL_AGGS:
             out.append(_funnel_mod().segment_partial(seg, a, mask))
@@ -700,7 +724,13 @@ def agg_partials(seg: ImmutableSegment, ctx: QueryContext, query_mask: np.ndarra
                 lo, hi = bounds
                 out.append((np_est_hist(v, lo, hi), lo, hi))
             continue
-        if a.func in ("percentile", "percentiletdigest"):
+        if a.func == "percentiletdigest":
+            from pinot_tpu.query.aggregates import _td_comp
+            from pinot_tpu.query.quantile_sketch import td_from_values
+
+            out.append(td_from_values(eval_value(seg, a.arg)[mask].astype(np.float64), _td_comp(a.extra)))
+            continue
+        if a.func == "percentile":
             out.append(eval_value(seg, a.arg)[mask].astype(np.float64))
             continue
         if a.func == "mode":
@@ -875,15 +905,25 @@ def group_frame(seg: ImmutableSegment, ctx: QueryContext, mask: np.ndarray) -> p
                 out[f"a{i}p1"] = g[f"m{i}p1"].max().values
             elif a.func in _MV_VALUES_AGGS:
                 out[f"a{i}p0"] = g[f"m{i}p0"].apply(
-                    lambda s: np.concatenate([np.asarray(x, dtype=np.float64) for x in s])
+                    lambda s, _f=a.func, _e=a.extra: _mv_values_to_twin(
+                        _f, np.concatenate([np.asarray(x, dtype=np.float64) for x in s]), _e
+                    )
                 ).values
             elif a.func in _MV_REG_AGGS:
                 # group-merged value set -> registers, matching the SV twin's
                 # partial format so reduce merges via np.maximum
-                from pinot_tpu.query.sketches import np_hll_registers
+                if a.func in ("distinctcounthllplusmv", "distinctcountrawhllplusmv"):
+                    from pinot_tpu.query.aggregates import _hpp_p
+                    from pinot_tpu.query.distinct_sketch import hllplus_registers
+
+                    def _regs(v, _p=_hpp_p(a.extra)):
+                        return hllplus_registers(v, _p)
+
+                else:
+                    from pinot_tpu.query.sketches import np_hll_registers as _regs
 
                 out[f"a{i}p0"] = g[f"m{i}p0"].apply(
-                    lambda s: np_hll_registers(np.asarray(list(set().union(*s))))
+                    lambda s, _r=_regs: _r(np.asarray(list(set().union(*s))))
                 ).values
             else:  # distinct*-mv set partials
                 out[f"a{i}p0"] = g[f"m{i}p0"].agg(lambda s: set().union(*s)).values
@@ -978,7 +1018,16 @@ def group_frame(seg: ImmutableSegment, ctx: QueryContext, mask: np.ndarray) -> p
                     _hi,
                 )
             ).values
-        elif a.func in ("percentile", "percentileest", "percentiletdigest"):
+        elif a.func == "percentiletdigest":
+            from pinot_tpu.query.aggregates import _td_comp
+            from pinot_tpu.query.quantile_sketch import td_from_values
+
+            out[f"a{i}p0"] = g[f"v{i}"].apply(
+                lambda s, _na=(i in null_aggs), _c=_td_comp(a.extra): td_from_values(
+                    np.asarray(s.dropna() if _na else s, dtype=np.float64), _c
+                )
+            ).values
+        elif a.func in ("percentile", "percentileest"):
             # .apply, not .agg: pandas agg rejects array-valued reducers
             out[f"a{i}p0"] = g[f"v{i}"].apply(
                 lambda s, _na=(i in null_aggs): np.asarray(

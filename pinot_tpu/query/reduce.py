@@ -214,10 +214,14 @@ def _merge_agg_partials(func: str, a, b, null_on: bool = False):
             return a | b
         return np.maximum(a, b)
     if func == "percentileest":
-        if isinstance(a, tuple):  # (hist counts, lo, hi)
+        if isinstance(a, tuple) and len(a) == 3:  # (hist counts, lo, hi)
             return (a[0] + b[0], a[1], a[2])
         return np.concatenate([a, b])  # exact-values fallback mode
-    if func in ("percentile", "percentiletdigest"):
+    if func == "percentiletdigest":
+        from pinot_tpu.query.quantile_sketch import td_merge
+
+        return td_merge(a, b)
+    if func == "percentile":
         return np.concatenate([a, b])
     if func == "mode":
         out = dict(a)
@@ -285,7 +289,13 @@ def _finalize(a, p, null_on: bool = False):
         if null_on and len(p) == 0:
             return None
         return _exact_percentile(p, a.extra[0])
-    if func in ("percentile", "percentiletdigest"):
+    if func == "percentiletdigest":
+        from pinot_tpu.query.quantile_sketch import td_quantile
+
+        if null_on and p[1] == 0:
+            return None  # empty digest under null handling
+        return td_quantile(p, a.extra[0])
+    if func == "percentile":
         if null_on and len(p) == 0:
             return None
         return _exact_percentile(p, a.extra[0])
@@ -339,21 +349,29 @@ def _empty_partial(func: str, extra: tuple = ()):
     func = MV_TWIN.get(func, func)
     if func in EXT_AGGS:
         return EXT_AGGS[func].empty(extra)
-    return {
-        "count": 0,
-        "sum": 0.0,
-        "min": float("inf"),
-        "max": float("-inf"),
-        "avg": (0.0, 0),
-        "minmaxrange": (float("inf"), float("-inf")),
-        "distinctcount": set(),
-        "distinctcountbitmap": set(),
-        "distinctcounthll": set(),
-        "percentile": np.zeros(0),
-        "percentileest": np.zeros(0),
-        "percentiletdigest": np.zeros(0),
-        "mode": {},
-    }[func]
+    if func == "percentiletdigest":
+        from pinot_tpu.query.quantile_sketch import td_create
+
+        return td_create()
+    if func == "count":
+        return 0
+    if func == "sum":
+        return 0.0
+    if func == "min":
+        return float("inf")
+    if func == "max":
+        return float("-inf")
+    if func == "avg":
+        return (0.0, 0)
+    if func == "minmaxrange":
+        return (float("inf"), float("-inf"))
+    if func in ("distinctcount", "distinctcountbitmap", "distinctcounthll"):
+        return set()
+    if func in ("percentile", "percentileest"):
+        return np.zeros(0)
+    if func == "mode":
+        return {}
+    raise AssertionError(func)
 
 
 def reduce_group_by(ctx: QueryContext, frames: list[pd.DataFrame]) -> list[list]:
@@ -407,7 +425,13 @@ def reduce_group_by(ctx: QueryContext, frames: list[pd.DataFrame]) -> list[list]
             apply_map[f"a{i}p0"] = lambda s, _f=func: _reduce(
                 lambda x, y: _merge_agg_partials(_f, x, y), s
             )
-        elif func in ("percentile", "percentiletdigest"):
+        elif func == "percentiletdigest":
+            from functools import reduce as _reduce
+
+            from pinot_tpu.query.quantile_sketch import td_merge as _tdm
+
+            apply_map[f"a{i}p0"] = lambda s, _m=_tdm: _reduce(_m, s)
+        elif func == "percentile":
             apply_map[f"a{i}p0"] = lambda s: np.concatenate([np.asarray(x, dtype=np.float64) for x in s])
         elif func == "mode":
             apply_map[f"a{i}p0"] = _merge_counters

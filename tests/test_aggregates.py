@@ -108,10 +108,13 @@ def test_histogram(setup):
 
 
 def test_percentile_kll(setup):
+    """Real KLL sketch (round 4): the estimate must land within the k=200
+    normalized rank error bound (~1.65%), not exactly on the order stat."""
     engine, t = setup
     got = one(engine, "SELECT PERCENTILEKLL(x, 90) FROM m")
-    v = np.sort(t.x.to_numpy())
-    assert got == pytest.approx(v[int((len(v) - 1) * 0.9)])
+    v = t.x.to_numpy()
+    rank = (v < got).mean()
+    assert abs(rank - 0.90) < 0.02, (got, rank)
 
 
 def test_theta_and_hll_family(setup):

@@ -1,0 +1,262 @@
+"""Real bounded-size mergeable sketches (round 4, VERDICT item 2).
+
+Reference parity: PercentileTDigestAggregationFunction.java:60 (MergingDigest,
+compression-bounded centroids), PercentileKLLAggregationFunction.java:66
+(KllDoublesSketch, k=200 compactor levels),
+DistinctCountCPCSketchAggregationFunction.java:54 and the HLL++/ULL family.
+
+Covers: published error bounds on 10M rows, associative merging, O(k)
+partial size independent of input size, and that the engine's group-by
+path ships sketch partials (not raw value arrays).
+"""
+
+import functools
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from pinot_tpu.common import DataType, Schema
+from pinot_tpu.query import QueryEngine
+from pinot_tpu.query.quantile_sketch import (
+    kll_deserialize,
+    kll_from_values,
+    kll_merge,
+    kll_quantile,
+    kll_serialize,
+    td_deserialize,
+    td_from_values,
+    td_merge,
+    td_quantile,
+    td_serialize,
+)
+from pinot_tpu.segment import SegmentBuilder
+
+
+@pytest.fixture(scope="module")
+def big_data():
+    rng = np.random.default_rng(41)
+    return rng.lognormal(3.0, 2.0, 10_000_000)
+
+
+def _rank_err(data, est, q):
+    return abs((data < est).mean() - q)
+
+
+def test_tdigest_bound_on_10m_rows(big_data):
+    parts = [td_from_values(c) for c in np.array_split(big_data, 16)]
+    d = functools.reduce(td_merge, parts)
+    # partial size is O(compression), NOT O(n)
+    assert len(d[4]) < 2 * 100
+    assert d[1] == len(big_data)
+    for pct in (0.5, 1, 25, 50, 75, 99, 99.9):
+        assert _rank_err(big_data, td_quantile(d, pct), pct / 100) < 0.01, pct
+    # tails are tighter than the middle (the k1 scale function property)
+    assert _rank_err(big_data, td_quantile(d, 99.9), 0.999) < 0.003
+
+
+def test_kll_bound_on_10m_rows(big_data):
+    parts = [kll_from_values(c) for c in np.array_split(big_data, 16)]
+    s = functools.reduce(kll_merge, parts)
+    assert sum(len(l) for l in s[4]) < 3 * 200  # O(k) items
+    assert s[1] == len(big_data)
+    for pct in (1, 25, 50, 75, 99):
+        # k=200 -> ~1.65% normalized rank error at high confidence
+        assert _rank_err(big_data, kll_quantile(s, pct), pct / 100) < 0.0165 * 2, pct
+
+
+def test_merge_associativity():
+    rng = np.random.default_rng(5)
+    chunks = [rng.normal(0, 1, 10_000) for _ in range(8)]
+    tds = [td_from_values(c) for c in chunks]
+    klls = [kll_from_values(c) for c in chunks]
+    data = np.concatenate(chunks)
+    # left fold vs balanced tree vs reversed — all within bound of each other
+    orders = [
+        functools.reduce(td_merge, tds),
+        functools.reduce(td_merge, tds[::-1]),
+        td_merge(
+            td_merge(td_merge(tds[0], tds[1]), td_merge(tds[2], tds[3])),
+            td_merge(td_merge(tds[4], tds[5]), td_merge(tds[6], tds[7])),
+        ),
+    ]
+    for d in orders:
+        assert d[1] == len(data)
+        assert _rank_err(data, td_quantile(d, 50), 0.5) < 0.01
+    for s in (functools.reduce(kll_merge, klls), functools.reduce(kll_merge, klls[::-1])):
+        assert s[1] == len(data)
+        assert _rank_err(data, kll_quantile(s, 50), 0.5) < 0.033
+
+
+def test_serialization_roundtrip():
+    v = np.random.default_rng(3).uniform(0, 100, 5000)
+    d = td_from_values(v)
+    d2 = td_deserialize(td_serialize(d))
+    assert td_quantile(d2, 75) == td_quantile(d, 75)
+    s = kll_from_values(v)
+    s2 = kll_deserialize(kll_serialize(s))
+    assert kll_quantile(s2, 75) == kll_quantile(s, 75)
+
+
+def test_distinct_sketch_bounds():
+    from pinot_tpu.query.distinct_sketch import (
+        cpc_estimate,
+        cpc_matrix,
+        cpc_merge,
+        hllplus_estimate,
+        hllplus_merge,
+        hllplus_registers,
+        ull_estimate,
+        ull_merge,
+        ull_registers,
+    )
+
+    rng = np.random.default_rng(17)
+    for true_n in (1000, 100_000, 1_000_000):
+        vals = rng.integers(0, 2**62, true_n)
+        true = len(np.unique(vals))
+        chunks = np.array_split(vals, 4)
+        h = functools.reduce(hllplus_merge, [hllplus_registers(c) for c in chunks])
+        u = functools.reduce(ull_merge, [ull_registers(c) for c in chunks])
+        p = functools.reduce(cpc_merge, [cpc_matrix(c) for c in chunks])
+        assert abs(hllplus_estimate(h) - true) / true < 0.05  # p=14 -> ~0.8% std
+        assert abs(ull_estimate(u) - true) / true < 0.06  # p=12 ML
+        assert abs(cpc_estimate(p) - true) / true < 0.10  # lgk=10 -> ~2.4% std
+        # fixed partial sizes
+        assert h.nbytes == 1 << 14 and u.nbytes == 2 * (1 << 12) and p.nbytes == 8 * (1 << 10)
+
+
+def test_sketches_are_distinct_algorithms():
+    """CPC/ULL/HLL++ must NOT be aliases of each other or of the core HLL
+    (round-3 verdict: they were HLL register stand-ins)."""
+    from pinot_tpu.query.distinct_sketch import cpc_matrix, hllplus_registers, ull_registers
+    from pinot_tpu.query.sketches import np_hll_registers
+
+    v = np.arange(10_000)
+    shapes = {
+        "hll": np_hll_registers(v).shape,
+        "hllplus": hllplus_registers(v).shape,
+        "ull": ull_registers(v).shape,
+        "cpc": cpc_matrix(v).shape,
+    }
+    assert len({s for s in shapes.values()}) >= 3, shapes
+    # ULL registers carry indicator bits, not just max ranks
+    u = ull_registers(v)
+    assert np.any(u & 0b11), "ULL indicator bits never set"
+    # CPC rows are bit sets (multiple bits per row), not max ranks
+    c = cpc_matrix(v)
+    pop = sum(bin(int(x)).count("1") for x in c[:64])
+    assert pop > 64, "CPC rows hold at most one bit - that's not a bit matrix"
+
+
+def test_group_by_ships_sketch_partials():
+    """The host group-by path must emit tdigest/KLL sketch partials whose
+    size is bounded — not raw per-group value arrays (the round-3 failure
+    mode this round replaces)."""
+    from pinot_tpu.query.host_exec import group_frame
+
+    rng = np.random.default_rng(23)
+    n = 200_000
+    schema = Schema.build(
+        "t", dimensions=[("g", DataType.STRING)], metrics=[("x", DataType.DOUBLE)]
+    )
+    data = {
+        "g": np.asarray(["a", "b"], dtype=object)[rng.integers(0, 2, n)],
+        "x": rng.normal(50, 10, n),
+    }
+    seg = SegmentBuilder(schema).build(data, "s0")
+    eng = QueryEngine([seg])
+    ctx = eng.make_context(
+        "SELECT g, PERCENTILETDIGEST(x, 90), PERCENTILEKLL(x, 90) FROM t GROUP BY g"
+    )
+    frame = group_frame(seg, ctx, np.ones(seg.n_docs, dtype=bool))
+    for _, row in frame.iterrows():
+        td = row["a0p0"]
+        assert isinstance(td, tuple) and len(td[4]) < 200, "tdigest partial is not bounded"
+        kll = row["a1p0"]
+        assert isinstance(kll, tuple) and sum(len(l) for l in kll[4]) < 600
+
+
+def test_engine_tdigest_kll_grouped_accuracy():
+    rng = np.random.default_rng(29)
+    n = 100_000
+    schema = Schema.build(
+        "t", dimensions=[("g", DataType.STRING)], metrics=[("x", DataType.DOUBLE)]
+    )
+    g = np.asarray(["a", "b", "c"], dtype=object)[rng.integers(0, 3, n)]
+    x = rng.lognormal(2, 1, n)
+    segs = [
+        SegmentBuilder(schema).build({"g": g[: n // 2], "x": x[: n // 2]}, "s0"),
+        SegmentBuilder(schema).build({"g": g[n // 2 :], "x": x[n // 2 :]}, "s1"),
+    ]
+    eng = QueryEngine(segs)
+    df = pd.DataFrame({"g": [str(s) for s in g], "x": x})
+    res = eng.execute(
+        "SELECT g, PERCENTILETDIGEST(x, 95), PERCENTILEKLL(x, 95) FROM t GROUP BY g ORDER BY g LIMIT 10"
+    )
+    for grp, td_est, kll_est in res.rows:
+        sub = df[df.g == grp].x.to_numpy()
+        assert abs((sub < td_est).mean() - 0.95) < 0.01, grp
+        assert abs((sub < kll_est).mean() - 0.95) < 0.033, grp
+    # v2 parity: same query through the multistage engine
+    from pinot_tpu.multistage import MultistageEngine
+
+    m = MultistageEngine({"t": segs}, n_workers=2)
+    res2 = m.execute(
+        "SELECT g, PERCENTILETDIGEST(x, 95) FROM t GROUP BY g ORDER BY g LIMIT 10"
+    )
+    for grp, td_est in res2.rows:
+        sub = df[df.g == grp].x.to_numpy()
+        assert abs((sub < td_est).mean() - 0.95) < 0.01, grp
+
+
+def test_sketch_parameters_reach_the_sketch():
+    """Review r4: DISTINCTCOUNTHLLPLUS(col, p) and PERCENTILEKLL(col, pct, k)
+    literals must flow through the parser into the sketch builders."""
+    rng = np.random.default_rng(31)
+    n = 50_000
+    schema = Schema.build("t", dimensions=[("g", DataType.STRING)], metrics=[("id", DataType.LONG)])
+    seg = SegmentBuilder(schema).build(
+        {
+            "g": np.asarray(["a"], dtype=object)[np.zeros(n, dtype=int)],
+            "id": rng.integers(0, 30_000, n),
+        },
+        "s0",
+    )
+    eng = QueryEngine([seg])
+    ctx = eng.make_context("SELECT DISTINCTCOUNTHLLPLUS(id, 12), PERCENTILEKLL(id, 50, 400), PERCENTILETDIGEST(id, 50, 250) FROM t")
+    assert ctx.aggregations[0].extra == (12,)
+    assert ctx.aggregations[1].extra == (50.0, 400.0)
+    assert ctx.aggregations[2].extra == (50.0, 250.0)
+    # p=12 -> 4096-register partial; the estimate still lands in bound
+    from pinot_tpu.query.aggregates import EXT_AGGS
+
+    part = EXT_AGGS["distinctcounthllplus"].compute(seg.columns["id"].materialize(), None, (12,))
+    assert len(part) == 1 << 12
+    true = 30_000 * (1 - np.exp(-n / 30_000))  # approx distinct after collisions
+    r = eng.execute("SELECT DISTINCTCOUNTHLLPLUS(id, 12) FROM t").rows[0][0]
+    assert abs(r - true) / true < 0.08
+    # the empty partial (pruned segments) matches the sized registers
+    empty = EXT_AGGS["distinctcounthllplus"].empty((12,))
+    assert len(empty) == 1 << 12
+    EXT_AGGS["distinctcounthllplus"].merge(empty, part)  # must not shape-error
+
+
+def test_v2_nan_filter_keeps_ieee_semantics():
+    """Review r4: the v2 Compare NA-collapse must NOT swallow stored-NaN
+    DOUBLE rows when null handling is off (IEEE: NaN != 5 is True)."""
+    from pinot_tpu.multistage import MultistageEngine
+
+    schema = Schema.build("t", dimensions=[("g", DataType.STRING)], metrics=[("x", DataType.DOUBLE)])
+    seg = SegmentBuilder(schema).build(
+        {
+            "g": np.asarray(["a", "b", "c"], dtype=object),
+            "x": np.asarray([np.nan, 5.0, 4.0], dtype=np.float64),
+        },
+        "s0",
+    )
+    m = MultistageEngine({"t": [seg]}, n_workers=2)
+    # ORDER BY forces an intermediate stage with a FilterNode over the scan
+    res = m.execute("SELECT g, MODE(x) FROM t WHERE x != 5 GROUP BY g ORDER BY g LIMIT 10")
+    got = sorted(r[0] for r in res.rows)
+    assert got == ["a", "c"], got  # NaN row passes != per IEEE
