@@ -338,6 +338,94 @@ def _device_sort_perm(keys: list[np.ndarray], descs: list[bool]) -> "np.ndarray 
 DEVICE_JOIN_MAX_PAIRS = 1 << 25
 
 
+def _join_key_pair(ls: pd.Series, rs: pd.Series) -> "tuple[np.ndarray, np.ndarray] | None":
+    """Project one join-key column pair onto a COMMON comparable dtype:
+    numeric when both sides hold numbers (object cells from null-handling
+    scans / null-extended outer outputs coerce back to float), string when
+    both sides hold strings. Returns None for cross-kind pairs (int vs str)
+    so equality semantics match the pandas fallback exactly — a stringified
+    compare would both drop 1 vs 1.0 matches and invent 1 vs "1" matches
+    (review r4). Null cells may come out as NaN; callers mask them via the
+    l_null/r_null sentinels."""
+
+    def _as_numeric(s: pd.Series) -> np.ndarray | None:
+        v = s.to_numpy()
+        if v.dtype != object and np.issubdtype(v.dtype, np.number):
+            return v
+        if v.dtype == object:
+            cells = v[~pd.isna(v)]
+            # actual number objects only — pd.to_numeric alone would also
+            # parse numeric STRINGS and invent 1 == "1" matches
+            if len(cells) and all(
+                isinstance(x, (int, float, np.integer, np.floating)) and not isinstance(x, bool)
+                for x in cells[:1024]
+            ):
+                num = pd.to_numeric(s, errors="coerce")
+                if bool((num.notna() | s.isna()).all()):
+                    return num.to_numpy(np.float64)
+        return None
+
+    ln, rn = _as_numeric(ls), _as_numeric(rs)
+    if ln is not None and rn is not None:
+        return ln, rn
+    if ln is not None or rn is not None:
+        return None  # one side numeric, the other strings
+
+    def _as_str(s: pd.Series) -> np.ndarray | None:
+        v = s.to_numpy()
+        if v.dtype == object:
+            cells = v[~pd.isna(v)]
+            if len(cells) and not all(isinstance(x, str) for x in cells[:256]):
+                return None  # mixed-content object column: don't stringify
+        return np.where(pd.isna(v), "", np.asarray(v, dtype=object)).astype(str)
+
+    lstr, rstr = _as_str(ls), _as_str(rs)
+    if lstr is None or rstr is None:
+        return None
+    return lstr, rstr
+
+
+def _encode_join_keys(
+    lk: pd.DataFrame, rk: pd.DataFrame, l_null: np.ndarray, r_null: np.ndarray
+) -> "tuple[np.ndarray, np.ndarray] | None":
+    """Combine N join-key columns into ONE int64 code per row on each side —
+    the dictionary-id analog for intermediate blocks, so ANY equi-join
+    (multi-key, string keys) rides the device sort+searchsorted path.
+
+    Per key: one joint np.unique over both sides yields dense codes that are
+    equal iff the values are equal across sides; codes fold together by
+    cardinality strides with a re-compression after every fold (post-
+    compression cardinality <= n_l + n_r < 2^31, so the stride product
+    never overflows int64). Null-key rows get sentinel codes that can never
+    match. Returns None when a key's dtypes can't be joined (mixed
+    int/str object columns)."""
+    lcodes: np.ndarray | None = None
+    rcodes: np.ndarray | None = None
+    for c in lk.columns:
+        pair = _join_key_pair(lk[c], rk[c])
+        if pair is None:
+            return None  # cross-dtype (numeric vs string) keys: fallback
+        lv, rv = pair
+        both = np.concatenate([lv, rv])
+        both = np.nan_to_num(both) if both.dtype.kind == "f" else both
+        _, codes = np.unique(both, return_inverse=True)
+        codes = codes.astype(np.int64)
+        card = int(codes.max()) + 1 if len(codes) else 1
+        lc, rc = codes[: len(lv)], codes[len(lv) :]
+        if lcodes is None:
+            lcodes, rcodes = lc, rc
+        else:
+            comb = np.concatenate([lcodes, rcodes]) * card + codes
+            _, comp = np.unique(comb, return_inverse=True)
+            comp = comp.astype(np.int64)
+            lcodes, rcodes = comp[: len(lv)], comp[len(lv) :]
+    assert lcodes is not None and rcodes is not None
+    # null keys never match anything (not even other nulls)
+    lcodes = np.where(l_null, np.int64(-1), lcodes)
+    rcodes = np.where(r_null, np.int64(-2), rcodes)
+    return lcodes, rcodes
+
+
 def _device_equi_join(lk: np.ndarray, rk: np.ndarray) -> "tuple[np.ndarray, np.ndarray] | None":
     """General inner equi-join on a numeric key: device sort of the build
     side + device searchsorted range probe, then one vectorized host
@@ -972,33 +1060,64 @@ def _exec_join(node: L.Join, ctx: RunCtx) -> pd.DataFrame:
     lcols = [f"l{i}" for i in range(nl)]
     rcols = [f"r{i}" for i in range(nr)]
 
+    def _positional_frame(m: pd.DataFrame) -> pd.DataFrame:
+        return m.set_axis(range(m.shape[1]), axis=1).reset_index(drop=True)
+
     def _positional(m: pd.DataFrame) -> pd.DataFrame:
-        v = m[lcols + rcols].copy()
-        v.columns = range(nl + nr)
-        return v.reset_index(drop=True)
+        return _positional_frame(m[lcols + rcols])
 
     kind = node.kind if node.kind != "cross" else "inner"
-    if kind == "inner":
+
+    # -- device path: ANY equi-keyed join (multi-key / string keys ride the
+    # joint dense encoding; inner AND outer kinds — HashJoinOperator.java:71
+    # parity, executed as device sort + searchsorted range probe) ----------
+    if keys[0] != "__cross" and len(l) >= DEVICE_JOIN_MIN and len(r):
+        # single plain-numeric key with no nulls: probe the raw values
+        # directly — the joint np.unique encode would cost a host sort
+        # comparable to the offloaded work (review r4)
         if (
             len(keys) == 1
-            and keys[0] != "__cross"
-            and len(l) >= DEVICE_JOIN_MIN
-            and len(r)
+            and not l_null.any()
+            and not r_null.any()
+            and l[keys[0]].dtype != object
+            and r[keys[0]].dtype != object
+            and np.issubdtype(l[keys[0]].dtype, np.number)
+            and np.issubdtype(r[keys[0]].dtype, np.number)
         ):
-            # large probe side, single equi-key: device sort + range probe
-            # (general equi-join; unique build keys = the lookup-join shape)
-            dev = _device_equi_join(l[keys[0]].to_numpy(), r[keys[0]].to_numpy())
-            if dev is not None:
-                lidx, ridx = dev
-                keep = ~l_null[lidx] if len(lidx) else np.zeros(0, dtype=bool)
-                lm = l.iloc[lidx[keep]]
-                rm = r.iloc[ridx[keep]]
-                rm.index = lm.index
-                m = pd.concat([lm[lcols], rm[rcols]], axis=1)
-                out = _positional(m)
-                if node.post_filter is not None and len(out):
-                    out = out[eval_filter(node.post_filter, node.fields, out)].reset_index(drop=True)
-                return out
+            enc = (l[keys[0]].to_numpy(), r[keys[0]].to_numpy())
+        else:
+            enc = _encode_join_keys(l[keys], r[keys], l_null, r_null)
+        dev = _device_equi_join(enc[0], enc[1]) if enc is not None else None
+        if dev is not None:
+            lidx, ridx = dev
+            lm = l.iloc[lidx]
+            rm = r.iloc[ridx]
+            rm.index = lm.index
+            pairs = pd.concat([lm[lcols], rm[rcols]], axis=1)
+            if node.post_filter is not None and len(pairs):
+                view = pairs.set_axis(range(nl + nr), axis=1)
+                fm = np.asarray(eval_filter(node.post_filter, node.fields, view), bool)
+                pairs = pairs[fm]
+                lidx = lidx[fm]
+                ridx = ridx[fm]
+            if kind == "inner":
+                return _positional_frame(pairs)
+            # outer: append unmatched rows null-extended (the ON residual
+            # participated in matching above, so a residual-failed row
+            # correctly null-extends instead of dropping)
+            parts = [pairs]
+            if kind in ("left", "full"):
+                lmatched = np.zeros(len(l), dtype=bool)
+                lmatched[lidx] = True
+                parts.append(l[~lmatched][lcols])
+            if kind in ("right", "full"):
+                rmatched = np.zeros(len(r), dtype=bool)
+                rmatched[ridx] = True
+                parts.append(r[~rmatched][rcols])
+            return _positional_frame(pd.concat(parts, ignore_index=True)[lcols + rcols])
+
+    # -- pandas fallback (small blocks / unjoinable key dtypes) ------------
+    if kind == "inner":
         m = l[~l_null].merge(r[~r_null], how="inner", on=keys)
         out = _positional(m)
         if node.post_filter is not None and len(out):

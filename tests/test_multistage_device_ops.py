@@ -214,3 +214,90 @@ def test_string_sort_falls_back(setup):
     want = sorted([str(x) for x in ddf.dname], reverse=True)[:5]
     assert [r[0] for r in res.rows] == want
     assert runtime.DEVICE_OP_STATS["sort"] == before  # string keys: pandas path
+
+
+def test_device_join_string_key(setup):
+    """Round 4 (VERDICT item 3): string-keyed equi-joins ride the device path
+    via joint dense key encoding instead of dropping to pandas."""
+    engine, fdf, ddf = setup
+    before = runtime.DEVICE_OP_STATS["join"]
+    res = engine.execute(
+        "SELECT dim.did FROM dim JOIN dim AS d2 ON dim.dname = d2.dname LIMIT 10000"
+    )
+    assert runtime.DEVICE_OP_STATS["join"] > before
+    assert len(res.rows) == N_DIM  # unique names join 1:1
+
+
+def test_device_join_multi_key(setup):
+    """Multi-key equi-join (two join columns) engages the device path."""
+    engine, fdf, ddf = setup
+    before = runtime.DEVICE_OP_STATS["join"]
+    res = engine.execute(
+        "SELECT f2.val FROM fact JOIN fact AS f2 ON fact.fid = f2.fid AND fact.fdid = f2.fdid LIMIT 10000"
+    )
+    assert runtime.DEVICE_OP_STATS["join"] > before
+    assert len(res.rows) == min(N_FACT, 10000)
+
+
+def test_device_left_outer_join_matches_oracle(setup):
+    """LEFT OUTER equi-join on device: matched pairs + null-extended
+    unmatched left rows must equal the pandas oracle."""
+    engine, fdf, ddf = setup
+    before = runtime.DEVICE_OP_STATS["join"]
+    res = engine.execute(
+        "SELECT fact.fid, dim.weight FROM fact LEFT JOIN dim ON fact.fdid = dim.did LIMIT 10000"
+    )
+    assert runtime.DEVICE_OP_STATS["join"] > before
+    got = {}
+    for fid, w in res.rows:
+        got[int(fid)] = None if w is None else int(w)
+    oracle = fdf.merge(ddf, left_on="fdid", right_on="did", how="left")
+    want = {
+        int(row.fid): (None if pd.isna(row.weight) else int(row.weight))
+        for row in oracle.itertuples()
+    }
+    assert got == want
+
+
+def test_device_join_null_keys_never_match(setup, monkeypatch):
+    """Null join keys match nothing on the device path (SQL equi-join
+    semantics), including null-vs-null."""
+    from pinot_tpu.common.config import IndexingConfig, TableConfig
+    from pinot_tpu.common import DataType, Schema
+    from pinot_tpu.segment import SegmentBuilder
+
+    schema = Schema.build("n", dimensions=[("k", DataType.INT)], metrics=[("v", DataType.LONG)])
+    cfg = TableConfig("n", indexing=IndexingConfig(null_handling=True))
+    k = np.asarray([1, 2, None, None] * 40, dtype=object)
+    v = np.arange(160, dtype=np.int64)
+    seg = SegmentBuilder(schema, cfg).build({"k": k, "v": v}, "n0")
+    m = MultistageEngine({"n": [seg]}, n_workers=2)
+    before = runtime.DEVICE_OP_STATS["join"]
+    res = m.execute(
+        "SET enableNullHandling = true; "
+        "SELECT n.v FROM n JOIN n AS n2 ON n.k = n2.k LIMIT 100000"
+    )
+    assert runtime.DEVICE_OP_STATS["join"] > before
+    # 80 rows with k in {1,2}: each matches the 40 rows sharing its key
+    assert len(res.rows) == 80 * 40
+
+
+def test_join_cross_dtype_numeric_keys_match():
+    """Review r4: an object-dtype numeric key (null-handling scan output)
+    joined against a plain int64 key must match by VALUE (1.0 == 1), not by
+    stringified form — device and fallback paths must agree."""
+    from pinot_tpu.multistage.runtime import _encode_join_keys
+
+    lk = pd.DataFrame({"k": pd.Series([1.0, 2.0, None], dtype=object)})
+    rk = pd.DataFrame({"k": pd.Series(np.asarray([1, 2, 3], dtype=np.int64))})
+    l_null = lk["k"].isna().to_numpy()
+    r_null = np.zeros(3, dtype=bool)
+    enc = _encode_join_keys(lk, rk, l_null, r_null)
+    assert enc is not None
+    lcodes, rcodes = enc
+    assert lcodes[0] == rcodes[0] and lcodes[1] == rcodes[1]  # 1.0==1, 2.0==2
+    assert lcodes[2] < 0  # null never matches
+    # int vs str keys: no coercion-invented matches — encoder refuses
+    lk2 = pd.DataFrame({"k": pd.Series([1, 2], dtype=object)})
+    rk2 = pd.DataFrame({"k": pd.Series(["1", "2"], dtype=object)})
+    assert _encode_join_keys(lk2, rk2, np.zeros(2, bool), np.zeros(2, bool)) is None
