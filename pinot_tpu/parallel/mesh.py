@@ -291,6 +291,14 @@ def execute_sharded(table: ShardedTable, sql: str):
                     float(ci.stats.max_value),
                 )
     plan: SegmentPlan = plan_segment(table.proto, ctx)
+    gspec = plan.spec[2]
+    if gspec is not None and gspec[0] != "groups":
+        # fail fast with clear semantics: the sharded path has no host
+        # fallback, so a sparse/MV group spec must not reach jit tracing
+        raise ValueError(
+            "sharded execution supports dense group specs only "
+            f"(got {gspec[0]}: high-cardinality/MV GROUP BY)"
+        )
     kernel, _unpack = _sharded_kernel(plan.spec, table.mesh, table.mesh.axis_names[0])
     cols = {c: table.arrays[c] for c in plan.columns}
     if not cols:

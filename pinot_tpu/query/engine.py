@@ -284,6 +284,19 @@ class QueryEngine:
             matched, parts = out
             return self._convert_agg(seg, ctx, plan, parts), int(matched)
         if qt in (QueryType.GROUP_BY, QueryType.DISTINCT):
+            gspec = plan.spec[2]
+            if gspec is not None and gspec[0] == "groups_sparse":
+                matched, counts, parts, uniq, n_unique = out
+                if int(n_unique) > gspec[2]:
+                    # more present groups than compact slots: the kernel's
+                    # clipped slots collided — results unusable, rerun host
+                    return self._host_segment(seg, ctx, extra_mask=vmask)
+                return (
+                    self._convert_groups(
+                        seg, ctx, plan, np.asarray(counts), parts, dense_gids=np.asarray(uniq)
+                    ),
+                    int(matched),
+                )
             matched, counts, parts = out
             return self._convert_groups(seg, ctx, plan, np.asarray(counts), parts), int(matched)
         if qt == QueryType.SELECTION:
@@ -356,15 +369,19 @@ class QueryEngine:
                 out.append(float(p))
         return out
 
-    def _convert_groups(self, seg, ctx, plan: SegmentPlan, counts: np.ndarray, parts) -> pd.DataFrame:
+    def _convert_groups(
+        self, seg, ctx, plan: SegmentPlan, counts: np.ndarray, parts, dense_gids=None
+    ) -> pd.DataFrame:
+        from pinot_tpu.query.plan import group_strides
+
         pg = np.nonzero(counts)[0]
         cards = [ci.cardinality for _, ci in plan.group_cols]
-        strides = np.ones(len(cards), dtype=np.int64)
-        for i in range(len(cards) - 2, -1, -1):
-            strides[i] = strides[i + 1] * max(cards[i + 1], 1)
+        strides = group_strides(cards, np.int64)
+        # sparse compaction: slot -> its 64-bit dense gid; dense: slot IS gid
+        gids = dense_gids[pg] if dense_gids is not None else pg
         data = {}
         for i, (col, ci) in enumerate(plan.group_cols):
-            ids = (pg // strides[i]) % max(cards[i], 1)
+            ids = (gids // strides[i]) % max(cards[i], 1)
             vals = ci.dictionary.get_many(ids)
             data[f"k{i}"] = vals.astype(str) if vals.dtype == object else vals
         if ctx.query_type == QueryType.DISTINCT:

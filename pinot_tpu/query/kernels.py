@@ -556,6 +556,27 @@ def build_fn(spec: tuple):
                     doc_pad=n_padded,
                 )
                 return matched, counts, parts
+            if gspec[0] == "groups_sparse":
+                # high-cardinality product: 64-bit dense gids -> device sort
+                # -> run-length compaction into U slots -> aggregate over the
+                # compact slot space. The slot table `uniq` rides back so the
+                # host can decode keys; n_unique > U is detected host-side
+                # and falls back (slot collisions would corrupt results).
+                _, gcols, u_slots, strides_idx = gspec
+                strides = ops[strides_idx]
+                gid64 = jnp.zeros((n_padded,), dtype=jnp.int64)
+                for i, c in enumerate(gcols):
+                    gid64 = gid64 + cols[c].astype(jnp.int64) * strides[i]
+                sent = jnp.int64(1) << jnp.int64(62)
+                gm = jnp.where(mask, gid64, sent)
+                sg = jnp.sort(gm)
+                first = jnp.concatenate([jnp.ones((1,), bool), sg[1:] != sg[:-1]]) & (sg < sent)
+                n_unique = jnp.sum(first, dtype=jnp.int32)
+                slot = jnp.clip(jnp.cumsum(first.astype(jnp.int32)) - 1, 0, u_slots - 1)
+                uniq = jnp.full((u_slots,), sent, dtype=jnp.int64).at[slot].min(sg)
+                cid = jnp.clip(jnp.searchsorted(uniq, gid64), 0, u_slots - 1).astype(jnp.int32)
+                counts, parts = _grouped_all(aggs, cols, ops, mask, cid, u_slots)
+                return matched, counts, parts, uniq, n_unique
             _, gcols, ng, strides_idx = gspec
             strides = ops[strides_idx]
             gid = jnp.zeros((n_padded,), dtype=jnp.int32)
@@ -629,7 +650,7 @@ def build_masked_fn(spec: tuple):
         matched = jnp.sum(mask, dtype=jnp.int32).astype(_I)
         if gspec is None:
             return matched, tuple(_agg_scalar(a, cols, ops, mask) for a in aggs)
-        assert gspec[0] == "groups", gspec  # sharded tables reject MV columns
+        assert gspec[0] == "groups", gspec  # execute_sharded rejects MV/sparse
         _, gcols, ng, strides_idx = gspec
         strides = ops[strides_idx]
         gid = jnp.zeros((n_padded,), dtype=jnp.int32)

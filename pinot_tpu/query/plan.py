@@ -57,6 +57,15 @@ def _pow2(n: int) -> int:
     return 1 if n <= 1 else 1 << (n - 1).bit_length()
 
 
+def group_strides(cards: list, dtype=np.int64) -> np.ndarray:
+    """Row-major strides over group-key cardinalities: ids dot strides gives
+    the dense group id (DictionaryBasedGroupKeyGenerator.java:119-130)."""
+    strides = np.ones(len(cards), dtype=dtype)
+    for i in range(len(cards) - 2, -1, -1):
+        strides[i] = strides[i + 1] * max(cards[i + 1], 1)
+    return strides
+
+
 @dataclass
 class SegmentPlan:
     spec: tuple  # static, hashable — keys the kernel compile cache
@@ -881,13 +890,23 @@ class _Lowering:
         for c in cards:
             num_groups *= max(c, 1)
         if num_groups > MAX_DENSE_GROUPS:
-            raise DeviceFallback(
-                f"group cardinality product {num_groups} exceeds dense limit {MAX_DENSE_GROUPS}"
-            )
-        # strides: ids dot strides gives the dense group id
-        strides = np.ones(len(cols), dtype=np.int32)
-        for i in range(len(cols) - 2, -1, -1):
-            strides[i] = strides[i + 1] * max(cards[i + 1], 1)
+            # high-cardinality product: sort-compaction path — dense 64-bit
+            # gids are sorted on device, run-length compacted to slots, and
+            # the aggregation runs over the compact slot space. The slot
+            # budget U bounds PRESENT groups (<= n_docs), not the product.
+            # Reference: NoDictionaryMultiColumnGroupKeyGenerator.java:56
+            # (hash-table group ids) — redesigned as sort-compaction, which
+            # is what maps onto the TPU (lax.sort rides the VPU; a serial
+            # hash table would not vectorize).
+            if mv_cols:
+                raise DeviceFallback("high-cardinality MV GROUP BY runs host-side")
+            if num_groups >= (1 << 62):
+                raise DeviceFallback("group cardinality product overflows int64 gids")
+            strides64 = group_strides(cards, np.int64)
+            u = min(_pow2(max(self.seg.n_docs, 256)), MAX_DENSE_GROUPS)
+            self._group_ng = u
+            return ("groups_sparse", tuple(cols), u, self.op_idx(strides64))
+        strides = group_strides(cards, np.int32)
         # round ng to the pallas GROUP_TILE granularity: a pow2 bucket would
         # nearly double the one-hot work at e.g. 4375 groups, while 256-step
         # buckets still keep the kernel compile cache warm across near-alike
