@@ -574,22 +574,18 @@ def _leaf_filter_mask(seg, filt, null_on: bool = False) -> np.ndarray:
     from pinot_tpu.query.kernels import run_plan
     from pinot_tpu.query.plan import DeviceFallback, PlanError, plan_filter_mask
 
-    if null_on:
-        from pinot_tpu.query.context import _collect_filter_identifiers
-
-        refs: set = set()
-        _collect_filter_identifiers(filt, refs)
-        if any((seg.extras or {}).get("null", {}).get(c) is not None for c in refs):
-            # three-valued evaluation (same Kleene semantics as the v1 path);
-            # counts as a device fallback for path-assertion metrics
-            server_metrics().meter(ServerMeter.DEVICE_FALLBACKS).mark()
-            return host_exec.filter_mask_null_aware(seg, filt)
     try:
-        plan = plan_filter_mask(seg, filt)
+        # null_on lowers nullable-column predicates to the device Kleene
+        # (true, unknown) pair tree — same semantics as the v1 where_spec
+        plan = plan_filter_mask(seg, filt, kleene=null_on)
         mask = np.asarray(run_plan(plan, seg.to_device_cached()))[: seg.n_docs]
     except (DeviceFallback, PlanError):
         server_metrics().meter(ServerMeter.DEVICE_FALLBACKS).mark()
-        return host_exec.filter_mask(seg, filt)
+        return (
+            host_exec.filter_mask_null_aware(seg, filt)
+            if null_on
+            else host_exec.filter_mask(seg, filt)
+        )
     server_metrics().meter(ServerMeter.MULTISTAGE_LEAF_DEVICE_SCANS).mark()
     return mask
 

@@ -100,8 +100,47 @@ _CMPS = {
 }
 
 
+def _filter_k3(fspec, cols, ops, n_padded):
+    """Three-valued filter evaluation: returns the (true, unknown) dense
+    mask pair. Mirrors host_exec._filter3 exactly (Kleene AND: FALSE
+    dominates UNKNOWN; OR: TRUE dominates; NOT(unknown)=unknown)."""
+    kind = fspec[0]
+    if kind == "k3_and":
+        t = jnp.ones((n_padded,), dtype=bool)
+        any_u = jnp.zeros((n_padded,), dtype=bool)
+        any_false = jnp.zeros((n_padded,), dtype=bool)
+        for c in fspec[1]:
+            ct, cu = _filter_k3(c, cols, ops, n_padded)
+            t = t & ct
+            any_u = any_u | cu
+            any_false = any_false | (~ct & ~cu)
+        return t, any_u & ~any_false
+    if kind == "k3_or":
+        t = jnp.zeros((n_padded,), dtype=bool)
+        any_u = jnp.zeros((n_padded,), dtype=bool)
+        for c in fspec[1]:
+            ct, cu = _filter_k3(c, cols, ops, n_padded)
+            t = t | ct
+            any_u = any_u | cu
+        return t, any_u & ~t
+    if kind == "k3_not":
+        ct, cu = _filter_k3(fspec[1], cols, ops, n_padded)
+        return ~ct & ~cu, cu
+    if kind == "k3_exact":
+        return _filter(fspec[1], cols, ops, n_padded), jnp.zeros((n_padded,), dtype=bool)
+    if kind == "k3_leaf":
+        t = _filter(fspec[1], cols, ops, n_padded)
+        nu = ops[fspec[2]]
+        return t & ~nu, nu
+    raise AssertionError(fspec)
+
+
 def _filter(fspec, cols, ops, n_padded):
     kind = fspec[0]
+    if kind == "k3root":
+        # three-valued WHERE: only definitely-true rows survive
+        t, _u = _filter_k3(fspec[1], cols, ops, n_padded)
+        return t
     if kind == "const":
         return jnp.full((n_padded,), fspec[1], dtype=bool)
     if kind == "and":

@@ -88,6 +88,9 @@ class _Lowering:
         self.operands: list[Any] = []
         self.columns: list[str] = []
         self._group_ng = 1  # set by group_spec; agg budget checks consult it
+        # null docmask operand index per frozenset of columns: one decode +
+        # one device transfer however many Kleene leaves reference them
+        self._null_mask_ops: dict[frozenset, int] = {}
 
     # -- operand / column registration --------------------------------------
 
@@ -360,6 +363,59 @@ class _Lowering:
         if isinstance(f, ast.PredicateFunction):
             return self._predicate_function(f)
         raise PlanError(f"unsupported filter: {f}")
+
+    def where_spec(self, f: "FilterExpr | None") -> tuple:
+        """Filter lowering that keeps nullable columns ON DEVICE under
+        enableNullHandling: when any referenced column has a null vector, the
+        filter lowers to a three-valued (true, unknown) Kleene pair tree
+        (k3root) instead of forcing a host fallback (round-3 cliff). Without
+        nullable refs (or with null handling off) this is plain filter_spec."""
+        from pinot_tpu.query.context import _collect_filter_identifiers, null_handling_enabled
+
+        if f is not None and null_handling_enabled(self.ctx.options):
+            refs: set[str] = set()
+            _collect_filter_identifiers(f, refs)
+            if any((self.seg.extras or {}).get("null", {}).get(c) is not None for c in refs):
+                return ("k3root", self.filter3_spec(f))
+        return self.filter_spec(f)
+
+    def filter3_spec(self, f: FilterExpr) -> tuple:
+        """Three-valued lowering mirroring host_exec._filter3 node-for-node:
+        every leaf predicate carries the union of its referenced columns'
+        null vectors as a docmask operand; AND/OR/NOT combine (t, u) pairs
+        with Kleene semantics in the kernel (_filter_k3)."""
+        from pinot_tpu.query.context import _collect_filter_identifiers
+
+        if isinstance(f, ast.And):
+            return ("k3_and", tuple(self.filter3_spec(c) for c in f.children))
+        if isinstance(f, ast.Or):
+            return ("k3_or", tuple(self.filter3_spec(c) for c in f.children))
+        if isinstance(f, ast.Not):
+            return ("k3_not", self.filter3_spec(f.child))
+        if isinstance(f, (ast.IsNull, ast.DistinctFrom)):
+            # never unknown: these evaluate null vectors exactly
+            return ("k3_exact", self.filter_spec(f))
+        spec = self.filter_spec(f)
+        refs: set[str] = set()
+        _collect_filter_identifiers(f, refs)
+        nullable = frozenset(
+            c for c in refs if (self.seg.extras or {}).get("null", {}).get(c) is not None
+        )
+        if not nullable:
+            return ("k3_exact", spec)
+        idx = self._null_mask_ops.get(nullable)
+        if idx is None:
+            from pinot_tpu import native
+
+            nulls = None
+            for name in nullable:
+                b = native.bm_to_bool(self.seg.extras["null"][name], self.seg.n_docs)
+                nulls = b if nulls is None else (nulls | b)
+            if not nulls.any():
+                return ("k3_exact", spec)
+            idx = self.docmask_spec(nulls)[1]
+            self._null_mask_ops[nullable] = idx
+        return ("k3_leaf", spec, idx)
 
     def _distinct_from(self, f: "ast.DistinctFrom") -> tuple:
         """IS [NOT] DISTINCT FROM: (l != r AND both non-null) OR (exactly one
@@ -723,7 +779,7 @@ class _Lowering:
             import dataclasses
 
             inner = dataclasses.replace(info, filter=None)
-            return ("masked", self.filter_spec(info.filter), self.agg_spec(inner, grouped))
+            return ("masked", self.where_spec(info.filter), self.agg_spec(inner, grouped))
         if info.func == "count":
             return ("count",)
         if info.func in ("distinctcount", "distinctcountbitmap"):
@@ -1028,7 +1084,7 @@ def _like_to_regex(pattern: str) -> str:
     return "".join(out)
 
 
-def plan_filter_mask(seg: ImmutableSegment, filt, valid_mask=None) -> SegmentPlan:
+def plan_filter_mask(seg: ImmutableSegment, filt, valid_mask=None, kleene: bool = False) -> SegmentPlan:
     """Lower ONLY a filter expression into a device mask program. This is the
     multistage leaf Scan's fused-filter path (LeafStageTransferableBlock-
     Operator parity, pinot-query-runtime/.../operator/
@@ -1038,9 +1094,14 @@ def plan_filter_mask(seg: ImmutableSegment, filt, valid_mask=None) -> SegmentPla
     numpy. Raises DeviceFallback for host-only predicates."""
     from types import SimpleNamespace
 
-    shim = SimpleNamespace(table=seg.schema.name, hints={}, group_by=[])
+    shim = SimpleNamespace(
+        table=seg.schema.name,
+        hints={},
+        group_by=[],
+        options={"enablenullhandling": "true"} if kleene else {},
+    )
     lo = _Lowering(seg, shim)
-    fspec = lo.filter_spec(filt)
+    fspec = lo.where_spec(filt) if kleene else lo.filter_spec(filt)
     if valid_mask is not None:
         vm = lo.docmask_spec(np.asarray(valid_mask, dtype=bool))
         fspec = ("and", (vm, fspec))
@@ -1062,24 +1123,15 @@ def plan_segment(seg: ImmutableSegment, ctx: QueryContext, valid_mask=None) -> S
     from pinot_tpu.query.context import null_handling_enabled as _nhe
 
     if _nhe(ctx.options):
-        from pinot_tpu.query.context import _collect_filter_identifiers
-
-        refs: set[str] = set()
-        if ctx.filter is not None:
-            _collect_filter_identifiers(ctx.filter, refs)
-        for a in ctx.aggregations:
-            if a.filter is not None:
-                _collect_filter_identifiers(a.filter, refs)
-        if any((seg.extras or {}).get("null", {}).get(c) is not None for c in refs):
-            # three-valued WHERE/FILTER semantics run on the host executor
-            raise DeviceFallback("null-handling filter runs host-side (Kleene logic)")
         from pinot_tpu.query.host_exec import expr_null_mask as _enm
 
         if any(_enm(seg, g) is not None for g in ctx.group_by):
             # null keys must form their own group (reference group-by null
             # semantics); the host path substitutes None into the key column
             raise DeviceFallback("null-handling group-by key runs host-side")
-    fspec = lo.filter_spec(ctx.filter)
+    # three-valued WHERE stays on device: where_spec lowers nullable-column
+    # filters to a Kleene (true, unknown) pair tree (round-3 host cliff gone)
+    fspec = lo.where_spec(ctx.filter)
 
     if valid_mask is None:
         valid = seg.extras.get("valid_docs") if seg.extras else None

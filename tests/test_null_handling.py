@@ -489,14 +489,17 @@ def test_v2_where_kleene(setup):
     assert got2 == int((df.v <= 50).sum())
     # a SELECTION drives the leaf Scan's _leaf_filter_mask Kleene branch
     # (aggregations route through the leaf-partial engine path instead);
-    # the host fallback must mark DEVICE_FALLBACKS
+    # round 4: the Kleene pair tree lowers ON DEVICE — the leaf device-scan
+    # meter must tick, not the fallback meter
     from pinot_tpu.common.metrics import ServerMeter, server_metrics
 
-    before = server_metrics().meter(ServerMeter.DEVICE_FALLBACKS).count
+    before_dev = server_metrics().meter(ServerMeter.MULTISTAGE_LEAF_DEVICE_SCANS).count
+    before_fb = server_metrics().meter(ServerMeter.DEVICE_FALLBACKS).count
     sel = m.execute(SET_ON + "SELECT v FROM t WHERE v < 1000 LIMIT 10000")
     assert len(sel.rows) == int(df.v.notna().sum())
     assert all(r[0] is not None for r in sel.rows)
-    assert server_metrics().meter(ServerMeter.DEVICE_FALLBACKS).count > before
+    assert server_metrics().meter(ServerMeter.MULTISTAGE_LEAF_DEVICE_SCANS).count > before_dev
+    assert server_metrics().meter(ServerMeter.DEVICE_FALLBACKS).count == before_fb
 
 
 def test_agg_filter_kleene(setup):
@@ -752,3 +755,65 @@ def test_nan_data_propagates_when_null_handling_off():
     eng = QueryEngine([segA, segB])
     got = eng.execute("SELECT SUM(x) FROM t7").rows[0][0]
     assert got != got  # NaN propagates
+
+
+def test_v1_kleene_where_stays_on_device(setup, monkeypatch):
+    """Round 4 (VERDICT item 5): a WHERE over a nullable column no longer
+    evicts aggregation queries to the host — the Kleene (true, unknown)
+    pair tree lowers on device and matches the host oracle."""
+    eng, df, nn = setup
+
+    def _boom(*a, **k):
+        raise AssertionError("nullable WHERE fell back to the host executor")
+
+    monkeypatch.setattr("pinot_tpu.query.host_exec.agg_partials", _boom)
+    monkeypatch.setattr("pinot_tpu.query.host_exec.group_frame", _boom)
+    got = eng.execute(SET_ON + "SELECT COUNT(*) FROM t WHERE v < 1000").rows[0][0]
+    assert got == int(df.v.notna().sum())  # null rows are unknown -> excluded
+    got = eng.execute(SET_ON + "SELECT COUNT(*) FROM t WHERE NOT (v > 50)").rows[0][0]
+    assert got == int((df.v <= 50).sum())  # NOT(unknown) stays unknown
+    got = eng.execute(
+        SET_ON + "SELECT COUNT(*) FROM t WHERE v > 10 OR x > 1000000"
+    ).rows[0][0]
+    assert got == int((df.v > 10).sum())  # OR: TRUE dominates UNKNOWN
+    got = eng.execute(
+        SET_ON + "SELECT COUNT(*), SUM(x) FROM t WHERE v > 10 AND x < 1000000"
+    ).rows
+    want_mask = (df.v > 10) & (df.x < 1000000)
+    assert got[0][0] == int(want_mask.sum())
+    assert got[0][1] == pytest.approx(df.x[want_mask].sum())
+    # grouped query with nullable WHERE stays on device too
+    res = eng.execute(SET_ON + "SELECT g, COUNT(*) FROM t WHERE v < 1000 GROUP BY g ORDER BY g LIMIT 10")
+    gb = df[df.v.notna()].groupby("g").size()
+    for g, c in res.rows:
+        assert c == int(gb[g]), g
+
+
+def test_v1_kleene_where_matches_host_oracle(setup, monkeypatch):
+    """Device Kleene results must equal the host executor's three-valued
+    evaluation for a mix of predicate shapes (the differential guard)."""
+    import pinot_tpu.query.plan as plan_mod
+
+    eng, df, nn = setup
+    queries = [
+        "SELECT COUNT(*) FROM t WHERE v = 50",
+        "SELECT COUNT(*) FROM t WHERE v != 50",
+        "SELECT COUNT(*) FROM t WHERE v BETWEEN 10 AND 60",
+        "SELECT COUNT(*) FROM t WHERE v IN (1, 2, 3, 50)",
+        "SELECT COUNT(*) FROM t WHERE NOT (v IN (1, 2, 3))",
+        "SELECT COUNT(*) FROM t WHERE v > 20 AND g = 'a'",
+        "SELECT COUNT(*) FROM t WHERE v > 90 OR g = 'b'",
+        "SELECT COUNT(*) FROM t WHERE v IS NULL OR v > 95",
+        "SELECT COUNT(*) FROM t WHERE v IS NOT NULL AND x > 10",
+    ]
+    import pinot_tpu.query.engine as em
+
+    def _fb(*a, **k):
+        raise plan_mod.DeviceFallback("forced host for differential")
+
+    for q in queries:
+        dev = eng.execute(SET_ON + q).rows[0][0]
+        with pytest.MonkeyPatch.context() as mp:
+            mp.setattr(em, "plan_segment", _fb)
+            host = eng.execute(SET_ON + q).rows[0][0]
+        assert dev == host, q

@@ -152,7 +152,7 @@ def test_slot_overflow_falls_back_to_host(monkeypatch):
     orig = plan_mod.MAX_DENSE_GROUPS
     try:
         plan_mod.MAX_DENSE_GROUPS = 64
-        res = eng.execute("SELECT a, b, SUM(v) FROM t GROUP BY a, b ORDER BY a, b LIMIT 5".replace("t", "o"))
+        res = eng.execute("SELECT a, b, SUM(v) FROM o GROUP BY a, b ORDER BY a, b LIMIT 5")
     finally:
         plan_mod.MAX_DENSE_GROUPS = orig
     df = pd.DataFrame(data)
