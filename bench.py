@@ -23,6 +23,11 @@ import traceback
 import numpy as np
 
 HEADLINE = "ssb_q4_groupby_p50_latency"
+#: atomically-maintained copy of the most recent SUCCESSFUL on-chip run.
+#: When the driver's end-of-round invocation hits a dead tunnel, the bench
+#: emits this cached TPU evidence (flagged from_cache) instead of losing the
+#: round's on-chip numbers to a transient outage (VERDICT r3 item 1a).
+TPU_CACHE = os.path.join(os.path.dirname(os.path.abspath(__file__)), "BENCH_tpu_cache.json")
 
 
 def log(msg):
@@ -109,10 +114,86 @@ def _bench_pair(name, run_dev, run_cpu, iters, check=None):
     return out
 
 
+def _make_ssb_data(rng, n: int) -> dict:
+    """The SSB-flavored lineorder columns — ONE generator shared by the
+    smoke test and the real build so pre-flight always exercises the real
+    shapes."""
+    return {
+        "d_year": rng.integers(1992, 1999, n).astype(np.int32),
+        "c_nation": np.array([f"NATION_{i:02d}" for i in range(25)], dtype=object)[rng.integers(0, 25, n)],
+        "p_category": np.array([f"MFGR#{i//10+1}{i%10+1}" for i in range(25)], dtype=object)[
+            rng.integers(0, 25, n)
+        ],
+        "lo_revenue": rng.integers(100, 600_000, n).astype(np.int64),
+        "lo_supplycost": rng.integers(50, 100_000, n).astype(np.int64),
+        "lo_quantity": rng.integers(1, 51, n).astype(np.int32),
+    }
+
+
+def _emit_cached_tpu_result_if_any(init_err: str) -> bool:
+    """On TPU-init failure: if a prior on-chip run was cached, print THAT
+    (with provenance flags) and return True."""
+    if os.environ.get("PINOT_TPU_BENCH_NO_CACHE"):
+        return False
+    try:
+        with open(TPU_CACHE) as f:
+            cached = json.load(f)
+    except Exception:
+        return False
+    if cached.get("backend") != "tpu":
+        return False
+    cached["from_cache"] = True
+    cached["tpu_init_error_now"] = init_err
+    log(f"TPU unavailable now; emitting cached on-chip run from {cached.get('captured_at')}")
+    print(json.dumps(cached))
+    return True
+
+
+def _save_tpu_cache(result: dict) -> None:
+    """Atomic write of a successful on-chip run (temp file + rename)."""
+    try:
+        payload = dict(result)
+        payload["captured_at"] = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+        tmp = TPU_CACHE + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(payload, f)
+        os.replace(tmp, TPU_CACHE)
+        log(f"on-chip result cached to {TPU_CACHE}")
+    except Exception as e:
+        log(f"cache write failed (non-fatal): {e}")
+
+
+def _smoke_test(schema, mesh, rng):
+    """Pre-flight: run every config's query SHAPE on a tiny table so a
+    lowering/collective failure surfaces in seconds, before the multi-minute
+    16M-row build (VERDICT r3: config 2 died mid-round on a collective
+    lowering gap the bench only discovered after the build)."""
+    from pinot_tpu.parallel import build_sharded_table
+    from pinot_tpu.parallel.mesh import execute_sharded_result
+
+    n = 4096
+    tiny = build_sharded_table(schema, _make_ssb_data(rng, n), mesh, rows_per_segment=n // 2)
+    for q in (
+        "SELECT d_year, c_nation, p_category, SUM(lo_revenue - lo_supplycost) FROM lineorder "
+        "WHERE lo_quantity > 5 AND d_year BETWEEN 1993 AND 1997 "
+        "GROUP BY d_year, c_nation, p_category ORDER BY SUM(lo_revenue - lo_supplycost) DESC LIMIT 10",
+        "SELECT COUNT(*) FROM lineorder WHERE c_nation = 'NATION_07'",
+        "SELECT SUM(lo_revenue), MIN(lo_quantity), MAX(lo_revenue), AVG(lo_supplycost) "
+        "FROM lineorder WHERE d_year BETWEEN 1994 AND 1996 AND c_nation = 'NATION_03'",
+        "SELECT d_year, SUM(lo_revenue) FROM lineorder "
+        "WHERE (c_nation = 'NATION_01' OR c_nation = 'NATION_02') AND lo_quantity < 25 "
+        "GROUP BY d_year ORDER BY d_year LIMIT 20",
+    ):
+        execute_sharded_result(tiny, q)
+    log("pre-flight smoke test OK (4 sharded query shapes compiled+ran)")
+
+
 def main():
     import pinot_tpu  # noqa: F401  (x64 + platform setup)
 
     backend, devices, init_err = init_backend()
+    if init_err and _emit_cached_tpu_result_if_any(init_err):
+        return
     result = {
         "metric": HEADLINE,
         "value": None,
@@ -158,19 +239,14 @@ def main():
             ("lo_quantity", DataType.INT),
         ],
     )
-    data = {
-        "d_year": rng.integers(1992, 1999, n).astype(np.int32),
-        "c_nation": np.array([f"NATION_{i:02d}" for i in range(25)], dtype=object)[rng.integers(0, 25, n)],
-        "p_category": np.array([f"MFGR#{i//10+1}{i%10+1}" for i in range(25)], dtype=object)[
-            rng.integers(0, 25, n)
-        ],
-        "lo_revenue": rng.integers(100, 600_000, n).astype(np.int64),
-        "lo_supplycost": rng.integers(50, 100_000, n).astype(np.int64),
-        "lo_quantity": rng.integers(1, 51, n).astype(np.int32),
-    }
+    data = _make_ssb_data(rng, n)
     t = pd.DataFrame({k: (v.astype(str) if v.dtype == object else v) for k, v in data.items()})
 
     mesh = make_mesh()
+    try:
+        _smoke_test(schema, mesh, np.random.default_rng(1))
+    except Exception:
+        log(f"pre-flight smoke FAILED (continuing; per-config guards still apply): {traceback.format_exc()}")
     t0 = time.perf_counter()
     table = build_sharded_table(
         schema, data, mesh, rows_per_segment=max(1, n // max(4, len(devices)))
@@ -282,6 +358,10 @@ def main():
         log(f"config 5 FAILED: {traceback.format_exc()}")
         result["configs"]["5_startree_hll"] = {"error": str(e)}
 
+    if backend == "tpu" and any(
+        isinstance(c, dict) and "p50" in c for c in result["configs"].values()
+    ):
+        _save_tpu_cache(result)
     print(json.dumps(result))
 
 

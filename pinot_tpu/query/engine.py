@@ -19,7 +19,7 @@ import pandas as pd
 
 from pinot_tpu.query import ast, host_exec, reduce as reduce_mod
 from pinot_tpu.query.context import QueryContext, QueryType
-from pinot_tpu.query.kernels import run_plan
+from pinot_tpu.query.kernels import run_plan_packed
 from pinot_tpu.query.plan import DeviceFallback, SegmentPlan, plan_segment
 from pinot_tpu.query.result import ResultTable
 from pinot_tpu.query.sql import parse_sql
@@ -278,7 +278,7 @@ class QueryEngine:
             plan = plan_segment(seg, ctx, valid_mask=vmask)
         except DeviceFallback:
             return self._host_segment(seg, ctx, extra_mask=vmask)
-        out = run_plan(plan, self._device_seg(seg))
+        out = run_plan_packed(plan, self._device_seg(seg))
         qt = ctx.query_type
         if qt == QueryType.AGGREGATION:
             matched, parts = out

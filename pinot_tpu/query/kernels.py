@@ -709,14 +709,103 @@ def get_kernel(spec: tuple):
     return jax.jit(build_fn(spec), static_argnums=3)
 
 
-def run_plan(plan, device_segment):
-    """Execute a SegmentPlan against a DeviceSegment; returns device outputs."""
-    kernel = get_kernel(plan.spec)
+@lru_cache(maxsize=1024)
+def get_packed_kernel(spec: tuple):
+    """Jitted program whose outputs ride back in ONE float64 vector.
+
+    On tunneled/remote TPU attachments every device->host sync is a full
+    round trip (~tens of ms measured); blocking on a pytree of N output
+    arrays costs N round trips. Packing collapses a query's outputs to one
+    transfer (the same trick the sharded executor uses,
+    parallel/mesh.py:_sharded_kernel). int64 leaves split into hi/lo 32-bit
+    halves (two f64 chunks) so values past 2^53 — sparse group gids, raw
+    LONG columns — survive exactly; everything else casts to f64 losslessly.
+
+    Unpack metadata is NOT captured at trace time: output shapes can vary
+    with input shapes under one spec (select_ob's k is clipped to n_padded),
+    so _packed_meta derives them per input-shape signature via eval_shape."""
+    base = build_fn(spec)
+
+    def run(cols, ops, n_docs, n_padded):
+        leaves, _ = jax.tree.flatten(base(cols, ops, n_docs, n_padded))
+        chunks = []
+        for l in leaves:
+            flat = jnp.ravel(l)
+            if flat.dtype == jnp.int64:
+                chunks.append(jnp.floor_divide(flat, 1 << 32).astype(jnp.float64))
+                chunks.append(jnp.remainder(flat, 1 << 32).astype(jnp.float64))
+            else:
+                chunks.append(flat.astype(jnp.float64))
+        if not chunks:
+            return jnp.zeros((0,), dtype=jnp.float64)
+        return jnp.concatenate(chunks)
+
+    return jax.jit(run, static_argnums=3)
+
+
+@lru_cache(maxsize=4096)
+def _packed_meta(spec: tuple, col_sig: tuple, op_sig: tuple, n_padded: int):
+    """(treedef, [(shape, dtype)]) of a spec's output tree for one input
+    shape signature — abstract evaluation only, no compile."""
+    base = build_fn(spec)
+    cols = {k: jax.ShapeDtypeStruct(s, np.dtype(d)) for k, s, d in col_sig}
+    ops = tuple(jax.ShapeDtypeStruct(s, np.dtype(d)) for s, d in op_sig)
+    out = jax.eval_shape(
+        lambda c, o, nd: base(c, o, nd, n_padded),
+        cols,
+        ops,
+        jax.ShapeDtypeStruct((), np.int32),
+    )
+    leaves, treedef = jax.tree.flatten(out)
+    return treedef, tuple((tuple(l.shape), np.dtype(l.dtype)) for l in leaves)
+
+
+def _plan_inputs(plan, device_segment):
+    """Device column dict + operand tuple for a plan (shared by run_plan and
+    run_plan_packed; owns the no-columns '__shape__' dummy convention)."""
     cols = {c: device_segment.arrays[c] for c in plan.columns}
     if not cols:
-        # query touches no columns (e.g. SELECT COUNT(*) FROM t): feed a dummy
-        # array for shape discovery
+        # query touches no columns (e.g. SELECT COUNT(*) FROM t): feed a
+        # dummy array for shape discovery
         any_col = next(iter(device_segment.arrays))
         cols = {"__shape__": device_segment.arrays[any_col]}
     ops = tuple(jnp.asarray(o) for o in plan.operands)
+    return cols, ops
+
+
+def run_plan_packed(plan, device_segment):
+    """run_plan variant returning host numpy outputs via ONE device->host
+    transfer (see get_packed_kernel)."""
+    kernel = get_packed_kernel(plan.spec)
+    cols, ops = _plan_inputs(plan, device_segment)
+    vec = kernel(cols, ops, np.int32(device_segment.n_docs), device_segment.padded)
+    treedef, leaf_meta = _packed_meta(
+        plan.spec,
+        tuple(sorted((k, tuple(v.shape), str(np.dtype(v.dtype))) for k, v in cols.items())),
+        tuple((tuple(np.shape(o)), str(np.dtype(o.dtype))) for o in ops),
+        device_segment.padded,
+    )
+    vec = np.asarray(vec)
+    out = []
+    i = 0
+    for shape, dtype in leaf_meta:
+        size = int(np.prod(shape, dtype=np.int64)) if shape else 1
+        if dtype == np.int64:
+            hi = vec[i : i + size]
+            lo = vec[i + size : i + 2 * size]
+            i += 2 * size
+            chunk = (hi.astype(np.int64) << 32) + lo.astype(np.int64)
+        else:
+            chunk = vec[i : i + size]
+            i += size
+            if dtype != np.float64:
+                chunk = chunk.astype(dtype)
+        out.append(chunk.reshape(shape))
+    return jax.tree.unflatten(treedef, out)
+
+
+def run_plan(plan, device_segment):
+    """Execute a SegmentPlan against a DeviceSegment; returns device outputs."""
+    kernel = get_kernel(plan.spec)
+    cols, ops = _plan_inputs(plan, device_segment)
     return kernel(cols, ops, np.int32(device_segment.n_docs), device_segment.padded)
