@@ -251,6 +251,12 @@ class Server:
                             snap = c.consuming_snapshot()
                             segs.append(snap if snap is not None else c._mutable.snapshot())
                             break
+                        pend = getattr(c, "pending_sealed", lambda _n: None)(name)
+                        if pend is not None:
+                            # sealed, commit in flight (pauseless): the local
+                            # build serves until the committed copy lands
+                            segs.append(pend)
+                            break
             return segs
 
     def execute_partials(

@@ -1,0 +1,163 @@
+"""Segment completion protocol: multi-replica commit coordination with
+pauseless completion, committer-failure re-election, and peer download.
+
+Reference parity:
+- SegmentCompletionManager + the completion FSM (pinot-controller/.../helix/
+  core/realtime/SegmentCompletionManager.java, segment/CommittingSegment
+  states HOLDING -> COMMITTER_DECIDED -> COMMITTING -> COMMITTED) driving
+  the segmentConsumed / segmentCommitStart / segmentCommitEnd server calls.
+- PauselessSegmentCompletionFSM (pinot-controller/.../realtime/
+  PauselessSegmentCompletionFSM.java:46): commit METADATA first so the next
+  consuming segment opens immediately; the segment build/upload finishes
+  asynchronously.
+- Peer download (peerSegmentDownloadScheme): when the deep store has no
+  copy, non-committing replicas fetch the built segment from the committer
+  server instead.
+
+The FSM is controller-side state keyed by segment name; replicas poll it
+from their consume loops. A committer that stops responding past
+commit_timeout_s loses its claim and a HOLDING replica is promoted —
+the chaos case (replica killed mid-commit) recovers without operator
+action.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+HOLD = "HOLD"
+COMMIT = "COMMIT"
+CATCHUP = "CATCHUP"
+DISCARD_AND_DOWNLOAD = "DISCARD_AND_DOWNLOAD"
+KEEP = "KEEP"
+
+
+class SegmentCompletionManager:
+    """Controller-side completion FSM. One instance per controller; state is
+    per committing segment."""
+
+    def __init__(self, commit_timeout_s: float = 5.0):
+        self.commit_timeout_s = commit_timeout_s
+        self._lock = threading.RLock()
+        # segment -> state dict
+        self._fsm: dict[str, dict] = {}
+
+    def _state(self, segment: str) -> dict:
+        st = self._fsm.get(segment)
+        if st is None:
+            st = self._fsm[segment] = {
+                "phase": "HOLDING",
+                "offsets": {},  # server_id -> reached offset
+                "committer": None,
+                "commit_deadline": None,
+                "winning_offset": None,
+                "committed_end": None,
+                "download_from": None,
+            }
+        return st
+
+    # -- server calls --------------------------------------------------------
+
+    def segment_consumed(self, segment: str, server_id: str, offset: int) -> tuple[str, int | None]:
+        """A replica reached its end criteria at `offset`. Returns
+        (directive, target_offset). Directives: COMMIT (you are the
+        committer — build and commit), HOLD (wait; another replica is
+        committing or more replicas may arrive), CATCHUP (consume to
+        target_offset then call again), DISCARD_AND_DOWNLOAD (segment
+        already committed at target_offset — drop local rows, download)."""
+        with self._lock:
+            st = self._state(segment)
+            if st["phase"] == "COMMITTED":
+                return DISCARD_AND_DOWNLOAD, st["committed_end"]
+            st["offsets"][server_id] = max(st["offsets"].get(server_id, 0), offset)
+            if st["phase"] == "COMMITTING":
+                if st["committer"] == server_id:
+                    # this replica holds the claim (it may have been promoted
+                    # by a re-election triggered from ANOTHER replica's poll
+                    # or a failed commit_end) — (re)grant COMMIT
+                    return COMMIT, st["winning_offset"]
+                if self._commit_timed_out(st):
+                    self._reelect(segment, st, exclude=st["committer"])
+                    if st["committer"] == server_id:
+                        return COMMIT, st["winning_offset"]
+                return HOLD, st["winning_offset"]
+            # HOLDING: largest offset seen so far wins (the reference picks
+            # the largest offset among arrivals; stragglers catch up to it)
+            winning = max(st["offsets"].values())
+            if offset < winning:
+                return CATCHUP, winning
+            st["phase"] = "COMMITTING"
+            st["committer"] = server_id
+            st["winning_offset"] = winning
+            st["commit_deadline"] = time.time() + self.commit_timeout_s
+            return COMMIT, winning
+
+    def commit_heartbeat(self, segment: str, server_id: str) -> bool:
+        """Committer extends its claim during a long build/upload. Returns
+        False when the claim was lost (another replica was promoted)."""
+        with self._lock:
+            st = self._state(segment)
+            if st["phase"] != "COMMITTING" or st["committer"] != server_id:
+                return False
+            st["commit_deadline"] = time.time() + self.commit_timeout_s
+            return True
+
+    def commit_end(
+        self,
+        segment: str,
+        server_id: str,
+        end_offset: int,
+        success: bool,
+        download_from: str | None = None,
+    ) -> bool:
+        """Commit finished (or failed). On success the segment is COMMITTED
+        and held replicas are told to discard-and-download; `download_from`
+        records the committer server for peer download when the deep store
+        has no copy. Returns False if this server no longer held the claim."""
+        with self._lock:
+            st = self._state(segment)
+            if st["phase"] == "COMMITTED":
+                return False
+            if st["committer"] != server_id:
+                return False
+            if not success:
+                self._reelect(segment, st, exclude=server_id)
+                return True
+            st["phase"] = "COMMITTED"
+            st["committed_end"] = end_offset
+            st["download_from"] = download_from
+            return True
+
+    # -- introspection -------------------------------------------------------
+
+    def phase(self, segment: str) -> str:
+        with self._lock:
+            return self._state(segment)["phase"]
+
+    def download_source(self, segment: str) -> str | None:
+        with self._lock:
+            return self._state(segment)["download_from"]
+
+    # -- internals -----------------------------------------------------------
+
+    def _commit_timed_out(self, st: dict) -> bool:
+        return st["commit_deadline"] is not None and time.time() > st["commit_deadline"]
+
+    def _reelect(self, segment: str, st: dict, exclude: str | None) -> None:
+        """Committer failed (timeout or explicit failure): drop its claim
+        and promote the holding replica with the largest offset — the
+        replica-failure-during-commit path (SegmentCompletionManager re-
+        election on ControllerLeaderLocator timeouts)."""
+        st["offsets"].pop(exclude, None)
+        if not st["offsets"]:
+            # no live replicas holding: back to HOLDING; the next arrival
+            # becomes the committer
+            st["phase"] = "HOLDING"
+            st["committer"] = None
+            st["commit_deadline"] = None
+            return
+        new = max(st["offsets"], key=lambda s: st["offsets"][s])
+        st["committer"] = new
+        st["winning_offset"] = max(st["offsets"].values())
+        st["commit_deadline"] = time.time() + self.commit_timeout_s

@@ -43,8 +43,21 @@ class PartitionConsumer:
         batch_size: int = 1000,
         upsert=None,  # PartitionUpsertMetadataManager
         dedup=None,  # PartitionDedupMetadataManager
+        completion=None,  # SegmentCompletionManager (multi-replica protocol)
+        server_id: str = "server_0",
+        download_fn=None,  # fn(segment_name, download_from) -> bool
+        pauseless: bool = True,
     ):
         self.table = table
+        self.completion = completion
+        self.server_id = server_id
+        self.download_fn = download_fn or (lambda name, src: False)
+        self.pauseless = pauseless
+        #: commit phase trace for tests/observability
+        self.commit_log: list[tuple] = []
+        #: sealed-but-not-yet-committed segments, still queryable by name
+        #: (pauseless: the async build/upload must not open a visibility gap)
+        self._pending_sealed: dict[str, ImmutableSegment] = {}
         self.upsert = upsert
         self.dedup = dedup
         self.upsert_partial = bool(
@@ -121,10 +134,13 @@ class PartitionConsumer:
                 time.sleep(self.poll_interval_s)
         self.state = "STOPPED"
 
-    def _consume_batch(self) -> int:
+    def _consume_batch(self, ignore_budget: bool = False) -> int:
         # never overfill the consuming segment past its row budget: the
-        # rollover boundary must respect max_rows (segment size end-criteria)
-        budget = max(0, self.max_rows - self._mutable.n_docs)
+        # rollover boundary must respect max_rows (segment size end-criteria).
+        # ignore_budget: a CATCHUP directive must reach the winning offset
+        # even though the local segment is already full (all replicas commit
+        # the SAME row range; the budget would otherwise livelock the loop).
+        budget = self.batch_size if ignore_budget else max(0, self.max_rows - self._mutable.n_docs)
         msgs, next_off = self.consumer.fetch_messages(self.offset, min(self.batch_size, budget))
         for m in msgs:
             row = m.value
@@ -156,8 +172,12 @@ class PartitionConsumer:
 
     def _rollover(self) -> None:
         """End criteria reached: seal, commit, open the next consuming
-        segment (segment completion protocol, SegmentCompletionManager FSM
-        analog — single-replica synchronous variant)."""
+        segment. Without a completion manager this is the single-replica
+        synchronous variant; with one, the multi-replica completion
+        protocol runs (SegmentCompletionManager FSM parity)."""
+        if self.completion is not None:
+            self._rollover_protocol()
+            return
         self.state = "CONSUMING_TO_ONLINE"
         with self._lock:
             sealed = self._mutable.seal()
@@ -168,6 +188,166 @@ class PartitionConsumer:
         self.commit_fn(sealed, start, end)
         self.on_open(self._seg_name())
         self.state = "CONSUMING"
+
+    # -- multi-replica completion protocol ---------------------------------
+
+    def _rollover_protocol(self) -> None:
+        """segmentConsumed loop against the controller FSM: this replica
+        either wins the commit (build + upload + commitEnd), catches up to
+        the winning offset, or discards and downloads the committed copy
+        (SegmentCompletionManager directives)."""
+        from pinot_tpu.realtime import completion as C
+
+        seg_name = self._seg_name()
+        self.state = "HOLDING"
+        while not self._stop.is_set():
+            directive, target = self.completion.segment_consumed(
+                seg_name, self.server_id, self.offset
+            )
+            self.commit_log.append((seg_name, directive, target))
+            if directive == C.COMMIT:
+                self._protocol_commit(seg_name, target)
+                return
+            if directive == C.CATCHUP:
+                self._consume_to(target)
+                continue
+            if directive == C.DISCARD_AND_DOWNLOAD:
+                self._discard_and_download(seg_name, target)
+                return
+            time.sleep(0.02)  # HOLD
+        self.state = "STOPPED"
+
+    def _consume_to(self, target: int) -> None:
+        """Consume up to (at least) the target offset so every replica
+        commits the SAME row range (past the row budget if needed)."""
+        while self.offset < target and not self._stop.is_set():
+            if self._consume_batch(ignore_budget=True) == 0:
+                time.sleep(self.poll_interval_s)
+
+    def _protocol_commit(self, seg_name: str, target: int) -> None:
+        self.state = "COMMITTING"
+        self._consume_to(target)
+        with self._lock:
+            sealed = self._mutable.seal()
+            start, end = self._segment_start_offset, self.offset
+            self.sequence += 1
+            self._segment_start_offset = end
+            self._mutable = self._new_mutable()
+            self._pending_sealed[seg_name] = sealed
+
+        def do_commit() -> None:
+            ok = False
+            download_from = None
+            # last claim check before irreversible side effects: a commit
+            # that already lost its claim must not clobber the winner's
+            # segment metadata (review r4)
+            if not self.completion.commit_heartbeat(seg_name, self.server_id):
+                accepted = False
+            else:
+                try:
+                    self.commit_fn(sealed, start, end)
+                    ok = True
+                except Exception:
+                    # deep store unavailable: keep the built copy local and
+                    # offer it for PEER download (peerSegmentDownloadScheme)
+                    try:
+                        if self.peer_commit_fn is not None:
+                            self.peer_commit_fn(sealed, start, end)
+                            ok = True
+                            download_from = self.server_id
+                    except Exception:
+                        ok = False
+                accepted = self.completion.commit_end(seg_name, self.server_id, end, ok, download_from)
+            self.commit_log.append((seg_name, "COMMIT_END", ok and accepted))
+            if not (ok and accepted):
+                # another replica won (or will): fetch the winning copy so
+                # this server still serves the committed row range
+                self._recover_lost_commit(seg_name)
+            with self._lock:
+                self._pending_sealed.pop(seg_name, None)
+
+        if self.pauseless:
+            # pauseless completion: the next consuming segment opens and the
+            # consume loop continues while the build/upload runs on its own
+            # thread (PauselessSegmentCompletionFSM: metadata first,
+            # artifacts async); the sealed copy stays queryable from
+            # _pending_sealed meanwhile. A commit outliving the FSM's commit
+            # timeout loses its claim (commit_end -> accepted=False) and
+            # another replica is promoted — timeout IS the liveness signal.
+            self.on_open(self._seg_name())
+            self.state = "CONSUMING"
+            threading.Thread(target=do_commit, daemon=True).start()
+        else:
+            do_commit()
+            self.on_open(self._seg_name())
+            self.state = "CONSUMING"
+
+    def _recover_lost_commit(self, seg_name: str, timeout: float = 30.0) -> None:
+        """This replica's commit lost (failure or revoked claim): wait for
+        the winner to COMMIT, then download its copy."""
+        from pinot_tpu.realtime import completion as C
+
+        deadline = time.time() + timeout
+        while time.time() < deadline and not self._stop.is_set():
+            if self.completion.phase(seg_name) == "COMMITTED":
+                src = self.completion.download_source(seg_name)
+                got = self.download_fn(seg_name, src)
+                self.commit_log.append((seg_name, "RECOVERED" if got else "RECOVER_MISS", src))
+                return
+            time.sleep(0.05)
+        self.commit_log.append((seg_name, "RECOVER_TIMEOUT", None))
+
+    def pending_sealed(self, name: str) -> "ImmutableSegment | None":
+        with self._lock:
+            return self._pending_sealed.get(name)
+
+    #: optional fn(segment, start, end) registering a locally-built segment
+    #: for peer download when the deep store is unavailable
+    peer_commit_fn = None
+
+    def _discard_and_download(self, seg_name: str, committed_end: int) -> None:
+        """Another replica committed this segment: drop the locally consumed
+        rows, fetch the committed copy (deep store, else peer), and resume
+        consuming from the committed end offset."""
+        src = self.completion.download_source(seg_name)
+        with self._lock:
+            old = self._mutable
+            old_offset = self.offset
+            self.sequence += 1
+            self._segment_start_offset = committed_end
+            self.offset = committed_end
+            self._mutable = self._new_mutable()
+            if old_offset > committed_end:
+                # this replica consumed PAST the committed end: those rows
+                # already passed dedup/upsert, so re-fetching would drop
+                # them — carry them from the discarded mutable into the new
+                # consuming segment instead (never skipped, never re-deduped)
+                n_committed = self._committed_doc_count(seg_name)
+                if n_committed is not None:
+                    for i in range(n_committed, old.n_docs):
+                        row = old.get_row(i)
+                        doc_id = self._mutable.n_docs
+                        self._mutable.index(row)
+                        if self.upsert is not None:
+                            self.upsert.add_row(self._mutable.name, doc_id, dict(row))
+                    self.offset = old_offset
+                    self._segment_start_offset = committed_end
+        got = self.download_fn(seg_name, src)
+        self.commit_log.append((seg_name, "DOWNLOADED" if got else "DOWNLOAD_MISS", src))
+        self.on_open(self._seg_name())
+        self.state = "CONSUMING"
+
+    #: fn(segment_name) -> committed doc count (from controller metadata);
+    #: wired by the table manager, used by the offset-divergence carry-over
+    committed_docs_fn = None
+
+    def _committed_doc_count(self, seg_name: str) -> int | None:
+        if self.committed_docs_fn is None:
+            return None
+        try:
+            return self.committed_docs_fn(seg_name)
+        except Exception:
+            return None
 
     # -- query view ----------------------------------------------------------
 
@@ -197,9 +377,13 @@ class RealtimeTableManager:
         config: TableConfig,
         stream: StreamFactory,
         max_rows_per_segment: int = 100_000,
+        completion=None,  # shared SegmentCompletionManager for multi-replica
+        pauseless: bool = True,
     ):
         self.controller = controller
         self.server = server
+        self.completion = completion
+        self.pauseless = pauseless
         self.schema = schema
         self.config = config
         self.table = config.table_name
@@ -235,22 +419,29 @@ class RealtimeTableManager:
                 self.dedup_managers[p] = dedup
             start_offset, start_seq = self._recover(p)
             self._bootstrap_upsert(p, upsert)
-            self.consumers.append(
-                PartitionConsumer(
-                    self.table,
-                    p,
-                    schema,
-                    config,
-                    stream.create_consumer(p),
-                    self._make_commit(p),
-                    on_open=self._make_on_open(),
-                    start_offset=start_offset,
-                    start_sequence=start_seq,
-                    max_rows_per_segment=max_rows_per_segment,
-                    upsert=upsert,
-                    dedup=dedup,
-                )
+            pc = PartitionConsumer(
+                self.table,
+                p,
+                schema,
+                config,
+                stream.create_consumer(p),
+                self._make_commit(p),
+                on_open=self._make_on_open(),
+                start_offset=start_offset,
+                start_sequence=start_seq,
+                max_rows_per_segment=max_rows_per_segment,
+                upsert=upsert,
+                dedup=dedup,
+                completion=completion,
+                server_id=server.server_id,
+                download_fn=self._make_download(p),
+                pauseless=pauseless,
             )
+            pc.peer_commit_fn = self._make_peer_commit(p)
+            pc.committed_docs_fn = lambda name: (
+                (self.controller.segment_metadata(self.table, name) or {}).get("numDocs")
+            )
+            self.consumers.append(pc)
 
     def _make_on_open(self):
         def on_open(segment_name: str) -> None:
@@ -337,6 +528,55 @@ class RealtimeTableManager:
             self._record_stats_history(segment)
 
         return commit
+
+    def _make_peer_commit(self, partition: int):
+        """Deep store unavailable: register the built segment on THIS server
+        and write metadata pointing peers at it (peerSegmentDownloadScheme —
+        reference SegmentCompletionUtils peer download URI)."""
+
+        def peer_commit(segment: ImmutableSegment, start_off: int, end_off: int) -> None:
+            self.on_segment_loaded(segment)  # attach upsert validity first
+            self.server.add_segment_object(self.table, segment)
+            meta = {
+                "numDocs": segment.n_docs,
+                "startOffset": start_off,
+                "endOffset": end_off,
+                "partition": partition,
+                "servers": [self.server.server_id],
+                "peerDownload": self.server.server_id,
+            }
+            self.controller.store.set(f"/tables/{self.table}/segments/{segment.name}", meta)
+            self._record_stats_history(segment)
+
+        return peer_commit
+
+    def _make_download(self, partition: int):
+        """Fetch a committed segment this replica did NOT build: local copy
+        (the controller may have pushed one) -> deep store -> peer server."""
+
+        def download(segment_name: str, download_from: str | None) -> bool:
+            if self.server.get_segment_object(self.table, segment_name) is not None:
+                return True  # controller push already delivered it
+            meta = self.controller.segment_metadata(self.table, segment_name) or {}
+            loc = meta.get("location")
+            if loc:
+                try:
+                    self.server.add_segment(self.table, segment_name, loc)
+                    return True
+                except Exception:
+                    pass
+            src = download_from or meta.get("peerDownload")
+            if src:
+                peer = self.controller.servers().get(src)
+                if peer is not None:
+                    seg = peer.get_segment_object(self.table, segment_name)
+                    if seg is not None:
+                        self.on_segment_loaded(seg)  # attach upsert validity
+                        self.server.add_segment_object(self.table, seg)
+                        return True
+            return False
+
+        return download
 
     # -- stats history (RealtimeSegmentStatsHistory parity: per-column stats
     # persisted across seals, used to provision the next consuming segment) --

@@ -1,0 +1,268 @@
+"""Multi-replica segment completion protocol (round 4, VERDICT item 6).
+
+Reference parity: SegmentCompletionManager FSM (pinot-controller/.../helix/
+core/realtime/SegmentCompletionManager.java), PauselessSegmentCompletionFSM
+(PauselessSegmentCompletionFSM.java:46), and peerSegmentDownloadScheme.
+
+Covers: exactly-one-committer election, committer failure mid-commit with
+re-election (the chaos case), peer download when the deep store is
+unavailable, and pauseless completion (next segment consumes while the
+commit is in flight).
+"""
+
+import threading
+import time
+
+import pytest
+
+from pinot_tpu.common import DataType, Schema, TableConfig, TableType
+from pinot_tpu.cluster import Controller, PropertyStore, Server
+from pinot_tpu.realtime import InMemoryStream, RealtimeTableManager
+from pinot_tpu.realtime.completion import SegmentCompletionManager
+
+ROWS_PER_SEG = 40
+
+
+def _schema():
+    return Schema.build(
+        "ev",
+        dimensions=[("kind", DataType.STRING)],
+        metrics=[("value", DataType.LONG)],
+    )
+
+
+def _cluster(tmp_path, commit_timeout=2.0):
+    store = PropertyStore()
+    ctrl = Controller(store, tmp_path / "deep")
+    ctrl.add_schema(_schema())
+    ctrl.add_table(TableConfig("ev", table_type=TableType.REALTIME, replication=2))
+    stream = InMemoryStream(partitions=1)
+    completion = SegmentCompletionManager(commit_timeout_s=commit_timeout)
+    servers, managers = [], []
+    for i in range(2):
+        srv = Server(f"server_{i}")
+        ctrl.register_server(srv.server_id, handle=srv)
+        mgr = RealtimeTableManager(
+            ctrl,
+            srv,
+            _schema(),
+            TableConfig("ev", table_type=TableType.REALTIME, replication=2),
+            stream,
+            max_rows_per_segment=ROWS_PER_SEG,
+            completion=completion,
+        )
+        servers.append(srv)
+        managers.append(mgr)
+    return ctrl, stream, completion, servers, managers
+
+
+def _produce(stream, n, start=0):
+    for i in range(start, start + n):
+        stream.produce(0, {"kind": f"k{i % 3}", "value": i})
+
+
+def _wait(pred, timeout=15.0, msg="condition"):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if pred():
+            return
+        time.sleep(0.03)
+    raise AssertionError(f"timed out waiting for {msg}")
+
+
+def test_exactly_one_committer_other_downloads(tmp_path):
+    ctrl, stream, completion, servers, managers = _cluster(tmp_path)
+    _produce(stream, ROWS_PER_SEG + 5)
+    for m in managers:
+        m.start()
+    try:
+        seg0 = "ev__0__0"
+        _wait(lambda: completion.phase(seg0) == "COMMITTED", msg="segment committed")
+        # both servers end up serving the committed segment
+        _wait(
+            lambda: all(seg0 in s.segments_of("ev") for s in servers),
+            msg="both replicas hold the committed copy",
+        )
+        # exactly one replica committed; the other downloaded. The controller
+        # push can deliver the copy before the second replica's protocol
+        # turn, so wait for the protocol outcome itself, not just presence.
+        def outcomes():
+            out = []
+            for m in managers:
+                log = list(m.consumers[0].commit_log)
+                out.append(
+                    "commit" if any(e[1] == "COMMIT_END" and e[2] for e in log) else
+                    "download" if any(e[1] == "DOWNLOADED" for e in log) else "none"
+                )
+            return sorted(out)
+
+        _wait(lambda: outcomes() == ["commit", "download"], msg=f"outcomes {outcomes()}")
+        meta = ctrl.segment_metadata("ev", seg0)
+        assert meta["endOffset"] == ROWS_PER_SEG
+        # both consumers resumed at the committed end offset
+        for m in managers:
+            assert m.consumers[0]._segment_start_offset == ROWS_PER_SEG
+    finally:
+        for m in managers:
+            m.stop()
+
+
+def test_committer_killed_mid_commit_reelection(tmp_path):
+    """The chaos case: the elected committer dies between winning the claim
+    and uploading. The FSM times out its claim and promotes the holding
+    replica, which completes the segment."""
+    ctrl, stream, completion, servers, managers = _cluster(tmp_path, commit_timeout=0.7)
+
+    # server_0's commit hangs forever (killed mid-commit); make sure IT wins
+    # the claim by letting it reach the end criteria first
+    hang = threading.Event()
+    orig_commit = managers[0].consumers[0].commit_fn
+
+    def dying_commit(seg, start, end):
+        hang.set()
+        time.sleep(3600)  # never returns: the replica is dead mid-commit
+
+    managers[0].consumers[0].commit_fn = dying_commit
+    _produce(stream, ROWS_PER_SEG + 5)
+    managers[0].start()
+    _wait(hang.wait, timeout=15.0, msg="committer entered its commit")
+    managers[1].start()
+    try:
+        seg0 = "ev__0__0"
+        _wait(
+            lambda: completion.phase(seg0) == "COMMITTED",
+            timeout=20.0,
+            msg="re-elected replica committed",
+        )
+        meta = ctrl.segment_metadata("ev", seg0)
+        assert meta is not None and meta["endOffset"] == ROWS_PER_SEG
+        # the survivor (server_1) must hold the committed copy
+        assert seg0 in servers[1].segments_of("ev")
+        log1 = managers[1].consumers[0].commit_log
+        assert any(e[1] == "COMMIT_END" and e[2] for e in log1), log1
+    finally:
+        for m in managers:
+            for c in m.consumers:
+                c.stop(timeout=0.3)  # the dead committer thread never joins
+
+
+def test_peer_download_when_deep_store_unavailable(tmp_path, monkeypatch):
+    """Deep store writes fail: the committer registers its local build for
+    peer download and the other replica fetches it from the peer server."""
+    ctrl, stream, completion, servers, managers = _cluster(tmp_path)
+
+    def broken_upload(table, segment):
+        raise OSError("deep store unavailable")
+
+    monkeypatch.setattr(ctrl, "upload_segment", broken_upload)
+    _produce(stream, ROWS_PER_SEG + 5)
+    for m in managers:
+        m.start()
+    try:
+        seg0 = "ev__0__0"
+        _wait(lambda: completion.phase(seg0) == "COMMITTED", msg="peer commit")
+        meta = ctrl.segment_metadata("ev", seg0)
+        assert meta is not None and meta.get("peerDownload") in ("server_0", "server_1")
+        _wait(
+            lambda: all(s.get_segment_object("ev", seg0) is not None for s in servers),
+            msg="peer download delivered the segment to the other replica",
+        )
+        downloader = [m for m in managers if any(e[1] == "DOWNLOADED" for e in m.consumers[0].commit_log)]
+        assert len(downloader) == 1
+    finally:
+        for m in managers:
+            m.stop()
+
+
+def test_pauseless_consumption_continues_during_commit(tmp_path):
+    """Pauseless: the next consuming segment opens and ingests while the
+    previous segment's commit is still in flight."""
+    # generous commit timeout: the held commit must NOT lose its claim
+    ctrl, stream, completion, servers, managers = _cluster(tmp_path, commit_timeout=30.0)
+    mgr = managers[0]  # single replica is enough here
+    committing = threading.Event()
+    release = threading.Event()
+    orig = mgr.consumers[0].commit_fn
+
+    def slow_commit(seg, start, end):
+        committing.set()
+        assert release.wait(20.0)
+        orig(seg, start, end)
+
+    mgr.consumers[0].commit_fn = slow_commit
+    _produce(stream, ROWS_PER_SEG + 20)
+    mgr.start()
+    try:
+        _wait(committing.wait, timeout=15.0, msg="commit started")
+        # while the commit hangs, the NEXT segment must be consuming rows
+        _wait(
+            lambda: mgr.consumers[0]._mutable.n_docs > 0
+            and mgr.consumers[0]._seg_name() == "ev__0__1",
+            msg="next segment consuming during in-flight commit",
+        )
+        assert completion.phase("ev__0__0") == "COMMITTING"
+        release.set()
+        _wait(lambda: completion.phase("ev__0__0") == "COMMITTED", msg="commit finished")
+    finally:
+        release.set()
+        mgr.stop()
+
+
+def test_pauseless_sealed_segment_stays_queryable(tmp_path):
+    """Review r4: during the async build/upload the sealed rows must still
+    be queryable on this server (no visibility gap) via the pending-sealed
+    registry."""
+    ctrl, stream, completion, servers, managers = _cluster(tmp_path, commit_timeout=30.0)
+    mgr = managers[0]
+    committing = threading.Event()
+    release = threading.Event()
+    orig = mgr.consumers[0].commit_fn
+
+    def slow_commit(seg, start, end):
+        committing.set()
+        assert release.wait(20.0)
+        orig(seg, start, end)
+
+    mgr.consumers[0].commit_fn = slow_commit
+    _produce(stream, ROWS_PER_SEG + 10)
+    mgr.start()
+    try:
+        _wait(committing.wait, timeout=15.0, msg="commit started")
+        # the sealed-but-uncommitted segment resolves by name on the server
+        seg0 = "ev__0__0"
+        segs = servers[0]._resolve_segments("ev", [seg0])
+        assert len(segs) == 1 and segs[0].n_docs == ROWS_PER_SEG
+        release.set()
+        _wait(lambda: completion.phase(seg0) == "COMMITTED", msg="commit finished")
+        # after commit the hosted copy takes over; pending entry is gone
+        _wait(lambda: mgr.consumers[0].pending_sealed(seg0) is None, msg="pending cleared")
+        segs = servers[0]._resolve_segments("ev", [seg0])
+        assert len(segs) == 1 and segs[0].n_docs == ROWS_PER_SEG
+    finally:
+        release.set()
+        mgr.stop()
+
+
+def test_catchup_directive_reaches_winning_offset(tmp_path):
+    """A straggler replica that reaches end-criteria at a LOWER offset gets
+    CATCHUP and must actually reach the winning offset (review r4: the row
+    budget used to livelock the catch-up loop)."""
+    from pinot_tpu.realtime.completion import CATCHUP, COMMIT
+
+    completion = SegmentCompletionManager(commit_timeout_s=5.0)
+    # replica B arrives first at offset 40; straggler A arrives at 35
+    d, t = completion.segment_consumed("s__0__0", "B", 40)
+    assert d == COMMIT and t == 40
+    d, t = completion.segment_consumed("s__0__0", "A", 35)
+    assert d == "HOLD"
+    # verify the consumer-side loop consumes past its budget: simulate via a
+    # real consumer whose mutable is already full
+    ctrl, stream, _completion, servers, managers = _cluster(tmp_path)
+    c = managers[0].consumers[0]
+    _produce(stream, ROWS_PER_SEG + 10)
+    # fill to the budget, then force a catch-up past it
+    while c._mutable.n_docs < ROWS_PER_SEG:
+        c._consume_batch()
+    assert c._consume_batch() == 0  # budget exhausted: normal fetch stalls
+    c._consume_to(ROWS_PER_SEG + 5)
+    assert c.offset >= ROWS_PER_SEG + 5  # ignore_budget path made progress
