@@ -81,16 +81,37 @@ def build_sharded_table(
     # table-level encoding via one builder pass over the whole table
     proto = SegmentBuilder(schema, table_config).build(data, "proto")
     pad = padded_len(rows_per_segment)
+    has_mv = any(ci.is_mv for ci in proto.columns.values())
+    if has_mv and pad == rows_per_segment:
+        # MV flat-padding positions carry docid pad-1, which must be an
+        # ALWAYS-invalid doc slot — guarantee one exists
+        pad = padded_len(rows_per_segment + 1)
 
     arrays = {}
     axis = mesh.axis_names[0]
     sharding = NamedSharding(mesh, P(axis, None))
     for col, ci in proto.columns.items():
         if ci.is_mv:
-            raise ValueError(
-                f"sharded tables do not support MV column {col!r} yet; "
-                "use per-segment QueryEngine for MV queries"
-            )
+            # flattened-MV staging: per-segment flat id slices + LOCAL
+            # owning-doc ids, both padded to one F_pad. Padding docids point
+            # at slot pad-1 (invalid in every segment), so padding values
+            # can never contribute to a doc mask or an aggregate.
+            off = ci.offsets()
+            fdoc = ci.flat_docids()
+            ids = ci.forward
+            seg_bounds = [
+                (int(off[min(s * rows_per_segment, n)]), int(off[min((s + 1) * rows_per_segment, n)]))
+                for s in range(n_seg)
+            ]
+            f_pad = padded_len(max(1, max(b - a for a, b in seg_bounds)))
+            st_ids = np.zeros((n_seg, f_pad), dtype=ids.dtype)
+            st_docs = np.full((n_seg, f_pad), pad - 1, dtype=np.int32)
+            for sidx, (a, b) in enumerate(seg_bounds):
+                st_ids[sidx, : b - a] = ids[a:b]
+                st_docs[sidx, : b - a] = fdoc[a:b] - sidx * rows_per_segment
+            arrays[col] = jax.device_put(st_ids, sharding)
+            arrays[f"{col}!docs"] = jax.device_put(st_docs, sharding)
+            continue
         fwd = ci.forward
         if fwd.dtype == np.int64 and len(fwd):
             # lossless narrowing (DeviceSegment.to_device parity): i64 is
@@ -201,7 +222,7 @@ def _combine_tree(spec: tuple, matched, counts, parts, axis_name: str | None, lo
 
 
 @lru_cache(maxsize=256)
-def _sharded_kernel(spec: tuple, mesh: Mesh, axis: str):
+def _sharded_kernel(spec: tuple, mesh: Mesh, axis: str, doc_pad: int):
     """vmapped per-segment kernel + local reduce + ICI collective, wrapped in
     shard_map over the segment axis and jitted.
 
@@ -221,15 +242,22 @@ def _sharded_kernel(spec: tuple, mesh: Mesh, axis: str):
     pack_meta: dict = {}
 
     def per_shard(cols, ops, n_docs):
-        # cols: (S_local, P). Aggregates are order-independent, so flatten
-        # the local segments into ONE doc vector with a per-segment validity
-        # mask — one wide kernel call instead of a vmap over segments.
-        some = next(iter(cols.values()))
-        s_local, p_len = some.shape
-        flat = {k: v.reshape(s_local * p_len) for k, v in cols.items()}
+        # cols: doc-aligned (S_local, P) plus MV flats (S_local, F_pad).
+        # Aggregates are order-independent, so flatten the local segments
+        # into ONE doc vector with a per-segment validity mask — one wide
+        # kernel call instead of a vmap over segments. MV owning-doc ids
+        # shift by each segment's doc offset so they index the flat space.
+        s_local = next(iter(cols.values())).shape[0]
+        flat = {}
+        for k, v in cols.items():
+            if k.endswith("!docs"):
+                offs = (jnp.arange(s_local, dtype=v.dtype) * v.dtype.type(doc_pad))[:, None]
+                flat[k] = (v + offs).reshape(-1)
+            else:
+                flat[k] = v.reshape(s_local * v.shape[1])
         valid = (
-            jnp.arange(p_len, dtype=jnp.int32)[None, :] < n_docs[:, None]
-        ).reshape(s_local * p_len)
+            jnp.arange(doc_pad, dtype=jnp.int32)[None, :] < n_docs[:, None]
+        ).reshape(s_local * doc_pad)
         out = base(flat, ops, valid)
         if grouped:
             matched, counts, parts = out
@@ -272,6 +300,25 @@ def _sharded_kernel(spec: tuple, mesh: Mesh, axis: str):
     return jax.jit(run), unpack
 
 
+def _collect_mv_nv_indices(node, out: set) -> None:
+    """Operand indices holding MV flat-value counts. In the sharded flat
+    space those counts (taken from the whole-table proto) are meaningless —
+    validity is enforced by the padding-docid trick instead, so the caller
+    neutralizes them to 'all positions valid'."""
+    if not isinstance(node, tuple) or not node:
+        return
+    k = node[0]
+    if k == "mv_any":
+        out.add(node[3])
+    elif k == "mv_count":
+        out.add(node[2])
+    elif k in ("mv_sum", "mv_min", "mv_max", "mv_avg", "mv_distinct_ids"):
+        out.add(node[3])
+    for c in node:
+        if isinstance(c, tuple):
+            _collect_mv_nv_indices(c, out)
+
+
 def execute_sharded(table: ShardedTable, sql: str):
     """Execute an aggregation / group-by query over the sharded table.
     Returns the same device partial structure as the single-segment kernel,
@@ -299,11 +346,20 @@ def execute_sharded(table: ShardedTable, sql: str):
             "sharded execution supports dense group specs only "
             f"(got {gspec[0]}: high-cardinality/MV GROUP BY)"
         )
-    kernel, _unpack = _sharded_kernel(plan.spec, table.mesh, table.mesh.axis_names[0])
+    kernel, _unpack = _sharded_kernel(plan.spec, table.mesh, table.mesh.axis_names[0], table.padded)
     cols = {c: table.arrays[c] for c in plan.columns}
     if not cols:
         cols = {"__shape__": next(iter(table.arrays.values()))}
-    ops = tuple(jnp.asarray(o) for o in plan.operands)
+    operands = list(plan.operands)
+    nv_idx: set = set()
+    _collect_mv_nv_indices(plan.spec, nv_idx)
+    for i in nv_idx:
+        # sharded flat positions exceed the proto's table-level flat count
+        # whenever a device holds >1 segment; padding positions are already
+        # excluded via invalid padding docids, so the count check must pass
+        # everywhere (review r4: per-shard flat offsets vs table nv)
+        operands[i] = np.int32(np.iinfo(np.int32).max)
+    ops = tuple(jnp.asarray(o) for o in operands)
     out = kernel(cols, ops, table.n_docs)  # ONE packed f64 vector on device
     return ctx, plan, out
 
@@ -314,7 +370,7 @@ def execute_sharded_result(table: ShardedTable, sql: str):
     from pinot_tpu.query.engine import QueryEngine
 
     ctx, plan, out = execute_sharded(table, sql)
-    _, unpack = _sharded_kernel(plan.spec, table.mesh, table.mesh.axis_names[0])
+    _, unpack = _sharded_kernel(plan.spec, table.mesh, table.mesh.axis_names[0], table.padded)
     host = unpack(np.asarray(out))  # single device->host round trip
     e = QueryEngine([])
     if ctx.query_type == QueryType.AGGREGATION:

@@ -684,7 +684,9 @@ def build_masked_fn(spec: tuple):
     _, fspec, gspec, aggs = spec
 
     def run(cols, ops, valid):
-        n_padded = next(iter(cols.values())).shape[0]
+        # doc length from the validity mask: cols may also hold MV flat
+        # arrays whose length is the VALUE space, not the doc space
+        n_padded = valid.shape[0]
         mask = valid & _filter(fspec, cols, ops, n_padded)
         matched = jnp.sum(mask, dtype=jnp.int32).astype(_I)
         if gspec is None:

@@ -37,11 +37,17 @@ class SegmentCompletionManager:
     """Controller-side completion FSM. One instance per controller; state is
     per committing segment."""
 
-    def __init__(self, commit_timeout_s: float = 5.0):
+    def __init__(self, commit_timeout_s: float = 5.0, max_commit_factor: float = 3.0):
         self.commit_timeout_s = commit_timeout_s
+        #: absolute cap on one committer's total commit time — heartbeats
+        #: renew the claim, but never past commit_start + timeout*factor
+        #: (SegmentCompletionManager MAX_COMMIT_TIME parity)
+        self.max_commit_s = commit_timeout_s * max_commit_factor
         self._lock = threading.RLock()
-        # segment -> state dict
+        # in-flight segment -> state dict (evicted on COMMITTED)
         self._fsm: dict[str, dict] = {}
+        # compact permanent ledger: segment -> (committed_end, download_from)
+        self._committed: dict[str, tuple] = {}
 
     def _state(self, segment: str) -> dict:
         st = self._fsm.get(segment)
@@ -67,9 +73,15 @@ class SegmentCompletionManager:
         target_offset then call again), DISCARD_AND_DOWNLOAD (segment
         already committed at target_offset — drop local rows, download)."""
         with self._lock:
+            done = self._committed.get(segment)
+            if done is not None:
+                # KEEP: a replica whose local rows cover EXACTLY the
+                # committed range builds/serves its own copy — no download
+                # (reference CONTROLLER_RESPONSE_KEEP)
+                if offset == done[0]:
+                    return KEEP, done[0]
+                return DISCARD_AND_DOWNLOAD, done[0]
             st = self._state(segment)
-            if st["phase"] == "COMMITTED":
-                return DISCARD_AND_DOWNLOAD, st["committed_end"]
             st["offsets"][server_id] = max(st["offsets"].get(server_id, 0), offset)
             if st["phase"] == "COMMITTING":
                 if st["committer"] == server_id:
@@ -90,15 +102,22 @@ class SegmentCompletionManager:
             st["phase"] = "COMMITTING"
             st["committer"] = server_id
             st["winning_offset"] = winning
+            st["commit_started"] = time.time()
             st["commit_deadline"] = time.time() + self.commit_timeout_s
             return COMMIT, winning
 
     def commit_heartbeat(self, segment: str, server_id: str) -> bool:
-        """Committer extends its claim during a long build/upload. Returns
-        False when the claim was lost (another replica was promoted)."""
+        """Committer extends its claim during a long build/upload (renewed
+        up to the absolute max_commit_s cap — a hung committer cannot hold
+        the claim forever). Returns False when the claim was lost."""
         with self._lock:
+            if segment in self._committed:
+                return False
             st = self._state(segment)
             if st["phase"] != "COMMITTING" or st["committer"] != server_id:
+                return False
+            started = st.get("commit_started") or time.time()
+            if time.time() > started + self.max_commit_s:
                 return False
             st["commit_deadline"] = time.time() + self.commit_timeout_s
             return True
@@ -124,20 +143,26 @@ class SegmentCompletionManager:
             if not success:
                 self._reelect(segment, st, exclude=server_id)
                 return True
-            st["phase"] = "COMMITTED"
-            st["committed_end"] = end_offset
-            st["download_from"] = download_from
+            # evict the heavy in-flight state; keep only the compact ledger
+            # entry (a controller-lifetime singleton must not grow per-
+            # replica dicts forever — review r4)
+            self._committed[segment] = (end_offset, download_from)
+            del self._fsm[segment]
             return True
 
     # -- introspection -------------------------------------------------------
 
     def phase(self, segment: str) -> str:
         with self._lock:
-            return self._state(segment)["phase"]
+            if segment in self._committed:
+                return "COMMITTED"
+            st = self._fsm.get(segment)
+            return st["phase"] if st is not None else "HOLDING"
 
     def download_source(self, segment: str) -> str | None:
         with self._lock:
-            return self._state(segment)["download_from"]
+            done = self._committed.get(segment)
+            return done[1] if done is not None else None
 
     # -- internals -----------------------------------------------------------
 
@@ -160,4 +185,5 @@ class SegmentCompletionManager:
         new = max(st["offsets"], key=lambda s: st["offsets"][s])
         st["committer"] = new
         st["winning_offset"] = max(st["offsets"].values())
+        st["commit_started"] = time.time()
         st["commit_deadline"] = time.time() + self.commit_timeout_s

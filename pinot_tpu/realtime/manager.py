@@ -211,6 +211,9 @@ class PartitionConsumer:
             if directive == C.CATCHUP:
                 self._consume_to(target)
                 continue
+            if directive == C.KEEP:
+                self._keep_local(seg_name, target)
+                return
             if directive == C.DISCARD_AND_DOWNLOAD:
                 self._discard_and_download(seg_name, target)
                 return
@@ -238,33 +241,51 @@ class PartitionConsumer:
         def do_commit() -> None:
             ok = False
             download_from = None
-            # last claim check before irreversible side effects: a commit
-            # that already lost its claim must not clobber the winner's
-            # segment metadata (review r4)
-            if not self.completion.commit_heartbeat(seg_name, self.server_id):
-                accepted = False
-            else:
-                try:
-                    self.commit_fn(sealed, start, end)
-                    ok = True
-                except Exception:
-                    # deep store unavailable: keep the built copy local and
-                    # offer it for PEER download (peerSegmentDownloadScheme)
+            # heartbeat ticker: a LIVE slow commit renews its claim (capped
+            # by the FSM's absolute max commit time); claim loss is checked
+            # before irreversible side effects (narrow TOCTOU remains — the
+            # reference accepts the same race and rejects the late
+            # commitEnd, which commit_end does here too)
+            done = threading.Event()
+
+            def ticker():
+                while not done.wait(self.completion.commit_timeout_s / 3.0):
+                    if not self.completion.commit_heartbeat(seg_name, self.server_id):
+                        return
+
+            hb = threading.Thread(target=ticker, daemon=True)
+            hb.start()
+            try:
+                if not self.completion.commit_heartbeat(seg_name, self.server_id):
+                    accepted = False
+                else:
                     try:
-                        if self.peer_commit_fn is not None:
-                            self.peer_commit_fn(sealed, start, end)
-                            ok = True
-                            download_from = self.server_id
+                        self.commit_fn(sealed, start, end)
+                        ok = True
                     except Exception:
-                        ok = False
-                accepted = self.completion.commit_end(seg_name, self.server_id, end, ok, download_from)
+                        # deep store unavailable: keep the built copy local,
+                        # offer it for PEER download (peerSegmentDownloadScheme)
+                        try:
+                            if self.peer_commit_fn is not None:
+                                self.peer_commit_fn(sealed, start, end)
+                                ok = True
+                                download_from = self.server_id
+                        except Exception:
+                            ok = False
+                    accepted = self.completion.commit_end(seg_name, self.server_id, end, ok, download_from)
+            finally:
+                done.set()
             self.commit_log.append((seg_name, "COMMIT_END", ok and accepted))
+            recovered = True
             if not (ok and accepted):
                 # another replica won (or will): fetch the winning copy so
                 # this server still serves the committed row range
-                self._recover_lost_commit(seg_name)
-            with self._lock:
-                self._pending_sealed.pop(seg_name, None)
+                recovered = self._recover_lost_commit(seg_name)
+            if ok or recovered:
+                with self._lock:
+                    self._pending_sealed.pop(seg_name, None)
+            # on failed recovery the local sealed build STAYS queryable from
+            # _pending_sealed — it may be the cluster's only copy
 
         if self.pauseless:
             # pauseless completion: the next consuming segment opens and the
@@ -282,20 +303,45 @@ class PartitionConsumer:
             self.on_open(self._seg_name())
             self.state = "CONSUMING"
 
-    def _recover_lost_commit(self, seg_name: str, timeout: float = 30.0) -> None:
+    def _recover_lost_commit(self, seg_name: str, timeout: float = 30.0) -> bool:
         """This replica's commit lost (failure or revoked claim): wait for
-        the winner to COMMIT, then download its copy."""
-        from pinot_tpu.realtime import completion as C
-
+        the winner to COMMIT, then download its copy. Returns True when the
+        committed copy landed locally."""
         deadline = time.time() + timeout
         while time.time() < deadline and not self._stop.is_set():
             if self.completion.phase(seg_name) == "COMMITTED":
                 src = self.completion.download_source(seg_name)
                 got = self.download_fn(seg_name, src)
                 self.commit_log.append((seg_name, "RECOVERED" if got else "RECOVER_MISS", src))
-                return
+                return bool(got)
             time.sleep(0.05)
         self.commit_log.append((seg_name, "RECOVER_TIMEOUT", None))
+        return False
+
+    #: optional fn(ImmutableSegment) registering THIS replica's own build of
+    #: an already-committed segment (KEEP directive: identical row range, no
+    #: download needed)
+    keep_fn = None
+
+    def _keep_local(self, seg_name: str, committed_end: int) -> None:
+        """KEEP: local rows cover exactly the committed range — seal and
+        serve this replica's own build instead of downloading."""
+        with self._lock:
+            sealed = self._mutable.seal()
+            self.sequence += 1
+            self._segment_start_offset = committed_end
+            self.offset = committed_end
+            self._mutable = self._new_mutable()
+        if self.keep_fn is not None:
+            self.keep_fn(sealed)
+            self.commit_log.append((seg_name, "KEPT", None))
+        else:
+            # no local registration hook: fall back to a download
+            src = self.completion.download_source(seg_name)
+            got = self.download_fn(seg_name, src)
+            self.commit_log.append((seg_name, "DOWNLOADED" if got else "DOWNLOAD_MISS", src))
+        self.on_open(self._seg_name())
+        self.state = "CONSUMING"
 
     def pending_sealed(self, name: str) -> "ImmutableSegment | None":
         with self._lock:
@@ -438,6 +484,7 @@ class RealtimeTableManager:
                 pauseless=pauseless,
             )
             pc.peer_commit_fn = self._make_peer_commit(p)
+            pc.keep_fn = self._make_keep()
             pc.committed_docs_fn = lambda name: (
                 (self.controller.segment_metadata(self.table, name) or {}).get("numDocs")
             )
@@ -549,6 +596,17 @@ class RealtimeTableManager:
             self._record_stats_history(segment)
 
         return peer_commit
+
+    def _make_keep(self):
+        """Register this replica's own build of a committed segment (KEEP):
+        same rows, same name — the controller push may land a copy too, but
+        name-keyed registration makes that idempotent."""
+
+        def keep(segment: ImmutableSegment) -> None:
+            self.on_segment_loaded(segment)
+            self.server.add_segment_object(self.table, segment)
+
+        return keep
 
     def _make_download(self, partition: int):
         """Fetch a committed segment this replica did NOT build: local copy

@@ -31,7 +31,7 @@ def _schema():
     )
 
 
-def _cluster(tmp_path, commit_timeout=2.0):
+def _cluster(tmp_path, commit_timeout=2.0, max_rows=(ROWS_PER_SEG, ROWS_PER_SEG)):
     store = PropertyStore()
     ctrl = Controller(store, tmp_path / "deep")
     ctrl.add_schema(_schema())
@@ -48,7 +48,7 @@ def _cluster(tmp_path, commit_timeout=2.0):
             _schema(),
             TableConfig("ev", table_type=TableType.REALTIME, replication=2),
             stream,
-            max_rows_per_segment=ROWS_PER_SEG,
+            max_rows_per_segment=max_rows[i],
             completion=completion,
         )
         servers.append(srv)
@@ -70,7 +70,9 @@ def _wait(pred, timeout=15.0, msg="condition"):
     raise AssertionError(f"timed out waiting for {msg}")
 
 
-def test_exactly_one_committer_other_downloads(tmp_path):
+def test_exactly_one_committer_other_keeps(tmp_path):
+    """Equal-offset replicas: one commits, the other gets KEEP and serves
+    its OWN build — no download (CONTROLLER_RESPONSE_KEEP parity)."""
     ctrl, stream, completion, servers, managers = _cluster(tmp_path)
     _produce(stream, ROWS_PER_SEG + 5)
     for m in managers:
@@ -83,20 +85,19 @@ def test_exactly_one_committer_other_downloads(tmp_path):
             lambda: all(seg0 in s.segments_of("ev") for s in servers),
             msg="both replicas hold the committed copy",
         )
-        # exactly one replica committed; the other downloaded. The controller
-        # push can deliver the copy before the second replica's protocol
-        # turn, so wait for the protocol outcome itself, not just presence.
+        # exactly one replica committed; the other KEPT its own build
         def outcomes():
             out = []
             for m in managers:
                 log = list(m.consumers[0].commit_log)
                 out.append(
                     "commit" if any(e[1] == "COMMIT_END" and e[2] for e in log) else
+                    "keep" if any(e[1] == "KEPT" for e in log) else
                     "download" if any(e[1] == "DOWNLOADED" for e in log) else "none"
                 )
             return sorted(out)
 
-        _wait(lambda: outcomes() == ["commit", "download"], msg=f"outcomes {outcomes()}")
+        _wait(lambda: outcomes() == ["commit", "keep"], msg=f"outcomes {outcomes()}")
         meta = ctrl.segment_metadata("ev", seg0)
         assert meta["endOffset"] == ROWS_PER_SEG
         # both consumers resumed at the committed end offset
@@ -148,14 +149,18 @@ def test_committer_killed_mid_commit_reelection(tmp_path):
 
 def test_peer_download_when_deep_store_unavailable(tmp_path, monkeypatch):
     """Deep store writes fail: the committer registers its local build for
-    peer download and the other replica fetches it from the peer server."""
-    ctrl, stream, completion, servers, managers = _cluster(tmp_path)
+    peer download and the other replica fetches it from the peer server.
+    Replica B rolls over at a DIFFERENT row budget so its offset diverges
+    from the committed end — the DISCARD_AND_DOWNLOAD (not KEEP) path."""
+    ctrl, stream, completion, servers, managers = _cluster(
+        tmp_path, max_rows=(ROWS_PER_SEG, ROWS_PER_SEG + 20)
+    )
 
     def broken_upload(table, segment):
         raise OSError("deep store unavailable")
 
     monkeypatch.setattr(ctrl, "upload_segment", broken_upload)
-    _produce(stream, ROWS_PER_SEG + 5)
+    _produce(stream, ROWS_PER_SEG + 30)
     for m in managers:
         m.start()
     try:
