@@ -63,6 +63,7 @@ class Broker:
         failure_detector=None,
         enable_quota: bool = True,
         query_logger=None,
+        tenant_tags: list[str] | None = None,
     ):
         """selector: instance selector (Balanced default; ReplicaGroup /
         Adaptive from cluster.routing). failure_detector: optional
@@ -73,6 +74,9 @@ class Broker:
         from pinot_tpu.cluster.quota import QueryQuotaManager
 
         self.controller = controller
+        #: broker-tenant membership; None = serve every table (untagged
+        #: brokers belong to the DefaultTenant, TagNameUtils parity)
+        self.tenant_tags = list(tenant_tags) if tenant_tags is not None else None
         self.selector = selector if selector is not None else BalancedInstanceSelector()
         self.failure_detector = failure_detector
         self.quota = QueryQuotaManager(controller) if enable_quota else None
@@ -129,6 +133,20 @@ class Broker:
         rt_cfg = self.controller.get_table(rt_name) if not table.endswith("_REALTIME") else None
         if offline_cfg is None and rt_cfg is None:
             raise KeyError(f"no such table: {table}")  # BrokerResponse TableDoesNotExist parity
+        # broker-tenant gate: a tagged broker serves only tables whose broker
+        # tenant it belongs to (BrokerResourceManager routing-table parity)
+        if self.tenant_tags is not None:
+            from pinot_tpu.cluster.tenancy import broker_tag, table_tenants
+
+            for cfg in (offline_cfg, rt_cfg):  # BOTH halves of a hybrid table
+                if cfg is None:
+                    continue
+                want = broker_tag(table_tenants(cfg)[0])
+                if want not in self.tenant_tags:
+                    raise PermissionError(
+                        f"table {cfg.table_name!r} belongs to broker tenant tag {want!r}; "
+                        f"this broker serves {self.tenant_tags}"
+                    )
         schema = self.controller.get_schema(table) or self.controller.get_schema(rt_name)
         self._expand_star(stmt, schema)
         ctx = QueryContext.from_statement(stmt)

@@ -68,13 +68,30 @@ class Controller:
 
     # -- instances -----------------------------------------------------------
 
-    def register_server(self, server_id: str, handle=None, host: str = "local", port: int = 0) -> None:
+    def register_server(
+        self, server_id: str, handle=None, host: str = "local", port: int = 0, tags: list[str] | None = None
+    ) -> None:
         """handle=None with a port registers a remote (HTTP) server — the
         cross-process Helix-participant analog; a RemoteServerClient is built
-        lazily from the instance doc."""
+        lazily from the instance doc. `tags` carry tenant/tier membership
+        ("<tenant>_OFFLINE", "hot_tier", ...); untagged servers belong to
+        the DefaultTenant."""
         if handle is not None:
             self._servers[server_id] = handle
-        self.store.set(f"/instances/{server_id}", {"host": host, "port": port, "alive": True})
+        prev = self.store.get(f"/instances/{server_id}") or {}
+        # a re-registration without tags (server restart) must not wipe
+        # operator-assigned tenant/tier tags
+        eff_tags = list(tags) if tags is not None else prev.get("tags", [])
+        self.store.set(
+            f"/instances/{server_id}",
+            {"host": host, "port": port, "alive": True, "tags": eff_tags},
+        )
+
+    def update_server_tags(self, server_id: str, tags: list[str]) -> None:
+        """Re-tag a server (updateInstanceTags REST parity)."""
+        doc = self.store.get(f"/instances/{server_id}") or {}
+        doc["tags"] = list(tags)
+        self.store.set(f"/instances/{server_id}", doc)
 
     def servers(self) -> dict[str, object]:
         out = dict(self._servers)
@@ -219,12 +236,19 @@ class Controller:
         return out
 
     def _assign(self, table: str, segment_name: str, replication: int) -> list[str]:
-        """Balanced assignment: pick the `replication` servers currently
-        hosting the fewest segments of this table
-        (OfflineSegmentAssignment.assignSegment parity)."""
+        """Balanced assignment restricted to the table's server-tenant pool:
+        pick the `replication` eligible servers hosting the fewest segments
+        of this table (OfflineSegmentAssignment + tenant tags)."""
+        from pinot_tpu.cluster.tenancy import candidate_servers
+
         handles = self.servers()
         if not handles:
             raise RuntimeError("no servers registered")
+        config = self.get_table(table)
+        eligible = set(candidate_servers(self, config)) if config is not None else set(handles)
+        handles = {sid: h for sid, h in handles.items() if sid in eligible}
+        if not handles:
+            raise RuntimeError(f"no servers in table {table!r}'s tenant")
         ideal = self.store.get(f"/tables/{table}/idealstate") or {}
         load: dict[str, int] = {sid: 0 for sid in handles}
         for seg, replicas in ideal.items():

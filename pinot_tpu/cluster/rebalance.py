@@ -24,24 +24,40 @@ class RebalanceResult:
 
 
 def compute_target_assignment(
-    segments: list[str], servers: list[str], replication: int, current: dict[str, dict[str, str]]
+    segments: list[str],
+    servers: list[str],
+    replication: int,
+    current: dict[str, dict[str, str]],
+    candidates: dict[str, list[str]] | None = None,
 ) -> dict[str, list[str]]:
-    """Balanced target keeping current replicas when still valid."""
+    """Balanced target keeping current replicas when still valid.
+    `candidates` optionally restricts each segment to its eligible server
+    pool (tenant / tier tags); segments without an entry use `servers`."""
     servers = sorted(servers)
-    replication = max(1, min(replication, len(servers)))
     load = {s: 0 for s in servers}
+
+    def pool(seg: str) -> list[str]:
+        c = (candidates or {}).get(seg)
+        live = sorted(s for s in c if s in load) if c else []
+        return live if live else servers
+
     target: dict[str, list[str]] = {}
-    # first pass: retain existing replicas on live servers (minimal movement)
+    # first pass: retain existing replicas still in the segment's pool
+    # (minimal movement)
     for seg in sorted(segments):
-        keep = [s for s in sorted(current.get(seg, {})) if s in load][:replication]
+        p = set(pool(seg))
+        r = max(1, min(replication, len(p)))
+        keep = [s for s in sorted(current.get(seg, {})) if s in p][:r]
         target[seg] = keep
         for s in keep:
             load[s] += 1
-    # second pass: top up to replication on least-loaded servers
+    # second pass: top up to replication on least-loaded eligible servers
     for seg in sorted(segments):
+        p = pool(seg)
+        r = max(1, min(replication, len(p)))
         have = set(target[seg])
-        while len(target[seg]) < replication:
-            pick = min((s for s in servers if s not in have), key=lambda s: (load[s], s))
+        while len(target[seg]) < r:
+            pick = min((s for s in p if s not in have), key=lambda s: (load[s], s))
             target[seg].append(pick)
             have.add(pick)
             load[pick] += 1
@@ -56,7 +72,25 @@ def rebalance_table(controller, table: str, dry_run: bool = False) -> RebalanceR
         raise KeyError(f"no such table: {table}")
     ideal = controller.ideal_state(table)
     servers = sorted(controller.servers())
-    target = compute_target_assignment(list(ideal), servers, config.replication, ideal)
+    # per-segment eligibility: tier tag when a tier matches, else the
+    # table's server-tenant pool (TierBasedSegmentDirectoryLoader parity).
+    # The tenant pool is segment-invariant — computed once; only the tier
+    # lookup runs per segment.
+    from pinot_tpu.cluster.tenancy import candidate_servers, tagged_servers, tier_of_segment
+
+    tenant_pool = candidate_servers(controller, config)
+    tier_pools: dict[str, list[str]] = {}
+    candidates = {}
+    for seg in ideal:
+        tier = tier_of_segment(config, controller.segment_metadata(table, seg) or {})
+        if tier is not None:
+            tag = tier["serverTag"]
+            if tag not in tier_pools:
+                tier_pools[tag] = tagged_servers(controller, tag)
+            candidates[seg] = tier_pools[tag] or tenant_pool
+        else:
+            candidates[seg] = tenant_pool
+    target = compute_target_assignment(list(ideal), servers, config.replication, ideal, candidates)
 
     adds: list[tuple[str, str]] = []
     drops: list[tuple[str, str]] = []

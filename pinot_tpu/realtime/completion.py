@@ -135,10 +135,10 @@ class SegmentCompletionManager:
         records the committer server for peer download when the deep store
         has no copy. Returns False if this server no longer held the claim."""
         with self._lock:
-            st = self._state(segment)
-            if st["phase"] == "COMMITTED":
-                return False
-            if st["committer"] != server_id:
+            if segment in self._committed:
+                return False  # a late commit after eviction: rejected
+            st = self._fsm.get(segment)
+            if st is None or st["committer"] != server_id:
                 return False
             if not success:
                 self._reelect(segment, st, exclude=server_id)
