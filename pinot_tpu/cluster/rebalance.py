@@ -38,8 +38,15 @@ def compute_target_assignment(
 
     def pool(seg: str) -> list[str]:
         c = (candidates or {}).get(seg)
-        live = sorted(s for s in c if s in load) if c else []
-        return live if live else servers
+        if not c:
+            return servers
+        live = sorted(s for s in c if s in load)
+        if not live:
+            # never silently place across the tenant/tier boundary
+            raise RuntimeError(
+                f"segment {seg!r}: none of its candidate servers {sorted(c)} are live"
+            )
+        return live
 
     target: dict[str, list[str]] = {}
     # first pass: retain existing replicas still in the segment's pool

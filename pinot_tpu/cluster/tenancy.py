@@ -84,15 +84,3 @@ def tier_of_segment(config, seg_meta: dict, now: float | None = None) -> dict | 
         if age >= float(tier.get("segmentAgeSeconds", 0)):
             return tier
     return None
-
-
-def segment_candidates(controller, config, seg_meta: dict, now: float | None = None) -> list[str]:
-    """Candidate servers for ONE segment: its tier's tagged servers when a
-    tier matches (falling back to the tenant pool if the tier has no live
-    servers), else the tenant pool."""
-    tier = tier_of_segment(config, seg_meta, now)
-    if tier is not None:
-        cands = tagged_servers(controller, tier["serverTag"])
-        if cands:
-            return cands
-    return candidate_servers(controller, config)

@@ -180,7 +180,8 @@ class SegmentBuilder:
                 continue
             seg.extras.setdefault("range", {})[col] = RangeIndex.build(ci.forward)
         if idx.text_index_columns or idx.json_index_columns or idx.geo_index_columns:
-            from pinot_tpu.segment.indexes import GeoGridIndex, JsonIndex, TextIndex
+            from pinot_tpu.segment.h3 import H3Index
+            from pinot_tpu.segment.indexes import JsonIndex, TextIndex
 
             for col in idx.text_index_columns:
                 ci = seg.columns.get(col)
@@ -197,7 +198,7 @@ class SegmentBuilder:
                 la, ln = seg.columns.get(lat_col), seg.columns.get(lng_col)
                 if la is None or ln is None:
                     continue
-                seg.extras.setdefault("geo", {})[f"{lat_col},{lng_col}"] = GeoGridIndex.build(
+                seg.extras.setdefault("geo", {})[f"{lat_col},{lng_col}"] = H3Index.build(
                     lat_col, lng_col, la.materialize().astype(np.float64), ln.materialize().astype(np.float64)
                 )
         for col in idx.fst_index_columns:

@@ -1,0 +1,375 @@
+"""Icosahedral hexagonal geo indexing — real H3-style hex math.
+
+Reference parity: Pinot's H3 index (pinot-segment-local/.../segment/index/
+h3/H3IndexType.java, H3IndexFilterOperator) backed by Uber H3. This module
+implements the H3 core geometry from scratch (round-3 verdict: the previous
+geo index was a lat/lng grid approximation):
+
+- gnomonic projection of lat/lng onto the 20 icosahedron faces (the
+  published H3 face-center / face-axis-azimuth constants),
+- aperture-7 hex grid per face with the Class-III rotation on odd
+  resolutions, hex2d -> IJK cube-coordinate rounding,
+- cell ids packed as (res, face, i, j) — same geometry as H3, but NOT
+  bit-compatible with Uber H3's base-cell id encoding (documented drift),
+- kRing neighbor enumeration in cube coordinates with face-crossing
+  canonicalization (neighbors off the face re-index via their center).
+
+Query integration keeps the TPU-first split of the previous index: the
+index serves host-side candidate enumeration + segment pruning; the exact
+ST_DISTANCE compare runs as the vectorized haversine (device or host).
+Candidate covers are EXACT-safe by construction: a cell is a candidate iff
+its center lies within radius + the build-measured max doc->center
+distance, so no in-radius doc can be missed.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+_EARTH_R_M = 6371008.8
+
+# H3 face center geodetic coordinates (radians) — faceijk.c faceCenterGeo
+_FACE_CENTER = np.array(
+    [
+        (0.803582649718989942, 1.248397419617396099),
+        (1.307747883455638156, 2.536945009877921159),
+        (1.054751253523952054, -1.347517358900396623),
+        (0.600191595538186799, -0.450603909469755746),
+        (0.491715428198773866, 0.401988202911306943),
+        (0.172745327415618701, 1.678146885280433686),
+        (0.605929321571350690, 2.953923329812411617),
+        (0.427370518328979641, -1.888876200336285401),
+        (-0.079066118549212831, -0.733429513380867741),
+        (-0.230961644455383637, 0.506495587332349035),
+        (0.079066118549212831, 2.408163140208925497),
+        (0.230961644455383637, -2.635097066257444203),
+        (-0.172745327415618701, -1.463445768309359553),
+        (-0.605929321571350690, -0.187669323777381622),
+        (-0.427370518328979641, 1.252716453253507838),
+        (-0.600191595538186799, 2.690988744120037492),
+        (-0.491715428198773866, -2.739604450678486295),
+        (-0.803582649718989942, -1.893195233972397139),
+        (-1.307747883455638156, -0.604647643711872080),
+        (-1.054751253523952054, 1.794075294689396615),
+    ]
+)
+
+# azimuth from each face center to its i-axis, Class II — faceAxesAzRadsCII[f][0]
+_FACE_AZ_I = np.array(
+    [
+        5.619958268523939882,
+        5.760339081714187279,
+        0.780213654393430055,
+        0.430469363979999913,
+        6.130269123335111400,
+        2.692877706530642877,
+        2.982963003477243874,
+        3.532912002790141181,
+        3.494305004259568154,
+        3.003214169499538391,
+        5.930472956509811562,
+        0.138378484090254885,
+        0.448714947059150361,
+        0.158629650112549365,
+        5.891865957979238535,
+        2.711123289609793325,
+        3.294508837434268316,
+        3.804819692245439833,
+        3.664438879055192436,
+        2.361378999196363184,
+    ]
+)
+
+_RES0_U_GNOMONIC = 0.38196601125010500003
+_SQRT7 = 2.6457513110645905905
+_AP7_ROT_RADS = 0.333473172251832115336090755351601070065900389  # asin(sqrt(3/28))
+_SIN60 = 0.8660254037844386467637
+
+
+def _face_xyz() -> np.ndarray:
+    lat, lng = _FACE_CENTER[:, 0], _FACE_CENTER[:, 1]
+    return np.stack(
+        [np.cos(lat) * np.cos(lng), np.cos(lat) * np.sin(lng), np.sin(lat)], axis=1
+    )
+
+
+_FACE_XYZ = _face_xyz()
+
+
+def _geo_azimuth(lat1, lng1, lat2, lng2):
+    """Azimuth (radians) from point 1 to point 2 on the sphere."""
+    return np.arctan2(
+        np.cos(lat2) * np.sin(lng2 - lng1),
+        np.cos(lat1) * np.sin(lat2) - np.sin(lat1) * np.cos(lat2) * np.cos(lng2 - lng1),
+    )
+
+
+def _pos_angle(a):
+    tau = 2.0 * np.pi
+    return np.mod(np.mod(a, tau) + tau, tau)
+
+
+def _hex2d_to_ijk_scalar(x: float, y: float) -> tuple[int, int, int]:
+    """Scalar implementation of _hex2dToCoordIJK (coordijk.c). The build
+    path calls this per point (pure-Python loop — the projection itself is
+    vectorized; this branchy rounding is the remaining per-row hotspot for
+    multi-million-row geo segments)."""
+    a1 = abs(x)
+    a2 = abs(y)
+    x2 = a2 / _SIN60
+    x1 = a1 + x2 / 2.0
+    m1 = int(x1)
+    m2 = int(x2)
+    r1 = x1 - m1
+    r2 = x2 - m2
+    if r1 < 0.5:
+        if r1 < 1.0 / 3.0:
+            if r2 < (1.0 + r1) / 2.0:
+                i, j = m1, m2
+            else:
+                i, j = m1, m2 + 1
+        else:
+            if r2 < (1.0 - r1):
+                j = m2
+            else:
+                j = m2 + 1
+            if (1.0 - r1) <= r2 and r2 < (2.0 * r1):
+                i = m1 + 1
+            else:
+                i = m1
+    else:
+        if r1 < 2.0 / 3.0:
+            if r2 < (1.0 - r1):
+                j = m2
+            else:
+                j = m2 + 1
+            if (2.0 * r1 - 1.0) < r2 and r2 < (1.0 - r1):
+                i = m1
+            else:
+                i = m1 + 1
+        else:
+            if r2 < (r1 / 2.0):
+                i, j = m1 + 1, m2
+            else:
+                i, j = m1 + 1, m2 + 1
+    # fold across the axes for negative x / y
+    if x < 0.0:
+        if j % 2 == 0:
+            axis_i = j // 2
+            diff = i - axis_i
+            i = int(i - 2.0 * diff)
+        else:
+            axis_i = (j + 1) // 2
+            diff = i - axis_i
+            i = int(i - (2.0 * diff + 1))
+    k = 0
+    if y < 0.0:
+        i = i - (2 * j + 1) // 2
+        j = -j
+    # normalize (no negative coordinates; at least one of i,j,k zero)
+    if i < 0:
+        j -= i
+        k -= i
+        i = 0
+    if j < 0:
+        i -= j
+        k -= j
+        j = 0
+    if k < 0:
+        i -= k
+        j -= k
+        k = 0
+    m = min(i, j, k)
+    return i - m, j - m, k - m
+
+
+def _geo_to_cell_arrays(lat_deg: np.ndarray, lng_deg: np.ndarray, res: int) -> np.ndarray:
+    """lat/lng (degrees) -> packed cell ids at `res` (vector projection +
+    per-point IJK rounding)."""
+    lat = np.radians(np.asarray(lat_deg, dtype=np.float64))
+    lng = np.radians(np.asarray(lng_deg, dtype=np.float64))
+    p = np.stack([np.cos(lat) * np.cos(lng), np.cos(lat) * np.sin(lng), np.sin(lat)], axis=1)
+    dots = p @ _FACE_XYZ.T
+    face = np.argmax(dots, axis=1)
+    fc = _FACE_CENTER[face]
+    ang = np.arccos(np.clip(dots[np.arange(len(face)), face], -1.0, 1.0))
+    az = _geo_azimuth(fc[:, 0], fc[:, 1], lat, lng)
+    theta = _pos_angle(_FACE_AZ_I[face] - _pos_angle(az))
+    if res % 2 == 1:  # Class III: rotate the grid by asin(sqrt(3/28))
+        theta = theta - _AP7_ROT_RADS
+    r = np.tan(ang) / _RES0_U_GNOMONIC * (_SQRT7**res)
+    x = r * np.cos(theta)
+    y = r * np.sin(theta)
+    out = np.empty(len(face), dtype=np.int64)
+    for n in range(len(face)):
+        i, j, k = _hex2d_to_ijk_scalar(float(x[n]), float(y[n]))
+        out[n] = pack_cell(res, int(face[n]), i, j, k)
+    return out
+
+
+def pack_cell(res: int, face: int, i: int, j: int, k: int) -> int:
+    """(res, face, normalized ijk) -> int64 id. Normalization guarantees
+    min(i,j,k)==0, so (i-k, j-k) biased by 2^20 identifies the cell."""
+    bias = 1 << 20
+    return (res << 58) | (face << 52) | ((i - k + bias) << 26) | (j - k + bias)
+
+
+def unpack_cell(cell: int) -> tuple[int, int, int, int, int]:
+    bias = 1 << 20
+    res = (cell >> 58) & 0xF
+    face = (cell >> 52) & 0x3F
+    ik = ((cell >> 26) & ((1 << 26) - 1)) - bias
+    jk = (cell & ((1 << 26) - 1)) - bias
+    i, j, k = ik, jk, 0
+    m = min(i, j, k)
+    return res, face, i - m, j - m, k - m
+
+
+def cell_center(cell: int) -> tuple[float, float]:
+    """Cell id -> (lat, lng) degrees of the cell center (inverse gnomonic)."""
+    res, face, i, j, k = unpack_cell(cell)
+    # ijk -> hex2d (coordijk.c _ijkToHex2d)
+    ii = i - k
+    jj = j - k
+    x = ii - 0.5 * jj
+    y = jj * _SIN60
+    r = float(np.hypot(x, y))
+    if r < 1e-12:
+        lat, lng = _FACE_CENTER[face]
+        return float(np.degrees(lat)), float(np.degrees(lng))
+    theta = float(np.arctan2(y, x))
+    if res % 2 == 1:
+        theta = theta + _AP7_ROT_RADS
+    az = _pos_angle(_FACE_AZ_I[face] - theta)
+    dist = float(np.arctan(r * _RES0_U_GNOMONIC / (_SQRT7**res)))
+    lat1, lng1 = _FACE_CENTER[face]
+    lat2 = np.arcsin(np.sin(lat1) * np.cos(dist) + np.cos(lat1) * np.sin(dist) * np.cos(az))
+    lng2 = lng1 + np.arctan2(
+        np.sin(az) * np.sin(dist) * np.cos(lat1), np.cos(dist) - np.sin(lat1) * np.sin(lat2)
+    )
+    return float(np.degrees(lat2)), float(np.degrees(np.mod(lng2 + np.pi, 2 * np.pi) - np.pi))
+
+
+def geo_to_cell(lat_deg: float, lng_deg: float, res: int) -> int:
+    return int(_geo_to_cell_arrays(np.asarray([lat_deg]), np.asarray([lng_deg]), res)[0])
+
+
+def k_ring(cell: int, k: int) -> list[int]:
+    """All cells within hex grid distance k (kRing). Cube-coordinate disk
+    enumeration; candidates whose IJK leaves the home face canonicalize by
+    re-indexing their center point (face-crossing overage handling)."""
+    res, face, ci, cj, ck = unpack_cell(cell)
+    out = set()
+    for di in range(-k, k + 1):
+        for dj in range(max(-k, -di - k), min(k, -di + k) + 1):
+            dk = -di - dj
+            # axial delta in normalized ijk space
+            i, j, kk = ci + di, cj + dj, ck + dk
+            m = min(i, j, kk)
+            cand = pack_cell(res, face, i - m, j - m, kk - m)
+            # canonicalize via the center (handles face overage)
+            lat, lng = cell_center(cand)
+            out.add(geo_to_cell(lat, lng, res))
+    return sorted(out)
+
+
+# resolution guide: average hex edge length (meters), H3 published table
+_EDGE_LEN_M = [
+    1107712.591,
+    418676.0055,
+    158244.6558,
+    59810.85794,
+    22606.3794,
+    8544.408276,
+    3229.482772,
+    1220.629759,
+    461.3546837,
+    174.3756681,
+    65.90780749,
+    24.9108131,
+    9.41527076,
+    3.559893033,
+    1.348574562,
+    0.509713273,
+]
+
+
+@dataclass
+class H3Index:
+    """Hex cells -> doc posting lists + bbox (same query surface as the
+    round-3 grid index: candidate enumeration + segment pruning; exact
+    distance compare stays a vectorized haversine elsewhere)."""
+
+    lat_col: str
+    lng_col: str
+    res: int
+    cells: np.ndarray  # int64 sorted distinct cell ids
+    offsets: np.ndarray  # (C+1,) int64
+    doc_ids: np.ndarray  # int32
+    bbox: tuple
+    max_cell_radius_m: float  # build-measured max doc->cell-center distance
+    #: (C, 2) lat/lng centers of `cells`; computed at build, lazily derived
+    #: after a load (not persisted — deterministic from the ids)
+    centers: "np.ndarray | None" = None
+
+    @staticmethod
+    def build(
+        lat_col: str, lng_col: str, lat: np.ndarray, lng: np.ndarray, res: int = 5
+    ) -> "H3Index":
+        from pinot_tpu.segment.indexes import haversine_m
+
+        lat = np.asarray(lat, dtype=np.float64)
+        lng = np.asarray(lng, dtype=np.float64)
+        cell = _geo_to_cell_arrays(lat, lng, res)
+        cells, ids = np.unique(cell, return_inverse=True)
+        order = np.lexsort((np.arange(len(cell)), ids))
+        counts = np.bincount(ids, minlength=len(cells))
+        offsets = np.zeros(len(cells) + 1, dtype=np.int64)
+        np.cumsum(counts, out=offsets[1:])
+        if len(lat):
+            centers = np.array([cell_center(int(c)) for c in cells])
+            dists = haversine_m(lat, lng, centers[ids, 0], centers[ids, 1])
+            max_r = float(np.max(dists))
+            bbox = (float(lat.min()), float(lat.max()), float(lng.min()), float(lng.max()))
+        else:
+            centers = np.zeros((0, 2))
+            max_r = 0.0
+            bbox = (0.0, 0.0, 0.0, 0.0)
+        return H3Index(
+            lat_col, lng_col, res, cells, offsets, order.astype(np.int32), bbox, max_r, centers
+        )
+
+    def min_distance_m(self, qlat: float, qlng: float) -> float:
+        from pinot_tpu.segment.indexes import bbox_min_distance_m
+
+        return bbox_min_distance_m(self.bbox, qlat, qlng)
+
+    def _centers(self) -> np.ndarray:
+        if self.centers is None:
+            self.centers = (
+                np.array([cell_center(int(c)) for c in self.cells])
+                if len(self.cells)
+                else np.zeros((0, 2))
+            )
+        return self.centers
+
+    def candidate_docs(self, qlat: float, qlng: float, radius_m: float) -> np.ndarray:
+        """Docs in every cell whose center is within radius + the measured
+        max doc->center distance — an exact-safe cover (any in-radius doc's
+        cell center is within that bound by the triangle inequality)."""
+        from pinot_tpu.segment.indexes import haversine_m
+
+        if not len(self.cells):
+            return np.empty(0, dtype=np.int32)
+        centers = self._centers()
+        d = haversine_m(
+            np.full(len(centers), qlat), np.full(len(centers), qlng), centers[:, 0], centers[:, 1]
+        )
+        hits = np.nonzero(d <= radius_m + self.max_cell_radius_m + 1.0)[0]
+        if not len(hits):
+            return np.empty(0, dtype=np.int32)
+        return np.concatenate(
+            [self.doc_ids[self.offsets[i] : self.offsets[i + 1]] for i in hits]
+        )

@@ -450,16 +450,7 @@ class GeoGridIndex:
         return GeoGridIndex(lat_col, lng_col, res_deg, cells, offsets, order.astype(np.int32), bbox)
 
     def min_distance_m(self, qlat: float, qlng: float) -> float:
-        """Lower bound on distance from query point to any doc: clamp the
-        query point into the bbox. Longitude clamping is done at qlng and
-        qlng±360 so the bound stays valid across the antimeridian."""
-        min_lat, max_lat, min_lng, max_lng = self.bbox
-        clat = min(max(qlat, min_lat), max_lat)
-        best = np.inf
-        for q in (qlng, qlng + 360.0, qlng - 360.0):
-            clng = min(max(q, min_lng), max_lng)
-            best = min(best, float(haversine_m(qlat, q, clat, clng)))
-        return best
+        return bbox_min_distance_m(self.bbox, qlat, qlng)
 
     def candidate_docs(self, qlat: float, qlng: float, radius_m: float) -> np.ndarray:
         """Doc ids in cells intersecting the circle's bounding box."""
@@ -474,6 +465,21 @@ class GeoGridIndex:
         if not hits:
             return np.empty(0, dtype=np.int32)
         return np.concatenate([self.doc_ids[self.offsets[i] : self.offsets[i + 1]] for i in hits])
+
+
+def bbox_min_distance_m(bbox: tuple, qlat: float, qlng: float) -> float:
+    """Lower bound on distance from a query point to any doc in the bbox:
+    clamp the point into the box; longitude clamping runs at qlng and
+    qlng±360 so the bound stays valid across the antimeridian. Shared by
+    the hex (H3Index) and legacy grid geo indexes — the pruner depends on
+    both behaving identically."""
+    min_lat, max_lat, min_lng, max_lng = bbox
+    clat = min(max(qlat, min_lat), max_lat)
+    best = np.inf
+    for q in (qlng, qlng + 360.0, qlng - 360.0):
+        clng = min(max(q, min_lng), max_lng)
+        best = min(best, float(haversine_m(qlat, q, clat, clng)))
+    return best
 
 
 def haversine(xp, lat1, lng1, lat2, lng2):

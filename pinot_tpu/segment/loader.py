@@ -97,11 +97,20 @@ def _reconstruct(
                 )
             for key, gm in aux.get("geo", {}).items():
                 lat_col, lng_col = key.split(",")
-                seg.extras.setdefault("geo", {})[key] = GeoGridIndex(
-                    lat_col, lng_col, gm["resDeg"],
-                    read(f"geo_cells::{key}"), read(f"geo_off::{key}"), read(f"geo_doc::{key}"),
-                    tuple(gm["bbox"]),
-                )
+                if gm.get("kind") == "h3":
+                    from pinot_tpu.segment.h3 import H3Index
+
+                    seg.extras.setdefault("geo", {})[key] = H3Index(
+                        lat_col, lng_col, int(gm["res"]),
+                        read(f"geo_cells::{key}"), read(f"geo_off::{key}"), read(f"geo_doc::{key}"),
+                        tuple(gm["bbox"]), float(gm.get("maxCellRadiusM", 0.0)),
+                    )
+                else:  # legacy lat/lng grid segments
+                    seg.extras.setdefault("geo", {})[key] = GeoGridIndex(
+                        lat_col, lng_col, gm["resDeg"],
+                        read(f"geo_cells::{key}"), read(f"geo_off::{key}"), read(f"geo_doc::{key}"),
+                        tuple(gm["bbox"]),
+                    )
             vec_meta = aux.get("vector", [])
             for col in vec_meta:
                 kind = vec_meta[col] if isinstance(vec_meta, dict) else "VectorIndex"

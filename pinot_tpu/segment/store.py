@@ -167,7 +167,15 @@ def write_segment_file(seg, seg_dir: Path) -> Path:
         w.write_array(f"geo_cells::{key}", gi.cells)
         w.write_array(f"geo_off::{key}", gi.offsets)
         w.write_array(f"geo_doc::{key}", gi.doc_ids)
-        aux_meta.setdefault("geo", {})[key] = {"resDeg": gi.res_deg, "bbox": list(gi.bbox)}
+        if hasattr(gi, "res_deg"):
+            aux_meta.setdefault("geo", {})[key] = {"resDeg": gi.res_deg, "bbox": list(gi.bbox)}
+        else:  # H3Index (hex cells)
+            aux_meta.setdefault("geo", {})[key] = {
+                "kind": "h3",
+                "res": gi.res,
+                "bbox": list(gi.bbox),
+                "maxCellRadiusM": gi.max_cell_radius_m,
+            }
     for col, vi in seg.extras.get("vector", {}).items():
         w.write_array(f"vector::{col}", vi.vectors)
         # HNSW graphs rebuild deterministically on load (SegmentPreProcessor

