@@ -64,6 +64,7 @@ class Broker:
         enable_quota: bool = True,
         query_logger=None,
         tenant_tags: list[str] | None = None,
+        access_control=None,
     ):
         """selector: instance selector (Balanced default; ReplicaGroup /
         Adaptive from cluster.routing). failure_detector: optional
@@ -77,6 +78,9 @@ class Broker:
         #: broker-tenant membership; None = serve every table (untagged
         #: brokers belong to the DefaultTenant, TagNameUtils parity)
         self.tenant_tags = list(tenant_tags) if tenant_tags is not None else None
+        #: AccessControl SPI (None = allow all); execute(sql, identity=...)
+        #: gates READ on the queried table (BaseBrokerRequestHandler parity)
+        self.access_control = access_control
         self.selector = selector if selector is not None else BalancedInstanceSelector()
         self.failure_detector = failure_detector
         self.quota = QueryQuotaManager(controller) if enable_quota else None
@@ -85,7 +89,7 @@ class Broker:
         self._dispatcher = None
         self._dispatcher_lock = threading.Lock()
 
-    def execute(self, sql: str) -> ResultTable:
+    def execute(self, sql: str, identity: str | None = None) -> ResultTable:
         from pinot_tpu.common.metrics import BrokerMeter, broker_metrics
         from pinot_tpu.common.trace import start_trace
 
@@ -95,6 +99,11 @@ class Broker:
         try:
             stmt = parse_sql(sql)
             table = getattr(stmt, "from_table", None) or ""
+            if self.access_control is not None:
+                from pinot_tpu.cluster.access import READ
+
+                for t in _collect_tables(stmt) or ([table] if table else []):
+                    self.access_control.check(identity, t, READ)
             if self.quota is not None and table:
                 self.quota.acquire(table)
             if stmt.options.get("trace", "").lower() == "true":

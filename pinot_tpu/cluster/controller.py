@@ -23,6 +23,9 @@ from pinot_tpu.segment.segment import ImmutableSegment
 
 
 class Controller:
+    #: optional AccessControl SPI enforced by the HTTP endpoints
+    access_control = None
+
     def __init__(self, store: PropertyStore, deep_store: str | Path, controller_id: str = "controller_0"):
         """deep_store: directory holding uploaded segment dirs (the PinotFS
         deep-store analog: segments are durable here; servers load from it)."""

@@ -48,9 +48,16 @@ class BrokerHTTPService:
                 n = int(self.headers.get("Content-Length", 0))
                 body = json.loads(self.rfile.read(n) or b"{}")
                 try:
-                    res = svc.broker.execute(body["sql"])
+                    identity = None
+                    ac = getattr(svc.broker, "access_control", None)
+                    if ac is not None:
+                        identity = ac.authenticate(dict(self.headers))
+                    res = svc.broker.execute(body["sql"], identity=identity)
                     payload = json.dumps(res.to_dict()).encode()
                     self.send_response(200)
+                except PermissionError as e:
+                    payload = json.dumps({"exceptions": [{"message": str(e)}]}).encode()
+                    self.send_response(403)
                 except Exception as e:  # error surface parity: exceptions JSON
                     payload = json.dumps({"exceptions": [{"message": str(e)}]}).encode()
                     self.send_response(200)
@@ -433,7 +440,7 @@ class ControllerHTTPService:
                 except Exception as e:
                     self._json({"error": f"{type(e).__name__}: {e}"}, 500)
 
-            def do_POST(self):
+            def do_POST(self):  # noqa: C901
                 from pinot_tpu.common.config import TableConfig
                 from pinot_tpu.common.types import Schema
 
@@ -442,6 +449,17 @@ class ControllerHTTPService:
                 raw = self.rfile.read(n)
                 try:
                     parts = [p for p in self.path.split("/") if p]
+                    ac = getattr(c, "access_control", None)
+                    if ac is not None:
+                        # every mutating controller endpoint needs WRITE
+                        # (controller api/access AccessControl parity); the
+                        # table resource is the path's table component when
+                        # present
+                        from pinot_tpu.cluster.access import WRITE
+
+                        ident = ac.authenticate(dict(self.headers))
+                        table_res = parts[1] if len(parts) >= 2 and parts[0] in ("segments", "tables") else None
+                        ac.check(ident, table_res, WRITE)
                     if self.path == "/schemas":
                         c.add_schema(Schema.from_json(raw.decode()))
                         self._json({"status": "ok"})
@@ -505,6 +523,8 @@ class ControllerHTTPService:
                         )
                     else:
                         self._json({"error": "not found"}, 404)
+                except PermissionError as e:
+                    self._json({"error": str(e)}, 403)
                 except Exception as e:
                     self._json({"error": f"{type(e).__name__}: {e}"}, 500)
 

@@ -1,0 +1,142 @@
+"""Access control on broker and controller APIs (round 4, VERDICT missing
+item 9: pinot-controller/.../api/access AccessControl SPI +
+BasicAuthAccessControlFactory parity)."""
+
+import base64
+import json
+import urllib.request
+
+import numpy as np
+import pytest
+
+from pinot_tpu.common import DataType, Schema, TableConfig
+from pinot_tpu.cluster import Broker, Controller, PropertyStore, Server
+from pinot_tpu.cluster.access import (
+    READ,
+    WRITE,
+    AccessDenied,
+    BasicAuthAccessControl,
+    Principal,
+    parse_basic,
+)
+from pinot_tpu.segment import SegmentBuilder
+
+
+def _schema():
+    return Schema.build("t", dimensions=[("g", DataType.STRING)], metrics=[("v", DataType.LONG)])
+
+
+def _cluster(tmp_path):
+    store = PropertyStore()
+    ctrl = Controller(store, tmp_path / "deep")
+    srv = Server("s0")
+    ctrl.register_server("s0", handle=srv)
+    ctrl.add_schema(_schema())
+    ctrl.add_table(TableConfig("t"))
+    rng = np.random.default_rng(1)
+    seg = SegmentBuilder(_schema()).build(
+        {"g": np.asarray(["a"] * 100, dtype=object), "v": rng.integers(1, 9, 100).astype(np.int64)},
+        "s0seg",
+    )
+    ctrl.upload_segment("t", seg)
+    return ctrl
+
+
+def test_principal_table_and_permission_scoping():
+    ac = BasicAuthAccessControl(
+        principals=[
+            Principal("admin", "secret"),
+            Principal("reader", "r", tables=("t",), permissions=(READ,)),
+            Principal("other", "o", tables=("elsewhere",)),
+        ]
+    )
+    assert ac.has_access(parse_basic("admin", "secret"), "t", WRITE)
+    assert ac.has_access(parse_basic("reader", "r"), "t", READ)
+    assert not ac.has_access(parse_basic("reader", "r"), "t", WRITE)
+    assert not ac.has_access(parse_basic("other", "o"), "t", READ)
+    assert not ac.has_access(parse_basic("admin", "wrong"), "t", READ)
+    assert not ac.has_access(None, "t", READ)  # anonymous denied
+
+
+def test_broker_gates_reads(tmp_path):
+    ctrl = _cluster(tmp_path)
+    ac = BasicAuthAccessControl(
+        principals=[Principal("reader", "r", tables=("t",), permissions=(READ,))]
+    )
+    broker = Broker(ctrl, access_control=ac)
+    res = broker.execute("SELECT COUNT(*) FROM t", identity=parse_basic("reader", "r"))
+    assert res.rows[0][0] == 100
+    with pytest.raises(AccessDenied):
+        broker.execute("SELECT COUNT(*) FROM t")  # anonymous
+    with pytest.raises(AccessDenied):
+        broker.execute("SELECT COUNT(*) FROM t", identity=parse_basic("reader", "wrong"))
+    # no access control configured -> open (AllowAll default)
+    assert Broker(ctrl).execute("SELECT COUNT(*) FROM t").rows[0][0] == 100
+
+
+def test_http_basic_auth_end_to_end(tmp_path):
+    from pinot_tpu.cluster.http import BrokerHTTPService, ControllerHTTPService
+
+    ctrl = _cluster(tmp_path)
+    ac = BasicAuthAccessControl(
+        principals=[
+            Principal("admin", "secret"),
+            Principal("reader", "r", permissions=(READ,)),
+        ]
+    )
+    ctrl.access_control = ac
+    broker = Broker(ctrl, access_control=ac)
+    bsvc = BrokerHTTPService(broker)
+    csvc = ControllerHTTPService(ctrl) if hasattr(ControllerHTTPService, "__call__") else None
+    try:
+        def post(port, path, body, user=None, pw=None):
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}{path}",
+                data=json.dumps(body).encode(),
+                method="POST",
+            )
+            if user:
+                tok = base64.b64encode(f"{user}:{pw}".encode()).decode()
+                req.add_header("Authorization", f"Basic {tok}")
+            try:
+                with urllib.request.urlopen(req, timeout=10) as r:
+                    return r.status, json.loads(r.read().decode())
+            except urllib.error.HTTPError as e:
+                return e.code, json.loads(e.read().decode() or "{}")
+
+        # broker: query with and without credentials
+        code, out = post(bsvc.port, "/query/sql", {"sql": "SELECT COUNT(*) FROM t"}, "reader", "r")
+        assert code == 200 and out["resultTable"]["rows"][0][0] == 100
+        code, _denied = post(bsvc.port, "/query/sql", {"sql": "SELECT COUNT(*) FROM t"})
+        assert code == 403
+        # controller: mutating endpoint needs WRITE
+        from pinot_tpu.cluster.http import ControllerHTTPService as CS
+
+        cs = CS(ctrl)
+        try:
+            new_schema = Schema.build(
+                "t2", dimensions=[("g", DataType.STRING)], metrics=[("v", DataType.LONG)]
+            )
+            code, _ = post(cs.port, "/schemas", json.loads(new_schema.to_json()), "admin", "secret")
+            # Schema.from_json expects raw json body: re-post raw below if needed
+            if code != 200:
+                req = urllib.request.Request(
+                    f"http://127.0.0.1:{cs.port}/schemas", data=new_schema.to_json().encode(), method="POST"
+                )
+                tok = base64.b64encode(b"admin:secret").decode()
+                req.add_header("Authorization", f"Basic {tok}")
+                with urllib.request.urlopen(req, timeout=10) as r:
+                    assert r.status == 200
+            # reader (READ-only) may not mutate
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{cs.port}/schemas", data=new_schema.to_json().encode(), method="POST"
+            )
+            tok = base64.b64encode(b"reader:r").decode()
+            req.add_header("Authorization", f"Basic {tok}")
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(req, timeout=10)
+            assert ei.value.code == 403
+        finally:
+            cs.stop()
+    finally:
+        bsvc.stop()
