@@ -80,7 +80,12 @@ def tier_of_segment(config, seg_meta: dict, now: float | None = None) -> dict | 
     if uploaded is None:
         return None
     age = now - float(uploaded)
-    for tier in tiers:
+    # Oldest-age tier first (TierConfigUtils.getTierComparator sorts
+    # time-based selectors before first-match) — raw config order would
+    # route every aged segment to whichever tier happens to be listed
+    # first, never the colder ones.
+    ordered = sorted(tiers, key=lambda t: -float(t.get("segmentAgeSeconds", 0)))
+    for tier in ordered:
         if age >= float(tier.get("segmentAgeSeconds", 0)):
             return tier
     return None

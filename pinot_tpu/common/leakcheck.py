@@ -60,11 +60,21 @@ class StagingTracker:
             self._staged = alive
         return out
 
-    def assert_staging_collectable(self, keep: set[str] = frozenset()) -> None:
-        """Assert every staged copy NOT named in `keep` has been collected."""
-        leaked = {n: c for n, c in self.live().items() if n not in keep}
+    def _assert_none_live(self, is_checked) -> None:
+        leaked = {n: c for n, c in self.live().items() if is_checked(n)}
         if leaked:
             raise AssertionError(f"device staging leaked for segments: {leaked}")
+
+    def assert_staging_collectable(self, keep: set[str] = frozenset()) -> None:
+        """Assert every staged copy NOT named in `keep` has been collected."""
+        self._assert_none_live(lambda n: n not in keep)
+
+    def assert_collected(self, names: set[str]) -> None:
+        """Assert the NAMED segments have no live staged copies. Unlike
+        assert_staging_collectable this is scoped: unrelated segments other
+        components legitimately keep staged (to_device_cached) don't trip
+        it, so the check is stable under any test ordering."""
+        self._assert_none_live(lambda n: n in names)
 
 
 #: process-wide tracker (segment.to_device registers here)

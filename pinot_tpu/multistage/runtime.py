@@ -354,11 +354,13 @@ def _join_key_pair(ls: pd.Series, rs: pd.Series) -> "tuple[np.ndarray, np.ndarra
             return v
         if v.dtype == object:
             cells = v[~pd.isna(v)]
-            # actual number objects only — pd.to_numeric alone would also
-            # parse numeric STRINGS and invent 1 == "1" matches
-            if len(cells) and all(
-                isinstance(x, (int, float, np.integer, np.floating)) and not isinstance(x, bool)
-                for x in cells[:1024]
+            # actual number objects only, checked over EVERY cell (at C speed
+            # via infer_dtype) — a sampled prefix would let a numeric string
+            # past the window survive pd.to_numeric and invent 1 == "1"
+            if len(cells) and pd.api.types.infer_dtype(cells, skipna=True) in (
+                "integer",
+                "floating",
+                "mixed-integer-float",
             ):
                 num = pd.to_numeric(s, errors="coerce")
                 if bool((num.notna() | s.isna()).all()):
@@ -375,7 +377,7 @@ def _join_key_pair(ls: pd.Series, rs: pd.Series) -> "tuple[np.ndarray, np.ndarra
         v = s.to_numpy()
         if v.dtype == object:
             cells = v[~pd.isna(v)]
-            if len(cells) and not all(isinstance(x, str) for x in cells[:256]):
+            if len(cells) and pd.api.types.infer_dtype(cells, skipna=True) != "string":
                 return None  # mixed-content object column: don't stringify
         return np.where(pd.isna(v), "", np.asarray(v, dtype=object)).astype(str)
 

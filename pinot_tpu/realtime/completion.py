@@ -113,7 +113,11 @@ class SegmentCompletionManager:
         with self._lock:
             if segment in self._committed:
                 return False
-            st = self._state(segment)
+            # .get, not _state: a stray/late heartbeat for an unknown name
+            # must not mint a fresh FSM entry in this controller-lifetime map
+            st = self._fsm.get(segment)
+            if st is None:
+                return False
             if st["phase"] != "COMMITTING" or st["committer"] != server_id:
                 return False
             started = st.get("commit_started") or time.time()

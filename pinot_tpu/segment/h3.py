@@ -314,11 +314,22 @@ class H3Index:
     #: after a load (not persisted — deterministic from the ids)
     centers: "np.ndarray | None" = None
 
+    #: hex coords scale as sqrt(7)^res; past res 11 the i-k/j-k magnitudes
+    #: exceed the 2^20 bias in pack_cell's 26-bit fields and ids would
+    #: silently alias (advisor r4)
+    MAX_RES = 11
+
     @staticmethod
     def build(
         lat_col: str, lng_col: str, lat: np.ndarray, lng: np.ndarray, res: int = 5
     ) -> "H3Index":
         from pinot_tpu.segment.indexes import haversine_m
+
+        if not 0 <= res <= H3Index.MAX_RES:
+            raise ValueError(
+                f"h3 res {res} out of range [0, {H3Index.MAX_RES}]: packed-cell "
+                f"ijk fields alias past res {H3Index.MAX_RES}"
+            )
 
         lat = np.asarray(lat, dtype=np.float64)
         lng = np.asarray(lng, dtype=np.float64)

@@ -32,9 +32,11 @@ def test_staging_collected_after_unhost():
     seg = _segment("leak_a")
     eng = QueryEngine([seg])
     assert eng.execute("SELECT COUNT(*) FROM t").rows[0][0] == 500
-    # unhost: drop every reference; the staged device copy must be collectable
+    # unhost: drop every reference; the staged device copy must be
+    # collectable. Scoped to THIS test's segment — other tests' cached
+    # stagings (to_device_cached) are legitimate and must not trip it.
     del eng, seg
-    staging_tracker.assert_staging_collectable(keep=set())
+    staging_tracker.assert_collected({"leak_a"})
 
 
 def test_staging_leak_detected():
@@ -44,9 +46,9 @@ def test_staging_leak_detected():
     pinned = seg.to_device_cached()  # simulate a component pinning staging
     del eng, seg
     with pytest.raises(AssertionError, match="leak_b"):
-        staging_tracker.assert_staging_collectable(keep=set())
+        staging_tracker.assert_collected({"leak_b"})
     del pinned
-    staging_tracker.assert_staging_collectable(keep=set())
+    staging_tracker.assert_collected({"leak_b"})
 
 
 def test_accountant_clean_after_queries():
