@@ -445,6 +445,36 @@ def _device_equi_join(lk: np.ndarray, rk: np.ndarray) -> "tuple[np.ndarray, np.n
         return None
     if len(rk) == 0:
         return np.zeros(0, dtype=np.int64), np.zeros(0, dtype=np.int64)
+    if (np.issubdtype(lk.dtype, np.integer) and np.issubdtype(rk.dtype, np.integer)) or (
+        lk.dtype == np.float64 and rk.dtype == np.float64
+    ):
+        # same-mesh HASH exchange tier (BlockExchange HASH_DISTRIBUTED as
+        # all_to_all in shard_map): repartition both sides by key across
+        # the devices and probe per shard. Declines (None) on duplicate
+        # build keys / 1-device mesh; the single-device path then runs.
+        # Multistage blocks normalize numerics to f64 — NaN-free f64 keys
+        # (NaN was rejected above) bitcast to int64, which preserves
+        # equality exactly (-0.0 normalized to +0.0 first).
+        from pinot_tpu.parallel import shuffle
+
+        if lk.dtype == np.float64:
+            mk_l = np.where(lk == 0.0, 0.0, lk).view(np.int64)
+            mk_r = np.where(rk == 0.0, 0.0, rk).view(np.int64)
+        else:
+            mk_l, mk_r = lk, rk
+        mesh_out = shuffle.mesh_equi_join(mk_l, mk_r)
+        if mesh_out is None:
+            # the unique-key (build) side may be the LEFT one — the mesh
+            # kernel only requires uniqueness on its right operand, so probe
+            # the other way around and swap the returned pairs back
+            swapped = shuffle.mesh_equi_join(mk_r, mk_l)
+            if swapped is not None:
+                mesh_out = (swapped[1], swapped[0])
+        if mesh_out is not None:
+            DEVICE_OP_STATS["join"] += 1
+            DEVICE_OP_STATS["mesh_join"] = DEVICE_OP_STATS.get("mesh_join", 0) + 1
+            li, ri = mesh_out
+            return li.astype(np.int64), ri.astype(np.int64)
     order = np.argsort(rk, kind="stable")
     srk = rk[order]
     j_srk = jnp.asarray(srk)

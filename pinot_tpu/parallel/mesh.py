@@ -238,10 +238,12 @@ def _sharded_kernel(spec: tuple, mesh: Mesh, axis: str, doc_pad: int):
     from pinot_tpu.query.kernels import build_masked_fn
 
     base = build_masked_fn(spec)
-    grouped = spec[2] is not None
+    gspec = spec[2]
+    grouped = gspec is not None
+    sparse = grouped and gspec[0] == "groups_sparse"
     pack_meta: dict = {}
 
-    def per_shard(cols, ops, n_docs):
+    def _flatten_local(cols, n_docs):
         # cols: doc-aligned (S_local, P) plus MV flats (S_local, F_pad).
         # Aggregates are order-independent, so flatten the local segments
         # into ONE doc vector with a per-segment validity mask — one wide
@@ -258,7 +260,18 @@ def _sharded_kernel(spec: tuple, mesh: Mesh, axis: str, doc_pad: int):
         valid = (
             jnp.arange(doc_pad, dtype=jnp.int32)[None, :] < n_docs[:, None]
         ).reshape(s_local * doc_pad)
+        return flat, valid
+
+    def per_shard(cols, ops, n_docs):
+        flat, valid = _flatten_local(cols, n_docs)
         out = base(flat, ops, valid)
+        if sparse:
+            # sort-compaction slots are shard-LOCAL (each shard compacts its
+            # own present groups), so partials cannot ride an all-reduce —
+            # every shard ships its (counts, parts, uniq) table back and the
+            # broker-style reduce merges the <=U-row tables host-side, the
+            # per-server DataTable model (BrokerReduceService.java:61).
+            return jax.tree.map(lambda x: x[None, ...], out)
         if grouped:
             matched, counts, parts = out
         else:
@@ -276,7 +289,9 @@ def _sharded_kernel(spec: tuple, mesh: Mesh, axis: str, doc_pad: int):
             per_shard,
             mesh=mesh,
             in_specs=(col_specs, P(), P(axis)),
-            out_specs=P(),  # partials are replicated after collectives
+            # sparse: per-shard tables concatenate over the mesh axis;
+            # dense: partials are replicated after collectives
+            out_specs=P(axis) if sparse else P(),
             check_vma=False,
         )
         out = f(cols, ops, n_docs)
@@ -285,16 +300,34 @@ def _sharded_kernel(spec: tuple, mesh: Mesh, axis: str, doc_pad: int):
         # captured at (first) trace time is valid for every call
         pack_meta["treedef"] = treedef
         pack_meta["leaves"] = [(tuple(l.shape), np.dtype(l.dtype)) for l in leaves]
-        return jnp.concatenate([jnp.ravel(l).astype(jnp.float64) for l in leaves])
+        chunks = []
+        for l in leaves:
+            flat = jnp.ravel(l)
+            if flat.dtype == jnp.int64:
+                # hi/lo 32-bit split: sparse gid64 slot tables exceed 2^53
+                # and would lose exactness as a plain f64 cast
+                chunks.append(jnp.floor_divide(flat, 1 << 32).astype(jnp.float64))
+                chunks.append(jnp.remainder(flat, 1 << 32).astype(jnp.float64))
+            else:
+                chunks.append(flat.astype(jnp.float64))
+        return jnp.concatenate(chunks)
 
     def unpack(vec: np.ndarray):
         out = []
         i = 0
         for shape, dtype in pack_meta["leaves"]:
             size = int(np.prod(shape, dtype=np.int64)) if shape else 1
-            chunk = vec[i : i + size].reshape(shape)
-            out.append(chunk.astype(dtype) if dtype != np.float64 else chunk)
-            i += size
+            if dtype == np.int64:
+                hi = vec[i : i + size]
+                lo = vec[i + size : i + 2 * size]
+                i += 2 * size
+                chunk = (hi.astype(np.int64) << 32) + lo.astype(np.int64)
+            else:
+                chunk = vec[i : i + size]
+                i += size
+                if dtype != np.float64:
+                    chunk = chunk.astype(dtype)
+            out.append(chunk.reshape(shape))
         return jax.tree.unflatten(pack_meta["treedef"], out)
 
     return jax.jit(run), unpack
@@ -314,6 +347,8 @@ def _collect_mv_nv_indices(node, out: set) -> None:
         out.add(node[2])
     elif k in ("mv_sum", "mv_min", "mv_max", "mv_avg", "mv_distinct_ids"):
         out.add(node[3])
+    elif k == "groups_mv":
+        out.add(node[5])
     for c in node:
         if isinstance(c, tuple):
             _collect_mv_nv_indices(c, out)
@@ -339,13 +374,10 @@ def execute_sharded(table: ShardedTable, sql: str):
                 )
     plan: SegmentPlan = plan_segment(table.proto, ctx)
     gspec = plan.spec[2]
-    if gspec is not None and gspec[0] != "groups":
-        # fail fast with clear semantics: the sharded path has no host
-        # fallback, so a sparse/MV group spec must not reach jit tracing
-        raise ValueError(
-            "sharded execution supports dense group specs only "
-            f"(got {gspec[0]}: high-cardinality/MV GROUP BY)"
-        )
+    if gspec is not None and gspec[0] == "groups_mv2":
+        # mv2's per-doc offset/length tables index the proto doc space,
+        # which the sharded flat layout doesn't have — run on the proto
+        raise ProtoFallback("two-MV-key cartesian GROUP BY runs on the proto segment")
     kernel, _unpack = _sharded_kernel(plan.spec, table.mesh, table.mesh.axis_names[0], table.padded)
     cols = {c: table.arrays[c] for c in plan.columns}
     if not cols:
@@ -364,27 +396,76 @@ def execute_sharded(table: ShardedTable, sql: str):
     return ctx, plan, out
 
 
+class ProtoFallback(Exception):
+    """Raised when a query shape can't ride the sharded kernel; the caller
+    re-runs it over the host-side proto segment (which holds the full
+    table), preserving the result contract."""
+
+
+def _run_on_proto(table: ShardedTable, sql: str):
+    from pinot_tpu.query.engine import QueryEngine
+
+    return QueryEngine([table.proto]).execute(sql)
+
+
 def execute_sharded_result(table: ShardedTable, sql: str):
-    """execute_sharded + broker-style reduce to a final ResultTable."""
+    """execute_sharded + broker-style reduce to a final ResultTable.
+
+    Sparse (high-cardinality) group-bys come back as per-shard compacted
+    tables — one <=U-row (counts, parts, uniq) block per device — merged by
+    the same reduce that merges per-server DataTables. A shard whose present
+    groups overflow its slot budget invalidates the device result; the query
+    re-runs on the host-side proto segment."""
     from pinot_tpu.query import reduce as reduce_mod
     from pinot_tpu.query.engine import QueryEngine
 
-    ctx, plan, out = execute_sharded(table, sql)
+    from pinot_tpu.query.plan import DeviceFallback
+
+    try:
+        ctx, plan, out = execute_sharded(table, sql)
+    except (ProtoFallback, DeviceFallback):
+        # proto holds the full host-side table: any shape the sharded kernel
+        # can't express (mv2 cartesian, expression group keys, ...) still
+        # answers correctly through the per-segment engine's own paths
+        return _run_on_proto(table, sql)
     _, unpack = _sharded_kernel(plan.spec, table.mesh, table.mesh.axis_names[0], table.padded)
     host = unpack(np.asarray(out))  # single device->host round trip
     e = QueryEngine([])
+    gspec = plan.spec[2]
     if ctx.query_type == QueryType.AGGREGATION:
         matched, parts = host
         partial = e._convert_agg(table.proto, ctx, plan, parts)
         rows = reduce_mod.reduce_aggregation(ctx, [partial])
+        matched = int(matched)
+    elif gspec is not None and gspec[0] == "groups_sparse":
+        matched_s, counts_s, parts_s, uniq_s, n_unique_s = host
+        u_slots = gspec[2]
+        if int(np.max(n_unique_s)) > u_slots:
+            # a shard's clipped slots collided — device result unusable
+            return _run_on_proto(table, sql)
+        frames = []
+        for d in range(len(n_unique_s)):
+            frames.append(
+                e._convert_groups(
+                    table.proto,
+                    ctx,
+                    plan,
+                    np.asarray(counts_s[d]),
+                    jax.tree.map(lambda x: x[d], parts_s),
+                    dense_gids=np.asarray(uniq_s[d]),
+                )
+            )
+        rows = reduce_mod.reduce_group_by(ctx, frames)
+        matched = int(np.sum(matched_s))
     else:
         matched, counts, parts = host
         frame = e._convert_groups(table.proto, ctx, plan, np.asarray(counts), parts)
         rows = reduce_mod.reduce_group_by(ctx, [frame])
+        matched = int(matched)
     return reduce_mod.build_result(
         ctx,
         rows,
-        num_docs_scanned=int(matched),
+        num_docs_scanned=matched,
         total_docs=table.total_docs,
         num_segments_queried=table.n_segments,
     )

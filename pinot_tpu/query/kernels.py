@@ -526,6 +526,102 @@ def _grouped_all(aggs, cols, ops, mask, gid, ng, gather=None, doc_pad=None):
 # ---------------------------------------------------------------------------
 
 
+def _agg_eval(fspec, gspec, aggs, cols, ops, valid):
+    """The full aggregation program body over an explicit doc-validity mask.
+    Shared by build_fn (valid derived from an n_docs scalar) and
+    build_masked_fn (the sharded executor's flattened multi-segment space,
+    where validity comes per-position). Every group-spec kind — dense,
+    MV-key, MV-pair cartesian, sparse sort-compaction — evaluates here, so
+    the sharded path supports the same group shapes as the per-segment one
+    (groups_mv2 excluded: its per-doc offset/length operand tables index the
+    proto's doc space, which does not exist in the sharded flat layout)."""
+    n_padded = valid.shape[0]
+    mask = valid & _filter(fspec, cols, ops, n_padded)
+    matched = jnp.sum(mask, dtype=jnp.int32).astype(_I)
+    if gspec is None:
+        return matched, tuple(_agg_scalar(a, cols, ops, mask) for a in aggs)
+    if gspec[0] == "groups_mv":
+        # one MV group key: gids live in VALUE space — each doc
+        # contributes once per value (Pinot MV group-by semantics)
+        _, gcols, ng, strides_idx, mv_col, nv_idx = gspec
+        docids = cols[f"{mv_col}!docs"]
+        vmask = _mv_vmask(mv_col, nv_idx, cols, ops, mask)
+        strides = ops[strides_idx]
+        gid = jnp.zeros((cols[mv_col].shape[0],), dtype=jnp.int32)
+        for i, c in enumerate(gcols):
+            ids = cols[c] if c == mv_col else cols[c][docids]
+            gid = gid + ids * strides[i]
+        counts, parts = _grouped_all(
+            aggs, cols, ops, vmask, gid, ng, gather=docids, doc_pad=n_padded
+        )
+        return matched, counts, parts
+    if gspec[0] == "groups_mv2":
+        # two MV keys: dense (base flat values x other max-len) pair
+        # space — each pair is one cartesian (a_val, b_val) combination
+        # of one doc (Pinot MV group-by cartesian semantics)
+        _, gcols, ng, strides_idx, mv_a, nv_a, mv_b, off_idx, len_idx, lb = gspec
+        docids = cols[f"{mv_a}!docs"]  # (va,)
+        vmask_a = _mv_vmask(mv_a, nv_a, cols, ops, mask)
+        d_off = ops[off_idx][docids]  # (va,)
+        d_len = ops[len_idx][docids]
+        j = jnp.arange(lb, dtype=jnp.int32)
+        fidx = d_off[:, None] + j[None, :]  # (va, lb)
+        pvalid = vmask_a[:, None] & (j[None, :] < d_len[:, None])
+        nb = cols[mv_b].shape[0]
+        ids_b = cols[mv_b][jnp.clip(fidx, 0, nb - 1)]
+        strides = ops[strides_idx]
+        va = docids.shape[0]
+        gid2 = jnp.zeros((va, lb), dtype=jnp.int32)
+        for i, c in enumerate(gcols):
+            if c == mv_a:
+                idc = cols[c][:, None]
+            elif c == mv_b:
+                idc = ids_b
+            else:
+                idc = cols[c][docids][:, None]
+            gid2 = gid2 + idc * strides[i]
+        pair_docids = jnp.broadcast_to(docids[:, None], (va, lb)).reshape(-1)
+        counts, parts = _grouped_all(
+            aggs,
+            cols,
+            ops,
+            pvalid.reshape(-1),
+            gid2.reshape(-1),
+            ng,
+            gather=pair_docids,
+            doc_pad=n_padded,
+        )
+        return matched, counts, parts
+    if gspec[0] == "groups_sparse":
+        # high-cardinality product: 64-bit dense gids -> device sort
+        # -> run-length compaction into U slots -> aggregate over the
+        # compact slot space. The slot table `uniq` rides back so the
+        # host can decode keys; n_unique > U is detected host-side
+        # and falls back (slot collisions would corrupt results).
+        _, gcols, u_slots, strides_idx = gspec
+        strides = ops[strides_idx]
+        gid64 = jnp.zeros((n_padded,), dtype=jnp.int64)
+        for i, c in enumerate(gcols):
+            gid64 = gid64 + cols[c].astype(jnp.int64) * strides[i]
+        sent = jnp.int64(1) << jnp.int64(62)
+        gm = jnp.where(mask, gid64, sent)
+        sg = jnp.sort(gm)
+        first = jnp.concatenate([jnp.ones((1,), bool), sg[1:] != sg[:-1]]) & (sg < sent)
+        n_unique = jnp.sum(first, dtype=jnp.int32)
+        slot = jnp.clip(jnp.cumsum(first.astype(jnp.int32)) - 1, 0, u_slots - 1)
+        uniq = jnp.full((u_slots,), sent, dtype=jnp.int64).at[slot].min(sg)
+        cid = jnp.clip(jnp.searchsorted(uniq, gid64), 0, u_slots - 1).astype(jnp.int32)
+        counts, parts = _grouped_all(aggs, cols, ops, mask, cid, u_slots)
+        return matched, counts, parts, uniq, n_unique
+    _, gcols, ng, strides_idx = gspec
+    strides = ops[strides_idx]
+    gid = jnp.zeros((n_padded,), dtype=jnp.int32)
+    for i, c in enumerate(gcols):
+        gid = gid + cols[c] * strides[i]
+    counts, parts = _grouped_all(aggs, cols, ops, mask, gid, ng)
+    return matched, counts, parts
+
+
 @lru_cache(maxsize=1024)
 def build_fn(spec: tuple):
     """Build the (un-jitted) program for a plan spec. Used directly when
@@ -539,90 +635,7 @@ def build_fn(spec: tuple):
 
         def run(cols, ops, n_docs, n_padded):
             valid = jnp.arange(n_padded, dtype=jnp.int32) < n_docs
-            mask = valid & _filter(fspec, cols, ops, n_padded)
-            matched = jnp.sum(mask, dtype=jnp.int32).astype(_I)
-            if gspec is None:
-                return matched, tuple(_agg_scalar(a, cols, ops, mask) for a in aggs)
-            if gspec[0] == "groups_mv":
-                # one MV group key: gids live in VALUE space — each doc
-                # contributes once per value (Pinot MV group-by semantics)
-                _, gcols, ng, strides_idx, mv_col, nv_idx = gspec
-                docids = cols[f"{mv_col}!docs"]
-                vmask = _mv_vmask(mv_col, nv_idx, cols, ops, mask)
-                strides = ops[strides_idx]
-                gid = jnp.zeros((cols[mv_col].shape[0],), dtype=jnp.int32)
-                for i, c in enumerate(gcols):
-                    ids = cols[c] if c == mv_col else cols[c][docids]
-                    gid = gid + ids * strides[i]
-                counts, parts = _grouped_all(
-                    aggs, cols, ops, vmask, gid, ng, gather=docids, doc_pad=n_padded
-                )
-                return matched, counts, parts
-            if gspec[0] == "groups_mv2":
-                # two MV keys: dense (base flat values x other max-len) pair
-                # space — each pair is one cartesian (a_val, b_val) combination
-                # of one doc (Pinot MV group-by cartesian semantics)
-                _, gcols, ng, strides_idx, mv_a, nv_a, mv_b, off_idx, len_idx, lb = gspec
-                docids = cols[f"{mv_a}!docs"]  # (va,)
-                vmask_a = _mv_vmask(mv_a, nv_a, cols, ops, mask)
-                d_off = ops[off_idx][docids]  # (va,)
-                d_len = ops[len_idx][docids]
-                j = jnp.arange(lb, dtype=jnp.int32)
-                fidx = d_off[:, None] + j[None, :]  # (va, lb)
-                pvalid = vmask_a[:, None] & (j[None, :] < d_len[:, None])
-                nb = cols[mv_b].shape[0]
-                ids_b = cols[mv_b][jnp.clip(fidx, 0, nb - 1)]
-                strides = ops[strides_idx]
-                va = docids.shape[0]
-                gid2 = jnp.zeros((va, lb), dtype=jnp.int32)
-                for i, c in enumerate(gcols):
-                    if c == mv_a:
-                        idc = cols[c][:, None]
-                    elif c == mv_b:
-                        idc = ids_b
-                    else:
-                        idc = cols[c][docids][:, None]
-                    gid2 = gid2 + idc * strides[i]
-                pair_docids = jnp.broadcast_to(docids[:, None], (va, lb)).reshape(-1)
-                counts, parts = _grouped_all(
-                    aggs,
-                    cols,
-                    ops,
-                    pvalid.reshape(-1),
-                    gid2.reshape(-1),
-                    ng,
-                    gather=pair_docids,
-                    doc_pad=n_padded,
-                )
-                return matched, counts, parts
-            if gspec[0] == "groups_sparse":
-                # high-cardinality product: 64-bit dense gids -> device sort
-                # -> run-length compaction into U slots -> aggregate over the
-                # compact slot space. The slot table `uniq` rides back so the
-                # host can decode keys; n_unique > U is detected host-side
-                # and falls back (slot collisions would corrupt results).
-                _, gcols, u_slots, strides_idx = gspec
-                strides = ops[strides_idx]
-                gid64 = jnp.zeros((n_padded,), dtype=jnp.int64)
-                for i, c in enumerate(gcols):
-                    gid64 = gid64 + cols[c].astype(jnp.int64) * strides[i]
-                sent = jnp.int64(1) << jnp.int64(62)
-                gm = jnp.where(mask, gid64, sent)
-                sg = jnp.sort(gm)
-                first = jnp.concatenate([jnp.ones((1,), bool), sg[1:] != sg[:-1]]) & (sg < sent)
-                n_unique = jnp.sum(first, dtype=jnp.int32)
-                slot = jnp.clip(jnp.cumsum(first.astype(jnp.int32)) - 1, 0, u_slots - 1)
-                uniq = jnp.full((u_slots,), sent, dtype=jnp.int64).at[slot].min(sg)
-                cid = jnp.clip(jnp.searchsorted(uniq, gid64), 0, u_slots - 1).astype(jnp.int32)
-                counts, parts = _grouped_all(aggs, cols, ops, mask, cid, u_slots)
-                return matched, counts, parts, uniq, n_unique
-            _, gcols, ng, strides_idx = gspec
-            strides = ops[strides_idx]
-            gid = jnp.zeros((n_padded,), dtype=jnp.int32)
-            for i, c in enumerate(gcols):
-                gid = gid + cols[c] * strides[i]
-            counts, parts = _grouped_all(aggs, cols, ops, mask, gid, ng)
-            return matched, counts, parts
+            return _agg_eval(fspec, gspec, aggs, cols, ops, valid)
 
         return run
 
@@ -682,23 +695,14 @@ def build_masked_fn(spec: tuple):
     kind = spec[0]
     assert kind == "agg", spec
     _, fspec, gspec, aggs = spec
+    # mv2's per-doc offset/length operand tables index the PROTO doc space;
+    # the sharded flat layout has no such space — execute_sharded falls back
+    assert gspec is None or gspec[0] != "groups_mv2", gspec
 
     def run(cols, ops, valid):
-        # doc length from the validity mask: cols may also hold MV flat
-        # arrays whose length is the VALUE space, not the doc space
-        n_padded = valid.shape[0]
-        mask = valid & _filter(fspec, cols, ops, n_padded)
-        matched = jnp.sum(mask, dtype=jnp.int32).astype(_I)
-        if gspec is None:
-            return matched, tuple(_agg_scalar(a, cols, ops, mask) for a in aggs)
-        assert gspec[0] == "groups", gspec  # execute_sharded rejects MV/sparse
-        _, gcols, ng, strides_idx = gspec
-        strides = ops[strides_idx]
-        gid = jnp.zeros((n_padded,), dtype=jnp.int32)
-        for i, c in enumerate(gcols):
-            gid = gid + cols[c] * strides[i]
-        counts, parts = _grouped_all(aggs, cols, ops, mask, gid, ng)
-        return matched, counts, parts
+        # doc length comes from the validity mask: cols may also hold MV
+        # flat arrays whose length is the VALUE space, not the doc space
+        return _agg_eval(fspec, gspec, aggs, cols, ops, valid)
 
     return run
 
