@@ -37,16 +37,18 @@ from jax import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 
-def _mix32(x):
-    """murmur3-style finalizer over the low 32 bits — balances destinations
-    when keys are sequential (key % D would hot-spot)."""
-    h = (x & 0x7FFFFFFF).astype(jnp.uint32)
-    h = h ^ (h >> 16)
-    h = h * jnp.uint32(0x85EBCA6B)
-    h = h ^ (h >> 13)
-    h = h * jnp.uint32(0xC2B2AE35)
-    h = h ^ (h >> 16)
-    return h
+def _hash64(x):
+    """Full-width key hash via the shared murmur3 finalizer (jnp_mix32,
+    query/sketches.py): lo32 ^ mix32(hi32) then a final mix. Hashing BOTH
+    halves matters — float64-bitcast integer keys carry all their entropy
+    in the high word (low mantissa bits are zero), so a low-bits-only hash
+    would route every row to one shard."""
+    from pinot_tpu.query.sketches import jnp_mix32
+
+    xi = x.astype(jnp.int64)
+    lo = (xi & 0xFFFFFFFF).astype(jnp.uint32)
+    hi = ((xi >> 32) & 0xFFFFFFFF).astype(jnp.uint32)
+    return jnp_mix32(jnp, lo ^ jnp_mix32(jnp, hi))
 
 
 def _bucket_pack(cols: tuple, key, valid, n_dest: int, capacity: int):
@@ -54,7 +56,7 @@ def _bucket_pack(cols: tuple, key, valid, n_dest: int, capacity: int):
     Returns (packed_cols, packed_valid, n_dropped). Rows overflowing a
     destination's capacity are dropped and counted."""
     n = key.shape[0]
-    dest = (_mix32(key) % jnp.uint32(n_dest)).astype(jnp.int32)
+    dest = (_hash64(key) % jnp.uint32(n_dest)).astype(jnp.int32)
     dest = jnp.where(valid, dest, n_dest)  # invalid rows sort to the end
     order = jnp.argsort(dest, stable=True)
     sd = dest[order]
@@ -190,7 +192,9 @@ def mesh_equi_join(
 
     def shardify(keys: np.ndarray):
         n = len(keys)
-        per = -(-max(n, 1) // n_dest)
+        # pow2 bucket: bounds distinct compiled kernels to O(log n) across
+        # varying join sizes (review r5) at <2x padding cost
+        per = 1 << max(6, int(np.ceil(np.log2(-(-max(n, 1) // n_dest))))) if n else 64
         kp = np.full(n_dest * per, np.iinfo(kdt).max, dtype=kdt)
         ip = np.full(n_dest * per, -1, dtype=np.int32)
         kp[:n] = keys.astype(kdt)
@@ -206,7 +210,9 @@ def mesh_equi_join(
     rkd, rid, rc = shardify(rk)
     # worst case one shard receives EVERYTHING both sides hold for one
     # destination: start at balanced-x4, retry once at the safe bound
-    for capacity in (max(64, -(-4 * max(lc, rc) // n_dest)), max(lc, rc)):
+    # (pow2 capacities keep the compile cache warm across sizes)
+    cap0 = 1 << max(6, int(np.ceil(np.log2(max(1, -(-4 * max(lc, rc) // n_dest))))))
+    for capacity in (cap0, max(lc, rc)):
         run = _join_kernel(mesh, axis, lc, rc, int(capacity), str(kdt))
         li, ri, hit, drops, dups = run(lkd, lid, rkd, rid)
         if int(dups) > 0:

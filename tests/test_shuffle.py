@@ -51,14 +51,20 @@ def test_hash_exchange_delivers_every_row(mesh):
     # exactly one copy of every row survives, each on its hash shard
     got = sorted(v2[valid].tolist())
     assert got == vals.tolist()
-    # destination check: recompute the host-side hash
-    h = keys.astype(np.uint32) & np.uint32(0x7FFFFFFF)
-    h ^= h >> np.uint32(16)
-    h *= np.uint32(0x85EBCA6B)
-    h ^= h >> np.uint32(13)
-    h *= np.uint32(0xC2B2AE35)
-    h ^= h >> np.uint32(16)
-    want_dest = (h % np.uint32(8)).astype(np.int32)
+    # destination check: recompute the full-width host-side hash
+    def mix32(h):
+        h = h.astype(np.uint32)
+        h ^= h >> np.uint32(16)
+        h *= np.uint32(0x85EBCA6B)
+        h ^= h >> np.uint32(13)
+        h *= np.uint32(0xC2B2AE35)
+        h ^= h >> np.uint32(16)
+        return h
+
+    k64 = keys.astype(np.int64)
+    lo = (k64 & 0xFFFFFFFF).astype(np.uint32)
+    hi = ((k64 >> 32) & 0xFFFFFFFF).astype(np.uint32)
+    want_dest = (mix32(lo ^ mix32(hi)) % np.uint32(8)).astype(np.int32)
     for d in range(8):
         on_d = set(v2[d][valid[d]].tolist())
         expect = set(vals[want_dest == d].tolist())
@@ -188,3 +194,28 @@ def test_multistage_join_rides_mesh_exchange(mesh, monkeypatch):
     res = eng.execute("SELECT SUM(fact.m + dim.w) FROM fact JOIN dim ON fact.k = dim.k LIMIT 10")
     assert res.rows[0][0] == float((fm + dw[fk]).sum())
     assert rt.DEVICE_OP_STATS.get("mesh_join", 0) > before, "join skipped the mesh exchange"
+
+
+def test_hash_exchange_balances_f64_bitcast_keys(mesh):
+    """Integer-valued doubles bitcast to i64 carry all entropy in the high
+    word; the full-width hash must still spread them across shards
+    (review r5: a low-bits hash routed 100% to one shard)."""
+    vals = np.arange(1.0, 4097.0, dtype=np.float64).view(np.int64)
+    out = shuffle.mesh_equi_join(vals, vals[:256], mesh)
+    assert out is not None
+    li, ri = out
+    assert len(li) == 256
+    # destination spread: recompute and require every shard gets SOME rows
+    def mix32(h):
+        h = h.astype(np.uint32)
+        h ^= h >> np.uint32(16)
+        h *= np.uint32(0x85EBCA6B)
+        h ^= h >> np.uint32(13)
+        h *= np.uint32(0xC2B2AE35)
+        h ^= h >> np.uint32(16)
+        return h
+
+    lo = (vals & 0xFFFFFFFF).astype(np.uint32)
+    hi = ((vals >> 32) & 0xFFFFFFFF).astype(np.uint32)
+    dest = mix32(lo ^ mix32(hi)) % np.uint32(8)
+    assert len(np.unique(dest)) == 8, "hash fails to spread bitcast doubles"
