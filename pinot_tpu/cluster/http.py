@@ -42,7 +42,7 @@ class BrokerHTTPService:
                 pass
 
             def do_POST(self):
-                if self.path != "/query/sql":
+                if self.path not in ("/query/sql", "/timeseries/api/v1/query_range"):
                     self.send_error(404)
                     return
                 n = int(self.headers.get("Content-Length", 0))
@@ -52,6 +52,34 @@ class BrokerHTTPService:
                     ac = getattr(svc.broker, "access_control", None)
                     if ac is not None:
                         identity = ac.authenticate(dict(self.headers))
+                    if self.path == "/timeseries/api/v1/query_range":
+                        # TimeSeriesRequestHandler parity: language-selected
+                        # planner over the broker's SQL surface. The shim
+                        # forwards the authenticated identity so table-level
+                        # access control evaluates the real principal, not
+                        # anonymous (review r5).
+                        from pinot_tpu.timeseries import RangeTimeSeriesRequest, TimeSeriesEngine
+
+                        req = RangeTimeSeriesRequest(
+                            query=body["query"],
+                            start=float(body["start"]),
+                            end=float(body["end"]),
+                            step=float(body.get("step", 60)),
+                            language=body.get("language", "m3ql"),
+                        )
+
+                        class _IdentityExecutor:
+                            def execute(self, sql):
+                                return svc.broker.execute(sql, identity=identity)
+
+                        out = TimeSeriesEngine(_IdentityExecutor()).execute_dict(req)
+                        payload = json.dumps(out).encode()
+                        self.send_response(200)
+                        self.send_header("Content-Type", "application/json")
+                        self.send_header("Content-Length", str(len(payload)))
+                        self.end_headers()
+                        self.wfile.write(payload)
+                        return
                     res = svc.broker.execute(body["sql"], identity=identity)
                     payload = json.dumps(res.to_dict()).encode()
                     self.send_response(200)

@@ -45,7 +45,22 @@ def register_stream_factory(stream_type: str, ctor: Callable[[dict], StreamFacto
     _REGISTRY[stream_type] = ctor
 
 
+#: plugin modules auto-imported on first use, so a table config naming a
+#: stream type works without the caller importing the plugin module
+#: (PluginManager classloading parity)
+_PLUGIN_MODULES = {
+    "kafka": "pinot_tpu.realtime.plugins",
+    "file": "pinot_tpu.realtime.plugins",
+    "kinesis": "pinot_tpu.realtime.kinesis",
+    "pulsar": "pinot_tpu.realtime.pulsar",
+}
+
+
 def get_stream_factory(stream_type: str, props: dict) -> StreamFactory:
+    if stream_type not in _REGISTRY and stream_type in _PLUGIN_MODULES:
+        import importlib
+
+        importlib.import_module(_PLUGIN_MODULES[stream_type])
     if stream_type not in _REGISTRY:
         raise KeyError(f"unknown stream type {stream_type!r}; registered: {sorted(_REGISTRY)}")
     return _REGISTRY[stream_type](props)

@@ -31,12 +31,14 @@ from pinot_tpu.timeseries.plan import (
 @dataclass
 class RangeTimeSeriesRequest:
     """RangeTimeSeriesRequest parity: query + [start, end) + step, all in the
-    time column's native unit."""
+    time column's native unit. `language` selects the registered planner
+    (the reference's language query-param; m3ql is the built-in plugin)."""
 
     query: str
     start: float
     end: float
     step: float
+    language: str = "m3ql"
 
     @property
     def num_buckets(self) -> int:
@@ -51,7 +53,9 @@ class TimeSeriesEngine:
         self._sql = sql_executor
 
     def execute(self, request: RangeTimeSeriesRequest) -> TimeSeriesBlock:
-        root = parse_timeseries(request.query)
+        from pinot_tpu.timeseries.language import get_timeseries_planner
+
+        root = get_timeseries_planner(request.language)(request.query)
         return self._run(root, request)
 
     def execute_dict(self, request: RangeTimeSeriesRequest) -> dict:
@@ -119,14 +123,20 @@ def _apply_transform(node: TransformNode, block: TimeSeriesBlock, request) -> Ti
         k = max(1, int(node.args[0]) if node.args else 1)
         return _map_series(block, lambda v: _moving_avg(v, k))
     if kind == "scale":
-        f = float(node.args[0])
+        # "__step__" resolves to the request's bucket width (promql delta)
+        f = float(request.step) if node.args[0] == "__step__" else float(node.args[0])
         return _map_series(block, lambda v: v * f)
     if kind == "topk":
-        k = max(1, int(node.args[0]) if node.args else 1)
-        ranked = sorted(block.series.items(), key=lambda kv: -np.nansum(kv[1]))
-        return TimeSeriesBlock(block.buckets, block.tag_names, dict(ranked[:k]))
+        from pinot_tpu.timeseries.language import ranked_k
+
+        return ranked_k(block, int(node.args[0]) if node.args else 1, largest=True)
     if kind == "keeplastvalue":
         return _map_series(block, _ffill)
+    # pluggable pipeline ops (timeseries/language.py registry)
+    from pinot_tpu.timeseries.language import get_series_op, has_series_op
+
+    if has_series_op(kind):
+        return get_series_op(kind)(block, node.args, request)
     raise AssertionError(kind)
 
 

@@ -100,11 +100,25 @@ def parse_timeseries(query: str):
         raw_args = parts[1] if len(parts) > 1 else ""
         args = [a.strip() for a in re.split(r"[,\s]+", raw_args) if a.strip()]
         if kind not in _SERIES_TRANSFORMS:
-            raise ValueError(f"unknown timeseries transform {kind!r} (have {sorted(_SERIES_TRANSFORMS)})")
+            # registered pipeline-op plugins extend the language
+            from pinot_tpu.timeseries.language import has_series_op, registered_series_ops
+
+            if not has_series_op(kind):
+                raise ValueError(
+                    f"unknown timeseries transform {kind!r} "
+                    f"(core: {sorted(_SERIES_TRANSFORMS)}; ops: {registered_series_ops()})"
+                )
         if kind == "groupby" and not args:
             raise ValueError("groupBy requires at least one tag")
         node = TransformNode(kind, args, node)
     return node
+
+
+# m3ql-flavored pipe syntax is the first language plugin
+# (pinot-timeseries-m3ql analog)
+from pinot_tpu.timeseries.language import register_timeseries_language  # noqa: E402
+
+register_timeseries_language("m3ql", parse_timeseries)
 
 
 def _parse_fetch(stage: str) -> LeafTimeSeriesPlanNode:

@@ -163,3 +163,171 @@ def test_to_dict_json_surface(engine):
     assert d["tagNames"] == ["dc"]
     assert {s["tags"]["dc"] for s in d["series"]} == {"east", "west"}
     assert all(len(s["values"]) == 2 for s in d["series"])
+
+
+# -- round 5: language-plugin SPI + pipeline-op registry ---------------------
+
+
+def test_language_registry_lists_both_languages():
+    from pinot_tpu.timeseries.language import get_timeseries_planner, registered_languages
+
+    get_timeseries_planner("m3ql")
+    get_timeseries_planner("promql")
+    assert {"m3ql", "promql"} <= set(registered_languages())
+    import pytest as _pytest
+
+    with _pytest.raises(KeyError, match="unknown timeseries language"):
+        get_timeseries_planner("nope")
+
+
+def test_new_pipeline_ops_via_m3ql(engine):
+    req = RangeTimeSeriesRequest(
+        "fetch table=metrics value=value time=ts agg=sum | sum | transformNull 0 | integral",
+        start=0,
+        end=40,
+        step=10,
+    )
+    block = engine.execute(req)
+    v = block.series[()]
+    # per-bucket sums of value 0..39 by 10s: 45, 145, 245, 345 -> cumsum
+    assert v.tolist() == [45.0, 190.0, 435.0, 780.0]
+
+
+def test_persecond_and_clamp_ops(engine):
+    req = RangeTimeSeriesRequest(
+        "fetch table=metrics value=value time=ts agg=sum | sum | perSecond | clampMax 20",
+        start=0,
+        end=40,
+        step=10,
+    )
+    v = engine.execute(req).series[()]
+    assert v.tolist() == [4.5, 14.5, 20.0, 20.0]  # sums/10 clamped at 20
+
+
+def test_bottomk(engine):
+    req = RangeTimeSeriesRequest(
+        "fetch table=metrics value=value time=ts agg=sum groupBy=host | bottomk 1",
+        start=0,
+        end=40,
+        step=10,
+    )
+    block = engine.execute(req)
+    assert list(block.series) == [("h1",)]  # evens sum lower than odds
+
+
+def test_promql_language_end_to_end(engine):
+    # selector + label matcher + rate through the SECOND language plugin
+    req = RangeTimeSeriesRequest(
+        'sum(metrics:value{host="h1"})', start=0, end=40, step=10, language="promql"
+    )
+    v = engine.execute(req).series[()]
+    want = [sum(i for i in range(b, b + 10) if i % 2 == 0) for b in (0, 10, 20, 30)]
+    assert v.tolist() == [float(w) for w in want]
+
+
+def test_promql_by_grouping(engine):
+    req = RangeTimeSeriesRequest(
+        "sum by (host) (metrics:value)", start=0, end=40, step=10, language="promql"
+    )
+    block = engine.execute(req)
+    assert set(block.series) == {("h1",), ("h2",)}
+    evens = [sum(i for i in range(b, b + 10) if i % 2 == 0) for b in (0, 10, 20, 30)]
+    assert block.series[("h1",)].tolist() == [float(w) for w in evens]
+
+
+def test_promql_delta_and_clamp(engine):
+    req = RangeTimeSeriesRequest(
+        "clamp_min(delta(sum(metrics:value)), 0)", start=0, end=40, step=10, language="promql"
+    )
+    v = engine.execute(req).series[()]
+    # bucket sums 45,145,245,345 -> delta 100 per bucket; first bucket NaN
+    assert np.isnan(v[0]) and v[1:].tolist() == [100.0, 100.0, 100.0]
+
+
+def test_promql_count_metric(engine):
+    req = RangeTimeSeriesRequest("sum(metrics::count)", start=0, end=40, step=10, language="promql")
+    v = engine.execute(req).series[()]
+    assert v.tolist() == [10.0, 10.0, 10.0, 10.0]
+
+
+def test_promql_rejects_nonsum_by(engine):
+    with pytest.raises(ValueError, match="only sum supports 'by'"):
+        engine.execute(
+            RangeTimeSeriesRequest(
+                "min by (host) (metrics:value)", start=0, end=40, step=10, language="promql"
+            )
+        )
+
+
+def test_broker_http_query_range_endpoint(tmp_path):
+    """/timeseries/api/v1/query_range on the broker HTTP surface
+    (TimeSeriesRequestHandler analog), both languages."""
+    import json
+    import urllib.request
+
+    from pinot_tpu.cluster import Broker, Controller, PropertyStore, Server
+    from pinot_tpu.cluster.http import BrokerHTTPService
+    from pinot_tpu.common import TableConfig
+
+    schema = Schema.build(
+        "metrics",
+        dimensions=[("host", DataType.STRING)],
+        metrics=[("value", DataType.LONG)],
+        date_times=[("ts", DataType.LONG)],
+    )
+    n = 20
+    data = {
+        "host": np.array(["h1", "h2"], dtype=object)[np.arange(n) % 2],
+        "value": np.arange(n, dtype=np.int64),
+        "ts": np.arange(n, dtype=np.int64),
+    }
+    store = PropertyStore()
+    ctrl = Controller(store, tmp_path / "deep")
+    ctrl.add_schema(schema)
+    ctrl.add_table(TableConfig("metrics"))
+    srv = Server("server_0")
+    ctrl.register_server("server_0", srv)
+    ctrl.upload_segment("metrics", SegmentBuilder(schema).build(data, "s0"))
+    http = BrokerHTTPService(Broker(ctrl))
+    try:
+        for lang, q in (
+            ("m3ql", "fetch table=metrics value=value time=ts agg=sum | sum"),
+            ("promql", "sum(metrics:value)"),
+        ):
+            body = json.dumps(
+                {"query": q, "start": 0, "end": 20, "step": 10, "language": lang}
+            ).encode()
+            r = urllib.request.urlopen(
+                urllib.request.Request(
+                    f"http://127.0.0.1:{http.port}/timeseries/api/v1/query_range",
+                    data=body,
+                    headers={"Content-Type": "application/json"},
+                ),
+                timeout=10,
+            )
+            out = json.loads(r.read().decode())
+            vals = out["series"][0]["values"]
+            assert vals == [45.0, 145.0], (lang, out)
+    finally:
+        http.stop()
+
+
+def test_promql_time_column_matcher(engine):
+    """__time__ reserved matcher selects a non-default time column."""
+    schema = Schema.build(
+        "m2",
+        dimensions=[("h", DataType.STRING)],
+        metrics=[("v", DataType.LONG)],
+        date_times=[("when", DataType.LONG)],
+    )
+    n = 20
+    data = {
+        "h": np.array(["a", "b"], dtype=object)[np.arange(n) % 2],
+        "v": np.arange(n, dtype=np.int64),
+        "when": np.arange(n, dtype=np.int64),
+    }
+    eng = TimeSeriesEngine(QueryEngine([SegmentBuilder(schema).build(data, "s0")]))
+    req = RangeTimeSeriesRequest(
+        'sum(m2:v{__time__="when"})', start=0, end=20, step=10, language="promql"
+    )
+    assert eng.execute(req).series[()].tolist() == [45.0, 145.0]
