@@ -23,6 +23,64 @@ import traceback
 import numpy as np
 
 HEADLINE = "ssb_q4_groupby_p50_latency"
+#: the ONE headline query shape — smoke test, config 4, and the scale block
+#: must all measure exactly this workload
+Q4_SQL = (
+    "SELECT d_year, c_nation, p_category, SUM(lo_revenue - lo_supplycost) "
+    "FROM lineorder WHERE lo_quantity > 5 AND d_year BETWEEN 1993 AND 1997 "
+    "GROUP BY d_year, c_nation, p_category ORDER BY SUM(lo_revenue - lo_supplycost) DESC LIMIT 10"
+)
+Q2_SQL = (
+    "SELECT SUM(lo_revenue), MIN(lo_quantity), MAX(lo_revenue), AVG(lo_supplycost) "
+    "FROM lineorder WHERE d_year BETWEEN 1994 AND 1996 AND c_nation = 'NATION_03'"
+)
+
+
+def _bench_q4(table, t, iters, label):
+    """ONE implementation of the Q4 headline measurement (device run, pandas
+    reference, top-row check) — main() and the scale block must stay
+    comparable, so neither carries its own copy."""
+    from pinot_tpu.parallel.mesh import execute_sharded_result
+
+    state = {}
+
+    def dev():
+        state["res"] = execute_sharded_result(table, Q4_SQL)
+
+    def cpu():
+        sel = t[(t.lo_quantity > 5) & (t.d_year >= 1993) & (t.d_year <= 1997)]
+        profit = sel.lo_revenue - sel.lo_supplycost
+        state["cpu"] = profit.groupby([sel.d_year, sel.c_nation, sel.p_category]).sum().nlargest(10)
+
+    def check():
+        assert state["res"].rows[0][3] == float(state["cpu"].iloc[0]), (
+            f"result mismatch: {state['res'].rows[0][3]} vs {float(state['cpu'].iloc[0])}"
+        )
+
+    return _bench_pair(label, dev, cpu, iters, check)
+
+
+def _bench_q2(table, t, iters, label):
+    """Shared config-2 (filtered SUM/MIN/MAX/AVG) measurement."""
+    from pinot_tpu.parallel.mesh import execute_sharded_result
+
+    state = {}
+
+    def dev():
+        state["res"] = execute_sharded_result(table, Q2_SQL)
+
+    def cpu():
+        sel = t[(t.d_year >= 1994) & (t.d_year <= 1996) & (t.c_nation == "NATION_03")]
+        state["cpu"] = (
+            int(sel.lo_revenue.sum()),
+            int(sel.lo_quantity.min()),
+            int(sel.lo_revenue.max()),
+            float(sel.lo_supplycost.mean()),
+        )
+
+    return _bench_pair(
+        label, dev, cpu, iters, lambda: _assert_eq(state["res"].rows[0][0], state["cpu"][0])
+    )
 #: atomically-maintained copy of the most recent SUCCESSFUL on-chip run.
 #: When the driver's end-of-round invocation hits a dead tunnel, the bench
 #: emits this cached TPU evidence (flagged from_cache) instead of losing the
@@ -174,9 +232,7 @@ def _smoke_test(schema, mesh, rng):
     n = 4096
     tiny = build_sharded_table(schema, _make_ssb_data(rng, n), mesh, rows_per_segment=n // 2)
     for q in (
-        "SELECT d_year, c_nation, p_category, SUM(lo_revenue - lo_supplycost) FROM lineorder "
-        "WHERE lo_quantity > 5 AND d_year BETWEEN 1993 AND 1997 "
-        "GROUP BY d_year, c_nation, p_category ORDER BY SUM(lo_revenue - lo_supplycost) DESC LIMIT 10",
+        Q4_SQL,
         "SELECT COUNT(*) FROM lineorder WHERE c_nation = 'NATION_07'",
         "SELECT SUM(lo_revenue), MIN(lo_quantity), MAX(lo_revenue), AVG(lo_supplycost) "
         "FROM lineorder WHERE d_year BETWEEN 1994 AND 1996 AND c_nation = 'NATION_03'",
@@ -254,28 +310,8 @@ def main():
     log(f"table built+staged in {time.perf_counter() - t0:.1f}s ({table.n_segments} segments)")
 
     # ---- config 4 (HEADLINE): SSB Q4.2-flavored profit group-by -------------
-    q4 = (
-        "SELECT d_year, c_nation, p_category, SUM(lo_revenue - lo_supplycost) "
-        "FROM lineorder WHERE lo_quantity > 5 AND d_year BETWEEN 1993 AND 1997 "
-        "GROUP BY d_year, c_nation, p_category ORDER BY SUM(lo_revenue - lo_supplycost) DESC LIMIT 10"
-    )
-    state = {}
-
-    def dev4():
-        state["res"] = execute_sharded_result(table, q4)
-
-    def cpu4():
-        sel = t[(t.lo_quantity > 5) & (t.d_year >= 1993) & (t.d_year <= 1997)]
-        profit = sel.lo_revenue - sel.lo_supplycost
-        state["cpu"] = profit.groupby([sel.d_year, sel.c_nation, sel.p_category]).sum().nlargest(10)
-
-    def check4():
-        assert state["res"].rows[0][3] == float(state["cpu"].iloc[0]), (
-            f"result mismatch: {state['res'].rows[0][3]} vs {float(state['cpu'].iloc[0])}"
-        )
-
     try:
-        c4 = _bench_pair("config4 Q4.x group-by", dev4, cpu4, iters, check4)
+        c4 = _bench_q4(table, t, iters, "config4 Q4.x group-by")
         result["configs"]["4_q4_groupby_orderby"] = c4
         result["value"] = c4["p50"]
         result["vs_baseline"] = c4["speedup"]
@@ -283,6 +319,7 @@ def main():
         log(f"config 4 FAILED: {traceback.format_exc()}")
         result["configs"]["4_q4_groupby_orderby"] = {"error": str(e)}
 
+    state = {}
     # ---- config 1: quickstart COUNT(*) with equality filter -----------------
     q1 = "SELECT COUNT(*) FROM lineorder WHERE c_nation = 'NATION_07'"
 
@@ -302,28 +339,8 @@ def main():
         result["configs"]["1_count_filter"] = {"error": str(e)}
 
     # ---- config 2: SUM/MIN/MAX/AVG with range+equality filter ---------------
-    q2 = (
-        "SELECT SUM(lo_revenue), MIN(lo_quantity), MAX(lo_revenue), AVG(lo_supplycost) "
-        "FROM lineorder WHERE d_year BETWEEN 1994 AND 1996 AND c_nation = 'NATION_03'"
-    )
-
-    def dev2():
-        state["res"] = execute_sharded_result(table, q2)
-
-    def cpu2():
-        sel = t[(t.d_year >= 1994) & (t.d_year <= 1996) & (t.c_nation == "NATION_03")]
-        state["cpu"] = (
-            int(sel.lo_revenue.sum()),
-            int(sel.lo_quantity.min()),
-            int(sel.lo_revenue.max()),
-            float(sel.lo_supplycost.mean()),
-        )
-
     try:
-        result["configs"]["2_filtered_agg"] = _bench_pair(
-            "config2 filtered agg", dev2, cpu2, iters,
-            lambda: _assert_eq(state["res"].rows[0][0], state["cpu"][0]),
-        )
+        result["configs"]["2_filtered_agg"] = _bench_q2(table, t, iters, "config2 filtered agg")
     except Exception as e:
         log(f"config 2 FAILED: {traceback.format_exc()}")
         result["configs"]["2_filtered_agg"] = {"error": str(e)}
@@ -358,6 +375,29 @@ def main():
         log(f"config 5 FAILED: {traceback.format_exc()}")
         result["configs"]["5_startree_hll"] = {"error": str(e)}
 
+    # ---- scale block: sf10-class lineorder (>=60M rows) ---------------------
+    # VERDICT r4 item 3: establish the scaling curve toward BASELINE's
+    # sf100/1B north star. Separate table build, Q4 + filtered-agg at scale,
+    # rows/sec/chip + device-resident bytes recorded alongside p50/p99.
+    try:
+        scale_rows = int(os.environ.get("PINOT_TPU_BENCH_SCALE_ROWS", 60_000_000))
+        if init_err and "PINOT_TPU_BENCH_SCALE_ROWS" not in os.environ:
+            # bound the FALLBACK round like the main configs (a deliberate
+            # CPU run keeps the knob); full-size CPU evidence is captured
+            # out-of-band (BENCH_scale_cpu_r05.json)
+            scale_rows = min(scale_rows, 16_000_000)
+            log(f"TPU-init fallback: clamping scale rows -> {scale_rows}")
+        if scale_rows > 0:
+            # free the main table first: device buffers + both host copies —
+            # the scale build must not pay for the 16M set's residency
+            del table, data, t
+            result["scale"] = _bench_scale(schema, mesh, scale_rows, max(3, iters // 2))
+        else:
+            result["scale"] = {"skipped": "PINOT_TPU_BENCH_SCALE_ROWS=0"}
+    except Exception as e:
+        log(f"scale block FAILED: {traceback.format_exc()}")
+        result["scale"] = {"error": str(e)}
+
     if backend == "tpu" and any(
         isinstance(c, dict) and "p50" in c for c in result["configs"].values()
     ):
@@ -367,6 +407,40 @@ def main():
 
 def _assert_eq(a, b):
     assert float(a) == float(b), f"result mismatch: {a} vs {b}"
+
+
+def _bench_scale(schema, mesh, n: int, iters: int) -> dict:
+    """sf10-class block: build a fresh >=60M-row lineorder, run the Q4
+    headline + the filtered-agg shape at scale, record build time,
+    p50/p99, pandas reference, rows/sec/chip, and staged device bytes."""
+    import jax
+    import pandas as pd
+
+    from pinot_tpu.parallel import build_sharded_table
+
+    rng = np.random.default_rng(7)
+    log(f"[scale] generating {n} rows")
+    data = _make_ssb_data(rng, n)
+    t0 = time.perf_counter()
+    table = build_sharded_table(
+        schema, data, mesh, rows_per_segment=max(1, n // max(4, mesh.devices.size))
+    )
+    build_s = round(time.perf_counter() - t0, 1)
+    dev_bytes = int(sum(v.nbytes for v in table.arrays.values()))
+    log(f"[scale] built+staged in {build_s}s ({table.n_segments} segments, {dev_bytes >> 20} MiB on device)")
+    # object columns already hold str values — astype(str) here would
+    # materialize multi-GB fixed-width unicode copies at peak memory
+    t = pd.DataFrame(data)
+
+    out = {"rows": n, "build_s": build_s, "device_bytes": dev_bytes, "queries": {}}
+    per_chip = lambda b: round(n / (b["p50"] / 1e3) / max(1, len(jax.devices())))  # noqa: E731
+    b4 = _bench_q4(table, t, iters, "scale q4 groupby")
+    b4["rows_per_sec_per_chip"] = per_chip(b4)
+    out["queries"]["q4_groupby"] = b4
+    b2 = _bench_q2(table, t, iters, "scale filtered agg")
+    b2["rows_per_sec_per_chip"] = per_chip(b2)
+    out["queries"]["filtered_agg"] = b2
+    return out
 
 
 def _bench_config5(rng, n, iters):
