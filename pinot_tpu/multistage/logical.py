@@ -847,6 +847,8 @@ class StagePlan:
         # per-query SET options (enableNullHandling etc.) — threaded into
         # leaf-stage QueryContexts so v1 and v2 answer identically
         self.options: dict[str, str] = {}
+        #: rule-framework hit counts (rules.py), surfaced in EXPLAIN
+        self.rule_stats: dict[str, int] = {}
 
     def __repr__(self) -> str:
         lines = []
@@ -855,6 +857,9 @@ class StagePlan:
             lines.append(
                 f"stage {sid} (x{s.parallelism}, ->{s.dist}, inputs={s.inputs}): {_explain(s.root)}"
             )
+        if self.rule_stats:
+            fired = ", ".join(f"{k}:{v}" for k, v in sorted(self.rule_stats.items()))
+            lines.append(f"rules fired: {fired}")
         return "\n".join(lines)
 
 
@@ -910,11 +915,17 @@ class _RootCollect(Node):
 
 
 def build_stage_plan(stmt, catalog: Catalog, n_workers: int = 2) -> StagePlan:
+    from pinot_tpu.multistage.rules import LOGICAL_RULES, PHYSICAL_RULES, optimize
+
     builder = PlanBuilder(catalog)
     root = builder.build(stmt)
     nvis = _visible_count(root)
     visible = [f.name for f in root.fields[:nvis]]
+    rule_stats: dict[str, int] = {}
+    root = optimize(root, LOGICAL_RULES, rule_stats)
     root = insert_exchanges(root, catalog.row_counts)
+    root = optimize(root, PHYSICAL_RULES, rule_stats)
     plan = cut_stages(root, n_workers, visible)
     plan.options = dict(getattr(stmt, "options", None) or {})
+    plan.rule_stats = rule_stats
     return plan

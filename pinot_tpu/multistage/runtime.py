@@ -1386,6 +1386,9 @@ class MultistageEngine:
                 ]
                 for sid, s in sorted(plan.stages.items())
             ]
+            if plan.rule_stats:
+                fired = ", ".join(f"{k}:{v}" for k, v in sorted(plan.rule_stats.items()))
+                out_rows.append([f"[rules] {fired}", -1, -1])
             return ResultTable(
                 columns=["Operator", "Operator_Id", "Parent_Id"],
                 rows=out_rows,
