@@ -654,6 +654,18 @@ class RemoteControllerClient:
             tf.add(seg_dir, arcname=seg_dir.name)
         return self._post(f"/segments/{table}", buf.getvalue(), "application/gzip")
 
+    def upload_segment(self, table: str, seg) -> dict:
+        """Push a built in-memory segment: write to a temp dir, tar, upload.
+        Mirrors the in-process Controller.upload_segment surface so batch
+        runners/connectors work against either handle."""
+        import tempfile
+
+        from pinot_tpu.segment.builder import write_segment
+
+        with tempfile.TemporaryDirectory() as tmp:
+            seg_dir = write_segment(seg, Path(tmp))
+            return self.upload_segment_dir(table, seg_dir)
+
     def schedule_tasks(self, task_type: str | None = None) -> list[str]:
         body = json.dumps({"taskType": task_type} if task_type else {}).encode()
         return self._post("/tasks/schedule", body)["scheduled"]

@@ -73,7 +73,7 @@ def write_table(
 ) -> list[str]:
     """Chunk a DataFrame into segments and push them. The controller must
     already know the table's schema/config (AddTable first)."""
-    from pinot_tpu.segment.builder import SegmentBuilder, write_segment
+    from pinot_tpu.segment.builder import SegmentBuilder
 
     schema = controller.get_schema(table)
     if schema is None:
@@ -82,7 +82,6 @@ def write_table(
     builder = SegmentBuilder(schema, config)
     prefix = segment_name_prefix or f"{table}_df"
     pushed = []
-    remote = not hasattr(controller, "upload_segment")
     for i, start in enumerate(range(0, len(df), rows_per_segment)):
         chunk = df.iloc[start : start + rows_per_segment]
         data = {}
@@ -92,14 +91,79 @@ def write_table(
             v = chunk[name].to_numpy()
             data[name] = v if v.dtype != object else np.asarray(v, dtype=object)
         seg = builder.build(data, f"{prefix}_{i}")
-        if remote:
-            # RemoteControllerClient: write locally, push the tarball
-            import tempfile
-
-            with tempfile.TemporaryDirectory() as tmp:
-                seg_dir = write_segment(seg, Path(tmp))
-                controller.upload_segment_dir(table, seg_dir)
-        else:
-            controller.upload_segment(table, seg)
+        # both handles expose upload_segment (RemoteControllerClient wraps
+        # the write-tempdir-tar-push dance internally)
+        controller.upload_segment(table, seg)
         pushed.append(seg.name)
     return pushed
+
+
+def read_table_via_servers(
+    controller,
+    table: str,
+    columns: list[str] | None = None,
+    parallelism: int = 4,
+    where: str | None = None,
+) -> pd.DataFrame:
+    """Table scan into a DataFrame reading from the SERVERS rather than the
+    deep store — the reference Spark connector's direct-server scan path
+    (pinot-connectors/.../PinotServerDataFetcher reading via server gRPC).
+    One task per (server, segment batch): the same streamed-selection
+    surface the broker uses, so filter pushdown and segment pruning run
+    server-side and the deep store never spins up. Works with an in-process
+    Controller or a RemoteControllerClient."""
+    servers = controller.servers()
+    ideal = controller.ideal_state(table)
+    # one owner per segment: first listed replica (the Spark connector picks
+    # one server per split the same way)
+    per_server: dict[str, list[str]] = {}
+    for seg, owners in sorted(ideal.items()):
+        # ideal-state entries are {server_id: state}; take the first ONLINE
+        # replica as the split owner
+        owner_list = [s for s, st in owners.items() if st == "ONLINE"] if isinstance(owners, dict) else list(owners)
+        if owner_list:
+            per_server.setdefault(owner_list[0], []).append(seg)
+    # streamed selection frames carry positional column labels; the split
+    # results re-label to the real projection (schema order for SELECT *)
+    if columns is None:
+        schema = controller.get_schema(table)
+        if schema is None:
+            raise KeyError(f"no schema for table {table!r}")
+        out_names = list(schema.columns)
+    else:
+        out_names = list(columns)
+    col_sql = ", ".join(out_names)
+    base_sql = f"SELECT {col_sql} FROM {table}"
+    if where:
+        base_sql += f" WHERE {where}"
+    # LIMIT sized to the actual doc count: a huge constant limit would make
+    # the selection kernel allocate limit-sized index buffers
+    meta = controller.all_segment_metadata(table)
+    seg_docs = {s: int(m.get("numDocs", 0)) for s, m in meta.items()}
+
+    def one(item) -> pd.DataFrame:
+        sid, segs = item
+        sql = f"{base_sql} LIMIT {max(1, sum(seg_docs.get(s, 0) for s in segs))}"
+        handle = servers.get(sid)
+        if handle is None:
+            raise KeyError(f"segment owner {sid!r} not in controller instance registry")
+        frames = []
+        stream = handle.execute_partials_stream(table, sql, segs)
+        for frame, _matched, _docs in stream:
+            # in-process handles yield DataFrames; HTTP handles yield
+            # decoded DataTables (columns + rows)
+            if isinstance(frame, pd.DataFrame):
+                if len(frame):
+                    frames.append(frame.set_axis(out_names, axis=1))
+            elif frame.rows:
+                frames.append(pd.DataFrame(frame.rows, columns=out_names))
+        if not frames:
+            return pd.DataFrame(columns=out_names)
+        return pd.concat(frames, ignore_index=True)
+
+    if not per_server:
+        # segment-less table still answers with the schema/projection labels
+        return pd.DataFrame(columns=out_names)
+    with ThreadPoolExecutor(max_workers=max(1, parallelism)) as pool:
+        frames = list(pool.map(one, sorted(per_server.items())))
+    return pd.concat(frames, ignore_index=True)

@@ -87,7 +87,6 @@ def run_segment_generation_job(spec: SegmentGenerationJobSpec, controller=None) 
         raise ValueError(f"job type {spec.job_type} requires a controller to push to")
     if not push and spec.output_dir_uri is None:
         raise ValueError("SegmentCreation requires output_dir_uri")
-    prefix = spec.segment_name_prefix or spec.table_name
     builder = SegmentBuilder(spec.schema, spec.table_config)
 
     local = isinstance(fs, LocalFS)
@@ -95,7 +94,10 @@ def run_segment_generation_job(spec: SegmentGenerationJobSpec, controller=None) 
     def one(idx_file):
         i, fpath = idx_file
         if local:
-            reader = open_record_reader(fpath, spec.input_format)
+            # sequence id in the segment name (SimpleSegmentNameGenerator
+            # parity); read->transform->coerce->build shared with the
+            # distributed runner
+            seg = _build_one_local(spec, builder, i, fpath)
         else:
             # non-local FS (object store / mem): stage through a temp file,
             # the copyToLocal step every non-standalone runner performs
@@ -105,18 +107,10 @@ def run_segment_generation_job(spec: SegmentGenerationJobSpec, controller=None) 
             with tempfile.NamedTemporaryFile(suffix=suffix, delete=False) as tmp:
                 tmp.write(fs.read_bytes(fpath))
                 staged = tmp.name
-            reader = open_record_reader(staged, spec.input_format)
-        try:
-            cols = reader.read_columns()
-        finally:
-            reader.close()
-            if not local:
+            try:
+                seg = _build_one_local(spec, builder, i, staged)
+            finally:
                 Path(staged).unlink(missing_ok=True)
-        if spec.transform is not None:
-            cols = spec.transform(cols)
-        cols = _coerce(spec.schema, cols)
-        # sequence id in the segment name (SimpleSegmentNameGenerator parity)
-        seg = builder.build(cols, f"{prefix}_{i}")
         if push:
             controller.upload_segment(spec.table_name, seg)
             return seg.name
@@ -127,3 +121,144 @@ def run_segment_generation_job(spec: SegmentGenerationJobSpec, controller=None) 
         with ThreadPoolExecutor(max_workers=spec.parallelism) as pool:
             return list(pool.map(one, enumerate(files)))
     return [one(x) for x in enumerate(files)]
+
+
+# ---------------------------------------------------------------------------
+# distributed runner (Hadoop/Spark SegmentGenerationJobRunner analog)
+# ---------------------------------------------------------------------------
+
+
+def _build_one_local(spec: SegmentGenerationJobSpec, builder, i: int, fpath: str):
+    """Shared per-file body of both runners: read -> transform -> coerce ->
+    build. (The standalone runner adds object-store staging around it.)"""
+    reader = open_record_reader(fpath, spec.input_format)
+    try:
+        cols = reader.read_columns()
+    finally:
+        reader.close()
+    if spec.transform is not None:
+        cols = spec.transform(cols)
+    cols = _coerce(spec.schema, cols)
+    prefix = spec.segment_name_prefix or spec.table_name
+    return builder.build(cols, f"{prefix}_{i}")
+
+
+def _run_partition(spec: SegmentGenerationJobSpec, part: list, controller_url: str | None):
+    """One worker task: build + (push|write) every file in its partition.
+    Runs in a SEPARATE PROCESS; pushes travel the real tar.gz-over-HTTP
+    segment upload path, so the worker<->controller boundary matches the
+    reference's distributed runners (SparkSegmentGenerationJobRunner's
+    executors tar-pushing to the controller REST endpoint)."""
+    from pinot_tpu.segment.builder import SegmentBuilder, write_segment
+
+    builder = SegmentBuilder(spec.schema, spec.table_config)
+    push = spec.job_type.endswith("TarPush")
+    client = None
+    if push:
+        from pinot_tpu.cluster.http import RemoteControllerClient
+
+        client = RemoteControllerClient(controller_url)
+    out = []
+    for i, fpath in part:
+        seg = _build_one_local(spec, builder, i, fpath)
+        if push:
+            client.upload_segment(spec.table_name, seg)
+            out.append(seg.name)
+        else:
+            out.append(str(write_segment(seg, Path(spec.output_dir_uri))))
+    return out
+
+
+def run_distributed_segment_generation_job(
+    spec: SegmentGenerationJobSpec,
+    n_workers: int = 2,
+    controller_url: str | None = None,
+    max_task_retries: int = 1,
+) -> list[str]:
+    """Distributed-runner analog of run_segment_generation_job: input files
+    round-robin across `n_workers` worker PROCESSES, each building its
+    partition's segments and tar-pushing them to the controller over HTTP
+    (SegmentCreationAndTarPush) or writing to the shared output dir.
+
+    Failed partitions retry up to `max_task_retries` times (the map-task
+    reattempt semantics of the Hadoop/Spark runners). `spec.transform` must
+    be picklable (a module-level function) or None for this runner.
+
+    Reference: pinot-plugins/pinot-batch-ingestion/pinot-batch-ingestion-
+    {hadoop,spark-2.4,spark-3}/…/SegmentGenerationJobRunner.java — mappers/
+    executors each run the same stage-build-push loop over their file split.
+    """
+    import concurrent.futures as cf
+    import multiprocessing as mp
+
+    fs = get_fs(spec.input_dir_uri)
+    if not isinstance(fs, LocalFS):
+        raise ValueError(
+            "the distributed runner currently requires a shared local/NFS input dir "
+            "(object-store inputs ride the standalone runner's staging path)"
+        )
+    files = [
+        f
+        for f in fs.list_files(spec.input_dir_uri, recursive=True)
+        if fnmatch.fnmatch(PurePosixPath(f).name, spec.include_file_name_pattern)
+    ]
+    if not files:
+        raise FileNotFoundError(
+            f"no input files matching {spec.include_file_name_pattern!r} under {spec.input_dir_uri}"
+        )
+    push = spec.job_type.endswith("TarPush")
+    if push and not controller_url:
+        raise ValueError(f"job type {spec.job_type} requires controller_url to push to")
+    if not push and spec.output_dir_uri is None:
+        raise ValueError("SegmentCreation requires output_dir_uri")
+
+    n_workers = max(1, min(n_workers, len(files)))
+    partitions: list[list] = [[] for _ in range(n_workers)]
+    for i, f in enumerate(files):
+        partitions[i % n_workers].append((i, f))
+
+    # start-method choice: forkserver avoids threaded-parent fork hazards
+    # (a ControllerHTTPService in this process runs threads), but forkserver/
+    # spawn re-import __main__ — impossible for REPL/stdin callers, where
+    # plain fork is the only option (children touch only numpy/urllib, no
+    # parent thread state)
+    import __main__ as _m
+
+    methods = mp.get_all_start_methods()
+    script_main = getattr(_m, "__file__", None) is not None and Path(str(_m.__file__)).exists()
+    if script_main and "forkserver" in methods:
+        ctx = mp.get_context("forkserver")
+    elif "fork" in methods:
+        ctx = mp.get_context("fork")
+    else:
+        ctx = mp.get_context("spawn")
+    results: list[str] = []
+    pending = {pid: part for pid, part in enumerate(partitions) if part}
+    attempts: dict[int, int] = {pid: 0 for pid in pending}
+    pool_breaks = 0
+    while pending:
+        with cf.ProcessPoolExecutor(max_workers=n_workers, mp_context=ctx) as pool:
+            futs = {
+                pool.submit(_run_partition, spec, part, controller_url): pid
+                for pid, part in pending.items()
+            }
+            failed: dict[int, list] = {}
+            for fut in cf.as_completed(futs):
+                pid = futs[fut]
+                try:
+                    results.extend(fut.result())
+                except cf.process.BrokenProcessPool:
+                    # collateral of ANOTHER task crashing the pool: requeue
+                    # without charging this partition's retry budget; a
+                    # separate cap stops a repeatedly-dying worker
+                    pool_breaks += 1
+                    if pool_breaks > (max_task_retries + 1) * max(1, len(partitions)):
+                        raise
+                    failed[pid] = pending[pid]
+                except Exception:
+                    attempts[pid] += 1
+                    if attempts[pid] > max_task_retries:
+                        raise
+                    failed[pid] = pending[pid]
+        pending = failed
+    return results

@@ -158,3 +158,52 @@ def test_connector_against_rest_controller(tmp_path):
         assert sorted(out["value"].tolist()) == [5, 6]
     finally:
         svc.stop()
+
+
+def test_read_table_via_servers(tmp_path):
+    """Direct-server scan connector (Spark PinotServerDataFetcher analog):
+    splits per (server, segments), streamed selection with filter pushdown,
+    over both in-process handles and the HTTP data plane."""
+    from pinot_tpu.cluster import Controller, PropertyStore, Server
+    from pinot_tpu.cluster.http import (
+        ControllerHTTPService,
+        RemoteControllerClient,
+        ServerHTTPService,
+    )
+    from pinot_tpu.connectors.dataframe import read_table_via_servers
+
+    c = Controller(PropertyStore(), tmp_path / "deep")
+    s0, s1 = Server("server_0"), Server("server_1")
+    c.register_server("server_0", s0)
+    c.register_server("server_1", s1)
+    schema = Schema.build(
+        "t", dimensions=[("k", DataType.STRING)], metrics=[("v", DataType.LONG)]
+    )
+    c.add_schema(schema)
+    c.add_table(TableConfig("t"))
+    from pinot_tpu.segment import SegmentBuilder
+
+    rng = np.random.default_rng(0)
+    tot = vsum = 0
+    for i in range(4):
+        kv = np.array(["a", "b", "c"], dtype=object)[rng.integers(0, 3, 300)]
+        vv = rng.integers(0, 100, 300).astype(np.int64)
+        c.upload_segment("t", SegmentBuilder(schema).build({"k": kv, "v": vv}, f"s{i}"))
+        tot += int((kv == "a").sum())
+        vsum += int(vv[kv == "a"].sum())
+    df = read_table_via_servers(c, "t")
+    assert len(df) == 1200 and list(df.columns) == ["k", "v"]
+    df2 = read_table_via_servers(c, "t", columns=["v"], where="k = 'a'")
+    assert len(df2) == tot and int(df2.v.sum()) == vsum
+    # the same connector against the HTTP data plane
+    svc0, svc1, csvc = ServerHTTPService(s0), ServerHTTPService(s1), ControllerHTTPService(c)
+    try:
+        rc = RemoteControllerClient(f"http://127.0.0.1:{csvc.port}")
+        rc.register_instance("server", "server_0", "127.0.0.1", svc0.port)
+        rc.register_instance("server", "server_1", "127.0.0.1", svc1.port)
+        df3 = read_table_via_servers(rc, "t", where="k = 'a'")
+        assert len(df3) == tot and int(df3.v.sum()) == vsum
+    finally:
+        svc0.stop()
+        svc1.stop()
+        csvc.stop()
