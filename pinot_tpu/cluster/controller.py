@@ -143,6 +143,35 @@ class Controller:
     def tables(self) -> list[str]:
         return [p.split("/")[2] for p in self.store.list("/tables/") if p.endswith("/config")]
 
+    def delete_table(self, name: str) -> int:
+        """Drop a table: every segment (server unload + deep-store cleanup),
+        the dimension-table registration, and then the ENTIRE
+        /tables/{name}/ subtree — pauseStatus, watermarks, and any other
+        table-scoped key would otherwise poison a recreated table
+        (DeleteTableCommand / PinotHelixResourceManager.deleteOfflineTable
+        parity). Returns the number of segments removed."""
+        cfg = self.get_table(name)
+        segs = [
+            p.split("/")[-1]
+            for p in self.store.list(f"/tables/{name}/segments/")
+        ]
+        for s in segs:
+            self.delete_segment(name, s)
+        if cfg is not None and (cfg.extra or {}).get("isDimTable"):
+            from pinot_tpu.cluster.dimension import unregister_dim_table
+
+            unregister_dim_table(name)
+        for p in list(self.store.list(f"/tables/{name}/")):
+            self.store.delete(p)
+        return len(segs)
+
+    def delete_schema(self, name: str) -> None:
+        """Drop a schema (DeleteSchemaCommand parity). Refuses while a table
+        still uses it — the reference's referential guard."""
+        if name in self.tables():
+            raise ValueError(f"schema {name!r} is still used by table {name!r}; delete the table first")
+        self.store.delete(f"/schemas/{name}")
+
     # -- segment upload & assignment ----------------------------------------
 
     def upload_segment(self, table: str, segment: ImmutableSegment) -> list[str]:

@@ -247,6 +247,15 @@ class ServerHTTPService:
                     self.send_header("Content-Length", str(len(payload)))
                     self.end_headers()
                     self.wfile.write(payload)
+                elif self.path.startswith("/segments/"):
+                    # hosted-segment listing (VerifySegmentState's live view)
+                    table = self.path.split("/", 2)[2]
+                    payload = json.dumps(svc.server.segments_of(table)).encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type", "application/json")
+                    self.send_header("Content-Length", str(len(payload)))
+                    self.end_headers()
+                    self.wfile.write(payload)
                 elif self.path == "/metrics":
                     from pinot_tpu.common.metrics import server_metrics
 
@@ -367,6 +376,12 @@ class RemoteServerClient:
     def remove_segment(self, table: str, segment_name: str) -> None:
         self._post_json("/segments/remove", {"table": table, "segment": segment_name})
 
+    def segments_of(self, table: str) -> list[str]:
+        with urllib.request.urlopen(
+            f"{self.base_url}/segments/{table}", timeout=self.timeout
+        ) as resp:
+            return json.loads(resp.read())
+
     def get_segment_object(self, table: str, segment_name: str):
         """Remote servers don't ship segment objects over HTTP; multistage
         leaf scans run ON the server via multistage_submit instead."""
@@ -465,6 +480,23 @@ class ControllerHTTPService:
                         )
                     else:
                         self._json({"error": "not found"}, 404)
+                except Exception as e:
+                    self._json({"error": f"{type(e).__name__}: {e}"}, 500)
+
+            def do_DELETE(self):
+                c = svc.controller
+                parts = self.path.strip("/").split("/")
+                try:
+                    if len(parts) == 2 and parts[0] == "tables":
+                        removed = c.delete_table(parts[1])
+                        self._json({"status": "ok", "segmentsRemoved": removed})
+                    elif len(parts) == 2 and parts[0] == "schemas":
+                        c.delete_schema(parts[1])
+                        self._json({"status": "ok"})
+                    else:
+                        self._json({"error": "not found"}, 404)
+                except ValueError as e:
+                    self._json({"error": str(e)}, 409)
                 except Exception as e:
                     self._json({"error": f"{type(e).__name__}: {e}"}, 500)
 
@@ -635,6 +667,20 @@ class RemoteControllerClient:
 
     def add_table(self, config) -> None:
         self._post("/tables", config.to_json().encode())
+
+    def _delete(self, path: str) -> dict:
+        req = urllib.request.Request(self.base_url + path, method="DELETE")
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+                return json.loads(resp.read())
+        except urllib.error.HTTPError as e:
+            raise RuntimeError(f"controller error: {e.read().decode(errors='replace')}") from None
+
+    def delete_table(self, name: str) -> dict:
+        return self._delete(f"/tables/{name}")
+
+    def delete_schema(self, name: str) -> dict:
+        return self._delete(f"/schemas/{name}")
 
     def register_instance(self, kind: str, instance_id: str, host: str, port: int) -> None:
         self._post(

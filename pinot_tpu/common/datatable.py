@@ -108,9 +108,12 @@ def _encode_value(out: BytesIO, v) -> None:
             _w_u32(out, v.ndim)
             for d in v.shape:
                 _w_u32(out, d)
-            data = np.ascontiguousarray(v).tobytes()
-            _w_u32(out, len(data))
-            out.write(data)
+            data = np.ascontiguousarray(v)
+            _w_u32(out, data.nbytes)
+            # uint8 view write: no intermediate tobytes() copy, and unlike a
+            # raw memoryview cast it also handles datetime64/timedelta64
+            # (dtype 'M'/'m' can't export a buffer directly)
+            out.write(memoryview(data.view(np.uint8)))
     elif isinstance(v, (list, tuple, set)):
         tag = _T_LIST if isinstance(v, list) else _T_TUPLE if isinstance(v, tuple) else _T_SET
         out.write(bytes([tag]))
@@ -138,13 +141,17 @@ def encode(value) -> bytes:
 
 
 class _Reader:
+    """Cursor over the payload as a memoryview: numeric column decodes are
+    ZERO-COPY views into the received buffer (ZeroCopyDataBlockSerde
+    analog) — the payload stays alive as long as any decoded array does."""
+
     __slots__ = ("buf", "pos")
 
-    def __init__(self, buf: bytes):
-        self.buf = buf
+    def __init__(self, buf):
+        self.buf = memoryview(buf)
         self.pos = 0
 
-    def take(self, n: int) -> bytes:
+    def take(self, n: int) -> memoryview:
         if self.pos + n > len(self.buf):
             raise DataTableError("truncated DataTable payload")
         b = self.buf[self.pos : self.pos + n]
@@ -158,7 +165,7 @@ class _Reader:
         return struct.unpack("<I", self.take(4))[0]
 
     def s(self) -> str:
-        return self.take(self.u32()).decode()
+        return bytes(self.take(self.u32())).decode()
 
 
 def _decode_value(r: _Reader):
@@ -174,7 +181,7 @@ def _decode_value(r: _Reader):
     if tag == _T_STR:
         return r.s()
     if tag == _T_BYTES:
-        return r.take(r.u32())
+        return bytes(r.take(r.u32()))
     if tag == _T_LIST:
         return [_decode_value(r) for _ in range(r.u32())]
     if tag == _T_TUPLE:
@@ -187,12 +194,14 @@ def _decode_value(r: _Reader):
         dt = np.dtype(r.s())
         shape = tuple(r.u32() for _ in range(r.u32()))
         data = r.take(r.u32())
-        return np.frombuffer(data, dtype=dt).reshape(shape).copy()
+        # zero-copy: a read-only view over the receive buffer; consumers
+        # that mutate must copy (pandas copies on write anyway)
+        return np.frombuffer(data, dtype=dt).reshape(shape)
     if tag == _T_STRARRAY:
         shape = tuple(r.u32() for _ in range(r.u32()))
         n = int(np.prod(shape, dtype=np.int64)) if shape else 1
         lengths = np.frombuffer(r.take(4 * n), dtype=np.uint32)
-        blob = r.take(r.u32())
+        blob = bytes(r.take(r.u32()))
         arr = np.empty(n, dtype=object)
         pos = 0
         for i, ln in enumerate(lengths):

@@ -185,8 +185,30 @@ class Schema:
 
     @staticmethod
     def from_json(s: str) -> "Schema":
+        """Accepts both this framework's flat `fields` form and the
+        reference's Schema.json layout (dimensionFieldSpecs /
+        metricFieldSpecs / dateTimeFieldSpecs, Schema.java:65) so reference
+        schema files load unchanged."""
         d = json.loads(s)
         schema = Schema(d["schemaName"], primary_key_columns=d.get("primaryKeyColumns", []))
-        for fd in d["fields"]:
-            schema.add(FieldSpec.from_dict(fd))
+        if "fields" in d:
+            for fd in d["fields"]:
+                schema.add(FieldSpec.from_dict(fd))
+            return schema
+        for key, ftype in (
+            ("dimensionFieldSpecs", FieldType.DIMENSION),
+            ("metricFieldSpecs", FieldType.METRIC),
+            ("dateTimeFieldSpecs", FieldType.DATE_TIME),
+        ):
+            for fd in d.get(key, []):
+                schema.add(
+                    FieldSpec(
+                        name=fd["name"],
+                        data_type=DataType(fd["dataType"]),
+                        field_type=ftype,
+                        single_value=fd.get("singleValueField", True),
+                        format=fd.get("format"),
+                        granularity=fd.get("granularity"),
+                    )
+                )
         return schema

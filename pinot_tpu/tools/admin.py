@@ -159,6 +159,237 @@ def cmd_rebalance_table(args) -> dict:
     return out
 
 
+def cmd_add_schema(args) -> dict:
+    from pinot_tpu.cluster.http import RemoteControllerClient
+    from pinot_tpu.common.types import Schema
+
+    schema = Schema.from_json(Path(args.schema_file).read_text())
+    RemoteControllerClient(args.controller_url).add_schema(schema)
+    print(f"added schema {schema.name}", flush=True)
+    return {"schema": schema.name}
+
+
+def cmd_delete_table(args) -> dict:
+    from pinot_tpu.cluster.http import RemoteControllerClient
+
+    out = RemoteControllerClient(args.controller_url).delete_table(args.table)
+    print(json.dumps(out), flush=True)
+    return out
+
+
+def cmd_delete_schema(args) -> dict:
+    from pinot_tpu.cluster.http import RemoteControllerClient
+
+    out = RemoteControllerClient(args.controller_url).delete_schema(args.schema)
+    print(json.dumps(out), flush=True)
+    return out
+
+
+def cmd_upload_segment(args) -> dict:
+    """Push an already-built segment directory (UploadSegmentCommand)."""
+    from pinot_tpu.cluster.http import RemoteControllerClient
+
+    rc = RemoteControllerClient(args.controller_url)
+    out = rc.upload_segment_dir(args.table, args.segment_dir)
+    print(json.dumps(out), flush=True)
+    return out
+
+
+def cmd_create_segment(args) -> dict:
+    """Build segments from input files into an output dir WITHOUT pushing
+    (CreateSegmentCommand parity)."""
+    from pinot_tpu.common.types import Schema
+    from pinot_tpu.io.batch import SegmentGenerationJobSpec, run_segment_generation_job
+
+    schema = Schema.from_json(Path(args.schema_file).read_text())
+    spec = SegmentGenerationJobSpec(
+        table_name=args.table,
+        schema=schema,
+        input_dir_uri=args.input_dir,
+        include_file_name_pattern=args.pattern,
+        input_format=args.format,
+        output_dir_uri=args.output_dir,
+        segment_name_prefix=args.segment_prefix or args.table,
+    )
+    dirs = run_segment_generation_job(spec)
+    print(json.dumps({"segments": dirs}), flush=True)
+    return {"segments": dirs}
+
+
+def cmd_launch_distributed_job(args) -> dict:
+    """Distributed ingestion job over worker processes
+    (LaunchSparkDataIngestionJobCommand analog on the local-process tier)."""
+    from pinot_tpu.cluster.http import RemoteControllerClient
+    from pinot_tpu.common.types import Schema
+    from pinot_tpu.io.batch import (
+        SegmentGenerationJobSpec,
+        run_distributed_segment_generation_job,
+    )
+
+    rc = RemoteControllerClient(args.controller_url)
+    schema = rc.get_schema(args.table)
+    if schema is None:
+        raise SystemExit(f"no schema for table {args.table!r} on {args.controller_url}")
+    spec = SegmentGenerationJobSpec(
+        table_name=args.table,
+        schema=schema,
+        input_dir_uri=args.input_dir,
+        job_type="SegmentCreationAndTarPush",
+        include_file_name_pattern=args.pattern,
+        input_format=args.format,
+        segment_name_prefix=args.segment_prefix or args.table,
+    )
+    names = run_distributed_segment_generation_job(
+        spec, n_workers=args.workers, controller_url=args.controller_url
+    )
+    print(json.dumps({"pushed": names}), flush=True)
+    return {"pushed": names}
+
+
+def cmd_generate_data(args) -> dict:
+    """Write demo CSV files for a schema (GenerateDataCommand parity):
+    strings draw from a small token pool, numerics uniform."""
+    import numpy as np
+
+    from pinot_tpu.common.types import DataType, Schema
+
+    schema = Schema.from_json(Path(args.schema_file).read_text())
+    rng = np.random.default_rng(args.seed)
+    outdir = Path(args.output_dir)
+    outdir.mkdir(parents=True, exist_ok=True)
+    rows_per = -(-args.rows // args.files)
+    written = []
+    for f in range(args.files):
+        n = min(rows_per, args.rows - f * rows_per)
+        if n <= 0:
+            break
+        cols = {}
+        for name, spec in schema.fields.items():
+            dt = spec.data_type
+            if dt == DataType.STRING:
+                cols[name] = [f"{name}_{int(x)}" for x in rng.integers(0, args.cardinality, n)]
+            elif dt in (DataType.FLOAT, DataType.DOUBLE):
+                cols[name] = np.round(rng.uniform(0, 1000, n), 3)
+            else:
+                cols[name] = rng.integers(0, 100_000, n)
+        path = outdir / f"generated_{f}.csv"
+        header = ",".join(schema.fields)
+        lines = [header] + [
+            ",".join(str(cols[c][i]) for c in schema.fields) for i in range(n)
+        ]
+        path.write_text("\n".join(lines) + "\n")
+        written.append(str(path))
+    print(json.dumps({"files": written}), flush=True)
+    return {"files": written}
+
+
+def cmd_show_cluster_info(args) -> dict:
+    """Cluster summary (ShowClusterInfoCommand parity)."""
+    from pinot_tpu.cluster.http import RemoteControllerClient
+
+    rc = RemoteControllerClient(args.controller_url)
+    tables = rc.tables()
+    info = {
+        "tables": {
+            t: {"segments": len(rc.all_segment_metadata(t))} for t in tables
+        },
+        "brokers": rc.brokers(),
+        "instances": {k: v for k, v in rc._get("/instances").items()},
+    }
+    print(json.dumps(info, default=str), flush=True)
+    return info
+
+
+def cmd_verify_segment_state(args) -> dict:
+    """Ideal state vs live server state (VerifySegmentState parity):
+    reports segments whose assigned replicas don't host them."""
+    from pinot_tpu.cluster.http import RemoteControllerClient
+
+    rc = RemoteControllerClient(args.controller_url)
+    servers = rc.servers()
+    hosted: dict[str, set] = {}
+    unreachable: list[str] = []
+    for sid, handle in servers.items():
+        try:
+            hosted[sid] = set(handle.segments_of(args.table))
+        except Exception:
+            unreachable.append(sid)
+    mismatches = []
+    for seg, owners in rc.ideal_state(args.table).items():
+        owner_ids = owners if isinstance(owners, list) else list(owners)
+        for sid in owner_ids:
+            if sid in unreachable:
+                continue  # reported separately — down != drifted
+            if sid not in servers:
+                # registered without a reachable data-plane port (e.g. an
+                # in-process quickstart role): can't be verified from here
+                if sid not in unreachable:
+                    unreachable.append(sid)
+                continue
+            if seg not in hosted.get(sid, set()):
+                mismatches.append({"segment": seg, "server": sid})
+    out = {
+        "table": args.table,
+        "mismatches": mismatches,
+        "unreachableServers": sorted(unreachable),
+        "ok": not mismatches and not unreachable,
+    }
+    print(json.dumps(out), flush=True)
+    return out
+
+
+def cmd_change_table_state(args) -> dict:
+    """Pause/resume realtime consumption (ChangeTableState parity over the
+    pause/resume REST endpoints)."""
+    from pinot_tpu.cluster.http import RemoteControllerClient
+
+    rc = RemoteControllerClient(args.controller_url)
+    action = "pauseConsumption" if args.state == "pause" else "resumeConsumption"
+    out = rc._post(f"/tables/{args.table}/{action}", b"{}")
+    print(json.dumps(out), flush=True)
+    return out
+
+
+def cmd_json_to_schema(args) -> dict:
+    """Infer a schema from a JSON-lines sample (JsonToPinotSchema parity):
+    strings -> dimensions, integral -> LONG metrics, floats -> DOUBLE."""
+    sample = [
+        json.loads(line)
+        for line in Path(args.input_file).read_text().splitlines()
+        if line.strip()
+    ][: args.sample_rows]
+    if not sample:
+        raise ValueError(f"no JSON rows in {args.input_file}")
+    dims, metrics = [], []
+    keys: dict[str, None] = {}  # union of keys over the sample, first-seen order
+    for row in sample:
+        for k in row:
+            keys.setdefault(k)
+    for key in keys:
+        vals = [row.get(key) for row in sample if row.get(key) is not None]
+        if not vals:
+            # all-null in the sample: STRING dimension is the safe default
+            dims.append((key, "STRING"))
+        elif all(isinstance(v, bool) for v in vals):
+            metrics.append((key, "INT"))
+        elif all(isinstance(v, int) and not isinstance(v, bool) for v in vals):
+            metrics.append((key, "LONG"))
+        elif all(isinstance(v, (int, float)) and not isinstance(v, bool) for v in vals):
+            metrics.append((key, "DOUBLE"))
+        else:
+            dims.append((key, "STRING"))
+    doc = {
+        "schemaName": args.table or Path(args.input_file).stem,
+        "dimensionFieldSpecs": [{"name": n, "dataType": t} for n, t in dims],
+        "metricFieldSpecs": [{"name": n, "dataType": t} for n, t in metrics],
+    }
+    text = json.dumps(doc, indent=2)
+    if args.output_file:
+        Path(args.output_file).write_text(text)
+    print(text, flush=True)
+    return doc
+
+
 def cmd_quickstart(args) -> dict:
     """All-in-one in-process cluster with a sample table
     (QuickStartCommand parity: baseballStats-flavored demo data)."""
@@ -295,6 +526,78 @@ def build_parser() -> argparse.ArgumentParser:
     rb.add_argument("--table", required=True)
     rb.add_argument("--dry-run", action="store_true")
     rb.set_defaults(fn=cmd_rebalance_table, blocking=False)
+
+    asch = sub.add_parser("AddSchema")
+    asch.add_argument("--controller-url", required=True)
+    asch.add_argument("--schema-file", required=True)
+    asch.set_defaults(fn=cmd_add_schema, blocking=False)
+
+    dt = sub.add_parser("DeleteTable")
+    dt.add_argument("--controller-url", required=True)
+    dt.add_argument("--table", required=True)
+    dt.set_defaults(fn=cmd_delete_table, blocking=False)
+
+    ds = sub.add_parser("DeleteSchema")
+    ds.add_argument("--controller-url", required=True)
+    ds.add_argument("--schema", required=True)
+    ds.set_defaults(fn=cmd_delete_schema, blocking=False)
+
+    us = sub.add_parser("UploadSegment")
+    us.add_argument("--controller-url", required=True)
+    us.add_argument("--table", required=True)
+    us.add_argument("--segment-dir", required=True)
+    us.set_defaults(fn=cmd_upload_segment, blocking=False)
+
+    cs = sub.add_parser("CreateSegment")
+    cs.add_argument("--table", required=True)
+    cs.add_argument("--schema-file", required=True)
+    cs.add_argument("--input-dir", required=True)
+    cs.add_argument("--output-dir", required=True)
+    cs.add_argument("--pattern", default="*")
+    cs.add_argument("--format", default=None)
+    cs.add_argument("--segment-prefix", default=None)
+    cs.set_defaults(fn=cmd_create_segment, blocking=False)
+
+    dj = sub.add_parser("LaunchDistributedDataIngestionJob")
+    dj.add_argument("--controller-url", required=True)
+    dj.add_argument("--table", required=True)
+    dj.add_argument("--input-dir", required=True)
+    dj.add_argument("--pattern", default="*")
+    dj.add_argument("--format", default=None)
+    dj.add_argument("--segment-prefix", default=None)
+    dj.add_argument("--workers", type=int, default=2)
+    dj.set_defaults(fn=cmd_launch_distributed_job, blocking=False)
+
+    gd = sub.add_parser("GenerateData")
+    gd.add_argument("--schema-file", required=True)
+    gd.add_argument("--output-dir", required=True)
+    gd.add_argument("--rows", type=int, default=1000)
+    gd.add_argument("--files", type=int, default=1)
+    gd.add_argument("--cardinality", type=int, default=50)
+    gd.add_argument("--seed", type=int, default=0)
+    gd.set_defaults(fn=cmd_generate_data, blocking=False)
+
+    ci = sub.add_parser("ShowClusterInfo")
+    ci.add_argument("--controller-url", required=True)
+    ci.set_defaults(fn=cmd_show_cluster_info, blocking=False)
+
+    vs = sub.add_parser("VerifySegmentState")
+    vs.add_argument("--controller-url", required=True)
+    vs.add_argument("--table", required=True)
+    vs.set_defaults(fn=cmd_verify_segment_state, blocking=False)
+
+    ct = sub.add_parser("ChangeTableState")
+    ct.add_argument("--controller-url", required=True)
+    ct.add_argument("--table", required=True)
+    ct.add_argument("--state", choices=["pause", "resume"], required=True)
+    ct.set_defaults(fn=cmd_change_table_state, blocking=False)
+
+    js = sub.add_parser("JsonToPinotSchema")
+    js.add_argument("--input-file", required=True)
+    js.add_argument("--output-file", default=None)
+    js.add_argument("--table", default=None)
+    js.add_argument("--sample-rows", type=int, default=200)
+    js.set_defaults(fn=cmd_json_to_schema, blocking=False)
 
     return p
 

@@ -201,3 +201,90 @@ def test_quickstart_boots_and_serves(capsys):
         handles["minion"].stop()
     out = capsys.readouterr().out
     assert "broker:" in out and "sample query" in out
+
+
+def test_cli_long_tail_commands(stack, tmp_path):
+    """Round-5 CLI additions: GenerateData -> JsonToPinotSchema/AddSchema ->
+    CreateSegment -> UploadSegment -> ShowClusterInfo -> VerifySegmentState ->
+    DeleteTable/DeleteSchema — each over the live HTTP cluster."""
+    c_url = stack["c_url"]
+
+    schema_doc = {
+        "schemaName": "gen",
+        "dimensionFieldSpecs": [{"name": "kind", "dataType": "STRING"}],
+        "metricFieldSpecs": [{"name": "value", "dataType": "LONG"}],
+    }
+    schema_file = tmp_path / "gen_schema.json"
+    schema_file.write_text(json.dumps(schema_doc))
+
+    # GenerateData
+    rc = main(
+        [
+            "GenerateData",
+            "--schema-file", str(schema_file),
+            "--output-dir", str(tmp_path / "gen"),
+            "--rows", "60", "--files", "2",
+        ]
+    )
+    assert rc == 0
+    gen_files = sorted((tmp_path / "gen").glob("*.csv"))
+    assert len(gen_files) == 2
+
+    # AddSchema + table config
+    assert main(["AddSchema", "--controller-url", c_url, "--schema-file", str(schema_file)]) == 0
+    cfg_file = tmp_path / "gen_table.json"
+    cfg_file.write_text(TableConfig("gen").to_json())
+    assert main([
+        "AddTable", "--controller-url", c_url,
+        "--schema-file", str(schema_file), "--config-file", str(cfg_file),
+    ]) == 0
+
+    # CreateSegment (build only) then UploadSegment
+    assert main([
+        "CreateSegment", "--table", "gen", "--schema-file", str(schema_file),
+        "--input-dir", str(tmp_path / "gen"), "--output-dir", str(tmp_path / "segs"),
+        "--pattern", "*.csv",
+    ]) == 0
+    seg_dirs = sorted(p for p in (tmp_path / "segs").iterdir() if p.is_dir())
+    assert len(seg_dirs) == 2
+    for d in seg_dirs:
+        assert main([
+            "UploadSegment", "--controller-url", c_url, "--table", "gen",
+            "--segment-dir", str(d),
+        ]) == 0
+
+    # the data answers queries
+    from pinot_tpu.cluster.http import RemoteControllerClient
+
+    client = RemoteControllerClient(c_url)
+    assert "gen" in client.tables()
+    assert len(client.all_segment_metadata("gen")) == 2
+
+    # ShowClusterInfo + VerifySegmentState
+    assert main(["ShowClusterInfo", "--controller-url", c_url]) == 0
+    assert main(["VerifySegmentState", "--controller-url", c_url, "--table", "gen"]) == 0
+
+    # JsonToPinotSchema infers from a JSONL sample
+    sample = tmp_path / "sample.jsonl"
+    sample.write_text("\n".join(json.dumps({"k": f"a{i}", "v": i, "x": i / 2}) for i in range(5)))
+    out_schema = tmp_path / "inferred.json"
+    assert main([
+        "JsonToPinotSchema", "--input-file", str(sample),
+        "--output-file", str(out_schema), "--table", "inferred",
+    ]) == 0
+    inferred = json.loads(out_schema.read_text())
+    dims = {d["name"] for d in inferred["dimensionFieldSpecs"]}
+    mets = {(m["name"], m["dataType"]) for m in inferred["metricFieldSpecs"]}
+    assert dims == {"k"} and mets == {("v", "LONG"), ("x", "DOUBLE")}
+
+    # DeleteTable cleans segments + config; DeleteSchema then succeeds
+    assert main(["DeleteTable", "--controller-url", c_url, "--table", "gen"]) == 0
+    assert "gen" not in client.tables()
+    assert main(["DeleteSchema", "--controller-url", c_url, "--schema", "gen"]) == 0
+
+
+def test_delete_schema_guard(stack):
+    """DELETE /schemas/{s} refuses while the same-named table exists."""
+    client = stack["rc"]
+    with pytest.raises(RuntimeError, match="still used"):
+        client.delete_schema("hits")

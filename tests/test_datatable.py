@@ -104,3 +104,20 @@ def test_http_data_plane_uses_datatable(tmp_path):
         assert res.rows == [["a", 4.0], ["b", 2.0]]
     finally:
         svc.stop()
+
+
+def test_numeric_decode_is_zero_copy():
+    """ZeroCopyDataBlockSerde parity: numeric columns decode as views over
+    the receive buffer, not copies."""
+    import numpy as np
+
+    from pinot_tpu.common import datatable
+
+    arr = np.arange(100_000, dtype=np.int64)
+    payload = datatable.encode(arr)
+    out = datatable.decode(payload)
+    assert isinstance(out, np.ndarray) and not out.flags.writeable
+    # the decoded array's memory lives inside the payload buffer
+    iface = out.__array_interface__["data"][0]
+    base = np.frombuffer(memoryview(payload), dtype=np.uint8).__array_interface__["data"][0]
+    assert base <= iface < base + len(payload), "decode copied the column"
