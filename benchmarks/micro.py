@@ -317,7 +317,8 @@ def main(argv=None):
             continue
         try:
             out = b()
-            out["value"] = round(out["value"], 3)
+            if out.get("value") is not None:
+                out["value"] = round(out["value"], 3)
         except Exception as e:  # noqa: BLE001 — report, keep going
             out = {"metric": tag, "error": f"{type(e).__name__}: {e}"}
         print(json.dumps(out), flush=True)

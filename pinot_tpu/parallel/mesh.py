@@ -391,7 +391,9 @@ def execute_sharded(table: ShardedTable, sql: str):
         # excluded via invalid padding docids, so the count check must pass
         # everywhere (review r4: per-shard flat offsets vs table nv)
         operands[i] = np.int32(np.iinfo(np.int32).max)
-    ops = tuple(jnp.asarray(o) for o in operands)
+    from pinot_tpu.query.kernels import stage_operand
+
+    ops = tuple(stage_operand(o) for o in operands)
     out = kernel(cols, ops, table.n_docs)  # ONE packed f64 vector on device
     return ctx, plan, out
 

@@ -872,22 +872,23 @@ class _Lowering:
         return (f"mv_{info.func[:-2]}", vspec, col, nv)
 
     def _hll_spec(self, info: AggregationInfo) -> tuple:
-        from pinot_tpu.query.sketches import HLL_LOG2M, hash_any
+        from pinot_tpu.query.sketches import HLL_LOG2M
 
         if isinstance(info.arg, ast.Identifier):
             ci = self.seg.columns.get(info.arg.name)
             if ci is None:
                 raise PlanError(f"unknown column {info.arg.name!r}")
             if ci.is_dict_encoded:
-                # host-hash the dictionary values once; device gathers by id
+                # the dictionary owns a memoized padded hash table, marked as
+                # a stable operand so its staged HBM copy survives across
+                # queries (a high-cardinality table is MBs; on a tunneled TPU
+                # re-shipping it dwarfed the 0.1ms register-update kernel)
                 self.use_col(info.arg.name)
-                hv = hash_any(ci.dictionary.values)
-                pad = _pow2(max(len(hv), 1))
-                if len(hv) == 0:
-                    hv = np.zeros(1, dtype=np.uint32)
-                if len(hv) < pad:
-                    hv = np.concatenate([hv, np.zeros(pad - len(hv), dtype=np.uint32)])
-                return ("hll", ("gather", info.arg.name, self.op_idx(hv)), HLL_LOG2M)
+                return (
+                    "hll",
+                    ("gather", info.arg.name, self.op_idx(ci.dictionary.hll_hash_pad())),
+                    HLL_LOG2M,
+                )
         # raw numeric column / numeric expression: device-side bit-mix hashing
         if info.arg is None:
             raise PlanError("distinctcounthll requires an argument")

@@ -58,6 +58,27 @@ class Dictionary:
             values, ids = np.unique(np.asarray(column, dtype=data_type.np_dtype), return_inverse=True)
         return Dictionary(data_type, values), ids.astype(np.int32)
 
+    def hll_hash_pad(self) -> np.ndarray:
+        """uint32 hash of every dictionary value, zero-padded to a power of
+        two, memoized. Owned here because the memo's validity IS this class's
+        immutability guarantee (values never change after construction). The
+        array is registered as a stable device operand so the kernel layer
+        keeps ONE staged HBM copy across queries instead of re-shipping a
+        multi-MB table per DISTINCTCOUNTHLL execution."""
+        hv = getattr(self, "_hll_hash_pad", None)
+        if hv is None:
+            from pinot_tpu.query.kernels import mark_stable_operand
+            from pinot_tpu.query.sketches import hash_any
+
+            hv = hash_any(self.values)
+            pad = 1 << max(int(np.ceil(np.log2(max(len(hv), 1)))), 0)
+            if len(hv) == 0:
+                hv = np.zeros(1, dtype=np.uint32)
+            if len(hv) < pad:
+                hv = np.concatenate([hv, np.zeros(pad - len(hv), dtype=np.uint32)])
+            self._hll_hash_pad = hv = mark_stable_operand(hv)
+        return hv
+
     # -- lookups ------------------------------------------------------------
 
     def __len__(self) -> int:
