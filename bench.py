@@ -298,6 +298,15 @@ def main():
     data = _make_ssb_data(rng, n)
     t = pd.DataFrame({k: (v.astype(str) if v.dtype == object else v) for k, v in data.items()})
 
+    try:
+        from pinot_tpu.common.devlink import link_profile
+
+        rtt, bw = link_profile()
+        result["link"] = {"rtt_ms": round(rtt * 1e3, 2), "mb_per_s": round(bw / 1e6, 1)}
+        log(f"device link: rtt={result['link']['rtt_ms']}ms bw={result['link']['mb_per_s']}MB/s")
+    except Exception as e:
+        log(f"link probe failed (non-fatal): {e}")
+
     mesh = make_mesh()
     try:
         _smoke_test(schema, mesh, np.random.default_rng(1))

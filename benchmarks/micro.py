@@ -209,24 +209,31 @@ def _pandas_merge_ms(probe, build):
 
 
 def bench_device_lookup_join(n=4_000_000, dim=100_000):
-    """searchsorted probe against a unique sorted build side (v2 lookup-join
-    path) vs pandas hash merge."""
-    import jax.numpy as jnp
+    """The REAL multistage device join (_device_equi_join, force=True:
+    direct-address tables + index readback) vs pandas hash merge, plus
+    whether the link-profile gate would actually pick the device path on
+    this attachment."""
+    from pinot_tpu.common.devlink import link_profile
+    from pinot_tpu.multistage.runtime import _device_equi_join, _device_join_economical
 
     probe, build = _join_inputs(n, dim)
-    jp, jb = jnp.asarray(probe), jnp.asarray(build)
-
-    def probe_fn():
-        pos = jnp.clip(jnp.searchsorted(jb, jp), 0, dim - 1)
-        return jb[pos] == jp
-
-    dev = _time_device(probe_fn)
+    out = _device_equi_join(probe, build, force=True)  # warm
+    assert out is not None and len(out[0])
+    t0 = time.perf_counter()
+    iters = 5
+    for _ in range(iters):
+        _device_equi_join(probe, build, force=True)
+    dev = (time.perf_counter() - t0) / iters * 1e3
+    rtt, bw = link_profile()
     return {
         "metric": "device_lookup_join_probe",
-        "value": dev,
+        "value": round(dev, 3),
         "unit": "ms",
         "n": n,
         "pandas_merge_ms": _pandas_merge_ms(probe, build),
+        "link_rtt_ms": round(rtt * 1e3, 2),
+        "link_mb_per_s": round(bw / 1e6, 1),
+        "gate_picks_device": _device_join_economical(probe, build),
     }
 
 
