@@ -458,19 +458,26 @@ def reduce_group_by(ctx: QueryContext, frames: list[pd.DataFrame]) -> list[list]
         merged = df.drop_duplicates(subset=key_cols).reset_index(drop=True)
 
     aliases = _alias_map(ctx)
+    # column-wise extraction: iterrows() builds a type-coerced Series per
+    # group (~70us each), which dominated the broker reduce for group counts
+    # in the thousands; plain Python lists keep per-column dtypes AND make
+    # the env-build loop ~10x cheaper
+    key_vals = [merged[f"k{i}"].tolist() for i in range(nkeys)]
+    part_vals = {c: merged[c].tolist() for c in merged.columns if c not in key_cols}
+    group_names = [canonical(g) for g in ctx.group_by]
     rows = []
-    for _, r in merged.iterrows():
+    for ri in range(len(merged)):
         env: dict[str, Any] = {}
-        for i, g in enumerate(ctx.group_by):
-            k = r[f"k{i}"]
+        for i, name in enumerate(group_names):
+            k = key_vals[i][ri]
             if null_on and _is_null_partial(k):
                 k = None  # NaN key = the null group (host NaN substitution)
-            env[canonical(g)] = k
+            env[name] = k
         for i, a in enumerate(ctx.aggregations):
             if parts_of(a.func) == 2:
-                p = (r[f"a{i}p0"], r[f"a{i}p1"])
+                p = (part_vals[f"a{i}p0"][ri], part_vals[f"a{i}p1"][ri])
             else:
-                p = r[f"a{i}p0"]
+                p = part_vals[f"a{i}p0"][ri]
             env[a.name] = _finalize(a, p, null_on)
         rows.append(env)
 
