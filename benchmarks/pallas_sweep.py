@@ -23,7 +23,7 @@ import json, os, sys, time
 import numpy as np
 import pinot_tpu  # noqa: F401
 import jax, jax.numpy as jnp
-from pinot_tpu.ops.groupby_pallas import CHUNK, GROUP_TILE, _grids, pallas_grouped_multi_sum
+from pinot_tpu.ops.groupby_pallas import CHUNK, _grids, gtile_for, pallas_grouped_multi_sum
 
 n = int(os.environ.get("PINOT_TPU_SWEEP_DOCS", 4_000_000))
 ng = int(sys.argv[1])
@@ -44,9 +44,9 @@ lat = []
 for _ in range(7):
     t0 = time.perf_counter(); run(); lat.append((time.perf_counter() - t0) * 1e3)
 n_padded = n + ((-n) % CHUNK)
-n_chunks, n_gtiles, _ = _grids(n_padded, ng)
+n_chunks, n_gtiles, _, _gt = _grids(n_padded, ng)
 print(json.dumps({
-    "chunk": CHUNK, "gtile": GROUP_TILE, "ng": ng, "docs": n,
+    "chunk": CHUNK, "gtile": gtile_for(ng), "ng": ng, "docs": n,
     "p50_ms": round(float(np.percentile(lat, 50)), 2),
     "steps": n_chunks * n_gtiles,
 }))

@@ -32,16 +32,35 @@ import numpy as np
 # Tile geometry. Each grid step costs ~2us of fixed dispatch overhead on TPU,
 # so for a (chunks x group-tiles) grid the step count — not the MACs — is the
 # dominant cost at bench shapes (4M docs x 4.4k groups was 74k steps at
-# 1024/256). CHUNK=4096 keeps the per-chunk plane dot exact (4096*255 < 2^24)
-# and the one-hot VMEM tile at 4MB while cutting steps 4x. Overridable for
-# hardware sweeps (benchmarks/pallas_sweep.py).
-CHUNK = int(os.environ.get("PINOT_TPU_PALLAS_CHUNK", "4096"))
-GROUP_TILE = int(os.environ.get("PINOT_TPU_PALLAS_GTILE", "256"))
+# 1024/256). CHUNK*255 < 2^24 keeps the per-chunk plane dot exact.
+# CHUNK=2048 + the ADAPTIVE group tile below come from an on-chip A/B over
+# the Q4 headline (16M docs x 5000 groups, TPU v5 lite): 2048/1024 measured
+# 200ms e2e vs 298ms at the old 4096/256 — wider group tiles amortize the
+# per-step overhead across more MXU columns. Overridable for hardware
+# sweeps (benchmarks/pallas_sweep.py).
+CHUNK = int(os.environ.get("PINOT_TPU_PALLAS_CHUNK", "2048"))
+_GTILE_ENV = os.environ.get("PINOT_TPU_PALLAS_GTILE", "")
+
+
+def gtile_for(ng: int) -> int:
+    """Group-tile width for a given group count. Wide tiles win at high
+    cardinality (per-step overhead amortized over more MXU columns) but a
+    small GROUP BY padded to a 1024-wide tile would do 4x the one-hot cell
+    work — and the extreme kernels' (CHUNK, tile) where-intermediates would
+    quadruple their VMEM footprint — for nothing, so the tile tracks ng."""
+    if _GTILE_ENV:
+        return int(_GTILE_ENV)
+    for t in (256, 512, 1024):
+        if ng <= t:
+            return t
+    return 1024
+
+
 # exactness invariant of the byte-plane SUM: one chunk's plane dot must stay
 # below the f32 exact-integer bound. Fail loudly on bad sweep overrides.
 if CHUNK * 255 >= 2**24:
     raise ValueError(f"PINOT_TPU_PALLAS_CHUNK={CHUNK}: CHUNK*255 must stay < 2^24 for lossless sums")
-if CHUNK % 128 or GROUP_TILE % 128:
+if CHUNK % 128 or (_GTILE_ENV and int(_GTILE_ENV) % 128):
     raise ValueError("PINOT_TPU_PALLAS_CHUNK/GTILE must be multiples of 128 (lane tiling)")
 
 
@@ -78,31 +97,36 @@ def _pad_inputs(gid, values, mask):
 
 
 def _grids(n_padded: int, ng: int):
-    ng_pad = max(GROUP_TILE, ((ng + GROUP_TILE - 1) // GROUP_TILE) * GROUP_TILE)
-    return n_padded // CHUNK, ng_pad // GROUP_TILE, ng_pad
+    gtile = gtile_for(ng)
+    ng_pad = max(gtile, ((ng + gtile - 1) // gtile) * gtile)
+    return n_padded // CHUNK, ng_pad // gtile, ng_pad, gtile
 
 
 # -- sum / count: MXU one-hot matmul ----------------------------------------
 
 
-def _sum_kernel(gid_ref, val_ref, out_ref):
+@functools.lru_cache(maxsize=None)
+def _make_sum_kernel(gtile: int):
     from jax.experimental import pallas as pl
 
-    ci = pl.program_id(1)  # chunk index (innermost: accumulates in VMEM)
-    gi = pl.program_id(0)  # group-tile index
+    def kernel(gid_ref, val_ref, out_ref):
+        ci = pl.program_id(1)  # chunk index (innermost: accumulates in VMEM)
+        gi = pl.program_id(0)  # group-tile index
 
-    @pl.when(ci == 0)
-    def _():
-        out_ref[:] = jnp.zeros_like(out_ref)
+        @pl.when(ci == 0)
+        def _():
+            out_ref[:] = jnp.zeros_like(out_ref)
 
-    gid = gid_ref[0, :]  # (CHUNK,) int32, already offset to this tile
-    vals = val_ref[0:1, :]  # (1, CHUNK) f32, mask pre-applied
-    base = gi * GROUP_TILE
-    onehot = (
-        gid[:, None] == (base + jax.lax.broadcasted_iota(jnp.int32, (CHUNK, GROUP_TILE), 1))
-    ).astype(jnp.float32)
-    # (1, CHUNK) @ (CHUNK, GROUP_TILE): the MXU performs the scatter-add
-    out_ref[:] = out_ref[:] + jnp.dot(vals, onehot, preferred_element_type=jnp.float32)
+        gid = gid_ref[0, :]  # (CHUNK,) int32, already offset to this tile
+        vals = val_ref[0:1, :]  # (1, CHUNK) f32, mask pre-applied
+        base = gi * gtile
+        onehot = (
+            gid[:, None] == (base + jax.lax.broadcasted_iota(jnp.int32, (CHUNK, gtile), 1))
+        ).astype(jnp.float32)
+        # (1, CHUNK) @ (CHUNK, gtile): the MXU performs the scatter-add
+        out_ref[:] = out_ref[:] + jnp.dot(vals, onehot, preferred_element_type=jnp.float32)
+
+    return kernel
 
 
 @functools.partial(jax.jit, static_argnames=("ng",))
@@ -111,17 +135,17 @@ def _grouped_sum_impl(gid, masked_vals, ng: int):
     from jax.experimental.pallas import tpu as pltpu
 
     n_padded = gid.shape[0]
-    n_chunks, n_gtiles, ng_pad = _grids(n_padded, ng)
+    n_chunks, n_gtiles, ng_pad, gtile = _grids(n_padded, ng)
     gid2 = gid.reshape(1, n_padded)
     vals2 = masked_vals.reshape(1, n_padded)
     out = pl.pallas_call(
-        _sum_kernel,
+        _make_sum_kernel(gtile),
         grid=(n_gtiles, n_chunks),
         in_specs=[
             pl.BlockSpec((1, CHUNK), lambda g, c: (jnp.int32(0), c), memory_space=pltpu.VMEM),
             pl.BlockSpec((1, CHUNK), lambda g, c: (jnp.int32(0), c), memory_space=pltpu.VMEM),
         ],
-        out_specs=pl.BlockSpec((1, GROUP_TILE), lambda g, c: (jnp.int32(0), g), memory_space=pltpu.VMEM),
+        out_specs=pl.BlockSpec((1, gtile), lambda g, c: (jnp.int32(0), g), memory_space=pltpu.VMEM),
         out_shape=jax.ShapeDtypeStruct((1, ng_pad), jnp.float32),
         interpret=_interpret(),
     )(gid2, vals2)
@@ -146,7 +170,8 @@ def pallas_grouped_count(gid, mask, ng: int):
 # -- min / max / presence: one-hot select + VPU column reduce ----------------
 
 
-def _make_extreme_kernel(is_min: bool):
+@functools.lru_cache(maxsize=None)
+def _make_extreme_kernel(is_min: bool, gtile: int):
     from jax.experimental import pallas as pl
 
     fill = jnp.inf if is_min else -jnp.inf
@@ -161,23 +186,19 @@ def _make_extreme_kernel(is_min: bool):
 
         gid = gid_ref[0, :]
         vals = val_ref[0, :]
-        base = gi * GROUP_TILE
+        base = gi * gtile
         hit = gid[:, None] == (
-            base + jax.lax.broadcasted_iota(jnp.int32, (CHUNK, GROUP_TILE), 1)
+            base + jax.lax.broadcasted_iota(jnp.int32, (CHUNK, gtile), 1)
         )
         # minor-dim insertion must happen on 32-bit values (Mosaic tiling
         # constraint): broadcast the int32 mask, then compare
         maskcol = mask_ref[0, :][:, None] != 0
         w = jnp.where(hit & maskcol, vals[:, None], fill)
-        # keepdims: the (1, GROUP_TILE) shape matches out_ref's block layout
+        # keepdims: the (1, gtile) shape matches out_ref's block layout
         col = jnp.min(w, axis=0, keepdims=True) if is_min else jnp.max(w, axis=0, keepdims=True)
         out_ref[:] = jnp.minimum(out_ref[:], col) if is_min else jnp.maximum(out_ref[:], col)
 
     return kernel
-
-
-_MIN_KERNEL = _make_extreme_kernel(True)
-_MAX_KERNEL = _make_extreme_kernel(False)
 
 
 @functools.partial(jax.jit, static_argnames=("ng", "is_min"))
@@ -186,16 +207,16 @@ def _grouped_extreme_impl(gid, values, mask, ng: int, is_min: bool):
     from jax.experimental.pallas import tpu as pltpu
 
     n_padded = gid.shape[0]
-    n_chunks, n_gtiles, ng_pad = _grids(n_padded, ng)
+    n_chunks, n_gtiles, ng_pad, gtile = _grids(n_padded, ng)
     out = pl.pallas_call(
-        _MIN_KERNEL if is_min else _MAX_KERNEL,
+        _make_extreme_kernel(is_min, gtile),
         grid=(n_gtiles, n_chunks),
         in_specs=[
             pl.BlockSpec((1, CHUNK), lambda g, c: (jnp.int32(0), c), memory_space=pltpu.VMEM),
             pl.BlockSpec((1, CHUNK), lambda g, c: (jnp.int32(0), c), memory_space=pltpu.VMEM),
             pl.BlockSpec((1, CHUNK), lambda g, c: (jnp.int32(0), c), memory_space=pltpu.VMEM),
         ],
-        out_specs=pl.BlockSpec((1, GROUP_TILE), lambda g, c: (jnp.int32(0), g), memory_space=pltpu.VMEM),
+        out_specs=pl.BlockSpec((1, gtile), lambda g, c: (jnp.int32(0), g), memory_space=pltpu.VMEM),
         out_shape=jax.ShapeDtypeStruct((1, ng_pad), jnp.float32),
         interpret=_interpret(),
     )(
@@ -227,7 +248,8 @@ def pallas_grouped_max(values, gid, mask, ng: int):
 # yields byte-plane sums AND the group count (mask rides as a 5th plane);
 # the tiny (5, ng) recombination runs in f64 outside the kernel.
 
-def _make_planes_kernel(r: int):
+@functools.lru_cache(maxsize=None)
+def _make_planes_kernel(r: int, gtile: int):
     from jax.experimental import pallas as pl
 
     def kernel(gid_ref, planes_ref, out_ref):
@@ -240,9 +262,9 @@ def _make_planes_kernel(r: int):
 
         gid = gid_ref[0, :]
         planes = planes_ref[:]  # (r, CHUNK) f32, pre-masked
-        base = gi * GROUP_TILE
+        base = gi * gtile
         onehot = (
-            gid[:, None] == (base + jax.lax.broadcasted_iota(jnp.int32, (CHUNK, GROUP_TILE), 1))
+            gid[:, None] == (base + jax.lax.broadcasted_iota(jnp.int32, (CHUNK, gtile), 1))
         ).astype(jnp.float32)
         acc = jnp.dot(planes, onehot, preferred_element_type=jnp.float32)  # exact per chunk
         out_ref[:] = out_ref[:] + acc.astype(jnp.int32)
@@ -256,15 +278,15 @@ def _planes_impl(gid, planes, ng: int, r: int):
     from jax.experimental.pallas import tpu as pltpu
 
     n_padded = gid.shape[0]
-    n_chunks, n_gtiles, ng_pad = _grids(n_padded, ng)
+    n_chunks, n_gtiles, ng_pad, gtile = _grids(n_padded, ng)
     return pl.pallas_call(
-        _make_planes_kernel(r),
+        _make_planes_kernel(r, gtile),
         grid=(n_gtiles, n_chunks),
         in_specs=[
             pl.BlockSpec((1, CHUNK), lambda g, c: (jnp.int32(0), c), memory_space=pltpu.VMEM),
             pl.BlockSpec((r, CHUNK), lambda g, c: (jnp.int32(0), c), memory_space=pltpu.VMEM),
         ],
-        out_specs=pl.BlockSpec((r, GROUP_TILE), lambda g, c: (jnp.int32(0), g), memory_space=pltpu.VMEM),
+        out_specs=pl.BlockSpec((r, gtile), lambda g, c: (jnp.int32(0), g), memory_space=pltpu.VMEM),
         out_shape=jax.ShapeDtypeStruct((r, ng_pad), jnp.int32),
         interpret=_interpret(),
     )(gid.reshape(1, n_padded), planes)

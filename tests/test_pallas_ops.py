@@ -59,12 +59,17 @@ def test_grouped_min_max(data):
 
 
 def test_empty_mask_and_group_tile_boundary():
-    # ng exactly at GROUP_TILE boundary; all docs masked out
-    gid = jnp.arange(2048, dtype=jnp.int32) % 256
-    vals = jnp.ones(2048, dtype=jnp.float32)
-    mask = jnp.zeros(2048, dtype=bool)
-    assert np.asarray(pallas_grouped_sum(vals, gid, mask, 256)).sum() == 0.0
-    assert np.asarray(pallas_grouped_count(gid, mask, 256)).sum() == 0
+    # ng exactly at every rung of the adaptive tile ladder (gtile_for);
+    # all docs masked out — exercises the tile-edge base+iota compare
+    from pinot_tpu.ops.groupby_pallas import gtile_for
+
+    for ng in (256, 512, 1024):
+        assert gtile_for(ng) == ng  # ng IS the tile boundary
+        gid = jnp.arange(2048, dtype=jnp.int32) % ng
+        vals = jnp.ones(2048, dtype=jnp.float32)
+        mask = jnp.zeros(2048, dtype=bool)
+        assert np.asarray(pallas_grouped_sum(vals, gid, mask, ng)).sum() == 0.0
+        assert np.asarray(pallas_grouped_count(gid, mask, ng)).sum() == 0
 
 
 def test_large_ng_multiple_tiles():
