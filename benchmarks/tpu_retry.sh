@@ -1,0 +1,20 @@
+#!/bin/bash
+# Detached TPU-bench retry loop (round-5 analog of the r3 capture loop):
+# probe the chip cheaply every 10 minutes; on the first success run the full
+# bench (which atomically refreshes BENCH_tpu_cache.json) and also refresh
+# the micro benchmarks, then exit. Keeps at most one bench run; never
+# overlaps with itself (flock).
+cd "$(dirname "$0")/.." || exit 1
+exec 9>/tmp/pinot_tpu_retry.lock
+flock -n 9 || exit 0
+for i in $(seq 1 60); do
+  if timeout 60 python -c "import jax, jax.numpy as jnp; (jnp.ones((256,256))@jnp.ones((256,256))).block_until_ready()" >/dev/null 2>&1; then
+    echo "$(date -u +%FT%TZ) tunnel up, running bench" >> /tmp/pinot_tpu_retry.log
+    python bench.py > BENCH_tpu_retry_r05.json 2>> /tmp/pinot_tpu_retry.log
+    python -m benchmarks.micro > BENCH_micro_retry_r05.json 2>> /tmp/pinot_tpu_retry.log
+    echo "$(date -u +%FT%TZ) done" >> /tmp/pinot_tpu_retry.log
+    exit 0
+  fi
+  echo "$(date -u +%FT%TZ) probe $i failed" >> /tmp/pinot_tpu_retry.log
+  sleep 600
+done

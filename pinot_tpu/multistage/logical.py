@@ -283,7 +283,7 @@ def _idents_filter(f: ast.FilterExpr | None, out: set[str]) -> None:
         _idents_expr(f.expr, out)
         for v in f.values:
             _idents_expr(v, out)
-    elif isinstance(f, (ast.Like, ast.RegexpLike, ast.IsNull)):
+    elif isinstance(f, (ast.Like, ast.RegexpLike, ast.IsNull, ast.BoolAssert)):
         _idents_expr(f.expr, out)
     elif isinstance(f, ast.DistinctFrom):
         _idents_expr(f.left, out)

@@ -211,6 +211,8 @@ def _map_filter_idents(f: ast.FilterExpr, mapping: dict[str, str]) -> ast.Filter
             return ast.RegexpLike(fix_e(x.expr), x.pattern)
         if isinstance(x, ast.IsNull):
             return ast.IsNull(fix_e(x.expr), x.negated)
+        if isinstance(x, ast.BoolAssert):
+            return ast.BoolAssert(fix_e(x.expr), x.want_true, x.negated)
         if isinstance(x, ast.DistinctFrom):
             return ast.DistinctFrom(fix_e(x.left), fix_e(x.right), x.negated)
         return x

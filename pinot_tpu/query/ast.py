@@ -212,6 +212,21 @@ class IsNull(FilterExpr):
 
 
 @dataclass(frozen=True)
+class BoolAssert(FilterExpr):
+    """IS [NOT] TRUE / IS [NOT] FALSE (reference:
+    core/operator/transform/function/Is{,Not}{True,False}TransformFunction).
+    The positive forms exclude nulls; the NOT forms include them (SQL
+    three-valued assertion semantics)."""
+
+    expr: Expr
+    want_true: bool  # IS TRUE vs IS FALSE
+    negated: bool = False
+
+    def __str__(self) -> str:
+        return f"{self.expr} IS {'NOT ' if self.negated else ''}{'TRUE' if self.want_true else 'FALSE'}"
+
+
+@dataclass(frozen=True)
 class DistinctFrom(FilterExpr):
     """Null-aware inequality: `a IS DISTINCT FROM b` is true when the values
     differ OR exactly one side is null; never null itself."""

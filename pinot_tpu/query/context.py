@@ -372,6 +372,11 @@ def _filter_agg_scan(f: FilterExpr, out: dict[str, AggregationInfo]) -> None:
     elif isinstance(f, DistinctFrom):
         _extract_aggs(f.left, out)
         _extract_aggs(f.right, out)
+    else:
+        from pinot_tpu.query.ast import BoolAssert
+
+        if isinstance(f, BoolAssert):
+            _extract_aggs(f.expr, out)
     # PredicateFunction args never contain aggregates (index probes only)
 
 
@@ -424,11 +429,13 @@ def _collect_filter_identifiers(f: FilterExpr | None, out: set[str]) -> None:
         _collect_identifiers(f.left, out)
         _collect_identifiers(f.right, out)
     else:
-        from pinot_tpu.query.ast import PredicateFunction
+        from pinot_tpu.query.ast import BoolAssert, PredicateFunction
 
         if isinstance(f, PredicateFunction):
             for a in f.args:
                 _collect_identifiers(a, out)
+        elif isinstance(f, BoolAssert):
+            _collect_identifiers(f.expr, out)
 
 
 def expand_star(stmt: SelectStatement, schema) -> None:

@@ -144,6 +144,62 @@ def eval_value(seg: ImmutableSegment, expr: ast.Expr) -> np.ndarray:
             if target == "STRING":
                 return np.asarray([str(x) for x in v], dtype=object)
             raise PlanError(f"unsupported CAST target {target}")
+        if name == "coalesce":
+            # first non-null argument per row (CoalesceTransformFunction):
+            # null = the column null-vector OR a NaN/None cell. Accumulate in
+            # object space (args may mix numeric/string dtypes incl. numpy
+            # '<U' string columns); all-numeric results narrow back.
+            out = np.full(seg.n_docs, None, dtype=object)
+            filled = np.zeros(seg.n_docs, dtype=bool)
+            for a in expr.args:
+                v = np.asarray(eval_value(seg, a))
+                v = np.broadcast_to(v, (seg.n_docs,)) if v.ndim == 0 else v
+                miss = expr_null_mask(seg, a)
+                miss = miss.copy() if miss is not None else np.zeros(seg.n_docs, dtype=bool)
+                if v.dtype == object:
+                    miss |= np.asarray([x is None for x in v])
+                elif np.issubdtype(v.dtype, np.floating):
+                    miss |= np.isnan(v)
+                take = ~filled & ~miss
+                out[take] = v[take]
+                filled |= take
+                if filled.all():
+                    break
+            if filled.all() and all(
+                isinstance(x, (int, float, np.integer, np.floating)) and not isinstance(x, bool)
+                for x in out
+            ):
+                return out.astype(np.float64)
+            return out
+        if name in _ARRAY_FUNCS and len(expr.args) == 1:
+            mvci = _mv_column(seg, expr.args[0])
+            if mvci is not None:
+                return _ARRAY_FUNCS[name](mvci)
+        if name in _VECTOR_UNARY and len(expr.args) == 1:
+            mvci = _mv_column(seg, expr.args[0])
+            if mvci is not None:
+                vecs = _vectors_of(mvci)
+                if name == "vectordims":
+                    return np.full(len(vecs), vecs.shape[1], dtype=np.int64)
+                return np.sqrt((vecs * vecs).sum(axis=-1))
+        if name in _VECTOR_BINARY and len(expr.args) == 2:
+            sides = []
+            for a in expr.args:
+                mvci = _mv_column(seg, a)
+                if mvci is not None:
+                    sides.append(_vectors_of(mvci))
+                elif isinstance(a, ast.ArrayLiteral):
+                    # elements are raw python numbers (sql._array_element)
+                    sides.append(np.asarray([float(v) for v in a.values])[None, :])
+                else:
+                    sides = None
+                    break
+            if sides is not None and sides[0].shape[-1] == sides[1].shape[-1]:
+                res = _vector_binary(name, sides[0], sides[1])
+                if res.shape[0] == 1 and seg.n_docs != 1:
+                    # both sides literal: constant result per doc
+                    res = np.full(seg.n_docs, float(res[0]))
+                return res
         if name in DEVICE_FUNCS:
             _, fn = DEVICE_FUNCS[name]
             # the device lambdas take the array module first — numpy works too
@@ -182,6 +238,80 @@ def _mv_column(seg: ImmutableSegment, expr) -> "object | None":
 
 def _mv_flat_values(ci) -> np.ndarray:
     return ci.dictionary.get_many(ci.forward) if ci.dictionary is not None else ci.forward
+
+
+def _array_length(ci) -> np.ndarray:
+    return np.asarray(ci.lens, dtype=np.int64)
+
+
+def _array_numeric_reduce(ci, op: str) -> np.ndarray:
+    """Per-doc reduction over an MV column's values (Array{Sum,Min,Max,
+    Average}TransformFunction). Empty arrays reduce to NaN (finalized to
+    NULL upstream); string MVs reject."""
+    flat = _mv_flat_values(ci)
+    if flat.dtype == object or flat.dtype.kind in ("U", "S"):
+        raise PlanError(f"{op} requires a numeric multi-value column")
+    flat = flat.astype(np.float64)
+    docs = ci.flat_docids()
+    n = len(ci.lens)
+    empty = np.asarray(ci.lens) == 0
+    if op in ("arraysum", "arrayaverage"):
+        s = np.zeros(n, dtype=np.float64)
+        np.add.at(s, docs, flat)
+        if op == "arrayaverage":
+            s = s / np.maximum(np.asarray(ci.lens, dtype=np.float64), 1.0)
+    elif op == "arraymin":
+        s = np.full(n, np.inf)
+        np.minimum.at(s, docs, flat)
+    else:  # arraymax
+        s = np.full(n, -np.inf)
+        np.maximum.at(s, docs, flat)
+    return np.where(empty, np.nan, s)
+
+
+_ARRAY_FUNCS = {
+    "arraylength": _array_length,
+    "cardinality": _array_length,
+    "arraysum": lambda ci: _array_numeric_reduce(ci, "arraysum"),
+    "arrayaverage": lambda ci: _array_numeric_reduce(ci, "arrayaverage"),
+    "arraymin": lambda ci: _array_numeric_reduce(ci, "arraymin"),
+    "arraymax": lambda ci: _array_numeric_reduce(ci, "arraymax"),
+}
+
+
+def _vectors_of(ci) -> np.ndarray:
+    """(n_docs, dim) float matrix from a uniform-length numeric MV column."""
+    flat = _mv_flat_values(ci)
+    if flat.dtype == object or flat.dtype.kind in ("U", "S"):
+        raise PlanError("vector functions require a numeric multi-value column")
+    lens = np.asarray(ci.lens)
+    if len(lens) == 0 or (lens != lens[0]).any() or lens[0] == 0:
+        raise PlanError("vector functions require uniform non-empty vector lengths")
+    return flat.astype(np.float64).reshape(len(lens), int(lens[0]))
+
+
+def _vector_binary(name: str, a: np.ndarray, b: np.ndarray) -> np.ndarray:
+    if name == "innerproduct":
+        return (a * b).sum(axis=-1)
+    if name == "l1distance":
+        return np.abs(a - b).sum(axis=-1)
+    if name == "l2distance":
+        return np.sqrt(((a - b) ** 2).sum(axis=-1))
+    # cosinedistance: 1 - cos_sim; zero-norm rows -> NaN (reference default)
+    na = np.sqrt((a * a).sum(axis=-1))
+    nb = np.sqrt((b * b).sum(axis=-1))
+    denom = na * nb
+    with np.errstate(invalid="ignore", divide="ignore"):
+        sim = (a * b).sum(axis=-1) / denom
+    return np.where(denom == 0, np.nan, 1.0 - sim)
+
+
+#: VectorTransformFunctions parity (core/operator/transform/function/
+#: VectorTransformFunctions.java): binary distance/similarity over a float
+#: MV column and an ARRAY[...] literal (or two MV columns), plus unary
+#: VECTORDIMS / VECTORNORM.
+_VECTOR_BINARY = ("cosinedistance", "innerproduct", "l1distance", "l2distance")
+_VECTOR_UNARY = ("vectordims", "vectornorm")
 
 
 def _mv_any_match(ci, flat_pred: np.ndarray) -> np.ndarray:
@@ -282,6 +412,19 @@ def filter_mask(seg: ImmutableSegment, f: ast.FilterExpr | None) -> np.ndarray:
                 nulls = native.bm_to_bool(nv, n)
                 return ~nulls if f.negated else nulls
         return np.full(n, bool(f.negated))
+    if isinstance(f, ast.BoolAssert):
+        v = np.asarray(eval_value(seg, f.expr))
+        nulls = expr_null_mask(seg, f.expr)
+        nulls = nulls if nulls is not None else np.zeros(n, dtype=bool)
+        if v.dtype == object or v.dtype.kind in ("U", "S"):
+            truthy = np.asarray(
+                [x is not None and bool(x) and str(x).lower() not in ("false", "0") for x in v]
+            )
+        else:
+            truthy = v.astype(np.float64) != 0
+        pos = (truthy if f.want_true else ~truthy) & ~nulls
+        # IS NOT TRUE / IS NOT FALSE include the null rows (3-valued NOT)
+        return ~pos if f.negated else pos
     if isinstance(f, ast.DistinctFrom):
         l = eval_value(seg, f.left)
         r = eval_value(seg, f.right)
@@ -613,8 +756,11 @@ def _filter3(seg: ImmutableSegment, f: "ast.FilterExpr | None") -> tuple:
     if isinstance(f, ast.Not):
         ct, cu = _filter3(seg, f.child)
         return ~ct & ~cu, cu  # NOT(unknown) = unknown
-    if isinstance(f, (ast.IsNull, ast.DistinctFrom)):
-        return filter_mask(seg, f), np.zeros(n_docs, dtype=bool)  # never unknown
+    if isinstance(f, (ast.IsNull, ast.DistinctFrom, ast.BoolAssert)):
+        # never unknown: these consume the null vectors exactly (IS [NOT]
+        # TRUE/FALSE is a SQL assertion — nulls are definitively excluded
+        # by the positive forms and included by the NOT forms)
+        return filter_mask(seg, f), np.zeros(n_docs, dtype=bool)
     # leaf predicate: unknown wherever ANY referenced column is null
     # (tested expression, BETWEEN bounds, IN values, predicate args)
     from pinot_tpu.query.context import _collect_filter_identifiers
@@ -1113,6 +1259,18 @@ def expr_null_mask(seg: ImmutableSegment, expr) -> "np.ndarray | None":
     column has a null vector."""
     from pinot_tpu.native import bm_to_bool
     from pinot_tpu.query.context import _collect_identifiers
+
+    if isinstance(expr, ast.FunctionCall) and expr.name == "coalesce":
+        # COALESCE is null only where ALL arguments are null — the generic
+        # union-of-identifiers propagation would mark rows null exactly
+        # where the function exists to provide a fallback
+        m = None
+        for a in expr.args:
+            am = expr_null_mask(seg, a)
+            if am is None:
+                return None  # some argument is never null -> result never null
+            m = am if m is None else (m & am)
+        return m
 
     idents: set[str] = set()
     _collect_identifiers(expr, idents)

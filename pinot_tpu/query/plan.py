@@ -362,6 +362,8 @@ class _Lowering:
             return self._distinct_from(f)
         if isinstance(f, ast.PredicateFunction):
             return self._predicate_function(f)
+        if isinstance(f, ast.BoolAssert):
+            raise DeviceFallback("IS [NOT] TRUE/FALSE runs host-side")
         raise PlanError(f"unsupported filter: {f}")
 
     def where_spec(self, f: "FilterExpr | None") -> tuple:

@@ -163,6 +163,12 @@ def eval_having(f: ast.FilterExpr, env: dict[str, Any], aliases: dict[str, ast.E
         rn = _is_null_partial(r)
         m = (ln != rn) or (not ln and not rn and l != r)
         return not m if f.negated else m
+    if isinstance(f, ast.BoolAssert):
+        v = eval_scalar(f.expr, env, aliases)
+        # SQL assertion: never unknown — null fails IS TRUE/FALSE, passes NOT
+        truthy = not _is_null_partial(v) and bool(v) and str(v).lower() not in ("false", "0")
+        pos = truthy if f.want_true else (not _is_null_partial(v) and not truthy)
+        return not pos if f.negated else pos
     raise ValueError(f"unsupported HAVING predicate: {f}")
 
 

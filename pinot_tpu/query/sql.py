@@ -480,6 +480,12 @@ class Parser:
                 from pinot_tpu.query.ast import DistinctFrom
 
                 return DistinctFrom(left, right, neg)
+            if self.at_kw("TRUE") or self.at_kw("FALSE"):
+                from pinot_tpu.query.ast import BoolAssert
+
+                want_true = self.at_kw("TRUE")
+                self.next()
+                return BoolAssert(left, want_true, neg)
             self.expect_kw("NULL")
             return IsNull(left, neg)
         for sym, op in (
@@ -589,6 +595,19 @@ class Parser:
                     ty = self._identifier_name(self.next())
                     self.expect_op(")")
                     return FunctionCall("cast", (inner, Literal(ty.upper())))
+                if up == "EXTRACT":
+                    # EXTRACT(unit FROM expr) — rewrites to the matching
+                    # datetime extract function (ExtractTransformFunction)
+                    self.next()
+                    self.next()
+                    unit = self._identifier_name(self.next()).upper()
+                    fn = _EXTRACT_UNITS.get(unit)
+                    if fn is None:
+                        raise SqlParseError(f"unsupported EXTRACT unit {unit!r}")
+                    self.expect_kw("FROM")
+                    inner = self._expr()
+                    self.expect_op(")")
+                    return FunctionCall(fn, (inner,))
                 self.next()
                 self.next()
                 distinct = self.eat_kw("DISTINCT")
@@ -650,6 +669,25 @@ _PREDICATE_FUNCS = {"text_match", "json_match", "vector_similarity", "st_within_
 
 # SQL-name aliases for registry names (Pinot accepts several spellings of
 # the sketch aggregations; the registry uses one canonical name each)
+#: EXTRACT(unit FROM ts) -> datetime extract function (ExtractTransformFunction
+#: unit set, core/operator/transform/function/ExtractTransformFunction.java)
+_EXTRACT_UNITS = {
+    "YEAR": "year",
+    "QUARTER": "quarter",
+    "MONTH": "month",
+    "WEEK": "week",
+    "DAY": "dayofmonth",
+    "DAY_OF_MONTH": "dayofmonth",
+    "DOW": "dayofweek",
+    "DAY_OF_WEEK": "dayofweek",
+    "DOY": "dayofyear",
+    "DAY_OF_YEAR": "dayofyear",
+    "HOUR": "hour",
+    "MINUTE": "minute",
+    "SECOND": "second",
+    "MILLISECOND": "millisecond",
+}
+
 _FUNC_ALIASES = {
     "distinctcountthetasketch": "distinctcounttheta",
     "distinct_count_theta_sketch": "distinctcounttheta",

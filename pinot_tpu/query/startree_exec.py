@@ -35,7 +35,7 @@ def _null_dependent(f) -> bool:
     so these must run the per-doc path."""
     if f is None:
         return False
-    if isinstance(f, (ast.IsNull, ast.DistinctFrom)):
+    if isinstance(f, (ast.IsNull, ast.DistinctFrom, ast.BoolAssert)):
         return True
     if isinstance(f, (ast.And, ast.Or)):
         return any(_null_dependent(c) for c in f.children)

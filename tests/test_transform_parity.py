@@ -1,0 +1,223 @@
+"""Transform-function parity ledger vs the reference's 73 classes under
+core/operator/transform/function/ — the per-name analog of
+test_agg_parity.py. Each concrete reference class maps to the SQL surface
+that covers it (an executable query shape), STRUCTURAL parser/AST handling,
+or a documented ABSENT entry. Execution smoke-tests cover the surfaces
+added for this ledger (EXTRACT, IS TRUE/FALSE, COALESCE, ARRAY*, vector
+functions)."""
+
+import numpy as np
+import pytest
+
+from pinot_tpu.common import DataType, FieldSpec, Schema
+from pinot_tpu.query import QueryEngine
+from pinot_tpu.segment import SegmentBuilder
+
+# reference class -> how this framework covers it.
+# "sql": an executable function/operator surface (spot-checked below or in
+#   the dedicated suites); "structural": parser/AST construct; "absent":
+#   knowingly not implemented (reason).
+LEDGER = {
+    "AdditionTransformFunction": ("structural", "+ binary op"),
+    "AndOperatorTransformFunction": ("structural", "AND filter tree"),
+    "ArrayAverageTransformFunction": ("sql", "ARRAYAVERAGE(mv)"),
+    "ArrayLengthTransformFunction": ("sql", "ARRAYLENGTH(mv) / CARDINALITY(mv)"),
+    "ArrayLiteralTransformFunction": ("structural", "ARRAY[..] literals"),
+    "ArrayMaxTransformFunction": ("sql", "ARRAYMAX(mv)"),
+    "ArrayMinTransformFunction": ("sql", "ARRAYMIN(mv)"),
+    "ArraySumTransformFunction": ("sql", "ARRAYSUM(mv)"),
+    "CLPDecodeTransformFunction": ("absent", "CLP columns decode at ingest (io/readers.py CLPRecordReader); no encoded-column store"),
+    "CaseTransformFunction": ("structural", "CASE WHEN"),
+    "CastTransformFunction": ("sql", "CAST(x AS T)"),
+    "ClpEncodedVarsMatchTransformFunction": ("absent", "no CLP encoded-column store"),
+    "CoalesceTransformFunction": ("sql", "COALESCE(a, b, ...)"),
+    "DateTimeConversionHopTransformFunction": ("absent", "hop-window variant; plain DATETIMECONVERT covered"),
+    "DateTimeConversionTransformFunction": ("sql", "DATETIMECONVERT(...)"),
+    "DateTimeTransformFunction": ("sql", "year/month/.../millisecond extracts"),
+    "DateTruncTransformFunction": ("sql", "DATETRUNC('unit', ts)"),
+    "DistinctFromTransformFunction": ("structural", "IS DISTINCT FROM"),
+    "DivisionTransformFunction": ("structural", "/ binary op"),
+    "EqualsTransformFunction": ("structural", "= compare"),
+    "ExtractTransformFunction": ("sql", "EXTRACT(unit FROM ts)"),
+    "GenerateArrayTransformFunction": ("absent", "test-data generator"),
+    "GreaterThanOrEqualTransformFunction": ("structural", ">= compare"),
+    "GreaterThanTransformFunction": ("structural", "> compare"),
+    "GreatestTransformFunction": ("sql", "GREATEST(...)"),
+    "GroovyTransformFunction": ("absent", "no embedded scripting sandbox by design"),
+    "IdentifierTransformFunction": ("structural", "column refs"),
+    "InIdSetTransformFunction": ("absent", "IN_ID_SET sketch-membership predicate"),
+    "InTransformFunction": ("structural", "IN (...)"),
+    "IsDistinctFromTransformFunction": ("structural", "IS DISTINCT FROM"),
+    "IsFalseTransformFunction": ("sql", "x IS FALSE"),
+    "IsNotDistinctFromTransformFunction": ("structural", "IS NOT DISTINCT FROM"),
+    "IsNotFalseTransformFunction": ("sql", "x IS NOT FALSE"),
+    "IsNotNullTransformFunction": ("structural", "IS NOT NULL"),
+    "IsNotTrueTransformFunction": ("sql", "x IS NOT TRUE"),
+    "IsNullTransformFunction": ("structural", "IS NULL"),
+    "IsTrueTransformFunction": ("sql", "x IS TRUE"),
+    "ItemTransformFunction": ("absent", "array subscript access"),
+    "JsonExtractIndexTransformFunction": ("absent", "json-index-accelerated extract; JSONEXTRACTSCALAR + JSON_MATCH covered"),
+    "JsonExtractKeyTransformFunction": ("absent", "returns MV key arrays"),
+    "JsonExtractScalarTransformFunction": ("sql", "JSONEXTRACTSCALAR(col, path, type)"),
+    "LeastTransformFunction": ("sql", "LEAST(...)"),
+    "LessThanOrEqualTransformFunction": ("structural", "<= compare"),
+    "LessThanTransformFunction": ("structural", "< compare"),
+    "LiteralTransformFunction": ("structural", "literals"),
+    "LookupTransformFunction": ("sql", "LOOKUP('dimTable','dest','pk',expr)"),
+    "MapValueTransformFunction": ("sql", "MAP_VALUE(col,'key')"),
+    "ModuloTransformFunction": ("structural", "% binary op"),
+    "MultiplicationTransformFunction": ("structural", "* binary op"),
+    "NotEqualsTransformFunction": ("structural", "!= compare"),
+    "NotInTransformFunction": ("structural", "NOT IN (...)"),
+    "NotOperatorTransformFunction": ("structural", "NOT filter"),
+    "OrOperatorTransformFunction": ("structural", "OR filter tree"),
+    "PowerTransformFunction": ("sql", "POWER(x, y)"),
+    "RegexpExtractTransformFunction": ("sql", "REGEXPEXTRACT(...)"),
+    "RoundDecimalTransformFunction": ("sql", "ROUNDDECIMAL(x, n)"),
+    "SelectTupleElementTransformFunction": ("absent", "tuple element access"),
+    "SingleParamMathTransformFunction": ("sql", "ABS/CEIL/FLOOR/EXP/LN/SQRT/SIGN"),
+    "SubtractionTransformFunction": ("structural", "- binary op"),
+    "TimeConversionTransformFunction": ("sql", "TIMECONVERT(...)"),
+    "TimeSeriesBucketTransformFunction": ("sql", "timeseries engine bucket op (timeseries/)"),
+    "TrigonometricTransformFunctions": ("sql", "SIN/COS/TAN/.../ATAN2"),
+    "TruncateDecimalTransformFunction": ("sql", "TRUNCATE(x, n)"),
+    "ValueInTransformFunction": ("structural", "MV IN any-match"),
+    "VectorTransformFunctions": ("sql", "COSINEDISTANCE/INNERPRODUCT/L1DISTANCE/L2DISTANCE/VECTORDIMS/VECTORNORM"),
+}
+
+# base classes / infra excluded from scoring (no user-facing function)
+INFRA = {
+    "BaseBooleanAssertionTransformFunction",
+    "BaseTransformFunction",
+    "BinaryOperatorTransformFunction",
+    "ComputeDifferentlyWhenNullHandlingEnabledTransformFunction",
+    "LogicalOperatorTransformFunction",
+    "ScalarTransformFunctionWrapper",
+    "TransformFunction",
+    "TransformFunctionFactory",
+}
+
+
+def test_ledger_is_complete_against_reference_class_list():
+    # 73 files total: 65 concrete + 8 infra (reference:
+    # core/operator/transform/function/, wc -l = 73)
+    assert len(LEDGER) + len(INFRA) == 73
+    assert not (set(LEDGER) & INFRA)
+
+
+def test_coverage_threshold():
+    covered = [k for k, (st, _) in LEDGER.items() if st in ("sql", "structural")]
+    absent = [k for k, (st, _) in LEDGER.items() if st == "absent"]
+    assert len(covered) + len(absent) == len(LEDGER)
+    # >=80% of concrete reference transform classes have a covering surface
+    assert len(covered) >= 52, f"only {len(covered)} of {len(LEDGER)} covered; absent={absent}"
+
+
+@pytest.fixture(scope="module")
+def engines():
+    schema = Schema.build(
+        "t",
+        dimensions=[("a", DataType.INT), ("s", DataType.STRING)],
+        metrics=[("m", DataType.LONG)],
+    )
+    data = {
+        "a": np.array([1, 0, 3], np.int32),
+        "s": np.array(["x", "y", "z"], dtype=object),
+        "m": np.array([10, 20, 30], np.int64),
+    }
+    sv = QueryEngine([SegmentBuilder(schema).build(data, "s0")])
+
+    mv_schema = Schema("u")
+    mv_schema.add(FieldSpec("nums", DataType.LONG, single_value=False))
+    mv_schema.add(FieldSpec("emb", DataType.FLOAT, single_value=False))
+    mv_data = {
+        "nums": np.array([[1, 2], [5], [7, 8, 9]], dtype=object),
+        "emb": np.array([[1.0, 0.0], [0.0, 1.0], [3.0, 4.0]], dtype=object),
+    }
+    mv = QueryEngine([SegmentBuilder(mv_schema).build(mv_data, "u0")])
+    return sv, mv
+
+
+def test_extract_units(engines):
+    sv, _ = engines
+    r = sv.execute("SELECT EXTRACT(YEAR FROM m) FROM t")
+    assert [row[0] for row in r.rows] == [1970, 1970, 1970]
+
+
+def test_bool_assertions(engines):
+    sv, _ = engines
+    assert sv.execute("SELECT COUNT(*) FROM t WHERE a IS TRUE").rows[0][0] == 2
+    assert sv.execute("SELECT COUNT(*) FROM t WHERE a IS FALSE").rows[0][0] == 1
+    assert sv.execute("SELECT COUNT(*) FROM t WHERE a IS NOT TRUE").rows[0][0] == 1
+    assert sv.execute("SELECT COUNT(*) FROM t WHERE a IS NOT FALSE").rows[0][0] == 2
+
+
+def test_coalesce(engines):
+    sv, _ = engines
+    r = sv.execute("SELECT COALESCE(a, 0) FROM t")
+    assert [float(row[0]) for row in r.rows] == [1.0, 0.0, 3.0]
+
+
+def test_array_functions(engines):
+    _, mv = engines
+    assert [r[0] for r in mv.execute("SELECT ARRAYLENGTH(nums) FROM u").rows] == [2, 1, 3]
+    assert [r[0] for r in mv.execute("SELECT CARDINALITY(nums) FROM u").rows] == [2, 1, 3]
+    assert [float(r[0]) for r in mv.execute("SELECT ARRAYSUM(nums) FROM u").rows] == [3.0, 5.0, 24.0]
+    assert [float(r[0]) for r in mv.execute("SELECT ARRAYMIN(nums) FROM u").rows] == [1.0, 5.0, 7.0]
+    assert [float(r[0]) for r in mv.execute("SELECT ARRAYMAX(nums) FROM u").rows] == [2.0, 5.0, 9.0]
+    assert [float(r[0]) for r in mv.execute("SELECT ARRAYAVERAGE(nums) FROM u").rows] == [1.5, 5.0, 8.0]
+
+
+def test_coalesce_and_assertions_with_null_vectors():
+    from pinot_tpu.common import IndexingConfig, TableConfig
+
+    schema = Schema.build(
+        "nt",
+        dimensions=[("s", DataType.STRING), ("k", DataType.INT)],
+        metrics=[("b", DataType.INT)],
+    )
+    cfg = TableConfig("nt", indexing=IndexingConfig(null_handling=True))
+    data = {
+        "s": np.array(["x", None, "z"], dtype=object),
+        "k": np.array([1, 1, 2], np.int32),
+        "b": np.array([1, None, 0], dtype=object),
+    }
+    eng = QueryEngine([SegmentBuilder(schema, cfg).build(data, "s0")])
+    opts = "SET enableNullHandling=true; "
+    # COALESCE is null only where ALL args are null (string + numeric dtypes)
+    assert [r[0] for r in eng.execute(opts + "SELECT COALESCE(s, 'fallback') FROM nt").rows] == [
+        "x",
+        "fallback",
+        "z",
+    ]
+    assert [float(r[0]) for r in eng.execute(opts + "SELECT COALESCE(b, 0) FROM nt").rows] == [
+        1.0,
+        0.0,
+        0.0,
+    ]
+    # assertions are never unknown: positive forms exclude nulls, NOT forms include them
+    assert eng.execute(opts + "SELECT COUNT(*) FROM nt WHERE b IS TRUE").rows[0][0] == 1
+    assert eng.execute(opts + "SELECT COUNT(*) FROM nt WHERE b IS FALSE").rows[0][0] == 1
+    assert eng.execute(opts + "SELECT COUNT(*) FROM nt WHERE b IS NOT TRUE").rows[0][0] == 2
+    # HAVING with an assertion over an aggregate
+    r = eng.execute(opts + "SELECT k, MAX(b) FROM nt GROUP BY k HAVING MAX(b) IS TRUE")
+    assert [row[0] for row in r.rows] == [1]
+
+
+def test_vector_literal_pair_broadcasts(engines):
+    sv, _ = engines
+    r = sv.execute("SELECT L2DISTANCE(ARRAY[1.0, 2.0], ARRAY[1.0, 0.0]) FROM t")
+    assert [float(row[0]) for row in r.rows] == [2.0, 2.0, 2.0]
+
+
+def test_vector_functions(engines):
+    _, mv = engines
+    cos = [float(r[0]) for r in mv.execute("SELECT COSINEDISTANCE(emb, ARRAY[1.0, 0.0]) FROM u").rows]
+    assert cos[0] == pytest.approx(0.0) and cos[1] == pytest.approx(1.0) and cos[2] == pytest.approx(0.4)
+    l2 = [float(r[0]) for r in mv.execute("SELECT L2DISTANCE(emb, ARRAY[0.0, 0.0]) FROM u").rows]
+    assert l2 == [pytest.approx(1.0), pytest.approx(1.0), pytest.approx(5.0)]
+    ip = [float(r[0]) for r in mv.execute("SELECT INNERPRODUCT(emb, ARRAY[1.0, 1.0]) FROM u").rows]
+    assert ip == [1.0, 1.0, 7.0]
+    assert [r[0] for r in mv.execute("SELECT VECTORDIMS(emb) FROM u").rows] == [2, 2, 2]
+    nrm = [float(r[0]) for r in mv.execute("SELECT VECTORNORM(emb) FROM u").rows]
+    assert nrm == [pytest.approx(1.0), pytest.approx(1.0), pytest.approx(5.0)]
