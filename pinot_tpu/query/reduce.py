@@ -313,6 +313,61 @@ def _finalize(a, p, null_on: bool = False):
     raise AssertionError(func)
 
 
+def _finalize_column(a, parts, null_on: bool, n: int) -> list:
+    """Finalize one aggregation over ALL merged groups at once. The scalar
+    reducers (count/sum/min/max/avg/minmaxrange) vectorize to one numpy pass
+    + tolist — identical results to per-row _finalize, which dominated the
+    broker reduce at thousands of groups. Object-valued partials (sets,
+    sketches, or columns where None leaked into a numeric partial) fall back
+    to the per-row path via the TypeError/ValueError guard."""
+    func = MV_TWIN.get(a.func, a.func)
+    try:
+        if func == "count":
+            return np.asarray(parts, dtype=np.int64).tolist()
+        if func == "sum":
+            arr = np.asarray(parts, dtype=np.float64)
+            out = arr.tolist()
+            if null_on:
+                for j in np.flatnonzero(np.isnan(arr)):
+                    out[j] = None
+            return out
+        if func in ("min", "max"):
+            arr = np.asarray(parts, dtype=np.float64)
+            out = arr.tolist()
+            if null_on:
+                bad = np.isnan(arr) | (arr == (np.inf if func == "min" else -np.inf))
+                for j in np.flatnonzero(bad):
+                    out[j] = None
+            return out
+        if func == "avg":
+            s = np.asarray(parts[0], dtype=np.float64)
+            c = np.asarray(parts[1], dtype=np.float64)
+            with np.errstate(divide="ignore", invalid="ignore"):
+                out = (s / c).tolist()
+            zero = c == 0
+            if null_on:
+                for j in np.flatnonzero(zero | np.isnan(s)):
+                    out[j] = None
+            else:
+                for j in np.flatnonzero(zero):
+                    out[j] = float("-inf")  # Pinot: avg of 0 docs -> default
+            return out
+        if func == "minmaxrange":
+            lo = np.asarray(parts[0], dtype=np.float64)
+            hi = np.asarray(parts[1], dtype=np.float64)
+            out = (hi - lo).tolist()
+            if null_on:
+                bad = np.isnan(lo) | np.isnan(hi) | ((lo == np.inf) & (hi == -np.inf))
+                for j in np.flatnonzero(bad):
+                    out[j] = None
+            return out
+    except (TypeError, ValueError):
+        pass
+    if parts_of(a.func) == 2:
+        return [_finalize(a, (parts[0][ri], parts[1][ri]), null_on) for ri in range(n)]
+    return [_finalize(a, parts[ri], null_on) for ri in range(n)]
+
+
 def _alias_map(ctx: QueryContext) -> dict[str, ast.Expr]:
     return {it.alias: it.expr for it in ctx.select_items if it.alias}
 
@@ -471,8 +526,16 @@ def reduce_group_by(ctx: QueryContext, frames: list[pd.DataFrame]) -> list[list]
     key_vals = [merged[f"k{i}"].tolist() for i in range(nkeys)]
     part_vals = {c: merged[c].tolist() for c in merged.columns if c not in key_cols}
     group_names = [canonical(g) for g in ctx.group_by]
+    n_rows = len(merged)
+    fin_cols = []
+    for i, a in enumerate(ctx.aggregations):
+        if parts_of(a.func) == 2:
+            parts = (part_vals[f"a{i}p0"], part_vals[f"a{i}p1"])
+        else:
+            parts = part_vals[f"a{i}p0"]
+        fin_cols.append(_finalize_column(a, parts, null_on, n_rows))
     rows = []
-    for ri in range(len(merged)):
+    for ri in range(n_rows):
         env: dict[str, Any] = {}
         for i, name in enumerate(group_names):
             k = key_vals[i][ri]
@@ -480,28 +543,80 @@ def reduce_group_by(ctx: QueryContext, frames: list[pd.DataFrame]) -> list[list]
                 k = None  # NaN key = the null group (host NaN substitution)
             env[name] = k
         for i, a in enumerate(ctx.aggregations):
-            if parts_of(a.func) == 2:
-                p = (part_vals[f"a{i}p0"][ri], part_vals[f"a{i}p1"][ri])
-            else:
-                p = part_vals[f"a{i}p0"][ri]
-            env[a.name] = _finalize(a, p, null_on)
+            env[a.name] = fin_cols[i][ri]
         rows.append(env)
 
     if ctx.having is not None:
         rows = [e for e in rows if eval_having(ctx.having, e, aliases)]
 
     if ctx.order_by:
-        def sort_key(env):
-            ks = []
-            for ob in ctx.order_by:
-                v = eval_scalar(ob.expr, env, aliases)
-                ks.append(_OrderKey(v, ob.desc))
-            return tuple(ks)
-
-        rows.sort(key=sort_key)
+        rows = _order_rows(rows, ctx.order_by, aliases)
 
     rows = rows[ctx.offset : ctx.offset + ctx.limit]
     return [[eval_scalar(it.expr, env, aliases) for it in ctx.select_items] for env in rows]
+
+
+def _ob_column(ob, rows: list[dict], aliases) -> list:
+    """Evaluate one ORDER BY expression over every row env. The canonical
+    env key is row-independent, so it is resolved ONCE and the per-row work
+    collapses to a dict lookup; only expressions not materialized in the env
+    (post-agg arithmetic, alias chains) pay full eval_scalar per row."""
+    expr = ob.expr
+    if rows:
+        if isinstance(expr, ast.Identifier):
+            if expr.name in rows[0]:
+                return [e[expr.name] for e in rows]
+        elif not isinstance(expr, ast.Literal):
+            cn = canonical(expr)
+            if cn in rows[0]:
+                return [e[cn] for e in rows]
+    return [eval_scalar(expr, e, aliases) for e in rows]
+
+
+def _order_rows(rows: list[dict], order_by, aliases) -> list[dict]:
+    """ORDER BY over merged group rows. Numeric keys ride one stable
+    np.lexsort (nulls-as-largest, DESC via negation — same ordering as
+    _OrderKey); any non-numeric or precision-risky key (strings, |int|>2^53)
+    falls back to the general Python sort over the SAME pre-evaluated
+    columns, so eval_scalar never runs per-comparison either way."""
+    cols = [_ob_column(ob, rows, aliases) for ob in order_by]
+    descs = [ob.desc for ob in order_by]
+    n = len(rows)
+    lex: list[np.ndarray] = []
+    numeric = True
+    for vals, desc in zip(cols, descs):
+        arr = np.empty(n, np.float64)
+        mask = np.empty(n, np.float64)
+        for i, v in enumerate(vals):
+            if v is None or (isinstance(v, float) and math.isnan(v)):
+                # nulls rank as the largest value: first under DESC, last ASC
+                mask[i] = 0.0 if desc else 1.0
+                arr[i] = 0.0
+            elif isinstance(v, bool) or not isinstance(v, (int, float, np.integer, np.floating)):
+                numeric = False
+                break
+            elif isinstance(v, (int, np.integer)) and abs(int(v)) > (1 << 53):
+                numeric = False  # float64 would collapse distinct keys
+                break
+            else:
+                mask[i] = 1.0 if desc else 0.0
+                arr[i] = -float(v) if desc else float(v)
+        if not numeric:
+            break
+        lex.append(mask)
+        lex.append(arr)
+    if numeric:
+        if not lex:
+            return rows
+        # np.lexsort: LAST key is primary -> reversed, ob_1's null-group mask
+        # dominates, then its values, then ob_2's mask/values, ...
+        order = np.lexsort(lex[::-1])
+        return [rows[i] for i in order]
+    idx = sorted(
+        range(n),
+        key=lambda i: tuple(_OrderKey(c[i], d) for c, d in zip(cols, descs)),
+    )
+    return [rows[i] for i in idx]
 
 
 class _OrderKey:
@@ -516,14 +631,18 @@ class _OrderKey:
     def __lt__(self, other):
         a, b = (other.v, self.v) if self.desc else (self.v, other.v)
         # nulls rank as the largest value (OrderByExpressionContext default):
-        # None is never < anything; anything non-null is < None
-        if a is None:
+        # None/NaN is never < anything; anything non-null is < None/NaN
+        # (NaN = the device kernels' null sentinel — must agree with the
+        # np.lexsort fast path, which ranks it with None)
+        if _is_null_partial(a):
             return False
-        if b is None:
+        if _is_null_partial(b):
             return True
         return a < b
 
     def __eq__(self, other):
+        if _is_null_partial(self.v) or _is_null_partial(other.v):
+            return _is_null_partial(self.v) and _is_null_partial(other.v)
         return self.v == other.v
 
 
