@@ -23,7 +23,7 @@ import json, os, sys, time
 import numpy as np
 import pinot_tpu  # noqa: F401
 import jax, jax.numpy as jnp
-from pinot_tpu.ops.groupby_pallas import CHUNK, _grids, gtile_for, pallas_grouped_multi_sum
+from pinot_tpu.ops.groupby_pallas import PLANES_CHUNK, _grids, gtile_for, pallas_grouped_multi_sum
 
 n = int(os.environ.get("PINOT_TPU_SWEEP_DOCS", 4_000_000))
 ng = int(sys.argv[1])
@@ -43,10 +43,10 @@ assert np.allclose(out, truth), "parity failure"
 lat = []
 for _ in range(7):
     t0 = time.perf_counter(); run(); lat.append((time.perf_counter() - t0) * 1e3)
-n_padded = n + ((-n) % CHUNK)
-n_chunks, n_gtiles, _, _gt = _grids(n_padded, ng)
+n_padded = n + ((-n) % PLANES_CHUNK)
+n_chunks, n_gtiles, _, _gt = _grids(n_padded, ng, PLANES_CHUNK)
 print(json.dumps({
-    "chunk": CHUNK, "gtile": gtile_for(ng), "ng": ng, "docs": n,
+    "chunk": PLANES_CHUNK, "gtile": gtile_for(ng), "ng": ng, "docs": n,
     "p50_ms": round(float(np.percentile(lat, 50)), 2),
     "steps": n_chunks * n_gtiles,
 }))
@@ -58,7 +58,10 @@ def main() -> None:
     for chunk, gtile in CONFIGS:
         for ng in GROUPS:
             env = dict(os.environ)
+            # the byte-plane kernel (what this sweep measures) reads the
+            # _PLANES knob; keep the f32-kernel knob in step for column pad
             env["PINOT_TPU_PALLAS_CHUNK"] = str(chunk)
+            env["PINOT_TPU_PALLAS_CHUNK_PLANES"] = str(chunk)
             env["PINOT_TPU_PALLAS_GTILE"] = str(gtile)
             try:
                 p = subprocess.run(

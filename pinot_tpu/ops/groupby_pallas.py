@@ -39,6 +39,11 @@ import numpy as np
 # per-step overhead across more MXU columns. Overridable for hardware
 # sweeps (benchmarks/pallas_sweep.py).
 CHUNK = int(os.environ.get("PINOT_TPU_PALLAS_CHUNK", "2048"))
+#: chunk for the exact byte-plane kernel only. Its one-hot tile is bf16
+#: (plane values <=255 are exact in bf16's 8 mantissa bits), so a 4096-doc
+#: chunk costs the same 8MB of VMEM as the f32 kernels' 2048 — and HALVES the
+#: grid-step count, which dominates at bench shapes (~2us fixed cost/step).
+PLANES_CHUNK = int(os.environ.get("PINOT_TPU_PALLAS_CHUNK_PLANES", "4096"))
 _GTILE_ENV = os.environ.get("PINOT_TPU_PALLAS_GTILE", "")
 
 
@@ -58,10 +63,13 @@ def gtile_for(ng: int) -> int:
 
 # exactness invariant of the byte-plane SUM: one chunk's plane dot must stay
 # below the f32 exact-integer bound. Fail loudly on bad sweep overrides.
-if CHUNK * 255 >= 2**24:
-    raise ValueError(f"PINOT_TPU_PALLAS_CHUNK={CHUNK}: CHUNK*255 must stay < 2^24 for lossless sums")
-if CHUNK % 128 or (_GTILE_ENV and int(_GTILE_ENV) % 128):
-    raise ValueError("PINOT_TPU_PALLAS_CHUNK/GTILE must be multiples of 128 (lane tiling)")
+for _nm, _ck in (("PINOT_TPU_PALLAS_CHUNK", CHUNK), ("PINOT_TPU_PALLAS_CHUNK_PLANES", PLANES_CHUNK)):
+    if _ck * 255 >= 2**24:
+        raise ValueError(f"{_nm}={_ck}: CHUNK*255 must stay < 2^24 for lossless sums")
+    if _ck % 128:
+        raise ValueError(f"{_nm}={_ck}: must be a multiple of 128 (lane tiling)")
+if _GTILE_ENV and int(_GTILE_ENV) % 128:
+    raise ValueError("PINOT_TPU_PALLAS_GTILE must be a multiple of 128 (lane tiling)")
 
 
 def pallas_enabled() -> bool:
@@ -85,9 +93,10 @@ def _interpret() -> bool:
     return jax.default_backend() != "tpu"
 
 
-def _pad_inputs(gid, values, mask):
+def _pad_inputs(gid, values, mask, chunk: int = 0):
+    chunk = chunk or CHUNK
     n = gid.shape[0]
-    pad = (-n) % CHUNK
+    pad = (-n) % chunk
     if pad:
         gid = jnp.pad(gid, (0, pad))
         mask = jnp.pad(mask, (0, pad))
@@ -96,10 +105,11 @@ def _pad_inputs(gid, values, mask):
     return gid, values, mask, n + pad
 
 
-def _grids(n_padded: int, ng: int):
+def _grids(n_padded: int, ng: int, chunk: int = 0):
+    chunk = chunk or CHUNK
     gtile = gtile_for(ng)
     ng_pad = max(gtile, ((ng + gtile - 1) // gtile) * gtile)
-    return n_padded // CHUNK, ng_pad // gtile, ng_pad, gtile
+    return n_padded // chunk, ng_pad // gtile, ng_pad, gtile
 
 
 # -- sum / count: MXU one-hot matmul ----------------------------------------
@@ -249,7 +259,7 @@ def pallas_grouped_max(values, gid, mask, ng: int):
 # the tiny (5, ng) recombination runs in f64 outside the kernel.
 
 @functools.lru_cache(maxsize=None)
-def _make_planes_kernel(r: int, gtile: int):
+def _make_planes_kernel(r: int, gtile: int, chunk: int):
     from jax.experimental import pallas as pl
 
     def kernel(gid_ref, planes_ref, out_ref):
@@ -261,12 +271,17 @@ def _make_planes_kernel(r: int, gtile: int):
             out_ref[:] = jnp.zeros_like(out_ref)
 
         gid = gid_ref[0, :]
-        planes = planes_ref[:]  # (r, CHUNK) f32, pre-masked
+        # bf16 is exact here: plane bytes are integers in [-128, 255] and the
+        # one-hot is 0/1 — both inside bf16's 2^8 exact-integer range. The
+        # halved one-hot tile is what buys PLANES_CHUNK=2*CHUNK at equal VMEM,
+        # and the MXU runs bf16 at twice the f32 rate.
+        planes = planes_ref[:].astype(jnp.bfloat16)  # (r, chunk), pre-masked
         base = gi * gtile
         onehot = (
-            gid[:, None] == (base + jax.lax.broadcasted_iota(jnp.int32, (CHUNK, gtile), 1))
-        ).astype(jnp.float32)
-        acc = jnp.dot(planes, onehot, preferred_element_type=jnp.float32)  # exact per chunk
+            gid[:, None] == (base + jax.lax.broadcasted_iota(jnp.int32, (chunk, gtile), 1))
+        ).astype(jnp.bfloat16)
+        # f32 accumulation keeps each chunk's plane dot exact (< 2^24)
+        acc = jnp.dot(planes, onehot, preferred_element_type=jnp.float32)
         out_ref[:] = out_ref[:] + acc.astype(jnp.int32)
 
     return kernel
@@ -278,13 +293,13 @@ def _planes_impl(gid, planes, ng: int, r: int):
     from jax.experimental.pallas import tpu as pltpu
 
     n_padded = gid.shape[0]
-    n_chunks, n_gtiles, ng_pad, gtile = _grids(n_padded, ng)
+    n_chunks, n_gtiles, ng_pad, gtile = _grids(n_padded, ng, PLANES_CHUNK)
     return pl.pallas_call(
-        _make_planes_kernel(r, gtile),
+        _make_planes_kernel(r, gtile, PLANES_CHUNK),
         grid=(n_gtiles, n_chunks),
         in_specs=[
-            pl.BlockSpec((1, CHUNK), lambda g, c: (jnp.int32(0), c), memory_space=pltpu.VMEM),
-            pl.BlockSpec((r, CHUNK), lambda g, c: (jnp.int32(0), c), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, PLANES_CHUNK), lambda g, c: (jnp.int32(0), c), memory_space=pltpu.VMEM),
+            pl.BlockSpec((r, PLANES_CHUNK), lambda g, c: (jnp.int32(0), c), memory_space=pltpu.VMEM),
         ],
         out_specs=pl.BlockSpec((r, gtile), lambda g, c: (jnp.int32(0), g), memory_space=pltpu.VMEM),
         out_shape=jax.ShapeDtypeStruct((r, ng_pad), jnp.int32),
@@ -312,7 +327,7 @@ def pallas_grouped_multi_sum(values_list, gid, mask, ng: int):
             "use the XLA two-level path for larger inputs"
         )
     k = len(values_list)
-    gid, _, mask, n_padded = _pad_inputs(gid.astype(jnp.int32), None, mask)
+    gid, _, mask, n_padded = _pad_inputs(gid.astype(jnp.int32), None, mask, PLANES_CHUNK)
     rows = []
     for v in values_list:
         v = jnp.pad(v.astype(jnp.int32), (0, n_padded - v.shape[0]))
@@ -346,7 +361,7 @@ def pallas_grouped_multi_sum_blocked(values_list, gid, mask, ng: int):
     n = gid.shape[0]
     if n <= SAFE_DOCS:
         return pallas_grouped_multi_sum(values_list, gid, mask, ng)
-    block = (SAFE_DOCS // CHUNK) * CHUNK
+    block = (SAFE_DOCS // PLANES_CHUNK) * PLANES_CHUNK
     sums_acc = None
     counts_acc = None
     for start in range(0, n, block):
