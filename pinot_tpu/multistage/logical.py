@@ -321,17 +321,50 @@ def _statement_idents(stmt: ast.SelectStatement) -> set[str] | None:
 
 class Catalog:
     """table name -> list of column names (from the segment schema), plus
-    optional row counts feeding the cost-based exchange decisions."""
+    optional row counts feeding the cost-based exchange decisions and
+    optional per-column NDV estimates (dictionary cardinalities) feeding
+    cardinality-gated rules (AggregateJoinTranspose)."""
 
-    def __init__(self, tables: dict[str, list[str]], row_counts: dict[str, int] | None = None):
+    def __init__(
+        self,
+        tables: dict[str, list[str]],
+        row_counts: dict[str, int] | None = None,
+        ndv: dict[str, dict[str, int]] | None = None,
+    ):
         self.tables = tables
         self.row_counts = dict(row_counts or {})
+        self.ndv = {t: dict(cols) for t, cols in (ndv or {}).items()}
 
     def columns(self, table: str) -> list[str]:
         cols = self.tables.get(table)
         if cols is None:
             raise PlanV2Error(f"unknown table {table!r}")
         return list(cols)
+
+    @classmethod
+    def from_segments(
+        cls,
+        catalog: "dict[str, list]",
+        schemas: "dict[str, list[str]] | None" = None,
+    ) -> "Catalog":
+        """Build the planning catalog from table -> segment lists: column
+        names from the first segment's schema (overridable via `schemas` for
+        empty tables), row counts, and per-column NDV upper bounds (sum of
+        per-segment dictionary cardinalities) for cardinality-gated rules.
+        The ONE construction shared by the engine and plan-shape tests."""
+        cols = dict(schemas or {})
+        for t, segs in catalog.items():
+            if t not in cols and segs:
+                cols[t] = list(segs[0].schema.columns)
+        rows = {t: sum(s.n_docs for s in segs) for t, segs in catalog.items()}
+        ndv: dict[str, dict[str, int]] = {}
+        for t, segs in catalog.items():
+            if segs:
+                ndv[t] = {
+                    c: sum(s.columns[c].cardinality for s in segs if c in s.columns)
+                    for c in cols[t]
+                }
+        return cls(cols, row_counts=rows, ndv=ndv)
 
 
 def _conjuncts(f: ast.FilterExpr) -> list[ast.FilterExpr]:
@@ -922,9 +955,15 @@ def build_stage_plan(stmt, catalog: Catalog, n_workers: int = 2) -> StagePlan:
     nvis = _visible_count(root)
     visible = [f.name for f in root.fields[:nvis]]
     rule_stats: dict[str, int] = {}
-    root = optimize(root, LOGICAL_RULES, rule_stats)
-    root = insert_exchanges(root, catalog.row_counts)
-    root = optimize(root, PHYSICAL_RULES, rule_stats)
+    from pinot_tpu.multistage.rules import PLAN_CATALOG
+
+    token = PLAN_CATALOG.set(catalog)  # stat-gated physical rules read this
+    try:
+        root = optimize(root, LOGICAL_RULES, rule_stats)
+        root = insert_exchanges(root, catalog.row_counts)
+        root = optimize(root, PHYSICAL_RULES, rule_stats)
+    finally:
+        PLAN_CATALOG.reset(token)
     plan = cut_stages(root, n_workers, visible)
     plan.options = dict(getattr(stmt, "options", None) or {})
     plan.rule_stats = rule_stats

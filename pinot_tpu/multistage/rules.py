@@ -21,6 +21,7 @@ exchange) — the HepPlanner "keep firing until nothing changes" model.
 
 from __future__ import annotations
 
+import contextvars
 from dataclasses import dataclass
 from typing import Callable
 
@@ -332,7 +333,156 @@ def _limit_through_exchange(node: Node) -> Node | None:
     return None
 
 
+#: the planning catalog for the optimize() call in flight — set by
+#: build_stage_plan so stat-gated rules (AggregateJoinTranspose) can read
+#: row counts / NDV without widening every Rule's signature. contextvars
+#: keep concurrent per-query plans isolated.
+PLAN_CATALOG: contextvars.ContextVar = contextvars.ContextVar("plan_catalog", default=None)
+
+#: fire the transpose only when the pushed partial is estimated to collapse
+#: the probe side by at least this factor (NDV product vs estimated rows) —
+#: the Calcite AggregateJoinTransposeRule is cost-gated for the same reason:
+#: partial-aggregating a near-unique key (e.g. an FK to a large dim) groups
+#: everything and collapses nothing.
+TRANSPOSE_MIN_COLLAPSE = 4.0
+
+#: multiplicity-safe decomposable functions for the transpose below. A
+#: non-unique build-side key duplicates each probe-side partial row m times;
+#: the FINAL merge then re-sums, so sum/count/avg scale by exactly m — the
+#: same m the un-transposed join would have applied row-by-row — and
+#: min/max/distinct are duplicate-idempotent. percentile/tdigest partials
+#: are value collections where duplication CHANGES the result: excluded.
+_TRANSPOSE_AGGS = {
+    "count",
+    "sum",
+    "min",
+    "max",
+    "avg",
+    "minmaxrange",
+    "distinctcount",
+    "distinctcountbitmap",
+    "distinctcounthll",
+}
+
+
+def _scan_tables(node: Node, out: list[tuple[str | None, str]]) -> None:
+    """Collect (qualifier, table) for every Scan in a subtree."""
+    if isinstance(node, Scan):
+        out.append((node.qualifier, node.table))
+    for _, child in _children(node):
+        _scan_tables(child, out)
+
+
+def _transpose_collapses(pushed: list[ast.Expr], left_sub: Node) -> bool:
+    """Cardinality gate: the NDV product of the pushed group keys must be
+    at least TRANSPOSE_MIN_COLLAPSE times smaller than the probe side's
+    estimated rows. Unknown NDV (no catalog, expression keys, columns with
+    no dictionary stats) fails closed — the un-transposed plan is the safe
+    default for near-unique keys."""
+    cat = PLAN_CATALOG.get()
+    if cat is None or not getattr(cat, "ndv", None):
+        return False
+    scans: list[tuple[str | None, str]] = []
+    _scan_tables(left_sub, scans)
+    by_qual = {q: t for q, t in scans if q is not None}
+    for _, t in scans:
+        by_qual.setdefault(t, t)  # unaliased scans are referenced by table name
+    sole_table = scans[0][1] if len(scans) == 1 else None
+    ndv_product = 1.0
+    for g in pushed:
+        ids: set[str] = set()
+        L._idents_expr(g, ids)
+        for ident in ids:
+            q, n = ident.split(".", 1) if "." in ident else (None, ident)
+            # an unqualified ident is attributable only when one scan exists
+            table = by_qual.get(q) if q is not None else sole_table
+            card = cat.ndv.get(table, {}).get(n) if table else None
+            if card is None:
+                return False
+            ndv_product *= max(1, card)
+    est = L.estimate_rows(left_sub, cat.row_counts)
+    return ndv_product * TRANSPOSE_MIN_COLLAPSE <= est
+
+
+def _agg_join_transpose(node: Node) -> Node | None:
+    """AggregatePartial(Join(L, R)) -> Project(Join(AggregatePartial'(L), R))
+    [AggregateJoinTransposeRule]: when every aggregation argument lives on
+    the probe side of an INNER equi-join, the partial aggregate pushes below
+    the join keyed by (join keys + probe-side group keys). The fact side
+    then collapses to one row per key combination BEFORE the join — and the
+    pushed partial lands on the leaf stage, where the fused v1 device
+    group-by executes it on-chip. The final Aggregate re-merges above, which
+    is what makes non-unique build-side keys safe (see _TRANSPOSE_AGGS).
+
+    The Project restores the positional [group keys..., part cols...] layout
+    the final-mode Aggregate expects from its original partial."""
+    from pinot_tpu.query.context import canonical
+
+    if not isinstance(node, L.Aggregate) or node.mode != "partial":
+        return None
+    j = node.input
+    if (
+        not isinstance(j, L.Join)
+        or j.kind != "inner"
+        or j.post_filter is not None
+        or not j.left_keys
+    ):
+        return None
+    lex = j.left if isinstance(j.left, Exchange) else None
+    left_sub = lex.input if lex else j.left
+    lf, rf = left_sub.fields, j.right.fields
+
+    def _on(fields, ids: set[str]) -> bool:
+        return bool(ids) and all(L.try_resolve(fields, i) is not None for i in ids)
+
+    for a in node.aggs:
+        if a.func not in _TRANSPOSE_AGGS or a.arg2 is not None:
+            return None
+        ids: set[str] = set()
+        if a.arg is not None:
+            L._idents_expr(a.arg, ids)
+        if a.filter is not None:
+            L._idents_filter(a.filter, ids)
+        if ids and not _on(lf, ids):
+            return None
+    l_groups = []
+    for g in node.group_exprs:
+        ids = set()
+        L._idents_expr(g, ids)
+        if _on(lf, ids):
+            l_groups.append(g)
+        elif not _on(rf, ids):
+            return None  # right-side keys ride the join; mixed/literal: bail
+    for k in j.left_keys:
+        ids = set()
+        L._idents_expr(k, ids)
+        if not _on(lf, ids):
+            return None
+    seen: set[str] = set()
+    pushed = []
+    for g in list(j.left_keys) + l_groups:
+        c = canonical(g)
+        if c not in seen:
+            seen.add(c)
+            pushed.append(g)
+    if not _transpose_collapses(pushed, left_sub):
+        return None
+    partial2 = L.Aggregate(left_sub, pushed, list(node.aggs), mode="partial")
+    new_left = Exchange(partial2, lex.dist, list(lex.key_exprs)) if lex else partial2
+    new_join = L.Join(new_left, j.right, j.kind, list(j.left_keys), list(j.right_keys))
+    exprs, names = [], []
+    for f in node.fields:
+        if L.try_resolve(new_join.fields, f.canon) is None:
+            return None  # a layout column vanished — leave the plan alone
+        exprs.append(ast.Identifier(f.canon))
+        names.append(f.canon)
+    proj = Project(new_join, exprs, names)
+    proj.fields = list(node.fields)  # exact original layout incl. qualifiers
+    return proj
+
+
 PHYSICAL_RULES = [
     Rule("CollapseExchange", _collapse_exchange),
+    Rule("AggregateJoinTranspose", _agg_join_transpose),
     Rule("LimitThroughExchange", _limit_through_exchange),
 ]

@@ -1118,6 +1118,29 @@ def _exec_join(node: L.Join, ctx: RunCtx) -> pd.DataFrame:
     if keys:
         lk = _key_frame(node.left_keys, node.left.fields, l.rename(columns=dict(zip(l.columns, range(nl)))))
         rk = _key_frame(node.right_keys, node.right.fields, r.rename(columns=dict(zip(r.columns, range(nr)))))
+        # mixed-type key pair (numeric vs string column): coerce the string
+        # side numerically — parseable values compare as numbers (Pinot
+        # widens comparisons the same way), unparseable ones become NaN and
+        # ride the null-key path below (a NULL key never matches). Coercion
+        # is only sound when the rows were NOT routed here by hashing both
+        # sides' raw representations: hash(float 5.0) != hash("5"), so a
+        # HASH-HASH distributed mixed-type join would drop cross-partition
+        # matches silently — fail loudly instead (Calcite rejects the
+        # uncasted mixed-type equi-join at validation for the same reason).
+        for kc in lk.columns:
+            lnum, rnum = lk[kc].dtype.kind == "f", rk[kc].dtype.kind == "f"
+            if lnum != rnum:
+                ldist = ctx.stages[node.left.stage_id].dist if isinstance(node.left, L.StageInput) else None
+                rdist = ctx.stages[node.right.stage_id].dist if isinstance(node.right, L.StageInput) else None
+                if ldist == L.HASH and rdist == L.HASH:
+                    raise L.PlanV2Error(
+                        "join key type mismatch (numeric vs string) across hash-"
+                        "partitioned inputs; add an explicit CAST on one side"
+                    )
+                if lnum:
+                    rk[kc] = pd.to_numeric(rk[kc], errors="coerce").astype(np.float64)
+                else:
+                    lk[kc] = pd.to_numeric(lk[kc], errors="coerce").astype(np.float64)
         lk.index = l.index
         rk.index = r.index
         l = pd.concat([l, lk], axis=1)
@@ -1403,12 +1426,7 @@ class MultistageEngine:
         t0 = time.perf_counter()
         if stmt is None:
             stmt = parse_sql(sql)
-        cols = dict(self.schemas)
-        for t, segs in self.catalog.items():
-            if t not in cols and segs:
-                cols[t] = list(segs[0].schema.columns)
-        rows = {t: sum(s.n_docs for s in segs) for t, segs in self.catalog.items()}
-        cat = L.Catalog(cols, row_counts=rows)
+        cat = L.Catalog.from_segments(self.catalog, self.schemas)
         plan = L.build_stage_plan(stmt, cat, self.n_workers)
         # singleton-fed stages collapse to one worker BEFORE explain so the
         # reported parallelism matches what actually runs

@@ -557,3 +557,24 @@ def test_two_phase_hll_and_dual_key_regressions():
         "WHERE a.v = 1 AND b.v = 2 GROUP BY a.k, b.k ORDER BY a.k LIMIT 5"
     )
     assert r2.rows and all(row[0] == row[1] for row in r2.rows)
+
+
+def test_mixed_type_join_key_coerces(setup):
+    """INT-vs-STRING join keys: parseable strings compare numerically,
+    unparseable ones behave as NULL keys (never match) — no pandas merge
+    dtype crash (found driving the config-6 bench shapes)."""
+    eng, odf, cdf = setup
+    # ocid is numeric, cname is a string column: nonsense join, must not raise
+    res = eng.execute("SELECT COUNT(*) FROM orders o JOIN customers c ON o.ocid = c.cname")
+    assert res.rows[0][0] == 0
+
+
+def test_mixed_type_join_key_hash_hash_fails_loudly(setup):
+    """When BOTH join inputs are hash-partitioned (large tables, no
+    broadcast), a numeric-vs-string key cannot be coerced consistently with
+    the exchange hashing — the engine must raise a clear error, never
+    return silently partial results."""
+    eng, odf, cdf = setup
+    # self-join style: both sides are the large orders table -> HASH + HASH
+    with pytest.raises(Exception, match="type mismatch"):
+        eng.execute("SELECT COUNT(*) FROM orders a JOIN orders b ON a.ocid = b.status")

@@ -104,9 +104,24 @@ def test_device_join_group_by_oracle(setup):
     assert [float(r[1]) for r in res.rows] == [float(x) for x in want]
 
 
-def test_duplicate_build_keys_device_join(setup):
+def _pin_untransposed_plan(monkeypatch):
+    """These two tests target the device JOIN operator on a many-to-many
+    key. AggregateJoinTranspose rewrites COUNT(*)-over-self-join into a
+    unique-build-side join (correct, but a different operator scenario), so
+    pin the un-transposed plan to keep exercising the general join path."""
+    from pinot_tpu.multistage import rules
+
+    monkeypatch.setattr(
+        rules,
+        "PHYSICAL_RULES",
+        [r for r in rules.PHYSICAL_RULES if r.name != "AggregateJoinTranspose"],
+    )
+
+
+def test_duplicate_build_keys_device_join(setup, monkeypatch):
     """Self-join on a NON-unique key rides the general device equi-join
     (sort + range probe + expansion) and matches the pandas oracle."""
+    _pin_untransposed_plan(monkeypatch)
     engine, fdf, ddf = setup
     before = runtime.DEVICE_OP_STATS["join"]
     # no WHERE: the probe side must stay above DEVICE_JOIN_MIN (a pushed-down
@@ -121,6 +136,7 @@ def test_many_to_many_blowup_falls_back(setup, monkeypatch):
     """A pair count past the guard falls back to the pandas hash join. No
     WHERE: the probe must stay above DEVICE_JOIN_MIN so the guard itself
     (not the size threshold) is what rejects the device path."""
+    _pin_untransposed_plan(monkeypatch)
     engine, fdf, ddf = setup
     pairs = len(fdf.merge(fdf, on="fdid", how="inner"))
     # the join runs per worker over hash partitions: the cap must sit below

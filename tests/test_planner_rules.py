@@ -38,9 +38,7 @@ def _plan(engine, sql):
     from pinot_tpu.query.sql import parse_sql
 
     eng = engine[0] if isinstance(engine, tuple) else engine
-    cols = {t: list(segs[0].schema.columns) for t, segs in eng.catalog.items() if segs}
-    rows = {t: sum(s.n_docs for s in segs) for t, segs in eng.catalog.items()}
-    cat = L.Catalog(cols, row_counts=rows)
+    cat = L.Catalog.from_segments(eng.catalog)
     return L.build_stage_plan(parse_sql(sql), cat, n_workers=2)
 
 
@@ -151,3 +149,169 @@ def test_subquery_filter_pushes_into_scan(engine):
         assert "FilterNode" not in repr(plan), repr(plan)
     res = eng.execute(sql)
     assert res.rows[0][0] == int((df.v > 500).sum())
+
+
+# -- AggregateJoinTranspose ---------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def join_engine():
+    """fact (dup join keys on BOTH sides of the dim mapping) + dim whose key
+    is NON-unique for one nation — the multiplicity case that makes naive
+    aggregate pushdown wrong and the partial/final re-merge right."""
+    rng = np.random.default_rng(4)
+    n = 20_000
+    fact_schema = Schema.build(
+        "fact",
+        dimensions=[("nation", DataType.STRING)],
+        metrics=[("rev", DataType.LONG), ("qty", DataType.LONG)],
+    )
+    nations = [f"N{i}" for i in range(10)]
+    fdata = {
+        "nation": np.array(nations, dtype=object)[rng.integers(0, 10, n)],
+        # near-unique: NDV ~ n, so the cardinality gate blocks pushing by rev
+        "rev": rng.integers(0, 1_000_000_000, n).astype(np.int64),
+        "qty": rng.integers(1, 50, n).astype(np.int64),
+    }
+    dim_schema = Schema.build(
+        "dim",
+        dimensions=[("dnation", DataType.STRING), ("region", DataType.STRING)],
+        metrics=[],
+    )
+    # N3 maps to TWO regions: each N3 fact row joins twice (m=2)
+    ddata = {
+        "dnation": np.array(nations + ["N3"], dtype=object),
+        "region": np.array([f"R{i % 3}" for i in range(10)] + ["R9"], dtype=object),
+    }
+    fseg = SegmentBuilder(fact_schema).build(fdata, "f0")
+    dseg = SegmentBuilder(dim_schema).build(ddata, "d0")
+    fdf = pd.DataFrame({k: (v.astype(str) if v.dtype == object else v) for k, v in fdata.items()})
+    ddf = pd.DataFrame({k: v.astype(str) for k, v in ddata.items()})
+    return MultistageEngine({"fact": [fseg], "dim": [dseg]}, n_workers=2), fdf, ddf
+
+
+def test_agg_join_transpose_fires_and_matches_oracle(join_engine):
+    engine, fdf, ddf = join_engine
+    sql = (
+        "SELECT d.region, SUM(f.rev), COUNT(*), MIN(f.qty), MAX(f.rev), AVG(f.rev) "
+        "FROM fact f JOIN dim d ON f.nation = d.dnation "
+        "GROUP BY d.region ORDER BY d.region"
+    )
+    plan = _plan(engine, sql)
+    assert plan.rule_stats.get("AggregateJoinTranspose", 0) >= 1
+    res = engine.execute(sql)
+    m = fdf.merge(ddf, left_on="nation", right_on="dnation")
+    g = m.groupby("region").agg(
+        s=("rev", "sum"), c=("rev", "size"), mn=("qty", "min"), mx=("rev", "max"), a=("rev", "mean")
+    ).sort_index()
+    assert [r[0] for r in res.rows] == list(g.index)
+    for r, (_, w) in zip(res.rows, g.iterrows()):
+        # the N3 double-mapping multiplies its rows by 2 in every aggregate:
+        # the transposed plan must reproduce that exactly
+        assert r[1] == float(w.s) and r[2] == int(w.c) and r[3] == float(w.mn)
+        assert r[4] == float(w.mx) and abs(r[5] - w.a) < 1e-9
+
+
+def test_agg_join_transpose_left_side_group_key(join_engine):
+    engine, fdf, ddf = join_engine
+    sql = (
+        "SELECT f.nation, d.region, SUM(f.rev) FROM fact f "
+        "JOIN dim d ON f.nation = d.dnation "
+        "GROUP BY f.nation, d.region ORDER BY f.nation, d.region"
+    )
+    plan = _plan(engine, sql)
+    assert plan.rule_stats.get("AggregateJoinTranspose", 0) >= 1
+    res = engine.execute(sql)
+    m = fdf.merge(ddf, left_on="nation", right_on="dnation")
+    g = m.groupby(["nation", "region"]).rev.sum().sort_index()
+    assert [(r[0], r[1], r[2]) for r in res.rows] == [
+        (k[0], k[1], float(v)) for k, v in g.items()
+    ]
+
+
+def test_agg_join_transpose_distinctcount(join_engine):
+    engine, fdf, ddf = join_engine
+    sql = (
+        "SELECT d.region, DISTINCTCOUNT(f.qty) FROM fact f "
+        "JOIN dim d ON f.nation = d.dnation GROUP BY d.region ORDER BY d.region"
+    )
+    plan = _plan(engine, sql)
+    assert plan.rule_stats.get("AggregateJoinTranspose", 0) >= 1
+    res = engine.execute(sql)
+    m = fdf.merge(ddf, left_on="nation", right_on="dnation")
+    g = m.groupby("region").qty.nunique().sort_index()
+    assert [(r[0], r[1]) for r in res.rows] == [(k, int(v)) for k, v in g.items()]
+
+
+def test_agg_join_transpose_skips_percentile(join_engine):
+    """Percentile partials are value collections — duplication from a
+    non-unique build key changes the result, so the rule must NOT fire."""
+    engine, fdf, ddf = join_engine
+    sql = (
+        "SELECT d.region, PERCENTILE(f.rev, 50) FROM fact f "
+        "JOIN dim d ON f.nation = d.dnation GROUP BY d.region ORDER BY d.region"
+    )
+    plan = _plan(engine, sql)
+    assert plan.rule_stats.get("AggregateJoinTranspose", 0) == 0
+    res = engine.execute(sql)
+    m = fdf.merge(ddf, left_on="nation", right_on="dnation")
+    g = m.groupby("region").rev.quantile(0.5, interpolation="lower").sort_index()
+    for r, (k, v) in zip(res.rows, g.items()):
+        assert r[0] == k and abs(r[1] - float(v)) <= 1.0
+
+
+def test_agg_join_transpose_skips_outer_join(join_engine):
+    engine, fdf, ddf = join_engine
+    sql = (
+        "SELECT d.region, SUM(f.rev) FROM fact f "
+        "LEFT JOIN dim d ON f.nation = d.dnation GROUP BY d.region ORDER BY d.region"
+    )
+    plan = _plan(engine, sql)
+    assert plan.rule_stats.get("AggregateJoinTranspose", 0) == 0
+
+
+def test_agg_join_transpose_skips_right_side_agg_arg(join_engine):
+    """An aggregation argument from the BUILD side cannot push to the probe
+    side; the rule must leave the plan alone (and results stay right)."""
+    engine, fdf, ddf = join_engine
+    sql = (
+        "SELECT f.nation, COUNT(d.region) FROM fact f "
+        "JOIN dim d ON f.nation = d.dnation GROUP BY f.nation ORDER BY f.nation"
+    )
+    plan = _plan(engine, sql)
+    assert plan.rule_stats.get("AggregateJoinTranspose", 0) == 0
+    res = engine.execute(sql)
+    m = fdf.merge(ddf, left_on="nation", right_on="dnation")
+    g = m.groupby("nation").region.count().sort_index()
+    assert [(r[0], r[1]) for r in res.rows] == [(k, int(v)) for k, v in g.items()]
+
+
+def test_agg_join_transpose_cardinality_gate(join_engine):
+    """A near-unique pushed key must NOT transpose: partial-aggregating by
+    it collapses nothing (rev NDV ~ row count), so the gate holds the
+    original plan [cost-gated like Calcite's AggregateJoinTransposeRule]."""
+    engine, fdf, ddf = join_engine
+    sql = (
+        "SELECT d.region, SUM(f.qty) FROM fact f "
+        "JOIN dim d ON f.rev = d.dnation GROUP BY d.region"
+    )
+    # rev is a 10k-NDV metric joined against a string dim key: the join is
+    # nonsensical semantically but planner-valid; only the gate matters
+    plan = _plan(engine, sql)
+    assert plan.rule_stats.get("AggregateJoinTranspose", 0) == 0
+
+
+def test_agg_join_transpose_fails_closed_without_ndv(join_engine):
+    """No catalog NDV (hand-built Catalog) -> the rule must not fire."""
+    from pinot_tpu.query.sql import parse_sql
+
+    engine, fdf, ddf = join_engine
+    cols = {t: list(segs[0].schema.columns) for t, segs in engine.catalog.items()}
+    rows = {t: sum(s.n_docs for s in segs) for t, segs in engine.catalog.items()}
+    cat = L.Catalog(cols, row_counts=rows)  # ndv absent
+    sql = (
+        "SELECT d.region, SUM(f.rev) FROM fact f "
+        "JOIN dim d ON f.nation = d.dnation GROUP BY d.region"
+    )
+    plan = L.build_stage_plan(parse_sql(sql), cat, n_workers=2)
+    assert plan.rule_stats.get("AggregateJoinTranspose", 0) == 0
