@@ -267,11 +267,78 @@ def test_protobuf_reader_gated_message_cls(tmp_path):
         ProtobufRecordReader(tmp_path / "x.pb")
 
 
-def test_thrift_reader_gated(tmp_path):
+def test_thrift_reader_requires_field_map(tmp_path):
     from pinot_tpu.io.readers import ThriftRecordReader
 
-    with pytest.raises(ImportError, match="thriftpy2"):
+    with pytest.raises(ValueError, match="field_map"):
         ThriftRecordReader(tmp_path / "x.thrift")
+
+
+def test_thrift_reader_decodes_binary_protocol(tmp_path):
+    """Clean-room TBinaryProtocol: hand-encoded back-to-back structs with
+    every scalar wire type, a list, a map, and a nested struct decode into
+    rows; read_columns promotes numerics."""
+    import struct
+
+    from pinot_tpu.io.readers import ThriftRecordReader
+
+    def enc_field(ftype, fid, payload):
+        return struct.pack(">bh", ftype, fid) + payload
+
+    def enc_string(s):
+        b = s.encode()
+        return struct.pack(">i", len(b)) + b
+
+    def enc_struct(fields):
+        return b"".join(fields) + b"\x00"
+
+    rec1 = enc_struct([
+        enc_field(10, 1, struct.pack(">q", 123456789012)),       # I64 uid
+        enc_field(11, 2, enc_string("alice")),                    # STRING name
+        enc_field(4, 3, struct.pack(">d", 2.5)),                  # DOUBLE score
+        enc_field(2, 4, b"\x01"),                                # BOOL active
+        enc_field(8, 5, struct.pack(">i", -7)),                   # I32 delta
+        enc_field(15, 6, struct.pack(">bi", 8, 2)                 # LIST<i32>
+                  + struct.pack(">i", 10) + struct.pack(">i", 20)),
+        enc_field(13, 7, struct.pack(">bbi", 11, 8, 1)            # MAP<str,i32>
+                  + enc_string("k") + struct.pack(">i", 5)),
+        enc_field(12, 8, enc_struct([enc_field(6, 1, struct.pack(">h", 3))])),  # STRUCT
+    ])
+    rec2 = enc_struct([
+        enc_field(10, 1, struct.pack(">q", 42)),
+        enc_field(11, 2, enc_string("bob")),
+        enc_field(4, 3, struct.pack(">d", -1.25)),
+        enc_field(2, 4, b"\x00"),
+        enc_field(8, 5, struct.pack(">i", 9)),
+    ])
+    path = tmp_path / "rows.thrift"
+    path.write_bytes(rec1 + rec2)
+    fmap = {1: "uid", 2: "name", 3: "score", 4: "active", 5: "delta",
+            6: "tags", 7: "attrs", 8: "sub"}
+    rows = list(ThriftRecordReader(path, field_map=fmap))
+    assert rows[0]["uid"] == 123456789012 and rows[0]["name"] == "alice"
+    assert rows[0]["score"] == 2.5 and rows[0]["active"] is True
+    assert rows[0]["tags"] == [10, 20] and rows[0]["attrs"] == {"k": 5}
+    assert rows[0]["sub"] == {1: 3}
+    assert rows[1] == {"uid": 42, "name": "bob", "score": -1.25,
+                       "active": False, "delta": 9}
+
+
+def test_thrift_reader_field_map_from_thrift_spec(tmp_path):
+    import struct
+
+    from pinot_tpu.io.readers import ThriftRecordReader
+
+    class FakeThrift:
+        # thriftpy2-style: dict {fid: (ttype, name, ...)}
+        thrift_spec = {1: (10, "uid", False), 2: (11, "name", False)}
+
+    rec = struct.pack(">bh", 10, 1) + struct.pack(">q", 7) \
+        + struct.pack(">bh", 11, 2) + struct.pack(">i", 2) + b"hi" + b"\x00"
+    path = tmp_path / "one.thrift"
+    path.write_bytes(rec)
+    rows = list(ThriftRecordReader(path, thrift_cls=FakeThrift))
+    assert rows == [{"uid": 7, "name": "hi"}]
 
 
 def test_clp_ingestion_to_segment(tmp_path):
@@ -354,3 +421,44 @@ def test_distributed_job_local_output(tmp_path):
 
     engine = QueryEngine([load_segment(d) for d in dirs])
     assert engine.execute("SELECT COUNT(*) FROM events").rows[0][0] == 32
+
+
+def test_thrift_reader_apache_style_tuple_spec(tmp_path):
+    import struct
+
+    from pinot_tpu.io.readers import ThriftRecordReader
+
+    class ApacheThrift:
+        # Apache Thrift generated shape: (None, (fid, ttype, name, ...), ...)
+        thrift_spec = (None, (1, 10, "uid", None, None), (2, 11, "name", None, None))
+
+    rec = struct.pack(">bh", 10, 1) + struct.pack(">q", 9) \
+        + struct.pack(">bh", 11, 2) + struct.pack(">i", 2) + b"ok" + b"\x00"
+    path = tmp_path / "apache.thrift"
+    path.write_bytes(rec)
+    assert list(ThriftRecordReader(path, thrift_cls=ApacheThrift)) == [{"uid": 9, "name": "ok"}]
+
+
+def test_thrift_reader_corrupt_lengths_fail_loudly(tmp_path):
+    import struct
+
+    from pinot_tpu.io.readers import ThriftRecordReader
+
+    # negative string length must raise, not loop backwards forever
+    bad = struct.pack(">bh", 11, 1) + struct.pack(">i", -5) + b"\x00"
+    p1 = tmp_path / "neg.thrift"
+    p1.write_bytes(bad)
+    with pytest.raises(ValueError, match="corrupt"):
+        list(ThriftRecordReader(p1, field_map={1: "s"}))
+    # oversized length (points past EOF) must raise, not truncate silently
+    bad2 = struct.pack(">bh", 11, 1) + struct.pack(">i", 1 << 20) + b"hi"
+    p2 = tmp_path / "big.thrift"
+    p2.write_bytes(bad2)
+    with pytest.raises(ValueError, match="corrupt"):
+        list(ThriftRecordReader(p2, field_map={1: "s"}))
+    # struct missing its STOP byte must raise
+    bad3 = struct.pack(">bh", 10, 1) + struct.pack(">q", 5)
+    p3 = tmp_path / "trunc.thrift"
+    p3.write_bytes(bad3)
+    with pytest.raises(ValueError, match="corrupt|truncated"):
+        list(ThriftRecordReader(p3, field_map={1: "v"}))
