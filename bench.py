@@ -395,8 +395,9 @@ def main():
     # tunnel's ~64ms per-query round-trip floor (at 4M rows the floor alone
     # caps config-1-style queries below CPU parity)
     n = int(os.environ.get("PINOT_TPU_BENCH_ROWS", 16_000_000))
-    if init_err and n > 1_000_000:
-        # bound the *fallback* round only; a deliberate CPU run keeps the knob
+    if init_err and "PINOT_TPU_BENCH_ROWS" not in os.environ:
+        # bound the *fallback* round only; a deliberate CPU run keeps the
+        # knob by setting the env explicitly (same contract as SCALE_ROWS)
         log(f"TPU-init fallback: clamping rows {n} -> 1000000")
         n = 1_000_000
     iters = int(os.environ.get("PINOT_TPU_BENCH_ITERS", 7))
