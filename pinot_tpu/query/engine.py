@@ -19,7 +19,7 @@ import pandas as pd
 
 from pinot_tpu.query import ast, host_exec, reduce as reduce_mod
 from pinot_tpu.query.context import QueryContext, QueryType
-from pinot_tpu.query.kernels import run_plan_packed
+from pinot_tpu.query.kernels import dispatch_plan_packed
 from pinot_tpu.query.plan import DeviceFallback, SegmentPlan, plan_segment
 from pinot_tpu.query.result import ResultTable
 from pinot_tpu.query.sql import parse_sql
@@ -124,29 +124,51 @@ class QueryEngine:
     def partials(self, ctx: QueryContext, segments: list[ImmutableSegment] | None = None):
         """Server-side half: per-segment partials + matched doc count.
         (ServerQueryExecutorV1Impl role; the broker reduce consumes these.)"""
+        pend, pruned = self._dispatch_all(ctx, segments)
+        return self._resolve_partials(ctx, pend, pruned)
+
+    def _dispatch_all(self, ctx: QueryContext, segments=None):
+        """Prune + enqueue every segment's device program (non-blocking for
+        the fused path; host fallbacks run inline). The ONE dispatch loop
+        shared by partials()/submit()/execute()."""
         from pinot_tpu.common.accounting import default_accountant
-        from pinot_tpu.common.metrics import ServerMeter, server_metrics
-        from pinot_tpu.common.trace import InvocationScope
         from pinot_tpu.query import pruner
 
-        out = []
-        scanned = 0
+        pend: list = []
         pruned = 0
         for seg in self.segments if segments is None else segments:
             default_accountant.checkpoint()
             if not pruner.can_match(seg, ctx):
                 # bloom/min-max pruned: contribute a canonical empty partial
-                out.append(pruner.empty_partial(ctx))
+                pend.append((seg, ("pruned", pruner.empty_partial(ctx))))
                 pruned += 1
+            else:
+                pend.append((seg, self._dispatch_segment(seg, ctx)))
+        return pend, pruned
+
+    def _resolve_partials(self, ctx: QueryContext, pend: list, pruned: int):
+        """Sync + convert every pending dispatch; per-segment accounting
+        checkpoint (the QueryKilledError enforcement point), tracing scope,
+        byte sampling, and segment meters — the ONE resolve loop."""
+        from pinot_tpu.common.accounting import default_accountant
+        from pinot_tpu.common.metrics import ServerMeter, server_metrics
+        from pinot_tpu.common.trace import InvocationScope
+
+        out = []
+        scanned = 0
+        for seg, disp in pend:
+            if disp[0] == "pruned":
+                out.append(disp[1])  # no scan, no sample
                 continue
+            default_accountant.checkpoint()
             with InvocationScope(f"segment:{seg.name}") as scope:
-                partial, matched = self._execute_segment(seg, ctx)
-                scope.set_attr("numDocsMatched", matched)
+                partial, matched = self._finish_segment(seg, ctx, disp)
+                scope.set_attr("numDocsMatched", int(matched))
             default_accountant.sample(segments=1, allocated_bytes=seg.size_bytes)
             out.append(partial)
-            scanned += matched
+            scanned += int(matched)
         m = server_metrics()
-        m.meter(ServerMeter.NUM_SEGMENTS_QUERIED).mark(len(out) - pruned)
+        m.meter(ServerMeter.NUM_SEGMENTS_QUERIED).mark(len(pend) - pruned)
         if pruned:
             m.meter(ServerMeter.NUM_SEGMENTS_PRUNED).mark(pruned)
         return out, scanned
@@ -208,20 +230,40 @@ class QueryEngine:
         return ResultTable(columns=["Operator", "Operator_Id", "Parent_Id"], rows=rows)
 
     def execute(self, sql: str) -> ResultTable:
+        """Synchronous execute = submit + immediate resolve (one code path,
+        same per-segment accounting/tracing/meters either way)."""
+        return self.submit(sql)()
+
+    def submit(self, sql: str):
+        """Asynchronous submit (QueryScheduler.submit ListenableFuture
+        parity, core/query/scheduler/QueryScheduler.java): plans the query
+        and ENQUEUES every per-segment device program without the
+        device->host sync (jax dispatch is non-blocking; see
+        kernels.dispatch_plan_packed), returning a zero-argument resolve()
+        that performs the syncs, broker reduce, and ResultTable build.
+        Dispatching several queries before resolving any overlaps their
+        device round trips — on a high-RTT link N in-flight queries share
+        the link instead of paying N serial syncs. execute() is exactly
+        submit()() — one path, same instrumentation."""
         t0 = time.perf_counter()
         ctx = self.make_context(sql)
         if getattr(ctx.statement, "explain", False):
-            return self.explain(ctx)
-        partials, scanned = self.partials(ctx)
-        rows = self.reduce(ctx, partials)
-        return reduce_mod.build_result(
-            ctx,
-            rows,
-            num_docs_scanned=int(scanned),
-            total_docs=sum(s.n_docs for s in self.segments),
-            num_segments_queried=len(self.segments),
-            time_used_ms=(time.perf_counter() - t0) * 1e3,
-        )
+            return lambda: self.explain(ctx)
+        pend, pruned = self._dispatch_all(ctx)
+
+        def resolve() -> ResultTable:
+            partials, scanned = self._resolve_partials(ctx, pend, pruned)
+            rows = self.reduce(ctx, partials)
+            return reduce_mod.build_result(
+                ctx,
+                rows,
+                num_docs_scanned=int(scanned),
+                total_docs=sum(s.n_docs for s in self.segments),
+                num_segments_queried=len(self.segments),
+                time_used_ms=(time.perf_counter() - t0) * 1e3,
+            )
+
+        return resolve
 
     # ------------------------------------------------------------------
 
@@ -254,6 +296,15 @@ class QueryEngine:
 
     def _execute_segment(self, seg: ImmutableSegment, ctx: QueryContext):
         """Returns (partial, matched_docs) for one segment."""
+        return self._finish_segment(seg, ctx, self._dispatch_segment(seg, ctx))
+
+    def _dispatch_segment(self, seg: ImmutableSegment, ctx: QueryContext):
+        """Async half of segment execution: plan + ENQUEUE the fused device
+        program without any device->host sync. Returns ("ready", partial,
+        matched) when the segment resolved host-side (star-tree swap, host
+        fallback), else ("dev", plan, out) with `out` still in flight —
+        _finish_segment performs the sync. Splitting here is what lets
+        submit() overlap the device round trips of multiple queries."""
         valid = seg.extras.get("valid_docs")
         from pinot_tpu.query.context import null_handling_enabled
 
@@ -270,15 +321,22 @@ class QueryEngine:
 
             res = startree_exec.try_execute(self, seg, ctx)
             if res is not None:
-                return res
+                return ("ready",) + res
         vmask = valid(seg.n_docs) if valid is not None else None
         try:
             # plan_segment threads valid_docs into the kernel as a docmask
             # operand, so upsert tables run the fused device path too
             plan = plan_segment(seg, ctx, valid_mask=vmask)
         except DeviceFallback:
-            return self._host_segment(seg, ctx, extra_mask=vmask)
-        out = run_plan_packed(plan, self._device_seg(seg))
+            return ("ready",) + self._host_segment(seg, ctx, extra_mask=vmask)
+        return ("dev", plan, dispatch_plan_packed(plan, self._device_seg(seg)), vmask)
+
+    def _finish_segment(self, seg: ImmutableSegment, ctx: QueryContext, disp):
+        """Sync half: convert an in-flight dispatch to (partial, matched)."""
+        if disp[0] == "ready":
+            return disp[1], disp[2]
+        _, plan, unpack, vmask = disp
+        out = unpack()  # the one device->host sync for this segment
         qt = ctx.query_type
         if qt == QueryType.AGGREGATION:
             matched, parts = out

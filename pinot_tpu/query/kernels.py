@@ -825,9 +825,13 @@ def _plan_inputs(plan, device_segment):
     return cols, ops
 
 
-def run_plan_packed(plan, device_segment):
-    """run_plan variant returning host numpy outputs via ONE device->host
-    transfer (see get_packed_kernel)."""
+def dispatch_plan_packed(plan, device_segment):
+    """Async half of run_plan_packed: ENQUEUE the packed kernel (jax
+    dispatch is non-blocking) and return a zero-arg unpack() that performs
+    the single device->host transfer and re-inflates the output tree. A
+    caller overlapping several queries dispatches all of them first, then
+    unpacks — N in-flight programs share the link instead of syncing N
+    times."""
     kernel = get_packed_kernel(plan.spec)
     cols, ops = _plan_inputs(plan, device_segment)
     vec = kernel(cols, ops, np.int32(device_segment.n_docs), device_segment.padded)
@@ -837,23 +841,33 @@ def run_plan_packed(plan, device_segment):
         tuple((tuple(np.shape(o)), str(np.dtype(o.dtype))) for o in ops),
         device_segment.padded,
     )
-    vec = np.asarray(vec)
-    out = []
-    i = 0
-    for shape, dtype in leaf_meta:
-        size = int(np.prod(shape, dtype=np.int64)) if shape else 1
-        if dtype == np.int64:
-            hi = vec[i : i + size]
-            lo = vec[i + size : i + 2 * size]
-            i += 2 * size
-            chunk = (hi.astype(np.int64) << 32) + lo.astype(np.int64)
-        else:
-            chunk = vec[i : i + size]
-            i += size
-            if dtype != np.float64:
-                chunk = chunk.astype(dtype)
-        out.append(chunk.reshape(shape))
-    return jax.tree.unflatten(treedef, out)
+
+    def unpack():
+        v = np.asarray(vec)  # THE device->host sync
+        out = []
+        i = 0
+        for shape, dtype in leaf_meta:
+            size = int(np.prod(shape, dtype=np.int64)) if shape else 1
+            if dtype == np.int64:
+                hi = v[i : i + size]
+                lo = v[i + size : i + 2 * size]
+                i += 2 * size
+                chunk = (hi.astype(np.int64) << 32) + lo.astype(np.int64)
+            else:
+                chunk = v[i : i + size]
+                i += size
+                if dtype != np.float64:
+                    chunk = chunk.astype(dtype)
+            out.append(chunk.reshape(shape))
+        return jax.tree.unflatten(treedef, out)
+
+    return unpack
+
+
+def run_plan_packed(plan, device_segment):
+    """run_plan variant returning host numpy outputs via ONE device->host
+    transfer (see get_packed_kernel)."""
+    return dispatch_plan_packed(plan, device_segment)()
 
 
 def run_plan(plan, device_segment):
