@@ -291,7 +291,7 @@ def _hash_partition(keydf: pd.DataFrame, n: int) -> np.ndarray:
 DEVICE_SORT_MIN = 1 << 16
 DEVICE_JOIN_MIN = 1 << 16
 
-DEVICE_OP_STATS = {"sort": 0, "join": 0}
+DEVICE_OP_STATS = {"sort": 0, "join": 0, "window": 0}
 
 
 def sorted_frame(df: pd.DataFrame, by: list, descs: list[bool], reset_index: bool = False) -> pd.DataFrame:
@@ -332,6 +332,57 @@ def _device_sort_perm(keys: list[np.ndarray], descs: list[bool]) -> "np.ndarray 
     perm = jnp.lexsort(tuple(jnp.asarray(k) for k in reversed(prepped)))
     DEVICE_OP_STATS["sort"] += 1
     return np.asarray(perm)
+
+
+def _device_window_cum(fname: str, gk: np.ndarray, v: "np.ndarray | None", n: int) -> "np.ndarray | None":
+    """Segmented cumulative window aggregate on device (rows pre-sorted by
+    (partition, order), so partitions are contiguous): one associative
+    segmented scan — combine((f1,v1),(f2,v2)) = (f1|f2, f2 ? v2 : op(v1,v2))
+    with f = partition-start flags — computes running SUM/MIN/MAX/COUNT with
+    reset at every partition boundary (WindowAggregateOperator parity for
+    the default UNBOUNDED PRECEDING..CURRENT ROW frame). Returns None below
+    the size threshold or for non-numeric / NaN inputs (pandas skipna
+    cumulative semantics differ) — the pandas path takes over."""
+    if n < DEVICE_SORT_MIN or fname not in ("sum", "avg", "count", "min", "max", "row_number"):
+        return None
+    if v is not None:
+        if not np.issubdtype(v.dtype, np.number):
+            return None
+        if np.issubdtype(v.dtype, np.floating) and np.isnan(v).any():
+            return None
+    import jax
+    import jax.numpy as jnp
+
+    gk_d = jnp.asarray(gk)
+    start = jnp.concatenate([jnp.ones(1, bool), gk_d[1:] != gk_d[:-1]])
+
+    def seg_scan(op, vals):
+        def comb(a, b):
+            af, av = a
+            bf, bv = b
+            return (af | bf, jnp.where(bf, bv, op(av, bv)))
+
+        _, out = jax.lax.associative_scan(comb, (start, vals))
+        return out
+
+    add = jnp.add
+    if fname in ("row_number", "count"):
+        out = seg_scan(add, jnp.ones(n, jnp.int64))
+    elif fname == "sum":
+        # integer values upcast to int64 exactly like pandas groupby.cumsum
+        # (an int32 running sum would wrap past 2^31 on the device otherwise)
+        vv = jnp.asarray(v, jnp.int64) if np.issubdtype(v.dtype, np.integer) else jnp.asarray(v)
+        out = seg_scan(add, vv)
+    elif fname == "avg":
+        s = seg_scan(add, jnp.asarray(v, jnp.float64))
+        c = seg_scan(add, jnp.ones(n, jnp.float64))
+        out = s / c
+    elif fname == "min":
+        out = seg_scan(jnp.minimum, jnp.asarray(v))
+    else:
+        out = seg_scan(jnp.maximum, jnp.asarray(v))
+    DEVICE_OP_STATS["window"] += 1
+    return np.asarray(out)
 
 
 #: pair-count blowup guard for device equi-joins (many-to-many keys)
@@ -1287,8 +1338,17 @@ def _exec_window(node: L.WindowNode, ctx: RunCtx) -> pd.DataFrame:
                 g = sf.groupby(gname)
             else:
                 g = sf.groupby(pnames, dropna=False)
-            rn = g.cumcount() + 1
-            if fname == "row_number":
+            dres = None
+            if fname == "row_number" or fname in _WINDOW_AGGS:
+                # the cumulative scan rides the device as one segmented
+                # associative scan when the block is large and numeric
+                # (NaN/object values fall back inside _device_window_cum)
+                _v = sf["v"].to_numpy() if "v" in sf else None
+                dres = _device_window_cum(fname, g.ngroup().to_numpy(), _v, len(sf))
+            rn = None if dres is not None else g.cumcount() + 1
+            if dres is not None:
+                res = pd.Series(dres, index=sf.index)
+            elif fname == "row_number":
                 res = rn
             elif fname in ("rank", "dense_rank"):
                 first = rn == 1

@@ -317,3 +317,80 @@ def test_join_cross_dtype_numeric_keys_match():
     lk2 = pd.DataFrame({"k": pd.Series([1, 2], dtype=object)})
     rk2 = pd.DataFrame({"k": pd.Series(["1", "2"], dtype=object)})
     assert _encode_join_keys(lk2, rk2, np.zeros(2, bool), np.zeros(2, bool)) is None
+
+
+# -- device window cumulatives (segmented associative scan) -------------------
+
+
+@pytest.mark.parametrize(
+    "fn,pd_fn",
+    [
+        ("SUM", lambda g: g.cumsum()),
+        ("MIN", lambda g: g.cummin()),
+        ("MAX", lambda g: g.cummax()),
+        ("COUNT", None),
+        ("AVG", None),
+    ],
+)
+def test_device_window_cumulative_matches_pandas(setup, fn, pd_fn):
+    engine, fdf, ddf = setup
+    before = runtime.DEVICE_OP_STATS["window"]
+    arg = "*" if fn == "COUNT" else "val"
+    res = engine.execute(
+        f"SELECT fid, {fn}({arg}) OVER (PARTITION BY fdid ORDER BY fid) FROM fact ORDER BY fid LIMIT 5000"
+    )
+    assert runtime.DEVICE_OP_STATS["window"] > before  # device scan engaged
+    s = fdf.sort_values("fid")
+    g = s.groupby("fdid").val
+    if fn == "COUNT":
+        want = s.groupby("fdid").fid.transform(lambda x: np.arange(1, len(x) + 1))
+    elif fn == "AVG":
+        want = g.cumsum() / s.groupby("fdid").fid.transform(lambda x: np.arange(1, len(x) + 1))
+    else:
+        want = pd_fn(g)
+    want = want.reindex(s.index)
+    got = {r[0]: r[1] for r in res.rows}
+    for fid, w in zip(s.fid, want):
+        assert got[fid] == pytest.approx(float(w)), (fn, fid)
+
+
+def test_device_window_row_number(setup):
+    engine, fdf, ddf = setup
+    before = runtime.DEVICE_OP_STATS["window"]
+    res = engine.execute(
+        "SELECT fid, ROW_NUMBER() OVER (PARTITION BY fdid ORDER BY val DESC, fid) FROM fact ORDER BY fid LIMIT 5000"
+    )
+    assert runtime.DEVICE_OP_STATS["window"] > before
+    s = fdf.sort_values(["val", "fid"], ascending=[False, True])
+    want = s.groupby("fdid").cumcount() + 1
+    got = {r[0]: r[1] for r in res.rows}
+    for fid, w in zip(s.fid, want):
+        assert got[fid] == int(w)
+
+
+def test_window_rank_stays_host_and_correct(setup):
+    """rank/dense_rank keep the pandas tie logic — no device stat, right
+    answers."""
+    engine, fdf, ddf = setup
+    before = runtime.DEVICE_OP_STATS["window"]
+    res = engine.execute(
+        "SELECT fid, RANK() OVER (PARTITION BY fdid ORDER BY val) FROM fact ORDER BY fid LIMIT 5000"
+    )
+    assert runtime.DEVICE_OP_STATS["window"] == before
+    s = fdf.sort_values("val")
+    want = s.groupby("fdid").val.rank(method="min").astype(int)
+    got = {r[0]: r[1] for r in res.rows}
+    for fid, w in zip(s.fid, want):
+        assert got[fid] == int(w)
+
+
+def test_device_window_sum_int32_does_not_wrap(monkeypatch):
+    """int32 values upcast to int64 in the device running sum, matching
+    pandas groupby.cumsum — no wrap past 2^31."""
+    monkeypatch.setattr(runtime, "DEVICE_SORT_MIN", 4)
+    n = 64
+    gk = np.zeros(n, dtype=np.int64)
+    v = np.full(n, 2**30, dtype=np.int32)
+    out = runtime._device_window_cum("sum", gk, v, n)
+    assert out is not None
+    assert out[-1] == n * 2**30  # 2^36: far past int32 range
