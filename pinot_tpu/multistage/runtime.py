@@ -310,6 +310,22 @@ def sorted_frame(df: pd.DataFrame, by: list, descs: list[bool], reset_index: boo
     return out.reset_index(drop=True) if reset_index else out
 
 
+def _device_scan_economical(
+    ship_bytes: int, readback_bytes: int, host_cost_s: float, round_trips: int = 2
+) -> bool:
+    """THE economic gate for device intermediate ops that ship whole columns
+    and read results back (sort perms, window scans, join probes): the
+    modeled link cost must beat the host cost. On a co-located chip the link
+    moves GB/s and the gate always passes above the size thresholds; on a
+    tunneled attachment (~tens of ms RTT, ~15MB/s) it correctly declines —
+    the AdaptiveServerSelector philosophy applied to the accelerator link.
+    Callers must run their cheap dtype/shape rejections FIRST: pricing the
+    link triggers the one-time devlink probe (~2 RTTs + 8MB)."""
+    from pinot_tpu.common.devlink import transfer_cost_s
+
+    return transfer_cost_s(ship_bytes + readback_bytes, round_trips=round_trips) <= host_cost_s
+
+
 def _device_sort_perm(keys: list[np.ndarray], descs: list[bool]) -> "np.ndarray | None":
     """Stable multi-key sort permutation computed on device (lax.sort under
     jnp.lexsort). Returns None when a key is non-numeric or float-with-NaN
@@ -328,6 +344,11 @@ def _device_sort_perm(keys: list[np.ndarray], descs: list[bool]) -> "np.ndarray 
             prepped.append(-v if desc else v)
         else:
             prepped.append(~v if desc else v)
+    n = len(keys[0]) if keys else 0
+    ship = sum(k.nbytes for k in keys)
+    # host mergesort ~ 150ns/row/key; perm readback is one int64 vector
+    if not _device_scan_economical(ship, 8 * n, 150e-9 * n * max(1, len(keys)) + 2e-3):
+        return None
     # jnp.lexsort: LAST key is primary -> reverse significance order
     perm = jnp.lexsort(tuple(jnp.asarray(k) for k in reversed(prepped)))
     DEVICE_OP_STATS["sort"] += 1
@@ -350,6 +371,10 @@ def _device_window_cum(fname: str, gk: np.ndarray, v: "np.ndarray | None", n: in
             return None
         if np.issubdtype(v.dtype, np.floating) and np.isnan(v).any():
             return None
+    # host groupby-cumsum ~ 80ns/row; ship keys+values, read one vector back
+    ship = gk.nbytes + (v.nbytes if v is not None else 0)
+    if not _device_scan_economical(ship, 8 * n, 80e-9 * n + 2e-3):
+        return None
     import jax
     import jax.numpy as jnp
 
@@ -482,12 +507,9 @@ def _encode_join_keys(
 def _device_join_economical(lk: np.ndarray, rk: np.ndarray) -> bool:
     """Whether shipping both key vectors plus the per-row index readback over
     the measured device link beats a host hash join (~70ns/input row)."""
-    from pinot_tpu.common.devlink import transfer_cost_s
-
-    ship = lk.nbytes + rk.nbytes
     readback = 8 * len(lk)  # lo + count index vectors, int32 each
     host_cost = 70e-9 * (len(lk) + len(rk)) + 2e-3
-    return transfer_cost_s(ship + readback, round_trips=8) <= host_cost
+    return _device_scan_economical(lk.nbytes + rk.nbytes, readback, host_cost, round_trips=8)
 
 
 def _device_equi_join(

@@ -394,3 +394,22 @@ def test_device_window_sum_int32_does_not_wrap(monkeypatch):
     out = runtime._device_window_cum("sum", gk, v, n)
     assert out is not None
     assert out[-1] == n * 2**30  # 2^36: far past int32 range
+
+
+def test_economic_gate_declines_on_tunnel_link(monkeypatch):
+    """With a tunnel-like measured link (70ms RTT, 15MB/s) the sort and
+    window device paths must decline — per-row shipping loses to host
+    compute there (devlink gate, AdaptiveServerSelector philosophy)."""
+    from pinot_tpu.common import devlink
+
+    monkeypatch.setattr(devlink, "_profile", (0.07, 15e6))
+    n = 100_000
+    keys = [np.arange(n, dtype=np.int64)]
+    assert runtime._device_sort_perm(keys, [False]) is None
+    gk = np.zeros(n, dtype=np.int64)
+    v = np.ones(n, dtype=np.int64)
+    assert runtime._device_window_cum("sum", gk, v, n) is None
+    # a local-speed link accepts the same shapes
+    monkeypatch.setattr(devlink, "_profile", (1e-4, 5e9))
+    assert runtime._device_sort_perm(keys, [False]) is not None
+    assert runtime._device_window_cum("sum", gk, v, n) is not None
