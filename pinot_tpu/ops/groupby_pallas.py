@@ -315,6 +315,89 @@ def _planes_impl(gid, planes, ng: int, r: int):
 SAFE_DOCS = (2**31 - 2**24) // 255
 
 
+# -- two-level byte-plane kernel: gid = hi*G2 + lo ---------------------------
+#
+# The flat one-hot kernel's dot is (r x chunk) @ (chunk x gtile): M = r = 8
+# plane rows against the MXU's 128-row tile (~6% row utilization). The
+# two-level form scales each of G2=128 lo-one-hot rows by every plane row,
+# giving L[(p*G2+l), c] = plane_p[c] * (lo[c]==l), then contracts against
+# the hi-one-hot: (r*G2 x chunk) @ (chunk x G1) with G1 = ng_pad/G2 — a full
+# 1024-row M dimension doing IDENTICAL total MACs. The elementwise build of
+# L costs only r*G2*chunk VPU ops per step (no G1 factor), so it does not
+# cancel the MXU win. Same exactness invariant: products <= 255, per-chunk
+# dots < 2^24 in f32, int32 cross-chunk accumulation.
+
+G2 = 128  # lo-width: one MXU/VPU lane tile
+
+
+@functools.lru_cache(maxsize=None)
+def _make_planes2_kernel(r: int, g1tile: int, chunk: int):
+    from jax.experimental import pallas as pl
+
+    def kernel(gid_ref, planes_ref, out_ref):
+        ci = pl.program_id(1)
+        gi = pl.program_id(0)
+
+        @pl.when(ci == 0)
+        def _():
+            out_ref[:] = jnp.zeros_like(out_ref)
+
+        gid = gid_ref[0, :]
+        lo = gid & (G2 - 1)
+        hi = gid >> (G2.bit_length() - 1)
+        planes = planes_ref[:].astype(jnp.bfloat16)  # (r, chunk)
+        onehot_lo = (
+            jax.lax.broadcasted_iota(jnp.int32, (G2, chunk), 0) == lo[None, :]
+        ).astype(jnp.bfloat16)
+        left = (planes[:, None, :] * onehot_lo[None, :, :]).reshape(r * G2, chunk)
+        base = gi * g1tile
+        onehot_hi = (
+            hi[:, None] == (base + jax.lax.broadcasted_iota(jnp.int32, (chunk, g1tile), 1))
+        ).astype(jnp.bfloat16)
+        acc = jnp.dot(left, onehot_hi, preferred_element_type=jnp.float32)
+        out_ref[:] = out_ref[:] + acc.astype(jnp.int32)
+
+    return kernel
+
+
+@functools.partial(jax.jit, static_argnames=("ng", "r"))
+def _planes2_impl(gid, planes, ng: int, r: int):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    n_padded = gid.shape[0]
+    g1 = -(-ng // G2)
+    # lane-tile floor: the MXU N dimension is 128-wide — a narrower block
+    # pads internally and wastes columns (same constraint the module-load
+    # guards enforce on CHUNK/GTILE)
+    g1tile = min(256, max(128, -(-g1 // 128) * 128))
+    g1_pad = -(-g1 // g1tile) * g1tile
+    out = pl.pallas_call(
+        _make_planes2_kernel(r, g1tile, PLANES_CHUNK),
+        grid=(g1_pad // g1tile, n_padded // PLANES_CHUNK),
+        in_specs=[
+            pl.BlockSpec((1, PLANES_CHUNK), lambda g, c: (jnp.int32(0), c), memory_space=pltpu.VMEM),
+            pl.BlockSpec((r, PLANES_CHUNK), lambda g, c: (jnp.int32(0), c), memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec(
+            (r * G2, g1tile), lambda g, c: (jnp.int32(0), g), memory_space=pltpu.VMEM
+        ),
+        out_shape=jax.ShapeDtypeStruct((r * G2, g1_pad), jnp.int32),
+        interpret=_interpret(),
+    )(gid.reshape(1, n_padded), planes)
+    # out[(p*G2 + l), h] holds group h*G2+l: -> (r, G2, g1_pad) -> (r, ng)
+    cube = out.reshape(r, G2, g1_pad)
+    flat = jnp.transpose(cube, (0, 2, 1)).reshape(r, g1_pad * G2)
+    return flat[:, :ng]
+
+
+def planes_v2_enabled() -> bool:
+    """Two-level kernel opt-in/out: PINOT_TPU_PALLAS_V2=1 forces on, =0 off.
+    Default OFF until an on-chip A/B flips it (the flat kernel is the
+    measured-on-hardware baseline)."""
+    return os.environ.get("PINOT_TPU_PALLAS_V2", "0") == "1"
+
+
 def pallas_grouped_multi_sum(values_list, gid, mask, ng: int):
     """Fused lossless group-by reduction: byte-plane sums for every int32
     value array plus the group count, in ONE pallas pass. Returns
@@ -344,7 +427,8 @@ def pallas_grouped_multi_sum(values_list, gid, mask, ng: int):
     r = -(-len(rows) // 8) * 8  # pad plane rows to the f32 sublane tile
     while len(rows) < r:
         rows.append(jnp.zeros((n_padded,), jnp.float32))
-    out = _planes_impl(gid, jnp.stack(rows), ng, r)
+    impl = _planes2_impl if planes_v2_enabled() else _planes_impl
+    out = impl(gid, jnp.stack(rows), ng, r)
     sums = []
     for i in range(k):
         p = out[4 * i : 4 * i + 4, :ng].astype(jnp.float64)

@@ -175,3 +175,30 @@ def test_blocked_multi_sum_past_safe_docs(monkeypatch):
     np.add.at(tc, np.asarray(g), np.asarray(m).astype(np.int64))
     assert np.allclose(np.asarray(sums[0]), truth)
     assert np.array_equal(np.asarray(counts), tc)
+
+
+def test_two_level_planes_kernel_matches_flat(monkeypatch):
+    """PINOT_TPU_PALLAS_V2 two-level (hi/lo) byte-plane kernel is exact and
+    identical to the flat kernel across group counts that do / don't divide
+    G2, including multi-value fusion."""
+    import os
+
+    import jax.numpy as jnp
+
+    from pinot_tpu.ops import groupby_pallas as gp
+
+    rng = np.random.default_rng(8)
+    for n, ng, k in [(8192, 130, 2), (12288, 3125, 1), (4096, 64, 1)]:
+        gid = jnp.asarray(rng.integers(0, ng, n).astype(np.int32))
+        vals = [jnp.asarray(rng.integers(-50000, 50000, n).astype(np.int32)) for _ in range(k)]
+        mask = jnp.asarray(rng.random(n) < 0.8)
+        monkeypatch.setenv("PINOT_TPU_PALLAS_V2", "0")
+        s1, c1 = gp.pallas_grouped_multi_sum(vals, gid, mask, ng)
+        monkeypatch.setenv("PINOT_TPU_PALLAS_V2", "1")
+        s2, c2 = gp.pallas_grouped_multi_sum(vals, gid, mask, ng)
+        hm, hg = np.asarray(mask), np.asarray(gid)
+        for i in range(k):
+            want = np.bincount(hg[hm], weights=np.asarray(vals[i])[hm].astype(np.float64), minlength=ng)
+            assert np.array_equal(np.asarray(s1[i]), want)
+            assert np.array_equal(np.asarray(s2[i]), want)
+        assert np.array_equal(np.asarray(c2), np.bincount(hg[hm], minlength=ng))
