@@ -17,7 +17,6 @@ from __future__ import annotations
 
 import itertools
 import threading
-import time
 import zlib
 
 from pinot_tpu.query import ast

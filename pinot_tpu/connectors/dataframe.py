@@ -13,7 +13,6 @@ way Spark executors use the reference connector.
 from __future__ import annotations
 
 from concurrent.futures import ThreadPoolExecutor
-from pathlib import Path
 
 import numpy as np
 import pandas as pd

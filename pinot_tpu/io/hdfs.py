@@ -21,7 +21,6 @@ import os
 import urllib.error
 import urllib.parse
 import urllib.request
-from pathlib import Path
 
 from pinot_tpu.io.fs import PinotFS
 

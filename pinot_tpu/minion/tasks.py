@@ -10,7 +10,6 @@ per-task config block (the reference's taskTypeConfigsMap).
 
 from __future__ import annotations
 
-from pathlib import Path
 from typing import Callable
 
 import numpy as np

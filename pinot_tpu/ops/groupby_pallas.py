@@ -27,7 +27,6 @@ import os
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 
 # Tile geometry. Each grid step costs ~2us of fixed dispatch overhead on TPU,
 # so for a (chunks x group-tiles) grid the step count — not the MACs — is the

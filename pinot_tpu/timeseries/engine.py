@@ -24,7 +24,6 @@ from pinot_tpu.timeseries.plan import (
     LeafTimeSeriesPlanNode,
     TimeSeriesBlock,
     TransformNode,
-    parse_timeseries,
 )
 
 
