@@ -390,6 +390,9 @@ def _planes2_impl(gid, planes, ng: int, r: int):
     return flat[:, :ng]
 
 
+_V2_BROKEN = False  # set on first lowering failure; logged once
+
+
 def planes_v2_enabled() -> bool:
     """Two-level kernel opt-in/out: PINOT_TPU_PALLAS_V2=1 forces on, =0 off.
     Default OFF until an on-chip A/B flips it (the flat kernel is the
@@ -403,6 +406,7 @@ def pallas_grouped_multi_sum(values_list, gid, mask, ng: int):
     ([f64 (ng,) sum per input], i64 (ng,) counts).
 
     Exactness requires the flat doc count <= SAFE_DOCS (asserted)."""
+    global _V2_BROKEN
     if gid.shape[0] > SAFE_DOCS:  # not assert: must survive python -O
         raise ValueError(
             f"pallas byte-plane accumulator overflows past {SAFE_DOCS} docs; "
@@ -426,8 +430,25 @@ def pallas_grouped_multi_sum(values_list, gid, mask, ng: int):
     r = -(-len(rows) // 8) * 8  # pad plane rows to the f32 sublane tile
     while len(rows) < r:
         rows.append(jnp.zeros((n_padded,), jnp.float32))
-    impl = _planes2_impl if planes_v2_enabled() else _planes_impl
-    out = impl(gid, jnp.stack(rows), ng, r)
+    planes = jnp.stack(rows)
+    if planes_v2_enabled() and not _V2_BROKEN:
+        try:
+            out = _planes2_impl(gid, planes, ng, r)
+        except Exception as e:
+            # Covers eager execution and trace-time failures only: when this
+            # function is traced inside an OUTER jit (the fused query
+            # kernels), a Mosaic rejection surfaces at that jit's compile,
+            # beyond this except — the v2 opt-in is validated by
+            # benchmarks/planes_ab.py (subprocess-isolated) for that reason.
+            _V2_BROKEN = True  # known bad: don't re-pay the failed attempt
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "two-level planes kernel failed (%r); using flat kernel", e, exc_info=True
+            )
+            out = _planes_impl(gid, planes, ng, r)
+    else:
+        out = _planes_impl(gid, planes, ng, r)
     sums = []
     for i in range(k):
         p = out[4 * i : 4 * i + 4, :ng].astype(jnp.float64)

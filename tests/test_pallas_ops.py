@@ -202,3 +202,52 @@ def test_two_level_planes_kernel_matches_flat(monkeypatch):
             assert np.array_equal(np.asarray(s1[i]), want)
             assert np.array_equal(np.asarray(s2[i]), want)
         assert np.array_equal(np.asarray(c2), np.bincount(hg[hm], minlength=ng))
+
+
+def test_v2_kernel_failure_falls_back_to_flat(monkeypatch):
+    """A v2 lowering failure (Mosaic constraint interpret mode can't see)
+    must degrade to the flat kernel, not fail the query."""
+    import jax.numpy as jnp
+
+    from pinot_tpu.ops import groupby_pallas as gp
+
+    def boom(*a, **k):
+        raise RuntimeError("mosaic says no")
+
+    monkeypatch.setenv("PINOT_TPU_PALLAS_V2", "1")
+    monkeypatch.setattr(gp, "_planes2_impl", boom)
+    monkeypatch.setattr(gp, "_V2_BROKEN", False)
+    rng = np.random.default_rng(2)
+    n, ng = 8192, 50
+    gid = jnp.asarray(rng.integers(0, ng, n).astype(np.int32))
+    v = jnp.asarray(rng.integers(-1000, 1000, n).astype(np.int32))
+    mask = jnp.asarray(np.ones(n, bool))
+    s, c = gp.pallas_grouped_multi_sum([v], gid, mask, ng)
+    want = np.bincount(np.asarray(gid), weights=np.asarray(v).astype(np.float64), minlength=ng)
+    assert np.array_equal(np.asarray(s[0]), want)
+    assert gp._V2_BROKEN is True
+
+
+def test_v2_broken_short_circuits(monkeypatch):
+    """After one failure the broken v2 kernel is not re-attempted."""
+    import jax.numpy as jnp
+
+    from pinot_tpu.ops import groupby_pallas as gp
+
+    calls = {"n": 0}
+
+    def boom(*a, **k):
+        calls["n"] += 1
+        raise RuntimeError("no")
+
+    monkeypatch.setenv("PINOT_TPU_PALLAS_V2", "1")
+    monkeypatch.setattr(gp, "_planes2_impl", boom)
+    monkeypatch.setattr(gp, "_V2_BROKEN", False)
+    rng = np.random.default_rng(4)
+    n, ng = 4096, 10
+    gid = jnp.asarray(rng.integers(0, ng, n).astype(np.int32))
+    v = jnp.asarray(rng.integers(0, 100, n).astype(np.int32))
+    mask = jnp.asarray(np.ones(n, bool))
+    gp.pallas_grouped_multi_sum([v], gid, mask, ng)
+    gp.pallas_grouped_multi_sum([v], gid, mask, ng)
+    assert calls["n"] == 1  # second call skipped the broken kernel
