@@ -77,7 +77,7 @@ def test_random_join_aggregates(setup):
     rng = random.Random(7)
     m_inner = fdf.merge(ddf, left_on="nation", right_on="dnation")
     m_left = fdf.merge(ddf, left_on="nation", right_on="dnation", how="left")
-    for trial in range(12):
+    for trial in range(20):
         kind = rng.choice(["JOIN", "LEFT JOIN"])
         keys = rng.choice([["d.region"], ["f.year"], ["f.year", "d.region"]])
         n_aggs = rng.randint(1, 3)
@@ -121,7 +121,7 @@ def test_random_join_aggregates(setup):
 def test_random_window_functions(setup):
     eng, fdf, ddf = setup
     rng = random.Random(11)
-    for trial in range(6):
+    for trial in range(10):
         fn = rng.choice(["SUM(f.rev)", "MIN(f.rev)", "MAX(f.rev)", "COUNT(*)"])
         part = rng.choice(["f.nation", "f.year"])
         sql = (
