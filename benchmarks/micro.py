@@ -298,6 +298,55 @@ def bench_multistage_join_e2e(n=500_000, dim=10_000):
     }
 
 
+def bench_stats_overhead(n=200_000, dim=2_000):
+    """Per-operator stats plane cost: the same multistage join+group-by run
+    with stats collection off (default) vs on (trace=true). The off path must
+    stay near-zero-cost — exec_node takes one `ctx.stats is None` branch per
+    block, so off-vs-baseline overhead should be noise (<5%)."""
+    from pinot_tpu.common import DataType, Schema
+    from pinot_tpu.multistage import MultistageEngine
+    from pinot_tpu.segment import SegmentBuilder
+
+    rng = np.random.default_rng(13)
+    fact_s = Schema.build("fact", dimensions=[("k", DataType.INT)], metrics=[("m", DataType.LONG)])
+    dim_s = Schema.build("dim", dimensions=[("k", DataType.INT)], metrics=[("w", DataType.LONG)])
+    fact = SegmentBuilder(fact_s).build(
+        {"k": rng.integers(0, dim, n).astype(np.int32), "m": rng.integers(1, 10, n).astype(np.int64)},
+        "f0",
+    )
+    d = SegmentBuilder(dim_s).build(
+        {"k": np.arange(dim, dtype=np.int32), "w": rng.integers(1, 5, dim).astype(np.int64)}, "d0"
+    )
+    eng = MultistageEngine({"fact": [fact], "dim": [d]}, n_workers=2)
+    q = "SELECT dim.k, SUM(fact.m) FROM fact JOIN dim ON fact.k = dim.k GROUP BY dim.k ORDER BY dim.k LIMIT 10"
+    off_ms = _time_host(lambda: eng.execute(q), iters=7)
+    on_ms = _time_host(lambda: eng.execute("SET trace=true; " + q), iters=7)
+    # The disabled path adds exactly one `ctx.stats is None` branch per
+    # exec_node call; time that branch directly and hold it to a wildly
+    # generous per-op bound so a regression that puts real work on the off
+    # path fails here without wall-clock flakiness.
+    class _OffCtx:
+        stats = None
+
+    ctx0 = _OffCtx()
+    t0 = time.perf_counter()
+    for _ in range(100_000):
+        if ctx0.stats is None:
+            pass
+    per_op_us = (time.perf_counter() - t0) / 100_000 * 1e6
+    assert per_op_us < 100, f"stats-off guard costs {per_op_us:.1f}µs/op"
+    return {
+        "disabled_guard_us_per_op": round(per_op_us, 4),
+        "metric": "multistage_stats_overhead",
+        "value": round(on_ms - off_ms, 3),
+        "unit": "ms",
+        "n": n,
+        "off_ms": round(off_ms, 3),
+        "on_ms": round(on_ms, 3),
+        "overhead_pct": round((on_ms / off_ms - 1.0) * 100, 1),
+    }
+
+
 ALL = [
     bench_filter_mask,
     bench_grouped_sum_xla,
@@ -311,6 +360,7 @@ ALL = [
     bench_device_lookup_join,
     bench_mesh_exchange_join,
     bench_multistage_join_e2e,
+    bench_stats_overhead,
 ]
 
 

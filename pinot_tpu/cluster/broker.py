@@ -65,14 +65,19 @@ class Broker:
         query_logger=None,
         tenant_tags: list[str] | None = None,
         access_control=None,
+        obs_config=None,
     ):
         """selector: instance selector (Balanced default; ReplicaGroup /
         Adaptive from cluster.routing). failure_detector: optional
         cluster.failure.FailureDetector enabling routing exclusion + one-round
         connection-failure failover. Per-table QPS quotas come from
         TableConfig.extra['queryQuotaQps']; query_logger is an optional
-        cluster.quota.QueryLogger."""
+        cluster.quota.QueryLogger. obs_config: common.config.ObservabilityConfig
+        controlling the structured slow-query log."""
+        import collections
+
         from pinot_tpu.cluster.quota import QueryQuotaManager
+        from pinot_tpu.common.config import ObservabilityConfig
 
         self.controller = controller
         #: broker-tenant membership; None = serve every table (untagged
@@ -85,36 +90,42 @@ class Broker:
         self.failure_detector = failure_detector
         self.quota = QueryQuotaManager(controller) if enable_quota else None
         self.query_logger = query_logger
+        self.obs_config = obs_config if obs_config is not None else ObservabilityConfig()
+        #: structured slow-query ring buffer (newest last); entries also go
+        #: to the pinot_tpu.slowquery logger as one JSON line each
+        self.slow_queries = collections.deque(maxlen=self.obs_config.slow_query_log_max_entries)
         self._pool = ThreadPoolExecutor(max_workers=max_scatter_threads)
         self._dispatcher = None
         self._dispatcher_lock = threading.Lock()
 
     def execute(self, sql: str, identity: str | None = None) -> ResultTable:
-        from pinot_tpu.common.metrics import BrokerMeter, broker_metrics
+        from pinot_tpu.common.metrics import BrokerMeter, BrokerTimer, broker_metrics
         from pinot_tpu.common.trace import start_trace
 
         bm = broker_metrics()
         bm.meter(BrokerMeter.QUERIES).mark()
         table = ""
         try:
-            stmt = parse_sql(sql)
-            table = getattr(stmt, "from_table", None) or ""
-            if self.access_control is not None:
-                from pinot_tpu.cluster.access import READ
+            with bm.timer(BrokerTimer.QUERY_TOTAL).time():
+                stmt = parse_sql(sql)
+                table = getattr(stmt, "from_table", None) or ""
+                if self.access_control is not None:
+                    from pinot_tpu.cluster.access import READ
 
-                for t in _collect_tables(stmt) or ([table] if table else []):
-                    self.access_control.check(identity, t, READ)
-            if self.quota is not None and table:
-                self.quota.acquire(table)
-            if stmt.options.get("trace", "").lower() == "true":
-                # per-query tracing (Tracing.java + `trace=true` query option)
-                with start_trace(request_id=f"q{next(_request_seq)}") as tr:
+                    for t in _collect_tables(stmt) or ([table] if table else []):
+                        self.access_control.check(identity, t, READ)
+                if self.quota is not None and table:
+                    self.quota.acquire(table)
+                if stmt.options.get("trace", "").lower() == "true":
+                    # per-query tracing (Tracing.java + `trace=true` query option)
+                    with start_trace(request_id=f"q{next(_request_seq)}") as tr:
+                        result = self._execute(stmt, sql)
+                    result.trace = tr.to_dict()
+                else:
                     result = self._execute(stmt, sql)
-                result.trace = tr.to_dict()
-            else:
-                result = self._execute(stmt, sql)
             if self.query_logger is not None:
                 self.query_logger.log(sql, table, result.time_used_ms, result.num_docs_scanned)
+            self._log_slow_query(sql, table, result)
             return result
         except Exception as e:
             bm.meter(BrokerMeter.REQUEST_FAILURES).mark()
@@ -122,14 +133,36 @@ class Broker:
                 self.query_logger.log(sql, table, 0.0, 0, exception=type(e).__name__)
             raise
 
+    def _log_slow_query(self, sql: str, table: str, result: ResultTable) -> None:
+        """Structured slow-query log (the reference's broker query-log WARN
+        path for above-threshold queries): one JSON line + ring-buffer entry
+        when wall time crosses ObservabilityConfig.slow_query_threshold_ms."""
+        if result.time_used_ms < self.obs_config.slow_query_threshold_ms:
+            return
+        import json
+        import logging
+
+        entry = {
+            "sql": sql,
+            "table": table,
+            "timeMs": round(result.time_used_ms, 3),
+            "numDocsScanned": result.num_docs_scanned,
+            "numRows": len(result.rows),
+            "numSegmentsQueried": result.num_segments_queried,
+            "ts": time.time(),
+        }
+        self.slow_queries.append(entry)
+        logging.getLogger("pinot_tpu.slowquery").warning(json.dumps(entry, sort_keys=True))
+
     def _execute(self, stmt, sql: str) -> ResultTable:
         t0 = time.perf_counter()
-        if getattr(stmt, "explain", False):
+        if getattr(stmt, "explain", False) or getattr(stmt, "explain_analyze", False):
             # failing loudly beats silently executing the query and returning
             # its rows as if they were a plan
             raise ValueError(
-                "EXPLAIN PLAN FOR is supported on the embedded engines "
-                "(QueryEngine / MultistageEngine), not through the broker yet"
+                "EXPLAIN PLAN FOR / EXPLAIN ANALYZE are supported on the "
+                "embedded engines (QueryEngine / MultistageEngine), not "
+                "through the broker yet"
             )
         # v2 engine selection (MultiStageBrokerRequestHandler.java:88 parity):
         # joins/subqueries/set-ops/windows, or explicit SET useMultistageEngine
@@ -551,8 +584,8 @@ class Broker:
                 segs.append(got)
             catalog[table] = segs
         engine = MultistageEngine(catalog, n_workers=4, schemas=schemas)
-        # v2 operators are not yet individually instrumented; record one
-        # dispatch-level span so traced v2 responses are honest about scope
+        # per-operator runtime stats surface via result.stage_stats when
+        # trace=true; the dispatch-level span bounds the whole v2 execution
         with InvocationScope("multistage:dispatch", tables=list(catalog)) as scope:
             result = engine.execute(sql, stmt=stmt)
             scope.set_attr("numRows", len(result.rows))

@@ -31,6 +31,27 @@ def _serve(handler_cls, port: int) -> tuple[ThreadingHTTPServer, int, threading.
     return httpd, httpd.server_address[1], t
 
 
+def _serve_metrics(handler, registry) -> None:
+    """GET /metrics: Prometheus text exposition 0.0.4 by default (the
+    jmx_exporter scrape surface); `?format=json` or an application/json
+    Accept header keeps the legacy structured snapshot."""
+    from pinot_tpu.common.metrics import PROMETHEUS_CONTENT_TYPE, prometheus_text
+
+    query = handler.path.partition("?")[2]
+    want_json = "format=json" in query or "application/json" in (handler.headers.get("Accept") or "")
+    if want_json:
+        payload = json.dumps(registry.snapshot()).encode()
+        ctype = "application/json"
+    else:
+        payload = prometheus_text(registry).encode()
+        ctype = PROMETHEUS_CONTENT_TYPE
+    handler.send_response(200)
+    handler.send_header("Content-Type", ctype)
+    handler.send_header("Content-Length", str(len(payload)))
+    handler.end_headers()
+    handler.wfile.write(payload)
+
+
 class BrokerHTTPService:
     """POST /query/sql {"sql": ...} -> Pinot-shaped JSON broker response."""
 
@@ -100,6 +121,22 @@ class BrokerHTTPService:
                     self.send_header("Content-Length", "2")
                     self.end_headers()
                     self.wfile.write(b"OK")
+                elif self.path.partition("?")[0] == "/metrics":
+                    from pinot_tpu.common.metrics import BrokerTimer, broker_metrics
+
+                    reg = broker_metrics()
+                    # ensure the core latency families exist even before the
+                    # first query hits this broker (stable scrape schema)
+                    reg.timer(BrokerTimer.QUERY_TOTAL)
+                    _serve_metrics(self, reg)
+                elif self.path.partition("?")[0] == "/debug/slowQueries":
+                    # structured slow-query ring buffer (broker-side triage)
+                    payload = json.dumps(list(svc.broker.slow_queries)).encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type", "application/json")
+                    self.send_header("Content-Length", str(len(payload)))
+                    self.end_headers()
+                    self.wfile.write(payload)
                 else:
                     self.send_error(404)
 
@@ -256,15 +293,14 @@ class ServerHTTPService:
                     self.send_header("Content-Length", str(len(payload)))
                     self.end_headers()
                     self.wfile.write(payload)
-                elif self.path == "/metrics":
-                    from pinot_tpu.common.metrics import server_metrics
+                elif self.path.partition("?")[0] == "/metrics":
+                    from pinot_tpu.common.metrics import ServerTimer, server_metrics
 
-                    payload = json.dumps(server_metrics().snapshot()).encode()
-                    self.send_response(200)
-                    self.send_header("Content-Type", "application/json")
-                    self.send_header("Content-Length", str(len(payload)))
-                    self.end_headers()
-                    self.wfile.write(payload)
+                    reg = server_metrics()
+                    # ensure the core latency families exist even before the
+                    # first query hits this server (stable scrape schema)
+                    reg.timer(ServerTimer.QUERY_EXECUTION)
+                    _serve_metrics(self, reg)
                 elif self.path == "/debug/resources":
                     # leak-tracker + scheduler backlog (NettyLeakListener-
                     # style observability surfaced as a REST debug endpoint)
@@ -438,10 +474,10 @@ class ControllerHTTPService:
                         self.send_header("Content-Length", str(len(html)))
                         self.end_headers()
                         self.wfile.write(html)
-                    elif self.path == "/metrics":
+                    elif self.path.partition("?")[0] == "/metrics":
                         from pinot_tpu.common.metrics import controller_metrics
 
-                        self._json(controller_metrics().snapshot())
+                        _serve_metrics(self, controller_metrics())
                     elif self.path == "/health":
                         self._json({"status": "OK"})
                     elif self.path == "/tables":

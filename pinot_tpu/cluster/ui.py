@@ -92,7 +92,7 @@ async function renderInstances() {
 }
 
 async function renderMetrics() {
-  const m = await get('/metrics');
+  const m = await get('/metrics?format=json');
   main.replaceChildren($(`<h2>Controller metrics</h2><pre>${JSON.stringify(m, null, 1)}</pre>`));
 }
 

@@ -2,6 +2,7 @@ from pinot_tpu.common.types import DataType, FieldSpec, FieldType, Schema
 from pinot_tpu.common.config import (
     DedupConfig,
     IndexingConfig,
+    ObservabilityConfig,
     StarTreeIndexConfig,
     TableConfig,
     TableType,
@@ -15,6 +16,7 @@ __all__ = [
     "Schema",
     "DedupConfig",
     "IndexingConfig",
+    "ObservabilityConfig",
     "StarTreeIndexConfig",
     "TableConfig",
     "TableType",

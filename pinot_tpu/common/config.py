@@ -19,6 +19,31 @@ class TableType(Enum):
 
 
 @dataclass
+class ObservabilityConfig:
+    """Broker observability knobs (pinot.broker.* instance-config parity):
+    the slow-query log threshold and its bounded in-memory buffer size."""
+
+    #: queries at or above this wall time get a structured slow-query log
+    #: entry on the broker
+    slow_query_threshold_ms: float = 1000.0
+    #: ring-buffer capacity of Broker.slow_queries (inspection/debug surface)
+    slow_query_log_max_entries: int = 128
+
+    def to_dict(self) -> dict:
+        return {
+            "slowQueryThresholdMs": self.slow_query_threshold_ms,
+            "slowQueryLogMaxEntries": self.slow_query_log_max_entries,
+        }
+
+    @staticmethod
+    def from_dict(d: dict) -> "ObservabilityConfig":
+        return ObservabilityConfig(
+            d.get("slowQueryThresholdMs", 1000.0),
+            d.get("slowQueryLogMaxEntries", 128),
+        )
+
+
+@dataclass
 class StarTreeIndexConfig:
     """Parity with StarTreeIndexConfig (dimensionsSplitOrder,
     functionColumnPairs, maxLeafRecords)."""

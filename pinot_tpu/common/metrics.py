@@ -11,6 +11,8 @@ table-suffixed metric names the same way).
 
 from __future__ import annotations
 
+import math
+import re
 import threading
 import time
 from enum import Enum
@@ -20,6 +22,7 @@ class MetricKind(Enum):
     METER = "meter"
     GAUGE = "gauge"
     TIMER = "timer"
+    HISTOGRAM = "histogram"
 
 
 class Meter:
@@ -67,16 +70,111 @@ class Gauge:
             self.value += delta
 
 
-class Timer:
-    """Duration recorder with count/total/min/max (yammer Timer parity)."""
+# HDR-style log-linear bucket bounds shared by every Histogram: geometric
+# upper bounds from 10µs to ~22min with ratio 2^(1/4) (~19% max relative
+# error — two significant figures, the HdrHistogram default precision class).
+# A fixed shared tuple keeps each instance to one small counts list.
+_HIST_RATIO = 2.0 ** 0.25
+_HIST_BOUNDS: tuple = tuple(0.01 * _HIST_RATIO**i for i in range(int(math.log(1.4e8, _HIST_RATIO)) + 1))
 
-    __slots__ = ("count", "total_ms", "min_ms", "max_ms", "_lock")
+
+class Histogram:
+    """Bucketed duration histogram with p50/p95/p99 (HdrHistogram parity:
+    fixed log-linear buckets, constant memory, O(buckets) quantile reads).
+    Values are milliseconds; quantiles return the bucket upper bound clamped
+    to the observed [min, max] so exact extremes survive bucketing."""
+
+    __slots__ = ("counts", "count", "total_ms", "min_ms", "max_ms", "_lock")
+
+    def __init__(self):
+        self.counts = [0] * (len(_HIST_BOUNDS) + 1)  # +1 = overflow bucket
+        self.count = 0
+        self.total_ms = 0.0
+        self.min_ms = float("inf")
+        self.max_ms = 0.0
+        self._lock = threading.Lock()
+
+    @staticmethod
+    def _bucket(ms: float) -> int:
+        if ms <= _HIST_BOUNDS[0]:
+            return 0
+        i = int(math.log(ms / 0.01, _HIST_RATIO)) + 1
+        # float-log edge wobble: settle on the first bound >= ms
+        while i < len(_HIST_BOUNDS) and _HIST_BOUNDS[i] < ms:
+            i += 1
+        while i > 0 and _HIST_BOUNDS[i - 1] >= ms:
+            i -= 1
+        return i
+
+    def update_ms(self, ms: float) -> None:
+        ms = max(float(ms), 0.0)
+        with self._lock:
+            self.counts[self._bucket(ms)] += 1
+            self.count += 1
+            self.total_ms += ms
+            self.min_ms = min(self.min_ms, ms)
+            self.max_ms = max(self.max_ms, ms)
+
+    def quantile_ms(self, q: float) -> float:
+        with self._lock:
+            if not self.count:
+                return 0.0
+            target = max(1, math.ceil(q * self.count))
+            seen = 0
+            for i, c in enumerate(self.counts):
+                seen += c
+                if seen >= target:
+                    bound = _HIST_BOUNDS[i] if i < len(_HIST_BOUNDS) else self.max_ms
+                    return min(max(bound, self.min_ms), self.max_ms)
+            return self.max_ms
+
+    def mean_ms(self) -> float:
+        with self._lock:
+            return self.total_ms / self.count if self.count else 0.0
+
+    def bucket_counts(self) -> "list[tuple[float, int]]":
+        """Cumulative (upper_bound_ms, count) pairs, Prometheus `le` style;
+        the final pair's bound is +inf."""
+        out = []
+        cum = 0
+        with self._lock:
+            for i, c in enumerate(self.counts):
+                cum += c
+                if c or i == len(self.counts) - 1:
+                    out.append((_HIST_BOUNDS[i] if i < len(_HIST_BOUNDS) else float("inf"), cum))
+        return out
+
+    class _Ctx:
+        __slots__ = ("_hist", "_t0")
+
+        def __init__(self, hist):
+            self._hist = hist
+
+        def __enter__(self):
+            self._t0 = time.perf_counter()
+            return self
+
+        def __exit__(self, *exc):
+            self._hist.update_ms((time.perf_counter() - self._t0) * 1e3)
+            return False
+
+    def time(self) -> "_Ctx":
+        return Histogram._Ctx(self)
+
+
+class Timer:
+    """Duration recorder with count/total/min/max (yammer Timer parity) plus
+    an embedded Histogram so every existing ServerTimer/BrokerTimer call site
+    gets p50/p95/p99 for free."""
+
+    __slots__ = ("count", "total_ms", "min_ms", "max_ms", "hist", "_lock")
 
     def __init__(self):
         self.count = 0
         self.total_ms = 0.0
         self.min_ms = float("inf")
         self.max_ms = 0.0
+        self.hist = Histogram()
         self._lock = threading.Lock()
 
     def update_ms(self, ms: float) -> None:
@@ -85,6 +183,10 @@ class Timer:
             self.total_ms += ms
             self.min_ms = min(self.min_ms, ms)
             self.max_ms = max(self.max_ms, ms)
+        self.hist.update_ms(ms)
+
+    def quantile_ms(self, q: float) -> float:
+        return self.hist.quantile_ms(q)
 
     def mean_ms(self) -> float:
         with self._lock:
@@ -136,6 +238,9 @@ class MetricsRegistry:
     def timer(self, name) -> Timer:
         return self._get(name, Timer)
 
+    def histogram(self, name) -> Histogram:
+        return self._get(name, Histogram)
+
     def snapshot(self) -> dict:
         """Flat JSON-able dump (the JMX/exposition analog)."""
         out = {}
@@ -152,8 +257,81 @@ class MetricsRegistry:
                     "count": m.count,
                     "meanMs": m.mean_ms(),
                     "maxMs": m.max_ms if m.count else 0.0,
+                    "p50Ms": m.quantile_ms(0.5),
+                    "p95Ms": m.quantile_ms(0.95),
+                    "p99Ms": m.quantile_ms(0.99),
+                }
+            elif isinstance(m, Histogram):
+                out[k] = {
+                    "type": "histogram",
+                    "count": m.count,
+                    "meanMs": m.mean_ms(),
+                    "maxMs": m.max_ms if m.count else 0.0,
+                    "p50Ms": m.quantile_ms(0.5),
+                    "p95Ms": m.quantile_ms(0.95),
+                    "p99Ms": m.quantile_ms(0.99),
                 }
         return out
+
+
+# -- Prometheus exposition ----------------------------------------------------
+
+
+def _prom_name(key: str) -> str:
+    # exposition names must match [a-zA-Z_:][a-zA-Z0-9_:]*
+    return "pinot_" + re.sub(r"[^a-zA-Z0-9_:]", "_", key)
+
+
+def _prom_num(v) -> str:
+    if v == float("inf"):
+        return "+Inf"
+    return repr(float(v)) if isinstance(v, float) else str(v)
+
+
+def prometheus_text(registry: "MetricsRegistry") -> str:
+    """Render one registry in the Prometheus text exposition format 0.0.4
+    (the PinotMetricsRegistry -> JMX -> jmx_exporter chain collapsed to one
+    renderer). Meters become `_total` counters, gauges map directly, timers
+    and histograms expose `_count`/`_sum` plus `_p50`/`_p95`/`_p99` quantile
+    gauges; histograms additionally emit cumulative `_bucket{le=...}` series.
+    Durations stay in milliseconds — the metric names already carry the Ms
+    suffix."""
+    with registry._lock:
+        items = sorted(registry._metrics.items())
+    lines: list[str] = []
+
+    def _quantiles(name: str, m) -> None:
+        lines.append(f"# TYPE {name}_count counter")
+        lines.append(f"{name}_count {m.count}")
+        lines.append(f"# TYPE {name}_sum counter")
+        lines.append(f"{name}_sum {_prom_num(m.total_ms)}")
+        for q, suffix in ((0.5, "p50"), (0.95, "p95"), (0.99, "p99")):
+            lines.append(f"# TYPE {name}_{suffix} gauge")
+            lines.append(f"{name}_{suffix} {_prom_num(m.quantile_ms(q))}")
+
+    for key, m in items:
+        name = _prom_name(key)
+        if isinstance(m, Meter):
+            lines.append(f"# TYPE {name}_total counter")
+            lines.append(f"{name}_total {m.count}")
+        elif isinstance(m, Gauge):
+            lines.append(f"# TYPE {name} gauge")
+            lines.append(f"{name} {_prom_num(m.value)}")
+        elif isinstance(m, Timer):
+            _quantiles(name, m)
+        elif isinstance(m, Histogram):
+            lines.append(f"# TYPE {name} histogram")
+            for bound, cum in m.bucket_counts():
+                lines.append(f'{name}_bucket{{le="{_prom_num(bound)}"}} {cum}')
+            lines.append(f"{name}_sum {_prom_num(m.total_ms)}")
+            lines.append(f"{name}_count {m.count}")
+            for q, suffix in ((0.5, "p50"), (0.95, "p95"), (0.99, "p99")):
+                lines.append(f"# TYPE {name}_{suffix} gauge")
+                lines.append(f"{name}_{suffix} {_prom_num(m.quantile_ms(q))}")
+    return "\n".join(lines) + "\n"
+
+
+PROMETHEUS_CONTENT_TYPE = "text/plain; version=0.0.4"
 
 
 # -- typed metric names (subset of pinot-common/.../metrics enums) -----------

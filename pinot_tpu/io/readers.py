@@ -262,11 +262,30 @@ class ThriftRecordReader(RecordReader):
             yield {self._fields.get(fid, f"field_{fid}"): v for fid, v in row}
 
 
+def _thrift_unpack(fmt: str, buf: bytes, pos: int, width: int):
+    """Bounds-checked fixed-width read: a value that runs past the end of the
+    file is corruption (truncated download, bad offset), not a crash —
+    struct.error from unpack_from must never leak to callers as-is."""
+    if pos + width > len(buf):
+        raise ValueError(f"corrupt thrift data: truncated value at offset {pos}")
+    try:
+        return struct.unpack_from(fmt, buf, pos)[0]
+    except struct.error as e:  # pragma: no cover - bounds check above covers it
+        raise ValueError(f"corrupt thrift data: truncated value at offset {pos}") from e
+
+
+def _thrift_byte(buf: bytes, pos: int) -> int:
+    """Bounds-checked single-byte read (wire-type / element-type bytes)."""
+    if pos >= len(buf):
+        raise ValueError(f"corrupt thrift data: truncated value at offset {pos}")
+    return buf[pos]
+
+
 def _thrift_len(buf: bytes, pos: int, width: int = 1) -> int:
     """Validated length/count prefix: negative or past-end values are file
     corruption — fail loudly instead of looping backwards (negative length
     would move pos backwards forever) or yielding a truncated last row."""
-    (n,) = struct.unpack_from(">i", buf, pos)
+    n = _thrift_unpack(">i", buf, pos, 4)
     if n < 0 or pos + 4 + n * width > len(buf):
         raise ValueError(f"corrupt thrift data: length {n} at offset {pos}")
     return n
@@ -274,17 +293,17 @@ def _thrift_len(buf: bytes, pos: int, width: int = 1) -> int:
 
 def _thrift_read_value(buf: bytes, pos: int, ftype: int):
     if ftype == _T_BOOL:
-        return buf[pos] != 0, pos + 1
+        return _thrift_byte(buf, pos) != 0, pos + 1
     if ftype == _T_BYTE:
-        return struct.unpack_from(">b", buf, pos)[0], pos + 1
+        return _thrift_unpack(">b", buf, pos, 1), pos + 1
     if ftype == _T_DOUBLE:
-        return struct.unpack_from(">d", buf, pos)[0], pos + 8
+        return _thrift_unpack(">d", buf, pos, 8), pos + 8
     if ftype == _T_I16:
-        return struct.unpack_from(">h", buf, pos)[0], pos + 2
+        return _thrift_unpack(">h", buf, pos, 2), pos + 2
     if ftype == _T_I32:
-        return struct.unpack_from(">i", buf, pos)[0], pos + 4
+        return _thrift_unpack(">i", buf, pos, 4), pos + 4
     if ftype == _T_I64:
-        return struct.unpack_from(">q", buf, pos)[0], pos + 8
+        return _thrift_unpack(">q", buf, pos, 8), pos + 8
     if ftype == _T_STRING:
         n = _thrift_len(buf, pos)
         raw = buf[pos + 4 : pos + 4 + n]
@@ -296,7 +315,7 @@ def _thrift_read_value(buf: bytes, pos: int, ftype: int):
         fields, pos = _thrift_read_struct(buf, pos)
         return dict(fields), pos
     if ftype in (_T_LIST, _T_SET):
-        etype, n = buf[pos], _thrift_len(buf, pos + 1)
+        etype, n = _thrift_byte(buf, pos), _thrift_len(buf, pos + 1)
         pos += 5
         out = []
         for _ in range(n):
@@ -304,7 +323,7 @@ def _thrift_read_value(buf: bytes, pos: int, ftype: int):
             out.append(v)
         return out, pos
     if ftype == _T_MAP:
-        ktype, vtype = buf[pos], buf[pos + 1]
+        ktype, vtype = _thrift_byte(buf, pos), _thrift_byte(buf, pos + 1)
         n = _thrift_len(buf, pos + 2)
         pos += 6
         out = {}
@@ -325,7 +344,7 @@ def _thrift_read_struct(buf: bytes, pos: int) -> tuple[list, int]:
         pos += 1
         if ftype == _T_STOP:
             return fields, pos
-        (fid,) = struct.unpack_from(">h", buf, pos)
+        fid = _thrift_unpack(">h", buf, pos, 2)
         pos += 2
         v, pos = _thrift_read_value(buf, pos, ftype)
         fields.append((fid, v))

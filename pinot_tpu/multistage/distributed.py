@@ -235,14 +235,27 @@ class DistributedDispatcher:
                     parent_of[inp] = s.id
             n_senders = {sid: plan.stages[sid].parallelism for sid in plan.stages}
             root = plan.stages[0]
-            ctx = R.RunCtx(root, 0, mailbox, plan.stages, {}, n_senders, options=plan.options)
+            from pinot_tpu.multistage.stats import (
+                StageStatsCollector,
+                merge_stage_stats,
+                stats_enabled,
+            )
+
+            ctx = R.RunCtx(
+                root, 0, mailbox, plan.stages, {}, n_senders, options=plan.options,
+                stats=StageStatsCollector(root, 0) if stats_enabled(plan.options) else None,
+            )
             df = R.exec_node(root.root, ctx)
         finally:
             self.registry.close(qid)
         df = df.astype(object).where(pd.notna(df), None)
-        return ResultTable(
+        result = ResultTable(
             columns=list(plan.visible_names),
             rows=df.values.tolist(),
             total_docs=total_docs,
             time_used_ms=(_time.perf_counter() - t0) * 1e3,
         )
+        if ctx.stats is not None:
+            # remote workers' records arrived on their trailing EOS envelopes
+            result.stage_stats = merge_stage_stats(ctx.stats.payload())
+        return result

@@ -359,11 +359,18 @@ class Catalog:
         rows = {t: sum(s.n_docs for s in segs) for t, segs in catalog.items()}
         ndv: dict[str, dict[str, int]] = {}
         for t, segs in catalog.items():
-            if segs:
-                ndv[t] = {
-                    c: sum(s.columns[c].cardinality for s in segs if c in s.columns)
-                    for c in cols[t]
-                }
+            if not segs:
+                continue
+            per: dict[str, int] = {}
+            for c in cols[t]:
+                cards = [getattr(s.columns[c], "cardinality", 0) for s in segs if c in s.columns]
+                # A zero/absent per-segment cardinality means "unknown", and a
+                # column missing from any segment makes the sum a non-bound;
+                # omit the entry so cardinality-gated rules see None and fail
+                # closed instead of firing on a bogus NDV of 0.
+                if len(cards) == len(segs) and cards and all(card > 0 for card in cards):
+                    per[c] = sum(cards)
+            ndv[t] = per
         return cls(cols, row_counts=rows, ndv=ndv)
 
 

@@ -26,12 +26,19 @@ from __future__ import annotations
 
 import queue
 import threading
+import time as _time
 from dataclasses import dataclass, field as dfield
 
 import numpy as np
 import pandas as pd
 
 from pinot_tpu.multistage import logical as L
+from pinot_tpu.multistage.stats import (
+    StageStatsCollector,
+    analyze_rows,
+    merge_stage_stats,
+    stats_enabled,
+)
 from pinot_tpu.query import ast, host_exec
 from pinot_tpu.query.context import canonical
 from pinot_tpu.query.result import ResultTable
@@ -63,8 +70,18 @@ class MailboxService:
     #: instead of hanging the receiving OpChain (GrpcMailbox deadline parity).
     receive_timeout: float | None = None
 
-    def receive_all(self, recv_stage: int, recv_worker: int, send_stage: int, n_senders: int):
-        """Drain blocks from n_senders until each sent EOS. Raises on error."""
+    def receive_all(
+        self,
+        recv_stage: int,
+        recv_worker: int,
+        send_stage: int,
+        n_senders: int,
+        stats_out: list | None = None,
+    ):
+        """Drain blocks from n_senders until each sent EOS. Raises on error.
+        An EOS may carry the sender's accumulated operator-stats records
+        (("__eos__", [records]) — MultiStageQueryStats-in-trailing-block
+        parity); they are appended to `stats_out` when the receiver collects."""
         q = self._q(recv_stage, recv_worker, send_stage)
         blocks: list[pd.DataFrame] = []
         eos = 0
@@ -79,6 +96,8 @@ class MailboxService:
                 ) from None
             if item is _EOS or (isinstance(item, tuple) and item and item[0] == "__eos__"):
                 eos += 1
+                if stats_out is not None and isinstance(item, tuple) and len(item) > 1 and item[1]:
+                    stats_out.extend(item[1])
             elif isinstance(item, tuple) and item and item[0] == "__err__":
                 raise RuntimeError(f"upstream stage {send_stage} failed: {item[1]}")
             else:
@@ -710,21 +729,27 @@ class RunCtx:
     scan_local_all: bool = False
     # per-query SET options (threaded from StagePlan.options)
     options: dict = dfield(default_factory=dict)
+    # per-operator runtime stats accumulator (None = collection disabled,
+    # the default — `trace=true` / EXPLAIN ANALYZE turn it on)
+    stats: StageStatsCollector | None = None
 
 
 def _empty_df(n_cols: int) -> pd.DataFrame:
     return pd.DataFrame({i: pd.Series(dtype=object) for i in range(n_cols)})
 
 
-def _leaf_filter_mask(seg, filt, null_on: bool = False) -> np.ndarray:
+def _leaf_filter_mask(seg, filt, null_on: bool = False, stats=None, node=None) -> np.ndarray:
     """Leaf Scan filter on the fused device kernel (LeafStageTransferableBlock-
     Operator.java:87 parity: the v2 leaf runs the v1 engine's path). Falls
     back to the host numpy evaluator for host-only predicates; each side is
-    counted in server metrics so tests/operators can assert which path ran."""
+    counted in server metrics so tests/operators can assert which path ran.
+    When a StageStatsCollector is threaded in, the device time / fallback is
+    also attributed to the owning Scan operator's stats."""
     from pinot_tpu.common.metrics import ServerMeter, server_metrics
     from pinot_tpu.query.kernels import run_plan
     from pinot_tpu.query.plan import DeviceFallback, PlanError, plan_filter_mask
 
+    t0 = _time.perf_counter() if stats is not None else 0.0
     try:
         # null_on lowers nullable-column predicates to the device Kleene
         # (true, unknown) pair tree — same semantics as the v1 where_spec
@@ -732,24 +757,57 @@ def _leaf_filter_mask(seg, filt, null_on: bool = False) -> np.ndarray:
         mask = np.asarray(run_plan(plan, seg.to_device_cached()))[: seg.n_docs]
     except (DeviceFallback, PlanError):
         server_metrics().meter(ServerMeter.DEVICE_FALLBACKS).mark()
+        if stats is not None:
+            stats.add_fallback(node)
         return (
             host_exec.filter_mask_null_aware(seg, filt)
             if null_on
             else host_exec.filter_mask(seg, filt)
         )
     server_metrics().meter(ServerMeter.MULTISTAGE_LEAF_DEVICE_SCANS).mark()
+    if stats is not None:
+        stats.add_device(node, (_time.perf_counter() - t0) * 1e3)
     return mask
 
 
 def exec_node(node: L.Node, ctx: RunCtx) -> pd.DataFrame:
+    """Stats-instrumented dispatch: when the ctx carries a collector, each
+    operator's rows/blocks/wall time is recorded around the real execution
+    (MultiStageOperator.registerExecution parity); the disabled path is one
+    attribute check."""
+    st = ctx.stats
+    if st is None:
+        return _exec_node(node, ctx)
+    t0 = _time.perf_counter()
+    df = _exec_node(node, ctx)
+    st.record_exec(
+        node,
+        len(df),
+        (_time.perf_counter() - t0) * 1e3,
+        blocks=0 if isinstance(node, L.StageInput) else 1,
+    )
+    return df
+
+
+def _exec_node(node: L.Node, ctx: RunCtx) -> pd.DataFrame:
     if isinstance(node, L.StageInput):
         blocks = ctx.mailbox.receive_all(
-            ctx.stage.id, ctx.worker, node.stage_id, ctx.n_senders[node.stage_id]
+            ctx.stage.id, ctx.worker, node.stage_id, ctx.n_senders[node.stage_id],
+            stats_out=ctx.stats.upstream if ctx.stats is not None else None,
         )
+        if ctx.stats is not None:
+            ctx.stats.add_blocks(node, len(blocks))  # blocks received, not emitted
         blocks = [b for b in blocks if len(b)]
         if not blocks:
             return _empty_df(len(node.fields))
-        return pd.concat(blocks, ignore_index=True)
+        out = pd.concat(blocks, ignore_index=True)
+        # Fresh per-receiver columns Index: concat of equal indexes reuses the
+        # sender's Index object, and pandas' lazily-built index engine is not
+        # thread-safe — two receiver threads sharing one Index object can see
+        # a half-populated hashtable and raise a transient KeyError on the
+        # first get_loc (e.g. in groupby).
+        out.columns = pd.RangeIndex(out.shape[1])
+        return out
 
     if isinstance(node, L.Scan):
         from pinot_tpu.query.context import null_handling_enabled
@@ -760,7 +818,7 @@ def exec_node(node: L.Node, ctx: RunCtx) -> pd.DataFrame:
         frames = []
         for seg in mine:
             mask = (
-                _leaf_filter_mask(seg, node.filter, null_on=null_on)
+                _leaf_filter_mask(seg, node.filter, null_on=null_on, stats=ctx.stats, node=node)
                 if node.filter is not None
                 else None
             )
@@ -872,8 +930,11 @@ def _exec_aggregate(node: L.Aggregate, ctx: RunCtx) -> pd.DataFrame:
     if node.mode == "partial":
         # leaf pattern first: Scan input + plain-column keys/args runs the
         # fused v1 device engine WITHOUT materializing scan rows
+        t0 = _time.perf_counter() if ctx.stats is not None else 0.0
         leaf = _try_leaf_device_partial(node, ctx)
         if leaf is not None:
+            if ctx.stats is not None:
+                ctx.stats.add_device(node, (_time.perf_counter() - t0) * 1e3)
             return leaf
         from pinot_tpu.query.context import null_handling_enabled as _nhe
 
@@ -1181,6 +1242,21 @@ def _exec_final_aggregate(node: L.Aggregate, df: pd.DataFrame, null_on: bool = F
     return pd.DataFrame({i: [r[i] for r in rows] for i in range(len(node.fields))})
 
 
+def _join_input_dist(node: L.Node, ctx: RunCtx):
+    """Distribution that routed a join input's rows to this worker. Project/
+    Filter/Rename don't re-route rows, so walk through them to the underlying
+    StageInput; a Scan means co-located leaf data (no exchange -> None).
+    Anything else (an in-stage Aggregate/Join/...) makes the routing
+    indeterminate from here — callers must fail closed on it."""
+    while isinstance(node, (L.Project, L.FilterNode, L.Rename)):
+        node = node.input
+    if isinstance(node, L.StageInput):
+        return ctx.stages[node.stage_id].dist
+    if isinstance(node, L.Scan):
+        return None
+    return "indeterminate"
+
+
 def _exec_join(node: L.Join, ctx: RunCtx) -> pd.DataFrame:
     l = exec_node(node.left, ctx)
     r = exec_node(node.right, ctx)
@@ -1203,9 +1279,13 @@ def _exec_join(node: L.Join, ctx: RunCtx) -> pd.DataFrame:
         for kc in lk.columns:
             lnum, rnum = lk[kc].dtype.kind == "f", rk[kc].dtype.kind == "f"
             if lnum != rnum:
-                ldist = ctx.stages[node.left.stage_id].dist if isinstance(node.left, L.StageInput) else None
-                rdist = ctx.stages[node.right.stage_id].dist if isinstance(node.right, L.StageInput) else None
-                if ldist == L.HASH and rdist == L.HASH:
+                ldist = _join_input_dist(node.left, ctx)
+                rdist = _join_input_dist(node.right, ctx)
+                # an indeterminate input can't be ruled out as hash-routed:
+                # treat it as HASH (fail closed) rather than silently coercing
+                l_hashy = ldist == L.HASH or ldist == "indeterminate"
+                r_hashy = rdist == L.HASH or rdist == "indeterminate"
+                if l_hashy and r_hashy:
                     raise L.PlanV2Error(
                         "join key type mismatch (numeric vs string) across hash-"
                         "partitioned inputs; add an explicit CAST on one side"
@@ -1425,7 +1505,7 @@ def _exec_window(node: L.WindowNode, ctx: RunCtx) -> pd.DataFrame:
 # ---------------------------------------------------------------------------
 
 
-def _send_output(df: pd.DataFrame, stage: L.Stage, parent_id: int, parent_par: int, mailbox: MailboxService, worker: int):
+def _send_output(df: pd.DataFrame, stage: L.Stage, parent_id: int, parent_par: int, mailbox: MailboxService, worker: int, stats: list | None = None):
     if stage.dist == L.SINGLETON:
         mailbox.send(stage.id, parent_id, 0, df)
     elif stage.dist == L.BROADCAST:
@@ -1442,8 +1522,10 @@ def _send_output(df: pd.DataFrame, stage: L.Stage, parent_id: int, parent_par: i
                 mailbox.send(stage.id, parent_id, w, sub.reset_index(drop=True))
     else:
         raise L.PlanV2Error(f"unknown distribution {stage.dist}")
+    # stats ride the trailing EOS (MultiStageQueryStats parity) — to parent
+    # worker 0 ONLY, so a multi-worker parent doesn't relay duplicate copies
     for w in range(parent_par):
-        mailbox.send(stage.id, parent_id, w, _EOS)
+        mailbox.send(stage.id, parent_id, w, ("__eos__", stats) if (stats and w == 0) else _EOS)
 
 
 def run_stage_worker(
@@ -1461,15 +1543,20 @@ def run_stage_worker(
     """Run ONE (stage, worker) OpChain to completion: execute the stage
     subtree and ship its output (or an error marker) to every parent worker.
     Shared by the in-process engine and the distributed server runtime."""
+    opts = dict(options or {})
     ctx = RunCtx(
         stage, w, mailbox, stages, segments, n_senders,
-        scan_local_all=scan_local_all, options=dict(options or {}),
+        scan_local_all=scan_local_all, options=opts,
+        stats=StageStatsCollector(stage, w) if stats_enabled(opts) else None,
     )
     parent = parent_of[stage.id]
     parent_par = stages[parent].parallelism
     try:
         df = exec_node(stage.root, ctx)
-        _send_output(df, stage, parent, parent_par, mailbox, w)
+        _send_output(
+            df, stage, parent, parent_par, mailbox, w,
+            stats=ctx.stats.payload() if ctx.stats is not None else None,
+        )
     except BaseException as e:  # propagate to receivers
         if errors is not None:
             errors.append(e)
@@ -1539,18 +1626,31 @@ class MultistageEngine:
                 columns=["Operator", "Operator_Id", "Parent_Id"],
                 rows=out_rows,
             )
-        df = self._run(plan)
+        if getattr(stmt, "explain_analyze", False):
+            # EXPLAIN ANALYZE: execute with stats collection forced on, then
+            # render the plan tree with the merged runtime stats inline
+            plan.options["__collect_stats__"] = True
+            _, stats_payload = self._run(plan)
+            merged = merge_stage_stats(stats_payload or [])
+            return ResultTable(
+                columns=["Operator", "Operator_Id", "Parent_Id"],
+                rows=analyze_rows(plan, merged),
+            )
+        df, stats_payload = self._run(plan)
         df = df.astype(object).where(pd.notna(df), None)
         rows = df.values.tolist()
         total_docs = sum(s.n_docs for segs in self.catalog.values() for s in segs)
-        return ResultTable(
+        result = ResultTable(
             columns=list(plan.visible_names),
             rows=rows,
             total_docs=total_docs,
             time_used_ms=(time.perf_counter() - t0) * 1e3,
         )
+        if stats_payload is not None:
+            result.stage_stats = merge_stage_stats(stats_payload)
+        return result
 
-    def _run(self, plan: L.StagePlan) -> pd.DataFrame:
+    def _run(self, plan: L.StagePlan) -> "tuple[pd.DataFrame, list | None]":
         mailbox = MailboxService()
         parent_of: dict[int, int] = {}
         for s in plan.stages.values():
@@ -1575,7 +1675,10 @@ class MultistageEngine:
                 t.start()
                 threads.append(t)
         root = plan.stages[0]
-        ctx = RunCtx(root, 0, mailbox, plan.stages, self.catalog, n_senders, options=plan.options)
+        ctx = RunCtx(
+            root, 0, mailbox, plan.stages, self.catalog, n_senders, options=plan.options,
+            stats=StageStatsCollector(root, 0) if stats_enabled(plan.options) else None,
+        )
         try:
             out = exec_node(root.root, ctx)
         finally:
@@ -1583,4 +1686,4 @@ class MultistageEngine:
                 t.join(timeout=30)
         if errors:
             raise errors[0]
-        return out
+        return out, (ctx.stats.payload() if ctx.stats is not None else None)

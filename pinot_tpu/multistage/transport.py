@@ -29,7 +29,9 @@ from pinot_tpu.multistage import runtime as R
 
 
 def encode_envelope(qid: str, rs: int, rw: int, ss: int, payload) -> bytes:
-    """payload: DataFrame | runtime._EOS | ("__err__", msg)."""
+    """payload: DataFrame | runtime._EOS | ("__eos__", [stats]) |
+    ("__err__", msg). A stats-carrying EOS ships the sender's accumulated
+    OperatorStats records in the header (trailing-EOS-block parity)."""
     if isinstance(payload, pd.DataFrame):
         header = {"qid": qid, "rs": rs, "rw": rw, "ss": ss, "kind": "block"}
         body = datatable.encode(payload)
@@ -38,6 +40,8 @@ def encode_envelope(qid: str, rs: int, rw: int, ss: int, payload) -> bytes:
         body = b""
     else:  # EOS
         header = {"qid": qid, "rs": rs, "rw": rw, "ss": ss, "kind": "eos"}
+        if isinstance(payload, tuple) and len(payload) > 1 and payload[1]:
+            header["stats"] = payload[1]
         body = b""
     hb = json.dumps(header).encode()
     return struct.pack("<I", len(hb)) + hb + body
@@ -57,7 +61,8 @@ def decode_envelope(data: bytes):
     elif kind == "err":
         payload = ("__err__", header.get("msg", "remote stage failed"))
     else:
-        payload = R._EOS
+        stats = header.get("stats")
+        payload = ("__eos__", stats) if stats else R._EOS
     return header, payload
 
 

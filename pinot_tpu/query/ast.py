@@ -362,6 +362,9 @@ class SelectStatement:
     relation: Relation | None = None  # full FROM tree (multistage engine)
     # EXPLAIN PLAN FOR ... : return the operator tree instead of executing
     explain: bool = False
+    # EXPLAIN ANALYZE ... : execute AND return the tree annotated with the
+    # merged runtime stats
+    explain_analyze: bool = False
 
     @property
     def needs_multistage(self) -> bool:

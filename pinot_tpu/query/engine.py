@@ -229,6 +229,44 @@ class QueryEngine:
             rows.append([f"HOST_EXECUTOR(reason={e})", 1, 0])
         return ResultTable(columns=["Operator", "Operator_Id", "Parent_Id"], rows=rows)
 
+    def _explain_analyze(self, ctx: QueryContext) -> ResultTable:
+        """EXPLAIN ANALYZE: run the query under a private trace and annotate
+        the EXPLAIN tree with the runtime stats — the single-stage path
+        reuses the per-segment InvocationScope spans instead of a separate
+        stats plane."""
+        from pinot_tpu.common.trace import start_trace
+
+        base = self.explain(ctx)
+        t0 = time.perf_counter()
+        with start_trace("explain-analyze") as tr:
+            pend, pruned = self._dispatch_all(ctx)
+            partials, scanned = self._resolve_partials(ctx, pend, pruned)
+            out_rows = self.reduce(ctx, partials)
+        wall_ms = (time.perf_counter() - t0) * 1e3
+        rows = [list(r) for r in base.rows]
+        rows[0][0] += (
+            f" (rows={len(out_rows)}, docsScanned={int(scanned)},"
+            f" segmentsPruned={pruned}, timeMs={wall_ms:.2f})"
+        )
+        # per-segment spans become children of the execution root (the
+        # DEVICE_FUSED_PROGRAM / HOST_EXECUTOR / STARTREE_SWAP row)
+        exec_parent = rows[1][1] if len(rows) > 1 else rows[0][1]
+        nid = max(r[1] for r in rows) + 1
+        for span in tr.to_dict()["spans"]:
+            if not span["name"].startswith("segment:"):
+                continue
+            matched = span.get("attrs", {}).get("numDocsMatched", 0)
+            rows.append(
+                [
+                    f"SEGMENT_SCAN({span['name'][len('segment:'):]},"
+                    f" docsMatched={matched}, wallMs={span['durationMs']})",
+                    nid,
+                    exec_parent,
+                ]
+            )
+            nid += 1
+        return ResultTable(columns=["Operator", "Operator_Id", "Parent_Id"], rows=rows)
+
     def execute(self, sql: str) -> ResultTable:
         """Synchronous execute = submit + immediate resolve (one code path,
         same per-segment accounting/tracing/meters either way)."""
@@ -249,6 +287,8 @@ class QueryEngine:
         ctx = self.make_context(sql)
         if getattr(ctx.statement, "explain", False):
             return lambda: self.explain(ctx)
+        if getattr(ctx.statement, "explain_analyze", False):
+            return lambda: self._explain_analyze(ctx)
         pend, pruned = self._dispatch_all(ctx)
 
         def resolve() -> ResultTable:

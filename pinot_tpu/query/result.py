@@ -28,6 +28,10 @@ class ResultTable:
     # populated when the query ran with `SET trace=true` (the reference
     # attaches a trace JSON blob to BrokerResponse the same way)
     trace: dict | None = None
+    # multistage per-operator runtime stats merged by the root stage
+    # (MultiStageQueryStats -> BrokerResponse `stageStats` parity); None
+    # when collection was off or the query ran on the v1 engine
+    stage_stats: list | None = None
 
     def __post_init__(self):
         self.rows = [[_plain(v) for v in row] for row in self.rows]
@@ -48,6 +52,8 @@ class ResultTable:
         }
         if self.trace is not None:
             d["traceInfo"] = self.trace
+        if self.stage_stats is not None:
+            d["stageStats"] = self.stage_stats
         return d
 
     def __repr__(self) -> str:  # human-friendly table

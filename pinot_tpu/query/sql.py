@@ -183,18 +183,25 @@ class Parser:
             self.expect_op(";")
 
         explain = False
+        analyze = False
         if self.at_kw("EXPLAIN"):
-            # EXPLAIN PLAN FOR <query> (CalciteSqlParser explain parity)
+            # EXPLAIN PLAN FOR <query> (CalciteSqlParser explain parity) or
+            # EXPLAIN ANALYZE <query> (execute + stats-annotated plan tree)
             self.next()
-            if not self.eat_kw("PLAN"):
-                raise SqlParseError("expected PLAN after EXPLAIN")
-            if not self.eat_kw("FOR"):
-                raise SqlParseError("expected FOR after EXPLAIN PLAN")
-            explain = True
+            if self.eat_kw("ANALYZE"):
+                analyze = True
+            else:
+                if not self.eat_kw("PLAN"):
+                    raise SqlParseError("expected PLAN or ANALYZE after EXPLAIN")
+                if not self.eat_kw("FOR"):
+                    raise SqlParseError("expected FOR after EXPLAIN PLAN")
+                explain = True
         stmt = self._query()
         stmt.options.update(options)
         if explain:
             stmt.explain = True
+        if analyze:
+            stmt.explain_analyze = True
         self.eat_op(";")
         t = self.peek()
         if t.kind != "eof":

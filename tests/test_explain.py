@@ -103,6 +103,57 @@ def test_explain_startree_swap():
     assert any(o.startswith("STARTREE_SWAP") for o in ops)
 
 
+def test_explain_analyze_single_stage(setup):
+    """EXPLAIN ANALYZE on the v1 engine: the EXPLAIN tree annotated with
+    actual execution stats plus one SEGMENT_SCAN row per traced segment."""
+    eng, _ = setup
+    res = eng.execute("EXPLAIN ANALYZE SELECT d, SUM(v) FROM t WHERE v > 10 GROUP BY d")
+    assert res.columns == ["Operator", "Operator_Id", "Parent_Id"]
+    root = res.rows[0][0]
+    assert root.startswith("BROKER_REDUCE")
+    assert "rows=2" in root and "docsScanned=" in root and "timeMs=" in root
+    scans = [r for r in res.rows if r[0].startswith("SEGMENT_SCAN(")]
+    assert len(scans) == 1  # one segment in the fixture
+    assert "docsMatched=" in scans[0][0] and "wallMs=" in scans[0][0]
+    # still a well-formed tree
+    ids = {r[1] for r in res.rows}
+    assert all(r[2] in ids or r[2] == -1 for r in res.rows)
+
+
+def test_explain_analyze_multistage(setup):
+    """EXPLAIN ANALYZE on the v2 engine: one row per physical operator with
+    the merged runtime stats inline, stages stitched into one tree."""
+    _, seg = setup
+    m = MultistageEngine({"t": [seg]}, n_workers=2)
+    res = m.execute("EXPLAIN ANALYZE SELECT d, SUM(v) FROM t GROUP BY d ORDER BY d LIMIT 10")
+    assert res.columns == ["Operator", "Operator_Id", "Parent_Id"]
+    ops = [r[0] for r in res.rows]
+    assert any("Scan(t)" in o for o in ops)
+    assert any("Aggregate(" in o for o in ops)
+    # runtime stats are rendered inline on executed operators
+    assert any("rows=" in o and "wallMs=" in o for o in ops)
+    # stage roots carry the distribution/parallelism banner
+    assert any(o.startswith("[stage 0 root x1] ") for o in ops)
+    ids = {r[1] for r in res.rows}
+    assert all(r[2] in ids or r[2] == -1 for r in res.rows)
+    assert res.rows[0][2] == -1
+
+
+def test_explain_analyze_parse():
+    from pinot_tpu.query.sql import parse_sql
+
+    stmt = parse_sql("EXPLAIN ANALYZE SELECT COUNT(*) FROM t")
+    assert stmt.explain_analyze and not stmt.explain
+
+
+def test_explain_analyze_rejected_by_broker():
+    from pinot_tpu.cluster import Broker, Controller, PropertyStore
+
+    broker = Broker(Controller(PropertyStore(), "/tmp/_explain_ds"))
+    with pytest.raises(Exception, match="EXPLAIN"):
+        broker.execute("EXPLAIN ANALYZE SELECT COUNT(*) FROM t")
+
+
 def test_explain_rejected_by_broker():
     from pinot_tpu.cluster import Broker, Controller, PropertyStore
 

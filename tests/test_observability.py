@@ -76,6 +76,189 @@ def test_role_registries_shared():
     reset_registries()
 
 
+# -- histograms / prometheus ------------------------------------------------
+
+
+def test_histogram_percentiles():
+    from pinot_tpu.common.metrics import Histogram
+
+    h = Histogram()
+    for v in range(1, 101):  # 1..100 ms
+        h.update_ms(float(v))
+    assert h.count == 100
+    assert h.min_ms == 1.0 and h.max_ms == 100.0
+    # log-linear buckets carry ~19% max relative error (2^(1/4) ratio)
+    for q, exact in ((0.5, 50.0), (0.95, 95.0), (0.99, 99.0)):
+        est = h.quantile_ms(q)
+        assert exact * 0.8 <= est <= exact * 1.25, (q, est)
+    assert h.quantile_ms(1.0) == 100.0  # clamped to observed max
+    assert h.mean_ms() == pytest.approx(50.5)
+
+
+def test_histogram_empty_and_single_value():
+    from pinot_tpu.common.metrics import Histogram
+
+    h = Histogram()
+    assert h.quantile_ms(0.99) == 0.0
+    h.update_ms(7.0)
+    # clamped to the observed [min, max]: exact extremes survive bucketing
+    assert h.quantile_ms(0.5) == 7.0
+    assert h.quantile_ms(0.99) == 7.0
+    # cumulative bucket pairs end at +inf with the full count
+    bounds, cums = zip(*h.bucket_counts())
+    assert bounds[-1] == float("inf") and cums[-1] == 1
+
+
+def test_timer_snapshot_has_quantiles():
+    reg = MetricsRegistry("test")
+    t = reg.timer("lat")
+    for v in (1.0, 2.0, 3.0, 4.0, 100.0):
+        t.update_ms(v)
+    snap = reg.snapshot()["lat"]
+    assert snap["p99Ms"] == pytest.approx(100.0, rel=0.25)
+    assert snap["p50Ms"] <= snap["p95Ms"] <= snap["p99Ms"]
+    with reg.timer("lat").time():
+        pass
+    assert reg.timer("lat").count == 6
+
+
+def test_prometheus_exposition_format():
+    import re
+
+    from pinot_tpu.common.metrics import prometheus_text
+
+    reg = MetricsRegistry("test")
+    reg.meter("broker.queries").mark(3)
+    reg.gauge("server.segmentCount").set(4)
+    reg.timer("server.queryExecutionMs").update_ms(12.0)
+    reg.histogram("server.scanMs").update_ms(1.5)
+    text = prometheus_text(reg)
+    line_re = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^}]*\})? \S+$")
+    for line in text.strip().splitlines():
+        if not line.startswith("#"):
+            assert line_re.match(line), line
+    assert "pinot_broker_queries_total 3" in text
+    assert "pinot_server_segmentCount 4" in text
+    assert "pinot_server_queryExecutionMs_p99" in text
+    assert "pinot_server_queryExecutionMs_count 1" in text
+    assert 'pinot_server_scanMs_bucket{le="+Inf"} 1' in text
+
+
+# -- multistage stage stats -------------------------------------------------
+
+
+def test_merge_stage_stats_lost_worker():
+    """A worker that never reports simply doesn't contribute; `workers`
+    reflects how many records actually arrived per operator."""
+    from pinot_tpu.multistage.stats import merge_stage_stats
+
+    payload = [
+        {"stage": 1, "op": 0, "operator": "Scan(t)", "worker": 0, "rows": 10, "blocks": 1, "wallMs": 2.0},
+        {"stage": 1, "op": 0, "operator": "Scan(t)", "worker": 1, "rows": 30, "blocks": 1, "wallMs": 6.0},
+        {"stage": 0, "op": 0, "operator": "Collect", "worker": 0, "rows": 40, "blocks": 2, "wallMs": 9.0},
+    ]
+    merged = merge_stage_stats(payload)
+    assert [s["stage"] for s in merged] == [0, 1]
+    scan = merged[1]["operators"][0]
+    assert scan["rows"] == 40 and scan["workers"] == 2
+    assert scan["wallMs"] == pytest.approx(8.0)
+    assert scan["maxWallMs"] == pytest.approx(6.0)
+    assert merged[0]["operators"][0]["workers"] == 1
+    assert merge_stage_stats([]) == []
+
+
+def test_multistage_stage_stats_end_to_end():
+    """SET trace=true on a JOIN + GROUP BY surfaces the merged per-stage
+    operator stats (stageStats tree) in the response."""
+    from pinot_tpu.common import DataType, Schema
+    from pinot_tpu.multistage import MultistageEngine
+    from pinot_tpu.segment import SegmentBuilder
+
+    rng = np.random.default_rng(11)
+    n = 400
+    cust_schema = Schema.build(
+        "customers",
+        dimensions=[("cid", DataType.INT), ("cnation", DataType.STRING)],
+        metrics=[("credit", DataType.LONG)],
+    )
+    cseg = SegmentBuilder(cust_schema).build(
+        {
+            "cid": np.arange(40, dtype=np.int32),
+            "cnation": np.asarray([f"N{i % 5}" for i in range(40)], dtype=object),
+            "credit": rng.integers(0, 100, 40).astype(np.int64),
+        },
+        "customers_0",
+    )
+    order_schema = Schema.build(
+        "orders",
+        dimensions=[("ocid", DataType.INT)],
+        metrics=[("amount", DataType.LONG)],
+    )
+    ob = SegmentBuilder(order_schema)
+    odata = {
+        "ocid": rng.integers(0, 40, n).astype(np.int32),
+        "amount": rng.integers(1, 50, n).astype(np.int64),
+    }
+    osegs = [
+        ob.build({k: v[: n // 2] for k, v in odata.items()}, "orders_0"),
+        ob.build({k: v[n // 2 :] for k, v in odata.items()}, "orders_1"),
+    ]
+    engine = MultistageEngine({"customers": [cseg], "orders": osegs}, n_workers=2)
+    res = engine.execute(
+        "SET trace=true; SELECT c.cnation, SUM(o.amount) FROM orders o "
+        "JOIN customers c ON o.ocid = c.cid GROUP BY c.cnation ORDER BY c.cnation LIMIT 10"
+    )
+    assert len(res.rows) == 5
+    assert res.stage_stats is not None and len(res.stage_stats) >= 3
+    ops = [op for s in res.stage_stats for op in s["operators"]]
+    labels = [op["operator"] for op in ops]
+    assert any(l.startswith("Join(") for l in labels)
+    # the orders side folds into a leaf device partial aggregate; the
+    # customers side keeps its Scan operator
+    assert any(l == "Scan(customers)" for l in labels)
+    scan = next(op for op in ops if op["operator"] == "Scan(customers)")
+    assert scan["rows"] == 40 and scan["workers"] == 2
+    assert max(op["workers"] for op in ops) >= 2
+    assert all(op["wallMs"] >= 0.0 for op in ops)
+    # the response dict carries the tree for HTTP clients
+    assert res.to_dict()["stageStats"] == res.stage_stats
+    # without trace=true the stats plane is fully off
+    res2 = engine.execute("SELECT COUNT(*) FROM orders")
+    assert res2.stage_stats is None
+    assert "stageStats" not in res2.to_dict()
+
+
+# -- slow-query log ---------------------------------------------------------
+
+
+def test_broker_slow_query_log(tmp_path):
+    from pinot_tpu.cluster import Broker, Controller, PropertyStore, Server
+    from pinot_tpu.common import DataType, ObservabilityConfig, Schema, TableConfig
+    from pinot_tpu.segment import SegmentBuilder
+
+    controller = Controller(PropertyStore(), tmp_path / "deepstore")
+    controller.register_server("server_0", Server("server_0"))
+    schema = Schema.build("t", dimensions=[("d", DataType.INT)], metrics=[("v", DataType.LONG)])
+    controller.add_schema(schema)
+    controller.add_table(TableConfig("t"))
+    controller.upload_segment(
+        "t",
+        SegmentBuilder(schema).build(
+            {"d": np.arange(32, dtype=np.int32), "v": np.arange(32, dtype=np.int64)}, "t_0"
+        ),
+    )
+    # threshold 0 -> every query is "slow"; default 1000ms -> none is
+    broker = Broker(controller, obs_config=ObservabilityConfig(slow_query_threshold_ms=0.0))
+    broker.execute("SELECT COUNT(*) FROM t")
+    assert len(broker.slow_queries) == 1
+    entry = broker.slow_queries[0]
+    assert entry["table"] == "t" and entry["timeMs"] >= 0.0
+    assert entry["numRows"] == 1 and "SELECT" in entry["sql"]
+    quiet = Broker(controller)
+    quiet.execute("SELECT COUNT(*) FROM t")
+    assert len(quiet.slow_queries) == 0
+
+
 # -- tracing ----------------------------------------------------------------
 
 

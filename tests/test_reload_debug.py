@@ -69,8 +69,12 @@ def test_reload_via_rest_and_status_page(tmp_path):
         assert "pinot-tpu" in html and "Query Console" in html
         with urllib.request.urlopen(f"http://127.0.0.1:{svc.port}/tables") as resp:
             assert "t" in json.loads(resp.read())["tables"]
-        with urllib.request.urlopen(f"http://127.0.0.1:{svc.port}/metrics") as resp:
+        with urllib.request.urlopen(f"http://127.0.0.1:{svc.port}/metrics?format=json") as resp:
             json.loads(resp.read())
+        # default exposition is Prometheus text 0.0.4
+        with urllib.request.urlopen(f"http://127.0.0.1:{svc.port}/metrics") as resp:
+            assert resp.headers["Content-Type"].startswith("text/plain")
+            resp.read()
     finally:
         svc.stop()
 
@@ -82,9 +86,14 @@ def test_server_debug_and_metrics_endpoints(tmp_path):
         base = f"http://127.0.0.1:{svc.port}"
         with urllib.request.urlopen(f"{base}/debug/queries") as resp:
             assert json.loads(resp.read()) == []  # no in-flight queries
-        with urllib.request.urlopen(f"{base}/metrics") as resp:
+        with urllib.request.urlopen(f"{base}/metrics?format=json") as resp:
             snap = json.loads(resp.read())
         assert isinstance(snap, dict)
+        # default exposition is Prometheus text 0.0.4 with quantile families
+        with urllib.request.urlopen(f"{base}/metrics") as resp:
+            assert resp.headers["Content-Type"] == "text/plain; version=0.0.4"
+            text = resp.read().decode()
+        assert "_p99" in text
         with urllib.request.urlopen(f"{base}/debug/resources") as resp:
             res = json.loads(resp.read())
         assert "stagedDeviceSegments" in res and "schedulerPending" in res
