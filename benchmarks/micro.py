@@ -347,6 +347,61 @@ def bench_stats_overhead(n=200_000, dim=2_000):
     }
 
 
+def bench_deadline_overhead(n=200_000, dim=2_000):
+    """Deadline-plane cost on the v2 hot path: the same multistage
+    join+group-by with no deadline vs a far-future one. The per-block check
+    is `mailbox.deadline is None` plus (when armed) one time.time() compare;
+    time the armed check directly and hold its projected share of the query
+    wall to the <2% budget — the stable form of the wall-clock assertion."""
+    from pinot_tpu.common import DataType, Schema
+    from pinot_tpu.multistage import MultistageEngine
+    from pinot_tpu.query.context import Deadline
+    from pinot_tpu.segment import SegmentBuilder
+
+    rng = np.random.default_rng(17)
+    fact_s = Schema.build("fact", dimensions=[("k", DataType.INT)], metrics=[("m", DataType.LONG)])
+    dim_s = Schema.build("dim", dimensions=[("k", DataType.INT)], metrics=[("w", DataType.LONG)])
+    fact = SegmentBuilder(fact_s).build(
+        {"k": rng.integers(0, dim, n).astype(np.int32), "m": rng.integers(1, 10, n).astype(np.int64)},
+        "f0",
+    )
+    d = SegmentBuilder(dim_s).build(
+        {"k": np.arange(dim, dtype=np.int32), "w": rng.integers(1, 5, dim).astype(np.int64)}, "d0"
+    )
+    eng = MultistageEngine({"fact": [fact], "dim": [d]}, n_workers=2)
+    q = "SELECT dim.k, SUM(fact.m) FROM fact JOIN dim ON fact.k = dim.k GROUP BY dim.k ORDER BY dim.k LIMIT 10"
+    off_ms = _time_host(lambda: eng.execute(q), iters=7)
+    on_ms = _time_host(
+        lambda: eng.execute(q, deadline=Deadline.from_timeout_ms(3_600_000.0)), iters=7
+    )
+
+    # Direct measure of one armed boundary check: a plan this size crosses
+    # well under 1000 operator/block boundaries per query, so per_check_us *
+    # 1000 projected against the query wall must sit inside the 2% budget.
+    dl = Deadline.from_timeout_ms(3_600_000.0)
+    checks = 100_000
+    t0 = time.perf_counter()
+    for _ in range(checks):
+        dl.check("bench")
+    per_check_us = (time.perf_counter() - t0) / checks * 1e6
+    projected_pct = per_check_us * 1000 / (off_ms * 1e3) * 100
+    assert projected_pct < 2.0, (
+        f"deadline check {per_check_us:.2f}µs x1000 = {projected_pct:.2f}% of "
+        f"{off_ms:.1f}ms query — over the 2% hot-loop budget"
+    )
+    return {
+        "metric": "deadline_overhead",
+        "value": round(on_ms - off_ms, 3),
+        "unit": "ms",
+        "n": n,
+        "off_ms": round(off_ms, 3),
+        "on_ms": round(on_ms, 3),
+        "overhead_pct": round((on_ms / off_ms - 1.0) * 100, 1),
+        "check_us": round(per_check_us, 4),
+        "projected_pct_at_1000_checks": round(projected_pct, 3),
+    }
+
+
 ALL = [
     bench_filter_mask,
     bench_grouped_sum_xla,
@@ -361,6 +416,7 @@ ALL = [
     bench_mesh_exchange_join,
     bench_multistage_join_e2e,
     bench_stats_overhead,
+    bench_deadline_overhead,
 ]
 
 

@@ -26,8 +26,15 @@ class ResultSet:
 
     def __init__(self, response: dict):
         self._resp = response
-        if response.get("exceptions"):
-            raise PinotClientError("; ".join(e.get("message", "") for e in response["exceptions"]))
+        # a degraded-but-answered query (allowPartialResults) carries BOTH
+        # rows and exceptions: surface the rows, expose the exceptions;
+        # exceptions WITHOUT a result table are a hard failure
+        self.partial_result: bool = bool(response.get("partialResult"))
+        self.exceptions: list[dict] = list(response.get("exceptions") or [])
+        if self.exceptions and not (self.partial_result and response.get("resultTable")):
+            raise PinotClientError(
+                "; ".join(e.get("message", "") for e in self.exceptions)
+            )
         rt = response.get("resultTable") or {}
         schema = rt.get("dataSchema") or {}
         self.columns: list[str] = schema.get("columnNames", [])
@@ -44,7 +51,14 @@ class ResultSet:
     def execution_stats(self) -> dict:
         return {
             k: self._resp.get(k)
-            for k in ("numDocsScanned", "totalDocs", "numSegmentsQueried", "timeUsedMs")
+            for k in (
+                "numDocsScanned",
+                "totalDocs",
+                "numSegmentsQueried",
+                "timeUsedMs",
+                "numServersQueried",
+                "numServersResponded",
+            )
         }
 
     def to_pandas(self):
@@ -87,7 +101,23 @@ class Connection:
         brokers = RemoteControllerClient(self._controller_url).brokers()
         return sorted(brokers.values())
 
-    def execute(self, sql: str, retries_per_broker: int = 1) -> ResultSet:
+    def execute(
+        self,
+        sql: str,
+        retries_per_broker: int = 1,
+        timeout_ms: float | None = None,
+        allow_partial_results: bool | None = None,
+    ) -> ResultSet:
+        """timeout_ms / allow_partial_results become per-query SET options
+        (`timeoutMs`, `allowPartialResults`) prepended to the statement —
+        the java client's query-options map."""
+        opts = []
+        if timeout_ms is not None:
+            opts.append(f"SET timeoutMs = {float(timeout_ms):g};")
+        if allow_partial_results is not None:
+            opts.append(f"SET allowPartialResults = {str(bool(allow_partial_results)).lower()};")
+        if opts:
+            sql = " ".join(opts) + " " + sql
         last_err: Exception | None = None
         for attempt in range(retries_per_broker + 1):
             for url in self._selector.urls_in_order():
@@ -105,6 +135,25 @@ class Connection:
             if attempt < retries_per_broker:
                 time.sleep(0.05 * (attempt + 1))
         raise PinotClientError(f"all brokers unreachable: {last_err}")
+
+    def cancel(self, query_id: str) -> bool:
+        """DELETE /query/{id} against each broker until one knows the id
+        (the cancel REST surface; ids come from GET /queries)."""
+        import json as _json
+        import urllib.error
+        import urllib.request
+
+        for url in self._selector.urls_in_order():
+            req = urllib.request.Request(
+                f"{url.rstrip('/')}/query/{query_id}", method="DELETE"
+            )
+            try:
+                with urllib.request.urlopen(req, timeout=5.0) as resp:
+                    if _json.loads(resp.read()).get("cancelled"):
+                        return True
+            except (urllib.error.URLError, OSError):
+                continue
+        return False
 
     # -- PEP-249 shim (pinot-jdbc-client parity) -----------------------------
 

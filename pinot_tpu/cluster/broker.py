@@ -54,6 +54,25 @@ def _collect_tables(stmt) -> list[str]:
 _request_seq = itertools.count()
 
 
+class _PartialState:
+    """Per-query degradation collector. Counts scattered/answered servers and,
+    when `allow` (allowPartialResults), records server failures as structured
+    exceptions instead of letting the query die — the broker then returns the
+    merged rows it has with partialResult=true (BrokerResponseNative
+    partial-response parity)."""
+
+    def __init__(self, allow: bool):
+        self.allow = allow
+        self.partial = False
+        self.exceptions: list[dict] = []
+        self.servers_queried = 0
+        self.servers_responded = 0
+
+    def record(self, message: str, error_code: int = 200) -> None:
+        self.partial = True
+        self.exceptions.append({"errorCode": error_code, "message": message})
+
+
 class Broker:
     def __init__(
         self,
@@ -66,6 +85,7 @@ class Broker:
         tenant_tags: list[str] | None = None,
         access_control=None,
         obs_config=None,
+        resilience=None,
     ):
         """selector: instance selector (Balanced default; ReplicaGroup /
         Adaptive from cluster.routing). failure_detector: optional
@@ -73,11 +93,14 @@ class Broker:
         connection-failure failover. Per-table QPS quotas come from
         TableConfig.extra['queryQuotaQps']; query_logger is an optional
         cluster.quota.QueryLogger. obs_config: common.config.ObservabilityConfig
-        controlling the structured slow-query log."""
+        controlling the structured slow-query log. resilience:
+        common.config.ResilienceConfig — default query timeout, partial-result
+        policy, and fault-injection rules (applied to the process-global
+        injector when non-empty)."""
         import collections
 
         from pinot_tpu.cluster.quota import QueryQuotaManager
-        from pinot_tpu.common.config import ObservabilityConfig
+        from pinot_tpu.common.config import ObservabilityConfig, ResilienceConfig
 
         self.controller = controller
         #: broker-tenant membership; None = serve every table (untagged
@@ -94,20 +117,97 @@ class Broker:
         #: structured slow-query ring buffer (newest last); entries also go
         #: to the pinot_tpu.slowquery logger as one JSON line each
         self.slow_queries = collections.deque(maxlen=self.obs_config.slow_query_log_max_entries)
+        self.resilience = resilience if resilience is not None else ResilienceConfig()
+        if self.resilience.faults:
+            from pinot_tpu.common.faults import FAULTS
+
+            FAULTS.configure(self.resilience.faults, seed=self.resilience.fault_seed)
+        # query id -> {"sql", "deadline", "startMs"} for every in-flight query
+        # (ServerQueryLogger running-query registry parity; DELETE /query/{id})
+        self._running: dict[str, dict] = {}
+        self._running_lock = threading.Lock()
         self._pool = ThreadPoolExecutor(max_workers=max_scatter_threads)
         self._dispatcher = None
         self._dispatcher_lock = threading.Lock()
 
+    # -- cancellation / running-query registry --------------------------------
+
+    def running_queries(self) -> list[dict]:
+        """[{queryId, sql, startMs}] for queries currently executing here."""
+        with self._running_lock:
+            return [
+                {"queryId": qid, "sql": ent["sql"], "startMs": ent["startMs"]}
+                for qid, ent in sorted(self._running.items())
+            ]
+
+    def cancel_query(self, qid: str) -> bool:
+        """Cancel an in-flight query: flip its cancel flag (observed by the
+        broker's own gather/reduce loops), fan out to every server (v1
+        partials and v2 stage workers check the same flag), and tombstone the
+        query's mailboxes so straggler blocks are dropped. Returns whether
+        any participant knew the id."""
+        with self._running_lock:
+            ent = self._running.get(qid)
+        found = ent is not None
+        if ent is not None:
+            ent["deadline"].cancel()
+        for srv in self.controller.servers().values():
+            cancel = getattr(srv, "cancel_query", None)
+            if cancel is None:
+                continue
+            try:
+                found = bool(cancel(qid)) or found
+            except Exception:
+                # a server that can't be reached for the cancel is already
+                # failing the query its own way; best-effort fan-out
+                pass
+        disp = self._dispatcher
+        if disp is not None and qid in disp.registry.live_queries():
+            disp.registry.close(qid)
+            found = True
+        return found
+
     def execute(self, sql: str, identity: str | None = None) -> ResultTable:
         from pinot_tpu.common.metrics import BrokerMeter, BrokerTimer, broker_metrics
         from pinot_tpu.common.trace import start_trace
+        from pinot_tpu.query.context import (
+            Deadline,
+            QueryCancelledError,
+            QueryTimeoutError,
+            query_option,
+        )
 
         bm = broker_metrics()
         bm.meter(BrokerMeter.QUERIES).mark()
         table = ""
+        qid = f"q{next(_request_seq)}"
+        deadline: Deadline | None = None
+        timeout_ms: float | None = None
         try:
             with bm.timer(BrokerTimer.QUERY_TOTAL).time():
                 stmt = parse_sql(sql)
+                raw_timeout = query_option(
+                    stmt.options, "timeoutMs", self.resilience.default_timeout_ms
+                )
+                timeout_ms = float(raw_timeout) if raw_timeout is not None else None
+                deadline = Deadline.from_timeout_ms(timeout_ms)
+                allow_partial = (
+                    str(
+                        query_option(
+                            stmt.options,
+                            "allowPartialResults",
+                            self.resilience.allow_partial_results,
+                        )
+                    ).lower()
+                    == "true"
+                )
+                partial = _PartialState(allow_partial)
+                with self._running_lock:
+                    self._running[qid] = {
+                        "sql": sql,
+                        "deadline": deadline,
+                        "startMs": time.time() * 1e3,
+                    }
                 table = getattr(stmt, "from_table", None) or ""
                 if self.access_control is not None:
                     from pinot_tpu.cluster.access import READ
@@ -118,11 +218,21 @@ class Broker:
                     self.quota.acquire(table)
                 if stmt.options.get("trace", "").lower() == "true":
                     # per-query tracing (Tracing.java + `trace=true` query option)
-                    with start_trace(request_id=f"q{next(_request_seq)}") as tr:
-                        result = self._execute(stmt, sql)
+                    with start_trace(request_id=qid) as tr:
+                        result = self._execute(stmt, sql, deadline=deadline, qid=qid, partial=partial)
                     result.trace = tr.to_dict()
                 else:
-                    result = self._execute(stmt, sql)
+                    result = self._execute(stmt, sql, deadline=deadline, qid=qid, partial=partial)
+                # a cancel acknowledged mid-flight must not turn into a
+                # success: the execution may have raced past every check
+                deadline.check("post-execute")
+            if partial.partial:
+                bm.meter(BrokerMeter.PARTIAL_RESPONSES).mark()
+                result.partial_result = True
+                result.exceptions = list(partial.exceptions)
+            if partial.servers_queried:
+                result.num_servers_queried = partial.servers_queried
+                result.num_servers_responded = partial.servers_responded
             if self.query_logger is not None:
                 self.query_logger.log(sql, table, result.time_used_ms, result.num_docs_scanned)
             self._log_slow_query(sql, table, result)
@@ -131,7 +241,25 @@ class Broker:
             bm.meter(BrokerMeter.REQUEST_FAILURES).mark()
             if self.query_logger is not None:
                 self.query_logger.log(sql, table, 0.0, 0, exception=type(e).__name__)
+            # central outcome mapping: whatever low-level error the deadline or
+            # cancel flag surfaced as (mailbox RuntimeError, connection reset,
+            # worker error tuple), the caller sees the distinct error class
+            if deadline is not None and deadline.cancelled:
+                bm.meter(BrokerMeter.QUERIES_CANCELLED).mark()
+                if isinstance(e, QueryCancelledError):
+                    raise
+                raise QueryCancelledError(f"query {qid} cancelled: {e}") from e
+            if deadline is not None and deadline.expired:
+                bm.meter(BrokerMeter.QUERIES_TIMED_OUT).mark()
+                if isinstance(e, QueryTimeoutError):
+                    raise
+                raise QueryTimeoutError(
+                    f"query {qid} timed out after {timeout_ms:.0f}ms: {e}"
+                ) from e
             raise
+        finally:
+            with self._running_lock:
+                self._running.pop(qid, None)
 
     def _log_slow_query(self, sql: str, table: str, result: ResultTable) -> None:
         """Structured slow-query log (the reference's broker query-log WARN
@@ -154,7 +282,7 @@ class Broker:
         self.slow_queries.append(entry)
         logging.getLogger("pinot_tpu.slowquery").warning(json.dumps(entry, sort_keys=True))
 
-    def _execute(self, stmt, sql: str) -> ResultTable:
+    def _execute(self, stmt, sql: str, deadline=None, qid=None, partial=None) -> ResultTable:
         t0 = time.perf_counter()
         if getattr(stmt, "explain", False) or getattr(stmt, "explain_analyze", False):
             # failing loudly beats silently executing the query and returning
@@ -168,7 +296,7 @@ class Broker:
         # joins/subqueries/set-ops/windows, or explicit SET useMultistageEngine
         use_v2 = stmt.needs_multistage or stmt.options.get("useMultistageEngine", "").lower() == "true"
         if use_v2:
-            return self._execute_multistage(stmt, sql)
+            return self._execute_multistage(stmt, sql, deadline=deadline, qid=qid)
         table = stmt.from_table
         offline_cfg = self.controller.get_table(table)
         rt_name = f"{table}_REALTIME"
@@ -192,6 +320,14 @@ class Broker:
         schema = self.controller.get_schema(table) or self.controller.get_schema(rt_name)
         self._expand_star(stmt, schema)
         ctx = QueryContext.from_statement(stmt)
+        ctx.deadline = deadline
+        # the deadline and query id ride the hints dict to every server (so
+        # any server-handle shape carries them); servers pop the markers,
+        # rebuild a local Deadline, and register it for cancel fan-out
+        if deadline is not None and deadline.deadline_ts is not None:
+            ctx.hints["__deadlineTs__"] = deadline.deadline_ts
+        if qid is not None:
+            ctx.hints["__queryId__"] = qid
 
         # legs: (physical table, sql text). Hybrid tables split on the time
         # boundary (TimeBoundaryManager parity): offline <= boundary < realtime
@@ -219,11 +355,13 @@ class Broker:
             # memory stays bounded by (needed rows + one frame), and servers
             # stop producing once the LIMIT is satisfied
             # (StreamingReduceService parity)
-            return self._execute_streaming(ctx, legs, all_meta, t0)
+            return self._execute_streaming(ctx, legs, all_meta, t0, partial=partial)
 
         partials, scanned, queried, pruned = [], 0, 0, 0
         for leg_table, leg_sql in legs:
-            p, s, q, pr = self._scatter_leg(ctx, leg_table, leg_sql)
+            if deadline is not None:
+                deadline.check(f"scatter {leg_table}")
+            p, s, q, pr = self._scatter_leg(ctx, leg_table, leg_sql, partial=partial)
             partials.extend(p)
             scanned += s
             queried += q
@@ -240,26 +378,33 @@ class Broker:
             time_used_ms=(time.perf_counter() - t0) * 1e3,
         )
 
-    def _execute_streaming(self, ctx: QueryContext, legs, all_meta, t0) -> ResultTable:
+    def _execute_streaming(self, ctx: QueryContext, legs, all_meta, t0, partial=None) -> ResultTable:
         """Selection-only streaming scatter/gather: all servers stream in
         parallel into one bounded frame queue (memory stays bounded by
         queue depth x frame size); the incremental reduce appends rows and
         signals every stream to stop the moment offset+limit rows are
         gathered. Connection failures fail over to a surviving replica once,
-        like the non-streaming scatter."""
+        like the non-streaming scatter; under allowPartialResults a failed
+        failover degrades to the rows gathered so far instead of raising."""
         need = ctx.offset + ctx.limit
         rows: list[list] = []
         state = {"scanned": 0, "frames": 0}
         queried = 0
         pruned = 0
         for leg_table, leg_sql in legs:
+            if ctx.deadline is not None:
+                ctx.deadline.check(f"stream scatter {leg_table}")
             plan, servers, ideal, n_candidates, leg_pruned = self._route_leg(ctx, leg_table)
             queried += n_candidates
             pruned += leg_pruned
             hints = dict(ctx.hints)
             failed = self._drain_streams(
-                plan, servers, leg_table, leg_sql, hints, need, rows, state
+                plan, servers, leg_table, leg_sql, hints, need, rows, state,
+                deadline=ctx.deadline,
             )
+            if partial is not None:
+                partial.servers_queried += len(plan)
+                partial.servers_responded += len(plan) - len(failed)
             if failed and len(rows) < need:
                 # one failover round on surviving replicas (connection-failure
                 # parity with _scatter_leg)
@@ -271,16 +416,28 @@ class Broker:
                 }
                 plan2, unroutable = self.selector.select(retry_ideal, retry_segs)
                 if unroutable:
-                    raise RuntimeError(
-                        f"servers {sorted(bad)} unreachable and no surviving replica for {unroutable}"
-                    ) from failed[0][2]
+                    if partial is None or not partial.allow:
+                        raise RuntimeError(
+                            f"servers {sorted(bad)} unreachable and no surviving replica for {unroutable}"
+                        ) from failed[0][2]
+                    partial.record(
+                        f"servers {sorted(bad)} unreachable and no surviving "
+                        f"replica for {sorted(unroutable)}: {failed[0][2]}"
+                    )
                 still = self._drain_streams(
-                    plan2, servers, leg_table, leg_sql, hints, need, rows, state
-                )
+                    plan2, servers, leg_table, leg_sql, hints, need, rows, state,
+                    deadline=ctx.deadline,
+                ) if plan2 else []
+                if partial is not None:
+                    partial.servers_queried += len(plan2)
+                    partial.servers_responded += len(plan2) - len(still)
                 if still:
-                    raise RuntimeError(
-                        f"streaming retry failed for servers {[sid for sid, _, _ in still]}"
-                    ) from still[0][2]
+                    if partial is None or not partial.allow:
+                        raise RuntimeError(
+                            f"streaming retry failed for servers {[sid for sid, _, _ in still]}"
+                        ) from still[0][2]
+                    for sid, _segs, exc in still:
+                        partial.record(f"streaming retry failed for server {sid}: {exc}")
             if len(rows) >= need:
                 break
         rows = rows[ctx.offset : need]
@@ -295,11 +452,12 @@ class Broker:
             time_used_ms=(time.perf_counter() - t0) * 1e3,
         )
 
-    def _drain_streams(self, plan, servers, table, sql, hints, need, rows, state):
+    def _drain_streams(self, plan, servers, table, sql, hints, need, rows, state, deadline=None):
         """Pump every server's stream concurrently into a bounded queue and
         append rows until `need` is reached. Returns [(sid, segs, exc)] for
         servers that failed with a connection-class error; other exceptions
-        propagate."""
+        propagate. The gather loop polls the query deadline so a hung server
+        stream cannot wedge the broker thread past expiry."""
         import queue as _queue
 
         from pinot_tpu.cluster.routing import AdaptiveServerSelector
@@ -347,7 +505,18 @@ class Broker:
         failed = []
         error = None
         while pending:
-            msg = out_q.get()
+            if deadline is not None:
+                try:
+                    deadline.check("stream gather")
+                except Exception:
+                    stop.set()  # release the pumps before surfacing the expiry
+                    raise
+                try:
+                    msg = out_q.get(timeout=0.2)
+                except _queue.Empty:
+                    continue
+            else:
+                msg = out_q.get()
             kind = msg[0]
             if kind == "frame":
                 frame, matched, _seg_docs = msg[1]
@@ -399,7 +568,7 @@ class Broker:
             raise RuntimeError(f"no ONLINE replica for segments: {unroutable}")
         return plan, self.controller.servers(), ideal, len(candidates), pruned
 
-    def _scatter_leg(self, ctx: QueryContext, table: str, sql: str):
+    def _scatter_leg(self, ctx: QueryContext, table: str, sql: str, partial=None):
         """Route + scatter one physical table, re-routing briefly when a
         query lands exactly in a segment-rollover commit window (the routed
         CONSUMING name is transiently unresolvable on a single replica —
@@ -407,8 +576,10 @@ class Broker:
         fail over to other replicas inside the single attempt."""
         last: RuntimeError | None = None
         for attempt in range(4):
+            if ctx.deadline is not None:
+                ctx.deadline.check(f"scatter {table}")
             try:
-                return self._scatter_leg_once(ctx, table, sql)
+                return self._scatter_leg_once(ctx, table, sql, partial=partial)
             except RuntimeError as e:
                 if "does not host segments" not in str(e):
                     raise
@@ -416,15 +587,19 @@ class Broker:
                 time.sleep(0.05 * (attempt + 1))  # commit windows are short
         raise last
 
-    def _scatter_leg_once(self, ctx: QueryContext, table: str, sql: str):
+    def _scatter_leg_once(self, ctx: QueryContext, table: str, sql: str, partial=None):
         """One route + scatter pass: prune on stats/partitions, select
         replicas (excluding failure-detected servers), fan out, retry
         connection failures on other replicas once. Returns
-        (partials, scanned, num_segments_queried, num_segments_pruned)."""
+        (partials, scanned, num_segments_queried, num_segments_pruned).
+        When `partial` allows it, a failed failover records the loss and the
+        reduce proceeds over the partials that did arrive."""
         from pinot_tpu.cluster.routing import AdaptiveServerSelector
 
         plan, servers, ideal, n_candidates, pruned = self._route_leg(ctx, table)
         hints = dict(ctx.hints)
+        if partial is not None:
+            partial.servers_queried += len(plan)
 
         from pinot_tpu.common.trace import active_trace, run_traced
 
@@ -437,8 +612,15 @@ class Broker:
             try:
                 out = run_traced(trace, servers[sid].execute_partials, table, sql, segs, hints)
             except RuntimeError as e:
-                if self.failure_detector is not None and "unreachable" in str(e):
-                    self.failure_detector.mark_failure(sid)
+                # connection-class failures enter the failover/degradation
+                # path when a failure detector is watching OR the query opted
+                # into partial results; otherwise they stay hard errors
+                degradable = self.failure_detector is not None or (
+                    partial is not None and partial.allow
+                )
+                if degradable and "unreachable" in str(e):
+                    if self.failure_detector is not None:
+                        self.failure_detector.mark_failure(sid)
                     return ("__failed__", sid, segs, e)
                 raise
             if self.failure_detector is not None:
@@ -456,9 +638,12 @@ class Broker:
         results = list(self._pool.map(scatter, plan.items())) if plan else []
         failed = [r for r in results if isinstance(r, tuple) and r and r[0] == "__failed__"]
         results = [r for r in results if not (isinstance(r, tuple) and r and r[0] == "__failed__")]
+        if partial is not None:
+            partial.servers_responded += len(plan) - len(failed)
         if failed:
             # one retry round on surviving replicas (connection-failure
-            # failover; a second failure is a hard error)
+            # failover; a second failure is a hard error — or, under
+            # allowPartialResults, a recorded loss)
             bad_servers = {f[1] for f in failed}
             retry_segs = [s for f in failed for s in f[2]]
             retry_ideal = {
@@ -467,13 +652,29 @@ class Broker:
             }
             plan2, unroutable2 = self.selector.select(retry_ideal, retry_segs)
             if unroutable2:
-                raise RuntimeError(
-                    f"servers {sorted(bad_servers)} unreachable and no surviving replica for {unroutable2}"
-                ) from failed[0][3]
-            retry_results = list(self._pool.map(scatter, plan2.items()))
+                if partial is None or not partial.allow:
+                    raise RuntimeError(
+                        f"servers {sorted(bad_servers)} unreachable and no surviving replica for {unroutable2}"
+                    ) from failed[0][3]
+                partial.record(
+                    f"servers {sorted(bad_servers)} unreachable and no surviving "
+                    f"replica for {sorted(unroutable2)}: {failed[0][3]}"
+                )
+            retry_results = list(self._pool.map(scatter, plan2.items())) if plan2 else []
             still = [r for r in retry_results if isinstance(r, tuple) and r and r[0] == "__failed__"]
+            retry_results = [
+                r for r in retry_results if not (isinstance(r, tuple) and r and r[0] == "__failed__")
+            ]
+            if partial is not None:
+                partial.servers_queried += len(plan2)
+                partial.servers_responded += len(plan2) - len(still)
             if still:
-                raise RuntimeError(f"retry failed for servers {[f[1] for f in still]}") from still[0][3]
+                if partial is None or not partial.allow:
+                    raise RuntimeError(
+                        f"retry failed for servers {[f[1] for f in still]}"
+                    ) from still[0][3]
+                for f in still:
+                    partial.record(f"retry failed for server {f[1]}: {f[3]}")
             results.extend(retry_results)
 
         partials, scanned = [], 0
@@ -482,7 +683,7 @@ class Broker:
             scanned += matched
         return partials, scanned, n_candidates, pruned
 
-    def _execute_multistage(self, stmt, sql: str) -> ResultTable:
+    def _execute_multistage(self, stmt, sql: str, deadline=None, qid=None) -> ResultTable:
         """Dispatch the v2 engine over one replica of each segment.
 
         Reference parity: QueryDispatcher.submitAndReduce
@@ -555,6 +756,8 @@ class Broker:
                     server_urls=server_urls,
                     total_docs=total_docs,
                     row_counts=table_docs,
+                    qid=qid,
+                    deadline=deadline,
                 )
                 scope.set_attr("numRows", len(result.rows))
             return result
@@ -587,7 +790,7 @@ class Broker:
         # per-operator runtime stats surface via result.stage_stats when
         # trace=true; the dispatch-level span bounds the whole v2 execution
         with InvocationScope("multistage:dispatch", tables=list(catalog)) as scope:
-            result = engine.execute(sql, stmt=stmt)
+            result = engine.execute(sql, stmt=stmt, deadline=deadline)
             scope.set_attr("numRows", len(result.rows))
         return result
 

@@ -108,7 +108,15 @@ class BrokerHTTPService:
                     payload = json.dumps({"exceptions": [{"message": str(e)}]}).encode()
                     self.send_response(403)
                 except Exception as e:  # error surface parity: exceptions JSON
-                    payload = json.dumps({"exceptions": [{"message": str(e)}]}).encode()
+                    # QueryTimeoutError/QueryCancelledError carry distinct
+                    # error codes (BrokerResponse errorCode parity)
+                    payload = json.dumps(
+                        {
+                            "exceptions": [
+                                {"errorCode": getattr(e, "error_code", 200), "message": str(e)}
+                            ]
+                        }
+                    ).encode()
                     self.send_response(200)
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Content-Length", str(len(payload)))
@@ -133,6 +141,37 @@ class BrokerHTTPService:
                     # structured slow-query ring buffer (broker-side triage)
                     payload = json.dumps(list(svc.broker.slow_queries)).encode()
                     self.send_response(200)
+                    self.send_header("Content-Type", "application/json")
+                    self.send_header("Content-Length", str(len(payload)))
+                    self.end_headers()
+                    self.wfile.write(payload)
+                elif self.path == "/queries":
+                    # in-flight query listing (ClusterInfoAccessor running
+                    # queries parity); ids here feed DELETE /query/{id}
+                    payload = json.dumps(svc.broker.running_queries()).encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type", "application/json")
+                    self.send_header("Content-Length", str(len(payload)))
+                    self.end_headers()
+                    self.wfile.write(payload)
+                else:
+                    self.send_error(404)
+
+            def do_DELETE(self):
+                # DELETE /query/{id}: cancel an in-flight query
+                # (PinotClientRequest.cancelQuery REST parity)
+                parts = [p for p in self.path.split("/") if p]
+                if len(parts) == 2 and parts[0] == "query":
+                    try:
+                        found = svc.broker.cancel_query(parts[1])
+                    except Exception as e:
+                        payload = json.dumps({"error": f"{type(e).__name__}: {e}"}).encode()
+                        self.send_response(500)
+                    else:
+                        payload = json.dumps(
+                            {"queryId": parts[1], "cancelled": bool(found)}
+                        ).encode()
+                        self.send_response(200 if found else 404)
                     self.send_header("Content-Type", "application/json")
                     self.send_header("Content-Length", str(len(payload)))
                     self.end_headers()
@@ -175,6 +214,23 @@ class ServerHTTPService:
                         body = json.loads(self.rfile.read(n) or b"{}")
                         svc.server.multistage_submit(body)
                         payload = b'{"status": "started"}'
+                        self.send_response(200)
+                    except Exception as e:
+                        payload = json.dumps({"error": f"{type(e).__name__}: {e}"}).encode()
+                        self.send_response(500)
+                    self.send_header("Content-Type", "application/json")
+                    self.send_header("Content-Length", str(len(payload)))
+                    self.end_headers()
+                    self.wfile.write(payload)
+                    return
+                if self.path == "/query/cancel":
+                    # broker cancel fan-out target: flip the cancel flag on an
+                    # in-flight v1 partial execution or v2 stage workers
+                    n = int(self.headers.get("Content-Length", 0))
+                    try:
+                        body = json.loads(self.rfile.read(n) or b"{}")
+                        found = svc.server.cancel_query(body.get("queryId", ""))
+                        payload = json.dumps({"found": bool(found)}).encode()
                         self.send_response(200)
                     except Exception as e:
                         payload = json.dumps({"error": f"{type(e).__name__}: {e}"}).encode()
@@ -337,6 +393,17 @@ class RemoteServerClient:
         self.base_url = base_url.rstrip("/")
         self.timeout = timeout
 
+    def _hop_timeout(self, hints: dict | None) -> float:
+        """Per-call socket timeout bounded by the query deadline riding in the
+        hints markers: a hop must not outlive the query (+0.5s grace so the
+        server-side deadline error wins the race and reaches the broker)."""
+        import time as _time
+
+        dl = (hints or {}).get("__deadlineTs__")
+        if dl is None:
+            return self.timeout
+        return max(0.1, min(self.timeout, float(dl) - _time.time() + 0.5))
+
     def execute_partials(self, table: str, sql: str, segment_names: list[str], hints: dict | None = None):
         body = json.dumps(
             {"table": table, "sql": sql, "segments": segment_names, "hints": hints or {}}
@@ -345,13 +412,22 @@ class RemoteServerClient:
             self.base_url + "/query", data=body, headers={"Content-Type": "application/json"}
         )
         try:
-            with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+            with urllib.request.urlopen(req, timeout=self._hop_timeout(hints)) as resp:
                 return datatable.decode(resp.read())
         except urllib.error.HTTPError as e:
             detail = e.read().decode(errors="replace")
             raise RuntimeError(f"server error from {self.base_url}: {detail}") from None
         except (TimeoutError, OSError) as e:
             raise RuntimeError(f"server {self.base_url} unreachable: {e}") from None
+
+    def cancel_query(self, qid: str) -> bool:
+        """Fan-out target for Broker.cancel_query; False when the server
+        doesn't know the id (or can't be reached — it is failing the query
+        its own way)."""
+        try:
+            return bool(self._post_json("/query/cancel", {"queryId": qid}).get("found"))
+        except RuntimeError:
+            return False
 
     def execute_partials_stream(
         self, table: str, sql: str, segment_names: list[str], hints: dict | None = None, max_rows: int | None = None
@@ -372,7 +448,7 @@ class RemoteServerClient:
         req = urllib.request.Request(
             self.base_url + "/query/stream", data=body, headers={"Content-Type": "application/json"}
         )
-        resp = urllib.request.urlopen(req, timeout=self.timeout)
+        resp = urllib.request.urlopen(req, timeout=self._hop_timeout(hints))
         try:
             while True:
                 hdr = resp.read(4)
@@ -529,6 +605,25 @@ class ControllerHTTPService:
                     elif len(parts) == 2 and parts[0] == "schemas":
                         c.delete_schema(parts[1])
                         self._json({"status": "ok"})
+                    elif len(parts) == 2 and parts[0] == "query":
+                        # cancel proxy (PinotRunningQueryResource parity): the
+                        # client knows only the controller; try every broker
+                        qid = parts[1]
+                        cancelled_on = []
+                        for bid, base_url in sorted(c.brokers().items()):
+                            req = urllib.request.Request(
+                                f"{base_url.rstrip('/')}/query/{qid}", method="DELETE"
+                            )
+                            try:
+                                with urllib.request.urlopen(req, timeout=5.0) as resp:
+                                    if json.loads(resp.read()).get("cancelled"):
+                                        cancelled_on.append(bid)
+                            except (urllib.error.URLError, OSError):
+                                continue
+                        self._json(
+                            {"queryId": qid, "cancelled": bool(cancelled_on), "brokers": cancelled_on},
+                            200 if cancelled_on else 404,
+                        )
                     else:
                         self._json({"error": "not found"}, 404)
                 except ValueError as e:

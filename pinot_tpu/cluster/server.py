@@ -33,6 +33,9 @@ class Server:
         self._engines: dict[str, QueryEngine] = {}
         self._realtime: dict[str, object] = {}  # table -> RealtimeTableManager
         self._lock = threading.RLock()
+        # query id -> Deadline of an in-flight query (cancellation fan-out
+        # target; QueryThreadContext registry parity)
+        self._running: dict[str, object] = {}
 
         self._fast32 = fast32
         self._scheduler = scheduler
@@ -42,6 +45,35 @@ class Server:
     def shutdown(self) -> None:
         if self._scheduler is not None:
             self._scheduler.stop()
+
+    # -- cancellation ---------------------------------------------------------
+
+    def _register_query(self, qid: str | None, deadline) -> None:
+        if qid is not None and deadline is not None:
+            with self._lock:
+                self._running[qid] = deadline
+
+    def _unregister_query(self, qid: str | None) -> None:
+        if qid is not None:
+            with self._lock:
+                self._running.pop(qid, None)
+
+    def running_queries(self) -> list[str]:
+        with self._lock:
+            return sorted(self._running)
+
+    def cancel_query(self, qid: str) -> bool:
+        """Set the cancel flag on an in-flight query (v1 partials or
+        multistage workers) and tombstone-close its mailboxes. Returns
+        whether the query was found here."""
+        with self._lock:
+            deadline = self._running.get(qid)
+            reg = getattr(self, "_mailbox_registry", None)
+        if deadline is not None:
+            deadline.cancel()
+        if reg is not None and qid in reg.live_queries():
+            reg.close(qid)
+        return deadline is not None
 
     # -- realtime ------------------------------------------------------------
 
@@ -144,8 +176,16 @@ class Server:
                     )
                 objs.append(got)
             segments[table] = objs
+        from pinot_tpu.query.context import Deadline
+
+        qid = body["query_id"]
+        deadline_ts = body.get("deadline_ts")
+        deadline = Deadline(float(deadline_ts) if deadline_ts is not None else None)
+        # register BEFORE starting workers: a cancel racing the submit must
+        # find the entry (on_done unregisters once the last worker finishes)
+        self._register_query(qid, deadline)
         run_assigned_stages(
-            qid=body["query_id"],
+            qid=qid,
             my_id=body.get("target", self.server_id),
             sql=body["sql"],
             schemas=body["schemas"],
@@ -157,6 +197,8 @@ class Server:
             registry=self.mailbox_registry,
             receive_timeout=float(body.get("receive_timeout", 60.0)),
             row_counts={k: int(v) for k, v in (body.get("row_counts") or {}).items()},
+            deadline=deadline,
+            on_done=lambda: self._unregister_query(qid),
         )
 
     def _engine(self, table: str) -> QueryEngine:
@@ -211,31 +253,41 @@ class Server:
                     f"server {self.server_id} does not host segments "
                     f"{sorted(truly_missing)} of table {table!r}"
                 )
+        from pinot_tpu.common.faults import FAULTS
+        from pinot_tpu.common.metrics import ServerMeter, server_metrics
+
+        hints, deadline, broker_qid = self._pop_resilience_hints(hints)
         eng = self._engine(table)
         ctx = eng.make_context(sql)
         if hints:
             ctx.hints.update(hints)
-        from pinot_tpu.common.metrics import ServerMeter, server_metrics
-
+        ctx.deadline = deadline
         server_metrics().meter(ServerMeter.QUERIES).mark()
-        emitted = 0
-        for seg, partial, matched in eng.partials_iter(ctx, segs):
-            if hasattr(partial, "iloc"):  # selection frame: chunk it
-                start = 0
-                n = len(partial)
-                while start < n:
-                    chunk = partial.iloc[start : start + self.STREAM_FRAME_ROWS]
-                    yield chunk, (matched if start == 0 else 0), (seg.n_docs if start == 0 else 0)
-                    emitted += len(chunk)
-                    start += self.STREAM_FRAME_ROWS
-                    if max_rows is not None and emitted >= max_rows:
-                        return
-                if n == 0:
+        self._register_query(broker_qid, deadline)
+        try:
+            emitted = 0
+            for seg, partial, matched in eng.partials_iter(ctx, segs):
+                FAULTS.maybe_fail("stream.consume")
+                if deadline is not None:
+                    deadline.check(f"stream {seg.name}")
+                if hasattr(partial, "iloc"):  # selection frame: chunk it
+                    start = 0
+                    n = len(partial)
+                    while start < n:
+                        chunk = partial.iloc[start : start + self.STREAM_FRAME_ROWS]
+                        yield chunk, (matched if start == 0 else 0), (seg.n_docs if start == 0 else 0)
+                        emitted += len(chunk)
+                        start += self.STREAM_FRAME_ROWS
+                        if max_rows is not None and emitted >= max_rows:
+                            return
+                    if n == 0:
+                        yield partial, matched, seg.n_docs
+                else:
                     yield partial, matched, seg.n_docs
-            else:
-                yield partial, matched, seg.n_docs
-            if max_rows is not None and emitted >= max_rows:
-                return
+                if max_rows is not None and emitted >= max_rows:
+                    return
+        finally:
+            self._unregister_query(broker_qid)
 
     def _resolve_segments(self, table: str, segment_names: list[str]):
         with self._lock:
@@ -282,22 +334,51 @@ class Server:
             return fut.result()
         return self._execute_partials(table, sql, segment_names, hints)
 
+    @staticmethod
+    def _pop_resilience_hints(hints: dict | None):
+        """Split the broker's deadline/query-id markers out of the hints dict
+        (they ride the existing hints channel so every server-handle shape —
+        in-process, HTTP, test stubs — carries them without signature churn).
+        Returns (clean hints, Deadline | None, broker query id | None)."""
+        from pinot_tpu.query.context import Deadline
+
+        hints = dict(hints or {})
+        deadline_ts = hints.pop("__deadlineTs__", None)
+        broker_qid = hints.pop("__queryId__", None)
+        deadline = None
+        if deadline_ts is not None or broker_qid is not None:
+            deadline = Deadline(float(deadline_ts) if deadline_ts is not None else None)
+        return hints, deadline, broker_qid
+
     def _execute_partials(self, table: str, sql: str, segment_names: list[str], hints: dict | None = None):
-        segs = self._resolve_segments(table, segment_names)
         from pinot_tpu.common.accounting import default_accountant
+        from pinot_tpu.common.faults import FAULTS, InjectedFault
         from pinot_tpu.common.metrics import ServerMeter, ServerTimer, server_metrics
         from pinot_tpu.common.trace import ServerQueryPhase, phase_timer
 
+        try:
+            FAULTS.maybe_fail("server.scatter")
+        except InjectedFault as e:
+            # present exactly what a dead TCP peer produces so the broker's
+            # failover path (which matches on "unreachable") engages
+            raise RuntimeError(f"server {self.server_id} unreachable: {e}") from None
+        hints, deadline, broker_qid = self._pop_resilience_hints(hints)
+        segs = self._resolve_segments(table, segment_names)
         m = server_metrics()
         m.meter(ServerMeter.QUERIES).mark()
         qid = f"{self.server_id}-{next(_query_seq)}"
-        with m.timer(ServerTimer.QUERY_EXECUTION).time(), default_accountant.scope(qid):
-            eng = self._engine(table)
-            with phase_timer(ServerQueryPhase.BUILD_QUERY_PLAN):
-                ctx = eng.make_context(sql)
-            if hints:
-                ctx.hints.update(hints)
-            with phase_timer(ServerQueryPhase.QUERY_PLAN_EXECUTION):
-                partials, matched = eng.partials(ctx, segs)
+        self._register_query(broker_qid, deadline)
+        try:
+            with m.timer(ServerTimer.QUERY_EXECUTION).time(), default_accountant.scope(qid):
+                eng = self._engine(table)
+                with phase_timer(ServerQueryPhase.BUILD_QUERY_PLAN):
+                    ctx = eng.make_context(sql)
+                if hints:
+                    ctx.hints.update(hints)
+                ctx.deadline = deadline
+                with phase_timer(ServerQueryPhase.QUERY_PLAN_EXECUTION):
+                    partials, matched = eng.partials(ctx, segs)
+        finally:
+            self._unregister_query(broker_qid)
         m.meter(ServerMeter.NUM_DOCS_SCANNED).mark(matched)
         return partials, matched, sum(s.n_docs for s in segs)

@@ -44,6 +44,54 @@ class ObservabilityConfig:
 
 
 @dataclass
+class ResilienceConfig:
+    """Query-resilience knobs (pinot.broker.timeoutMs / grpc retry parity):
+    the default per-query deadline, the allowPartialResults default, mailbox
+    send retry/backoff bounds, and the fault-injection rule set chaos tests
+    wire through common.faults.FAULTS."""
+
+    #: default per-query deadline when no `SET timeoutMs` is given
+    default_timeout_ms: float = 30000.0
+    #: default for the allowPartialResults query option
+    allow_partial_results: bool = False
+    #: DistributedMailbox.send connection-failure retries (beyond the first try)
+    mailbox_send_retries: int = 3
+    #: first retry backoff; doubles per attempt up to the max
+    mailbox_retry_initial_s: float = 0.05
+    mailbox_retry_max_s: float = 1.0
+    #: how long a closed query id tombstone drops straggler envelopes
+    mailbox_tombstone_ttl_s: float = 60.0
+    #: fault-injection rules (point -> FaultRule dict) + deterministic seed
+    faults: dict = field(default_factory=dict)
+    fault_seed: int = 0
+
+    def to_dict(self) -> dict:
+        return {
+            "defaultTimeoutMs": self.default_timeout_ms,
+            "allowPartialResults": self.allow_partial_results,
+            "mailboxSendRetries": self.mailbox_send_retries,
+            "mailboxRetryInitialS": self.mailbox_retry_initial_s,
+            "mailboxRetryMaxS": self.mailbox_retry_max_s,
+            "mailboxTombstoneTtlS": self.mailbox_tombstone_ttl_s,
+            "faults": self.faults,
+            "faultSeed": self.fault_seed,
+        }
+
+    @staticmethod
+    def from_dict(d: dict) -> "ResilienceConfig":
+        return ResilienceConfig(
+            default_timeout_ms=d.get("defaultTimeoutMs", 30000.0),
+            allow_partial_results=d.get("allowPartialResults", False),
+            mailbox_send_retries=d.get("mailboxSendRetries", 3),
+            mailbox_retry_initial_s=d.get("mailboxRetryInitialS", 0.05),
+            mailbox_retry_max_s=d.get("mailboxRetryMaxS", 1.0),
+            mailbox_tombstone_ttl_s=d.get("mailboxTombstoneTtlS", 60.0),
+            faults=d.get("faults", {}),
+            fault_seed=d.get("faultSeed", 0),
+        )
+
+
+@dataclass
 class StarTreeIndexConfig:
     """Parity with StarTreeIndexConfig (dimensionsSplitOrder,
     functionColumnPairs, maxLeafRecords)."""

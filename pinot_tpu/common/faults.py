@@ -1,0 +1,106 @@
+"""Deterministic fault injection for chaos testing the distributed query path.
+
+Reference parity: Pinot's failure-injection knobs used by integration tests
+(e.g. the failure detector / mailbox tests that kill servers mid-query). Here
+a process-global `FaultInjector` holds named injection points the transport
+and execution layers call through (`FAULTS.maybe_fail("mailbox.send")`); a
+rule per point either raises an `InjectedFault` or sleeps a fixed delay.
+Draws come from a seeded `random.Random`, so a chaos test that configures
+{point, probability, seed} replays identically.
+
+Well-known points (wired in this repo):
+    mailbox.send     — DistributedMailbox.send, before the HTTP POST
+    mailbox.deliver  — MailboxRegistry.deliver, before routing an envelope
+    segment.execute  — QueryEngine partial resolution, per segment
+    server.scatter   — Server.execute_partials entry (v1 scatter target)
+    stream.consume   — Server.execute_partials_stream, per yielded frame
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+from dataclasses import dataclass
+
+
+class InjectedFault(ConnectionError):
+    """Raised by error-mode rules. Subclasses ConnectionError so transport
+    layers classify it as a connection-class failure (retry/failover paths
+    see exactly what a dead TCP peer produces)."""
+
+
+@dataclass
+class FaultRule:
+    mode: str = "error"  # "error" | "delay"
+    prob: float = 1.0  # probability each call through the point fires
+    delay_s: float = 0.0  # sleep length for mode="delay"
+    max_count: int | None = None  # stop firing after N triggers (None = forever)
+    message: str = ""  # extra context for the raised error
+
+    @staticmethod
+    def from_dict(d: dict) -> "FaultRule":
+        return FaultRule(
+            mode=d.get("mode", "error"),
+            prob=float(d.get("prob", 1.0)),
+            delay_s=float(d.get("delayS", d.get("delay_s", 0.0))),
+            max_count=d.get("maxCount", d.get("max_count")),
+            message=d.get("message", ""),
+        )
+
+
+class FaultInjector:
+    """Thread-safe registry of injection rules keyed by point name. Disabled
+    (no rules) is the production state: `maybe_fail` is one dict check."""
+
+    def __init__(self):
+        self._rules: dict[str, FaultRule] = {}
+        self._rng = random.Random(0)
+        self._counts: dict[str, int] = {}
+        self._lock = threading.Lock()
+
+    def configure(self, rules: dict[str, FaultRule | dict], seed: int = 0) -> None:
+        """Replace the rule set. `rules`: point -> FaultRule (or its dict
+        form, e.g. from ResilienceConfig.faults). Resets trigger counts."""
+        with self._lock:
+            self._rules = {
+                point: r if isinstance(r, FaultRule) else FaultRule.from_dict(r)
+                for point, r in rules.items()
+            }
+            self._rng = random.Random(seed)
+            self._counts = {}
+
+    def reset(self) -> None:
+        self.configure({})
+
+    @property
+    def enabled(self) -> bool:
+        return bool(self._rules)
+
+    def counts(self) -> dict[str, int]:
+        """point -> number of times its rule fired (test assertions)."""
+        with self._lock:
+            return dict(self._counts)
+
+    def maybe_fail(self, point: str) -> None:
+        if not self._rules:  # production fast path
+            return
+        with self._lock:
+            rule = self._rules.get(point)
+            if rule is None:
+                return
+            fired = self._counts.get(point, 0)
+            if rule.max_count is not None and fired >= rule.max_count:
+                return
+            if rule.prob < 1.0 and self._rng.random() >= rule.prob:
+                return
+            self._counts[point] = fired + 1
+        if rule.mode == "delay":
+            time.sleep(rule.delay_s)
+            return
+        detail = f": {rule.message}" if rule.message else ""
+        raise InjectedFault(f"injected fault at {point}{detail}")
+
+
+#: process-global injector; production code calls FAULTS.maybe_fail(point)
+FAULTS = FaultInjector()

@@ -347,6 +347,7 @@ class ServerMeter(Enum):
     REALTIME_ROWS_CONSUMED = "server.realtimeRowsConsumed"
     QUERIES_KILLED = "server.queriesKilled"
     SCHEDULING_TIMEOUTS = "server.schedulingTimeouts"
+    MAILBOX_STRAGGLER_DROPS = "server.mailboxStragglerDrops"
 
 
 class ServerGauge(Enum):
@@ -366,6 +367,9 @@ class BrokerMeter(Enum):
     QUERIES = "broker.queries"
     NO_SERVING_HOST = "broker.noServingHostForSegment"
     REQUEST_FAILURES = "broker.requestFailures"
+    QUERIES_TIMED_OUT = "broker.queriesTimedOut"
+    QUERIES_CANCELLED = "broker.queriesCancelled"
+    PARTIAL_RESPONSES = "broker.partialResponses"
     DOCS_SCANNED = "broker.docsScanned"
 
 

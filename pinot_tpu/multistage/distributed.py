@@ -113,17 +113,35 @@ def run_assigned_stages(
     receive_timeout: float = 60.0,
     block: bool = False,
     row_counts: dict[str, int] | None = None,
-) -> None:
+    deadline_ts: float | None = None,
+    deadline=None,
+    on_done=None,
+):
     """Server-side half of a distributed query: rebuild the plan, then run
-    every (stage, worker) assigned to `my_id` on daemon threads."""
+    every (stage, worker) assigned to `my_id` on daemon threads.
+
+    deadline_ts: absolute wall-clock query deadline shipped by the broker;
+    workers check it at operator block boundaries and the mailbox receive
+    loop derives its timeout from it. Returns the query's Deadline so the
+    caller can register it for cancellation; `on_done` fires after the last
+    local worker finishes and the mailbox is reaped."""
+    from pinot_tpu.query.context import Deadline
     from pinot_tpu.query.sql import parse_sql
 
     stmt = parse_sql(sql)
     plan = build_plan(stmt, schemas, n_workers, row_counts)
     apply_parallelism(plan, parallelism)
+    if deadline is None:
+        deadline = Deadline(deadline_ts)
+    else:
+        deadline_ts = deadline.deadline_ts
     mailbox: DistributedMailbox = registry.get(qid)
     mailbox.configure(qid, my_id, placement, addresses)
+    if deadline_ts is not None:
+        rem = deadline.remaining()
+        receive_timeout = max(0.1, min(receive_timeout, rem if rem is not None else receive_timeout))
     mailbox.receive_timeout = receive_timeout
+    mailbox.deadline = deadline
     parent_of: dict[int, int] = {}
     for s in plan.stages.values():
         for inp in s.inputs:
@@ -153,14 +171,19 @@ def run_assigned_stages(
         for _ in mine:
             done.acquire()
         registry.close(qid)
+        if on_done is not None:
+            on_done()
     else:
         # reap the registry entry once all local workers finish
         def reaper():
             for _ in mine:
                 done.acquire()
             registry.close(qid)
+            if on_done is not None:
+                on_done()
 
         threading.Thread(target=reaper, daemon=True).start()
+    return deadline
 
 
 class DistributedDispatcher:
@@ -190,8 +213,15 @@ class DistributedDispatcher:
         receive_timeout: float = 60.0,
         total_docs: int = 0,
         row_counts: dict[str, int] | None = None,
+        qid: str | None = None,
+        deadline=None,
     ):
-        """Returns the root-stage DataFrame-shaped ResultTable rows."""
+        """Returns the root-stage DataFrame-shaped ResultTable rows.
+
+        qid: broker-assigned query id (so DELETE /query/{id} can find and
+        close this query's mailboxes); a fresh uuid when absent. deadline:
+        query.context.Deadline — its absolute timestamp ships in every
+        stage-plan envelope and bounds the root receive."""
         import time as _time
 
         import pandas as pd
@@ -199,12 +229,16 @@ class DistributedDispatcher:
         from pinot_tpu.query.result import ResultTable
 
         t0 = _time.perf_counter()
-        qid = uuid.uuid4().hex
+        qid = qid or uuid.uuid4().hex
         plan = build_plan(stmt, schemas, n_workers, row_counts)
         all_servers = sorted(server_urls)
         parallelism, placement = plan_placement(plan, table_servers, all_servers, n_workers)
         apply_parallelism(plan, parallelism)
         addresses = {BROKER_ID: self.url, **server_urls}
+        deadline_ts = getattr(deadline, "deadline_ts", None)
+        if deadline_ts is not None:
+            rem = deadline.remaining()
+            receive_timeout = max(0.1, min(receive_timeout, rem))
         doc_common = {
             "query_id": qid,
             "sql": sql,
@@ -215,6 +249,7 @@ class DistributedDispatcher:
             "addresses": addresses,
             "receive_timeout": receive_timeout,
             "row_counts": dict(row_counts or {}),
+            "deadline_ts": deadline_ts,
         }
         participants = sorted({owner for owner in placement.values() if owner != BROKER_ID})
         try:
@@ -229,6 +264,8 @@ class DistributedDispatcher:
             mailbox: DistributedMailbox = self.registry.get(qid)
             mailbox.configure(qid, BROKER_ID, placement, addresses)
             mailbox.receive_timeout = receive_timeout
+            if deadline is not None:
+                mailbox.deadline = deadline
             parent_of: dict[int, int] = {}
             for s in plan.stages.values():
                 for inp in s.inputs:

@@ -69,6 +69,36 @@ class MailboxService:
     #: distributed engine sets one so a dead remote sender fails the query
     #: instead of hanging the receiving OpChain (GrpcMailbox deadline parity).
     receive_timeout: float | None = None
+    #: per-query Deadline (query.context.Deadline) — when set, receives poll
+    #: in short slices so cancellation/expiry interrupts a blocked OpChain
+    #: within ~0.2s instead of after receive_timeout
+    deadline = None
+
+    def _get_one(self, q: queue.Queue, recv_stage: int, recv_worker: int, send_stage: int):
+        deadline = self.deadline
+        if deadline is None and self.receive_timeout is None:
+            return q.get()
+        t_start = _time.monotonic()
+        where = f"stage {send_stage} -> ({recv_stage}, w{recv_worker})"
+        while True:
+            if deadline is not None:
+                deadline.check(where)
+            slice_t = 0.2
+            if self.receive_timeout is not None:
+                left = self.receive_timeout - (_time.monotonic() - t_start)
+                if left <= 0:
+                    raise RuntimeError(
+                        f"mailbox receive timed out after {self.receive_timeout}s: {where}"
+                    ) from None
+                slice_t = min(slice_t, left)
+            if deadline is not None:
+                rem = deadline.remaining()
+                if rem is not None:
+                    slice_t = min(slice_t, max(rem, 0.01))
+            try:
+                return q.get(timeout=slice_t)
+            except queue.Empty:
+                continue
 
     def receive_all(
         self,
@@ -86,14 +116,7 @@ class MailboxService:
         blocks: list[pd.DataFrame] = []
         eos = 0
         while eos < n_senders:
-            try:
-                item = q.get(timeout=self.receive_timeout)
-            except queue.Empty:
-                raise RuntimeError(
-                    f"mailbox receive timed out after {self.receive_timeout}s: stage "
-                    f"{send_stage} -> ({recv_stage}, w{recv_worker}), "
-                    f"{eos}/{n_senders} senders finished"
-                ) from None
+            item = self._get_one(q, recv_stage, recv_worker, send_stage)
             if item is _EOS or (isinstance(item, tuple) and item and item[0] == "__eos__"):
                 eos += 1
                 if stats_out is not None and isinstance(item, tuple) and len(item) > 1 and item[1]:
@@ -775,6 +798,12 @@ def exec_node(node: L.Node, ctx: RunCtx) -> pd.DataFrame:
     operator's rows/blocks/wall time is recorded around the real execution
     (MultiStageOperator.registerExecution parity); the disabled path is one
     attribute check."""
+    # operator block boundary = the deadline/cancel enforcement point
+    # (QueryThreadContext deadline checks between blocks); a slow stage
+    # terminates itself instead of relying on the receiver's timeout
+    dl = ctx.mailbox.deadline
+    if dl is not None:
+        dl.check(type(node).__name__)
     st = ctx.stats
     if st is None:
         return _exec_node(node, ctx)
@@ -813,10 +842,15 @@ def _exec_node(node: L.Node, ctx: RunCtx) -> pd.DataFrame:
         from pinot_tpu.query.context import null_handling_enabled
 
         null_on = null_handling_enabled(ctx.options)
+        from pinot_tpu.common.faults import FAULTS
+
         segs = ctx.segments.get(node.table, [])
         mine = segs if ctx.scan_local_all else segs[ctx.worker :: ctx.stage.parallelism]
         frames = []
         for seg in mine:
+            if ctx.mailbox.deadline is not None:
+                ctx.mailbox.deadline.check(f"scan {seg.name}")
+            FAULTS.maybe_fail("segment.execute")
             mask = (
                 _leaf_filter_mask(seg, node.filter, null_on=null_on, stats=ctx.stats, node=node)
                 if node.filter is not None
@@ -1062,9 +1096,15 @@ def _try_leaf_device_partial(node: L.Aggregate, ctx: RunCtx) -> pd.DataFrame | N
         offset=0,
         options=dict(ctx.options),
     )
+    from pinot_tpu.common.faults import InjectedFault
+    from pinot_tpu.query.context import QueryCancelledError, QueryTimeoutError
+
+    qctx.deadline = ctx.mailbox.deadline
     eng = QueryEngine(mine)
     try:
         partials, _matched = eng.partials(qctx, mine)
+    except (QueryTimeoutError, QueryCancelledError, InjectedFault):
+        raise  # deadline/cancel/chaos must fail the stage, not fall back
     except Exception:
         return None  # column/type not lowerable: pandas partial takes over
     from pinot_tpu.common.metrics import ServerMeter, server_metrics
@@ -1587,7 +1627,9 @@ class MultistageEngine:
         self.n_workers = n_workers
         self.schemas = dict(schemas) if schemas else {}
 
-    def execute(self, sql: str, stmt=None) -> ResultTable:
+    def execute(self, sql: str, stmt=None, deadline=None) -> ResultTable:
+        """deadline: optional query.context.Deadline enforced at every
+        operator block boundary and mailbox receive."""
         import time
 
         from pinot_tpu.query.sql import parse_sql
@@ -1630,13 +1672,13 @@ class MultistageEngine:
             # EXPLAIN ANALYZE: execute with stats collection forced on, then
             # render the plan tree with the merged runtime stats inline
             plan.options["__collect_stats__"] = True
-            _, stats_payload = self._run(plan)
+            _, stats_payload = self._run(plan, deadline=deadline)
             merged = merge_stage_stats(stats_payload or [])
             return ResultTable(
                 columns=["Operator", "Operator_Id", "Parent_Id"],
                 rows=analyze_rows(plan, merged),
             )
-        df, stats_payload = self._run(plan)
+        df, stats_payload = self._run(plan, deadline=deadline)
         df = df.astype(object).where(pd.notna(df), None)
         rows = df.values.tolist()
         total_docs = sum(s.n_docs for segs in self.catalog.values() for s in segs)
@@ -1650,8 +1692,9 @@ class MultistageEngine:
             result.stage_stats = merge_stage_stats(stats_payload)
         return result
 
-    def _run(self, plan: L.StagePlan) -> "tuple[pd.DataFrame, list | None]":
+    def _run(self, plan: L.StagePlan, deadline=None) -> "tuple[pd.DataFrame, list | None]":
         mailbox = MailboxService()
+        mailbox.deadline = deadline
         parent_of: dict[int, int] = {}
         for s in plan.stages.values():
             for inp in s.inputs:

@@ -17,9 +17,11 @@ Envelope format (one POST per block):
 from __future__ import annotations
 
 import json
+import random
 import struct
 import threading
 import time
+import urllib.error
 import urllib.request
 
 import pandas as pd
@@ -48,21 +50,44 @@ def encode_envelope(qid: str, rs: int, rw: int, ss: int, payload) -> bytes:
 
 
 def decode_envelope(data: bytes):
-    """-> (header dict, payload as used by MailboxService queues)."""
+    """-> (header dict, payload as used by MailboxService queues).
+
+    Every length/slice is bounds-checked (io/readers.py discipline): a
+    truncated or garbled POST body raises ValueError("corrupt mailbox
+    envelope ..."), never a raw struct.error/JSONDecodeError, so /mailbox
+    can answer 400 instead of 500."""
+    if len(data) < 4:
+        raise ValueError(
+            f"corrupt mailbox envelope: {len(data)} bytes, need >= 4 for header length"
+        )
     (hlen,) = struct.unpack_from("<I", data, 0)
-    header = json.loads(data[4 : 4 + hlen].decode())
-    kind = header["kind"]
+    if hlen == 0 or 4 + hlen > len(data):
+        raise ValueError(
+            f"corrupt mailbox envelope: header length {hlen} exceeds body ({len(data)} bytes)"
+        )
+    try:
+        header = json.loads(data[4 : 4 + hlen].decode())
+    except (UnicodeDecodeError, json.JSONDecodeError) as e:
+        raise ValueError(f"corrupt mailbox envelope: bad JSON header ({e})") from None
+    if not isinstance(header, dict) or not all(k in header for k in ("qid", "rs", "rw", "ss")):
+        raise ValueError("corrupt mailbox envelope: header missing qid/rs/rw/ss")
+    kind = header.get("kind")
     if kind == "block":
-        df = datatable.decode(data[4 + hlen :])
+        try:
+            df = datatable.decode(data[4 + hlen :])
+        except Exception as e:
+            raise ValueError(f"corrupt mailbox envelope: bad block payload ({e})") from None
         # wire format stringifies column labels; runtime blocks use
         # positional ints
         df.columns = range(len(df.columns))
         payload = df
     elif kind == "err":
         payload = ("__err__", header.get("msg", "remote stage failed"))
-    else:
+    elif kind == "eos":
         stats = header.get("stats")
         payload = ("__eos__", stats) if stats else R._EOS
+    else:
+        raise ValueError(f"corrupt mailbox envelope: unknown kind {kind!r}")
     return header, payload
 
 
@@ -70,12 +95,17 @@ class MailboxRegistry:
     """Per-process registry: query id -> DistributedMailbox. Entries are
     created on first touch (blocks may arrive before the local workers
     start) and expire after `ttl_s` to bound leakage from abandoned
-    queries."""
+    queries. Closed query ids are tombstoned for `tombstone_ttl_s` so a
+    late straggler envelope is dropped (and counted) instead of silently
+    recreating the mailbox and leaking it until TTL."""
 
-    def __init__(self, ttl_s: float = 600.0):
+    def __init__(self, ttl_s: float = 600.0, tombstone_ttl_s: float = 60.0):
         self._boxes: dict[str, tuple[float, "DistributedMailbox"]] = {}
         self._lock = threading.Lock()
         self._ttl = ttl_s
+        self._tombstone_ttl = tombstone_ttl_s
+        self._tombstones: dict[str, float] = {}  # closed qid -> close time
+        self.straggler_drops = 0
 
     def get(self, qid: str) -> "DistributedMailbox":
         now = time.monotonic()
@@ -83,6 +113,9 @@ class MailboxRegistry:
             for k in [k for k, (t, _) in self._boxes.items() if now - t > self._ttl]:
                 if k != qid:
                     del self._boxes[k]
+            # re-opening a closed qid (e.g. explicit get() by a retry) clears
+            # its tombstone — the id is live again
+            self._tombstones.pop(qid, None)
             ent = self._boxes.get(qid)
             if ent is None:
                 ent = (now, DistributedMailbox())
@@ -93,13 +126,37 @@ class MailboxRegistry:
             return ent[1]
 
     def close(self, qid: str) -> None:
+        now = time.monotonic()
         with self._lock:
             self._boxes.pop(qid, None)
+            self._tombstones[qid] = now
+            # the tombstone set stays short: drop expired ones on each close
+            for k in [k for k, t in self._tombstones.items() if now - t > self._tombstone_ttl]:
+                del self._tombstones[k]
+
+    def live_queries(self) -> list[str]:
+        with self._lock:
+            return sorted(self._boxes)
 
     def deliver(self, data: bytes) -> None:
-        """HTTP-handler entry: route one envelope into the right mailbox."""
+        """HTTP-handler entry: route one envelope into the right mailbox.
+        Envelopes for a tombstoned (recently closed) query are dropped and
+        counted — a straggler block from a cancelled/finished query must not
+        resurrect its mailbox."""
+        from pinot_tpu.common.faults import FAULTS
+        from pinot_tpu.common.metrics import ServerMeter, server_metrics
+
+        FAULTS.maybe_fail("mailbox.deliver")
         header, payload = decode_envelope(data)
-        box = self.get(header["qid"])
+        qid = header["qid"]
+        now = time.monotonic()
+        with self._lock:
+            t = self._tombstones.get(qid)
+            if t is not None and now - t <= self._tombstone_ttl:
+                self.straggler_drops += 1
+                server_metrics().meter(ServerMeter.MAILBOX_STRAGGLER_DROPS).mark()
+                return
+        box = self.get(qid)
         box.deliver_local(header["rs"], header["rw"], header["ss"], payload)
 
 
@@ -107,6 +164,13 @@ class DistributedMailbox(R.MailboxService):
     """MailboxService whose send() routes by worker placement: local
     (stage, worker) pairs use the in-process queues, remote pairs POST the
     DataTable envelope to the owner's /mailbox endpoint."""
+
+    #: connection-class send failures retry with exponential backoff +
+    #: deterministic jitter, bounded by the query deadline (gRPC mailbox
+    #: retry policy parity). Defaults match ResilienceConfig.
+    send_retries: int = 3
+    retry_initial_s: float = 0.05
+    retry_max_s: float = 1.0
 
     def __init__(self):
         super().__init__()
@@ -125,26 +189,62 @@ class DistributedMailbox(R.MailboxService):
         super().send(ss, rs, rw, payload)
 
     def send(self, send_stage: int, recv_stage: int, recv_worker: int, payload) -> None:
+        from pinot_tpu.common.faults import FAULTS
+
         owner = self.placement.get((recv_stage, recv_worker), self.my_id)
         if owner == self.my_id:
             super().send(send_stage, recv_stage, recv_worker, payload)
             return
         data = encode_envelope(self.qid, recv_stage, recv_worker, send_stage, payload)
         url = self.addresses[owner].rstrip("/") + "/mailbox"
-        req = urllib.request.Request(
-            url, data=data, headers={"Content-Type": "application/x-pinot-mailbox"}
-        )
-        try:
-            with urllib.request.urlopen(req, timeout=self.timeout) as resp:
-                resp.read()
-        except Exception as e:
-            raise RuntimeError(f"mailbox send to {owner} ({url}) failed: {e}") from None
+        backoff = self.retry_initial_s
+        for attempt in range(self.send_retries + 1):
+            req = urllib.request.Request(
+                url, data=data, headers={"Content-Type": "application/x-pinot-mailbox"}
+            )
+            try:
+                FAULTS.maybe_fail("mailbox.send")
+                with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+                    resp.read()
+                return
+            except urllib.error.HTTPError as e:
+                # the envelope reached a live handler which rejected it:
+                # retrying the same bytes cannot succeed
+                detail = e.read().decode(errors="replace")
+                raise RuntimeError(
+                    f"mailbox send to {owner} ({url}) failed: HTTP {e.code}: {detail}"
+                ) from None
+            except (urllib.error.URLError, OSError) as e:
+                # connection-class (refused/reset/timeout): transient by
+                # definition — retry within deadline budget
+                if attempt >= self.send_retries:
+                    raise RuntimeError(f"mailbox send to {owner} ({url}) failed: {e}") from None
+                dl = self.deadline
+                if dl is not None and dl.cancelled:
+                    raise RuntimeError(
+                        f"mailbox send to {owner} ({url}) abandoned: query cancelled"
+                    ) from None
+                # deterministic jitter: replayable under a fixed fault seed
+                rng = random.Random(f"{self.qid}:{owner}:{attempt}")
+                sleep_s = min(backoff, self.retry_max_s) * (0.5 + rng.random())
+                if dl is not None:
+                    rem = dl.remaining()
+                    if rem is not None:
+                        if rem <= 0:
+                            raise RuntimeError(
+                                f"mailbox send to {owner} ({url}) failed: {e} "
+                                "(deadline exhausted)"
+                            ) from None
+                        sleep_s = min(sleep_s, rem)
+                time.sleep(sleep_s)
+                backoff *= 2
 
 
 def handle_mailbox_post(registry: MailboxRegistry, handler) -> None:
     """Shared /mailbox POST handling for every participant's HTTP service
     (ServerHTTPService and MailboxHTTPService): read the envelope, deliver,
-    answer 200 'ok' or a 500 JSON error."""
+    answer 200 'ok'. A corrupt envelope (ValueError from decode_envelope) is
+    the sender's fault — 400; anything else is ours — 500."""
     n = int(handler.headers.get("Content-Length", 0))
     try:
         registry.deliver(handler.rfile.read(n))
@@ -154,7 +254,7 @@ def handle_mailbox_post(registry: MailboxRegistry, handler) -> None:
         handler.wfile.write(b"ok")
     except Exception as e:
         msg = json.dumps({"error": f"{type(e).__name__}: {e}"}).encode()
-        handler.send_response(500)
+        handler.send_response(400 if isinstance(e, ValueError) else 500)
         handler.send_header("Content-Length", str(len(msg)))
         handler.end_headers()
         handler.wfile.write(msg)

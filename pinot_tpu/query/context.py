@@ -159,6 +159,87 @@ def null_handling_enabled(options: dict) -> bool:
     return False
 
 
+def query_option(options: dict, name: str, default=None):
+    """Case-insensitive query-option lookup (QueryOptionsUtils parity —
+    option keys arrive as the user typed them in `SET key = value;`)."""
+    want = name.lower()
+    for k, v in options.items():
+        if k.lower() == want:
+            return v
+    return default
+
+
+class QueryTimeoutError(RuntimeError):
+    """Query exceeded its deadline (BrokerResponse EXECUTION_TIMEOUT_ERROR,
+    errorCode 250). Deliberately NOT an OSError subtype: the scatter paths
+    treat OSError as a connection-class failure and would fail over — a
+    timed-out query must surface its distinct code instead."""
+
+    error_code = 250
+
+
+class QueryCancelledError(RuntimeError):
+    """Query was cancelled via DELETE /query/{id} (QueryCancelledException
+    parity, errorCode 503)."""
+
+    error_code = 503
+
+
+class Deadline:
+    """Per-query deadline + cancel flag carried in QueryContext and shipped
+    (as an absolute wall-clock timestamp) in scatter requests and multistage
+    stage-plan envelopes — QueryThreadContext deadline parity.
+
+    `deadline_ts` is `time.time()`-based so the same value is meaningful on
+    every process of the cluster; None means no time limit (cancel-only)."""
+
+    __slots__ = ("deadline_ts", "_cancelled")
+
+    def __init__(self, deadline_ts: float | None = None):
+        import threading as _threading
+
+        self.deadline_ts = deadline_ts
+        self._cancelled = _threading.Event()
+
+    @staticmethod
+    def from_timeout_ms(timeout_ms: float | None) -> "Deadline":
+        import time as _time
+
+        if timeout_ms is None:
+            return Deadline(None)
+        return Deadline(_time.time() + float(timeout_ms) / 1e3)
+
+    def remaining(self) -> float | None:
+        """Seconds until expiry (may be <= 0); None when unbounded."""
+        if self.deadline_ts is None:
+            return None
+        import time as _time
+
+        return self.deadline_ts - _time.time()
+
+    @property
+    def expired(self) -> bool:
+        rem = self.remaining()
+        return rem is not None and rem <= 0
+
+    @property
+    def cancelled(self) -> bool:
+        return self._cancelled.is_set()
+
+    def cancel(self) -> None:
+        self._cancelled.set()
+
+    def check(self, where: str = "") -> None:
+        """Raise if cancelled or expired — the per-block / per-segment
+        enforcement point."""
+        if self._cancelled.is_set():
+            raise QueryCancelledError(f"query cancelled{f' at {where}' if where else ''}")
+        if self.expired:
+            raise QueryTimeoutError(
+                f"query exceeded its deadline{f' at {where}' if where else ''}"
+            )
+
+
 class QueryType(Enum):
     SELECTION = "SELECTION"
     SELECTION_ORDER_BY = "SELECTION_ORDER_BY"
@@ -549,6 +630,10 @@ class QueryContext:
     # for histogram-based percentile sketches)
     hints: dict = field(default_factory=dict)
     gapfill: "GapfillSpec | None" = None
+    # per-query deadline + cancel flag (QueryThreadContext parity); set by
+    # the broker (timeoutMs option / ResilienceConfig default) or by the
+    # server from the shipped absolute timestamp. None = unbounded.
+    deadline: "Deadline | None" = None
 
     @property
     def columns_used(self) -> set[str]:

@@ -132,12 +132,16 @@ class QueryEngine:
         the fused path; host fallbacks run inline). The ONE dispatch loop
         shared by partials()/submit()/execute()."""
         from pinot_tpu.common.accounting import default_accountant
+        from pinot_tpu.common.faults import FAULTS
         from pinot_tpu.query import pruner
 
         pend: list = []
         pruned = 0
         for seg in self.segments if segments is None else segments:
             default_accountant.checkpoint()
+            if ctx.deadline is not None:
+                ctx.deadline.check(f"segment {seg.name}")
+            FAULTS.maybe_fail("segment.execute")
             if not pruner.can_match(seg, ctx):
                 # bloom/min-max pruned: contribute a canonical empty partial
                 pend.append((seg, ("pruned", pruner.empty_partial(ctx))))
@@ -161,6 +165,8 @@ class QueryEngine:
                 out.append(disp[1])  # no scan, no sample
                 continue
             default_accountant.checkpoint()
+            if ctx.deadline is not None:
+                ctx.deadline.check(f"segment {seg.name}")
             with InvocationScope(f"segment:{seg.name}") as scope:
                 partial, matched = self._finish_segment(seg, ctx, disp)
                 scope.set_attr("numDocsMatched", int(matched))
@@ -178,9 +184,13 @@ class QueryEngine:
         (partial, matched) as each segment finishes, so callers can frame
         results out incrementally and stop early (GrpcQueryServer.submit
         streaming parity, core/transport/grpc/GrpcQueryServer.java:65,165)."""
+        from pinot_tpu.common.faults import FAULTS
         from pinot_tpu.query import pruner
 
         for seg in self.segments if segments is None else segments:
+            if ctx.deadline is not None:
+                ctx.deadline.check(f"segment {seg.name}")
+            FAULTS.maybe_fail("segment.execute")
             if not pruner.can_match(seg, ctx):
                 continue
             partial, matched = self._execute_segment(seg, ctx)

@@ -32,6 +32,13 @@ class ResultTable:
     # (MultiStageQueryStats -> BrokerResponse `stageStats` parity); None
     # when collection was off or the query ran on the v1 engine
     stage_stats: list | None = None
+    # degraded-response surface (BrokerResponse partialResult/exceptions
+    # parity): set by the broker when allowPartialResults let it answer
+    # despite server failures; exceptions entries are {"errorCode","message"}
+    partial_result: bool = False
+    exceptions: list = field(default_factory=list)
+    num_servers_queried: int = 0
+    num_servers_responded: int = 0
 
     def __post_init__(self):
         self.rows = [[_plain(v) for v in row] for row in self.rows]
@@ -54,6 +61,14 @@ class ResultTable:
             d["traceInfo"] = self.trace
         if self.stage_stats is not None:
             d["stageStats"] = self.stage_stats
+        # emitted only on the degraded path so pre-existing exact-dict
+        # consumers of healthy responses see an unchanged shape
+        if self.partial_result or self.exceptions:
+            d["partialResult"] = self.partial_result
+            d["exceptions"] = list(self.exceptions)
+        if self.num_servers_queried:
+            d["numServersQueried"] = self.num_servers_queried
+            d["numServersResponded"] = self.num_servers_responded
         return d
 
     def __repr__(self) -> str:  # human-friendly table

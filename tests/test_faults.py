@@ -1,0 +1,554 @@
+"""Deterministic chaos suite for the query resilience plane.
+
+Model: Pinot's failure-injection integration tests (killing servers /
+delaying stages mid-query and asserting the broker response degrades the
+documented way) — but driven through the seeded common/faults.py registry so
+every run replays identically. Covers deadlines, cancellation, partial
+results, mailbox hardening, and the fault points on both engines, with
+bounded wall time per test.
+"""
+
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from pinot_tpu.cluster import Broker, Controller, PropertyStore, Server
+from pinot_tpu.common import DataType, Schema, TableConfig
+from pinot_tpu.common.faults import FAULTS, FaultRule, InjectedFault
+from pinot_tpu.query.context import (
+    Deadline,
+    QueryCancelledError,
+    QueryTimeoutError,
+)
+from pinot_tpu.segment import SegmentBuilder
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    """Every test starts and ends with the injector disabled: a leaked rule
+    would poison unrelated tests through the process-global registry."""
+    FAULTS.reset()
+    yield
+    FAULTS.reset()
+
+
+def _build_cluster(tmp_path, n_servers=2, replication=1, rows_per_seg=500, n_segs=4):
+    controller = Controller(PropertyStore(), tmp_path / "ds")
+    servers = {f"s{i}": Server(f"s{i}") for i in range(n_servers)}
+    for sid, s in servers.items():
+        controller.register_server(sid, s)
+    schema = Schema.build(
+        "t", dimensions=[("d", DataType.INT)], metrics=[("v", DataType.LONG)]
+    )
+    controller.add_schema(schema)
+    controller.add_table(TableConfig("t", replication=replication))
+    b = SegmentBuilder(schema)
+    rng = np.random.default_rng(0)
+    for i in range(n_segs):
+        controller.upload_segment(
+            "t",
+            b.build(
+                {
+                    "d": rng.integers(0, 10, rows_per_seg).astype(np.int32),
+                    "v": np.full(rows_per_seg, i, dtype=np.int64),
+                },
+                f"t_{i}",
+            ),
+        )
+    return controller, servers, Broker(controller)
+
+
+class _DeadServer:
+    """Wraps a live Server handle; every data-plane call fails the way a dead
+    TCP peer does (the broker failover/degradation classifier's trigger)."""
+
+    def __init__(self, inner):
+        self.inner = inner
+
+    def __getattr__(self, name):
+        return getattr(self.inner, name)
+
+    def execute_partials(self, *a, **kw):
+        raise RuntimeError(f"server {self.inner.server_id} unreachable: killed by test")
+
+    def execute_partials_stream(self, *a, **kw):
+        raise RuntimeError(f"server {self.inner.server_id} unreachable: killed by test")
+
+
+# -- injector mechanics ------------------------------------------------------
+
+
+def test_injector_deterministic_and_counted():
+    FAULTS.configure({"p": FaultRule(prob=0.5, max_count=3)}, seed=42)
+    fired_a = []
+    for _ in range(20):
+        try:
+            FAULTS.maybe_fail("p")
+            fired_a.append(0)
+        except InjectedFault:
+            fired_a.append(1)
+    assert sum(fired_a) == 3  # max_count caps triggers
+    FAULTS.configure({"p": FaultRule(prob=0.5, max_count=3)}, seed=42)
+    fired_b = []
+    for _ in range(20):
+        try:
+            FAULTS.maybe_fail("p")
+            fired_b.append(0)
+        except InjectedFault:
+            fired_b.append(1)
+    assert fired_a == fired_b  # same seed -> identical replay
+    assert FAULTS.counts() == {"p": 3}
+
+
+def test_injected_fault_is_connection_class():
+    # transports classify on ConnectionError/OSError: injected faults must
+    # take the same retry/failover paths a dead peer does
+    assert issubclass(InjectedFault, ConnectionError)
+    assert issubclass(InjectedFault, OSError)
+
+
+# -- envelope hardening (satellite 2) ----------------------------------------
+
+
+def test_decode_envelope_rejects_corruption():
+    import struct
+
+    import pandas as pd
+
+    from pinot_tpu.multistage.transport import decode_envelope, encode_envelope
+
+    good = encode_envelope("q", 1, 0, 2, pd.DataFrame({0: [1, 2]}))
+    for bad in (
+        b"",  # empty
+        b"\x01\x02",  # shorter than the header-length word
+        struct.pack("<I", 10_000) + b"{}",  # header length past the body
+        struct.pack("<I", 4) + b"notj",  # unparseable JSON header
+        struct.pack("<I", 2) + b"{}",  # header missing qid/rs/rw/ss
+        good[:-1],  # truncated block payload
+    ):
+        with pytest.raises(ValueError, match="corrupt mailbox envelope"):
+            decode_envelope(bad)
+
+
+def test_mailbox_post_corrupt_is_400():
+    from pinot_tpu.multistage.transport import MailboxHTTPService, MailboxRegistry
+
+    svc = MailboxHTTPService(MailboxRegistry())
+    try:
+        req = urllib.request.Request(
+            svc.url + "/mailbox", data=b"\x99garbage", method="POST"
+        )
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(req, timeout=5)
+        assert ei.value.code == 400  # sender's fault, not a server 500
+    finally:
+        svc.stop()
+
+
+# -- tombstones (satellite 3) ------------------------------------------------
+
+
+def test_closed_query_drops_stragglers():
+    import pandas as pd
+
+    from pinot_tpu.multistage.transport import MailboxRegistry, encode_envelope
+
+    reg = MailboxRegistry()
+    reg.get("qgone")
+    reg.close("qgone")
+    env = encode_envelope("qgone", 1, 0, 2, pd.DataFrame({0: [1]}))
+    before = reg.straggler_drops
+    reg.deliver(env)
+    assert reg.straggler_drops == before + 1
+    assert "qgone" not in reg.live_queries()  # straggler didn't resurrect it
+    # an explicit re-open clears the tombstone: the id is live again
+    reg.get("qgone")
+    reg.deliver(env)
+    assert reg.straggler_drops == before + 1
+    assert "qgone" in reg.live_queries()
+    reg.close("qgone")
+
+
+# -- send retry (tentpole 4) -------------------------------------------------
+
+
+def test_mailbox_send_retries_transient_failure():
+    import pandas as pd
+
+    from pinot_tpu.multistage import runtime as R
+    from pinot_tpu.multistage.transport import (
+        DistributedMailbox,
+        MailboxHTTPService,
+        MailboxRegistry,
+    )
+
+    reg = MailboxRegistry()
+    svc = MailboxHTTPService(reg)
+    try:
+        sender = DistributedMailbox()
+        sender.configure("qret", "me", {(1, 0): "other"}, {"other": svc.url})
+        sender.retry_initial_s = 0.01
+        FAULTS.configure({"mailbox.send": FaultRule(max_count=1)})  # one failure
+        df = pd.DataFrame({0: np.arange(3, dtype=np.int64)})
+        sender.send(2, 1, 0, df)
+        sender.send(2, 1, 0, R._EOS)
+        assert FAULTS.counts()["mailbox.send"] == 1
+        box = reg.get("qret")
+        box.receive_timeout = 5.0
+        frames = box.receive_all(1, 0, 2, n_senders=1)
+        assert len(frames) == 1 and frames[0][0].tolist() == [0, 1, 2]
+    finally:
+        svc.stop()
+
+
+def test_mailbox_send_exhausted_retries_raise():
+    from pinot_tpu.multistage.transport import DistributedMailbox
+
+    sender = DistributedMailbox()
+    # nothing listens on this port: every attempt is connection-refused
+    sender.configure("qdead", "me", {(1, 0): "other"}, {"other": "http://127.0.0.1:1"})
+    sender.send_retries = 2
+    sender.retry_initial_s = 0.01
+    import pandas as pd
+
+    with pytest.raises(RuntimeError, match="mailbox send to other"):
+        sender.send(2, 1, 0, pd.DataFrame({0: [1]}))
+
+
+# -- failure detector single-admit (satellite 1) -----------------------------
+
+
+def test_failure_detector_probe_is_single_admit():
+    from pinot_tpu.cluster.failure import FailureDetector
+
+    fd = FailureDetector(initial_delay_sec=0.05, probe_ttl_sec=10.0)
+    fd.mark_failure("s0")
+    assert not fd.is_healthy("s0")
+    time.sleep(0.06)
+    # the retry is due: exactly ONE caller wins the probe slot
+    assert fd.is_healthy("s0")
+    assert not fd.is_healthy("s0")  # herd stays excluded
+    assert fd.unhealthy_servers() == ["s0"]
+    fd.mark_success("s0")  # probe resolved: everyone sees healthy again
+    assert fd.is_healthy("s0") and fd.is_healthy("s0")
+
+
+def test_failure_detector_probe_ttl_reopens_slot():
+    from pinot_tpu.cluster.failure import FailureDetector
+
+    fd = FailureDetector(initial_delay_sec=0.01, probe_ttl_sec=0.05)
+    fd.mark_failure("s0")
+    time.sleep(0.02)
+    assert fd.is_healthy("s0")
+    assert not fd.is_healthy("s0")
+    time.sleep(0.06)  # the prober died without resolving: TTL reopens the slot
+    assert fd.is_healthy("s0")
+
+
+# -- v1 engine: deadline / partial / cancel ----------------------------------
+
+
+def test_v1_timeout_is_bounded_and_distinct(tmp_path):
+    _, _, broker = _build_cluster(tmp_path)
+    FAULTS.configure({"segment.execute": FaultRule(mode="delay", delay_s=0.4)})
+    t0 = time.monotonic()
+    with pytest.raises(QueryTimeoutError) as ei:
+        broker.execute("SET timeoutMs = 300; SELECT COUNT(*) FROM t")
+    assert time.monotonic() - t0 < 0.3 + 1.0  # timeoutMs + 1s slack
+    assert ei.value.error_code == 250  # distinct timeout code
+    assert broker.running_queries() == []  # registry drained
+
+
+def test_v1_partial_results_after_failed_failover(tmp_path):
+    controller, servers, broker = _build_cluster(tmp_path, replication=1)
+    controller._servers["s0"] = _DeadServer(servers["s0"])
+    # without the option the failure stays fatal
+    with pytest.raises(RuntimeError, match="unreachable"):
+        broker.execute("SELECT COUNT(*) FROM t")
+    res = broker.execute("SET allowPartialResults = true; SELECT COUNT(*) FROM t")
+    assert res.partial_result
+    assert res.exceptions and "unreachable" in res.exceptions[0]["message"]
+    assert res.num_servers_queried == 2 and res.num_servers_responded == 1
+    # the surviving server's rows were merged, not discarded
+    assert 0 < res.rows[0][0] < 2000
+    d = res.to_dict()
+    assert d["partialResult"] and d["exceptions"] and d["numServersQueried"] == 2
+    # streaming selection path degrades the same way
+    res2 = broker.execute("SET allowPartialResults = true; SELECT v FROM t LIMIT 100000")
+    assert res2.partial_result and 0 < len(res2.rows) < 2000
+
+
+def test_v1_cancel_within_one_second(tmp_path):
+    _, _, broker = _build_cluster(tmp_path)
+    FAULTS.configure({"segment.execute": FaultRule(mode="delay", delay_s=0.3)})
+    outcome = {}
+
+    def run():
+        try:
+            broker.execute("SELECT COUNT(*) FROM t")
+            outcome["err"] = None
+        except Exception as e:  # noqa: BLE001
+            outcome["err"] = e
+
+    th = threading.Thread(target=run)
+    th.start()
+    deadline = time.monotonic() + 2.0
+    while not broker.running_queries() and time.monotonic() < deadline:
+        time.sleep(0.01)
+    running = broker.running_queries()
+    assert running, "query never registered"
+    t0 = time.monotonic()
+    assert broker.cancel_query(running[0]["queryId"])
+    th.join(timeout=2.0)
+    assert time.monotonic() - t0 < 1.0
+    assert isinstance(outcome["err"], QueryCancelledError)
+    assert outcome["err"].error_code == 503
+    assert not broker.cancel_query("no-such-query")
+
+
+# -- v2 engine: deadline / cancel --------------------------------------------
+
+
+def test_v2_inprocess_timeout(tmp_path):
+    _, _, broker = _build_cluster(tmp_path)
+    FAULTS.configure({"segment.execute": FaultRule(mode="delay", delay_s=0.4)})
+    t0 = time.monotonic()
+    with pytest.raises(QueryTimeoutError):
+        broker.execute(
+            "SET useMultistageEngine = true; SET timeoutMs = 300; "
+            "SELECT d, COUNT(*) FROM t GROUP BY d"
+        )
+    assert time.monotonic() - t0 < 0.3 + 1.0
+
+
+@pytest.fixture()
+def dist_cluster(tmp_path):
+    """Two real HTTP servers: v2 stages run remotely, blocks cross sockets."""
+    from pinot_tpu.cluster.http import RemoteServerClient, ServerHTTPService
+
+    controller = Controller(PropertyStore(), tmp_path / "ds")
+    inner = {f"s{i}": Server(f"s{i}") for i in range(2)}
+    services = {sid: ServerHTTPService(s, port=0) for sid, s in inner.items()}
+    for sid, svc in services.items():
+        controller.register_server(sid, RemoteServerClient(f"http://127.0.0.1:{svc.port}"))
+    schema = Schema.build(
+        "t", dimensions=[("d", DataType.INT)], metrics=[("v", DataType.LONG)]
+    )
+    controller.add_schema(schema)
+    controller.add_table(TableConfig("t", replication=1))
+    b = SegmentBuilder(schema)
+    rng = np.random.default_rng(0)
+    for i in range(4):
+        controller.upload_segment(
+            "t",
+            b.build(
+                {
+                    "d": rng.integers(0, 10, 500).astype(np.int32),
+                    "v": np.full(500, i, dtype=np.int64),
+                },
+                f"t_{i}",
+            ),
+        )
+    broker = Broker(controller)
+    yield controller, inner, broker
+    for svc in services.values():
+        svc.stop()
+    if broker._dispatcher is not None:
+        broker._dispatcher.stop()
+
+
+def _assert_no_leaked_mailboxes(broker, inner, timeout=3.0):
+    """Every participant's registry must drain once the query dies (reapers
+    run on daemon threads, so poll briefly)."""
+    regs = [s.mailbox_registry for s in inner.values()]
+    if broker._dispatcher is not None:
+        regs.append(broker._dispatcher.registry)
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if all(not r.live_queries() for r in regs):
+            return
+        time.sleep(0.05)
+    leaked = {i: r.live_queries() for i, r in enumerate(regs) if r.live_queries()}
+    raise AssertionError(f"mailboxes leaked after query death: {leaked}")
+
+
+def test_v2_distributed_stage_timeout_no_leaks(dist_cluster):
+    """Acceptance: a v2 query whose mid-plan stage is delayed past the
+    deadline fails with the timeout error within timeoutMs + 1s, leaves no
+    mailbox behind, and doesn't hang the broker thread."""
+    _, inner, broker = dist_cluster
+    # warm up the distributed path (plan build + listener sockets)
+    res = broker.execute(
+        "SET useMultistageEngine = true; SELECT d, COUNT(*) FROM t GROUP BY d LIMIT 20"
+    )
+    assert len(res.rows) > 0 and broker._dispatcher is not None
+    FAULTS.configure({"segment.execute": FaultRule(mode="delay", delay_s=0.5)})
+    t0 = time.monotonic()
+    with pytest.raises(QueryTimeoutError):
+        broker.execute(
+            "SET useMultistageEngine = true; SET timeoutMs = 400; "
+            "SELECT d, COUNT(*) FROM t GROUP BY d LIMIT 20"
+        )
+    assert time.monotonic() - t0 < 0.4 + 1.0
+    FAULTS.reset()
+    _assert_no_leaked_mailboxes(broker, inner)
+    # the plane recovers: the same query succeeds afterwards
+    res = broker.execute(
+        "SET useMultistageEngine = true; SELECT COUNT(*) FROM t"
+    )
+    assert res.rows[0][0] == 2000
+
+
+def test_v2_distributed_cancel(dist_cluster):
+    _, inner, broker = dist_cluster
+    FAULTS.configure({"segment.execute": FaultRule(mode="delay", delay_s=0.3)})
+    outcome = {}
+
+    def run():
+        try:
+            broker.execute(
+                "SET useMultistageEngine = true; SELECT d, COUNT(*) FROM t GROUP BY d"
+            )
+            outcome["err"] = None
+        except Exception as e:  # noqa: BLE001
+            outcome["err"] = e
+
+    th = threading.Thread(target=run)
+    th.start()
+    deadline = time.monotonic() + 2.0
+    while not broker.running_queries() and time.monotonic() < deadline:
+        time.sleep(0.01)
+    running = broker.running_queries()
+    assert running, "query never registered"
+    t0 = time.monotonic()
+    assert broker.cancel_query(running[0]["queryId"])
+    th.join(timeout=3.0)
+    assert time.monotonic() - t0 < 1.0
+    assert isinstance(outcome["err"], QueryCancelledError)
+    FAULTS.reset()
+    _assert_no_leaked_mailboxes(broker, inner)
+
+
+# -- HTTP surface ------------------------------------------------------------
+
+
+def test_http_cancel_and_timeout_error_code(tmp_path):
+    from pinot_tpu.cluster.http import (
+        BrokerHTTPService,
+        ControllerHTTPService,
+        query_broker_http,
+    )
+
+    controller, _, broker = _build_cluster(tmp_path)
+    bsvc = BrokerHTTPService(broker, port=0)
+    csvc = ControllerHTTPService(controller, port=0)
+    controller.register_broker("b0", "127.0.0.1", bsvc.port)
+    try:
+        broker_url = f"http://127.0.0.1:{bsvc.port}"
+        # timed-out queries surface the distinct error code over HTTP
+        FAULTS.configure({"segment.execute": FaultRule(mode="delay", delay_s=0.4)})
+        out = query_broker_http(broker_url, "SET timeoutMs = 300; SELECT COUNT(*) FROM t")
+        assert out["exceptions"][0]["errorCode"] == 250
+        FAULTS.reset()
+
+        # cancel an in-flight query through DELETE /query/{id} via broker AND
+        # through the controller proxy
+        for target in ("broker", "controller"):
+            FAULTS.configure({"segment.execute": FaultRule(mode="delay", delay_s=0.3)})
+            outcome = {}
+
+            def run():
+                outcome["resp"] = query_broker_http(broker_url, "SELECT COUNT(*) FROM t")
+
+            th = threading.Thread(target=run)
+            th.start()
+            deadline = time.monotonic() + 2.0
+            while not broker.running_queries() and time.monotonic() < deadline:
+                time.sleep(0.01)
+            qid = broker.running_queries()[0]["queryId"]
+            base = broker_url if target == "broker" else f"http://127.0.0.1:{csvc.port}"
+            req = urllib.request.Request(f"{base}/query/{qid}", method="DELETE")
+            with urllib.request.urlopen(req, timeout=5) as resp:
+                import json
+
+                assert json.loads(resp.read())["cancelled"] is True
+            th.join(timeout=3.0)
+            assert outcome["resp"]["exceptions"][0]["errorCode"] == 503
+            FAULTS.reset()
+
+        # unknown id -> 404 on both surfaces
+        for base in (broker_url, f"http://127.0.0.1:{csvc.port}"):
+            req = urllib.request.Request(f"{base}/query/nope", method="DELETE")
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(req, timeout=5)
+            assert ei.value.code == 404
+    finally:
+        bsvc.stop()
+        csvc.stop()
+
+
+def test_client_partial_result_surface():
+    from pinot_tpu.client import Connection, PinotClientError, ResultSet
+
+    # partial response: rows + exceptions coexist, no raise
+    rs = ResultSet(
+        {
+            "resultTable": {
+                "dataSchema": {"columnNames": ["c"], "columnDataTypes": ["LONG"]},
+                "rows": [[1]],
+            },
+            "partialResult": True,
+            "exceptions": [{"errorCode": 200, "message": "server s0 unreachable"}],
+            "numServersQueried": 2,
+            "numServersResponded": 1,
+        }
+    )
+    assert rs.partial_result and rs.rows == [[1]]
+    assert rs.execution_stats["numServersResponded"] == 1
+    # exceptions without rows stay fatal
+    with pytest.raises(PinotClientError):
+        ResultSet({"exceptions": [{"errorCode": 250, "message": "timed out"}]})
+    # option plumbing: execute() prepends the SET statements
+    seen = {}
+
+    class _Conn(Connection):
+        def __init__(self):
+            pass
+
+    conn = _Conn()
+    conn._selector = type("S", (), {"urls_in_order": lambda self: ["http://x"]})()
+    import pinot_tpu.client as client_mod
+
+    orig = client_mod.query_broker_http
+    client_mod.query_broker_http = lambda url, sql: seen.update(sql=sql) or {
+        "resultTable": {"dataSchema": {}, "rows": []}
+    }
+    try:
+        conn.execute("SELECT 1 FROM t", timeout_ms=1500, allow_partial_results=True)
+    finally:
+        client_mod.query_broker_http = orig
+    assert "SET timeoutMs = 1500;" in seen["sql"]
+    assert "SET allowPartialResults = true;" in seen["sql"]
+
+
+# -- per-point chaos sweep ---------------------------------------------------
+
+
+def test_v1_survives_scatter_error_injection_with_replicas(tmp_path):
+    """With replication=2 and a one-shot scatter failure, the failover round
+    absorbs the injected error: the query still answers correctly. The fault
+    enters at server.scatter, where Server converts the InjectedFault into
+    the connection-class 'unreachable' error the broker classifies on."""
+    from pinot_tpu.cluster.failure import FailureDetector
+
+    controller, _, _ = _build_cluster(tmp_path, replication=2)
+    broker = Broker(controller, failure_detector=FailureDetector(initial_delay_sec=0.05))
+    FAULTS.configure({"server.scatter": FaultRule(max_count=1)}, seed=7)
+    res = broker.execute("SELECT COUNT(*) FROM t")
+    assert res.rows[0][0] == 2000
+    # the fault actually fired (the pass wasn't vacuous)
+    assert FAULTS.counts().get("server.scatter", 0) == 1
