@@ -20,6 +20,7 @@ Covered (JMH analog in parens):
 from __future__ import annotations
 
 import json
+import os
 import sys
 import time
 
@@ -402,6 +403,26 @@ def bench_deadline_overhead(n=200_000, dim=2_000):
     }
 
 
+def bench_lint_runtime():
+    """pinotlint must stay fast enough to sit in tier-1 and CI: a whole-package
+    run (all five checkers, ~200 modules) is asserted under the 10s budget on
+    CPU. Parse + visit dominates; there is no jax work in the analyzer."""
+    from pinot_tpu.devtools.lint import lint_paths
+
+    pkg = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "pinot_tpu")
+    t0 = time.perf_counter()
+    findings = lint_paths([pkg], require_reason=True)
+    wall_s = time.perf_counter() - t0
+    assert not findings, f"package must lint clean: {findings[:3]}"
+    assert wall_s < 10.0, f"whole-package lint took {wall_s:.1f}s — over the 10s CI budget"
+    return {
+        "metric": "lint_runtime",
+        "value": round(wall_s * 1e3, 3),
+        "unit": "ms",
+        "findings": len(findings),
+    }
+
+
 ALL = [
     bench_filter_mask,
     bench_grouped_sum_xla,
@@ -417,6 +438,7 @@ ALL = [
     bench_multistage_join_e2e,
     bench_stats_overhead,
     bench_deadline_overhead,
+    bench_lint_runtime,
 ]
 
 

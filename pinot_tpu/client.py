@@ -130,7 +130,7 @@ class Connection:
             if self._controller_url is not None:
                 try:
                     self._selector = _BrokerSelector(self._discover())
-                except Exception:
+                except Exception:  # pinotlint: disable=deadline-swallow — broker rediscovery is best-effort; no deadline errors cross this discovery call
                     pass
             if attempt < retries_per_broker:
                 time.sleep(0.05 * (attempt + 1))

@@ -90,7 +90,7 @@ class BasicAuthAccessControl(AccessControl):
         if auth.startswith("Basic "):
             try:
                 return base64.b64decode(auth[6:]).decode()
-            except Exception:
+            except Exception:  # pinotlint: disable=deadline-swallow — garbled auth header means anonymous; no query runs inside this try
                 return None
         if auth.startswith("Bearer "):
             # token-only principals use user "": identity "user:token" form

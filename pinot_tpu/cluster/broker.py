@@ -16,6 +16,7 @@ import threading
 import time
 from concurrent.futures import ThreadPoolExecutor
 
+from pinot_tpu.common.errors import QueryErrorCode
 from pinot_tpu.query import ast
 from pinot_tpu.query.context import QueryContext, QueryType
 from pinot_tpu.query.engine import QueryEngine
@@ -68,7 +69,7 @@ class _PartialState:
         self.servers_queried = 0
         self.servers_responded = 0
 
-    def record(self, message: str, error_code: int = 200) -> None:
+    def record(self, message: str, error_code: int = QueryErrorCode.QUERY_EXECUTION) -> None:
         self.partial = True
         self.exceptions.append({"errorCode": error_code, "message": message})
 
@@ -157,9 +158,7 @@ class Broker:
                 continue
             try:
                 found = bool(cancel(qid)) or found
-            except Exception:
-                # a server that can't be reached for the cancel is already
-                # failing the query its own way; best-effort fan-out
+            except Exception:  # pinotlint: disable=deadline-swallow — best-effort cancel fan-out; an unreachable server is already failing the query
                 pass
         disp = self._dispatcher
         if disp is not None and qid in disp.registry.live_queries():
@@ -490,7 +489,7 @@ class Broker:
                 if adaptive is not None:
                     adaptive.record(sid, (time.perf_counter() - t0) * 1e3)
                 out_q.put(("done", sid))
-            except Exception as e:
+            except Exception as e:  # pinotlint: disable=deadline-swallow — every branch enqueues e to out_q; the gather loop re-raises it
                 if isinstance(e, (RuntimeError, OSError)) and (
                     "unreachable" in str(e) or "truncated" in str(e) or isinstance(e, OSError)
                 ):

@@ -215,7 +215,7 @@ class Controller:
                 try:
                     handles[sid].add_segment(table, segment.name, str(seg_dir))
                     self._transitions.record_external_view(table, segment.name, sid, "ONLINE")
-                except Exception:
+                except Exception:  # pinotlint: disable=deadline-swallow — segment-add control plane; failure enqueues a retryable helix transition
                     self._transitions.enqueue(table, segment.name, sid, "add", str(seg_dir))
             else:
                 handles[sid].add_segment(table, segment.name, str(seg_dir))

@@ -224,7 +224,7 @@ class TransitionManager:
                 srv.add_segment(msg["table"], msg["segment"], msg["dir"])
             else:
                 srv.remove_segment(msg["table"], msg["segment"])
-        except Exception:
+        except Exception:  # pinotlint: disable=deadline-swallow — helix transition apply; False requeues the message
             return False
         self.record_external_view(
             msg["table"], msg["segment"], msg["server"], "ONLINE" if msg["action"] == "add" else None

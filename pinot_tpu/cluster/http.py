@@ -22,6 +22,7 @@ from pathlib import Path
 from pinot_tpu.cluster.broker import Broker
 from pinot_tpu.cluster.server import Server
 from pinot_tpu.common import datatable
+from pinot_tpu.common.errors import code_of
 
 
 def _serve(handler_cls, port: int) -> tuple[ThreadingHTTPServer, int, threading.Thread]:
@@ -113,7 +114,7 @@ class BrokerHTTPService:
                     payload = json.dumps(
                         {
                             "exceptions": [
-                                {"errorCode": getattr(e, "error_code", 200), "message": str(e)}
+                                {"errorCode": code_of(e), "message": str(e)}
                             ]
                         }
                     ).encode()
@@ -165,7 +166,9 @@ class BrokerHTTPService:
                     try:
                         found = svc.broker.cancel_query(parts[1])
                     except Exception as e:
-                        payload = json.dumps({"error": f"{type(e).__name__}: {e}"}).encode()
+                        payload = json.dumps(
+                            {"error": f"{type(e).__name__}: {e}", "errorCode": code_of(e)}
+                        ).encode()
                         self.send_response(500)
                     else:
                         payload = json.dumps(
@@ -216,7 +219,9 @@ class ServerHTTPService:
                         payload = b'{"status": "started"}'
                         self.send_response(200)
                     except Exception as e:
-                        payload = json.dumps({"error": f"{type(e).__name__}: {e}"}).encode()
+                        payload = json.dumps(
+                            {"error": f"{type(e).__name__}: {e}", "errorCode": code_of(e)}
+                        ).encode()
                         self.send_response(500)
                     self.send_header("Content-Type", "application/json")
                     self.send_header("Content-Length", str(len(payload)))
@@ -233,7 +238,9 @@ class ServerHTTPService:
                         payload = json.dumps({"found": bool(found)}).encode()
                         self.send_response(200)
                     except Exception as e:
-                        payload = json.dumps({"error": f"{type(e).__name__}: {e}"}).encode()
+                        payload = json.dumps(
+                            {"error": f"{type(e).__name__}: {e}", "errorCode": code_of(e)}
+                        ).encode()
                         self.send_response(500)
                     self.send_header("Content-Type", "application/json")
                     self.send_header("Content-Length", str(len(payload)))
@@ -251,7 +258,9 @@ class ServerHTTPService:
                         payload = b'{"status": "ok"}'
                         self.send_response(200)
                     except Exception as e:
-                        payload = json.dumps({"error": f"{type(e).__name__}: {e}"}).encode()
+                        payload = json.dumps(
+                            {"error": f"{type(e).__name__}: {e}", "errorCode": code_of(e)}
+                        ).encode()
                         self.send_response(500)
                     self.send_header("Content-Type", "application/json")
                     self.send_header("Content-Length", str(len(payload)))
@@ -289,7 +298,9 @@ class ServerHTTPService:
                                 self.wfile.write(_struct.pack("<I", len(payload)))
                                 self.wfile.write(payload)
                         except Exception as e:  # mid-stream failure marker
-                            msg = f"{type(e).__name__}: {e}".encode()
+                            # the numeric code rides in the marker text so the
+                            # broker side can still classify the failure
+                            msg = f"{type(e).__name__}: {e} [errorCode {code_of(e)}]".encode()
                             self.wfile.write(_struct.pack("<I", 0xFFFFFFFF))
                             self.wfile.write(_struct.pack("<I", len(msg)))
                             self.wfile.write(msg)
@@ -310,7 +321,9 @@ class ServerHTTPService:
                 except Exception as e:
                     # surface the real error to the broker instead of a
                     # dropped connection
-                    payload = json.dumps({"error": f"{type(e).__name__}: {e}"}).encode()
+                    payload = json.dumps(
+                            {"error": f"{type(e).__name__}: {e}", "errorCode": code_of(e)}
+                        ).encode()
                     self.send_response(500)
                     self.send_header("Content-Type", "application/json")
                     self.send_header("Content-Length", str(len(payload)))
@@ -593,7 +606,7 @@ class ControllerHTTPService:
                     else:
                         self._json({"error": "not found"}, 404)
                 except Exception as e:
-                    self._json({"error": f"{type(e).__name__}: {e}"}, 500)
+                    self._json({"error": f"{type(e).__name__}: {e}", "errorCode": code_of(e)}, 500)
 
             def do_DELETE(self):
                 c = svc.controller
@@ -629,7 +642,7 @@ class ControllerHTTPService:
                 except ValueError as e:
                     self._json({"error": str(e)}, 409)
                 except Exception as e:
-                    self._json({"error": f"{type(e).__name__}: {e}"}, 500)
+                    self._json({"error": f"{type(e).__name__}: {e}", "errorCode": code_of(e)}, 500)
 
             def do_POST(self):  # noqa: C901
                 from pinot_tpu.common.config import TableConfig
@@ -717,7 +730,7 @@ class ControllerHTTPService:
                 except PermissionError as e:
                     self._json({"error": str(e)}, 403)
                 except Exception as e:
-                    self._json({"error": f"{type(e).__name__}: {e}"}, 500)
+                    self._json({"error": f"{type(e).__name__}: {e}", "errorCode": code_of(e)}, 500)
 
         self.httpd, self.port, self._thread = _serve(Handler, port)
 

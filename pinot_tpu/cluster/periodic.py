@@ -30,7 +30,7 @@ class ControllerPeriodicTask:
         for table in self.controller.tables():
             try:
                 out[table] = self.process_table(table)
-            except Exception as e:  # noqa: BLE001 — one bad table must not stop the sweep
+            except Exception as e:  # noqa: BLE001  # pinotlint: disable=deadline-swallow — maintenance sweep, off the query path; one bad table must not stop it
                 out[table] = {"error": f"{type(e).__name__}: {e}"}
         return out
 

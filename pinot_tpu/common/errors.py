@@ -1,0 +1,38 @@
+"""Canonical registry of query error codes (QueryException parity).
+
+Reference: org.apache.pinot.common.exception.QueryException assigns every
+failure surface a stable numeric code that travels in BrokerResponse
+`exceptions: [{"errorCode", "message"}]` entries so clients can react
+without string-matching. This module is the single place those numbers
+live; everything else imports `QueryErrorCode` (an IntEnum, so members
+serialize as plain ints in JSON and compare equal to raw wire values).
+
+pinotlint's `error-code-registry` checker flags any registered numeric
+literal used in an error-code position outside this module, so new call
+sites cannot re-hardcode 250/503/... and drift from the registry.
+"""
+
+from __future__ import annotations
+
+import enum
+
+
+class QueryErrorCode(enum.IntEnum):
+    """Numeric query error codes (QueryException.*_ERROR_CODE parity)."""
+
+    #: generic server-side execution failure; the default code attached to
+    #: partial-result exception entries when nothing more specific is known
+    QUERY_EXECUTION = 200
+
+    #: query exceeded its deadline (EXECUTION_TIMEOUT_ERROR_CODE)
+    EXECUTION_TIMEOUT = 250
+
+    #: query was cancelled via DELETE /query/{id} (QueryCancelledException)
+    QUERY_CANCELLATION = 503
+
+
+def code_of(exc: BaseException, default: int = QueryErrorCode.QUERY_EXECUTION) -> int:
+    """Error code carried by an exception (its `error_code` attribute), or
+    `default`. The one sanctioned way to map an arbitrary exception to a
+    wire code at response boundaries."""
+    return int(getattr(exc, "error_code", default))

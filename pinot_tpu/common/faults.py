@@ -24,6 +24,23 @@ import time
 from dataclasses import dataclass
 
 
+#: Declared injection points. pinotlint's `fault-point-registry` checker
+#: cross-references every ``FAULTS.maybe_fail("<point>")`` call site against
+#: this set in BOTH directions — an undeclared point at a call site and a
+#: declared point with no call site are each findings — so chaos tests can't
+#: silently reference dead points. Runtime behavior is unaffected: tests may
+#: still configure ad-hoc points (e.g. unit tests of the injector itself).
+FAULT_POINTS = frozenset(
+    {
+        "mailbox.send",  # DistributedMailbox.send, before the HTTP POST
+        "mailbox.deliver",  # MailboxRegistry.deliver, before routing an envelope
+        "segment.execute",  # per-segment execution (v1 engine + v2 leaf scan)
+        "server.scatter",  # Server.execute_partials entry (v1 scatter target)
+        "stream.consume",  # Server.execute_partials_stream, per yielded frame
+    }
+)
+
+
 class InjectedFault(ConnectionError):
     """Raised by error-mode rules. Subclasses ConnectionError so transport
     layers classify it as a connection-class failure (retry/failover paths

@@ -1,0 +1,47 @@
+"""pinotlint: project-invariant static analyzer for pinot_tpu.
+
+Five AST checkers enforce the conventions the engine's correctness actually
+rests on — race discipline, jit purity, deadline/cancellation coverage, the
+error-code registry, and the fault-point registry. See README.md in this
+directory and the module docstrings for each checker's exact rules.
+
+Usage (CLI):   python -m pinot_tpu.devtools.lint pinot_tpu/
+Usage (code):  from pinot_tpu.devtools.lint import lint_paths
+"""
+
+from __future__ import annotations
+
+from pinot_tpu.devtools.lint.core import Checker, Finding, run
+from pinot_tpu.devtools.lint.deadlines import DeadlineChecker
+from pinot_tpu.devtools.lint.error_codes import ErrorCodeChecker
+from pinot_tpu.devtools.lint.fault_points import FaultPointChecker
+from pinot_tpu.devtools.lint.jit_purity import JitPurityChecker
+from pinot_tpu.devtools.lint.races import RaceChecker
+
+#: checker-id -> class, in reporting order. Checker instances hold run state
+#: (whole-program accumulation), so callers construct fresh ones per run.
+ALL_CHECKERS: dict[str, type[Checker]] = {
+    "race-discipline": RaceChecker,
+    "jit-purity": JitPurityChecker,
+    "deadline-coverage": DeadlineChecker,  # also emits deadline-swallow
+    "error-code-registry": ErrorCodeChecker,
+    "fault-point-registry": FaultPointChecker,
+}
+
+
+def make_checkers(names: list[str] | None = None) -> list[Checker]:
+    names = names or list(ALL_CHECKERS)
+    unknown = [n for n in names if n not in ALL_CHECKERS]
+    if unknown:
+        raise KeyError(f"unknown checker(s): {unknown}; known: {sorted(ALL_CHECKERS)}")
+    return [ALL_CHECKERS[n]() for n in names]
+
+
+def lint_paths(
+    paths: list[str], checks: list[str] | None = None, require_reason: bool = False
+) -> list[Finding]:
+    """Run the analyzer over `paths`; returns unsuppressed findings."""
+    return run(paths, make_checkers(checks), require_reason=require_reason)
+
+
+__all__ = ["ALL_CHECKERS", "Checker", "Finding", "lint_paths", "make_checkers", "run"]

@@ -1,0 +1,56 @@
+"""CLI: `python -m pinot_tpu.devtools.lint [options] path [path ...]`.
+
+Exit status is the CI contract: 0 when no findings survive suppression,
+1 when any do, 2 on usage errors. Imports nothing heavy (no jax/pandas):
+the analyzer is pure-stdlib `ast`, so the CI lint step is cheap.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from pinot_tpu.devtools.lint import ALL_CHECKERS, lint_paths
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m pinot_tpu.devtools.lint",
+        description="pinotlint: project-invariant static analyzer",
+    )
+    ap.add_argument("paths", nargs="*", help=".py files or directories to analyze")
+    ap.add_argument(
+        "--check",
+        action="append",
+        metavar="NAME",
+        help=f"run only this checker (repeatable); known: {', '.join(ALL_CHECKERS)}",
+    )
+    ap.add_argument("--list", action="store_true", help="list checkers and exit")
+    ap.add_argument(
+        "--require-reason",
+        action="store_true",
+        help="flag suppression comments that carry no reason text",
+    )
+    args = ap.parse_args(argv)
+    if args.list:
+        for name, cls in ALL_CHECKERS.items():
+            doc = (cls.__module__ and sys.modules[cls.__module__].__doc__) or ""
+            print(f"{name}: {doc.strip().splitlines()[0] if doc else ''}")
+        return 0
+    if not args.paths:
+        ap.print_usage(sys.stderr)
+        return 2
+    try:
+        findings = lint_paths(args.paths, checks=args.check, require_reason=args.require_reason)
+    except (FileNotFoundError, KeyError) as e:
+        print(f"pinotlint: error: {e}", file=sys.stderr)
+        return 2
+    for f in findings:
+        print(f)
+    n = len(findings)
+    print(f"pinotlint: {n} finding{'s' if n != 1 else ''}" if n else "pinotlint: clean", file=sys.stderr)
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

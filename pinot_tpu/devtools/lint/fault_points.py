@@ -1,0 +1,68 @@
+"""fault-point-registry: every `FAULTS.maybe_fail("<point>")` call site must
+name a point declared in a `FAULT_POINTS` registry, and every declared point
+must have at least one call site.
+
+Without this, a chaos test can configure a rule for a point the production
+code no longer calls through — the test silently stops injecting anything
+and keeps passing. The registry lives in `pinot_tpu/common/faults.py`
+(`FAULT_POINTS = frozenset({...})`); the checker discovers it syntactically
+in the analyzed file set, so golden fixtures can declare their own.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from pinot_tpu.devtools.lint.core import Checker, Finding, ModuleInfo
+
+
+class FaultPointChecker(Checker):
+    name = "fault-point-registry"
+
+    def __init__(self):
+        # point -> list of (path, line) call sites
+        self._sites: dict[str, list[tuple[str, int]]] = {}
+        self._non_literal: list[tuple[str, int]] = []
+        # declared point -> (path, line of the registry literal)
+        self._registry: dict[str, tuple[str, int]] = {}
+        self._registry_seen = False
+
+    def check_module(self, module: ModuleInfo) -> list[Finding]:
+        for node in ast.walk(module.tree):
+            if isinstance(node, ast.Call):
+                fn = node.func
+                if isinstance(fn, ast.Attribute) and fn.attr == "maybe_fail":
+                    if node.args and isinstance(node.args[0], ast.Constant) and isinstance(node.args[0].value, str):
+                        self._sites.setdefault(node.args[0].value, []).append((module.path, node.lineno))
+                    else:
+                        self._non_literal.append((module.path, node.lineno))
+            elif isinstance(node, ast.Assign):
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name) and tgt.id == "FAULT_POINTS":
+                        self._registry_seen = True
+                        for c in ast.walk(node.value):
+                            if isinstance(c, ast.Constant) and isinstance(c.value, str):
+                                self._registry.setdefault(c.value, (module.path, c.lineno))
+        return []
+
+    def finalize(self, modules) -> list[Finding]:
+        out: list[Finding] = []
+        for path, line in self._non_literal:
+            out.append(
+                Finding(self.name, path, line, "maybe_fail() point must be a string literal so the registry can be checked")
+            )
+        if not self._registry_seen:
+            if self._sites:
+                path, line = next(iter(self._sites.values()))[0]
+                out.append(Finding(self.name, path, line, "no FAULT_POINTS registry declared in the analyzed files"))
+            return out
+        for point, sites in sorted(self._sites.items()):
+            if point not in self._registry:
+                for path, line in sites:
+                    out.append(Finding(self.name, path, line, f"fault point {point!r} is not declared in FAULT_POINTS"))
+        for point, (path, line) in sorted(self._registry.items()):
+            if point not in self._sites:
+                out.append(
+                    Finding(self.name, path, line, f"declared fault point {point!r} has no maybe_fail() call site (dead point)")
+                )
+        return out

@@ -1,0 +1,255 @@
+"""jit-purity: functions compiled by `jax.jit` / `pl.pallas_call` (and the
+same-module functions they call) must be traceable-pure.
+
+Inside a compiled function the Python body runs ONCE, at trace time; a host
+side effect there silently runs once instead of per-call, and a branch on a
+traced value raises a ConcretizationTypeError at runtime — on TPU, usually
+long after the code looked fine on CPU test shapes. Three rules:
+
+host-call
+    No calls into host-effect namespaces: `time.*`, `logging.*`,
+    `random.*`, `np.random.*` / `numpy.random.*`, the metrics registries
+    (`server_metrics`/`broker_metrics`), or `print`/`open`/`input`.
+    Applies to the compiled function and every same-module function it
+    (transitively) calls by name.
+
+nonlocal-mutation
+    No `global`/`nonlocal` declarations and no item/attribute stores whose
+    base is a free (closed-over) variable — trace-time mutation of host
+    state. (`ref[...] = ...` on a parameter is fine: pallas refs are
+    parameters.) Deliberate trace-time capture must carry a suppression
+    with its reason.
+
+non-static-branch
+    In the compiled function itself, an `if`/`while` test may not reference
+    a parameter unless that parameter is listed in `static_argnames` /
+    `static_argnums`, or the test only consults trace-static facets
+    (`.shape`/`.ndim`/`.dtype`/`len(...)`/`is None`). Callees are exempt —
+    their argument staticness is unknowable lexically.
+
+Compiled-function discovery is lexical, per module: `@jax.jit` (bare or via
+`functools.partial(jax.jit, ...)`) decorators, `jax.jit(f)` calls, and
+kernels handed to `pl.pallas_call(f, ...)` / `shard_map(f, ...)`, with `f`
+resolved through enclosing scopes.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from pinot_tpu.devtools.lint.core import Checker, Finding, ModuleInfo, dotted_name
+
+_HOST_ROOTS = {"time", "logging", "random"}
+_HOST_BUILTINS = {"print", "open", "input"}
+_METRICS = {"server_metrics", "broker_metrics"}
+_WRAPPERS = {"pallas_call", "shard_map", "vmap", "pmap"}  # compile the Name they wrap
+_STATIC_FACETS = {"shape", "ndim", "dtype", "size"}
+
+
+def _is_jit(node: ast.AST) -> bool:
+    """`jit` / `jax.jit` (any dotting)."""
+    name = dotted_name(node)
+    return name == "jit" or name.endswith(".jit")
+
+
+def _jit_static(call: ast.Call, fn: ast.FunctionDef | ast.Lambda) -> set[str]:
+    """Parameter names made static by a jit call's static_argnames/nums."""
+    out: set[str] = set()
+    params = [a.arg for a in fn.args.args] if isinstance(fn, ast.FunctionDef) else []
+    for kw in call.keywords:
+        if kw.arg == "static_argnames":
+            for c in ast.walk(kw.value):
+                if isinstance(c, ast.Constant) and isinstance(c.value, str):
+                    out.add(c.value)
+        elif kw.arg == "static_argnums":
+            for c in ast.walk(kw.value):
+                if isinstance(c, ast.Constant) and isinstance(c.value, int) and c.value < len(params):
+                    out.add(params[c.value])
+    return out
+
+
+class _ScopedDefs(ast.NodeVisitor):
+    """Map every FunctionDef to its enclosing-scope chain so `jax.jit(run)`
+    resolves `run` to the nearest lexically enclosing definition."""
+
+    def __init__(self):
+        self.scope_stack: list[dict[str, ast.AST]] = [{}]
+        self.scope_of_call: dict[ast.Call, list[dict]] = {}
+
+    def visit_FunctionDef(self, node: ast.FunctionDef):
+        self.scope_stack[-1][node.name] = node
+        self.scope_stack.append({})
+        self.generic_visit(node)
+        self.scope_stack.pop()
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Call(self, node: ast.Call):
+        self.scope_of_call[node] = [dict(s) for s in self.scope_stack]
+        self.generic_visit(node)
+
+    def resolve(self, call: ast.Call, name: str):
+        for scope in reversed(self.scope_of_call.get(call, [])):
+            if name in scope:
+                return scope[name]
+        return None
+
+
+class JitPurityChecker(Checker):
+    name = "jit-purity"
+
+    def check_module(self, module: ModuleInfo) -> list[Finding]:
+        defs = _ScopedDefs()
+        defs.visit(module.tree)
+        # compiled root -> set of static param names
+        roots: dict[ast.AST, set[str]] = {}
+
+        for node in ast.walk(module.tree):
+            if isinstance(node, ast.FunctionDef):
+                for dec in node.decorator_list:
+                    if _is_jit(dec):
+                        roots.setdefault(node, set())
+                    elif isinstance(dec, ast.Call):
+                        if _is_jit(dec.func):
+                            roots.setdefault(node, set()).update(_jit_static(dec, node))
+                        elif dotted_name(dec.func).endswith("partial") and dec.args and _is_jit(dec.args[0]):
+                            roots.setdefault(node, set()).update(_jit_static(dec, node))
+            elif isinstance(node, ast.Call):
+                fn_name = dotted_name(node.func)
+                wrapped = None
+                if _is_jit(node.func) and node.args and isinstance(node.args[0], ast.Name):
+                    wrapped = defs.resolve(node, node.args[0].id)
+                    if wrapped is not None:
+                        roots.setdefault(wrapped, set()).update(_jit_static(node, wrapped))
+                    continue
+                if fn_name.split(".")[-1] in _WRAPPERS and node.args and isinstance(node.args[0], ast.Name):
+                    wrapped = defs.resolve(node, node.args[0].id)
+                    if wrapped is not None:
+                        roots.setdefault(wrapped, set())
+
+        out: list[Finding] = []
+        visited: set[ast.AST] = set()
+        for fn, static in roots.items():
+            out.extend(self._check_fn(module, fn, static, defs, visited, is_root=True))
+        return out
+
+    # ------------------------------------------------------------------
+
+    def _check_fn(self, module, fn, static, defs, visited, is_root) -> list[Finding]:
+        if fn in visited:
+            return []
+        visited.add(fn)
+        out: list[Finding] = []
+        params = {a.arg for a in fn.args.args + fn.args.kwonlyargs} if isinstance(fn, ast.FunctionDef) else set()
+        local_names = set(params)
+        body = fn.body if isinstance(fn.body, list) else [fn.body]
+        body_nodes = [n for stmt in body for n in ast.walk(stmt)]
+        for n in body_nodes:
+            if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Store):
+                local_names.add(n.id)
+            elif isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                local_names.add(n.name)
+            elif isinstance(n, ast.arg):  # nested defs' params (pallas refs)
+                local_names.add(n.arg)
+
+        callees: list[ast.Call] = []
+        for n in body_nodes:
+            if isinstance(n, (ast.Global, ast.Nonlocal)):
+                out.append(
+                    Finding(
+                        self.name, module.path, n.lineno,
+                        f"compiled function mutates {'global' if isinstance(n, ast.Global) else 'nonlocal'} "
+                        f"state ({', '.join(n.names)}): trace-time side effect",
+                    )
+                )
+            elif isinstance(n, (ast.Assign, ast.AugAssign)):
+                targets = n.targets if isinstance(n, ast.Assign) else [n.target]
+                for t in targets:
+                    base = t
+                    while isinstance(base, (ast.Subscript, ast.Attribute)):
+                        base = base.value
+                    if (
+                        isinstance(base, ast.Name)
+                        and base is not t  # plain Name store is a local binding
+                        and base.id not in local_names
+                    ):
+                        out.append(
+                            Finding(
+                                self.name, module.path, n.lineno,
+                                f"compiled function stores into closed-over {base.id!r}: "
+                                "trace-time mutation of host state (runs once, not per call)",
+                            )
+                        )
+            elif isinstance(n, ast.Call):
+                callees.append(n)
+                out.extend(self._check_host_call(module, n))
+            elif is_root and isinstance(n, (ast.If, ast.While)):
+                bad = self._nonstatic_param_in_test(n.test, params - static)
+                if bad:
+                    out.append(
+                        Finding(
+                            self.name, module.path, n.lineno,
+                            f"branch on non-static parameter {bad!r} inside a compiled function "
+                            "(mark it static_argnames/static_argnums or use lax.cond/jnp.where)",
+                        )
+                    )
+
+        # transitive: same-module functions called by name
+        for call in callees:
+            if isinstance(call.func, ast.Name):
+                target = defs.resolve(call, call.func.id)
+                if target is not None and isinstance(target, ast.FunctionDef):
+                    sub = self._check_fn(module, target, set(), defs, visited, is_root=False)
+                    out.extend(sub)
+        return out
+
+    def _check_host_call(self, module, call: ast.Call) -> list[Finding]:
+        name = dotted_name(call.func)
+        root = name.split(".")[0]
+        leaf = name.split(".")[-1]
+        bad = (
+            name in _HOST_BUILTINS
+            or root in _HOST_ROOTS
+            or name.startswith(("np.random.", "numpy.random."))
+            or leaf in _METRICS
+        )
+        if bad:
+            return [
+                Finding(
+                    self.name, module.path, call.lineno,
+                    f"host side effect {name}() reachable from a compiled function "
+                    "(runs at trace time only, or breaks tracing)",
+                )
+            ]
+        return []
+
+    @staticmethod
+    def _nonstatic_param_in_test(test: ast.AST, nonstatic: set[str]) -> str | None:
+        """Name of a non-static param the test depends on for its VALUE, or
+        None. References through .shape/.ndim/.dtype/.size, len(param) and
+        `param is None` checks are trace-static and allowed."""
+        for n in ast.walk(test):
+            if not (isinstance(n, ast.Name) and n.id in nonstatic and isinstance(n.ctx, ast.Load)):
+                continue
+            # allowed facets are checked by looking at how the name is used;
+            # re-walk the test with parent tracking
+            if not _used_statically(test, n):
+                return n.id
+        return None
+
+
+def _used_statically(test: ast.AST, name_node: ast.Name) -> bool:
+    parents: dict[ast.AST, ast.AST] = {}
+    for p in ast.walk(test):
+        for c in ast.iter_child_nodes(p):
+            parents[c] = p
+    p = parents.get(name_node)
+    if isinstance(p, ast.Attribute) and p.attr in _STATIC_FACETS:
+        return True
+    if isinstance(p, ast.Call) and dotted_name(p.func) == "len":
+        return True
+    if isinstance(p, ast.Compare) and any(
+        isinstance(c, ast.Constant) and c.value is None for c in p.comparators
+    ) and all(isinstance(op, (ast.Is, ast.IsNot)) for op in p.ops):
+        return True
+    return False

@@ -1,0 +1,186 @@
+"""race-discipline: cross-thread mutation of instance state without the
+instance lock.
+
+Motivating bug (PR 1): two receiver threads shared one pandas `Index`
+object whose lazily-built hash engine is not thread-safe — a transient
+KeyError in groupby under concurrency. The general pattern this checker
+polices: an instance attribute that is REBOUND (assign / augassign / del)
+from a method that other threads enter — a `threading.Thread` target, an
+executor `submit`/`map` callee, an HTTP `do_GET`/`do_POST`/... handler, or
+`run` — without holding `with self.<lock>`, while some OTHER method also
+touches the same attribute outside the lock. Either side alone is fine
+(thread-confined state, or consistently locked state); the combination is
+a data race.
+
+`__init__` is exempt on both sides: construction happens-before the thread
+start. Attributes whose every access is under the lock never fire. The
+checker is per-class and purely lexical — it does not chase cross-class
+aliasing — so it is a discipline check, not a proof; suppress with a reason
+for intentional patterns (double-checked init of an immutable reference,
+monotonic counters read for monitoring, ...).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from pinot_tpu.devtools.lint.core import Checker, Finding, ModuleInfo, dotted_name
+
+_HANDLER_NAMES = {"run", "do_GET", "do_POST", "do_PUT", "do_DELETE", "do_HEAD"}
+_SPAWN_ATTRS = {"submit", "map"}
+
+
+def _is_lock_ctx(item: ast.withitem) -> bool:
+    """`with self._lock:` / `with self._foo_lock:` (optionally `.acquire()`-less
+    plain attribute, or a local alias whose name mentions lock)."""
+    expr = item.context_expr
+    name = dotted_name(expr)
+    return "lock" in name.lower() or "mutex" in name.lower()
+
+
+class _MethodScan(ast.NodeVisitor):
+    """Collect self-attribute accesses within ONE method, tagging each with
+    whether a `with <lock>` block encloses it."""
+
+    def __init__(self, self_name: str):
+        self.self_name = self_name
+        self.lock_depth = 0
+        # attr -> {"write_unlocked": line|None, "read_unlocked": line|None,
+        #          "locked": bool}
+        self.writes: dict[str, list[tuple[int, bool]]] = {}  # attr -> [(line, locked)]
+        self.reads: dict[str, list[tuple[int, bool]]] = {}
+        self.spawn_targets: set[str] = set()  # method names handed to threads
+
+    def visit_With(self, node: ast.With):
+        locky = any(_is_lock_ctx(i) for i in node.items)
+        if locky:
+            self.lock_depth += 1
+        self.generic_visit(node)
+        if locky:
+            self.lock_depth -= 1
+
+    def _self_attr(self, node: ast.AST) -> str | None:
+        if (
+            isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name)
+            and node.value.id == self.self_name
+        ):
+            return node.attr
+        return None
+
+    def _collect_target(self, t: ast.AST) -> None:
+        attr = self._self_attr(t)
+        if attr is not None:
+            self.writes.setdefault(attr, []).append((t.lineno, self.lock_depth > 0))
+        elif isinstance(t, (ast.Tuple, ast.List)):
+            for e in t.elts:
+                self._collect_target(e)
+        elif isinstance(t, ast.Starred):
+            self._collect_target(t.value)
+        else:
+            self.visit(t)  # complex target (self.d[k] = ..): inner loads count as reads
+
+    def visit_Assign(self, node: ast.Assign):
+        for tgt in node.targets:
+            self._collect_target(tgt)
+        self.visit(node.value)
+
+    def visit_AugAssign(self, node: ast.AugAssign):
+        attr = self._self_attr(node.target)
+        if attr:
+            self.writes.setdefault(attr, []).append((node.lineno, self.lock_depth > 0))
+        self.visit(node.value)
+
+    def visit_Delete(self, node: ast.Delete):
+        for t in node.targets:
+            attr = self._self_attr(t)
+            if attr:
+                self.writes.setdefault(attr, []).append((t.lineno, self.lock_depth > 0))
+
+    def visit_Attribute(self, node: ast.Attribute):
+        attr = self._self_attr(node)
+        if attr and isinstance(node.ctx, ast.Load):
+            self.reads.setdefault(attr, []).append((node.lineno, self.lock_depth > 0))
+        self.generic_visit(node)
+
+    def visit_Call(self, node: ast.Call):
+        # threading.Thread(target=self.m) / pool.submit(self.m) / pool.map(self.m)
+        fn = node.func
+        fn_name = dotted_name(fn)
+        if fn_name.endswith("Thread") or fn_name.endswith("Timer"):
+            for kw in node.keywords:
+                if kw.arg == "target":
+                    attr = self._self_attr(kw.value)
+                    if attr:
+                        self.spawn_targets.add(attr)
+        if isinstance(fn, ast.Attribute) and fn.attr in _SPAWN_ATTRS and node.args:
+            attr = self._self_attr(node.args[0])
+            if attr:
+                self.spawn_targets.add(attr)
+        self.generic_visit(node)
+
+    # do not descend into nested defs: their bodies execute in unknown
+    # thread contexts; conservatively out of scope
+    def visit_FunctionDef(self, node: ast.FunctionDef):
+        pass
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Lambda(self, node: ast.Lambda):
+        pass
+
+
+class RaceChecker(Checker):
+    name = "race-discipline"
+
+    def check_module(self, module: ModuleInfo) -> list[Finding]:
+        out: list[Finding] = []
+        for node in ast.walk(module.tree):
+            if isinstance(node, ast.ClassDef):
+                out.extend(self._check_class(module, node))
+        return out
+
+    def _check_class(self, module: ModuleInfo, cls: ast.ClassDef) -> list[Finding]:
+        methods = [n for n in cls.body if isinstance(n, ast.FunctionDef)]
+        scans: dict[str, _MethodScan] = {}
+        for m in methods:
+            self_name = m.args.args[0].arg if m.args.args else "self"
+            scan = _MethodScan(self_name)
+            for stmt in m.body:
+                scan.visit(stmt)
+            scans[m.name] = scan
+
+        spawned = set().union(*(s.spawn_targets for s in scans.values())) if scans else set()
+        thread_entries = {
+            name for name in scans if name in _HANDLER_NAMES or name in spawned
+        }
+
+        out: list[Finding] = []
+        for entry in sorted(thread_entries):
+            if entry == "__init__":
+                continue
+            for attr, writes in scans[entry].writes.items():
+                unlocked_writes = [ln for ln, locked in writes if not locked]
+                if not unlocked_writes:
+                    continue
+                for other_name, other in scans.items():
+                    if other_name in (entry, "__init__"):
+                        continue
+                    other_hits = [
+                        ln
+                        for ln, locked in other.writes.get(attr, []) + other.reads.get(attr, [])
+                        if not locked
+                    ]
+                    if other_hits:
+                        out.append(
+                            Finding(
+                                self.name,
+                                module.path,
+                                unlocked_writes[0],
+                                f"self.{attr} is mutated in thread-entry method "
+                                f"{cls.name}.{entry}() without holding the lock, and accessed "
+                                f"in {other_name}() (line {other_hits[0]}) also unlocked",
+                            )
+                        )
+                        break  # one finding per (entry, attr)
+        return out

@@ -116,7 +116,7 @@ def _fold_compare(c: ast.FilterExpr) -> "bool | None":
                 "GT": l > r,
                 "GTE": l >= r,
             }[c.op.name]
-        except Exception:
+        except Exception:  # pinotlint: disable=deadline-swallow — constant-fold probe at plan time; None means 'not foldable'
             return None
     return None
 

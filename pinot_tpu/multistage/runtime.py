@@ -122,7 +122,19 @@ class MailboxService:
                 if stats_out is not None and isinstance(item, tuple) and len(item) > 1 and item[1]:
                     stats_out.extend(item[1])
             elif isinstance(item, tuple) and item and item[0] == "__err__":
-                raise RuntimeError(f"upstream stage {send_stage} failed: {item[1]}")
+                # the marker carries the sender's error code (third slot) so a
+                # deadline/cancel failure crossing a mailbox re-raises as its
+                # distinct class instead of degrading to a generic RuntimeError
+                from pinot_tpu.common.errors import QueryErrorCode
+                from pinot_tpu.query.context import QueryCancelledError, QueryTimeoutError
+
+                code = item[2] if len(item) > 2 else None
+                msg = f"upstream stage {send_stage} failed: {item[1]}"
+                if code == QueryErrorCode.EXECUTION_TIMEOUT:
+                    raise QueryTimeoutError(msg)
+                if code == QueryErrorCode.QUERY_CANCELLATION:
+                    raise QueryCancelledError(msg)
+                raise RuntimeError(msg)
             else:
                 blocks.append(item)
         return blocks
@@ -1597,14 +1609,16 @@ def run_stage_worker(
             df, stage, parent, parent_par, mailbox, w,
             stats=ctx.stats.payload() if ctx.stats is not None else None,
         )
-    except BaseException as e:  # propagate to receivers
+    except BaseException as e:  # propagate to receivers, error code intact
+        from pinot_tpu.common.errors import code_of
+
         if errors is not None:
             errors.append(e)
         for pw in range(parent_par):
             try:
-                mailbox.send(stage.id, parent, pw, ("__err__", repr(e)))
-            except Exception:
-                pass  # receiver's timeout reports the loss
+                mailbox.send(stage.id, parent, pw, ("__err__", repr(e), code_of(e)))
+            except Exception:  # pinotlint: disable=deadline-swallow — best-effort marker forwarding; the receiver's own deadline reports the loss
+                pass
 
 
 class MultistageEngine:

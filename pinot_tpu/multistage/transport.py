@@ -32,13 +32,17 @@ from pinot_tpu.multistage import runtime as R
 
 def encode_envelope(qid: str, rs: int, rw: int, ss: int, payload) -> bytes:
     """payload: DataFrame | runtime._EOS | ("__eos__", [stats]) |
-    ("__err__", msg). A stats-carrying EOS ships the sender's accumulated
-    OperatorStats records in the header (trailing-EOS-block parity)."""
+    ("__err__", msg[, code]). A stats-carrying EOS ships the sender's
+    accumulated OperatorStats records in the header (trailing-EOS-block
+    parity); an error marker ships the sender's numeric error code so a
+    deadline/cancel failure keeps its class across processes."""
     if isinstance(payload, pd.DataFrame):
         header = {"qid": qid, "rs": rs, "rw": rw, "ss": ss, "kind": "block"}
         body = datatable.encode(payload)
     elif isinstance(payload, tuple) and payload and payload[0] == "__err__":
         header = {"qid": qid, "rs": rs, "rw": rw, "ss": ss, "kind": "err", "msg": str(payload[1])}
+        if len(payload) > 2 and payload[2] is not None:
+            header["code"] = int(payload[2])
         body = b""
     else:  # EOS
         header = {"qid": qid, "rs": rs, "rw": rw, "ss": ss, "kind": "eos"}
@@ -75,14 +79,17 @@ def decode_envelope(data: bytes):
     if kind == "block":
         try:
             df = datatable.decode(data[4 + hlen :])
-        except Exception as e:
+        except Exception as e:  # pinotlint: disable=deadline-swallow — decode sees only parse failures; ValueError is the 400-vs-500 contract
             raise ValueError(f"corrupt mailbox envelope: bad block payload ({e})") from None
         # wire format stringifies column labels; runtime blocks use
         # positional ints
         df.columns = range(len(df.columns))
         payload = df
     elif kind == "err":
-        payload = ("__err__", header.get("msg", "remote stage failed"))
+        msg = header.get("msg", "remote stage failed")
+        code = header.get("code")
+        # legacy 2-tuple when the sender shipped no code; receive_all accepts both
+        payload = ("__err__", msg, code) if code is not None else ("__err__", msg)
     elif kind == "eos":
         stats = header.get("stats")
         payload = ("__eos__", stats) if stats else R._EOS
@@ -253,7 +260,9 @@ def handle_mailbox_post(registry: MailboxRegistry, handler) -> None:
         handler.end_headers()
         handler.wfile.write(b"ok")
     except Exception as e:
-        msg = json.dumps({"error": f"{type(e).__name__}: {e}"}).encode()
+        from pinot_tpu.common.errors import code_of
+
+        msg = json.dumps({"error": f"{type(e).__name__}: {e}", "errorCode": code_of(e)}).encode()
         handler.send_response(400 if isinstance(e, ValueError) else 500)
         handler.send_header("Content-Length", str(len(msg)))
         handler.end_headers()

@@ -12,6 +12,8 @@ from __future__ import annotations
 from dataclasses import dataclass, field
 from enum import Enum
 
+from pinot_tpu.common.errors import QueryErrorCode
+
 from pinot_tpu.query.ast import (
     Expr,
     FilterExpr,
@@ -175,14 +177,14 @@ class QueryTimeoutError(RuntimeError):
     treat OSError as a connection-class failure and would fail over — a
     timed-out query must surface its distinct code instead."""
 
-    error_code = 250
+    error_code = QueryErrorCode.EXECUTION_TIMEOUT
 
 
 class QueryCancelledError(RuntimeError):
     """Query was cancelled via DELETE /query/{id} (QueryCancelledException
     parity, errorCode 503)."""
 
-    error_code = 503
+    error_code = QueryErrorCode.QUERY_CANCELLATION
 
 
 class Deadline:

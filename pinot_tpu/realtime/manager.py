@@ -118,7 +118,7 @@ class PartitionConsumer:
         return not self._resume.is_set()
 
     def _run(self) -> None:
-        self.state = "CONSUMING"
+        self.state = "CONSUMING"  # pinotlint: disable=race-discipline — state is written only by the consumer thread (_rollover runs on it); readers see a GIL-atomic str for status reporting
         while not self._stop.is_set():
             if not self._resume.is_set():
                 self.state = "PAUSED"

@@ -1,0 +1,71 @@
+"""Golden fixture for deadline-coverage and deadline-swallow. Naming the
+deadline classes below opts this module into the swallow check's scope."""
+
+from pinot_tpu.query.context import QueryCancelledError, QueryTimeoutError
+
+FAULTS = None  # lexical stand-in; the checker only reads call shapes
+
+
+def uncovered_loop(segments, deadline):
+    for seg in segments:
+        FAULTS.maybe_fail("segment.execute")  # line 11: VIOLATION no deadline check in loop
+        seg.run()
+
+
+def covered_loop(segments, deadline):
+    for seg in segments:  # CLEAN: loop observes the deadline
+        deadline.check(seg.name)
+        FAULTS.maybe_fail("segment.execute")
+        seg.run()
+
+
+def covered_by_remaining(segments, dl):
+    while segments:  # CLEAN: consults remaining()
+        if dl.remaining() <= 0:
+            break
+        FAULTS.maybe_fail("segment.execute")
+        segments.pop()
+
+
+def swallows(run):
+    try:
+        return run()
+    except Exception:  # line 33: VIOLATION swallows deadline errors
+        return None
+
+
+def reraises(run):
+    try:
+        return run()
+    except Exception:  # CLEAN: bare raise
+        raise
+
+
+def typed_first(run):
+    try:
+        return run()
+    except (QueryTimeoutError, QueryCancelledError):
+        raise
+    except Exception:  # CLEAN: typed clause precedes
+        return None
+
+
+def typed_swallow(run):
+    try:
+        return run()
+    except QueryTimeoutError:  # line 56: VIOLATION typed clause swallows
+        return None
+
+
+def maps_code(run, code_of):
+    try:
+        return run()
+    except Exception as e:  # CLEAN: maps the error code
+        return {"errorCode": code_of(e)}
+
+
+def suppressed_swallow(run):
+    try:
+        return run()
+    except Exception:  # pinotlint: disable=deadline-swallow — fixture: provably benign
+        return None

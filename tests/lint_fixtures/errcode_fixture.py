@@ -1,0 +1,34 @@
+"""Golden fixture for the error-code-registry checker: declares its own
+registry, then uses registered codes as magic literals."""
+
+
+class QueryErrorCode:
+    QUERY_EXECUTION = 200
+    EXECUTION_TIMEOUT = 250
+
+
+class TimeoutishError(RuntimeError):
+    error_code = 250  # line 11: VIOLATION magic literal for a registered code
+
+
+def record(message, error_code=250):  # line 14: VIOLATION default is a registered literal
+    return {"errorCode": 200, "message": message}  # line 15: VIOLATION dict literal
+
+
+def respond(e):
+    code = getattr(e, "error_code", 200)  # line 19: VIOLATION getattr default
+    return code
+
+
+def clean(e):
+    code = getattr(e, "error_code", QueryErrorCode.QUERY_EXECUTION)  # CLEAN: from registry
+    http_status = 200  # CLEAN: not an error-code position
+    return {"status": http_status, "errorCode": QueryErrorCode.EXECUTION_TIMEOUT, "code": code}
+
+
+def unregistered(e):
+    return {"errorCode": 999}  # CLEAN: 999 is not a registered code
+
+
+def suppressed():
+    return {"errorCode": 250}  # pinotlint: disable=error-code-registry — fixture: wire-format doc example

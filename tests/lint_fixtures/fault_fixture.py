@@ -1,0 +1,24 @@
+"""Golden fixture for the fault-point-registry checker: declares a registry
+with one live point, one dead point; calls one undeclared point."""
+
+FAULT_POINTS = frozenset(
+    {
+        "mailbox.send",  # live: called below
+        "dead.point",  # line 7: VIOLATION declared but never called
+    }
+)
+
+FAULTS = None  # lexical stand-in
+
+
+def send():
+    FAULTS.maybe_fail("mailbox.send")  # CLEAN: declared and called
+
+
+def mystery(point):
+    FAULTS.maybe_fail("un.declared")  # line 19: VIOLATION not in FAULT_POINTS
+    FAULTS.maybe_fail(point)  # line 20: VIOLATION non-literal point
+
+
+def suppressed():
+    FAULTS.maybe_fail("also.undeclared")  # pinotlint: disable=fault-point-registry — fixture: suppression demo

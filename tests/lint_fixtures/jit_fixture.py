@@ -1,0 +1,76 @@
+"""Golden fixture for the jit-purity checker (never imported: jax names are
+only referenced lexically, which is all the AST checker sees)."""
+
+import functools
+import time
+
+import jax
+import jax.numpy as jnp
+
+_cache = {}
+
+
+@jax.jit
+def impure_host_call(x):
+    t0 = time.perf_counter()  # line 15: VIOLATION host call
+    return x + t0
+
+
+@functools.partial(jax.jit, static_argnames=("n",))
+def branch_ok_static(x, n):
+    if n > 4:  # CLEAN: n is static
+        return x * 2
+    return x
+
+
+@jax.jit
+def branch_on_traced(x, y):
+    if y > 0:  # line 28: VIOLATION branch on non-static parameter
+        return x
+    return -x
+
+
+@jax.jit
+def shape_branch_ok(x):
+    if x.shape[0] > 128:  # CLEAN: shape is trace-static
+        return x[:128]
+    return x
+
+
+@jax.jit
+def mutates_closure(x):
+    _cache["last"] = x  # line 42: VIOLATION trace-time mutation
+    return x
+
+
+@jax.jit
+def suppressed_mutation(x):
+    _cache["ok"] = x  # pinotlint: disable=jit-purity — fixture: deliberate trace-time capture
+    return x
+
+
+def make_kernel():
+    def run(x):
+        print(x)  # line 54: VIOLATION host call inside jax.jit(run)
+        return jnp.sum(x)
+
+    return jax.jit(run)
+
+
+def _helper(x):
+    time.sleep(0.1)  # line 61: VIOLATION reachable from compiled caller
+    return x
+
+
+@jax.jit
+def calls_impure_helper(x):
+    return _helper(x)
+
+
+def pure_helper(x):
+    return jnp.tanh(x)
+
+
+@jax.jit
+def calls_pure_helper(x):  # CLEAN
+    return pure_helper(x)

@@ -1,0 +1,76 @@
+"""Golden fixture for the race-discipline checker.
+
+Violations and clean patterns live at KNOWN LINE NUMBERS asserted by
+tests/test_lint.py — edit with care.
+"""
+
+import threading
+
+
+class RacyCounter:
+    """VIOLATION: `hits` is mutated in the thread-entry `_loop` without the
+    lock and read unlocked in `snapshot`."""
+
+    def __init__(self):
+        self.hits = 0
+        self._lock = threading.Lock()
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+
+    def _loop(self):
+        self.hits += 1  # line 20: the flagged unlocked write
+
+    def snapshot(self):
+        return self.hits
+
+
+class LockedCounter:
+    """CLEAN: every access to `hits` holds the lock."""
+
+    def __init__(self):
+        self.hits = 0
+        self._lock = threading.Lock()
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+
+    def _loop(self):
+        with self._lock:
+            self.hits += 1
+
+    def snapshot(self):
+        with self._lock:
+            return self.hits
+
+
+class ConfinedCounter:
+    """CLEAN: `hits` is only touched by the thread-entry method itself."""
+
+    def __init__(self):
+        self.hits = 0
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+
+    def _loop(self):
+        self.hits += 1
+        print(self.hits)
+
+
+class SuppressedRacy:
+    """Same shape as RacyCounter but explicitly suppressed."""
+
+    def __init__(self):
+        self.n = 0
+        self._thread = threading.Thread(target=self.run, daemon=True)
+
+    def run(self):
+        self.n += 1  # pinotlint: disable=race-discipline — fixture: monitoring counter, staleness is fine
+
+    def read(self):
+        return self.n
+
+
+class HandlerRacy:
+    """VIOLATION: HTTP-handler method mutates shared state unlocked."""
+
+    def do_POST(self):
+        self.last_body = "x"  # line 71: flagged (do_POST is a thread entry)
+
+    def status(self):
+        return self.last_body

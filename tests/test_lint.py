@@ -1,0 +1,198 @@
+"""Golden-fixture tests for pinotlint (pinot_tpu.devtools.lint).
+
+Each fixture in tests/lint_fixtures/ carries known violations at known
+lines plus clean patterns and a suppression demo; the tests pin the exact
+(line, check) sets so any checker regression (missed or spurious finding)
+fails loudly. The suite ends with the self-run test: the whole pinot_tpu
+package must lint clean, including under --require-reason.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+from pinot_tpu.devtools.lint import ALL_CHECKERS, lint_paths, make_checkers
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURES = os.path.join(REPO, "tests", "lint_fixtures")
+PACKAGE = os.path.join(REPO, "pinot_tpu")
+
+
+def fixture(name: str) -> str:
+    return os.path.join(FIXTURES, name)
+
+
+def findings_for(name: str, checks: list[str] | None = None, **kw):
+    return lint_paths([fixture(name)], checks=checks, **kw)
+
+
+def lines_of(findings, check: str) -> list[int]:
+    return sorted(f.line for f in findings if f.check == check)
+
+
+# ---------------------------------------------------------------------------
+# per-checker golden fixtures: exact locations
+# ---------------------------------------------------------------------------
+
+
+def test_race_fixture_findings():
+    fs = findings_for("race_fixture.py", checks=["race-discipline"])
+    assert lines_of(fs, "race-discipline") == [20, 73]
+    by_line = {f.line: f.message for f in fs}
+    assert "hits" in by_line[20] and "RacyCounter" in by_line[20]
+    assert "last_body" in by_line[73] and "HandlerRacy" in by_line[73]
+
+
+def test_jit_fixture_findings():
+    fs = findings_for("jit_fixture.py", checks=["jit-purity"])
+    assert lines_of(fs, "jit-purity") == [15, 28, 42, 54, 61]
+    by_line = {f.line: f.message for f in fs}
+    assert "time.perf_counter" in by_line[15]
+    assert "y" in by_line[28]  # branch on traced parameter
+    assert "_cache" in by_line[42]  # closed-over mutation
+    assert "print" in by_line[54]
+    assert "time.sleep" in by_line[61]  # transitively reached helper
+
+
+def test_deadline_fixture_findings():
+    fs = findings_for("deadline_fixture.py", checks=["deadline-coverage"])
+    assert lines_of(fs, "deadline-coverage") == [11]
+    assert lines_of(fs, "deadline-swallow") == [33, 56]
+
+
+def test_errcode_fixture_findings():
+    fs = findings_for("errcode_fixture.py", checks=["error-code-registry"])
+    assert lines_of(fs, "error-code-registry") == [11, 14, 15, 19]
+    assert all("magic error code" in f.message for f in fs)
+
+
+def test_fault_fixture_findings():
+    fs = findings_for("fault_fixture.py", checks=["fault-point-registry"])
+    by_line = {f.line: f.message for f in fs}
+    assert sorted(by_line) == [7, 19, 20]
+    assert "dead.point" in by_line[7]  # declared but never injected
+    assert "un.declared" in by_line[19]  # injected but never declared
+    assert "literal" in by_line[20]  # non-literal point name
+
+
+# ---------------------------------------------------------------------------
+# suppressions
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize(
+    "name, checks, suppressed_line",
+    [
+        ("jit_fixture.py", ["jit-purity"], 48),
+        ("deadline_fixture.py", ["deadline-coverage"], 70),
+        ("errcode_fixture.py", ["error-code-registry"], 34),
+        ("fault_fixture.py", ["fault-point-registry"], 24),
+    ],
+)
+def test_suppressed_lines_not_reported(name, checks, suppressed_line):
+    fs = findings_for(name, checks=checks)
+    assert suppressed_line not in {f.line for f in fs}
+
+
+def test_require_reason_flags_bare_suppressions(tmp_path):
+    bare = tmp_path / "bare.py"
+    bare.write_text("x = {'errorCode': 1}  # pinotlint: disable=error-code-registry\n")
+    fs = lint_paths([str(bare)], require_reason=True)
+    assert [f.check for f in fs] == ["suppression-reason"]
+    assert fs[0].line == 1
+    # fixtures all carry reasons, so --require-reason adds nothing there
+    fs = findings_for("errcode_fixture.py", checks=["error-code-registry"], require_reason=True)
+    assert not any(f.check == "suppression-reason" for f in fs)
+
+
+def test_suppression_only_covers_named_check():
+    # a disable= for one check must not hide findings from another
+    fs = findings_for("deadline_fixture.py", checks=["deadline-coverage"])
+    assert 33 in {f.line for f in fs}  # un-suppressed swallow still reported
+
+
+# ---------------------------------------------------------------------------
+# framework behavior
+# ---------------------------------------------------------------------------
+
+
+def test_parse_error_becomes_finding(tmp_path):
+    bad = tmp_path / "bad.py"
+    bad.write_text("def broken(:\n")
+    fs = lint_paths([str(bad)])
+    assert [f.check for f in fs] == ["parse-error"]
+
+
+def test_unknown_checker_rejected():
+    with pytest.raises(KeyError):
+        make_checkers(["no-such-check"])
+
+
+def test_findings_sorted_and_stringify():
+    fs = findings_for("errcode_fixture.py", checks=["error-code-registry"])
+    assert fs == sorted(fs, key=lambda f: (f.path, f.line, f.check, f.message))
+    s = str(fs[0])
+    assert s.endswith(f"[error-code-registry] {fs[0].message}")
+    assert f":{fs[0].line}:" in s
+
+
+# ---------------------------------------------------------------------------
+# CLI contract: exit 0 clean / 1 findings / 2 usage
+# ---------------------------------------------------------------------------
+
+
+def _cli(*args: str):
+    return subprocess.run(
+        [sys.executable, "-m", "pinot_tpu.devtools.lint", *args],
+        capture_output=True,
+        text=True,
+        cwd=REPO,
+    )
+
+
+@pytest.mark.parametrize(
+    "name",
+    [
+        "race_fixture.py",
+        "jit_fixture.py",
+        "deadline_fixture.py",
+        "errcode_fixture.py",
+        "fault_fixture.py",
+    ],
+)
+def test_cli_nonzero_on_fixture(name):
+    proc = _cli(fixture(name))
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    assert name in proc.stdout
+
+
+def test_cli_list_checkers():
+    proc = _cli("--list")
+    assert proc.returncode == 0
+    for check in ALL_CHECKERS:
+        assert check in proc.stdout
+
+
+def test_cli_unknown_check_is_usage_error():
+    proc = _cli("--check", "bogus", fixture("errcode_fixture.py"))
+    assert proc.returncode == 2
+
+
+# ---------------------------------------------------------------------------
+# the tentpole invariant: the package itself lints clean
+# ---------------------------------------------------------------------------
+
+
+def test_package_lints_clean():
+    fs = lint_paths([PACKAGE], require_reason=True)
+    assert fs == [], "\n".join(str(f) for f in fs)
+
+
+def test_cli_clean_on_package():
+    proc = _cli("--require-reason", PACKAGE)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "clean" in proc.stderr
