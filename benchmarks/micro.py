@@ -403,6 +403,64 @@ def bench_deadline_overhead(n=200_000, dim=2_000):
     }
 
 
+def bench_trace_overhead(n=200_000, dim=2_000):
+    """Tracing-plane cost on the v2 hot path: the same multistage
+    join+group-by untraced vs under an active sampled trace. With sampling
+    off the per-site cost is one ContextVar read inside `trace_event()`;
+    time that disabled guard directly and hold its projected share of the
+    query wall to the <2% budget — the stable form of the assertion."""
+    from pinot_tpu.common import DataType, Schema
+    from pinot_tpu.common.trace import TraceContext, start_trace, trace_event
+    from pinot_tpu.multistage import MultistageEngine
+    from pinot_tpu.segment import SegmentBuilder
+
+    rng = np.random.default_rng(23)
+    fact_s = Schema.build("fact", dimensions=[("k", DataType.INT)], metrics=[("m", DataType.LONG)])
+    dim_s = Schema.build("dim", dimensions=[("k", DataType.INT)], metrics=[("w", DataType.LONG)])
+    fact = SegmentBuilder(fact_s).build(
+        {"k": rng.integers(0, dim, n).astype(np.int32), "m": rng.integers(1, 10, n).astype(np.int64)},
+        "f0",
+    )
+    d = SegmentBuilder(dim_s).build(
+        {"k": np.arange(dim, dtype=np.int32), "w": rng.integers(1, 5, dim).astype(np.int64)}, "d0"
+    )
+    eng = MultistageEngine({"fact": [fact], "dim": [d]}, n_workers=2)
+    q = "SELECT dim.k, SUM(fact.m) FROM fact JOIN dim ON fact.k = dim.k GROUP BY dim.k ORDER BY dim.k LIMIT 10"
+    off_ms = _time_host(lambda: eng.execute(q), iters=7)
+
+    def traced():
+        with start_trace(request_id="bench", context=TraceContext.mint(), service="broker"):
+            eng.execute(q)
+
+    on_ms = _time_host(traced, iters=7)
+
+    # Direct measure of one disabled event site: with no active trace the
+    # whole of trace_event() is a ContextVar read and a None compare. A query
+    # crosses well under 1000 such sites, so per_call_us * 1000 projected
+    # against the untraced wall must sit inside the 2% budget.
+    calls = 100_000
+    t0 = time.perf_counter()
+    for _ in range(calls):
+        trace_event("bench")
+    per_call_us = (time.perf_counter() - t0) / calls * 1e6
+    projected_pct = per_call_us * 1000 / (off_ms * 1e3) * 100
+    assert projected_pct < 2.0, (
+        f"disabled trace_event {per_call_us:.2f}µs x1000 = {projected_pct:.2f}% of "
+        f"{off_ms:.1f}ms query — over the 2% hot-loop budget"
+    )
+    return {
+        "metric": "trace_overhead",
+        "value": round(on_ms - off_ms, 3),
+        "unit": "ms",
+        "n": n,
+        "off_ms": round(off_ms, 3),
+        "on_ms": round(on_ms, 3),
+        "overhead_pct": round((on_ms / off_ms - 1.0) * 100, 1),
+        "disabled_event_us": round(per_call_us, 4),
+        "projected_pct_at_1000_sites": round(projected_pct, 3),
+    }
+
+
 def bench_lint_runtime():
     """pinotlint must stay fast enough to sit in tier-1 and CI: a whole-package
     run (all five checkers, ~200 modules) is asserted under the 10s budget on
@@ -438,6 +496,7 @@ ALL = [
     bench_multistage_join_e2e,
     bench_stats_overhead,
     bench_deadline_overhead,
+    bench_trace_overhead,
     bench_lint_runtime,
 ]
 

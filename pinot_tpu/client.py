@@ -31,6 +31,9 @@ class ResultSet:
         # exceptions WITHOUT a result table are a hard failure
         self.partial_result: bool = bool(response.get("partialResult"))
         self.exceptions: list[dict] = list(response.get("exceptions") or [])
+        #: distributed-trace exemplar id ("" when the query wasn't sampled);
+        #: feeds GET /debug/traces/{traceId} on the broker
+        self.trace_id: str = response.get("traceId", "")
         if self.exceptions and not (self.partial_result and response.get("resultTable")):
             raise PinotClientError(
                 "; ".join(e.get("message", "") for e in self.exceptions)

@@ -118,6 +118,10 @@ class Broker:
         #: structured slow-query ring buffer (newest last); entries also go
         #: to the pinot_tpu.slowquery logger as one JSON line each
         self.slow_queries = collections.deque(maxlen=self.obs_config.slow_query_log_max_entries)
+        #: assembled distributed traces, newest last (GET /debug/traces);
+        #: populated for trace=true queries and trace_sample_rate samples
+        self.traces = collections.deque(maxlen=self.obs_config.trace_buffer_max_entries)
+        self._traces_lock = threading.Lock()
         self.resilience = resilience if resilience is not None else ResilienceConfig()
         if self.resilience.faults:
             from pinot_tpu.common.faults import FAULTS
@@ -167,8 +171,10 @@ class Broker:
         return found
 
     def execute(self, sql: str, identity: str | None = None) -> ResultTable:
+        import random
+
         from pinot_tpu.common.metrics import BrokerMeter, BrokerTimer, broker_metrics
-        from pinot_tpu.common.trace import start_trace
+        from pinot_tpu.common.trace import TraceContext, start_trace
         from pinot_tpu.query.context import (
             Deadline,
             QueryCancelledError,
@@ -182,6 +188,7 @@ class Broker:
         qid = f"q{next(_request_seq)}"
         deadline: Deadline | None = None
         timeout_ms: float | None = None
+        tctx = None
         try:
             with bm.timer(BrokerTimer.QUERY_TOTAL).time():
                 stmt = parse_sql(sql)
@@ -215,11 +222,24 @@ class Broker:
                         self.access_control.check(identity, t, READ)
                 if self.quota is not None and table:
                     self.quota.acquire(table)
-                if stmt.options.get("trace", "").lower() == "true":
-                    # per-query tracing (Tracing.java + `trace=true` query option)
-                    with start_trace(request_id=qid) as tr:
-                        result = self._execute(stmt, sql, deadline=deadline, qid=qid, partial=partial)
-                    result.trace = tr.to_dict()
+                # per-query tracing (Tracing.java + `trace=true` query option):
+                # always sampled on trace=true, else probabilistically per
+                # ObservabilityConfig.trace_sample_rate (head-based sampling)
+                trace_requested = stmt.options.get("trace", "").lower() == "true"
+                rate = self.obs_config.trace_sample_rate
+                sampled = trace_requested or (rate > 0.0 and random.random() < rate)
+                if sampled:
+                    tctx = TraceContext.mint()
+                    t_start = time.perf_counter()
+                    with start_trace(request_id=qid, context=tctx, service="broker") as tr:
+                        try:
+                            result = self._execute(stmt, sql, deadline=deadline, qid=qid, partial=partial)
+                        finally:
+                            tr.root.duration_ms = (time.perf_counter() - t_start) * 1e3
+                            self._store_trace(tr)
+                    result.trace_id = tctx.trace_id
+                    if trace_requested:
+                        result.trace = tr.to_dict()
                 else:
                     result = self._execute(stmt, sql, deadline=deadline, qid=qid, partial=partial)
                 # a cancel acknowledged mid-flight must not turn into a
@@ -238,6 +258,12 @@ class Broker:
             return result
         except Exception as e:
             bm.meter(BrokerMeter.REQUEST_FAILURES).mark()
+            if tctx is not None and not getattr(e, "trace_id", None):
+                e.trace_id = tctx.trace_id  # exemplar id for the error payload
+            kill_reason = getattr(e, "kill_reason", None)
+            if kill_reason:
+                # accountant kills surface structured, not just as message text
+                self._log_killed_query(sql, table, qid, kill_reason, getattr(e, "trace_id", None))
             if self.query_logger is not None:
                 self.query_logger.log(sql, table, 0.0, 0, exception=type(e).__name__)
             # central outcome mapping: whatever low-level error the deadline or
@@ -278,8 +304,62 @@ class Broker:
             "numSegmentsQueried": result.num_segments_queried,
             "ts": time.time(),
         }
+        if result.trace_id:
+            # exemplar: join the slow-query log entry to /debug/traces/{id}
+            entry["traceId"] = result.trace_id
         self.slow_queries.append(entry)
         logging.getLogger("pinot_tpu.slowquery").warning(json.dumps(entry, sort_keys=True))
+
+    def _log_killed_query(self, sql: str, table: str, qid: str, reason: str, trace_id: str | None) -> None:
+        """Accountant kills get a structured log entry of their own — the
+        killReason would otherwise survive only inside the exception text."""
+        import json
+        import logging
+
+        entry = {
+            "sql": sql,
+            "table": table,
+            "queryId": qid,
+            "killReason": reason,
+            "ts": time.time(),
+        }
+        if trace_id:
+            entry["traceId"] = trace_id
+        self.slow_queries.append(entry)
+        logging.getLogger("pinot_tpu.slowquery").warning(json.dumps(entry, sort_keys=True))
+
+    # -- distributed-trace ring buffer (GET /debug/traces) --------------------
+
+    def _store_trace(self, tr) -> None:
+        try:
+            doc = tr.assemble()
+        except Exception:  # pinotlint: disable=deadline-swallow — trace assembly must never fail the query it observed
+            return
+        doc["ts"] = time.time()
+        with self._traces_lock:
+            self.traces.append(doc)
+
+    def recent_traces(self) -> list[dict]:
+        """Summaries of the buffered traces, newest last."""
+        with self._traces_lock:
+            return [
+                {
+                    "traceId": d.get("traceId", ""),
+                    "requestId": d.get("requestId", ""),
+                    "numProcesses": len(d.get("resourceSpans", [])),
+                    "numSpans": sum(len(rs.get("spans", [])) for rs in d.get("resourceSpans", [])),
+                    "ts": d.get("ts"),
+                }
+                for d in self.traces
+            ]
+
+    def get_trace(self, request_id: str) -> dict | None:
+        """Full assembled trace by request id or trace id (newest match)."""
+        with self._traces_lock:
+            for d in reversed(self.traces):
+                if d.get("requestId") == request_id or d.get("traceId") == request_id:
+                    return d
+        return None
 
     def _execute(self, stmt, sql: str, deadline=None, qid=None, partial=None) -> ResultTable:
         t0 = time.perf_counter()
@@ -327,6 +407,13 @@ class Broker:
             ctx.hints["__deadlineTs__"] = deadline.deadline_ts
         if qid is not None:
             ctx.hints["__queryId__"] = qid
+        from pinot_tpu.common.trace import active_trace
+
+        tr = active_trace()
+        if tr is not None and tr.context is not None:
+            # rides hints to in-process handles; the HTTP client pops it and
+            # sends a real `traceparent` header instead
+            ctx.hints["__traceCtx__"] = tr.context.to_dict()
 
         # legs: (physical table, sql text). Hybrid tables split on the time
         # boundary (TimeBoundaryManager parity): offline <= boundary < realtime
@@ -677,9 +764,13 @@ class Broker:
             results.extend(retry_results)
 
         partials, scanned = [], 0
-        for p, matched, _total in results:
-            partials.extend(p)
-            scanned += matched
+        for out in results:
+            partials.extend(out[0])
+            scanned += out[1]
+            # remote servers append their span subtree as a 4th element;
+            # in-process handles share our trace and return the bare triple
+            if len(out) > 3 and out[3] and trace is not None:
+                trace.add_remote(out[3])
         return partials, scanned, n_candidates, pruned
 
     def _execute_multistage(self, stmt, sql: str, deadline=None, qid=None) -> ResultTable:

@@ -53,6 +53,21 @@ def _serve_metrics(handler, registry) -> None:
     handler.wfile.write(payload)
 
 
+def _hints_with_traceparent(hints: dict, headers) -> dict:
+    """Re-inject an incoming W3C `traceparent` header as the __traceCtx__
+    hints marker (the wire format of the v1 data-plane hop; the server pops
+    the marker and records its span subtree under the propagated context)."""
+    tp = headers.get("traceparent")
+    if tp:
+        from pinot_tpu.common.trace import TraceContext
+
+        tc = TraceContext.from_header(tp)
+        if tc is not None and tc.sampled:
+            hints = dict(hints)
+            hints["__traceCtx__"] = tc.to_dict()
+    return hints
+
+
 class BrokerHTTPService:
     """POST /query/sql {"sql": ...} -> Pinot-shaped JSON broker response."""
 
@@ -110,14 +125,15 @@ class BrokerHTTPService:
                     self.send_response(403)
                 except Exception as e:  # error surface parity: exceptions JSON
                     # QueryTimeoutError/QueryCancelledError carry distinct
-                    # error codes (BrokerResponse errorCode parity)
-                    payload = json.dumps(
-                        {
-                            "exceptions": [
-                                {"errorCode": code_of(e), "message": str(e)}
-                            ]
-                        }
-                    ).encode()
+                    # error codes (BrokerResponse errorCode parity); sampled
+                    # queries add the trace exemplar id, accountant kills
+                    # their structured reason
+                    entry = {"errorCode": code_of(e), "message": str(e)}
+                    if getattr(e, "trace_id", None):
+                        entry["traceId"] = e.trace_id
+                    if getattr(e, "kill_reason", None):
+                        entry["killReason"] = e.kill_reason
+                    payload = json.dumps({"exceptions": [entry]}).encode()
                     self.send_response(200)
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Content-Length", str(len(payload)))
@@ -150,6 +166,24 @@ class BrokerHTTPService:
                     # in-flight query listing (ClusterInfoAccessor running
                     # queries parity); ids here feed DELETE /query/{id}
                     payload = json.dumps(svc.broker.running_queries()).encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type", "application/json")
+                    self.send_header("Content-Length", str(len(payload)))
+                    self.end_headers()
+                    self.wfile.write(payload)
+                elif self.path.partition("?")[0].startswith("/debug/traces"):
+                    # assembled distributed traces: the list view returns
+                    # summaries, /debug/traces/{requestId} the full
+                    # OTLP-flavored document (trace id also accepted)
+                    tail = self.path.partition("?")[0][len("/debug/traces") :].strip("/")
+                    if tail:
+                        doc = svc.broker.get_trace(tail)
+                        if doc is None:
+                            self.send_error(404, f"no trace for {tail!r}")
+                            return
+                        payload = json.dumps(doc).encode()
+                    else:
+                        payload = json.dumps(svc.broker.recent_traces()).encode()
                     self.send_response(200)
                     self.send_header("Content-Type", "application/json")
                     self.send_header("Content-Length", str(len(payload)))
@@ -291,7 +325,7 @@ class ServerHTTPService:
                                 body["table"],
                                 body["sql"],
                                 body.get("segments", []),
-                                body.get("hints") or {},
+                                _hints_with_traceparent(body.get("hints") or {}, self.headers),
                                 max_rows=body.get("maxRows"),
                             ):
                                 payload = datatable.encode(frame)
@@ -316,14 +350,18 @@ class ServerHTTPService:
                 try:
                     body = json.loads(self.rfile.read(n) or b"{}")
                     out = svc.server.execute_partials(
-                        body["table"], body["sql"], body.get("segments", []), body.get("hints") or {}
+                        body["table"],
+                        body["sql"],
+                        body.get("segments", []),
+                        _hints_with_traceparent(body.get("hints") or {}, self.headers),
                     )
                 except Exception as e:
                     # surface the real error to the broker instead of a
-                    # dropped connection
-                    payload = json.dumps(
-                            {"error": f"{type(e).__name__}: {e}", "errorCode": code_of(e)}
-                        ).encode()
+                    # dropped connection; accountant kills keep their reason
+                    doc = {"error": f"{type(e).__name__}: {e}", "errorCode": code_of(e)}
+                    if getattr(e, "kill_reason", None):
+                        doc["killReason"] = e.kill_reason
+                    payload = json.dumps(doc).encode()
                     self.send_response(500)
                     self.send_header("Content-Type", "application/json")
                     self.send_header("Content-Length", str(len(payload)))
@@ -417,19 +455,39 @@ class RemoteServerClient:
             return self.timeout
         return max(0.1, min(self.timeout, float(dl) - _time.time() + 0.5))
 
+    @staticmethod
+    def _trace_headers(hints: dict) -> dict:
+        """Pop the broker's __traceCtx__ marker into a real W3C traceparent
+        header — tracing context travels as HTTP metadata on the wire, not
+        inside the query payload."""
+        headers = {"Content-Type": "application/json"}
+        tctx = hints.pop("__traceCtx__", None)
+        if tctx:
+            from pinot_tpu.common.trace import TraceContext
+
+            headers["traceparent"] = TraceContext.from_dict(tctx).to_header()
+        return headers
+
     def execute_partials(self, table: str, sql: str, segment_names: list[str], hints: dict | None = None):
+        hints = dict(hints or {})
+        headers = self._trace_headers(hints)
         body = json.dumps(
-            {"table": table, "sql": sql, "segments": segment_names, "hints": hints or {}}
+            {"table": table, "sql": sql, "segments": segment_names, "hints": hints}
         ).encode()
-        req = urllib.request.Request(
-            self.base_url + "/query", data=body, headers={"Content-Type": "application/json"}
-        )
+        req = urllib.request.Request(self.base_url + "/query", data=body, headers=headers)
         try:
             with urllib.request.urlopen(req, timeout=self._hop_timeout(hints)) as resp:
                 return datatable.decode(resp.read())
         except urllib.error.HTTPError as e:
             detail = e.read().decode(errors="replace")
-            raise RuntimeError(f"server error from {self.base_url}: {detail}") from None
+            err = RuntimeError(f"server error from {self.base_url}: {detail}")
+            try:
+                kill = json.loads(detail).get("killReason")
+            except Exception:  # pinotlint: disable=deadline-swallow — non-JSON error detail; the RuntimeError below carries it verbatim
+                kill = None
+            if kill:
+                err.kill_reason = kill  # re-attach across the HTTP hop
+            raise err from None
         except (TimeoutError, OSError) as e:
             raise RuntimeError(f"server {self.base_url} unreachable: {e}") from None
 
@@ -449,18 +507,18 @@ class RemoteServerClient:
         the generator closes the HTTP response, telling the server to stop."""
         import struct as _struct
 
+        hints = dict(hints or {})
+        headers = self._trace_headers(hints)
         body = json.dumps(
             {
                 "table": table,
                 "sql": sql,
                 "segments": segment_names,
-                "hints": hints or {},
+                "hints": hints,
                 "maxRows": max_rows,
             }
         ).encode()
-        req = urllib.request.Request(
-            self.base_url + "/query/stream", data=body, headers={"Content-Type": "application/json"}
-        )
+        req = urllib.request.Request(self.base_url + "/query/stream", data=body, headers=headers)
         resp = urllib.request.urlopen(req, timeout=self._hop_timeout(hints))
         try:
             while True:

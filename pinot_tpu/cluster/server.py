@@ -199,6 +199,7 @@ class Server:
             row_counts={k: int(v) for k, v in (body.get("row_counts") or {}).items()},
             deadline=deadline,
             on_done=lambda: self._unregister_query(qid),
+            trace_ctx=body.get("trace_ctx"),
         )
 
     def _engine(self, table: str) -> QueryEngine:
@@ -253,10 +254,11 @@ class Server:
                     f"server {self.server_id} does not host segments "
                     f"{sorted(truly_missing)} of table {table!r}"
                 )
-        from pinot_tpu.common.faults import FAULTS
+        from pinot_tpu.common.faults import FAULTS, InjectedFault
         from pinot_tpu.common.metrics import ServerMeter, server_metrics
+        from pinot_tpu.common.trace import trace_event
 
-        hints, deadline, broker_qid = self._pop_resilience_hints(hints)
+        hints, deadline, broker_qid, _tctx = self._pop_resilience_hints(hints)
         eng = self._engine(table)
         ctx = eng.make_context(sql)
         if hints:
@@ -267,7 +269,11 @@ class Server:
         try:
             emitted = 0
             for seg, partial, matched in eng.partials_iter(ctx, segs):
-                FAULTS.maybe_fail("stream.consume")
+                try:
+                    FAULTS.maybe_fail("stream.consume")
+                except InjectedFault:
+                    trace_event("fault.injected", point="stream.consume", segment=seg.name)
+                    raise
                 if deadline is not None:
                     deadline.check(f"stream {seg.name}")
                 if hasattr(partial, "iloc"):  # selection frame: chunk it
@@ -320,7 +326,7 @@ class Server:
         scheduler configured, execution queues behind its policy; the caller
         blocks on the future (QueryScheduler.submit parity)."""
         if self._scheduler is not None:
-            from pinot_tpu.common.trace import ServerQueryPhase, active_trace, run_traced
+            from pinot_tpu.common.trace import ServerQueryPhase, active_trace
 
             trace = active_trace()
             t_sub = time.perf_counter()
@@ -330,7 +336,9 @@ class Server:
                     trace.record_phase(ServerQueryPhase.SCHEDULER_WAIT, (time.perf_counter() - t_sub) * 1e3)
                 return self._execute_partials(table, sql, segment_names, hints)
 
-            fut = self._scheduler.submit(run_traced, trace, run, table=table, workload=workload)
+            # the scheduler snapshots the submitting contextvars per job, so
+            # the active trace crosses into the worker thread by itself
+            fut = self._scheduler.submit(run, table=table, workload=workload)
             return fut.result()
         return self._execute_partials(table, sql, segment_names, hints)
 
@@ -339,36 +347,59 @@ class Server:
         """Split the broker's deadline/query-id markers out of the hints dict
         (they ride the existing hints channel so every server-handle shape —
         in-process, HTTP, test stubs — carries them without signature churn).
-        Returns (clean hints, Deadline | None, broker query id | None)."""
+        Returns (clean hints, Deadline | None, broker query id | None,
+        trace-context dict | None)."""
         from pinot_tpu.query.context import Deadline
 
         hints = dict(hints or {})
         deadline_ts = hints.pop("__deadlineTs__", None)
         broker_qid = hints.pop("__queryId__", None)
+        trace_ctx = hints.pop("__traceCtx__", None)
         deadline = None
         if deadline_ts is not None or broker_qid is not None:
             deadline = Deadline(float(deadline_ts) if deadline_ts is not None else None)
-        return hints, deadline, broker_qid
+        return hints, deadline, broker_qid, trace_ctx
 
     def _execute_partials(self, table: str, sql: str, segment_names: list[str], hints: dict | None = None):
         from pinot_tpu.common.accounting import default_accountant
         from pinot_tpu.common.faults import FAULTS, InjectedFault
         from pinot_tpu.common.metrics import ServerMeter, ServerTimer, server_metrics
-        from pinot_tpu.common.trace import ServerQueryPhase, phase_timer
+        from pinot_tpu.common.trace import (
+            RequestTrace,
+            ServerQueryPhase,
+            TraceContext,
+            active_trace,
+            phase_timer,
+            run_traced,
+            trace_event,
+        )
 
         try:
             FAULTS.maybe_fail("server.scatter")
         except InjectedFault as e:
+            trace_event("fault.injected", point="server.scatter", server=self.server_id)
             # present exactly what a dead TCP peer produces so the broker's
             # failover path (which matches on "unreachable") engages
             raise RuntimeError(f"server {self.server_id} unreachable: {e}") from None
-        hints, deadline, broker_qid = self._pop_resilience_hints(hints)
+        hints, deadline, broker_qid, tctx = self._pop_resilience_hints(hints)
+        local_tr = None
+        if tctx is not None and active_trace() is None:
+            # remote hop: the broker's trace context arrived over the wire;
+            # record this process's span subtree locally and ship it back as
+            # a 4th result element (in-process handles share the broker's
+            # trace directly and keep the bare triple)
+            local_tr = RequestTrace(
+                broker_qid or "",
+                context=TraceContext.from_dict(tctx),
+                service=f"server:{self.server_id}",
+            )
         segs = self._resolve_segments(table, segment_names)
         m = server_metrics()
         m.meter(ServerMeter.QUERIES).mark()
         qid = f"{self.server_id}-{next(_query_seq)}"
         self._register_query(broker_qid, deadline)
-        try:
+
+        def body():
             with m.timer(ServerTimer.QUERY_EXECUTION).time(), default_accountant.scope(qid):
                 eng = self._engine(table)
                 with phase_timer(ServerQueryPhase.BUILD_QUERY_PLAN):
@@ -377,8 +408,15 @@ class Server:
                     ctx.hints.update(hints)
                 ctx.deadline = deadline
                 with phase_timer(ServerQueryPhase.QUERY_PLAN_EXECUTION):
-                    partials, matched = eng.partials(ctx, segs)
+                    return eng.partials(ctx, segs)
+
+        try:
+            partials, matched = run_traced(local_tr, body) if local_tr is not None else body()
         finally:
             self._unregister_query(broker_qid)
         m.meter(ServerMeter.NUM_DOCS_SCANNED).mark(matched)
-        return partials, matched, sum(s.n_docs for s in segs)
+        total = sum(s.n_docs for s in segs)
+        if local_tr is not None:
+            local_tr.root.duration_ms = local_tr.now_ms()
+            return partials, matched, total, local_tr.subtree()
+        return partials, matched, total

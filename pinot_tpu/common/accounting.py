@@ -20,7 +20,13 @@ from dataclasses import dataclass, field
 
 class QueryKilledError(RuntimeError):
     """Raised inside operator checkpoints when the accountant cancels the
-    query (QueryCancelledException parity)."""
+    query (QueryCancelledException parity). Carries the structured
+    `kill_reason` so the broker can surface it in error payloads and the
+    slow-query log instead of parsing it back out of the message."""
+
+    def __init__(self, message: str, kill_reason: str = ""):
+        super().__init__(message)
+        self.kill_reason = kill_reason or message
 
 
 @dataclass
@@ -114,8 +120,13 @@ class ResourceAccountant:
             return
         with self._lock:
             tr = self._queries.get(qid)
-            if tr is not None and tr.killed:
-                raise QueryKilledError(f"query {qid} killed: {tr.kill_reason}")
+            killed = tr is not None and tr.killed
+            reason = tr.kill_reason if killed else ""
+        if killed:
+            from pinot_tpu.common.trace import trace_event
+
+            trace_event("accountant.kill", queryId=qid, reason=reason)
+            raise QueryKilledError(f"query {qid} killed: {reason}", kill_reason=reason)
 
     # -- enforcement --------------------------------------------------------
 

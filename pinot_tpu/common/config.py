@@ -21,18 +21,26 @@ class TableType(Enum):
 @dataclass
 class ObservabilityConfig:
     """Broker observability knobs (pinot.broker.* instance-config parity):
-    the slow-query log threshold and its bounded in-memory buffer size."""
+    the slow-query log threshold, its bounded in-memory buffer size, and
+    distributed-trace sampling / retention."""
 
     #: queries at or above this wall time get a structured slow-query log
     #: entry on the broker
     slow_query_threshold_ms: float = 1000.0
     #: ring-buffer capacity of Broker.slow_queries (inspection/debug surface)
     slow_query_log_max_entries: int = 128
+    #: probability [0, 1] of tracing a query that did NOT set `trace=true`
+    #: (trace=true always samples; 0.0 = opt-in only, the default)
+    trace_sample_rate: float = 0.0
+    #: ring-buffer capacity of Broker.traces (GET /debug/traces)
+    trace_buffer_max_entries: int = 64
 
     def to_dict(self) -> dict:
         return {
             "slowQueryThresholdMs": self.slow_query_threshold_ms,
             "slowQueryLogMaxEntries": self.slow_query_log_max_entries,
+            "traceSampleRate": self.trace_sample_rate,
+            "traceBufferMaxEntries": self.trace_buffer_max_entries,
         }
 
     @staticmethod
@@ -40,6 +48,8 @@ class ObservabilityConfig:
         return ObservabilityConfig(
             d.get("slowQueryThresholdMs", 1000.0),
             d.get("slowQueryLogMaxEntries", 128),
+            d.get("traceSampleRate", 0.0),
+            d.get("traceBufferMaxEntries", 64),
         )
 
 

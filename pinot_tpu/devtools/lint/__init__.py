@@ -1,9 +1,10 @@
 """pinotlint: project-invariant static analyzer for pinot_tpu.
 
-Five AST checkers enforce the conventions the engine's correctness actually
+Six AST checkers enforce the conventions the engine's correctness actually
 rests on — race discipline, jit purity, deadline/cancellation coverage, the
-error-code registry, and the fault-point registry. See README.md in this
-directory and the module docstrings for each checker's exact rules.
+error-code registry, the fault-point registry, and fault-point span-event
+coverage on the query path. See README.md in this directory and the module
+docstrings for each checker's exact rules.
 
 Usage (CLI):   python -m pinot_tpu.devtools.lint pinot_tpu/
 Usage (code):  from pinot_tpu.devtools.lint import lint_paths
@@ -14,7 +15,7 @@ from __future__ import annotations
 from pinot_tpu.devtools.lint.core import Checker, Finding, run
 from pinot_tpu.devtools.lint.deadlines import DeadlineChecker
 from pinot_tpu.devtools.lint.error_codes import ErrorCodeChecker
-from pinot_tpu.devtools.lint.fault_points import FaultPointChecker
+from pinot_tpu.devtools.lint.fault_points import FaultPointChecker, FaultSpanEventChecker
 from pinot_tpu.devtools.lint.jit_purity import JitPurityChecker
 from pinot_tpu.devtools.lint.races import RaceChecker
 
@@ -26,6 +27,7 @@ ALL_CHECKERS: dict[str, type[Checker]] = {
     "deadline-coverage": DeadlineChecker,  # also emits deadline-swallow
     "error-code-registry": ErrorCodeChecker,
     "fault-point-registry": FaultPointChecker,
+    "fault-span-event": FaultSpanEventChecker,
 }
 
 

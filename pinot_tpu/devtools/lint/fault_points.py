@@ -7,13 +7,21 @@ code no longer calls through — the test silently stops injecting anything
 and keeps passing. The registry lives in `pinot_tpu/common/faults.py`
 (`FAULT_POINTS = frozenset({...})`); the checker discovers it syntactically
 in the analyzed file set, so golden fixtures can declare their own.
+
+fault-span-event: inside the query path (pinot_tpu/query|multistage|cluster),
+every function that calls `maybe_fail(...)` must also emit a trace span event
+(a `trace_event(...)` or `.add_event(...)` call) in the same lexical scope —
+an injected fault that leaves no mark in the assembled distributed trace is
+invisible to whoever debugs the resulting failure. Suppress with a reasoned
+`# pinotlint: disable=fault-span-event — <why>` when the site genuinely has
+no trace to write to.
 """
 
 from __future__ import annotations
 
 import ast
 
-from pinot_tpu.devtools.lint.core import Checker, Finding, ModuleInfo
+from pinot_tpu.devtools.lint.core import Checker, Finding, ModuleInfo, walk_scope
 
 
 class FaultPointChecker(Checker):
@@ -65,4 +73,53 @@ class FaultPointChecker(Checker):
                 out.append(
                     Finding(self.name, path, line, f"declared fault point {point!r} has no maybe_fail() call site (dead point)")
                 )
+        return out
+
+
+#: directories whose fault points sit on the query path and therefore must be
+#: visible in the assembled distributed trace
+_QUERY_PATH_DIRS = ("query", "multistage", "cluster")
+
+
+def _on_query_path(path: str) -> bool:
+    p = path.replace("\\", "/")
+    return "pinot_tpu/" in p and any(f"/{d}/" in p for d in _QUERY_PATH_DIRS)
+
+
+class FaultSpanEventChecker(Checker):
+    """Per-file pass: each function in a query-path module that crosses a
+    fault point must also record a span event in the same lexical scope."""
+
+    name = "fault-span-event"
+
+    def check_module(self, module: ModuleInfo) -> list[Finding]:
+        if not _on_query_path(module.path):
+            return []
+        out: list[Finding] = []
+        for fn in ast.walk(module.tree):
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            fail_lines: list[int] = []
+            emits_event = False
+            for node in walk_scope(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                f = node.func
+                if isinstance(f, ast.Attribute) and f.attr == "maybe_fail":
+                    fail_lines.append(node.lineno)
+                elif isinstance(f, ast.Name) and f.id == "trace_event":
+                    emits_event = True
+                elif isinstance(f, ast.Attribute) and f.attr == "add_event":
+                    emits_event = True
+            if fail_lines and not emits_event:
+                for line in fail_lines:
+                    out.append(
+                        Finding(
+                            self.name,
+                            module.path,
+                            line,
+                            f"query-path fault point in {fn.name}() emits no trace span event "
+                            "(call trace_event(...) so injected faults show in the assembled trace)",
+                        )
+                    )
         return out

@@ -116,6 +116,7 @@ def run_assigned_stages(
     deadline_ts: float | None = None,
     deadline=None,
     on_done=None,
+    trace_ctx: dict | None = None,
 ):
     """Server-side half of a distributed query: rebuild the plan, then run
     every (stage, worker) assigned to `my_id` on daemon threads.
@@ -124,13 +125,23 @@ def run_assigned_stages(
     workers check it at operator block boundaries and the mailbox receive
     loop derives its timeout from it. Returns the query's Deadline so the
     caller can register it for cancellation; `on_done` fires after the last
-    local worker finishes and the mailbox is reaped."""
+    local worker finishes and the mailbox is reaped.
+
+    trace_ctx: serialized TraceContext from the broker's stage-plan envelope.
+    When present, each local worker records its span subtree into a fresh
+    RequestTrace and ships it back on the trailing-EOS stats relay."""
+    from pinot_tpu.common.trace import RequestTrace, TraceContext
     from pinot_tpu.query.context import Deadline
     from pinot_tpu.query.sql import parse_sql
 
     stmt = parse_sql(sql)
     plan = build_plan(stmt, schemas, n_workers, row_counts)
     apply_parallelism(plan, parallelism)
+    tctx = TraceContext.from_dict(trace_ctx) if trace_ctx else None
+    if tctx is not None:
+        # trace subtrees ride the EOS stats relay: force collection on so
+        # every RunCtx gets a StageStatsCollector to relay through
+        plan.options["__collect_stats__"] = True
     if deadline is None:
         deadline = Deadline(deadline_ts)
     else:
@@ -156,9 +167,19 @@ def run_assigned_stages(
         try:
             stage = plan.stages[sid]
             has_scan = bool(stage.is_leaf)
-            R.run_stage_worker(
+            if tctx is None:
+                tr = None
+            else:
+                # one RequestTrace per (stage, worker): each ships its own
+                # subtree on its trailing EOS, so nothing is double-counted
+                tr = RequestTrace(qid, context=tctx, service=f"server:{my_id}")
+            from pinot_tpu.common.trace import run_traced
+
+            run_traced(
+                tr,
+                R.run_stage_worker,
                 stage, w, mailbox, plan.stages, segments, n_senders, parent_of,
-                scan_local_all=has_scan, options=plan.options,
+                scan_local_all=has_scan, options=plan.options, trace_out=tr,
             )
         finally:
             done.release()
@@ -231,6 +252,16 @@ class DistributedDispatcher:
         t0 = _time.perf_counter()
         qid = qid or uuid.uuid4().hex
         plan = build_plan(stmt, schemas, n_workers, row_counts)
+        from pinot_tpu.common.trace import active_trace
+
+        broker_trace = active_trace()
+        tctx = broker_trace.context if broker_trace is not None else None
+        if tctx is not None and tctx.sampled:
+            # trace subtrees piggyback the EOS stats relay — force stats
+            # collection so every intermediate stage relays them through
+            plan.options["__collect_stats__"] = True
+        else:
+            tctx = None
         all_servers = sorted(server_urls)
         parallelism, placement = plan_placement(plan, table_servers, all_servers, n_workers)
         apply_parallelism(plan, parallelism)
@@ -251,6 +282,10 @@ class DistributedDispatcher:
             "row_counts": dict(row_counts or {}),
             "deadline_ts": deadline_ts,
         }
+        if tctx is not None:
+            # trace context rides the stage-plan envelope (the v2 analog of
+            # the v1 traceparent header)
+            doc_common["trace_ctx"] = tctx.to_dict()
         participants = sorted({owner for owner in placement.values() if owner != BROKER_ID})
         try:
             for sid_server in participants:
@@ -275,6 +310,7 @@ class DistributedDispatcher:
             from pinot_tpu.multistage.stats import (
                 StageStatsCollector,
                 merge_stage_stats,
+                split_stats_payload,
                 stats_enabled,
             )
 
@@ -293,6 +329,11 @@ class DistributedDispatcher:
             time_used_ms=(_time.perf_counter() - t0) * 1e3,
         )
         if ctx.stats is not None:
-            # remote workers' records arrived on their trailing EOS envelopes
-            result.stage_stats = merge_stage_stats(ctx.stats.payload())
+            # remote workers' records arrived on their trailing EOS envelopes;
+            # trace subtrees share the channel and attach to the broker trace
+            stats_recs, subtrees = split_stats_payload(ctx.stats.payload())
+            if broker_trace is not None:
+                for sub in subtrees:
+                    broker_trace.add_remote(sub)
+            result.stage_stats = merge_stage_stats(stats_recs)
         return result

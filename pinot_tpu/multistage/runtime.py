@@ -63,6 +63,8 @@ class MailboxService:
             return q
 
     def send(self, send_stage: int, recv_stage: int, recv_worker: int, payload) -> None:
+        if callable(payload):  # lazily-built frame (trailing EOS with stats)
+            payload = payload()
         self._q(recv_stage, recv_worker, send_stage).put(payload)
 
     #: receive deadline; None blocks forever (in-process engine). The
@@ -854,7 +856,8 @@ def _exec_node(node: L.Node, ctx: RunCtx) -> pd.DataFrame:
         from pinot_tpu.query.context import null_handling_enabled
 
         null_on = null_handling_enabled(ctx.options)
-        from pinot_tpu.common.faults import FAULTS
+        from pinot_tpu.common.faults import FAULTS, InjectedFault
+        from pinot_tpu.common.trace import trace_event
 
         segs = ctx.segments.get(node.table, [])
         mine = segs if ctx.scan_local_all else segs[ctx.worker :: ctx.stage.parallelism]
@@ -862,7 +865,11 @@ def _exec_node(node: L.Node, ctx: RunCtx) -> pd.DataFrame:
         for seg in mine:
             if ctx.mailbox.deadline is not None:
                 ctx.mailbox.deadline.check(f"scan {seg.name}")
-            FAULTS.maybe_fail("segment.execute")
+            try:
+                FAULTS.maybe_fail("segment.execute")
+            except InjectedFault:
+                trace_event("fault.injected", point="segment.execute", segment=seg.name)
+                raise
             mask = (
                 _leaf_filter_mask(seg, node.filter, null_on=null_on, stats=ctx.stats, node=node)
                 if node.filter is not None
@@ -1575,9 +1582,17 @@ def _send_output(df: pd.DataFrame, stage: L.Stage, parent_id: int, parent_par: i
     else:
         raise L.PlanV2Error(f"unknown distribution {stage.dist}")
     # stats ride the trailing EOS (MultiStageQueryStats parity) — to parent
-    # worker 0 ONLY, so a multi-worker parent doesn't relay duplicate copies
-    for w in range(parent_par):
-        mailbox.send(stage.id, parent_id, w, ("__eos__", stats) if (stats and w == 0) else _EOS)
+    # worker 0 ONLY, so a multi-worker parent doesn't relay duplicate copies.
+    # That frame goes LAST, and a callable defers its construction to the
+    # transport's send attempt, so the shipped trace subtree includes
+    # fault/retry span events recorded during the other EOS sends and during
+    # its own failed attempts.
+    for w in [*range(1, parent_par), 0]:
+        if stats and w == 0:
+            payload = (lambda: ("__eos__", stats())) if callable(stats) else ("__eos__", stats)
+        else:
+            payload = _EOS
+        mailbox.send(stage.id, parent_id, w, payload)
 
 
 def run_stage_worker(
@@ -1591,10 +1606,17 @@ def run_stage_worker(
     scan_local_all: bool = False,
     errors: list | None = None,
     options: dict | None = None,
+    trace_out=None,
 ) -> None:
     """Run ONE (stage, worker) OpChain to completion: execute the stage
     subtree and ship its output (or an error marker) to every parent worker.
-    Shared by the in-process engine and the distributed server runtime."""
+    Shared by the in-process engine and the distributed server runtime.
+
+    trace_out: this worker's common.trace.RequestTrace (distributed remote
+    workers only). Its span subtree is appended to the trailing-EOS stats
+    payload as a TRACE_RECORD_KEY record for the broker to reassemble."""
+    from pinot_tpu.common.trace import InvocationScope
+
     opts = dict(options or {})
     ctx = RunCtx(
         stage, w, mailbox, stages, segments, n_senders,
@@ -1604,11 +1626,22 @@ def run_stage_worker(
     parent = parent_of[stage.id]
     parent_par = stages[parent].parallelism
     try:
-        df = exec_node(stage.root, ctx)
-        _send_output(
-            df, stage, parent, parent_par, mailbox, w,
-            stats=ctx.stats.payload() if ctx.stats is not None else None,
-        )
+        with InvocationScope(f"stage{stage.id}:w{w}"):
+            df = exec_node(stage.root, ctx)
+        stats = ctx.stats.payload() if ctx.stats is not None else None
+        if trace_out is not None and stats is not None:
+            from pinot_tpu.multistage.stats import TRACE_RECORD_KEY
+
+            base_stats = stats
+
+            def stats_with_subtree():
+                # resolved at (re)send time, not here: mailbox fault/retry
+                # events recorded DURING the EOS send must make the snapshot
+                trace_out.root.duration_ms = trace_out.now_ms()
+                return base_stats + [{TRACE_RECORD_KEY: trace_out.subtree()}]
+
+            stats = stats_with_subtree
+        _send_output(df, stage, parent, parent_par, mailbox, w, stats=stats)
     except BaseException as e:  # propagate to receivers, error code intact
         from pinot_tpu.common.errors import code_of
 
@@ -1715,9 +1748,16 @@ class MultistageEngine:
                 parent_of[inp] = s.id
         n_senders = {sid: s.parallelism for sid, s in plan.stages.items()}
         errors: list[BaseException] = []
+        from pinot_tpu.common.trace import active_trace, run_traced
+
+        trace = active_trace()
 
         def worker_fn(stage: L.Stage, w: int):
-            run_stage_worker(
+            # in-process workers record straight into the request's trace
+            # (plain threads don't inherit the submitting contextvars)
+            run_traced(
+                trace,
+                run_stage_worker,
                 stage, w, mailbox, plan.stages, self.catalog, n_senders, parent_of,
                 errors=errors, options=plan.options,
             )

@@ -23,6 +23,24 @@ from dataclasses import dataclass
 from pinot_tpu.multistage import logical as L
 
 
+#: reserved record key marking a distributed-trace span subtree riding the
+#: EOS stats relay (one record per remote worker; never a stats record)
+TRACE_RECORD_KEY = "__traceSubtree__"
+
+
+def split_stats_payload(payload: list[dict]) -> tuple[list[dict], list[dict]]:
+    """Separate operator-stats records from trace-subtree records that share
+    the EOS relay channel. Returns (stats_records, trace_subtrees)."""
+    stats: list[dict] = []
+    subtrees: list[dict] = []
+    for rec in payload or []:
+        if isinstance(rec, dict) and TRACE_RECORD_KEY in rec:
+            subtrees.append(rec[TRACE_RECORD_KEY])
+        else:
+            stats.append(rec)
+    return stats, subtrees
+
+
 def stats_enabled(options: dict) -> bool:
     """Collection is per-query opt-in (`trace=true`, the reference's query
     option) so the disabled path stays near-zero-cost; EXPLAIN ANALYZE
@@ -151,6 +169,8 @@ def merge_stage_stats(payload: list[dict]) -> list[dict]:
     contribute, and `workers` reports how many actually arrived."""
     by_key: dict[tuple[int, int], dict] = {}
     for rec in payload or []:
+        if TRACE_RECORD_KEY in rec:
+            continue  # trace subtree riding the same relay; not a stats record
         key = (int(rec["stage"]), int(rec["op"]))
         m = by_key.get(key)
         if m is None:

@@ -150,10 +150,15 @@ class MailboxRegistry:
         Envelopes for a tombstoned (recently closed) query are dropped and
         counted — a straggler block from a cancelled/finished query must not
         resurrect its mailbox."""
-        from pinot_tpu.common.faults import FAULTS
+        from pinot_tpu.common.faults import FAULTS, InjectedFault
         from pinot_tpu.common.metrics import ServerMeter, server_metrics
+        from pinot_tpu.common.trace import trace_event
 
-        FAULTS.maybe_fail("mailbox.deliver")
+        try:
+            FAULTS.maybe_fail("mailbox.deliver")
+        except InjectedFault:
+            trace_event("fault.injected", point="mailbox.deliver")
+            raise
         header, payload = decode_envelope(data)
         qid = header["qid"]
         now = time.monotonic()
@@ -196,21 +201,33 @@ class DistributedMailbox(R.MailboxService):
         super().send(ss, rs, rw, payload)
 
     def send(self, send_stage: int, recv_stage: int, recv_worker: int, payload) -> None:
-        from pinot_tpu.common.faults import FAULTS
+        from pinot_tpu.common.faults import FAULTS, InjectedFault
+        from pinot_tpu.common.trace import trace_event
 
         owner = self.placement.get((recv_stage, recv_worker), self.my_id)
         if owner == self.my_id:
             super().send(send_stage, recv_stage, recv_worker, payload)
             return
-        data = encode_envelope(self.qid, recv_stage, recv_worker, send_stage, payload)
         url = self.addresses[owner].rstrip("/") + "/mailbox"
         backoff = self.retry_initial_s
         for attempt in range(self.send_retries + 1):
+            # encode per attempt: a callable payload (trailing EOS carrying
+            # the trace subtree) re-snapshots, so fault/retry span events
+            # recorded by a failed attempt ride the retry that succeeds
+            data = encode_envelope(
+                self.qid, recv_stage, recv_worker, send_stage, payload() if callable(payload) else payload
+            )
             req = urllib.request.Request(
                 url, data=data, headers={"Content-Type": "application/x-pinot-mailbox"}
             )
             try:
-                FAULTS.maybe_fail("mailbox.send")
+                try:
+                    FAULTS.maybe_fail("mailbox.send")
+                except InjectedFault:
+                    # span event before the retry machinery sees it: injected
+                    # faults must be visible in the assembled trace
+                    trace_event("fault.injected", point="mailbox.send", owner=owner, attempt=attempt)
+                    raise
                 with urllib.request.urlopen(req, timeout=self.timeout) as resp:
                     resp.read()
                 return
@@ -243,6 +260,14 @@ class DistributedMailbox(R.MailboxService):
                                 "(deadline exhausted)"
                             ) from None
                         sleep_s = min(sleep_s, rem)
+                # a retried send is ONE span event, never a duplicated span
+                trace_event(
+                    "mailbox.retry",
+                    owner=owner,
+                    stage=recv_stage,
+                    attempt=attempt,
+                    sleepS=round(sleep_s, 4),
+                )
                 time.sleep(sleep_s)
                 backoff *= 2
 

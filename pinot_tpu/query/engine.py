@@ -132,7 +132,8 @@ class QueryEngine:
         the fused path; host fallbacks run inline). The ONE dispatch loop
         shared by partials()/submit()/execute()."""
         from pinot_tpu.common.accounting import default_accountant
-        from pinot_tpu.common.faults import FAULTS
+        from pinot_tpu.common.faults import FAULTS, InjectedFault
+        from pinot_tpu.common.trace import trace_event
         from pinot_tpu.query import pruner
 
         pend: list = []
@@ -141,7 +142,11 @@ class QueryEngine:
             default_accountant.checkpoint()
             if ctx.deadline is not None:
                 ctx.deadline.check(f"segment {seg.name}")
-            FAULTS.maybe_fail("segment.execute")
+            try:
+                FAULTS.maybe_fail("segment.execute")
+            except InjectedFault:
+                trace_event("fault.injected", point="segment.execute", segment=seg.name)
+                raise
             if not pruner.can_match(seg, ctx):
                 # bloom/min-max pruned: contribute a canonical empty partial
                 pend.append((seg, ("pruned", pruner.empty_partial(ctx))))
@@ -184,13 +189,18 @@ class QueryEngine:
         (partial, matched) as each segment finishes, so callers can frame
         results out incrementally and stop early (GrpcQueryServer.submit
         streaming parity, core/transport/grpc/GrpcQueryServer.java:65,165)."""
-        from pinot_tpu.common.faults import FAULTS
+        from pinot_tpu.common.faults import FAULTS, InjectedFault
+        from pinot_tpu.common.trace import trace_event
         from pinot_tpu.query import pruner
 
         for seg in self.segments if segments is None else segments:
             if ctx.deadline is not None:
                 ctx.deadline.check(f"segment {seg.name}")
-            FAULTS.maybe_fail("segment.execute")
+            try:
+                FAULTS.maybe_fail("segment.execute")
+            except InjectedFault:
+                trace_event("fault.injected", point="segment.execute", segment=seg.name)
+                raise
             if not pruner.can_match(seg, ctx):
                 continue
             partial, matched = self._execute_segment(seg, ctx)

@@ -28,6 +28,9 @@ class ResultTable:
     # populated when the query ran with `SET trace=true` (the reference
     # attaches a trace JSON blob to BrokerResponse the same way)
     trace: dict | None = None
+    # distributed-trace exemplar id (set whenever the query was sampled;
+    # joins the response to GET /debug/traces/{requestId})
+    trace_id: str = ""
     # multistage per-operator runtime stats merged by the root stage
     # (MultiStageQueryStats -> BrokerResponse `stageStats` parity); None
     # when collection was off or the query ran on the v1 engine
@@ -59,6 +62,8 @@ class ResultTable:
         }
         if self.trace is not None:
             d["traceInfo"] = self.trace
+        if self.trace_id:
+            d["traceId"] = self.trace_id
         if self.stage_stats is not None:
             d["stageStats"] = self.stage_stats
         # emitted only on the degraded path so pre-existing exact-dict

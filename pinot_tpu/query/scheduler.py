@@ -20,6 +20,7 @@ that must not execute queries inline).
 
 from __future__ import annotations
 
+import contextvars
 import queue
 import threading
 import time
@@ -32,7 +33,7 @@ class SchedulerRejectedError(RuntimeError):
 
 
 class _Job:
-    __slots__ = ("fn", "args", "kwargs", "future", "group", "workload", "enqueue_ts")
+    __slots__ = ("fn", "args", "kwargs", "future", "group", "workload", "enqueue_ts", "ctx")
 
     def __init__(self, fn, args, kwargs, group, workload):
         self.fn = fn
@@ -42,12 +43,16 @@ class _Job:
         self.group = group
         self.workload = workload
         self.enqueue_ts = time.perf_counter()
+        # snapshot the submitter's contextvars (TraceRunnable parity): runner
+        # threads see the submitting request's active trace, so segment-level
+        # spans land under the right parent instead of being dropped
+        self.ctx = contextvars.copy_context()
 
     def run(self):
         if not self.future.set_running_or_notify_cancel():
             return
         try:
-            self.future.set_result(self.fn(*self.args, **self.kwargs))
+            self.future.set_result(self.ctx.run(self.fn, *self.args, **self.kwargs))
         except BaseException as e:  # noqa: BLE001 — future carries it to caller
             self.future.set_exception(e)
 

@@ -1,0 +1,36 @@
+"""Golden fixture for the fault-span-event checker. Nested under a
+pinot_tpu/query/ directory on purpose: the checker only applies its rule to
+query-path modules, so the fixture must satisfy the path gate."""
+
+FAULT_POINTS = frozenset({"mailbox.send"})
+
+FAULTS = None  # lexical stand-in
+trace = None
+
+
+def no_event():
+    FAULTS.maybe_fail("mailbox.send")  # line 12: VIOLATION no span event in scope
+    return 1
+
+
+def with_trace_event():
+    FAULTS.maybe_fail("mailbox.send")  # CLEAN: trace_event in the same scope
+    trace_event("fault.injected", point="mailbox.send")  # noqa: F821 — ast-only fixture
+
+
+def with_add_event():
+    FAULTS.maybe_fail("mailbox.send")  # CLEAN: .add_event in the same scope
+    trace.add_event("fault.injected", 0.0)
+
+
+def nested_scope_does_not_count():
+    FAULTS.maybe_fail("mailbox.send")  # line 27: VIOLATION event only in nested def
+
+    def inner():
+        trace_event("fault.injected")  # noqa: F821 — ast-only fixture
+
+    return inner
+
+
+def suppressed():
+    FAULTS.maybe_fail("mailbox.send")  # pinotlint: disable=fault-span-event — fixture: this site has no trace to write to

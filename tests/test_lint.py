@@ -79,6 +79,23 @@ def test_fault_fixture_findings():
     assert "literal" in by_line[20]  # non-literal point name
 
 
+SPAN_FIXTURE = os.path.join("pinot_tpu", "query", "span_fixture.py")
+
+
+def test_span_fixture_findings():
+    fs = findings_for(SPAN_FIXTURE, checks=["fault-span-event"])
+    assert lines_of(fs, "fault-span-event") == [12, 27]
+    by_line = {f.line: f.message for f in fs}
+    assert "no_event" in by_line[12]
+    assert "nested_scope_does_not_count" in by_line[27]  # walk_scope stops at inner def
+
+
+def test_span_checker_ignores_off_query_path():
+    # the same violations in a plain fixtures path are out of the rule's scope
+    fs = findings_for("fault_fixture.py", checks=["fault-span-event"])
+    assert fs == []
+
+
 # ---------------------------------------------------------------------------
 # suppressions
 # ---------------------------------------------------------------------------
@@ -91,6 +108,7 @@ def test_fault_fixture_findings():
         ("deadline_fixture.py", ["deadline-coverage"], 70),
         ("errcode_fixture.py", ["error-code-registry"], 34),
         ("fault_fixture.py", ["fault-point-registry"], 24),
+        (os.path.join("pinot_tpu", "query", "span_fixture.py"), ["fault-span-event"], 36),
     ],
 )
 def test_suppressed_lines_not_reported(name, checks, suppressed_line):

@@ -1,0 +1,369 @@
+"""Distributed tracing: context propagation across v1 scatter HTTP hops and
+v2 mailbox envelopes, span assembly at the broker, sampling, span events for
+the resilience plane (mailbox retries, deadline hits, fault injections,
+accountant kills), and the /debug/traces export surface.
+
+Deterministic throughout: faults are seeded, sampling is exercised at rates
+0.0 and 1.0 only, and every cluster runs in-process on localhost sockets.
+"""
+
+import json
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from pinot_tpu.cluster import Broker, Controller, PropertyStore, Server
+from pinot_tpu.cluster.http import BrokerHTTPService, RemoteServerClient, ServerHTTPService
+from pinot_tpu.common import DataType, ObservabilityConfig, Schema, TableConfig
+from pinot_tpu.common.faults import FAULTS, FaultRule
+from pinot_tpu.common.trace import TraceContext, active_trace, start_trace, trace_event
+from pinot_tpu.segment import SegmentBuilder
+
+
+# ---------------------------------------------------------------------------
+# TraceContext: W3C traceparent shape
+# ---------------------------------------------------------------------------
+
+
+def test_traceparent_header_roundtrip():
+    ctx = TraceContext.mint()
+    assert len(ctx.trace_id) == 32 and len(ctx.parent_span_id) == 16
+    back = TraceContext.from_header(ctx.to_header())
+    assert back == ctx
+    off = TraceContext(ctx.trace_id, ctx.parent_span_id, sampled=False)
+    assert off.to_header().endswith("-00")
+    assert TraceContext.from_header(off.to_header()).sampled is False
+
+
+def test_traceparent_dict_roundtrip():
+    ctx = TraceContext.mint()
+    assert TraceContext.from_dict(ctx.to_dict()) == ctx
+
+
+@pytest.mark.parametrize(
+    "header",
+    ["", "garbage", "00-abc-def-01", "00-" + "a" * 32 + "-" + "b" * 8 + "-01", "a-b-c"],
+)
+def test_traceparent_malformed_header_is_none(header):
+    assert TraceContext.from_header(header) is None
+
+
+def test_trace_event_noop_without_trace():
+    trace_event("anything", k=1)  # must not raise with tracing off
+    with start_trace("q", context=TraceContext.mint()) as tr:
+        trace_event("mailbox.retry", attempt=1)
+    evs = tr.root.events
+    assert [e["name"] for e in evs] == ["mailbox.retry"]
+    assert evs[0]["attrs"] == {"attempt": 1}
+
+
+# ---------------------------------------------------------------------------
+# fixtures
+# ---------------------------------------------------------------------------
+
+
+def _small_cluster(tmp_path, obs_config=None):
+    controller = Controller(PropertyStore(), tmp_path / "deepstore")
+    controller.register_server("server_0", Server("server_0"))
+    schema = Schema.build("t", dimensions=[("d", DataType.INT)], metrics=[("v", DataType.LONG)])
+    controller.add_schema(schema)
+    controller.add_table(TableConfig("t"))
+    b = SegmentBuilder(schema)
+    for i in range(3):  # >1 segment so accountant checkpoints fire mid-query
+        controller.upload_segment(
+            "t",
+            b.build(
+                {"d": np.arange(64, dtype=np.int32) % 4, "v": np.arange(64, dtype=np.int64)},
+                f"t_{i}",
+            ),
+        )
+    return Broker(controller, obs_config=obs_config) if obs_config else Broker(controller)
+
+
+@pytest.fixture(scope="module")
+def http_cluster(tmp_path_factory):
+    """Two real HTTP server endpoints: v1 scatter crosses the wire with a
+    traceparent header, v2 stages exchange blocks through /mailbox."""
+    root = tmp_path_factory.mktemp("tracedist")
+    controller = Controller(PropertyStore(), root / "deepstore")
+    inner = {f"server_{i}": Server(f"server_{i}") for i in range(2)}
+    services = {sid: ServerHTTPService(s, port=0) for sid, s in inner.items()}
+    for sid, svc in services.items():
+        controller.register_server(sid, RemoteServerClient(f"http://127.0.0.1:{svc.port}"))
+
+    rng = np.random.default_rng(11)
+    orders_schema = Schema.build(
+        "orders", dimensions=[("ocid", DataType.INT)], metrics=[("amount", DataType.LONG)]
+    )
+    cust_schema = Schema.build(
+        "customers", dimensions=[("cid", DataType.INT)], metrics=[("credit", DataType.LONG)]
+    )
+    controller.add_schema(orders_schema)
+    controller.add_schema(cust_schema)
+    controller.add_table(TableConfig("orders", replication=1))
+    controller.add_table(TableConfig("customers", replication=1))
+    ob = SegmentBuilder(orders_schema)
+    for i in range(4):  # spread across both servers
+        controller.upload_segment(
+            "orders",
+            ob.build(
+                {
+                    "ocid": rng.integers(0, 20, 500).astype(np.int32),
+                    "amount": rng.integers(1, 100, 500).astype(np.int64),
+                },
+                f"orders_{i}",
+            ),
+        )
+    controller.upload_segment(
+        "customers",
+        SegmentBuilder(cust_schema).build(
+            {
+                "cid": np.arange(20, dtype=np.int32),
+                "credit": rng.integers(0, 1000, 20).astype(np.int64),
+            },
+            "customers_0",
+        ),
+    )
+    broker = Broker(controller)
+    yield broker, inner
+    for svc in services.values():
+        svc.stop()
+    if getattr(broker, "_dispatcher", None) is not None:
+        broker._dispatcher.stop()
+
+
+def _all_spans(doc):
+    return [s for rs in doc["resourceSpans"] for s in rs["spans"]]
+
+
+def _all_events(doc):
+    return [e for s in _all_spans(doc) for e in s.get("events", ())]
+
+
+# ---------------------------------------------------------------------------
+# sampling
+# ---------------------------------------------------------------------------
+
+
+def test_sampling_off_by_default(tmp_path):
+    broker = _small_cluster(tmp_path)
+    res = broker.execute("SELECT COUNT(*) FROM t")
+    assert res.trace_id == "" and res.trace is None
+    assert broker.recent_traces() == []
+
+
+def test_sampling_rate_one_samples_without_inline_trace(tmp_path):
+    broker = _small_cluster(tmp_path, ObservabilityConfig(trace_sample_rate=1.0))
+    res = broker.execute("SELECT COUNT(*) FROM t")
+    # sampled: exemplar id + buffered trace, but no inline blob (not requested)
+    assert res.trace_id and res.trace is None
+    doc = broker.get_trace(res.trace_id)
+    assert doc is not None and doc["traceId"] == res.trace_id
+
+
+def test_trace_true_always_samples(tmp_path):
+    broker = _small_cluster(tmp_path)  # sample rate 0.0
+    res = broker.execute("SET trace=true; SELECT COUNT(*) FROM t")
+    assert res.trace_id and res.trace is not None
+    assert res.to_dict()["traceId"] == res.trace_id
+    doc = broker.get_trace(res.trace_id)
+    assert doc["requestId"] and doc["resourceSpans"]
+    # root span id is the minted parent span id; local spans hang off it
+    root = doc["resourceSpans"][0]["spans"][0]
+    assert root["parentSpanId"] == "" and len(root["spanId"]) == 16
+
+
+def test_trace_buffer_is_bounded(tmp_path):
+    broker = _small_cluster(
+        tmp_path, ObservabilityConfig(trace_sample_rate=1.0, trace_buffer_max_entries=3)
+    )
+    for _ in range(5):
+        broker.execute("SELECT COUNT(*) FROM t")
+    assert len(broker.recent_traces()) == 3
+
+
+# ---------------------------------------------------------------------------
+# v1 scatter: traceparent over HTTP, subtree piggybacked on the response
+# ---------------------------------------------------------------------------
+
+
+def test_v1_scatter_assembles_remote_spans(http_cluster):
+    broker, _ = http_cluster
+    res = broker.execute("SET trace=true; SELECT COUNT(*) FROM orders")
+    assert res.rows[0][0] == 2000
+    doc = broker.get_trace(res.trace_id)
+    services = {rs["resource"]["service.name"] for rs in doc["resourceSpans"]}
+    assert "broker" in services
+    # segments span both servers, so both must ship a subtree back
+    assert {"server:server_0", "server:server_1"} <= services
+    # remote segment spans survive assembly with synthetic unique span ids
+    ids = [s["spanId"] for s in _all_spans(doc)]
+    assert len(ids) == len(set(ids))
+    assert any(s["name"].startswith("segment:") for s in _all_spans(doc))
+
+
+# ---------------------------------------------------------------------------
+# v2 multistage: context in the stage-plan envelope, subtrees on the EOS relay
+# ---------------------------------------------------------------------------
+
+_JOIN = (
+    "SELECT c.cid, SUM(o.amount) FROM orders o JOIN customers c ON o.ocid = c.cid "
+    "GROUP BY c.cid ORDER BY c.cid LIMIT 5"
+)
+
+
+def test_v2_distributed_trace_spans_two_processes(http_cluster):
+    broker, _ = http_cluster
+    res = broker.execute("SET trace=true; " + _JOIN)
+    assert len(res.rows) == 5
+    assert getattr(broker, "_dispatcher", None) is not None  # distributed path ran
+    doc = broker.get_trace(res.trace_id)
+    services = {rs["resource"]["service.name"] for rs in doc["resourceSpans"]}
+    assert "broker" in services
+    assert sum(1 for s in services if s.startswith("server:")) >= 2
+
+
+def test_v2_mailbox_fault_is_span_event_not_duplicate_span(http_cluster):
+    """A seeded single-shot mailbox.send fault must surface as span events
+    (fault.injected + mailbox.retry) on the worker that hit it — and the
+    retried send must NOT duplicate that worker's span subtree."""
+    broker, _ = http_cluster
+    FAULTS.configure({"mailbox.send": FaultRule(prob=1.0, max_count=1)}, seed=7)
+    try:
+        res = broker.execute("SET trace=true; " + _JOIN)
+    finally:
+        FAULTS.reset()
+    assert len(res.rows) == 5
+    doc = broker.get_trace(res.trace_id)
+    events = _all_events(doc)
+    injected = [e for e in events if e["name"] == "fault.injected"]
+    retried = [e for e in events if e["name"] == "mailbox.retry"]
+    assert len(injected) == 1 and injected[0]["attrs"]["point"] == "mailbox.send"
+    assert len(retried) == 1 and retried[0]["attrs"]["attempt"] == 0
+    ids = [s["spanId"] for s in _all_spans(doc)]
+    assert len(ids) == len(set(ids))
+
+
+# ---------------------------------------------------------------------------
+# scheduler context propagation (TraceRunnable parity)
+# ---------------------------------------------------------------------------
+
+
+def test_scheduler_propagates_submitting_context():
+    from pinot_tpu.query.scheduler import FCFSScheduler
+
+    sched = FCFSScheduler(num_runners=1)
+    sched.start()
+    try:
+        with start_trace("qsched", context=TraceContext.mint()) as tr:
+            fut = sched.submit(active_trace)
+        assert fut.result(timeout=5) is tr
+        # and with tracing off the runner sees no stale trace
+        assert sched.submit(active_trace).result(timeout=5) is None
+    finally:
+        sched.stop()
+
+
+# ---------------------------------------------------------------------------
+# resilience-plane span events
+# ---------------------------------------------------------------------------
+
+
+def test_deadline_expiry_emits_span_event():
+    from pinot_tpu.query.context import Deadline, QueryTimeoutError
+
+    with start_trace("qdl", context=TraceContext.mint()) as tr:
+        dl = Deadline.from_timeout_ms(0.0)
+        with pytest.raises(QueryTimeoutError):
+            dl.check("unit")
+    evs = [e for e in tr.root.events if e["name"] == "deadline.expired"]
+    assert len(evs) == 1 and evs[0]["attrs"]["where"] == "unit"
+
+
+def test_deadline_cancel_emits_span_event():
+    from pinot_tpu.query.context import Deadline, QueryCancelledError
+
+    with start_trace("qcl", context=TraceContext.mint()) as tr:
+        dl = Deadline()
+        dl.cancel()
+        with pytest.raises(QueryCancelledError):
+            dl.check("unit")
+    assert [e["name"] for e in tr.root.events] == ["deadline.cancelled"]
+
+
+def test_accountant_kill_carries_reason_and_trace_id(tmp_path):
+    from pinot_tpu.common.accounting import QueryKilledError, default_accountant
+
+    broker = _small_cluster(tmp_path)
+    default_accountant.per_query_limit_bytes = 1  # below any segment size
+    try:
+        with pytest.raises(QueryKilledError) as ei:
+            broker.execute("SET trace=true; SELECT COUNT(*) FROM t")
+    finally:
+        default_accountant.per_query_limit_bytes = None
+    e = ei.value
+    assert e.kill_reason and "limit" in e.kill_reason
+    assert getattr(e, "trace_id", "")  # exemplar id attached to the error
+    killed = [q for q in broker.slow_queries if q.get("killReason")]
+    assert len(killed) == 1
+    assert killed[0]["killReason"] == e.kill_reason
+    assert killed[0]["traceId"] == e.trace_id
+    # the kill checkpoint left a span event in the buffered trace
+    doc = broker.get_trace(e.trace_id)
+    kills = [ev for ev in _all_events(doc) if ev["name"] == "accountant.kill"]
+    assert kills and kills[0]["attrs"]["reason"] == e.kill_reason
+
+
+# ---------------------------------------------------------------------------
+# export surface: GET /debug/traces, error payload exemplars
+# ---------------------------------------------------------------------------
+
+
+def _get_json(url):
+    with urllib.request.urlopen(url, timeout=5.0) as resp:
+        return json.loads(resp.read())
+
+
+def test_debug_traces_http_endpoints(tmp_path):
+    from pinot_tpu.cluster.http import query_broker_http
+
+    broker = _small_cluster(tmp_path)
+    svc = BrokerHTTPService(broker, port=0)
+    base = f"http://127.0.0.1:{svc.port}"
+    try:
+        resp = query_broker_http(base, "SET trace=true; SELECT COUNT(*) FROM t")
+        trace_id = resp["traceId"]
+        assert trace_id
+        listing = _get_json(f"{base}/debug/traces")
+        assert [d["traceId"] for d in listing] == [trace_id]
+        assert listing[0]["numSpans"] >= 1
+        doc = _get_json(f"{base}/debug/traces/{trace_id}")
+        assert doc["traceId"] == trace_id and doc["resourceSpans"]
+        # requestId is accepted as the lookup key too
+        assert _get_json(f"{base}/debug/traces/{doc['requestId']}") == doc
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _get_json(f"{base}/debug/traces/{'0' * 32}")
+        assert ei.value.code == 404
+    finally:
+        svc.stop()
+
+
+def test_kill_reason_in_http_error_payload(tmp_path):
+    from pinot_tpu.cluster.http import query_broker_http
+    from pinot_tpu.common.accounting import default_accountant
+
+    broker = _small_cluster(tmp_path)
+    svc = BrokerHTTPService(broker, port=0)
+    default_accountant.per_query_limit_bytes = 1
+    try:
+        resp = query_broker_http(
+            f"http://127.0.0.1:{svc.port}", "SET trace=true; SELECT COUNT(*) FROM t"
+        )
+    finally:
+        default_accountant.per_query_limit_bytes = None
+        svc.stop()
+    exc = resp["exceptions"][0]
+    assert "killed" in exc["message"]
+    assert exc["killReason"] and exc["traceId"]
