@@ -77,7 +77,7 @@ class LeaderElection:
             return None
 
         got = self.store.update(LEASE_PATH, claim)
-        self._leader = bool(got) and got.get("owner") == cid
+        self._leader = bool(got) and got.get("owner") == cid  # pinotlint: disable=race-discipline — single-writer boolean: only the renew thread (and pre-start start()) assigns it; readers take a monotonic snapshot and stop() joins the writer before its own clear
 
     def _run(self) -> None:
         while not self._stop.wait(self.renew_every):

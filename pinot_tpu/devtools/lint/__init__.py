@@ -1,10 +1,13 @@
 """pinotlint: project-invariant static analyzer for pinot_tpu.
 
-Six AST checkers enforce the conventions the engine's correctness actually
+Nine AST checkers enforce the conventions the engine's correctness actually
 rests on — race discipline, jit purity, deadline/cancellation coverage, the
-error-code registry, the fault-point registry, and fault-point span-event
-coverage on the query path. See README.md in this directory and the module
-docstrings for each checker's exact rules.
+error-code registry, the fault-point registry, fault-point span-event
+coverage on the query path, lock-order cycles, blocking calls made while a
+lock is held, and resource leaks. The concurrency family (race-discipline,
+lock-order, blocking-under-lock) is whole-program: all three share one
+call-graph + lock-summary build per run (`core.AnalysisSession`). See
+README.md in this directory and the module docstrings for exact rules.
 
 Usage (CLI):   python -m pinot_tpu.devtools.lint pinot_tpu/
 Usage (code):  from pinot_tpu.devtools.lint import lint_paths
@@ -12,12 +15,14 @@ Usage (code):  from pinot_tpu.devtools.lint import lint_paths
 
 from __future__ import annotations
 
+from pinot_tpu.devtools.lint.concurrency import BlockingUnderLockChecker, LockOrderChecker
 from pinot_tpu.devtools.lint.core import Checker, Finding, run
 from pinot_tpu.devtools.lint.deadlines import DeadlineChecker
 from pinot_tpu.devtools.lint.error_codes import ErrorCodeChecker
 from pinot_tpu.devtools.lint.fault_points import FaultPointChecker, FaultSpanEventChecker
 from pinot_tpu.devtools.lint.jit_purity import JitPurityChecker
 from pinot_tpu.devtools.lint.races import RaceChecker
+from pinot_tpu.devtools.lint.resources import ResourceLeakChecker
 
 #: checker-id -> class, in reporting order. Checker instances hold run state
 #: (whole-program accumulation), so callers construct fresh ones per run.
@@ -28,6 +33,9 @@ ALL_CHECKERS: dict[str, type[Checker]] = {
     "error-code-registry": ErrorCodeChecker,
     "fault-point-registry": FaultPointChecker,
     "fault-span-event": FaultSpanEventChecker,
+    "lock-order": LockOrderChecker,
+    "blocking-under-lock": BlockingUnderLockChecker,
+    "resource-leak": ResourceLeakChecker,
 }
 
 

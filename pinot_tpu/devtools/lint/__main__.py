@@ -1,16 +1,72 @@
 """CLI: `python -m pinot_tpu.devtools.lint [options] path [path ...]`.
 
-Exit status is the CI contract: 0 when no findings survive suppression,
-1 when any do, 2 on usage errors. Imports nothing heavy (no jax/pandas):
-the analyzer is pure-stdlib `ast`, so the CI lint step is cheap.
+Exit status is the CI contract: 0 when no findings survive suppression (and
+baseline, when one is given), 1 when any do, 2 on usage errors. Imports
+nothing heavy (no jax/pandas): the analyzer is pure-stdlib `ast`, so the CI
+lint step is cheap.
+
+Baseline workflow: CI runs with `--baseline devtools/lint/baseline.json`,
+which tolerates exactly the recorded findings and fails on anything NEW —
+so a checker can land before the last legacy finding is fixed without
+freezing the tree. Entries are keyed (check, path, message), deliberately
+NOT line: unrelated edits above a known finding must not break CI. Refresh
+the file with `--update-baseline` after fixing or accepting findings; the
+diff then shows reviewers exactly which debts were paid or incurred.
 """
 
 from __future__ import annotations
 
 import argparse
+import json
+import re
 import sys
+from collections import Counter
+from pathlib import Path
 
-from pinot_tpu.devtools.lint import ALL_CHECKERS, lint_paths
+from pinot_tpu.devtools.lint import ALL_CHECKERS, Finding, lint_paths
+
+#: messages may cite other source locations ("(line 29)", "at foo.py:111");
+#: those drift with unrelated edits just like the finding's own line, so the
+#: baseline key normalizes them away
+_LINE_REF_RE = re.compile(r"(line |:)\d+")
+
+
+def _norm_message(message: str) -> str:
+    return _LINE_REF_RE.sub(r"\1N", message)
+
+
+def _baseline_key(f: Finding) -> tuple[str, str, str]:
+    return (f.check, f.path, _norm_message(f.message))
+
+
+def load_baseline(path: str) -> Counter:
+    """Baseline file -> multiset of tolerated (check, path, message) keys."""
+    doc = json.loads(Path(path).read_text(encoding="utf-8"))
+    entries = doc["findings"] if isinstance(doc, dict) else doc
+    return Counter((e["check"], e["path"], _norm_message(e["message"])) for e in entries)
+
+
+def apply_baseline(findings: list[Finding], budget: Counter) -> list[Finding]:
+    """Findings not covered by the baseline multiset (each entry tolerates
+    one occurrence, so a DUPLICATED known finding still fails)."""
+    budget = Counter(budget)  # caller's copy stays intact
+    fresh: list[Finding] = []
+    for f in findings:
+        k = _baseline_key(f)
+        if budget[k] > 0:
+            budget[k] -= 1
+        else:
+            fresh.append(f)
+    return fresh
+
+
+def write_baseline(path: str, findings: list[Finding]) -> None:
+    entries = [
+        {"check": c, "path": p, "message": m}
+        for c, p, m in sorted(_baseline_key(f) for f in findings)
+    ]
+    doc = {"version": 1, "findings": entries}
+    Path(path).write_text(json.dumps(doc, indent=2) + "\n", encoding="utf-8")
 
 
 def main(argv: list[str] | None = None) -> int:
@@ -31,6 +87,21 @@ def main(argv: list[str] | None = None) -> int:
         action="store_true",
         help="flag suppression comments that carry no reason text",
     )
+    ap.add_argument(
+        "--json",
+        action="store_true",
+        help="emit findings as a JSON array on stdout (machine-readable)",
+    )
+    ap.add_argument(
+        "--baseline",
+        metavar="FILE",
+        help="tolerate the findings recorded in FILE; only NEW findings fail",
+    )
+    ap.add_argument(
+        "--update-baseline",
+        action="store_true",
+        help="rewrite the --baseline FILE from the current findings and exit 0",
+    )
     args = ap.parse_args(argv)
     if args.list:
         for name, cls in ALL_CHECKERS.items():
@@ -40,15 +111,45 @@ def main(argv: list[str] | None = None) -> int:
     if not args.paths:
         ap.print_usage(sys.stderr)
         return 2
+    if args.update_baseline and not args.baseline:
+        print("pinotlint: error: --update-baseline requires --baseline FILE", file=sys.stderr)
+        return 2
     try:
         findings = lint_paths(args.paths, checks=args.check, require_reason=args.require_reason)
     except (FileNotFoundError, KeyError) as e:
         print(f"pinotlint: error: {e}", file=sys.stderr)
         return 2
-    for f in findings:
-        print(f)
+    if args.update_baseline:
+        write_baseline(args.baseline, findings)
+        print(
+            f"pinotlint: baseline updated with {len(findings)} finding"
+            f"{'s' if len(findings) != 1 else ''}: {args.baseline}",
+            file=sys.stderr,
+        )
+        return 0
+    if args.baseline:
+        try:
+            budget = load_baseline(args.baseline)
+        except (OSError, ValueError, KeyError, TypeError) as e:
+            print(f"pinotlint: error: bad baseline {args.baseline}: {e}", file=sys.stderr)
+            return 2
+        findings = apply_baseline(findings, budget)
+    if args.json:
+        print(
+            json.dumps(
+                [
+                    {"check": f.check, "path": f.path, "line": f.line, "message": f.message}
+                    for f in findings
+                ],
+                indent=2,
+            )
+        )
+    else:
+        for f in findings:
+            print(f)
     n = len(findings)
-    print(f"pinotlint: {n} finding{'s' if n != 1 else ''}" if n else "pinotlint: clean", file=sys.stderr)
+    label = "new finding" if args.baseline else "finding"
+    print(f"pinotlint: {n} {label}{'s' if n != 1 else ''}" if n else "pinotlint: clean", file=sys.stderr)
     return 1 if findings else 0
 
 

@@ -81,10 +81,34 @@ class Suppressions:
         return checks is not None and (finding.check in checks or "all" in checks)
 
 
+class AnalysisSession:
+    """One lint run's shared analysis state: every checker sees the same
+    parsed modules, and the whole-program `ProgramIndex` (call graph + lock
+    summaries, see callgraph.py) is built lazily ONCE and reused by every
+    checker that needs it — race-discipline, lock-order and
+    blocking-under-lock all pay for one build, which is what keeps the
+    whole-package run inside the `lint_runtime` budget."""
+
+    def __init__(self, modules: list[ModuleInfo]):
+        self.modules = modules
+        self._index = None
+
+    @property
+    def index(self):
+        if self._index is None:
+            from pinot_tpu.devtools.lint.callgraph import ProgramIndex
+
+            self._index = ProgramIndex.build(self.modules)
+        return self._index
+
+
 class Checker:
-    """Base class. Subclasses set `name` and override one or both passes."""
+    """Base class. Subclasses set `name` and override one or both passes.
+    The runner assigns `self.session` (an AnalysisSession) before the first
+    pass; whole-program checkers read `self.session.index`."""
 
     name: str = ""
+    session: AnalysisSession | None = None
 
     def check_module(self, module: ModuleInfo) -> list[Finding]:
         return []
@@ -142,6 +166,10 @@ def run(
                 findings.append(
                     Finding("suppression-reason", mod.path, ln, "suppression comment has no reason text")
                 )
+    session = AnalysisSession(modules)
+    for checker in checkers:
+        checker.session = session
+    for mod in modules:
         for checker in checkers:
             findings.extend(checker.check_module(mod))
     for checker in checkers:

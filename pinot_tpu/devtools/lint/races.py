@@ -1,5 +1,5 @@
 """race-discipline: cross-thread mutation of instance state without the
-instance lock.
+instance lock — whole-program since pinotlint v2.
 
 Motivating bug (PR 1): two receiver threads shared one pandas `Index`
 object whose lazily-built hash engine is not thread-safe — a transient
@@ -12,19 +12,31 @@ touches the same attribute outside the lock. Either side alone is fine
 (thread-confined state, or consistently locked state); the combination is
 a data race.
 
+The v2 upgrade rides the shared call graph (`AnalysisSession.index`):
+
+- classes are merged across their MRO, so a base class in one module and
+  the subclass that spawns the thread in another are analyzed as ONE class
+  — the per-file pass used to be blind to exactly that split;
+- the thread entry's effects are **transitive**: a write inside a helper
+  method reached from the entry (`self._step()` from `run()`) counts as an
+  entry write, and it counts as LOCKED when the call site held the lock
+  even though the helper body is lexically lock-free — the locked-helper
+  pattern (`_enqueue`/`_dequeue` called under the scheduler lock) no longer
+  needs suppressions, and an unlocked helper write is no longer invisible.
+
 `__init__` is exempt on both sides: construction happens-before the thread
 start. Attributes whose every access is under the lock never fire. The
-checker is per-class and purely lexical — it does not chase cross-class
-aliasing — so it is a discipline check, not a proof; suppress with a reason
-for intentional patterns (double-checked init of an immutable reference,
-monotonic counters read for monitoring, ...).
+checker does not chase aliasing through containers or non-self receivers,
+so it remains a discipline check, not a proof; suppress with a reason for
+intentional patterns (single-writer state machines, monotonic counters
+read for monitoring, ...).
 """
 
 from __future__ import annotations
 
 import ast
 
-from pinot_tpu.devtools.lint.core import Checker, Finding, ModuleInfo, dotted_name
+from pinot_tpu.devtools.lint.core import Checker, Finding, dotted_name
 
 _HANDLER_NAMES = {"run", "do_GET", "do_POST", "do_PUT", "do_DELETE", "do_HEAD"}
 _SPAWN_ATTRS = {"submit", "map"}
@@ -40,16 +52,16 @@ def _is_lock_ctx(item: ast.withitem) -> bool:
 
 class _MethodScan(ast.NodeVisitor):
     """Collect self-attribute accesses within ONE method, tagging each with
-    whether a `with <lock>` block encloses it."""
+    whether a `with <lock>` block encloses it, plus same-instance method
+    calls (`self.m()`) with their lock state for the transitive pass."""
 
     def __init__(self, self_name: str):
         self.self_name = self_name
         self.lock_depth = 0
-        # attr -> {"write_unlocked": line|None, "read_unlocked": line|None,
-        #          "locked": bool}
         self.writes: dict[str, list[tuple[int, bool]]] = {}  # attr -> [(line, locked)]
         self.reads: dict[str, list[tuple[int, bool]]] = {}
         self.spawn_targets: set[str] = set()  # method names handed to threads
+        self.self_calls: list[tuple[str, int, bool]] = []  # (method, line, locked)
 
     def visit_With(self, node: ast.With):
         locky = any(_is_lock_ctx(i) for i in node.items)
@@ -117,6 +129,12 @@ class _MethodScan(ast.NodeVisitor):
             attr = self._self_attr(node.args[0])
             if attr:
                 self.spawn_targets.add(attr)
+        if (
+            isinstance(fn, ast.Attribute)
+            and isinstance(fn.value, ast.Name)
+            and fn.value.id == self.self_name
+        ):
+            self.self_calls.append((fn.attr, node.lineno, self.lock_depth > 0))
         self.generic_visit(node)
 
     # do not descend into nested defs: their bodies execute in unknown
@@ -133,54 +151,105 @@ class _MethodScan(ast.NodeVisitor):
 class RaceChecker(Checker):
     name = "race-discipline"
 
-    def check_module(self, module: ModuleInfo) -> list[Finding]:
-        out: list[Finding] = []
-        for node in ast.walk(module.tree):
-            if isinstance(node, ast.ClassDef):
-                out.extend(self._check_class(module, node))
-        return out
+    def finalize(self, modules) -> list[Finding]:
+        idx = self.session.index
+        scans: dict[str, _MethodScan] = {}  # FuncInfo qname -> scan
 
-    def _check_class(self, module: ModuleInfo, cls: ast.ClassDef) -> list[Finding]:
-        methods = [n for n in cls.body if isinstance(n, ast.FunctionDef)]
-        scans: dict[str, _MethodScan] = {}
-        for m in methods:
-            self_name = m.args.args[0].arg if m.args.args else "self"
-            scan = _MethodScan(self_name)
-            for stmt in m.body:
-                scan.visit(stmt)
-            scans[m.name] = scan
-
-        spawned = set().union(*(s.spawn_targets for s in scans.values())) if scans else set()
-        thread_entries = {
-            name for name in scans if name in _HANDLER_NAMES or name in spawned
-        }
+        def scan_of(fi) -> _MethodScan:
+            s = scans.get(fi.qname)
+            if s is None:
+                args = fi.node.args.args
+                s = _MethodScan(args[0].arg if args else "self")
+                for stmt in fi.node.body:
+                    s.visit(stmt)
+                scans[fi.qname] = s
+            return s
 
         out: list[Finding] = []
-        for entry in sorted(thread_entries):
-            if entry == "__init__":
+        seen_lines: set[tuple[str, int, str]] = set()
+        for ci in idx.classes.values():
+            # merged view across the MRO: most-derived definition wins, so a
+            # base-class helper and the subclass entry analyze as one class
+            merged: dict[str, object] = {}
+            for c in idx.mro(ci):
+                for name, fi in c.methods.items():
+                    merged.setdefault(name, fi)
+            if not merged:
                 continue
-            for attr, writes in scans[entry].writes.items():
-                unlocked_writes = [ln for ln, locked in writes if not locked]
-                if not unlocked_writes:
+            spawned: set[str] = set()
+            for fi in merged.values():
+                spawned |= scan_of(fi).spawn_targets
+            entries = sorted(
+                name for name in merged if name in _HANDLER_NAMES or name in spawned
+            )
+            for entry in entries:
+                if entry == "__init__":
                     continue
-                for other_name, other in scans.items():
-                    if other_name in (entry, "__init__"):
+                eff_writes = self._entry_effects(idx, ci, merged, entry, scan_of)
+                for attr, writes in eff_writes.items():
+                    unlocked = [(ln, path, holder) for ln, locked, path, holder in writes if not locked]
+                    if not unlocked:
                         continue
-                    other_hits = [
-                        ln
-                        for ln, locked in other.writes.get(attr, []) + other.reads.get(attr, [])
-                        if not locked
-                    ]
-                    if other_hits:
-                        out.append(
-                            Finding(
-                                self.name,
-                                module.path,
-                                unlocked_writes[0],
-                                f"self.{attr} is mutated in thread-entry method "
-                                f"{cls.name}.{entry}() without holding the lock, and accessed "
-                                f"in {other_name}() (line {other_hits[0]}) also unlocked",
+                    first_line, first_path, holder = unlocked[0]
+                    for other_name, other_fi in merged.items():
+                        if other_name in (entry, "__init__", holder):
+                            continue
+                        other = scan_of(other_fi)
+                        other_hits = [
+                            ln
+                            for ln, locked in other.writes.get(attr, []) + other.reads.get(attr, [])
+                            if not locked
+                        ]
+                        if other_hits:
+                            key = (first_path, first_line, attr)
+                            if key in seen_lines:
+                                break
+                            seen_lines.add(key)
+                            via = "" if holder == entry else f" (via {holder}())"
+                            out.append(
+                                Finding(
+                                    self.name,
+                                    first_path,
+                                    first_line,
+                                    f"self.{attr} is mutated in thread-entry method "
+                                    f"{ci.name}.{entry}(){via} without holding the lock, and "
+                                    f"accessed in {other_name}() (line {other_hits[0]}) also unlocked",
+                                )
                             )
-                        )
-                        break  # one finding per (entry, attr)
+                            break  # one finding per (entry, attr)
         return out
+
+    @staticmethod
+    def _entry_effects(idx, ci, merged, entry: str, scan_of):
+        """attr -> [(line, locked, path, holder_method)] for every self-attr
+        rebind reachable from `entry` through same-instance calls. A write is
+        locked when its own site is, or ANY call on the chain held the lock;
+        a method reached both locked and unlocked is re-visited so the
+        weaker (unlocked) state wins — conservative toward reporting."""
+        effects: dict[str, list[tuple[int, bool, str, str]]] = {}
+        visited: dict[str, bool] = {}  # qname -> inherited_locked it was walked with
+        stack = [(merged[entry], False)]
+        while stack:
+            fi, inherited = stack.pop()
+            prev = visited.get(fi.qname)
+            # re-walk only to DOWNGRADE: walked locked before, reached
+            # unlocked now (two states, so this terminates)
+            if prev is not None and not (prev and not inherited):
+                continue
+            visited[fi.qname] = inherited
+            scan = scan_of(fi)
+            holder = fi.qname.rsplit(".", 1)[-1]
+            for attr, ws in scan.writes.items():
+                for line, locked in ws:
+                    effects.setdefault(attr, []).append(
+                        (line, locked or inherited, fi.module.path, holder)
+                    )
+            for m, _line, call_locked in scan.self_calls:
+                target = idx.find_method(ci, m)
+                if target is None or m == entry:
+                    continue
+                stack.append((target, inherited or call_locked))
+        # entry's own writes first, then transitive, each in source order
+        for attr in effects:
+            effects[attr].sort(key=lambda w: (w[3] != entry, w[0]))
+        return effects

@@ -96,9 +96,9 @@ class KafkaWireClient:
             header = struct.pack(">hhi", api_key, api_version, corr) + _str(self.client_id)
             msg = header + payload
             self._sock.sendall(struct.pack(">i", len(msg)) + msg)
-            raw = self._recv_exact(4)
+            raw = self._recv_exact(4)  # pinotlint: disable=blocking-under-lock — per-connection wire lock: it exists to serialize request/response pairs on this socket, so blocking reads under it are the design, and no other lock nests inside
             (n,) = struct.unpack(">i", raw)
-            body = self._recv_exact(n)
+            body = self._recv_exact(n)  # pinotlint: disable=blocking-under-lock — same wire-serialization shape as above
         r = _Reader(body)
         got_corr = r.i32()
         if got_corr != corr:

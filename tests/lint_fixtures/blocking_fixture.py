@@ -1,0 +1,51 @@
+"""Golden fixture for the blocking-under-lock checker: direct and
+interprocedural blocking while holding a lock, the legal Condition.wait
+shape, the dict.get / str.join near-misses, and a suppression demo."""
+
+import queue
+import threading
+import time
+
+
+def slow_io():
+    time.sleep(0.5)  # CLEAN here: no lock held in THIS frame
+
+
+class Worker:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._wake = threading.Condition(self._lock)
+        self._other = threading.Lock()
+        self._q = queue.Queue()
+        self._conf = {}
+
+    def sleeps_under_lock(self):
+        with self._lock:
+            time.sleep(0.1)  # line 24: VIOLATION direct sleep under lock
+
+    def calls_blocker_under_lock(self):
+        with self._lock:
+            slow_io()  # line 28: VIOLATION callee reaches time.sleep
+
+    def legal_condition_wait(self):
+        with self._lock:
+            self._wake.wait(timeout=0.1)  # CLEAN: wait releases the bound lock
+
+    def wait_holding_other_lock(self):
+        with self._other:
+            with self._lock:
+                self._wake.wait()  # line 37: VIOLATION _other stays held across the wait
+
+    def queue_get_under_lock(self):
+        with self._lock:
+            return self._q.get(timeout=0.2)  # line 41: VIOLATION queue.get parks the thread
+
+    def near_misses_are_clean(self):
+        with self._lock:
+            v = self._conf.get("key", 1)  # CLEAN: dict.get takes a key
+            s = ", ".join(["a", "b"])  # CLEAN: str.join takes an iterable
+            return v, s
+
+    def suppressed(self):
+        with self._lock:
+            time.sleep(0.01)  # pinotlint: disable=blocking-under-lock — fixture: demo acknowledged hold-and-sleep

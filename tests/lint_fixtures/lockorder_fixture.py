@@ -1,0 +1,44 @@
+"""Golden fixture for the lock-order checker: a two-lock inversion, a
+non-cycle edge that must stay quiet, reentrant re-acquisition (never an
+edge), and a suppression demo."""
+
+import threading
+
+LOCK_A = threading.Lock()
+LOCK_B = threading.Lock()
+LOCK_C = threading.Lock()  # acquired under A but never inverted: no finding
+LOCK_D = threading.Lock()  # suppression-demo pair, isolated from A/B/C
+LOCK_E = threading.Lock()
+REENTRANT = threading.RLock()
+
+
+def forward():
+    with LOCK_A:
+        with LOCK_B:  # line 15: VIOLATION half of the A->B->A cycle
+            pass
+        with LOCK_C:  # CLEAN: A->C edge is on no cycle
+            pass
+
+
+def inverted():
+    with LOCK_B:
+        with LOCK_A:  # line 23: VIOLATION the inverse edge
+            pass
+
+
+def reentrant_ok():
+    with REENTRANT:
+        with REENTRANT:  # CLEAN: same lock, RLock reentrance
+            pass
+
+
+def suppressed_inversion():
+    with LOCK_D:
+        with LOCK_E:  # line 37: VIOLATION the un-acknowledged edge of the D/E cycle
+            pass
+
+
+def suppressed_inverse():
+    with LOCK_E:
+        with LOCK_D:  # pinotlint: disable=lock-order — fixture: demo that one edge of a cycle can be acknowledged
+            pass

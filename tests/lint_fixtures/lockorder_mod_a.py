@@ -1,0 +1,10 @@
+"""Cross-module half of the lock-order fixture pair: the X->Y edge only
+exists through a call into lockorder_mod_b — a per-file pass cannot see
+it. Lint together with lockorder_mod_b.py."""
+
+from lockorder_mod_b import LOCK_X, grab_y
+
+
+def locks_x_then_calls():
+    with LOCK_X:
+        grab_y()  # line 10: VIOLATION callee acquires Y while X is held

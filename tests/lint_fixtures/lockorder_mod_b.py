@@ -1,0 +1,18 @@
+"""Cross-module half of the lock-order fixture pair: defines both locks,
+the helper that closes the X->Y edge, and the direct Y->X inverse."""
+
+import threading
+
+LOCK_X = threading.Lock()
+LOCK_Y = threading.Lock()
+
+
+def grab_y():
+    with LOCK_Y:
+        pass
+
+
+def locks_y_then_x():
+    with LOCK_Y:
+        with LOCK_X:  # line 17: VIOLATION inverse order, directly
+            pass

@@ -1,0 +1,21 @@
+"""Cross-module half of the race-discipline fixture pair: the base class
+holds the state and the unlocked helper write; the thread that reaches it
+is spawned by the subclass in race_mod_sub.py. Lint together."""
+
+import threading
+
+
+class Base:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.count = 0
+        self.safe = 0
+
+    def _bump(self):
+        self.count += 1  # line 15: VIOLATION unlocked write, reached from Worker._run
+
+    def _bump_safe(self):
+        self.safe += 1  # CLEAN when every call site holds the lock
+
+    def snapshot(self):
+        return self.count, self.safe  # the unlocked reader side

@@ -1,0 +1,18 @@
+"""Cross-module half of the race-discipline fixture pair: spawns the
+thread whose entry reaches Base._bump (unlocked) and Base._bump_safe
+(call-site locked) defined in race_mod_base.py. Lint together."""
+
+import threading
+
+from race_mod_base import Base
+
+
+class Worker(Base):
+    def start(self):
+        t = threading.Thread(target=self._run, daemon=True)
+        t.start()
+
+    def _run(self):
+        self._bump()  # unlocked call: _bump's write stays unlocked
+        with self._lock:
+            self._bump_safe()  # locked call site: _bump_safe's write is safe

@@ -1,0 +1,69 @@
+"""Golden fixture for the resource-leak checker: unconditional leaks,
+a conditional-path-only disposal, every escape/daemon/with shape that must
+stay clean, and a suppression demo."""
+
+import socket
+import threading
+from concurrent.futures import ThreadPoolExecutor
+
+
+def work():
+    pass
+
+
+def leaks_thread():
+    t = threading.Thread(target=work)  # line 15: VIOLATION never joined
+    t.start()
+
+
+def leaks_socket_and_pool():
+    s = socket.create_connection(("host", 1))  # line 20: VIOLATION never closed
+    s.sendall(b"x")
+    pool = ThreadPoolExecutor(2)  # line 22: VIOLATION never shut down
+    pool.submit(work)
+
+
+def conditional_close(flag):
+    s = socket.socket()  # line 27: VIOLATION closed only when flag is true
+    if flag:
+        s.close()
+
+
+def daemon_is_clean():
+    t = threading.Thread(target=work, daemon=True)
+    t.start()
+    t2 = threading.Thread(target=work)
+    t2.daemon = True  # CLEAN: daemonized after construction
+    t2.start()
+
+
+def with_is_clean():
+    s = socket.socket()
+    with s:
+        s.sendall(b"x")
+
+
+def escape_is_clean(sink):
+    t = threading.Thread(target=work)
+    sink(t)  # CLEAN: receiver owns it now
+    u = threading.Thread(target=work)
+    return u  # CLEAN: caller owns it now
+
+
+def finally_close_is_clean():
+    s = socket.socket()
+    try:
+        s.sendall(b"x")
+    finally:
+        s.close()
+
+
+def joined_is_clean():
+    t = threading.Thread(target=work)
+    t.start()
+    t.join()
+
+
+def suppressed():
+    t = threading.Thread(target=work)  # pinotlint: disable=resource-leak — fixture: demo acknowledged fire-and-forget thread
+    t.start()
