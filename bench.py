@@ -153,15 +153,44 @@ def _time(fn, iters):
     }
 
 
+def _link_rtt_ms():
+    """Memoized one-way tunnel RTT in ms (devlink.link_profile); None when
+    the probe itself fails — timings must survive a broken link probe."""
+    global _LINK_RTT_MS
+    if _LINK_RTT_MS is _UNSET:
+        try:
+            from pinot_tpu.common.devlink import link_profile
+
+            _LINK_RTT_MS = link_profile()[0] * 1e3
+        except Exception as e:
+            log(f"link rtt probe failed: {e}")
+            _LINK_RTT_MS = None
+    return _LINK_RTT_MS
+
+
+_UNSET = object()
+_LINK_RTT_MS = _UNSET
+
+
 def _bench_pair(name, run_dev, run_cpu, iters, check=None):
     """warmup+time the device path and the pandas reference; optional result
     check. A check failure is RECORDED next to the timings, never instead of
-    them — measured latencies are round evidence and must survive."""
+    them — measured latencies are round evidence and must survive.
+
+    Every row also splits `device_ms_*` (wall minus the measured tunnel RTT,
+    clamped at 0 — the run_* closures are block_until_ready-bounded so wall =
+    link + compute) from `link_rtt_ms`, so configs pinned to the 67-97 ms
+    RTT floor can show compute progress (ROADMAP item 4c)."""
     run_dev()  # compile
     run_dev()
     dev = _time(run_dev, iters)
     cpu = _time(run_cpu, max(3, iters // 2))
     out = {**dev, "cpu_p50": cpu["p50"], "speedup": round(cpu["p50"] / dev["p50"], 3)}
+    rtt_ms = _link_rtt_ms()
+    if rtt_ms is not None:
+        out["link_rtt_ms"] = round(rtt_ms, 3)
+        out["device_ms_p50"] = round(max(dev["p50"] - rtt_ms, 0.0), 3)
+        out["device_ms_p99"] = round(max(dev["p99"] - rtt_ms, 0.0), 3)
     if check is not None:
         try:
             check()

@@ -461,6 +461,68 @@ def bench_trace_overhead(n=200_000, dim=2_000):
     }
 
 
+def bench_profiler_overhead(n=200_000, dim=2_000):
+    """Sampling-profiler cost on the v2 hot path: the same multistage
+    join+group-by with the continuous profiler daemon off vs on at the
+    default rate. The profiled threads pay nothing per operation — the cost
+    is the daemon's O(threads x stack depth) walk, hz times a second — so
+    the stable assertion projects the measured per-tick cost at the default
+    rate against the query wall and holds it to the <2% budget (matching the
+    stats/deadline/trace budget benches)."""
+    from pinot_tpu.common import DataType, Schema
+    from pinot_tpu.common.profiler import SamplingProfiler
+    from pinot_tpu.multistage import MultistageEngine
+    from pinot_tpu.segment import SegmentBuilder
+
+    rng = np.random.default_rng(29)
+    fact_s = Schema.build("fact", dimensions=[("k", DataType.INT)], metrics=[("m", DataType.LONG)])
+    dim_s = Schema.build("dim", dimensions=[("k", DataType.INT)], metrics=[("w", DataType.LONG)])
+    fact = SegmentBuilder(fact_s).build(
+        {"k": rng.integers(0, dim, n).astype(np.int32), "m": rng.integers(1, 10, n).astype(np.int64)},
+        "f0",
+    )
+    d = SegmentBuilder(dim_s).build(
+        {"k": np.arange(dim, dtype=np.int32), "w": rng.integers(1, 5, dim).astype(np.int64)}, "d0"
+    )
+    eng = MultistageEngine({"fact": [fact], "dim": [d]}, n_workers=2)
+    q = "SELECT dim.k, SUM(fact.m) FROM fact JOIN dim ON fact.k = dim.k GROUP BY dim.k ORDER BY dim.k LIMIT 10"
+    off_ms = _time_host(lambda: eng.execute(q), iters=7)
+
+    prof = SamplingProfiler()
+    prof.start()
+    try:
+        on_ms = _time_host(lambda: eng.execute(q), iters=7)
+    finally:
+        prof.stop()
+
+    # Direct measure of one sampling tick (all threads walked + folded),
+    # projected at the default rate against the query wall: ticks-per-query
+    # x per-tick cost must sit inside the 2% budget.
+    ticks = 200
+    t0 = time.perf_counter()
+    for _ in range(ticks):
+        prof.sample_once()
+    per_tick_ms = (time.perf_counter() - t0) / ticks * 1e3
+    ticks_per_query = prof.hz * off_ms / 1e3
+    projected_pct = per_tick_ms * ticks_per_query / off_ms * 100
+    assert projected_pct < 2.0, (
+        f"profiler tick {per_tick_ms:.3f}ms x {prof.hz}Hz = {projected_pct:.2f}% of "
+        f"{off_ms:.1f}ms query — over the 2% hot-loop budget"
+    )
+    return {
+        "metric": "profiler_overhead",
+        "value": round(on_ms - off_ms, 3),
+        "unit": "ms",
+        "n": n,
+        "off_ms": round(off_ms, 3),
+        "on_ms": round(on_ms, 3),
+        "overhead_pct": round((on_ms / off_ms - 1.0) * 100, 1),
+        "tick_ms": round(per_tick_ms, 4),
+        "hz": prof.hz,
+        "projected_pct_at_default_hz": round(projected_pct, 3),
+    }
+
+
 def bench_lint_runtime():
     """pinotlint must stay fast enough to sit in tier-1 and CI: a whole-package
     run (all five checkers, ~200 modules) is asserted under the 10s budget on
@@ -497,6 +559,7 @@ ALL = [
     bench_stats_overhead,
     bench_deadline_overhead,
     bench_trace_overhead,
+    bench_profiler_overhead,
     bench_lint_runtime,
 ]
 

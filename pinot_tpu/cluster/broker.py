@@ -115,6 +115,10 @@ class Broker:
         self.quota = QueryQuotaManager(controller) if enable_quota else None
         self.query_logger = query_logger
         self.obs_config = obs_config if obs_config is not None else ObservabilityConfig()
+        if self.obs_config.profiler_enabled:
+            from pinot_tpu.common.profiler import maybe_start_profiler
+
+            maybe_start_profiler(self.obs_config)
         #: structured slow-query ring buffer (newest last); entries also go
         #: to the pinot_tpu.slowquery logger as one JSON line each
         self.slow_queries = collections.deque(maxlen=self.obs_config.slow_query_log_max_entries)
@@ -174,7 +178,7 @@ class Broker:
         import random
 
         from pinot_tpu.common.metrics import BrokerMeter, BrokerTimer, broker_metrics
-        from pinot_tpu.common.trace import TraceContext, start_trace
+        from pinot_tpu.common.trace import ServerQueryPhase, TraceContext, phase_timer, start_trace
         from pinot_tpu.query.context import (
             Deadline,
             QueryCancelledError,
@@ -190,8 +194,14 @@ class Broker:
         timeout_ms: float | None = None
         tctx = None
         try:
-            with bm.timer(BrokerTimer.QUERY_TOTAL).time():
-                stmt = parse_sql(sql)
+            # bind-only attribution scope: broker-side samples (parse, plan,
+            # scatter wait, reduce) show up under this query id in
+            # /debug/pprof; no tracker is registered here (see bind_scope)
+            from pinot_tpu.common.accounting import default_accountant
+
+            with bm.timer(BrokerTimer.QUERY_TOTAL).time(), default_accountant.bind_scope(qid):
+                with phase_timer(ServerQueryPhase.REQUEST_COMPILATION, role="broker"):
+                    stmt = parse_sql(sql)
                 raw_timeout = query_option(
                     stmt.options, "timeoutMs", self.resilience.default_timeout_ms
                 )
@@ -361,6 +371,22 @@ class Broker:
                     return d
         return None
 
+    def readiness(self) -> tuple[bool, dict]:
+        """(ready, per-component detail) for GET /health/ready. A broker is
+        live as soon as its HTTP service binds, but not *ready* until the
+        controller answers and at least one server is registered to route to
+        (BrokerResourceManager convergence analog)."""
+        try:
+            servers = self.controller.servers()
+            controller_ok, n_servers, err = True, len(servers), ""
+        except Exception as e:  # pinotlint: disable=deadline-swallow — readiness probe: an unreachable controller IS the not-ready answer, reported in detail
+            controller_ok, n_servers, err = False, 0, f"{type(e).__name__}: {e}"
+        components = {
+            "controller": {"ok": controller_ok, **({"error": err} if err else {})},
+            "servers": {"ok": n_servers > 0, "registered": n_servers},
+        }
+        return all(c["ok"] for c in components.values()), components
+
     def _execute(self, stmt, sql: str, deadline=None, qid=None, partial=None) -> ResultTable:
         t0 = time.perf_counter()
         if getattr(stmt, "explain", False) or getattr(stmt, "explain_analyze", False):
@@ -396,10 +422,21 @@ class Broker:
                         f"table {cfg.table_name!r} belongs to broker tenant tag {want!r}; "
                         f"this broker serves {self.tenant_tags}"
                     )
+        from pinot_tpu.common.trace import ServerQueryPhase, phase_timer
+
         schema = self.controller.get_schema(table) or self.controller.get_schema(rt_name)
-        self._expand_star(stmt, schema)
-        ctx = QueryContext.from_statement(stmt)
+        with phase_timer(ServerQueryPhase.REQUEST_COMPILATION, role="broker"):
+            self._expand_star(stmt, schema)
+            ctx = QueryContext.from_statement(stmt)
         ctx.deadline = deadline
+        # workload attribution: the table's server tenant rides the hints to
+        # every server (accountant rollups) and labels the broker-side meter
+        from pinot_tpu.cluster.tenancy import table_tenants
+        from pinot_tpu.common.metrics import broker_metrics
+
+        tenant = table_tenants(offline_cfg or rt_cfg)[1]
+        ctx.hints["__tenant__"] = tenant
+        broker_metrics().meter("broker.tableQueries", table=table, tenant=tenant).mark()
         # the deadline and query id ride the hints dict to every server (so
         # any server-handle shape carries them); servers pop the markers,
         # rebuild a local Deadline, and register it for cancel fan-out
@@ -453,7 +490,8 @@ class Broker:
             queried += q
             pruned += pr
 
-        rows = QueryEngine.reduce(ctx, partials)
+        with phase_timer(ServerQueryPhase.BROKER_REDUCE, role="broker"):
+            rows = QueryEngine.reduce(ctx, partials)
         return build_result(
             ctx,
             rows,

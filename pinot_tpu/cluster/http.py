@@ -53,6 +53,67 @@ def _serve_metrics(handler, registry) -> None:
     handler.wfile.write(payload)
 
 
+def _send_json(handler, doc, status: int = 200) -> None:
+    payload = json.dumps(doc).encode()
+    handler.send_response(status)
+    handler.send_header("Content-Type", "application/json")
+    handler.send_header("Content-Length", str(len(payload)))
+    handler.end_headers()
+    handler.wfile.write(payload)
+
+
+def _serve_pprof(handler) -> None:
+    """GET /debug/pprof[?seconds=N][&format=json]: sampling-profiler output
+    (common/profiler.py). Default is flamegraph.pl collapsed-stack text of
+    the continuous ring; `?seconds=N` takes a fresh bounded capture window
+    inline (the pprof-style on-demand profile); `format=json` returns the
+    structured stacks with per-query attribution counts."""
+    from pinot_tpu.common.profiler import SamplingProfiler, get_profiler
+
+    query = handler.path.partition("?")[2]
+    params = dict(p.split("=", 1) for p in query.split("&") if "=" in p)
+    prof = get_profiler()
+    if "seconds" in params:
+        try:
+            seconds = float(params["seconds"])
+        except ValueError:
+            handler.send_error(400, "seconds must be a number")
+            return
+        doc = prof.capture(seconds)
+    else:
+        doc = prof.profile()
+    if params.get("format") == "json":
+        _send_json(handler, doc)
+        return
+    payload = SamplingProfiler.collapsed_text(doc).encode()
+    handler.send_response(200)
+    handler.send_header("Content-Type", "text/plain; charset=utf-8")
+    handler.send_header("Content-Length", str(len(payload)))
+    handler.end_headers()
+    handler.wfile.write(payload)
+
+
+def _serve_workload(handler) -> None:
+    """GET /debug/workload: per-(tenant, table) cpu_ns/bytes/queries rollups
+    from the process accountant — the measurement substrate for quota tuning
+    and load shedding (ROADMAP item 2)."""
+    from pinot_tpu.common.accounting import default_accountant
+
+    _send_json(handler, {"rollups": default_accountant.workload_rollups()})
+
+
+def _serve_ready(handler, readiness_fn) -> None:
+    """GET /health/ready: 200 + component detail when ready, 503 + the
+    failing components otherwise (readiness, distinct from the bare
+    liveness `/health`)."""
+    ready, components = readiness_fn()
+    _send_json(
+        handler,
+        {"status": "ready" if ready else "not ready", "components": components},
+        status=200 if ready else 503,
+    )
+
+
 def _hints_with_traceparent(hints: dict, headers) -> dict:
     """Re-inject an incoming W3C `traceparent` header as the __traceCtx__
     hints marker (the wire format of the v1 data-plane hop; the server pops
@@ -146,6 +207,12 @@ class BrokerHTTPService:
                     self.send_header("Content-Length", "2")
                     self.end_headers()
                     self.wfile.write(b"OK")
+                elif self.path == "/health/ready":
+                    _serve_ready(self, svc.broker.readiness)
+                elif self.path.partition("?")[0] == "/debug/pprof":
+                    _serve_pprof(self)
+                elif self.path == "/debug/workload":
+                    _serve_workload(self)
                 elif self.path.partition("?")[0] == "/metrics":
                     from pinot_tpu.common.metrics import BrokerTimer, broker_metrics
 
@@ -346,9 +413,12 @@ class ServerHTTPService:
                 if self.path != "/query":
                     self.send_error(404)
                     return
+                from pinot_tpu.common.trace import ServerQueryPhase, phase_timer
+
                 n = int(self.headers.get("Content-Length", 0))
                 try:
-                    body = json.loads(self.rfile.read(n) or b"{}")
+                    with phase_timer(ServerQueryPhase.REQUEST_DESERIALIZATION, role="server"):
+                        body = json.loads(self.rfile.read(n) or b"{}")
                     out = svc.server.execute_partials(
                         body["table"],
                         body["sql"],
@@ -368,7 +438,8 @@ class ServerHTTPService:
                     self.end_headers()
                     self.wfile.write(payload)
                     return
-                payload = datatable.encode(out)
+                with phase_timer(ServerQueryPhase.RESPONSE_SERIALIZATION, role="server"):
+                    payload = datatable.encode(out)
                 self.send_response(200)
                 self.send_header("Content-Type", "application/x-pinot-datatable")
                 self.send_header("Content-Length", str(len(payload)))
@@ -381,6 +452,12 @@ class ServerHTTPService:
                     self.send_header("Content-Length", "2")
                     self.end_headers()
                     self.wfile.write(b"OK")
+                elif self.path == "/health/ready":
+                    _serve_ready(self, svc.server.readiness)
+                elif self.path.partition("?")[0] == "/debug/pprof":
+                    _serve_pprof(self)
+                elif self.path == "/debug/workload":
+                    _serve_workload(self)
                 elif self.path == "/debug/queries":
                     # ThreadResourceTracker/QueryResourceTracker REST parity
                     from pinot_tpu.common.accounting import default_accountant

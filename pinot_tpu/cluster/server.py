@@ -36,6 +36,9 @@ class Server:
         # query id -> Deadline of an in-flight query (cancellation fan-out
         # target; QueryThreadContext registry parity)
         self._running: dict[str, object] = {}
+        # in-flight Helix-style segment state transitions; non-zero means a
+        # segment is mid-load and /health/ready must answer 503
+        self._pending_transitions = 0
 
         self._fast32 = fast32
         self._scheduler = scheduler
@@ -104,6 +107,15 @@ class Server:
     # -- state transitions (Helix OFFLINE->ONLINE analog) --------------------
 
     def add_segment(self, table: str, segment_name: str, seg_dir: str | Path) -> None:
+        with self._lock:
+            self._pending_transitions += 1
+        try:
+            self._add_segment_inner(table, segment_name, seg_dir)
+        finally:
+            with self._lock:
+                self._pending_transitions -= 1
+
+    def _add_segment_inner(self, table: str, segment_name: str, seg_dir: str | Path) -> None:
         seg = load_segment(seg_dir)
         with self._lock:
             rt = self._realtime.get(table)
@@ -129,6 +141,27 @@ class Server:
     def segments_of(self, table: str) -> list[str]:
         with self._lock:
             return sorted(self._tables.get(table, {}))
+
+    def readiness(self) -> tuple[bool, dict]:
+        """(ready, per-component detail) for GET /health/ready — distinct
+        from liveness: a live server mid-way through segment loads or with a
+        stopped scheduler must not take traffic yet (the reference's
+        ServiceStatus readiness-check pattern: Helix state converged before
+        ONLINE). Components: segmentsLoaded (no in-flight state
+        transitions), mailboxRegistry (v2 shuffle registry serving),
+        scheduler (running, or inline when none is configured)."""
+        with self._lock:
+            pending = self._pending_transitions
+            sched = self._scheduler
+        components = {
+            "segmentsLoaded": {"ok": pending == 0, "pendingTransitions": pending},
+            "mailboxRegistry": {"ok": self.mailbox_registry is not None},
+            "scheduler": {
+                "ok": sched is None or bool(getattr(sched, "_running", True)),
+                "configured": sched is not None,
+            },
+        }
+        return all(c["ok"] for c in components.values()), components
 
     def get_segment_object(self, table: str, segment_name: str) -> ImmutableSegment | None:
         """Hand out a hosted segment for multistage leaf scans
@@ -326,14 +359,21 @@ class Server:
         scheduler configured, execution queues behind its policy; the caller
         blocks on the future (QueryScheduler.submit parity)."""
         if self._scheduler is not None:
+            from pinot_tpu.common.metrics import server_metrics
             from pinot_tpu.common.trace import ServerQueryPhase, active_trace
 
             trace = active_trace()
             t_sub = time.perf_counter()
 
             def run():
+                wait_ms = (time.perf_counter() - t_sub) * 1e3
                 if trace is not None:
-                    trace.record_phase(ServerQueryPhase.SCHEDULER_WAIT, (time.perf_counter() - t_sub) * 1e3)
+                    trace.record_phase(ServerQueryPhase.SCHEDULER_WAIT, wait_ms)
+                # aggregate phase timer: /metrics carries scheduler wait even
+                # for untraced queries (phase_timer role= parity)
+                server_metrics().timer(
+                    f"server.phase.{ServerQueryPhase.SCHEDULER_WAIT.value}Ms"
+                ).update_ms(wait_ms)
                 return self._execute_partials(table, sql, segment_names, hints)
 
             # the scheduler snapshots the submitting contextvars per job, so
@@ -382,6 +422,10 @@ class Server:
             # failover path (which matches on "unreachable") engages
             raise RuntimeError(f"server {self.server_id} unreachable: {e}") from None
         hints, deadline, broker_qid, tctx = self._pop_resilience_hints(hints)
+        # workload-attribution marker (rides hints like the resilience
+        # markers): the broker stamps the table's tenant so the accountant's
+        # per-(tenant, table) rollups attribute this query server-side
+        tenant = str(hints.pop("__tenant__", "") or "")
         local_tr = None
         if tctx is not None and active_trace() is None:
             # remote hop: the broker's trace context arrived over the wire;
@@ -396,18 +440,23 @@ class Server:
         segs = self._resolve_segments(table, segment_names)
         m = server_metrics()
         m.meter(ServerMeter.QUERIES).mark()
+        # labelled workload meter: per-table/tenant query counts on /metrics
+        # (`{table="...",tenant="..."}` series, reference table-suffix parity)
+        m.meter("server.tableQueries", table=table, tenant=tenant or "DefaultTenant").mark()
         qid = f"{self.server_id}-{next(_query_seq)}"
         self._register_query(broker_qid, deadline)
 
         def body():
-            with m.timer(ServerTimer.QUERY_EXECUTION).time(), default_accountant.scope(qid):
+            with m.timer(ServerTimer.QUERY_EXECUTION).time(), default_accountant.scope(
+                qid, table=table, tenant=tenant
+            ):
                 eng = self._engine(table)
-                with phase_timer(ServerQueryPhase.BUILD_QUERY_PLAN):
+                with phase_timer(ServerQueryPhase.BUILD_QUERY_PLAN, role="server"):
                     ctx = eng.make_context(sql)
                 if hints:
                     ctx.hints.update(hints)
                 ctx.deadline = deadline
-                with phase_timer(ServerQueryPhase.QUERY_PLAN_EXECUTION):
+                with phase_timer(ServerQueryPhase.QUERY_PLAN_EXECUTION, role="server"):
                     return eng.partials(ctx, segs)
 
         try:

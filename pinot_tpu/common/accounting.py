@@ -38,15 +38,50 @@ class QueryResourceTracker:
     segments_executed: int = 0
     killed: bool = False
     kill_reason: str = ""
+    #: workload-attribution dimensions (reference: table-suffixed metric
+    #: names + the tenant tag of PerQueryCPUMemAccountant); "" = unattributed
+    table: str = ""
+    tenant: str = ""
 
     def to_dict(self) -> dict:
-        return {
+        d = {
             "queryId": self.query_id,
             "cpuTimeNs": self.cpu_ns,
             "allocatedBytes": self.allocated_bytes,
             "segmentsExecuted": self.segments_executed,
             "ageSec": round(time.time() - self.start_ts, 3),
             "killed": self.killed,
+        }
+        if self.table:
+            d["table"] = self.table
+        if self.tenant:
+            d["tenant"] = self.tenant
+        return d
+
+
+@dataclass
+class WorkloadRollup:
+    """Lifetime per-(tenant, table) aggregate, folded in when each query's
+    tracker unregisters — the measurement substrate for quota tuning and
+    load shedding (ROADMAP item 2)."""
+
+    tenant: str
+    table: str
+    queries: int = 0
+    cpu_ns: int = 0
+    allocated_bytes: int = 0
+    segments_executed: int = 0
+    queries_killed: int = 0
+
+    def to_dict(self) -> dict:
+        return {
+            "tenant": self.tenant,
+            "table": self.table,
+            "queries": self.queries,
+            "cpuTimeNs": self.cpu_ns,
+            "allocatedBytes": self.allocated_bytes,
+            "segmentsExecuted": self.segments_executed,
+            "queriesKilled": self.queries_killed,
         }
 
 
@@ -63,39 +98,113 @@ class ResourceAccountant:
         self.heap_limit_bytes = heap_limit_bytes
         self.per_query_limit_bytes = per_query_limit_bytes
         self._queries: dict[str, QueryResourceTracker] = {}
+        #: thread ident -> in-flight query id, maintained by bind_thread/
+        #: _Scope so an *external* observer (the sampling profiler walking
+        #: sys._current_frames()) can attribute any thread's stack to its
+        #: query — the contextvar below is only readable from inside the
+        #: thread itself
+        self._threads: dict[int, str] = {}
+        #: (tenant, table) -> lifetime rollup; survives unregister
+        self._rollups: dict[tuple[str, str], WorkloadRollup] = {}
         self._lock = threading.Lock()
 
     # -- query lifecycle ----------------------------------------------------
 
-    def register(self, query_id: str) -> QueryResourceTracker:
+    def register(self, query_id: str, table: str = "", tenant: str = "") -> QueryResourceTracker:
         with self._lock:
             tr = self._queries.get(query_id)
             if tr is None:
                 tr = QueryResourceTracker(query_id)
                 self._queries[query_id] = tr
+            if table and not tr.table:
+                tr.table = table
+            if tenant and not tr.tenant:
+                tr.tenant = tenant
             return tr
 
     def unregister(self, query_id: str) -> None:
         with self._lock:
-            self._queries.pop(query_id, None)
+            tr = self._queries.pop(query_id, None)
+            if tr is not None:
+                key = (tr.tenant, tr.table)
+                r = self._rollups.get(key)
+                if r is None:
+                    r = self._rollups[key] = WorkloadRollup(tr.tenant, tr.table)
+                r.queries += 1
+                r.cpu_ns += tr.cpu_ns
+                r.allocated_bytes += tr.allocated_bytes
+                r.segments_executed += tr.segments_executed
+                r.queries_killed += 1 if tr.killed else 0
+
+    # -- thread attribution (read by common/profiler.py) --------------------
+
+    def bind_thread(self, query_id: str, ident: int | None = None) -> None:
+        with self._lock:
+            self._threads[ident if ident is not None else threading.get_ident()] = query_id
+
+    def unbind_thread(self, ident: int | None = None) -> None:
+        with self._lock:
+            self._threads.pop(ident if ident is not None else threading.get_ident(), None)
+
+    def thread_bindings(self) -> dict[int, str]:
+        """Snapshot of thread ident -> query id (profiler attribution map)."""
+        with self._lock:
+            return dict(self._threads)
 
     class _Scope:
+        def __init__(self, acct, query_id, table, tenant):
+            self._acct = acct
+            self._qid = query_id
+            self._table = table
+            self._tenant = tenant
+
+        def __enter__(self):
+            self._token = _current_query.set(self._qid)
+            # nesting: remember any outer binding on this thread so exit
+            # restores it instead of leaving the thread unattributed
+            self._prev = self._acct.thread_bindings().get(threading.get_ident())
+            self._acct.bind_thread(self._qid)
+            return self._acct.register(self._qid, table=self._table, tenant=self._tenant)
+
+        def __exit__(self, *exc):
+            _current_query.reset(self._token)
+            if self._prev is not None:
+                self._acct.bind_thread(self._prev)
+            else:
+                self._acct.unbind_thread()
+            self._acct.unregister(self._qid)
+            return False
+
+    def scope(self, query_id: str, table: str = "", tenant: str = "") -> "_Scope":
+        """Context manager: register + bind the query to this thread."""
+        return ResourceAccountant._Scope(self, query_id, table, tenant)
+
+    class _BindScope:
         def __init__(self, acct, query_id):
             self._acct = acct
             self._qid = query_id
 
         def __enter__(self):
-            self._token = _current_query.set(self._qid)
-            return self._acct.register(self._qid)
+            self._prev = self._acct.thread_bindings().get(threading.get_ident())
+            self._acct.bind_thread(self._qid)
+            return self
 
         def __exit__(self, *exc):
-            _current_query.reset(self._token)
-            self._acct.unregister(self._qid)
+            if self._prev is not None:
+                self._acct.bind_thread(self._prev)
+            else:
+                self._acct.unbind_thread()
             return False
 
-    def scope(self, query_id: str) -> "_Scope":
-        """Context manager: register + bind the query to this thread."""
-        return ResourceAccountant._Scope(self, query_id)
+    def bind_scope(self, query_id: str) -> "_BindScope":
+        """Context manager: profiler thread attribution only — binds the
+        query id to this thread without registering a tracker. The broker
+        wraps its whole request path in this so parse/plan/reduce samples
+        attribute to the query, while tracker registration (and the rollup
+        fold on exit) stays exclusively server-side — otherwise an
+        in-process broker+server pair sharing default_accountant would
+        double-count every query in /debug/workload."""
+        return ResourceAccountant._BindScope(self, query_id)
 
     # -- sampling (called by worker threads) --------------------------------
 
@@ -166,6 +275,35 @@ class ResourceAccountant:
     def query_trackers(self) -> list[dict]:
         with self._lock:
             return [t.to_dict() for t in self._queries.values()]
+
+    def workload_rollups(self, include_inflight: bool = True) -> list[dict]:
+        """Per-(tenant, table) lifetime rollups for GET /debug/workload,
+        sorted by cpu_ns descending. With `include_inflight` (the default)
+        still-registered queries are folded into a merged view so the
+        endpoint answers "who is eating the box *right now*" too."""
+        with self._lock:
+            merged: dict[tuple[str, str], WorkloadRollup] = {
+                k: WorkloadRollup(r.tenant, r.table, r.queries, r.cpu_ns,
+                                  r.allocated_bytes, r.segments_executed, r.queries_killed)
+                for k, r in self._rollups.items()
+            }
+            if include_inflight:
+                for tr in self._queries.values():
+                    key = (tr.tenant, tr.table)
+                    r = merged.get(key)
+                    if r is None:
+                        r = merged[key] = WorkloadRollup(tr.tenant, tr.table)
+                    r.queries += 1
+                    r.cpu_ns += tr.cpu_ns
+                    r.allocated_bytes += tr.allocated_bytes
+                    r.segments_executed += tr.segments_executed
+                    r.queries_killed += 1 if tr.killed else 0
+        return [r.to_dict() for r in sorted(merged.values(), key=lambda r: -r.cpu_ns)]
+
+    def reset_rollups(self) -> None:
+        """Test hook."""
+        with self._lock:
+            self._rollups.clear()
 
 
 # default process-wide accountant (no limits => tracking only)

@@ -34,6 +34,15 @@ class ObservabilityConfig:
     trace_sample_rate: float = 0.0
     #: ring-buffer capacity of Broker.traces (GET /debug/traces)
     trace_buffer_max_entries: int = 64
+    #: start the continuous sampling profiler (common/profiler.py) with the
+    #: service; /debug/pprof?seconds=N on-demand capture works either way
+    profiler_enabled: bool = False
+    #: sampling rate of the profiler daemon (prime default decorrelates from
+    #: round-millisecond workload periods — see profiler.py bias caveats)
+    profiler_hz: float = 31.0
+    #: continuous-ring capacity in distinct collapsed stacks; rarest half is
+    #: evicted (and counted) when full
+    profiler_ring_max_stacks: int = 2048
 
     def to_dict(self) -> dict:
         return {
@@ -41,6 +50,9 @@ class ObservabilityConfig:
             "slowQueryLogMaxEntries": self.slow_query_log_max_entries,
             "traceSampleRate": self.trace_sample_rate,
             "traceBufferMaxEntries": self.trace_buffer_max_entries,
+            "profilerEnabled": self.profiler_enabled,
+            "profilerHz": self.profiler_hz,
+            "profilerRingMaxStacks": self.profiler_ring_max_stacks,
         }
 
     @staticmethod
@@ -50,6 +62,9 @@ class ObservabilityConfig:
             d.get("slowQueryLogMaxEntries", 128),
             d.get("traceSampleRate", 0.0),
             d.get("traceBufferMaxEntries", 64),
+            d.get("profilerEnabled", False),
+            d.get("profilerHz", 31.0),
+            d.get("profilerRingMaxStacks", 2048),
         )
 
 

@@ -210,42 +210,82 @@ class Timer:
         return Timer._Ctx(self)
 
 
+def _escape_label_value(v: str) -> str:
+    # per the exposition format spec: backslash, double-quote and line feed
+    # are the only escapes inside a label value
+    return v.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
+def _label_key(k: str) -> str:
+    # label names share the metric-name charset minus the colon
+    return re.sub(r"[^a-zA-Z0-9_]", "_", str(k))
+
+
+def series_key(base: str, labels: dict | None) -> str:
+    """Canonical registry key for one (metric, labels) series: the base name
+    with a sorted, escaped `{k="v",...}` suffix. Two call sites passing the
+    same labels in any order resolve to the same underlying metric."""
+    if not labels:
+        return base
+    body = ",".join(
+        f'{_label_key(k)}="{_escape_label_value(str(v))}"' for k, v in sorted(labels.items())
+    )
+    return f"{base}{{{body}}}"
+
+
 class MetricsRegistry:
-    """Thread-safe name -> metric registry (PinotMetricsRegistry parity)."""
+    """Thread-safe name -> metric registry (PinotMetricsRegistry parity).
+
+    Metrics accept optional labels (`registry.meter("queries", table="t",
+    tenant="gold")`), the ServerMeter-with-table-suffix pattern of the
+    reference generalized to real Prometheus label pairs: each distinct
+    label set is its own series keyed by `series_key()`, rendered as
+    `{label="value"}` in the exposition."""
 
     def __init__(self, role: str = ""):
         self.role = role
         self._metrics: dict[str, object] = {}
+        #: series key -> (base name, labels) for labelled series only
+        self._labels: dict[str, tuple[str, dict]] = {}
         self._lock = threading.Lock()
 
-    def _get(self, name, cls):
-        key = name.value if isinstance(name, Enum) else str(name)
+    def _get(self, name, cls, labels: dict | None = None):
+        base = name.value if isinstance(name, Enum) else str(name)
+        key = series_key(base, labels)
         with self._lock:
             m = self._metrics.get(key)
             if m is None:
                 m = cls()
                 self._metrics[key] = m
+                if labels:
+                    self._labels[key] = (base, dict(labels))
             elif not isinstance(m, cls):
                 raise TypeError(f"metric {key} already registered as {type(m).__name__}")
             return m
 
-    def meter(self, name) -> Meter:
-        return self._get(name, Meter)
+    def series_labels(self, key: str) -> "tuple[str, dict]":
+        """(base name, labels) for a registry key; unlabelled -> (key, {})."""
+        with self._lock:
+            return self._labels.get(key, (key, {}))
 
-    def gauge(self, name) -> Gauge:
-        return self._get(name, Gauge)
+    def meter(self, name, **labels) -> Meter:
+        return self._get(name, Meter, labels)
 
-    def timer(self, name) -> Timer:
-        return self._get(name, Timer)
+    def gauge(self, name, **labels) -> Gauge:
+        return self._get(name, Gauge, labels)
 
-    def histogram(self, name) -> Histogram:
-        return self._get(name, Histogram)
+    def timer(self, name, **labels) -> Timer:
+        return self._get(name, Timer, labels)
+
+    def histogram(self, name, **labels) -> Histogram:
+        return self._get(name, Histogram, labels)
 
     def snapshot(self) -> dict:
         """Flat JSON-able dump (the JMX/exposition analog)."""
         out = {}
         with self._lock:
             items = list(self._metrics.items())
+            labelled = dict(self._labels)
         for k, m in items:
             if isinstance(m, Meter):
                 out[k] = {"type": "meter", "count": m.count}
@@ -271,6 +311,8 @@ class MetricsRegistry:
                     "p95Ms": m.quantile_ms(0.95),
                     "p99Ms": m.quantile_ms(0.99),
                 }
+            if k in labelled and k in out:
+                out[k]["labels"] = dict(labelled[k][1])
         return out
 
 
@@ -288,46 +330,62 @@ def _prom_num(v) -> str:
     return repr(float(v)) if isinstance(v, float) else str(v)
 
 
+def _prom_labels(labels: dict, **extra) -> str:
+    """`{k="v",...}` suffix with spec escaping; "" when no labels. `extra`
+    pairs (the histogram `le`) render after the sorted user labels."""
+    pairs = [
+        (_label_key(k), _escape_label_value(str(v))) for k, v in sorted(labels.items())
+    ] + [(k, _escape_label_value(str(v))) for k, v in extra.items()]
+    if not pairs:
+        return ""
+    return "{" + ",".join(f'{k}="{v}"' for k, v in pairs) + "}"
+
+
 def prometheus_text(registry: "MetricsRegistry") -> str:
     """Render one registry in the Prometheus text exposition format 0.0.4
     (the PinotMetricsRegistry -> JMX -> jmx_exporter chain collapsed to one
-    renderer). Meters become `_total` counters, gauges map directly, timers
-    and histograms expose `_count`/`_sum` plus `_p50`/`_p95`/`_p99` quantile
-    gauges; histograms additionally emit cumulative `_bucket{le=...}` series.
-    Durations stay in milliseconds — the metric names already carry the Ms
-    suffix."""
+    renderer). Meters become `_total` counters, gauges map directly; timers
+    and histograms are full histogram families — cumulative
+    `_bucket{le="..."}` series always terminated by a `+Inf` bucket equal to
+    `_count`, plus `_sum` and `_p50`/`_p95`/`_p99` quantile gauges. Labelled
+    series render `{label="value"}` pairs (escaped per the spec) and share
+    one `# TYPE` line per family. Durations stay in milliseconds — the
+    metric names already carry the Ms suffix."""
     with registry._lock:
         items = sorted(registry._metrics.items())
+        labelled = dict(registry._labels)
     lines: list[str] = []
+    typed: set[str] = set()
 
-    def _quantiles(name: str, m) -> None:
-        lines.append(f"# TYPE {name}_count counter")
-        lines.append(f"{name}_count {m.count}")
-        lines.append(f"# TYPE {name}_sum counter")
-        lines.append(f"{name}_sum {_prom_num(m.total_ms)}")
+    def _type(name: str, kind: str) -> None:
+        if name not in typed:
+            typed.add(name)
+            lines.append(f"# TYPE {name} {kind}")
+
+    def _hist_family(name: str, lbl: str, labels: dict, m, hist: Histogram) -> None:
+        _type(name, "histogram")
+        for bound, cum in hist.bucket_counts():
+            lines.append(f"{name}_bucket{_prom_labels(labels, le=_prom_num(bound))} {cum}")
+        lines.append(f"{name}_sum{lbl} {_prom_num(m.total_ms)}")
+        lines.append(f"{name}_count{lbl} {m.count}")
         for q, suffix in ((0.5, "p50"), (0.95, "p95"), (0.99, "p99")):
-            lines.append(f"# TYPE {name}_{suffix} gauge")
-            lines.append(f"{name}_{suffix} {_prom_num(m.quantile_ms(q))}")
+            _type(f"{name}_{suffix}", "gauge")
+            lines.append(f"{name}_{suffix}{lbl} {_prom_num(m.quantile_ms(q))}")
 
     for key, m in items:
-        name = _prom_name(key)
+        base, labels = labelled.get(key, (key, {}))
+        name = _prom_name(base)
+        lbl = _prom_labels(labels)
         if isinstance(m, Meter):
-            lines.append(f"# TYPE {name}_total counter")
-            lines.append(f"{name}_total {m.count}")
+            _type(f"{name}_total", "counter")
+            lines.append(f"{name}_total{lbl} {m.count}")
         elif isinstance(m, Gauge):
-            lines.append(f"# TYPE {name} gauge")
-            lines.append(f"{name} {_prom_num(m.value)}")
+            _type(name, "gauge")
+            lines.append(f"{name}{lbl} {_prom_num(m.value)}")
         elif isinstance(m, Timer):
-            _quantiles(name, m)
+            _hist_family(name, lbl, labels, m, m.hist)
         elif isinstance(m, Histogram):
-            lines.append(f"# TYPE {name} histogram")
-            for bound, cum in m.bucket_counts():
-                lines.append(f'{name}_bucket{{le="{_prom_num(bound)}"}} {cum}')
-            lines.append(f"{name}_sum {_prom_num(m.total_ms)}")
-            lines.append(f"{name}_count {m.count}")
-            for q, suffix in ((0.5, "p50"), (0.95, "p95"), (0.99, "p99")):
-                lines.append(f"# TYPE {name}_{suffix} gauge")
-                lines.append(f"{name}_{suffix} {_prom_num(m.quantile_ms(q))}")
+            _hist_family(name, lbl, labels, m, m)
     return "\n".join(lines) + "\n"
 
 

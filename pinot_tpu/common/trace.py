@@ -45,6 +45,11 @@ class ServerQueryPhase(Enum):
     QUERY_PLAN_EXECUTION = "queryPlanExecution"
     RESPONSE_SERIALIZATION = "responseSerialization"
     SCHEDULER_WAIT = "schedulerWait"
+    # broker/transport phases (BrokerQueryPhase parity) — one enum keeps the
+    # phaseTimesMs namespace flat across roles
+    REQUEST_COMPILATION = "requestCompilation"
+    BROKER_REDUCE = "brokerReduce"
+    MAILBOX_RECEIVE_WAIT = "mailboxReceiveWait"
 
 
 @dataclass
@@ -329,20 +334,30 @@ class InvocationScope:
 
 
 class phase_timer:
-    """Times one ServerQueryPhase into the active trace (TimerContext parity).
-    Always times; only records when tracing is active."""
+    """Times one ServerQueryPhase (TimerContext parity). Records into the
+    active trace's phaseTimesMs when tracing is on, and — when `role` is
+    given — unconditionally into that role's metrics registry as a
+    `<role>.phase.<phase>Ms` Timer, so `/metrics` answers "which phase ate
+    the budget" in aggregate even for untraced queries while `/debug/traces`
+    answers it per request."""
 
-    def __init__(self, phase: ServerQueryPhase):
+    def __init__(self, phase: ServerQueryPhase, role: str | None = None):
         self.phase = phase
+        self.role = role
 
     def __enter__(self):
         self._t0 = time.perf_counter()
         return self
 
     def __exit__(self, *exc):
+        ms = (time.perf_counter() - self._t0) * 1e3
         tr = _active.get()
         if tr is not None:
-            tr.record_phase(self.phase, (time.perf_counter() - self._t0) * 1e3)
+            tr.record_phase(self.phase, ms)
+        if self.role is not None:
+            from pinot_tpu.common.metrics import get_registry
+
+            get_registry(self.role).timer(f"{self.role}.phase.{self.phase.value}Ms").update_ms(ms)
         return False
 
 

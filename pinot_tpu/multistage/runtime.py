@@ -114,11 +114,17 @@ class MailboxService:
         An EOS may carry the sender's accumulated operator-stats records
         (("__eos__", [records]) — MultiStageQueryStats-in-trailing-block
         parity); they are appended to `stats_out` when the receiver collects."""
+        from pinot_tpu.common.trace import ServerQueryPhase, phase_timer
+
         q = self._q(recv_stage, recv_worker, send_stage)
         blocks: list[pd.DataFrame] = []
         eos = 0
         while eos < n_senders:
-            item = self._get_one(q, recv_stage, recv_worker, send_stage)
+            # transport-wait attribution: time blocked on upstream senders,
+            # separated from this stage's own compute in phaseTimesMs and the
+            # server.phase.mailboxReceiveWaitMs timer
+            with phase_timer(ServerQueryPhase.MAILBOX_RECEIVE_WAIT, role="server"):
+                item = self._get_one(q, recv_stage, recv_worker, send_stage)
             if item is _EOS or (isinstance(item, tuple) and item and item[0] == "__eos__"):
                 eos += 1
                 if stats_out is not None and isinstance(item, tuple) and len(item) > 1 and item[1]:

@@ -172,10 +172,18 @@ class QueryEngine:
             default_accountant.checkpoint()
             if ctx.deadline is not None:
                 ctx.deadline.check(f"segment {seg.name}")
+            # per-segment CPU attribution (ThreadResourceUsageAccountant
+            # sampleThreadCPUTime parity): thread_time_ns deltas exclude time
+            # this thread spent descheduled or blocked
+            t_cpu = time.thread_time_ns()
             with InvocationScope(f"segment:{seg.name}") as scope:
                 partial, matched = self._finish_segment(seg, ctx, disp)
                 scope.set_attr("numDocsMatched", int(matched))
-            default_accountant.sample(segments=1, allocated_bytes=seg.size_bytes)
+            default_accountant.sample(
+                segments=1,
+                allocated_bytes=seg.size_bytes,
+                cpu_ns=time.thread_time_ns() - t_cpu,
+            )
             out.append(partial)
             scanned += int(matched)
         m = server_metrics()

@@ -1,0 +1,439 @@
+"""Continuous profiling & workload-attribution plane tests: sampling
+profiler capture/attribution, labelled-metric exposition + escaping, timer
+bucket exposition invariants, /debug/workload rollups, /health/ready
+transitions, and phase-time attribution. Deterministic: profiler ticks are
+driven explicitly via sample_once(); the only real-time wait is the one
+bounded /debug/pprof?seconds=N capture window."""
+
+import json
+import re
+import threading
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from pinot_tpu.cluster import Broker, Controller, PropertyStore, Server
+from pinot_tpu.common import DataType, Schema, TableConfig
+from pinot_tpu.common.accounting import ResourceAccountant, default_accountant
+from pinot_tpu.common.metrics import MetricsRegistry, prometheus_text
+from pinot_tpu.common.profiler import SamplingProfiler, fold_stack, reset_profiler
+from pinot_tpu.segment import SegmentBuilder
+
+
+def _http_get(url: str):
+    try:
+        with urllib.request.urlopen(url, timeout=15) as r:
+            return r.status, r.read()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read()
+
+
+def _busy_thread(acct, qid: str):
+    """Start a worker spinning in pure Python under acct.scope(qid). Returns
+    (thread, stop_event) once the accountant binding is visible."""
+    stop = threading.Event()
+    bound = threading.Event()
+
+    def busy():
+        with acct.scope(qid):
+            bound.set()
+            while not stop.is_set():
+                sum(range(200))
+
+    t = threading.Thread(target=busy, name="busy-query", daemon=True)
+    t.start()
+    assert bound.wait(timeout=10)
+    return t, stop
+
+
+# -- profiler core -----------------------------------------------------------
+
+
+def test_fold_stack_shape():
+    import sys
+
+    frame = sys._current_frames()[threading.get_ident()]
+    folded = fold_stack(frame)
+    parts = folded.split(";")
+    assert parts[-1] == "test_profiling:test_fold_stack_shape"
+    assert all(":" in p for p in parts)
+
+
+def test_profiler_attribution_deterministic():
+    """Busy-loop query thread bound via the accountant scope: >=90% of the
+    samples landing in the busy function carry its query id (acceptance
+    criterion), with ticks driven explicitly — no wall-clock sampling."""
+    acct = ResourceAccountant()
+    prof = SamplingProfiler(accountant=acct)
+    t, stop = _busy_thread(acct, "q-busy-1")
+    try:
+        for _ in range(25):
+            prof.sample_once()
+    finally:
+        stop.set()
+        t.join(timeout=10)
+    doc = prof.profile()
+    assert doc["samples"] >= 25  # busy thread sampled at every tick
+    busy = [s for s in doc["stacks"] if any(f.endswith(":busy") for f in s["stack"])]
+    total = sum(s["count"] for s in busy)
+    attributed = sum(s["count"] for s in busy if s["queryId"] == "q-busy-1")
+    assert total >= 25
+    assert attributed >= 0.9 * total
+    # collapsed text roots attributed samples under a query frame
+    text = SamplingProfiler.collapsed_text(doc)
+    assert re.search(r"^query:q-busy-1;.* \d+$", text, re.M)
+
+
+def test_profiler_scope_nesting_restores_binding():
+    acct = ResourceAccountant()
+    ident = threading.get_ident()
+    with acct.scope("outer"):
+        assert acct.thread_bindings()[ident] == "outer"
+        with acct.scope("inner"):
+            assert acct.thread_bindings()[ident] == "inner"
+        assert acct.thread_bindings()[ident] == "outer"
+    assert ident not in acct.thread_bindings()
+
+
+def test_profiler_ring_eviction_bounded():
+    acct = ResourceAccountant()
+    prof = SamplingProfiler(accountant=acct, ring_max_stacks=8)
+    with prof._lock:
+        for i in range(50):
+            prof._ring[(f"q{i}", f"a:b;c:d{i}")] = 1 + (i % 3)
+        prof._evict_locked()
+    doc = prof.profile()
+    assert len(doc["stacks"]) <= 8
+    assert doc["droppedStacks"] >= 42
+
+
+def test_profiler_daemon_start_stop():
+    prof = SamplingProfiler(hz=200.0)
+    prof.start()
+    try:
+        assert prof.running
+        prof.start()  # idempotent
+    finally:
+        prof.stop()
+    assert not prof.running
+
+
+# -- labelled metrics ---------------------------------------------------------
+
+
+def test_labelled_metrics_same_series_any_order():
+    reg = MetricsRegistry("test")
+    reg.meter("queries", table="t1", tenant="gold").mark(2)
+    reg.meter("queries", tenant="gold", table="t1").mark()
+    assert reg.meter("queries", table="t1", tenant="gold").count == 3
+    # distinct label values are distinct series
+    reg.meter("queries", table="t2", tenant="gold").mark()
+    assert reg.meter("queries", table="t2", tenant="gold").count == 1
+
+
+def test_labelled_exposition_rendering_and_escaping():
+    reg = MetricsRegistry("test")
+    reg.meter("queries", table='we"ird\\t\nbl', tenant="gold").mark(2)
+    reg.meter("queries", table="plain", tenant="gold").mark(5)
+    reg.gauge("depth", queue="p1").set(7)
+    text = prometheus_text(reg)
+    # spec escaping: backslash, double quote, newline
+    assert 'pinot_queries_total{table="we\\"ird\\\\t\\nbl",tenant="gold"} 2' in text
+    assert 'pinot_queries_total{table="plain",tenant="gold"} 5' in text
+    assert 'pinot_depth{queue="p1"} 7' in text
+    # one TYPE line per family even with multiple labelled series
+    assert text.count("# TYPE pinot_queries_total counter") == 1
+    # every non-comment line still matches the exposition grammar
+    line_re = re.compile(r'^[a-zA-Z_:][a-zA-Z0-9_:]*(\{([a-zA-Z0-9_]+="(\\.|[^"\\])*",?)*\})? \S+$')
+    for line in text.strip().splitlines():
+        if not line.startswith("#"):
+            assert line_re.match(line), line
+
+
+def test_labelled_snapshot_carries_labels():
+    reg = MetricsRegistry("test")
+    reg.meter("queries", table="t1").mark()
+    snap = reg.snapshot()
+    (key,) = [k for k in snap if k.startswith("queries{")]
+    assert snap[key]["labels"] == {"table": "t1"}
+
+
+# -- timer/histogram bucket exposition ---------------------------------------
+
+
+def _parse_buckets(text: str, family: str):
+    pat = re.compile(rf'^{family}_bucket\{{le="([^"]+)"\}} (\d+)$', re.M)
+    return [(float("inf") if le == "+Inf" else float(le), int(c)) for le, c in pat.findall(text)]
+
+
+def test_timer_bucket_exposition_scraper_invariants():
+    """Timers now expose a full cumulative histogram family; verify the
+    invariants a scraper relies on: non-decreasing cumulative counts, a
+    trailing +Inf bucket equal to _count, and the bucket-bounded sum
+    estimate bracketing the exact _sum."""
+    reg = MetricsRegistry("test")
+    t = reg.timer("latMs")
+    values = [0.02, 0.5, 3.0, 3.1, 47.0, 512.0, 10_000.0]
+    for v in values:
+        t.update_ms(v)
+    text = prometheus_text(reg)
+    buckets = _parse_buckets(text, "pinot_latMs")
+    assert buckets, text
+    les = [le for le, _ in buckets]
+    cums = [c for _, c in buckets]
+    assert les == sorted(les) and les[-1] == float("inf")
+    assert cums == sorted(cums)  # cumulative counts never decrease
+    assert cums[-1] == len(values)
+    assert f"pinot_latMs_count {len(values)}" in text
+    # scraper-side sum invariant: per-bucket counts weighted by bucket upper
+    # (lower) bounds bound the exact _sum from above (below)
+    diffs = [(les[i], cums[i] - (cums[i - 1] if i else 0)) for i in range(len(buckets))]
+    finite = [d for d in diffs if d[0] != float("inf")]
+    assert sum(c for le, c in diffs if le == float("inf")) == 0  # all values bucketed
+    upper = sum(le * c for le, c in finite)
+    lowers = [0.0] + les[:-1]
+    lower = sum(lowers[i] * diffs[i][1] for i in range(len(finite)))
+    exact = sum(values)
+    assert lower <= exact <= upper, (lower, exact, upper)
+
+
+def test_empty_timer_still_emits_inf_bucket():
+    reg = MetricsRegistry("test")
+    reg.timer("coldMs")
+    text = prometheus_text(reg)
+    assert 'pinot_coldMs_bucket{le="+Inf"} 0' in text
+    assert "pinot_coldMs_count 0" in text
+
+
+# -- workload rollups ---------------------------------------------------------
+
+
+def test_workload_rollups_fold_on_unregister():
+    acct = ResourceAccountant()
+    with acct.scope("q1", table="t", tenant="gold"):
+        acct.sample(cpu_ns=1000, allocated_bytes=500, segments=2)
+    with acct.scope("q2", table="t", tenant="gold"):
+        acct.sample(cpu_ns=500, allocated_bytes=100, segments=1)
+    with acct.scope("q3", table="u", tenant="silver"):
+        acct.sample(cpu_ns=9000, allocated_bytes=50, segments=1)
+    rollups = {(r["tenant"], r["table"]): r for r in acct.workload_rollups()}
+    gold = rollups[("gold", "t")]
+    assert gold["queries"] == 2
+    assert gold["cpuTimeNs"] == 1500
+    assert gold["allocatedBytes"] == 600
+    assert gold["segmentsExecuted"] == 3
+    assert rollups[("silver", "u")]["cpuTimeNs"] == 9000
+    # sorted by cpu_ns descending
+    assert acct.workload_rollups()[0]["tenant"] == "silver"
+
+
+def test_workload_rollups_include_inflight():
+    acct = ResourceAccountant()
+    acct.register("q-live", table="t", tenant="gold")
+    acct.sample(query_id="q-live", cpu_ns=77, allocated_bytes=11)
+    (r,) = acct.workload_rollups()
+    assert r["queries"] == 1 and r["cpuTimeNs"] == 77
+    assert acct.workload_rollups(include_inflight=False) == []
+    acct.unregister("q-live")
+    (r,) = acct.workload_rollups(include_inflight=False)
+    assert r["cpuTimeNs"] == 77 and r["allocatedBytes"] == 11
+
+
+# -- end-to-end: cluster fixtures --------------------------------------------
+
+
+@pytest.fixture()
+def small_cluster(tmp_path):
+    controller = Controller(PropertyStore(), tmp_path / "deepstore")
+    server = Server("server_0")
+    controller.register_server("server_0", server)
+    schema = Schema.build("t", dimensions=[("d", DataType.INT)], metrics=[("v", DataType.LONG)])
+    controller.add_schema(schema)
+    controller.add_table(TableConfig("t"))
+    b = SegmentBuilder(schema)
+    for i in range(3):
+        controller.upload_segment(
+            "t",
+            b.build({"d": np.arange(64, dtype=np.int32), "v": np.arange(64, dtype=np.int64)}, f"t_{i}"),
+        )
+    return controller, Broker(controller), server
+
+
+def test_debug_workload_consistent_with_trackers(small_cluster):
+    """Acceptance: /debug/workload rollups agree with what the accountant's
+    per-query trackers accumulated for the queries just executed."""
+    from pinot_tpu.cluster.http import ServerHTTPService
+
+    controller, broker, server = small_cluster
+    default_accountant.reset_rollups()
+    assert broker.execute("SELECT COUNT(*) FROM t").rows[0][0] == 192
+    assert broker.execute("SELECT SUM(v) FROM t").rows[0][0] == int(np.arange(64).sum()) * 3
+    svc = ServerHTTPService(server, port=0)
+    try:
+        status, body = _http_get(f"http://127.0.0.1:{svc.port}/debug/workload")
+    finally:
+        svc.stop()
+    assert status == 200
+    rollups = {(r["tenant"], r["table"]): r for r in json.loads(body)["rollups"]}
+    r = rollups[("DefaultTenant", "t")]
+    assert r["queries"] == 2
+    assert r["segmentsExecuted"] == 6  # 3 segments x 2 queries
+    # bytes attribution matches the trackers' per-segment size sampling
+    seg_bytes = sum(
+        server.get_segment_object("t", name).size_bytes for name in server.segments_of("t")
+    )
+    assert r["allocatedBytes"] == 2 * seg_bytes
+    assert r["cpuTimeNs"] >= 0
+
+
+def test_phase_timers_in_metrics_and_trace(small_cluster):
+    """Phase-time attribution: per-phase Timers land in the role registries
+    for every query, and phaseTimesMs on the trace for sampled ones."""
+    from pinot_tpu.common.metrics import get_registry
+
+    _, broker, _ = small_cluster
+    res = broker.execute("SET trace = 'true'; SELECT COUNT(*) FROM t")
+    assert res.trace is not None
+    phases = res.trace["phaseTimesMs"]
+    assert "brokerReduce" in phases
+    assert "requestCompilation" in phases
+    broker_reg = get_registry("broker")
+    assert broker_reg.timer("broker.phase.requestCompilationMs").count >= 1
+    assert broker_reg.timer("broker.phase.brokerReduceMs").count >= 1
+    server_reg = get_registry("server")
+    assert server_reg.timer("server.phase.queryPlanExecutionMs").count >= 1
+    assert server_reg.timer("server.phase.buildQueryPlanMs").count >= 1
+
+
+def test_labelled_table_meters_marked(small_cluster):
+    from pinot_tpu.common.metrics import get_registry
+
+    _, broker, _ = small_cluster
+    before = get_registry("broker").meter("broker.tableQueries", table="t", tenant="DefaultTenant").count
+    broker.execute("SELECT COUNT(*) FROM t")
+    after = get_registry("broker").meter("broker.tableQueries", table="t", tenant="DefaultTenant").count
+    assert after == before + 1
+    assert get_registry("server").meter("server.tableQueries", table="t", tenant="DefaultTenant").count >= 1
+    text = prometheus_text(get_registry("broker"))
+    assert re.search(r'pinot_broker_tableQueries_total\{table="t",tenant="DefaultTenant"\} \d+', text)
+
+
+def test_pprof_http_capture_attributes_running_query(small_cluster):
+    """Acceptance: GET /debug/pprof?seconds=N during a running query returns
+    collapsed stacks with >=90% of the in-query samples attributed to that
+    query id. The busy worker binds through default_accountant exactly like
+    Server._execute_partials does. This is the suite's one bounded real-time
+    capture window."""
+    from pinot_tpu.cluster.http import ServerHTTPService
+
+    _, _, server = small_cluster
+    reset_profiler()
+    t, stop = _busy_thread(default_accountant, "q-live-7")
+    svc = ServerHTTPService(server, port=0)
+    try:
+        status, body = _http_get(
+            f"http://127.0.0.1:{svc.port}/debug/pprof?seconds=0.5&format=json"
+        )
+        assert status == 200
+        doc = json.loads(body)
+        assert doc["kind"] == "window" and doc["samples"] > 0
+        busy = [s for s in doc["stacks"] if any(f.endswith(":busy") for f in s["stack"])]
+        total = sum(s["count"] for s in busy)
+        attributed = sum(s["count"] for s in busy if s["queryId"] == "q-live-7")
+        assert total > 0
+        assert attributed >= 0.9 * total
+        # default rendering is collapsed-stack text over the continuous ring
+        status, body = _http_get(f"http://127.0.0.1:{svc.port}/debug/pprof")
+        assert status == 200
+        status, _ = _http_get(f"http://127.0.0.1:{svc.port}/debug/pprof?seconds=bogus")
+        assert status == 400
+    finally:
+        stop.set()
+        t.join(timeout=10)
+        svc.stop()
+        reset_profiler()
+
+
+# -- readiness ----------------------------------------------------------------
+
+
+def test_health_ready_transitions(tmp_path):
+    """Liveness vs readiness: /health answers 200 from bind time, while
+    /health/ready flips 503 -> 200 as components converge (and back)."""
+    from pinot_tpu.cluster.http import BrokerHTTPService, ServerHTTPService
+
+    controller = Controller(PropertyStore(), tmp_path / "deepstore")
+    broker = Broker(controller)
+    bsvc = BrokerHTTPService(broker, port=0)
+    try:
+        status, _ = _http_get(f"http://127.0.0.1:{bsvc.port}/health")
+        assert status == 200  # live immediately
+        status, body = _http_get(f"http://127.0.0.1:{bsvc.port}/health/ready")
+        assert status == 503  # no servers registered yet
+        doc = json.loads(body)
+        assert doc["status"] == "not ready"
+        assert doc["components"]["servers"]["ok"] is False
+        server = Server("server_0")
+        controller.register_server("server_0", server)
+        status, body = _http_get(f"http://127.0.0.1:{bsvc.port}/health/ready")
+        assert status == 200
+        assert json.loads(body)["components"]["servers"]["registered"] == 1
+    finally:
+        bsvc.stop()
+
+    ssvc = ServerHTTPService(server, port=0)
+    try:
+        status, body = _http_get(f"http://127.0.0.1:{ssvc.port}/health/ready")
+        assert status == 200
+        assert json.loads(body)["components"]["segmentsLoaded"]["ok"] is True
+        # a segment mid-load (in-flight Helix transition) flips readiness
+        with server._lock:
+            server._pending_transitions += 1
+        try:
+            status, body = _http_get(f"http://127.0.0.1:{ssvc.port}/health/ready")
+            assert status == 503
+            doc = json.loads(body)
+            assert doc["components"]["segmentsLoaded"] == {"ok": False, "pendingTransitions": 1}
+        finally:
+            with server._lock:
+                server._pending_transitions -= 1
+        status, _ = _http_get(f"http://127.0.0.1:{ssvc.port}/health/ready")
+        assert status == 200
+    finally:
+        ssvc.stop()
+
+
+# -- config -------------------------------------------------------------------
+
+
+def test_profiler_enabled_config_starts_continuous_profiler(tmp_path):
+    from pinot_tpu.common.config import ObservabilityConfig
+    from pinot_tpu.common.profiler import get_profiler
+
+    reset_profiler()
+    try:
+        Broker(
+            Controller(PropertyStore(), tmp_path / "deepstore"),
+            obs_config=ObservabilityConfig(profiler_enabled=True, profiler_hz=200.0),
+        )
+        prof = get_profiler()
+        assert prof.running and prof.hz == 200.0
+    finally:
+        reset_profiler()
+    # default config leaves the profiler off
+    Broker(Controller(PropertyStore(), tmp_path / "deepstore2"))
+    assert not get_profiler().running
+
+
+def test_observability_config_profiler_roundtrip():
+    from pinot_tpu.common.config import ObservabilityConfig
+
+    cfg = ObservabilityConfig(profiler_enabled=True, profiler_hz=7.0, profiler_ring_max_stacks=99)
+    d = cfg.to_dict()
+    assert d["profilerEnabled"] is True and d["profilerHz"] == 7.0
+    back = ObservabilityConfig.from_dict(d)
+    assert back.profiler_enabled and back.profiler_hz == 7.0
+    assert back.profiler_ring_max_stacks == 99
+    assert ObservabilityConfig.from_dict({}).profiler_enabled is False
