@@ -394,6 +394,140 @@ def _smoke_test(schema, mesh, rng):
     log("pre-flight smoke test OK (4 sharded query shapes compiled+ran)")
 
 
+def qps_main():
+    """`bench.py qps`: the QPS measurement plane (ROADMAP item 2 baseline).
+
+    Drives 100s of concurrent HTTP clients against a local controller + 2
+    servers + broker cluster and reports p50/p99/throughput/error-rate twice
+    over: once from the broker's own `broker.queryTotalMs` histogram (what
+    the federated SLO plane sees) and once from client-side wall timing
+    (what users see) — the two p99s must agree within ~20% or the broker's
+    self-reported SLO series can't be trusted for admission-control tuning.
+    Writes BENCH_qps_r08.json and prints the same JSON line.
+
+    Env knobs: PINOT_TPU_QPS_CLIENTS (128), PINOT_TPU_QPS_QUERIES (10 per
+    client), PINOT_TPU_QPS_ROWS (120_000 total)."""
+    import shutil
+    import tempfile
+    import threading
+
+    import pinot_tpu  # noqa: F401  (x64 + platform setup)
+    from pinot_tpu.common import DataType, Schema, TableConfig
+    from pinot_tpu.common.metrics import broker_metrics, reset_registries
+    from pinot_tpu.cluster import Broker, Controller, PropertyStore, Server
+    from pinot_tpu.cluster.http import BrokerHTTPService, query_broker_http
+    from pinot_tpu.segment import SegmentBuilder
+
+    n_clients = int(os.environ.get("PINOT_TPU_QPS_CLIENTS", 128))
+    per_client = int(os.environ.get("PINOT_TPU_QPS_QUERIES", 10))
+    n_rows = int(os.environ.get("PINOT_TPU_QPS_ROWS", 120_000))
+
+    root = tempfile.mkdtemp(prefix="pinot_tpu_qps_")
+    store = PropertyStore()
+    controller = Controller(store, os.path.join(root, "deepstore"))
+    for i in range(2):
+        controller.register_server(f"server_{i}", Server(f"server_{i}"))
+    schema = Schema.build(
+        "lineorder",
+        dimensions=[("region", DataType.STRING), ("year", DataType.INT)],
+        metrics=[("revenue", DataType.LONG)],
+    )
+    controller.add_schema(schema)
+    controller.add_table(TableConfig("lineorder", replication=2))
+    rng = np.random.default_rng(8)
+    builder = SegmentBuilder(schema)
+    seg_rows = n_rows // 4
+    for i in range(4):
+        data = {
+            "region": np.array(["AFRICA", "AMERICA", "ASIA", "EUROPE"], dtype=object)[
+                rng.integers(0, 4, seg_rows)
+            ],
+            "year": rng.integers(1992, 1999, seg_rows).astype(np.int32),
+            "revenue": rng.integers(100, 600_000, seg_rows).astype(np.int64),
+        }
+        controller.upload_segment("lineorder", builder.build(data, f"lineorder_{i}"))
+    broker = Broker(controller)
+    bsvc = BrokerHTTPService(broker, port=0)
+    base_url = f"http://127.0.0.1:{bsvc.port}"
+    controller.register_broker("broker_0", "127.0.0.1", bsvc.port)
+
+    queries = [
+        "SELECT COUNT(*) FROM lineorder WHERE year > 1994",
+        "SELECT region, SUM(revenue) FROM lineorder GROUP BY region ORDER BY SUM(revenue) DESC LIMIT 4",
+    ]
+    for q in queries:  # compile/JIT warmup outside the measured window
+        query_broker_http(base_url, q)
+    log(f"qps warmup done; driving {n_clients} clients x {per_client} queries")
+    reset_registries()  # broker histogram covers exactly the measured run
+
+    lat_ms: list = []
+    errors: list = []
+    lock = threading.Lock()
+    barrier = threading.Barrier(n_clients + 1)
+
+    def client(idx: int) -> None:
+        mine, bad = [], 0
+        barrier.wait()
+        for j in range(per_client):
+            q = queries[(idx + j) % len(queries)]
+            t0 = time.perf_counter()
+            try:
+                res = query_broker_http(base_url, q)
+                if res.get("exceptions"):
+                    bad += 1
+            except Exception:
+                bad += 1
+            mine.append((time.perf_counter() - t0) * 1e3)
+        with lock:
+            lat_ms.extend(mine)
+            errors.append(bad)
+
+    threads = [threading.Thread(target=client, args=(i,), daemon=True) for i in range(n_clients)]
+    for t in threads:
+        t.start()
+    barrier.wait()
+    t_run = time.perf_counter()
+    for t in threads:
+        t.join()
+    wall_s = time.perf_counter() - t_run
+    bsvc.stop()
+    shutil.rmtree(root, ignore_errors=True)
+
+    total = n_clients * per_client
+    n_errors = sum(errors)
+    timer = broker_metrics().timer("broker.queryTotalMs")
+    client_p50 = float(np.percentile(lat_ms, 50))
+    client_p99 = float(np.percentile(lat_ms, 99))
+    broker_p50 = timer.quantile_ms(0.5)
+    broker_p99 = timer.quantile_ms(0.99)
+    result = {
+        "metric": "qps_concurrent_serving",
+        "clients": n_clients,
+        "queries": total,
+        "rows": seg_rows * 4,
+        "wall_s": round(wall_s, 3),
+        "throughput_qps": round(total / wall_s, 2),
+        "error_rate": n_errors / total,
+        "broker_histogram": {
+            "count": timer.count,
+            "p50_ms": round(broker_p50, 3),
+            "p99_ms": round(broker_p99, 3),
+            "mean_ms": round(timer.mean_ms(), 3),
+        },
+        "client_side": {
+            "count": len(lat_ms),
+            "p50_ms": round(client_p50, 3),
+            "p99_ms": round(client_p99, 3),
+        },
+        # broker-vs-client agreement: the acceptance gate is |1 - ratio| <= 0.2
+        "p99_agreement": round(broker_p99 / client_p99, 4) if client_p99 else None,
+    }
+    with open("BENCH_qps_r08.json", "w") as f:
+        json.dump(result, f, indent=2)
+        f.write("\n")
+    print(json.dumps(result))
+
+
 def main():
     import pinot_tpu  # noqa: F401  (x64 + platform setup)
 
@@ -677,6 +811,9 @@ def _bench_config5(rng, n, iters):
 
 if __name__ == "__main__":
     try:
+        if len(sys.argv) > 1 and sys.argv[1] == "qps":
+            qps_main()
+            sys.exit(0)
         main()
     except Exception as e:  # emit evidence even on unrecoverable failure
         log(traceback.format_exc())

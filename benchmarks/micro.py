@@ -523,6 +523,163 @@ def bench_profiler_overhead(n=200_000, dim=2_000):
     }
 
 
+def bench_slo_overhead(cycles=200):
+    """SloEvaluator cost per scrape cycle with a full long-window history
+    (360 samples at the 10s default interval), a cluster scope plus 8
+    per-table override scopes, and both objective kinds active. The SLO
+    plane runs on the controller's periodic thread, never the query hot
+    path, so the budget is against the scrape interval: one observe+evaluate
+    must stay under 2% of it."""
+    from pinot_tpu.common.slo import SloEvaluator
+
+    clock = {"t": 0.0}
+    ev = SloEvaluator(
+        {
+            "availability": 0.999,
+            "p99LatencyMs": 100.0,
+            "tables": {f"t{i}": {"p99LatencyMs": 50.0} for i in range(8)},
+        },
+        now_fn=lambda: clock["t"],
+    )
+    bounds = [0.5 * 2**i for i in range(20)] + [float("inf")]
+
+    def sample(i):
+        q = 1000 * (i + 1)
+        buckets = [(b, min(q, q * (j + 1) // len(bounds))) for j, b in enumerate(bounds)]
+        tables = {
+            f"t{k}": {"queries": q // 8, "errors": i, "latencyBuckets": buckets} for k in range(8)
+        }
+        return {
+            "queries": q,
+            "errors": i,
+            "latencyBuckets": buckets,
+            "tables": tables,
+            "exemplars": [{"traceId": f"tr{i}", "table": "t0", "timeMs": 120.0}],
+        }
+
+    for i in range(360):  # fill the long window: worst-case history scan
+        clock["t"] += 10.0
+        ev.observe(sample(i))
+    t0 = time.perf_counter()
+    for i in range(360, 360 + cycles):
+        clock["t"] += 10.0
+        ev.observe(sample(i))
+    per_cycle_ms = (time.perf_counter() - t0) / cycles * 1e3
+    interval_ms = 10_000.0
+    projected_pct = per_cycle_ms / interval_ms * 100
+    assert projected_pct < 2.0, (
+        f"SLO evaluation {per_cycle_ms:.2f}ms/cycle = {projected_pct:.2f}% of the "
+        f"{interval_ms:.0f}ms scrape interval — over the 2% budget"
+    )
+    return {
+        "metric": "slo_overhead",
+        "value": round(per_cycle_ms, 3),
+        "unit": "ms",
+        "cycles": cycles,
+        "history": 360,
+        "scopes": 9,
+        "projected_pct_of_interval": round(projected_pct, 3),
+    }
+
+
+def bench_aggregator_scrape(cycles=50):
+    """Full ClusterMetricsAggregator cycle over 2 brokers + 6 servers with 16
+    labelled tables each: fetch (injected, includes the nodes' snapshot
+    serialization — normally paid node-side, so this over-counts), fold with
+    counter-reset detection, cross-node histogram merge, gauge publication,
+    and SLO evaluation. Budget: one cycle under 2% of the 10s scrape
+    interval, i.e. the aggregator thread stays >98% idle."""
+    import tempfile
+
+    from pinot_tpu.cluster.controller import Controller
+    from pinot_tpu.cluster.metadata import PropertyStore
+    from pinot_tpu.cluster.periodic import ClusterMetricsAggregator
+    from pinot_tpu.common.metrics import MetricsRegistry
+
+    controller = Controller(PropertyStore(), tempfile.mkdtemp(prefix="aggbench_"))
+    regs: dict = {}
+    for i in range(2):
+        controller.register_broker(f"broker-{i}", f"broker-{i}", 80)
+        regs[f"broker-{i}"] = (MetricsRegistry("broker"), "broker")
+    for i in range(6):
+        controller.store.set(
+            f"/instances/server-{i}", {"host": f"server-{i}", "port": 80, "alive": True, "tags": []}
+        )
+        regs[f"server-{i}"] = (MetricsRegistry("server"), "server")
+
+    rng = np.random.default_rng(8)
+
+    def tick(reg, role):
+        if role == "broker":
+            reg.meter("broker.queries").mark(50)
+            t = reg.timer("broker.queryTotalMs")
+            for v in rng.uniform(1, 200, 50):
+                t.update_ms(float(v))
+            for k in range(16):
+                reg.meter("broker.tableQueries", table=f"t{k}", tenant="g").mark(3)
+                reg.timer("broker.tableLatencyMs", table=f"t{k}").update_ms(float(rng.uniform(1, 200)))
+        else:
+            reg.meter("server.queries").mark(50)
+            t = reg.timer("server.queryExecutionMs")
+            for v in rng.uniform(0.5, 100, 50):
+                t.update_ms(float(v))
+
+    def fetch(url):
+        rest = url.split("//", 1)[1]
+        hostport, _, path = rest.partition("/")
+        nid = hostport.split(":")[0]
+        reg, role = regs[nid]
+        if path.startswith("metrics"):
+            return json.dumps(reg.snapshot())
+        if path.startswith("debug/workload"):
+            return json.dumps(
+                {
+                    "rollups": [
+                        {
+                            "tenant": "g",
+                            "table": f"t{k}",
+                            "queries": 10,
+                            "cpuTimeNs": 1000,
+                            "allocatedBytes": 0,
+                            "segmentsExecuted": 4,
+                            "queriesKilled": 0,
+                        }
+                        for k in range(16)
+                    ]
+                }
+            )
+        return json.dumps([{"traceId": "tr", "table": "t0", "timeMs": 120.0, "sql": "SELECT 1"}])
+
+    agg = ClusterMetricsAggregator(
+        controller, fetch=fetch, objectives={"availability": 0.999, "p99LatencyMs": 500.0}
+    )
+    for reg, role in regs.values():
+        tick(reg, role)
+    agg.run_once()  # warmup fold (first-scrape baseline capture)
+    total = 0.0
+    for _ in range(cycles):
+        for reg, role in regs.values():
+            tick(reg, role)
+        t0 = time.perf_counter()
+        agg.run_once()
+        total += time.perf_counter() - t0
+    per_cycle_ms = total / cycles * 1e3
+    interval_ms = agg.interval_sec * 1e3
+    projected_pct = per_cycle_ms / interval_ms * 100
+    assert projected_pct < 2.0, (
+        f"aggregator cycle {per_cycle_ms:.2f}ms = {projected_pct:.2f}% of the "
+        f"{interval_ms:.0f}ms scrape interval — over the 2% budget"
+    )
+    return {
+        "metric": "aggregator_scrape",
+        "value": round(per_cycle_ms, 3),
+        "unit": "ms",
+        "cycles": cycles,
+        "nodes": len(regs),
+        "projected_pct_of_interval": round(projected_pct, 3),
+    }
+
+
 def bench_lint_runtime():
     """pinotlint must stay fast enough to sit in tier-1 and CI: a whole-package
     run (all five checkers, ~200 modules) is asserted under the 10s budget on
@@ -560,6 +717,8 @@ ALL = [
     bench_deadline_overhead,
     bench_trace_overhead,
     bench_profiler_overhead,
+    bench_slo_overhead,
+    bench_aggregator_scrape,
     bench_lint_runtime,
 ]
 

@@ -242,6 +242,13 @@ class Broker:
                     tctx = TraceContext.mint()
                     t_start = time.perf_counter()
                     with start_trace(request_id=qid, context=tctx, service="broker") as tr:
+                        # expose the live trace to attach_alert(): a firing
+                        # SLO alert attributable to this request id lands as
+                        # a span event while the query is still in flight
+                        with self._running_lock:
+                            if qid in self._running:
+                                self._running[qid]["trace"] = tr
+                                self._running[qid]["traceId"] = tctx.trace_id
                         try:
                             result = self._execute(stmt, sql, deadline=deadline, qid=qid, partial=partial)
                         finally:
@@ -264,10 +271,17 @@ class Broker:
                 result.num_servers_responded = partial.servers_responded
             if self.query_logger is not None:
                 self.query_logger.log(sql, table, result.time_used_ms, result.num_docs_scanned)
-            self._log_slow_query(sql, table, result)
+            if table:
+                # labelled per-table latency family: the federated scrape
+                # merges these into per-table p99 series so SLO objectives
+                # can carry per-table overrides
+                bm.timer("broker.tableLatencyMs", table=table).update_ms(result.time_used_ms)
+            self._log_slow_query(sql, table, result, qid)
             return result
         except Exception as e:
             bm.meter(BrokerMeter.REQUEST_FAILURES).mark()
+            if table:
+                bm.meter("broker.tableErrors", table=table).mark()
             if tctx is not None and not getattr(e, "trace_id", None):
                 e.trace_id = tctx.trace_id  # exemplar id for the error payload
             kill_reason = getattr(e, "kill_reason", None)
@@ -296,7 +310,7 @@ class Broker:
             with self._running_lock:
                 self._running.pop(qid, None)
 
-    def _log_slow_query(self, sql: str, table: str, result: ResultTable) -> None:
+    def _log_slow_query(self, sql: str, table: str, result: ResultTable, qid: str = "") -> None:
         """Structured slow-query log (the reference's broker query-log WARN
         path for above-threshold queries): one JSON line + ring-buffer entry
         when wall time crosses ObservabilityConfig.slow_query_threshold_ms."""
@@ -314,11 +328,55 @@ class Broker:
             "numSegmentsQueried": result.num_segments_queried,
             "ts": time.time(),
         }
+        if qid:
+            # SLO exemplars carry the request id so a firing alert can be
+            # attributed back to the query while it is still in flight
+            entry["queryId"] = qid
         if result.trace_id:
             # exemplar: join the slow-query log entry to /debug/traces/{id}
             entry["traceId"] = result.trace_id
         self.slow_queries.append(entry)
         logging.getLogger("pinot_tpu.slowquery").warning(json.dumps(entry, sort_keys=True))
+
+    def attach_alert(self, alert: dict) -> dict:
+        """Cross-link a controller SLO alert into this broker's observability
+        planes (the alert -> trace -> slow-query join, both directions):
+        slow-query entries matching the alert's exemplar trace — or, lacking
+        one, the alert's table — gain an `alertId` field, and when the
+        exemplar's request id or trace id is still in flight with a sampled
+        trace, the firing lands as a `slo.alert` span event on the live
+        trace. Called in-process by the ClusterMetricsAggregator or via
+        POST /debug/alerts/attach."""
+        aid = alert.get("id")
+        out = {"alertId": aid, "slowQueries": 0, "spanEvents": 0}
+        if not aid:
+            return out
+        ex = alert.get("exemplar") or {}
+        tid, rid, table = ex.get("traceId"), ex.get("queryId"), alert.get("table")
+        # deque iteration races with concurrent appends; a list copy is
+        # stable and the entry dicts are shared so stamping still lands
+        for entry in list(self.slow_queries):
+            if (tid and entry.get("traceId") == tid) or (
+                not tid and table and entry.get("table") == table
+            ):
+                entry["alertId"] = aid
+                out["slowQueries"] += 1
+        with self._running_lock:
+            running = list(self._running.items())
+        for qid, ent in running:
+            tr = ent.get("trace")
+            if tr is None:
+                continue
+            if qid == rid or (tid and ent.get("traceId") == tid):
+                tr.add_event(
+                    "slo.alert",
+                    alertId=aid,
+                    slo=str(alert.get("slo")),
+                    state=str(alert.get("state")),
+                    table=str(table or ""),
+                )
+                out["spanEvents"] += 1
+        return out
 
     def _log_killed_query(self, sql: str, table: str, qid: str, reason: str, trace_id: str | None) -> None:
         """Accountant kills get a structured log entry of their own — the

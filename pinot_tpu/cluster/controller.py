@@ -25,6 +25,12 @@ from pinot_tpu.segment.segment import ImmutableSegment
 class Controller:
     #: optional AccessControl SPI enforced by the HTTP endpoints
     access_control = None
+    #: bound by PeriodicTaskScheduler(controller=...) — the /health/ready
+    #: "periodicScheduler" component reports on it when present
+    periodic_scheduler = None
+    #: bound by ClusterMetricsAggregator(controller) — serves /debug/cluster
+    #: and /debug/alerts on the controller HTTP surface
+    cluster_aggregator = None
 
     def __init__(self, store: PropertyStore, deep_store: str | Path, controller_id: str = "controller_0"):
         """deep_store: directory holding uploaded segment dirs (the PinotFS
@@ -36,6 +42,36 @@ class Controller:
         self._servers: dict[str, object] = {}  # server_id -> Server handle
         self._election = None
         self._transitions = None
+
+    def readiness(self) -> "tuple[bool, dict]":
+        """(ready, per-component detail) for GET /health/ready — the broker/
+        server readiness contract extended to the controller: the property
+        store must answer, a configured periodic scheduler must actually be
+        running, and with HA enabled the lease state must be known (election
+        thread alive — leader or standby both count as known)."""
+        components: dict[str, dict] = {}
+        try:
+            self.store.list("/instances/")
+            components["propertyStore"] = {"ok": True}
+        except Exception as e:  # noqa: BLE001  # pinotlint: disable=deadline-swallow — readiness probe, off the query path; the failure is the signal
+            components["propertyStore"] = {"ok": False, "error": f"{type(e).__name__}: {e}"}
+        sched = self.periodic_scheduler
+        if sched is None:
+            components["periodicScheduler"] = {"ok": True, "configured": False}
+        else:
+            running = bool(getattr(sched, "_running", False))
+            components["periodicScheduler"] = {
+                "ok": running,
+                "configured": True,
+                "tasks": [t.name for t in sched.tasks],
+            }
+        if self._election is None:
+            components["ha"] = {"ok": True, "enabled": False}
+        else:
+            thread = getattr(self._election, "_thread", None)
+            known = thread is not None and thread.is_alive()
+            components["ha"] = {"ok": known, "enabled": True, "leader": self.is_leader}
+        return all(c["ok"] for c in components.values()), components
 
     # -- high availability (cluster/ha.py) -----------------------------------
 

@@ -26,7 +26,13 @@ from pinot_tpu.common.errors import code_of
 
 
 def _serve(handler_cls, port: int) -> tuple[ThreadingHTTPServer, int, threading.Thread]:
-    httpd = ThreadingHTTPServer(("127.0.0.1", port), handler_cls)
+    class _Server(ThreadingHTTPServer):
+        # socketserver's default accept backlog of 5 refuses connections the
+        # moment 100s of clients connect at once (bench.py qps drives 128+);
+        # a deep backlog lets the thread-per-request model absorb the burst
+        request_queue_size = 256
+
+    httpd = _Server(("127.0.0.1", port), handler_cls)
     t = threading.Thread(target=httpd.serve_forever, daemon=True)
     t.start()
     return httpd, httpd.server_address[1], t
@@ -140,11 +146,21 @@ class BrokerHTTPService:
                 pass
 
             def do_POST(self):
-                if self.path not in ("/query/sql", "/timeseries/api/v1/query_range"):
+                if self.path not in (
+                    "/query/sql",
+                    "/timeseries/api/v1/query_range",
+                    "/debug/alerts/attach",
+                ):
                     self.send_error(404)
                     return
                 n = int(self.headers.get("Content-Length", 0))
                 body = json.loads(self.rfile.read(n) or b"{}")
+                if self.path == "/debug/alerts/attach":
+                    # controller SLO plane pushing an alert transition: stamp
+                    # alertId into matching slow-query exemplars and emit a
+                    # span event on the trace if its request is still running
+                    _send_json(self, svc.broker.attach_alert(body))
+                    return
                 try:
                     identity = None
                     ac = getattr(svc.broker, "access_control", None)
@@ -655,9 +671,9 @@ class ControllerHTTPService:
     """Controller REST surface (pinot-controller/.../api/resources/ parity,
     the subset that matters for clients/CLI):
 
-      GET  /health | /tables | /tables/{t} | /tables/{t}/schema
+      GET  /health | /health/ready | /tables | /tables/{t} | /tables/{t}/schema
            /tables/{t}/idealstate | /tables/{t}/segments | /brokers | /instances
-           /tasks?state=...
+           /tasks?state=... | /debug/cluster | /debug/alerts
       POST /schemas            {schema json}
       POST /tables             {table config json}
       POST /instances          {"type": "server"|"broker", "id", "host", "port"}
@@ -704,6 +720,27 @@ class ControllerHTTPService:
                         _serve_metrics(self, controller_metrics())
                     elif self.path == "/health":
                         self._json({"status": "OK"})
+                    elif self.path == "/health/ready":
+                        _serve_ready(self, c.readiness)
+                    elif self.path.partition("?")[0] == "/debug/cluster":
+                        # federated cluster view assembled by the
+                        # ClusterMetricsAggregator periodic task
+                        agg = c.cluster_aggregator
+                        if agg is None:
+                            self._json({"error": "no ClusterMetricsAggregator registered"}, 404)
+                        else:
+                            self._json(agg.debug_cluster())
+                    elif self.path.partition("?")[0] == "/debug/alerts":
+                        agg = c.cluster_aggregator
+                        if agg is None:
+                            self._json({"error": "no ClusterMetricsAggregator registered"}, 404)
+                        else:
+                            self._json(
+                                {
+                                    "alerts": agg.evaluator.alerts(),
+                                    "slo": agg.evaluator.status(),
+                                }
+                            )
                     elif self.path == "/tables":
                         self._json({"tables": c.tables()})
                     elif len(parts) == 2 and parts[0] == "tables":

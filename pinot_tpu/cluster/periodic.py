@@ -11,10 +11,16 @@ tasks on daemon timers; run_once() is the deterministic test entry.
 
 from __future__ import annotations
 
+import json
 import threading
 import time
+from collections import defaultdict
 
-from pinot_tpu.common.metrics import controller_metrics
+from pinot_tpu.common.metrics import (
+    controller_metrics,
+    merge_cumulative_buckets,
+    quantile_from_buckets,
+)
 
 
 class ControllerPeriodicTask:
@@ -147,14 +153,468 @@ class MissingConsumingSegmentFinder(ControllerPeriodicTask):
         return {"missingPartitions": missing}
 
 
+class ClusterMetricsAggregator(ControllerPeriodicTask):
+    """Federated metrics scrape: pull every registered broker's and server's
+    `/metrics?format=json` snapshot (plus `/debug/workload` rollups and the
+    broker slow-query ring for exemplars) and fold them into cluster rollup
+    series in the controller registry — the ValidationMetrics pattern of the
+    reference generalized from segment counts to the full metric surface.
+
+    Correctness properties:
+      * **Never raises.** An unreachable or malformed node marks its series
+        stale (`lastScrapeMs` frozen at the last success) and the sweep
+        continues; previously folded counts are retained, not dropped.
+      * **Counter-reset detection.** A node restart resets its registries;
+        any tracked counter going backwards flags the whole scrape as a
+        restart and the fresh values count as the delta, so cluster
+        accumulations are monotone and never go negative.
+      * **Histogram merge.** Latency buckets accumulate per node per bound
+        and cross-node merge goes through `merge_cumulative_buckets`, so the
+        merged `+Inf` always equals the summed `_count`s even when nodes
+        expose different (sparse) bound sets.
+      * **No I/O under locks.** All scrapes complete before `_lock` is
+        taken; the fold under the lock is pure arithmetic (the
+        blocking-under-lock contract pinotlint enforces).
+
+    `fetch` and `now_fn` are injectable so failure-path tests are fully
+    deterministic (no sockets, no sleeps)."""
+
+    name = "ClusterMetricsAggregator"
+    interval_sec = 10.0
+
+    #: meters folded into the cluster.errors{code=...} rollup, keyed by the
+    #: registered QueryErrorCode each broker meter maps to
+    ERROR_METERS = {
+        "broker.requestFailures": 200,
+        "broker.queriesTimedOut": 250,
+        "broker.queriesCancelled": 503,
+    }
+
+    def __init__(self, controller, fetch=None, now_fn=None, objectives=None,
+                 evaluator=None, scrape_timeout: float = 2.0, local_brokers=None):
+        super().__init__(controller)
+        self.fetch = fetch or self._http_fetch
+        self.now_fn = now_fn or time.time
+        self.scrape_timeout = scrape_timeout
+        #: broker_id -> in-process Broker for alert cross-linking without a
+        #: network hop (HTTP brokers get POST /debug/alerts/attach instead)
+        self.local_brokers = dict(local_brokers or {})
+        if evaluator is None:
+            from pinot_tpu.common.slo import SloEvaluator
+
+            evaluator = SloEvaluator(objectives, now_fn=self.now_fn,
+                                     registry=controller_metrics())
+        self.evaluator = evaluator
+        self.status_checker = SegmentStatusChecker(controller)
+        self._lock = threading.Lock()
+        self._nodes: dict[str, dict] = {}
+        self._series_labels: dict[str, dict] = {}
+        self._table_rates: dict[str, dict] = {}
+        self._last_sample: dict = {}
+        # the controller exposes the hub surfaces (/debug/cluster,
+        # /debug/alerts) through whichever aggregator registered last
+        controller.cluster_aggregator = self
+
+    # -- scrape (no locks held anywhere in this section) ----------------------
+
+    def _http_fetch(self, url: str) -> str:
+        import urllib.request
+
+        with urllib.request.urlopen(url, timeout=self.scrape_timeout) as resp:
+            return resp.read().decode()
+
+    def _endpoints(self) -> dict[str, dict]:
+        """node id -> {"role", "url"} for every registered broker and every
+        server instance that advertises an HTTP port (in-process handles
+        have no scrape surface of their own — their metrics land in shared
+        per-role registries some HTTP node already exposes)."""
+        eps = {}
+        for bid, url in self.controller.brokers().items():
+            eps[bid] = {"role": "broker", "url": url}
+        for path in self.controller.store.list("/instances/"):
+            sid = path.split("/")[-1]
+            doc = self.controller.store.get(path) or {}
+            if doc.get("port"):
+                eps[sid] = {"role": "server", "url": f"http://{doc['host']}:{doc['port']}"}
+        return eps
+
+    def _scrape_node(self, node_id: str, ep: dict) -> dict:
+        base = ep["url"].rstrip("/")
+        try:
+            snap = json.loads(self.fetch(f"{base}/metrics?format=json"))
+            if not isinstance(snap, dict):
+                raise ValueError(f"metrics snapshot from {node_id} is not a JSON object")
+            try:
+                workload = (json.loads(self.fetch(f"{base}/debug/workload")) or {}).get("rollups") or []
+            except Exception:  # noqa: BLE001  # pinotlint: disable=deadline-swallow — optional surface; a node without /debug/workload still contributes metrics
+                workload = []
+            slow = []
+            if ep["role"] == "broker":
+                try:
+                    slow = json.loads(self.fetch(f"{base}/debug/slowQueries")) or []
+                except Exception:  # noqa: BLE001  # pinotlint: disable=deadline-swallow — exemplars are best-effort garnish on the scrape
+                    slow = []
+            return {"ok": True, "snapshot": snap, "workload": workload, "slow": slow, "error": None}
+        except Exception as e:  # noqa: BLE001  # pinotlint: disable=deadline-swallow — the federated scrape must never raise: a down/malformed node marks its series stale and the sweep continues
+            return {"ok": False, "snapshot": None, "workload": [], "slow": [],
+                    "error": f"{type(e).__name__}: {e}"}
+
+    # -- fold -----------------------------------------------------------------
+
+    @staticmethod
+    def _new_node_state(ep: dict) -> dict:
+        return {
+            "role": ep["role"], "url": ep["url"],
+            "ok": None, "lastScrapeMs": None, "lastError": None, "restarts": 0,
+            "timeline": [],  # [{"tsMs", "ok"}] transitions only, bounded
+            "rawCounters": {}, "rawBuckets": {}, "rawTimer": {}, "rawWorkload": {},
+            "accCounters": defaultdict(int), "accBuckets": {}, "accTimer": {},
+            "accWorkload": {},
+        }
+
+    @staticmethod
+    def _per_bucket(raw_buckets) -> dict:
+        """JSON `[[le, cum], ...]` -> exact per-bucket {bound: count} (sparse
+        cumulative output omits only zero-count buckets, so this is lossless)."""
+        out = {}
+        prev = 0
+        for le, cum in sorted(((float(le), int(c)) for le, c in raw_buckets), key=lambda p: p[0]):
+            if cum > prev:
+                out[le] = cum - prev
+                prev = cum
+        return out
+
+    def _fold_node(self, st: dict, res: dict, now_ms: float) -> None:
+        """Fold one successful scrape into the node's monotone accumulations
+        (caller holds self._lock; pure arithmetic only)."""
+        counters, buckets, timers = {}, {}, {}
+        for key, entry in res["snapshot"].items():
+            t = entry.get("type")
+            if t == "meter":
+                counters[key] = int(entry.get("count") or 0)
+            elif t in ("timer", "histogram"):
+                buckets[key] = self._per_bucket(entry.get("buckets") or [])
+                timers[key] = {
+                    "count": int(entry.get("count") or 0),
+                    "totalMs": float(entry.get("totalMs") or 0.0),
+                    "maxMs": float(entry.get("maxMs") or 0.0),
+                }
+            if entry.get("labels"):
+                self._series_labels[key] = dict(entry["labels"])
+        workload = {}
+        for r in res["workload"]:
+            wkey = (r.get("tenant") or "", r.get("table") or "")
+            workload[wkey] = {
+                k: int(r.get(k) or 0)
+                for k in ("queries", "cpuTimeNs", "allocatedBytes", "segmentsExecuted", "queriesKilled")
+            }
+
+        restarted = (
+            any(v < st["rawCounters"].get(k, 0) for k, v in counters.items())
+            or any(t["count"] < st["rawTimer"].get(k, {}).get("count", 0) for k, t in timers.items())
+            or any(
+                w["queries"] < st["rawWorkload"].get(k, {}).get("queries", 0)
+                for k, w in workload.items()
+            )
+        )
+        if restarted:
+            st["restarts"] += 1
+
+        for k, v in counters.items():
+            prev = 0 if restarted else st["rawCounters"].get(k, 0)
+            st["accCounters"][k] += max(0, v - prev)
+        for k, per in buckets.items():
+            acc = st["accBuckets"].setdefault(k, defaultdict(int))
+            prev_per = {} if restarted else st["rawBuckets"].get(k, {})
+            for le, c in per.items():
+                acc[le] += max(0, c - prev_per.get(le, 0))
+        for k, t in timers.items():
+            acc = st["accTimer"].setdefault(k, {"count": 0, "totalMs": 0.0, "maxMs": 0.0})
+            prev = {"count": 0, "totalMs": 0.0} if restarted else st["rawTimer"].get(k, {"count": 0, "totalMs": 0.0})
+            acc["count"] += max(0, t["count"] - prev.get("count", 0))
+            acc["totalMs"] += max(0.0, t["totalMs"] - prev.get("totalMs", 0.0))
+            acc["maxMs"] = max(acc["maxMs"], t["maxMs"])
+        for k, w in workload.items():
+            acc = st["accWorkload"].setdefault(k, defaultdict(int))
+            prev = {} if restarted else st["rawWorkload"].get(k, {})
+            for f, v in w.items():
+                acc[f] += max(0, v - prev.get(f, 0))
+
+        st["rawCounters"], st["rawBuckets"] = counters, buckets
+        st["rawTimer"], st["rawWorkload"] = timers, workload
+        st["lastScrapeMs"] = now_ms
+
+    @staticmethod
+    def _cumulative(per_bucket: dict) -> "list[tuple[float, int]]":
+        out = []
+        cum = 0
+        for le in sorted(per_bucket):
+            cum += per_bucket[le]
+            out.append((le, cum))
+        return out
+
+    def _fold_locked(self, endpoints: dict, results: dict, now_ms: float) -> dict:
+        for nid, ep in endpoints.items():
+            st = self._nodes.get(nid)
+            if st is None:
+                st = self._nodes[nid] = self._new_node_state(ep)
+            st["url"] = ep["url"]
+            res = results[nid]
+            if st["ok"] is None or st["ok"] != res["ok"]:
+                st["timeline"].append({"tsMs": now_ms, "ok": res["ok"]})
+                del st["timeline"][:-64]
+            st["ok"] = res["ok"]
+            if res["ok"]:
+                st["lastError"] = None
+                self._fold_node(st, res, now_ms)
+            else:
+                st["lastError"] = res["error"]
+
+        # -- cluster rollup sample for the SLO plane --------------------------
+        def nodes(role):
+            return [s for s in self._nodes.values() if s["role"] == role]
+
+        queries = sum(s["accCounters"].get("broker.queries", 0) for s in nodes("broker"))
+        errors_by_code = defaultdict(int)
+        for s in nodes("broker"):
+            for meter, code in self.ERROR_METERS.items():
+                errors_by_code[code] += s["accCounters"].get(meter, 0)
+        latency = merge_cumulative_buckets(
+            [self._cumulative(s["accBuckets"].get("broker.queryTotalMs", {})) for s in nodes("broker")]
+        )
+        server_latency = merge_cumulative_buckets(
+            [self._cumulative(s["accBuckets"].get("server.queryExecutionMs", {})) for s in nodes("server")]
+        )
+
+        # per-table series from the labelled broker families
+        tables: dict[str, dict] = {}
+        for s in nodes("broker"):
+            for key, acc in s["accBuckets"].items():
+                if key.startswith("broker.tableLatencyMs{"):
+                    t = self._series_labels.get(key, {}).get("table")
+                    if t:
+                        tb = tables.setdefault(t, {"queries": 0, "errors": 0, "bucketLists": []})
+                        tb["bucketLists"].append(self._cumulative(acc))
+            for key, v in s["accCounters"].items():
+                if key.startswith("broker.tableQueries{"):
+                    t = self._series_labels.get(key, {}).get("table")
+                    if t:
+                        tables.setdefault(t, {"queries": 0, "errors": 0, "bucketLists": []})["queries"] += v
+                elif key.startswith("broker.tableErrors{"):
+                    t = self._series_labels.get(key, {}).get("table")
+                    if t:
+                        tables.setdefault(t, {"queries": 0, "errors": 0, "bucketLists": []})["errors"] += v
+        table_samples = {
+            t: {
+                "queries": tb["queries"],
+                "errors": tb["errors"],
+                "latencyBuckets": merge_cumulative_buckets(tb["bucketLists"]),
+            }
+            for t, tb in tables.items()
+        }
+
+        # merged per-(tenant, table) workload + per-table scrape-window QPS
+        workload: dict = {}
+        for s in self._nodes.values():
+            for (tenant, table), acc in s["accWorkload"].items():
+                agg = workload.setdefault((tenant, table), defaultdict(int))
+                for f, v in acc.items():
+                    agg[f] += v
+        prev = self._last_sample
+        elapsed_s = max(1e-3, (now_ms - prev["tsMs"]) / 1000.0) if prev else None
+        rates = {}
+        for t, tb in table_samples.items():
+            prev_q = ((prev.get("tables") or {}).get(t) or {}).get("queries", 0) if prev else 0
+            rates[t] = {
+                "qps": (tb["queries"] - prev_q) / elapsed_s if elapsed_s else 0.0,
+                "queries": tb["queries"],
+                "p99Ms": quantile_from_buckets(tb["latencyBuckets"], 0.99),
+            }
+        for (tenant, table), agg in workload.items():
+            rates.setdefault(table, {"qps": 0.0, "queries": agg.get("queries", 0), "p99Ms": 0.0})
+            rates[table]["cpuTimeNs"] = rates[table].get("cpuTimeNs", 0) + agg.get("cpuTimeNs", 0)
+            rates[table]["tenant"] = tenant
+        self._table_rates = rates
+
+        exemplars = [e for nid in sorted(results) for e in results[nid]["slow"]]
+        sample = {
+            "tsMs": now_ms,
+            "queries": queries,
+            "errors": sum(errors_by_code.values()),
+            "errorsByCode": dict(errors_by_code),
+            "latencyBuckets": latency,
+            "serverLatencyBuckets": server_latency,
+            "latencyTotalMs": sum(
+                s["accTimer"].get("broker.queryTotalMs", {}).get("totalMs", 0.0) for s in nodes("broker")
+            ),
+            "latencyMaxMs": max(
+                [s["accTimer"].get("broker.queryTotalMs", {}).get("maxMs", 0.0) for s in nodes("broker")],
+                default=0.0,
+            ),
+            "serverLatencyTotalMs": sum(
+                s["accTimer"].get("server.queryExecutionMs", {}).get("totalMs", 0.0) for s in nodes("server")
+            ),
+            "serverLatencyMaxMs": max(
+                [s["accTimer"].get("server.queryExecutionMs", {}).get("maxMs", 0.0) for s in nodes("server")],
+                default=0.0,
+            ),
+            "tables": table_samples,
+            "workload": {f"{tenant}/{table}": dict(agg) for (tenant, table), agg in sorted(workload.items())},
+            "exemplars": exemplars,
+        }
+        self._last_sample = sample
+        return sample
+
+    # -- publish + cross-link -------------------------------------------------
+
+    def _publish(self, sample: dict) -> None:
+        m = controller_metrics()
+        m.gauge("cluster.queries").set(sample["queries"])
+        for code, n in sorted(sample["errorsByCode"].items()):
+            m.gauge("cluster.errors", code=str(code)).set(n)
+        m.histogram("cluster.latencyMs").load_cumulative(
+            sample["latencyBuckets"], total_ms=sample["latencyTotalMs"], max_ms=sample["latencyMaxMs"]
+        )
+        m.histogram("cluster.serverLatencyMs").load_cumulative(
+            sample["serverLatencyBuckets"],
+            total_ms=sample["serverLatencyTotalMs"],
+            max_ms=sample["serverLatencyMaxMs"],
+        )
+        with self._lock:
+            total = len(self._nodes)
+            healthy = sum(1 for s in self._nodes.values() if s["ok"])
+            rates = dict(self._table_rates)
+        m.gauge("cluster.nodes").set(total)
+        m.gauge("cluster.nodesStale").set(total - healthy)
+        for table, r in rates.items():
+            labels = {"table": table}
+            if r.get("tenant"):
+                labels["tenant"] = r["tenant"]
+            m.gauge("cluster.table.queries", **labels).set(r.get("queries", 0))
+            m.gauge("cluster.table.cpuTimeNs", **labels).set(r.get("cpuTimeNs", 0))
+
+    def _crosslink(self, transitions: list, endpoints: dict) -> None:
+        """Push alert transitions to every broker so they can stamp
+        `alertId` into matching slow-query exemplars and emit span events on
+        still-in-flight traces (satellite: the three observability planes
+        link both directions). In-process brokers are called directly;
+        remote ones get POST /debug/alerts/attach — best-effort, a down
+        broker must not fail the sweep."""
+        import urllib.request
+
+        for alert in transitions:
+            for bid, broker in self.local_brokers.items():
+                try:
+                    broker.attach_alert(alert)
+                except Exception:  # noqa: BLE001  # pinotlint: disable=deadline-swallow — cross-linking is best-effort decoration of an already-recorded alert
+                    pass
+            for bid, ep in endpoints.items():
+                if ep["role"] != "broker" or bid in self.local_brokers:
+                    continue
+                try:
+                    req = urllib.request.Request(
+                        f"{ep['url'].rstrip('/')}/debug/alerts/attach",
+                        data=json.dumps(alert).encode(),
+                        headers={"Content-Type": "application/json"},
+                        method="POST",
+                    )
+                    with urllib.request.urlopen(req, timeout=self.scrape_timeout) as resp:
+                        resp.read()
+                except Exception:  # noqa: BLE001  # pinotlint: disable=deadline-swallow — cross-linking is best-effort decoration of an already-recorded alert
+                    pass
+
+    # -- periodic entry + read surfaces ---------------------------------------
+
+    def run_once(self) -> dict:
+        endpoints = self._endpoints()
+        results = {nid: self._scrape_node(nid, ep) for nid, ep in sorted(endpoints.items())}
+        now_ms = self.now_fn() * 1000.0
+        with self._lock:
+            sample = self._fold_locked(endpoints, results, now_ms)
+        self._publish(sample)
+        transitions = self.evaluator.observe(
+            {
+                "queries": sample["queries"],
+                "errors": sample["errors"],
+                "latencyBuckets": sample["latencyBuckets"],
+                "tables": sample["tables"],
+                "exemplars": sample["exemplars"],
+            }
+        )
+        if transitions:
+            self._crosslink(transitions, endpoints)
+        return {
+            "scraped": {nid: res["ok"] for nid, res in results.items()},
+            "queries": sample["queries"],
+            "errors": sample["errors"],
+            "transitions": [{"id": t["id"], "slo": t["slo"], "state": t["state"]} for t in transitions],
+        }
+
+    def debug_cluster(self) -> dict:
+        """The structured `GET /debug/cluster` document: per-node liveness
+        (scrape timeline), merged cluster series, segment health, and top
+        tables by QPS / CPU."""
+        segment_health = self.status_checker.run_once()
+        now_ms = self.now_fn() * 1000.0
+        with self._lock:
+            nodes = {}
+            for nid, s in self._nodes.items():
+                stale = (not s["ok"]) or s["lastScrapeMs"] is None
+                nodes[nid] = {
+                    "role": s["role"],
+                    "url": s["url"],
+                    "healthy": bool(s["ok"]),
+                    "stale": stale,
+                    "lastScrapeMs": s["lastScrapeMs"],
+                    "staleForMs": (now_ms - s["lastScrapeMs"]) if stale and s["lastScrapeMs"] else None,
+                    "lastError": s["lastError"],
+                    "restarts": s["restarts"],
+                    "timeline": list(s["timeline"]),
+                }
+            sample = self._last_sample
+            rates = dict(self._table_rates)
+        by_qps = sorted(rates.items(), key=lambda kv: -kv[1].get("qps", 0.0))[:10]
+        by_cpu = sorted(rates.items(), key=lambda kv: -kv[1].get("cpuTimeNs", 0))[:10]
+        doc = {
+            "generatedAtMs": now_ms,
+            "nodes": nodes,
+            "cluster": {
+                "queries": sample.get("queries", 0),
+                "errorsByCode": sample.get("errorsByCode", {}),
+                "latency": {
+                    "count": (sample.get("latencyBuckets") or [(0, 0)])[-1][1],
+                    "p50Ms": quantile_from_buckets(sample.get("latencyBuckets") or [], 0.5),
+                    "p99Ms": quantile_from_buckets(sample.get("latencyBuckets") or [], 0.99),
+                },
+                "serverLatency": {
+                    "count": (sample.get("serverLatencyBuckets") or [(0, 0)])[-1][1],
+                    "p50Ms": quantile_from_buckets(sample.get("serverLatencyBuckets") or [], 0.5),
+                    "p99Ms": quantile_from_buckets(sample.get("serverLatencyBuckets") or [], 0.99),
+                },
+                "workload": sample.get("workload", {}),
+            },
+            "topTables": {
+                "byQps": [dict(v, table=t) for t, v in by_qps],
+                "byCpu": [dict(v, table=t) for t, v in by_cpu],
+            },
+            "segmentHealth": segment_health,
+            "slo": self.evaluator.status(),
+        }
+        return doc
+
+
 class PeriodicTaskScheduler:
     """Daemon-timer driver for registered tasks (the lead-controller's
     periodic task executor)."""
 
-    def __init__(self):
+    def __init__(self, controller=None):
         self._tasks: list[ControllerPeriodicTask] = []
         self._threads: list[threading.Thread] = []
         self._running = False
+        # the controller's /health/ready reports on whichever scheduler
+        # bound itself here (readiness component "periodicScheduler")
+        if controller is not None:
+            controller.periodic_scheduler = self
 
     def register(self, task: ControllerPeriodicTask) -> None:
         self._tasks.append(task)

@@ -43,6 +43,13 @@ class ObservabilityConfig:
     #: continuous-ring capacity in distinct collapsed stacks; rarest half is
     #: evicted (and counted) when full
     profiler_ring_max_stacks: int = 2048
+    #: declarative SLO objectives evaluated by common/slo.py on the
+    #: controller's aggregated cluster series. Keys (all optional; see
+    #: slo.DEFAULT_OBJECTIVES): "availability" (fraction, e.g. 0.999),
+    #: "p99LatencyMs", "burnRateThreshold", "shortWindowS", "longWindowS",
+    #: and "tables": {table: {same keys}} per-table overrides. Empty dict =
+    #: defaults (availability 99.9%, latency objective off).
+    slo_objectives: dict = field(default_factory=dict)
 
     def to_dict(self) -> dict:
         return {
@@ -53,6 +60,7 @@ class ObservabilityConfig:
             "profilerEnabled": self.profiler_enabled,
             "profilerHz": self.profiler_hz,
             "profilerRingMaxStacks": self.profiler_ring_max_stacks,
+            "sloObjectives": dict(self.slo_objectives),
         }
 
     @staticmethod
@@ -65,6 +73,7 @@ class ObservabilityConfig:
             d.get("profilerEnabled", False),
             d.get("profilerHz", 31.0),
             d.get("profilerRingMaxStacks", 2048),
+            dict(d.get("sloObjectives", {})),
         )
 
 

@@ -1,0 +1,654 @@
+"""Cluster observability hub: federated scrape, histogram merge, SLO
+burn-rate alerts, readiness, and the alert -> trace -> slow-query cross-link.
+
+Deterministic throughout: scrape-failure paths use an injected `fetch` and an
+injected clock (no sockets, no sleeps); the acceptance tests run a real
+multi-process cluster on localhost but drive all SLO windows through the
+injected clock — the only sleeps are the bounded, seeded fault delays that
+create the latency regression under test.
+"""
+
+import json
+import random
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from pinot_tpu.cluster import Broker, Controller, PropertyStore, Server
+from pinot_tpu.cluster.http import (
+    BrokerHTTPService,
+    ControllerHTTPService,
+    RemoteServerClient,
+    ServerHTTPService,
+    query_broker_http,
+)
+from pinot_tpu.cluster.periodic import (
+    ClusterMetricsAggregator,
+    PeriodicTaskScheduler,
+    SegmentStatusChecker,
+)
+from pinot_tpu.common import DataType, ObservabilityConfig, Schema, TableConfig
+from pinot_tpu.common.faults import FAULTS, FaultRule
+from pinot_tpu.common.metrics import (
+    MetricsRegistry,
+    broker_metrics,
+    buckets_from_json,
+    controller_metrics,
+    buckets_to_json,
+    merge_cumulative_buckets,
+    quantile_from_buckets,
+    rebucket_counts,
+    reset_registries,
+)
+from pinot_tpu.common.slo import SloEvaluator
+from pinot_tpu.common.trace import TraceContext, start_trace
+from pinot_tpu.segment import SegmentBuilder
+
+
+def _get_json(url, timeout=10):
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        return json.loads(r.read())
+
+
+# ---------------------------------------------------------------------------
+# histogram merge: the cumulative-bucket invariant under federation
+# ---------------------------------------------------------------------------
+
+
+def test_merge_cumulative_buckets_invariant_property():
+    """Merged +Inf == sum of per-source _count for random bound sets — the
+    exposition invariant the federated scrape must preserve."""
+    rng = random.Random(8)
+    pool = [0.5, 1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0, 128.0]
+    for _ in range(200):
+        series, total = [], 0
+        for _n in range(rng.randint(1, 5)):
+            bounds = sorted(rng.sample(pool, rng.randint(1, 6)))
+            cum, pairs = 0, []
+            for b in bounds:
+                cum += rng.randint(0, 20)
+                pairs.append((b, cum))
+            if rng.random() < 0.5:  # some nodes expose an explicit +Inf bucket
+                cum += rng.randint(0, 10)
+                pairs.append((float("inf"), cum))
+            total += cum
+            series.append(pairs)
+        merged = merge_cumulative_buckets(series)
+        assert merged[-1][0] == float("inf")
+        assert merged[-1][1] == total
+        # cumulative series must be non-decreasing
+        assert all(merged[i][1] <= merged[i + 1][1] for i in range(len(merged) - 1))
+
+
+def test_rebucket_is_conservative_and_conserves_totals():
+    rng = random.Random(9)
+    target = [1.0, 2.0, 4.0, 8.0, 16.0]
+    for _ in range(200):
+        bounds = sorted(rng.sample([0.3, 0.9, 1.5, 3.0, 6.0, 12.0, 24.0, 48.0], rng.randint(1, 5)))
+        cum, pairs = 0, []
+        for b in bounds:
+            cum += rng.randint(0, 9)
+            pairs.append((b, cum))
+        per = rebucket_counts(pairs, target)
+        assert len(per) == len(target) + 1  # trailing overflow slot
+        assert sum(per) == cum  # no count ever dropped
+    # conservative direction: a source bucket lands at the smallest target
+    # bound >= its own, so the quantile read can only round up
+    per = rebucket_counts([(3.0, 10)], target)
+    assert per == [0, 0, 10, 0, 0, 0]
+
+
+def test_buckets_json_roundtrip_and_quantiles():
+    pairs = [(1.0, 3), (8.0, 9), (float("inf"), 10)]
+    raw = buckets_to_json(pairs)
+    assert raw[-1][0] == "+Inf"  # strict JSON: no float Infinity
+    assert buckets_from_json(json.loads(json.dumps(raw))) == pairs
+    assert quantile_from_buckets(pairs, 0.5) == 8.0
+    # +Inf populations report the largest finite bound, never inf
+    assert quantile_from_buckets(pairs, 0.999) == 8.0
+    assert quantile_from_buckets([], 0.99) == 0.0
+
+
+def test_snapshot_exposes_cumulative_buckets():
+    """The JSON snapshot every node serves carries the bucket lists the
+    aggregator folds (PR-8 addition to the exposition surface)."""
+    reset_registries()
+    t = broker_metrics().timer("broker.queryTotalMs")
+    for ms in (1.0, 5.0, 40.0):
+        t.update_ms(ms)
+    entry = broker_metrics().snapshot()["broker.queryTotalMs"]
+    pairs = buckets_from_json(entry["buckets"])
+    assert pairs[-1][1] == 3 == entry["count"]
+    assert entry["totalMs"] == pytest.approx(46.0)
+
+
+# ---------------------------------------------------------------------------
+# federated scrape failure paths (injected fetch + clock; no sockets)
+# ---------------------------------------------------------------------------
+
+
+def _broker_snapshot(queries, failures=0, buckets=None):
+    buckets = buckets if buckets is not None else [[4.0, queries]]
+    return {
+        "broker.queries": {"type": "meter", "count": queries},
+        "broker.requestFailures": {"type": "meter", "count": failures},
+        "broker.queryTotalMs": {
+            "type": "timer",
+            "count": queries,
+            "totalMs": 4.0 * queries,
+            "maxMs": 4.0,
+            "buckets": buckets,
+        },
+    }
+
+
+def _server_snapshot(executed):
+    return {
+        "server.queryExecutionMs": {
+            "type": "timer",
+            "count": executed,
+            "totalMs": 2.0 * executed,
+            "maxMs": 2.0,
+            "buckets": [[2.0, executed]],
+        }
+    }
+
+
+def _fake_cluster(tmp_path, responses, brokers=("broker-0",), servers=("server-0",)):
+    """Controller with fake registered nodes and an injected fetch that
+    serves `responses[node_id]`: a dict ({"snapshot", "workload", "slow"}),
+    a raw string (malformed exposition), or an Exception (node down)."""
+    controller = Controller(PropertyStore(), tmp_path / "deepstore")
+    for bid in brokers:
+        controller.register_broker(bid, bid, 80)
+    for sid in servers:
+        controller.register_server(sid, None, host=sid, port=80)
+
+    def fetch(url):
+        host = url.split("//")[1].split(":")[0]
+        r = responses[host]
+        if isinstance(r, Exception):
+            raise r
+        if isinstance(r, str):
+            return r
+        if "/metrics" in url:
+            return json.dumps(r.get("snapshot", {}))
+        if "/debug/workload" in url:
+            return json.dumps({"rollups": r.get("workload", [])})
+        if "/debug/slowQueries" in url:
+            return json.dumps(r.get("slow", []))
+        raise AssertionError(f"unexpected scrape url {url}")
+
+    clock = [1000.0]
+    agg = ClusterMetricsAggregator(controller, fetch=fetch, now_fn=lambda: clock[0])
+    return controller, agg, clock
+
+
+def test_scrape_node_down_marks_stale_not_missing(tmp_path):
+    reset_registries()
+    responses = {"broker-0": {"snapshot": _broker_snapshot(50)}, "server-0": {"snapshot": _server_snapshot(40)}}
+    controller, agg, clock = _fake_cluster(tmp_path, responses)
+    r1 = agg.run_once()
+    assert r1["scraped"] == {"broker-0": True, "server-0": True}
+    first_scrape_ms = agg.debug_cluster()["nodes"]["server-0"]["lastScrapeMs"]
+
+    # the node dies; the sweep must not raise, and its series go stale
+    responses["server-0"] = OSError("connection refused")
+    clock[0] += 10.0
+    r2 = agg.run_once()
+    assert r2["scraped"] == {"broker-0": True, "server-0": False}
+    doc = agg.debug_cluster()
+    node = doc["nodes"]["server-0"]
+    assert node["stale"] and not node["healthy"]
+    assert node["lastScrapeMs"] == first_scrape_ms  # frozen at last success
+    assert node["staleForMs"] == pytest.approx(10_000.0)
+    assert "OSError" in node["lastError"]
+    assert [e["ok"] for e in node["timeline"]] == [True, False]
+    # previously folded series are retained, not dropped
+    assert doc["cluster"]["queries"] == 50
+    assert doc["cluster"]["serverLatency"]["count"] == 40
+
+    # recovery flips the timeline back and resumes folding deltas
+    responses["server-0"] = {"snapshot": _server_snapshot(45)}
+    clock[0] += 10.0
+    agg.run_once()
+    node = agg.debug_cluster()["nodes"]["server-0"]
+    assert node["healthy"] and not node["stale"]
+    assert [e["ok"] for e in node["timeline"]] == [True, False, True]
+    assert agg.debug_cluster()["cluster"]["serverLatency"]["count"] == 45
+
+
+def test_scrape_malformed_exposition_is_a_failed_scrape(tmp_path):
+    reset_registries()
+    responses = {"broker-0": "this is not json {", "server-0": {"snapshot": _server_snapshot(7)}}
+    _controller, agg, _clock = _fake_cluster(tmp_path, responses)
+    r = agg.run_once()
+    assert r["scraped"]["broker-0"] is False
+    assert r["scraped"]["server-0"] is True
+    node = agg.debug_cluster()["nodes"]["broker-0"]
+    assert node["stale"] and "JSONDecodeError" in node["lastError"]
+    # a JSON scalar is equally malformed — the sweep still must not raise
+    responses["broker-0"] = json.dumps([1, 2, 3])
+    r = agg.run_once()
+    assert r["scraped"]["broker-0"] is False
+
+
+def test_scrape_counter_reset_detected_as_restart(tmp_path):
+    reset_registries()
+    responses = {"broker-0": {"snapshot": _broker_snapshot(100, failures=4)}, "server-0": {"snapshot": _server_snapshot(10)}}
+    _controller, agg, clock = _fake_cluster(tmp_path, responses)
+    agg.run_once()
+    assert agg.debug_cluster()["cluster"]["queries"] == 100
+
+    # node restarts: every counter goes backwards; the fresh values must
+    # count as the delta (100 + 40), never subtract
+    responses["broker-0"] = {"snapshot": _broker_snapshot(40, failures=1)}
+    clock[0] += 10.0
+    r = agg.run_once()
+    doc = agg.debug_cluster()
+    assert doc["nodes"]["broker-0"]["restarts"] == 1
+    assert doc["cluster"]["queries"] == 140
+    assert doc["cluster"]["errorsByCode"][200] == 5
+    assert r["errors"] == 5
+    # plain progress on the same node is a delta, not a restart
+    responses["broker-0"] = {"snapshot": _broker_snapshot(60, failures=1)}
+    clock[0] += 10.0
+    agg.run_once()
+    doc = agg.debug_cluster()
+    assert doc["nodes"]["broker-0"]["restarts"] == 1
+    assert doc["cluster"]["queries"] == 160
+
+
+def test_scrape_merges_histograms_across_heterogeneous_brokers(tmp_path):
+    reset_registries()
+    responses = {
+        # different bound sets on purpose: the merge must not drop counts
+        "broker-0": {"snapshot": _broker_snapshot(10, buckets=[[1.0, 5], [4.0, 9], ["+Inf", 10]])},
+        "broker-1": {"snapshot": _broker_snapshot(7, buckets=[[2.0, 3], [8.0, 7]])},
+        "server-0": {"snapshot": _server_snapshot(3)},
+    }
+    _controller, agg, _clock = _fake_cluster(tmp_path, responses, brokers=("broker-0", "broker-1"))
+    agg.run_once()
+    doc = agg.debug_cluster()
+    assert doc["cluster"]["queries"] == 17
+    assert doc["cluster"]["latency"]["count"] == 17  # merged +Inf == Σ _count
+    # the controller registry republishes the merged family losslessly
+    snap = controller_metrics().snapshot()
+    assert buckets_from_json(snap["cluster.latencyMs"]["buckets"])[-1][1] == 17
+    assert snap["cluster.nodes"]["value"] == 3
+
+
+def test_scrape_folds_workload_and_top_tables(tmp_path):
+    reset_registries()
+    responses = {
+        "broker-0": {"snapshot": _broker_snapshot(20)},
+        "server-0": {
+            "snapshot": _server_snapshot(20),
+            "workload": [
+                {"tenant": "DefaultTenant", "table": "orders", "queries": 12, "cpuTimeNs": 900, "allocatedBytes": 64, "segmentsExecuted": 24, "queriesKilled": 0},
+                {"tenant": "DefaultTenant", "table": "lineorder", "queries": 8, "cpuTimeNs": 4000, "allocatedBytes": 32, "segmentsExecuted": 8, "queriesKilled": 0},
+            ],
+        },
+    }
+    _controller, agg, _clock = _fake_cluster(tmp_path, responses)
+    agg.run_once()
+    doc = agg.debug_cluster()
+    assert doc["cluster"]["workload"]["DefaultTenant/orders"]["queries"] == 12
+    by_cpu = [t["table"] for t in doc["topTables"]["byCpu"]]
+    assert by_cpu[0] == "lineorder"  # 4000ns beats 900ns
+
+
+# ---------------------------------------------------------------------------
+# SLO evaluator: burn rates, alert state machine, dedup (injected clock)
+# ---------------------------------------------------------------------------
+
+
+def _sample(queries, errors, buckets=(), tables=None, exemplars=()):
+    return {
+        "queries": queries,
+        "errors": errors,
+        "latencyBuckets": list(buckets),
+        "tables": tables or {},
+        "exemplars": list(exemplars),
+    }
+
+
+def test_slo_availability_fire_dedupe_resolve():
+    clock = [0.0]
+    reg = MetricsRegistry("controller")
+    ev = SloEvaluator(
+        {"availability": 0.99, "burnRateThreshold": 2.0, "shortWindowS": 300.0, "longWindowS": 3600.0},
+        now_fn=lambda: clock[0],
+        registry=reg,
+    )
+    assert ev.observe(_sample(100, 0)) == []  # healthy: no transitions
+
+    clock[0] = 10.0
+    tr = ev.observe(_sample(200, 50, exemplars=[{"traceId": "abc123", "table": "t"}]))
+    assert len(tr) == 1 and tr[0]["state"] == "firing" and tr[0]["slo"] == "availability"
+    assert tr[0]["exemplar"]["traceId"] == "abc123"
+    assert reg.snapshot()["cluster.slo.alertsFiring"]["value"] == 1
+
+    # still burning: dedup — measured refreshes in place, no new ring entry
+    clock[0] = 20.0
+    assert ev.observe(_sample(300, 100)) == []
+    assert len(ev.alerts()) == 1 and ev.alerts()[0]["state"] == "firing"
+
+    # errors stop; once the short window only sees clean traffic the alert
+    # resolves even though the long window still remembers the incident
+    clock[0] = 400.0
+    tr = ev.observe(_sample(400, 100))
+    assert len(tr) == 1 and tr[0]["state"] == "resolved"
+    assert tr[0]["resolvedAtMs"] == pytest.approx(400_000.0)
+    ring = ev.alerts()
+    assert len(ring) == 1 and ring[0]["state"] == "resolved"
+    assert ev.status()["firing"] == 0
+    assert reg.snapshot()["cluster.slo.alertsFiring"]["value"] == 0
+    st = ev.status()["scopes"]["_cluster"]["availability"]
+    assert st["burnRateShort"] == 0.0 and st["burnRateLong"] > 2.0
+
+
+def test_slo_needs_both_windows_to_fire():
+    """One bad scrape must not page: the long window gates significance."""
+    clock = [0.0]
+    ev = SloEvaluator(
+        {"availability": 0.99, "burnRateThreshold": 2.0, "shortWindowS": 60.0, "longWindowS": 3600.0},
+        now_fn=lambda: clock[0],
+    )
+    # a long history of clean traffic, then one bad short window
+    ev.observe(_sample(0, 0))
+    clock[0] = 3000.0
+    ev.observe(_sample(100_000, 0))
+    clock[0] = 3010.0
+    # 50 errors in the short window: short burn is huge, long burn is
+    # 50/100050/0.01 ≈ 0.05 — below threshold, so nothing fires
+    assert ev.observe(_sample(100_050, 50)) == []
+    assert ev.status()["firing"] == 0
+
+
+def test_slo_per_table_p99_override():
+    clock = [0.0]
+    ev = SloEvaluator(
+        {
+            "availability": None,
+            "p99LatencyMs": None,  # cluster latency objective off...
+            "shortWindowS": 300.0,
+            "longWindowS": 3600.0,
+            "tables": {"orders": {"p99LatencyMs": 50.0}},  # ...but orders has one
+        },
+        now_fn=lambda: clock[0],
+    )
+    slow = {"orders": {"queries": 10, "errors": 0, "latencyBuckets": [(100.0, 10)]}}
+    tr = ev.observe(_sample(10, 0, tables=slow, exemplars=[{"traceId": "t1", "table": "orders"}]))
+    assert len(tr) == 1 and tr[0]["slo"] == "p99Latency" and tr[0]["table"] == "orders"
+    assert tr[0]["measured"]["p99ShortMs"] == 100.0
+    assert tr[0]["exemplar"]["traceId"] == "t1"
+    # recovery: only fast traffic inside the short window
+    clock[0] = 400.0
+    fast = {"orders": {"queries": 30, "errors": 0, "latencyBuckets": [(8.0, 20), (100.0, 30)]}}
+    tr = ev.observe(_sample(30, 0, tables=fast))
+    assert len(tr) == 1 and tr[0]["state"] == "resolved"
+
+
+def test_observability_config_slo_objectives_roundtrip():
+    obj = {"availability": 0.995, "p99LatencyMs": 120.0, "tables": {"orders": {"p99LatencyMs": 60.0}}}
+    cfg = ObservabilityConfig(slo_objectives=obj)
+    wire = json.loads(json.dumps(cfg.to_dict()))
+    back = ObservabilityConfig.from_dict(wire)
+    assert back.slo_objectives == obj
+    assert ObservabilityConfig.from_dict({}).slo_objectives == {}
+
+
+# ---------------------------------------------------------------------------
+# controller readiness
+# ---------------------------------------------------------------------------
+
+
+def test_controller_readiness_transitions(tmp_path):
+    controller = Controller(PropertyStore(), tmp_path / "deepstore")
+    ready, comps = controller.readiness()
+    assert ready  # store answers, no scheduler configured, no HA
+    assert comps["periodicScheduler"] == {"ok": True, "configured": False}
+
+    sched = PeriodicTaskScheduler(controller)
+    sched.register(SegmentStatusChecker(controller))
+    ready, comps = controller.readiness()
+    assert not ready  # configured but not running is NOT ready
+    assert comps["periodicScheduler"]["configured"] and not comps["periodicScheduler"]["ok"]
+    assert comps["periodicScheduler"]["tasks"] == ["SegmentStatusChecker"]
+
+    svc = ControllerHTTPService(controller)
+    try:
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(f"http://127.0.0.1:{svc.port}/health/ready", timeout=10)
+        assert ei.value.code == 503
+        detail = json.loads(ei.value.read())
+        assert detail["status"] == "not ready"
+        assert detail["components"]["periodicScheduler"]["ok"] is False
+
+        sched.start()
+        try:
+            with urllib.request.urlopen(f"http://127.0.0.1:{svc.port}/health/ready", timeout=10) as r:
+                assert r.status == 200
+                assert json.loads(r.read())["status"] == "ready"
+        finally:
+            sched.stop()
+        assert controller.readiness()[0] is False  # stopped -> not ready again
+    finally:
+        svc.stop()
+
+
+# ---------------------------------------------------------------------------
+# alert cross-link: alertId into slow-query entries + span event in flight
+# ---------------------------------------------------------------------------
+
+
+def _tiny_cluster(tmp_path, obs_config=None):
+    controller = Controller(PropertyStore(), tmp_path / "deepstore")
+    controller.register_server("server_0", Server("server_0"))
+    schema = Schema.build("t", dimensions=[("d", DataType.INT)], metrics=[("v", DataType.LONG)])
+    controller.add_schema(schema)
+    controller.add_table(TableConfig("t"))
+    b = SegmentBuilder(schema)
+    for i in range(3):
+        controller.upload_segment(
+            "t",
+            b.build(
+                {"d": np.arange(64, dtype=np.int32) % 4, "v": np.arange(64, dtype=np.int64)},
+                f"t_{i}",
+            ),
+        )
+    return controller, Broker(controller, obs_config=obs_config)
+
+
+def test_attach_alert_stamps_slow_queries_and_inflight_trace(tmp_path):
+    reset_registries()
+    controller, broker = _tiny_cluster(
+        tmp_path, ObservabilityConfig(slow_query_threshold_ms=0.0, trace_sample_rate=1.0)
+    )
+    broker.execute("SELECT COUNT(*) FROM t WHERE d = 1")
+    entry = broker.slow_queries[-1]
+    tid = entry.get("traceId")
+    assert tid  # sampled at rate 1.0, so the exemplar join key exists
+
+    with start_trace("inflight", context=TraceContext.mint()) as tr:
+        with broker._running_lock:
+            broker._running["q-live"] = {"sql": "x", "trace": tr, "traceId": "feedbead" * 4}
+        try:
+            out = broker.attach_alert(
+                {
+                    "id": "alert-42",
+                    "slo": "p99Latency",
+                    "state": "firing",
+                    "table": "t",
+                    "exemplar": {"traceId": tid, "queryId": "q-live"},
+                }
+            )
+        finally:
+            with broker._running_lock:
+                broker._running.pop("q-live", None)
+    assert out["slowQueries"] >= 1
+    assert entry["alertId"] == "alert-42"
+    assert out["spanEvents"] == 1
+    ev = [e for e in tr.root.events if e["name"] == "slo.alert"]
+    assert len(ev) == 1 and ev[0]["attrs"]["alertId"] == "alert-42"
+    # an alert with no id is a no-op, never an error
+    assert broker.attach_alert({}) == {"alertId": None, "slowQueries": 0, "spanEvents": 0}
+
+
+# ---------------------------------------------------------------------------
+# acceptance: multi-process /debug/cluster with a node killed mid-scrape
+# ---------------------------------------------------------------------------
+
+
+def test_debug_cluster_multiprocess_merge_and_killed_node(tmp_path):
+    reset_registries()
+    controller = Controller(PropertyStore(), tmp_path / "deepstore")
+    inner = {f"server_{i}": Server(f"server_{i}") for i in range(2)}
+    services = {sid: ServerHTTPService(s, port=0) for sid, s in inner.items()}
+    bsvc = csvc = None
+    try:
+        for sid, svc in services.items():
+            controller.register_server(
+                sid, RemoteServerClient(f"http://127.0.0.1:{svc.port}"), host="127.0.0.1", port=svc.port
+            )
+        schema = Schema.build("t", dimensions=[("d", DataType.INT)], metrics=[("v", DataType.LONG)])
+        controller.add_schema(schema)
+        controller.add_table(TableConfig("t", replication=2))
+        b = SegmentBuilder(schema)
+        for i in range(4):
+            controller.upload_segment(
+                "t",
+                b.build(
+                    {"d": np.arange(256, dtype=np.int32) % 8, "v": np.arange(256, dtype=np.int64)},
+                    f"t_{i}",
+                ),
+            )
+        broker = Broker(controller)
+        bsvc = BrokerHTTPService(broker, port=0)
+        controller.register_broker("broker_0", "127.0.0.1", bsvc.port)
+        csvc = ControllerHTTPService(controller)
+        agg = ClusterMetricsAggregator(controller)
+
+        for _ in range(5):
+            r = query_broker_http(f"http://127.0.0.1:{bsvc.port}", "SELECT COUNT(*) FROM t WHERE d = 1")
+            assert not r.get("exceptions")
+
+        r1 = agg.run_once()
+        assert r1["scraped"] == {"broker_0": True, "server_0": True, "server_1": True}
+        doc = _get_json(f"http://127.0.0.1:{csvc.port}/debug/cluster")
+        servers = [n for n in doc["nodes"].values() if n["role"] == "server"]
+        brokers = [n for n in doc["nodes"].values() if n["role"] == "broker"]
+        assert len(servers) == 2 and len(brokers) == 1
+        assert all(n["healthy"] and not n["stale"] for n in doc["nodes"].values())
+        assert doc["cluster"]["queries"] >= 5
+        assert doc["cluster"]["latency"]["count"] >= 5
+        assert doc["cluster"]["latency"]["p99Ms"] > 0
+        assert doc["cluster"]["serverLatency"]["count"] >= 5  # scatter legs landed
+        assert doc["segmentHealth"]["t"]["percent"] == 100
+        # the merged rollup is also on the controller's own exposition
+        snap = _get_json(f"http://127.0.0.1:{csvc.port}/metrics?format=json")
+        assert snap["cluster.queries"]["value"] >= 5
+        assert buckets_from_json(snap["cluster.latencyMs"]["buckets"])[-1][1] >= 5
+
+        baseline = doc["nodes"]["server_1"]["lastScrapeMs"]
+        services["server_1"].stop()  # kill one server mid-scrape
+        r2 = agg.run_once()  # must not raise
+        assert r2["scraped"]["server_1"] is False
+        assert r2["scraped"]["broker_0"] is True and r2["scraped"]["server_0"] is True
+        doc2 = _get_json(f"http://127.0.0.1:{csvc.port}/debug/cluster")
+        node = doc2["nodes"]["server_1"]  # stale, NOT missing
+        assert node["stale"] and not node["healthy"]
+        assert node["lastScrapeMs"] == baseline
+        assert node["lastError"]
+        assert [e["ok"] for e in node["timeline"]] == [True, False]
+        assert doc2["cluster"]["queries"] >= 5  # folded series retained
+        assert snap["cluster.nodes"]["value"] == 3
+    finally:
+        for svc in services.values():
+            try:
+                svc.stop()
+            except Exception:
+                pass
+        if bsvc:
+            bsvc.stop()
+        if csvc:
+            csvc.stop()
+
+
+# ---------------------------------------------------------------------------
+# acceptance: injected latency regression drives the p99 SLO through
+# ok -> firing (exemplar trace id, alertId cross-link) -> resolved
+# ---------------------------------------------------------------------------
+
+
+def test_slo_alert_lifecycle_with_injected_latency_fault(tmp_path):
+    reset_registries()
+    FAULTS.reset()
+    controller, broker = _tiny_cluster(
+        tmp_path, ObservabilityConfig(slow_query_threshold_ms=50.0, trace_sample_rate=1.0)
+    )
+    bsvc = BrokerHTTPService(broker, port=0)
+    controller.register_broker("broker_0", "127.0.0.1", bsvc.port)
+    csvc = ControllerHTTPService(controller)
+    clock = [0.0]
+    agg = ClusterMetricsAggregator(
+        controller,
+        now_fn=lambda: clock[0],
+        objectives={"availability": None, "p99LatencyMs": 80.0, "shortWindowS": 300.0, "longWindowS": 3600.0},
+    )
+    sql = "SELECT COUNT(*) FROM t WHERE d = 1"
+    try:
+        for _ in range(3):  # warm the JIT so compile time is not a regression
+            broker.execute(sql)
+        reset_registries()
+
+        # cycle 1: healthy traffic -> no alert
+        for _ in range(4):
+            broker.execute(sql)
+        r1 = agg.run_once()
+        assert r1["transitions"] == []
+        assert _get_json(f"http://127.0.0.1:{csvc.port}/debug/alerts")["alerts"] == []
+
+        # seeded fault slows every segment execution on the one server;
+        # with 3 segments each query is pushed well past the 80ms target
+        FAULTS.configure({"segment.execute": FaultRule(mode="delay", delay_s=0.1)}, seed=1)
+        try:
+            for _ in range(3):
+                broker.execute(sql)
+        finally:
+            FAULTS.reset()
+
+        clock[0] = 10.0
+        r2 = agg.run_once()
+        assert [(t["slo"], t["state"]) for t in r2["transitions"]] == [("p99Latency", "firing")]
+        doc = _get_json(f"http://127.0.0.1:{csvc.port}/debug/alerts")
+        firing = [a for a in doc["alerts"] if a["state"] == "firing"]
+        assert len(firing) == 1
+        alert = firing[0]
+        assert alert["measured"]["p99ShortMs"] > 80.0
+        assert alert["exemplar"] and alert["exemplar"]["traceId"]  # jump-off to /debug/traces
+        assert doc["slo"]["firing"] == 1
+        # the cross-link landed back on the broker over POST /debug/alerts/attach
+        assert any(e.get("alertId") == alert["id"] for e in broker.slow_queries)
+        # ...and the exemplar's trace is fetchable where the runbook points
+        tid = alert["exemplar"]["traceId"]
+        tdoc = _get_json(f"http://127.0.0.1:{bsvc.port}/debug/traces/{tid}")
+        assert tdoc["traceId"] == tid
+
+        # recovery: fast traffic only, advance past the short window
+        for _ in range(4):
+            broker.execute(sql)
+        clock[0] = 321.0
+        r3 = agg.run_once()
+        assert [(t["slo"], t["state"]) for t in r3["transitions"]] == [("p99Latency", "resolved")]
+        doc = _get_json(f"http://127.0.0.1:{csvc.port}/debug/alerts")
+        assert doc["slo"]["firing"] == 0
+        assert len(doc["alerts"]) == 1 and doc["alerts"][0]["state"] == "resolved"
+        assert doc["alerts"][0]["resolvedAtMs"] == pytest.approx(321_000.0)
+    finally:
+        FAULTS.reset()
+        bsvc.stop()
+        csvc.stop()
