@@ -403,7 +403,10 @@ def qps_main():
     the federated SLO plane sees) and once from client-side wall timing
     (what users see) — the two p99s must agree within ~20% or the broker's
     self-reported SLO series can't be trusted for admission-control tuning.
-    Writes BENCH_qps_r08.json and prints the same JSON line.
+    Also snapshots the shared connection pool (common/wire.py) and asserts
+    hits > 0 — 128 clients x 10 queries over pooled keep-alive transport
+    must reuse sockets, not open one per request (ISSUE 10 acceptance).
+    Writes BENCH_qps_r10.json and prints the same JSON line.
 
     Env knobs: PINOT_TPU_QPS_CLIENTS (128), PINOT_TPU_QPS_QUERIES (10 per
     client), PINOT_TPU_QPS_ROWS (120_000 total)."""
@@ -416,6 +419,7 @@ def qps_main():
     from pinot_tpu.common.metrics import broker_metrics, reset_registries
     from pinot_tpu.cluster import Broker, Controller, PropertyStore, Server
     from pinot_tpu.cluster.http import BrokerHTTPService, query_broker_http
+    from pinot_tpu.common.wire import get_pool
     from pinot_tpu.segment import SegmentBuilder
 
     n_clients = int(os.environ.get("PINOT_TPU_QPS_CLIENTS", 128))
@@ -490,6 +494,7 @@ def qps_main():
     for t in threads:
         t.join()
     wall_s = time.perf_counter() - t_run
+    pool_stats = get_pool().stats()
     bsvc.stop()
     shutil.rmtree(root, ignore_errors=True)
 
@@ -521,8 +526,10 @@ def qps_main():
         },
         # broker-vs-client agreement: the acceptance gate is |1 - ratio| <= 0.2
         "p99_agreement": round(broker_p99 / client_p99, 4) if client_p99 else None,
+        "wire_pool": pool_stats,
     }
-    with open("BENCH_qps_r08.json", "w") as f:
+    assert pool_stats["hits"] > 0, f"pooled transport never reused a connection: {pool_stats}"
+    with open("BENCH_qps_r10.json", "w") as f:
         json.dump(result, f, indent=2)
         f.write("\n")
     print(json.dumps(result))

@@ -5,24 +5,33 @@ Reference parity: Pinot's network split — broker REST SQL endpoint
 and the broker<->server data plane (Netty/thrift InstanceRequest,
 pinot-core/.../transport/InstanceRequestHandler.java:69). Here each role
 exposes a ThreadingHTTPServer; the broker->server hop carries
-{table, sql, segments, hints} JSON and returns pickled host-format partials
-(the DataTable bytes analog — trusted intra-cluster links, as in Pinot).
+{table, sql, segments, hints} JSON and returns DataTable-encoded partials
+(the DataTableImplV4 bytes analog — a versioned pure-data wire format,
+never pickle). All client roles (scatter, mailbox sender, controller
+proxy) share the keep-alive connection pool in common/wire.py, and
+handlers speak HTTP/1.1 so one TCP connection carries many requests.
 Intra-pod device collectives (parallel/mesh.py) stay out of this tier.
 """
 
 from __future__ import annotations
 
 import json
+import socket
 import threading
-import urllib.error
-import urllib.request
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from pathlib import Path
+from urllib.parse import urlsplit
 
 from pinot_tpu.cluster.broker import Broker
 from pinot_tpu.cluster.server import Server
 from pinot_tpu.common import datatable
 from pinot_tpu.common.errors import code_of
+from pinot_tpu.common.wire import FRAME_END, FRAME_ERR, get_pool, read_exact
+
+
+def _host_port(base_url: str) -> tuple[str, int]:
+    u = urlsplit(base_url)
+    return u.hostname or "127.0.0.1", u.port or (443 if u.scheme == "https" else 80)
 
 
 def _serve(handler_cls, port: int) -> tuple[ThreadingHTTPServer, int, threading.Thread]:
@@ -32,6 +41,46 @@ def _serve(handler_cls, port: int) -> tuple[ThreadingHTTPServer, int, threading.
         # a deep backlog lets the thread-per-request model absorb the burst
         request_queue_size = 256
 
+        def __init__(self, *args, **kwargs):
+            super().__init__(*args, **kwargs)
+            self._live_conns: set = set()
+            self._conn_lock = threading.Lock()
+
+        def process_request(self, request, client_address):
+            with self._conn_lock:
+                self._live_conns.add(request)
+            super().process_request(request, client_address)
+
+        def shutdown_request(self, request):
+            with self._conn_lock:
+                self._live_conns.discard(request)
+            super().shutdown_request(request)
+
+        def shutdown(self):
+            # stop the accept loop, then force-close accepted keep-alive
+            # sockets: their daemon handler threads otherwise block in
+            # readline() forever, and a pooled client holding the other
+            # end would see an ESTABLISHED socket to a dead service
+            # instead of the FIN that triggers health eviction
+            super().shutdown()
+            self.server_close()
+            with self._conn_lock:
+                conns = list(self._live_conns)
+                self._live_conns.clear()
+            for s in conns:
+                try:
+                    s.shutdown(socket.SHUT_RDWR)
+                except OSError:
+                    pass
+
+    # HTTP/1.1 keep-alive: pooled clients reuse one TCP connection across
+    # requests. Every handler sends Content-Length (or Connection: close on
+    # the unbounded /query/stream), so persistent framing is well-defined.
+    handler_cls.protocol_version = "HTTP/1.1"
+    # TCP_NODELAY: gather-written iovec responses are multiple small sends
+    # per response; on a persistent connection Nagle would stall each one
+    # behind the peer's delayed ACK
+    handler_cls.disable_nagle_algorithm = True
     httpd = _Server(("127.0.0.1", port), handler_cls)
     t = threading.Thread(target=httpd.serve_forever, daemon=True)
     t.start()
@@ -307,7 +356,8 @@ class BrokerHTTPService:
 
 
 class ServerHTTPService:
-    """POST /query {"table","sql","segments","hints"} -> pickled partials.
+    """POST /query {"table","sql","segments","hints"} -> DataTable-encoded
+    partials (v2 iovec segments gather-written straight onto the socket).
     POST /segments/add|/segments/remove carry the Helix state-transition
     messages for cross-process clusters (segment dirs live on a filesystem
     both processes see — the deep-store mount assumption)."""
@@ -411,18 +461,21 @@ class ServerHTTPService:
                                 _hints_with_traceparent(body.get("hints") or {}, self.headers),
                                 max_rows=body.get("maxRows"),
                             ):
-                                payload = datatable.encode(frame)
-                                self.wfile.write(_struct.pack("<I", len(payload)))
-                                self.wfile.write(payload)
+                                # iovec gather-write: length prefix + the
+                                # encoder's segments, no intermediate concat
+                                segments = datatable.encode_segments(frame)
+                                total = sum(len(s) for s in segments)
+                                self.wfile.write(_struct.pack("<I", total))
+                                self.wfile.writelines(segments)
                         except Exception as e:  # mid-stream failure marker
                             # the numeric code rides in the marker text so the
                             # broker side can still classify the failure
                             msg = f"{type(e).__name__}: {e} [errorCode {code_of(e)}]".encode()
-                            self.wfile.write(_struct.pack("<I", 0xFFFFFFFF))
+                            self.wfile.write(_struct.pack("<I", FRAME_ERR))
                             self.wfile.write(_struct.pack("<I", len(msg)))
                             self.wfile.write(msg)
                             return
-                        self.wfile.write(_struct.pack("<I", 0))
+                        self.wfile.write(_struct.pack("<I", FRAME_END))
                     except (BrokenPipeError, ConnectionResetError):
                         pass  # broker closed early: expected fast-path exit
                     return
@@ -455,12 +508,15 @@ class ServerHTTPService:
                     self.wfile.write(payload)
                     return
                 with phase_timer(ServerQueryPhase.RESPONSE_SERIALIZATION, role="server"):
-                    payload = datatable.encode(out)
+                    # iovec encode: header scratch + zero-copy column views;
+                    # writelines() gather-writes them without materializing
+                    # the payload a second time (no BytesIO/getvalue concat)
+                    segments = datatable.encode_segments(out)
                 self.send_response(200)
                 self.send_header("Content-Type", "application/x-pinot-datatable")
-                self.send_header("Content-Length", str(len(payload)))
+                self.send_header("Content-Length", str(sum(len(s) for s in segments)))
                 self.end_headers()
-                self.wfile.write(payload)
+                self.wfile.writelines(segments)
 
             def do_GET(self):
                 if self.path == "/health":
@@ -529,13 +585,17 @@ class ServerHTTPService:
 
 class RemoteServerClient:
     """Broker-side handle to a server over HTTP; mirrors Server's
-    execute_partials/add_segment surface (QueryRouter connection analog)."""
+    execute_partials/add_segment surface (QueryRouter connection analog).
+    All requests ride pooled keep-alive connections from common/wire.py —
+    one TCP connection per (broker, server) pair carries many scatter hops
+    instead of a fresh connect per request."""
 
     def __init__(self, base_url: str, timeout: float = 10.0):
         """timeout: per-hop data-plane timeout (Pinot brokerTimeoutMs analog).
         A dead/hung server must fail the query quickly, not stall the broker."""
         self.base_url = base_url.rstrip("/")
         self.timeout = timeout
+        self._host, self._port = _host_port(self.base_url)
 
     def _hop_timeout(self, hints: dict | None) -> float:
         """Per-call socket timeout bounded by the query deadline riding in the
@@ -567,12 +627,22 @@ class RemoteServerClient:
         body = json.dumps(
             {"table": table, "sql": sql, "segments": segment_names, "hints": hints}
         ).encode()
-        req = urllib.request.Request(self.base_url + "/query", data=body, headers=headers)
         try:
-            with urllib.request.urlopen(req, timeout=self._hop_timeout(hints)) as resp:
-                return datatable.decode(resp.read())
-        except urllib.error.HTTPError as e:
-            detail = e.read().decode(errors="replace")
+            with get_pool().request(
+                self._host,
+                self._port,
+                "POST",
+                "/query",
+                body=body,
+                headers=headers,
+                timeout_s=self._hop_timeout(hints),
+            ) as resp:
+                payload = resp.read()
+                status = resp.status
+        except (TimeoutError, OSError) as e:
+            raise RuntimeError(f"server {self.base_url} unreachable: {e}") from None
+        if status >= 400:
+            detail = bytes(payload).decode(errors="replace")
             err = RuntimeError(f"server error from {self.base_url}: {detail}")
             try:
                 kill = json.loads(detail).get("killReason")
@@ -581,8 +651,7 @@ class RemoteServerClient:
             if kill:
                 err.kill_reason = kill  # re-attach across the HTTP hop
             raise err from None
-        except (TimeoutError, OSError) as e:
-            raise RuntimeError(f"server {self.base_url} unreachable: {e}") from None
+        return datatable.decode(payload)
 
     def cancel_query(self, qid: str) -> bool:
         """Fan-out target for Broker.cancel_query; False when the server
@@ -611,9 +680,22 @@ class RemoteServerClient:
                 "maxRows": max_rows,
             }
         ).encode()
-        req = urllib.request.Request(self.base_url + "/query/stream", data=body, headers=headers)
-        resp = urllib.request.urlopen(req, timeout=self._hop_timeout(hints))
         try:
+            resp = get_pool().request(
+                self._host,
+                self._port,
+                "POST",
+                "/query/stream",
+                body=body,
+                headers=headers,
+                timeout_s=self._hop_timeout(hints),
+            )
+        except (TimeoutError, OSError) as e:
+            raise RuntimeError(f"server {self.base_url} unreachable: {e}") from None
+        try:
+            # frame-by-frame: each frame decodes (zero-copy views over its
+            # own receive buffer) as it arrives — the full result set never
+            # materializes on the broker side
             while True:
                 hdr = resp.read(4)
                 if len(hdr) < 4:
@@ -628,23 +710,36 @@ class RemoteServerClient:
                     raise RuntimeError(
                         f"server error from {self.base_url}: {resp.read(elen).decode(errors='replace')}"
                     )
-                yield datatable.decode(resp.read(n))
+                try:
+                    frame = read_exact(resp, n)
+                except OSError:
+                    raise RuntimeError(
+                        f"server {self.base_url} stream truncated mid-response"
+                    ) from None
+                yield datatable.decode(frame)
         finally:
             resp.close()
 
     def _post_json(self, path: str, doc: dict) -> dict:
         body = json.dumps(doc).encode()
-        req = urllib.request.Request(
-            self.base_url + path, data=body, headers={"Content-Type": "application/json"}
-        )
         try:
-            with urllib.request.urlopen(req, timeout=self.timeout) as resp:
-                return json.loads(resp.read())
-        except urllib.error.HTTPError as e:
-            detail = e.read().decode(errors="replace")
-            raise RuntimeError(f"server error from {self.base_url}: {detail}") from None
+            with get_pool().request(
+                self._host,
+                self._port,
+                "POST",
+                path,
+                body=body,
+                headers={"Content-Type": "application/json"},
+                timeout_s=self.timeout,
+            ) as resp:
+                payload = resp.read()
+                status = resp.status
         except (TimeoutError, OSError) as e:
             raise RuntimeError(f"server {self.base_url} unreachable: {e}") from None
+        if status >= 400:
+            detail = bytes(payload).decode(errors="replace")
+            raise RuntimeError(f"server error from {self.base_url}: {detail}") from None
+        return json.loads(payload)
 
     def add_segment(self, table: str, segment_name: str, seg_dir) -> None:
         self._post_json("/segments/add", {"table": table, "segment": segment_name, "dir": str(seg_dir)})
@@ -653,8 +748,8 @@ class RemoteServerClient:
         self._post_json("/segments/remove", {"table": table, "segment": segment_name})
 
     def segments_of(self, table: str) -> list[str]:
-        with urllib.request.urlopen(
-            f"{self.base_url}/segments/{table}", timeout=self.timeout
+        with get_pool().request(
+            self._host, self._port, "GET", f"/segments/{table}", timeout_s=self.timeout
         ) as resp:
             return json.loads(resp.read())
 
@@ -796,14 +891,15 @@ class ControllerHTTPService:
                         qid = parts[1]
                         cancelled_on = []
                         for bid, base_url in sorted(c.brokers().items()):
-                            req = urllib.request.Request(
-                                f"{base_url.rstrip('/')}/query/{qid}", method="DELETE"
-                            )
+                            bhost, bport = _host_port(base_url.rstrip("/"))
                             try:
-                                with urllib.request.urlopen(req, timeout=5.0) as resp:
-                                    if json.loads(resp.read()).get("cancelled"):
+                                with get_pool().request(
+                                    bhost, bport, "DELETE", f"/query/{qid}", timeout_s=5.0
+                                ) as resp:
+                                    body = resp.read()
+                                    if resp.status < 400 and json.loads(body).get("cancelled"):
                                         cancelled_on.append(bid)
-                            except (urllib.error.URLError, OSError):
+                            except (ValueError, OSError):
                                 continue
                         self._json(
                             {"queryId": qid, "cancelled": bool(cancelled_on), "brokers": cancelled_on},
@@ -912,25 +1008,37 @@ class ControllerHTTPService:
 
 class RemoteControllerClient:
     """Client-side controller handle over REST (used by CLI/clients and by
-    broker processes running apart from the controller)."""
+    broker processes running apart from the controller). Control-plane
+    calls share the same keep-alive pool as the data plane."""
 
     def __init__(self, base_url: str, timeout: float = 30.0):
         self.base_url = base_url.rstrip("/")
         self.timeout = timeout
+        self._host, self._port = _host_port(self.base_url)
 
     def _get(self, path: str) -> dict:
-        with urllib.request.urlopen(self.base_url + path, timeout=self.timeout) as resp:
-            return json.loads(resp.read())
+        with get_pool().request(self._host, self._port, "GET", path, timeout_s=self.timeout) as resp:
+            payload = resp.read()
+            if resp.status >= 400:
+                raise RuntimeError(
+                    f"controller error ({resp.status}): {bytes(payload).decode(errors='replace')}"
+                )
+        return json.loads(payload)
 
     def _post(self, path: str, data: bytes, content_type: str = "application/json") -> dict:
-        req = urllib.request.Request(
-            self.base_url + path, data=data, headers={"Content-Type": content_type}
-        )
-        try:
-            with urllib.request.urlopen(req, timeout=self.timeout) as resp:
-                return json.loads(resp.read())
-        except urllib.error.HTTPError as e:
-            raise RuntimeError(f"controller error: {e.read().decode(errors='replace')}") from None
+        with get_pool().request(
+            self._host,
+            self._port,
+            "POST",
+            path,
+            body=data,
+            headers={"Content-Type": content_type},
+            timeout_s=self.timeout,
+        ) as resp:
+            payload = resp.read()
+            if resp.status >= 400:
+                raise RuntimeError(f"controller error: {bytes(payload).decode(errors='replace')}")
+        return json.loads(payload)
 
     def health(self) -> bool:
         try:
@@ -958,7 +1066,7 @@ class RemoteControllerClient:
 
         try:
             return TableConfig.from_json(json.dumps(self._get(f"/tables/{name}")))
-        except (urllib.error.HTTPError, RuntimeError):
+        except RuntimeError:
             return None
 
     def get_schema(self, name: str):
@@ -966,7 +1074,7 @@ class RemoteControllerClient:
 
         try:
             return Schema.from_json(json.dumps(self._get(f"/tables/{name}/schema")))
-        except (urllib.error.HTTPError, RuntimeError):
+        except RuntimeError:
             return None
 
     def servers(self) -> dict[str, object]:
@@ -985,12 +1093,13 @@ class RemoteControllerClient:
         self._post("/tables", config.to_json().encode())
 
     def _delete(self, path: str) -> dict:
-        req = urllib.request.Request(self.base_url + path, method="DELETE")
-        try:
-            with urllib.request.urlopen(req, timeout=self.timeout) as resp:
-                return json.loads(resp.read())
-        except urllib.error.HTTPError as e:
-            raise RuntimeError(f"controller error: {e.read().decode(errors='replace')}") from None
+        with get_pool().request(
+            self._host, self._port, "DELETE", path, timeout_s=self.timeout
+        ) as resp:
+            payload = resp.read()
+            if resp.status >= 400:
+                raise RuntimeError(f"controller error: {bytes(payload).decode(errors='replace')}")
+        return json.loads(payload)
 
     def delete_table(self, name: str) -> dict:
         return self._delete(f"/tables/{name}")
@@ -1037,10 +1146,22 @@ class RemoteControllerClient:
 
 
 def query_broker_http(base_url: str, sql: str) -> dict:
-    """Client helper: POST a SQL query to a broker endpoint."""
+    """Client helper: POST a SQL query to a broker endpoint over a pooled
+    keep-alive connection."""
+    host, port = _host_port(base_url.rstrip("/"))
     body = json.dumps({"sql": sql}).encode()
-    req = urllib.request.Request(
-        base_url.rstrip("/") + "/query/sql", data=body, headers={"Content-Type": "application/json"}
-    )
-    with urllib.request.urlopen(req, timeout=60) as resp:
-        return json.loads(resp.read())
+    with get_pool().request(
+        host,
+        port,
+        "POST",
+        "/query/sql",
+        body=body,
+        headers={"Content-Type": "application/json"},
+        timeout_s=60,
+    ) as resp:
+        payload = resp.read()
+        if resp.status >= 400:
+            raise RuntimeError(
+                f"broker error ({resp.status}): {bytes(payload).decode(errors='replace')}"
+            )
+    return json.loads(payload)

@@ -8,6 +8,18 @@ results cross the wire in this format instead of pickle: decoding is pure
 data (no code execution), the layout is versioned, and numpy buffers are
 written contiguously so the hot path is one memcpy per column.
 
+Version 2 (current) is iovec-style: `encode_segments()` returns a list of
+bytes-like segments — small header fields coalesced into scratch buffers,
+large column payloads appended as zero-copy memoryviews over the source
+arrays — which callers hand to `BufferedWriter.writelines()` (the writev
+analog) without ever concatenating. String columns are vectorized both
+ways: encode factorizes to a dictionary (uniques + int32 codes) when the
+column compresses, else writes one NUL-joined utf8 blob; decode is one
+`str.split` or one fancy-index take instead of a per-item Python loop.
+Version 1 payloads (per-value BytesIO stream, per-item string loop) still
+decode; `encode_v1` is kept for compatibility tests and version-negotiation
+fallback.
+
 Supported values: None, bool, int, float, str, bytes, list, tuple, set,
 dict, numpy scalars/arrays (object arrays encode element-wise), and pandas
 DataFrames (encoded columnar: the DataBlock analog).
@@ -22,7 +34,13 @@ import numpy as np
 import pandas as pd
 
 MAGIC = b"PTDT"
-VERSION = 1
+VERSION = 2
+#: versions this decoder accepts (version negotiation: a v2 node still
+#: reads v1 payloads from an old peer mid-rollout)
+DECODE_VERSIONS = (1, 2)
+
+#: single-segment / single-field ceiling: every length on the wire is u32
+_MAX_LEN = 0xFFFFFFFF
 
 _T_NONE = 0
 _T_BOOL = 1
@@ -37,15 +55,247 @@ _T_DICT = 9
 _T_NDARRAY = 10
 _T_OBJARRAY = 11
 _T_DATAFRAME = 12
-_T_STRARRAY = 13  # all-string object array: offsets + one utf8 blob
+_T_STRARRAY = 13  # v1: per-item byte-length array + concatenated utf8 blob
+_T_STRBLOB = 14  # v2: one utf8 blob, NUL-joined (mode 0) or char-offset (mode 1)
+_T_STRDICT = 15  # v2: dictionary-encoded strings — uniques blob + int32 codes
 
 
 class DataTableError(ValueError):
     pass
 
 
+_U32 = struct.Struct("<I")
+_U32x2 = struct.Struct("<II")
+
+
+class _SegWriter:
+    """Iovec accumulator. Small writes coalesce into a scratch bytearray;
+    large bytes-like payloads (column buffers, blobs) are appended as-is —
+    zero-copy views that stay alive via the segment list. `segments()`
+    yields what `writelines()` / `b"".join()` consume directly."""
+
+    __slots__ = ("_segs", "_scratch")
+
+    #: below this, appending a dedicated iovec segment costs more than the
+    #: memcpy into scratch (syscall/iteration overhead per segment)
+    INLINE_CUTOFF = 4096
+
+    def __init__(self):
+        self._segs: list = []
+        self._scratch = bytearray()
+
+    def raw(self, b) -> None:
+        if len(b) >= self.INLINE_CUTOFF:
+            if self._scratch:
+                self._segs.append(self._scratch)
+                self._scratch = bytearray()
+            self._segs.append(b)
+        else:
+            self._scratch += b
+
+    def u8(self, v: int) -> None:
+        self._scratch.append(v)
+
+    def u32(self, v: int) -> None:
+        if v > _MAX_LEN:
+            raise DataTableError("DataTable field exceeds u32 length limit (>4 GB)")
+        self._scratch += _U32.pack(v)
+
+    def s(self, s: str) -> None:
+        b = s.encode()
+        self.u32(len(b))
+        self.raw(b)
+
+    def segments(self) -> list:
+        if self._scratch:
+            self._segs.append(self._scratch)
+            self._scratch = bytearray()
+        return self._segs
+
+
+try:  # specialized C hashtable: utf8 hashing without PyObject_Hash dispatch
+    from pandas._libs import hashtable as _pd_hashtable
+except ImportError:  # pragma: no cover - pandas internals moved
+    _pd_hashtable = None
+
+
+def _factorize_str(flat: np.ndarray):
+    """(codes int64, uniques object) for an all-str object array, else None.
+
+    StringHashTable marks every non-str element (ints, None, NaN, nested
+    containers) with the -1 sentinel instead of raising, so one codes.min()
+    doubles as the all-str check — no 200k-iteration isinstance pass."""
+    if _pd_hashtable is not None:
+        try:
+            table = _pd_hashtable.StringHashTable(min(flat.size, 1 << 20))
+            uniques, codes = table.factorize(flat)
+        except (TypeError, ValueError):
+            return None
+        if codes.min() < 0:
+            return None
+        return codes, uniques
+    try:
+        codes, uniques = pd.factorize(flat, use_na_sentinel=True)
+    except TypeError:
+        return None
+    if codes.min() < 0 or not all(isinstance(u, str) for u in uniques):
+        return None
+    return codes, uniques
+
+
+def _encode_obj_array(out: _SegWriter, v: np.ndarray) -> None:
+    flat = v.ravel()
+    n = flat.size
+    lst = None
+    if n >= 64:
+        fact = _factorize_str(flat)
+        if fact is not None:
+            codes, uniques = fact
+            if 2 * len(uniques) <= n:
+                # dictionary-encoded: decode is one fancy-index take that
+                # shares the uniques' PyUnicode objects — no per-item alloc
+                out.u8(_T_STRDICT)
+                out.u32(v.ndim)
+                for d in v.shape:
+                    out.u32(d)
+                _encode_str_blob(out, uniques.tolist())
+                out.u32(n)
+                out.raw(memoryview(np.ascontiguousarray(codes, dtype=np.int32)).cast("B"))
+            else:
+                out.u8(_T_STRBLOB)
+                out.u32(v.ndim)
+                for d in v.shape:
+                    out.u32(d)
+                _encode_str_blob(out, flat.tolist())
+            return
+    else:
+        lst = flat.tolist()
+        if lst and all(isinstance(x, str) for x in lst):
+            out.u8(_T_STRBLOB)
+            out.u32(v.ndim)
+            for d in v.shape:
+                out.u32(d)
+            _encode_str_blob(out, lst)
+            return
+    out.u8(_T_OBJARRAY)
+    out.u32(v.ndim)
+    for d in v.shape:
+        out.u32(d)
+    for item in lst if lst is not None else flat.tolist():
+        _encode_value(out, item)
+
+
+def _encode_str_blob(out: _SegWriter, lst: list) -> None:
+    """One utf8 blob for a flat list of str. Mode 0 (NUL separators, decode
+    is a single split) when no element contains NUL; mode 1 (uint32 char
+    lengths, offsets rebuilt via np.cumsum) otherwise."""
+    n = len(lst)
+    joined = "\x00".join(lst)
+    if joined.count("\x00") == max(n - 1, 0):
+        out.u8(0)
+        out.u32(n)
+        blob = joined.encode()
+        out.u32(len(blob))
+        out.raw(blob)
+    else:
+        out.u8(1)
+        out.u32(n)
+        lengths = np.fromiter((len(s) for s in lst), dtype=np.uint32, count=n)
+        out.raw(memoryview(lengths).cast("B"))
+        blob = "".join(lst).encode()
+        out.u32(len(blob))
+        out.raw(blob)
+
+
+def _encode_value(out: _SegWriter, v) -> None:
+    if v is None:
+        out.u8(_T_NONE)
+    elif isinstance(v, (bool, np.bool_)):
+        out.u8(_T_BOOL)
+        out.u8(1 if v else 0)
+    elif isinstance(v, (int, np.integer)):
+        out.u8(_T_INT)
+        out.raw(struct.pack("<q", int(v)))
+    elif isinstance(v, (float, np.floating)):
+        out.u8(_T_FLOAT)
+        out.raw(struct.pack("<d", float(v)))
+    elif isinstance(v, str):
+        out.u8(_T_STR)
+        out.s(v)
+    elif isinstance(v, (bytes, bytearray)):
+        out.u8(_T_BYTES)
+        out.u32(len(v))
+        out.raw(v)
+    elif isinstance(v, pd.DataFrame):
+        out.u8(_T_DATAFRAME)
+        out.u32(len(v.columns))
+        for col in v.columns:
+            out.s(str(col))
+            _encode_value(out, v[col].to_numpy())
+    elif isinstance(v, np.ndarray):
+        if v.dtype == object:
+            _encode_obj_array(out, v)
+        else:
+            out.u8(_T_NDARRAY)
+            out.s(v.dtype.str)  # includes endianness, e.g. '<i8'
+            out.u32(v.ndim)
+            for d in v.shape:
+                out.u32(d)
+            # guard BEFORE ascontiguousarray: a broadcast view can claim
+            # petabytes of logical bytes without owning them
+            if v.nbytes > _MAX_LEN:
+                raise DataTableError("DataTable field exceeds u32 length limit (>4 GB)")
+            data = v if v.flags.c_contiguous else np.ascontiguousarray(v)
+            out.u32(data.nbytes)
+            # uint8 view: no intermediate tobytes() copy, and unlike a raw
+            # memoryview cast it also handles datetime64/timedelta64
+            # (dtype 'M'/'m' can't export a buffer directly)
+            out.raw(memoryview(data.view(np.uint8)))
+    elif isinstance(v, (list, tuple, set)):
+        tag = _T_LIST if isinstance(v, list) else _T_TUPLE if isinstance(v, tuple) else _T_SET
+        out.u8(tag)
+        items = sorted(v, key=repr) if isinstance(v, set) else v
+        out.u32(len(items))
+        for item in items:
+            _encode_value(out, item)
+    elif isinstance(v, dict):
+        out.u8(_T_DICT)
+        out.u32(len(v))
+        for k, val in v.items():
+            _encode_value(out, k)
+            _encode_value(out, val)
+    else:
+        raise DataTableError(f"unsupported type for DataTable encoding: {type(v).__name__}")
+
+
+def encode_segments(value) -> list:
+    """Serialize to a list of bytes-like segments (header + zero-copy column
+    views). Hand to `writelines()` for a gather-write; `sum(len(s) for s in
+    segs)` is the Content-Length. Segments reference the source arrays —
+    keep the value alive until the write completes."""
+    out = _SegWriter()
+    out.raw(MAGIC)
+    out.raw(struct.pack("<H", VERSION))
+    _encode_value(out, value)
+    return out.segments()
+
+
+def encode(value) -> bytes:
+    """Serialize any supported partial-result structure to one buffer."""
+    segs = encode_segments(value)
+    if len(segs) == 1:
+        return bytes(segs[0])
+    return b"".join(segs)
+
+
+# ---------------------------------------------------------------------------
+# v1 encoder — kept for version-negotiation fallback and backward-decode
+# tests. Layout is identical to the historical VERSION=1 wire format.
+# ---------------------------------------------------------------------------
+
+
 def _w_u32(out: BytesIO, v: int) -> None:
-    out.write(struct.pack("<I", v))
+    out.write(_U32.pack(v))
 
 
 def _w_str(out: BytesIO, s: str) -> None:
@@ -54,7 +304,7 @@ def _w_str(out: BytesIO, s: str) -> None:
     out.write(b)
 
 
-def _encode_value(out: BytesIO, v) -> None:
+def _encode_value_v1(out: BytesIO, v) -> None:
     if v is None:
         out.write(bytes([_T_NONE]))
     elif isinstance(v, (bool, np.bool_)):
@@ -77,14 +327,11 @@ def _encode_value(out: BytesIO, v) -> None:
         _w_u32(out, len(v.columns))
         for col in v.columns:
             _w_str(out, str(col))
-            _encode_value(out, v[col].to_numpy())
+            _encode_value_v1(out, v[col].to_numpy())
     elif isinstance(v, np.ndarray):
         if v.dtype == object:
             flat = v.ravel()
             if flat.size and all(isinstance(x, str) for x in flat):
-                # var-byte string column (VarByteChunk forward index analog):
-                # one length array + one concatenated utf8 blob, no per-item
-                # tag overhead — the hot shape for group keys on the wire
                 out.write(bytes([_T_STRARRAY]))
                 _w_u32(out, v.ndim)
                 for d in v.shape:
@@ -101,18 +348,15 @@ def _encode_value(out: BytesIO, v) -> None:
             for d in v.shape:
                 _w_u32(out, d)
             for item in flat:
-                _encode_value(out, item)
+                _encode_value_v1(out, item)
         else:
             out.write(bytes([_T_NDARRAY]))
-            _w_str(out, v.dtype.str)  # includes endianness, e.g. '<i8'
+            _w_str(out, v.dtype.str)
             _w_u32(out, v.ndim)
             for d in v.shape:
                 _w_u32(out, d)
             data = np.ascontiguousarray(v)
             _w_u32(out, data.nbytes)
-            # uint8 view write: no intermediate tobytes() copy, and unlike a
-            # raw memoryview cast it also handles datetime64/timedelta64
-            # (dtype 'M'/'m' can't export a buffer directly)
             out.write(memoryview(data.view(np.uint8)))
     elif isinstance(v, (list, tuple, set)):
         tag = _T_LIST if isinstance(v, list) else _T_TUPLE if isinstance(v, tuple) else _T_SET
@@ -120,24 +364,34 @@ def _encode_value(out: BytesIO, v) -> None:
         items = sorted(v, key=repr) if isinstance(v, set) else v
         _w_u32(out, len(items))
         for item in items:
-            _encode_value(out, item)
+            _encode_value_v1(out, item)
     elif isinstance(v, dict):
         out.write(bytes([_T_DICT]))
         _w_u32(out, len(v))
         for k, val in v.items():
-            _encode_value(out, k)
-            _encode_value(out, val)
+            _encode_value_v1(out, k)
+            _encode_value_v1(out, val)
     else:
         raise DataTableError(f"unsupported type for DataTable encoding: {type(v).__name__}")
 
 
-def encode(value) -> bytes:
-    """Serialize any supported partial-result structure."""
+def encode_v1(value) -> bytes:
+    """Serialize in the legacy VERSION=1 layout (per-value BytesIO stream).
+    Used by compatibility tests and as the negotiation fallback for peers
+    that predate v2."""
     out = BytesIO()
     out.write(MAGIC)
-    out.write(struct.pack("<H", VERSION))
-    _encode_value(out, value)
+    out.write(struct.pack("<H", 1))
+    _encode_value_v1(out, value)
     return out.getvalue()
+
+
+# ---------------------------------------------------------------------------
+# decode — shared across versions; v2-only tags simply never appear in v1
+# payloads. Every length/count is bounds-checked against the remaining
+# buffer BEFORE allocation, so adversarial payloads fail with DataTableError
+# instead of MemoryError/struct.error.
+# ---------------------------------------------------------------------------
 
 
 class _Reader:
@@ -152,7 +406,7 @@ class _Reader:
         self.pos = 0
 
     def take(self, n: int) -> memoryview:
-        if self.pos + n > len(self.buf):
+        if n < 0 or self.pos + n > len(self.buf):
             raise DataTableError("truncated DataTable payload")
         b = self.buf[self.pos : self.pos + n]
         self.pos += n
@@ -162,10 +416,67 @@ class _Reader:
         return self.take(1)[0]
 
     def u32(self) -> int:
-        return struct.unpack("<I", self.take(4))[0]
+        return _U32.unpack(self.take(4))[0]
 
     def s(self) -> str:
-        return bytes(self.take(self.u32())).decode()
+        return _utf8(self.take(self.u32()))
+
+    def count(self, n: int, unit: int = 1) -> int:
+        """Validate a declared element count against the bytes actually
+        remaining (each element needs >= `unit` bytes) before allocating."""
+        if n * unit > len(self.buf) - self.pos:
+            raise DataTableError("truncated DataTable payload")
+        return n
+
+    def shape(self) -> tuple:
+        ndim = self.u32()
+        if ndim > 32:  # numpy's own dimension limit
+            raise DataTableError("corrupt DataTable payload: bad ndim")
+        return tuple(self.u32() for _ in range(ndim))
+
+
+def _utf8(b) -> str:
+    try:
+        return bytes(b).decode()
+    except UnicodeDecodeError as e:
+        raise DataTableError(f"corrupt DataTable payload: invalid utf-8 ({e})") from e
+
+
+def _shape_size(r: _Reader, shape: tuple, unit: int = 1) -> int:
+    n = 1
+    for d in shape:
+        n *= d
+    return r.count(n, unit)
+
+
+def _decode_str_blob(r: _Reader):
+    mode = r.u8()
+    n = r.count(r.u32())
+    if mode == 0:
+        text = _utf8(r.take(r.u32()))
+        if n == 0:
+            if text:
+                raise DataTableError("corrupt DataTable payload: non-empty blob for empty array")
+            return []
+        parts = text.split("\x00")
+        if len(parts) != n:
+            raise DataTableError("corrupt DataTable payload: string blob separator mismatch")
+        return parts
+    if mode == 1:
+        lengths = np.frombuffer(r.take(4 * n), dtype=np.uint32)
+        text = _utf8(r.take(r.u32()))
+        ends = np.cumsum(lengths, dtype=np.int64)
+        if n and ends[-1] != len(text):
+            raise DataTableError("corrupt DataTable payload: string blob length mismatch")
+        starts = ends - lengths
+        return [text[s:e] for s, e in zip(starts.tolist(), ends.tolist())]
+    raise DataTableError(f"unknown DataTable string-blob mode {mode}")
+
+
+def _obj_array(parts: list, shape: tuple) -> np.ndarray:
+    arr = np.empty(len(parts), dtype=object)
+    arr[:] = parts
+    return arr.reshape(shape)
 
 
 def _decode_value(r: _Reader):
@@ -183,53 +494,101 @@ def _decode_value(r: _Reader):
     if tag == _T_BYTES:
         return bytes(r.take(r.u32()))
     if tag == _T_LIST:
-        return [_decode_value(r) for _ in range(r.u32())]
+        return [_decode_value(r) for _ in range(r.count(r.u32()))]
     if tag == _T_TUPLE:
-        return tuple(_decode_value(r) for _ in range(r.u32()))
+        return tuple(_decode_value(r) for _ in range(r.count(r.u32())))
     if tag == _T_SET:
-        return {_decode_value(r) for _ in range(r.u32())}
+        return {_decode_value(r) for _ in range(r.count(r.u32()))}
     if tag == _T_DICT:
-        return {_decode_value(r): _decode_value(r) for _ in range(r.u32())}
+        return {_decode_value(r): _decode_value(r) for _ in range(r.count(r.u32(), 2))}
     if tag == _T_NDARRAY:
-        dt = np.dtype(r.s())
-        shape = tuple(r.u32() for _ in range(r.u32()))
+        try:
+            dt = np.dtype(r.s())
+        except (TypeError, ValueError, UnicodeDecodeError) as e:
+            raise DataTableError(f"corrupt DataTable payload: bad dtype ({e})") from None
+        shape = r.shape()
         data = r.take(r.u32())
-        # zero-copy: a read-only view over the receive buffer; consumers
-        # that mutate must copy (pandas copies on write anyway)
-        return np.frombuffer(data, dtype=dt).reshape(shape)
+        try:
+            # zero-copy: a read-only view over the receive buffer; consumers
+            # that mutate must copy (pandas copies on write anyway)
+            return np.frombuffer(data, dtype=dt).reshape(shape)
+        except (TypeError, ValueError) as e:
+            raise DataTableError(f"corrupt DataTable payload: bad array ({e})") from None
     if tag == _T_STRARRAY:
-        shape = tuple(r.u32() for _ in range(r.u32()))
-        n = int(np.prod(shape, dtype=np.int64)) if shape else 1
+        shape = r.shape()
+        n = _shape_size(r, shape, 4)
         lengths = np.frombuffer(r.take(4 * n), dtype=np.uint32)
         blob = bytes(r.take(r.u32()))
-        arr = np.empty(n, dtype=object)
-        pos = 0
-        for i, ln in enumerate(lengths):
-            arr[i] = blob[pos : pos + ln].decode()
-            pos += ln
-        return arr.reshape(shape)
+        ends = np.cumsum(lengths, dtype=np.int64)
+        if n and ends[-1] != len(blob):
+            raise DataTableError("corrupt DataTable payload: string blob length mismatch")
+        starts = ends - lengths
+        return _obj_array(
+            [_utf8(blob[s:e]) for s, e in zip(starts.tolist(), ends.tolist())], shape
+        )
+    if tag == _T_STRBLOB:
+        shape = r.shape()
+        parts = _decode_str_blob(r)
+        if not _shape_matches(parts, shape):
+            raise DataTableError("corrupt DataTable payload: string array shape mismatch")
+        return _obj_array(parts, shape)
+    if tag == _T_STRDICT:
+        shape = r.shape()
+        parts = _decode_str_blob(r)
+        uniq = np.empty(len(parts), dtype=object)
+        uniq[:] = parts
+        n = r.count(r.u32(), 4)
+        codes = np.frombuffer(r.take(4 * n), dtype=np.int32)
+        if n and (codes.max(initial=0) >= len(uniq) or codes.min(initial=0) < 0):
+            raise DataTableError("corrupt DataTable payload: string dictionary code out of range")
+        # fancy-index take: the decoded array shares the dictionary's
+        # PyUnicode objects — a pointer copy, no per-item materialization
+        try:
+            return uniq[codes].reshape(shape)
+        except ValueError as e:
+            raise DataTableError(f"corrupt DataTable payload: bad string array ({e})") from None
     if tag == _T_OBJARRAY:
-        shape = tuple(r.u32() for _ in range(r.u32()))
-        n = int(np.prod(shape, dtype=np.int64)) if shape else 1
+        shape = r.shape()
+        n = _shape_size(r, shape)
         arr = np.empty(n, dtype=object)
         for i in range(n):
             arr[i] = _decode_value(r)
-        return arr.reshape(shape)
+        try:
+            return arr.reshape(shape)
+        except ValueError as e:
+            raise DataTableError(f"corrupt DataTable payload: bad array ({e})") from None
     if tag == _T_DATAFRAME:
         data = {}
-        for _ in range(r.u32()):
+        for _ in range(r.count(r.u32())):
             name = r.s()
             data[name] = _decode_value(r)
-        return pd.DataFrame(data)
+        try:
+            # copy=False: numeric columns stay zero-copy views over the
+            # receive buffer where pandas' block layout allows it
+            return pd.DataFrame(data, copy=False)
+        except ValueError as e:
+            raise DataTableError(f"corrupt DataTable payload: bad DataFrame ({e})") from None
     raise DataTableError(f"unknown DataTable tag {tag}")
 
 
-def decode(payload: bytes):
-    if payload[:4] != MAGIC:
+def _shape_matches(parts: list, shape: tuple) -> bool:
+    n = 1
+    for d in shape:
+        n *= d
+    return n == len(parts)
+
+
+def decode(payload):
+    """Decode a v1 or v2 payload (bytes-like). Raises DataTableError — and
+    only DataTableError — on any malformed input."""
+    buf = memoryview(payload)
+    if len(buf) < 6:
+        raise DataTableError("truncated DataTable payload")
+    if bytes(buf[:4]) != MAGIC:
         raise DataTableError("bad DataTable magic")
-    (version,) = struct.unpack("<H", payload[4:6])
-    if version != VERSION:
+    version = buf[4] | (buf[5] << 8)
+    if version not in DECODE_VERSIONS:
         raise DataTableError(f"unsupported DataTable version {version}")
-    r = _Reader(payload)
+    r = _Reader(buf)
     r.pos = 6
     return _decode_value(r)

@@ -14,6 +14,7 @@ Well-known points (wired in this repo):
     segment.execute  — QueryEngine partial resolution, per segment
     server.scatter   — Server.execute_partials entry (v1 scatter target)
     stream.consume   — Server.execute_partials_stream, per yielded frame
+    wire.connect     — ConnectionPool._connect, before the TCP connect
 """
 
 from __future__ import annotations
@@ -37,6 +38,7 @@ FAULT_POINTS = frozenset(
         "segment.execute",  # per-segment execution (v1 engine + v2 leaf scan)
         "server.scatter",  # Server.execute_partials entry (v1 scatter target)
         "stream.consume",  # Server.execute_partials_stream, per yielded frame
+        "wire.connect",  # ConnectionPool._connect, before the TCP connect
     }
 )
 

@@ -8,10 +8,11 @@ blocks travel as DataTable-encoded payloads POSTed to the receiving process's
 /mailbox endpoint; same-process pairs short-circuit through the in-memory
 queues exactly like InMemorySendingMailbox.
 
-Envelope format (one POST per block):
+Envelope format (one POST per block, over a pooled keep-alive connection —
+one persistent socket per peer instead of a fresh urlopen per block):
     4-byte little-endian header length | JSON header | body bytes
     header: {"qid", "rs", "rw", "ss", "kind": "block"|"eos"|"err", "msg"?}
-    body:   datatable.encode(DataFrame) for kind=block, empty otherwise
+    body:   DataTable v2 segments for kind=block, empty otherwise
 """
 
 from __future__ import annotations
@@ -21,36 +22,43 @@ import random
 import struct
 import threading
 import time
-import urllib.error
-import urllib.request
 
 import pandas as pd
 
 from pinot_tpu.common import datatable
+from pinot_tpu.common.wire import get_pool
 from pinot_tpu.multistage import runtime as R
 
 
-def encode_envelope(qid: str, rs: int, rw: int, ss: int, payload) -> bytes:
+def encode_envelope_segments(qid: str, rs: int, rw: int, ss: int, payload) -> list:
     """payload: DataFrame | runtime._EOS | ("__eos__", [stats]) |
     ("__err__", msg[, code]). A stats-carrying EOS ships the sender's
     accumulated OperatorStats records in the header (trailing-EOS-block
     parity); an error marker ships the sender's numeric error code so a
-    deadline/cancel failure keeps its class across processes."""
+    deadline/cancel failure keeps its class across processes.
+
+    Returns iovec segments ([len+header] + zero-copy DataTable column
+    views) for a gather-write over the pooled transport."""
     if isinstance(payload, pd.DataFrame):
         header = {"qid": qid, "rs": rs, "rw": rw, "ss": ss, "kind": "block"}
-        body = datatable.encode(payload)
+        body_segments = datatable.encode_segments(payload)
     elif isinstance(payload, tuple) and payload and payload[0] == "__err__":
         header = {"qid": qid, "rs": rs, "rw": rw, "ss": ss, "kind": "err", "msg": str(payload[1])}
         if len(payload) > 2 and payload[2] is not None:
             header["code"] = int(payload[2])
-        body = b""
+        body_segments = []
     else:  # EOS
         header = {"qid": qid, "rs": rs, "rw": rw, "ss": ss, "kind": "eos"}
         if isinstance(payload, tuple) and len(payload) > 1 and payload[1]:
             header["stats"] = payload[1]
-        body = b""
+        body_segments = []
     hb = json.dumps(header).encode()
-    return struct.pack("<I", len(hb)) + hb + body
+    return [struct.pack("<I", len(hb)) + hb, *body_segments]
+
+
+def encode_envelope(qid: str, rs: int, rw: int, ss: int, payload) -> bytes:
+    """One-buffer form of encode_envelope_segments (tests, local loopback)."""
+    return b"".join(encode_envelope_segments(qid, rs, rw, ss, payload))
 
 
 def decode_envelope(data: bytes):
@@ -78,7 +86,9 @@ def decode_envelope(data: bytes):
     kind = header.get("kind")
     if kind == "block":
         try:
-            df = datatable.decode(data[4 + hlen :])
+            # memoryview slice: the DataTable decodes zero-copy over the
+            # received envelope buffer, no body-copy per block
+            df = datatable.decode(memoryview(data)[4 + hlen :])
         except Exception as e:  # pinotlint: disable=deadline-swallow — decode sees only parse failures; ValueError is the 400-vs-500 contract
             raise ValueError(f"corrupt mailbox envelope: bad block payload ({e})") from None
         # wire format stringifies column labels; runtime blocks use
@@ -208,17 +218,18 @@ class DistributedMailbox(R.MailboxService):
         if owner == self.my_id:
             super().send(send_stage, recv_stage, recv_worker, payload)
             return
-        url = self.addresses[owner].rstrip("/") + "/mailbox"
+        base = self.addresses[owner].rstrip("/")
+        url = base + "/mailbox"
+        from pinot_tpu.cluster.http import _host_port
+
+        host, port = _host_port(base)
         backoff = self.retry_initial_s
         for attempt in range(self.send_retries + 1):
             # encode per attempt: a callable payload (trailing EOS carrying
             # the trace subtree) re-snapshots, so fault/retry span events
             # recorded by a failed attempt ride the retry that succeeds
-            data = encode_envelope(
+            segments = encode_envelope_segments(
                 self.qid, recv_stage, recv_worker, send_stage, payload() if callable(payload) else payload
-            )
-            req = urllib.request.Request(
-                url, data=data, headers={"Content-Type": "application/x-pinot-mailbox"}
             )
             try:
                 try:
@@ -228,17 +239,29 @@ class DistributedMailbox(R.MailboxService):
                     # faults must be visible in the assembled trace
                     trace_event("fault.injected", point="mailbox.send", owner=owner, attempt=attempt)
                     raise
-                with urllib.request.urlopen(req, timeout=self.timeout) as resp:
-                    resp.read()
+                # pooled keep-alive: one persistent connection per peer
+                # carries every block of the shuffle; a stale socket is
+                # evicted and the request re-checks-out a fresh one
+                with get_pool().request(
+                    host,
+                    port,
+                    "POST",
+                    "/mailbox",
+                    body=segments,
+                    headers={"Content-Type": "application/x-pinot-mailbox"},
+                    timeout_s=self.timeout,
+                ) as resp:
+                    body = resp.read()
+                    status = resp.status
+                if status >= 400:
+                    # the envelope reached a live handler which rejected it:
+                    # retrying the same bytes cannot succeed
+                    detail = bytes(body).decode(errors="replace")
+                    raise RuntimeError(
+                        f"mailbox send to {owner} ({url}) failed: HTTP {status}: {detail}"
+                    ) from None
                 return
-            except urllib.error.HTTPError as e:
-                # the envelope reached a live handler which rejected it:
-                # retrying the same bytes cannot succeed
-                detail = e.read().decode(errors="replace")
-                raise RuntimeError(
-                    f"mailbox send to {owner} ({url}) failed: HTTP {e.code}: {detail}"
-                ) from None
-            except (urllib.error.URLError, OSError) as e:
+            except OSError as e:
                 # connection-class (refused/reset/timeout): transient by
                 # definition — retry within deadline budget
                 if attempt >= self.send_retries:

@@ -1,0 +1,19 @@
+"""jax version compatibility for the mesh/shuffle tier.
+
+`shard_map` moved from `jax.experimental.shard_map` to the top-level `jax`
+namespace, renaming the replication-check kwarg from `check_rep=` to
+`check_vma=` along the way. Call sites import from here and always pass
+`check_vma=`; on older jax the wrapper translates the kwarg.
+"""
+
+from __future__ import annotations
+
+try:
+    from jax import shard_map  # jax >= 0.6: top-level, check_vma kwarg
+except ImportError:  # older jax: experimental namespace, check_rep kwarg
+    from jax.experimental.shard_map import shard_map as _experimental_shard_map
+
+    def shard_map(f, *args, check_vma=None, **kwargs):
+        if check_vma is not None:
+            kwargs["check_rep"] = check_vma
+        return _experimental_shard_map(f, *args, **kwargs)

@@ -26,10 +26,10 @@ from typing import Any
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from pinot_tpu.common.types import Schema
+from pinot_tpu.parallel.compat import shard_map
 from pinot_tpu.query.context import QueryContext, QueryType
 from pinot_tpu.query.kernels import build_fn
 from pinot_tpu.query.plan import SegmentPlan, plan_segment

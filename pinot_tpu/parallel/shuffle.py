@@ -28,13 +28,23 @@ with the safe capacity (= local row count) or falls back host-side.
 
 from __future__ import annotations
 
+import threading
 from functools import lru_cache
 
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from pinot_tpu.parallel.compat import shard_map
+
+# Multi-device collective launches must not interleave: two host threads
+# each enqueueing an all_to_all across the same mesh can order their
+# per-device work differently on different devices, and the collective
+# deadlocks waiting for peers that are stuck behind the other launch.
+# The multistage engine's stage workers call mesh_equi_join concurrently
+# (one hash partition per worker), so serialize every launch here.
+_COLLECTIVE_LAUNCH_LOCK = threading.Lock()
 
 
 def _hash64(x):
@@ -215,20 +225,21 @@ def mesh_equi_join(
             per,
         )
 
-    lkd, lid, lc = shardify(lk)
-    rkd, rid, rc = shardify(rk)
-    # worst case one shard receives EVERYTHING both sides hold for one
-    # destination: start at balanced-x2, retry once at the safe bound
-    # (pow2 capacities keep the compile cache warm across sizes; the
-    # received-buffer size D*capacity is what the per-shard probe sorts,
-    # so slack directly multiplies the dominant sort cost)
-    cap0 = 1 << max(6, int(np.ceil(np.log2(max(1, -(-2 * max(lc, rc) // n_dest))))))
-    for capacity in (cap0, max(lc, rc)):
-        run = _join_kernel(mesh, axis, lc, rc, int(capacity), str(kdt))
-        li, ri, hit, drops, dups = run(lkd, lid, rkd, rid)
-        if int(dups) > 0:
-            return None  # many-to-many: single-device range-probe handles
-        if int(drops) == 0:
-            h = np.asarray(hit)
-            return np.asarray(li)[h], np.asarray(ri)[h]
+    with _COLLECTIVE_LAUNCH_LOCK:
+        lkd, lid, lc = shardify(lk)
+        rkd, rid, rc = shardify(rk)
+        # worst case one shard receives EVERYTHING both sides hold for one
+        # destination: start at balanced-x2, retry once at the safe bound
+        # (pow2 capacities keep the compile cache warm across sizes; the
+        # received-buffer size D*capacity is what the per-shard probe sorts,
+        # so slack directly multiplies the dominant sort cost)
+        cap0 = 1 << max(6, int(np.ceil(np.log2(max(1, -(-2 * max(lc, rc) // n_dest))))))
+        for capacity in (cap0, max(lc, rc)):
+            run = _join_kernel(mesh, axis, lc, rc, int(capacity), str(kdt))
+            li, ri, hit, drops, dups = run(lkd, lid, rkd, rid)
+            if int(dups) > 0:
+                return None  # many-to-many: single-device range-probe handles
+            if int(drops) == 0:
+                h = np.asarray(hit)
+                return np.asarray(li)[h], np.asarray(ri)[h]
     return None

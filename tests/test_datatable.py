@@ -106,6 +106,125 @@ def test_http_data_plane_uses_datatable(tmp_path):
         svc.stop()
 
 
+def test_dtype_matrix_property():
+    """Seeded pseudo-property sweep: random frames over the full dtype
+    matrix (incl. datetime64/timedelta64 and object/str/mixed columns) must
+    roundtrip exactly through the v2 encoder AND through encode_v1 (the
+    version-negotiation fallback)."""
+    from pinot_tpu.common.datatable import encode_v1
+
+    rng = np.random.default_rng(42)
+    dtypes = [np.int8, np.int32, np.int64, np.uint16, np.float32, np.float64, np.bool_]
+    words = np.array(["alpha", "béta", "g\x00mma", "", "delta" * 40], dtype=object)
+    for case in range(25):
+        n = int(rng.integers(0, 300))
+        cols = {}
+        for c in range(int(rng.integers(1, 5))):
+            kind = int(rng.integers(0, 5))
+            if kind == 0:
+                dt = dtypes[int(rng.integers(0, len(dtypes)))]
+                cols[f"n{c}"] = rng.integers(0, 100, n).astype(dt)
+            elif kind == 1:
+                cols[f"t{c}"] = rng.integers(0, 10**9, n).astype("datetime64[ns]")
+            elif kind == 2:
+                cols[f"d{c}"] = rng.integers(0, 10**6, n).astype("timedelta64[us]")
+            elif kind == 3:
+                cols[f"s{c}"] = words[rng.integers(0, len(words), n)]
+            else:  # mixed object column: strings + None + ints
+                mixed = np.empty(n, dtype=object)
+                mixed[:] = [
+                    ("w%d" % i, None, i)[i % 3] for i in range(n)
+                ]
+                cols[f"m{c}"] = mixed
+        df = pd.DataFrame(cols)
+        out = rt(df)
+        pd.testing.assert_frame_equal(out, df, check_index_type=False)
+        out_v1 = decode(encode_v1(df))
+        pd.testing.assert_frame_equal(out_v1, df, check_index_type=False)
+
+
+def test_empty_frames():
+    pd.testing.assert_frame_equal(rt(pd.DataFrame()), pd.DataFrame())
+    df = pd.DataFrame({"a": np.array([], dtype=np.int64), "s": np.array([], dtype=object)})
+    pd.testing.assert_frame_equal(rt(df), df)
+
+
+def test_over_4gb_guard():
+    """Fields above the u32 length limit must be rejected BEFORE any
+    materialization — np.broadcast_to reports 8 GiB logical without owning
+    the memory, so an encoder that copies-then-checks would OOM here."""
+    big = np.broadcast_to(np.zeros(1, dtype=np.int64), (1 << 29, 2))
+    with pytest.raises(DataTableError, match="4 GB"):
+        encode(big)
+
+
+def test_v1_backward_decode():
+    """Version negotiation: payloads written by the v1 encoder (version word
+    1) must decode bit-exactly on the v2 reader."""
+    from pinot_tpu.common.datatable import DECODE_VERSIONS, VERSION, encode_v1
+
+    assert VERSION == 2 and 1 in DECODE_VERSIONS
+    values = [
+        None,
+        {"a": [1, 2.5, "x"], ("t",): {3, 4}},
+        np.arange(20, dtype=np.float32).reshape(4, 5),
+        pd.DataFrame({"k": np.array(["a", "b", "a"], dtype=object), "v": [1.0, 2.0, 3.0]}),
+    ]
+    for v in values:
+        p = encode_v1(v)
+        assert p[4] | (p[5] << 8) == 1
+        out = decode(p)
+        if isinstance(v, pd.DataFrame):
+            pd.testing.assert_frame_equal(out, v)
+        elif isinstance(v, np.ndarray):
+            np.testing.assert_array_equal(out, v)
+        else:
+            assert out == v
+
+
+def test_encode_segments_matches_encode():
+    """The iovec encoder's segments, joined, are byte-identical to the flat
+    encoding — writelines(segments) and write(encode(v)) put the same bytes
+    on the wire."""
+    from pinot_tpu.common.datatable import encode_segments
+
+    df = pd.DataFrame(
+        {"k": np.array([f"key{i % 97}" for i in range(5000)], dtype=object), "v": np.arange(5000)}
+    )
+    for v in (df, np.arange(1000, dtype=np.int64), [1, "x", {2.5}], None):
+        assert b"".join(encode_segments(v)) == encode(v)
+
+
+def test_adversarial_payloads_never_struct_error():
+    """Truncations and byte flips of real payloads must raise DataTableError
+    (or decode to garbage values) — NEVER struct.error/ValueError leaking
+    from the parsing internals, which the transport layer doesn't catch."""
+    rng = np.random.default_rng(7)
+    df = pd.DataFrame(
+        {"k": np.array(["aa", "bb", "cc"] * 40, dtype=object), "v": np.arange(120, dtype=np.int64)}
+    )
+    payloads = [encode(df), encode([1, "x", np.arange(10)]), encode({"a": (1, 2)})]
+    for payload in payloads:
+        for cut in rng.integers(0, len(payload), 40):
+            try:
+                decode(payload[: int(cut)])
+            except DataTableError:
+                pass  # the only acceptable exception type
+        for _ in range(60):
+            mutated = bytearray(payload)
+            for pos in rng.integers(0, len(payload), int(rng.integers(1, 4))):
+                mutated[int(pos)] ^= int(rng.integers(1, 256))
+            try:
+                decode(bytes(mutated))
+            except DataTableError:
+                pass
+    # declared-count overflow: a crafted header promising 4B elements must
+    # be rejected by the count-vs-remaining check, not attempt allocation
+    huge = encode([1])[:7] + b"\xff\xff\xff\xff"
+    with pytest.raises(DataTableError):
+        decode(huge)
+
+
 def test_numeric_decode_is_zero_copy():
     """ZeroCopyDataBlockSerde parity: numeric columns decode as views over
     the receive buffer, not copies."""

@@ -1,0 +1,304 @@
+"""Connection pool + chaos coverage for the pooled persistent transport
+(common/wire.py): checkout/release accounting, max-per-host backpressure,
+health eviction (TTL and peer-EOF), deadline bounds, the wire.connect fault
+point, and mid-stream disconnect surfacing as a clean error.
+
+Reference test model: GrpcMailboxTest / failure-detector integration tests
+(pinot-query-runtime) that kill peers under a live channel pool.
+"""
+
+import http.server
+import io
+import socket
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from pinot_tpu.common.faults import FAULTS, FaultRule, InjectedFault
+from pinot_tpu.common.wire import (
+    ConnectionPool,
+    WireError,
+    WireTimeout,
+    get_pool,
+    read_exact,
+)
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    FAULTS.reset()
+    yield
+    FAULTS.reset()
+
+
+class _EchoHandler(http.server.BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+    connections: list = []
+
+    def do_POST(self):
+        body = self.rfile.read(int(self.headers.get("Content-Length", 0)))
+        type(self).connections.append(self.connection)
+        self.send_response(200)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    do_GET = do_POST
+
+    def log_message(self, *a):
+        pass
+
+
+def _serve(handler_cls, port=0):
+    srv = http.server.ThreadingHTTPServer(("127.0.0.1", port), handler_cls)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    return srv
+
+
+def test_read_exact_eof():
+    assert bytes(read_exact(io.BytesIO(b"abcdef"), 4)) == b"abcd"
+    with pytest.raises(WireError, match="truncated"):
+        read_exact(io.BytesIO(b"ab"), 4)
+
+
+def test_pool_hit_miss_and_release():
+    srv = _serve(_EchoHandler)
+    pool = ConnectionPool()
+    try:
+        port = srv.server_address[1]
+        for _ in range(3):
+            with pool.request("127.0.0.1", port, "POST", "/x", body=b"ping") as resp:
+                assert resp.status == 200 and resp.read() == b"ping"
+        s = pool.stats()
+        # one socket, reused: first request is the miss, the rest are hits
+        assert s["misses"] == 1 and s["hits"] == 2
+        assert s["live"] == 1 and s["idle"] == 1
+    finally:
+        pool.close()
+        srv.shutdown()
+        srv.server_close()
+
+
+def test_connection_close_is_not_pooled():
+    class _CloseHandler(_EchoHandler):
+        def do_POST(self):
+            body = self.rfile.read(int(self.headers.get("Content-Length", 0)))
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(body)))
+            self.send_header("Connection", "close")
+            self.end_headers()
+            self.wfile.write(body)
+
+    srv = _serve(_CloseHandler)
+    pool = ConnectionPool()
+    try:
+        port = srv.server_address[1]
+        for _ in range(2):
+            with pool.request("127.0.0.1", port, "POST", "/x", body=b"d") as resp:
+                resp.read()
+        s = pool.stats()
+        # server refuses keep-alive -> every request dials fresh, pool empty
+        assert s["misses"] == 2 and s["hits"] == 0 and s["live"] == 0
+    finally:
+        pool.close()
+        srv.shutdown()
+        srv.server_close()
+
+
+def test_max_per_host_checkout_timeout():
+    srv = _serve(_EchoHandler)
+    pool = ConnectionPool(max_per_host=1)
+    try:
+        port = srv.server_address[1]
+        held = pool.checkout("127.0.0.1", port)
+        t0 = time.monotonic()
+        with pytest.raises(WireTimeout, match="all busy"):
+            pool.checkout("127.0.0.1", port, timeout_s=0.2)
+        assert time.monotonic() - t0 < 2.0
+        assert pool.stats()["checkoutTimeouts"] == 1
+        # release unblocks a parked checkout
+        got = []
+        t = threading.Thread(
+            target=lambda: got.append(pool.checkout("127.0.0.1", port, timeout_s=5.0))
+        )
+        t.start()
+        pool.release(held)
+        t.join(timeout=5.0)
+        assert got and got[0].reused
+        pool.release(got[0])
+    finally:
+        pool.close()
+        srv.shutdown()
+        srv.server_close()
+
+
+def test_deadline_honored():
+    srv = _serve(_EchoHandler)
+    pool = ConnectionPool(max_per_host=1)
+    try:
+        port = srv.server_address[1]
+        # expired absolute deadline: refused before any socket I/O
+        with pytest.raises(WireTimeout):
+            pool.request(
+                "127.0.0.1", port, "POST", "/x", body=b"d",
+                deadline_ts=time.monotonic() - 0.01,
+            )
+        # deadline also bounds the checkout wait when the host cap is busy
+        held = pool.checkout("127.0.0.1", port)
+        t0 = time.monotonic()
+        with pytest.raises(WireTimeout):
+            pool.checkout("127.0.0.1", port, deadline_ts=time.monotonic() + 0.2)
+        assert time.monotonic() - t0 < 2.0
+        pool.release(held)
+    finally:
+        pool.close()
+        srv.shutdown()
+        srv.server_close()
+
+
+def test_ttl_stale_eviction():
+    srv = _serve(_EchoHandler)
+    pool = ConnectionPool(idle_ttl_s=0.05)
+    try:
+        port = srv.server_address[1]
+        with pool.request("127.0.0.1", port, "POST", "/x", body=b"a") as resp:
+            resp.read()
+        time.sleep(0.1)  # idle past TTL
+        with pool.request("127.0.0.1", port, "POST", "/x", body=b"b") as resp:
+            assert resp.read() == b"b"
+        s = pool.stats()
+        assert s["evictions"] == 1 and s["misses"] == 2 and s["hits"] == 0
+    finally:
+        pool.close()
+        srv.shutdown()
+        srv.server_close()
+
+
+def test_server_restart_evicts_stale_socket():
+    """A server restarted behind a live pool entry: the dead socket (peer
+    FIN pending) is evicted on checkout and the request transparently runs
+    on a fresh connection to the new process."""
+
+    class _H(_EchoHandler):
+        connections = []
+
+    srv = _serve(_H)
+    port = srv.server_address[1]
+    pool = ConnectionPool()
+    srv2 = None
+    try:
+        with pool.request("127.0.0.1", port, "POST", "/x", body=b"one") as resp:
+            resp.read()
+        # "restart": kill the listener AND the accepted keep-alive sockets
+        # (ThreadingHTTPServer's daemon handler threads would otherwise hold
+        # them open), then bind a new server on the same port
+        srv.shutdown()
+        srv.server_close()
+        for c in _H.connections:
+            try:
+                c.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+        srv2 = _serve(_EchoHandler, port=port)
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline:
+            if pool._stale(pool._idle[("127.0.0.1", port)][0], pool.idle_ttl_s):
+                break  # FIN has reached the idle socket
+            time.sleep(0.01)
+        with pool.request("127.0.0.1", port, "POST", "/x", body=b"two") as resp:
+            assert resp.read() == b"two"
+        s = pool.stats()
+        assert s["evictions"] + s["staleRetries"] >= 1, s
+    finally:
+        pool.close()
+        if srv2 is not None:
+            srv2.shutdown()
+            srv2.server_close()
+
+
+def test_wire_connect_fault_point():
+    """wire.connect fires inside ConnectionPool._connect: a fresh-dial
+    failure propagates as a connection-class error and the pool slot is
+    rolled back (no leaked capacity)."""
+    srv = _serve(_EchoHandler)
+    pool = ConnectionPool()
+    try:
+        port = srv.server_address[1]
+        FAULTS.configure({"wire.connect": FaultRule(max_count=1)})
+        with pytest.raises(InjectedFault):
+            pool.request("127.0.0.1", port, "POST", "/x", body=b"d")
+        assert FAULTS.counts()["wire.connect"] == 1
+        # slot rolled back: the next request dials clean and succeeds
+        with pool.request("127.0.0.1", port, "POST", "/x", body=b"d") as resp:
+            assert resp.status == 200 and resp.read() == b"d"
+        assert pool.stats()["live"] == 1
+    finally:
+        pool.close()
+        srv.shutdown()
+        srv.server_close()
+
+
+def test_mailbox_survives_pool_checkout_failure():
+    """Chaos: a wire.connect failure under the mailbox sender looks like a
+    dead peer; the send-level retry re-checks-out a fresh connection and the
+    block is delivered on attempt 2."""
+    import pandas as pd
+
+    from pinot_tpu.multistage import runtime as R
+    from pinot_tpu.multistage.transport import (
+        DistributedMailbox,
+        MailboxHTTPService,
+        MailboxRegistry,
+    )
+
+    reg = MailboxRegistry()
+    svc = MailboxHTTPService(reg)
+    try:
+        get_pool().reset()  # no idle socket may absorb the connect fault
+        sender = DistributedMailbox()
+        sender.configure("qwire", "me", {(1, 0): "other"}, {"other": svc.url})
+        sender.retry_initial_s = 0.01
+        FAULTS.configure({"wire.connect": FaultRule(max_count=1)})
+        df = pd.DataFrame({0: np.arange(3, dtype=np.int64)})
+        sender.send(2, 1, 0, df)
+        sender.send(2, 1, 0, R._EOS)
+        assert FAULTS.counts()["wire.connect"] == 1
+        box = reg.get("qwire")
+        box.receive_timeout = 5.0
+        frames = box.receive_all(1, 0, 2, n_senders=1)
+        assert len(frames) == 1 and frames[0][0].tolist() == [0, 1, 2]
+    finally:
+        svc.stop()
+
+
+def test_mid_stream_disconnect_is_clean_error():
+    """A server dying mid-frame must surface as the classified 'stream
+    truncated' RuntimeError — never a silent short result or a raw
+    http.client exception."""
+    from pinot_tpu.cluster.http import RemoteServerClient
+
+    class _TruncHandler(http.server.BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def do_POST(self):
+            self.rfile.read(int(self.headers.get("Content-Length", 0)))
+            self.send_response(200)
+            self.send_header("Connection", "close")
+            self.end_headers()
+            # frame header promises 100 bytes, connection dies after 10
+            self.wfile.write(b"\x64\x00\x00\x00" + b"x" * 10)
+            self.close_connection = True
+
+        def log_message(self, *a):
+            pass
+
+    srv = _serve(_TruncHandler)
+    try:
+        client = RemoteServerClient(f"http://127.0.0.1:{srv.server_address[1]}")
+        with pytest.raises(RuntimeError, match="stream truncated"):
+            list(client.execute_partials_stream("t", "SELECT 1", ["s0"]))
+    finally:
+        srv.shutdown()
+        srv.server_close()
