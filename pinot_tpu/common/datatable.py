@@ -85,7 +85,16 @@ class _SegWriter:
         self._scratch = bytearray()
 
     def raw(self, b) -> None:
-        if len(b) >= self.INLINE_CUTOFF:
+        if isinstance(b, memoryview):
+            # flatten to a 1-d byte view: every consumer of the segment list
+            # (Content-Length, stream frame prefixes) totals `len(s)`, and on
+            # a multi-dimensional view len() is shape[0], not nbytes
+            if b.ndim != 1 or b.itemsize != 1:
+                b = b.cast("B")
+            size = b.nbytes
+        else:
+            size = len(b)
+        if size >= self.INLINE_CUTOFF:
             if self._scratch:
                 self._segs.append(self._scratch)
                 self._scratch = bytearray()
@@ -249,8 +258,9 @@ def _encode_value(out: _SegWriter, v) -> None:
             out.u32(data.nbytes)
             # uint8 view: no intermediate tobytes() copy, and unlike a raw
             # memoryview cast it also handles datetime64/timedelta64
-            # (dtype 'M'/'m' can't export a buffer directly)
-            out.raw(memoryview(data.view(np.uint8)))
+            # (dtype 'M'/'m' can't export a buffer directly); cast("B")
+            # flattens so len(segment) == nbytes for n-d arrays
+            out.raw(memoryview(data.view(np.uint8)).cast("B"))
     elif isinstance(v, (list, tuple, set)):
         tag = _T_LIST if isinstance(v, list) else _T_TUPLE if isinstance(v, tuple) else _T_SET
         out.u8(tag)

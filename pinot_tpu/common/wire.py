@@ -15,10 +15,13 @@ fresh TCP connection (3-way handshake + slow start) for a single POST.
 * **health eviction** — idle sockets past `idle_ttl_s`, or readable while
   idle (server closed or sent junk: an idle HTTP connection must be
   silent), are closed and replaced instead of handed out.
-* **stale retry** — a send failure on a *reused* connection is
-  indistinguishable from a keep-alive socket the peer closed under us; the
-  request retries exactly once on a freshly connected socket. Failures on
-  fresh connections propagate (the peer really is down).
+* **stale retry** — a *connection-class* failure (ConnectionError /
+  RemoteDisconnected) on a *reused* connection is indistinguishable from a
+  keep-alive socket the peer closed under us; the request retries exactly
+  once on a freshly connected socket. Failures on fresh connections
+  propagate (the peer really is down), and timeouts never retry — a slow
+  peer may already be executing the non-idempotent POST, so a re-send
+  would double-deliver; they raise WireTimeout instead.
 
 Lock discipline (pinotlint blocking-under-lock): all socket operations —
 connect, close, select() health probes, request I/O — happen OUTSIDE the
@@ -349,8 +352,12 @@ class ConnectionPool:
         with an explicit Content-Length so http.client never falls back to
         chunked transfer (the stdlib server can't decode it).
 
-        A send/response failure on a REUSED connection retries once on a
-        fresh socket; the stale one is discarded either way.
+        A connection-class failure (peer closed the keep-alive socket:
+        ConnectionError / RemoteDisconnected) on a REUSED connection retries
+        once on a fresh socket; the stale one is discarded either way.
+        Timeouts NEVER retry: a slow peer may already be executing the
+        (non-idempotent) request, so a re-send would double-deliver — they
+        surface as WireTimeout after discarding the socket.
         """
         retried = False
         while True:
@@ -361,9 +368,18 @@ class ConnectionPool:
             except WireTimeout:
                 self.discard(entry)
                 raise
+            except TimeoutError as e:  # socket.timeout: slow peer, not stale
+                self.discard(entry)
+                raise WireTimeout(
+                    f"HTTP exchange with {host}:{port} timed out ({method} {path})"
+                ) from e
             except (OSError, http.client.HTTPException) as e:
                 self.discard(entry)
-                if entry.reused and not retried:
+                # retry only connection-class failures — the signature of a
+                # keep-alive socket the peer closed under us. RemoteDisconnected
+                # subclasses ConnectionResetError, so one check covers EOF on
+                # getresponse(), EPIPE/ECONNRESET on send, and refused dials.
+                if entry.reused and not retried and isinstance(e, ConnectionError):
                     retried = True
                     with self._cv:
                         self._stale_retries += 1

@@ -1248,9 +1248,11 @@ def _exec_partial_aggregate(node: L.Aggregate, df: pd.DataFrame, null_on: bool =
     key_df = pd.DataFrame({f"g{i}": work[f"g{i}"] for i in range(k)})
     by = [f"g{i}" for i in range(k)] if k > 1 else "g0"
     rows = []
-    for key, idx in key_df.groupby(by, dropna=False, sort=False).groups.items():
+    # .indices, not .groups: with dropna=False a NaN key (e.g. LEFT JOIN
+    # unmatched rows) makes .groups raise "Categorical categories cannot be
+    # null" in pandas 2.x; .indices also yields positions directly
+    for key, pos in key_df.groupby(by, dropna=False, sort=False).indices.items():
         key_vals = list(key) if isinstance(key, tuple) else [key]
-        pos = key_df.index.get_indexer(idx)
         rows.append(key_vals + _partial_cols(pos))
     ncols = k + sum(parts_of(a.func) for a in node.aggs)
     return pd.DataFrame({i: [r[i] for r in rows] for i in range(ncols)})
@@ -1301,9 +1303,11 @@ def _exec_final_aggregate(node: L.Aggregate, df: pd.DataFrame, null_on: bool = F
         return pd.DataFrame({i: [v] for i, v in enumerate(_merge_rows(df))})
     rows = []
     by = list(range(k)) if k > 1 else 0
-    for key, idx in df.groupby(by, dropna=False, sort=False).groups.items():
+    # .indices, not .groups — see _exec_partial_aggregate: a NaN group key
+    # with dropna=False makes .groups raise in pandas 2.x
+    for key, pos in df.groupby(by, dropna=False, sort=False).indices.items():
         key_vals = list(key) if isinstance(key, tuple) else [key]
-        rows.append(key_vals + _merge_rows(df.loc[idx]))
+        rows.append(key_vals + _merge_rows(df.iloc[pos]))
     return pd.DataFrame({i: [r[i] for r in rows] for i in range(len(node.fields))})
 
 

@@ -195,6 +195,30 @@ def test_encode_segments_matches_encode():
         assert b"".join(encode_segments(v)) == encode(v)
 
 
+def test_2d_array_segment_lengths_are_bytes():
+    """Regression: a multi-dimensional column buffer used to land in the
+    segment list as an n-d memoryview whose len() is shape[0], not nbytes,
+    so every `sum(len(s))` total (Content-Length, stream frame prefixes)
+    undercounted while writelines() emitted the full buffer — desyncing
+    keep-alive streams for 2-d+ columns with >= 4096 rows."""
+    from pinot_tpu.common.datatable import encode_segments
+
+    for dtype in ("<f8", "<i4", "<M8[ns]"):
+        arr = np.arange(5000 * 4).reshape(5000, 4).astype(dtype)
+        for v in (arr, {"col": arr}, [arr, arr.T, arr[:2]]):
+            segs = encode_segments(v)
+            flat = encode(v)
+            assert sum(len(s) for s in segs) == len(flat)
+            # every segment must be a flat byte view: len(s) == nbytes
+            assert all(
+                memoryview(s).ndim == 1 and memoryview(s).itemsize == 1 for s in segs
+            )
+    out = rt({"col": np.arange(5000 * 4, dtype=np.float64).reshape(5000, 4)})
+    np.testing.assert_array_equal(
+        out["col"], np.arange(5000 * 4, dtype=np.float64).reshape(5000, 4)
+    )
+
+
 def test_adversarial_payloads_never_struct_error():
     """Truncations and byte flips of real payloads must raise DataTableError
     (or decode to garbage values) — NEVER struct.error/ValueError leaking

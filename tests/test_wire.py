@@ -10,6 +10,7 @@ Reference test model: GrpcMailboxTest / failure-detector integration tests
 import http.server
 import io
 import socket
+import struct
 import threading
 import time
 
@@ -216,6 +217,85 @@ def test_server_restart_evicts_stale_socket():
         if srv2 is not None:
             srv2.shutdown()
             srv2.server_close()
+
+
+def test_2d_array_content_length_over_http():
+    """Regression: iovec segments holding an n-d memoryview made the pool's
+    Content-Length (sum of len(s)) undercount the body for 2-d columns with
+    >= 4096 rows, desyncing the keep-alive stream. The echoed payload must
+    decode back AND the next request on the same socket must still parse."""
+    from pinot_tpu.common import datatable
+
+    srv = _serve(_EchoHandler)
+    pool = ConnectionPool()
+    try:
+        port = srv.server_address[1]
+        arr = np.arange(5000 * 4, dtype=np.float64).reshape(5000, 4)
+        segs = datatable.encode_segments({"m": arr})
+        with pool.request("127.0.0.1", port, "POST", "/x", body=segs) as resp:
+            assert resp.status == 200
+            echoed = resp.read()
+        np.testing.assert_array_equal(datatable.decode(echoed)["m"], arr)
+        # keep-alive socket stayed in sync: the follow-up reuses it cleanly
+        with pool.request("127.0.0.1", port, "POST", "/x", body=b"ok") as resp:
+            assert resp.read() == b"ok"
+        s = pool.stats()
+        assert s["hits"] == 1 and s["staleRetries"] == 0
+    finally:
+        pool.close()
+        srv.shutdown()
+        srv.server_close()
+
+
+def test_write_frame_prefix_matches_payload_2d():
+    """Stream-frame regression twin: the u32 length prefix must equal the
+    actual payload bytes for a 2-d column with >= 4096 rows."""
+    from pinot_tpu.common import datatable
+    from pinot_tpu.common.wire import write_frame
+
+    arr = np.arange(4096 * 3, dtype=np.int64).reshape(4096, 3)
+    buf = io.BytesIO()
+    total = write_frame(buf, datatable.encode_segments(arr))
+    raw = buf.getvalue()
+    assert struct.unpack("<I", raw[:4])[0] == total == len(raw) - 4
+    np.testing.assert_array_equal(datatable.decode(raw[4:]), arr)
+
+
+def test_slow_response_times_out_without_retry():
+    """A socket timeout on a reused connection must NOT take the stale-retry
+    path: the slow peer may already be executing the non-idempotent POST, so
+    a re-send would double-deliver. Expect exactly one delivery plus a
+    WireTimeout."""
+
+    class _SlowHandler(_EchoHandler):
+        slow_hits = 0
+
+        def do_POST(self):
+            if self.path == "/slow":
+                type(self).slow_hits += 1
+                time.sleep(0.8)
+            try:
+                super().do_POST()
+            except OSError:
+                pass  # client gave up and closed the socket
+
+    srv = _serve(_SlowHandler)
+    pool = ConnectionPool()
+    try:
+        port = srv.server_address[1]
+        # warm the pool so the slow request runs on a REUSED connection
+        with pool.request("127.0.0.1", port, "POST", "/x", body=b"warm") as resp:
+            resp.read()
+        with pytest.raises(WireTimeout):
+            pool.request("127.0.0.1", port, "POST", "/slow", body=b"d", timeout_s=0.2)
+        time.sleep(1.0)  # let the in-flight handler finish before counting
+        assert _SlowHandler.slow_hits == 1, "timed-out POST was re-sent"
+        s = pool.stats()
+        assert s["staleRetries"] == 0 and s["hits"] == 1
+    finally:
+        pool.close()
+        srv.shutdown()
+        srv.server_close()
 
 
 def test_wire_connect_fault_point():
