@@ -394,39 +394,15 @@ def _smoke_test(schema, mesh, rng):
     log("pre-flight smoke test OK (4 sharded query shapes compiled+ran)")
 
 
-def qps_main():
-    """`bench.py qps`: the QPS measurement plane (ROADMAP item 2 baseline).
-
-    Drives 100s of concurrent HTTP clients against a local controller + 2
-    servers + broker cluster and reports p50/p99/throughput/error-rate twice
-    over: once from the broker's own `broker.queryTotalMs` histogram (what
-    the federated SLO plane sees) and once from client-side wall timing
-    (what users see) — the two p99s must agree within ~20% or the broker's
-    self-reported SLO series can't be trusted for admission-control tuning.
-    Also snapshots the shared connection pool (common/wire.py) and asserts
-    hits > 0 — 128 clients x 10 queries over pooled keep-alive transport
-    must reuse sockets, not open one per request (ISSUE 10 acceptance).
-    Writes BENCH_qps_r10.json and prints the same JSON line.
-
-    Env knobs: PINOT_TPU_QPS_CLIENTS (128), PINOT_TPU_QPS_QUERIES (10 per
-    client), PINOT_TPU_QPS_ROWS (120_000 total)."""
-    import shutil
-    import tempfile
-    import threading
-
-    import pinot_tpu  # noqa: F401  (x64 + platform setup)
+def _build_qps_cluster(n_rows: int, root: str):
+    """Local controller + 2 servers + 120k-row lineorder table: the shared
+    fixture for `bench.py qps` and `bench.py qps --overload`. Returns
+    (controller, queries) — the caller constructs the broker so each mode
+    picks its own SchedulerConfig."""
     from pinot_tpu.common import DataType, Schema, TableConfig
-    from pinot_tpu.common.metrics import broker_metrics, reset_registries
-    from pinot_tpu.cluster import Broker, Controller, PropertyStore, Server
-    from pinot_tpu.cluster.http import BrokerHTTPService, query_broker_http
-    from pinot_tpu.common.wire import get_pool
+    from pinot_tpu.cluster import Controller, PropertyStore, Server
     from pinot_tpu.segment import SegmentBuilder
 
-    n_clients = int(os.environ.get("PINOT_TPU_QPS_CLIENTS", 128))
-    per_client = int(os.environ.get("PINOT_TPU_QPS_QUERIES", 10))
-    n_rows = int(os.environ.get("PINOT_TPU_QPS_ROWS", 120_000))
-
-    root = tempfile.mkdtemp(prefix="pinot_tpu_qps_")
     store = PropertyStore()
     controller = Controller(store, os.path.join(root, "deepstore"))
     for i in range(2):
@@ -450,15 +426,51 @@ def qps_main():
             "revenue": rng.integers(100, 600_000, seg_rows).astype(np.int64),
         }
         controller.upload_segment("lineorder", builder.build(data, f"lineorder_{i}"))
+    queries = [
+        "SELECT COUNT(*) FROM lineorder WHERE year > 1994",
+        "SELECT region, SUM(revenue) FROM lineorder GROUP BY region ORDER BY SUM(revenue) DESC LIMIT 4",
+    ]
+    return controller, queries
+
+
+def qps_main():
+    """`bench.py qps`: the QPS measurement plane (ROADMAP item 2 baseline).
+
+    Drives 100s of concurrent HTTP clients against a local controller + 2
+    servers + broker cluster and reports p50/p99/throughput/error-rate twice
+    over: once from the broker's own `broker.queryTotalMs` histogram (what
+    the federated SLO plane sees) and once from client-side wall timing
+    (what users see) — the two p99s must agree within ~20% or the broker's
+    self-reported SLO series can't be trusted for admission-control tuning.
+    Also snapshots the shared connection pool (common/wire.py) and asserts
+    hits > 0 — 128 clients x 10 queries over pooled keep-alive transport
+    must reuse sockets, not open one per request (ISSUE 10 acceptance).
+    Writes BENCH_qps_r10.json and prints the same JSON line.
+
+    Env knobs: PINOT_TPU_QPS_CLIENTS (128), PINOT_TPU_QPS_QUERIES (10 per
+    client), PINOT_TPU_QPS_ROWS (120_000 total)."""
+    import shutil
+    import tempfile
+    import threading
+
+    import pinot_tpu  # noqa: F401  (x64 + platform setup)
+    from pinot_tpu.common.metrics import broker_metrics, reset_registries
+    from pinot_tpu.cluster import Broker
+    from pinot_tpu.cluster.http import BrokerHTTPService, query_broker_http
+    from pinot_tpu.common.wire import get_pool
+
+    n_clients = int(os.environ.get("PINOT_TPU_QPS_CLIENTS", 128))
+    per_client = int(os.environ.get("PINOT_TPU_QPS_QUERIES", 10))
+    n_rows = int(os.environ.get("PINOT_TPU_QPS_ROWS", 120_000))
+
+    root = tempfile.mkdtemp(prefix="pinot_tpu_qps_")
+    controller, queries = _build_qps_cluster(n_rows, root)
+    seg_rows = n_rows // 4
     broker = Broker(controller)
     bsvc = BrokerHTTPService(broker, port=0)
     base_url = f"http://127.0.0.1:{bsvc.port}"
     controller.register_broker("broker_0", "127.0.0.1", bsvc.port)
 
-    queries = [
-        "SELECT COUNT(*) FROM lineorder WHERE year > 1994",
-        "SELECT region, SUM(revenue) FROM lineorder GROUP BY region ORDER BY SUM(revenue) DESC LIMIT 4",
-    ]
     for q in queries:  # compile/JIT warmup outside the measured window
         query_broker_http(base_url, q)
     log(f"qps warmup done; driving {n_clients} clients x {per_client} queries")
@@ -496,6 +508,7 @@ def qps_main():
     wall_s = time.perf_counter() - t_run
     pool_stats = get_pool().stats()
     bsvc.stop()
+    broker.shutdown()
     shutil.rmtree(root, ignore_errors=True)
 
     total = n_clients * per_client
@@ -530,6 +543,212 @@ def qps_main():
     }
     assert pool_stats["hits"] > 0, f"pooled transport never reused a connection: {pool_stats}"
     with open("BENCH_qps_r10.json", "w") as f:
+        json.dump(result, f, indent=2)
+        f.write("\n")
+    print(json.dumps(result))
+
+
+def qps_overload_main():
+    """`bench.py qps --overload`: the overload-protection acceptance run
+    (ISSUE 11). Two phases against the same cluster:
+
+    Phase 1 (steady): the BENCH_qps_r10 workload (128 clients x queries)
+    with the admission tier at defaults — steady-state qps must be no worse
+    than the r10 baseline (47.6 on the reference box; read live from
+    BENCH_qps_r10.json when present).
+
+    Phase 2 (overload): a 4x client burst (512 one-shot queries) against a
+    broker constrained to a small runner pool and a bounded per-group queue.
+    The excess MUST be answered with HTTP 503 + Retry-After (typed
+    SchedulerRejectedError at the client) in <100 ms median — never queued
+    into code-250 deadline death. A sampler thread polls /debug/admission
+    for the queue-depth series during the burst.
+
+    Writes BENCH_qps_r11.json and prints the same JSON line."""
+    import shutil
+    import tempfile
+    import threading
+
+    import pinot_tpu  # noqa: F401  (x64 + platform setup)
+    from pinot_tpu.common.config import SchedulerConfig
+    from pinot_tpu.common.errors import QueryErrorCode
+    from pinot_tpu.common.metrics import broker_metrics, reset_registries
+    from pinot_tpu.cluster import Broker
+    from pinot_tpu.cluster.http import BrokerHTTPService, query_broker_http
+    from pinot_tpu.query.scheduler import SchedulerRejectedError
+
+    n_clients = int(os.environ.get("PINOT_TPU_QPS_CLIENTS", 128))
+    per_client = int(os.environ.get("PINOT_TPU_QPS_QUERIES", 10))
+    n_rows = int(os.environ.get("PINOT_TPU_QPS_ROWS", 120_000))
+    baseline_qps = 47.6
+    try:
+        with open("BENCH_qps_r10.json") as f:
+            baseline_qps = float(json.load(f)["throughput_qps"])
+    except (OSError, KeyError, ValueError):
+        pass
+
+    root = tempfile.mkdtemp(prefix="pinot_tpu_qps_ovl_")
+    controller, queries = _build_qps_cluster(n_rows, root)
+
+    def drive(base_url, n, per, record_shed=None):
+        """n clients x per queries; returns (wall_s, ok, shed, code250, other)."""
+        lock = threading.Lock()
+        stats = {"ok": 0, "shed": 0, "code250": 0, "other": 0}
+        barrier = threading.Barrier(n + 1)
+
+        def client(idx):
+            barrier.wait()
+            for j in range(per):
+                q = queries[(idx + j) % len(queries)]
+                t0 = time.perf_counter()
+                try:
+                    res = query_broker_http(base_url, q)
+                    codes = {e.get("errorCode") for e in res.get("exceptions") or []}
+                    with lock:
+                        if int(QueryErrorCode.EXECUTION_TIMEOUT) in codes:
+                            stats["code250"] += 1
+                        elif codes:
+                            stats["other"] += 1
+                        else:
+                            stats["ok"] += 1
+                except SchedulerRejectedError as e:
+                    ms = (time.perf_counter() - t0) * 1e3
+                    with lock:
+                        stats["shed"] += 1
+                        if record_shed is not None:
+                            record_shed.append((ms, e.retry_after_s))
+                except Exception:
+                    with lock:
+                        stats["other"] += 1
+
+        threads = [threading.Thread(target=client, args=(i,), daemon=True) for i in range(n)]
+        for t in threads:
+            t.start()
+        barrier.wait()
+        t_run = time.perf_counter()
+        for t in threads:
+            t.join()
+        return time.perf_counter() - t_run, stats
+
+    # -- phase 1: steady state, default admission tier ------------------------
+    broker = Broker(controller)
+    bsvc = BrokerHTTPService(broker, port=0)
+    base_url = f"http://127.0.0.1:{bsvc.port}"
+    for q in queries:  # compile/JIT warmup outside the measured window
+        query_broker_http(base_url, q)
+    # one unmeasured concurrent round: the steady gate compares sustained
+    # throughput against the r10 baseline, so JIT/page-cache cold-start and
+    # elastic pool growth must not bill the measured window
+    drive(base_url, n_clients, 2)
+    reset_registries()
+    log(f"overload bench phase 1 (steady): {n_clients} clients x {per_client}")
+    wall_s, steady = drive(base_url, n_clients, per_client)
+    steady_qps = (n_clients * per_client) / wall_s
+    steady_snap = broker.admission_snapshot()
+    bsvc.stop()
+    broker.shutdown()
+    log(f"steady qps={steady_qps:.1f} (baseline {baseline_qps}) outcomes={steady}")
+
+    # -- phase 2: 4x burst against a constrained scheduler ---------------------
+    burst = 4 * n_clients
+    ovl_cfg = SchedulerConfig(num_runners=4, max_pending_per_group=32)
+    broker = Broker(controller, scheduler_config=ovl_cfg)
+    bsvc = BrokerHTTPService(broker, port=0)
+    base_url = f"http://127.0.0.1:{bsvc.port}"
+    for q in queries:
+        query_broker_http(base_url, q)
+    reset_registries()  # shedDecisionMs histogram covers exactly the burst
+    depth_series = []
+    stop_sampler = threading.Event()
+
+    def sampler():
+        import urllib.request
+
+        while not stop_sampler.is_set():
+            try:
+                with urllib.request.urlopen(f"{base_url}/debug/admission", timeout=2) as r:
+                    snap = json.loads(r.read())
+                depth_series.append(
+                    {
+                        "t": round(time.perf_counter(), 3),
+                        "pending": snap["scheduler"]["pending"],
+                        "inFlight": snap["scheduler"]["inFlight"],
+                        "shed": snap["counters"]["shed"],
+                    }
+                )
+            except Exception:
+                pass
+            stop_sampler.wait(0.05)
+
+    log(f"overload bench phase 2 (burst): {burst} one-shot clients, runners=4, queue=32")
+    shed_lat = []
+    samp = threading.Thread(target=sampler, daemon=True)
+    samp.start()
+    ovl_wall, ovl = drive(base_url, burst, 1, record_shed=shed_lat)
+    stop_sampler.set()
+    samp.join(timeout=5)
+    ovl_snap = broker.admission_snapshot()
+    decision_hist = broker_metrics().histogram("broker.admission.shedDecisionMs")
+    decision_p50 = decision_hist.quantile_ms(0.5) if decision_hist.count else None
+    decision_p95 = decision_hist.quantile_ms(0.95) if decision_hist.count else None
+    bsvc.stop()
+    broker.shutdown()
+    shutil.rmtree(root, ignore_errors=True)
+
+    shed_ms = sorted(ms for ms, _ in shed_lat)
+    shed_p50 = float(np.percentile(shed_ms, 50)) if shed_ms else None
+    shed_p95 = float(np.percentile(shed_ms, 95)) if shed_ms else None
+    t0 = depth_series[0]["t"] if depth_series else 0.0
+    result = {
+        "metric": "qps_overload_protection",
+        "steady": {
+            "clients": n_clients,
+            "queries": n_clients * per_client,
+            "wall_s": round(wall_s, 3),
+            "throughput_qps": round(steady_qps, 2),
+            "baseline_qps": baseline_qps,
+            "outcomes": steady,
+            "admitted": steady_snap["counters"]["admitted"],
+        },
+        "overload": {
+            "clients": burst,
+            "scheduler": {"numRunners": 4, "maxPendingPerGroup": 32},
+            "wall_s": round(ovl_wall, 3),
+            "outcomes": ovl,
+            "shed_rate": round(ovl["shed"] / burst, 4),
+            # broker-side: request entry -> typed 503 raise (the decision);
+            # client-side wall adds burst-local HTTP/thread scheduling noise
+            "shed_decision_ms": {
+                "p50": round(decision_p50, 3) if decision_p50 is not None else None,
+                "p95": round(decision_p95, 3) if decision_p95 is not None else None,
+            },
+            "shed_client_wall_ms": {
+                "p50": round(shed_p50, 3) if shed_p50 is not None else None,
+                "p95": round(shed_p95, 3) if shed_p95 is not None else None,
+            },
+            "retry_after_present": all(ra is not None and ra >= 1.0 for _, ra in shed_lat),
+            "counters": ovl_snap["counters"],
+            "queue_depth_series": [
+                {**d, "t": round(d["t"] - t0, 3)} for d in depth_series
+            ],
+        },
+    }
+    # acceptance gates (ISSUE 11): overload answered by typed 503 sheds with
+    # Retry-After, zero deadline deaths for admitted queries, fast shed
+    # decisions, and no steady-state regression
+    assert steady_qps >= baseline_qps, (
+        f"steady-state qps regressed: {steady_qps:.1f} < baseline {baseline_qps}"
+    )
+    assert steady["code250"] == 0 and steady["other"] == 0, f"steady phase errors: {steady}"
+    assert ovl["shed"] > 0, f"overload burst never shed: {ovl}"
+    assert ovl["code250"] == 0, f"admitted queries died of deadline under overload: {ovl}"
+    assert ovl["other"] == 0, f"untyped overload failures: {ovl}"
+    assert result["overload"]["retry_after_present"], "shed without Retry-After"
+    assert decision_p95 is not None and decision_p95 < 100.0, (
+        f"shed decisions too slow: broker-side p95={decision_p95}"
+    )
+    assert any(d["pending"] > 0 for d in depth_series), "queue-depth series never saw a queue"
+    with open("BENCH_qps_r11.json", "w") as f:
         json.dump(result, f, indent=2)
         f.write("\n")
     print(json.dumps(result))
@@ -819,7 +1038,10 @@ def _bench_config5(rng, n, iters):
 if __name__ == "__main__":
     try:
         if len(sys.argv) > 1 and sys.argv[1] == "qps":
-            qps_main()
+            if "--overload" in sys.argv[2:]:
+                qps_overload_main()
+            else:
+                qps_main()
             sys.exit(0)
         main()
     except Exception as e:  # emit evidence even on unrecoverable failure

@@ -487,6 +487,92 @@ def bench_deadline_overhead(n=200_000, dim=2_000):
     }
 
 
+def bench_admission_overhead(n=120_000):
+    """Admission-plane cost on the broker request path: the same single-table
+    aggregation with the scheduler/admission tier disabled vs at defaults.
+    The per-query hot cost is one decide() (queue-state read + M/M/c
+    projection + gauge updates) plus one scheduler submit/result handoff;
+    time the armed decide() directly and hold its projected share of the
+    query wall to the <2% budget — the stable form of the wall-clock
+    assertion (same shape as deadline_overhead)."""
+    import shutil
+    import tempfile
+
+    from pinot_tpu.common import DataType, Schema, TableConfig
+    from pinot_tpu.common.config import SchedulerConfig
+    from pinot_tpu.cluster import Broker, Controller, PropertyStore, Server
+    from pinot_tpu.cluster.admission import AdmissionController
+    from pinot_tpu.query.context import Deadline
+    from pinot_tpu.segment import SegmentBuilder
+
+    rng = np.random.default_rng(23)
+    root = tempfile.mkdtemp(prefix="pinot_tpu_adm_")
+    try:
+        controller = Controller(PropertyStore(), os.path.join(root, "ds"))
+        for i in range(2):
+            controller.register_server(f"s{i}", Server(f"s{i}"))
+        schema = Schema.build(
+            "t", dimensions=[("k", DataType.INT)], metrics=[("m", DataType.LONG)]
+        )
+        controller.add_schema(schema)
+        controller.add_table(TableConfig("t", replication=2))
+        builder = SegmentBuilder(schema)
+        for i in range(4):
+            controller.upload_segment(
+                "t",
+                builder.build(
+                    {
+                        "k": rng.integers(0, 64, n // 4).astype(np.int32),
+                        "m": rng.integers(1, 10, n // 4).astype(np.int64),
+                    },
+                    f"t_{i}",
+                ),
+            )
+        q = "SELECT k, SUM(m) FROM t GROUP BY k ORDER BY k LIMIT 10"
+
+        broker_off = Broker(controller, scheduler_config=SchedulerConfig(enabled=False))
+        off_ms = _time_host(lambda: broker_off.execute(q), iters=7)
+        broker_on = Broker(controller)
+        try:
+            on_ms = _time_host(lambda: broker_on.execute(q), iters=7)
+        finally:
+            broker_on.shutdown()
+
+        # Direct measure of one armed admission decision against a live
+        # scheduler with a warm service-time estimate: exactly one decide()
+        # runs per broker request, so per_decide_us projected against the
+        # query wall must sit inside the 2% budget.
+        ac = AdmissionController(SchedulerConfig())
+        try:
+            ac.note_service_time("t", off_ms)
+            deadline = Deadline.from_timeout_ms(3_600_000.0)
+            decides = 100_000
+            t0 = time.perf_counter()
+            for _ in range(decides):
+                ac.decide("t", deadline)
+            per_decide_us = (time.perf_counter() - t0) / decides * 1e6
+        finally:
+            ac.stop()
+        projected_pct = per_decide_us / (off_ms * 1e3) * 100
+        assert projected_pct < 2.0, (
+            f"admission decide {per_decide_us:.2f}µs = {projected_pct:.2f}% of "
+            f"{off_ms:.1f}ms query — over the 2% request-path budget"
+        )
+        return {
+            "metric": "admission_overhead",
+            "value": round(on_ms - off_ms, 3),
+            "unit": "ms",
+            "n": n,
+            "off_ms": round(off_ms, 3),
+            "on_ms": round(on_ms, 3),
+            "overhead_pct": round((on_ms / off_ms - 1.0) * 100, 1),
+            "decide_us": round(per_decide_us, 4),
+            "projected_pct_per_query": round(projected_pct, 3),
+        }
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+
+
 def bench_trace_overhead(n=200_000, dim=2_000):
     """Tracing-plane cost on the v2 hot path: the same multistage
     join+group-by untraced vs under an active sampled trace. With sampling
@@ -800,6 +886,7 @@ ALL = [
     bench_multistage_join_e2e,
     bench_stats_overhead,
     bench_deadline_overhead,
+    bench_admission_overhead,
     bench_trace_overhead,
     bench_profiler_overhead,
     bench_slo_overhead,

@@ -15,6 +15,8 @@ import time
 from typing import Any
 
 from pinot_tpu.cluster.http import query_broker_http
+from pinot_tpu.cluster.quota import QuotaExceededError
+from pinot_tpu.query.scheduler import SchedulerRejectedError
 
 
 class PinotClientError(RuntimeError):
@@ -113,7 +115,13 @@ class Connection:
     ) -> ResultSet:
         """timeout_ms / allow_partial_results become per-query SET options
         (`timeoutMs`, `allowPartialResults`) prepended to the statement —
-        the java client's query-options map."""
+        the java client's query-options map.
+
+        Admission rejections raise typed: `QuotaExceededError` (HTTP 429)
+        and `SchedulerRejectedError` (HTTP 503 shed), each carrying
+        `retry_after_s` from the broker's Retry-After header. Neither is
+        retried on another broker — the quota/overload verdict applies to
+        the serving plane, not one broker instance."""
         opts = []
         if timeout_ms is not None:
             opts.append(f"SET timeoutMs = {float(timeout_ms):g};")
@@ -126,6 +134,8 @@ class Connection:
             for url in self._selector.urls_in_order():
                 try:
                     return ResultSet(query_broker_http(url, sql))
+                except (QuotaExceededError, SchedulerRejectedError):
+                    raise  # typed admission rejection: honor retry_after_s
                 except PinotClientError:
                     raise  # server-side SQL error: do not retry elsewhere
                 except OSError as e:

@@ -22,6 +22,7 @@ from pinot_tpu.query.context import QueryContext, QueryType
 from pinot_tpu.query.engine import QueryEngine
 from pinot_tpu.query.reduce import build_result
 from pinot_tpu.query.result import ResultTable
+from pinot_tpu.query.scheduler import SchedulerRejectedError
 from pinot_tpu.query.sql import parse_sql
 from pinot_tpu.cluster.controller import Controller
 from pinot_tpu.cluster.routing import BalancedInstanceSelector, segment_can_match
@@ -65,6 +66,9 @@ class _PartialState:
     def __init__(self, allow: bool):
         self.allow = allow
         self.partial = False
+        #: set by the admission controller: projected overload + allowPartial
+        #: -> trim scatter fan-out instead of shedding (see _degrade_plan)
+        self.degrade = False
         self.exceptions: list[dict] = []
         self.servers_queried = 0
         self.servers_responded = 0
@@ -87,6 +91,7 @@ class Broker:
         access_control=None,
         obs_config=None,
         resilience=None,
+        scheduler_config=None,
     ):
         """selector: instance selector (Balanced default; ReplicaGroup /
         Adaptive from cluster.routing). failure_detector: optional
@@ -97,13 +102,29 @@ class Broker:
         controlling the structured slow-query log. resilience:
         common.config.ResilienceConfig — default query timeout, partial-result
         policy, and fault-injection rules (applied to the process-global
-        injector when non-empty)."""
+        injector when non-empty). scheduler_config:
+        common.config.SchedulerConfig — the admission tier: which
+        QueryScheduler the request path runs on (priority default), queue
+        bounds, shed/degrade policy, and per-tenant QPS quotas
+        (SchedulerConfig(enabled=False) restores inline execution)."""
         import collections
 
+        from pinot_tpu.cluster.admission import AdmissionController
         from pinot_tpu.cluster.quota import QueryQuotaManager
-        from pinot_tpu.common.config import ObservabilityConfig, ResilienceConfig
+        from pinot_tpu.common.config import ObservabilityConfig, ResilienceConfig, SchedulerConfig
 
         self.controller = controller
+        self.scheduler_config = (
+            scheduler_config if scheduler_config is not None else SchedulerConfig()
+        )
+        #: admission tier (None when SchedulerConfig.enabled is False): every
+        #: query passes decide() before any work is enqueued, then runs on
+        #: the scheduler's bounded runner pool instead of the caller thread
+        self.admission = (
+            AdmissionController(self.scheduler_config, role="broker")
+            if self.scheduler_config.enabled
+            else None
+        )
         #: broker-tenant membership; None = serve every table (untagged
         #: brokers belong to the DefaultTenant, TagNameUtils parity)
         self.tenant_tags = list(tenant_tags) if tenant_tags is not None else None
@@ -112,7 +133,11 @@ class Broker:
         self.access_control = access_control
         self.selector = selector if selector is not None else BalancedInstanceSelector()
         self.failure_detector = failure_detector
-        self.quota = QueryQuotaManager(controller) if enable_quota else None
+        self.quota = (
+            QueryQuotaManager(controller, tenant_qps=self.scheduler_config.tenant_qps)
+            if enable_quota
+            else None
+        )
         self.query_logger = query_logger
         self.obs_config = obs_config if obs_config is not None else ObservabilityConfig()
         if self.obs_config.profiler_enabled:
@@ -189,6 +214,7 @@ class Broker:
         bm = broker_metrics()
         bm.meter(BrokerMeter.QUERIES).mark()
         table = ""
+        t_entry = time.perf_counter()
         qid = f"q{next(_request_seq)}"
         deadline: Deadline | None = None
         timeout_ms: float | None = None
@@ -232,6 +258,27 @@ class Broker:
                         self.access_control.check(identity, t, READ)
                 if self.quota is not None and table:
                     self.quota.acquire(table)
+                # admission decision BEFORE any work is enqueued: shed
+                # (SchedulerRejectedError -> HTTP 503 + Retry-After) when the
+                # projected completion cannot fit the remaining deadline
+                # budget, or degrade fan-out when the client allows partials
+                if self.admission is not None:
+                    from pinot_tpu.cluster.admission import DEGRADE
+
+                    decision = self.admission.decide(
+                        table or "_default", deadline=deadline, allow_partial=allow_partial
+                    )
+                    if decision == DEGRADE:
+                        partial.degrade = True
+
+                def run_query():
+                    return self._execute(stmt, sql, deadline=deadline, qid=qid, partial=partial)
+
+                def run_admitted():
+                    if self.admission is None:
+                        return run_query()
+                    return self.admission.execute(run_query, table or "_default")
+
                 # per-query tracing (Tracing.java + `trace=true` query option):
                 # always sampled on trace=true, else probabilistically per
                 # ObservabilityConfig.trace_sample_rate (head-based sampling)
@@ -250,7 +297,7 @@ class Broker:
                                 self._running[qid]["trace"] = tr
                                 self._running[qid]["traceId"] = tctx.trace_id
                         try:
-                            result = self._execute(stmt, sql, deadline=deadline, qid=qid, partial=partial)
+                            result = run_admitted()
                         finally:
                             tr.root.duration_ms = (time.perf_counter() - t_start) * 1e3
                             self._store_trace(tr)
@@ -258,7 +305,7 @@ class Broker:
                     if trace_requested:
                         result.trace = tr.to_dict()
                 else:
-                    result = self._execute(stmt, sql, deadline=deadline, qid=qid, partial=partial)
+                    result = run_admitted()
                 # a cancel acknowledged mid-flight must not turn into a
                 # success: the execution may have raced past every check
                 deadline.check("post-execute")
@@ -282,6 +329,13 @@ class Broker:
             bm.meter(BrokerMeter.REQUEST_FAILURES).mark()
             if table:
                 bm.meter("broker.tableErrors", table=table).mark()
+            if isinstance(e, SchedulerRejectedError) or getattr(e, "error_code", None) == QueryErrorCode.QUOTA_EXCEEDED:
+                # rejection latency from request entry to the typed raise:
+                # the overload bench gates this at <100ms (sheds must be
+                # instant verdicts, never queued work that failed late)
+                bm.histogram("broker.admission.shedDecisionMs").update_ms(
+                    (time.perf_counter() - t_entry) * 1e3
+                )
             if tctx is not None and not getattr(e, "trace_id", None):
                 e.trace_id = tctx.trace_id  # exemplar id for the error payload
             kill_reason = getattr(e, "kill_reason", None)
@@ -445,6 +499,47 @@ class Broker:
         }
         return all(c["ok"] for c in components.values()), components
 
+    def shutdown(self) -> None:
+        """Stop the admission scheduler's runner threads (idempotent)."""
+        if self.admission is not None:
+            self.admission.stop()
+
+    def admission_snapshot(self) -> dict:
+        """Live admission-plane state for GET /debug/admission."""
+        if self.admission is not None:
+            snap = self.admission.snapshot()
+        else:
+            snap = {"role": "broker", "enabled": False, "scheduler": None, "counters": {}}
+        snap.setdefault("counters", {})["quotaRejected"] = (
+            self.quota.rejected if self.quota is not None else 0
+        )
+        if self.scheduler_config.tenant_qps:
+            snap["tenantQps"] = dict(self.scheduler_config.tenant_qps)
+        return snap
+
+    def _degrade_plan(self, plan: dict, partial, table: str) -> dict:
+        """Admission degrade: keep the busiest `degrade_keep_fraction` of the
+        planned servers and record the skipped segments as a partial-result
+        loss — reduced fan-out under overload beats queueing the full plan
+        into deadline death. Only active when the admission controller set
+        partial.degrade (which requires allowPartialResults)."""
+        if partial is None or not partial.degrade or len(plan) <= 1:
+            return plan
+        import math
+
+        keep_n = max(1, math.ceil(len(plan) * self.scheduler_config.degrade_keep_fraction))
+        if keep_n >= len(plan):
+            return plan
+        ranked = sorted(plan.items(), key=lambda kv: (-len(kv[1]), kv[0]))
+        kept = dict(ranked[:keep_n])
+        skipped = sum(len(segs) for _, segs in ranked[keep_n:])
+        partial.record(
+            f"admission degrade under overload: serving {keep_n}/{len(plan)} "
+            f"servers for {table}, skipped {skipped} segments",
+            error_code=QueryErrorCode.SERVER_OUT_OF_CAPACITY,
+        )
+        return kept
+
     def _execute(self, stmt, sql: str, deadline=None, qid=None, partial=None) -> ResultTable:
         t0 = time.perf_counter()
         if getattr(stmt, "explain", False) or getattr(stmt, "explain_analyze", False):
@@ -577,6 +672,7 @@ class Broker:
             if ctx.deadline is not None:
                 ctx.deadline.check(f"stream scatter {leg_table}")
             plan, servers, ideal, n_candidates, leg_pruned = self._route_leg(ctx, leg_table)
+            plan = self._degrade_plan(plan, partial, leg_table)
             queried += n_candidates
             pruned += leg_pruned
             hints = dict(ctx.hints)
@@ -779,6 +875,7 @@ class Broker:
         from pinot_tpu.cluster.routing import AdaptiveServerSelector
 
         plan, servers, ideal, n_candidates, pruned = self._route_leg(ctx, table)
+        plan = self._degrade_plan(plan, partial, table)
         hints = dict(ctx.hints)
         if partial is not None:
             partial.servers_queried += len(plan)

@@ -25,7 +25,7 @@ from urllib.parse import urlsplit
 from pinot_tpu.cluster.broker import Broker
 from pinot_tpu.cluster.server import Server
 from pinot_tpu.common import datatable
-from pinot_tpu.common.errors import code_of
+from pinot_tpu.common.errors import QueryErrorCode, code_of, http_status_of, retry_after_of
 from pinot_tpu.common.wire import FRAME_END, FRAME_ERR, get_pool, read_exact
 
 
@@ -260,7 +260,14 @@ class BrokerHTTPService:
                     if getattr(e, "kill_reason", None):
                         entry["killReason"] = e.kill_reason
                     payload = json.dumps({"exceptions": [entry]}).encode()
-                    self.send_response(200)
+                    # admission rejections ride real HTTP statuses (503 shed
+                    # / 429 quota) + Retry-After so load balancers and
+                    # clients back off without parsing the body; every other
+                    # error keeps the BrokerResponse-style 200 + exceptions[]
+                    status = http_status_of(e)
+                    self.send_response(status or 200)
+                    if status is not None:
+                        self.send_header("Retry-After", str(int(retry_after_of(e) + 0.5)))
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Content-Length", str(len(payload)))
                 self.end_headers()
@@ -286,6 +293,11 @@ class BrokerHTTPService:
                     # first query hits this broker (stable scrape schema)
                     reg.timer(BrokerTimer.QUERY_TOTAL)
                     _serve_metrics(self, reg)
+                elif self.path == "/debug/admission":
+                    # live admission-plane state: scheduler queue depths,
+                    # per-group tokens, service-time estimates, shed/quota
+                    # counters (the runbook's first stop under overload)
+                    _send_json(self, svc.broker.admission_snapshot())
                 elif self.path.partition("?")[0] == "/debug/slowQueries":
                     # structured slow-query ring buffer (broker-side triage)
                     payload = json.dumps(list(svc.broker.slow_queries)).encode()
@@ -389,7 +401,10 @@ class ServerHTTPService:
                         payload = json.dumps(
                             {"error": f"{type(e).__name__}: {e}", "errorCode": code_of(e)}
                         ).encode()
-                        self.send_response(500)
+                        status = http_status_of(e)
+                        self.send_response(status or 500)
+                        if status is not None:
+                            self.send_header("Retry-After", str(int(retry_after_of(e) + 0.5)))
                     self.send_header("Content-Type", "application/json")
                     self.send_header("Content-Length", str(len(payload)))
                     self.end_headers()
@@ -496,12 +511,18 @@ class ServerHTTPService:
                     )
                 except Exception as e:
                     # surface the real error to the broker instead of a
-                    # dropped connection; accountant kills keep their reason
+                    # dropped connection; accountant kills keep their reason.
+                    # Scheduler rejections (queue overflow) ride their real
+                    # status (503) + Retry-After so the broker can classify
+                    # the shed without string-matching
                     doc = {"error": f"{type(e).__name__}: {e}", "errorCode": code_of(e)}
                     if getattr(e, "kill_reason", None):
                         doc["killReason"] = e.kill_reason
                     payload = json.dumps(doc).encode()
-                    self.send_response(500)
+                    status = http_status_of(e)
+                    self.send_response(status or 500)
+                    if status is not None:
+                        self.send_header("Retry-After", str(int(retry_after_of(e) + 0.5)))
                     self.send_header("Content-Type", "application/json")
                     self.send_header("Content-Length", str(len(payload)))
                     self.end_headers()
@@ -530,6 +551,10 @@ class ServerHTTPService:
                     _serve_pprof(self)
                 elif self.path == "/debug/workload":
                     _serve_workload(self)
+                elif self.path == "/debug/admission":
+                    # live scheduler state (server role): queue depths,
+                    # in-flight counts, per-group tokens
+                    _send_json(self, svc.server.admission_snapshot())
                 elif self.path == "/debug/queries":
                     # ThreadResourceTracker/QueryResourceTracker REST parity
                     from pinot_tpu.common.accounting import default_accountant
@@ -639,17 +664,27 @@ class RemoteServerClient:
             ) as resp:
                 payload = resp.read()
                 status = resp.status
+                retry_after = resp.getheader("Retry-After")
         except (TimeoutError, OSError) as e:
             raise RuntimeError(f"server {self.base_url} unreachable: {e}") from None
         if status >= 400:
             detail = bytes(payload).decode(errors="replace")
-            err = RuntimeError(f"server error from {self.base_url}: {detail}")
             try:
-                kill = json.loads(detail).get("killReason")
+                doc = json.loads(detail)
             except Exception:  # pinotlint: disable=deadline-swallow — non-JSON error detail; the RuntimeError below carries it verbatim
-                kill = None
-            if kill:
-                err.kill_reason = kill  # re-attach across the HTTP hop
+                doc = {}
+            if status == 503 and doc.get("errorCode") == int(QueryErrorCode.SERVER_OUT_OF_CAPACITY):
+                # server-side shed stays typed across the hop: the broker
+                # surfaces it as its own 503 + Retry-After, not a failover
+                from pinot_tpu.query.scheduler import SchedulerRejectedError
+
+                raise SchedulerRejectedError(
+                    f"server {self.base_url} out of capacity: {doc.get('error', detail)}",
+                    retry_after_s=float(retry_after or 1.0),
+                ) from None
+            err = RuntimeError(f"server error from {self.base_url}: {detail}")
+            if doc.get("killReason"):
+                err.kill_reason = doc["killReason"]  # re-attach across the HTTP hop
             raise err from None
         return datatable.decode(payload)
 
@@ -1160,8 +1195,30 @@ def query_broker_http(base_url: str, sql: str) -> dict:
         timeout_s=60,
     ) as resp:
         payload = resp.read()
-        if resp.status >= 400:
-            raise RuntimeError(
-                f"broker error ({resp.status}): {bytes(payload).decode(errors='replace')}"
-            )
+        status = resp.status
+        retry_after = resp.getheader("Retry-After")
+    if status >= 400:
+        detail = bytes(payload).decode(errors="replace")
+        if status in (429, 503):
+            _raise_admission_error(status, detail, retry_after)
+        raise RuntimeError(f"broker error ({status}): {detail}")
     return json.loads(payload)
+
+
+def _raise_admission_error(status: int, detail: str, retry_after) -> None:
+    """Map a broker 429/503 admission rejection back to the typed exception
+    it started as (QuotaExceededError / SchedulerRejectedError), preserving
+    the Retry-After hint — clients get a class to catch and a backoff to
+    honor instead of a generic RuntimeError."""
+    try:
+        message = json.loads(detail)["exceptions"][0]["message"]
+    except Exception:  # pinotlint: disable=deadline-swallow — non-JSON rejection body; the raw detail is the message
+        message = detail
+    wait_s = float(retry_after or 1.0)
+    if status == 429:
+        from pinot_tpu.cluster.quota import QuotaExceededError
+
+        raise QuotaExceededError(message, retry_after_s=wait_s)
+    from pinot_tpu.query.scheduler import SchedulerRejectedError
+
+    raise SchedulerRejectedError(message, retry_after_s=wait_s)

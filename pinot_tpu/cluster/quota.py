@@ -15,16 +15,34 @@ import logging
 import threading
 import time
 
+from pinot_tpu.common.errors import QueryErrorCode
+
 
 class QuotaExceededError(RuntimeError):
-    """Surfaced to clients as the 429-style quota-exceeded broker error."""
+    """Surfaced to clients as the HTTP 429 quota-exceeded broker error.
+    Carries the registered error code so `code_of()` maps it at response
+    boundaries, plus a `Retry-After` hint (the quota window length)."""
+
+    error_code = QueryErrorCode.QUOTA_EXCEEDED
+
+    def __init__(self, message: str, retry_after_s: float = 1.0):
+        super().__init__(message)
+        self.retry_after_s = retry_after_s
 
 
 class QueryQuotaManager:
-    def __init__(self, controller):
+    """Sliding-1s-window QPS admission, per table (from TableConfig
+    extra["queryQuotaQps"]) and per tenant (from `tenant_qps`, aggregated
+    across every table the tenant serves — the HelixExternalViewBased
+    database/application rate-limiter analog)."""
+
+    def __init__(self, controller, tenant_qps: dict[str, float] | None = None):
         self._controller = controller
         self._hits: dict[str, collections.deque] = {}
+        self._tenant_hits: dict[str, collections.deque] = {}
+        self._tenant_qps = dict(tenant_qps or {})
         self._lock = threading.Lock()
+        self.rejected = 0  # lifetime rejections (debug/admission snapshot)
 
     def _qps_limit(self, table: str) -> float | None:
         config = self._controller.get_table(table)
@@ -33,24 +51,64 @@ class QueryQuotaManager:
         q = (config.extra or {}).get("queryQuotaQps")
         return float(q) if q else None
 
-    def acquire(self, table: str) -> None:
-        """Admit or reject one query against the table's QPS quota."""
+    @staticmethod
+    def _over(dq: collections.deque, now: float, limit: float) -> bool:
+        while dq and now - dq[0] > 1.0:
+            dq.popleft()
+        return len(dq) >= limit
+
+    def _reject(self, message: str, table: str, tenant: str) -> None:
+        from pinot_tpu.common.metrics import broker_metrics
+
+        self.rejected += 1
+        broker_metrics().meter(
+            "broker.admission.quotaRejected", table=table, tenant=tenant or "unknown"
+        ).mark()
+        raise QuotaExceededError(message, retry_after_s=1.0)
+
+    def _tenant_of(self, table: str) -> str:
+        from pinot_tpu.cluster.tenancy import table_tenants
+
+        config = self._controller.get_table(table) or self._controller.get_table(
+            f"{table}_REALTIME"
+        )
+        return table_tenants(config)[1] if config is not None else ""
+
+    def acquire(self, table: str, tenant: str | None = None) -> None:
+        """Admit or reject one query against the table's QPS quota and (when
+        configured) the owning tenant's aggregate QPS quota. The tenant is
+        resolved from the table config when not supplied — and only when
+        tenant quotas exist, so the common no-quota path stays one lookup."""
         limit = self._qps_limit(table)
-        if limit is None:
+        tenant_limit = None
+        if self._tenant_qps:
+            if tenant is None:
+                tenant = self._tenant_of(table)
+            tenant_limit = self._tenant_qps.get(tenant)
+        tenant = tenant or ""
+        if limit is None and tenant_limit is None:
             return
         now = time.monotonic()
         with self._lock:
-            dq = self._hits.setdefault(table, collections.deque())
-            while dq and now - dq[0] > 1.0:
-                dq.popleft()
-            if len(dq) >= limit:
-                from pinot_tpu.common.metrics import broker_metrics
-
-                broker_metrics().meter(f"broker.{table}.queryQuotaExceeded").mark()
-                raise QuotaExceededError(
-                    f"table {table!r} exceeded query quota of {limit} QPS"
-                )
-            dq.append(now)
+            if limit is not None:
+                dq = self._hits.setdefault(table, collections.deque())
+                if self._over(dq, now, limit):
+                    self._reject(
+                        f"table {table!r} exceeded query quota of {limit} QPS",
+                        table,
+                        tenant,
+                    )
+            if tenant_limit is not None:
+                tq = self._tenant_hits.setdefault(tenant, collections.deque())
+                if self._over(tq, now, tenant_limit):
+                    self._reject(
+                        f"tenant {tenant!r} exceeded query quota of {tenant_limit} QPS",
+                        table,
+                        tenant,
+                    )
+                tq.append(now)
+            if limit is not None:
+                self._hits[table].append(now)
 
 
 class QueryLogger:

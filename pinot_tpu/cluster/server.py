@@ -25,9 +25,22 @@ _query_seq = itertools.count()
 
 class Server:
     def __init__(self, server_id: str, fast32: bool = False, scheduler=None):
-        """`scheduler`: optional QueryScheduler (query/scheduler.py). When set,
-        execute_partials routes through it (QueryScheduler.submit parity);
+        """`scheduler`: optional QueryScheduler instance, a
+        common.config.SchedulerConfig, or a kind string
+        ("fcfs" | "priority" | "binary_workload"). When set, execute_partials
+        and multistage_submit route through it (QueryScheduler.submit
+        parity) so server-side concurrency is bounded and queue overflow
+        surfaces as SchedulerRejectedError (-> HTTP 503 + Retry-After);
         None executes inline (the in-process test default)."""
+        if scheduler is not None and not hasattr(scheduler, "submit"):
+            from pinot_tpu.common.config import SchedulerConfig
+
+            cfg = (
+                scheduler
+                if isinstance(scheduler, SchedulerConfig)
+                else SchedulerConfig(kind=str(scheduler))
+            )
+            scheduler = cfg.make()
         self.server_id = server_id
         self._tables: dict[str, dict[str, ImmutableSegment]] = {}
         self._engines: dict[str, QueryEngine] = {}
@@ -48,6 +61,16 @@ class Server:
     def shutdown(self) -> None:
         if self._scheduler is not None:
             self._scheduler.stop()
+
+    def admission_snapshot(self) -> dict:
+        """Live scheduler state for GET /debug/admission (server role)."""
+        sched = self._scheduler
+        return {
+            "role": "server",
+            "serverId": self.server_id,
+            "enabled": sched is not None,
+            "scheduler": sched.stats() if sched is not None else None,
+        }
 
     # -- cancellation ---------------------------------------------------------
 
@@ -186,7 +209,19 @@ class Server:
     def multistage_submit(self, body: dict) -> None:
         """Accept a distributed stage-plan submission (QueryServer.submit
         parity, worker.proto:24-32): rebuild the plan and run this server's
-        assigned (stage, worker) OpChains on background threads."""
+        assigned (stage, worker) OpChains on background threads. With a
+        scheduler configured, the plan rebuild + worker launch is admitted
+        through it, so a flood of stage submissions is bounded by the same
+        queue that bounds the v1 scatter path (overflow rejects with
+        SchedulerRejectedError instead of spawning unbounded workers)."""
+        if self._scheduler is not None:
+            tables = sorted(body.get("segments") or {})
+            group = tables[0] if tables else "_stages"
+            self._scheduler.submit(self._multistage_submit_inner, body, table=group).result()
+            return
+        self._multistage_submit_inner(body)
+
+    def _multistage_submit_inner(self, body: dict) -> None:
         from pinot_tpu.multistage.distributed import run_assigned_stages
 
         placement = {(int(s), int(w)): owner for s, w, owner in body["placement"]}

@@ -126,6 +126,118 @@ class ResilienceConfig:
 
 
 @dataclass
+class SchedulerConfig:
+    """Admission / scheduling knobs for the serving path
+    (pinot.query.scheduler.name + accounting-factory parity).
+
+    Selects the QueryScheduler implementation the broker request path and
+    the server scatter/stage path run queries through, bounds its per-group
+    queues, and tunes the admission controller built on top (wait-estimate
+    shedding, quota enforcement, degrade-under-partial)."""
+
+    #: scheduler implementation: "fcfs" | "priority" | "binary_workload";
+    #: priority = per-table groups with token-bucket fairness (the default)
+    kind: str = "priority"
+    #: concurrent query slots (runner threads). The default is deliberately
+    #: generous: numpy kernels release the GIL, so steady-state throughput
+    #: needs wide concurrency — overload protection comes from the shed
+    #: projection and the bounded per-group queues, not a small pool
+    num_runners: int = 64
+    #: bounded per-group queue length; overflow -> SchedulerRejectedError
+    max_pending_per_group: int = 256
+    #: token-bucket accrual rate / burst for the priority scheduler
+    tokens_per_sec: float = 1.0
+    token_burst_sec: float = 4.0
+    #: binary-workload lane caps (kind="binary_workload" only)
+    secondary_runners: int = 1
+    max_secondary_pending: int = 16
+    #: master switch: False = run queries inline on the caller thread with
+    #: no admission control (the pre-scheduler behavior)
+    enabled: bool = True
+    #: shed queries whose projected completion exceeds remaining deadline
+    #: budget (never enqueue work that is already doomed)
+    shed_enabled: bool = True
+    #: shed when projected_completion_ms > remaining_ms * this headroom
+    #: factor (<1.0 sheds earlier, leaving slack for reduce/transport)
+    shed_headroom: float = 0.9
+    #: floor for the per-table service-time EWMA so a cold estimator never
+    #: projects zero wait
+    min_service_ms: float = 1.0
+    #: EWMA smoothing for observed service times (weight of the new sample)
+    service_ewma_alpha: float = 0.2
+    #: under degrade (allowPartialResults + projected overload), keep this
+    #: fraction of the planned scatter servers (floor 1)
+    degrade_keep_fraction: float = 0.5
+    #: per-tenant aggregate QPS quotas (tenant -> QPS), enforced by
+    #: QueryQuotaManager alongside per-table TableConfig quotas
+    tenant_qps: dict = field(default_factory=dict)
+
+    def to_dict(self) -> dict:
+        return {
+            "kind": self.kind,
+            "numRunners": self.num_runners,
+            "maxPendingPerGroup": self.max_pending_per_group,
+            "tokensPerSec": self.tokens_per_sec,
+            "tokenBurstSec": self.token_burst_sec,
+            "secondaryRunners": self.secondary_runners,
+            "maxSecondaryPending": self.max_secondary_pending,
+            "enabled": self.enabled,
+            "shedEnabled": self.shed_enabled,
+            "shedHeadroom": self.shed_headroom,
+            "minServiceMs": self.min_service_ms,
+            "serviceEwmaAlpha": self.service_ewma_alpha,
+            "degradeKeepFraction": self.degrade_keep_fraction,
+            "tenantQps": dict(self.tenant_qps),
+        }
+
+    @staticmethod
+    def from_dict(d: dict) -> "SchedulerConfig":
+        return SchedulerConfig(
+            kind=d.get("kind", "priority"),
+            num_runners=d.get("numRunners", 64),
+            max_pending_per_group=d.get("maxPendingPerGroup", 256),
+            tokens_per_sec=d.get("tokensPerSec", 1.0),
+            token_burst_sec=d.get("tokenBurstSec", 4.0),
+            secondary_runners=d.get("secondaryRunners", 1),
+            max_secondary_pending=d.get("maxSecondaryPending", 16),
+            enabled=d.get("enabled", True),
+            shed_enabled=d.get("shedEnabled", True),
+            shed_headroom=d.get("shedHeadroom", 0.9),
+            min_service_ms=d.get("minServiceMs", 1.0),
+            service_ewma_alpha=d.get("serviceEwmaAlpha", 0.2),
+            degrade_keep_fraction=d.get("degradeKeepFraction", 0.5),
+            tenant_qps=d.get("tenantQps", {}),
+        )
+
+    def make(self):
+        """Build the configured QueryScheduler (not started); None when
+        scheduling is disabled."""
+        if not self.enabled:
+            return None
+        from pinot_tpu.query.scheduler import make_scheduler
+
+        kind = self.kind.lower()
+        if kind == "fcfs":
+            return make_scheduler("fcfs", num_runners=self.num_runners)
+        if kind in ("binary_workload", "binaryworkload"):
+            return make_scheduler(
+                "binary_workload",
+                num_runners=self.num_runners,
+                secondary_runners=self.secondary_runners,
+                max_secondary_pending=self.max_secondary_pending,
+            )
+        if kind != "priority":
+            raise ValueError(f"unknown scheduler kind: {self.kind}")
+        return make_scheduler(
+            "priority",
+            num_runners=self.num_runners,
+            tokens_per_sec=self.tokens_per_sec,
+            token_burst_sec=self.token_burst_sec,
+            max_pending_per_group=self.max_pending_per_group,
+        )
+
+
+@dataclass
 class StarTreeIndexConfig:
     """Parity with StarTreeIndexConfig (dimensionsSplitOrder,
     functionColumnPairs, maxLeafRecords)."""

@@ -30,9 +30,44 @@ class QueryErrorCode(enum.IntEnum):
     #: query was cancelled via DELETE /query/{id} (QueryCancelledException)
     QUERY_CANCELLATION = 503
 
+    #: admission tier shed the query before any work was enqueued — queue
+    #: overflow, scheduler shutdown, or projected completion past the deadline
+    #: (SERVER_OUT_OF_CAPACITY_ERROR_CODE parity); travels as HTTP 503
+    SERVER_OUT_OF_CAPACITY = 211
+
+    #: per-table / per-tenant QPS quota rejection by QueryQuotaManager
+    #: (TOO_MANY_REQUESTS_ERROR_CODE parity); travels as HTTP 429
+    QUOTA_EXCEEDED = 429
+
+
+#: Error codes that map to a non-200 HTTP status at response boundaries.
+#: Everything else stays the BrokerResponse convention: HTTP 200 with the
+#: code inside `exceptions[]`. Shed/quota responses use real statuses so
+#: load balancers and clients can back off without parsing the body.
+_HTTP_STATUS_BY_CODE = {
+    int(QueryErrorCode.SERVER_OUT_OF_CAPACITY): 503,
+    int(QueryErrorCode.QUOTA_EXCEEDED): 429,
+}
+
 
 def code_of(exc: BaseException, default: int = QueryErrorCode.QUERY_EXECUTION) -> int:
     """Error code carried by an exception (its `error_code` attribute), or
     `default`. The one sanctioned way to map an arbitrary exception to a
     wire code at response boundaries."""
     return int(getattr(exc, "error_code", default))
+
+
+def http_status_of(exc: BaseException) -> int | None:
+    """HTTP status override for admission-tier rejections (503 shed /
+    429 quota), or None for errors that ride in a 200 BrokerResponse."""
+    return _HTTP_STATUS_BY_CODE.get(code_of(exc, default=0))
+
+
+def retry_after_of(exc: BaseException, default: float = 1.0) -> float:
+    """`Retry-After` seconds carried by an admission rejection (its
+    `retry_after_s` attribute), floored at 1 s for header sanity."""
+    v = getattr(exc, "retry_after_s", None)
+    try:
+        return max(1.0, float(v)) if v is not None else float(default)
+    except (TypeError, ValueError):
+        return float(default)

@@ -15,6 +15,7 @@ Well-known points (wired in this repo):
     server.scatter   — Server.execute_partials entry (v1 scatter target)
     stream.consume   — Server.execute_partials_stream, per yielded frame
     wire.connect     — ConnectionPool._connect, before the TCP connect
+    scheduler.admit  — AdmissionController.decide, before any admission math
 """
 
 from __future__ import annotations
@@ -39,6 +40,7 @@ FAULT_POINTS = frozenset(
         "server.scatter",  # Server.execute_partials entry (v1 scatter target)
         "stream.consume",  # Server.execute_partials_stream, per yielded frame
         "wire.connect",  # ConnectionPool._connect, before the TCP connect
+        "scheduler.admit",  # AdmissionController.decide, before admission math
     }
 )
 

@@ -543,10 +543,17 @@ class BrokerMeter(Enum):
     QUERIES_CANCELLED = "broker.queriesCancelled"
     PARTIAL_RESPONSES = "broker.partialResponses"
     DOCS_SCANNED = "broker.docsScanned"
+    # admission tier (one series per table label)
+    ADMISSION_ADMITTED = "broker.admission.admitted"
+    ADMISSION_SHED = "broker.admission.shed"
+    ADMISSION_QUOTA_REJECTED = "broker.admission.quotaRejected"
+    ADMISSION_DEGRADED = "broker.admission.degraded"
 
 
 class BrokerGauge(Enum):
     ONLINE_SERVERS = "broker.onlineServers"
+    ADMISSION_QUEUE_DEPTH = "broker.admission.queueDepth"
+    ADMISSION_IN_FLIGHT = "broker.admission.inFlight"
 
 
 class BrokerTimer(Enum):

@@ -26,10 +26,21 @@ import threading
 import time
 from concurrent.futures import Future
 
+from pinot_tpu.common.errors import QueryErrorCode
+
 
 class SchedulerRejectedError(RuntimeError):
-    """Query rejected at submission (queue overflow / shutdown) —
-    the QueryScheduler 'server out of capacity' error response."""
+    """Query rejected at submission (queue overflow / shutdown) or shed by
+    the admission tier — the QueryScheduler 'server out of capacity' error
+    response. Carries the registered error code so `code_of()` maps it at
+    every response boundary, plus an optional `Retry-After` hint in seconds
+    (the admission controller's projected drain time)."""
+
+    error_code = QueryErrorCode.SERVER_OUT_OF_CAPACITY
+
+    def __init__(self, message: str, retry_after_s: float | None = None):
+        super().__init__(message)
+        self.retry_after_s = retry_after_s
 
 
 class _Job:
@@ -57,8 +68,18 @@ class _Job:
             self.future.set_exception(e)
 
 
+#: runner threads started eagerly; the pool grows on demand up to
+#: num_runners as submissions back up (idle services stay this small)
+_CORE_RUNNERS = 4
+
+
 class QueryScheduler:
-    """Base: N runner threads draining `_next_job()`."""
+    """Base: N runner threads draining `_next_job()`.
+
+    The pool is elastic: `start()` spawns at most `_CORE_RUNNERS` threads
+    and `submit()` adds one (up to `num_runners`) whenever queued+running
+    work exceeds the live thread count — so a broker with a generous
+    `num_runners` cap doesn't pin dozens of idle threads per instance."""
 
     def __init__(self, num_runners: int = 4, name: str = "scheduler"):
         self.num_runners = num_runners
@@ -68,11 +89,35 @@ class QueryScheduler:
         self._lock = threading.Lock()
         self._wake = threading.Condition(self._lock)
         self._queued = 0  # jobs enqueued but not yet picked up (pending())
+        self._in_flight = 0  # jobs picked up by a runner, not yet finished
 
     def pending(self) -> int:
         """Queued-but-not-running job count (leak-check / observability)."""
         with self._lock:
             return self._queued
+
+    def in_flight(self) -> int:
+        """Jobs currently executing on runner threads."""
+        with self._lock:
+            return self._in_flight
+
+    def queue_depths(self) -> dict[str, int]:
+        """Per-group queued-job counts (single anonymous group by default;
+        strategy subclasses report their real lanes/groups)."""
+        with self._lock:
+            return {"": self._queued}
+
+    def stats(self) -> dict:
+        """Live scheduler state for /debug/admission and metrics export."""
+        with self._lock:
+            return {
+                "kind": self._name,
+                "numRunners": self.num_runners,
+                "liveRunners": len(self._threads),
+                "running": self._running,
+                "pending": self._queued,
+                "inFlight": self._in_flight,
+            }
 
     # -- lifecycle ----------------------------------------------------------
 
@@ -81,10 +126,17 @@ class QueryScheduler:
             if self._running:
                 return
             self._running = True
-        for i in range(self.num_runners):
-            t = threading.Thread(target=self._runner_loop, name=f"{self._name}-runner-{i}", daemon=True)
-            t.start()
+            self._spawn_locked(min(self.num_runners, _CORE_RUNNERS))
+
+    def _spawn_locked(self, n: int) -> None:
+        for _ in range(n):
+            t = threading.Thread(
+                target=self._runner_loop,
+                name=f"{self._name}-runner-{len(self._threads)}",
+                daemon=True,
+            )
             self._threads.append(t)
+            t.start()
 
     def stop(self) -> None:
         with self._lock:
@@ -109,6 +161,12 @@ class QueryScheduler:
                 raise SchedulerRejectedError("scheduler not running")
             self._enqueue(job)
             self._queued += 1
+            # grow the elastic pool while work is backing up
+            if (
+                len(self._threads) < self.num_runners
+                and self._queued + self._in_flight > len(self._threads)
+            ):
+                self._spawn_locked(1)
             self._wake.notify()
         return job.future
 
@@ -145,10 +203,12 @@ class QueryScheduler:
                 if not self._running:
                     return
                 self._queued -= 1
+                self._in_flight += 1
             t0 = time.perf_counter()
             job.run()
             elapsed = time.perf_counter() - t0
             with self._lock:
+                self._in_flight -= 1
                 self._on_finish(job, elapsed)
                 self._wake.notify()
 
@@ -248,6 +308,17 @@ class PriorityScheduler(QueryScheduler):
                 b.refill()
             return {g: b.tokens for g, b in self._buckets.items()}
 
+    def queue_depths(self) -> dict[str, int]:
+        with self._lock:
+            return {g: len(q) for g, q in self._groups.items()}
+
+    def stats(self) -> dict:
+        out = super().stats()
+        out["maxPendingPerGroup"] = self._max_pending
+        out["queueDepths"] = self.queue_depths()
+        out["groupTokens"] = self.group_tokens()
+        return out
+
 
 class BinaryWorkloadScheduler(QueryScheduler):
     """Two lanes (BinaryWorkloadScheduler parity): PRIMARY jobs always run;
@@ -286,6 +357,16 @@ class BinaryWorkloadScheduler(QueryScheduler):
         out = self._primary + self._secondary
         self._primary.clear()
         self._secondary.clear()
+        return out
+
+    def queue_depths(self) -> dict[str, int]:
+        with self._lock:
+            return {"PRIMARY": len(self._primary), "SECONDARY": len(self._secondary)}
+
+    def stats(self) -> dict:
+        out = super().stats()
+        out["queueDepths"] = self.queue_depths()
+        out["secondaryRunning"] = self._secondary_running
         return out
 
 

@@ -63,9 +63,13 @@ def cmd_start_controller(args) -> dict:
 def cmd_start_server(args) -> dict:
     from pinot_tpu.cluster import Server
     from pinot_tpu.cluster.http import RemoteControllerClient, ServerHTTPService
-    from pinot_tpu.query.scheduler import make_scheduler
+    from pinot_tpu.common.config import SchedulerConfig
 
-    scheduler = make_scheduler(args.scheduler, num_runners=args.runners) if args.scheduler else None
+    scheduler = (
+        SchedulerConfig(kind=args.scheduler, num_runners=args.runners)
+        if args.scheduler
+        else None
+    )
     server = Server(args.server_id, scheduler=scheduler)
     svc = ServerHTTPService(server, port=args.port)
     RemoteControllerClient(args.controller_url).register_instance(
@@ -76,11 +80,22 @@ def cmd_start_server(args) -> dict:
 
 
 def cmd_start_broker(args) -> dict:
+    import json as _json
+
     from pinot_tpu.cluster.broker import Broker
     from pinot_tpu.cluster.http import BrokerHTTPService, RemoteControllerClient
+    from pinot_tpu.common.config import SchedulerConfig
 
     rc = RemoteControllerClient(args.controller_url)
-    broker = Broker(rc)
+    # --scheduler-json takes SchedulerConfig camelCase keys, e.g.
+    # '{"numRunners": 16, "shedHeadroom": 0.8, "tenantQps": {"T": 50}}';
+    # empty string keeps the admission tier at defaults
+    sched_cfg = (
+        SchedulerConfig.from_dict(_json.loads(args.scheduler_json))
+        if getattr(args, "scheduler_json", "")
+        else None
+    )
+    broker = Broker(rc, scheduler_config=sched_cfg)
     svc = BrokerHTTPService(broker, port=args.port)
     rc.register_instance("broker", args.broker_id, "127.0.0.1", svc.port)
     print(f"broker listening on http://127.0.0.1:{svc.port}", flush=True)
@@ -493,6 +508,11 @@ def build_parser() -> argparse.ArgumentParser:
     b.add_argument("--controller-url", required=True)
     b.add_argument("--broker-id", default="broker_0")
     b.add_argument("--port", type=int, default=0)
+    b.add_argument(
+        "--scheduler-json",
+        default="",
+        help='SchedulerConfig overrides as camelCase JSON, e.g. \'{"numRunners": 16}\'',
+    )
     b.set_defaults(fn=cmd_start_broker, blocking=True)
 
     a = sub.add_parser("AddTable")

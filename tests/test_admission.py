@@ -1,0 +1,427 @@
+"""Admission-plane tests: wait-estimate shedding, quota 429s, degrade under
+allowPartialResults, typed errors across the HTTP boundary, and the
+/debug/admission + metrics surfaces.
+
+Model: the reference's scheduler/ResourceManager tier plus the broker
+QueryQuotaManager rejection semantics — overload answered by explicit 503 +
+Retry-After (SERVER_OUT_OF_CAPACITY) or 429 (QUOTA_EXCEEDED), never by
+silent queueing into deadline death.
+"""
+
+import json
+import threading
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from pinot_tpu.cluster import Broker, Controller, PropertyStore, Server
+from pinot_tpu.cluster.admission import ADMIT, DEGRADE, AdmissionController
+from pinot_tpu.cluster.quota import QuotaExceededError
+from pinot_tpu.common import DataType, Schema, TableConfig
+from pinot_tpu.common.config import SchedulerConfig
+from pinot_tpu.common.errors import QueryErrorCode, code_of, http_status_of, retry_after_of
+from pinot_tpu.common.faults import FAULTS
+from pinot_tpu.common.metrics import broker_metrics, reset_registries
+from pinot_tpu.query.context import Deadline
+from pinot_tpu.query.scheduler import FCFSScheduler, PriorityScheduler, SchedulerRejectedError
+from pinot_tpu.segment import SegmentBuilder
+
+
+@pytest.fixture(autouse=True)
+def _clean_state():
+    FAULTS.reset()
+    reset_registries()
+    yield
+    FAULTS.reset()
+    reset_registries()
+
+
+def _build_cluster(tmp_path, n_servers=2, replication=1, table_extra=None, n_segs=4):
+    controller = Controller(PropertyStore(), tmp_path / "ds")
+    servers = {f"s{i}": Server(f"s{i}") for i in range(n_servers)}
+    for sid, s in servers.items():
+        controller.register_server(sid, s)
+    schema = Schema.build(
+        "t", dimensions=[("d", DataType.INT)], metrics=[("v", DataType.LONG)]
+    )
+    controller.add_schema(schema)
+    controller.add_table(TableConfig("t", replication=replication, extra=table_extra or {}))
+    b = SegmentBuilder(schema)
+    rng = np.random.default_rng(0)
+    for i in range(n_segs):
+        controller.upload_segment(
+            "t",
+            b.build(
+                {
+                    "d": rng.integers(0, 10, 200).astype(np.int32),
+                    "v": np.full(200, i, dtype=np.int64),
+                },
+                f"t_{i}",
+            ),
+        )
+    return controller, servers
+
+
+class _StubScheduler:
+    """Fixed queue-state scheduler for deterministic decide() math."""
+
+    def __init__(self, pending=0, in_flight=0, num_runners=1):
+        self.num_runners = num_runners
+        self._pending = pending
+        self._in_flight = in_flight
+
+    def start(self):
+        pass
+
+    def stop(self):
+        pass
+
+    def pending(self):
+        return self._pending
+
+    def in_flight(self):
+        return self._in_flight
+
+    def queue_depths(self):
+        return {"t": self._pending}
+
+    def stats(self):
+        return {"kind": "stub", "pending": self._pending}
+
+
+# -- decide() math -----------------------------------------------------------
+
+
+def test_decide_admits_when_idle():
+    ac = AdmissionController(SchedulerConfig(), scheduler=_StubScheduler())
+    assert ac.decide("t", Deadline.from_timeout_ms(30_000)) == ADMIT
+    assert ac.admitted == 1 and ac.shed == 0
+
+
+def test_decide_sheds_projected_overload():
+    ac = AdmissionController(
+        SchedulerConfig(), scheduler=_StubScheduler(pending=10, in_flight=1, num_runners=1)
+    )
+    ac.note_service_time("t", 200.0)
+    # projected: 11 jobs ahead of 1 runner at ~200ms each >> 300ms budget
+    with pytest.raises(SchedulerRejectedError) as ei:
+        ac.decide("t", Deadline.from_timeout_ms(300))
+    e = ei.value
+    assert code_of(e) == QueryErrorCode.SERVER_OUT_OF_CAPACITY
+    assert http_status_of(e) == 503
+    assert retry_after_of(e) >= 1.0
+    assert ac.shed == 1
+
+
+def test_decide_degrades_under_allow_partial():
+    ac = AdmissionController(
+        SchedulerConfig(), scheduler=_StubScheduler(pending=10, in_flight=1, num_runners=1)
+    )
+    ac.note_service_time("t", 200.0)
+    assert ac.decide("t", Deadline.from_timeout_ms(300), allow_partial=True) == DEGRADE
+    assert ac.degraded == 1 and ac.shed == 0
+
+
+def test_service_estimator_ewma_floor_and_cold_borrow():
+    cfg = SchedulerConfig(min_service_ms=2.0, service_ewma_alpha=0.5)
+    ac = AdmissionController(cfg, scheduler=_StubScheduler())
+    assert ac.service_estimate_ms("t") == 2.0  # cold floor
+    ac.note_service_time("t", 100.0)
+    assert ac.service_estimate_ms("t") == 100.0
+    ac.note_service_time("t", 50.0)
+    assert ac.service_estimate_ms("t") == pytest.approx(75.0)
+    # a cold table borrows the busiest estimate, not the floor
+    assert ac.service_estimate_ms("other") == pytest.approx(75.0)
+
+
+def test_execute_runs_on_scheduler_and_feeds_estimator():
+    ac = AdmissionController(SchedulerConfig(), scheduler=FCFSScheduler(num_runners=2))
+    try:
+        assert ac.execute(lambda: 41 + 1, "t") == 42
+        assert ac.service_estimate_ms("t") >= SchedulerConfig().min_service_ms
+        snap = broker_metrics().snapshot()
+        assert any(k.startswith("broker.admission.queueWaitMs") for k in snap)
+    finally:
+        ac.stop()
+
+
+def test_submit_overflow_is_shed_with_retry_after():
+    sched = PriorityScheduler(num_runners=1, max_pending_per_group=1)
+    ac = AdmissionController(SchedulerConfig(), scheduler=sched)
+    release = threading.Event()
+    started = threading.Event()
+
+    def blocker():
+        started.set()
+        release.wait(5)
+
+    try:
+        ac._ensure_started()
+        sched.submit(blocker, table="t")
+        assert started.wait(5)
+        sched.submit(lambda: None, table="t")  # fills the single queue slot
+        with pytest.raises(SchedulerRejectedError) as ei:
+            ac.execute(lambda: None, "t")
+        assert ei.value.retry_after_s >= 1.0
+        assert ac.shed == 1
+    finally:
+        release.set()
+        ac.stop()
+
+
+def test_snapshot_reports_live_state():
+    ac = AdmissionController(SchedulerConfig(num_runners=3))
+    try:
+        ac.decide("t", Deadline.from_timeout_ms(30_000))
+        snap = ac.snapshot()
+        assert snap["enabled"] and snap["scheduler"]["kind"] == "priority"
+        assert snap["scheduler"]["numRunners"] == 3
+        assert snap["counters"]["admitted"] == 1
+    finally:
+        ac.stop()
+
+
+# -- broker integration ------------------------------------------------------
+
+
+def test_broker_sheds_doomed_query(tmp_path):
+    controller, _ = _build_cluster(tmp_path)
+    broker = Broker(controller, scheduler_config=SchedulerConfig(num_runners=2))
+    try:
+        # prime the estimator: every query "takes" ~10s, so a 500ms deadline
+        # is doomed before it enqueues
+        broker.admission.note_service_time("t", 10_000.0)
+        with pytest.raises(SchedulerRejectedError) as ei:
+            broker.execute("SET timeoutMs = 500; SELECT COUNT(*) FROM t")
+        assert code_of(ei.value) == QueryErrorCode.SERVER_OUT_OF_CAPACITY
+        snap = broker.admission_snapshot()
+        assert snap["counters"]["shed"] == 1
+        # an honest deadline admits fine afterwards
+        res = broker.execute("SELECT COUNT(*) FROM t")
+        assert res.rows[0][0] == 800
+    finally:
+        broker.shutdown()
+
+
+def test_broker_degrades_fanout_under_allow_partial(tmp_path):
+    controller, _ = _build_cluster(tmp_path, n_servers=2, replication=1)
+    broker = Broker(controller, scheduler_config=SchedulerConfig(num_runners=2))
+    try:
+        broker.admission.note_service_time("t", 10_000.0)
+        res = broker.execute(
+            "SET timeoutMs = 500; SET allowPartialResults = true; SELECT COUNT(*) FROM t"
+        )
+        assert res.partial_result
+        codes = {e["errorCode"] for e in res.exceptions}
+        assert int(QueryErrorCode.SERVER_OUT_OF_CAPACITY) in codes
+        # reduced fan-out: one of the two planned servers served the query
+        assert res.num_servers_queried == 1
+        assert 0 < res.rows[0][0] < 800
+        assert broker.admission.degraded == 1
+    finally:
+        broker.shutdown()
+
+
+def test_broker_quota_rejects_typed_429(tmp_path):
+    controller, _ = _build_cluster(tmp_path, table_extra={"queryQuotaQps": 1})
+    broker = Broker(controller)
+    try:
+        broker.execute("SELECT COUNT(*) FROM t")
+        with pytest.raises(QuotaExceededError) as ei:
+            broker.execute("SELECT COUNT(*) FROM t")
+        e = ei.value
+        assert code_of(e) == QueryErrorCode.QUOTA_EXCEEDED
+        assert http_status_of(e) == 429
+        assert broker.admission_snapshot()["counters"]["quotaRejected"] == 1
+    finally:
+        broker.shutdown()
+
+
+def test_tenant_quota_shared_across_tables(tmp_path):
+    controller = Controller(PropertyStore(), tmp_path / "ds")
+    srv = Server("s0")
+    controller.register_server("s0", srv)
+    for table in ("a", "b"):
+        schema = Schema.build(
+            table, dimensions=[("d", DataType.INT)], metrics=[("v", DataType.LONG)]
+        )
+        controller.add_schema(schema)
+        controller.add_table(TableConfig(table, replication=1))
+        controller.upload_segment(
+            table,
+            SegmentBuilder(schema).build(
+                {"d": np.zeros(10, dtype=np.int32), "v": np.ones(10, dtype=np.int64)},
+                f"{table}_0",
+            ),
+        )
+    broker = Broker(
+        controller,
+        scheduler_config=SchedulerConfig(tenant_qps={"DefaultTenant": 2}),
+    )
+    try:
+        broker.execute("SELECT COUNT(*) FROM a")
+        broker.execute("SELECT COUNT(*) FROM b")  # same tenant, shared window
+        with pytest.raises(QuotaExceededError):
+            broker.execute("SELECT COUNT(*) FROM a")
+    finally:
+        broker.shutdown()
+
+
+def test_scheduler_disabled_runs_inline(tmp_path):
+    controller, _ = _build_cluster(tmp_path)
+    broker = Broker(controller, scheduler_config=SchedulerConfig(enabled=False))
+    try:
+        assert broker.admission is None
+        res = broker.execute("SELECT COUNT(*) FROM t")
+        assert res.rows[0][0] == 800
+        assert broker.admission_snapshot()["enabled"] is False
+    finally:
+        broker.shutdown()
+
+
+# -- HTTP boundary -----------------------------------------------------------
+
+
+def _post_query(port, sql):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/query/sql",
+        data=json.dumps({"sql": sql}).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            return resp.status, dict(resp.headers), json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        return e.code, dict(e.headers), json.loads(e.read())
+
+
+def test_http_shed_is_503_with_retry_after(tmp_path):
+    from pinot_tpu.cluster.http import BrokerHTTPService, query_broker_http
+
+    controller, _ = _build_cluster(tmp_path)
+    broker = Broker(controller, scheduler_config=SchedulerConfig(num_runners=2))
+    svc = BrokerHTTPService(broker, port=0)
+    try:
+        broker.admission.note_service_time("t", 10_000.0)
+        status, headers, doc = _post_query(svc.port, "SET timeoutMs = 500; SELECT COUNT(*) FROM t")
+        assert status == 503
+        assert int(headers["Retry-After"]) >= 1
+        assert doc["exceptions"][0]["errorCode"] == int(QueryErrorCode.SERVER_OUT_OF_CAPACITY)
+        # pooled client helper raises the same typed error
+        with pytest.raises(SchedulerRejectedError) as ei:
+            query_broker_http(
+                f"http://127.0.0.1:{svc.port}", "SET timeoutMs = 500; SELECT COUNT(*) FROM t"
+            )
+        assert ei.value.retry_after_s >= 1.0
+    finally:
+        svc.stop()
+        broker.shutdown()
+
+
+def test_http_quota_is_429_and_client_raises_typed(tmp_path):
+    from pinot_tpu.client import connect
+    from pinot_tpu.cluster.http import BrokerHTTPService
+
+    controller, _ = _build_cluster(tmp_path, table_extra={"queryQuotaQps": 1})
+    broker = Broker(controller)
+    svc = BrokerHTTPService(broker, port=0)
+    try:
+        conn = connect(f"http://127.0.0.1:{svc.port}")
+        assert conn.execute("SELECT COUNT(*) FROM t").rows[0][0] == 800
+        with pytest.raises(QuotaExceededError) as ei:
+            conn.execute("SELECT COUNT(*) FROM t")
+        assert ei.value.retry_after_s >= 1.0
+        status, headers, _ = _post_query(svc.port, "SELECT COUNT(*) FROM t")
+        assert status == 429 and "Retry-After" in headers
+    finally:
+        svc.stop()
+        broker.shutdown()
+
+
+def test_debug_admission_endpoint_and_metrics(tmp_path):
+    from pinot_tpu.cluster.http import BrokerHTTPService
+
+    controller, _ = _build_cluster(tmp_path)
+    broker = Broker(controller, scheduler_config=SchedulerConfig(num_runners=2))
+    svc = BrokerHTTPService(broker, port=0)
+    try:
+        broker.execute("SELECT COUNT(*) FROM t")
+        broker.admission.note_service_time("t", 10_000.0)
+        with pytest.raises(SchedulerRejectedError):
+            broker.execute("SET timeoutMs = 500; SELECT COUNT(*) FROM t")
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{svc.port}/debug/admission", timeout=10
+        ) as resp:
+            snap = json.loads(resp.read())
+        assert snap["scheduler"]["kind"] == "priority"
+        assert snap["counters"]["shed"] == 1 and snap["counters"]["admitted"] >= 1
+        assert "t" in snap["serviceEstimateMs"]
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{svc.port}/metrics?format=json", timeout=10
+        ) as resp:
+            metrics = json.loads(resp.read())
+        assert any(k.startswith("broker.admission.shed") for k in metrics)
+        assert any(k.startswith("broker.admission.queueDepth") for k in metrics)
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{svc.port}/metrics", timeout=10
+        ) as resp:
+            text = resp.read().decode()
+        assert "broker_admission_shed" in text
+    finally:
+        svc.stop()
+        broker.shutdown()
+
+
+# -- server-side scheduler ---------------------------------------------------
+
+
+def test_server_accepts_config_and_kind_string(tmp_path):
+    s = Server("s0", scheduler="fcfs")
+    assert isinstance(s._scheduler, FCFSScheduler)
+    s.shutdown()
+    s2 = Server("s1", scheduler=SchedulerConfig(kind="priority", num_runners=2))
+    assert isinstance(s2._scheduler, PriorityScheduler)
+    assert s2.admission_snapshot()["scheduler"]["numRunners"] == 2
+    s2.shutdown()
+    s3 = Server("s2", scheduler=SchedulerConfig(enabled=False))
+    assert s3._scheduler is None and s3.admission_snapshot()["enabled"] is False
+
+
+def test_server_queue_overflow_maps_to_503_across_http(tmp_path):
+    from pinot_tpu.cluster.http import RemoteServerClient, ServerHTTPService
+    from pinot_tpu.segment.builder import write_segment
+
+    server = Server(
+        "hs", scheduler=SchedulerConfig(num_runners=1, max_pending_per_group=1)
+    )
+    schema = Schema.build(
+        "t", dimensions=[("d", DataType.INT)], metrics=[("v", DataType.LONG)]
+    )
+    seg = SegmentBuilder(schema).build(
+        {"d": np.zeros(10, dtype=np.int32), "v": np.ones(10, dtype=np.int64)}, "t_0"
+    )
+    server.add_segment("t", "t_0", write_segment(seg, tmp_path / "t_0"))
+    svc = ServerHTTPService(server, port=0)
+    client = RemoteServerClient(f"http://127.0.0.1:{svc.port}")
+    release = threading.Event()
+    started = threading.Event()
+
+    def blocker():
+        started.set()
+        release.wait(5)
+
+    try:
+        server._scheduler.start()
+        server._scheduler.submit(blocker, table="t")
+        assert started.wait(5)
+        server._scheduler.submit(lambda: None, table="t")  # fills the queue
+        with pytest.raises(SchedulerRejectedError) as ei:
+            client.execute_partials("t", "SELECT COUNT(*) FROM t", ["t_0"], {})
+        assert code_of(ei.value) == QueryErrorCode.SERVER_OUT_OF_CAPACITY
+        assert ei.value.retry_after_s >= 1.0
+    finally:
+        release.set()
+        svc.stop()
+        server.shutdown()

@@ -552,3 +552,60 @@ def test_v1_survives_scatter_error_injection_with_replicas(tmp_path):
     assert res.rows[0][0] == 2000
     # the fault actually fired (the pass wasn't vacuous)
     assert FAULTS.counts().get("server.scatter", 0) == 1
+
+
+def test_chaos_admission_shed_is_deterministic_under_concurrency(tmp_path):
+    """scheduler.admit chaos: with a seeded 50% fault rule capped at 8
+    fires, 32 concurrent queries split deterministically into typed
+    SchedulerRejectedError sheds and clean successes. The fired count
+    depends only on the seeded RNG prefix (draws happen under the injector
+    lock), so a replay with the same seed reproduces it exactly —
+    regardless of thread interleaving."""
+    import threading
+
+    from pinot_tpu.query.scheduler import SchedulerRejectedError
+
+    def run_round(broker):
+        FAULTS.configure(
+            {"scheduler.admit": FaultRule(prob=0.5, max_count=8)}, seed=1234
+        )
+        results, errors = [], []
+        lock = threading.Lock()
+
+        def one_query():
+            try:
+                res = broker.execute("SELECT COUNT(*) FROM t")
+                with lock:
+                    results.append(res.rows[0][0])
+            except SchedulerRejectedError as e:
+                with lock:
+                    errors.append(e)
+            except Exception as e:  # pragma: no cover - fail loud below
+                with lock:
+                    errors.append(e)
+
+        threads = [threading.Thread(target=one_query) for _ in range(32)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=30)
+        return results, errors, FAULTS.counts().get("scheduler.admit", 0)
+
+    controller, _, broker = _build_cluster(tmp_path)
+    try:
+        results, errors, fired = run_round(broker)
+        # every failure is the typed shed, never a deadline death or raw fault
+        assert all(isinstance(e, SchedulerRejectedError) for e in errors)
+        assert all(e.retry_after_s >= 1.0 for e in errors)
+        assert len(errors) == fired > 0
+        assert len(results) == 32 - fired
+        assert all(r == 2000 for r in results)
+        assert broker.admission.shed == fired
+        # replay with the same seed: identical shed count
+        shed_before = broker.admission.shed
+        results2, errors2, fired2 = run_round(broker)
+        assert fired2 == fired
+        assert len(errors2) == len(errors)
+        assert broker.admission.shed == shed_before + fired2
+    finally:
+        broker.shutdown()

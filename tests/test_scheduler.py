@@ -271,3 +271,112 @@ def test_binary_workload_stop_drains_capped_secondary_lane():
         with pytest.raises((CancelledError, SchedulerRejectedError)):
             f.result(timeout=5)
     running.result(timeout=5)
+
+
+# -- introspection tier (admission plane, PR 11) -----------------------------
+
+
+def test_in_flight_and_stats_accounting():
+    s = FCFSScheduler(num_runners=1)
+    s.start()
+    gate = threading.Event()
+    started = threading.Event()
+
+    def block():
+        started.set()
+        gate.wait(5)
+        return "ok"
+
+    try:
+        fut = s.submit(block)
+        assert started.wait(5)
+        assert s.in_flight() == 1
+        queued = s.submit(lambda: "q")
+        assert s.pending() == 1
+        st = s.stats()
+        assert st["kind"] == "fcfs"
+        assert st["numRunners"] == 1
+        assert st["inFlight"] == 1 and st["pending"] == 1
+        gate.set()
+        assert fut.result(timeout=5) == "ok"
+        assert queued.result(timeout=5) == "q"
+        for _ in range(100):
+            if s.in_flight() == 0 and s.pending() == 0:
+                break
+            time.sleep(0.02)
+        assert s.in_flight() == 0 and s.pending() == 0
+    finally:
+        gate.set()
+        s.stop()
+
+
+def test_queue_depths_per_kind():
+    fcfs = FCFSScheduler(num_runners=2)
+    assert fcfs.queue_depths() == {"": 0}
+    pri = PriorityScheduler(num_runners=1, max_pending_per_group=4)
+    pri.start()
+    gate = threading.Event()
+    started = threading.Event()
+
+    def block():
+        started.set()
+        gate.wait(5)
+
+    try:
+        pri.submit(block, table="a")
+        assert started.wait(5)
+        pri.submit(lambda: 1, table="a")
+        pri.submit(lambda: 1, table="b")
+        depths = pri.queue_depths()
+        assert depths["a"] == 1 and depths["b"] == 1
+        st = pri.stats()
+        assert st["maxPendingPerGroup"] == 4
+        assert st["queueDepths"] == depths
+        assert "groupTokens" in st
+    finally:
+        gate.set()
+        pri.stop()
+    bw = BinaryWorkloadScheduler(num_runners=2, secondary_runners=1)
+    assert set(bw.queue_depths()) == {"PRIMARY", "SECONDARY"}
+    assert "secondaryRunning" in bw.stats()
+
+
+def test_rejected_error_carries_code_and_retry_after():
+    from pinot_tpu.common.errors import QueryErrorCode, code_of, http_status_of
+
+    e = SchedulerRejectedError("full", retry_after_s=2.5)
+    assert code_of(e) == QueryErrorCode.SERVER_OUT_OF_CAPACITY
+    assert http_status_of(e) == 503
+    assert e.retry_after_s == 2.5
+    assert SchedulerRejectedError("full").retry_after_s is None
+
+
+def test_scheduler_config_make_kinds():
+    from pinot_tpu.common.config import SchedulerConfig
+
+    assert isinstance(SchedulerConfig(kind="fcfs").make(), FCFSScheduler)
+    pri = SchedulerConfig(kind="priority", num_runners=3, max_pending_per_group=7).make()
+    assert isinstance(pri, PriorityScheduler)
+    assert pri.stats()["numRunners"] == 3
+    assert pri.stats()["maxPendingPerGroup"] == 7
+    assert isinstance(
+        SchedulerConfig(kind="binary_workload").make(), BinaryWorkloadScheduler
+    )
+    assert SchedulerConfig(enabled=False).make() is None
+    with pytest.raises(ValueError):
+        SchedulerConfig(kind="nope").make()
+
+
+def test_scheduler_config_roundtrips_camel_case():
+    from pinot_tpu.common.config import SchedulerConfig
+
+    cfg = SchedulerConfig(
+        kind="priority",
+        num_runners=5,
+        shed_headroom=0.8,
+        tenant_qps={"DefaultTenant": 10.0},
+    )
+    d = cfg.to_dict()
+    assert d["numRunners"] == 5 and d["shedHeadroom"] == 0.8
+    back = SchedulerConfig.from_dict(d)
+    assert back == cfg
