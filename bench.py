@@ -754,6 +754,548 @@ def qps_overload_main():
     print(json.dumps(result))
 
 
+def _spawn_role(argv: list, procs: list, pattern: str = "listening on "):
+    """Start one cluster role as a real OS process (`python -m
+    pinot_tpu.tools.admin ...`), wait for its "listening on http://..." line,
+    and return (proc, base_url). The child is appended to `procs` BEFORE the
+    wait so cleanup reaps it even when startup fails."""
+    import subprocess
+
+    env = dict(os.environ)
+    # the survivability bench measures the serving plane, not kernels: every
+    # role runs the CPU backend unless the caller explicitly overrides
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    env["PYTHONPATH"] = os.path.dirname(os.path.abspath(__file__)) + os.pathsep + env.get("PYTHONPATH", "")
+    p = subprocess.Popen(
+        [sys.executable, "-m", "pinot_tpu.tools.admin", *argv],
+        stdout=subprocess.PIPE,
+        stderr=subprocess.DEVNULL,
+        text=True,
+        env=env,
+    )
+    procs.append(p)
+    deadline = time.time() + 90
+    while time.time() < deadline:
+        line = p.stdout.readline()
+        if not line:
+            raise RuntimeError(f"role {argv[0]} exited during startup (rc={p.poll()})")
+        if pattern in line:
+            return p, line.rsplit(" ", 1)[-1].strip()
+    raise RuntimeError(f"role {argv[0]} never printed {pattern!r}")
+
+
+def _classify_outcome(stats, lock, res=None, exc=None):
+    """Fold one query outcome into `stats` under `lock`. Typed outcomes
+    (timeout 250, 503 shed, 429 quota) are the contract under chaos; a
+    dropped-query routing hole and everything else are hard failures."""
+    from pinot_tpu.common.errors import QueryErrorCode
+
+    kind, detail = "ok", None
+    if exc is not None:
+        name = type(exc).__name__
+        if name in ("SchedulerRejectedError", "QuotaExceededError"):
+            kind = "typed_shed"
+        elif "no ONLINE replica" in str(exc):
+            kind, detail = "dropped", str(exc)[:300]
+        else:
+            kind, detail = "untyped", f"{name}: {exc}"[:300]
+    else:
+        excs = res.get("exceptions") or []
+        codes = {e.get("errorCode") for e in excs}
+        msgs = " | ".join(str(e.get("message", "")) for e in excs)
+        if not excs:
+            kind = "ok"
+        elif "no ONLINE replica" in msgs:
+            kind, detail = "dropped", msgs[:300]
+        elif codes <= {int(QueryErrorCode.EXECUTION_TIMEOUT), 503}:
+            kind = "typed_timeout"
+        else:
+            kind, detail = "untyped", f"codes={sorted(codes, key=str)}: {msgs}"[:300]
+    with lock:
+        stats[kind] = stats.get(kind, 0) + 1
+        if detail and len(stats["samples"]) < 8:
+            stats["samples"].append(detail)
+
+
+def _cluster_drive(urls: list, queries: list, n_clients: int, duration_s: float):
+    """Closed-loop load: `n_clients` threads issue queries round-robin over
+    `urls` for `duration_s`. Returns outcome counts + client-side latency
+    percentiles — the measurement half of every chaos phase."""
+    import threading
+
+    from pinot_tpu.cluster.http import query_broker_http
+
+    stats = {"ok": 0, "typed_timeout": 0, "typed_shed": 0, "dropped": 0, "untyped": 0, "samples": []}
+    lat_ms: list = []
+    lock = threading.Lock()
+    stop_at = time.perf_counter() + duration_s
+    barrier = threading.Barrier(n_clients + 1)
+
+    def client(idx: int) -> None:
+        mine = []
+        j = 0
+        barrier.wait()
+        while time.perf_counter() < stop_at:
+            url = urls[(idx + j) % len(urls)]
+            q = queries[(idx + j) % len(queries)]
+            j += 1
+            t0 = time.perf_counter()
+            try:
+                res = query_broker_http(url, q)
+                _classify_outcome(stats, lock, res=res)
+            except Exception as e:
+                _classify_outcome(stats, lock, exc=e)
+            mine.append((time.perf_counter() - t0) * 1e3)
+        with lock:
+            lat_ms.extend(mine)
+
+    threads = [threading.Thread(target=client, args=(i,), daemon=True) for i in range(n_clients)]
+    for t in threads:
+        t.start()
+    barrier.wait()
+    t_run = time.perf_counter()
+    for t in threads:
+        t.join()
+    wall_s = time.perf_counter() - t_run
+    total = sum(stats[k] for k in ("ok", "typed_timeout", "typed_shed", "dropped", "untyped"))
+    return {
+        "queries": total,
+        "wall_s": round(wall_s, 3),
+        "throughput_qps": round(total / wall_s, 2) if wall_s else 0.0,
+        "outcomes": {k: stats[k] for k in ("ok", "typed_timeout", "typed_shed", "dropped", "untyped")},
+        "error_samples": stats["samples"],
+        "p50_ms": round(float(np.percentile(lat_ms, 50)), 3) if lat_ms else None,
+        "p99_ms": round(float(np.percentile(lat_ms, 99)), 3) if lat_ms else None,
+    }
+
+
+def _cluster_freshness_phase(seed: int) -> dict:
+    """Live-ingest freshness phase (in one process so the stream, consumer
+    FSM, aggregator and SLO evaluator are deterministic): produce stamped
+    events through the realtime FSM while querying the consuming snapshot,
+    then read event-to-queryable freshness three ways — the server histogram,
+    the federated /debug/cluster fold, and the SLO evaluator's
+    freshnessP99Ms objective."""
+    import tempfile
+    import threading
+
+    from pinot_tpu.cluster import Broker, Controller, PropertyStore, Server
+    from pinot_tpu.cluster.http import ServerHTTPService
+    from pinot_tpu.cluster.periodic import ClusterMetricsAggregator
+    from pinot_tpu.common import DataType, Schema, TableConfig, TableType
+    from pinot_tpu.common.metrics import ServerHistogram, reset_registries, server_metrics
+    from pinot_tpu.realtime import InMemoryStream, RealtimeTableManager
+
+    reset_registries()
+    rng = np.random.default_rng(seed)
+    root = tempfile.mkdtemp(prefix="pinot_tpu_cluster_rt_")
+    controller = Controller(PropertyStore(), os.path.join(root, "deep"))
+    server = Server("server_rt")
+    ssvc = ServerHTTPService(server, port=0)
+    # advertise the HTTP port so the aggregator scrapes this server's
+    # /metrics (the freshness series travels the same federated path the
+    # multi-process roles use)
+    controller.register_server("server_rt", server, host="127.0.0.1", port=ssvc.port)
+    schema = Schema.build(
+        "clicks",
+        dimensions=[("kind", DataType.STRING)],
+        metrics=[("value", DataType.LONG)],
+        date_times=[("ts", DataType.LONG)],
+    )
+    controller.add_schema(schema)
+    config = TableConfig("clicks", TableType.REALTIME, time_column="ts")
+    controller.add_table(config)
+    stream = InMemoryStream(partitions=2)
+    mgr = RealtimeTableManager(controller, server, schema, config, stream, max_rows_per_segment=2000)
+    broker = Broker(controller)
+    freshness_target_ms = 2000.0
+    agg = ClusterMetricsAggregator(
+        controller, objectives={"freshnessP99Ms": freshness_target_ms}
+    )
+
+    n_events = int(os.environ.get("PINOT_TPU_CLUSTER_EVENTS", 3000))
+    produced = [0, 0]
+    query_outcomes = {"ok": 0, "errors": 0}
+    stop = threading.Event()
+
+    def querier():
+        while not stop.is_set():
+            try:
+                broker.execute("SELECT COUNT(*), MAX(value) FROM clicks")
+                query_outcomes["ok"] += 1
+            except Exception:
+                query_outcomes["errors"] += 1
+            stop.wait(0.05)
+
+    mgr.start()
+    qt = threading.Thread(target=querier, daemon=True)
+    qt.start()
+    t0 = time.perf_counter()
+    try:
+        for i in range(n_events):
+            p = i % 2
+            stream.produce(p, {"kind": f"k{i % 7}", "value": int(rng.integers(0, 1000)), "ts": i})
+            produced[p] += 1
+            if i % 50 == 49:
+                time.sleep(0.02)  # ~2.5k events/s sustained, not one burst
+        caught_up = mgr.wait_until_caught_up(produced, timeout=30)
+        ingest_wall_s = time.perf_counter() - t0
+        stop.set()
+        qt.join(timeout=5)
+        agg.run_once()
+        doc = agg.debug_cluster()
+    finally:
+        stop.set()
+        mgr.stop()
+        ssvc.stop()
+        broker.shutdown()
+
+    fh = server_metrics().histogram(ServerHistogram.FRESHNESS, table="clicks")
+    slo_scope = (doc.get("slo", {}).get("scopes", {}).get("_cluster", {})).get("freshness", {})
+    return {
+        "events": sum(produced),
+        "caught_up": bool(caught_up),
+        "ingest_wall_s": round(ingest_wall_s, 3),
+        "queries_during_ingest": dict(query_outcomes),
+        "freshness_p99_ms": round(fh.quantile_ms(0.99), 3),
+        "freshness_p50_ms": round(fh.quantile_ms(0.5), 3),
+        "samples": fh.count,
+        "debug_cluster_freshness": doc.get("cluster", {}).get("freshness"),
+        "slo": {
+            "objective_freshness_p99_ms": freshness_target_ms,
+            "evaluated": slo_scope,
+            "alerts_firing": doc.get("slo", {}).get("firing", 0),
+        },
+    }
+
+
+def cluster_main():
+    """`bench.py cluster`: the cluster-survivability acceptance run (ISSUE
+    12). A real multi-process topology on one box — 1 controller (+metrics
+    aggregator), 2 brokers (one with hedged scatter), 4->8 servers,
+    replication 2, all over the pooled wire plane — driven by sustained
+    closed-loop HTTP load while chaos runs:
+
+      phase 1  qps @ 4 servers
+      phase 2  scale-out: +4 servers, rebalance_table UNDER LIVE LOAD
+               (zero-dropped-query assertion: routing never observes an
+               assignment with no ONLINE replica)
+      phase 3  qps @ 8 servers
+      phase 4  hedged-vs-unhedged A/B against a SIGSTOP straggler
+               (hedging must cut p99 within a <=5% extra-fan-out budget)
+      phase 5  SIGKILL a server mid-flight (failover: zero non-typed errors)
+      phase 6  live-ingest freshness through the realtime FSM (in-process,
+               deterministic) -> freshness_p99_ms + SLO evaluation
+
+    Writes BENCH_cluster_r12.json and prints the same JSON line."""
+    import shutil
+    import signal
+    import tempfile
+    import threading
+
+    import pinot_tpu  # noqa: F401  (x64 + platform setup)
+    from pinot_tpu.cluster.http import RemoteControllerClient, query_broker_http
+    from pinot_tpu.common import DataType, Schema, TableConfig
+    from pinot_tpu.segment import SegmentBuilder, write_segment
+
+    n_clients = int(os.environ.get("PINOT_TPU_CLUSTER_CLIENTS", 12))
+    phase_s = float(os.environ.get("PINOT_TPU_CLUSTER_PHASE_SECS", 5.0))
+    n_rows = int(os.environ.get("PINOT_TPU_CLUSTER_ROWS", 96_000))
+    seed = int(os.environ.get("PINOT_TPU_CLUSTER_SEED", 12))
+    # 5 segments x replication 2 = 10 replicas: the odd segment count keeps
+    # the brokers' round-robin replica selector alternating across queries
+    # (an even count advances the cursor by a multiple of the replica count,
+    # pinning every segment to one replica forever), and after the bootstrap
+    # rebalance over 8 servers most servers host a single replica — scatter
+    # groups of one segment, so a whole-group hedge target always exists
+    n_segments = 5
+
+    root = tempfile.mkdtemp(prefix="pinot_tpu_cluster_")
+    procs: list = []
+    servers: dict[str, object] = {}
+    result = {"metric": "cluster_survivability", "seed": seed}
+    try:
+        # -- topology ----------------------------------------------------------
+        log("spawning controller (with metrics aggregator) ...")
+        _, controller_url = _spawn_role(
+            [
+                "StartController",
+                "--store-dir", os.path.join(root, "store"),
+                "--deep-store", os.path.join(root, "deep"),
+                "--port", "0",
+                "--with-periodics",
+                "--metrics-interval", "2",
+            ],
+            procs,
+        )
+        rc = RemoteControllerClient(controller_url)
+
+        server_urls: dict[str, str] = {}
+
+        def start_server(sid: str):
+            p, url = _spawn_role(
+                ["StartServer", "--controller-url", controller_url, "--server-id", sid, "--port", "0"],
+                procs,
+            )
+            servers[sid] = p
+            server_urls[sid] = url
+            return url
+
+        log("spawning servers 0-3 ...")
+        for i in range(4):
+            start_server(f"server_{i}")
+        resilience = {"defaultTimeoutMs": 1500.0}
+        log("spawning brokers (broker_0 plain, broker_1 hedged) ...")
+        _, broker0_url = _spawn_role(
+            [
+                "StartBroker", "--controller-url", controller_url,
+                "--broker-id", "broker_0", "--port", "0",
+                "--scatter-threads", "32",
+                "--resilience-json", json.dumps(resilience),
+            ],
+            procs,
+        )
+        _, broker1_url = _spawn_role(
+            [
+                "StartBroker", "--controller-url", controller_url,
+                "--broker-id", "broker_1", "--port", "0",
+                "--scatter-threads", "32",
+                "--resilience-json", json.dumps(
+                    {**resilience, "hedgeEnabled": True, "hedgeDelayMaxMs": 150.0}
+                ),
+            ],
+            procs,
+        )
+        both = [broker0_url, broker1_url]
+
+        # -- table: 8 segments x replication 2 over the first 4 servers --------
+        schema = Schema.build(
+            "lineorder",
+            dimensions=[("region", DataType.STRING), ("year", DataType.INT)],
+            metrics=[("revenue", DataType.LONG)],
+        )
+        rc.add_schema(schema)
+        rc.add_table(TableConfig("lineorder", replication=2))
+        rng = np.random.default_rng(seed)
+        builder = SegmentBuilder(schema)
+        seg_rows = n_rows // n_segments
+        for i in range(n_segments):
+            data = {
+                "region": np.array(["AFRICA", "AMERICA", "ASIA", "EUROPE"], dtype=object)[
+                    rng.integers(0, 4, seg_rows)
+                ],
+                "year": rng.integers(1992, 1999, seg_rows).astype(np.int32),
+                "revenue": rng.integers(100, 600_000, seg_rows).astype(np.int64),
+            }
+            seg_dir = write_segment(builder.build(data, f"lineorder_{i}"), os.path.join(root, "built"))
+            rc.upload_segment_dir("lineorder", seg_dir)
+        queries = [
+            "SELECT COUNT(*) FROM lineorder WHERE year > 1994",
+            "SELECT region, SUM(revenue) FROM lineorder GROUP BY region ORDER BY SUM(revenue) DESC LIMIT 4",
+        ]
+
+        def warmup(rounds: int = 10):
+            # every server process JIT-compiles each query shape on first
+            # contact; drive enough rounds that routing has touched them all
+            for j in range(rounds):
+                for url in both:
+                    for q in queries:
+                        try:
+                            query_broker_http(url, q)
+                        except Exception as e:
+                            log(f"warmup round {j}: {type(e).__name__}: {e}")
+
+        log("warmup (JIT per server process) ...")
+        warmup()
+
+        # -- phase 1: qps @ 4 servers ------------------------------------------
+        log(f"phase 1: qps @ 4 servers ({n_clients} clients, {phase_s}s)")
+        result["qps_4_servers"] = _cluster_drive(both, queries, n_clients, phase_s)
+
+        # -- phase 2: scale-out + rebalance under live load --------------------
+        log("phase 2: +4 servers, rebalance under live load")
+        for i in range(4, 8):
+            start_server(f"server_{i}")
+        bg: dict = {}
+        t_bg = threading.Thread(
+            target=lambda: bg.update(_cluster_drive(both, queries, max(4, n_clients // 2), phase_s + 2.0)),
+            daemon=True,
+        )
+        t_bg.start()
+        time.sleep(0.5)  # load is flowing before the first segment moves
+        reb = rc.rebalance_table("lineorder", drain_grace_sec=0.15, bootstrap=True)
+        log(f"rebalance: {reb.get('status')} adds={reb.get('adds')} drops={reb.get('drops')}")
+        t_bg.join()
+        result["rebalance_under_load"] = {
+            "rebalance": {"status": reb.get("status"), "adds": len(reb.get("adds") or []),
+                          "drops": len(reb.get("drops") or [])},
+            "driven": bg,
+        }
+        assert bg["outcomes"]["dropped"] == 0, (
+            f"rebalance dropped queries (no ONLINE replica observed): {bg}"
+        )
+
+        log("post-rebalance warmup (new server processes JIT) ...")
+        warmup()
+
+        # -- phase 3: qps @ 8 servers ------------------------------------------
+        log(f"phase 3: qps @ 8 servers ({n_clients} clients, {phase_s}s)")
+        result["qps_8_servers"] = _cluster_drive(both, queries, n_clients, phase_s)
+
+        # -- phase 4: hedged vs unhedged A/B against a delay straggler ---------
+        # pick the straggler from the actual post-rebalance placement: a
+        # single-segment host, so the slow scatter group always has a
+        # one-server hedge target on the partner replica. The straggler is
+        # slow-but-alive (seeded delay fault on server.scatter, armed over
+        # /debug/faults) — the tail-at-scale shape hedging is built for; a
+        # hard freeze is the failure detector's job and is phase 5's SIGKILL.
+        import urllib.request
+
+        def _post_json(url, doc):
+            req = urllib.request.Request(
+                url, data=json.dumps(doc).encode(), headers={"Content-Type": "application/json"}
+            )
+            with urllib.request.urlopen(req, timeout=10) as r:
+                return json.loads(r.read())
+
+        ideal = rc.ideal_state("lineorder")
+        hosts: dict[str, list] = {}
+        for seg, reps in ideal.items():
+            for sid in reps:
+                hosts.setdefault(sid, []).append(seg)
+        single_hosts = sorted(s for s, g in hosts.items() if len(g) == 1)
+        assert single_hosts, f"placement has no single-segment hosts: {hosts}"
+        straggler_id = single_hosts[0]
+        victim_id = next(s for s in sorted(hosts) if s != straggler_id)
+        # shape the chaos to what a budgeted hedge can rescue: replica
+        # round-robin sends ~half the straggler group's queries to the
+        # straggler, so P(query delayed) ~= prob/2 ~= 7% — a p99 tail, not a
+        # p50 collapse. The 5% fan-out budget is cumulative over the
+        # broker's primaries (phases 1+3 included), so it covers that tail;
+        # a much higher hit rate exhausts the budget and the uncovered
+        # remainder dominates p99 in BOTH windows (observed at prob=0.4).
+        delay_rule = {"mode": "delay", "prob": 0.15, "delay_s": 0.5}
+        log(
+            f"phase 4: delay-fault straggler {straggler_id} (hosts {hosts[straggler_id]}, "
+            f"{delay_rule}); unhedged window (broker_0) ..."
+        )
+        ab_clients = max(4, n_clients // 2)
+        ab_s = phase_s + 1.0
+        _post_json(
+            f"{server_urls[straggler_id]}/debug/faults",
+            {"points": {"server.scatter": delay_rule}, "seed": seed},
+        )
+        try:
+            unhedged = _cluster_drive([broker0_url], queries, ab_clients, ab_s)
+            log("phase 4: hedged window (broker_1) ...")
+            hedged = _cluster_drive([broker1_url], queries, ab_clients, ab_s)
+            with urllib.request.urlopen(
+                f"{server_urls[straggler_id]}/debug/faults", timeout=5
+            ) as r:
+                fault_counts = json.loads(r.read())
+        finally:
+            _post_json(f"{server_urls[straggler_id]}/debug/faults", {"points": {}})
+        with urllib.request.urlopen(f"{broker1_url}/debug/hedge", timeout=5) as r:
+            hedge_snap = json.loads(r.read())
+        overhead = (
+            hedge_snap["hedgesIssued"] / hedge_snap["primaryScatters"]
+            if hedge_snap["primaryScatters"]
+            else 0.0
+        )
+        result["hedge_ab"] = {
+            "straggler": f"{straggler_id} (server.scatter delay fault)",
+            "delay_rule": delay_rule,
+            "fault_fires": fault_counts,
+            "unhedged": unhedged,
+            "hedged": hedged,
+            "hedge_snapshot": hedge_snap,
+            "extra_fanout_fraction": round(overhead, 4),
+        }
+        log(
+            f"hedge A/B raw: fault_fires={fault_counts} "
+            f"unhedged(q={unhedged['queries']}, p50={unhedged['p50_ms']}, "
+            f"p99={unhedged['p99_ms']}, outcomes={unhedged['outcomes']}) "
+            f"hedged(q={hedged['queries']}, p50={hedged['p50_ms']}, "
+            f"p99={hedged['p99_ms']}, outcomes={hedged['outcomes']}) "
+            f"snap={hedge_snap}"
+        )
+        for name, window in (("unhedged", unhedged), ("hedged", hedged)):
+            # a shed/error storm makes the p99 comparison vacuous (rejections
+            # return in microseconds) — the A/B only means something when
+            # both windows actually served their load
+            assert window["outcomes"]["ok"] >= 0.5 * window["queries"], (
+                f"{name} window did not serve its load: {window['outcomes']}"
+            )
+        assert hedged["p99_ms"] < unhedged["p99_ms"], (
+            f"hedging did not cut straggler p99: hedged={hedged['p99_ms']} "
+            f"unhedged={unhedged['p99_ms']}"
+        )
+        assert hedge_snap["hedgesIssued"] > 0, f"straggler never triggered a hedge: {hedge_snap}"
+        assert overhead <= 0.055, f"hedge fan-out over budget: {overhead:.4f}"
+        log(
+            f"hedge A/B: p99 {unhedged['p99_ms']}ms -> {hedged['p99_ms']}ms, "
+            f"extra fan-out {overhead * 100:.2f}%"
+        )
+
+        # -- phase 5: SIGKILL a server mid-flight ------------------------------
+        victim = servers[victim_id]
+        log(f"phase 5: sustained load + SIGKILL {victim_id} (hosts {hosts[victim_id]}) mid-flight")
+        kill_bg: dict = {}
+        t_kill = threading.Thread(
+            target=lambda: kill_bg.update(_cluster_drive(both, queries, n_clients, phase_s + 1.0)),
+            daemon=True,
+        )
+        t_kill.start()
+        time.sleep(max(0.5, phase_s / 3))
+        os.kill(victim.pid, signal.SIGKILL)
+        t_kill.join()
+        result["server_kill"] = {"victim": f"{victim_id} (SIGKILL)", "driven": kill_bg}
+        assert kill_bg["outcomes"]["untyped"] == 0, (
+            f"server kill produced non-typed client errors: {kill_bg}"
+        )
+        assert kill_bg["outcomes"]["dropped"] == 0, f"server kill dropped queries: {kill_bg}"
+
+        # -- /debug/cluster from the controller hub ----------------------------
+        with urllib.request.urlopen(f"{controller_url}/debug/cluster", timeout=10) as r:
+            doc = json.loads(r.read())
+        result["debug_cluster"] = {
+            "nodes": {
+                nid: {"role": n["role"], "healthy": n["healthy"], "stale": n["stale"]}
+                for nid, n in doc.get("nodes", {}).items()
+            },
+            "rebalance": doc.get("rebalance"),
+            "hedge": doc.get("cluster", {}).get("hedge"),
+        }
+    finally:
+        for p in procs:
+            try:
+                os.kill(p.pid, signal.SIGCONT)  # a still-stopped child ignores SIGTERM
+            except OSError:
+                pass
+            p.terminate()
+        for p in procs:
+            try:
+                p.wait(timeout=10)
+            except Exception:
+                p.kill()
+        shutil.rmtree(root, ignore_errors=True)
+
+    # -- phase 6: live-ingest freshness (in-process, deterministic) ------------
+    log("phase 6: live-ingest freshness through the realtime FSM")
+    result["freshness"] = _cluster_freshness_phase(seed)
+    assert result["freshness"]["caught_up"], f"ingest never caught up: {result['freshness']}"
+    assert result["freshness"]["samples"] > 0, "no freshness samples recorded"
+
+    result["qps_vs_server_count"] = {
+        "4": result["qps_4_servers"]["throughput_qps"],
+        "8": result["qps_8_servers"]["throughput_qps"],
+    }
+    with open("BENCH_cluster_r12.json", "w") as f:
+        json.dump(result, f, indent=2)
+        f.write("\n")
+    print(json.dumps(result))
+
+
 def main():
     import pinot_tpu  # noqa: F401  (x64 + platform setup)
 
@@ -1042,6 +1584,9 @@ if __name__ == "__main__":
                 qps_overload_main()
             else:
                 qps_main()
+            sys.exit(0)
+        if len(sys.argv) > 1 and sys.argv[1] == "cluster":
+            cluster_main()
             sys.exit(0)
         main()
     except Exception as e:  # emit evidence even on unrecoverable failure

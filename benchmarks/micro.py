@@ -573,6 +573,93 @@ def bench_admission_overhead(n=120_000):
         shutil.rmtree(root, ignore_errors=True)
 
 
+def bench_hedge_overhead(n=120_000):
+    """Hedged-scatter cost on the happy path (no stragglers): the same
+    aggregation with hedging disabled (plain pool.map fan-out) vs enabled
+    (per-leg future + EWMA-delay wait). With healthy servers every primary
+    returns before its hedge delay, so no hedges issue and the whole cost is
+    bookkeeping: one _hedge_delay_s + timed result() per leg plus one
+    _hedge_record per reply. Time that bookkeeping directly and hold its
+    projected share of the query wall to the <2% budget — the stable form of
+    the wall-clock assertion (same shape as admission_overhead)."""
+    import shutil
+    import tempfile
+
+    from pinot_tpu.cluster import Broker, Controller, PropertyStore, Server
+    from pinot_tpu.common import DataType, Schema, TableConfig
+    from pinot_tpu.common.config import ResilienceConfig
+    from pinot_tpu.segment import SegmentBuilder
+
+    rng = np.random.default_rng(29)
+    root = tempfile.mkdtemp(prefix="pinot_tpu_hedge_")
+    try:
+        controller = Controller(PropertyStore(), os.path.join(root, "ds"))
+        for i in range(2):
+            controller.register_server(f"s{i}", Server(f"s{i}"))
+        schema = Schema.build(
+            "t", dimensions=[("k", DataType.INT)], metrics=[("m", DataType.LONG)]
+        )
+        controller.add_schema(schema)
+        controller.add_table(TableConfig("t", replication=2))
+        builder = SegmentBuilder(schema)
+        for i in range(4):
+            controller.upload_segment(
+                "t",
+                builder.build(
+                    {
+                        "k": rng.integers(0, 64, n // 4).astype(np.int32),
+                        "m": rng.integers(1, 10, n // 4).astype(np.int64),
+                    },
+                    f"t_{i}",
+                ),
+            )
+        q = "SELECT k, SUM(m) FROM t GROUP BY k ORDER BY k LIMIT 10"
+
+        broker_off = Broker(controller)  # hedge_enabled defaults False
+        try:
+            off_ms = _time_host(lambda: broker_off.execute(q), iters=7)
+        finally:
+            broker_off.shutdown()
+        broker_on = Broker(controller, resilience=ResilienceConfig(hedge_enabled=True))
+        try:
+            on_ms = _time_host(lambda: broker_on.execute(q), iters=7)
+            hedges_issued = broker_on.hedge_snapshot()["hedgesIssued"]
+
+            # Direct measure of the per-leg bookkeeping against the live
+            # broker: a 2-server scatter pays 2x (delay lookup + record);
+            # project that against the query wall for the budget assertion.
+            ops = 100_000
+            t0 = time.perf_counter()
+            for _ in range(ops):
+                broker_on._hedge_delay_s("s0", "t")
+                broker_on._hedge_record("s0", "t", 5.0)
+            per_leg_us = (time.perf_counter() - t0) / ops * 1e6
+        finally:
+            broker_on.shutdown()
+        projected_pct = 2 * per_leg_us / (off_ms * 1e3) * 100
+        assert hedges_issued == 0, (
+            f"{hedges_issued} hedges issued with healthy servers — the happy "
+            "path must not spend hedge budget"
+        )
+        assert projected_pct < 2.0, (
+            f"hedge bookkeeping {per_leg_us:.2f}µs/leg = {projected_pct:.2f}% of "
+            f"{off_ms:.1f}ms query — over the 2% request-path budget"
+        )
+        return {
+            "metric": "hedge_overhead",
+            "value": round(on_ms - off_ms, 3),
+            "unit": "ms",
+            "n": n,
+            "off_ms": round(off_ms, 3),
+            "on_ms": round(on_ms, 3),
+            "overhead_pct": round((on_ms / off_ms - 1.0) * 100, 1),
+            "per_leg_us": round(per_leg_us, 4),
+            "projected_pct_per_query": round(projected_pct, 3),
+        }
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+
+
 def bench_trace_overhead(n=200_000, dim=2_000):
     """Tracing-plane cost on the v2 hot path: the same multistage
     join+group-by untraced vs under an active sampled trace. With sampling
@@ -887,6 +974,7 @@ ALL = [
     bench_stats_overhead,
     bench_deadline_overhead,
     bench_admission_overhead,
+    bench_hedge_overhead,
     bench_trace_overhead,
     bench_profiler_overhead,
     bench_slo_overhead,

@@ -54,6 +54,8 @@ class AdmissionController:
         self.scheduler = scheduler if scheduler is not None else self.config.make()
         self.role = role
         self._ewma_ms: dict[str, float] = {}
+        # table -> next estimator-liveness probe timestamp (monotonic)
+        self._probe_next: dict[str, float] = {}
         self._lock = threading.Lock()
         self._started = False
         # lifetime counters (meters carry the same data per-table; these
@@ -61,6 +63,7 @@ class AdmissionController:
         self.admitted = 0
         self.shed = 0
         self.degraded = 0
+        self.probed = 0
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -135,11 +138,37 @@ class AdmissionController:
         projected_ms = wait_ms + self.service_estimate_ms(table)
         budget_ms = remaining_s * 1000.0 * self.config.shed_headroom
         if projected_ms <= budget_ms:
+            if self._probe_next:
+                # recovered: a future estimate-only rejection starts a fresh
+                # shed-then-probe sequence instead of instantly probing
+                with self._lock:
+                    self._probe_next.pop(table, None)
             return self._mark_admitted(table)
         if allow_partial:
             self.degraded += 1
             reg.meter(BrokerMeter.ADMISSION_DEGRADED, table=table).mark()
             return DEGRADE
+        # Estimator-liveness probe (FailureDetector single-probe parity):
+        # with no queue pressure the rejection rests entirely on the service
+        # EWMA, which only updates when a query completes — shedding 100%
+        # would freeze a poisoned estimate forever (a JIT-cold warmup is
+        # enough to push it past the deadline, observed as a permanent
+        # 503 storm in bench.py cluster). The first estimate-only shed
+        # starts the probe clock; one query per interval is then admitted
+        # as a probe so the estimate can recover. Real backlog
+        # (wait_ms > 0) still sheds unconditionally.
+        if wait_ms <= 0.0:
+            now = time.monotonic()
+            interval_s = self.config.probe_interval_ms / 1000.0
+            with self._lock:
+                due = self._probe_next.get(table)
+                probe = due is not None and now >= due
+                if probe or due is None:
+                    self._probe_next[table] = now + interval_s
+            if probe:
+                self.probed += 1
+                reg.meter(BrokerMeter.ADMISSION_PROBED, table=table).mark()
+                return self._mark_admitted(table)
         self._mark_shed(
             table,
             f"projected completion {projected_ms:.0f}ms exceeds remaining "
@@ -210,5 +239,6 @@ class AdmissionController:
                 "admitted": self.admitted,
                 "shed": self.shed,
                 "degraded": self.degraded,
+                "probed": self.probed,
             },
         }

@@ -163,6 +163,159 @@ class Broker:
         self._pool = ThreadPoolExecutor(max_workers=max_scatter_threads)
         self._dispatcher = None
         self._dispatcher_lock = threading.Lock()
+        # hedged-scatter state (tail-at-scale): per-(server,table) latency
+        # EWMA drives the hedge delay; cumulative primary/issued counts
+        # enforce the fan-out budget
+        self._hedge_lock = threading.Lock()
+        self._hedge_ewma: dict[tuple[str, str], float] = {}
+        self._hedge_primary = 0
+        self._hedge_issued = 0
+
+    # -- hedged scatter (tail-at-scale) ---------------------------------------
+
+    def _hedge_record(self, sid: str, table: str, ms: float) -> None:
+        """Fold one successful scatter latency into the (server, table) EWMA
+        the hedge delay derives from. Always on (one lock + dict op) so the
+        model is warm the moment hedging is enabled."""
+        key = (sid, table)
+        with self._hedge_lock:
+            prev = self._hedge_ewma.get(key)
+            self._hedge_ewma[key] = ms if prev is None else prev * 0.8 + ms * 0.2
+
+    def _hedge_delay_s(self, sid: str, table: str) -> float:
+        """Hedge delay for this (server, table): factor × EWMA, clamped to
+        [min, max]; no observation yet → max (hedge only when clearly hung)."""
+        r = self.resilience
+        with self._hedge_lock:
+            ewma = self._hedge_ewma.get((sid, table))
+        ms = r.hedge_delay_max_ms if ewma is None else ewma * r.hedge_delay_factor
+        return min(max(ms, r.hedge_delay_min_ms), r.hedge_delay_max_ms) / 1e3
+
+    def _hedge_admit(self) -> bool:
+        """Claim one unit of hedge budget: cumulative hedges stay within
+        hedge_budget_fraction of cumulative primary scatter calls (with a
+        floor of one so a cold broker can still hedge its first straggler)."""
+        with self._hedge_lock:
+            allowed = max(1.0, self._hedge_primary * self.resilience.hedge_budget_fraction)
+            if self._hedge_issued + 1 > allowed:
+                return False
+            self._hedge_issued += 1
+            return True
+
+    def _hedge_target(self, sid: str, segs, ideal, table: str) -> str | None:
+        """A single surviving ONLINE replica hosting the WHOLE segment group
+        (lowest EWMA wins) — hedging never splits a group, so the hedge is
+        one extra request, not a re-scatter."""
+        cands: set[str] | None = None
+        for seg in segs:
+            reps = {s for s, st in ideal.get(seg, {}).items() if st == "ONLINE" and s != sid}
+            cands = reps if cands is None else cands & reps
+            if not cands:
+                return None
+        if not cands:
+            return None
+        if self.failure_detector is not None:
+            cands -= set(self.failure_detector.unhealthy_servers())
+            if not cands:
+                return None
+        with self._hedge_lock:
+            return min(cands, key=lambda s: (self._hedge_ewma.get((s, table), float("inf")), s))
+
+    @staticmethod
+    def _is_failed_marker(r) -> bool:
+        return isinstance(r, tuple) and bool(r) and r[0] == "__failed__"
+
+    def _scatter_plan(self, scatter, plan: dict, ideal, table: str) -> list:
+        """Fan the scatter closure over the plan. With hedging disabled this
+        is exactly the old pool.map. Enabled, each primary that outlives its
+        EWMA-derived hedge delay is re-issued (budget permitting) to one
+        surviving replica hosting the same group; the first non-failed result
+        wins and the loser is cancelled (or its result ignored — a thread
+        already executing cannot be interrupted, which is why the fan-out
+        budget, not cancellation, bounds hedge cost)."""
+        items = list(plan.items())
+        if not items:
+            return []
+        if not self.resilience.hedge_enabled:
+            return list(self._pool.map(scatter, items))
+        from concurrent.futures import FIRST_COMPLETED
+        from concurrent.futures import TimeoutError as _FutTimeout  # builtin alias only on 3.11+
+        from concurrent.futures import wait as _fut_wait
+
+        from pinot_tpu.common.metrics import BrokerMeter, broker_metrics
+
+        bm = broker_metrics()
+        t_submit = time.perf_counter()
+        entries = []
+        for sid, segs in items:
+            entries.append(
+                (
+                    sid,
+                    segs,
+                    self._pool.submit(scatter, (sid, segs)),
+                    t_submit + self._hedge_delay_s(sid, table),
+                )
+            )
+        with self._hedge_lock:
+            self._hedge_primary += len(entries)
+
+        results = []
+        for sid, segs, fut, hedge_ts in entries:
+            try:
+                results.append(fut.result(timeout=max(0.0, hedge_ts - time.perf_counter())))
+                continue
+            except (TimeoutError, _FutTimeout):
+                pass
+            target = self._hedge_target(sid, segs, ideal, table)
+            if target is None or not self._hedge_admit():
+                results.append(fut.result())  # nothing to hedge with / over budget
+                continue
+            bm.meter(BrokerMeter.HEDGE_ISSUED, table=table).mark()
+            hfut = self._pool.submit(scatter, (target, segs))
+            _fut_wait({fut, hfut}, return_when=FIRST_COMPLETED)
+            first, other = (fut, hfut) if fut.done() else (hfut, fut)
+
+            def outcome(f):
+                try:
+                    return f.result(), None
+                except Exception as e:  # pinotlint: disable=deadline-swallow — re-raised below when the other leg also fails
+                    return None, e
+
+            r1, e1 = outcome(first)
+            if e1 is None and not self._is_failed_marker(r1):
+                other.cancel()
+                bm.meter(
+                    BrokerMeter.HEDGE_WON if first is hfut else BrokerMeter.HEDGE_WASTED,
+                    table=table,
+                ).mark()
+                results.append(r1)
+                continue
+            r2, e2 = outcome(other)  # first leg failed: wait out the other
+            if e2 is None and not self._is_failed_marker(r2):
+                bm.meter(
+                    BrokerMeter.HEDGE_WON if other is hfut else BrokerMeter.HEDGE_WASTED,
+                    table=table,
+                ).mark()
+                results.append(r2)
+                continue
+            # both legs failed: surface the PRIMARY's outcome so the normal
+            # failover/degradation path sees the unhedged shape
+            bm.meter(BrokerMeter.HEDGE_WASTED, table=table).mark()
+            pr, pe = (r1, e1) if first is fut else (r2, e2)
+            if pe is not None:
+                raise pe
+            results.append(pr)
+        return results
+
+    def hedge_snapshot(self) -> dict:
+        """Cumulative hedge counters + budget state (for /debug/cluster)."""
+        with self._hedge_lock:
+            return {
+                "enabled": self.resilience.hedge_enabled,
+                "primaryScatters": self._hedge_primary,
+                "hedgesIssued": self._hedge_issued,
+                "budgetFraction": self.resilience.hedge_budget_fraction,
+            }
 
     # -- cancellation / running-query registry --------------------------------
 
@@ -904,8 +1057,10 @@ class Broker:
                 raise
             if self.failure_detector is not None:
                 self.failure_detector.mark_success(sid)
+            elapsed_ms = (time.perf_counter() - t0) * 1e3
             if adaptive is not None:
-                adaptive.record(sid, (time.perf_counter() - t0) * 1e3)
+                adaptive.record(sid, elapsed_ms)
+            self._hedge_record(sid, table, elapsed_ms)
             if len(out[0]) != len(segs):
                 # a server silently skipping unhosted segments would mean
                 # missing rows; fail loudly instead (partial-response guard)
@@ -914,7 +1069,7 @@ class Broker:
                 )
             return out
 
-        results = list(self._pool.map(scatter, plan.items())) if plan else []
+        results = self._scatter_plan(scatter, plan, ideal, table)
         failed = [r for r in results if isinstance(r, tuple) and r and r[0] == "__failed__"]
         results = [r for r in results if not (isinstance(r, tuple) and r and r[0] == "__failed__")]
         if partial is not None:

@@ -298,6 +298,10 @@ class BrokerHTTPService:
                     # per-group tokens, service-time estimates, shed/quota
                     # counters (the runbook's first stop under overload)
                     _send_json(self, svc.broker.admission_snapshot())
+                elif self.path == "/debug/hedge":
+                    # hedged-scatter state: enabled flag, cumulative primary
+                    # legs vs hedges issued (the <=budget-fraction evidence)
+                    _send_json(self, svc.broker.hedge_snapshot())
                 elif self.path.partition("?")[0] == "/debug/slowQueries":
                     # structured slow-query ring buffer (broker-side triage)
                     payload = json.dumps(list(svc.broker.slow_queries)).encode()
@@ -429,6 +433,33 @@ class ServerHTTPService:
                     self.end_headers()
                     self.wfile.write(payload)
                     return
+                if self.path == "/debug/faults":
+                    # runtime chaos arming: replace this process's fault-rule
+                    # set ({"points": {point: rule}, "seed": n}; empty points
+                    # disarms). The chaos bench uses this to turn one server
+                    # into a seeded delay straggler mid-run without a restart.
+                    from pinot_tpu.common.faults import FAULT_POINTS, FAULTS
+
+                    n = int(self.headers.get("Content-Length", 0))
+                    try:
+                        body = json.loads(self.rfile.read(n) or b"{}")
+                        points = body.get("points") or {}
+                        unknown = sorted(set(points) - FAULT_POINTS)
+                        if unknown:
+                            raise ValueError(f"unknown fault points: {unknown}")
+                        FAULTS.configure(points, seed=int(body.get("seed", 0)))
+                        payload = json.dumps({"armed": sorted(points)}).encode()
+                        self.send_response(200)
+                    except Exception as e:
+                        payload = json.dumps(
+                            {"error": f"{type(e).__name__}: {e}", "errorCode": code_of(e)}
+                        ).encode()
+                        self.send_response(400)
+                    self.send_header("Content-Type", "application/json")
+                    self.send_header("Content-Length", str(len(payload)))
+                    self.end_headers()
+                    self.wfile.write(payload)
+                    return
                 if self.path in ("/segments/add", "/segments/remove"):
                     n = int(self.headers.get("Content-Length", 0))
                     body = json.loads(self.rfile.read(n) or b"{}")
@@ -555,6 +586,12 @@ class ServerHTTPService:
                     # live scheduler state (server role): queue depths,
                     # in-flight counts, per-group tokens
                     _send_json(self, svc.server.admission_snapshot())
+                elif self.path == "/debug/faults":
+                    # armed fault points + per-point fire counts (chaos
+                    # evidence: did the injected rule actually trigger?)
+                    from pinot_tpu.common.faults import FAULTS
+
+                    _send_json(self, {"enabled": FAULTS.enabled, "counts": FAULTS.counts()})
                 elif self.path == "/debug/queries":
                     # ThreadResourceTracker/QueryResourceTracker REST parity
                     from pinot_tpu.common.accounting import default_accountant
@@ -1019,7 +1056,13 @@ class ControllerHTTPService:
                         from pinot_tpu.cluster.rebalance import rebalance_table
 
                         body = json.loads(raw or b"{}")
-                        r = rebalance_table(c, parts[1], dry_run=bool(body.get("dryRun")))
+                        r = rebalance_table(
+                            c,
+                            parts[1],
+                            dry_run=bool(body.get("dryRun")),
+                            drain_grace_sec=float(body.get("drainGraceSec") or 0.0),
+                            bootstrap=bool(body.get("bootstrap")),
+                        )
                         self._json(
                             {
                                 "status": r.status,
@@ -1176,8 +1219,15 @@ class RemoteControllerClient:
         body = json.dumps({"taskType": task_type} if task_type else {}).encode()
         return self._post("/tasks/schedule", body)["scheduled"]
 
-    def rebalance_table(self, table: str, dry_run: bool = False) -> dict:
-        return self._post(f"/tables/{table}/rebalance", json.dumps({"dryRun": dry_run}).encode())
+    def rebalance_table(
+        self,
+        table: str,
+        dry_run: bool = False,
+        drain_grace_sec: float = 0.0,
+        bootstrap: bool = False,
+    ) -> dict:
+        body = {"dryRun": dry_run, "drainGraceSec": drain_grace_sec, "bootstrap": bootstrap}
+        return self._post(f"/tables/{table}/rebalance", json.dumps(body).encode())
 
 
 def query_broker_http(base_url: str, sql: str) -> dict:

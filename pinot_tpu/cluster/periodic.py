@@ -21,6 +21,7 @@ from pinot_tpu.common.metrics import (
     merge_cumulative_buckets,
     quantile_from_buckets,
 )
+from pinot_tpu.cluster.rebalance import rebalance_progress as _rebalance_progress
 
 
 class ControllerPeriodicTask:
@@ -413,6 +414,32 @@ class ClusterMetricsAggregator(ControllerPeriodicTask):
             for t, tb in tables.items()
         }
 
+        # event-to-queryable freshness: per-table server.freshnessMs series
+        # merged per table and cluster-wide (the freshness SLO input)
+        fresh_tables: dict[str, list] = {}
+        for s in nodes("server"):
+            for key, acc in s["accBuckets"].items():
+                if key.startswith("server.freshnessMs{"):
+                    t = self._series_labels.get(key, {}).get("table")
+                    if t:
+                        fresh_tables.setdefault(t, []).append(self._cumulative(acc))
+        freshness = merge_cumulative_buckets(
+            [bl for lists in fresh_tables.values() for bl in lists]
+        )
+        for t, lists in fresh_tables.items():
+            entry = table_samples.setdefault(
+                t, {"queries": 0, "errors": 0, "latencyBuckets": []}
+            )
+            entry["freshnessBuckets"] = merge_cumulative_buckets(lists)
+
+        # hedged-scatter rollup across brokers (labelled per-table meters)
+        hedge = {"issued": 0, "won": 0, "wasted": 0}
+        for s in nodes("broker"):
+            for key, v in s["accCounters"].items():
+                for kind in hedge:
+                    if key == f"broker.hedge.{kind}" or key.startswith(f"broker.hedge.{kind}{{"):
+                        hedge[kind] += v
+
         # merged per-(tenant, table) workload + per-table scrape-window QPS
         workload: dict = {}
         for s in self._nodes.values():
@@ -459,6 +486,8 @@ class ClusterMetricsAggregator(ControllerPeriodicTask):
                 default=0.0,
             ),
             "tables": table_samples,
+            "freshnessBuckets": freshness,
+            "hedge": hedge,
             "workload": {f"{tenant}/{table}": dict(agg) for (tenant, table), agg in sorted(workload.items())},
             "exemplars": exemplars,
         }
@@ -480,6 +509,10 @@ class ClusterMetricsAggregator(ControllerPeriodicTask):
             total_ms=sample["serverLatencyTotalMs"],
             max_ms=sample["serverLatencyMaxMs"],
         )
+        if sample.get("freshnessBuckets"):
+            m.histogram("cluster.freshnessMs").load_cumulative(sample["freshnessBuckets"])
+        for kind, n in sorted((sample.get("hedge") or {}).items()):
+            m.gauge("cluster.hedge", kind=kind).set(n)
         with self._lock:
             total = len(self._nodes)
             healthy = sum(1 for s in self._nodes.values() if s["ok"])
@@ -537,6 +570,7 @@ class ClusterMetricsAggregator(ControllerPeriodicTask):
                 "queries": sample["queries"],
                 "errors": sample["errors"],
                 "latencyBuckets": sample["latencyBuckets"],
+                "freshnessBuckets": sample["freshnessBuckets"],
                 "tables": sample["tables"],
                 "exemplars": sample["exemplars"],
             }
@@ -591,8 +625,15 @@ class ClusterMetricsAggregator(ControllerPeriodicTask):
                     "p50Ms": quantile_from_buckets(sample.get("serverLatencyBuckets") or [], 0.5),
                     "p99Ms": quantile_from_buckets(sample.get("serverLatencyBuckets") or [], 0.99),
                 },
+                "freshness": {
+                    "count": (sample.get("freshnessBuckets") or [(0, 0)])[-1][1],
+                    "p50Ms": quantile_from_buckets(sample.get("freshnessBuckets") or [], 0.5),
+                    "p99Ms": quantile_from_buckets(sample.get("freshnessBuckets") or [], 0.99),
+                },
+                "hedge": dict(sample.get("hedge") or {"issued": 0, "won": 0, "wasted": 0}),
                 "workload": sample.get("workload", {}),
             },
+            "rebalance": _rebalance_progress(),
             "topTables": {
                 "byQps": [dict(v, table=t) for t, v in by_qps],
                 "byCpu": [dict(v, table=t) for t, v in by_cpu],

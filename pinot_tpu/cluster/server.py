@@ -326,6 +326,11 @@ class Server:
         from pinot_tpu.common.metrics import ServerMeter, server_metrics
         from pinot_tpu.common.trace import trace_event
 
+        try:
+            FAULTS.maybe_fail("server.crash")
+        except InjectedFault as e:
+            trace_event("fault.injected", point="server.crash", server=self.server_id)
+            raise RuntimeError(f"server {self.server_id} unreachable: {e}") from None
         hints, deadline, broker_qid, _tctx = self._pop_resilience_hints(hints)
         eng = self._engine(table)
         ctx = eng.make_context(sql)
@@ -455,6 +460,14 @@ class Server:
             trace_event("fault.injected", point="server.scatter", server=self.server_id)
             # present exactly what a dead TCP peer produces so the broker's
             # failover path (which matches on "unreachable") engages
+            raise RuntimeError(f"server {self.server_id} unreachable: {e}") from None
+        try:
+            # whole-server hard-down simulation: same surface as a dead TCP
+            # peer, but (unlike server.scatter) also armed on the streaming
+            # path so the server is dead from every angle
+            FAULTS.maybe_fail("server.crash")
+        except InjectedFault as e:
+            trace_event("fault.injected", point="server.crash", server=self.server_id)
             raise RuntimeError(f"server {self.server_id} unreachable: {e}") from None
         hints, deadline, broker_qid, tctx = self._pop_resilience_hints(hints)
         # workload-attribution marker (rides hints like the resilience

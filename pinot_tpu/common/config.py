@@ -98,6 +98,17 @@ class ResilienceConfig:
     #: fault-injection rules (point -> FaultRule dict) + deterministic seed
     faults: dict = field(default_factory=dict)
     fault_seed: int = 0
+    #: hedged scatter (tail-at-scale): after hedge_delay_factor × the
+    #: per-(server,table) latency EWMA — clamped to [hedge_delay_min_ms,
+    #: hedge_delay_max_ms] — re-issue an unfinished segment-group to a
+    #: surviving replica and take whichever answers first
+    hedge_enabled: bool = False
+    hedge_delay_factor: float = 3.0
+    hedge_delay_min_ms: float = 5.0
+    hedge_delay_max_ms: float = 500.0
+    #: fan-out budget: hedges are suppressed once issued-hedges exceed this
+    #: fraction of primary scatter calls (tail-at-scale's "≤5% extra load")
+    hedge_budget_fraction: float = 0.05
 
     def to_dict(self) -> dict:
         return {
@@ -109,6 +120,11 @@ class ResilienceConfig:
             "mailboxTombstoneTtlS": self.mailbox_tombstone_ttl_s,
             "faults": self.faults,
             "faultSeed": self.fault_seed,
+            "hedgeEnabled": self.hedge_enabled,
+            "hedgeDelayFactor": self.hedge_delay_factor,
+            "hedgeDelayMinMs": self.hedge_delay_min_ms,
+            "hedgeDelayMaxMs": self.hedge_delay_max_ms,
+            "hedgeBudgetFraction": self.hedge_budget_fraction,
         }
 
     @staticmethod
@@ -122,6 +138,11 @@ class ResilienceConfig:
             mailbox_tombstone_ttl_s=d.get("mailboxTombstoneTtlS", 60.0),
             faults=d.get("faults", {}),
             fault_seed=d.get("faultSeed", 0),
+            hedge_enabled=d.get("hedgeEnabled", False),
+            hedge_delay_factor=d.get("hedgeDelayFactor", 3.0),
+            hedge_delay_min_ms=d.get("hedgeDelayMinMs", 5.0),
+            hedge_delay_max_ms=d.get("hedgeDelayMaxMs", 500.0),
+            hedge_budget_fraction=d.get("hedgeBudgetFraction", 0.05),
         )
 
 
@@ -168,6 +189,12 @@ class SchedulerConfig:
     #: under degrade (allowPartialResults + projected overload), keep this
     #: fraction of the planned scatter servers (floor 1)
     degrade_keep_fraction: float = 0.5
+    #: estimator-liveness probe: when a shed would rest entirely on the
+    #: service-time EWMA (free runners, no queue pressure), admit one query
+    #: per this interval per table so the estimate can recover — the EWMA
+    #: only updates when a query completes, so shedding everything would
+    #: freeze a poisoned estimate forever (FailureDetector probe parity)
+    probe_interval_ms: float = 500.0
     #: per-tenant aggregate QPS quotas (tenant -> QPS), enforced by
     #: QueryQuotaManager alongside per-table TableConfig quotas
     tenant_qps: dict = field(default_factory=dict)
@@ -187,6 +214,7 @@ class SchedulerConfig:
             "minServiceMs": self.min_service_ms,
             "serviceEwmaAlpha": self.service_ewma_alpha,
             "degradeKeepFraction": self.degrade_keep_fraction,
+            "probeIntervalMs": self.probe_interval_ms,
             "tenantQps": dict(self.tenant_qps),
         }
 
@@ -206,6 +234,7 @@ class SchedulerConfig:
             min_service_ms=d.get("minServiceMs", 1.0),
             service_ewma_alpha=d.get("serviceEwmaAlpha", 0.2),
             degrade_keep_fraction=d.get("degradeKeepFraction", 0.5),
+            probe_interval_ms=d.get("probeIntervalMs", 500.0),
             tenant_qps=d.get("tenantQps", {}),
         )
 

@@ -16,6 +16,10 @@ Well-known points (wired in this repo):
     stream.consume   — Server.execute_partials_stream, per yielded frame
     wire.connect     — ConnectionPool._connect, before the TCP connect
     scheduler.admit  — AdmissionController.decide, before any admission math
+    server.crash     — Server.execute_partials, hard-down simulation (the
+                       whole server looks dead, not one scatter call)
+    rebalance.move   — rebalance_table, per segment move before the ADD step
+    stream.lag       — PartitionConsumer batch fetch, consumer-lag simulation
 """
 
 from __future__ import annotations
@@ -41,6 +45,9 @@ FAULT_POINTS = frozenset(
         "stream.consume",  # Server.execute_partials_stream, per yielded frame
         "wire.connect",  # ConnectionPool._connect, before the TCP connect
         "scheduler.admit",  # AdmissionController.decide, before admission math
+        "server.crash",  # Server.execute_partials, whole-server hard-down
+        "rebalance.move",  # rebalance_table, per segment move (before ADD)
+        "stream.lag",  # PartitionConsumer batch fetch, consumer-lag delay
     }
 )
 

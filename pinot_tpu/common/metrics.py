@@ -522,6 +522,12 @@ class ServerMeter(Enum):
     MAILBOX_STRAGGLER_DROPS = "server.mailboxStragglerDrops"
 
 
+class ServerHistogram(Enum):
+    #: event-to-queryable latency: stream-producer stamp -> row visible in
+    #: the consuming segment (freshness SLO input, one series per table)
+    FRESHNESS = "server.freshnessMs"
+
+
 class ServerGauge(Enum):
     SEGMENT_COUNT = "server.segmentCount"
     LLC_PARTITION_CONSUMING = "server.llcPartitionConsuming"
@@ -548,6 +554,12 @@ class BrokerMeter(Enum):
     ADMISSION_SHED = "broker.admission.shed"
     ADMISSION_QUOTA_REJECTED = "broker.admission.quotaRejected"
     ADMISSION_DEGRADED = "broker.admission.degraded"
+    ADMISSION_PROBED = "broker.admission.probed"
+    # hedged scatter (tail-at-scale): extra replica requests issued after the
+    # EWMA hedge delay, split by which leg answered first
+    HEDGE_ISSUED = "broker.hedge.issued"
+    HEDGE_WON = "broker.hedge.won"
+    HEDGE_WASTED = "broker.hedge.wasted"
 
 
 class BrokerGauge(Enum):

@@ -35,6 +35,7 @@ from pinot_tpu.common.metrics import quantile_from_buckets
 DEFAULT_OBJECTIVES = {
     "availability": 0.999,
     "p99LatencyMs": None,  # disabled unless configured
+    "freshnessP99Ms": None,  # event-to-queryable p99 target; disabled unless set
     "burnRateThreshold": 1.0,
     "shortWindowS": 300.0,
     "longWindowS": 3600.0,
@@ -82,7 +83,9 @@ class SloEvaluator:
 
         sample = {"queries": int, "errors": int,
                   "latencyBuckets": [(le, cum), ...],          # accumulated
-                  "tables": {table: {"queries", "errors", "latencyBuckets"}},
+                  "freshnessBuckets": [(le, cum), ...],        # accumulated
+                  "tables": {table: {"queries", "errors", "latencyBuckets",
+                                     "freshnessBuckets"}},
                   "exemplars": [slow-query entries, newest last]}
 
         Returns the list of alert *transitions* (newly fired / newly
@@ -127,17 +130,26 @@ class SloEvaluator:
         c, b = _pick(cur), _pick(base)
         queries = max(0, int(c.get("queries") or 0) - int(b.get("queries") or 0))
         errors = max(0, int(c.get("errors") or 0) - int(b.get("errors") or 0))
-        cur_b = {le: cum for le, cum in (c.get("latencyBuckets") or ())}
-        base_b = {le: cum for le, cum in (b.get("latencyBuckets") or ())}
-        # per-bound cumulative deltas; a bound the baseline hadn't seen yet
-        # contributes its full count, and a running max keeps the result a
-        # valid (non-decreasing) cumulative series for quantile reads
-        delta_b = []
-        hi = 0
-        for le, cum in sorted(cur_b.items()):
-            hi = max(hi, max(0, cum - base_b.get(le, 0)))
-            delta_b.append((le, hi))
-        return {"queries": queries, "errors": errors, "buckets": delta_b}
+
+        def _delta_buckets(key: str):
+            cur_b = {le: cum for le, cum in (c.get(key) or ())}
+            base_b = {le: cum for le, cum in (b.get(key) or ())}
+            # per-bound cumulative deltas; a bound the baseline hadn't seen
+            # yet contributes its full count, and a running max keeps the
+            # result a valid (non-decreasing) cumulative series
+            delta_b = []
+            hi = 0
+            for le, cum in sorted(cur_b.items()):
+                hi = max(hi, max(0, cum - base_b.get(le, 0)))
+                delta_b.append((le, hi))
+            return delta_b
+
+        return {
+            "queries": queries,
+            "errors": errors,
+            "buckets": _delta_buckets("latencyBuckets"),
+            "freshnessBuckets": _delta_buckets("freshnessBuckets"),
+        }
 
     @staticmethod
     def _burn_rate(win: dict, availability: float) -> float:
@@ -194,6 +206,21 @@ class SloEvaluator:
                     clear=(ps <= float(p99_target)), now=now,
                     measured={"p99ShortMs": ps, "p99LongMs": pl,
                               "targetMs": float(p99_target)},
+                )
+
+            fresh_target = obj.get("freshnessP99Ms")
+            if fresh_target is not None:
+                fs = quantile_from_buckets(short["freshnessBuckets"], 0.99)
+                fl = quantile_from_buckets(long_["freshnessBuckets"], 0.99)
+                scope_status["freshness"] = {
+                    "targetMs": float(fresh_target), "p99ShortMs": fs, "p99LongMs": fl,
+                }
+                transitions += self._transition(
+                    "freshness", table,
+                    firing=(fs > float(fresh_target) and fl > float(fresh_target)),
+                    clear=(fs <= float(fresh_target)), now=now,
+                    measured={"p99ShortMs": fs, "p99LongMs": fl,
+                              "targetMs": float(fresh_target)},
                 )
             self._status["scopes"][scope_key] = scope_status
         return transitions
@@ -263,3 +290,7 @@ class SloEvaluator:
             if p:
                 self.registry.gauge("cluster.slo.p99Ms", scope=scope, window="short").set(p["p99ShortMs"])
                 self.registry.gauge("cluster.slo.p99Ms", scope=scope, window="long").set(p["p99LongMs"])
+            f = per_slo.get("freshness")
+            if f:
+                self.registry.gauge("cluster.slo.freshnessP99Ms", scope=scope, window="short").set(f["p99ShortMs"])
+                self.registry.gauge("cluster.slo.freshnessP99Ms", scope=scope, window="long").set(f["p99LongMs"])

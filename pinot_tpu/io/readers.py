@@ -284,11 +284,23 @@ def _thrift_byte(buf: bytes, pos: int) -> int:
 def _thrift_len(buf: bytes, pos: int, width: int = 1) -> int:
     """Validated length/count prefix: negative or past-end values are file
     corruption — fail loudly instead of looping backwards (negative length
-    would move pos backwards forever) or yielding a truncated last row."""
+    would move pos backwards forever) or yielding a truncated last row.
+    `width` is the minimum encoded size of one element, so an absurd count
+    of wide elements is rejected at the prefix instead of spinning through
+    per-element reads to the eventual truncation error."""
     n = _thrift_unpack(">i", buf, pos, 4)
     if n < 0 or pos + 4 + n * width > len(buf):
         raise ValueError(f"corrupt thrift data: length {n} at offset {pos}")
     return n
+
+
+#: minimum encoded bytes per value of each wire type (variable-width types
+#: count their own mandatory prefix: string 4B length, list/set 1B etype +
+#: 4B count, map 2B types + 4B count, struct 1B STOP)
+_T_MIN_WIDTH = {
+    _T_BOOL: 1, _T_BYTE: 1, _T_DOUBLE: 8, _T_I16: 2, _T_I32: 4, _T_I64: 8,
+    _T_STRING: 4, _T_STRUCT: 1, _T_LIST: 5, _T_SET: 5, _T_MAP: 6,
+}
 
 
 def _thrift_read_value(buf: bytes, pos: int, ftype: int):
@@ -315,7 +327,8 @@ def _thrift_read_value(buf: bytes, pos: int, ftype: int):
         fields, pos = _thrift_read_struct(buf, pos)
         return dict(fields), pos
     if ftype in (_T_LIST, _T_SET):
-        etype, n = _thrift_byte(buf, pos), _thrift_len(buf, pos + 1)
+        etype = _thrift_byte(buf, pos)
+        n = _thrift_len(buf, pos + 1, _T_MIN_WIDTH.get(etype, 1))
         pos += 5
         out = []
         for _ in range(n):
@@ -324,7 +337,9 @@ def _thrift_read_value(buf: bytes, pos: int, ftype: int):
         return out, pos
     if ftype == _T_MAP:
         ktype, vtype = _thrift_byte(buf, pos), _thrift_byte(buf, pos + 1)
-        n = _thrift_len(buf, pos + 2)
+        n = _thrift_len(
+            buf, pos + 2, _T_MIN_WIDTH.get(ktype, 1) + _T_MIN_WIDTH.get(vtype, 1)
+        )
         pos += 6
         out = {}
         for _ in range(n):

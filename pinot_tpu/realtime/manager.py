@@ -140,6 +140,14 @@ class PartitionConsumer:
         # ignore_budget: a CATCHUP directive must reach the winning offset
         # even though the local segment is already full (all replicas commit
         # the SAME row range; the budget would otherwise livelock the loop).
+        from pinot_tpu.common.faults import FAULTS, InjectedFault
+
+        try:
+            FAULTS.maybe_fail("stream.lag")
+        except InjectedFault:
+            # transient fetch failure (broker hiccup): nothing consumed this
+            # round; the poll loop retries — lag, not data loss
+            return 0
         budget = self.batch_size if ignore_budget else max(0, self.max_rows - self._mutable.n_docs)
         msgs, next_off = self.consumer.fetch_messages(self.offset, min(self.batch_size, budget))
         for m in msgs:
@@ -168,6 +176,18 @@ class PartitionConsumer:
                 self._mutable.index(row)
         with self._lock:
             self.offset = next_off
+        if msgs:
+            # event-to-queryable freshness: rows indexed above are visible to
+            # queries via the consuming snapshot the moment this batch lands,
+            # so producer-stamp -> now IS the freshness sample (per table; the
+            # aggregator folds the series into the cluster freshness SLO)
+            from pinot_tpu.common.metrics import ServerHistogram, server_metrics
+
+            now_ms = time.time() * 1e3
+            fh = server_metrics().histogram(ServerHistogram.FRESHNESS, table=self.table)
+            for m in msgs:
+                if m.timestamp_ms:
+                    fh.update_ms(max(0.0, now_ms - m.timestamp_ms))
         return len(msgs)
 
     def _rollover(self) -> None:

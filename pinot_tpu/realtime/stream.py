@@ -10,6 +10,7 @@ Kafka test analog; real connectors implement the same three methods.
 from __future__ import annotations
 
 import threading
+import time
 from dataclasses import dataclass
 from typing import Any, Callable, Mapping, Protocol
 
@@ -19,6 +20,9 @@ class StreamMessage:
     offset: int
     value: Mapping[str, Any]  # decoded row
     key: str | None = None
+    #: producer-side wall-clock stamp (Kafka record timestamp parity); the
+    #: consume loop measures event-to-queryable freshness against it. 0 =
+    #: unknown (freshness not tracked for this message)
     timestamp_ms: int = 0
 
 
@@ -78,7 +82,14 @@ class InMemoryStream:
         with self._lock:
             log = self._partitions[partition]
             offset = len(log)
-            log.append(StreamMessage(offset=offset, value=dict(value), key=key))
+            log.append(
+                StreamMessage(
+                    offset=offset,
+                    value=dict(value),
+                    key=key,
+                    timestamp_ms=int(time.time() * 1e3),
+                )
+            )
             return offset
 
     def partition_count(self) -> int:

@@ -56,8 +56,23 @@ def cmd_start_controller(args) -> dict:
     for g in BUILTIN_GENERATORS:
         tm.register_generator(g())
     svc = ControllerHTTPService(controller, port=args.port, task_manager=tm)
+    handles = {"controller": controller, "service": svc, "task_manager": tm}
+    if getattr(args, "with_periodics", False):
+        # federated metrics hub: scrape every registered broker/server and
+        # serve /debug/cluster + /debug/alerts from this process
+        from pinot_tpu.cluster.periodic import ClusterMetricsAggregator, PeriodicTaskScheduler
+
+        objectives = (
+            json.loads(args.slo_json) if getattr(args, "slo_json", "") else None
+        )
+        agg = ClusterMetricsAggregator(controller, objectives=objectives)
+        agg.interval_sec = args.metrics_interval
+        sched = PeriodicTaskScheduler(controller=controller)
+        sched.register(agg)
+        sched.start()
+        handles["periodic_scheduler"] = sched
     print(f"controller listening on http://127.0.0.1:{svc.port}", flush=True)
-    return {"controller": controller, "service": svc, "task_manager": tm}
+    return handles
 
 
 def cmd_start_server(args) -> dict:
@@ -83,8 +98,9 @@ def cmd_start_broker(args) -> dict:
     import json as _json
 
     from pinot_tpu.cluster.broker import Broker
+    from pinot_tpu.cluster.failure import FailureDetector
     from pinot_tpu.cluster.http import BrokerHTTPService, RemoteControllerClient
-    from pinot_tpu.common.config import SchedulerConfig
+    from pinot_tpu.common.config import ResilienceConfig, SchedulerConfig
 
     rc = RemoteControllerClient(args.controller_url)
     # --scheduler-json takes SchedulerConfig camelCase keys, e.g.
@@ -95,7 +111,24 @@ def cmd_start_broker(args) -> dict:
         if getattr(args, "scheduler_json", "")
         else None
     )
-    broker = Broker(rc, scheduler_config=sched_cfg)
+    # --resilience-json takes ResilienceConfig camelCase keys, e.g.
+    # '{"hedgeEnabled": true, "hedgeDelayFactor": 3.0}'; empty string keeps
+    # timeouts/hedging at defaults
+    res_cfg = (
+        ResilienceConfig.from_dict(_json.loads(args.resilience_json))
+        if getattr(args, "resilience_json", "")
+        else None
+    )
+    # a standalone broker process always runs a failure detector: without
+    # one, a dead server is a hard query error instead of routing exclusion
+    # plus one-round replica failover
+    broker = Broker(
+        rc,
+        scheduler_config=sched_cfg,
+        resilience=res_cfg,
+        max_scatter_threads=args.scatter_threads,
+        failure_detector=FailureDetector(),
+    )
     svc = BrokerHTTPService(broker, port=args.port)
     rc.register_instance("broker", args.broker_id, "127.0.0.1", svc.port)
     print(f"broker listening on http://127.0.0.1:{svc.port}", flush=True)
@@ -169,7 +202,12 @@ def cmd_schedule_tasks(args) -> dict:
 def cmd_rebalance_table(args) -> dict:
     from pinot_tpu.cluster.http import RemoteControllerClient
 
-    out = RemoteControllerClient(args.controller_url).rebalance_table(args.table, dry_run=args.dry_run)
+    out = RemoteControllerClient(args.controller_url).rebalance_table(
+        args.table,
+        dry_run=args.dry_run,
+        drain_grace_sec=args.drain_grace_sec,
+        bootstrap=args.bootstrap,
+    )
     print(json.dumps(out), flush=True)
     return out
 
@@ -494,6 +532,17 @@ def build_parser() -> argparse.ArgumentParser:
     c.add_argument("--store-dir", required=True)
     c.add_argument("--deep-store", required=True)
     c.add_argument("--port", type=int, default=0)
+    c.add_argument(
+        "--with-periodics",
+        action="store_true",
+        help="run the ClusterMetricsAggregator scrape loop (serves /debug/cluster)",
+    )
+    c.add_argument("--metrics-interval", type=float, default=10.0)
+    c.add_argument(
+        "--slo-json",
+        default="",
+        help='SLO objectives as camelCase JSON, e.g. \'{"freshnessP99Ms": 2000}\'',
+    )
     c.set_defaults(fn=cmd_start_controller, blocking=True)
 
     s = sub.add_parser("StartServer")
@@ -513,6 +562,12 @@ def build_parser() -> argparse.ArgumentParser:
         default="",
         help='SchedulerConfig overrides as camelCase JSON, e.g. \'{"numRunners": 16}\'',
     )
+    b.add_argument(
+        "--resilience-json",
+        default="",
+        help='ResilienceConfig overrides as camelCase JSON, e.g. \'{"hedgeEnabled": true}\'',
+    )
+    b.add_argument("--scatter-threads", type=int, default=8)
     b.set_defaults(fn=cmd_start_broker, blocking=True)
 
     a = sub.add_parser("AddTable")
@@ -545,6 +600,18 @@ def build_parser() -> argparse.ArgumentParser:
     rb.add_argument("--controller-url", required=True)
     rb.add_argument("--table", required=True)
     rb.add_argument("--dry-run", action="store_true")
+    rb.add_argument(
+        "--drain-grace-sec",
+        type=float,
+        default=0.0,
+        help="pause after de-routing each replaced replica before removing it",
+    )
+    rb.add_argument(
+        "--bootstrap",
+        action="store_true",
+        help="converge to a load-balanced placement (moves replicas off "
+        "over-the-ceiling servers) instead of pure minimal movement",
+    )
     rb.set_defaults(fn=cmd_rebalance_table, blocking=False)
 
     asch = sub.add_parser("AddSchema")

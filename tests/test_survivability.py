@@ -1,0 +1,581 @@
+"""Cluster survivability suite: failure-detector concurrency, the chaos
+fault points added for the cluster plane (server.crash / rebalance.move /
+stream.lag), bootstrap rebalance under live load, hedged scatter, and the
+/debug/faults runtime-arming endpoint.
+
+Reference test model: Pinot's failure-detector unit tests plus
+ChaosMonkeyIntegrationTest — but every chaotic input here flows through the
+seeded common/faults.py registry (or a deterministic handle wrapper), so
+each run replays identically inside a bounded wall time.
+"""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from pinot_tpu.cluster import Broker, Controller, PropertyStore, Server
+from pinot_tpu.cluster.failure import FailureDetector
+from pinot_tpu.cluster.rebalance import rebalance_progress, rebalance_table
+from pinot_tpu.common import DataType, Schema, TableConfig, TableType
+from pinot_tpu.common.config import ResilienceConfig
+from pinot_tpu.common.faults import FAULTS, FaultRule, InjectedFault
+from pinot_tpu.common.metrics import BrokerMeter, broker_metrics, reset_registries
+from pinot_tpu.realtime import InMemoryStream, RealtimeTableManager
+from pinot_tpu.segment import SegmentBuilder
+
+
+@pytest.fixture(autouse=True)
+def _clean_state():
+    """Faults and metrics are process-global registries: start and end every
+    test with both clean so a leaked rule/counter can't poison neighbors."""
+    FAULTS.reset()
+    reset_registries()
+    yield
+    FAULTS.reset()
+    reset_registries()
+
+
+def _build_cluster(tmp_path, n_servers=2, replication=1, rows_per_seg=200, n_segs=5):
+    controller = Controller(PropertyStore(), tmp_path / "ds")
+    servers = {f"s{i}": Server(f"s{i}") for i in range(n_servers)}
+    for sid, s in servers.items():
+        controller.register_server(sid, s)
+    schema = Schema.build(
+        "t", dimensions=[("d", DataType.INT)], metrics=[("v", DataType.LONG)]
+    )
+    controller.add_schema(schema)
+    controller.add_table(TableConfig("t", replication=replication))
+    b = SegmentBuilder(schema)
+    rng = np.random.default_rng(0)
+    for i in range(n_segs):
+        controller.upload_segment(
+            "t",
+            b.build(
+                {
+                    "d": rng.integers(0, 10, rows_per_seg).astype(np.int32),
+                    "v": np.full(rows_per_seg, i, dtype=np.int64),
+                },
+                f"t_{i}",
+            ),
+        )
+    return controller, servers
+
+
+TOTAL_ROWS = 5 * 200
+
+
+# ---------------------------------------------------------------------------
+# FailureDetector concurrency semantics
+# ---------------------------------------------------------------------------
+
+
+def test_failure_detector_backoff_doubles_and_caps():
+    fd = FailureDetector(initial_delay_sec=0.5, backoff_factor=2.0, max_delay_sec=4.0)
+    expected = [0.5, 1.0, 2.0, 4.0, 4.0]  # doubles, then pins at max
+    for want in expected:
+        fd.mark_failure("s0")
+        assert fd._down["s0"][1] == pytest.approx(want)
+    fd.mark_success("s0")
+    assert fd.is_healthy("s0")
+    # recovery resets the schedule: the next failure starts over at initial
+    fd.mark_failure("s0")
+    assert fd._down["s0"][1] == pytest.approx(0.5)
+
+
+def test_failure_detector_failure_during_probe_resolves_claim():
+    fd = FailureDetector(initial_delay_sec=0.02, probe_ttl_sec=30.0)
+    fd.mark_failure("s0")
+    time.sleep(0.03)
+    assert fd.is_healthy("s0")  # this caller claimed the single probe slot
+    # the probe's query failed: the claim must resolve immediately (not wait
+    # out the 30s TTL) and the slot reopen when the grown backoff expires
+    fd.mark_failure("s0")
+    assert not fd.is_healthy("s0")  # inside the new backoff window
+    time.sleep(0.05)  # past the doubled 0.04s delay
+    assert fd.is_healthy("s0")  # slot reopened — TTL did not wedge it
+
+
+def test_failure_detector_single_probe_under_concurrency():
+    fd = FailureDetector(initial_delay_sec=0.02, probe_ttl_sec=30.0)
+    fd.mark_failure("s0")
+    time.sleep(0.03)
+    n = 16
+    barrier = threading.Barrier(n)
+    admits = []
+    lock = threading.Lock()
+
+    def worker():
+        barrier.wait()
+        ok = fd.is_healthy("s0")
+        with lock:
+            admits.append(ok)
+
+    threads = [threading.Thread(target=worker) for _ in range(n)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    # exactly ONE of the racing queries takes the probe slot; the rest keep
+    # routing around the down server (no thundering herd)
+    assert admits.count(True) == 1
+    assert fd.unhealthy_servers() == ["s0"]
+    fd.mark_success("s0")
+    assert fd.unhealthy_servers() == []
+
+
+def test_failure_detector_concurrent_mark_churn_is_consistent():
+    """Hammer mark_failure/mark_success/is_healthy from many threads: the
+    detector must end in a coherent state (no exception, no stuck entry)."""
+    fd = FailureDetector(initial_delay_sec=0.001, max_delay_sec=0.01, probe_ttl_sec=0.01)
+    stop = time.monotonic() + 0.5
+    errors = []
+
+    def churn(i):
+        try:
+            while time.monotonic() < stop:
+                sid = f"s{i % 4}"
+                fd.mark_failure(sid)
+                fd.is_healthy(sid)
+                fd.unhealthy_servers()
+                fd.mark_success(sid)
+        except Exception as e:  # pragma: no cover - the assertion target
+            errors.append(e)
+
+    threads = [threading.Thread(target=churn, args=(i,)) for i in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert errors == []
+    for sid in (f"s{i}" for i in range(4)):
+        fd.mark_success(sid)
+    assert fd.unhealthy_servers() == []
+    assert fd.is_healthy("s0")
+
+
+# ---------------------------------------------------------------------------
+# Chaos fault points: server.crash / rebalance.move / stream.lag
+# ---------------------------------------------------------------------------
+
+
+def test_server_crash_fault_fails_over_to_replica(tmp_path):
+    controller, _ = _build_cluster(tmp_path, replication=2)
+    broker = Broker(controller, failure_detector=FailureDetector(initial_delay_sec=0.05))
+    FAULTS.configure({"server.crash": FaultRule(max_count=1)}, seed=11)
+    res = broker.execute("SELECT COUNT(*) FROM t")
+    assert res.rows[0][0] == TOTAL_ROWS  # failover kept the answer complete
+    assert FAULTS.counts().get("server.crash", 0) == 1  # the crash really fired
+
+
+def test_rebalance_move_fault_marks_progress_failed_then_recovers(tmp_path):
+    controller, _ = _build_cluster(tmp_path, n_servers=2, replication=2)
+    for i in range(2, 4):
+        controller.register_server(f"s{i}", Server(f"s{i}"))
+    FAULTS.configure({"rebalance.move": FaultRule()}, seed=3)
+    with pytest.raises(InjectedFault):
+        rebalance_table(controller, "t", bootstrap=True)
+    assert rebalance_progress("t")["status"] == "FAILED"
+    assert FAULTS.counts()["rebalance.move"] == 1
+    # disarm and retry: the rebalance completes and queries stay whole
+    FAULTS.reset()
+    result = rebalance_table(controller, "t", bootstrap=True)
+    assert result.status == "DONE"
+    assert rebalance_progress("t")["status"] == "DONE"
+    assert Broker(controller).execute("SELECT COUNT(*) FROM t").rows[0][0] == TOTAL_ROWS
+
+
+def test_stream_lag_fault_is_lag_not_loss(tmp_path):
+    controller = Controller(PropertyStore(), tmp_path / "deep")
+    server = Server("server_rt")
+    controller.register_server("server_rt", server)
+    schema = Schema.build(
+        "events", dimensions=[("kind", DataType.STRING)], metrics=[("value", DataType.LONG)]
+    )
+    controller.add_schema(schema)
+    config = TableConfig("events", TableType.REALTIME)
+    controller.add_table(config)
+    stream = InMemoryStream(partitions=1)
+    mgr = RealtimeTableManager(controller, server, schema, config, stream, max_rows_per_segment=50)
+    # every other fetch round fails for the first 20 fires: consumption lags
+    # but the poll loop retries — no message may be skipped
+    FAULTS.configure({"stream.lag": FaultRule(prob=0.5, max_count=20)}, seed=9)
+    mgr.start()
+    try:
+        for i in range(120):
+            stream.produce(0, {"kind": f"k{i % 5}", "value": i})
+        assert mgr.wait_until_caught_up([120], timeout=15)
+        assert FAULTS.counts().get("stream.lag", 0) > 0  # chaos actually ran
+        res = Broker(controller).execute("SELECT COUNT(*), SUM(value) FROM events")
+        assert res.rows[0][0] == 120
+        assert res.rows[0][1] == sum(range(120))  # lag, not loss
+    finally:
+        mgr.stop()
+
+
+# ---------------------------------------------------------------------------
+# Rebalance: bootstrap balancing + zero drops under live load
+# ---------------------------------------------------------------------------
+
+
+def test_bootstrap_rebalance_balances_scale_out(tmp_path):
+    controller, _ = _build_cluster(tmp_path, n_servers=2, replication=2, n_segs=4)
+    for i in range(2, 4):
+        controller.register_server(f"s{i}", Server(f"s{i}"))
+    # default mode is pure minimal movement: replication already satisfied,
+    # so the scale-out is a NO_OP and the new servers stay idle
+    assert rebalance_table(controller, "t").status == "NO_OP"
+    result = rebalance_table(controller, "t", bootstrap=True)
+    assert result.status == "DONE" and result.adds and result.drops
+    load = {f"s{i}": 0 for i in range(4)}
+    for replicas in controller.ideal_state("t").values():
+        assert len(replicas) == 2  # replication held through the move
+        for sid in replicas:
+            load[sid] += 1
+    # 4 segments x 2 replicas over 4 servers -> exactly 2 each
+    assert set(load.values()) == {2}
+    assert Broker(controller).execute("SELECT COUNT(*) FROM t").rows[0][0] == 4 * 200
+
+
+def test_rebalance_under_live_load_drops_no_queries(tmp_path):
+    controller, _ = _build_cluster(tmp_path, n_servers=2, replication=2)
+    for i in range(2, 4):
+        controller.register_server(f"s{i}", Server(f"s{i}"))
+    broker = Broker(controller, failure_detector=FailureDetector())
+    errors = []
+    stop = threading.Event()
+
+    def drive():
+        while not stop.is_set():
+            try:
+                r = broker.execute("SELECT COUNT(*) FROM t")
+                if r.rows[0][0] != TOTAL_ROWS:
+                    errors.append(f"short read: {r.rows[0][0]}")
+            except Exception as e:
+                errors.append(repr(e))
+
+    threads = [threading.Thread(target=drive) for _ in range(4)]
+    for t in threads:
+        t.start()
+    try:
+        time.sleep(0.1)
+        result = rebalance_table(controller, "t", drain_grace_sec=0.02, bootstrap=True)
+        assert result.status == "DONE" and result.adds
+        time.sleep(0.1)
+    finally:
+        stop.set()
+        for t in threads:
+            t.join()
+    # ADD-new -> ONLINE -> de-route -> REMOVE-old ordering: routing never
+    # observes a segment with zero ONLINE replicas, so zero drops
+    assert errors == []
+    prog = rebalance_progress("t")
+    assert prog["status"] == "DONE" and prog["doneMoves"] == prog["totalMoves"]
+
+
+# ---------------------------------------------------------------------------
+# Hedged scatter
+# ---------------------------------------------------------------------------
+
+
+def test_hedge_delay_clamps_to_configured_window(tmp_path):
+    controller, _ = _build_cluster(tmp_path, n_segs=1, rows_per_seg=10)
+    broker = Broker(
+        controller,
+        resilience=ResilienceConfig(
+            hedge_enabled=True,
+            hedge_delay_factor=2.0,
+            hedge_delay_min_ms=10.0,
+            hedge_delay_max_ms=100.0,
+        ),
+    )
+    # no observation yet: hedge only when clearly hung (max)
+    assert broker._hedge_delay_s("s0", "t") == pytest.approx(0.1)
+    broker._hedge_ewma[("s0", "t")] = 1.0  # 2x1ms -> below min, clamp up
+    assert broker._hedge_delay_s("s0", "t") == pytest.approx(0.01)
+    broker._hedge_ewma[("s0", "t")] = 500.0  # 2x500ms -> above max, clamp down
+    assert broker._hedge_delay_s("s0", "t") == pytest.approx(0.1)
+    broker._hedge_ewma[("s0", "t")] = 20.0  # in-window: factor x EWMA
+    assert broker._hedge_delay_s("s0", "t") == pytest.approx(0.04)
+
+
+def test_hedge_budget_floor_and_fraction(tmp_path):
+    controller, _ = _build_cluster(tmp_path, n_segs=1, rows_per_seg=10)
+    broker = Broker(
+        controller,
+        resilience=ResilienceConfig(hedge_enabled=True, hedge_budget_fraction=0.05),
+    )
+    # cold broker: the floor of one admits the first straggler, nothing more
+    assert broker._hedge_admit()
+    assert not broker._hedge_admit()
+    # 100 primaries at 5% -> 5 cumulative hedges total
+    broker._hedge_primary = 100
+    grants = sum(1 for _ in range(10) if broker._hedge_admit())
+    assert broker._hedge_issued == 5
+    assert grants == 4  # one of the five was the cold-start grant
+
+
+def test_hedge_target_requires_whole_group_and_health(tmp_path):
+    controller, _ = _build_cluster(tmp_path, n_segs=1, rows_per_seg=10)
+    fd = FailureDetector()
+    broker = Broker(
+        controller,
+        failure_detector=fd,
+        resilience=ResilienceConfig(hedge_enabled=True),
+    )
+    ideal = {
+        "a": {"s0": "ONLINE", "s1": "ONLINE", "s2": "ONLINE"},
+        "b": {"s0": "ONLINE", "s1": "ONLINE"},  # s2 does not host b
+    }
+    # only s1 hosts the WHOLE group besides the straggling primary s0
+    assert broker._hedge_target("s0", ["a", "b"], ideal, "t") == "s1"
+    fd.mark_failure("s1")
+    assert broker._hedge_target("s0", ["a", "b"], ideal, "t") is None
+    fd.mark_success("s1")
+    # lowest EWMA wins among full-group survivors
+    broker._hedge_ewma[("s1", "t")] = 50.0
+    broker._hedge_ewma[("s2", "t")] = 1.0
+    assert broker._hedge_target("s0", ["a"], ideal, "t") == "s2"
+    assert broker._hedge_target("s0", ["a", "b"], ideal, "t") == "s1"  # s2 lacks b
+    ideal["b"]["s2"] = "ONLINE"
+    assert broker._hedge_target("s0", ["a", "b"], ideal, "t") == "s2"
+
+
+class _SlowServer:
+    """Delegating handle that stalls the scatter path: the deterministic
+    straggler the hedge must beat."""
+
+    def __init__(self, inner, delay_s):
+        self.inner = inner
+        self.delay_s = delay_s
+
+    def __getattr__(self, name):
+        return getattr(self.inner, name)
+
+    def execute_partials(self, *a, **kw):
+        time.sleep(self.delay_s)
+        return self.inner.execute_partials(*a, **kw)
+
+
+def test_hedged_scatter_beats_straggler_and_marks_meters(tmp_path):
+    controller, servers = _build_cluster(tmp_path, replication=2, n_segs=5)
+    controller.register_server("s1", _SlowServer(servers["s1"], delay_s=0.6))
+    broker = Broker(
+        controller,
+        failure_detector=FailureDetector(),
+        resilience=ResilienceConfig(
+            hedge_enabled=True,
+            hedge_delay_max_ms=40.0,
+            hedge_budget_fraction=0.5,
+        ),
+    )
+    try:
+        t0 = time.perf_counter()
+        res = broker.execute("SELECT COUNT(*) FROM t")
+        elapsed = time.perf_counter() - t0
+        assert res.rows[0][0] == TOTAL_ROWS
+        # the hedge to s0 returns long before the 0.6s straggler would
+        assert elapsed < 0.5
+        snap = broker.hedge_snapshot()
+        assert snap["enabled"] and snap["hedgesIssued"] >= 1
+        bm = broker_metrics()
+        issued = bm.meter(BrokerMeter.HEDGE_ISSUED, table="t").count
+        won = bm.meter(BrokerMeter.HEDGE_WON, table="t").count
+        assert issued >= 1 and won >= 1
+    finally:
+        broker.shutdown()
+
+
+def test_hedging_disabled_issues_no_hedges(tmp_path):
+    controller, servers = _build_cluster(tmp_path, replication=2, n_segs=5)
+    controller.register_server("s1", _SlowServer(servers["s1"], delay_s=0.1))
+    broker = Broker(controller)  # hedge_enabled defaults False
+    try:
+        assert broker.execute("SELECT COUNT(*) FROM t").rows[0][0] == TOTAL_ROWS
+        snap = broker.hedge_snapshot()
+        assert not snap["enabled"] and snap["hedgesIssued"] == 0
+        assert broker_metrics().meter(BrokerMeter.HEDGE_ISSUED, table="t").count == 0
+    finally:
+        broker.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# Admission estimator-liveness probe
+# ---------------------------------------------------------------------------
+
+
+def test_admission_probe_recovers_poisoned_estimate():
+    """A service-time EWMA pushed past the deadline (e.g. by JIT-cold
+    warmup queries) must not shed 100% forever: the EWMA only updates when a
+    query completes, so the first estimate-only shed starts a probe clock
+    that admits one query per interval until the estimate recovers."""
+    from pinot_tpu.cluster.admission import ADMIT, AdmissionController
+    from pinot_tpu.common.config import SchedulerConfig
+    from pinot_tpu.query.context import Deadline
+    from pinot_tpu.query.scheduler import SchedulerRejectedError
+
+    ac = AdmissionController(SchedulerConfig(probe_interval_ms=40.0))
+    try:
+        ac.note_service_time("t", 60_000.0)  # poisoned far past any deadline
+        # first estimate-only rejection sheds (and starts the probe clock)
+        with pytest.raises(SchedulerRejectedError):
+            ac.decide("t", Deadline.from_timeout_ms(1_500.0))
+        with pytest.raises(SchedulerRejectedError):
+            ac.decide("t", Deadline.from_timeout_ms(1_500.0))  # window claimed
+        time.sleep(0.05)
+        assert ac.decide("t", Deadline.from_timeout_ms(1_500.0)) == ADMIT
+        assert ac.probed == 1
+        # the probe's real observation walks the estimate back down;
+        # normal admission resumes and the probe clock resets
+        for _ in range(40):
+            ac.note_service_time("t", 5.0)
+        assert ac.decide("t", Deadline.from_timeout_ms(1_500.0)) == ADMIT
+        assert ac.probed == 1  # not a probe — a plain admit
+        # post-recovery, a re-poisoned estimate sheds first again
+        ac.note_service_time("t", 60_000.0)
+        with pytest.raises(SchedulerRejectedError):
+            ac.decide("t", Deadline.from_timeout_ms(1_500.0))
+    finally:
+        ac.stop()
+
+
+# ---------------------------------------------------------------------------
+# /debug/faults: runtime chaos arming over HTTP
+# ---------------------------------------------------------------------------
+
+
+def _post_json(url, doc):
+    req = urllib.request.Request(
+        url, data=json.dumps(doc).encode(), headers={"Content-Type": "application/json"}
+    )
+    with urllib.request.urlopen(req, timeout=10) as r:
+        return json.loads(r.read())
+
+
+def _get_json(url):
+    with urllib.request.urlopen(url, timeout=10) as r:
+        return json.loads(r.read())
+
+
+def test_debug_faults_endpoint_arm_fire_disarm(tmp_path):
+    from pinot_tpu.cluster.http import ServerHTTPService
+
+    controller, servers = _build_cluster(tmp_path, n_servers=1, replication=1, n_segs=2)
+    svc = ServerHTTPService(servers["s0"], port=0)
+    base = f"http://127.0.0.1:{svc.port}"
+    try:
+        doc = _get_json(f"{base}/debug/faults")
+        assert doc == {"enabled": False, "counts": {}}
+        # unknown point names are rejected before touching the registry
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _post_json(f"{base}/debug/faults", {"points": {"nope.bogus": {}}})
+        assert ei.value.code == 400
+        assert not FAULTS.enabled
+        armed = _post_json(
+            f"{base}/debug/faults",
+            {"points": {"server.scatter": {"mode": "error", "maxCount": 1}}, "seed": 5},
+        )
+        assert armed["armed"] == ["server.scatter"]
+        broker = Broker(controller, failure_detector=FailureDetector(initial_delay_sec=0.01))
+        with pytest.raises(Exception):
+            # single replica: the injected unreachable cannot fail over
+            broker.execute("SELECT COUNT(*) FROM t")
+        doc = _get_json(f"{base}/debug/faults")
+        assert doc["enabled"] and doc["counts"].get("server.scatter") == 1
+        # empty points disarms: back to the production state
+        _post_json(f"{base}/debug/faults", {"points": {}})
+        assert _get_json(f"{base}/debug/faults") == {"enabled": False, "counts": {}}
+        time.sleep(0.02)  # let the failure detector's backoff on s0 expire
+        assert broker.execute("SELECT COUNT(*) FROM t").rows[0][0] == 2 * 200
+        broker.shutdown()
+    finally:
+        svc.stop()
+
+
+# ---------------------------------------------------------------------------
+# Bounded deterministic cluster-chaos smoke (the CI tier-1 survival gate)
+# ---------------------------------------------------------------------------
+
+
+class _CrashedServer:
+    """Hard-down handle: every data-plane call looks like a dead TCP peer.
+    (The server.crash FAULTS point is process-global — in a single-process
+    cluster it would take down every replica at once — so the smoke kills
+    exactly one server by swapping its handle, the way test_faults does.)"""
+
+    def __init__(self, inner):
+        self.inner = inner
+        self.calls = 0
+
+    def __getattr__(self, name):
+        return getattr(self.inner, name)
+
+    def execute_partials(self, *a, **kw):
+        self.calls += 1
+        raise RuntimeError(f"server {self.inner.server_id} unreachable: killed by test")
+
+    def execute_partials_stream(self, *a, **kw):
+        self.calls += 1
+        raise RuntimeError(f"server {self.inner.server_id} unreachable: killed by test")
+
+
+def test_cluster_chaos_smoke_kill_and_rebalance_under_load(tmp_path):
+    """One bounded pass over the survivability plane: sustained concurrent
+    queries through a hedged broker while (1) one server hard-crashes
+    mid-flight and (2) a bootstrap rebalance drains segments onto fresh
+    capacity — zero wrong answers, zero non-typed errors."""
+    controller, servers = _build_cluster(tmp_path, n_servers=3, replication=2)
+    broker = Broker(
+        controller,
+        failure_detector=FailureDetector(initial_delay_sec=0.05),
+        resilience=ResilienceConfig(hedge_enabled=True, hedge_delay_max_ms=200.0),
+    )
+    errors = []
+    oks = [0]
+    lock = threading.Lock()
+    stop = threading.Event()
+
+    def drive():
+        while not stop.is_set():
+            try:
+                r = broker.execute("SELECT COUNT(*) FROM t")
+                with lock:
+                    if r.rows[0][0] == TOTAL_ROWS:
+                        oks[0] += 1
+                    else:
+                        errors.append(f"short read: {r.rows[0][0]}")
+            except Exception as e:
+                with lock:
+                    errors.append(repr(e))
+
+    threads = [threading.Thread(target=drive) for _ in range(6)]
+    for t in threads:
+        t.start()
+    try:
+        time.sleep(0.15)
+        # chaos 1: s1 hard-down mid-flight; replicas + the failure detector
+        # keep every in-flight query whole
+        dead = _CrashedServer(servers["s1"])
+        controller.register_server("s1", dead)
+        time.sleep(0.4)
+        controller.register_server("s1", servers["s1"])  # server comes back
+        crash_fires = dead.calls
+        # chaos 2: scale out and rebalance while the same load keeps running
+        controller.register_server("s3", Server("s3"))
+        result = rebalance_table(controller, "t", drain_grace_sec=0.02, bootstrap=True)
+        assert result.status == "DONE" and result.adds
+        time.sleep(0.15)
+    finally:
+        stop.set()
+        for t in threads:
+            t.join()
+        broker.shutdown()
+    assert errors == []
+    assert oks[0] > 20  # the load was real, not vacuous
+    assert crash_fires >= 1  # the crash point actually fired mid-load
+    prog = rebalance_progress("t")
+    assert prog["status"] == "DONE"
