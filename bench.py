@@ -986,8 +986,12 @@ def cluster_main():
       phase 5  SIGKILL a server mid-flight (failover: zero non-typed errors)
       phase 6  live-ingest freshness through the realtime FSM (in-process,
                deterministic) -> freshness_p99_ms + SLO evaluation
+      phase 7  disk corruption under live load: bit-flip one replica's local
+               segment copy + one deep-store copy; the 1s integrity scrubber
+               must quarantine + repair both while queries keep answering
+               (0 untyped, 0 dropped)
 
-    Writes BENCH_cluster_r12.json and prints the same JSON line."""
+    Writes BENCH_cluster_r13.json and prints the same JSON line."""
     import shutil
     import signal
     import tempfile
@@ -1025,6 +1029,7 @@ def cluster_main():
                 "--port", "0",
                 "--with-periodics",
                 "--metrics-interval", "2",
+                "--scrub-interval", "1",
             ],
             procs,
         )
@@ -1034,7 +1039,13 @@ def cluster_main():
 
         def start_server(sid: str):
             p, url = _spawn_role(
-                ["StartServer", "--controller-url", controller_url, "--server-id", sid, "--port", "0"],
+                [
+                    "StartServer", "--controller-url", controller_url,
+                    "--server-id", sid, "--port", "0",
+                    # local verified copies: the corruption phase flips bits
+                    # here and the self-healing plane must repair them
+                    "--data-dir", os.path.join(root, "data", sid),
+                ],
                 procs,
             )
             servers[sid] = p
@@ -1255,6 +1266,80 @@ def cluster_main():
         )
         assert kill_bg["outcomes"]["dropped"] == 0, f"server kill dropped queries: {kill_bg}"
 
+        # -- phase 7: disk corruption under live load (self-healing proof) -----
+        # flip one bit in a replica's local segment copy AND in a different
+        # segment's deep-store copy while queries keep flowing. Queries must
+        # keep answering (replication 2 + in-memory copies: 0 untyped, 0
+        # dropped), and the 1s IntegrityScrubber must detect -> quarantine ->
+        # repair both copies inside the phase window.
+        def _get_json(url):
+            with urllib.request.urlopen(url, timeout=10) as r:
+                return json.loads(r.read())
+
+        live_sids = sorted(s for s in hosts if s != victim_id)
+        corrupt_sid = live_sids[0]
+        corrupt_seg = hosts[corrupt_sid][0]
+        local_file = os.path.join(
+            root, "data", corrupt_sid, "lineorder", corrupt_seg, "segment.ptseg"
+        )
+        deep_seg = next(s for s in sorted(ideal) if s != corrupt_seg)
+        deep_file = os.path.join(root, "deep", "lineorder", deep_seg, "segment.ptseg")
+
+        def _flip_bit(path):
+            with open(path, "r+b") as f:
+                f.seek(os.path.getsize(path) // 2)
+                b = f.read(1)
+                f.seek(-1, 1)
+                f.write(bytes([b[0] ^ 0x20]))
+
+        log(
+            f"phase 7: corruption under load — bit-flip {corrupt_sid} local copy of "
+            f"{corrupt_seg} + deep-store copy of {deep_seg}"
+        )
+        corrupt_bg: dict = {}
+        t_corrupt = threading.Thread(
+            target=lambda: corrupt_bg.update(
+                _cluster_drive(both, queries, n_clients, phase_s + 2.0)
+            ),
+            daemon=True,
+        )
+        t_corrupt.start()
+        time.sleep(0.3)
+        _flip_bit(local_file)
+        _flip_bit(deep_file)
+        heal_deadline = time.time() + max(30.0, phase_s * 4)
+        heal = {"serverRepaired": 0, "deepRepaired": 0, "quarantined": []}
+        while time.time() < heal_deadline:
+            storage = _get_json(f"{server_urls[corrupt_sid]}/debug/storage")
+            smetrics = _get_json(f"{server_urls[corrupt_sid]}/metrics?format=json")
+            cmetrics = _get_json(f"{controller_url}/metrics?format=json")
+            heal = {
+                "serverRepaired": smetrics.get("storage.scrub.repaired", {}).get("count", 0),
+                "deepRepaired": cmetrics.get("storage.scrub.repaired", {}).get("count", 0),
+                "deepVerified": cmetrics.get("storage.scrub.verified", {}).get("count", 0),
+                "unrepairable": cmetrics.get("storage.scrub.unrepairable", {}).get("count", 0),
+                "quarantined": storage["quarantined"],
+            }
+            if heal["serverRepaired"] >= 1 and heal["deepRepaired"] >= 1:
+                break
+            time.sleep(1.0)
+        t_corrupt.join()
+        result["corruption_heal"] = {
+            "local": f"{corrupt_sid}:{corrupt_seg}",
+            "deep_store": deep_seg,
+            "heal": heal,
+            "driven": corrupt_bg,
+        }
+        log(f"phase 7: heal state {heal}, driven {corrupt_bg['outcomes']}")
+        assert corrupt_bg["outcomes"]["untyped"] == 0, (
+            f"corruption produced non-typed client errors: {corrupt_bg}"
+        )
+        assert corrupt_bg["outcomes"]["dropped"] == 0, f"corruption dropped queries: {corrupt_bg}"
+        assert heal["serverRepaired"] >= 1, f"server scrub never repaired the local copy: {heal}"
+        assert heal["deepRepaired"] >= 1, f"controller scrub never repaired the deep store: {heal}"
+        assert heal["unrepairable"] == 0, f"scrubber declared corruption unrepairable: {heal}"
+        assert heal["quarantined"], "no quarantined file left on disk for the runbook"
+
         # -- /debug/cluster from the controller hub ----------------------------
         with urllib.request.urlopen(f"{controller_url}/debug/cluster", timeout=10) as r:
             doc = json.loads(r.read())
@@ -1290,7 +1375,7 @@ def cluster_main():
         "4": result["qps_4_servers"]["throughput_qps"],
         "8": result["qps_8_servers"]["throughput_qps"],
     }
-    with open("BENCH_cluster_r12.json", "w") as f:
+    with open("BENCH_cluster_r13.json", "w") as f:
         json.dump(result, f, indent=2)
         f.write("\n")
     print(json.dumps(result))

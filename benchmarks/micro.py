@@ -937,6 +937,107 @@ def bench_aggregator_scrape(cycles=50):
     }
 
 
+def bench_atomic_write_overhead(size=4 * 1024 * 1024):
+    """Crash-consistent write cost vs a bare write (fsync held equal so the
+    delta is the tmp-name + rename + fault-guard mechanics, not disk sync).
+    The production fast path through the storage.write fault guard is one
+    dict check per file write; it is timed directly and its projected share
+    of a segment write must sit inside the 2% budget — the stable form of
+    the wall-clock assertion (fsync noise can't flake it)."""
+    import tempfile
+    from pathlib import Path
+
+    from pinot_tpu.common.durability import atomic_write_bytes
+    from pinot_tpu.common.faults import FAULTS
+
+    data = os.urandom(size)
+    with tempfile.TemporaryDirectory(prefix="pinot_tpu_bench_") as td:
+        bare_path = Path(td) / "bare.bin"
+        atomic_path = Path(td) / "atomic.bin"
+        bare_ms = _time_host(lambda: bare_path.write_bytes(data), iters=7)
+        atomic_ms = _time_host(lambda: atomic_write_bytes(atomic_path, data, fsync=False), iters=7)
+
+    FAULTS.reset()  # production state: guard is one empty-dict check
+    checks = 100_000
+    t0 = time.perf_counter()
+    for _ in range(checks):
+        FAULTS.maybe_fail("storage.write", data)
+    per_call_us = (time.perf_counter() - t0) / checks * 1e6
+    # one guard call per file write, projected against the bare write wall
+    projected_pct = per_call_us / (bare_ms * 1e3) * 100
+    assert projected_pct < 2.0, (
+        f"storage.write guard {per_call_us:.2f}µs = {projected_pct:.2f}% of a "
+        f"{bare_ms:.1f}ms write — over the 2% budget"
+    )
+    return {
+        "metric": "atomic_write_overhead",
+        "value": round(atomic_ms - bare_ms, 3),
+        "unit": "ms",
+        "size_bytes": size,
+        "bare_ms": round(bare_ms, 3),
+        "atomic_ms": round(atomic_ms, 3),
+        "overhead_pct": round((atomic_ms / bare_ms - 1.0) * 100, 1),
+        "guard_us_per_write": round(per_call_us, 4),
+        "projected_pct": round(projected_pct, 3),
+    }
+
+
+def bench_scrub_overhead(n_segments=8, rows=20_000):
+    """Integrity-scrubber duty cycle: a full CRC sweep of a server's local
+    copies vs one budget-throttled increment. The throttle is the overhead
+    contract — at the default 30s interval, one increment's wall share must
+    stay under the 2% budget, and a 1-byte budget must scan exactly one
+    segment per call (the incremental-coverage proof)."""
+    import tempfile
+    from pathlib import Path
+
+    from pinot_tpu.cluster import Controller, PropertyStore, Server
+    from pinot_tpu.common import DataType, Schema, TableConfig
+    from pinot_tpu.segment import SegmentBuilder
+
+    rng = np.random.default_rng(23)
+    with tempfile.TemporaryDirectory(prefix="pinot_tpu_scrub_") as td:
+        root = Path(td)
+        controller = Controller(PropertyStore(root / "zk"), root / "deepstore")
+        server = Server("server_0", data_dir=root / "data")
+        controller.register_server("server_0", server)
+        schema = Schema.build(
+            "t", dimensions=[("d", DataType.INT)], metrics=[("m", DataType.LONG)]
+        )
+        controller.add_schema(schema)
+        controller.add_table(TableConfig("t", replication=1))
+        b = SegmentBuilder(schema)
+        for i in range(n_segments):
+            seg = b.build(
+                {
+                    "d": rng.integers(0, 100, rows).astype(np.int32),
+                    "m": rng.integers(1, 10, rows).astype(np.int64),
+                },
+                f"t_{i}",
+            )
+            controller.upload_segment("t", seg)
+        full_ms = _time_host(lambda: server.scrub(), iters=5)
+        one = server.scrub(io_budget_bytes=1)
+        assert one["verified"] == 1, f"1-byte budget must scan one segment, got {one}"
+        throttled_ms = _time_host(lambda: server.scrub(io_budget_bytes=1), iters=5)
+        seg_bytes = one["bytesScanned"]
+    duty_pct = throttled_ms / 30_000.0 * 100  # share of the default interval
+    assert duty_pct < 2.0, (
+        f"one throttled scrub increment {throttled_ms:.1f}ms = {duty_pct:.2f}% "
+        "of the 30s interval — over the 2% budget"
+    )
+    return {
+        "metric": "scrub_overhead",
+        "value": round(throttled_ms, 3),
+        "unit": "ms",
+        "n_segments": n_segments,
+        "segment_bytes": seg_bytes,
+        "full_sweep_ms": round(full_ms, 3),
+        "throttled_ms": round(throttled_ms, 3),
+        "duty_pct_at_30s_interval": round(duty_pct, 4),
+    }
+
+
 def bench_lint_runtime():
     """pinotlint must stay fast enough to sit in tier-1 and CI: a whole-package
     run (all five checkers, ~200 modules) is asserted under the 10s budget on
@@ -979,6 +1080,8 @@ ALL = [
     bench_profiler_overhead,
     bench_slo_overhead,
     bench_aggregator_scrape,
+    bench_atomic_write_overhead,
+    bench_scrub_overhead,
     bench_lint_runtime,
 ]
 

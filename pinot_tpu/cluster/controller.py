@@ -211,12 +211,47 @@ class Controller:
     # -- segment upload & assignment ----------------------------------------
 
     def upload_segment(self, table: str, segment: ImmutableSegment) -> list[str]:
-        """Write segment to the deep store, assign replicas, push state
-        transitions to the chosen servers. Returns the assigned server ids."""
+        """Write segment to the deep store, VERIFY the written bytes, then
+        assign replicas and push state transitions to the chosen servers.
+        Returns the assigned server ids.
+
+        Ordering contract (write → verify → assign): no cluster metadata —
+        segment doc, ideal state, server transition — may reference the
+        deep-store dir until the on-disk image passes whole-file CRC
+        verification. A failed or short write (ENOSPC, crash, disk fault)
+        surfaces as a typed SegmentUploadError and removes the partial dir,
+        so later downloads can never reference half a segment."""
         config = self.get_table(table)
         if config is None:
             raise KeyError(f"no such table: {table}")
-        seg_dir = write_segment(segment, self.deep_store / table)
+        from pinot_tpu.common.errors import SegmentCorruptedError, SegmentUploadError
+        from pinot_tpu.segment.store import SEGMENT_FILE, verify_segment_file
+
+        table_dir = self.deep_store / table
+        seg_dir = table_dir / segment.name
+        existed = seg_dir.exists()
+        table_dir_existed = table_dir.exists()
+        try:
+            seg_dir = write_segment(segment, table_dir)
+            file_crc = (
+                verify_segment_file(seg_dir) if (seg_dir / SEGMENT_FILE).exists() else None
+            )
+        except (OSError, SegmentCorruptedError) as e:
+            if not existed:
+                import shutil
+
+                shutil.rmtree(seg_dir, ignore_errors=True)
+                if not table_dir_existed:
+                    # first segment of the table: drop the dir the failed
+                    # write created so the deep store is exactly as before
+                    import contextlib
+
+                    with contextlib.suppress(OSError):
+                        table_dir.rmdir()
+            raise SegmentUploadError(
+                getattr(e, "errno", None) or 0,
+                f"segment upload {table}/{segment.name} failed, no partial dir left: {e}",
+            ) from e
         stats = {
             col: {
                 "min": ci.stats.to_dict()["min"],
@@ -235,6 +270,10 @@ class Controller:
             "servers": assigned,
             "uploadedAt": _time.time(),
         }
+        if file_crc is not None:
+            # cluster truth for downloaders/scrubbers: a copy whose bytes
+            # don't hash to this is corrupt no matter what its footer says
+            seg_meta["fileCrc"] = file_crc
         partitions = self._compute_partitions(segment, config)
         if partitions:
             seg_meta["partitions"] = partitions

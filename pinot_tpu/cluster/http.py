@@ -460,6 +460,29 @@ class ServerHTTPService:
                     self.end_headers()
                     self.wfile.write(payload)
                     return
+                if self.path == "/segments/scrub":
+                    # on-demand integrity pass over this server's local
+                    # segment copies (the controller's IntegrityScrubber
+                    # calls this on remote handles; ops can too)
+                    n = int(self.headers.get("Content-Length", 0))
+                    try:
+                        body = json.loads(self.rfile.read(n) or b"{}")
+                        budget = body.get("ioBudgetBytes")
+                        out = svc.server.scrub(
+                            io_budget_bytes=int(budget) if budget is not None else None
+                        )
+                        payload = json.dumps(out).encode()
+                        self.send_response(200)
+                    except Exception as e:
+                        payload = json.dumps(
+                            {"error": f"{type(e).__name__}: {e}", "errorCode": code_of(e)}
+                        ).encode()
+                        self.send_response(500)
+                    self.send_header("Content-Type", "application/json")
+                    self.send_header("Content-Length", str(len(payload)))
+                    self.end_headers()
+                    self.wfile.write(payload)
+                    return
                 if self.path in ("/segments/add", "/segments/remove"):
                     n = int(self.headers.get("Content-Length", 0))
                     body = json.loads(self.rfile.read(n) or b"{}")
@@ -602,6 +625,28 @@ class ServerHTTPService:
                     self.send_header("Content-Length", str(len(payload)))
                     self.end_headers()
                     self.wfile.write(payload)
+                elif self.path.startswith("/segments/file/"):
+                    # verified raw segment bytes for peer-replica repair
+                    # (the scrubber's last-resort fetch when the deep-store
+                    # copy is bad); 404 when this server has no healthy copy
+                    parts = self.path.split("/")[3:]
+                    data = (
+                        svc.server.fetch_segment_file(parts[0], parts[1])
+                        if len(parts) == 2
+                        else None
+                    )
+                    if data is None:
+                        self.send_error(404)
+                        return
+                    self.send_response(200)
+                    self.send_header("Content-Type", "application/octet-stream")
+                    self.send_header("Content-Length", str(len(data)))
+                    self.end_headers()
+                    self.wfile.write(data)
+                elif self.path == "/debug/storage":
+                    # quarantine runbook surface: data dir, local copies and
+                    # their deep-store sources, *.quarantined files on disk
+                    _send_json(self, svc.server.local_segment_report())
                 elif self.path.startswith("/segments/"):
                     # hosted-segment listing (VerifySegmentState's live view)
                     table = self.path.split("/", 2)[2]
@@ -829,6 +874,27 @@ class RemoteServerClient:
         """Remote servers don't ship segment objects over HTTP; multistage
         leaf scans run ON the server via multistage_submit instead."""
         return None
+
+    def scrub(self, io_budget_bytes: int | None = None) -> dict:
+        body = {} if io_budget_bytes is None else {"ioBudgetBytes": int(io_budget_bytes)}
+        return self._post_json("/segments/scrub", body)
+
+    def fetch_segment_file(self, table: str, segment_name: str) -> bytes | None:
+        """Verified segment bytes from the remote server's copy, or None
+        when it has no healthy copy (404)."""
+        try:
+            with get_pool().request(
+                self._host,
+                self._port,
+                "GET",
+                f"/segments/file/{table}/{segment_name}",
+                timeout_s=self.timeout,
+            ) as resp:
+                if resp.status != 200:
+                    return None
+                return bytes(resp.read())
+        except (OSError, RuntimeError):
+            return None
 
     def multistage_submit(self, doc: dict) -> None:
         self._post_json("/multistage/submit", doc)

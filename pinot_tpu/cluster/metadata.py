@@ -22,6 +22,8 @@ import json
 import threading
 from pathlib import Path
 
+from ..common.durability import atomic_write_json
+
 
 class PropertyStore:
     """Path -> JSON document store; file-backed when rooted, else in-memory."""
@@ -47,7 +49,9 @@ class PropertyStore:
             else:
                 f = self._file(path)
                 f.parent.mkdir(parents=True, exist_ok=True)
-                f.write_text(json.dumps(doc))
+                # tmp+rename+fsync: a crash mid-set leaves the previous doc
+                # intact, never a torn JSON that bricks controller restart
+                atomic_write_json(f, doc)
 
     def get(self, path: str) -> dict | None:
         with self._lock:

@@ -154,6 +154,135 @@ class MissingConsumingSegmentFinder(ControllerPeriodicTask):
         return {"missingPartitions": missing}
 
 
+class IntegrityScrubber(ControllerPeriodicTask):
+    """Background storage-integrity scrubber (SegmentStatusChecker's missing
+    sibling in the reference: validate-on-load exists there, but nothing
+    re-verifies cold bytes — here the controller owns that sweep).
+
+    Two sweeps per run, both under one IO budget:
+      1. **Server sweep** — every registered server handle exposing
+         `scrub()` verifies its local copies (quarantine + re-download +
+         hot-swap happen server-side; see Server.scrub).
+      2. **Deep-store sweep** — CRC-verify deep-store segment files against
+         the `fileCrc` recorded in ZK segment metadata. A corrupt deep-store
+         copy is quarantined and RE-REPLICATED from the first healthy server
+         replica (`fetch_segment_file` -> verify -> atomic write -> refresh
+         `fileCrc`), restoring durability without operator action.
+
+    The deep-store cursor persists across runs, so a small per-run budget
+    still covers the whole store incrementally (the IO throttle contract).
+    Meters: `storage.scrub.{verified,corrupted,repaired,unrepairable}` on
+    the controller registry; unrepairable corruption additionally feeds the
+    SLO plane's `scrubUnrepairable` objective via the aggregator."""
+
+    name = "IntegrityScrubber"
+    interval_sec = 30.0
+
+    def __init__(self, controller, io_budget_bytes: int | None = 64 * 1024 * 1024):
+        super().__init__(controller)
+        self.io_budget_bytes = io_budget_bytes
+        self._cursor = 0
+        self.last_run: dict = {}
+
+    def run_once(self) -> dict:
+        servers = {}
+        for sid, h in sorted(self.controller.servers().items()):
+            scrub = getattr(h, "scrub", None)
+            if scrub is None:
+                continue
+            try:
+                servers[sid] = scrub(io_budget_bytes=self.io_budget_bytes)
+            except Exception as e:  # noqa: BLE001  # pinotlint: disable=deadline-swallow — maintenance sweep, off the query path; a down server must not stop the scrub
+                servers[sid] = {"error": f"{type(e).__name__}: {e}"}
+        out = self._deep_store_sweep()
+        out["servers"] = servers
+        self.last_run = out
+        return out
+
+    def _deep_store_sweep(self) -> dict:
+        from pathlib import Path
+
+        from pinot_tpu.common.errors import SegmentCorruptedError
+        from pinot_tpu.segment.store import SEGMENT_FILE, verify_segment_file
+
+        items = []
+        for table in self.controller.tables():
+            try:
+                for name, meta in sorted(self.controller.all_segment_metadata(table).items()):
+                    loc = (meta or {}).get("location")
+                    if loc and (Path(loc) / SEGMENT_FILE).exists():
+                        items.append((table, name, meta, Path(loc) / SEGMENT_FILE))
+            except Exception:  # noqa: BLE001  # pinotlint: disable=deadline-swallow — maintenance sweep, off the query path; one bad table must not stop it
+                pass
+        m = controller_metrics()
+        out = {"verified": 0, "corrupted": 0, "repaired": 0, "unrepairable": 0,
+               "bytesScanned": 0, "deepStoreSegments": len(items)}
+        if not items:
+            return out
+        start = self._cursor % len(items)
+        for table, name, meta, f in items[start:] + items[:start]:
+            if self.io_budget_bytes is not None and out["bytesScanned"] >= self.io_budget_bytes:
+                break
+            self._cursor += 1
+            try:
+                out["bytesScanned"] += f.stat().st_size
+            except OSError:
+                pass
+            try:
+                verify_segment_file(f, expected_crc=meta.get("fileCrc"))
+                out["verified"] += 1
+                m.meter("storage.scrub.verified").mark()
+            except SegmentCorruptedError:
+                out["corrupted"] += 1
+                m.meter("storage.scrub.corrupted").mark()
+                if self._repair_deep_store(table, name, meta, f):
+                    out["repaired"] += 1
+                    m.meter("storage.scrub.repaired").mark()
+                else:
+                    out["unrepairable"] += 1
+                    m.meter("storage.scrub.unrepairable").mark()
+        return out
+
+    def _repair_deep_store(self, table: str, name: str, meta: dict, f) -> bool:
+        """Re-replicate a corrupt deep-store copy from a healthy server
+        replica. The bad file is quarantined (kept for the runbook), the
+        fetched bytes are verified BEFORE landing, and the refreshed
+        `fileCrc` goes back into ZK metadata (a re-serialized in-memory
+        copy legitimately hashes differently)."""
+        import logging
+        import os
+
+        from pinot_tpu.common.durability import atomic_write_bytes
+        from pinot_tpu.segment.store import verify_segment_bytes
+
+        handles = self.controller.servers()
+        for sid in meta.get("servers") or sorted(handles):
+            fetch = getattr(handles.get(sid), "fetch_segment_file", None)
+            if fetch is None:
+                continue
+            try:
+                data = fetch(table, name)
+                if not data:
+                    continue
+                crc = verify_segment_bytes(data, f"replica {sid} copy of {table}/{name}")
+            except Exception:  # noqa: BLE001  # pinotlint: disable=deadline-swallow — a bad/unreachable replica just means trying the next one; unrepairable is metered by the caller
+                continue
+            if f.exists():
+                os.replace(f, f.with_name(f.name + ".quarantined"))
+            atomic_write_bytes(f, data)
+            meta = dict(meta)
+            meta["fileCrc"] = crc
+            self.controller.store.set(f"/tables/{table}/segments/{name}", meta)
+            logging.getLogger("pinot_tpu.storage").warning(
+                "re-replicated corrupt deep-store copy of %s/%s from %s", table, name, sid
+            )
+            return True
+        return False
+
+    def process_table(self, table: str) -> dict:  # pragma: no cover - run_once overridden
+        raise NotImplementedError
+
+
 class ClusterMetricsAggregator(ControllerPeriodicTask):
     """Federated metrics scrape: pull every registered broker's and server's
     `/metrics?format=json` snapshot (plus `/debug/workload` rollups and the
@@ -573,6 +702,12 @@ class ClusterMetricsAggregator(ControllerPeriodicTask):
                 "freshnessBuckets": sample["freshnessBuckets"],
                 "tables": sample["tables"],
                 "exemplars": sample["exemplars"],
+                # integrity-scrubber feed: unrepairable corruption fires the
+                # scrubUnrepairable objective (the scrubber runs in this
+                # process, so the controller registry is the source of truth)
+                "scrubUnrepairable": int(
+                    controller_metrics().meter("storage.scrub.unrepairable").count
+                ),
             }
         )
         if transitions:

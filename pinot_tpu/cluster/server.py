@@ -24,14 +24,24 @@ _query_seq = itertools.count()
 
 
 class Server:
-    def __init__(self, server_id: str, fast32: bool = False, scheduler=None):
+    def __init__(self, server_id: str, fast32: bool = False, scheduler=None, data_dir=None):
         """`scheduler`: optional QueryScheduler instance, a
         common.config.SchedulerConfig, or a kind string
         ("fcfs" | "priority" | "binary_workload"). When set, execute_partials
         and multistage_submit route through it (QueryScheduler.submit
         parity) so server-side concurrency is bounded and queue overflow
         surfaces as SchedulerRejectedError (-> HTTP 503 + Retry-After);
-        None executes inline (the in-process test default)."""
+        None executes inline (the in-process test default).
+
+        `data_dir`: optional local segment directory (the server dataDir of
+        the reference). When set, add_segment DOWNLOADS each assigned
+        segment's file from the deep store into
+        `<data_dir>/<table>/<segment>/`, CRC-verifies the copy, and serves
+        from it — giving the integrity plane a real local artifact to
+        scrub, quarantine (`*.quarantined`), and self-heal (re-download
+        from deep store, then peer replicas via `peer_fetch`). When None,
+        segments load straight from the deep-store dir (the in-process
+        default; behavior unchanged)."""
         if scheduler is not None and not hasattr(scheduler, "submit"):
             from pinot_tpu.common.config import SchedulerConfig
 
@@ -55,6 +65,14 @@ class Server:
 
         self._fast32 = fast32
         self._scheduler = scheduler
+        self.data_dir = Path(data_dir) if data_dir else None
+        #: (table, segment) -> {"local": dir, "source": deep-store dir} for
+        #: every data-dir'd copy — the scrubber's work list
+        self._local_segs: dict[tuple[str, str], dict] = {}
+        self._scrub_cursor = 0
+        #: optional callable(table, segment) -> segment-file bytes | None,
+        #: the peer-replica fallback when local copy AND deep store are bad
+        self.peer_fetch = None
         if scheduler is not None:
             scheduler.start()
 
@@ -139,7 +157,13 @@ class Server:
                 self._pending_transitions -= 1
 
     def _add_segment_inner(self, table: str, segment_name: str, seg_dir: str | Path) -> None:
-        seg = load_segment(seg_dir)
+        from pinot_tpu.segment.store import SEGMENT_FILE
+
+        seg_dir = Path(seg_dir)
+        if self.data_dir is not None and (seg_dir / SEGMENT_FILE).exists():
+            seg = self._load_with_healing(table, segment_name, seg_dir)
+        else:
+            seg = load_segment(seg_dir)
         with self._lock:
             rt = self._realtime.get(table)
             if rt is not None and hasattr(rt, "on_segment_loaded"):
@@ -151,6 +175,191 @@ class Server:
             # engines are rebuilt lazily; drop the cached one
             self._engines.pop(table, None)
 
+    # -- storage integrity: local copies, quarantine, self-healing -----------
+
+    def _quarantine(self, path: Path) -> Path:
+        """Move a corrupt file aside as `<name>.quarantined` (never deleted:
+        the operator runbook inspects these) and meter the event."""
+        import logging
+        import os
+
+        from pinot_tpu.common.metrics import server_metrics
+
+        q = path.with_name(path.name + ".quarantined")
+        os.replace(path, q)
+        server_metrics().meter("storage.quarantined").mark()
+        logging.getLogger("pinot_tpu.storage").warning(
+            "server %s quarantined corrupt segment file %s -> %s",
+            self.server_id, path, q.name,
+        )
+        return q
+
+    def _fetch_verified(self, src: Path, local_dir: Path) -> None:
+        """Download (copy) a deep-store segment file into the local dir and
+        verify the landed copy; raises SegmentCorruptedError when the SOURCE
+        is bad (the landed bytes are quarantined, not left live)."""
+        from pinot_tpu.common.durability import atomic_write_bytes
+        from pinot_tpu.common.errors import SegmentCorruptedError
+        from pinot_tpu.segment.store import SEGMENT_FILE, verify_segment_file
+
+        local_dir.mkdir(parents=True, exist_ok=True)
+        data = (src / SEGMENT_FILE).read_bytes()
+        atomic_write_bytes(local_dir / SEGMENT_FILE, data)
+        try:
+            verify_segment_file(local_dir / SEGMENT_FILE)
+        except SegmentCorruptedError:
+            self._quarantine(local_dir / SEGMENT_FILE)
+            raise
+
+    def _register_local(self, table: str, name: str, local_dir: Path, source_dir: Path):
+        seg = load_segment(local_dir)
+        with self._lock:
+            self._local_segs[(table, name)] = {
+                "local": str(local_dir),
+                "source": str(source_dir),
+            }
+        return seg
+
+    def _load_with_healing(self, table: str, name: str, source_dir: Path):
+        """Load a segment via a verified local copy, self-healing corruption:
+        bad local copy -> quarantine + re-download from the deep store; bad
+        deep-store copy too -> peer-replica fallback (`peer_fetch`); only
+        when EVERY source is bad does the typed SegmentCorruptedError
+        surface to the caller."""
+        from pinot_tpu.common.durability import atomic_write_bytes
+        from pinot_tpu.common.errors import SegmentCorruptedError
+        from pinot_tpu.common.metrics import server_metrics
+        from pinot_tpu.segment.store import (
+            SEGMENT_FILE,
+            verify_segment_bytes,
+            verify_segment_file,
+        )
+
+        m = server_metrics()
+        local_dir = self.data_dir / table / name
+        local_file = local_dir / SEGMENT_FILE
+        # 1. existing verified local copy
+        if local_file.exists():
+            try:
+                verify_segment_file(local_file)
+                return self._register_local(table, name, local_dir, source_dir)
+            except SegmentCorruptedError:
+                m.meter("storage.corruption.detected").mark()
+                self._quarantine(local_file)
+        # 2. (re-)download from the deep store, verified on landing
+        try:
+            self._fetch_verified(source_dir, local_dir)
+            return self._register_local(table, name, local_dir, source_dir)
+        except SegmentCorruptedError:
+            m.meter("storage.corruption.detected").mark()
+        # 3. peer-replica fallback
+        if self.peer_fetch is not None:
+            data = self.peer_fetch(table, name)
+            if data:
+                verify_segment_bytes(data, f"peer copy of {table}/{name}")
+                local_dir.mkdir(parents=True, exist_ok=True)
+                atomic_write_bytes(local_file, data)
+                m.meter("storage.repaired").mark()
+                return self._register_local(table, name, local_dir, source_dir)
+        raise SegmentCorruptedError(
+            f"segment {table}/{name}: local copy, deep store, and peer "
+            "replicas all failed integrity verification",
+            path=str(local_file),
+        )
+
+    def scrub(self, io_budget_bytes: int | None = None) -> dict:
+        """Incrementally CRC-verify this server's local segment copies,
+        healing what it can (quarantine + re-download + hot-swap the
+        in-memory segment). `io_budget_bytes` caps bytes read per call; the
+        cursor resumes where the last call stopped, so repeated small-budget
+        calls cover the full set (the scrubber's IO throttle)."""
+        from pinot_tpu.common.errors import SegmentCorruptedError
+        from pinot_tpu.common.metrics import server_metrics
+        from pinot_tpu.segment.store import SEGMENT_FILE, verify_segment_file
+
+        m = server_metrics()
+        out = {"verified": 0, "corrupted": 0, "repaired": 0, "unrepairable": 0, "bytesScanned": 0}
+        with self._lock:
+            items = sorted(self._local_segs.items())
+        if not items:
+            return out
+        start = self._scrub_cursor % len(items)
+        for (table, name), entry in items[start:] + items[:start]:
+            if io_budget_bytes is not None and out["bytesScanned"] >= io_budget_bytes:
+                break
+            self._scrub_cursor += 1
+            local_dir = Path(entry["local"])
+            f = local_dir / SEGMENT_FILE
+            try:
+                out["bytesScanned"] += f.stat().st_size
+            except OSError:
+                pass
+            try:
+                verify_segment_file(f)
+                out["verified"] += 1
+                m.meter("storage.scrub.verified").mark()
+                continue
+            except SegmentCorruptedError:
+                out["corrupted"] += 1
+                m.meter("storage.scrub.corrupted").mark()
+            try:
+                if f.exists():
+                    self._quarantine(f)
+                self._fetch_verified(Path(entry["source"]), local_dir)
+                seg = load_segment(local_dir)
+                with self._lock:
+                    self._tables.setdefault(table, {})[name] = seg
+                    self._engines.pop(table, None)
+                out["repaired"] += 1
+                m.meter("storage.scrub.repaired").mark()
+            except Exception:  # noqa: BLE001  # pinotlint: disable=deadline-swallow — scrub repair is best-effort; the unrepairable meter is the alert signal and queries keep serving the in-memory copy
+                out["unrepairable"] += 1
+                m.meter("storage.scrub.unrepairable").mark()
+        return out
+
+    def fetch_segment_file(self, table: str, segment_name: str) -> bytes | None:
+        """Serve this server's copy of a segment's file bytes (the
+        controller's peer-repair source for a corrupt deep-store copy),
+        verified before shipping so corruption never propagates. Falls back
+        to re-serializing the in-memory segment when there is no local file
+        (in-process servers without a data dir)."""
+        from pinot_tpu.common.errors import SegmentCorruptedError
+        from pinot_tpu.segment.store import SEGMENT_FILE, verify_segment_bytes
+
+        with self._lock:
+            entry = self._local_segs.get((table, segment_name))
+            seg = self._tables.get(table, {}).get(segment_name)
+        if entry is not None:
+            f = Path(entry["local"]) / SEGMENT_FILE
+            if f.exists():
+                data = f.read_bytes()
+                try:
+                    verify_segment_bytes(data, str(f))
+                    return data
+                except SegmentCorruptedError:
+                    pass  # fall through to re-serialization of the live copy
+        if seg is None:
+            return None
+        import tempfile
+
+        from pinot_tpu.segment.store import write_segment_file
+
+        with tempfile.TemporaryDirectory(prefix="pinot_tpu_fetch_") as td:
+            d = write_segment_file(seg, Path(td) / segment_name)
+            data = (d / SEGMENT_FILE).read_bytes()
+        verify_segment_bytes(data, f"re-serialized {table}/{segment_name}")
+        return data
+
+    def local_segment_report(self) -> dict:
+        """Local-copy + quarantine inventory for debug surfaces."""
+        with self._lock:
+            entries = {f"{t}/{n}": dict(e) for (t, n), e in sorted(self._local_segs.items())}
+        quarantined = []
+        if self.data_dir is not None and self.data_dir.exists():
+            quarantined = sorted(str(p) for p in self.data_dir.rglob("*.quarantined"))
+        return {"dataDir": str(self.data_dir) if self.data_dir else None,
+                "localSegments": entries, "quarantined": quarantined}
+
     def add_segment_object(self, table: str, seg: ImmutableSegment) -> None:
         with self._lock:
             self._tables.setdefault(table, {})[seg.name] = seg
@@ -160,6 +369,7 @@ class Server:
         with self._lock:
             self._tables.get(table, {}).pop(segment_name, None)
             self._engines.pop(table, None)
+            self._local_segs.pop((table, segment_name), None)
 
     def segments_of(self, table: str) -> list[str]:
         with self._lock:

@@ -39,6 +39,13 @@ class QueryErrorCode(enum.IntEnum):
     #: (TOO_MANY_REQUESTS_ERROR_CODE parity); travels as HTTP 429
     QUOTA_EXCEEDED = 429
 
+    #: a segment's on-disk bytes failed integrity verification (whole-file
+    #: or per-entry CRC mismatch, torn/truncated file) and every recovery
+    #: source — local copy, deep store, peer replicas — is also bad
+    #: (SEGMENT_MISSING/data-corruption parity). Rides in a 200
+    #: BrokerResponse as a partial-result exception entry.
+    SEGMENT_CORRUPTED = 260
+
 
 #: Error codes that map to a non-200 HTTP status at response boundaries.
 #: Everything else stays the BrokerResponse convention: HTTP 200 with the
@@ -48,6 +55,29 @@ _HTTP_STATUS_BY_CODE = {
     int(QueryErrorCode.SERVER_OUT_OF_CAPACITY): 503,
     int(QueryErrorCode.QUOTA_EXCEEDED): 429,
 }
+
+
+class SegmentCorruptedError(ValueError):
+    """A segment failed CRC/structural verification. Subclasses ValueError
+    (corrupt bytes are malformed values) so legacy callers that guard
+    segment decode with `except ValueError` keep working; carries
+    `error_code` so `code_of` maps it to `SEGMENT_CORRUPTED` at every
+    response boundary and `path` names the bad copy for quarantine
+    runbooks."""
+
+    error_code = QueryErrorCode.SEGMENT_CORRUPTED
+
+    def __init__(self, message: str, path: str | None = None):
+        super().__init__(message)
+        self.path = path
+
+
+class SegmentUploadError(OSError):
+    """A segment upload failed before any cluster metadata referenced it
+    (ENOSPC, crash, or the written bytes failing verification). The errno
+    of the underlying OSError is preserved — `e.errno == errno.ENOSPC`
+    is the disk-full contract — and the controller guarantees the deep
+    store holds no partial segment dir when this is raised."""
 
 
 def code_of(exc: BaseException, default: int = QueryErrorCode.QUERY_EXECUTION) -> int:

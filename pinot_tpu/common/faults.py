@@ -20,10 +20,21 @@ Well-known points (wired in this repo):
                        whole server looks dead, not one scatter call)
     rebalance.move   — rebalance_table, per segment move before the ADD step
     stream.lag       — PartitionConsumer batch fetch, consumer-lag simulation
+    storage.write    — common/durability.py atomic_write_bytes, before the
+                       tmp-file write; supports the disk fault modes below
+    storage.read     — SegmentFileReader open, after the file bytes are read
+
+Disk fault modes (storage points only): beyond "error"/"delay", a rule may
+declare mode "bitflip" (XOR one bit into the payload at `offset`),
+"truncate" (drop everything from `offset` on), "torn" (write the prefix
+up to `offset` then raise TornWriteFault — a SIGKILL mid-write), or
+"enospc" (raise OSError(ENOSPC)). Callers at storage points pass the
+payload through `maybe_fail(point, data=...)` and use the returned bytes.
 """
 
 from __future__ import annotations
 
+import errno
 import random
 import threading
 import time
@@ -48,6 +59,8 @@ FAULT_POINTS = frozenset(
         "server.crash",  # Server.execute_partials, whole-server hard-down
         "rebalance.move",  # rebalance_table, per segment move (before ADD)
         "stream.lag",  # PartitionConsumer batch fetch, consumer-lag delay
+        "storage.write",  # atomic_write_bytes, before the tmp-file write
+        "storage.read",  # SegmentFileReader open, after the bytes are read
     }
 )
 
@@ -58,13 +71,30 @@ class InjectedFault(ConnectionError):
     see exactly what a dead TCP peer produces)."""
 
 
+class TornWriteFault(InjectedFault):
+    """Raised by torn-mode rules at storage points: the writer already put
+    `offset` bytes of the payload on disk when the (simulated) SIGKILL hit.
+    `common/durability.py` persists exactly that prefix to the tmp file
+    before re-raising, so crash-consistency tests can kill a write at every
+    byte offset."""
+
+    def __init__(self, message: str, offset: int):
+        super().__init__(message)
+        self.offset = offset
+
+
+#: modes that need the payload bytes to act on (disk-corruption shapes)
+_DATA_MODES = frozenset({"bitflip", "truncate", "torn"})
+
+
 @dataclass
 class FaultRule:
-    mode: str = "error"  # "error" | "delay"
+    mode: str = "error"  # "error" | "delay" | "bitflip" | "truncate" | "torn" | "enospc"
     prob: float = 1.0  # probability each call through the point fires
     delay_s: float = 0.0  # sleep length for mode="delay"
     max_count: int | None = None  # stop firing after N triggers (None = forever)
     message: str = ""  # extra context for the raised error
+    offset: int | None = None  # byte offset for bitflip/truncate/torn (None = seeded draw)
 
     @staticmethod
     def from_dict(d: dict) -> "FaultRule":
@@ -74,6 +104,7 @@ class FaultRule:
             delay_s=float(d.get("delayS", d.get("delay_s", 0.0))),
             max_count=d.get("maxCount", d.get("max_count")),
             message=d.get("message", ""),
+            offset=d.get("offset"),
         )
 
 
@@ -110,23 +141,50 @@ class FaultInjector:
         with self._lock:
             return dict(self._counts)
 
-    def maybe_fail(self, point: str) -> None:
+    def maybe_fail(self, point: str, data: bytes | None = None) -> bytes | None:
+        """Fire the rule for `point`, if any. Storage call sites pass the
+        payload via `data` and use the return value: corruption modes
+        (bitflip/truncate) hand back a mutated copy; every other outcome
+        returns `data` unchanged (or None when no payload was given)."""
         if not self._rules:  # production fast path
-            return
+            return data
         with self._lock:
             rule = self._rules.get(point)
             if rule is None:
-                return
+                return data
             fired = self._counts.get(point, 0)
             if rule.max_count is not None and fired >= rule.max_count:
-                return
+                return data
+            if rule.mode in _DATA_MODES and data is None:
+                return data  # corruption modes only act where bytes flow
             if rule.prob < 1.0 and self._rng.random() >= rule.prob:
-                return
+                return data
             self._counts[point] = fired + 1
+            if rule.offset is not None:
+                off = int(rule.offset)
+            else:
+                off = self._rng.randrange(len(data)) if data else 0
         if rule.mode == "delay":
             time.sleep(rule.delay_s)
-            return
+            return data
         detail = f": {rule.message}" if rule.message else ""
+        if rule.mode == "bitflip":
+            if not data:
+                return data
+            off = min(off, len(data) - 1)
+            mutated = bytearray(data)
+            mutated[off] ^= 1 << (off % 8)
+            return bytes(mutated)
+        if rule.mode == "truncate":
+            return data[: min(off, len(data))]
+        if rule.mode == "torn":
+            raise TornWriteFault(
+                f"injected torn write at {point} offset {off}{detail}", offset=off
+            )
+        if rule.mode == "enospc":
+            raise OSError(
+                errno.ENOSPC, f"injected ENOSPC at {point}{detail}"
+            )
         raise InjectedFault(f"injected fault at {point}{detail}")
 
 

@@ -36,6 +36,9 @@ DEFAULT_OBJECTIVES = {
     "availability": 0.999,
     "p99LatencyMs": None,  # disabled unless configured
     "freshnessP99Ms": None,  # event-to-queryable p99 target; disabled unless set
+    # unrepairable-corruption budget per short window (integrity scrubber
+    # feed); any count above this fires — data loss is never acceptable
+    "scrubUnrepairable": 0,
     "burnRateThreshold": 1.0,
     "shortWindowS": 300.0,
     "longWindowS": 3600.0,
@@ -149,6 +152,9 @@ class SloEvaluator:
             "errors": errors,
             "buckets": _delta_buckets("latencyBuckets"),
             "freshnessBuckets": _delta_buckets("freshnessBuckets"),
+            "scrubUnrepairable": max(
+                0, int(c.get("scrubUnrepairable") or 0) - int(b.get("scrubUnrepairable") or 0)
+            ),
         }
 
     @staticmethod
@@ -206,6 +212,23 @@ class SloEvaluator:
                     clear=(ps <= float(p99_target)), now=now,
                     measured={"p99ShortMs": ps, "p99LongMs": pl,
                               "targetMs": float(p99_target)},
+                )
+
+            scrub_budget = obj.get("scrubUnrepairable")
+            if scrub_budget is not None and table is None:
+                # a discrete data-loss event, not a rate: the short window
+                # alone both fires and clears (clears once the window rolls
+                # past the incident — resolution means "no NEW unrepairable
+                # corruption", the lost copy itself needs the runbook)
+                n = short["scrubUnrepairable"]
+                scope_status["scrubUnrepairable"] = {
+                    "budget": int(scrub_budget), "shortWindowCount": n,
+                }
+                transitions += self._transition(
+                    "scrubUnrepairable", table,
+                    firing=(n > int(scrub_budget)),
+                    clear=(n <= int(scrub_budget)), now=now,
+                    measured={"shortWindowCount": n, "budget": int(scrub_budget)},
                 )
 
             fresh_target = obj.get("freshnessP99Ms")

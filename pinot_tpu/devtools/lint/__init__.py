@@ -1,10 +1,10 @@
 """pinotlint: project-invariant static analyzer for pinot_tpu.
 
-Nine AST checkers enforce the conventions the engine's correctness actually
+Ten AST checkers enforce the conventions the engine's correctness actually
 rests on — race discipline, jit purity, deadline/cancellation coverage, the
 error-code registry, the fault-point registry, fault-point span-event
 coverage on the query path, lock-order cycles, blocking calls made while a
-lock is held, and resource leaks. The concurrency family (race-discipline,
+lock is held, resource leaks, and atomic writes to durable artifacts. The concurrency family (race-discipline,
 lock-order, blocking-under-lock) is whole-program: all three share one
 call-graph + lock-summary build per run (`core.AnalysisSession`). See
 README.md in this directory and the module docstrings for exact rules.
@@ -15,6 +15,7 @@ Usage (code):  from pinot_tpu.devtools.lint import lint_paths
 
 from __future__ import annotations
 
+from pinot_tpu.devtools.lint.atomic_write import AtomicWriteChecker
 from pinot_tpu.devtools.lint.concurrency import BlockingUnderLockChecker, LockOrderChecker
 from pinot_tpu.devtools.lint.core import Checker, Finding, run
 from pinot_tpu.devtools.lint.deadlines import DeadlineChecker
@@ -36,6 +37,7 @@ ALL_CHECKERS: dict[str, type[Checker]] = {
     "lock-order": LockOrderChecker,
     "blocking-under-lock": BlockingUnderLockChecker,
     "resource-leak": ResourceLeakChecker,
+    "atomic-write": AtomicWriteChecker,
 }
 
 

@@ -163,9 +163,13 @@ class LocalFS(PinotFS):
         return _local_path(uri).read_bytes()
 
     def write_bytes(self, uri: str, data: bytes) -> None:
+        from pinot_tpu.common.durability import atomic_write_bytes
+
         p = _local_path(uri)
         p.parent.mkdir(parents=True, exist_ok=True)
-        p.write_bytes(data)
+        # crash mid-write must leave the previous object or none, never a
+        # torn one (object stores give this for free; match it locally)
+        atomic_write_bytes(p, data)
 
 
 class MemFS(PinotFS):

@@ -18,6 +18,7 @@ Encoding decisions (parity with IndexingConfig semantics):
 
 from __future__ import annotations
 
+import io
 import json
 from pathlib import Path
 from typing import Any, Mapping, Sequence
@@ -25,6 +26,7 @@ from typing import Any, Mapping, Sequence
 import numpy as np
 
 from pinot_tpu.common.config import TableConfig
+from pinot_tpu.common.durability import atomic_write_bytes, atomic_write_text
 from pinot_tpu.common.types import DataType, FieldType, Schema
 from pinot_tpu.segment.dictionary import Dictionary
 from pinot_tpu.segment.segment import ColumnIndex, ImmutableSegment
@@ -295,7 +297,11 @@ def _write_segment_npz(seg: ImmutableSegment, out_dir: str | Path) -> Path:
         aux_meta["range"].append(col)
     if seg.extras.get("__custom_indexes__"):
         aux_meta["custom"] = seg.extras["__custom_indexes__"]
-    np.savez(seg_dir / "columns.npz", **arrays)
+    # serialize the archive to memory then land it via the atomic-write
+    # helper: a crash mid-save must not leave a torn columns.npz behind
+    buf = io.BytesIO()
+    np.savez(buf, **arrays)
+    atomic_write_bytes(seg_dir / "columns.npz", buf.getvalue())
     meta = {
         "formatVersion": FORMAT_VERSION,
         "segmentName": seg.name,
@@ -305,5 +311,5 @@ def _write_segment_npz(seg: ImmutableSegment, out_dir: str | Path) -> Path:
         "starTrees": star_meta,
         "auxIndexes": aux_meta,
     }
-    (seg_dir / "metadata.json").write_text(json.dumps(meta, indent=1))
+    atomic_write_text(seg_dir / "metadata.json", json.dumps(meta, indent=1))
     return seg_dir

@@ -2,18 +2,35 @@
 
 Reference parity: Pinot V3 segment format — one `columns.psf` with an index
 map of (column, indexType) -> (offset, size) entries plus
-`metadata.properties` (SegmentDirectory / SingleFileIndexDirectory.java:88).
-Here: one file holding back-to-back encoded entries, a JSON index map at the
-tail, and a fixed footer. Per-entry CRC32 gives integrity; dict-id forward
-indexes are fixed-bit packed and chunks are LZ4-compressed via the native C++
-kernels (pinot_tpu/native) exactly where the reference leans on
-FixedBitSVForwardIndexReaderV2 + ChunkCompressionType.LZ4.
+`metadata.properties` (SegmentDirectory / SingleFileIndexDirectory.java:88),
+with the segment CRC recorded in ZK metadata and validated on load/download
+(ImmutableSegmentLoader + SegmentFetcher retry tier). Here: one file holding
+back-to-back encoded entries, a JSON index map at the tail, and a fixed
+footer. Integrity is two-level: a per-entry CRC32 (checked lazily on each
+entry decode) pinpoints WHICH index is damaged, and a whole-file CRC32 in
+the v03 footer — covering every byte before the footer: header magic, entry
+blobs, and index JSON — is checked once at open and is what the controller
+records in the segment's ZK metadata (`fileCrc`) at upload/commit time, so
+a downloader or the integrity scrubber can verify a copy against cluster
+truth without trusting the file's own footer. Any mismatch raises the typed
+`SegmentCorruptedError` (code SEGMENT_CORRUPTED), which the server's
+self-healing path catches to quarantine + re-fetch. Writes are
+crash-consistent: `finish` funnels the whole image through
+`common/durability.py` (tmp + fsync + rename), so a torn segment file can
+only ever be a tmp sibling. Dict-id forward indexes are fixed-bit packed
+and chunks are LZ4-compressed via the native C++ kernels (pinot_tpu/native)
+exactly where the reference leans on FixedBitSVForwardIndexReaderV2 +
+ChunkCompressionType.LZ4.
 
-Layout:
-    magic "PTSEGv02"
+Layout (v03, written by this module):
+    magic "PTSEGv03"
     entry blobs (back-to-back, 8-byte aligned)
     index-map JSON (utf-8)
-    footer: uint64 index_off, uint64 index_len, magic "PTSEGv02"
+    footer: uint64 index_off, uint64 index_len,
+            uint32 file_crc (CRC32 of all preceding bytes), magic "PTSEGv03"
+
+Legacy v02 files (24-byte footer, no whole-file CRC) still load; they get
+structural + per-entry verification only.
 
 Entry kinds:
     arr  — numeric ndarray: dtype + shape, codec raw|lz4
@@ -29,9 +46,15 @@ from pathlib import Path
 import numpy as np
 
 from pinot_tpu import native
+from pinot_tpu.common.durability import atomic_write_bytes
+from pinot_tpu.common.errors import SegmentCorruptedError
+from pinot_tpu.common.faults import FAULTS
 
-MAGIC = b"PTSEGv02"
+MAGIC = b"PTSEGv03"
+MAGIC_V2 = b"PTSEGv02"
 SEGMENT_FILE = "segment.ptseg"
+#: v03 footer: u64 index_off + u64 index_len + u32 file_crc + 8-byte magic
+FOOTER_V3 = 8 + 8 + 4 + len(MAGIC)
 
 
 import os
@@ -95,15 +118,17 @@ class SegmentFileWriter:
         meta = dict(meta)
         meta["entries"] = self._entries
         index = json.dumps(meta).encode("utf-8")
-        with open(path, "wb") as f:
-            f.write(MAGIC)
-            for b in self._blobs:
-                f.write(b)
-            index_off = self._pos
-            f.write(index)
-            f.write(
-                np.asarray([index_off, len(index)], dtype="<u8").tobytes() + MAGIC
-            )
+        index_off = self._pos
+        image = bytearray(MAGIC)
+        for b in self._blobs:
+            image += b
+        image += index
+        file_crc = native.crc32(bytes(image))
+        image += np.asarray([index_off, len(index)], dtype="<u8").tobytes()
+        image += np.asarray([file_crc], dtype="<u4").tobytes()
+        image += MAGIC
+        # tmp + fsync + rename: a crash mid-write leaves no torn .ptseg
+        atomic_write_bytes(path, bytes(image))
 
 
 def write_segment_file(seg, seg_dir: Path) -> Path:
@@ -207,29 +232,47 @@ def write_segment_file(seg, seg_dir: Path) -> Path:
 
 
 class SegmentFileReader:
-    """Reads a .ptseg file; entries decode lazily on access."""
+    """Reads a .ptseg file; entries decode lazily on access. The v03
+    whole-file CRC is verified once at open (`verify=False` skips it for
+    callers that already checked the bytes against ZK metadata); structural
+    or CRC damage raises the typed SegmentCorruptedError."""
 
-    def __init__(self, path: Path):
+    def __init__(self, path: Path, verify: bool = True):
         self.path = Path(path)
-        self._buf = np.fromfile(self.path, dtype=np.uint8)
+        raw = self.path.read_bytes()
+        raw = FAULTS.maybe_fail("storage.read", raw)
         nm = len(MAGIC)
-        if (
-            len(self._buf) < 2 * nm + 16
-            or self._buf[:nm].tobytes() != MAGIC
-            or self._buf[-nm:].tobytes() != MAGIC
-        ):
-            raise ValueError(f"{path}: not a PTSEG file")
-        index_off, index_len = np.frombuffer(self._buf[-nm - 16 : -nm].tobytes(), dtype="<u8")
-        self.meta = json.loads(
-            self._buf[int(index_off) : int(index_off) + int(index_len)].tobytes().decode("utf-8")
-        )
-        self.entries = self.meta["entries"]
+        head, tail = raw[:nm], raw[-nm:]
+        if len(raw) < 2 * nm + 16 or head not in (MAGIC, MAGIC_V2) or tail != head:
+            raise SegmentCorruptedError(f"{path}: not a PTSEG file", path=str(path))
+        if tail == MAGIC:  # v03: verify whole file against the footer CRC
+            self.file_crc = int(np.frombuffer(raw[-nm - 4 : -nm], dtype="<u4")[0])
+            if verify and native.crc32(raw[:-FOOTER_V3]) != self.file_crc:
+                raise SegmentCorruptedError(
+                    f"{path}: whole-file CRC mismatch", path=str(path)
+                )
+            index_off, index_len = np.frombuffer(raw[-FOOTER_V3 : -nm - 4], dtype="<u8")
+        else:  # legacy v02: structural checks + per-entry CRCs only
+            self.file_crc = None
+            index_off, index_len = np.frombuffer(raw[-nm - 16 : -nm], dtype="<u8")
+        self._buf = np.frombuffer(raw, dtype=np.uint8)
+        try:
+            self.meta = json.loads(
+                raw[int(index_off) : int(index_off) + int(index_len)].decode("utf-8")
+            )
+            self.entries = self.meta["entries"]
+        except (UnicodeDecodeError, json.JSONDecodeError, KeyError) as e:
+            raise SegmentCorruptedError(
+                f"{path}: damaged index map ({e})", path=str(path)
+            ) from e
 
     def _raw_bytes(self, e: dict) -> bytes:
         stored = self._buf[e["off"] : e["off"] + e["stored"]].tobytes()
         raw = native.chunk_decompress(stored, e["raw"], e["codec"])
         if native.crc32(raw) != e["crc"]:
-            raise ValueError(f"{self.path}: CRC mismatch on entry")
+            raise SegmentCorruptedError(
+                f"{self.path}: CRC mismatch on entry", path=str(self.path)
+            )
         return raw
 
     def keys(self):
@@ -260,3 +303,60 @@ class SegmentFileReader:
                     pos += l
             return out
         raise AssertionError(e["kind"])
+
+
+def segment_file_crc(path: Path | str) -> int | None:
+    """Stored whole-file CRC from a segment file's v03 footer — a 28-byte
+    tail read, no full-file IO — or None for legacy v02 files. This is the
+    value the controller records as `fileCrc` in ZK segment metadata."""
+    path = Path(path)
+    if path.is_dir():
+        path = path / SEGMENT_FILE
+    nm = len(MAGIC)
+    with open(path, "rb") as f:
+        size = f.seek(0, 2)
+        if size < FOOTER_V3:
+            return None
+        f.seek(size - FOOTER_V3)
+        foot = f.read(FOOTER_V3)
+    if foot[-nm:] != MAGIC:
+        return None
+    return int(np.frombuffer(foot[16:20], dtype="<u4")[0])
+
+
+def verify_segment_bytes(raw: bytes, label: str = "<bytes>", expected_crc: int | None = None) -> int:
+    """Integrity-check a segment-file image in memory: structural magic
+    checks, whole-file CRC against the v03 footer, and (optionally) the
+    `fileCrc` recorded in ZK segment metadata — which catches a footer
+    damaged/forged in concert with the payload. Returns the verified CRC;
+    raises SegmentCorruptedError on any mismatch. Legacy v02 images get
+    structural verification only and return a CRC over the entire image as
+    their fingerprint."""
+    nm = len(MAGIC)
+    head, tail = raw[:nm], raw[-nm:]
+    if len(raw) < 2 * nm + 16 or head not in (MAGIC, MAGIC_V2) or tail != head:
+        raise SegmentCorruptedError(f"{label}: not a PTSEG file", path=label)
+    if tail == MAGIC_V2:
+        return native.crc32(raw)
+    stored = int(np.frombuffer(raw[-nm - 4 : -nm], dtype="<u4")[0])
+    if native.crc32(raw[:-FOOTER_V3]) != stored:
+        raise SegmentCorruptedError(f"{label}: whole-file CRC mismatch", path=label)
+    if expected_crc is not None and stored != expected_crc:
+        raise SegmentCorruptedError(
+            f"{label}: CRC {stored} != cluster metadata fileCrc {expected_crc}",
+            path=label,
+        )
+    return stored
+
+
+def verify_segment_file(path: Path | str, expected_crc: int | None = None) -> int:
+    """Full-file integrity check of an on-disk segment file (or segment
+    dir); see verify_segment_bytes for the verification contract."""
+    path = Path(path)
+    if path.is_dir():
+        path = path / SEGMENT_FILE
+    try:
+        raw = path.read_bytes()
+    except OSError as e:
+        raise SegmentCorruptedError(f"{path}: unreadable ({e})", path=str(path)) from e
+    return verify_segment_bytes(raw, str(path), expected_crc)

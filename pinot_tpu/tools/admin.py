@@ -65,10 +65,15 @@ def cmd_start_controller(args) -> dict:
         objectives = (
             json.loads(args.slo_json) if getattr(args, "slo_json", "") else None
         )
+        from pinot_tpu.cluster.periodic import IntegrityScrubber
+
         agg = ClusterMetricsAggregator(controller, objectives=objectives)
         agg.interval_sec = args.metrics_interval
+        scrubber = IntegrityScrubber(controller)
+        scrubber.interval_sec = args.scrub_interval
         sched = PeriodicTaskScheduler(controller=controller)
         sched.register(agg)
+        sched.register(scrubber)
         sched.start()
         handles["periodic_scheduler"] = sched
     print(f"controller listening on http://127.0.0.1:{svc.port}", flush=True)
@@ -85,7 +90,11 @@ def cmd_start_server(args) -> dict:
         if args.scheduler
         else None
     )
-    server = Server(args.server_id, scheduler=scheduler)
+    server = Server(
+        args.server_id,
+        scheduler=scheduler,
+        data_dir=getattr(args, "data_dir", None) or None,
+    )
     svc = ServerHTTPService(server, port=args.port)
     RemoteControllerClient(args.controller_url).register_instance(
         "server", args.server_id, "127.0.0.1", svc.port
@@ -539,6 +548,12 @@ def build_parser() -> argparse.ArgumentParser:
     )
     c.add_argument("--metrics-interval", type=float, default=10.0)
     c.add_argument(
+        "--scrub-interval",
+        type=float,
+        default=30.0,
+        help="IntegrityScrubber period in seconds (with --with-periodics)",
+    )
+    c.add_argument(
         "--slo-json",
         default="",
         help='SLO objectives as camelCase JSON, e.g. \'{"freshnessP99Ms": 2000}\'',
@@ -551,6 +566,12 @@ def build_parser() -> argparse.ArgumentParser:
     s.add_argument("--port", type=int, default=0)
     s.add_argument("--scheduler", default="", help="fcfs|priority|binary_workload (default: none)")
     s.add_argument("--runners", type=int, default=4)
+    s.add_argument(
+        "--data-dir",
+        default="",
+        help="local segment dir: download deep-store segments here, verify "
+        "CRCs, self-heal corrupted copies (empty: serve deep store directly)",
+    )
     s.set_defaults(fn=cmd_start_server, blocking=True)
 
     b = sub.add_parser("StartBroker")

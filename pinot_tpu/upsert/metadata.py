@@ -209,9 +209,13 @@ class PartitionUpsertMetadataManager:
                     for pk, loc in self._map.items()
                 ],
             }
+        from pinot_tpu.common.durability import atomic_write_json
+
         p = Path(path)
         p.parent.mkdir(parents=True, exist_ok=True)
-        p.write_text(json.dumps(state))
+        # a crash mid-snapshot must leave the previous snapshot readable,
+        # not a torn JSON doc that poisons the next restore
+        atomic_write_json(p, state)
 
     def restore(self, path: str | Path) -> None:
         state = json.loads(Path(path).read_text())

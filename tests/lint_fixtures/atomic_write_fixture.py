@@ -1,0 +1,38 @@
+"""Golden fixture for the atomic-write checker: direct writes to durable
+artifacts (*.doc.json / *.ptseg / metadata.json) versus the sanctioned
+durability helpers and writes to paths the rule does not cover."""
+
+import json
+from pathlib import Path
+
+from pinot_tpu.common.durability import atomic_write_bytes, atomic_write_json
+
+root = Path("/tmp/fixture")
+doc = {"k": 1}
+
+
+def torn_doc_write():
+    (root / "node.doc.json").write_text(json.dumps(doc))  # line 15: VIOLATION write_text
+
+
+def torn_segment_write(image: bytes):
+    (root / "segment.ptseg").write_bytes(image)  # line 19: VIOLATION write_bytes
+
+
+def torn_meta_dump():
+    with open(root / "metadata.json", "w") as f:  # line 23: VIOLATION open for write
+        json.dump(doc, f)
+
+
+def clean_atomic_writes(image: bytes):
+    atomic_write_json(root / "node.doc.json", doc)  # CLEAN: sanctioned helper
+    atomic_write_bytes(root / "segment.ptseg", image)  # CLEAN: sanctioned helper
+
+
+def clean_reads_and_other_paths():
+    open(root / "metadata.json").read()  # CLEAN: read mode
+    (root / "notes.txt").write_text("hi")  # CLEAN: not a durable artifact
+
+
+def suppressed():
+    (root / "torn.ptseg").write_bytes(b"x")  # pinotlint: disable=atomic-write — fixture: deliberately torn test file

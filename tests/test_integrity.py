@@ -1,0 +1,447 @@
+"""Storage-fault tolerance suite: crash-consistent writes, corruption
+self-healing, and the integrity scrubber.
+
+The torn-write property tests kill a write at EVERY byte offset (via the
+seeded `storage.write` torn rule, which persists exactly the pre-kill prefix
+to the tmp file before raising) and assert the durable artifact always reads
+back as the old version or the new one — never a torn hybrid. The healing
+tests corrupt real bytes on disk and walk the full recovery chain: local
+quarantine -> deep-store re-download -> peer-replica fallback -> typed
+SEGMENT_CORRUPTED surfacing only when every source is bad."""
+
+import errno
+import json
+import os
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from pinot_tpu.cluster import Controller, PropertyStore, Server
+from pinot_tpu.common import DataType, Schema, TableConfig
+from pinot_tpu.common.durability import atomic_write_bytes
+from pinot_tpu.common.errors import (
+    QueryErrorCode,
+    SegmentCorruptedError,
+    SegmentUploadError,
+    code_of,
+)
+from pinot_tpu.common.faults import FAULTS, TornWriteFault
+from pinot_tpu.common.metrics import server_metrics
+from pinot_tpu.segment import SegmentBuilder
+from pinot_tpu.segment.loader import load_segment
+from pinot_tpu.segment.store import (
+    SEGMENT_FILE,
+    segment_file_crc,
+    verify_segment_file,
+)
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    FAULTS.reset()
+    yield
+    FAULTS.reset()
+
+
+def _schema(name="orders"):
+    return Schema.build(
+        name,
+        dimensions=[("region", DataType.STRING)],
+        metrics=[("amount", DataType.LONG)],
+    )
+
+
+def _segment(schema, name="orders_0", seed=7, n=40):
+    rng = np.random.default_rng(seed)
+    data = {
+        "region": np.array(["EU", "US", "APAC"], dtype=object)[rng.integers(0, 3, n)],
+        "amount": rng.integers(1, 1000, n).astype(np.int64),
+    }
+    return SegmentBuilder(schema).build(data, name)
+
+
+def _flip_bit(path: Path, offset: int = None) -> None:
+    """In-place single-bit corruption, the disk-rot shape scrubbers exist for."""
+    raw = bytearray(path.read_bytes())
+    off = (len(raw) // 2) if offset is None else offset
+    raw[off] ^= 0x10
+    path.write_bytes(bytes(raw))  # deliberate torn-unsafe write: simulating rot
+
+
+def _cluster(tmp_path, n_servers=2, replication=2, data_dirs=True):
+    """Controller + in-process servers with local data dirs, one uploaded
+    segment, replication 2 — the minimal self-healing topology."""
+    store = PropertyStore(tmp_path / "zk")
+    controller = Controller(store, tmp_path / "deepstore")
+    servers = {}
+    for i in range(n_servers):
+        sid = f"server_{i}"
+        servers[sid] = Server(sid, data_dir=(tmp_path / f"data_{i}") if data_dirs else None)
+        controller.register_server(sid, servers[sid])
+    schema = _schema()
+    controller.add_schema(schema)
+    controller.add_table(TableConfig("orders", replication=replication))
+    seg = _segment(schema)
+    controller.upload_segment("orders", seg)
+    return controller, servers, seg
+
+
+# ---------------------------------------------------------------------------
+# crash consistency: kill the write at every byte offset
+# ---------------------------------------------------------------------------
+
+
+def test_property_store_torn_write_every_offset(tmp_path):
+    root = tmp_path / "zk"
+    store = PropertyStore(root)
+    old = {"v": 0, "who": "before"}
+    new = {"v": 1, "who": "after", "pad": "x" * 32}
+    store.set("/tables/t/segments/s", old)
+    payload = json.dumps(new).encode("utf-8")
+    for off in range(len(payload) + 1):
+        FAULTS.configure({"storage.write": {"mode": "torn", "offset": off}})
+        with pytest.raises(TornWriteFault):
+            store.set("/tables/t/segments/s", new)
+        FAULTS.reset()
+        # "restart": a fresh PropertyStore re-reads the directory
+        recovered = PropertyStore(root)
+        assert recovered.get("/tables/t/segments/s") == old, f"torn at offset {off}"
+        # tmp leftovers never pollute the document listing
+        assert recovered.list("/tables/t/segments") == ["/tables/t/segments/s"]
+    store.set("/tables/t/segments/s", new)
+    assert PropertyStore(root).get("/tables/t/segments/s") == new
+
+
+def test_segment_file_torn_write_every_offset(tmp_path):
+    schema = _schema()
+    seg_dir = tmp_path / "seg"
+    from pinot_tpu.segment.store import write_segment_file
+
+    write_segment_file(_segment(schema, seed=1, n=8), seg_dir)
+    f = seg_dir / SEGMENT_FILE
+    old_crc = verify_segment_file(f)
+    new_image = (
+        write_segment_file(_segment(schema, seed=2, n=8), tmp_path / "v2") / SEGMENT_FILE
+    ).read_bytes()
+    # kill an overwrite of the live segment file at every byte offset
+    for off in range(0, len(new_image) + 1, 7):  # stride keeps runtime sane
+        FAULTS.configure({"storage.write": {"mode": "torn", "offset": off}})
+        with pytest.raises(TornWriteFault):
+            atomic_write_bytes(f, new_image)
+        FAULTS.reset()
+        assert verify_segment_file(f) == old_crc, f"torn at offset {off}"
+        assert load_segment(seg_dir).n_docs == 8
+    atomic_write_bytes(f, new_image)
+    assert verify_segment_file(f) != old_crc  # the real write landed whole
+
+
+def test_torn_write_via_segment_builder_commit(tmp_path):
+    """The builder's finish() path rides the same helper: a kill mid-commit
+    leaves no .ptseg at all (fresh write) rather than a torn one."""
+    from pinot_tpu.segment.store import write_segment_file
+
+    FAULTS.configure({"storage.write": {"mode": "torn", "offset": 100}})
+    with pytest.raises(TornWriteFault):
+        write_segment_file(_segment(_schema(), seed=3, n=8), tmp_path / "seg")
+    FAULTS.reset()
+    assert not (tmp_path / "seg" / SEGMENT_FILE).exists()
+
+
+# ---------------------------------------------------------------------------
+# corruption detection + self-healing chain
+# ---------------------------------------------------------------------------
+
+
+def test_upload_records_file_crc_in_metadata(tmp_path):
+    controller, servers, seg = _cluster(tmp_path)
+    meta = controller.segment_metadata("orders", seg.name)
+    assert meta["fileCrc"] == segment_file_crc(Path(meta["location"]))
+    # deep-store copy passes verification against the recorded CRC
+    verify_segment_file(Path(meta["location"]), expected_crc=meta["fileCrc"])
+
+
+def test_corrupt_local_copy_quarantined_and_redownloaded(tmp_path):
+    controller, servers, seg = _cluster(tmp_path)
+    sid, server = next(iter(servers.items()))
+    local = server.data_dir / "orders" / seg.name / SEGMENT_FILE
+    assert local.exists()
+    _flip_bit(local)
+    with pytest.raises(SegmentCorruptedError):
+        verify_segment_file(local)
+    meta = controller.segment_metadata("orders", seg.name)
+    before = server_metrics().meter("storage.corruption.detected").count
+    server.add_segment("orders", seg.name, meta["location"])  # reload heals
+    assert server_metrics().meter("storage.corruption.detected").count == before + 1
+    # corrupt copy kept aside for the runbook; fresh verified copy serves
+    assert local.with_name(local.name + ".quarantined").exists()
+    verify_segment_file(local)
+    assert server.segments_of("orders") == [seg.name]
+
+
+def test_peer_fallback_when_deep_store_also_bad(tmp_path):
+    controller, servers, seg = _cluster(tmp_path)
+    server = servers["server_0"]
+    good_bytes = (servers["server_1"].data_dir / "orders" / seg.name / SEGMENT_FILE).read_bytes()
+    meta = controller.segment_metadata("orders", seg.name)
+    _flip_bit(server.data_dir / "orders" / seg.name / SEGMENT_FILE)
+    _flip_bit(Path(meta["location"]) / SEGMENT_FILE)
+    calls = []
+
+    def peer_fetch(table, name):
+        calls.append((table, name))
+        return good_bytes
+
+    server.peer_fetch = peer_fetch
+    before = server_metrics().meter("storage.repaired").count
+    server.add_segment("orders", seg.name, meta["location"])
+    assert calls == [("orders", seg.name)]
+    assert server_metrics().meter("storage.repaired").count == before + 1
+    verify_segment_file(server.data_dir / "orders" / seg.name / SEGMENT_FILE)
+
+
+def test_every_source_bad_surfaces_typed_error(tmp_path):
+    controller, servers, seg = _cluster(tmp_path)
+    server = servers["server_0"]
+    meta = controller.segment_metadata("orders", seg.name)
+    _flip_bit(server.data_dir / "orders" / seg.name / SEGMENT_FILE)
+    _flip_bit(Path(meta["location"]) / SEGMENT_FILE)
+    server.peer_fetch = lambda table, name: None
+    with pytest.raises(SegmentCorruptedError) as ei:
+        server.add_segment("orders", seg.name, meta["location"])
+    assert code_of(ei.value) == QueryErrorCode.SEGMENT_CORRUPTED == 260
+    assert ei.value.path  # names the bad copy for the runbook
+
+
+def test_segment_corrupted_code_crosses_http_hop(tmp_path):
+    from pinot_tpu.cluster.http import ServerHTTPService
+
+    controller, servers, seg = _cluster(tmp_path)
+    server = servers["server_0"]
+    meta = controller.segment_metadata("orders", seg.name)
+    _flip_bit(server.data_dir / "orders" / seg.name / SEGMENT_FILE)
+    _flip_bit(Path(meta["location"]) / SEGMENT_FILE)
+    svc = ServerHTTPService(server, port=0)
+    try:
+        import urllib.error
+        import urllib.request
+
+        body = json.dumps(
+            {"table": "orders", "segment": seg.name, "dir": meta["location"]}
+        ).encode()
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{svc.port}/segments/add",
+            data=body,
+            headers={"Content-Type": "application/json"},
+        )
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(req)
+        doc = json.loads(ei.value.read())
+        assert doc["errorCode"] == 260  # typed code survives the wire
+    finally:
+        svc.stop()
+
+
+# ---------------------------------------------------------------------------
+# the scrubber: server sweep, deep-store sweep, IO budget
+# ---------------------------------------------------------------------------
+
+
+def test_server_scrub_detects_and_repairs(tmp_path):
+    controller, servers, seg = _cluster(tmp_path)
+    server = servers["server_0"]
+    out = server.scrub()
+    assert out["verified"] == 1 and out["corrupted"] == 0
+    local = server.data_dir / "orders" / seg.name / SEGMENT_FILE
+    _flip_bit(local)
+    out = server.scrub()
+    assert out == {**out, "corrupted": 1, "repaired": 1, "unrepairable": 0}
+    assert local.with_name(local.name + ".quarantined").exists()
+    verify_segment_file(local)
+    # repaired copy was hot-swapped: queries keep answering
+    assert server.segments_of("orders") == [seg.name]
+
+
+def test_server_scrub_io_budget_and_cursor(tmp_path):
+    store = PropertyStore(tmp_path / "zk")
+    controller = Controller(store, tmp_path / "deepstore")
+    server = Server("server_0", data_dir=tmp_path / "data")
+    controller.register_server("server_0", server)
+    schema = _schema()
+    controller.add_schema(schema)
+    controller.add_table(TableConfig("orders", replication=1))
+    for i in range(4):
+        controller.upload_segment("orders", _segment(schema, f"orders_{i}", seed=i))
+    # a 1-byte budget verifies exactly one segment per call; the cursor
+    # rotates so four calls achieve full coverage (the IO throttle contract)
+    seen = 0
+    for _ in range(4):
+        out = server.scrub(io_budget_bytes=1)
+        assert out["verified"] == 1
+        seen += out["verified"]
+    assert seen == 4
+    assert server.scrub()["verified"] == 4  # unbudgeted: everything in one pass
+
+
+def test_controller_scrubber_repairs_deep_store_from_replica(tmp_path):
+    from pinot_tpu.cluster.periodic import IntegrityScrubber
+
+    controller, servers, seg = _cluster(tmp_path)
+    meta = controller.segment_metadata("orders", seg.name)
+    deep = Path(meta["location"]) / SEGMENT_FILE
+    _flip_bit(deep)
+    scrubber = IntegrityScrubber(controller)
+    out = scrubber.run_once()
+    assert out["corrupted"] == 1 and out["repaired"] == 1 and out["unrepairable"] == 0
+    # bad deep-store copy kept aside; replacement passes CRC against the
+    # refreshed fileCrc in cluster metadata
+    assert deep.with_name(deep.name + ".quarantined").exists()
+    meta2 = controller.segment_metadata("orders", seg.name)
+    verify_segment_file(deep, expected_crc=meta2["fileCrc"])
+    # healthy store: next sweep is all-verified
+    out = scrubber.run_once()
+    assert out["corrupted"] == 0 and out["verified"] >= 1
+
+
+def test_scrubber_unrepairable_feeds_slo_plane(tmp_path):
+    """No healthy replica: the scrubber meters unrepairable and the SLO
+    evaluator fires the scrubUnrepairable objective on the next sample."""
+    from pinot_tpu.cluster.periodic import IntegrityScrubber
+    from pinot_tpu.common.slo import SloEvaluator
+
+    controller, servers, seg = _cluster(tmp_path, n_servers=1, replication=1)
+    meta = controller.segment_metadata("orders", seg.name)
+    _flip_bit(Path(meta["location"]) / SEGMENT_FILE)
+    # drop the only replica: no repair source remains anywhere
+    servers["server_0"].remove_segment("orders", seg.name)
+    out = IntegrityScrubber(controller).run_once()
+    assert out["corrupted"] == 1 and out["unrepairable"] == 1
+
+    clock = [1000.0]
+    ev = SloEvaluator(now_fn=lambda: clock[0])
+    base = {"queries": 100, "errors": 0, "latencyBuckets": [],
+            "freshnessBuckets": [], "tables": {}, "exemplars": []}
+    ev.observe({**base, "scrubUnrepairable": 0})
+    clock[0] += 10
+    transitions = ev.observe({**base, "scrubUnrepairable": 1})
+    fired = [t for t in transitions if t["slo"] == "scrubUnrepairable"]
+    assert fired and fired[0]["state"] == "firing"
+
+
+# ---------------------------------------------------------------------------
+# upload ordering + disk fault injection
+# ---------------------------------------------------------------------------
+
+
+def test_upload_enospc_is_typed_and_leaves_no_partial_dir(tmp_path):
+    store = PropertyStore(tmp_path / "zk")
+    controller = Controller(store, tmp_path / "deepstore")
+    controller.register_server("server_0", Server("server_0"))
+    schema = _schema()
+    controller.add_schema(schema)
+    controller.add_table(TableConfig("orders", replication=1))
+    FAULTS.configure({"storage.write": {"mode": "enospc"}})
+    with pytest.raises(SegmentUploadError) as ei:
+        controller.upload_segment("orders", _segment(schema))
+    assert ei.value.errno == errno.ENOSPC
+    FAULTS.reset()
+    # no partial deep-store dir, no metadata, no idealstate entry
+    assert not (tmp_path / "deepstore" / "orders").exists()
+    assert controller.segment_metadata("orders", "orders_0") is None
+    assert controller.ideal_state("orders") == {}
+    # disk back: the same upload now goes through cleanly
+    controller.upload_segment("orders", _segment(schema))
+    assert "orders_0" in controller.ideal_state("orders")
+
+
+def test_crash_between_write_and_assign_leaves_no_partial_dir(tmp_path):
+    """A torn write inside write_segment aborts the upload before any
+    metadata references the dir — and the dir itself is cleaned up."""
+    store = PropertyStore(tmp_path / "zk")
+    controller = Controller(store, tmp_path / "deepstore")
+    controller.register_server("server_0", Server("server_0"))
+    schema = _schema()
+    controller.add_schema(schema)
+    controller.add_table(TableConfig("orders", replication=1))
+    FAULTS.configure({"storage.write": {"mode": "torn", "offset": 64}})
+    with pytest.raises(SegmentUploadError):
+        controller.upload_segment("orders", _segment(schema))
+    FAULTS.reset()
+    assert not (tmp_path / "deepstore" / "orders").exists()
+
+
+def test_storage_read_bitflip_surfaces_typed_error(tmp_path):
+    seg_dir = tmp_path / "seg"
+    from pinot_tpu.segment.store import write_segment_file
+
+    write_segment_file(_segment(_schema(), seed=5, n=8), seg_dir)
+    FAULTS.configure({"storage.read": {"mode": "bitflip", "offset": 40}})
+    with pytest.raises(SegmentCorruptedError) as ei:
+        load_segment(seg_dir)
+    assert code_of(ei.value) == 260
+    FAULTS.reset()
+    assert load_segment(seg_dir).n_docs == 8  # the file itself was never touched
+
+
+def test_debug_faults_endpoint_arms_storage_points(tmp_path):
+    from pinot_tpu.cluster.http import ServerHTTPService
+
+    server = Server("server_0")
+    svc = ServerHTTPService(server, port=0)
+    try:
+        import urllib.request
+
+        body = json.dumps(
+            {"points": {"storage.read": {"mode": "bitflip", "offset": 3},
+                        "storage.write": {"mode": "enospc"}}}
+        ).encode()
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{svc.port}/debug/faults",
+            data=body,
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req) as resp:
+            doc = json.loads(resp.read())
+        assert doc["armed"] == ["storage.read", "storage.write"]
+        assert FAULTS.enabled
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{svc.port}/debug/faults"
+        ) as resp:
+            assert json.loads(resp.read())["enabled"] is True
+    finally:
+        svc.stop()
+
+
+# ---------------------------------------------------------------------------
+# remote scrub + peer fetch over HTTP
+# ---------------------------------------------------------------------------
+
+
+def test_remote_scrub_and_fetch_segment_file(tmp_path):
+    from pinot_tpu.cluster.http import RemoteServerClient, ServerHTTPService
+
+    controller, servers, seg = _cluster(tmp_path, n_servers=1, replication=1)
+    server = servers["server_0"]
+    svc = ServerHTTPService(server, port=0)
+    try:
+        remote = RemoteServerClient(f"http://127.0.0.1:{svc.port}")
+        out = remote.scrub(io_budget_bytes=10**9)
+        assert out["verified"] == 1
+        data = remote.fetch_segment_file("orders", seg.name)
+        local = server.data_dir / "orders" / seg.name / SEGMENT_FILE
+        assert data == local.read_bytes()
+        assert remote.fetch_segment_file("orders", "no_such_segment") is None
+    finally:
+        svc.stop()
+
+
+def test_local_segment_report_lists_quarantined(tmp_path):
+    controller, servers, seg = _cluster(tmp_path, n_servers=1, replication=1)
+    server = servers["server_0"]
+    local = server.data_dir / "orders" / seg.name / SEGMENT_FILE
+    _flip_bit(local)
+    server.scrub()  # quarantine + repair
+    report = server.local_segment_report()
+    assert report["dataDir"] == str(server.data_dir)
+    assert f"orders/{seg.name}" in report["localSegments"]
+    assert any(p.endswith(".quarantined") for p in report["quarantined"])

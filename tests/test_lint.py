@@ -96,6 +96,18 @@ def test_span_checker_ignores_off_query_path():
     assert fs == []
 
 
+def test_atomic_write_fixture_findings():
+    fs = findings_for("atomic_write_fixture.py", checks=["atomic-write"])
+    assert lines_of(fs, "atomic-write") == [15, 19, 23]
+    assert all("durability.atomic_write" in f.message for f in fs)
+
+
+def test_atomic_write_exempts_durability_module():
+    # the helper module itself is the one sanctioned direct writer
+    durability = os.path.join(REPO, "pinot_tpu", "common", "durability.py")
+    assert lint_paths([durability], checks=["atomic-write"]) == []
+
+
 # ---------------------------------------------------------------------------
 # v2 whole-program checkers: lock-order, blocking-under-lock, resource-leak
 # ---------------------------------------------------------------------------
