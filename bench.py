@@ -1662,8 +1662,108 @@ def _bench_config5(rng, n, iters):
     return _bench_pair("config5 star-tree+HLL", dev, cpu, iters, check)
 
 
+def roofline_main():
+    """--roofline: cross-check GET /debug/roofline against bench's own
+    measured device_ms split (the drift gate CI runs).
+
+    Bench first times the packed dispatch+sync loop with kernel_obs DISABLED
+    — its own wall-minus-RTT split, the `_bench_pair` arithmetic — then
+    re-runs the identical loop with kernel_obs enabled and fetches
+    /debug/roofline from a live ServerHTTPService. The two per-process
+    device-ms totals must agree within 10% (plus a small absolute floor so
+    the CPU tier, where both sides sit at ~0 ms, stays deterministic)."""
+    import urllib.request
+
+    from pinot_tpu.cluster.http import ServerHTTPService
+    from pinot_tpu.cluster.server import Server
+    from pinot_tpu.common import DataType, Schema
+    from pinot_tpu.common.kernel_obs import KERNELS
+    from pinot_tpu.query.engine import QueryEngine
+    from pinot_tpu.query.kernels import dispatch_plan_packed
+    from pinot_tpu.query.plan import plan_segment
+    from pinot_tpu.segment import SegmentBuilder
+
+    n, iters = 200_000, 30
+    rng = np.random.default_rng(7)
+    schema = Schema.build(
+        "t", dimensions=[("d", DataType.INT)], metrics=[("v", DataType.LONG)]
+    )
+    seg = SegmentBuilder(schema).build(
+        {
+            "d": rng.integers(0, 50, n).astype(np.int32),
+            "v": rng.integers(0, 1000, n).astype(np.int64),
+        },
+        "t_0",
+    )
+    eng = QueryEngine([seg])
+    ctx = eng.make_context("SELECT d, SUM(v), COUNT(*) FROM t GROUP BY d")
+    plan = plan_segment(seg, ctx)
+    dseg = eng._device_seg(seg)
+
+    def one():
+        return dispatch_plan_packed(plan, dseg)()
+
+    one()  # compile
+    one()
+    rtt_ms = _link_rtt_ms() or 0.0
+
+    # 1) bench's own split: kernel_obs disabled, plain wall minus RTT
+    KERNELS.configure(enabled=False)
+    bench_dev_ms = 0.0
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        one()
+        bench_dev_ms += max((time.perf_counter() - t0) * 1e3 - rtt_ms, 0.0)
+
+    # 2) the instrumented split: same loop, kernel_obs enabled
+    KERNELS.configure(enabled=True)
+    KERNELS.reset_stats()
+    for _ in range(iters):
+        one()
+
+    svc = ServerHTTPService(Server("bench-roofline"), port=0)
+    try:
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{svc.port}/debug/roofline", timeout=10
+        ) as resp:
+            doc = json.loads(resp.read())
+    finally:
+        svc.stop()
+    endpoint_dev_ms = sum(k["deviceMs"] for k in doc["kernels"])
+    calls = sum(k["calls"] for k in doc["kernels"])
+
+    # 10% relative, with an absolute floor covering timer noise at ~0 ms
+    tol_ms = max(0.10 * bench_dev_ms, 1.0 + 0.05 * iters)
+    drift_ms = abs(endpoint_dev_ms - bench_dev_ms)
+    ok = calls >= iters and drift_ms <= tol_ms
+    log(
+        f"[roofline] bench={bench_dev_ms:.3f}ms endpoint={endpoint_dev_ms:.3f}ms "
+        f"drift={drift_ms:.3f}ms tol={tol_ms:.3f}ms calls={calls} ok={ok}"
+    )
+    print(
+        json.dumps(
+            {
+                "metric": "roofline_drift",
+                "bench_device_ms": round(bench_dev_ms, 3),
+                "endpoint_device_ms": round(endpoint_dev_ms, 3),
+                "drift_ms": round(drift_ms, 3),
+                "tolerance_ms": round(tol_ms, 3),
+                "link_rtt_ms": round(rtt_ms, 3),
+                "calls": calls,
+                "hbm": doc.get("hbm"),
+                "kernels": doc["kernels"],
+                "ok": ok,
+            }
+        )
+    )
+    sys.exit(0 if ok else 1)
+
+
 if __name__ == "__main__":
     try:
+        if "--roofline" in sys.argv[1:]:
+            roofline_main()
+            sys.exit(0)
         if len(sys.argv) > 1 and sys.argv[1] == "qps":
             if "--overload" in sys.argv[2:]:
                 qps_overload_main()

@@ -1058,6 +1058,66 @@ def bench_lint_runtime():
     }
 
 
+def bench_kernel_obs_overhead(n=300_000):
+    """Kernel-observability cost on the single-stage hot path: the same
+    packed group-by dispatch with the KernelRegistry disabled vs enabled.
+    Enabled adds one perf_counter pair, a dict fold, three metric updates
+    and an accountant sample per kernel invocation; disabled is a single
+    attribute check, timed directly like the trace/deadline guards."""
+    from pinot_tpu.common import DataType, Schema
+    from pinot_tpu.common.kernel_obs import KERNELS
+    from pinot_tpu.query.engine import QueryEngine
+    from pinot_tpu.segment import SegmentBuilder
+
+    rng = np.random.default_rng(29)
+    schema = Schema.build("t", dimensions=[("d", DataType.INT)], metrics=[("v", DataType.LONG)])
+    seg = SegmentBuilder(schema).build(
+        {"d": rng.integers(0, 64, n).astype(np.int32), "v": rng.integers(0, 1000, n).astype(np.int64)},
+        "t_0",
+    )
+    eng = QueryEngine([seg])
+    q = "SELECT d, SUM(v), COUNT(*) FROM t GROUP BY d"
+    eng.execute(q)  # compile
+
+    KERNELS.configure(enabled=False)
+    try:
+        off_ms = _time_host(lambda: eng.execute(q), iters=9)
+    finally:
+        KERNELS.configure(enabled=True)
+    KERNELS.reset_stats()
+    on_ms = _time_host(lambda: eng.execute(q), iters=9)
+    assert KERNELS.total_device_ms() >= 0.0 and KERNELS.stats_snapshot()
+
+    # Direct measure of the disabled guard: one `self._enabled` check plus
+    # the lambda call. A query crosses a handful of timed_sync sites; even
+    # projected at 1000 the share of the query wall must stay inside 2%.
+    calls = 100_000
+    KERNELS.configure(enabled=False)
+    try:
+        t0 = time.perf_counter()
+        for _ in range(calls):
+            KERNELS.timed_sync("query.fused", lambda: None)
+        per_call_us = (time.perf_counter() - t0) / calls * 1e6
+    finally:
+        KERNELS.configure(enabled=True)
+    projected_pct = per_call_us * 1000 / (off_ms * 1e3) * 100
+    assert projected_pct < 2.0, (
+        f"disabled timed_sync {per_call_us:.2f}µs x1000 = {projected_pct:.2f}% of "
+        f"{off_ms:.1f}ms query — over the 2% hot-loop budget"
+    )
+    return {
+        "metric": "kernel_obs_overhead",
+        "value": round(on_ms - off_ms, 3),
+        "unit": "ms",
+        "n": n,
+        "off_ms": round(off_ms, 3),
+        "on_ms": round(on_ms, 3),
+        "overhead_pct": round((on_ms / off_ms - 1.0) * 100, 1),
+        "disabled_guard_us": round(per_call_us, 4),
+        "projected_pct_at_1000_sites": round(projected_pct, 3),
+    }
+
+
 ALL = [
     bench_filter_mask,
     bench_grouped_sum_xla,
@@ -1082,6 +1142,7 @@ ALL = [
     bench_aggregator_scrape,
     bench_atomic_write_overhead,
     bench_scrub_overhead,
+    bench_kernel_obs_overhead,
     bench_lint_runtime,
 ]
 

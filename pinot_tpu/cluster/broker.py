@@ -140,6 +140,15 @@ class Broker:
         )
         self.query_logger = query_logger
         self.obs_config = obs_config if obs_config is not None else ObservabilityConfig()
+        # kernel_obs is process-global (kernels register at import time);
+        # the broker is where ObservabilityConfig enters the process, so it
+        # applies the deployment's knobs here
+        from pinot_tpu.common.kernel_obs import KERNELS
+
+        KERNELS.configure(
+            enabled=self.obs_config.kernel_obs_enabled,
+            hbm_peak_gbps=self.obs_config.hbm_peak_gbps,
+        )
         if self.obs_config.profiler_enabled:
             from pinot_tpu.common.profiler import maybe_start_profiler
 
@@ -526,6 +535,8 @@ class Broker:
         import json
         import logging
 
+        from pinot_tpu.common.accounting import default_accountant
+
         entry = {
             "sql": sql,
             "table": table,
@@ -539,6 +550,12 @@ class Broker:
             # SLO exemplars carry the request id so a firing alert can be
             # attributed back to the query while it is still in flight
             entry["queryId"] = qid
+            # device-vs-host split (kernel_obs): the servers re-publish their
+            # per-request device ms / peak HBM under the broker's query id
+            st = default_accountant.recent_query_stats(qid)
+            if st is not None:
+                entry["deviceMs"] = st.get("deviceMs", 0.0)
+                entry["peakHbmBytes"] = st.get("peakHbmBytes", 0)
         if result.trace_id:
             # exemplar: join the slow-query log entry to /debug/traces/{id}
             entry["traceId"] = result.trace_id

@@ -605,6 +605,20 @@ class ServerHTTPService:
                     _serve_pprof(self)
                 elif self.path == "/debug/workload":
                     _serve_workload(self)
+                elif self.path.partition("?")[0] == "/debug/roofline":
+                    # per-(kernel, shape-bucket) achieved GB/s vs configured
+                    # peak + HBM live/peak (common/kernel_obs.py); ?top=N
+                    # bounds the offender list
+                    from pinot_tpu.common.kernel_obs import KERNELS
+
+                    from urllib.parse import parse_qs
+
+                    qs = parse_qs(self.path.partition("?")[2])
+                    try:
+                        top = int(qs.get("top", ["10"])[0])
+                    except ValueError:
+                        top = 10
+                    _send_json(self, KERNELS.roofline(top=top))
                 elif self.path == "/debug/admission":
                     # live scheduler state (server role): queue depths,
                     # in-flight counts, per-group tokens

@@ -384,10 +384,17 @@ class ClusterMetricsAggregator(ControllerPeriodicTask):
                     slow = json.loads(self.fetch(f"{base}/debug/slowQueries")) or []
                 except Exception:  # noqa: BLE001  # pinotlint: disable=deadline-swallow — exemplars are best-effort garnish on the scrape
                     slow = []
-            return {"ok": True, "snapshot": snap, "workload": workload, "slow": slow, "error": None}
+            roofline = []
+            if ep["role"] == "server":
+                try:
+                    roofline = (json.loads(self.fetch(f"{base}/debug/roofline")) or {}).get("kernels") or []
+                except Exception:  # noqa: BLE001  # pinotlint: disable=deadline-swallow — optional surface; a node without /debug/roofline still contributes metrics
+                    roofline = []
+            return {"ok": True, "snapshot": snap, "workload": workload, "slow": slow,
+                    "roofline": roofline, "error": None}
         except Exception as e:  # noqa: BLE001  # pinotlint: disable=deadline-swallow — the federated scrape must never raise: a down/malformed node marks its series stale and the sweep continues
             return {"ok": False, "snapshot": None, "workload": [], "slow": [],
-                    "error": f"{type(e).__name__}: {e}"}
+                    "roofline": [], "error": f"{type(e).__name__}: {e}"}
 
     # -- fold -----------------------------------------------------------------
 
@@ -400,6 +407,10 @@ class ClusterMetricsAggregator(ControllerPeriodicTask):
             "rawCounters": {}, "rawBuckets": {}, "rawTimer": {}, "rawWorkload": {},
             "accCounters": defaultdict(int), "accBuckets": {}, "accTimer": {},
             "accWorkload": {},
+            # latest per-(kernel, shape) roofline rows from /debug/roofline —
+            # the endpoint reports process-lifetime totals, so the newest
+            # snapshot IS the accumulation (no delta fold)
+            "roofline": [],
         }
 
     @staticmethod
@@ -438,6 +449,8 @@ class ClusterMetricsAggregator(ControllerPeriodicTask):
                 k: int(r.get(k) or 0)
                 for k in ("queries", "cpuTimeNs", "allocatedBytes", "segmentsExecuted", "queriesKilled")
             }
+            workload[wkey]["deviceMs"] = float(r.get("deviceMs") or 0.0)
+            workload[wkey]["peakHbmBytes"] = int(r.get("peakHbmBytes") or 0)
 
         restarted = (
             any(v < st["rawCounters"].get(k, 0) for k, v in counters.items())
@@ -468,7 +481,12 @@ class ClusterMetricsAggregator(ControllerPeriodicTask):
             acc = st["accWorkload"].setdefault(k, defaultdict(int))
             prev = {} if restarted else st["rawWorkload"].get(k, {})
             for f, v in w.items():
-                acc[f] += max(0, v - prev.get(f, 0))
+                if f == "peakHbmBytes":
+                    # high-watermark, not a counter: fold with max
+                    acc[f] = max(acc[f], v)
+                else:
+                    acc[f] += max(0, v - prev.get(f, 0))
+        st["roofline"] = res.get("roofline") or st["roofline"]
 
         st["rawCounters"], st["rawBuckets"] = counters, buckets
         st["rawTimer"], st["rawWorkload"] = timers, workload
@@ -575,7 +593,10 @@ class ClusterMetricsAggregator(ControllerPeriodicTask):
             for (tenant, table), acc in s["accWorkload"].items():
                 agg = workload.setdefault((tenant, table), defaultdict(int))
                 for f, v in acc.items():
-                    agg[f] += v
+                    if f == "peakHbmBytes":
+                        agg[f] = max(agg[f], v)
+                    else:
+                        agg[f] += v
         prev = self._last_sample
         elapsed_s = max(1e-3, (now_ms - prev["tsMs"]) / 1000.0) if prev else None
         rates = {}
@@ -742,6 +763,49 @@ class ClusterMetricsAggregator(ControllerPeriodicTask):
                 }
             sample = self._last_sample
             rates = dict(self._table_rates)
+            # merge per-server roofline rows by (kernel, shape-bucket):
+            # calls/ms/bytes/flops sum across servers; achieved bandwidth and
+            # the gap are recomputed from the merged totals
+            roof: dict[tuple[str, str], dict] = {}
+            for s in self._nodes.values():
+                for r in s.get("roofline") or []:
+                    key = (r.get("kernel") or "", r.get("shape") or "")
+                    agg = roof.setdefault(
+                        key, {"calls": 0, "deviceMs": 0.0, "bytesMoved": 0, "flops": 0}
+                    )
+                    agg["calls"] += int(r.get("calls") or 0)
+                    agg["deviceMs"] += float(r.get("deviceMs") or 0.0)
+                    agg["bytesMoved"] += int(r.get("bytesMoved") or 0)
+                    agg["flops"] += int(r.get("flops") or 0)
+        from pinot_tpu.common.kernel_obs import KERNELS
+
+        peak_gbps = KERNELS.hbm_peak_gbps
+        roofline_rows = []
+        for (kernel, shape), agg in sorted(roof.items()):
+            dev_s = agg["deviceMs"] / 1e3
+            achieved = (agg["bytesMoved"] / dev_s / 1e9) if dev_s > 0 else 0.0
+            pct = (100.0 * achieved / peak_gbps) if peak_gbps > 0 else 0.0
+            roofline_rows.append(
+                {
+                    "kernel": kernel,
+                    "shape": shape,
+                    "calls": agg["calls"],
+                    "deviceMs": round(agg["deviceMs"], 3),
+                    "bytesMoved": agg["bytesMoved"],
+                    "flops": agg["flops"],
+                    "achievedGBps": round(achieved, 3),
+                    "arithmeticIntensity": (
+                        round(agg["flops"] / agg["bytesMoved"], 4) if agg["bytesMoved"] else 0.0
+                    ),
+                    "pctOfPeak": round(pct, 3),
+                    "rooflineGap": round(peak_gbps / achieved, 1) if achieved > 0 else None,
+                    "lostMs": round(agg["deviceMs"] * max(1.0 - pct / 100.0, 0.0), 3),
+                }
+            )
+        roofline_offenders = sorted(
+            (r for r in roofline_rows if r["rooflineGap"] is not None),
+            key=lambda r: -r["lostMs"],
+        )[:10]
         by_qps = sorted(rates.items(), key=lambda kv: -kv[1].get("qps", 0.0))[:10]
         by_cpu = sorted(rates.items(), key=lambda kv: -kv[1].get("cpuTimeNs", 0))[:10]
         doc = {
@@ -767,6 +831,11 @@ class ClusterMetricsAggregator(ControllerPeriodicTask):
                 },
                 "hedge": dict(sample.get("hedge") or {"issued": 0, "won": 0, "wasted": 0}),
                 "workload": sample.get("workload", {}),
+                "roofline": {
+                    "hbmPeakGBps": peak_gbps,
+                    "kernels": roofline_rows,
+                    "offenders": roofline_offenders,
+                },
             },
             "rebalance": _rebalance_progress(),
             "topTables": {

@@ -721,6 +721,13 @@ class Server:
             partials, matched = run_traced(local_tr, body) if local_tr is not None else body()
         finally:
             self._unregister_query(broker_qid)
+            if broker_qid and broker_qid != qid:
+                # re-publish this request's device split under the broker's
+                # query id so the broker-side slow-query log can stamp it
+                # (scatter fan-out merges: ms sum, HBM max)
+                st = default_accountant.recent_query_stats(qid)
+                if st is not None:
+                    default_accountant.merge_recent(broker_qid, st)
         m.meter(ServerMeter.NUM_DOCS_SCANNED).mark(matched)
         total = sum(s.n_docs for s in segs)
         if local_tr is not None:

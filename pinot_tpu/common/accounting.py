@@ -42,6 +42,10 @@ class QueryResourceTracker:
     #: names + the tenant tag of PerQueryCPUMemAccountant); "" = unattributed
     table: str = ""
     tenant: str = ""
+    #: device-side split (kernel_obs): accelerator ms spent on this query's
+    #: kernels and the largest modeled HBM footprint any of them touched
+    device_ms: float = 0.0
+    peak_hbm_bytes: int = 0
 
     def to_dict(self) -> dict:
         d = {
@@ -49,6 +53,8 @@ class QueryResourceTracker:
             "cpuTimeNs": self.cpu_ns,
             "allocatedBytes": self.allocated_bytes,
             "segmentsExecuted": self.segments_executed,
+            "deviceMs": round(self.device_ms, 3),
+            "peakHbmBytes": self.peak_hbm_bytes,
             "ageSec": round(time.time() - self.start_ts, 3),
             "killed": self.killed,
         }
@@ -72,6 +78,9 @@ class WorkloadRollup:
     allocated_bytes: int = 0
     segments_executed: int = 0
     queries_killed: int = 0
+    #: device split: summed accelerator ms; max single-query HBM footprint
+    device_ms: float = 0.0
+    peak_hbm_bytes: int = 0
 
     def to_dict(self) -> dict:
         return {
@@ -82,6 +91,8 @@ class WorkloadRollup:
             "allocatedBytes": self.allocated_bytes,
             "segmentsExecuted": self.segments_executed,
             "queriesKilled": self.queries_killed,
+            "deviceMs": round(self.device_ms, 3),
+            "peakHbmBytes": self.peak_hbm_bytes,
         }
 
 
@@ -106,6 +117,10 @@ class ResourceAccountant:
         self._threads: dict[int, str] = {}
         #: (tenant, table) -> lifetime rollup; survives unregister
         self._rollups: dict[tuple[str, str], WorkloadRollup] = {}
+        #: query id -> {"deviceMs", "peakHbmBytes"} for recently finished
+        #: queries (bounded, insertion-ordered) so the broker can stamp the
+        #: device split into slow-query log entries after the tracker is gone
+        self._recent: dict[str, dict] = {}
         self._lock = threading.Lock()
 
     # -- query lifecycle ----------------------------------------------------
@@ -135,6 +150,43 @@ class ResourceAccountant:
                 r.allocated_bytes += tr.allocated_bytes
                 r.segments_executed += tr.segments_executed
                 r.queries_killed += 1 if tr.killed else 0
+                r.device_ms += tr.device_ms
+                r.peak_hbm_bytes = max(r.peak_hbm_bytes, tr.peak_hbm_bytes)
+                self._note_recent_locked(
+                    query_id,
+                    {"deviceMs": round(tr.device_ms, 3), "peakHbmBytes": tr.peak_hbm_bytes},
+                )
+
+    _RECENT_MAX = 256
+
+    def _note_recent_locked(self, query_id: str, stats: dict) -> None:
+        self._recent[query_id] = stats
+        while len(self._recent) > self._RECENT_MAX:
+            self._recent.pop(next(iter(self._recent)))
+
+    def merge_recent(self, query_id: str, stats: dict) -> None:
+        """Alias a finished query's device stats under another id (the server
+        re-publishes its per-request totals under the broker's query id so
+        the broker-side slow-query log can find them; scatter fan-out merges
+        by summing ms and maxing HBM)."""
+        with self._lock:
+            cur = self._recent.get(query_id)
+            if cur is None:
+                self._note_recent_locked(query_id, dict(stats))
+            else:
+                cur["deviceMs"] = round(cur.get("deviceMs", 0.0) + stats.get("deviceMs", 0.0), 3)
+                cur["peakHbmBytes"] = max(
+                    cur.get("peakHbmBytes", 0), stats.get("peakHbmBytes", 0)
+                )
+
+    def recent_query_stats(self, query_id: str) -> dict | None:
+        """Device split for an in-flight or recently finished query id."""
+        with self._lock:
+            tr = self._queries.get(query_id)
+            if tr is not None:
+                return {"deviceMs": round(tr.device_ms, 3), "peakHbmBytes": tr.peak_hbm_bytes}
+            st = self._recent.get(query_id)
+            return dict(st) if st is not None else None
 
     # -- thread attribution (read by common/profiler.py) --------------------
 
@@ -208,7 +260,7 @@ class ResourceAccountant:
 
     # -- sampling (called by worker threads) --------------------------------
 
-    def sample(self, query_id: str | None = None, cpu_ns: int = 0, allocated_bytes: int = 0, segments: int = 0) -> None:
+    def sample(self, query_id: str | None = None, cpu_ns: int = 0, allocated_bytes: int = 0, segments: int = 0, device_ms: float = 0.0, hbm_bytes: int = 0) -> None:
         qid = query_id or _current_query.get()
         if qid is None:
             return
@@ -219,6 +271,8 @@ class ResourceAccountant:
             tr.cpu_ns += cpu_ns
             tr.allocated_bytes += allocated_bytes
             tr.segments_executed += segments
+            tr.device_ms += device_ms
+            tr.peak_hbm_bytes = max(tr.peak_hbm_bytes, hbm_bytes)
         self._enforce()
 
     def checkpoint(self, query_id: str | None = None) -> None:
@@ -284,7 +338,8 @@ class ResourceAccountant:
         with self._lock:
             merged: dict[tuple[str, str], WorkloadRollup] = {
                 k: WorkloadRollup(r.tenant, r.table, r.queries, r.cpu_ns,
-                                  r.allocated_bytes, r.segments_executed, r.queries_killed)
+                                  r.allocated_bytes, r.segments_executed, r.queries_killed,
+                                  r.device_ms, r.peak_hbm_bytes)
                 for k, r in self._rollups.items()
             }
             if include_inflight:
@@ -298,12 +353,15 @@ class ResourceAccountant:
                     r.allocated_bytes += tr.allocated_bytes
                     r.segments_executed += tr.segments_executed
                     r.queries_killed += 1 if tr.killed else 0
+                    r.device_ms += tr.device_ms
+                    r.peak_hbm_bytes = max(r.peak_hbm_bytes, tr.peak_hbm_bytes)
         return [r.to_dict() for r in sorted(merged.values(), key=lambda r: -r.cpu_ns)]
 
     def reset_rollups(self) -> None:
         """Test hook."""
         with self._lock:
             self._rollups.clear()
+            self._recent.clear()
 
 
 # default process-wide accountant (no limits => tracking only)

@@ -50,6 +50,14 @@ class ObservabilityConfig:
     #: and "tables": {table: {same keys}} per-table overrides. Empty dict =
     #: defaults (availability 99.9%, latency objective off).
     slo_objectives: dict = field(default_factory=dict)
+    #: per-kernel device-time attribution + HBM accounting (common/
+    #: kernel_obs.py). On by default: the disabled guard only matters when a
+    #: deployment wants the last fraction of a percent back.
+    kernel_obs_enabled: bool = True
+    #: HBM peak bandwidth (GB/s) the roofline report compares achieved
+    #: bandwidth against. Default is v5e-class HBM; a config number rather
+    #: than a probed one so CPU tier-1 roofline output stays deterministic.
+    hbm_peak_gbps: float = 819.0
 
     def to_dict(self) -> dict:
         return {
@@ -61,6 +69,8 @@ class ObservabilityConfig:
             "profilerHz": self.profiler_hz,
             "profilerRingMaxStacks": self.profiler_ring_max_stacks,
             "sloObjectives": dict(self.slo_objectives),
+            "kernelObsEnabled": self.kernel_obs_enabled,
+            "hbmPeakGBps": self.hbm_peak_gbps,
         }
 
     @staticmethod
@@ -74,6 +84,8 @@ class ObservabilityConfig:
             d.get("profilerHz", 31.0),
             d.get("profilerRingMaxStacks", 2048),
             dict(d.get("sloObjectives", {})),
+            d.get("kernelObsEnabled", True),
+            d.get("hbmPeakGBps", 819.0),
         )
 
 

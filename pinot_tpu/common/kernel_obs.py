@@ -1,0 +1,441 @@
+"""Kernel & memory observability plane: per-kernel device-time attribution,
+HBM accounting, and roofline analytics.
+
+The rest of the observability stack (traces, the 31 Hz profiler, the cluster
+hub) stops at the host boundary: it measures wall time. This module is the
+device-side counterpart — the TPU-native equivalent of the reference's
+per-operator `Tracing` SPI / `ExecutionStatistics` accounting:
+
+- `KernelRegistry`: every jitted / pallas root registers under a stable name
+  with a bytes-moved / FLOPs cost model. Invocations are timed device-side
+  (`block_until_ready` fencing with the memoized `devlink.link_profile()`
+  RTT subtracted, the same split `bench.py` computes) and folded into
+  labelled `engine.kernel.*{kernel=,shape=}` Timer/Meter families, per-query
+  device-ms + peak-HBM totals in the accountant, and `kernel.execute` span
+  events on the active trace.
+- HBM accounting: live/peak bytes from `device.memory_stats()` when the
+  backend exposes it, else a deterministic host-side estimator so CPU
+  tier-1 sees the same math the TPU path uses.
+- `roofline()`: per-(kernel, shape-bucket) achieved GB/s vs. the configured
+  peak (`ObservabilityConfig.hbm_peak_gbps`), arithmetic intensity, and the
+  top roofline-gap offenders — served as `GET /debug/roofline` and merged
+  into the controller's `/debug/cluster`.
+
+Shape labels are power-of-two buckets, never raw shapes, so metric label
+cardinality stays bounded no matter what the workload looks like.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Callable
+
+from pinot_tpu.common.accounting import default_accountant
+from pinot_tpu.common.metrics import server_metrics
+from pinot_tpu.common.trace import ServerQueryPhase, active_trace, trace_event
+
+#: default HBM peak bandwidth assumed for roofline math when the deployment
+#: doesn't configure one (TPU v5e-class HBM; override with
+#: `ObservabilityConfig.hbm_peak_gbps`). Deliberately a config number, not a
+#: probed one, so CPU tier-1 roofline output is deterministic.
+DEFAULT_HBM_PEAK_GBPS = 819.0
+
+# -- shape buckets ----------------------------------------------------------
+
+
+def shape_bucket(n) -> str:
+    """Power-of-two bucket label for a row count: 2^k covers [2^k, 2^(k+1)).
+
+    Bounds `shape=` label cardinality: a query stream touching thousands of
+    distinct segment sizes produces at most ~40 buckets.
+    """
+    try:
+        n = int(n)
+    except (TypeError, ValueError):
+        return "0"
+    if n <= 0:
+        return "0"
+    return f"2^{n.bit_length() - 1}"
+
+
+# -- link RTT (memoized; mirrors bench.py's device/link split) --------------
+
+_UNSET = object()
+_link_rtt_ms_cached = _UNSET
+_link_lock = threading.Lock()
+
+
+def _link_rtt_ms() -> float:
+    """Memoized host<->device link RTT in ms from `devlink.link_profile()`;
+    0.0 when the probe fails (e.g. no device runtime at all)."""
+    global _link_rtt_ms_cached
+    if _link_rtt_ms_cached is _UNSET:
+        with _link_lock:
+            if _link_rtt_ms_cached is _UNSET:
+                try:
+                    from pinot_tpu.common import devlink
+
+                    rtt_s, _ = devlink.link_profile()
+                    _link_rtt_ms_cached = max(float(rtt_s) * 1e3, 0.0)
+                except Exception:
+                    _link_rtt_ms_cached = 0.0
+    return _link_rtt_ms_cached
+
+
+def _reset_link_rtt() -> None:
+    """Test hook."""
+    global _link_rtt_ms_cached
+    _link_rtt_ms_cached = _UNSET
+
+
+def _has_tracer(out) -> bool:
+    """True when `out` contains jax tracers (we are inside an outer trace;
+    there is nothing concrete to fence or time)."""
+    try:
+        import jax
+
+        return any(
+            isinstance(leaf, jax.core.Tracer) for leaf in jax.tree_util.tree_leaves(out)
+        )
+    except Exception:
+        return False
+
+
+def _block(out):
+    try:
+        import jax
+
+        return jax.block_until_ready(out)
+    except Exception:
+        return out
+
+
+# -- HBM accounting ---------------------------------------------------------
+
+
+class HostHbmEstimator:
+    """Deterministic host-side HBM model used when the backend exposes no
+    `memory_stats()` (CPU tier-1). Kernels report their working-set bytes as
+    transient footprints; long-lived residency (device segments) uses
+    alloc/free. live/peak then mirror what `bytes_in_use` /
+    `peak_bytes_in_use` report on a real TPU."""
+
+    def __init__(self):
+        self._live = 0
+        self._peak = 0
+        self._lock = threading.Lock()
+
+    def alloc(self, nbytes: int) -> None:
+        n = max(int(nbytes), 0)
+        with self._lock:
+            self._live += n
+            self._peak = max(self._peak, self._live)
+
+    def free(self, nbytes: int) -> None:
+        n = max(int(nbytes), 0)
+        with self._lock:
+            self._live = max(self._live - n, 0)
+
+    def transient(self, nbytes: int) -> int:
+        """One kernel invocation's working set: allocated and freed within
+        the call. Moves peak, not live. Returns the modeled footprint
+        (live-at-peak) for per-query peak-HBM attribution."""
+        n = max(int(nbytes), 0)
+        with self._lock:
+            footprint = self._live + n
+            self._peak = max(self._peak, footprint)
+            return footprint
+
+    @property
+    def live(self) -> int:
+        with self._lock:
+            return self._live
+
+    @property
+    def peak(self) -> int:
+        with self._lock:
+            return self._peak
+
+    def reset(self) -> None:
+        with self._lock:
+            self._live = 0
+            self._peak = 0
+
+
+def device_hbm_stats() -> dict | None:
+    """live/peak bytes summed over `jax.local_devices()`, or None when the
+    backend doesn't report memory stats (CPU)."""
+    try:
+        import jax
+
+        stats = [d.memory_stats() for d in jax.local_devices()]
+    except Exception:
+        return None
+    if not stats or any(not isinstance(s, dict) or "bytes_in_use" not in s for s in stats):
+        return None
+    return {
+        "liveBytes": sum(int(s.get("bytes_in_use", 0)) for s in stats),
+        "peakBytes": sum(
+            int(s.get("peak_bytes_in_use", s.get("bytes_in_use", 0))) for s in stats
+        ),
+    }
+
+
+# -- the registry -----------------------------------------------------------
+
+
+@dataclass
+class RegisteredKernel:
+    """One jitted / pallas root. `cost_model(shape_kwargs) -> (bytes, flops)`
+    prices a single invocation from its shape signature."""
+
+    name: str
+    root: object = None
+    cost_model: Callable[[dict], tuple[float, float]] | None = None
+    description: str = ""
+
+
+@dataclass
+class _KernelStats:
+    calls: int = 0
+    device_ms: float = 0.0
+    bytes_moved: float = 0.0
+    flops: float = 0.0
+
+
+class KernelRegistry:
+    """Registry + device-time ledger for every compiled kernel root."""
+
+    def __init__(self, hbm_peak_gbps: float = DEFAULT_HBM_PEAK_GBPS):
+        self._lock = threading.Lock()
+        self._enabled = True
+        self._hbm_peak_gbps = float(hbm_peak_gbps)
+        self._kernels: dict[str, RegisteredKernel] = {}
+        self._stats: dict[tuple[str, str], _KernelStats] = {}
+        self.hbm = HostHbmEstimator()
+
+    # -- configuration ------------------------------------------------------
+
+    @property
+    def enabled(self) -> bool:
+        return self._enabled
+
+    @property
+    def hbm_peak_gbps(self) -> float:
+        return self._hbm_peak_gbps
+
+    def configure(self, enabled: bool | None = None, hbm_peak_gbps: float | None = None) -> None:
+        with self._lock:
+            if enabled is not None:
+                self._enabled = bool(enabled)
+            if hbm_peak_gbps is not None:
+                self._hbm_peak_gbps = float(hbm_peak_gbps)
+
+    # -- registration -------------------------------------------------------
+
+    def register(
+        self,
+        name: str,
+        root: object = None,
+        cost_model: Callable[[dict], tuple[float, float]] | None = None,
+        description: str = "",
+    ) -> RegisteredKernel:
+        """Register a kernel root under a stable name. Double registration is
+        a programming error (two kernels would alias one ledger row)."""
+        k = RegisteredKernel(name, root, cost_model, description)
+        with self._lock:
+            if name in self._kernels:
+                raise ValueError(f"kernel {name!r} already registered")
+            self._kernels[name] = k
+        return k
+
+    def is_registered(self, name: str) -> bool:
+        with self._lock:
+            return name in self._kernels
+
+    def kernel_names(self) -> list[str]:
+        with self._lock:
+            return sorted(self._kernels)
+
+    # -- recording ----------------------------------------------------------
+
+    def record(self, name: str, device_ms: float, **shape) -> None:
+        """Fold one timed invocation into the ledger, metrics, the current
+        query's accountant tracker, and the active trace."""
+        k = self._kernels.get(name)
+        if k is None:
+            return
+        nbytes, flops = (0.0, 0.0)
+        if k.cost_model is not None:
+            nbytes, flops = k.cost_model(shape)
+            nbytes, flops = max(float(nbytes), 0.0), max(float(flops), 0.0)
+        bucket = shape_bucket(shape.get("rows", 0))
+        with self._lock:
+            s = self._stats.setdefault((name, bucket), _KernelStats())
+            s.calls += 1
+            s.device_ms += device_ms
+            s.bytes_moved += nbytes
+            s.flops += flops
+        footprint = self.hbm.transient(int(nbytes))
+        reg = server_metrics()
+        reg.timer("engine.kernel.deviceMs", kernel=name, shape=bucket).update_ms(device_ms)
+        reg.meter("engine.kernel.invocations", kernel=name, shape=bucket).mark()
+        if nbytes:
+            reg.meter("engine.kernel.bytesMoved", kernel=name, shape=bucket).mark(int(nbytes))
+        hbm = self.hbm_snapshot()
+        reg.gauge("engine.hbm.liveBytes").set(hbm["liveBytes"])
+        reg.gauge("engine.hbm.peakBytes").set(hbm["peakBytes"])
+        default_accountant.sample(device_ms=device_ms, hbm_bytes=footprint)
+        trace_event(
+            "kernel.execute",
+            kernel=name,
+            shape=bucket,
+            deviceMs=round(device_ms, 3),
+            bytesMoved=int(nbytes),
+        )
+        tr = active_trace()
+        if tr is not None:
+            tr.record_phase(ServerQueryPhase.DEVICE_EXECUTION, device_ms)
+
+    def timed_sync(self, name: str, fn: Callable[[], object], **shape):
+        """Run `fn` (a device dispatch whose result the caller is about to
+        consume), fence with `block_until_ready`, and record wall-minus-RTT
+        as device time — the same split `bench.py` computes. Disabled
+        registries and calls made under an outer jax trace pass straight
+        through."""
+        if not self._enabled:
+            return fn()
+        t0 = time.perf_counter()
+        out = fn()
+        if _has_tracer(out):
+            return out
+        out = _block(out)
+        wall_ms = (time.perf_counter() - t0) * 1e3
+        self.record(name, max(wall_ms - _link_rtt_ms(), 0.0), **shape)
+        return out
+
+    # -- reporting ----------------------------------------------------------
+
+    def hbm_snapshot(self) -> dict:
+        dev = device_hbm_stats()
+        if dev is not None:
+            return {**dev, "source": "device"}
+        return {"liveBytes": self.hbm.live, "peakBytes": self.hbm.peak, "source": "estimator"}
+
+    def stats_snapshot(self) -> dict[tuple[str, str], dict]:
+        with self._lock:
+            return {
+                key: {
+                    "calls": s.calls,
+                    "deviceMs": s.device_ms,
+                    "bytesMoved": s.bytes_moved,
+                    "flops": s.flops,
+                }
+                for key, s in self._stats.items()
+            }
+
+    def total_device_ms(self) -> float:
+        with self._lock:
+            return sum(s.device_ms for s in self._stats.values())
+
+    def roofline(self, peak_gbps: float | None = None, top: int = 10) -> dict:
+        """The `/debug/roofline` document: per-(kernel, shape-bucket) achieved
+        GB/s vs. peak, arithmetic intensity, and the top offenders ranked by
+        device-ms spent below the roof (gap alone would rank microscopic
+        kernels first)."""
+        peak = float(peak_gbps) if peak_gbps is not None else self._hbm_peak_gbps
+        rows = []
+        for (name, bucket), s in sorted(self.stats_snapshot().items()):
+            dev_s = s["deviceMs"] / 1e3
+            achieved = (s["bytesMoved"] / dev_s / 1e9) if dev_s > 0 else 0.0
+            pct = (100.0 * achieved / peak) if peak > 0 else 0.0
+            rows.append(
+                {
+                    "kernel": name,
+                    "shape": bucket,
+                    "calls": s["calls"],
+                    "deviceMs": round(s["deviceMs"], 3),
+                    "bytesMoved": int(s["bytesMoved"]),
+                    "flops": int(s["flops"]),
+                    "achievedGBps": round(achieved, 3),
+                    "arithmeticIntensity": (
+                        round(s["flops"] / s["bytesMoved"], 4) if s["bytesMoved"] else 0.0
+                    ),
+                    "pctOfPeak": round(pct, 3),
+                    "rooflineGap": round(peak / achieved, 1) if achieved > 0 else None,
+                    "lostMs": round(s["deviceMs"] * max(1.0 - pct / 100.0, 0.0), 3),
+                }
+            )
+        offenders = sorted(
+            (r for r in rows if r["rooflineGap"] is not None),
+            key=lambda r: -r["lostMs"],
+        )[: max(int(top), 0)]
+        return {
+            "hbmPeakGBps": peak,
+            "enabled": self._enabled,
+            "linkRttMs": round(_link_rtt_ms(), 4) if self._stats else 0.0,
+            "kernels": rows,
+            "offenders": offenders,
+            "hbm": self.hbm_snapshot(),
+            "registered": self.kernel_names(),
+        }
+
+    # -- test hooks ---------------------------------------------------------
+
+    def reset_stats(self) -> None:
+        with self._lock:
+            self._stats.clear()
+        self.hbm.reset()
+
+    def reset(self) -> None:
+        with self._lock:
+            self._kernels.clear()
+            self._stats.clear()
+            self._enabled = True
+            self._hbm_peak_gbps = DEFAULT_HBM_PEAK_GBPS
+        self.hbm.reset()
+
+
+#: process-wide registry every compiled root registers into at import time
+KERNELS = KernelRegistry()
+
+
+# -- lru_cache observability ------------------------------------------------
+
+
+class CacheObserver:
+    """Publishes an `functools.lru_cache`'s hit/miss/size/evict counters as
+    `engine.kernelCache.*{cache=...}` metric families. lru_cache keeps
+    monotonic totals; we emit deltas so the meters compose with every other
+    meter on /metrics. Evictions are inferred: every miss inserts, so
+    `misses - currsize` (once the cache has filled) counts entries pushed
+    out."""
+
+    def __init__(self, cached_fn, cache: str):
+        self._fn = cached_fn
+        self._label = cache
+        self._lock = threading.Lock()
+        self._hits = 0
+        self._misses = 0
+        self._evictions = 0
+
+    def observe(self) -> None:
+        """Fold the cache's counters into metrics (call after each lookup)."""
+        info = self._fn.cache_info()
+        reg = server_metrics()
+        with self._lock:
+            d_hits = info.hits - self._hits
+            d_misses = info.misses - self._misses
+            evictions = max(info.misses - info.currsize, 0)
+            d_evict = evictions - self._evictions
+            self._hits, self._misses = info.hits, info.misses
+            self._evictions = evictions
+        if d_hits > 0:
+            reg.meter("engine.kernelCache.hits", cache=self._label).mark(d_hits)
+        if d_misses > 0:
+            reg.meter("engine.kernelCache.misses", cache=self._label).mark(d_misses)
+        if d_evict > 0:
+            reg.meter("engine.kernelCache.evictions", cache=self._label).mark(d_evict)
+        reg.gauge("engine.kernelCache.size", cache=self._label).set(info.currsize)

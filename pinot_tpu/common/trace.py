@@ -45,6 +45,9 @@ class ServerQueryPhase(Enum):
     QUERY_PLAN_EXECUTION = "queryPlanExecution"
     RESPONSE_SERIALIZATION = "responseSerialization"
     SCHEDULER_WAIT = "schedulerWait"
+    #: accelerator time attributed by kernel_obs (block_until_ready fenced,
+    #: link RTT subtracted) — the device-side slice of queryPlanExecution
+    DEVICE_EXECUTION = "deviceExecution"
     # broker/transport phases (BrokerQueryPhase parity) — one enum keeps the
     # phaseTimesMs namespace flat across roles
     REQUEST_COMPILATION = "requestCompilation"

@@ -1,10 +1,11 @@
 """pinotlint: project-invariant static analyzer for pinot_tpu.
 
-Ten AST checkers enforce the conventions the engine's correctness actually
+Eleven AST checkers enforce the conventions the engine's correctness actually
 rests on — race discipline, jit purity, deadline/cancellation coverage, the
 error-code registry, the fault-point registry, fault-point span-event
 coverage on the query path, lock-order cycles, blocking calls made while a
-lock is held, resource leaks, and atomic writes to durable artifacts. The concurrency family (race-discipline,
+lock is held, resource leaks, atomic writes to durable artifacts, and
+kernel-registry coverage of compiled roots on the query path. The concurrency family (race-discipline,
 lock-order, blocking-under-lock) is whole-program: all three share one
 call-graph + lock-summary build per run (`core.AnalysisSession`). See
 README.md in this directory and the module docstrings for exact rules.
@@ -22,6 +23,7 @@ from pinot_tpu.devtools.lint.deadlines import DeadlineChecker
 from pinot_tpu.devtools.lint.error_codes import ErrorCodeChecker
 from pinot_tpu.devtools.lint.fault_points import FaultPointChecker, FaultSpanEventChecker
 from pinot_tpu.devtools.lint.jit_purity import JitPurityChecker
+from pinot_tpu.devtools.lint.kernel_registry import KernelRegistryChecker
 from pinot_tpu.devtools.lint.races import RaceChecker
 from pinot_tpu.devtools.lint.resources import ResourceLeakChecker
 
@@ -38,6 +40,7 @@ ALL_CHECKERS: dict[str, type[Checker]] = {
     "blocking-under-lock": BlockingUnderLockChecker,
     "resource-leak": ResourceLeakChecker,
     "atomic-write": AtomicWriteChecker,
+    "kernel-registry": KernelRegistryChecker,
 }
 
 

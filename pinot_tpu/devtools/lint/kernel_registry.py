@@ -1,0 +1,138 @@
+"""kernel-registry: every compiled kernel root under `query/` + `ops/` must
+be registered with the KernelRegistry (common/kernel_obs.py).
+
+The kernel & memory observability plane only sees what registers: an
+unregistered `@jax.jit` / `pl.pallas_call` root executes invisibly — no
+device-time attribution, no bytes-moved cost model, a hole in
+`/debug/roofline`. The rule:
+
+registered-root
+    Every function that owns a compiled root — a `@jax.jit` decorator (bare
+    or via `functools.partial(jax.jit, ...)`), a `jax.jit(...)` call, or a
+    kernel handed to `pl.pallas_call` / `shard_map` / `vmap` / `pmap` — must
+    be referenced from a `*.register(...)` / `register_kernel(...)` call in
+    the same module (by name or as a string argument), or carry a
+    disable-with-reason.
+
+"Owns" means the OUTERMOST enclosing function: builder factories like
+`get_kernel` that `jax.jit` an inner closure register once under their own
+name, not once per closure. Scope mirrors fault_points path scoping:
+`pinot_tpu/` files under a `query/` or `ops/` directory — the engine's
+compiled hot path; devtools, cluster glue, and tests are exempt.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from pinot_tpu.devtools.lint.core import Checker, Finding, ModuleInfo, dotted_name
+from pinot_tpu.devtools.lint.jit_purity import _ScopedDefs, _is_jit
+
+_WRAPPERS = {"pallas_call", "shard_map", "vmap", "pmap"}
+_KERNEL_PATH_DIRS = ("query", "ops")
+
+
+def _on_kernel_path(path: str) -> bool:
+    p = path.replace("\\", "/")
+    return "pinot_tpu/" in p and any(f"/{d}/" in p for d in _KERNEL_PATH_DIRS)
+
+
+class KernelRegistryChecker(Checker):
+    name = "kernel-registry"
+
+    def check_module(self, module: ModuleInfo) -> list[Finding]:
+        if not _on_kernel_path(module.path):
+            return []
+        defs = _ScopedDefs()
+        defs.visit(module.tree)
+
+        # enclosing-FunctionDef chain for every node, to map a compiled root
+        # (decorator, jit call, or wrapper call) to its outermost owner
+        parent_fn: dict[ast.AST, ast.FunctionDef | None] = {}
+
+        def walk(node: ast.AST, owner: ast.FunctionDef | None):
+            for child in ast.iter_child_nodes(node):
+                parent_fn[child] = owner
+                walk(
+                    child,
+                    child if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)) else owner,
+                )
+
+        walk(module.tree, None)
+
+        def outermost(node: ast.AST) -> ast.FunctionDef | None:
+            top, cur = None, parent_fn.get(node)
+            while cur is not None:
+                top = cur
+                cur = parent_fn.get(cur)
+            return top
+
+        # owner FunctionDef (or module-level call node) for every compiled root
+        owners: dict[ast.AST, int] = {}  # node -> finding line
+
+        for node in ast.walk(module.tree):
+            if isinstance(node, ast.FunctionDef):
+                is_root = False
+                for dec in node.decorator_list:
+                    if _is_jit(dec):
+                        is_root = True
+                    elif isinstance(dec, ast.Call):
+                        if _is_jit(dec.func):
+                            is_root = True
+                        elif dotted_name(dec.func).endswith("partial") and dec.args and _is_jit(dec.args[0]):
+                            is_root = True
+                if is_root:
+                    own = outermost(node) or node
+                    owners.setdefault(own, own.lineno)
+            elif isinstance(node, ast.Call):
+                fn_name = dotted_name(node.func)
+                if _is_jit(node.func) or fn_name.split(".")[-1] in _WRAPPERS:
+                    wrapped = None
+                    if node.args and isinstance(node.args[0], ast.Name):
+                        wrapped = defs.resolve(node, node.args[0].id)
+                    anchor = wrapped if wrapped is not None else node
+                    own = outermost(anchor) or (
+                        anchor if isinstance(anchor, ast.FunctionDef) else None
+                    )
+                    if own is not None:
+                        owners.setdefault(own, own.lineno)
+                    else:
+                        # module-level jit call with no resolvable def: flag
+                        # the call site itself
+                        owners.setdefault(node, node.lineno)
+
+        if not owners:
+            return []
+
+        # names referenced from registration calls: *.register(...) /
+        # register_kernel(...), by Name or string-constant argument
+        registered: set[str] = set()
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fn_name = dotted_name(node.func)
+            if not (fn_name.split(".")[-1] in ("register", "register_kernel")):
+                continue
+            for a in list(node.args) + [kw.value for kw in node.keywords]:
+                if isinstance(a, ast.Name):
+                    registered.add(a.id)
+                elif isinstance(a, ast.Constant) and isinstance(a.value, str):
+                    registered.add(a.value)
+
+        out: list[Finding] = []
+        for own, line in sorted(owners.items(), key=lambda kv: kv[1]):
+            name = own.name if isinstance(own, ast.FunctionDef) else "<module-level jit>"
+            if name in registered:
+                continue
+            out.append(
+                Finding(
+                    self.name,
+                    module.path,
+                    line,
+                    f"compiled kernel root {name!r} is not registered with the "
+                    "KernelRegistry (KERNELS.register): it executes invisibly to "
+                    "the kernel observability plane (/debug/roofline, "
+                    "engine.kernel.* metrics)",
+                )
+            )
+        return out

@@ -28,6 +28,8 @@ import os
 import jax
 import jax.numpy as jnp
 
+from pinot_tpu.common.kernel_obs import KERNELS
+
 # Tile geometry. Each grid step costs ~2us of fixed dispatch overhead on TPU,
 # so for a (chunks x group-tiles) grid the step count — not the MACs — is the
 # dominant cost at bench shapes (4M docs x 4.4k groups was 74k steps at
@@ -167,13 +169,23 @@ def pallas_grouped_sum(values, gid, mask, ng: int):
         gid.astype(jnp.int32), values.astype(jnp.float32), mask
     )
     masked = jnp.where(mask, values, 0.0)
-    return _grouped_sum_impl(gid, masked, ng)
+    return KERNELS.timed_sync(
+        "ops.grouped_sum",
+        lambda: _grouped_sum_impl(gid, masked, ng),
+        rows=gid.shape[0],
+        groups=ng,
+    )
 
 
 def pallas_grouped_count(gid, mask, ng: int):
     """count of masked docs per group (COUNT result holder)."""
     gid, _, mask, _ = _pad_inputs(gid.astype(jnp.int32), None, mask)
-    return _grouped_sum_impl(gid, mask.astype(jnp.float32), ng)
+    return KERNELS.timed_sync(
+        "ops.grouped_sum",
+        lambda: _grouped_sum_impl(gid, mask.astype(jnp.float32), ng),
+        rows=gid.shape[0],
+        groups=ng,
+    )
 
 
 # -- min / max / presence: one-hot select + VPU column reduce ----------------
@@ -238,12 +250,22 @@ def _grouped_extreme_impl(gid, values, mask, ng: int, is_min: bool):
 
 def pallas_grouped_min(values, gid, mask, ng: int):
     gid, values, mask, _ = _pad_inputs(gid.astype(jnp.int32), values.astype(jnp.float32), mask)
-    return _grouped_extreme_impl(gid, values, mask, ng, True)
+    return KERNELS.timed_sync(
+        "ops.grouped_extreme",
+        lambda: _grouped_extreme_impl(gid, values, mask, ng, True),
+        rows=gid.shape[0],
+        groups=ng,
+    )
 
 
 def pallas_grouped_max(values, gid, mask, ng: int):
     gid, values, mask, _ = _pad_inputs(gid.astype(jnp.int32), values.astype(jnp.float32), mask)
-    return _grouped_extreme_impl(gid, values, mask, ng, False)
+    return KERNELS.timed_sync(
+        "ops.grouped_extreme",
+        lambda: _grouped_extreme_impl(gid, values, mask, ng, False),
+        rows=gid.shape[0],
+        groups=ng,
+    )
 
 
 # -- exact integer sum+count: byte-plane one-hot matmul ----------------------
@@ -433,7 +455,13 @@ def pallas_grouped_multi_sum(values_list, gid, mask, ng: int):
     planes = jnp.stack(rows)
     if planes_v2_enabled() and not _V2_BROKEN:
         try:
-            out = _planes2_impl(gid, planes, ng, r)
+            out = KERNELS.timed_sync(
+                "ops.grouped_planes2",
+                lambda: _planes2_impl(gid, planes, ng, r),
+                rows=n_padded,
+                groups=ng,
+                planes=r,
+            )
         except Exception as e:
             # Covers eager execution and trace-time failures only: when this
             # function is traced inside an OUTER jit (the fused query
@@ -446,9 +474,21 @@ def pallas_grouped_multi_sum(values_list, gid, mask, ng: int):
             logging.getLogger(__name__).warning(
                 "two-level planes kernel failed (%r); using flat kernel", e, exc_info=True
             )
-            out = _planes_impl(gid, planes, ng, r)
+            out = KERNELS.timed_sync(
+                "ops.grouped_planes",
+                lambda: _planes_impl(gid, planes, ng, r),
+                rows=n_padded,
+                groups=ng,
+                planes=r,
+            )
     else:
-        out = _planes_impl(gid, planes, ng, r)
+        out = KERNELS.timed_sync(
+            "ops.grouped_planes",
+            lambda: _planes_impl(gid, planes, ng, r),
+            rows=n_padded,
+            groups=ng,
+            planes=r,
+        )
     sums = []
     for i in range(k):
         p = out[4 * i : 4 * i + 4, :ng].astype(jnp.float64)
@@ -496,5 +536,64 @@ def pallas_presence(dict_ids, mask, cardinality: int):
     """DISTINCTCOUNT presence bitmap: presence[d] = any masked doc with
     dict id d (the scatter-max over the valid-doc mask)."""
     ids, _, mask, _ = _pad_inputs(dict_ids.astype(jnp.int32), None, mask)
-    counts = _grouped_sum_impl(ids, mask.astype(jnp.float32), cardinality)
+    counts = KERNELS.timed_sync(
+        "ops.grouped_sum",
+        lambda: _grouped_sum_impl(ids, mask.astype(jnp.float32), cardinality),
+        rows=ids.shape[0],
+        groups=cardinality,
+    )
     return counts > 0
+
+
+# -- kernel registry: cost models for the roofline report --------------------
+#
+# Bytes model what each grid actually streams through VMEM: every doc chunk
+# is re-read once per group tile (the chunk axis is innermost), so traffic
+# scales with rows x group-tiles, not rows alone. FLOPs count the one-hot
+# build (1 compare) + MXU MAC (2) per (doc, group) pair.
+
+
+def _onehot_cost(n_streams: float):
+    def cost(shape: dict) -> tuple[float, float]:
+        rows = max(float(shape.get("rows", 0)), 0.0)
+        groups = max(float(shape.get("groups", 1)), 1.0)
+        gtile = float(gtile_for(int(groups)))
+        n_gtiles = max(-(-groups // gtile), 1.0)
+        return rows * n_streams * 4.0 * n_gtiles, rows * groups * 3.0
+
+    return cost
+
+
+def _planes_cost(shape: dict) -> tuple[float, float]:
+    rows = max(float(shape.get("rows", 0)), 0.0)
+    groups = max(float(shape.get("groups", 1)), 1.0)
+    planes = max(float(shape.get("planes", 8)), 1.0)
+    gtile = float(gtile_for(int(groups)))
+    n_gtiles = max(-(-groups // gtile), 1.0)
+    return rows * (planes + 1.0) * 4.0 * n_gtiles, rows * groups * (2.0 * planes + 1.0)
+
+
+KERNELS.register(
+    "ops.grouped_sum",
+    _grouped_sum_impl,
+    cost_model=_onehot_cost(2.0),
+    description="one-hot matmul grouped SUM/COUNT/presence (gid + value streams)",
+)
+KERNELS.register(
+    "ops.grouped_extreme",
+    _grouped_extreme_impl,
+    cost_model=_onehot_cost(3.0),
+    description="one-hot select + VPU column reduce MIN/MAX (gid + value + mask)",
+)
+KERNELS.register(
+    "ops.grouped_planes",
+    _planes_impl,
+    cost_model=_planes_cost,
+    description="byte-plane exact SUM+COUNT, flat grid",
+)
+KERNELS.register(
+    "ops.grouped_planes2",
+    _planes2_impl,
+    cost_model=_planes_cost,
+    description="byte-plane exact SUM+COUNT, two-level grid (PINOT_TPU_PALLAS_V2)",
+)

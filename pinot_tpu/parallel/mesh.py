@@ -28,6 +28,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from pinot_tpu.common.kernel_obs import KERNELS
 from pinot_tpu.common.types import Schema
 from pinot_tpu.parallel.compat import shard_map
 from pinot_tpu.query.context import QueryContext, QueryType
@@ -431,7 +432,17 @@ def execute_sharded_result(table: ShardedTable, sql: str):
         # answers correctly through the per-segment engine's own paths
         return _run_on_proto(table, sql)
     _, unpack = _sharded_kernel(plan.spec, table.mesh, table.mesh.axis_names[0], table.padded)
-    host = unpack(np.asarray(out))  # single device->host round trip
+    # single device->host round trip, fenced + attributed by kernel_obs
+    host = unpack(
+        np.asarray(
+            KERNELS.timed_sync(
+                "exchange.sharded",
+                lambda: np.asarray(out),
+                rows=table.padded,
+                cols=max(len(plan.columns), 1),
+            )
+        )
+    )
     e = QueryEngine([])
     gspec = plan.spec[2]
     if ctx.query_type == QueryType.AGGREGATION:
@@ -471,3 +482,22 @@ def execute_sharded_result(table: ShardedTable, sql: str):
         total_docs=table.total_docs,
         num_segments_queried=table.n_segments,
     )
+
+
+# -- kernel registry: cost model for the roofline report ---------------------
+
+
+def _sharded_cost(shape: dict) -> tuple[float, float]:
+    # same streaming model as the per-segment fused program (each staged
+    # column read once at accumulator width), applied to the sharded layout
+    rows = max(float(shape.get("rows", 0)), 0.0)
+    cols = max(float(shape.get("cols", 1)), 1.0)
+    return rows * (cols * 8.0 + 1.0), rows * cols * 4.0
+
+
+KERNELS.register(
+    "exchange.sharded",
+    _sharded_kernel,
+    cost_model=_sharded_cost,
+    description="sharded whole-table program: vmapped fused kernel + ICI partial merge",
+)

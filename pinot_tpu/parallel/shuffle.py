@@ -36,6 +36,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from pinot_tpu.common.kernel_obs import KERNELS
 from pinot_tpu.parallel.compat import shard_map
 
 # Multi-device collective launches must not interleave: two host threads
@@ -236,10 +237,34 @@ def mesh_equi_join(
         cap0 = 1 << max(6, int(np.ceil(np.log2(max(1, -(-2 * max(lc, rc) // n_dest))))))
         for capacity in (cap0, max(lc, rc)):
             run = _join_kernel(mesh, axis, lc, rc, int(capacity), str(kdt))
-            li, ri, hit, drops, dups = run(lkd, lid, rkd, rid)
+            li, ri, hit, drops, dups = KERNELS.timed_sync(
+                "exchange.join",
+                lambda: run(lkd, lid, rkd, rid),
+                rows=n_dest * int(capacity),
+            )
             if int(dups) > 0:
                 return None  # many-to-many: single-device range-probe handles
             if int(drops) == 0:
                 h = np.asarray(hit)
                 return np.asarray(li)[h], np.asarray(ri)[h]
     return None
+
+
+# -- kernel registry: cost model for the roofline report ---------------------
+#
+# rows = the exchanged buffer slots (n_dest * capacity). Both sides' key+idx
+# columns cross the ICI twice (send + receive), and the per-shard probe is
+# sort-dominated: ~2 * rows * log2(rows) compare/moves.
+
+
+def _join_cost(shape: dict) -> tuple[float, float]:
+    rows = max(float(shape.get("rows", 0)), 1.0)
+    return rows * (8.0 + 4.0) * 2.0 * 2.0, rows * 2.0 * max(float(np.log2(rows)), 1.0)
+
+
+KERNELS.register(
+    "exchange.join",
+    _join_kernel,
+    cost_model=_join_cost,
+    description="mesh equi-join: hash all_to_all repartition + sorted probe",
+)

@@ -29,6 +29,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from pinot_tpu.common.kernel_obs import KERNELS, CacheObserver
+
 _F = jnp.float64
 _I = jnp.int64
 
@@ -239,7 +241,7 @@ def _blocked(v):
     return v.reshape(nb, _BLOCK)
 
 
-def _exact_int_grouped_sum(v, gid, mask, ng):
+def _exact_int_grouped_sum(v, gid, mask, ng):  # pinotlint: disable=kernel-registry — vmap here is traced inline inside the fused kernel; device time lands under query.fused, not a separate root
     v2 = _blocked(v.astype(jnp.int32))
     g2 = _blocked(gid)
     m2 = _blocked(mask)
@@ -751,6 +753,36 @@ def get_packed_kernel(spec: tuple):
     return jax.jit(run, static_argnums=3)
 
 
+#: compile-cache observability (engine.kernelCache.*{cache=} on /metrics) —
+#: the measurement baseline for the shared compile-cache work (ROADMAP 1)
+_kernel_cache_obs = CacheObserver(get_kernel, cache="kernel")
+_packed_cache_obs = CacheObserver(get_packed_kernel, cache="packed")
+
+
+def _fused_cost(shape: dict) -> tuple[float, float]:
+    """Bytes-moved / FLOPs model for the fused per-segment program: each of
+    the plan's staged columns streams once at accumulator width (8 B) plus
+    the filter mask, and every row/column pair costs ~4 flops (compare +
+    mask + accumulate + combine)."""
+    rows = max(float(shape.get("rows", 0)), 0.0)
+    cols = max(float(shape.get("cols", 1)), 1.0)
+    return rows * (cols * 8.0 + 1.0), rows * cols * 4.0
+
+
+KERNELS.register(
+    "query.fused",
+    get_kernel,
+    cost_model=_fused_cost,
+    description="fused filter+project+aggregate segment program (device outputs)",
+)
+KERNELS.register(
+    "query.fused_packed",
+    get_packed_kernel,
+    cost_model=_fused_cost,
+    description="fused segment program, outputs packed into one f64 vector",
+)
+
+
 @lru_cache(maxsize=4096)
 def _packed_meta(spec: tuple, col_sig: tuple, op_sig: tuple, n_padded: int):
     """(treedef, [(shape, dtype)]) of a spec's output tree for one input
@@ -833,7 +865,9 @@ def dispatch_plan_packed(plan, device_segment):
     unpacks — N in-flight programs share the link instead of syncing N
     times."""
     kernel = get_packed_kernel(plan.spec)
+    _packed_cache_obs.observe()
     cols, ops = _plan_inputs(plan, device_segment)
+    n_cols = len(cols)
     vec = kernel(cols, ops, np.int32(device_segment.n_docs), device_segment.padded)
     treedef, leaf_meta = _packed_meta(
         plan.spec,
@@ -843,7 +877,16 @@ def dispatch_plan_packed(plan, device_segment):
     )
 
     def unpack():
-        v = np.asarray(vec)  # THE device->host sync
+        # THE device->host sync, fenced + attributed by kernel_obs (device
+        # time = wall minus the memoized link RTT, the bench.py split)
+        v = np.asarray(
+            KERNELS.timed_sync(
+                "query.fused_packed",
+                lambda: np.asarray(vec),
+                rows=device_segment.padded,
+                cols=n_cols,
+            )
+        )
         out = []
         i = 0
         for shape, dtype in leaf_meta:
@@ -873,5 +916,6 @@ def run_plan_packed(plan, device_segment):
 def run_plan(plan, device_segment):
     """Execute a SegmentPlan against a DeviceSegment; returns device outputs."""
     kernel = get_kernel(plan.spec)
+    _kernel_cache_obs.observe()
     cols, ops = _plan_inputs(plan, device_segment)
     return kernel(cols, ops, np.int32(device_segment.n_docs), device_segment.padded)

@@ -1,0 +1,56 @@
+"""kernel-registry golden fixture: compiled roots under query/ must be
+registered with the KernelRegistry or carry a disable-with-reason.
+
+Parsed by pinotlint only — never imported or executed."""
+
+import jax
+
+from pinot_tpu.common.kernel_obs import KERNELS
+
+
+@jax.jit
+def registered_root(x):  # clean: referenced from KERNELS.register below
+    return x + 1
+
+
+@jax.jit
+def unregistered_root(x):
+    return x * 2
+
+
+def plain_fn(x):
+    return x - 1
+
+
+_jitted = jax.jit(plain_fn)  # call-form root: finding lands on plain_fn's def
+
+
+def kernel_factory(spec):  # clean: outermost owner, registered by string name
+    def inner(x):
+        return x * spec
+
+    return jax.jit(inner)
+
+
+def pallas_body(ref):
+    return ref
+
+
+def build_pallas(pallas_call):
+    return pallas_call(pallas_body)  # wrapper root: finding lands on pallas_body
+
+
+_anon = jax.jit(lambda x: x)  # unresolvable root: flagged at this call site
+
+
+@jax.jit
+def suppressed_root(x):  # pinotlint: disable=kernel-registry — fixture demo: traced inline under a registered parent kernel
+    return x
+
+
+def _cost(shape):
+    return (1.0, 1.0)
+
+
+KERNELS.register("fixture.registered", registered_root, cost_model=_cost)
+KERNELS.register("kernel_factory")

@@ -108,6 +108,29 @@ def test_atomic_write_exempts_durability_module():
     assert lint_paths([durability], checks=["atomic-write"]) == []
 
 
+KREG_FIXTURE = os.path.join("pinot_tpu", "query", "kernel_registry_fixture.py")
+
+
+def test_kernel_registry_fixture_findings():
+    fs = findings_for(KREG_FIXTURE, checks=["kernel-registry"])
+    assert lines_of(fs, "kernel-registry") == [17, 21, 35, 43]
+    by_line = {f.line: f.message for f in fs}
+    assert "unregistered_root" in by_line[17]  # plain @jax.jit decorator
+    assert "plain_fn" in by_line[21]  # jax.jit(f) call form resolves to the def
+    assert "pallas_body" in by_line[35]  # handed to a pallas_call wrapper
+    assert "<module-level jit>" in by_line[43]  # anonymous lambda root
+    # registered_root (by Name), kernel_factory (outermost owner, by string
+    # name), and suppressed_root (line 46) must all stay quiet
+    for clean in ("registered_root", "kernel_factory", "suppressed_root"):
+        assert not any(f"'{clean}'" in f.message for f in fs)
+
+
+def test_kernel_registry_ignores_off_kernel_path():
+    # same rule set, but a fixture outside query/ + ops/ is out of scope
+    fs = findings_for("jit_fixture.py", checks=["kernel-registry"])
+    assert fs == []
+
+
 # ---------------------------------------------------------------------------
 # v2 whole-program checkers: lock-order, blocking-under-lock, resource-leak
 # ---------------------------------------------------------------------------
@@ -204,6 +227,7 @@ def test_v2_suppressions(name, checks, suppressed_line):
         ("errcode_fixture.py", ["error-code-registry"], 34),
         ("fault_fixture.py", ["fault-point-registry"], 24),
         (os.path.join("pinot_tpu", "query", "span_fixture.py"), ["fault-span-event"], 36),
+        (os.path.join("pinot_tpu", "query", "kernel_registry_fixture.py"), ["kernel-registry"], 46),
     ],
 )
 def test_suppressed_lines_not_reported(name, checks, suppressed_line):
