@@ -573,6 +573,99 @@ def bench_admission_overhead(n=120_000):
         shutil.rmtree(root, ignore_errors=True)
 
 
+def bench_cache_overhead(n=120_000):
+    """Query-cache cost on the MISS path (the hit path is the win, the miss
+    path is the tax): the same aggregation with the cache plane disabled vs
+    at defaults, driven with a never-repeating WHERE literal so every lookup
+    misses. The per-miss hot cost is one key build (normalize is already paid
+    by the parse tier; routing-version reads dominate) + one result_get miss
+    + one clone/estimate/result_put; time those ops directly against a live
+    broker and hold their projected share of the query wall to the <2%
+    budget — the stable form of the wall-clock assertion (same shape as
+    admission_overhead)."""
+    import shutil
+    import tempfile
+
+    from pinot_tpu.common import CacheConfig, DataType, Schema, TableConfig
+    from pinot_tpu.cluster import Broker, Controller, PropertyStore, Server
+    from pinot_tpu.segment import SegmentBuilder
+
+    rng = np.random.default_rng(29)
+    root = tempfile.mkdtemp(prefix="pinot_tpu_cache_")
+    try:
+        controller = Controller(PropertyStore(), os.path.join(root, "ds"))
+        for i in range(2):
+            controller.register_server(f"s{i}", Server(f"s{i}"))
+        schema = Schema.build(
+            "t", dimensions=[("k", DataType.INT)], metrics=[("m", DataType.LONG)]
+        )
+        controller.add_schema(schema)
+        controller.add_table(TableConfig("t", replication=2))
+        builder = SegmentBuilder(schema)
+        for i in range(4):
+            controller.upload_segment(
+                "t",
+                builder.build(
+                    {
+                        "k": rng.integers(0, 64, n // 4).astype(np.int32),
+                        "m": rng.integers(1, 10, n // 4).astype(np.int64),
+                    },
+                    f"t_{i}",
+                ),
+            )
+
+        # unique literal per execution => the result tier misses every time
+        counter = [0]
+
+        def q():
+            counter[0] += 1
+            return f"SELECT k, SUM(m) FROM t WHERE k < {64 + counter[0]} GROUP BY k ORDER BY k LIMIT 10"
+
+        broker_off = Broker(controller, cache_config=CacheConfig(enabled=False))
+        try:
+            off_ms = _time_host(lambda: broker_off.execute(q()), iters=7)
+        finally:
+            broker_off.shutdown()
+        broker_on = Broker(controller)
+        try:
+            on_ms = _time_host(lambda: broker_on.execute(q()), iters=7)
+
+            # Direct measure of the added miss-path ops against the live
+            # broker: key build + result-tier miss + put of a small response.
+            stmt, normalized = broker_on._compile(q())
+            probe = broker_on.execute(q())
+            ops = 20_000
+            t0 = time.perf_counter()
+            for i in range(ops):
+                key, versions, twins = broker_on._cache_key(stmt, "t", normalized)
+                miss_key = (f"{normalized}#{i}", key[1])
+                broker_on.caches.result_get(miss_key, versions)
+                broker_on.caches.result_put(
+                    miss_key, probe, versions, realtime=False
+                )
+            per_op_us = (time.perf_counter() - t0) / ops * 1e6
+        finally:
+            broker_on.shutdown()
+        projected_pct = per_op_us / (off_ms * 1e3) * 100
+        assert projected_pct < 2.0, (
+            f"cache miss-path ops {per_op_us:.2f}µs = {projected_pct:.2f}% of "
+            f"{off_ms:.1f}ms query — over the 2% request-path budget"
+        )
+        return {
+            "metric": "cache_overhead",
+            "value": round(on_ms - off_ms, 3),
+            "unit": "ms",
+            "n": n,
+            "off_ms": round(off_ms, 3),
+            "on_ms": round(on_ms, 3),
+            "overhead_pct": round((on_ms / off_ms - 1.0) * 100, 1),
+            "miss_ops_us": round(per_op_us, 4),
+            "projected_pct_per_query": round(projected_pct, 3),
+        }
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+
+
 def bench_hedge_overhead(n=120_000):
     """Hedged-scatter cost on the happy path (no stragglers): the same
     aggregation with hedging disabled (plain pool.map fan-out) vs enabled
@@ -1135,6 +1228,7 @@ ALL = [
     bench_stats_overhead,
     bench_deadline_overhead,
     bench_admission_overhead,
+    bench_cache_overhead,
     bench_hedge_overhead,
     bench_trace_overhead,
     bench_profiler_overhead,

@@ -92,6 +92,7 @@ class Broker:
         obs_config=None,
         resilience=None,
         scheduler_config=None,
+        cache_config=None,
     ):
         """selector: instance selector (Balanced default; ReplicaGroup /
         Adaptive from cluster.routing). failure_detector: optional
@@ -106,12 +107,20 @@ class Broker:
         common.config.SchedulerConfig — the admission tier: which
         QueryScheduler the request path runs on (priority default), queue
         bounds, shed/degrade policy, and per-tenant QPS quotas
-        (SchedulerConfig(enabled=False) restores inline execution)."""
+        (SchedulerConfig(enabled=False) restores inline execution).
+        cache_config: common.config.CacheConfig — the query-cache plane
+        (result + parse + plan tiers, cluster/result_cache.py); default ON,
+        CacheConfig(enabled=False) restores uncached execution."""
         import collections
 
         from pinot_tpu.cluster.admission import AdmissionController
         from pinot_tpu.cluster.quota import QueryQuotaManager
-        from pinot_tpu.common.config import ObservabilityConfig, ResilienceConfig, SchedulerConfig
+        from pinot_tpu.common.config import (
+            CacheConfig,
+            ObservabilityConfig,
+            ResilienceConfig,
+            SchedulerConfig,
+        )
 
         self.controller = controller
         self.scheduler_config = (
@@ -138,6 +147,11 @@ class Broker:
             if enable_quota
             else None
         )
+        self.cache_config = cache_config if cache_config is not None else CacheConfig()
+        #: QueryCaches (result/parse/plan tiers + single-flight), or None
+        #: when CacheConfig.enabled is False — every cache branch in the
+        #: request path keys off this being non-None
+        self.caches = self.cache_config.make()
         self.query_logger = query_logger
         self.obs_config = obs_config if obs_config is not None else ObservabilityConfig()
         # kernel_obs is process-global (kernels register at import time);
@@ -365,7 +379,7 @@ class Broker:
         import random
 
         from pinot_tpu.common.metrics import BrokerMeter, BrokerTimer, broker_metrics
-        from pinot_tpu.common.trace import ServerQueryPhase, TraceContext, phase_timer, start_trace
+        from pinot_tpu.common.trace import TraceContext, start_trace
         from pinot_tpu.query.context import (
             Deadline,
             QueryCancelledError,
@@ -388,8 +402,7 @@ class Broker:
             from pinot_tpu.common.accounting import default_accountant
 
             with bm.timer(BrokerTimer.QUERY_TOTAL).time(), default_accountant.bind_scope(qid):
-                with phase_timer(ServerQueryPhase.REQUEST_COMPILATION, role="broker"):
-                    stmt = parse_sql(sql)
+                stmt, normalized = self._compile(sql)
                 raw_timeout = query_option(
                     stmt.options, "timeoutMs", self.resilience.default_timeout_ms
                 )
@@ -434,12 +447,26 @@ class Broker:
                         partial.degrade = True
 
                 def run_query():
-                    return self._execute(stmt, sql, deadline=deadline, qid=qid, partial=partial)
+                    return self._execute(
+                        stmt, sql, deadline=deadline, qid=qid, partial=partial,
+                        normalized=normalized,
+                    )
 
                 def run_admitted():
                     if self.admission is None:
                         return run_query()
                     return self.admission.execute(run_query, table or "_default")
+
+                # result-cache tier, AFTER quota + admission by design: hits
+                # still count against quotas and shed/degrade verdicts, but a
+                # hit bypasses the scheduler enqueue and the whole scatter
+                cache_state = self._cache_key(stmt, table, normalized)
+                hit_box = {"hit": False}
+
+                def run_cached():
+                    if cache_state is None:
+                        return run_admitted()
+                    return self._run_cached(cache_state, run_admitted, partial, deadline, hit_box)
 
                 # per-query tracing (Tracing.java + `trace=true` query option):
                 # always sampled on trace=true, else probabilistically per
@@ -459,7 +486,7 @@ class Broker:
                                 self._running[qid]["trace"] = tr
                                 self._running[qid]["traceId"] = tctx.trace_id
                         try:
-                            result = run_admitted()
+                            result = run_cached()
                         finally:
                             tr.root.duration_ms = (time.perf_counter() - t_start) * 1e3
                             self._store_trace(tr)
@@ -467,10 +494,15 @@ class Broker:
                     if trace_requested:
                         result.trace = tr.to_dict()
                 else:
-                    result = run_admitted()
+                    result = run_cached()
                 # a cancel acknowledged mid-flight must not turn into a
                 # success: the execution may have raced past every check
                 deadline.check("post-execute")
+                result.cache_hit = hit_box["hit"]
+                if hit_box["hit"]:
+                    # a hit's latency is this request's dict lookup, not the
+                    # original scatter's wall time
+                    result.time_used_ms = (time.perf_counter() - t_entry) * 1e3
             if partial.partial:
                 bm.meter(BrokerMeter.PARTIAL_RESPONSES).mark()
                 result.partial_result = True
@@ -526,6 +558,160 @@ class Broker:
             with self._running_lock:
                 self._running.pop(qid, None)
 
+    # -- query-cache plane (cluster/result_cache.py) --------------------------
+
+    def _compile(self, sql: str, *, stmt=None, schema=None, table: str | None = None,
+                 normalized: str | None = None, epoch=None):
+        """The single broker compile choke point — the two formerly duplicated
+        `phase_timer(REQUEST_COMPILATION)` sites both route here, so the parse
+        and plan caches have exactly one fill path and the phase counter ticks
+        only on real compile work (cache hits skip it entirely).
+
+        Parse mode (stmt=None): sql -> (statement, normalized text | None).
+        The statement may come from the shared parse cache: treat it as
+        immutable (plan mode deep-copies before star expansion).
+
+        Plan mode (stmt given): -> (expanded statement, QueryContext), cached
+        per (normalized sql, table, routing epoch); the cached prototype is
+        cloned per query with fresh hints/options dicts so per-request state
+        (deadline, tenant, trace context) never leaks between queries."""
+        import copy
+
+        from pinot_tpu.common.trace import ServerQueryPhase, phase_timer
+
+        def timer():
+            return phase_timer(ServerQueryPhase.REQUEST_COMPILATION, role="broker")
+
+        if stmt is None:
+            if self.caches is None:
+                with timer():
+                    return parse_sql(sql), None
+            return self.caches.get_or_parse(sql, on_compile=timer)
+
+        if self.caches is None or normalized is None:
+            with timer():
+                self._expand_star(stmt, schema)
+                return stmt, QueryContext.from_statement(stmt)
+        key = (normalized, table, epoch)
+        ent = self.caches.get_plan(key)
+        if ent is None:
+            with timer():
+                # the parse-tier statement is shared across requests; star
+                # expansion and context building both mutate, so plan on a copy
+                pristine = copy.deepcopy(stmt)
+                self._expand_star(pristine, schema)
+                proto = QueryContext.from_statement(pristine)
+            ent = (pristine, proto)
+            self.caches.put_plan(key, ent)
+        cached_stmt, proto = ent
+        ctx = copy.copy(proto)
+        ctx.options = dict(proto.options)
+        ctx.hints = dict(proto.hints)
+        ctx.deadline = None
+        return cached_stmt, ctx
+
+    def _cache_key(self, stmt, table: str, normalized: str | None):
+        """Result-tier key material: ((normalized sql, option fingerprint),
+        version vector, twin table list) or None when caching is off. The
+        vector covers every referenced table AND its `_REALTIME` twin — hybrid
+        queries route through both halves, so a mutation on either must change
+        the key."""
+        if self.caches is None or normalized is None:
+            return None
+        from pinot_tpu.cluster.result_cache import options_fingerprint
+
+        tables = _collect_tables(stmt) or ([table] if table else [])
+        if not tables:
+            return None
+        twins: list[str] = []
+        for t in tables:
+            twins.append(t)
+            if not t.endswith("_REALTIME"):
+                twins.append(f"{t}_REALTIME")
+        vv = self.controller.routing_versions(twins)
+        versions = tuple(sorted((t, int(v)) for t, v in vv.items()))
+        return (normalized, options_fingerprint(stmt.options)), versions, twins
+
+    def _run_cached(self, cache_state, run_admitted, partial, deadline, hit_box):
+        """Result-tier lookup around the admitted execution. Hit: clone the
+        cached response (bypassing the scheduler enqueue — quota and admission
+        already ruled). Miss: single-flight identical concurrent queries so
+        one scatter fills the cache for all, then cache the response only when
+        it is complete (partial/degraded/error responses are never cached)."""
+        from pinot_tpu.common.trace import trace_event
+
+        key, versions, twins = cache_state
+        caches = self.caches
+
+        def hit(value):
+            hit_box["hit"] = True
+            trace_event("resultCacheHit", entries=len(caches.result))
+            return self._clone_result(value)
+
+        cached = caches.result_get(key, versions)
+        if cached is not None:
+            return hit(cached)
+
+        def fill():
+            result = run_admitted()
+            if not partial.partial and not result.exceptions:
+                caches.result_put(
+                    key,
+                    self._clone_result(result),
+                    versions,
+                    realtime=self._has_consuming(twins),
+                )
+            return result
+
+        if not caches.config.single_flight:
+            return fill()
+        leader, ev = caches.result_flight.begin((key, versions))
+        if not leader:
+            budget = deadline.remaining() if deadline is not None else None
+            caches.result_flight.wait(ev, timeout=budget if budget is not None else 30.0)
+            cached = caches.result_get(key, versions)
+            if cached is not None:
+                return hit(cached)
+            # leader failed, returned partial, or we timed out: run our own
+            return run_admitted()
+        try:
+            return fill()
+        finally:
+            caches.result_flight.done((key, versions))
+
+    @staticmethod
+    def _clone_result(result: ResultTable) -> ResultTable:
+        """Detached copy for cache put/get: per-request fields (trace ids,
+        exceptions) must not flow between the filling query and later hits.
+        Row payloads are shared read-only — nothing mutates rows post-reduce."""
+        import copy
+
+        out = copy.copy(result)
+        out.exceptions = list(result.exceptions)
+        out.trace = None
+        out.trace_id = ""
+        return out
+
+    def _has_consuming(self, tables) -> bool:
+        """Any listed table with an ideal-state segment lacking committed
+        metadata (= actively consuming). Those rows advance with no metadata
+        write, so cached entries get the realtimeTtlMs freshness cap instead
+        of living until the next version bump."""
+        for t in tables:
+            ideal = self.controller.ideal_state(t)
+            if not ideal:
+                continue
+            meta = self.controller.all_segment_metadata(t)
+            if any(s not in meta for s in ideal):
+                return True
+        return False
+
+    def cache_snapshot(self) -> dict:
+        """The GET /debug/cache document."""
+        if self.caches is None:
+            return {"enabled": False, "config": self.cache_config.to_dict()}
+        return self.caches.snapshot()
+
     def _log_slow_query(self, sql: str, table: str, result: ResultTable, qid: str = "") -> None:
         """Structured slow-query log (the reference's broker query-log WARN
         path for above-threshold queries): one JSON line + ring-buffer entry
@@ -544,6 +730,7 @@ class Broker:
             "numDocsScanned": result.num_docs_scanned,
             "numRows": len(result.rows),
             "numSegmentsQueried": result.num_segments_queried,
+            "cacheHit": bool(getattr(result, "cache_hit", False)),
             "ts": time.time(),
         }
         if qid:
@@ -710,7 +897,7 @@ class Broker:
         )
         return kept
 
-    def _execute(self, stmt, sql: str, deadline=None, qid=None, partial=None) -> ResultTable:
+    def _execute(self, stmt, sql: str, deadline=None, qid=None, partial=None, normalized=None) -> ResultTable:
         t0 = time.perf_counter()
         if getattr(stmt, "explain", False) or getattr(stmt, "explain_analyze", False):
             # failing loudly beats silently executing the query and returning
@@ -724,6 +911,12 @@ class Broker:
         # joins/subqueries/set-ops/windows, or explicit SET useMultistageEngine
         use_v2 = stmt.needs_multistage or stmt.options.get("useMultistageEngine", "").lower() == "true"
         if use_v2:
+            if self.caches is not None and normalized is not None:
+                # the v2 planner mutates the statement; never hand it the
+                # shared parse-tier copy
+                import copy
+
+                stmt = copy.deepcopy(stmt)
             return self._execute_multistage(stmt, sql, deadline=deadline, qid=qid)
         table = stmt.from_table
         offline_cfg = self.controller.get_table(table)
@@ -748,9 +941,16 @@ class Broker:
         from pinot_tpu.common.trace import ServerQueryPhase, phase_timer
 
         schema = self.controller.get_schema(table) or self.controller.get_schema(rt_name)
-        with phase_timer(ServerQueryPhase.REQUEST_COMPILATION, role="broker"):
-            self._expand_star(stmt, schema)
-            ctx = QueryContext.from_statement(stmt)
+        # plan epoch: the (offline, realtime) routing versions — schema and
+        # segment-set changes both land as bumps, re-keying the cached plan
+        epoch = (
+            tuple(sorted(self.controller.routing_versions([table, rt_name]).items()))
+            if self.caches is not None and normalized is not None
+            else None
+        )
+        stmt, ctx = self._compile(
+            sql, stmt=stmt, schema=schema, table=table, normalized=normalized, epoch=epoch
+        )
         ctx.deadline = deadline
         # workload attribution: the table's server tenant rides the hints to
         # every server (accountant rollups) and labels the broker-side meter

@@ -171,6 +171,35 @@ class Controller:
         self.store.set(f"/tables/{config.table_name}/config", {"json": config.to_json()})
         if self.store.get(f"/tables/{config.table_name}/idealstate") is None:
             self.store.set(f"/tables/{config.table_name}/idealstate", {})
+        # config (re)writes can change plans/pruning: treat as a routing change
+        self.bump_routing_version(config.table_name)
+
+    # -- routing version vector ----------------------------------------------
+    # One monotonic counter per table, bumped by EVERY code path that mutates
+    # the table's segment set or its routing-relevant metadata (upload,
+    # delete, refresh, rebalance move, realtime state change, deep-store
+    # repair). The broker's result/plan caches key on these versions, so a
+    # bump implicitly invalidates every cached result computed against the
+    # old segment set — no explicit flush protocol exists or is needed. The
+    # pinotlint `cache-invalidation` checker enforces that mutation sites
+    # keep calling this.
+
+    def bump_routing_version(self, table: str) -> int:
+        """Increment and return the table's routing version."""
+        doc = self.store.update(
+            f"/tables/{table}/routingversion",
+            lambda cur: {"v": int((cur or {}).get("v", 0)) + 1},
+        )
+        return int(doc["v"])
+
+    def routing_version(self, table: str) -> int:
+        """The table's current routing version (0 = never mutated/unknown)."""
+        doc = self.store.get(f"/tables/{table}/routingversion")
+        return int((doc or {}).get("v", 0))
+
+    def routing_versions(self, tables: list[str]) -> dict[str, int]:
+        """Batched `routing_version` (one round trip for HTTP deployments)."""
+        return {t: self.routing_version(t) for t in tables}
 
     def get_table(self, name: str) -> TableConfig | None:
         doc = self.store.get(f"/tables/{name}/config")
@@ -281,6 +310,7 @@ class Controller:
         ideal = self.store.get(f"/tables/{table}/idealstate") or {}
         ideal[segment.name] = {s: "ONLINE" for s in assigned}
         self.store.set(f"/tables/{table}/idealstate", ideal)
+        self.bump_routing_version(table)
         # state transition: servers load the segment from the deep store.
         # With HA enabled, a failing server falls back to the durable retry
         # queue instead of failing the upload (Helix async transition analog).
@@ -377,6 +407,7 @@ class Controller:
         ideal = self.store.get(f"/tables/{table}/idealstate") or {}
         replicas = ideal.pop(segment_name, {})
         self.store.set(f"/tables/{table}/idealstate", ideal)
+        self.bump_routing_version(table)
         if self._transitions is not None:
             self._transitions.cancel(table, segment_name)
         handles = self.servers()
@@ -422,6 +453,7 @@ class Controller:
                 new_meta = self.segment_metadata(table, name) or {}
                 new_meta.update(keep)
                 self.store.set(f"/tables/{table}/segments/{name}", new_meta)
+                self.bump_routing_version(table)
             reloaded.append(name)
         return reloaded
 
@@ -461,6 +493,7 @@ class Controller:
         else:
             ideal.pop(segment, None)
         self.store.set(f"/tables/{table}/idealstate", ideal)
+        self.bump_routing_version(table)
 
     # -- views ---------------------------------------------------------------
 

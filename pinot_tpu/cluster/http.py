@@ -302,6 +302,11 @@ class BrokerHTTPService:
                     # hedged-scatter state: enabled flag, cumulative primary
                     # legs vs hedges issued (the <=budget-fraction evidence)
                     _send_json(self, svc.broker.hedge_snapshot())
+                elif self.path == "/debug/cache":
+                    # query-cache plane: per-tier hit/miss/eviction/
+                    # invalidation counters + sizes (runbook: low hit rate →
+                    # check normalization; staleness → version-vector series)
+                    _send_json(self, svc.broker.cache_snapshot())
                 elif self.path.partition("?")[0] == "/debug/slowQueries":
                     # structured slow-query ring buffer (broker-side triage)
                     payload = json.dumps(list(svc.broker.slow_queries)).encode()
@@ -1003,6 +1008,14 @@ class ControllerHTTPService:
                         self._json(c.ideal_state(parts[1]))
                     elif len(parts) == 3 and parts[0] == "tables" and parts[2] == "segments":
                         self._json(c.all_segment_metadata(parts[1]))
+                    elif self.path.partition("?")[0] == "/routingversions":
+                        # batched version-vector read for broker cache keys:
+                        # one RTT regardless of how many tables a query touches
+                        from urllib.parse import parse_qs
+
+                        qs = parse_qs(self.path.partition("?")[2])
+                        names = [t for t in (qs.get("tables", [""])[0]).split(",") if t]
+                        self._json(c.routing_versions(names))
                     elif len(parts) == 3 and parts[0] == "tables" and parts[2] == "consumingSegmentsInfo":
                         info = {}
                         for sid, srv in c.servers().items():
@@ -1218,6 +1231,14 @@ class RemoteControllerClient:
 
     def segment_metadata(self, table: str, segment: str) -> dict | None:
         return self.all_segment_metadata(table).get(segment)
+
+    def routing_versions(self, tables: list[str]) -> dict[str, int]:
+        if not tables:
+            return {}
+        return {t: int(v) for t, v in self._get(f"/routingversions?tables={','.join(tables)}").items()}
+
+    def routing_version(self, table: str) -> int:
+        return self.routing_versions([table]).get(table, 0)
 
     def get_table(self, name: str):
         from pinot_tpu.common.config import TableConfig

@@ -273,6 +273,7 @@ class IntegrityScrubber(ControllerPeriodicTask):
             meta = dict(meta)
             meta["fileCrc"] = crc
             self.controller.store.set(f"/tables/{table}/segments/{name}", meta)
+            self.controller.bump_routing_version(table)
             logging.getLogger("pinot_tpu.storage").warning(
                 "re-replicated corrupt deep-store copy of %s/%s from %s", table, name, sid
             )
@@ -587,6 +588,24 @@ class ClusterMetricsAggregator(ControllerPeriodicTask):
                     if key == f"broker.hedge.{kind}" or key.startswith(f"broker.hedge.{kind}{{"):
                         hedge[kind] += v
 
+        # query-cache rollup across brokers: the labelled broker.cache.*
+        # meter family folded per tier, with a derived hit-rate series
+        cache_tiers: dict[str, dict] = {}
+        for s in nodes("broker"):
+            for key, v in s["accCounters"].items():
+                if key.startswith("broker.cache."):
+                    event = key[len("broker.cache.") :].partition("{")[0]
+                    tier = self._series_labels.get(key, {}).get("cache")
+                    if tier:
+                        cache_tiers.setdefault(tier, defaultdict(int))[event] += v
+        cache_sample = {}
+        for tier, ev in sorted(cache_tiers.items()):
+            total = ev.get("hits", 0) + ev.get("misses", 0)
+            cache_sample[tier] = {
+                **{k: int(x) for k, x in sorted(ev.items())},
+                "hitRate": round(ev.get("hits", 0) / total, 4) if total else 0.0,
+            }
+
         # merged per-(tenant, table) workload + per-table scrape-window QPS
         workload: dict = {}
         for s in self._nodes.values():
@@ -638,6 +657,7 @@ class ClusterMetricsAggregator(ControllerPeriodicTask):
             "tables": table_samples,
             "freshnessBuckets": freshness,
             "hedge": hedge,
+            "cache": cache_sample,
             "workload": {f"{tenant}/{table}": dict(agg) for (tenant, table), agg in sorted(workload.items())},
             "exemplars": exemplars,
         }
@@ -663,6 +683,8 @@ class ClusterMetricsAggregator(ControllerPeriodicTask):
             m.histogram("cluster.freshnessMs").load_cumulative(sample["freshnessBuckets"])
         for kind, n in sorted((sample.get("hedge") or {}).items()):
             m.gauge("cluster.hedge", kind=kind).set(n)
+        for tier, ev in sorted((sample.get("cache") or {}).items()):
+            m.gauge("cluster.cache.hitRate", cache=tier).set(ev.get("hitRate", 0.0))
         with self._lock:
             total = len(self._nodes)
             healthy = sum(1 for s in self._nodes.values() if s["ok"])
@@ -830,6 +852,7 @@ class ClusterMetricsAggregator(ControllerPeriodicTask):
                     "p99Ms": quantile_from_buckets(sample.get("freshnessBuckets") or [], 0.99),
                 },
                 "hedge": dict(sample.get("hedge") or {"issued": 0, "won": 0, "wasted": 0}),
+                "cache": dict(sample.get("cache") or {}),
                 "workload": sample.get("workload", {}),
                 "roofline": {
                     "hbmPeakGBps": peak_gbps,

@@ -231,6 +231,7 @@ def rebalance_table(
             if meta is not None:
                 meta["servers"] = sorted(target[seg])
                 controller.store.set(f"/tables/{table}/segments/{seg}", meta)
+                controller.bump_routing_version(table)
         _progress_update(
             table,
             status="DONE",

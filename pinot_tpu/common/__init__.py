@@ -1,5 +1,6 @@
 from pinot_tpu.common.types import DataType, FieldSpec, FieldType, Schema
 from pinot_tpu.common.config import (
+    CacheConfig,
     DedupConfig,
     IndexingConfig,
     ObservabilityConfig,
@@ -14,6 +15,7 @@ __all__ = [
     "FieldSpec",
     "FieldType",
     "Schema",
+    "CacheConfig",
     "DedupConfig",
     "IndexingConfig",
     "ObservabilityConfig",

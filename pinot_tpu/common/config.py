@@ -279,6 +279,96 @@ class SchedulerConfig:
 
 
 @dataclass
+class CacheConfig:
+    """Broker query-cache knobs (the response/plan-cache tier the reference
+    keeps beside the QueryQuotaManager; SURVEY §L5).
+
+    Three cooperating tiers, all behind one switch: the result cache (reduced
+    responses keyed on normalized SQL + option fingerprint + per-table routing
+    version vector), the parse cache (raw SQL -> immutable AST), and the plan
+    cache (normalized SQL + schema/routing epoch -> star-expanded statement).
+    Invalidation is implicit: any segment-set mutation bumps the owning
+    table's routing version, which changes every affected result/plan key."""
+
+    #: master switch: False = every query takes the full
+    #: parse -> plan -> scatter -> reduce path (pre-cache behavior)
+    enabled: bool = True
+    #: cache implementation; "lru" is the only kind today (`make()` rejects
+    #: anything else, SchedulerConfig.make parity)
+    kind: str = "lru"
+    #: result-cache byte budget; least-recently-used entries evict past it
+    max_bytes: int = 64 * 1024 * 1024
+    #: result-cache entry-count bound (backstop against many tiny entries)
+    max_entries: int = 4096
+    #: optional wall-clock TTL for every result entry (0 = version-vector
+    #: invalidation only, the default: offline data only changes via bumps)
+    ttl_ms: float = 0.0
+    #: TTL cap for results touching a table with an active consuming
+    #: segment — consuming rows change without any metadata mutation, so
+    #: freshness is bounded by time, not versions (PR-12 freshness SLO)
+    realtime_ttl_ms: float = 250.0
+    #: parse-cache entry bound (raw SQL text -> parsed statement)
+    parse_max_entries: int = 2048
+    #: plan-cache entry bound (normalized SQL + epoch -> expanded statement)
+    plan_max_entries: int = 2048
+    #: single-flight de-dup: N identical concurrent queries compile once and
+    #: share one scatter result instead of racing N misses
+    single_flight: bool = True
+
+    def to_dict(self) -> dict:
+        return {
+            "enabled": self.enabled,
+            "kind": self.kind,
+            "maxBytes": self.max_bytes,
+            "maxEntries": self.max_entries,
+            "ttlMs": self.ttl_ms,
+            "realtimeTtlMs": self.realtime_ttl_ms,
+            "parseMaxEntries": self.parse_max_entries,
+            "planMaxEntries": self.plan_max_entries,
+            "singleFlight": self.single_flight,
+        }
+
+    _WIRE_KEYS = frozenset(
+        {
+            "enabled", "kind", "maxBytes", "maxEntries", "ttlMs",
+            "realtimeTtlMs", "parseMaxEntries", "planMaxEntries", "singleFlight",
+        }
+    )
+
+    @staticmethod
+    def from_dict(d: dict) -> "CacheConfig":
+        # strict: a typo'd knob silently falling back to its default would
+        # read as "cache misbehaving", so unknown keys fail loudly here
+        unknown = sorted(set(d) - CacheConfig._WIRE_KEYS)
+        if unknown:
+            raise ValueError(
+                f"unknown CacheConfig key(s): {unknown}; known: {sorted(CacheConfig._WIRE_KEYS)}"
+            )
+        return CacheConfig(
+            enabled=d.get("enabled", True),
+            kind=d.get("kind", "lru"),
+            max_bytes=int(d.get("maxBytes", 64 * 1024 * 1024)),
+            max_entries=int(d.get("maxEntries", 4096)),
+            ttl_ms=float(d.get("ttlMs", 0.0)),
+            realtime_ttl_ms=float(d.get("realtimeTtlMs", 250.0)),
+            parse_max_entries=int(d.get("parseMaxEntries", 2048)),
+            plan_max_entries=int(d.get("planMaxEntries", 2048)),
+            single_flight=d.get("singleFlight", True),
+        )
+
+    def make(self):
+        """Build the broker's QueryCaches (None when disabled); rejects
+        unknown kinds like SchedulerConfig.make rejects unknown schedulers."""
+        if not self.enabled:
+            return None
+        if self.kind.lower() != "lru":
+            raise ValueError(f"unknown cache kind: {self.kind}")
+        from pinot_tpu.cluster.result_cache import QueryCaches
+
+        return QueryCaches(self)
+
+
+@dataclass
 class StarTreeIndexConfig:
     """Parity with StarTreeIndexConfig (dimensionsSplitOrder,
     functionColumnPairs, maxLeafRecords)."""

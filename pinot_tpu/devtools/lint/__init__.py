@@ -1,11 +1,12 @@
 """pinotlint: project-invariant static analyzer for pinot_tpu.
 
-Eleven AST checkers enforce the conventions the engine's correctness actually
+Twelve AST checkers enforce the conventions the engine's correctness actually
 rests on — race discipline, jit purity, deadline/cancellation coverage, the
 error-code registry, the fault-point registry, fault-point span-event
 coverage on the query path, lock-order cycles, blocking calls made while a
-lock is held, resource leaks, atomic writes to durable artifacts, and
-kernel-registry coverage of compiled roots on the query path. The concurrency family (race-discipline,
+lock is held, resource leaks, atomic writes to durable artifacts,
+kernel-registry coverage of compiled roots on the query path, and
+routing-version bumps on segment-set mutations (query-cache invalidation). The concurrency family (race-discipline,
 lock-order, blocking-under-lock) is whole-program: all three share one
 call-graph + lock-summary build per run (`core.AnalysisSession`). See
 README.md in this directory and the module docstrings for exact rules.
@@ -17,6 +18,7 @@ Usage (code):  from pinot_tpu.devtools.lint import lint_paths
 from __future__ import annotations
 
 from pinot_tpu.devtools.lint.atomic_write import AtomicWriteChecker
+from pinot_tpu.devtools.lint.cache_invalidation import CacheInvalidationChecker
 from pinot_tpu.devtools.lint.concurrency import BlockingUnderLockChecker, LockOrderChecker
 from pinot_tpu.devtools.lint.core import Checker, Finding, run
 from pinot_tpu.devtools.lint.deadlines import DeadlineChecker
@@ -41,6 +43,7 @@ ALL_CHECKERS: dict[str, type[Checker]] = {
     "resource-leak": ResourceLeakChecker,
     "atomic-write": AtomicWriteChecker,
     "kernel-registry": KernelRegistryChecker,
+    "cache-invalidation": CacheInvalidationChecker,
 }
 
 

@@ -231,6 +231,7 @@ class RefreshSegmentTaskExecutor(PinotTaskExecutor):
         meta = controller.segment_metadata(table, name)
         meta["refreshEpoch"] = task.configs["epoch"]
         controller.store.set(f"/tables/{table}/segments/{name}", meta)
+        controller.bump_routing_version(table)
         return {"refreshed": name}
 
 

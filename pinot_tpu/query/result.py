@@ -42,6 +42,9 @@ class ResultTable:
     exceptions: list = field(default_factory=list)
     num_servers_queried: int = 0
     num_servers_responded: int = 0
+    # broker result-cache verdict for THIS request (BrokerResponse metadata):
+    # true = the response was served from cluster/result_cache.py
+    cache_hit: bool = False
 
     def __post_init__(self):
         self.rows = [[_plain(v) for v in row] for row in self.rows]
@@ -59,6 +62,7 @@ class ResultTable:
             "numSegmentsQueried": self.num_segments_queried,
             "numSegmentsPrunedByServer": self.num_segments_pruned,
             "timeUsedMs": self.time_used_ms,
+            "cacheHit": self.cache_hit,
         }
         if self.trace is not None:
             d["traceInfo"] = self.trace

@@ -592,6 +592,7 @@ class RealtimeTableManager:
             meta["endOffset"] = end_off
             meta["partition"] = partition
             self.controller.store.set(f"/tables/{self.table}/segments/{segment.name}", meta)
+            self.controller.bump_routing_version(self.table)
             self._record_stats_history(segment)
 
         return commit
@@ -613,6 +614,7 @@ class RealtimeTableManager:
                 "peerDownload": self.server.server_id,
             }
             self.controller.store.set(f"/tables/{self.table}/segments/{segment.name}", meta)
+            self.controller.bump_routing_version(self.table)
             self._record_stats_history(segment)
 
         return peer_commit

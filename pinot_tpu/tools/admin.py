@@ -109,7 +109,7 @@ def cmd_start_broker(args) -> dict:
     from pinot_tpu.cluster.broker import Broker
     from pinot_tpu.cluster.failure import FailureDetector
     from pinot_tpu.cluster.http import BrokerHTTPService, RemoteControllerClient
-    from pinot_tpu.common.config import ResilienceConfig, SchedulerConfig
+    from pinot_tpu.common.config import CacheConfig, ResilienceConfig, SchedulerConfig
 
     rc = RemoteControllerClient(args.controller_url)
     # --scheduler-json takes SchedulerConfig camelCase keys, e.g.
@@ -128,6 +128,14 @@ def cmd_start_broker(args) -> dict:
         if getattr(args, "resilience_json", "")
         else None
     )
+    # --cache-json takes CacheConfig camelCase keys, e.g.
+    # '{"maxBytes": 134217728, "realtimeTtlMs": 100}' or
+    # '{"enabled": false}'; empty string keeps the cache plane at defaults (ON)
+    cache_cfg = (
+        CacheConfig.from_dict(_json.loads(args.cache_json))
+        if getattr(args, "cache_json", "")
+        else None
+    )
     # a standalone broker process always runs a failure detector: without
     # one, a dead server is a hard query error instead of routing exclusion
     # plus one-round replica failover
@@ -135,6 +143,7 @@ def cmd_start_broker(args) -> dict:
         rc,
         scheduler_config=sched_cfg,
         resilience=res_cfg,
+        cache_config=cache_cfg,
         max_scatter_threads=args.scatter_threads,
         failure_detector=FailureDetector(),
     )
@@ -587,6 +596,12 @@ def build_parser() -> argparse.ArgumentParser:
         "--resilience-json",
         default="",
         help='ResilienceConfig overrides as camelCase JSON, e.g. \'{"hedgeEnabled": true}\'',
+    )
+    b.add_argument(
+        "--cache-json",
+        default="",
+        help='CacheConfig overrides as camelCase JSON, e.g. \'{"maxBytes": 134217728}\' '
+        'or \'{"enabled": false}\' (cache plane defaults ON)',
     )
     b.add_argument("--scatter-threads", type=int, default=8)
     b.set_defaults(fn=cmd_start_broker, blocking=True)

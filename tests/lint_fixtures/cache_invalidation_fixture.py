@@ -1,0 +1,39 @@
+"""Golden fixture for the cache-invalidation checker: segment-set store
+writes (idealstate / deep-store segment metadata paths) with and without the
+required `bump_routing_version()` call that invalidates the broker's
+result/plan caches."""
+
+
+class FakeController:
+    def __init__(self, store):
+        self.store = store
+        self.meta_store = store
+
+    def upload_without_bump(self, table, seg):
+        ideal = self.store.get(f"/tables/{table}/idealstate") or {}
+        ideal[seg] = ["s1"]
+        self.store.set(f"/tables/{table}/idealstate", ideal)  # line 15: VIOLATION
+
+    def refresh_without_bump(self, table, seg, meta):
+        self.meta_store.update(  # line 18: VIOLATION
+            f"/tables/{table}/segments/{seg}", lambda cur: meta
+        )
+
+    def upload_with_bump(self, table, seg):
+        self.store.set(f"/tables/{table}/idealstate", {seg: ["s1"]})  # CLEAN
+        self.bump_routing_version(table)
+
+    def bump_routing_version(self, table):
+        doc = self.store.update(  # CLEAN: the sanctioned version writer
+            f"/tables/{table}/routingversion",
+            lambda cur: {"v": int((cur or {}).get("v", 0)) + 1},
+        )
+        return int(doc["v"])
+
+    def read_only_paths(self, table):
+        self.store.get(f"/tables/{table}/idealstate")  # CLEAN: read, not write
+        self.store.set(f"/tables/{table}/quota", {"qps": 1})  # CLEAN: not segment-set
+        self.caches.set(f"/tables/{table}/idealstate", {})  # CLEAN: not a store receiver
+
+    def suppressed_write(self, table):
+        self.store.set(f"/tables/{table}/idealstate", {})  # pinotlint: disable=cache-invalidation — fixture: bump lives in the caller

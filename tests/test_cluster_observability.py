@@ -29,7 +29,7 @@ from pinot_tpu.cluster.periodic import (
     PeriodicTaskScheduler,
     SegmentStatusChecker,
 )
-from pinot_tpu.common import DataType, ObservabilityConfig, Schema, TableConfig
+from pinot_tpu.common import CacheConfig, DataType, ObservabilityConfig, Schema, TableConfig
 from pinot_tpu.common.faults import FAULTS, FaultRule
 from pinot_tpu.common.metrics import (
     MetricsRegistry,
@@ -445,7 +445,7 @@ def test_controller_readiness_transitions(tmp_path):
 # ---------------------------------------------------------------------------
 
 
-def _tiny_cluster(tmp_path, obs_config=None):
+def _tiny_cluster(tmp_path, obs_config=None, cache_config=None):
     controller = Controller(PropertyStore(), tmp_path / "deepstore")
     controller.register_server("server_0", Server("server_0"))
     schema = Schema.build("t", dimensions=[("d", DataType.INT)], metrics=[("v", DataType.LONG)])
@@ -460,7 +460,7 @@ def _tiny_cluster(tmp_path, obs_config=None):
                 f"t_{i}",
             ),
         )
-    return controller, Broker(controller, obs_config=obs_config)
+    return controller, Broker(controller, obs_config=obs_config, cache_config=cache_config)
 
 
 def test_attach_alert_stamps_slow_queries_and_inflight_trace(tmp_path):
@@ -532,8 +532,10 @@ def test_debug_cluster_multiprocess_merge_and_killed_node(tmp_path):
         csvc = ControllerHTTPService(controller)
         agg = ClusterMetricsAggregator(controller)
 
-        for _ in range(5):
-            r = query_broker_http(f"http://127.0.0.1:{bsvc.port}", "SELECT COUNT(*) FROM t WHERE d = 1")
+        # distinct predicates: identical SQL would hit the result cache after
+        # round one and the scatter legs under test would never reach servers
+        for i in range(5):
+            r = query_broker_http(f"http://127.0.0.1:{bsvc.port}", f"SELECT COUNT(*) FROM t WHERE d = {i}")
             assert not r.get("exceptions")
 
         r1 = agg.run_once()
@@ -588,7 +590,11 @@ def test_slo_alert_lifecycle_with_injected_latency_fault(tmp_path):
     reset_registries()
     FAULTS.reset()
     controller, broker = _tiny_cluster(
-        tmp_path, ObservabilityConfig(slow_query_threshold_ms=50.0, trace_sample_rate=1.0)
+        tmp_path,
+        ObservabilityConfig(slow_query_threshold_ms=50.0, trace_sample_rate=1.0),
+        # the lifecycle depends on repeated identical queries re-running with
+        # injected latency; a result-cache hit would mask the regression
+        cache_config=CacheConfig(enabled=False),
     )
     bsvc = BrokerHTTPService(broker, port=0)
     controller.register_broker("broker_0", "127.0.0.1", bsvc.port)

@@ -131,6 +131,26 @@ def test_kernel_registry_ignores_off_kernel_path():
     assert fs == []
 
 
+def test_cache_invalidation_fixture_findings():
+    fs = findings_for("cache_invalidation_fixture.py", checks=["cache-invalidation"])
+    assert lines_of(fs, "cache-invalidation") == [15, 18]
+    assert all("bump_routing_version" in f.message for f in fs)
+    by_line = {f.line: f.message for f in fs}
+    assert "'idealstate'" in by_line[15]  # idealstate replace without a bump
+    assert "'/segments/'" in by_line[18]  # segment-metadata update without a bump
+    # upload_with_bump, the bump itself, reads, non-segment paths, non-store
+    # receivers, and the suppressed write must all stay quiet
+    for clean in ("upload_with_bump", "bump_routing_version", "read_only_paths",
+                  "suppressed_write"):
+        assert not any(f"in {clean}()" in f.message for f in fs)
+
+
+def test_cache_invalidation_exempts_metadata_module():
+    # the PropertyStore module is the machinery under the rule, not a client
+    metadata = os.path.join(REPO, "pinot_tpu", "cluster", "metadata.py")
+    assert lint_paths([metadata], checks=["cache-invalidation"]) == []
+
+
 # ---------------------------------------------------------------------------
 # v2 whole-program checkers: lock-order, blocking-under-lock, resource-leak
 # ---------------------------------------------------------------------------

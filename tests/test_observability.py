@@ -390,7 +390,9 @@ def test_accounting_wired_through_server_path(tmp_path):
     default_accountant.per_query_limit_bytes = 1  # below any segment size
     try:
         with pytest.raises(Exception) as ei:
-            broker.execute("SELECT COUNT(*) FROM t")
+            # distinct SQL: the result cache would serve the first COUNT(*)
+            # back without ever reaching the accountant
+            broker.execute("SELECT SUM(v) FROM t")
         assert "killed" in str(ei.value)
     finally:
         default_accountant.per_query_limit_bytes = None

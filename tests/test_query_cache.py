@@ -1,0 +1,412 @@
+"""Query-cache plane tests (cluster/result_cache.py + the broker wiring).
+
+Coverage map, per the PR-15 acceptance list: result-cache hits and the
+cacheHit response stamp; whitespace-insensitive keying; invalidation via the
+routing-version vector on upload / refresh (direct + minion task) / rebalance
+/ realtime commit, including the deterministic stale-proof (upload -> query
+-> refresh -> query must return the NEW rows with cacheHit=false); byte-bound
+eviction; the realtime freshness TTL; single-flight de-dup of 32 identical
+concurrent queries asserted through the requestCompilation phase counter;
+quota charged on hits; partial/error responses never cached; and the strict
+CacheConfig wire form.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from pinot_tpu.cluster import Broker, Controller, PropertyStore, Server
+from pinot_tpu.cluster.quota import QuotaExceededError
+from pinot_tpu.cluster.rebalance import rebalance_table
+from pinot_tpu.cluster.result_cache import (
+    CacheStats,
+    ResultCache,
+    estimate_result_bytes,
+    normalize_sql,
+)
+from pinot_tpu.common import CacheConfig, DataType, Schema, TableConfig, TableType
+from pinot_tpu.common.metrics import get_registry, reset_registries
+from pinot_tpu.segment import SegmentBuilder
+
+
+@pytest.fixture(autouse=True)
+def _clean_state():
+    reset_registries()
+    yield
+    reset_registries()
+
+
+def _seg(schema, name, d, v):
+    return SegmentBuilder(schema).build(
+        {"d": np.asarray(d, dtype=np.int32), "v": np.asarray(v, dtype=np.int64)},
+        name,
+    )
+
+
+def _cluster(tmp_path, n_servers=1, replication=1, table_extra=None, cache=None):
+    controller = Controller(PropertyStore(), tmp_path / "ds")
+    for i in range(n_servers):
+        controller.register_server(f"s{i}", Server(f"s{i}"))
+    schema = Schema.build(
+        "t", dimensions=[("d", DataType.INT)], metrics=[("v", DataType.LONG)]
+    )
+    controller.add_schema(schema)
+    controller.add_table(TableConfig("t", replication=replication, extra=table_extra or {}))
+    controller.upload_segment("t", _seg(schema, "t_0", [0, 1, 2, 3], [1, 1, 1, 1]))
+    broker = Broker(controller, cache_config=cache)
+    return controller, schema, broker
+
+
+# -- result tier: hits, keying, invalidation --------------------------------
+
+
+def test_result_cache_hit_and_response_stamp(tmp_path):
+    _, _, broker = _cluster(tmp_path)
+    try:
+        first = broker.execute("SELECT SUM(v) FROM t")
+        assert first.cache_hit is False
+        assert first.to_dict()["cacheHit"] is False
+        second = broker.execute("SELECT SUM(v) FROM t")
+        assert second.cache_hit is True
+        assert second.to_dict()["cacheHit"] is True
+        assert second.rows == first.rows == [[4]]
+        snap = broker.cache_snapshot()
+        assert snap["result"]["hits"] == 1
+        assert snap["result"]["misses"] == 1
+        assert snap["result"]["hitRate"] == 0.5
+    finally:
+        broker.shutdown()
+
+
+def test_whitespace_insensitive_keying(tmp_path):
+    _, _, broker = _cluster(tmp_path)
+    try:
+        broker.execute("SELECT SUM(v) FROM t")
+        res = broker.execute("SELECT   SUM(v)\n  FROM    t")
+        assert res.cache_hit is True
+        # but a different literal is a different key
+        assert normalize_sql("SELECT 'a  b' FROM t") != normalize_sql("SELECT 'a b' FROM t")
+    finally:
+        broker.shutdown()
+
+
+def test_options_are_part_of_the_key(tmp_path):
+    _, _, broker = _cluster(tmp_path)
+    try:
+        broker.execute("SELECT SUM(v) FROM t")
+        res = broker.execute("SET timeoutMs = 9000; SELECT SUM(v) FROM t")
+        assert res.cache_hit is False  # distinct option fingerprint
+    finally:
+        broker.shutdown()
+
+
+def test_upload_invalidates_and_stale_proof_on_refresh(tmp_path):
+    """The acceptance stale-proof: upload -> query -> refresh -> query. The
+    second query must see the refreshed rows with cacheHit=false — the
+    version-vector key makes the old entry unreachable, no flush involved."""
+    controller, schema, broker = _cluster(tmp_path)
+    try:
+        assert broker.execute("SELECT SUM(v) FROM t").rows == [[4]]
+        assert broker.execute("SELECT SUM(v) FROM t").cache_hit is True
+
+        # new segment upload: version bump -> miss + fresh data
+        v0 = controller.routing_version("t")
+        controller.upload_segment("t", _seg(schema, "t_1", [4, 5], [10, 10]))
+        assert controller.routing_version("t") > v0
+        res = broker.execute("SELECT SUM(v) FROM t")
+        assert res.cache_hit is False
+        assert res.rows == [[24]]
+
+        # refresh = replacing an existing segment's bits in place
+        assert broker.execute("SELECT SUM(v) FROM t").cache_hit is True
+        controller.upload_segment("t", _seg(schema, "t_1", [4, 5], [100, 100]))
+        res = broker.execute("SELECT SUM(v) FROM t")
+        assert res.cache_hit is False
+        assert res.rows == [[204]]
+
+        # the superseded entries were detected and counted
+        assert broker.cache_snapshot()["result"]["invalidations"] >= 2
+    finally:
+        broker.shutdown()
+
+
+def test_minion_refresh_task_invalidates(tmp_path):
+    from pinot_tpu.minion import PinotTaskManager, TaskState
+    from pinot_tpu.minion.tasks import make_minion_with_builtins
+
+    controller, schema, broker = _cluster(tmp_path, table_extra={"refreshEpoch": 1})
+    try:
+        assert broker.execute("SELECT COUNT(*) FROM t").rows == [[4]]
+        assert broker.execute("SELECT COUNT(*) FROM t").cache_hit is True
+
+        tm = PinotTaskManager(controller)
+        minion = make_minion_with_builtins("minion_0", tm, controller)
+        tasks = tm.schedule_tasks("RefreshSegmentTask")
+        assert len(tasks) == 1
+        minion.run_pending()
+        assert tasks[0].state == TaskState.COMPLETED, tasks[0].error
+
+        res = broker.execute("SELECT COUNT(*) FROM t")
+        assert res.cache_hit is False  # same rows, but recomputed post-refresh
+        assert res.rows == [[4]]
+    finally:
+        broker.shutdown()
+
+
+def test_rebalance_invalidates(tmp_path):
+    # replication=2 on one server (clamped to 1): adding a server gives the
+    # rebalance real adds to apply
+    controller, schema, broker = _cluster(tmp_path, n_servers=1, replication=2)
+    try:
+        broker.execute("SELECT SUM(v) FROM t")
+        assert broker.execute("SELECT SUM(v) FROM t").cache_hit is True
+
+        controller.register_server("s9", Server("s9"))
+        v0 = controller.routing_version("t")
+        result = rebalance_table(controller, "t")
+        assert result.status == "DONE"
+        assert controller.routing_version("t") > v0
+        res = broker.execute("SELECT SUM(v) FROM t")
+        assert res.cache_hit is False
+        assert res.rows == [[4]]
+    finally:
+        broker.shutdown()
+
+
+def test_realtime_commit_bumps_routing_version(tmp_path):
+    from pinot_tpu.realtime import InMemoryStream, RealtimeTableManager
+
+    controller = Controller(PropertyStore(), tmp_path / "ds")
+    server = Server("srv")
+    controller.register_server("srv", server)
+    schema = Schema.build(
+        "events", dimensions=[("shard", DataType.INT)], metrics=[("value", DataType.LONG)]
+    )
+    controller.add_schema(schema)
+    config = TableConfig("events", table_type=TableType.REALTIME, replication=1)
+    controller.add_table(config)
+    stream = InMemoryStream(partitions=1)
+    for i in range(300):
+        stream.produce(0, {"shard": 0, "value": i})
+    v0 = controller.routing_version("events")
+    mgr = RealtimeTableManager(
+        controller, server, schema, config, stream, max_rows_per_segment=100
+    )
+    mgr.start()
+    try:
+        assert mgr.wait_until_caught_up([stream.latest_offset(0)])
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            committed = [
+                n
+                for n, m in controller.all_segment_metadata("events").items()
+                if "endOffset" in m
+            ]
+            if committed:
+                break
+            time.sleep(0.05)
+        assert committed, "no segment committed within the deadline"
+        assert controller.routing_version("events") > v0
+    finally:
+        mgr.stop()
+
+
+# -- bounds: bytes + realtime TTL -------------------------------------------
+
+
+def test_byte_bound_eviction(tmp_path):
+    controller, schema, broker = _cluster(
+        tmp_path, cache=CacheConfig(max_bytes=4096)
+    )
+    try:
+        # distinct queries whose entries together exceed the byte budget
+        for i in range(8):
+            broker.execute(f"SELECT SUM(v) FROM t WHERE d < {i}")
+        snap = broker.cache_snapshot()["result"]
+        assert snap["evictions"] > 0
+        assert snap["bytes"] <= 4096
+        assert snap["entries"] < 8
+    finally:
+        broker.shutdown()
+
+
+def test_result_cache_ttl_unit():
+    """TTL mechanics without wall-clock sleeps: `get` takes an explicit now."""
+    cache = ResultCache(max_bytes=1 << 20, max_entries=16, stats=CacheStats())
+    versions = (("t", 1),)
+    cache.put("k", "value", versions, size=100, ttl_s=0.05)
+    now = time.monotonic()
+    assert cache.get("k", versions, now=now) == "value"
+    assert cache.get("k", versions, now=now + 1.0) is None  # expired
+    assert cache.stats.invalidations == 1
+    # a version mismatch is the same death, differently caused
+    cache.put("k", "value", versions, size=100, ttl_s=None)
+    assert cache.get("k", (("t", 2),)) is None
+    assert cache.stats.invalidations == 2
+
+
+def test_realtime_entries_carry_ttl_offline_do_not(tmp_path):
+    from pinot_tpu.realtime import InMemoryStream, RealtimeTableManager
+
+    controller, schema, broker = _cluster(tmp_path)
+    try:
+        broker.execute("SELECT SUM(v) FROM t")
+        (offline_entry,) = broker.caches.result._d.values()
+        assert offline_entry["expires"] is None  # offline: lives until a bump
+
+        rt_schema = Schema.build(
+            "events",
+            dimensions=[("shard", DataType.INT)],
+            metrics=[("value", DataType.LONG)],
+        )
+        controller.add_schema(rt_schema)
+        rt_config = TableConfig("events", table_type=TableType.REALTIME, replication=1)
+        controller.add_table(rt_config)
+        stream = InMemoryStream(partitions=1)
+        stream.produce(0, {"shard": 0, "value": 7})
+        mgr = RealtimeTableManager(
+            controller, server=controller.servers()["s0"], schema=rt_schema,
+            config=rt_config, stream=stream, max_rows_per_segment=10_000,
+        )
+        mgr.start()
+        try:
+            assert mgr.wait_until_caught_up([stream.latest_offset(0)])
+            broker.execute("SELECT SUM(value) FROM events")
+            rt_entries = [
+                e
+                for e in broker.caches.result._d.values()
+                if e["expires"] is not None
+            ]
+            assert rt_entries  # consuming segment => realtimeTtlMs freshness cap
+        finally:
+            mgr.stop()
+    finally:
+        broker.shutdown()
+
+
+# -- single-flight -----------------------------------------------------------
+
+
+def test_single_flight_32_identical_queries_compile_twice(tmp_path):
+    """32 concurrent identical queries: the parse tier fills once and the
+    result-flight leader plans once — the requestCompilation phase timer must
+    tick exactly twice, and every thread gets the same complete answer."""
+    _, _, broker = _cluster(tmp_path)
+    try:
+        n = 32
+        barrier = threading.Barrier(n)
+        results, errors = [None] * n, []
+
+        def worker(i):
+            barrier.wait()
+            try:
+                results[i] = broker.execute("SELECT SUM(v) FROM t")
+            except Exception as e:  # pragma: no cover - failure detail
+                errors.append(e)
+
+        threads = [threading.Thread(target=worker, args=(i,)) for i in range(n)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=30)
+        assert not errors
+        assert all(r is not None and r.rows == [[4]] for r in results)
+        timer = get_registry("broker").timer("broker.phase.requestCompilationMs")
+        assert timer.count == 2  # one parse fill + one plan fill, 30 waiters
+        assert sum(1 for r in results if r.cache_hit) >= n - 1
+    finally:
+        broker.shutdown()
+
+
+# -- admission interplay -----------------------------------------------------
+
+
+def test_quota_charged_on_cache_hits(tmp_path):
+    _, _, broker = _cluster(tmp_path, table_extra={"queryQuotaQps": 2})
+    try:
+        assert broker.execute("SELECT SUM(v) FROM t").cache_hit is False
+        assert broker.execute("SELECT SUM(v) FROM t").cache_hit is True
+        # the hit above consumed quota: the third call is rejected BEFORE the
+        # cache is consulted — a hot cache must not bypass tenant isolation
+        with pytest.raises(QuotaExceededError):
+            broker.execute("SELECT SUM(v) FROM t")
+    finally:
+        broker.shutdown()
+
+
+def test_partial_and_error_results_never_cached(tmp_path):
+    from pinot_tpu.common.config import SchedulerConfig
+
+    controller, schema, broker = _cluster(tmp_path, n_servers=2)
+    for i in range(1, 4):
+        controller.upload_segment("t", _seg(schema, f"t_{i}", [i], [0]))
+    broker.shutdown()
+    broker = Broker(controller, scheduler_config=SchedulerConfig(num_runners=2))
+    try:
+        broker.admission.note_service_time("t", 10_000.0)
+        res = broker.execute(
+            "SET timeoutMs = 500; SET allowPartialResults = true; SELECT SUM(v) FROM t"
+        )
+        assert res.partial_result and res.exceptions
+        assert len(broker.caches.result) == 0  # degraded answer not admitted
+        res2 = broker.execute(
+            "SET timeoutMs = 500; SET allowPartialResults = true; SELECT SUM(v) FROM t"
+        )
+        assert res2.cache_hit is False
+    finally:
+        broker.shutdown()
+
+
+def test_parse_error_not_cached_and_raises_each_time(tmp_path):
+    _, _, broker = _cluster(tmp_path)
+    try:
+        for _ in range(2):
+            with pytest.raises(Exception):
+                broker.execute("SELEC nope FROM t")
+        assert len(broker.caches.result) == 0
+    finally:
+        broker.shutdown()
+
+
+# -- config wire form --------------------------------------------------------
+
+
+def test_cache_config_strict_wire_form():
+    cfg = CacheConfig.from_dict(
+        {"enabled": True, "maxBytes": 1024, "realtimeTtlMs": 50.0}
+    )
+    assert cfg.max_bytes == 1024 and cfg.realtime_ttl_ms == 50.0
+    round_trip = CacheConfig.from_dict(cfg.to_dict())
+    assert round_trip.to_dict() == cfg.to_dict()
+    with pytest.raises((KeyError, TypeError, ValueError)):
+        CacheConfig.from_dict({"maxByte": 1024})  # typo'd key must be rejected
+    with pytest.raises((KeyError, ValueError)):
+        CacheConfig(kind="arc").make()  # unknown kind must be rejected
+    assert CacheConfig(enabled=False).make() is None
+
+
+def test_cache_off_broker_never_stamps_hits(tmp_path):
+    _, _, broker = _cluster(tmp_path, cache=CacheConfig(enabled=False))
+    try:
+        assert broker.caches is None
+        for _ in range(3):
+            res = broker.execute("SELECT SUM(v) FROM t")
+            assert res.rows == [[4]] and res.cache_hit is False
+        assert broker.cache_snapshot() == {
+            "enabled": False,
+            "config": CacheConfig(enabled=False).to_dict(),
+        }
+    finally:
+        broker.shutdown()
+
+
+def test_estimate_result_bytes_scales_with_rows():
+    class R:
+        rows = [[1, "abc"]] * 100
+
+    class Small:
+        rows = [[1]]
+
+    assert estimate_result_bytes(R()) > estimate_result_bytes(Small()) > 0

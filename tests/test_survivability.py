@@ -22,7 +22,7 @@ from pinot_tpu.cluster import Broker, Controller, PropertyStore, Server
 from pinot_tpu.cluster.failure import FailureDetector
 from pinot_tpu.cluster.rebalance import rebalance_progress, rebalance_table
 from pinot_tpu.common import DataType, Schema, TableConfig, TableType
-from pinot_tpu.common.config import ResilienceConfig
+from pinot_tpu.common.config import CacheConfig, ResilienceConfig
 from pinot_tpu.common.faults import FAULTS, FaultRule, InjectedFault
 from pinot_tpu.common.metrics import BrokerMeter, broker_metrics, reset_registries
 from pinot_tpu.realtime import InMemoryStream, RealtimeTableManager
@@ -533,6 +533,9 @@ def test_cluster_chaos_smoke_kill_and_rebalance_under_load(tmp_path):
         controller,
         failure_detector=FailureDetector(initial_delay_sec=0.05),
         resilience=ResilienceConfig(hedge_enabled=True, hedge_delay_max_ms=200.0),
+        # cache off: the chaos points live on the scatter path, and a result
+        # cache hit for the repeated COUNT(*) would never reach them
+        cache_config=CacheConfig(enabled=False),
     )
     errors = []
     oks = [0]

@@ -16,7 +16,7 @@ import pytest
 
 from pinot_tpu.cluster import Broker, Controller, PropertyStore, Server
 from pinot_tpu.cluster.http import BrokerHTTPService, RemoteServerClient, ServerHTTPService
-from pinot_tpu.common import DataType, ObservabilityConfig, Schema, TableConfig
+from pinot_tpu.common import CacheConfig, DataType, ObservabilityConfig, Schema, TableConfig
 from pinot_tpu.common.faults import FAULTS, FaultRule
 from pinot_tpu.common.trace import TraceContext, active_trace, start_trace, trace_event
 from pinot_tpu.segment import SegmentBuilder
@@ -126,7 +126,9 @@ def http_cluster(tmp_path_factory):
             "customers_0",
         ),
     )
-    broker = Broker(controller)
+    # cache off: these tests observe execution spans and seeded faults on the
+    # wire, and a result-cache hit would skip both for repeated queries
+    broker = Broker(controller, cache_config=CacheConfig(enabled=False))
     yield broker, inner
     for svc in services.values():
         svc.stop()
