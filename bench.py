@@ -927,6 +927,273 @@ def qps_cache_ab_main():
     print(json.dumps(result))
 
 
+def qps_frontend_main():
+    """`bench.py qps --frontend`: the client-tail attribution harness
+    (ISSUE 16). BENCH_qps_r15 left a 0.9 ms broker p99 against a 276 ms
+    client p99 with only a flamegraph as evidence; this run makes the gap
+    a measured, named quantity on both sides of the wire:
+
+    * clients use raw keep-alive sockets and split every request into
+      connect / send / TTFB / read phases; the broker-reported timeUsedMs
+      from the response body anchors the server-side slice;
+    * `attribute_client_gap` decomposes client-minus-broker latency into
+      those named phases — acceptance requires >= 90% of the gap (overall
+      AND the top-1% tail) attributed, the before/after gate for the
+      ROADMAP item 1 asyncio frontend rewrite;
+    * the broker's own wire-phase timeline (GET /debug/frontend) is
+      cross-checked for completeness: the per-phase timers must cover
+      >= 90% of the whole-request timer (sum-to-wall invariant, live);
+    * a burst leg slams the listener with partial requests aborted via
+      SO_LINGER(1,0) RSTs and asserts the connection-plane reset counter
+      actually moves (the `process_request` blind spot fixed in ISSUE 16).
+
+    Writes BENCH_qps_r16.json and prints the same JSON line. Env knobs:
+    PINOT_TPU_QPS_CLIENTS (64), PINOT_TPU_QPS_QUERIES (12 per client),
+    PINOT_TPU_QPS_ROWS (120_000)."""
+    import shutil
+    import socket
+    import struct
+    import tempfile
+    import threading
+    import urllib.request
+
+    import pinot_tpu  # noqa: F401  (x64 + platform setup)
+    from pinot_tpu.common.frontend_obs import WIRE_PHASES, attribute_client_gap
+    from pinot_tpu.common.metrics import reset_registries
+    from pinot_tpu.cluster import Broker
+    from pinot_tpu.cluster.http import BrokerHTTPService, query_broker_http
+
+    n_clients = int(os.environ.get("PINOT_TPU_QPS_CLIENTS", 64))
+    per_client = int(os.environ.get("PINOT_TPU_QPS_QUERIES", 12))
+    n_rows = int(os.environ.get("PINOT_TPU_QPS_ROWS", 120_000))
+
+    root = tempfile.mkdtemp(prefix="pinot_tpu_qps_fe_")
+    controller, queries = _build_qps_cluster(n_rows, root)
+    broker = Broker(controller)
+    bsvc = BrokerHTTPService(broker, port=0)
+    port = bsvc.port
+    base_url = f"http://127.0.0.1:{port}"
+    controller.register_broker("broker_0", "127.0.0.1", port)
+
+    def fetch_frontend() -> dict:
+        with urllib.request.urlopen(f"{base_url}/debug/frontend", timeout=10) as resp:
+            return json.loads(resp.read())
+
+    for q in queries:  # compile/JIT warmup outside the measured window
+        query_broker_http(base_url, q)
+    log(f"qps --frontend warmup done; {n_clients} clients x {per_client} queries")
+    reset_registries()  # wire-phase timers cover exactly the measured run
+
+    samples: list = []
+    errors: list = []
+    lock = threading.Lock()
+    barrier = threading.Barrier(n_clients + 1)
+
+    def raw_request(sock, payload: bytes):
+        """One request/response over a raw socket, phase-stamped: returns
+        (sendMs, ttfbMs, readMs, body). TTFB runs from last request byte
+        written to first response byte — the slice that contains the
+        broker's entire server-side time plus accept/scheduling delay."""
+        t0 = time.perf_counter()
+        sock.sendall(payload)
+        t1 = time.perf_counter()
+        buf = b""
+        first = None
+        while b"\r\n\r\n" not in buf:
+            chunk = sock.recv(65536)
+            if not chunk:
+                raise ConnectionError("server closed mid-headers")
+            if first is None:
+                first = time.perf_counter()
+            buf += chunk
+        head, _, body = buf.partition(b"\r\n\r\n")
+        clen = 0
+        for line in head.split(b"\r\n")[1:]:
+            k, _, v = line.partition(b":")
+            if k.strip().lower() == b"content-length":
+                clen = int(v.strip())
+        while len(body) < clen:
+            chunk = sock.recv(65536)
+            if not chunk:
+                raise ConnectionError("server closed mid-body")
+            body += chunk
+        t2 = time.perf_counter()
+        return (t1 - t0) * 1e3, (first - t1) * 1e3, (t2 - first) * 1e3, body[:clen]
+
+    def client(idx: int) -> None:
+        mine, bad = [], 0
+        sock = None
+        barrier.wait()
+        for j in range(per_client):
+            q = queries[(idx + j) % len(queries)]
+            body = json.dumps({"sql": q}).encode()
+            payload = (
+                f"POST /query/sql HTTP/1.1\r\nHost: 127.0.0.1:{port}\r\n"
+                f"Content-Type: application/json\r\nContent-Length: {len(body)}\r\n"
+                "Connection: keep-alive\r\n\r\n"
+            ).encode() + body
+            t_start = time.perf_counter()
+            connect_ms = 0.0
+            try:
+                if sock is None:
+                    tc = time.perf_counter()
+                    sock = socket.create_connection(("127.0.0.1", port), timeout=60)
+                    sock.settimeout(60)
+                    connect_ms = (time.perf_counter() - tc) * 1e3
+                send_ms, ttfb_ms, read_ms, raw = raw_request(sock, payload)
+                wall_ms = (time.perf_counter() - t_start) * 1e3
+                doc = json.loads(raw)
+                if doc.get("exceptions"):
+                    bad += 1
+                    continue
+                mine.append(
+                    {
+                        "wallMs": wall_ms,
+                        "connectMs": connect_ms,
+                        "sendMs": send_ms,
+                        "ttfbMs": ttfb_ms,
+                        "readMs": read_ms,
+                        "brokerMs": float(doc.get("timeUsedMs") or 0.0),
+                    }
+                )
+            except Exception:
+                bad += 1
+                if sock is not None:
+                    try:
+                        sock.close()
+                    except OSError:
+                        pass
+                    sock = None
+        if sock is not None:
+            sock.close()
+        with lock:
+            samples.extend(mine)
+            errors.append(bad)
+
+    threads = [threading.Thread(target=client, args=(i,), daemon=True) for i in range(n_clients)]
+    for t in threads:
+        t.start()
+    barrier.wait()
+    t_run = time.perf_counter()
+    fe_during = fetch_frontend()  # live gauges under load (open/active > 0)
+    for t in threads:
+        t.join()
+    wall_s = time.perf_counter() - t_run
+
+    fe = fetch_frontend()
+    # broker wire-timeline completeness: top-level phases must cover the
+    # whole-request timer (the same sum-to-wall invariant the unit tests
+    # assert, checked here against the live histograms under load)
+    covered_ms = sum(
+        fe["phases"][p]["totalMs"] for p in WIRE_PHASES if p in fe["phases"]
+    )
+    request_total_ms = fe["request"]["totalMs"]
+    completeness = covered_ms / request_total_ms if request_total_ms else 0.0
+
+    # burst leg: partial requests aborted with RST — the reset counter and
+    # accepted counter must both move (satellite 3: accept-path accounting)
+    resets_before = fe["connections"]["reset"]
+    accepted_before = fe["connections"]["accepted"]
+    n_burst = 32
+    for _ in range(n_burst):
+        try:
+            s = socket.create_connection(("127.0.0.1", port), timeout=10)
+            s.sendall(b"POST /query/sql HTT")  # partial: handler blocks reading
+            s.setsockopt(
+                socket.SOL_SOCKET, socket.SO_LINGER, struct.pack("ii", 1, 0)
+            )
+            s.close()  # SO_LINGER(1,0) -> RST while the server reads
+        except OSError:
+            pass
+    fe_after = fe
+    deadline = time.perf_counter() + 10.0
+    while time.perf_counter() < deadline:
+        fe_after = fetch_frontend()
+        if fe_after["connections"]["reset"] >= resets_before + n_burst // 2:
+            break
+        time.sleep(0.1)
+    resets_after = fe_after["connections"]["reset"]
+    accepted_after = fe_after["connections"]["accepted"]
+
+    bsvc.stop()
+    broker.shutdown()
+    shutil.rmtree(root, ignore_errors=True)
+
+    total = n_clients * per_client
+    n_errors = sum(errors)
+    attribution = attribute_client_gap(samples)
+    wall_list = [s["wallMs"] for s in samples]
+    client_p50 = float(np.percentile(wall_list, 50)) if wall_list else 0.0
+    client_p99 = float(np.percentile(wall_list, 99)) if wall_list else 0.0
+    result = {
+        "metric": "qps_client_tail_attribution",
+        "clients": n_clients,
+        "queries": total,
+        "rows": n_rows,
+        "wall_s": round(wall_s, 3),
+        "throughput_qps": round(total / wall_s, 2),
+        "error_rate": n_errors / total,
+        "client_side": {
+            "count": len(samples),
+            "p50_ms": round(client_p50, 3),
+            "p99_ms": round(client_p99, 3),
+        },
+        # the headline: where client-minus-broker milliseconds actually go
+        "attribution": attribution,
+        "wire_timeline": {
+            "phaseTotalMs": {
+                p: fe["phases"][p]["totalMs"] for p in WIRE_PHASES if p in fe["phases"]
+            },
+            "phaseP99Ms": {
+                p: fe["phases"][p]["p99Ms"] for p in WIRE_PHASES if p in fe["phases"]
+            },
+            "requestTotalMs": round(request_total_ms, 3),
+            "requestP99Ms": fe["request"]["p99Ms"],
+            "completeness": round(completeness, 4),
+        },
+        "connections": fe_after["connections"],
+        "connections_during_run": fe_during["connections"],
+        "keepAlive": {
+            "requestsServedMean": (fe["keepAlive"]["requestsServed"] or {}).get("meanMs"),
+        },
+        "schedLag": fe_after["schedLag"],
+        "status": fe_after["status"],
+        "burst": {
+            "aborted": n_burst,
+            "resets_before": resets_before,
+            "resets_after": resets_after,
+            "accepted_before": accepted_before,
+            "accepted_after": accepted_after,
+        },
+        "note": (
+            "client p99 decomposition baseline for the ROADMAP item 1 asyncio "
+            "frontend rewrite — the rewrite's before/after gate compares this "
+            "attribution block"
+        ),
+    }
+    assert attribution["coverage"] >= 0.9, (
+        f"client-tail attribution must name >=90% of the gap: {attribution}"
+    )
+    assert attribution["tail"]["coverage"] >= 0.9, (
+        f"tail (top-1%) attribution must name >=90% of the gap: {attribution['tail']}"
+    )
+    assert completeness >= 0.9, (
+        f"broker wire timeline incomplete: phases cover {covered_ms:.1f} of "
+        f"{request_total_ms:.1f} ms ({completeness:.1%})"
+    )
+    assert resets_after > resets_before, (
+        f"burst leg produced no reset counts: {resets_before} -> {resets_after}"
+    )
+    assert accepted_after >= accepted_before + n_burst // 2, (
+        f"burst connections not counted as accepted: {accepted_before} -> {accepted_after}"
+    )
+    assert n_errors == 0, f"frontend bench saw {n_errors} client errors"
+    with open("BENCH_qps_r16.json", "w") as f:
+        json.dump(result, f, indent=2)
+        f.write("\n")
+    print(json.dumps(result))
+
+
 def _spawn_role(argv: list, procs: list, pattern: str = "listening on "):
     """Start one cluster role as a real OS process (`python -m
     pinot_tpu.tools.admin ...`), wait for its "listening on http://..." line,
@@ -1942,6 +2209,8 @@ if __name__ == "__main__":
                 qps_overload_main()
             elif "--cache-ab" in sys.argv[2:]:
                 qps_cache_ab_main()
+            elif "--frontend" in sys.argv[2:]:
+                qps_frontend_main()
             else:
                 qps_main()
             sys.exit(0)

@@ -1211,6 +1211,65 @@ def bench_kernel_obs_overhead(n=300_000):
     }
 
 
+def bench_frontend_obs_overhead(iters=20_000):
+    """Frontend request-lifecycle bookkeeping cost per HTTP request: one
+    PhaseTimeline (construct, activate, the seven hot-path marks, finish
+    with its timer folds) plus the ConnTracker request-transition pair —
+    everything the instrumented handler adds to /query/sql beyond what the
+    un-instrumented handler already did. Projected against the minimal
+    broker-side request wall (a small single-stage group-by), the share
+    must stay inside the same 2% hot-path budget as the other planes."""
+    from pinot_tpu.common import DataType, Schema
+    from pinot_tpu.common.frontend_obs import ConnTracker, PhaseTimeline
+    from pinot_tpu.common.metrics import get_registry, reset_registries
+    from pinot_tpu.query.engine import QueryEngine
+    from pinot_tpu.segment import SegmentBuilder
+
+    rng = np.random.default_rng(31)
+    n = 200_000
+    schema = Schema.build("t", dimensions=[("d", DataType.INT)], metrics=[("v", DataType.LONG)])
+    seg = SegmentBuilder(schema).build(
+        {"d": rng.integers(0, 64, n).astype(np.int32), "v": rng.integers(0, 1000, n).astype(np.int64)},
+        "t_0",
+    )
+    eng = QueryEngine([seg])
+    q = "SELECT d, SUM(v), COUNT(*) FROM t GROUP BY d"
+    eng.execute(q)  # compile
+    req_ms = _time_host(lambda: eng.execute(q), iters=9)
+
+    reset_registries()
+    reg = get_registry("broker")
+    tracker = ConnTracker("broker")
+    tracker.conn_opened()
+    marks = ("headersRead", "bodyRead", "parse", "execute", "serialize", "write", "drain")
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        tracker.request_started()
+        tl = PhaseTimeline("broker")
+        tl.activate()
+        for m in marks:
+            tl.mark(m)
+        tl.deactivate()
+        tl.finish(reg)
+        tracker.request_finished(256, 1024)
+    per_req_us = (time.perf_counter() - t0) / iters * 1e6
+    tracker.conn_closed(1.0, iters)
+    reset_registries()
+
+    projected_pct = per_req_us / (req_ms * 1e3) * 100
+    assert projected_pct < 2.0, (
+        f"frontend bookkeeping {per_req_us:.2f}µs/request = {projected_pct:.2f}% "
+        f"of the {req_ms:.1f}ms hot request — over the 2% budget"
+    )
+    return {
+        "metric": "frontend_obs_overhead",
+        "value": round(per_req_us, 3),
+        "unit": "us_per_request",
+        "hot_request_ms": round(req_ms, 3),
+        "projected_pct": round(projected_pct, 3),
+    }
+
+
 ALL = [
     bench_filter_mask,
     bench_grouped_sum_xla,
@@ -1237,6 +1296,7 @@ ALL = [
     bench_atomic_write_overhead,
     bench_scrub_overhead,
     bench_kernel_obs_overhead,
+    bench_frontend_obs_overhead,
     bench_lint_runtime,
 ]
 

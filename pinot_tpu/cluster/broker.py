@@ -437,16 +437,32 @@ class Broker:
                 # (SchedulerRejectedError -> HTTP 503 + Retry-After) when the
                 # projected completion cannot fit the remaining deadline
                 # budget, or degrade fan-out when the client allows partials
+                from pinot_tpu.common.frontend_obs import active_timeline
+
+                wire_tl = active_timeline()  # HTTP wire timeline, if any
                 if self.admission is not None:
                     from pinot_tpu.cluster.admission import DEGRADE
 
+                    t_adm = time.perf_counter()
                     decision = self.admission.decide(
                         table or "_default", deadline=deadline, allow_partial=allow_partial
                     )
+                    if wire_tl is not None:
+                        wire_tl.record_sub(
+                            "admission", (time.perf_counter() - t_adm) * 1e3
+                        )
                     if decision == DEGRADE:
                         partial.degrade = True
 
+                t_submit = time.perf_counter()
+
                 def run_query():
+                    # dequeue-start minus submit = scheduler queue wait: the
+                    # slice of `execute` spent waiting for an admission slot
+                    if wire_tl is not None:
+                        wire_tl.record_sub(
+                            "queueWait", (time.perf_counter() - t_submit) * 1e3
+                        )
                     return self._execute(
                         stmt, sql, deadline=deadline, qid=qid, partial=partial,
                         normalized=normalized,
@@ -478,6 +494,12 @@ class Broker:
                     tctx = TraceContext.mint()
                     t_start = time.perf_counter()
                     with start_trace(request_id=qid, context=tctx, service="broker") as tr:
+                        if wire_tl is not None:
+                            # the timeline finishes after the response write:
+                            # attaching the trace here lets finish() fold the
+                            # COMPLETE wire-phase set (incl. serialize/write/
+                            # drain) into phaseTimesMs under http.* keys
+                            wire_tl.trace = tr
                         # expose the live trace to attach_alert(): a firing
                         # SLO alert attributable to this request id lands as
                         # a span event while the query is still in flight
@@ -746,6 +768,17 @@ class Broker:
         if result.trace_id:
             # exemplar: join the slow-query log entry to /debug/traces/{id}
             entry["traceId"] = result.trace_id
+        from pinot_tpu.common.frontend_obs import active_timeline
+
+        wire_tl = active_timeline()
+        if wire_tl is not None:
+            # wire-phase breakdown gathered so far (bodyRead/parse + the
+            # execute sub-phases; serialize/write happen after logging):
+            # "was the slow query slow on the engine or on the socket?"
+            snap = wire_tl.snapshot()
+            entry["wirePhasesMs"] = snap["phasesMs"]
+            if snap["subPhasesMs"]:
+                entry["wireSubPhasesMs"] = snap["subPhasesMs"]
         self.slow_queries.append(entry)
         logging.getLogger("pinot_tpu.slowquery").warning(json.dumps(entry, sort_keys=True))
 

@@ -17,7 +17,9 @@ from __future__ import annotations
 
 import json
 import socket
+import sys
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from pathlib import Path
 from urllib.parse import urlsplit
@@ -26,6 +28,15 @@ from pinot_tpu.cluster.broker import Broker
 from pinot_tpu.cluster.server import Server
 from pinot_tpu.common import datatable
 from pinot_tpu.common.errors import QueryErrorCode, code_of, http_status_of, retry_after_of
+from pinot_tpu.common.frontend_obs import (
+    ConnTracker,
+    CountingReader,
+    CountingWriter,
+    PhaseTimeline,
+    SchedLagProbe,
+    active_timeline,
+    frontend_snapshot,
+)
 from pinot_tpu.common.wire import FRAME_END, FRAME_ERR, get_pool, read_exact
 
 
@@ -34,7 +45,25 @@ def _host_port(base_url: str) -> tuple[str, int]:
     return u.hostname or "127.0.0.1", u.port or (443 if u.scheme == "https" else 80)
 
 
-def _serve(handler_cls, port: int) -> tuple[ThreadingHTTPServer, int, threading.Thread]:
+def _frontend_role(service_obj, role: str) -> str | None:
+    """The observability role for a service's HTTP plane, or None when
+    ObservabilityConfig.frontend_obs_enabled is off for the owning broker
+    (servers/controllers without a config default to instrumented)."""
+    cfg = getattr(service_obj, "obs_config", None)
+    return role if getattr(cfg, "frontend_obs_enabled", True) else None
+
+
+def _tl_mark(name: str) -> None:
+    """Close the current wire-phase interval on the active request timeline
+    (no-op when the frontend plane is off)."""
+    tl = active_timeline()
+    if tl is not None:
+        tl.mark(name)
+
+
+def _serve(
+    handler_cls, port: int, role: str | None = None
+) -> tuple[ThreadingHTTPServer, int, threading.Thread]:
     class _Server(ThreadingHTTPServer):
         # socketserver's default accept backlog of 5 refuses connections the
         # moment 100s of clients connect at once (bench.py qps drives 128+);
@@ -45,16 +74,44 @@ def _serve(handler_cls, port: int) -> tuple[ThreadingHTTPServer, int, threading.
             super().__init__(*args, **kwargs)
             self._live_conns: set = set()
             self._conn_lock = threading.Lock()
+            self._obs_role = role
+            self._conn_tracker = ConnTracker(role) if role is not None else None
+            # accept() timestamps keyed by socket, consumed by the handler's
+            # setup(): measures accept->handler-thread dispatch delay
+            self._accept_ts: dict = {}
 
         def process_request(self, request, client_address):
             with self._conn_lock:
                 self._live_conns.add(request)
-            super().process_request(request, client_address)
+                if self._conn_tracker is not None:
+                    self._accept_ts[request] = time.perf_counter()
+            try:
+                super().process_request(request, client_address)
+            except Exception:
+                # the accept succeeded but the socket never reached a
+                # handler thread (thread-spawn failure under load): that is
+                # a refused connection — count it before socketserver's
+                # handle_error/shutdown_request cleanup
+                if self._conn_tracker is not None:
+                    self._conn_tracker.conn_refused()
+                raise
 
         def shutdown_request(self, request):
             with self._conn_lock:
                 self._live_conns.discard(request)
+                self._accept_ts.pop(request, None)
             super().shutdown_request(request)
+
+        def handle_error(self, request, client_address):
+            # peer aborts (RST mid-request, write to a closed socket) are an
+            # accounting event on the connection plane, not a crash worth a
+            # stderr traceback
+            exc = sys.exc_info()[1]
+            if isinstance(exc, (ConnectionError, TimeoutError)):
+                if self._conn_tracker is not None:
+                    self._conn_tracker.conn_reset()
+                return
+            super().handle_error(request, client_address)
 
         def shutdown(self):
             # stop the accept loop, then force-close accepted keep-alive
@@ -82,9 +139,123 @@ def _serve(handler_cls, port: int) -> tuple[ThreadingHTTPServer, int, threading.
     # behind the peer's delayed ACK
     handler_cls.disable_nagle_algorithm = True
     httpd = _Server(("127.0.0.1", port), handler_cls)
+    if role is not None:
+        # one heartbeat thread per process no matter how many services start
+        SchedLagProbe.ensure(role)
     t = threading.Thread(target=httpd.serve_forever, daemon=True)
     t.start()
     return httpd, httpd.server_address[1], t
+
+
+class _InstrumentedHandler(BaseHTTPRequestHandler):
+    """BaseHTTPRequestHandler with the request-lifecycle observability plane
+    woven into the stdlib hooks (no-op passthrough when the owning _Server
+    carries no ConnTracker):
+
+    * setup()              — connection accounting + byte-counting rfile/wfile
+    * parse_request()      — starts the PhaseTimeline at the request's first
+                             byte (keep-alive idle excluded), marks
+                             `headersRead`, charges the accept->thread
+                             dispatch delay to the first request
+    * handle_one_request() — finishes the timeline (drain/handler remainder),
+                             folds phases into `<role>.http.phase.*` timers,
+                             counts peer resets instead of raising
+    * send_response()      — `<role>.http.status{code=}` labelled meters
+    * finish()             — connection lifetime + requests-served histograms
+
+    Hot endpoints (broker /query/sql, server /query) add the finer
+    bodyRead/parse/execute/serialize/write marks via `_tl_mark`."""
+
+    def setup(self):
+        super().setup()
+        tracker = getattr(self.server, "_conn_tracker", None)
+        self._fe_tracker = tracker
+        self._fe_tl = None
+        self._fe_started = False
+        if tracker is None:
+            return
+        self.rfile = CountingReader(self.rfile)
+        self.wfile = CountingWriter(self.wfile)
+        self._fe_conn_t0 = time.perf_counter()
+        self._fe_requests = 0
+        self._fe_first = True
+        with self.server._conn_lock:
+            accept_t = self.server._accept_ts.pop(self.request, None)
+        # accept -> handler-thread dispatch delay: the thread-per-connection
+        # starvation signal, charged to the first request's `accept` phase
+        self._fe_accept_ms = (
+            (self._fe_conn_t0 - accept_t) * 1e3 if accept_t is not None else 0.0
+        )
+        tracker.conn_opened()
+
+    def parse_request(self):
+        tracker = self._fe_tracker
+        if tracker is None:
+            return super().parse_request()
+        # timeline epoch = first byte of this request, so keep-alive idle and
+        # client think time never pollute the request wall
+        t0 = self.rfile.first_byte_t
+        tl = PhaseTimeline(self.server._obs_role, t0=t0)
+        if self._fe_first:
+            self._fe_first = False
+            tl.record_pre("accept", self._fe_accept_ms)
+        self._fe_tl = tl
+        tl.activate()
+        ok = super().parse_request()
+        tl.mark("headersRead")
+        if ok:
+            self._fe_requests += 1
+            self._fe_started = True
+            tracker.request_started()
+        return ok
+
+    def handle_one_request(self):
+        tracker = self._fe_tracker
+        if tracker is None:
+            super().handle_one_request()
+            return
+        self.rfile.begin_request()
+        self.wfile.begin_request()
+        try:
+            super().handle_one_request()
+        except (ConnectionError, TimeoutError):
+            # peer reset / write to a closed socket mid-request: count it
+            # and end the keep-alive loop instead of letting the handler
+            # thread die with a traceback
+            tracker.conn_reset()
+            self.close_connection = True
+        finally:
+            tl = self._fe_tl
+            if tl is not None:
+                self._fe_tl = None
+                # instrumented endpoints marked `write` already: the rest is
+                # the post-handler flush (drain). Coarse endpoints charge
+                # everything since headersRead to `handler`.
+                tl.mark("drain" if "write" in tl.phases else "handler")
+                tl.deactivate()
+                tl.finish()
+            if self._fe_started:
+                self._fe_started = False
+                tracker.request_finished(self.rfile.taken(), self.wfile.taken())
+
+    def send_response(self, code, message=None):
+        role = getattr(self.server, "_obs_role", None)
+        if role is not None:
+            from pinot_tpu.common.metrics import get_registry
+
+            get_registry(role).meter(f"{role}.http.status", code=str(code)).mark()
+        super().send_response(code, message)
+
+    def finish(self):
+        try:
+            super().finish()
+        finally:
+            tracker = getattr(self, "_fe_tracker", None)
+            if tracker is not None:
+                self._fe_tracker = None
+                tracker.conn_closed(
+                    (time.perf_counter() - self._fe_conn_t0) * 1e3, self._fe_requests
+                )
 
 
 def _serve_metrics(handler, registry) -> None:
@@ -190,7 +361,7 @@ class BrokerHTTPService:
     def __init__(self, broker: Broker, port: int = 0):
         svc = self
 
-        class Handler(BaseHTTPRequestHandler):
+        class Handler(_InstrumentedHandler):
             def log_message(self, *a):  # quiet
                 pass
 
@@ -203,7 +374,10 @@ class BrokerHTTPService:
                     self.send_error(404)
                     return
                 n = int(self.headers.get("Content-Length", 0))
-                body = json.loads(self.rfile.read(n) or b"{}")
+                raw = self.rfile.read(n)
+                _tl_mark("bodyRead")
+                body = json.loads(raw or b"{}")
+                _tl_mark("parse")
                 if self.path == "/debug/alerts/attach":
                     # controller SLO plane pushing an alert transition: stamp
                     # alertId into matching slow-query exemplars and emit a
@@ -244,9 +418,12 @@ class BrokerHTTPService:
                         self.wfile.write(payload)
                         return
                     res = svc.broker.execute(body["sql"], identity=identity)
+                    _tl_mark("execute")
                     payload = json.dumps(res.to_dict()).encode()
+                    _tl_mark("serialize")
                     self.send_response(200)
                 except PermissionError as e:
+                    _tl_mark("execute")
                     payload = json.dumps({"exceptions": [{"message": str(e)}]}).encode()
                     self.send_response(403)
                 except Exception as e:  # error surface parity: exceptions JSON
@@ -254,6 +431,7 @@ class BrokerHTTPService:
                     # error codes (BrokerResponse errorCode parity); sampled
                     # queries add the trace exemplar id, accountant kills
                     # their structured reason
+                    _tl_mark("execute")
                     entry = {"errorCode": code_of(e), "message": str(e)}
                     if getattr(e, "trace_id", None):
                         entry["traceId"] = e.trace_id
@@ -272,6 +450,7 @@ class BrokerHTTPService:
                 self.send_header("Content-Length", str(len(payload)))
                 self.end_headers()
                 self.wfile.write(payload)
+                _tl_mark("write")
 
             def do_GET(self):
                 if self.path == "/health":
@@ -293,6 +472,15 @@ class BrokerHTTPService:
                     # first query hits this broker (stable scrape schema)
                     reg.timer(BrokerTimer.QUERY_TOTAL)
                     _serve_metrics(self, reg)
+                elif self.path == "/debug/frontend":
+                    # request-lifecycle & transport plane: connection gauges,
+                    # wire-phase histograms, status rates, scheduling lag
+                    _send_json(
+                        self,
+                        frontend_snapshot(
+                            "broker", tracker=getattr(self.server, "_conn_tracker", None)
+                        ),
+                    )
                 elif self.path == "/debug/admission":
                     # live admission-plane state: scheduler queue depths,
                     # per-group tokens, service-time estimates, shed/quota
@@ -370,7 +558,9 @@ class BrokerHTTPService:
                     self.send_error(404)
 
         self.broker = broker
-        self.httpd, self.port, self._thread = _serve(Handler, port)
+        self.httpd, self.port, self._thread = _serve(
+            Handler, port, role=_frontend_role(broker, "broker")
+        )
 
     def stop(self):
         self.httpd.shutdown()
@@ -386,7 +576,7 @@ class ServerHTTPService:
     def __init__(self, server: Server, port: int = 0):
         svc = self
 
-        class Handler(BaseHTTPRequestHandler):
+        class Handler(_InstrumentedHandler):
             def log_message(self, *a):
                 pass
 
@@ -560,20 +750,25 @@ class ServerHTTPService:
 
                 n = int(self.headers.get("Content-Length", 0))
                 try:
+                    raw = self.rfile.read(n)
+                    _tl_mark("bodyRead")
                     with phase_timer(ServerQueryPhase.REQUEST_DESERIALIZATION, role="server"):
-                        body = json.loads(self.rfile.read(n) or b"{}")
+                        body = json.loads(raw or b"{}")
+                    _tl_mark("parse")
                     out = svc.server.execute_partials(
                         body["table"],
                         body["sql"],
                         body.get("segments", []),
                         _hints_with_traceparent(body.get("hints") or {}, self.headers),
                     )
+                    _tl_mark("execute")
                 except Exception as e:
                     # surface the real error to the broker instead of a
                     # dropped connection; accountant kills keep their reason.
                     # Scheduler rejections (queue overflow) ride their real
                     # status (503) + Retry-After so the broker can classify
                     # the shed without string-matching
+                    _tl_mark("execute")
                     doc = {"error": f"{type(e).__name__}: {e}", "errorCode": code_of(e)}
                     if getattr(e, "kill_reason", None):
                         doc["killReason"] = e.kill_reason
@@ -592,11 +787,13 @@ class ServerHTTPService:
                     # writelines() gather-writes them without materializing
                     # the payload a second time (no BytesIO/getvalue concat)
                     segments = datatable.encode_segments(out)
+                _tl_mark("serialize")
                 self.send_response(200)
                 self.send_header("Content-Type", "application/x-pinot-datatable")
                 self.send_header("Content-Length", str(sum(len(s) for s in segments)))
                 self.end_headers()
                 self.wfile.writelines(segments)
+                _tl_mark("write")
 
             def do_GET(self):
                 if self.path == "/health":
@@ -624,6 +821,14 @@ class ServerHTTPService:
                     except ValueError:
                         top = 10
                     _send_json(self, KERNELS.roofline(top=top))
+                elif self.path == "/debug/frontend":
+                    # request-lifecycle & transport plane (server role)
+                    _send_json(
+                        self,
+                        frontend_snapshot(
+                            "server", tracker=getattr(self.server, "_conn_tracker", None)
+                        ),
+                    )
                 elif self.path == "/debug/admission":
                     # live scheduler state (server role): queue depths,
                     # in-flight counts, per-group tokens
@@ -703,7 +908,9 @@ class ServerHTTPService:
                     self.send_error(404)
 
         self.server = server
-        self.httpd, self.port, self._thread = _serve(Handler, port)
+        self.httpd, self.port, self._thread = _serve(
+            Handler, port, role=_frontend_role(server, "server")
+        )
 
     def stop(self):
         self.httpd.shutdown()
@@ -938,7 +1145,7 @@ class ControllerHTTPService:
         self.controller = controller
         self.task_manager = task_manager
 
-        class Handler(BaseHTTPRequestHandler):
+        class Handler(_InstrumentedHandler):
             def log_message(self, *a):
                 pass
 
@@ -974,6 +1181,13 @@ class ControllerHTTPService:
                         self._json({"status": "OK"})
                     elif self.path == "/health/ready":
                         _serve_ready(self, c.readiness)
+                    elif self.path == "/debug/frontend":
+                        self._json(
+                            frontend_snapshot(
+                                "controller",
+                                tracker=getattr(self.server, "_conn_tracker", None),
+                            )
+                        )
                     elif self.path.partition("?")[0] == "/debug/cluster":
                         # federated cluster view assembled by the
                         # ClusterMetricsAggregator periodic task
@@ -1171,7 +1385,9 @@ class ControllerHTTPService:
                 except Exception as e:
                     self._json({"error": f"{type(e).__name__}: {e}", "errorCode": code_of(e)}, 500)
 
-        self.httpd, self.port, self._thread = _serve(Handler, port)
+        self.httpd, self.port, self._thread = _serve(
+            Handler, port, role=_frontend_role(controller, "controller")
+        )
 
     def stop(self):
         self.httpd.shutdown()

@@ -391,11 +391,19 @@ class ClusterMetricsAggregator(ControllerPeriodicTask):
                     roofline = (json.loads(self.fetch(f"{base}/debug/roofline")) or {}).get("kernels") or []
                 except Exception:  # noqa: BLE001  # pinotlint: disable=deadline-swallow — optional surface; a node without /debug/roofline still contributes metrics
                     roofline = []
+            frontend = None
+            try:
+                # request-lifecycle/transport plane (latest-snapshot
+                # semantics like roofline: the endpoint reports live gauges
+                # and process-lifetime phase histograms)
+                frontend = json.loads(self.fetch(f"{base}/debug/frontend")) or None
+            except Exception:  # noqa: BLE001  # pinotlint: disable=deadline-swallow — optional surface; a node without /debug/frontend still contributes metrics
+                frontend = None
             return {"ok": True, "snapshot": snap, "workload": workload, "slow": slow,
-                    "roofline": roofline, "error": None}
+                    "roofline": roofline, "frontend": frontend, "error": None}
         except Exception as e:  # noqa: BLE001  # pinotlint: disable=deadline-swallow — the federated scrape must never raise: a down/malformed node marks its series stale and the sweep continues
             return {"ok": False, "snapshot": None, "workload": [], "slow": [],
-                    "roofline": [], "error": f"{type(e).__name__}: {e}"}
+                    "roofline": [], "frontend": None, "error": f"{type(e).__name__}: {e}"}
 
     # -- fold -----------------------------------------------------------------
 
@@ -412,6 +420,12 @@ class ClusterMetricsAggregator(ControllerPeriodicTask):
             # the endpoint reports process-lifetime totals, so the newest
             # snapshot IS the accumulation (no delta fold)
             "roofline": [],
+            # latest /debug/frontend document (same latest-snapshot
+            # semantics: connection gauges are live state, not counters)
+            "frontend": None,
+            # latest gauge values from the metrics snapshot (ingest lag,
+            # connection-plane open/active/idle): point-in-time, no fold
+            "rawGauges": {},
         }
 
     @staticmethod
@@ -429,11 +443,13 @@ class ClusterMetricsAggregator(ControllerPeriodicTask):
     def _fold_node(self, st: dict, res: dict, now_ms: float) -> None:
         """Fold one successful scrape into the node's monotone accumulations
         (caller holds self._lock; pure arithmetic only)."""
-        counters, buckets, timers = {}, {}, {}
+        counters, buckets, timers, gauges = {}, {}, {}, {}
         for key, entry in res["snapshot"].items():
             t = entry.get("type")
             if t == "meter":
                 counters[key] = int(entry.get("count") or 0)
+            elif t == "gauge":
+                gauges[key] = entry.get("value")
             elif t in ("timer", "histogram"):
                 buckets[key] = self._per_bucket(entry.get("buckets") or [])
                 timers[key] = {
@@ -488,9 +504,11 @@ class ClusterMetricsAggregator(ControllerPeriodicTask):
                 else:
                     acc[f] += max(0, v - prev.get(f, 0))
         st["roofline"] = res.get("roofline") or st["roofline"]
+        st["frontend"] = res.get("frontend") or st["frontend"]
 
         st["rawCounters"], st["rawBuckets"] = counters, buckets
         st["rawTimer"], st["rawWorkload"] = timers, workload
+        st["rawGauges"] = gauges
         st["lastScrapeMs"] = now_ms
 
     @staticmethod
@@ -580,6 +598,46 @@ class ClusterMetricsAggregator(ControllerPeriodicTask):
             )
             entry["freshnessBuckets"] = merge_cumulative_buckets(lists)
 
+        # ingest plane (ROADMAP item 4 starter): per-(table, partition)
+        # consumer lag from the server.ingest.lagEvents gauges (latest
+        # point-in-time values) plus merged per-table commit-latency buckets
+        ingest_lag: dict[str, dict[str, int]] = {}
+        commit_lists: dict[str, list] = {}
+        commit_totals: dict[str, dict] = {}
+        for s in nodes("server"):
+            for key, v in s["rawGauges"].items():
+                if key.startswith("server.ingest.lagEvents{"):
+                    lbl = self._series_labels.get(key, {})
+                    t, p = lbl.get("table"), lbl.get("partition")
+                    if t and p is not None:
+                        ingest_lag.setdefault(t, {})[p] = int(v or 0)
+            for key, acc in s["accBuckets"].items():
+                if key.startswith("server.ingest.commitLatencyMs{"):
+                    t = self._series_labels.get(key, {}).get("table")
+                    if t:
+                        commit_lists.setdefault(t, []).append(self._cumulative(acc))
+            for key, tm in s["accTimer"].items():
+                if key.startswith("server.ingest.commitLatencyMs{"):
+                    t = self._series_labels.get(key, {}).get("table")
+                    if t:
+                        tot = commit_totals.setdefault(t, {"count": 0, "totalMs": 0.0})
+                        tot["count"] += tm.get("count", 0)
+                        tot["totalMs"] += tm.get("totalMs", 0.0)
+        ingest_sample = {}
+        for t in sorted(set(ingest_lag) | set(commit_lists)):
+            merged = merge_cumulative_buckets(commit_lists.get(t, []))
+            tot = commit_totals.get(t, {"count": 0, "totalMs": 0.0})
+            ingest_sample[t] = {
+                "lagEventsByPartition": dict(sorted(ingest_lag.get(t, {}).items())),
+                "lagEvents": sum(ingest_lag.get(t, {}).values()),
+                "commits": tot["count"],
+                "commitLatency": {
+                    "p50Ms": quantile_from_buckets(merged, 0.5),
+                    "p99Ms": quantile_from_buckets(merged, 0.99),
+                    "totalMs": round(tot["totalMs"], 3),
+                },
+            }
+
         # hedged-scatter rollup across brokers (labelled per-table meters)
         hedge = {"issued": 0, "won": 0, "wasted": 0}
         for s in nodes("broker"):
@@ -656,6 +714,7 @@ class ClusterMetricsAggregator(ControllerPeriodicTask):
             ),
             "tables": table_samples,
             "freshnessBuckets": freshness,
+            "ingest": ingest_sample,
             "hedge": hedge,
             "cache": cache_sample,
             "workload": {f"{tenant}/{table}": dict(agg) for (tenant, table), agg in sorted(workload.items())},
@@ -785,6 +844,40 @@ class ClusterMetricsAggregator(ControllerPeriodicTask):
                 }
             sample = self._last_sample
             rates = dict(self._table_rates)
+            # merge per-node /debug/frontend documents by role: connection
+            # and status counters sum, phase histograms merge by bucket (so
+            # cluster-level phase p99s are exact, not averages of averages),
+            # scheduling lag stays per-node (a starved node must not hide
+            # behind a healthy fleet median)
+            fe_roles: dict[str, dict] = {}
+            for nid, s in self._nodes.items():
+                fe = s.get("frontend")
+                if not fe:
+                    continue
+                agg = fe_roles.setdefault(
+                    fe.get("role") or s["role"],
+                    {
+                        "nodes": 0,
+                        "connections": defaultdict(int),
+                        "status": defaultdict(int),
+                        "phaseLists": {},
+                        "phaseTotals": {},
+                        "schedLagByNode": {},
+                    },
+                )
+                agg["nodes"] += 1
+                for k, v in (fe.get("connections") or {}).items():
+                    agg["connections"][k] += int(v or 0)
+                for code, cnt in (fe.get("status") or {}).items():
+                    agg["status"][code] += int(cnt or 0)
+                for name, ph in (fe.get("phases") or {}).items():
+                    agg["phaseLists"].setdefault(name, []).append(
+                        [(float(le), int(c)) for le, c in (ph.get("buckets") or [])]
+                    )
+                    tot = agg["phaseTotals"].setdefault(name, {"count": 0, "totalMs": 0.0})
+                    tot["count"] += int(ph.get("count") or 0)
+                    tot["totalMs"] += float(ph.get("totalMs") or 0.0)
+                agg["schedLagByNode"][nid] = fe.get("schedLag")
             # merge per-server roofline rows by (kernel, shape-bucket):
             # calls/ms/bytes/flops sum across servers; achieved bandwidth and
             # the gap are recomputed from the merged totals
@@ -828,6 +921,26 @@ class ClusterMetricsAggregator(ControllerPeriodicTask):
             (r for r in roofline_rows if r["rooflineGap"] is not None),
             key=lambda r: -r["lostMs"],
         )[:10]
+        frontend_doc = {}
+        for role, agg in sorted(fe_roles.items()):
+            phases = {}
+            for name, lists in sorted(agg["phaseLists"].items()):
+                merged = merge_cumulative_buckets(lists)
+                tot = agg["phaseTotals"][name]
+                phases[name] = {
+                    "count": tot["count"],
+                    "totalMs": round(tot["totalMs"], 3),
+                    "meanMs": round(tot["totalMs"] / tot["count"], 3) if tot["count"] else 0.0,
+                    "p50Ms": quantile_from_buckets(merged, 0.5),
+                    "p99Ms": quantile_from_buckets(merged, 0.99),
+                }
+            frontend_doc[role] = {
+                "nodes": agg["nodes"],
+                "connections": dict(agg["connections"]),
+                "status": dict(sorted(agg["status"].items())),
+                "phases": phases,
+                "schedLagByNode": agg["schedLagByNode"],
+            }
         by_qps = sorted(rates.items(), key=lambda kv: -kv[1].get("qps", 0.0))[:10]
         by_cpu = sorted(rates.items(), key=lambda kv: -kv[1].get("cpuTimeNs", 0))[:10]
         doc = {
@@ -851,6 +964,8 @@ class ClusterMetricsAggregator(ControllerPeriodicTask):
                     "p50Ms": quantile_from_buckets(sample.get("freshnessBuckets") or [], 0.5),
                     "p99Ms": quantile_from_buckets(sample.get("freshnessBuckets") or [], 0.99),
                 },
+                "ingest": dict(sample.get("ingest") or {}),
+                "frontend": frontend_doc,
                 "hedge": dict(sample.get("hedge") or {"issued": 0, "won": 0, "wasted": 0}),
                 "cache": dict(sample.get("cache") or {}),
                 "workload": sample.get("workload", {}),

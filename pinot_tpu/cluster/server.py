@@ -612,13 +612,20 @@ class Server:
             from pinot_tpu.common.metrics import server_metrics
             from pinot_tpu.common.trace import ServerQueryPhase, active_trace
 
+            from pinot_tpu.common.frontend_obs import active_timeline
+
             trace = active_trace()
+            wire_tl = active_timeline()
             t_sub = time.perf_counter()
 
             def run():
                 wait_ms = (time.perf_counter() - t_sub) * 1e3
                 if trace is not None:
                     trace.record_phase(ServerQueryPhase.SCHEDULER_WAIT, wait_ms)
+                if wire_tl is not None:
+                    # HTTP wire timeline sub-phase: the queue-wait slice of
+                    # this request's `execute` on the server side
+                    wire_tl.record_sub(ServerQueryPhase.SCHEDULER_WAIT.value, wait_ms)
                 # aggregate phase timer: /metrics carries scheduler wait even
                 # for untraced queries (phase_timer role= parity)
                 server_metrics().timer(

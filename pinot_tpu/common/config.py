@@ -58,6 +58,13 @@ class ObservabilityConfig:
     #: bandwidth against. Default is v5e-class HBM; a config number rather
     #: than a probed one so CPU tier-1 roofline output stays deterministic.
     hbm_peak_gbps: float = 819.0
+    #: instrument the HTTP plane with per-request wire-phase timelines and
+    #: connection gauges (common/frontend_obs.py, GET /debug/frontend). On
+    #: by default — the bookkeeping is a few dict writes per request.
+    frontend_obs_enabled: bool = True
+    #: heartbeat interval of the scheduling-lag probe (runtime.schedLagMs);
+    #: <= 0 disables the probe thread
+    sched_lag_interval_ms: float = 50.0
 
     def to_dict(self) -> dict:
         return {
@@ -71,6 +78,8 @@ class ObservabilityConfig:
             "sloObjectives": dict(self.slo_objectives),
             "kernelObsEnabled": self.kernel_obs_enabled,
             "hbmPeakGBps": self.hbm_peak_gbps,
+            "frontendObsEnabled": self.frontend_obs_enabled,
+            "schedLagIntervalMs": self.sched_lag_interval_ms,
         }
 
     @staticmethod
@@ -86,6 +95,8 @@ class ObservabilityConfig:
             dict(d.get("sloObjectives", {})),
             d.get("kernelObsEnabled", True),
             d.get("hbmPeakGBps", 819.0),
+            d.get("frontendObsEnabled", True),
+            d.get("schedLagIntervalMs", 50.0),
         )
 
 

@@ -528,6 +528,18 @@ class ServerHistogram(Enum):
     FRESHNESS = "server.freshnessMs"
 
 
+class IngestGauge(Enum):
+    #: per-(table, partition) consumer lag in events: upstream head minus
+    #: the committed read offset (the "how far behind" the freshness SLO
+    #: can't distinguish from slow commits on its own)
+    LAG_EVENTS = "server.ingest.lagEvents"
+
+
+class IngestTimer(Enum):
+    #: seal -> durable commit latency per rollover (one series per table)
+    COMMIT_LATENCY = "server.ingest.commitLatencyMs"
+
+
 class ServerGauge(Enum):
     SEGMENT_COUNT = "server.segmentCount"
     LLC_PARTITION_CONSUMING = "server.llcPartitionConsuming"

@@ -159,6 +159,14 @@ class RequestTrace:
         with self._lock:
             self.phase_ms[phase.value] = self.phase_ms.get(phase.value, 0.0) + ms
 
+    def record_phase_ms(self, name: str, ms: float) -> None:
+        """String-keyed phase recording for phases outside ServerQueryPhase —
+        the HTTP wire timeline folds its socket-level phases in here under
+        `http.<name>` keys so /debug/traces/{id} shows transport time next
+        to engine time."""
+        with self._lock:
+            self.phase_ms[name] = self.phase_ms.get(name, 0.0) + ms
+
     def to_dict(self) -> dict:
         with self._lock:
             d = {
@@ -361,6 +369,11 @@ class phase_timer:
             from pinot_tpu.common.metrics import get_registry
 
             get_registry(self.role).timer(f"{self.role}.phase.{self.phase.value}Ms").update_ms(ms)
+        # fold into the active HTTP wire timeline's sub-phase decomposition
+        # (no-op outside an instrumented HTTP request)
+        from pinot_tpu.common.frontend_obs import record_timeline_sub
+
+        record_timeline_sub(self.phase.value, ms)
         return False
 
 

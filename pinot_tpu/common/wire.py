@@ -189,6 +189,7 @@ class ConnectionPool:
     def _connect(self, host: str, port: int) -> http.client.HTTPConnection:
         FAULTS.maybe_fail("wire.connect")
         conn = http.client.HTTPConnection(host, port, timeout=self.connect_timeout_s)
+        t0 = time.perf_counter()
         try:
             conn.connect()
             # TCP_NODELAY: segment-list bodies go out as several small
@@ -198,6 +199,11 @@ class ConnectionPool:
         except OSError:
             conn.close()
             raise
+        # client-side wire phase: TCP dial time (pool misses only — the
+        # connect share of the client-minus-server latency gap)
+        get_registry("wire").timer("wire.connectMs").update_ms(
+            (time.perf_counter() - t0) * 1e3
+        )
         return conn
 
     @staticmethod
@@ -402,6 +408,7 @@ class ConnectionPool:
         if conn.sock is not None:
             conn.sock.settimeout(remaining)
         hdrs = dict(headers or {})
+        t0 = time.perf_counter()
         if body is None:
             conn.request(method, path, headers=hdrs)
         else:
@@ -413,7 +420,16 @@ class ConnectionPool:
             hdrs.setdefault("Content-Length", str(length))
             hdrs.setdefault("Content-Type", "application/octet-stream")
             conn.request(method, path, body=body, headers=hdrs)
-        return conn.getresponse()
+        t_sent = time.perf_counter()
+        resp = conn.getresponse()
+        t_first = time.perf_counter()
+        # client-side wire phases: request write vs time-to-first-byte (the
+        # TTFB slice contains the server's whole handling time; subtracting
+        # the server-reported time isolates queueing + wire)
+        reg = get_registry("wire")
+        reg.timer("wire.sendMs").update_ms((t_sent - t0) * 1e3)
+        reg.timer("wire.ttfbMs").update_ms((t_first - t_sent) * 1e3)
+        return resp
 
 
 #: process-global pool shared by the v1 scatter client, the v2 mailbox

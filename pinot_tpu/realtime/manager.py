@@ -176,6 +176,7 @@ class PartitionConsumer:
                 self._mutable.index(row)
         with self._lock:
             self.offset = next_off
+        self._record_lag()
         if msgs:
             # event-to-queryable freshness: rows indexed above are visible to
             # queries via the consuming snapshot the moment this batch lands,
@@ -189,6 +190,42 @@ class PartitionConsumer:
                 if m.timestamp_ms:
                     fh.update_ms(max(0.0, now_ms - m.timestamp_ms))
         return len(msgs)
+
+    def _record_lag(self) -> None:
+        """Per-partition consumer lag in events (upstream head minus our
+        committed read offset): `server.ingest.lagEvents{table=,partition=}`.
+        The stream protocol only mandates fetch_messages, so the upstream
+        head comes from `consumer.latest_offset(partition)` or the backing
+        `consumer.stream` when available — no lag series otherwise."""
+        latest_fn = getattr(self.consumer, "latest_offset", None)
+        if latest_fn is None:
+            stream = getattr(self.consumer, "stream", None)
+            latest_fn = getattr(stream, "latest_offset", None)
+        if latest_fn is None:
+            return
+        try:
+            head = int(latest_fn(self.partition))
+        except Exception:  # pinotlint: disable=deadline-swallow — optional observability probe; a flaky upstream head lookup must never stall the consume loop
+            return
+        from pinot_tpu.common.metrics import IngestGauge, server_metrics
+
+        server_metrics().gauge(
+            IngestGauge.LAG_EVENTS, table=self.table, partition=str(self.partition)
+        ).set(max(0, head - self.offset))
+
+    def _timed_commit(self, commit_fn, sealed, start: int, end: int) -> None:
+        """Commit with cadence observability: `server.ingest.commitLatencyMs`
+        times the seal->durable path (deep-store write + metadata), the
+        ingest-side cost the freshness SLO pays on every rollover."""
+        t0 = time.perf_counter()
+        try:
+            commit_fn(sealed, start, end)
+        finally:
+            from pinot_tpu.common.metrics import IngestTimer, server_metrics
+
+            server_metrics().timer(
+                IngestTimer.COMMIT_LATENCY, table=self.table
+            ).update_ms((time.perf_counter() - t0) * 1e3)
 
     def _rollover(self) -> None:
         """End criteria reached: seal, commit, open the next consuming
@@ -205,7 +242,7 @@ class PartitionConsumer:
             self.sequence += 1
             self._segment_start_offset = end
             self._mutable = self._new_mutable()
-        self.commit_fn(sealed, start, end)
+        self._timed_commit(self.commit_fn, sealed, start, end)
         self.on_open(self._seg_name())
         self.state = "CONSUMING"
 
@@ -280,14 +317,14 @@ class PartitionConsumer:
                     accepted = False
                 else:
                     try:
-                        self.commit_fn(sealed, start, end)
+                        self._timed_commit(self.commit_fn, sealed, start, end)
                         ok = True
                     except Exception:
                         # deep store unavailable: keep the built copy local,
                         # offer it for PEER download (peerSegmentDownloadScheme)
                         try:
                             if self.peer_commit_fn is not None:
-                                self.peer_commit_fn(sealed, start, end)
+                                self._timed_commit(self.peer_commit_fn, sealed, start, end)
                                 ok = True
                                 download_from = self.server_id
                         except Exception:

@@ -1,0 +1,691 @@
+"""Frontend & transport request-lifecycle observability (ISSUE 16):
+wire-phase timelines, connection-plane gauges, the scheduling-lag probe,
+the /debug/frontend surface, the aggregator merge into /debug/cluster,
+the ingest lag/commit series, and the client-tail attribution math.
+
+Deterministic throughout: the timeline/attribution units run on injected
+clocks and canned samples; the socket tests use real localhost services
+but only assert monotone counter transitions behind bounded polls."""
+
+import json
+import socket
+import struct
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from pinot_tpu.cluster import Broker, Controller, PropertyStore, Server
+from pinot_tpu.cluster.http import (
+    BrokerHTTPService,
+    RemoteServerClient,
+    ServerHTTPService,
+    query_broker_http,
+)
+from pinot_tpu.cluster.periodic import ClusterMetricsAggregator
+from pinot_tpu.common import (
+    DataType,
+    ObservabilityConfig,
+    Schema,
+    TableConfig,
+    TableType,
+)
+from pinot_tpu.common.frontend_obs import (
+    WIRE_PHASES,
+    ConnTracker,
+    PhaseTimeline,
+    SchedLagProbe,
+    active_timeline,
+    attribute_client_gap,
+    frontend_snapshot,
+    record_timeline_sub,
+)
+from pinot_tpu.common.metrics import (
+    broker_metrics,
+    get_registry,
+    reset_registries,
+    server_metrics,
+)
+from pinot_tpu.common.trace import TraceContext, start_trace
+from pinot_tpu.segment import SegmentBuilder
+
+
+def _get_json(url, timeout=10):
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        return json.loads(r.read())
+
+
+# ---------------------------------------------------------------------------
+# PhaseTimeline: the sum-to-wall invariant
+# ---------------------------------------------------------------------------
+
+
+def test_phase_timeline_marks_are_disjoint_and_sum_to_wall():
+    tl = PhaseTimeline("broker", t0=100.0)
+    tl.mark("headersRead", now=100.010)
+    tl.mark("bodyRead", now=100.025)
+    tl.mark("parse", now=100.027)
+    tl.mark("execute", now=100.127)
+    tl.mark("serialize", now=100.130)
+    tl.mark("write", now=100.140)
+    tl.mark("drain", now=100.141)
+    snap = tl.snapshot()
+    assert snap["phasesMs"] == pytest.approx(
+        {
+            "headersRead": 10.0,
+            "bodyRead": 15.0,
+            "parse": 2.0,
+            "execute": 100.0,
+            "serialize": 3.0,
+            "write": 10.0,
+            "drain": 1.0,
+        },
+        abs=1e-6,
+    )
+    # disjoint by construction: the phases partition the wall exactly
+    assert sum(snap["phasesMs"].values()) == pytest.approx(
+        tl.wall_ms(now=100.141), abs=1e-6
+    )
+    # a mark with a clock that went backwards records nothing (never negative)
+    tl.mark("drain", now=100.100)
+    assert tl.snapshot()["phasesMs"]["drain"] == pytest.approx(1.0, abs=1e-6)
+
+
+def test_record_pre_charges_accept_delay_into_the_wall():
+    tl = PhaseTimeline("broker", t0=50.0)
+    tl.record_pre("accept", 5.0)
+    tl.mark("headersRead", now=50.002)
+    snap = tl.snapshot()
+    assert snap["phasesMs"]["accept"] == pytest.approx(5.0)
+    # pre-epoch time counts toward the wall, keeping the invariant
+    assert tl.wall_ms(now=50.002) == pytest.approx(7.0, abs=1e-6)
+    assert sum(snap["phasesMs"].values()) == pytest.approx(7.0, abs=1e-6)
+
+
+def test_finish_charges_unmarked_remainder_to_handler_and_folds_timers():
+    reset_registries()
+    tl = PhaseTimeline("broker")
+    tl.record_pre("accept", 5.0)
+    tl.mark("headersRead")
+    time.sleep(0.002)  # un-marked handler work -> leftover
+    out = tl.finish()
+    phases = out["phasesMs"]
+    assert phases["accept"] == pytest.approx(5.0)
+    assert phases.get("handler", 0.0) > 0.0
+    assert sum(phases.values()) == pytest.approx(out["wallMs"], abs=0.01)
+    snap = broker_metrics().snapshot()
+    assert snap["broker.http.phase.acceptMs"]["count"] == 1
+    assert snap["broker.http.phase.handlerMs"]["count"] == 1
+    assert snap["broker.http.requestMs"]["count"] == 1
+    assert snap["broker.http.requestMs"]["totalMs"] == pytest.approx(out["wallMs"], abs=0.01)
+
+
+def test_sub_phases_record_via_contextvar_and_fold_into_trace():
+    reset_registries()
+    record_timeline_sub("admission", 1.0)  # no active timeline: a no-op
+    tl = PhaseTimeline("broker")
+    tl.activate()
+    try:
+        assert active_timeline() is tl
+        record_timeline_sub("admission", 1.5)
+        record_timeline_sub("queueWait", 0.5)
+    finally:
+        tl.deactivate()
+    assert active_timeline() is None
+    tl.mark("execute")
+    with start_trace("q", context=TraceContext.mint()) as tr:
+        tl.trace = tr
+        out = tl.finish()
+    assert out["subPhasesMs"] == {"admission": 1.5, "queueWait": 0.5}
+    # sub-phases overlap execute: excluded from the sum-to-wall phase set...
+    assert "admission" not in out["phasesMs"]
+    # ...but still folded into the registry and the attached trace
+    snap = broker_metrics().snapshot()
+    assert snap["broker.http.phase.admissionMs"]["count"] == 1
+    assert snap["broker.http.phase.queueWaitMs"]["count"] == 1
+    phase_times = tr.to_dict()["phaseTimesMs"]
+    assert phase_times["http.execute"] > 0
+
+
+# ---------------------------------------------------------------------------
+# ConnTracker: connection-plane transitions
+# ---------------------------------------------------------------------------
+
+
+def test_conn_tracker_transitions_and_gauge_mirror():
+    reset_registries()
+    t = ConnTracker("broker")
+    t.conn_opened()
+    t.conn_opened()
+    t.request_started()
+    s = t.stats()
+    assert (s["open"], s["active"], s["idle"], s["accepted"]) == (2, 1, 1, 2)
+    t.request_finished(100, 200)
+    t.conn_closed(12.5, 3)
+    t.conn_refused()
+    t.conn_reset()
+    assert t.stats() == {
+        "open": 1,
+        "active": 0,
+        "idle": 1,
+        "accepted": 2,
+        "refused": 1,
+        "reset": 1,
+        "closed": 1,
+        "requests": 1,
+        "bytesIn": 100,
+        "bytesOut": 200,
+    }
+    snap = broker_metrics().snapshot()
+    assert snap["broker.http.conn.open"]["value"] == 1
+    assert snap["broker.http.conn.idle"]["value"] == 1
+    assert snap["broker.http.conn.accepted"]["count"] == 2
+    assert snap["broker.http.conn.refused"]["count"] == 1
+    assert snap["broker.http.conn.reset"]["count"] == 1
+    assert snap["broker.http.conn.lifetimeMs"]["count"] == 1
+    assert snap["broker.http.bytesIn"]["count"] == 100
+    # plain-int counts are reset-immune: the next transition re-mirrors
+    reset_registries()
+    t.conn_opened()
+    assert broker_metrics().snapshot()["broker.http.conn.open"]["value"] == 2
+
+
+# ---------------------------------------------------------------------------
+# SchedLagProbe
+# ---------------------------------------------------------------------------
+
+
+def test_sched_lag_probe_tick_is_deterministic_and_clamped():
+    reset_registries()
+    p = SchedLagProbe(0.05)
+    p.add_role("broker")
+    p.add_role("server")
+    p._tick(7.5)
+    p._tick(-3.0)  # an early wakeup clamps to 0, never negative
+    for role in ("broker", "server"):
+        snap = get_registry(role).snapshot()
+        assert snap["runtime.schedLagMs"]["count"] == 2
+        assert snap["runtime.schedLagMs"]["maxMs"] >= 7.5
+        assert snap["runtime.schedLagLastMs"]["value"] == 0.0
+
+
+def test_sched_lag_probe_thread_records_under_gil_hog():
+    reset_registries()
+    p = SchedLagProbe(0.002)
+    p.add_role("broker")
+    p.start()
+    stop = threading.Event()
+
+    def hog():
+        while not stop.is_set():
+            sum(i * i for i in range(2000))
+
+    th = threading.Thread(target=hog, daemon=True)
+    th.start()
+    snap = None
+    try:
+        deadline = time.time() + 5.0
+        while time.time() < deadline:
+            snap = get_registry("broker").snapshot().get("runtime.schedLagMs")
+            if snap and snap["count"] >= 3:
+                break
+            time.sleep(0.01)
+    finally:
+        stop.set()
+        p.stop()
+        th.join()
+    assert snap and snap["count"] >= 3
+
+
+def test_sched_lag_probe_ensure_is_a_process_singleton():
+    a = SchedLagProbe.ensure("broker")
+    b = SchedLagProbe.ensure("server")
+    assert a is b
+
+
+# ---------------------------------------------------------------------------
+# attribute_client_gap: canned cross-check math
+# ---------------------------------------------------------------------------
+
+
+def test_attribute_client_gap_canned_math():
+    out = attribute_client_gap(
+        [{"wallMs": 100.0, "connectMs": 10.0, "sendMs": 5.0, "ttfbMs": 50.0, "readMs": 30.0, "brokerMs": 20.0}]
+    )
+    o = out["overall"]
+    assert o["meanBrokerMs"] == 20.0
+    assert o["meanGapMs"] == 80.0
+    assert o["attributionMs"] == {
+        "connect": 10.0,
+        "send": 5.0,
+        "ttfbMinusBroker": 30.0,
+        "read": 30.0,
+        "other": 5.0,
+    }
+    assert o["coverage"] == pytest.approx(75.0 / 80.0, abs=1e-4)
+
+
+def test_attribute_client_gap_clamps_broker_time_to_ttfb():
+    # a broker reporting more time than the client's whole TTFB can only
+    # account for the TTFB slice — never negative attribution
+    out = attribute_client_gap(
+        [{"wallMs": 100.0, "connectMs": 0.0, "sendMs": 10.0, "ttfbMs": 50.0, "readMs": 40.0, "brokerMs": 60.0}]
+    )
+    o = out["overall"]
+    assert o["meanBrokerMs"] == 50.0
+    assert o["attributionMs"]["ttfbMinusBroker"] == 0.0
+    assert o["coverage"] == 1.0
+
+
+def test_attribute_client_gap_tail_is_top_percent_by_wall():
+    fast = [
+        {"wallMs": 10.0, "connectMs": 0.0, "sendMs": 1.0, "ttfbMs": 6.0, "readMs": 3.0, "brokerMs": 2.0}
+        for _ in range(198)
+    ]
+    slow = [
+        {"wallMs": 500.0, "connectMs": 5.0, "sendMs": 5.0, "ttfbMs": 450.0, "readMs": 40.0, "brokerMs": 2.0}
+        for _ in range(2)
+    ]
+    out = attribute_client_gap(fast + slow)
+    assert out["requests"] == 200
+    assert out["tail"]["requests"] == 2  # top 1%
+    assert out["tail"]["meanWallMs"] == 500.0
+    assert out["tail"]["attributionMs"]["ttfbMinusBroker"] == 448.0
+    assert out["coverage"] >= 0.9 and out["tail"]["coverage"] >= 0.9
+
+
+def test_attribute_client_gap_empty_is_fully_covered():
+    out = attribute_client_gap([])
+    assert out["requests"] == 0 and out["coverage"] == 1.0
+
+
+# ---------------------------------------------------------------------------
+# frontend config knobs
+# ---------------------------------------------------------------------------
+
+
+def test_observability_config_frontend_knobs_roundtrip():
+    cfg = ObservabilityConfig(frontend_obs_enabled=False, sched_lag_interval_ms=25.0)
+    d = cfg.to_dict()
+    assert d["frontendObsEnabled"] is False and d["schedLagIntervalMs"] == 25.0
+    back = ObservabilityConfig.from_dict(json.loads(json.dumps(d)))
+    assert back.frontend_obs_enabled is False
+    assert back.sched_lag_interval_ms == 25.0
+    assert ObservabilityConfig.from_dict({}).frontend_obs_enabled is True
+
+
+# ---------------------------------------------------------------------------
+# live HTTP: /debug/frontend gauges, phases, status codes, keep-alive
+# ---------------------------------------------------------------------------
+
+
+def _tiny_http_cluster(tmp_path):
+    controller = Controller(PropertyStore(), tmp_path / "deepstore")
+    controller.register_server("server_0", Server("server_0"))
+    schema = Schema.build("t", dimensions=[("d", DataType.INT)], metrics=[("v", DataType.LONG)])
+    controller.add_schema(schema)
+    controller.add_table(TableConfig("t"))
+    b = SegmentBuilder(schema)
+    for i in range(3):
+        controller.upload_segment(
+            "t",
+            b.build(
+                {"d": np.arange(64, dtype=np.int32) % 4, "v": np.arange(64, dtype=np.int64)},
+                f"t_{i}",
+            ),
+        )
+    broker = Broker(controller)
+    bsvc = BrokerHTTPService(broker, port=0)
+    return controller, broker, bsvc
+
+
+def _read_http_response(sock):
+    """Read one HTTP/1.1 response (status line + headers + Content-Length
+    body) off a keep-alive socket; returns the body bytes."""
+    buf = b""
+    while b"\r\n\r\n" not in buf:
+        chunk = sock.recv(65536)
+        if not chunk:
+            raise ConnectionError("server closed mid-headers")
+        buf += chunk
+    head, _, body = buf.partition(b"\r\n\r\n")
+    clen = 0
+    for line in head.split(b"\r\n")[1:]:
+        k, _, v = line.partition(b":")
+        if k.strip().lower() == b"content-length":
+            clen = int(v.strip())
+    while len(body) < clen:
+        chunk = sock.recv(65536)
+        if not chunk:
+            raise ConnectionError("server closed mid-body")
+        body += chunk
+    return body[:clen]
+
+
+def test_debug_frontend_serves_live_gauges_phases_and_status(tmp_path):
+    reset_registries()
+    controller, broker, bsvc = _tiny_http_cluster(tmp_path)
+    try:
+        base = f"http://127.0.0.1:{bsvc.port}"
+        for i in range(3):
+            r = query_broker_http(base, f"SELECT COUNT(*) FROM t WHERE d = {i}")
+            assert not r.get("exceptions")
+        with pytest.raises(urllib.error.HTTPError):  # a 404 for the status table
+            urllib.request.urlopen(f"{base}/no/such/path", timeout=10)
+        doc = _get_json(f"{base}/debug/frontend")
+        assert doc["role"] == "broker"
+        conns = doc["connections"]
+        assert conns["accepted"] >= 1 and conns["open"] >= 1
+        assert conns["requests"] >= 4
+        assert conns["bytesIn"] > 0 and conns["bytesOut"] > 0
+        for phase in ("headersRead", "bodyRead", "parse", "execute", "serialize", "write"):
+            assert doc["phases"][phase]["count"] >= 3, phase
+        # the live sum-to-wall check: top-level phases cover the request timer
+        covered = sum(doc["phases"][p]["totalMs"] for p in WIRE_PHASES if p in doc["phases"])
+        assert doc["request"]["totalMs"] > 0
+        assert covered >= 0.9 * doc["request"]["totalMs"]
+        assert doc["status"].get("200", 0) >= 3
+        assert doc["status"].get("404", 0) >= 1
+        assert "schedLag" in doc
+    finally:
+        bsvc.stop()
+        broker.shutdown()
+
+
+def test_keepalive_connection_gauges_and_per_connection_histograms(tmp_path):
+    reset_registries()
+    controller, broker, bsvc = _tiny_http_cluster(tmp_path)
+    try:
+        base = f"http://127.0.0.1:{bsvc.port}"
+        before = _get_json(f"{base}/debug/frontend")["connections"]
+        s = socket.create_connection(("127.0.0.1", bsvc.port), timeout=10)
+        s.settimeout(10)
+        body = json.dumps({"sql": "SELECT COUNT(*) FROM t"}).encode()
+        req = (
+            f"POST /query/sql HTTP/1.1\r\nHost: x\r\nContent-Type: application/json\r\n"
+            f"Content-Length: {len(body)}\r\nConnection: keep-alive\r\n\r\n"
+        ).encode() + body
+        for _ in range(3):
+            s.sendall(req)
+            out = json.loads(_read_http_response(s))
+            assert not out.get("exceptions")
+        during = _get_json(f"{base}/debug/frontend")["connections"]
+        assert during["accepted"] >= before["accepted"] + 1
+        assert during["open"] >= 1
+        assert during["requests"] >= before["requests"] + 3
+        s.close()
+        after = None
+        deadline = time.time() + 10.0
+        while time.time() < deadline:
+            after = _get_json(f"{base}/debug/frontend")
+            if after["connections"]["closed"] >= before["closed"] + 1:
+                break
+            time.sleep(0.05)
+        assert after["connections"]["closed"] >= before["closed"] + 1
+        # keep-alive efficiency histogram saw a 3-requests-served connection
+        served = after["keepAlive"]["requestsServed"]
+        assert served and served["count"] >= 1 and served["maxMs"] >= 3.0
+    finally:
+        bsvc.stop()
+        broker.shutdown()
+
+
+def test_aborted_connections_count_as_resets(tmp_path):
+    reset_registries()
+    controller, broker, bsvc = _tiny_http_cluster(tmp_path)
+    try:
+        base = f"http://127.0.0.1:{bsvc.port}"
+        before = _get_json(f"{base}/debug/frontend")["connections"]
+        n_abort = 4
+        for _ in range(n_abort):
+            s = socket.create_connection(("127.0.0.1", bsvc.port), timeout=10)
+            s.sendall(b"POST /query/sql HTT")  # partial: the handler blocks reading
+            s.setsockopt(socket.SOL_SOCKET, socket.SO_LINGER, struct.pack("ii", 1, 0))
+            s.close()  # SO_LINGER(1,0) -> RST mid-read
+        after = None
+        deadline = time.time() + 10.0
+        while time.time() < deadline:
+            after = _get_json(f"{base}/debug/frontend")["connections"]
+            if after["reset"] >= before["reset"] + n_abort:
+                break
+            time.sleep(0.05)
+        assert after["reset"] >= before["reset"] + n_abort
+        # the accept path counted them before they died (satellite 3 fix)
+        assert after["accepted"] >= before["accepted"] + n_abort
+    finally:
+        bsvc.stop()
+        broker.shutdown()
+
+
+def test_frontend_snapshot_falls_back_to_registry_gauges():
+    reset_registries()
+    t = ConnTracker("server")
+    t.conn_opened()
+    t.request_started()
+    doc = frontend_snapshot("server")  # no tracker handle: gauge-derived
+    assert doc["connections"]["open"] == 1
+    assert doc["connections"]["active"] == 1
+    assert doc["connections"]["accepted"] == 1
+
+
+# ---------------------------------------------------------------------------
+# aggregator merge: /debug/frontend + ingest series into /debug/cluster
+# ---------------------------------------------------------------------------
+
+
+def _fe_doc(role, reqs, bucket_ms):
+    return {
+        "role": role,
+        "connections": {
+            "open": 1, "active": 0, "idle": 1, "accepted": 2, "refused": 0,
+            "reset": 1, "closed": 1, "requests": reqs,
+            "bytesIn": 10 * reqs, "bytesOut": 20 * reqs,
+        },
+        "keepAlive": {"lifetimeMs": None, "requestsServed": None},
+        "request": {"count": reqs, "totalMs": bucket_ms * reqs},
+        "phases": {
+            "execute": {
+                "count": reqs,
+                "totalMs": bucket_ms * reqs,
+                "meanMs": bucket_ms,
+                "p50Ms": bucket_ms,
+                "p99Ms": bucket_ms,
+                "maxMs": bucket_ms,
+                "buckets": [[bucket_ms, reqs]],
+            }
+        },
+        "status": {"200": reqs},
+        "schedLag": {"count": 5, "p50Ms": 0.1, "p99Ms": 1.0, "maxMs": 2.0, "lastMs": 0.2},
+    }
+
+
+def _ingest_snapshot(partition, lag, commit_total_ms, commit_bucket):
+    return {
+        f'server.ingest.lagEvents{{partition="{partition}",table="events"}}': {
+            "type": "gauge",
+            "value": lag,
+            "labels": {"table": "events", "partition": partition},
+        },
+        'server.ingest.commitLatencyMs{table="events"}': {
+            "type": "timer",
+            "count": 2,
+            "totalMs": commit_total_ms,
+            "maxMs": commit_bucket,
+            "buckets": [[commit_bucket, 2]],
+            "labels": {"table": "events"},
+        },
+    }
+
+
+def test_aggregator_merges_frontend_and_ingest_planes(tmp_path):
+    per = {
+        "server-0": {
+            "snapshot": _ingest_snapshot("0", 3, 30.0, 16.0),
+            "frontend": _fe_doc("server", 10, 4.0),
+        },
+        "server-1": {
+            "snapshot": _ingest_snapshot("1", 7, 50.0, 32.0),
+            "frontend": _fe_doc("server", 20, 8.0),
+        },
+        "broker-0": {"snapshot": {}, "frontend": _fe_doc("broker", 5, 2.0)},
+    }
+
+    def fetch(url):
+        host = url.split("//")[1].split(":")[0]
+        if "/metrics" in url:
+            return json.dumps(per[host]["snapshot"])
+        if "/debug/workload" in url:
+            return json.dumps({"rollups": []})
+        if "/debug/slowQueries" in url:
+            return json.dumps([])
+        if "/debug/roofline" in url:
+            return json.dumps({"kernels": []})
+        if "/debug/frontend" in url:
+            return json.dumps(per[host]["frontend"])
+        raise AssertionError(f"unexpected scrape url {url}")
+
+    controller = Controller(PropertyStore(), tmp_path / "deepstore")
+    controller.register_broker("broker-0", "broker-0", 80)
+    controller.register_server("server-0", None, host="server-0", port=80)
+    controller.register_server("server-1", None, host="server-1", port=80)
+    agg = ClusterMetricsAggregator(controller, fetch=fetch, now_fn=lambda: 1000.0)
+    r = agg.run_once()
+    assert all(r["scraped"].values())
+    doc = agg.debug_cluster()
+
+    fe = doc["cluster"]["frontend"]
+    srv = fe["server"]
+    assert srv["nodes"] == 2
+    assert srv["connections"]["requests"] == 30  # summed across servers
+    assert srv["connections"]["reset"] == 2
+    assert srv["status"]["200"] == 30
+    ph = srv["phases"]["execute"]
+    assert ph["count"] == 30
+    assert ph["totalMs"] == pytest.approx(200.0)  # 10x4ms + 20x8ms
+    # bucket-merged tail: the slow node's bucket dominates the exact p99
+    assert ph["p99Ms"] == 8.0
+    assert set(srv["schedLagByNode"]) == {"server-0", "server-1"}
+    assert fe["broker"]["nodes"] == 1
+    assert fe["broker"]["connections"]["requests"] == 5
+
+    ing = doc["cluster"]["ingest"]["events"]
+    assert ing["lagEventsByPartition"] == {"0": 3, "1": 7}
+    assert ing["lagEvents"] == 10
+    assert ing["commits"] == 4
+    assert ing["commitLatency"]["p50Ms"] == 16.0
+    assert ing["commitLatency"]["totalMs"] == pytest.approx(80.0)
+
+
+def test_live_cluster_scrape_merges_frontend_for_both_roles(tmp_path):
+    reset_registries()
+    controller = Controller(PropertyStore(), tmp_path / "deepstore")
+    inner = Server("server_0")
+    ssvc = ServerHTTPService(inner, port=0)
+    bsvc = None
+    broker = None
+    try:
+        controller.register_server(
+            "server_0",
+            RemoteServerClient(f"http://127.0.0.1:{ssvc.port}"),
+            host="127.0.0.1",
+            port=ssvc.port,
+        )
+        schema = Schema.build("t", dimensions=[("d", DataType.INT)], metrics=[("v", DataType.LONG)])
+        controller.add_schema(schema)
+        controller.add_table(TableConfig("t"))
+        b = SegmentBuilder(schema)
+        for i in range(3):
+            controller.upload_segment(
+                "t",
+                b.build(
+                    {"d": np.arange(64, dtype=np.int32) % 4, "v": np.arange(64, dtype=np.int64)},
+                    f"t_{i}",
+                ),
+            )
+        broker = Broker(controller)
+        bsvc = BrokerHTTPService(broker, port=0)
+        controller.register_broker("broker_0", "127.0.0.1", bsvc.port)
+
+        # distinct predicates so scatter legs actually reach the server
+        for i in range(3):
+            r = query_broker_http(
+                f"http://127.0.0.1:{bsvc.port}", f"SELECT COUNT(*) FROM t WHERE d = {i}"
+            )
+            assert not r.get("exceptions")
+
+        agg = ClusterMetricsAggregator(controller)
+        r1 = agg.run_once()
+        assert all(r1["scraped"].values())
+        fe = agg.debug_cluster()["cluster"]["frontend"]
+        assert set(fe) >= {"broker", "server"}
+        assert fe["broker"]["connections"]["requests"] >= 3
+        assert fe["broker"]["phases"]["execute"]["count"] >= 3
+        # server-side wire phases came from the scatter legs
+        assert fe["server"]["connections"]["requests"] >= 3
+        assert fe["server"]["phases"]
+    finally:
+        if bsvc is not None:
+            bsvc.stop()
+        if broker is not None:
+            broker.shutdown()
+        ssvc.stop()
+
+
+# ---------------------------------------------------------------------------
+# ingest observability: lag gauge + commit latency (satellite 1)
+# ---------------------------------------------------------------------------
+
+
+def test_ingest_lag_gauge_and_commit_latency_series(tmp_path):
+    from pinot_tpu.realtime import InMemoryStream, RealtimeTableManager
+
+    reset_registries()
+    controller = Controller(PropertyStore(), tmp_path / "deep")
+    server = Server("server_rt")
+    controller.register_server("server_rt", server)
+    schema = Schema.build(
+        "events",
+        dimensions=[("kind", DataType.STRING), ("shard", DataType.INT)],
+        metrics=[("value", DataType.LONG)],
+    )
+    controller.add_schema(schema)
+    config = TableConfig("events", table_type=TableType.REALTIME, replication=1)
+    controller.add_table(config)
+    stream = InMemoryStream(partitions=2)
+    for i in range(400):
+        stream.produce(i % 2, {"kind": f"k{i % 5}", "shard": i % 2, "value": i})
+    mgr = RealtimeTableManager(
+        controller, server, schema, config, stream, max_rows_per_segment=120
+    )
+    mgr.start()
+    try:
+        assert mgr.wait_until_caught_up([stream.latest_offset(0), stream.latest_offset(1)])
+        deadline = time.time() + 10.0
+        commits = 0
+        while time.time() < deadline:
+            snap = server_metrics().snapshot()
+            commits = sum(
+                e["count"]
+                for k, e in snap.items()
+                if k.startswith("server.ingest.commitLatencyMs{")
+            )
+            if commits >= 2:  # one rollover per partition at 200 rows / 120
+                break
+            time.sleep(0.05)
+    finally:
+        mgr.stop()
+    snap = server_metrics().snapshot()
+    lag_keys = [k for k in snap if k.startswith("server.ingest.lagEvents{")]
+    assert len(lag_keys) == 2  # one series per partition
+    for k in lag_keys:
+        assert snap[k]["type"] == "gauge"
+        assert snap[k]["labels"]["table"] == "events"
+        assert snap[k]["value"] == 0  # caught up: head == committed offset
+    assert commits >= 2
+    commit_keys = [k for k in snap if k.startswith("server.ingest.commitLatencyMs{")]
+    assert commit_keys
+    assert snap[commit_keys[0]]["labels"]["table"] == "events"
+    assert snap[commit_keys[0]]["totalMs"] > 0
