@@ -1409,6 +1409,406 @@ def _cluster_freshness_phase(seed: int) -> dict:
     }
 
 
+def _cluster_drive_conn(broker_urls: list, queries: list, n_clients: int, duration_s: float):
+    """Closed-loop load through the REAL Python client (`Connection` with a
+    static broker list): connection-level failures fail over to the next
+    broker inside the client, so a dead broker surfaces as latency, never as
+    an untyped error — the contract the broker-SIGKILL leg asserts."""
+    import threading
+
+    from pinot_tpu.client import Connection, PinotClientError
+    from pinot_tpu.cluster.quota import QuotaExceededError
+    from pinot_tpu.common.errors import QueryErrorCode
+    from pinot_tpu.query.scheduler import SchedulerRejectedError
+
+    stats = {"ok": 0, "typed_timeout": 0, "typed_shed": 0, "dropped": 0, "untyped": 0, "samples": []}
+    lat_ms: list = []
+    lock = threading.Lock()
+    stop_at = time.perf_counter() + duration_s
+    barrier = threading.Barrier(n_clients + 1)
+
+    def fold(kind, detail=None):
+        with lock:
+            stats[kind] = stats.get(kind, 0) + 1
+            if detail and len(stats["samples"]) < 8:
+                stats["samples"].append(detail[:300])
+
+    def client(idx: int) -> None:
+        conn = Connection(broker_urls=list(broker_urls))
+        mine = []
+        j = 0
+        barrier.wait()
+        while time.perf_counter() < stop_at:
+            q = queries[(idx + j) % len(queries)]
+            j += 1
+            t0 = time.perf_counter()
+            try:
+                rs = conn.execute(q)
+                codes = {e.get("errorCode") for e in rs.exceptions}
+                if not rs.exceptions:
+                    fold("ok")
+                elif codes <= {int(QueryErrorCode.EXECUTION_TIMEOUT), 503}:
+                    fold("typed_timeout")
+                else:
+                    fold("untyped", f"partial codes={sorted(codes, key=str)}")
+            except (QuotaExceededError, SchedulerRejectedError):
+                fold("typed_shed")
+            except PinotClientError as e:
+                if "no ONLINE replica" in str(e):
+                    fold("dropped", str(e))
+                else:
+                    fold("untyped", f"{type(e).__name__}: {e}")
+            except Exception as e:
+                fold("untyped", f"{type(e).__name__}: {e}")
+            mine.append((time.perf_counter() - t0) * 1e3)
+        with lock:
+            lat_ms.extend(mine)
+
+    threads = [threading.Thread(target=client, args=(i,), daemon=True) for i in range(n_clients)]
+    for t in threads:
+        t.start()
+    barrier.wait()
+    t_run = time.perf_counter()
+    for t in threads:
+        t.join()
+    wall_s = time.perf_counter() - t_run
+    total = sum(stats[k] for k in ("ok", "typed_timeout", "typed_shed", "dropped", "untyped"))
+    return {
+        "queries": total,
+        "wall_s": round(wall_s, 3),
+        "throughput_qps": round(total / wall_s, 2) if wall_s else 0.0,
+        "outcomes": {k: stats[k] for k in ("ok", "typed_timeout", "typed_shed", "dropped", "untyped")},
+        "error_samples": stats["samples"],
+        "p50_ms": round(float(np.percentile(lat_ms, 50)), 3) if lat_ms else None,
+        "p99_ms": round(float(np.percentile(lat_ms, 99)), 3) if lat_ms else None,
+    }
+
+
+def _cluster_ha_phases(seed: int, n_clients: int, phase_s: float) -> dict:
+    """Control-plane survivability legs (ISSUE 18) on a dedicated
+    mini-topology — 2 HA controllers sharing one file-backed store, 2->3
+    servers (replication 2), 2 brokers, every role a real OS process:
+
+      split_brain      freeze the lead's lease renewal (lease.renew fault
+                       over /debug/faults); the standby takes the lease at a
+                       higher epoch and the frozen ex-leader's mutations are
+                       FENCED (503 + errorCode 270, fencedWrites >= 1)
+      controller_kill  SIGKILL the lead controller MID-REBALANCE under live
+                       load; the standby takes over and the reconciler
+                       converges what the dead leader left half-moved
+                       (0 untyped, 0 dropped, correct counts after)
+      broker_kill      SIGKILL one of two brokers under live client load;
+                       the Python client's broker failover keeps every
+                       outcome typed (0 untyped, 0 dropped)
+      cold_restart     SIGKILL every process; rebuild the whole cluster from
+                       the surviving property-store dir + deep store with
+                       --cold-start (external views cleared); queries must
+                       return IDENTICAL results
+    """
+    import shutil
+    import signal
+    import tempfile
+    import threading
+    import urllib.error
+    import urllib.request
+
+    from pinot_tpu.cluster.http import RemoteControllerClient, query_broker_http
+    from pinot_tpu.common import DataType, Schema, TableConfig
+    from pinot_tpu.segment import SegmentBuilder, write_segment
+
+    n_rows = int(os.environ.get("PINOT_TPU_HA_ROWS", 24_000))
+    n_segments = 4
+    table = "lineorder_ha"
+    root = tempfile.mkdtemp(prefix="pinot_tpu_ha_")
+    store_dir, deep_dir = os.path.join(root, "store"), os.path.join(root, "deep")
+    procs: list = []
+    out: dict = {}
+
+    def _get_json(url):
+        with urllib.request.urlopen(url, timeout=10) as r:
+            return json.loads(r.read())
+
+    def _post_json(url, doc):
+        req = urllib.request.Request(
+            url, data=json.dumps(doc).encode(), headers={"Content-Type": "application/json"}
+        )
+        with urllib.request.urlopen(req, timeout=10) as r:
+            return json.loads(r.read())
+
+    def start_controller(cid: str, cold: bool = False):
+        argv = [
+            "StartController",
+            "--store-dir", store_dir,
+            "--deep-store", deep_dir,
+            "--port", "0",
+            "--controller-id", cid,
+            "--ha", "--lease-ttl", "1.0", "--renew-every", "0.2",
+        ]
+        if cold:
+            argv.append("--cold-start")
+        return _spawn_role(argv, procs)
+
+    def start_server(sid: str, controllers: str):
+        return _spawn_role(
+            [
+                "StartServer", "--controller-url", controllers,
+                "--server-id", sid, "--port", "0",
+                "--data-dir", os.path.join(root, "data", sid),
+            ],
+            procs,
+        )
+
+    def start_broker(bid: str, controllers: str):
+        return _spawn_role(
+            [
+                "StartBroker", "--controller-url", controllers,
+                "--broker-id", bid, "--port", "0", "--scatter-threads", "16",
+            ],
+            procs,
+        )
+
+    def wait_leader(url: str, want: bool = True, timeout_s: float = 20.0) -> dict:
+        deadline = time.time() + timeout_s
+        status: dict = {}
+        while time.time() < deadline:
+            try:
+                status = _get_json(f"{url}/leader")
+                if bool(status.get("isLeader")) == want:
+                    return status
+            except OSError:
+                pass
+            time.sleep(0.1)
+        raise RuntimeError(f"controller at {url} never reached isLeader={want}: {status}")
+
+    def wait_count(broker_url: str, expect: int, timeout_s: float = 60.0) -> float:
+        """Poll COUNT(*) until the cluster serves the full row count again;
+        returns how long recovery took."""
+        t0 = time.time()
+        deadline = t0 + timeout_s
+        last = None
+        while time.time() < deadline:
+            try:
+                res = query_broker_http(broker_url, f"SELECT COUNT(*) FROM {table}")
+                if not (res.get("exceptions") or []):
+                    last = res["resultTable"]["rows"][0][0]
+                    if last == expect:
+                        return round(time.time() - t0, 3)
+            except OSError:
+                pass
+            time.sleep(0.25)
+        raise RuntimeError(f"cluster never recovered COUNT(*)={expect} (last={last})")
+
+    try:
+        # -- topology: 2 HA controllers, 2 servers, 2 brokers -------------------
+        log("HA: spawning controllers ha_c1 (lead) + ha_c2 (standby) ...")
+        c1_proc, c1_url = start_controller("ha_c1")
+        lead_status = wait_leader(c1_url)
+        c2_proc, c2_url = start_controller("ha_c2")
+        controllers = f"{c1_url},{c2_url}"
+        log("HA: spawning servers ha_s0, ha_s1 + brokers ha_b0, ha_b1 ...")
+        server_procs: dict = {}
+        for sid in ("ha_s0", "ha_s1"):
+            server_procs[sid], _ = start_server(sid, controllers)
+        b0_proc, b0_url = start_broker("ha_b0", controllers)
+        b1_proc, b1_url = start_broker("ha_b1", controllers)
+        both = [b0_url, b1_url]
+
+        rc = RemoteControllerClient(controllers)
+        schema = Schema.build(
+            table,
+            dimensions=[("region", DataType.STRING), ("year", DataType.INT)],
+            metrics=[("revenue", DataType.LONG)],
+        )
+        rc.add_schema(schema)
+        rc.add_table(TableConfig(table, replication=2))
+        rng = np.random.default_rng(seed)
+        builder = SegmentBuilder(schema)
+        seg_rows = n_rows // n_segments
+        for i in range(n_segments):
+            data = {
+                "region": np.array(["AFRICA", "AMERICA", "ASIA", "EUROPE"], dtype=object)[
+                    rng.integers(0, 4, seg_rows)
+                ],
+                "year": rng.integers(1992, 1999, seg_rows).astype(np.int32),
+                "revenue": rng.integers(100, 600_000, seg_rows).astype(np.int64),
+            }
+            seg_dir = write_segment(builder.build(data, f"{table}_{i}"), os.path.join(root, "built"))
+            rc.upload_segment_dir(table, seg_dir)
+        total_rows = seg_rows * n_segments
+        queries = [
+            f"SELECT COUNT(*) FROM {table} WHERE year > 1994",
+            f"SELECT region, SUM(revenue) FROM {table} GROUP BY region ORDER BY region",
+        ]
+        for _ in range(6):  # JIT warmup per server process
+            for url in both:
+                for q in queries:
+                    try:
+                        query_broker_http(url, q)
+                    except Exception as e:
+                        log(f"HA warmup: {type(e).__name__}: {e}")
+
+        # -- leg 1: split-brain (frozen lease renewal -> fenced writes) ---------
+        log("HA leg 1: freeze ha_c1 lease renewal (lease.renew fault), standby takeover")
+        bg1: dict = {}
+        t1 = threading.Thread(
+            target=lambda: bg1.update(_cluster_drive(both, queries, max(4, n_clients // 2), phase_s + 2.0)),
+            daemon=True,
+        )
+        t1.start()
+        _post_json(
+            f"{c1_url}/debug/faults",
+            {"points": {"lease.renew": {"mode": "error", "prob": 1.0}}, "seed": seed},
+        )
+        takeover = wait_leader(c2_url)
+        # the frozen ex-leader STILL believes it leads: its mutation must be
+        # rejected by the store's fencing check, not by the standby gate
+        ghost = Schema.build("ghost", dimensions=[("g", DataType.STRING)], metrics=[])
+        fenced_code, fenced_body = None, {}
+        try:
+            req = urllib.request.Request(
+                f"{c1_url}/schemas",
+                data=ghost.to_json().encode(),
+                headers={"Content-Type": "application/json"},
+            )
+            urllib.request.urlopen(req, timeout=10)
+        except urllib.error.HTTPError as e:
+            fenced_code = e.code
+            fenced_body = json.loads(e.read())
+        assert fenced_code == 503, f"stale-leader write was not rejected (HTTP {fenced_code})"
+        assert fenced_body.get("errorCode") == 270, f"rejection not typed: {fenced_body}"
+        ex_leader = _get_json(f"{c1_url}/leader")
+        _post_json(f"{c1_url}/debug/faults", {"points": {}})  # thaw renewal
+        demoted = wait_leader(c1_url, want=False)
+        t1.join()
+        out["split_brain"] = {
+            "frozen_leader": "ha_c1",
+            "takeover": takeover,
+            "fenced_response": fenced_body,
+            "fencedWrites": ex_leader.get("fencedWrites"),
+            "ex_leader_after_thaw": demoted,
+            "driven": bg1,
+        }
+        assert ex_leader.get("fencedWrites", 0) >= 1, f"no fenced write recorded: {ex_leader}"
+        assert bg1["outcomes"]["untyped"] == 0, f"split-brain produced untyped errors: {bg1}"
+        assert bg1["outcomes"]["dropped"] == 0, f"split-brain dropped queries: {bg1}"
+        log(
+            f"HA leg 1: epoch {lead_status['leaseEpoch']} -> {takeover['leaseEpoch']}, "
+            f"fencedWrites={ex_leader.get('fencedWrites')}"
+        )
+
+        # -- leg 2: SIGKILL the lead controller MID-REBALANCE under load --------
+        # leadership sits on ha_c2 after leg 1; give the rebalance real moves
+        # by adding a third server, then kill ha_c2 while segments migrate
+        log("HA leg 2: +ha_s2, SIGKILL lead ha_c2 mid-rebalance under live load")
+        server_procs["ha_s2"], _ = start_server("ha_s2", controllers)
+        bg2: dict = {}
+        t2 = threading.Thread(
+            target=lambda: bg2.update(_cluster_drive(both, queries, n_clients, phase_s + 4.0)),
+            daemon=True,
+        )
+        t2.start()
+        time.sleep(0.5)
+        reb_err: list = []
+
+        def fire_rebalance():
+            try:
+                RemoteControllerClient(c2_url).rebalance_table(
+                    table, drain_grace_sec=0.8, bootstrap=True
+                )
+                reb_err.append("completed before kill")
+            except Exception as e:  # the leader dies mid-call: expected
+                reb_err.append(f"{type(e).__name__}: {e}")
+
+        t_reb = threading.Thread(target=fire_rebalance, daemon=True)
+        t_reb.start()
+        time.sleep(1.0)  # inside the move window (>= 2 moves x 0.8s drain)
+        os.kill(c2_proc.pid, signal.SIGKILL)
+        t_reb.join(timeout=30)
+        survivor = wait_leader(c1_url)
+        t2.join()
+        recovery_s = wait_count(b0_url, total_rows, timeout_s=60.0)
+        out["controller_kill"] = {
+            "victim": "ha_c2 (SIGKILL mid-rebalance)",
+            "rebalance_call": reb_err[0] if reb_err else "no outcome recorded",
+            "survivor": survivor,
+            "recovery_to_full_count_s": recovery_s,
+            "driven": bg2,
+        }
+        assert survivor["isLeader"] and survivor["takeovers"] >= 1, survivor
+        assert survivor["leaseEpoch"] > takeover["leaseEpoch"], (
+            f"takeover did not advance the fencing epoch: {survivor} vs {takeover}"
+        )
+        assert bg2["outcomes"]["untyped"] == 0, f"controller kill produced untyped errors: {bg2}"
+        assert bg2["outcomes"]["dropped"] == 0, f"controller kill dropped queries: {bg2}"
+        log(f"HA leg 2: survivor epoch {survivor['leaseEpoch']}, recovered in {recovery_s}s")
+
+        # -- leg 3: SIGKILL one of two brokers under live CLIENT load -----------
+        log("HA leg 3: SIGKILL ha_b1 under live client load (Connection failover)")
+        bg3: dict = {}
+        t3 = threading.Thread(
+            target=lambda: bg3.update(_cluster_drive_conn(both, queries, n_clients, phase_s + 2.0)),
+            daemon=True,
+        )
+        t3.start()
+        time.sleep(max(0.5, phase_s / 3))
+        os.kill(b1_proc.pid, signal.SIGKILL)
+        t3.join()
+        out["broker_kill"] = {"victim": "ha_b1 (SIGKILL)", "driven": bg3}
+        assert bg3["outcomes"]["ok"] > 0, f"no queries served around the broker kill: {bg3}"
+        assert bg3["outcomes"]["untyped"] == 0, f"broker kill produced untyped errors: {bg3}"
+        assert bg3["outcomes"]["dropped"] == 0, f"broker kill dropped queries: {bg3}"
+
+        # -- leg 4: full-cluster cold restart from store dir + deep store -------
+        log("HA leg 4: SIGKILL every process; cold restart from property store + deep store")
+        want = query_broker_http(b0_url, queries[1])["resultTable"]["rows"]
+        count_before = query_broker_http(b0_url, f"SELECT COUNT(*) FROM {table}")[
+            "resultTable"
+        ]["rows"][0][0]
+        for p in procs:
+            if p.poll() is None:
+                os.kill(p.pid, signal.SIGKILL)
+        for p in procs:
+            p.wait(timeout=10)
+        procs.clear()
+        # only the store dir, deep store and server data dirs survive the
+        # "power loss"; every process restarts with fresh ports
+        _, c1_url = start_controller("ha_c1", cold=True)
+        _, c2_url = start_controller("ha_c2")
+        controllers = f"{c1_url},{c2_url}"
+        new_lead = wait_leader(c1_url, timeout_s=30.0)
+        for sid in ("ha_s0", "ha_s1", "ha_s2"):
+            start_server(sid, controllers)
+        _, b0_url = start_broker("ha_b0", controllers)
+        _, b1_url = start_broker("ha_b1", controllers)
+        recovery_s = wait_count(b0_url, count_before, timeout_s=120.0)
+        got = query_broker_http(b0_url, queries[1])["resultTable"]["rows"]
+        out["cold_restart"] = {
+            "lead_after_restart": new_lead,
+            "recovery_to_full_count_s": recovery_s,
+            "rows_identical": got == want,
+            "count": count_before,
+        }
+        assert got == want, f"cold restart diverged: {got} != {want}"
+        assert new_lead["leaseEpoch"] > survivor["leaseEpoch"], (
+            "fencing epoch did not survive the restart (it must be monotonic "
+            f"across cluster generations): {new_lead} vs {survivor}"
+        )
+        log(f"HA leg 4: identical results after cold restart, recovered in {recovery_s}s")
+    finally:
+        for p in procs:
+            try:
+                p.kill()
+            except OSError:
+                pass
+        for p in procs:
+            try:
+                p.wait(timeout=10)
+            except Exception:
+                pass
+        shutil.rmtree(root, ignore_errors=True)
+    return out
+
+
 def cluster_main():
     """`bench.py cluster`: the cluster-survivability acceptance run (ISSUE
     12). A real multi-process topology on one box — 1 controller (+metrics
@@ -1430,8 +1830,12 @@ def cluster_main():
                segment copy + one deep-store copy; the 1s integrity scrubber
                must quarantine + repair both while queries keep answering
                (0 untyped, 0 dropped)
+      phase 8  control-plane survivability (ISSUE 18) on a second topology
+               with 2 HA controllers: split-brain fencing, lead-controller
+               SIGKILL mid-rebalance, broker SIGKILL with client failover,
+               and a full-cluster cold restart — see _cluster_ha_phases
 
-    Writes BENCH_cluster_r13.json and prints the same JSON line."""
+    Writes BENCH_cluster_r18.json and prints the same JSON line."""
     import shutil
     import signal
     import tempfile
@@ -1811,11 +2215,15 @@ def cluster_main():
     assert result["freshness"]["caught_up"], f"ingest never caught up: {result['freshness']}"
     assert result["freshness"]["samples"] > 0, "no freshness samples recorded"
 
+    # -- phase 8: control-plane survivability (2 HA controllers) ---------------
+    log("phase 8: control-plane survivability (split-brain / kills / cold restart)")
+    result["control_plane"] = _cluster_ha_phases(seed, n_clients, phase_s)
+
     result["qps_vs_server_count"] = {
         "4": result["qps_4_servers"]["throughput_qps"],
         "8": result["qps_8_servers"]["throughput_qps"],
     }
-    with open("BENCH_cluster_r13.json", "w") as f:
+    with open("BENCH_cluster_r18.json", "w") as f:
         json.dump(result, f, indent=2)
         f.write("\n")
     print(json.dumps(result))

@@ -1075,6 +1075,65 @@ def bench_atomic_write_overhead(size=4 * 1024 * 1024):
     }
 
 
+def bench_store_cas_overhead(n_docs=200):
+    """Multi-process-safe property store cost: a versioned, flock-guarded
+    `set` vs a bare crash-consistent JSON write of the same doc. The CAS
+    machinery per write is the flock lock/unlock pair + the fault-point
+    guard + the fence check (a no-op read when unfenced); its per-call cost
+    is timed directly and its projected share of one `set` must sit inside
+    the 2% budget — the stable form of the wall-clock assertion (page-cache
+    noise on the version re-read can't flake it)."""
+    import tempfile
+    from pathlib import Path
+
+    from pinot_tpu.cluster.metadata import PropertyStore
+    from pinot_tpu.common.durability import atomic_write_json
+    from pinot_tpu.common.faults import FAULTS
+
+    doc = {"segment": "t_0", "servers": ["s0", "s1"], "docs": 123456, "crc": "deadbeef"}
+    with tempfile.TemporaryDirectory(prefix="pinot_tpu_cas_") as td:
+        root = Path(td)
+        store = PropertyStore(root / "store")
+        i = [0]
+
+        def bare():
+            i[0] += 1
+            atomic_write_json(root / f"bare_{i[0] % n_docs}.json", {"__v": i[0], "doc": doc})
+
+        def versioned_set():
+            i[0] += 1
+            store.set(f"/tables/t/segments/seg_{i[0] % n_docs}", doc)
+
+        bare_ms = _time_host(bare, iters=200)
+        set_ms = _time_host(versioned_set, iters=200)
+
+        # the cross-process exclusion mechanics, isolated: one flock
+        # LOCK_EX/LOCK_UN pair + the production-state fault guard per set
+        FAULTS.reset()
+        cycles = 20_000
+        t0 = time.perf_counter()
+        for _ in range(cycles):
+            with store._exclusive():
+                FAULTS.maybe_fail("store.cas")
+        per_call_us = (time.perf_counter() - t0) / cycles * 1e6
+
+    projected_pct = per_call_us / (set_ms * 1e3) * 100
+    assert projected_pct < 2.0, (
+        f"store CAS guard {per_call_us:.2f}µs = {projected_pct:.2f}% of a "
+        f"{set_ms:.3f}ms set — over the 2% budget"
+    )
+    return {
+        "metric": "store_cas_overhead",
+        "value": round(set_ms - bare_ms, 3),
+        "unit": "ms",
+        "bare_write_ms": round(bare_ms, 3),
+        "versioned_set_ms": round(set_ms, 3),
+        "overhead_pct": round((set_ms / bare_ms - 1.0) * 100, 1),
+        "lock_guard_us_per_set": round(per_call_us, 4),
+        "projected_pct": round(projected_pct, 3),
+    }
+
+
 def bench_scrub_overhead(n_segments=8, rows=20_000):
     """Integrity-scrubber duty cycle: a full CRC sweep of a server's local
     copies vs one budget-throttled increment. The throttle is the overhead
@@ -1294,6 +1353,7 @@ ALL = [
     bench_slo_overhead,
     bench_aggregator_scrape,
     bench_atomic_write_overhead,
+    bench_store_cas_overhead,
     bench_scrub_overhead,
     bench_kernel_obs_overhead,
     bench_frontend_obs_overhead,

@@ -9,6 +9,7 @@ here PEP-249-shaped: cursor().execute/fetchall/description).
 
 from __future__ import annotations
 
+import http.client
 import itertools
 import threading
 import time
@@ -89,11 +90,20 @@ class _BrokerSelector:
 
 
 class Connection:
-    def __init__(self, broker_urls: list[str] | None = None, controller_url: str | None = None):
+    def __init__(
+        self,
+        broker_urls: list[str] | None = None,
+        controller_url: str | list[str] | None = None,
+    ):
         """Static broker list (SimpleBrokerSelector) or controller discovery
         (DynamicBrokerSelector). With a controller, the broker list refreshes
-        on failure."""
+        on failure. `controller_url` accepts one URL, a comma-separated
+        string, or a list — an HA deployment's standbys are candidates, and
+        discovery follows `leaderUrl` hints / fails over when the lead dies.
+        When every controller candidate is down, discovery raises the typed
+        `ControllerUnavailableError` (a ConnectionError subclass)."""
         self._controller_url = controller_url
+        self._controller = None  # lazy RemoteControllerClient, kept so failover state persists
         if broker_urls is None:
             if controller_url is None:
                 raise PinotClientError("need broker_urls or controller_url")
@@ -103,7 +113,9 @@ class Connection:
     def _discover(self) -> list[str]:
         from pinot_tpu.cluster.http import RemoteControllerClient
 
-        brokers = RemoteControllerClient(self._controller_url).brokers()
+        if self._controller is None:
+            self._controller = RemoteControllerClient(self._controller_url)
+        brokers = self._controller.brokers()
         return sorted(brokers.values())
 
     def execute(
@@ -138,8 +150,12 @@ class Connection:
                     raise  # typed admission rejection: honor retry_after_s
                 except PinotClientError:
                     raise  # server-side SQL error: do not retry elsewhere
-                except OSError as e:
-                    last_err = e  # connection-level: try next broker
+                except (OSError, http.client.HTTPException) as e:
+                    # connection-level: refused/reset (OSError) or a torn
+                    # response from a broker killed mid-body (IncompleteRead,
+                    # an HTTPException, not an OSError) — queries are
+                    # idempotent reads, so retry on the next broker
+                    last_err = e
             if self._controller_url is not None:
                 try:
                     self._selector = _BrokerSelector(self._discover())
@@ -231,8 +247,13 @@ def _quote(p) -> str:
     return str(p)
 
 
-def connect(broker_urls: list[str] | str | None = None, controller_url: str | None = None) -> Connection:
-    """ConnectionFactory.fromHostList / fromController parity."""
+def connect(
+    broker_urls: list[str] | str | None = None,
+    controller_url: str | list[str] | None = None,
+) -> Connection:
+    """ConnectionFactory.fromHostList / fromController parity.
+    `controller_url` may name several HA controllers (list or
+    comma-separated string); the client fails over between them."""
     if isinstance(broker_urls, str):
         broker_urls = [broker_urls]
     return Connection(broker_urls, controller_url)

@@ -610,7 +610,10 @@ class Broker:
                     return parse_sql(sql), None
             return self.caches.get_or_parse(sql, on_compile=timer)
 
-        if self.caches is None or normalized is None:
+        if self.caches is None or normalized is None or epoch is None:
+            # epoch None with caches on = routing versions unavailable
+            # (controller failover): plan uncached rather than risk keying
+            # a plan to an unknown routing state
             with timer():
                 self._expand_star(stmt, schema)
                 return stmt, QueryContext.from_statement(stmt)
@@ -650,7 +653,13 @@ class Broker:
             twins.append(t)
             if not t.endswith("_REALTIME"):
                 twins.append(f"{t}_REALTIME")
-        vv = self.controller.routing_versions(twins)
+        try:
+            vv = self.controller.routing_versions(twins)
+        except ConnectionError:
+            # every controller candidate down (HA failover in progress):
+            # degrade to uncached execution — routing state can't be keyed
+            # safely, but the query itself only needs servers, not metadata
+            return None
         versions = tuple(sorted((t, int(v)) for t, v in vv.items()))
         return (normalized, options_fingerprint(stmt.options)), versions, twins
 
@@ -976,11 +985,13 @@ class Broker:
         schema = self.controller.get_schema(table) or self.controller.get_schema(rt_name)
         # plan epoch: the (offline, realtime) routing versions — schema and
         # segment-set changes both land as bumps, re-keying the cached plan
-        epoch = (
-            tuple(sorted(self.controller.routing_versions([table, rt_name]).items()))
-            if self.caches is not None and normalized is not None
-            else None
-        )
+        epoch = None
+        if self.caches is not None and normalized is not None:
+            try:
+                epoch = tuple(sorted(self.controller.routing_versions([table, rt_name]).items()))
+            except ConnectionError:
+                # controller failover in progress: plan uncached this round
+                epoch = None
         stmt, ctx = self._compile(
             sql, stmt=stmt, schema=schema, table=table, normalized=normalized, epoch=epoch
         )

@@ -105,6 +105,47 @@ class Controller:
     def is_leader(self) -> bool:
         return self._election is None or self._election.is_leader
 
+    def lease_fence(self) -> int | None:
+        """Fencing token (lease epoch) lead-path store mutations carry so
+        the store rejects them once a newer lease exists. None when HA is
+        off — single-controller deployments stay unfenced."""
+        return self._election.epoch if self._election is not None else None
+
+    def register_controller_endpoint(self, host: str, port: int) -> None:
+        """Publish this controller's HTTP endpoint so standbys' `leaderUrl`
+        hints and client failover can locate whoever holds the lease."""
+        self.store.set(f"/controllers/{self.controller_id}", {"host": host, "port": port})
+
+    def leader_url(self) -> str | None:
+        """Base URL of the current lease holder, or None when unknown (no
+        lease, or the holder never registered an HTTP endpoint)."""
+        from pinot_tpu.cluster.metadata import LEASE_PATH
+
+        lease = self.store.get(LEASE_PATH) or {}
+        owner = lease.get("owner") or ""
+        if not owner:
+            return None
+        doc = self.store.get(f"/controllers/{owner}") or {}
+        if not doc.get("port"):
+            return None
+        return f"http://{doc['host']}:{doc['port']}"
+
+    def ha_status(self) -> dict:
+        """controller.ha.* observability block for /debug/cluster and
+        GET /leader: lease role, fencing epoch, takeover/fenced-write
+        counters."""
+        from pinot_tpu.common.metrics import controller_metrics
+
+        return {
+            "enabled": self._election is not None,
+            "controllerId": self.controller_id,
+            "isLeader": self.is_leader,
+            "leaseEpoch": self._election.epoch if self._election is not None else 0,
+            "takeovers": self._election.takeovers if self._election is not None else 0,
+            "fencedWrites": int(controller_metrics().meter("controller.ha.fencedWrites").count),
+            "leaderUrl": self.leader_url(),
+        }
+
     # -- instances -----------------------------------------------------------
 
     def register_server(
@@ -117,6 +158,11 @@ class Controller:
         the DefaultTenant."""
         if handle is not None:
             self._servers[server_id] = handle
+        else:
+            # HTTP re-registration (server restart): the endpoint may have
+            # moved ports — drop any cached remote handle built from the old
+            # instance doc so deliveries go to the live process
+            self._servers.pop(server_id, None)
         prev = self.store.get(f"/instances/{server_id}") or {}
         # a re-registration without tags (server restart) must not wipe
         # operator-assigned tenant/tier tags
@@ -161,16 +207,19 @@ class Controller:
     # -- schemas / tables ----------------------------------------------------
 
     def add_schema(self, schema: Schema) -> None:
-        self.store.set(f"/schemas/{schema.name}", {"json": schema.to_json()})
+        # fenced: config mutations from a stale ex-leader (lease lost while
+        # it was paused/partitioned) must bounce like any other lead write
+        self.store.set(f"/schemas/{schema.name}", {"json": schema.to_json()}, fence=self.lease_fence())
 
     def get_schema(self, name: str) -> Schema | None:
         doc = self.store.get(f"/schemas/{name}")
         return Schema.from_json(doc["json"]) if doc else None
 
     def add_table(self, config: TableConfig) -> None:
-        self.store.set(f"/tables/{config.table_name}/config", {"json": config.to_json()})
+        fence = self.lease_fence()
+        self.store.set(f"/tables/{config.table_name}/config", {"json": config.to_json()}, fence=fence)
         if self.store.get(f"/tables/{config.table_name}/idealstate") is None:
-            self.store.set(f"/tables/{config.table_name}/idealstate", {})
+            self.store.set(f"/tables/{config.table_name}/idealstate", {}, fence=fence)
         # config (re)writes can change plans/pruning: treat as a routing change
         self.bump_routing_version(config.table_name)
 
@@ -189,6 +238,7 @@ class Controller:
         doc = self.store.update(
             f"/tables/{table}/routingversion",
             lambda cur: {"v": int((cur or {}).get("v", 0)) + 1},
+            fence=self.lease_fence(),
         )
         return int(doc["v"])
 
@@ -306,10 +356,10 @@ class Controller:
         partitions = self._compute_partitions(segment, config)
         if partitions:
             seg_meta["partitions"] = partitions
-        self.store.set(f"/tables/{table}/segments/{segment.name}", seg_meta)
+        self.store.set(f"/tables/{table}/segments/{segment.name}", seg_meta, fence=self.lease_fence())
         ideal = self.store.get(f"/tables/{table}/idealstate") or {}
         ideal[segment.name] = {s: "ONLINE" for s in assigned}
-        self.store.set(f"/tables/{table}/idealstate", ideal)
+        self.store.set(f"/tables/{table}/idealstate", ideal, fence=self.lease_fence())
         self.bump_routing_version(table)
         # state transition: servers load the segment from the deep store.
         # With HA enabled, a failing server falls back to the durable retry
@@ -406,7 +456,7 @@ class Controller:
         # the segment, THEN cancel queued messages, then unload
         ideal = self.store.get(f"/tables/{table}/idealstate") or {}
         replicas = ideal.pop(segment_name, {})
-        self.store.set(f"/tables/{table}/idealstate", ideal)
+        self.store.set(f"/tables/{table}/idealstate", ideal, fence=self.lease_fence())
         self.bump_routing_version(table)
         if self._transitions is not None:
             self._transitions.cancel(table, segment_name)
@@ -416,7 +466,7 @@ class Controller:
             if srv is not None:
                 srv.remove_segment(table, segment_name)
         meta = self.store.get(f"/tables/{table}/segments/{segment_name}")
-        self.store.delete(f"/tables/{table}/segments/{segment_name}")
+        self.store.delete(f"/tables/{table}/segments/{segment_name}", fence=self.lease_fence())
         if remove_from_deep_store and meta and meta.get("location"):
             import shutil
 
@@ -492,10 +542,25 @@ class Controller:
             ideal[segment] = entry
         else:
             ideal.pop(segment, None)
-        self.store.set(f"/tables/{table}/idealstate", ideal)
+        self.store.set(f"/tables/{table}/idealstate", ideal, fence=self.lease_fence())
         self.bump_routing_version(table)
 
     # -- views ---------------------------------------------------------------
+
+    def reset_external_views(self) -> int:
+        """Disaster-recovery entry point for a full-cluster cold restart:
+        external views record what servers held LAST session, and in the
+        reference they are derived from session-ephemeral Helix current
+        state — a restarted cluster must not trust them. Clearing them makes
+        the reconciler re-enqueue every (segment, replica) the ideal state
+        wants, and restarted servers re-download CRC-verified copies from
+        the deep store. Returns how many view docs were cleared."""
+        n = 0
+        for t in self.tables():
+            if self.store.get(f"/tables/{t}/externalview") is not None:
+                self.store.delete(f"/tables/{t}/externalview", fence=self.lease_fence())
+                n += 1
+        return n
 
     def ideal_state(self, table: str) -> dict:
         return self.store.get(f"/tables/{table}/idealstate") or {}

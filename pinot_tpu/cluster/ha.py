@@ -7,6 +7,12 @@ Reference parity:
   cluster at a time; standbys take over when the lead stops renewing its
   lease. Here: a TTL lease document in the property store, acquired and
   renewed via the store's atomic update (ZK ephemeral-node analog).
+- Fencing tokens: each lease CLAIM increments an epoch (ZK czxid / Helix
+  leader-generation analog). Every store mutation the lead path makes
+  carries the epoch as `fence=`; the store rejects it once a newer lease
+  exists, so a paused/frozen ex-leader cannot corrupt ideal state after a
+  standby takes over. The `lease.renew` fault point deterministically
+  freezes renewal to reproduce exactly that split-brain shape.
 - Helix async state transitions: segment ADD/DELETE messages to servers are
   queued durably in the store and delivered by a worker with exponential
   backoff, so a transiently-failing server converges instead of permanently
@@ -16,10 +22,9 @@ Reference parity:
   transitions for any ideal-vs-external drift
   (SegmentStatusChecker / RealtimeSegmentValidationManager analog).
 
-Scope note: lease atomicity relies on the shared PropertyStore lock, which
-spans threads in one process (the chaos-test deployment shape). A
-multi-process store would supply the same `update` contract via file locks
-or a real ZK/etcd.
+Scope note: with a file-backed PropertyStore the lease `update` is atomic
+ACROSS PROCESSES (flock + versioned writes, see metadata.py), so two real
+controller processes sharing one store dir elect exactly one lead.
 """
 
 from __future__ import annotations
@@ -28,19 +33,40 @@ import itertools
 import threading
 import time
 
-LEASE_PATH = "/controllers/lease"
+from ..common.faults import FAULTS, InjectedFault
+from ..common.metrics import controller_metrics
+from ..common.trace import trace_event
+from .metadata import LEASE_PATH, FencedWriteError
+
+__all__ = ["LEASE_PATH", "LeaderElection", "TransitionManager"]
+
 _msg_seq = itertools.count()
 
 
 class LeaderElection:
-    """TTL-lease leader election over PropertyStore.update."""
+    """TTL-lease leader election over PropertyStore.update, with fencing
+    epochs. `epoch` is the generation of this controller's most recent
+    successful claim (0 = never led); pass it as `fence=` on lead-path
+    store mutations so a stale ex-leader's writes are rejected."""
 
-    def __init__(self, store, controller_id: str, ttl: float = 2.0, renew_every: float = 0.4):
+    def __init__(
+        self,
+        store,
+        controller_id: str,
+        ttl: float = 2.0,
+        renew_every: float = 0.4,
+        on_gain=None,
+        on_lose=None,
+    ):
         self.store = store
         self.controller_id = controller_id
         self.ttl = ttl
         self.renew_every = renew_every
+        self.on_gain = on_gain
+        self.on_lose = on_lose
+        self.takeovers = 0
         self._leader = False
+        self._epoch = 0
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
 
@@ -54,41 +80,105 @@ class LeaderElection:
         if self._thread is not None:
             self._thread.join(timeout=5)
         if release and self._leader:
-            # graceful handoff: drop the lease so a standby takes over NOW
+            # graceful handoff: drop the lease so a standby takes over NOW.
+            # The epoch is preserved — the successor's claim must still
+            # increment past ours so our in-flight writes stay fenced.
             self.store.update(
                 LEASE_PATH,
-                lambda doc: {"owner": "", "expires": 0.0}
+                lambda doc: {"owner": "", "expires": 0.0, "epoch": int(doc.get("epoch", 0))}
                 if doc and doc.get("owner") == self.controller_id
                 else None,
             )
-        self._leader = False
+        self._set_leader(False)
 
     @property
     def is_leader(self) -> bool:
         return self._leader
 
+    @property
+    def epoch(self) -> int:
+        """Fencing token: lease generation of our most recent claim."""
+        return self._epoch
+
+    def _set_leader(self, leader: bool) -> None:
+        was = self._leader
+        self._leader = leader  # pinotlint: disable=race-discipline — single-writer boolean: only the renew thread (and pre-start start()/post-join stop()) assigns it; readers take a monotonic snapshot and stop() joins the writer before its own clear
+        m = controller_metrics()
+        m.gauge("controller.ha.isLeader").set(1.0 if leader else 0.0)
+        m.gauge("controller.ha.leaseEpoch").set(float(self._epoch))
+        if leader and not was:
+            self.takeovers += 1
+            m.meter("controller.ha.takeovers").mark()
+            trace_event("ha.lease_gained", controller=self.controller_id, epoch=self._epoch)
+            if self.on_gain is not None:
+                try:
+                    self.on_gain()
+                except Exception:  # pinotlint: disable=deadline-swallow — lease-transition hook: a failing callback must not kill the renew thread
+                    pass
+        elif was and not leader:
+            trace_event("ha.lease_lost", controller=self.controller_id, epoch=self._epoch)
+            if self.on_lose is not None:
+                try:
+                    self.on_lose()
+                except Exception:  # pinotlint: disable=deadline-swallow — lease-transition hook: a failing callback must not kill the renew thread
+                    pass
+
     def _tick(self) -> None:
-        now = time.time()
         cid = self.controller_id
+        try:
+            FAULTS.maybe_fail("lease.renew")
+        except InjectedFault:
+            # renewal frozen: self._leader stays (stale) True while the lease
+            # expires under us — the split-brain shape the fencing epoch
+            # exists to defuse. Every lead-path write we attempt after a
+            # standby claims is rejected with FencedWriteError.
+            trace_event("fault.injected", point="lease.renew", controller=cid)
+            return
 
         def claim(doc):
-            if doc is None or doc.get("expires", 0) < now or doc.get("owner") == cid:
-                return {"owner": cid, "expires": now + self.ttl}
+            # `now` is read INSIDE the closure: the store may block on the
+            # cross-process lock, and claiming with a pre-lock timestamp
+            # could grant a lease that is already (or not yet) expired.
+            now = time.time()
+            cur_epoch = int((doc or {}).get("epoch", 0))
+            expired = doc is None or doc.get("expires", 0) < now
+            if not expired and doc.get("owner") == cid and cur_epoch == self._epoch and self._leader:
+                # plain renewal of the lease THIS incarnation claimed: same
+                # generation (owner match alone is not enough — see below)
+                return {"owner": cid, "expires": now + self.ttl, "epoch": cur_epoch}
+            if expired or doc.get("owner") == cid:
+                # bump the generation: takeover of an expired lease, re-claim
+                # of our own expired lease (paused past TTL, old epoch is
+                # suspect), or adoption of a LIVE lease left by a previous
+                # incarnation with our identity (process restarted inside the
+                # TTL — the ZK-session analog: a new session, not a renewal).
+                # In every case the predecessor's in-flight writes must fence.
+                return {"owner": cid, "expires": now + self.ttl, "epoch": cur_epoch + 1}
             return None
 
         got = self.store.update(LEASE_PATH, claim)
-        self._leader = bool(got) and got.get("owner") == cid  # pinotlint: disable=race-discipline — single-writer boolean: only the renew thread (and pre-start start()) assigns it; readers take a monotonic snapshot and stop() joins the writer before its own clear
+        if got is not None and got.get("owner") == cid:
+            self._epoch = int(got.get("epoch", 0))  # pinotlint: disable=race-discipline — single-writer int: only the renew thread (and pre-start start()) assigns it; readers snapshot a monotonically-increasing fence, and a one-tick-stale epoch only makes fencing MORE conservative
+            self._set_leader(True)
+        else:
+            self._set_leader(False)
 
     def _run(self) -> None:
         while not self._stop.wait(self.renew_every):
-            self._tick()
+            try:
+                self._tick()
+            except InjectedFault:
+                # store.cas chaos: skip this renewal; lease TTL expiry and
+                # the next tick handle recovery
+                continue
 
 
 class TransitionManager:
     """Durable segment state-transition queue + delivery worker +
     ideal/external reconciler. Runs (delivers) only while this controller
     holds the lease; the queue itself lives in the shared store, so a new
-    lead resumes exactly where the old one stopped."""
+    lead resumes exactly where the old one stopped. Every queue mutation
+    carries the lease epoch as a fencing token."""
 
     BACKOFF_BASE = 0.2
     BACKOFF_MAX = 5.0
@@ -100,6 +190,10 @@ class TransitionManager:
         self.poll_every = poll_every
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
+
+    def _fence(self) -> int | None:
+        """Lease epoch to stamp on store mutations; None when HA is off."""
+        return self.election.epoch if self.election is not None else None
 
     # -- enqueue ---------------------------------------------------------------
 
@@ -116,6 +210,7 @@ class TransitionManager:
                 "attempts": 0,
                 "notBefore": 0.0,
             },
+            fence=self._fence(),
         )
 
     # -- lifecycle -------------------------------------------------------------
@@ -134,10 +229,16 @@ class TransitionManager:
         while not self._stop.wait(self.poll_every):
             if self.election is not None and not self.election.is_leader:
                 continue
-            self.drain_once()
-            if time.time() - last_reconcile > 1.0:
-                self.reconcile()
-                last_reconcile = time.time()
+            try:
+                self.drain_once()
+                if time.time() - last_reconcile > 1.0:
+                    self.reconcile()
+                    last_reconcile = time.time()
+            except (FencedWriteError, InjectedFault):
+                # fenced as a stale ex-leader (a standby took the lease) or
+                # chaos-injected store failure: drop this cycle — the new
+                # lead owns the queue, and our next is_leader check gates us
+                continue
 
     def cancel(self, table: str, segment: str) -> int:
         """Drop queued transitions for a segment (called on delete) and clear
@@ -146,11 +247,12 @@ class TransitionManager:
         for path in self.store.list("/transitions/"):
             msg = self.store.get(path)
             if msg is not None and msg["table"] == table and msg["segment"] == segment:
-                self.store.delete(path)
+                self.store.delete(path, fence=self._fence())
                 n += 1
         self.store.update(
             f"/tables/{table}/externalview",
             lambda doc: ({k: v for k, v in (doc or {}).items() if k != segment}),
+            fence=self._fence(),
         )
         return n
 
@@ -184,26 +286,28 @@ class TransitionManager:
         delivered = 0
         now = time.time()
         for path in self.store.list("/transitions/"):
-            msg = self.store.get(path)
+            msg, ver = self.store.get_versioned(path)
             if msg is None or msg.get("notBefore", 0) > now:
                 continue
             if self._deliver(msg):
-                self.store.delete(path)
+                self.store.delete(path, fence=self._fence())
                 delivered += 1
             else:
                 attempts = msg["attempts"] + 1
                 if attempts >= self.MAX_ATTEMPTS:
                     # dead-letter: stop hammering a permanently-failing
                     # delivery; the drift stays visible via ideal-vs-external
-                    self.store.delete(path)
-                    self.store.set(f"/deadletters/{path.split('/')[-1]}", msg)
+                    self.store.delete(path, fence=self._fence())
+                    self.store.set(f"/deadletters/{path.split('/')[-1]}", msg, fence=self._fence())
                     continue
                 backoff = min(self.BACKOFF_BASE * (2 ** attempts), self.BACKOFF_MAX)
                 msg["attempts"] = attempts
                 msg["notBefore"] = time.time() + backoff
-                # write back ONLY if still queued — a concurrent cancel()
-                # (segment delete) must not be resurrected by a retry update
-                self.store.update(path, lambda cur, _m=msg: _m if cur is not None else None)
+                # CAS on the version we read: a concurrent leader's delete
+                # (delivery or cancel) or redelivery bump must not be
+                # clobbered or resurrected by this retry write-back — a
+                # plain existence-checked update loses that race
+                self.store.cas(path, ver, msg, fence=self._fence())
         return delivered
 
     def _deliver(self, msg: dict) -> bool:
@@ -243,7 +347,7 @@ class TransitionManager:
                 entry[server_id] = state
             return doc
 
-        self.store.update(f"/tables/{table}/externalview", upd)
+        self.store.update(f"/tables/{table}/externalview", upd, fence=self._fence())
 
     # -- reconciliation --------------------------------------------------------
 

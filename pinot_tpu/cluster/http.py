@@ -1157,6 +1157,35 @@ class ControllerHTTPService:
                 self.end_headers()
                 self.wfile.write(payload)
 
+            def _reject_standby(self, c) -> bool:
+                """Standby gate for mutating endpoints: 503 + leaderUrl hint
+                (the lead-controller REST redirect contract — clients follow
+                the hint instead of mutating through a non-lead)."""
+                if c.is_leader:
+                    return False
+                self._json(
+                    {
+                        "error": f"not leader: controller {c.controller_id!r} is standby",
+                        "errorCode": int(QueryErrorCode.CONTROLLER_UNAVAILABLE),
+                        "leaderUrl": c.leader_url(),
+                    },
+                    503,
+                )
+                return True
+
+            def _fenced(self, c, e) -> None:
+                """A mutation slipped past the standby gate on a stale
+                ex-leader (lease lost mid-request) and the store rejected it:
+                same 503 + leaderUrl contract as the gate."""
+                self._json(
+                    {
+                        "error": f"{type(e).__name__}: {e}",
+                        "errorCode": int(QueryErrorCode.CONTROLLER_UNAVAILABLE),
+                        "leaderUrl": c.leader_url(),
+                    },
+                    503,
+                )
+
             def do_GET(self):
                 c = svc.controller
                 try:
@@ -1181,6 +1210,14 @@ class ControllerHTTPService:
                         self._json({"status": "OK"})
                     elif self.path == "/health/ready":
                         _serve_ready(self, c.readiness)
+                    elif self.path == "/leader":
+                        # lease observability for failover probes and the
+                        # chaos bench: role, epoch, takeover/fence counters
+                        self._json(c.ha_status())
+                    elif self.path == "/debug/faults":
+                        from pinot_tpu.common.faults import FAULTS
+
+                        self._json({"enabled": FAULTS.enabled, "counts": FAULTS.counts()})
                     elif self.path == "/debug/frontend":
                         self._json(
                             frontend_snapshot(
@@ -1255,8 +1292,14 @@ class ControllerHTTPService:
                     self._json({"error": f"{type(e).__name__}: {e}", "errorCode": code_of(e)}, 500)
 
             def do_DELETE(self):
+                from pinot_tpu.cluster.metadata import FencedWriteError
+
                 c = svc.controller
                 parts = self.path.strip("/").split("/")
+                # the query-cancel proxy stays available on standbys (it only
+                # fans out to brokers); metadata deletes are lead-only
+                if len(parts) == 2 and parts[0] in ("tables", "schemas") and self._reject_standby(c):
+                    return
                 try:
                     if len(parts) == 2 and parts[0] == "tables":
                         removed = c.delete_table(parts[1])
@@ -1286,18 +1329,40 @@ class ControllerHTTPService:
                         )
                     else:
                         self._json({"error": "not found"}, 404)
+                except FencedWriteError as e:
+                    self._fenced(c, e)
                 except ValueError as e:
                     self._json({"error": str(e)}, 409)
                 except Exception as e:
                     self._json({"error": f"{type(e).__name__}: {e}", "errorCode": code_of(e)}, 500)
 
             def do_POST(self):  # noqa: C901
+                from pinot_tpu.cluster.metadata import FencedWriteError
                 from pinot_tpu.common.config import TableConfig
                 from pinot_tpu.common.types import Schema
 
                 c = svc.controller
                 n = int(self.headers.get("Content-Length", 0))
                 raw = self.rfile.read(n)
+                if self.path == "/debug/faults":
+                    # runtime chaos arming, deliberately NOT lead-gated: the
+                    # split-brain test arms lease.renew on the current lead,
+                    # then must disarm it AFTER it has become a fenced standby
+                    from pinot_tpu.common.faults import FAULT_POINTS, FAULTS
+
+                    try:
+                        body = json.loads(raw or b"{}")
+                        points = body.get("points") or {}
+                        unknown = sorted(set(points) - FAULT_POINTS)
+                        if unknown:
+                            raise ValueError(f"unknown fault points: {unknown}")
+                        FAULTS.configure(points, seed=int(body.get("seed", 0)))
+                        self._json({"armed": sorted(points)})
+                    except Exception as e:
+                        self._json({"error": f"{type(e).__name__}: {e}", "errorCode": code_of(e)}, 400)
+                    return
+                if self._reject_standby(c):
+                    return
                 try:
                     parts = [p for p in self.path.split("/") if p]
                     ac = getattr(c, "access_control", None)
@@ -1382,6 +1447,8 @@ class ControllerHTTPService:
                         self._json({"error": "not found"}, 404)
                 except PermissionError as e:
                     self._json({"error": str(e)}, 403)
+                except FencedWriteError as e:
+                    self._fenced(c, e)
                 except Exception as e:
                     self._json({"error": f"{type(e).__name__}: {e}", "errorCode": code_of(e)}, 500)
 
@@ -1396,36 +1463,92 @@ class ControllerHTTPService:
 class RemoteControllerClient:
     """Client-side controller handle over REST (used by CLI/clients and by
     broker processes running apart from the controller). Control-plane
-    calls share the same keep-alive pool as the data plane."""
+    calls share the same keep-alive pool as the data plane.
 
-    def __init__(self, base_url: str, timeout: float = 30.0):
-        self.base_url = base_url.rstrip("/")
+    HA failover: accepts one URL, a comma-separated list, or a list of
+    URLs. Requests walk the candidates with bounded retry + backoff on
+    ConnectionError/503; a standby's 503 `leaderUrl` hint is followed and
+    promoted to the front (so subsequent calls go straight to the lead).
+    When every candidate is down or refusing leadership, a typed
+    `ControllerUnavailableError` surfaces instead of a raw ConnectionError."""
+
+    def __init__(self, base_url, timeout: float = 30.0, max_attempts: int = 3, backoff_s: float = 0.1):
+        if isinstance(base_url, (list, tuple)):
+            raw_urls = [str(u) for u in base_url]
+        else:
+            raw_urls = str(base_url).split(",")
+        self.urls = [u.strip().rstrip("/") for u in raw_urls if u.strip()]
+        if not self.urls:
+            raise ValueError("RemoteControllerClient needs at least one controller URL")
         self.timeout = timeout
-        self._host, self._port = _host_port(self.base_url)
+        self.max_attempts = max_attempts
+        self.backoff_s = backoff_s
+
+    @property
+    def base_url(self) -> str:
+        """Current preferred candidate (the known/most-recent lead)."""
+        return self.urls[0]
+
+    def _promote(self, url: str) -> None:
+        u = url.rstrip("/")
+        cur = self.urls
+        if cur and cur[0] == u:
+            return
+        # single reference assignment: racing request threads see either
+        # order, both of which contain every candidate
+        self.urls = [u] + [x for x in cur if x != u]
+
+    def _request(self, method: str, path: str, body: bytes | None = None,
+                 content_type: str = "application/json") -> dict:
+        from pinot_tpu.common.errors import ControllerUnavailableError
+
+        headers = {"Content-Type": content_type} if body is not None else None
+        last_err: Exception | None = None
+        for attempt in range(self.max_attempts):
+            for url in list(self.urls):
+                host, port = _host_port(url)
+                try:
+                    with get_pool().request(
+                        host, port, method, path, body=body, headers=headers, timeout_s=self.timeout
+                    ) as resp:
+                        payload = resp.read()
+                        status = resp.status
+                except OSError as e:
+                    last_err = e  # dead candidate: try the next one
+                    continue
+                if status == 503:
+                    # a standby (or a just-fenced ex-lead): follow its
+                    # leaderUrl hint when offered, else walk the candidates
+                    try:
+                        hint = json.loads(payload).get("leaderUrl")
+                    except (ValueError, AttributeError):
+                        hint = None
+                    if hint:
+                        self._promote(hint)
+                    last_err = RuntimeError(
+                        f"controller {url} not leading ({status}): "
+                        f"{bytes(payload).decode(errors='replace')}"
+                    )
+                    continue
+                if status >= 400:
+                    raise RuntimeError(
+                        f"controller error ({status}): {bytes(payload).decode(errors='replace')}"
+                    )
+                self._promote(url)
+                return json.loads(payload)
+            if attempt + 1 < self.max_attempts:
+                time.sleep(self.backoff_s * (attempt + 1))
+        raise ControllerUnavailableError(
+            f"no controller reachable and leading after {self.max_attempts} attempts "
+            f"across {self.urls}: {last_err}",
+            candidates=list(self.urls),
+        )
 
     def _get(self, path: str) -> dict:
-        with get_pool().request(self._host, self._port, "GET", path, timeout_s=self.timeout) as resp:
-            payload = resp.read()
-            if resp.status >= 400:
-                raise RuntimeError(
-                    f"controller error ({resp.status}): {bytes(payload).decode(errors='replace')}"
-                )
-        return json.loads(payload)
+        return self._request("GET", path)
 
     def _post(self, path: str, data: bytes, content_type: str = "application/json") -> dict:
-        with get_pool().request(
-            self._host,
-            self._port,
-            "POST",
-            path,
-            body=data,
-            headers={"Content-Type": content_type},
-            timeout_s=self.timeout,
-        ) as resp:
-            payload = resp.read()
-            if resp.status >= 400:
-                raise RuntimeError(f"controller error: {bytes(payload).decode(errors='replace')}")
-        return json.loads(payload)
+        return self._request("POST", path, body=data, content_type=content_type)
 
     def health(self) -> bool:
         try:
@@ -1488,13 +1611,12 @@ class RemoteControllerClient:
         self._post("/tables", config.to_json().encode())
 
     def _delete(self, path: str) -> dict:
-        with get_pool().request(
-            self._host, self._port, "DELETE", path, timeout_s=self.timeout
-        ) as resp:
-            payload = resp.read()
-            if resp.status >= 400:
-                raise RuntimeError(f"controller error: {bytes(payload).decode(errors='replace')}")
-        return json.loads(payload)
+        return self._request("DELETE", path)
+
+    def leader(self) -> dict:
+        """GET /leader: the answering controller's lease view (role, epoch,
+        takeover/fence counters, leaderUrl)."""
+        return self._get("/leader")
 
     def delete_table(self, name: str) -> dict:
         return self._delete(f"/tables/{name}")

@@ -272,7 +272,11 @@ class IntegrityScrubber(ControllerPeriodicTask):
             atomic_write_bytes(f, data)
             meta = dict(meta)
             meta["fileCrc"] = crc
-            self.controller.store.set(f"/tables/{table}/segments/{name}", meta)
+            # fenced: a scrubber sweep outliving this controller's lease
+            # must not overwrite metadata the new lead has since rewritten
+            self.controller.store.set(
+                f"/tables/{table}/segments/{name}", meta, fence=self.controller.lease_fence()
+            )
             self.controller.bump_routing_version(table)
             logging.getLogger("pinot_tpu.storage").warning(
                 "re-replicated corrupt deep-store copy of %s/%s from %s", table, name, sid
@@ -976,6 +980,9 @@ class ClusterMetricsAggregator(ControllerPeriodicTask):
                 },
             },
             "rebalance": _rebalance_progress(),
+            "controllerHa": self.controller.ha_status()
+            if hasattr(self.controller, "ha_status")
+            else {"enabled": False},
             "topTables": {
                 "byQps": [dict(v, table=t) for t, v in by_qps],
                 "byCpu": [dict(v, table=t) for t, v in by_cpu],
@@ -988,12 +995,17 @@ class ClusterMetricsAggregator(ControllerPeriodicTask):
 
 class PeriodicTaskScheduler:
     """Daemon-timer driver for registered tasks (the lead-controller's
-    periodic task executor)."""
+    periodic task executor). When bound to a controller, tasks are
+    LEAD-ONLY: a standby's scheduler idles (threads alive, run_once
+    skipped) and resumes the moment its controller wins the lease —
+    aggregator/scrubber sweeps from two controllers would double-scrape
+    and, worse, race repairs."""
 
     def __init__(self, controller=None):
         self._tasks: list[ControllerPeriodicTask] = []
         self._threads: list[threading.Thread] = []
         self._running = False
+        self._controller = controller
         # the controller's /health/ready reports on whichever scheduler
         # bound itself here (readiness component "periodicScheduler")
         if controller is not None:
@@ -1009,12 +1021,19 @@ class PeriodicTaskScheduler:
     def run_all_once(self) -> dict:
         return {t.name: t.run_once() for t in self._tasks}
 
+    def _should_run(self) -> bool:
+        """Lead-only gate: run when unbound (tests, single controller) or
+        when the bound controller currently holds the lease."""
+        c = self._controller
+        return c is None or bool(getattr(c, "is_leader", True))
+
     def start(self) -> None:
         self._running = True
         for task in self._tasks:
             def loop(t=task):
                 while self._running:
-                    t.run_once()
+                    if self._should_run():
+                        t.run_once()
                     deadline = time.monotonic() + t.interval_sec
                     while self._running and time.monotonic() < deadline:
                         time.sleep(min(0.2, t.interval_sec))

@@ -230,7 +230,11 @@ def rebalance_table(
             meta = controller.segment_metadata(table, seg)
             if meta is not None:
                 meta["servers"] = sorted(target[seg])
-                controller.store.set(f"/tables/{table}/segments/{seg}", meta)
+                # fenced: a rebalance surviving on a stale ex-leader (lease
+                # lost mid-move) must not clobber the new lead's placement
+                controller.store.set(
+                    f"/tables/{table}/segments/{seg}", meta, fence=controller.lease_fence()
+                )
                 controller.bump_routing_version(table)
         _progress_update(
             table,

@@ -46,6 +46,12 @@ class QueryErrorCode(enum.IntEnum):
     #: BrokerResponse as a partial-result exception entry.
     SEGMENT_CORRUPTED = 260
 
+    #: no controller candidate is reachable and leading — every configured
+    #: URL refused/timed out or answered "not leader" without a followable
+    #: leaderUrl hint (BROKER_INSTANCE_MISSING / controller-unreachable
+    #: parity). Travels as HTTP 503 so clients back off and retry.
+    CONTROLLER_UNAVAILABLE = 270
+
 
 #: Error codes that map to a non-200 HTTP status at response boundaries.
 #: Everything else stays the BrokerResponse convention: HTTP 200 with the
@@ -54,6 +60,7 @@ class QueryErrorCode(enum.IntEnum):
 _HTTP_STATUS_BY_CODE = {
     int(QueryErrorCode.SERVER_OUT_OF_CAPACITY): 503,
     int(QueryErrorCode.QUOTA_EXCEEDED): 429,
+    int(QueryErrorCode.CONTROLLER_UNAVAILABLE): 503,
 }
 
 
@@ -70,6 +77,22 @@ class SegmentCorruptedError(ValueError):
     def __init__(self, message: str, path: str | None = None):
         super().__init__(message)
         self.path = path
+
+
+class ControllerUnavailableError(ConnectionError):
+    """Every configured controller candidate is down or refusing leadership
+    (connection failures and 503s with no followable leaderUrl across the
+    bounded retry budget). Subclasses ConnectionError so legacy callers that
+    guard discovery with `except ConnectionError`/`except OSError` keep
+    working; carries `error_code` so response boundaries surface a typed
+    503 with Retry-After instead of an untyped stack."""
+
+    error_code = QueryErrorCode.CONTROLLER_UNAVAILABLE
+
+    def __init__(self, message: str, candidates: list[str] | None = None, retry_after_s: float = 1.0):
+        super().__init__(message)
+        self.candidates = list(candidates or [])
+        self.retry_after_s = retry_after_s
 
 
 class SegmentUploadError(OSError):

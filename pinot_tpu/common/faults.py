@@ -61,6 +61,11 @@ FAULT_POINTS = frozenset(
         "stream.lag",  # PartitionConsumer batch fetch, consumer-lag delay
         "storage.write",  # atomic_write_bytes, before the tmp-file write
         "storage.read",  # SegmentFileReader open, after the bytes are read
+        "store.cas",  # PropertyStore update/cas, before taking the exclusive
+        # section — contended-CAS retry exhaustion on the metadata store
+        "lease.renew",  # LeaderElection._tick, before the lease claim —
+        # deterministically freezes renewal so a standby takes over while
+        # the (stale) ex-leader still believes it leads (split-brain test)
     }
 )
 

@@ -51,12 +51,28 @@ def cmd_start_controller(args) -> dict:
     from pinot_tpu.minion.tasks import BUILTIN_GENERATORS
 
     store = PropertyStore(args.store_dir)
-    controller = Controller(store, args.deep_store)
+    controller = Controller(store, args.deep_store, controller_id=getattr(args, "controller_id", "controller_0"))
     tm = PinotTaskManager(controller)
     for g in BUILTIN_GENERATORS:
         tm.register_generator(g())
     svc = ControllerHTTPService(controller, port=args.port, task_manager=tm)
     handles = {"controller": controller, "service": svc, "task_manager": tm}
+    if getattr(args, "cold_start", False):
+        # DR runbook step: after a full-cluster restart the stored external
+        # views describe dead server sessions; clear them so the reconciler
+        # re-converges every replica from the deep store
+        cleared = controller.reset_external_views()
+        print(f"cold-start: cleared {cleared} external views", flush=True)
+    if getattr(args, "ha", False):
+        # HA: publish this controller's endpoint (leaderUrl hints), then join
+        # the lease election. A standby's mutating endpoints 503 with the
+        # lead's URL until it wins a takeover; the transition queue, scrubber
+        # and aggregator only act on whoever holds the lease.
+        controller.register_controller_endpoint("127.0.0.1", svc.port)
+        controller.enable_ha(
+            lease_ttl=getattr(args, "lease_ttl", 2.0),
+            renew_every=getattr(args, "renew_every", 0.4),
+        )
     if getattr(args, "with_periodics", False):
         # federated metrics hub: scrape every registered broker/server and
         # serve /debug/cluster + /debug/alerts from this process
@@ -550,6 +566,21 @@ def build_parser() -> argparse.ArgumentParser:
     c.add_argument("--store-dir", required=True)
     c.add_argument("--deep-store", required=True)
     c.add_argument("--port", type=int, default=0)
+    c.add_argument("--controller-id", default="controller_0")
+    c.add_argument(
+        "--ha",
+        action="store_true",
+        help="join lead-controller election over the shared store; standbys "
+        "503 mutating endpoints with a leaderUrl hint until they take over",
+    )
+    c.add_argument("--lease-ttl", type=float, default=2.0, help="lead lease TTL seconds (with --ha)")
+    c.add_argument("--renew-every", type=float, default=0.4, help="lease renew period seconds (with --ha)")
+    c.add_argument(
+        "--cold-start",
+        action="store_true",
+        help="full-cluster restart recovery: clear stale external views so "
+        "the reconciler re-converges every replica from the deep store",
+    )
     c.add_argument(
         "--with-periodics",
         action="store_true",
@@ -570,7 +601,11 @@ def build_parser() -> argparse.ArgumentParser:
     c.set_defaults(fn=cmd_start_controller, blocking=True)
 
     s = sub.add_parser("StartServer")
-    s.add_argument("--controller-url", required=True)
+    s.add_argument(
+        "--controller-url",
+        required=True,
+        help="controller URL(s); comma-separate HA candidates for failover",
+    )
     s.add_argument("--server-id", default="server_0")
     s.add_argument("--port", type=int, default=0)
     s.add_argument("--scheduler", default="", help="fcfs|priority|binary_workload (default: none)")
@@ -584,7 +619,11 @@ def build_parser() -> argparse.ArgumentParser:
     s.set_defaults(fn=cmd_start_server, blocking=True)
 
     b = sub.add_parser("StartBroker")
-    b.add_argument("--controller-url", required=True)
+    b.add_argument(
+        "--controller-url",
+        required=True,
+        help="controller URL(s); comma-separate HA candidates for failover",
+    )
     b.add_argument("--broker-id", default="broker_0")
     b.add_argument("--port", type=int, default=0)
     b.add_argument(

@@ -6,14 +6,22 @@ Helix async state transitions with retry, and the validator periodic tasks
 (SegmentStatusChecker / RealtimeSegmentValidationManager) that converge
 ideal vs external view; chaos shape follows ChaosMonkeyIntegrationTest
 (pinot-integration-tests/.../ChaosMonkeyIntegrationTest.java:47).
+
+Control-plane survivability additions: multi-process CAS on the file-backed
+store (flock + versioned writes), fencing-epoch rejection of stale-leader
+writes (the split-brain hole), standby 503 + leaderUrl redirect over HTTP,
+lead-only periodic planes on lease flap, and cold restart from the store dir.
 """
 
+import subprocess
+import sys
 import time
 
 import numpy as np
 import pytest
 
 from pinot_tpu.cluster import Broker, Controller, PropertyStore, Server
+from pinot_tpu.cluster.metadata import LEASE_PATH, FencedWriteError
 from pinot_tpu.common import DataType, Schema, TableConfig
 from pinot_tpu.segment import SegmentBuilder
 
@@ -171,4 +179,283 @@ def test_chaos_lead_death_mid_ingestion(tmp_path):
         assert store.list("/transitions/") == []
     finally:
         c1.stop_ha()
+        c2.stop_ha()
+
+
+# -- control-plane survivability ----------------------------------------------
+
+_CAS_HAMMER = """
+import sys
+from pinot_tpu.cluster.metadata import PropertyStore
+
+store = PropertyStore(sys.argv[1])
+for _ in range(int(sys.argv[2])):
+    store.update("/counter", lambda d: {"n": (d or {"n": 0})["n"] + 1})
+"""
+
+
+def test_multi_process_cas_no_lost_updates(tmp_path):
+    """Two REAL processes hammer `update` on one file-backed store: the
+    flock critical section must make every read-modify-write atomic across
+    processes, and the stamped version must count every write (monotonic,
+    no lost updates). This is the property the lead lease rests on."""
+    root = tmp_path / "store"
+    per_proc, nprocs = 150, 2
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-c", _CAS_HAMMER, str(root), str(per_proc)],
+            stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE,
+        )
+        for _ in range(nprocs)
+    ]
+    for p in procs:
+        _, err = p.communicate(timeout=120)
+        assert p.returncode == 0, err.decode()
+    store = PropertyStore(root)
+    doc, ver = store.get_versioned("/counter")
+    assert doc == {"n": per_proc * nprocs}, f"lost updates: {doc}"
+    assert ver == per_proc * nprocs, f"version skipped writes: {ver}"
+
+
+def test_fenced_write_rejected_after_takeover(tmp_path):
+    """A stale ex-leader (its lease epoch superseded) must have every
+    fenced store mutation REJECTED — the split-brain hole a paused or
+    partitioned controller would otherwise corrupt ideal state through."""
+    from pinot_tpu.common.metrics import controller_metrics
+
+    store = PropertyStore(tmp_path / "store")
+    c1 = Controller(store, tmp_path / "deep", controller_id="c1")
+    c1.enable_ha(lease_ttl=5.0, renew_every=0.1)
+    try:
+        deadline = time.time() + 5
+        while time.time() < deadline and not c1.is_leader:
+            time.sleep(0.05)
+        assert c1.is_leader
+        stale_fence = c1.lease_fence()
+        # another controller takes over: epoch bumps past c1's fence
+        store.update(
+            LEASE_PATH,
+            lambda d: {"owner": "usurper", "expires": time.time() + 30, "epoch": d["epoch"] + 1},
+        )
+        before = controller_metrics().meter("controller.ha.fencedWrites").count
+        with pytest.raises(FencedWriteError) as ei:
+            store.set("/tables/t/idealstate", {"t_0": {"s0": "ONLINE"}}, fence=stale_fence)
+        assert ei.value.current_epoch > ei.value.fence
+        # the rejected write never landed, and observability saw it
+        assert store.get("/tables/t/idealstate") is None
+        assert controller_metrics().meter("controller.ha.fencedWrites").count > before
+        assert c1.ha_status()["fencedWrites"] >= 1
+    finally:
+        c1.stop_ha(release_lease=False)
+
+
+def test_split_brain_frozen_renewal_is_fenced(tmp_path):
+    """The classic split-brain: the lead's renewal freezes (GC pause /
+    partition simulated by the lease.renew fault point), its lease expires,
+    a new leader claims a higher epoch — and the frozen ex-leader's fenced
+    writes bounce when it wakes up still believing it leads."""
+    from pinot_tpu.common.faults import FAULTS
+
+    store = PropertyStore(tmp_path / "store")
+    c1 = Controller(store, tmp_path / "deep", controller_id="c1")
+    c1.enable_ha(lease_ttl=0.5, renew_every=0.1)
+    try:
+        deadline = time.time() + 5
+        while time.time() < deadline and not c1.is_leader:
+            time.sleep(0.05)
+        assert c1.is_leader
+        frozen_fence = c1.lease_fence()
+        # freeze c1's renewal deterministically (prob=1.0 error mode)
+        FAULTS.configure({"lease.renew": {"mode": "error", "prob": 1.0}})
+        time.sleep(0.7)  # > ttl: the lease is now expired on disk
+        # a standby claims the expired lease at epoch+1
+        store.update(
+            LEASE_PATH,
+            lambda d: {"owner": "c2", "expires": time.time() + 30, "epoch": d["epoch"] + 1},
+        )
+        # the frozen ex-leader wakes and tries a lead-path mutation
+        with pytest.raises(FencedWriteError):
+            store.set("/tables/t/idealstate", {"t_0": {"s0": "ONLINE"}}, fence=frozen_fence)
+        # ...and once renewal thaws, it observes the foreign lease and demotes
+        FAULTS.reset()
+        deadline = time.time() + 5
+        while time.time() < deadline and c1.is_leader:
+            time.sleep(0.05)
+        assert not c1.is_leader
+    finally:
+        FAULTS.reset()
+        c1.stop_ha(release_lease=False)
+
+
+def test_standby_503_and_leader_url_redirect(tmp_path):
+    """Over real HTTP: the standby rejects mutations with 503 + a leaderUrl
+    hint, and RemoteControllerClient follows the hint transparently so a
+    client pointed at the WRONG controller still lands its write."""
+    from pinot_tpu.cluster.http import ControllerHTTPService, RemoteControllerClient
+
+    store = PropertyStore(tmp_path / "store")
+    c1 = Controller(store, tmp_path / "deep", controller_id="c1")
+    c2 = Controller(store, tmp_path / "deep", controller_id="c2")
+    svc1 = ControllerHTTPService(c1)
+    svc2 = ControllerHTTPService(c2)
+    try:
+        c1.register_controller_endpoint("127.0.0.1", svc1.port)
+        c2.register_controller_endpoint("127.0.0.1", svc2.port)
+        c1.enable_ha(lease_ttl=5.0, renew_every=0.1)
+        time.sleep(0.3)
+        c2.enable_ha(lease_ttl=5.0, renew_every=0.1)
+        deadline = time.time() + 5
+        while time.time() < deadline and not c1.is_leader:
+            time.sleep(0.05)
+        assert c1.is_leader and not c2.is_leader
+        # raw POST to the standby: 503 + leaderUrl hint, nothing mutated
+        import urllib.error
+        import urllib.request
+
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{svc2.port}/schemas",
+            data=_schema().to_json().encode(),
+            headers={"Content-Type": "application/json"},
+            method="POST",
+        )
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(req, timeout=10)
+        assert ei.value.code == 503
+        import json as _json
+
+        body = _json.loads(ei.value.read())
+        assert body["leaderUrl"] == f"http://127.0.0.1:{svc1.port}"
+        assert store.get("/schemas/t") is None
+        # the failover client pointed ONLY at the standby follows the hint
+        client = RemoteControllerClient(f"http://127.0.0.1:{svc2.port}")
+        client.add_schema(_schema())
+        assert store.get("/schemas/t") is not None
+        # GET /leader works on either node and agrees on the leader
+        assert client.leader()["leaderUrl"] == f"http://127.0.0.1:{svc1.port}"
+    finally:
+        c1.stop_ha()
+        c2.stop_ha()
+        svc1.stop()
+        svc2.stop()
+
+
+def test_lead_only_planes_follow_lease_flap(tmp_path):
+    """Periodic planes bound to a controller run only while it holds the
+    lease: they idle when the lease is stolen and resume when it returns —
+    two live schedulers would double-scrape and race repairs."""
+    from pinot_tpu.cluster.periodic import PeriodicTaskScheduler
+
+    store = PropertyStore(tmp_path / "store")
+    c1 = Controller(store, tmp_path / "deep", controller_id="c1")
+    c1.enable_ha(lease_ttl=0.5, renew_every=0.1)
+
+    class CountingTask:
+        name = "counting"
+        interval_sec = 0.05
+
+        def __init__(self):
+            self.runs = 0
+
+        def run_once(self):
+            self.runs += 1
+            return {}
+
+    task = CountingTask()
+    sched = PeriodicTaskScheduler(controller=c1)
+    sched.register(task)
+    try:
+        deadline = time.time() + 5
+        while time.time() < deadline and not c1.is_leader:
+            time.sleep(0.05)
+        assert c1.is_leader
+        sched.start()
+        deadline = time.time() + 5
+        while time.time() < deadline and task.runs == 0:
+            time.sleep(0.05)
+        assert task.runs > 0, "plane never ran while leading"
+        # steal the lease: c1 demotes, the plane must go quiet
+        store.update(
+            LEASE_PATH,
+            lambda d: {"owner": "c2", "expires": time.time() + 30, "epoch": d["epoch"] + 1},
+        )
+        deadline = time.time() + 5
+        while time.time() < deadline and c1.is_leader:
+            time.sleep(0.05)
+        assert not c1.is_leader
+        mark = task.runs
+        time.sleep(0.5)
+        assert task.runs <= mark + 1, "plane kept running on a standby"
+        # release the lease: c1 reclaims and the plane resumes
+        store.update(
+            LEASE_PATH, lambda d: {"owner": "", "expires": 0.0, "epoch": d["epoch"]}
+        )
+        deadline = time.time() + 5
+        while time.time() < deadline and not c1.is_leader:
+            time.sleep(0.05)
+        assert c1.is_leader
+        resumed = task.runs
+        deadline = time.time() + 5
+        while time.time() < deadline and task.runs <= resumed:
+            time.sleep(0.05)
+        assert task.runs > resumed, "plane never resumed after regaining the lease"
+    finally:
+        sched.stop()
+        c1.stop_ha()
+
+
+def test_cold_restart_recovers_from_store_and_deep_store(tmp_path):
+    """Full-cluster cold restart: tear the in-process topology down, rebuild
+    controller + server from the SAME store dir and deep store, clear the
+    stale external views (session-ephemeral Helix state analog), and verify
+    the reconciler re-materializes every segment with identical results."""
+    store_dir, deep = tmp_path / "store", tmp_path / "deep"
+    store = PropertyStore(store_dir)
+    c1 = Controller(store, deep, controller_id="c1")
+    s1 = Server("s0", data_dir=tmp_path / "sdata")
+    c1.register_server("s0", s1)
+    c1.add_schema(_schema())
+    c1.add_table(TableConfig("t", replication=1))
+    c1.enable_ha(lease_ttl=2.0, renew_every=0.2)
+    b = SegmentBuilder(_schema())
+    want = None
+    try:
+        for i in range(3):
+            c1.upload_segment("t", _segment(b, i))
+        # wait until the external view records all replicas ONLINE, so the
+        # restart leg has the stale session state a real crash leaves behind
+        deadline = time.time() + 15
+        while time.time() < deadline:
+            ev = store.get("/tables/t/externalview") or {}
+            if sum(1 for s in ev.values() if s.get("s0") == "ONLINE") == 3:
+                break
+            time.sleep(0.1)
+        want = Broker(c1).execute("SELECT k, SUM(v) FROM t GROUP BY k ORDER BY k").rows
+        assert want
+    finally:
+        c1.stop_ha()  # releases the lease, like a clean shutdown would
+    # ---- power loss: every process dies; only store dir + deep store survive
+    del c1, s1, store
+    store2 = PropertyStore(store_dir)
+    c2 = Controller(store2, deep, controller_id="c1")
+    s2 = Server("s0", data_dir=tmp_path / "sdata2")  # empty disk: re-downloads
+    c2.register_server("s0", s2)
+    # external views describe LAST session's placements — untrustworthy now
+    cleared = c2.reset_external_views()
+    assert cleared >= 1
+    c2.enable_ha(lease_ttl=2.0, renew_every=0.2)
+    try:
+        broker = Broker(c2)
+        deadline = time.time() + 15
+        got = None
+        while time.time() < deadline:
+            try:
+                got = broker.execute("SELECT k, SUM(v) FROM t GROUP BY k ORDER BY k").rows
+            except RuntimeError:
+                got = None
+            if got == want:
+                break
+            time.sleep(0.1)
+        assert got == want, f"cold restart diverged: {got} != {want}"
+    finally:
         c2.stop_ha()

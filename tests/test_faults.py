@@ -466,10 +466,16 @@ def test_http_cancel_and_timeout_error_code(tmp_path):
 
             th = threading.Thread(target=run)
             th.start()
-            deadline = time.monotonic() + 2.0
-            while not broker.running_queries() and time.monotonic() < deadline:
+            # poll generously (a loaded CI box can be slow to start the
+            # query thread) and keep the snapshot we matched on — a
+            # re-fetch after the loop can race the query finishing
+            deadline = time.monotonic() + 10.0
+            running = broker.running_queries()
+            while not running and time.monotonic() < deadline:
                 time.sleep(0.01)
-            qid = broker.running_queries()[0]["queryId"]
+                running = broker.running_queries()
+            assert running, "query never became visible in running_queries()"
+            qid = running[0]["queryId"]
             base = broker_url if target == "broker" else f"http://127.0.0.1:{csvc.port}"
             req = urllib.request.Request(f"{base}/query/{qid}", method="DELETE")
             with urllib.request.urlopen(req, timeout=5) as resp:
