@@ -114,7 +114,7 @@ class Controller:
     def register_controller_endpoint(self, host: str, port: int) -> None:
         """Publish this controller's HTTP endpoint so standbys' `leaderUrl`
         hints and client failover can locate whoever holds the lease."""
-        self.store.set(f"/controllers/{self.controller_id}", {"host": host, "port": port})
+        self.store.set(f"/controllers/{self.controller_id}", {"host": host, "port": port})  # pinotlint: disable=fence-discipline — deliberately unfenced: STANDBYS must publish their endpoint too (leaderUrl redirects + client failover depend on it), and a standby holds no lease epoch to fence with
 
     def leader_url(self) -> str | None:
         """Base URL of the current lease holder, or None when unknown (no
@@ -167,16 +167,19 @@ class Controller:
         # a re-registration without tags (server restart) must not wipe
         # operator-assigned tenant/tier tags
         eff_tags = list(tags) if tags is not None else prev.get("tags", [])
+        # fenced: instance registration is a leader-only mutation (HTTP gates
+        # standbys already); a deposed lead must not resurrect stale liveness
         self.store.set(
             f"/instances/{server_id}",
             {"host": host, "port": port, "alive": True, "tags": eff_tags},
+            fence=self.lease_fence(),
         )
 
     def update_server_tags(self, server_id: str, tags: list[str]) -> None:
         """Re-tag a server (updateInstanceTags REST parity)."""
         doc = self.store.get(f"/instances/{server_id}") or {}
         doc["tags"] = list(tags)
-        self.store.set(f"/instances/{server_id}", doc)
+        self.store.set(f"/instances/{server_id}", doc, fence=self.lease_fence())
 
     def servers(self) -> dict[str, object]:
         out = dict(self._servers)
@@ -194,7 +197,7 @@ class Controller:
     # -- brokers (DynamicBrokerSelector's ZK external-view analog) -----------
 
     def register_broker(self, broker_id: str, host: str, port: int) -> None:
-        self.store.set(f"/brokers/{broker_id}", {"host": host, "port": port})
+        self.store.set(f"/brokers/{broker_id}", {"host": host, "port": port}, fence=self.lease_fence())
 
     def brokers(self) -> dict[str, str]:
         """broker_id -> base URL."""
@@ -277,7 +280,7 @@ class Controller:
 
             unregister_dim_table(name)
         for p in list(self.store.list(f"/tables/{name}/")):
-            self.store.delete(p)
+            self.store.delete(p, fence=self.lease_fence())
         return len(segs)
 
     def delete_schema(self, name: str) -> None:
@@ -285,7 +288,7 @@ class Controller:
         still uses it — the reference's referential guard."""
         if name in self.tables():
             raise ValueError(f"schema {name!r} is still used by table {name!r}; delete the table first")
-        self.store.delete(f"/schemas/{name}")
+        self.store.delete(f"/schemas/{name}", fence=self.lease_fence())
 
     # -- segment upload & assignment ----------------------------------------
 
@@ -502,7 +505,7 @@ class Controller:
             if keep:
                 new_meta = self.segment_metadata(table, name) or {}
                 new_meta.update(keep)
-                self.store.set(f"/tables/{table}/segments/{name}", new_meta)
+                self.store.set(f"/tables/{table}/segments/{name}", new_meta, fence=self.lease_fence())
                 self.bump_routing_version(table)
             reloaded.append(name)
         return reloaded

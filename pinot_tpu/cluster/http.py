@@ -25,6 +25,7 @@ from pathlib import Path
 from urllib.parse import urlsplit
 
 from pinot_tpu.cluster.broker import Broker
+from pinot_tpu.cluster.controller import Controller
 from pinot_tpu.cluster.server import Server
 from pinot_tpu.common import datatable
 from pinot_tpu.common.errors import QueryErrorCode, code_of, http_status_of, retry_after_of
@@ -1140,7 +1141,7 @@ class ControllerHTTPService:
       POST /tasks/schedule     {"taskType": optional}
     """
 
-    def __init__(self, controller, port: int = 0, task_manager=None):
+    def __init__(self, controller: Controller, port: int = 0, task_manager=None):
         svc = self
         self.controller = controller
         self.task_manager = task_manager

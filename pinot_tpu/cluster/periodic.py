@@ -21,6 +21,7 @@ from pinot_tpu.common.metrics import (
     merge_cumulative_buckets,
     quantile_from_buckets,
 )
+from pinot_tpu.cluster.controller import Controller
 from pinot_tpu.cluster.rebalance import rebalance_progress as _rebalance_progress
 
 
@@ -28,7 +29,7 @@ class ControllerPeriodicTask:
     name = "periodic"
     interval_sec = 300.0
 
-    def __init__(self, controller):
+    def __init__(self, controller: Controller):
         self.controller = controller
 
     def run_once(self) -> dict:

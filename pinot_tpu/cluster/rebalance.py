@@ -16,6 +16,7 @@ import threading
 import time
 from dataclasses import dataclass, field
 
+from pinot_tpu.cluster.controller import Controller
 from pinot_tpu.common.faults import FAULTS, InjectedFault
 from pinot_tpu.common.trace import trace_event
 
@@ -125,7 +126,7 @@ def compute_target_assignment(
 
 
 def rebalance_table(
-    controller,
+    controller: Controller,
     table: str,
     dry_run: bool = False,
     drain_grace_sec: float = 0.0,

@@ -33,6 +33,8 @@ from io import BytesIO
 import numpy as np
 import pandas as pd
 
+from pinot_tpu.common.errors import QueryErrorCode
+
 MAGIC = b"PTDT"
 VERSION = 2
 #: versions this decoder accepts (version negotiation: a v2 node still
@@ -61,7 +63,11 @@ _T_STRDICT = 15  # v2: dictionary-encoded strings — uniques blob + int32 codes
 
 
 class DataTableError(ValueError):
-    pass
+    """Wire datatable (de)serialization failure. Registered with the error
+    registry so a frame error escaping a server/broker HTTP boundary rides
+    as a typed DATA_TABLE_SERIALIZATION code, not an anonymous 500."""
+
+    error_code = QueryErrorCode.DATA_TABLE_SERIALIZATION
 
 
 _U32 = struct.Struct("<I")

@@ -52,6 +52,17 @@ class QueryErrorCode(enum.IntEnum):
     #: parity). Travels as HTTP 503 so clients back off and retry.
     CONTROLLER_UNAVAILABLE = 270
 
+    #: a segment upload failed before any cluster metadata referenced it
+    #: (ENOSPC, short write, bytes failing CRC); the deep store holds no
+    #: partial dir. Typed so upload clients can distinguish "retry the
+    #: upload" from generic execution failures.
+    SEGMENT_UPLOAD = 290
+
+    #: wire datatable (de)serialization failure between query hops
+    #: (DATA_TABLE_SERIALIZATION_ERROR parity) — corrupt frame, unknown
+    #: column type, or a value the encoder cannot represent
+    DATA_TABLE_SERIALIZATION = 550
+
 
 #: Error codes that map to a non-200 HTTP status at response boundaries.
 #: Everything else stays the BrokerResponse convention: HTTP 200 with the
@@ -100,7 +111,11 @@ class SegmentUploadError(OSError):
     (ENOSPC, crash, or the written bytes failing verification). The errno
     of the underlying OSError is preserved — `e.errno == errno.ENOSPC`
     is the disk-full contract — and the controller guarantees the deep
-    store holds no partial segment dir when this is raised."""
+    store holds no partial segment dir when this is raised. Carries
+    `error_code` so the controller HTTP boundary returns a typed failure
+    instead of an anonymous 500."""
+
+    error_code = QueryErrorCode.SEGMENT_UPLOAD
 
 
 def code_of(exc: BaseException, default: int = QueryErrorCode.QUERY_EXECUTION) -> int:

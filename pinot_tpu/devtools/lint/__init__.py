@@ -1,14 +1,18 @@
 """pinotlint: project-invariant static analyzer for pinot_tpu.
 
-Twelve AST checkers enforce the conventions the engine's correctness actually
-rests on — race discipline, jit purity, deadline/cancellation coverage, the
-error-code registry, the fault-point registry, fault-point span-event
-coverage on the query path, lock-order cycles, blocking calls made while a
-lock is held, resource leaks, atomic writes to durable artifacts,
-kernel-registry coverage of compiled roots on the query path, and
-routing-version bumps on segment-set mutations (query-cache invalidation). The concurrency family (race-discipline,
-lock-order, blocking-under-lock) is whole-program: all three share one
-call-graph + lock-summary build per run (`core.AnalysisSession`). See
+Fifteen AST checkers (emitting sixteen checks) enforce the conventions the
+engine's correctness actually rests on — race discipline, jit purity,
+deadline/cancellation coverage, the error-code registry, the fault-point
+registry, fault-point span-event coverage on the query path, lock-order
+cycles, blocking calls made while a lock is held, resource leaks, atomic
+writes to durable artifacts, kernel-registry coverage of compiled roots on
+the query path, routing-version bumps on segment-set mutations (query-cache
+invalidation), fencing-epoch flow into every lead-path PropertyStore
+mutation (fence-discipline), registered QueryErrorCodes on every exception
+that can escape an HTTP handler (typed-error-boundary), and the asyncio
+readiness pack (event-loop-safety). The whole-program family shares one
+call-graph + lock-summary + dataflow build per run
+(`core.AnalysisSession` -> `callgraph.ProgramIndex` -> `dataflow`). See
 README.md in this directory and the module docstrings for exact rules.
 
 Usage (CLI):   python -m pinot_tpu.devtools.lint pinot_tpu/
@@ -23,11 +27,14 @@ from pinot_tpu.devtools.lint.concurrency import BlockingUnderLockChecker, LockOr
 from pinot_tpu.devtools.lint.core import Checker, Finding, run
 from pinot_tpu.devtools.lint.deadlines import DeadlineChecker
 from pinot_tpu.devtools.lint.error_codes import ErrorCodeChecker
+from pinot_tpu.devtools.lint.event_loop import EventLoopSafetyChecker
 from pinot_tpu.devtools.lint.fault_points import FaultPointChecker, FaultSpanEventChecker
+from pinot_tpu.devtools.lint.fence import FenceDisciplineChecker
 from pinot_tpu.devtools.lint.jit_purity import JitPurityChecker
 from pinot_tpu.devtools.lint.kernel_registry import KernelRegistryChecker
 from pinot_tpu.devtools.lint.races import RaceChecker
 from pinot_tpu.devtools.lint.resources import ResourceLeakChecker
+from pinot_tpu.devtools.lint.typed_errors import TypedErrorBoundaryChecker
 
 #: checker-id -> class, in reporting order. Checker instances hold run state
 #: (whole-program accumulation), so callers construct fresh ones per run.
@@ -44,6 +51,9 @@ ALL_CHECKERS: dict[str, type[Checker]] = {
     "atomic-write": AtomicWriteChecker,
     "kernel-registry": KernelRegistryChecker,
     "cache-invalidation": CacheInvalidationChecker,
+    "fence-discipline": FenceDisciplineChecker,
+    "typed-error-boundary": TypedErrorBoundaryChecker,
+    "event-loop-safety": EventLoopSafetyChecker,
 }
 
 

@@ -12,13 +12,22 @@ freezing the tree. Entries are keyed (check, path, message), deliberately
 NOT line: unrelated edits above a known finding must not break CI. Refresh
 the file with `--update-baseline` after fixing or accepting findings; the
 diff then shows reviewers exactly which debts were paid or incurred.
+
+Diff mode: `--diff REF` still analyzes the WHOLE given tree (the dataflow
+and call-graph checkers need every module to resolve cross-module edges)
+but reports only findings that land on lines changed versus the git REF —
+the pre-commit shape: full-fidelity analysis, your-diff-only noise. New
+(untracked) files report in full. Applied after --baseline, so a run can
+combine both.
 """
 
 from __future__ import annotations
 
 import argparse
 import json
+import os
 import re
+import subprocess
 import sys
 from collections import Counter
 from pathlib import Path
@@ -69,6 +78,68 @@ def write_baseline(path: str, findings: list[Finding]) -> None:
     Path(path).write_text(json.dumps(doc, indent=2) + "\n", encoding="utf-8")
 
 
+#: unified-diff hunk header: `@@ -old[,n] +start[,count] @@ ...`
+_HUNK_RE = re.compile(r"@@ -\d+(?:,\d+)? \+(\d+)(?:,(\d+))? @@")
+
+
+def changed_lines(ref: str, anchor: str) -> dict[str, set[int] | None]:
+    """Absolute path -> set of line numbers added/modified versus git `ref`
+    (value None = untracked file: every line counts as changed). `anchor`
+    is any path inside the repository. Raises ValueError on a bad ref or a
+    non-git tree."""
+
+    def run(cwd: str, *a: str):
+        return subprocess.run(["git", "-C", cwd, *a], capture_output=True, text=True)
+
+    top = run(anchor, "rev-parse", "--show-toplevel")
+    if top.returncode != 0:
+        raise ValueError(top.stderr.strip() or "not a git repository")
+    root = top.stdout.strip()
+    # run from the root so every path (diff headers AND ls-files output)
+    # comes back root-relative, whatever subdirectory anchored us
+    diff = run(root, "diff", "-U0", ref, "--", "*.py")
+    if diff.returncode != 0:
+        raise ValueError(diff.stderr.strip() or f"bad ref {ref!r}")
+    out: dict[str, set[int] | None] = {}
+    cur: str | None = None
+    for line in diff.stdout.splitlines():
+        if line.startswith("+++ "):
+            name = line[4:].strip()
+            if name == "/dev/null":  # deletion: nothing to report on
+                cur = None
+            else:
+                cur = os.path.join(root, name[2:] if name.startswith("b/") else name)
+        elif line.startswith("@@") and cur is not None:
+            m = _HUNK_RE.match(line)
+            if m:
+                start, count = int(m.group(1)), int(m.group(2) or "1")
+                if count:
+                    bucket = out.setdefault(cur, set())
+                    if bucket is not None:
+                        bucket.update(range(start, start + count))
+    unt = run(root, "ls-files", "--others", "--exclude-standard", "--", "*.py")
+    if unt.returncode == 0:
+        for name in unt.stdout.splitlines():
+            if name.strip():
+                out[os.path.join(root, name.strip())] = None
+    return out
+
+
+def apply_diff_filter(
+    findings: list[Finding], changed: dict[str, set[int] | None]
+) -> list[Finding]:
+    """Findings on changed lines (or anywhere in an untracked file)."""
+    fresh: list[Finding] = []
+    for f in findings:
+        p = os.path.abspath(f.path)
+        if p not in changed:
+            continue
+        lines = changed[p]
+        if lines is None or f.line in lines:
+            fresh.append(f)
+    return fresh
+
+
 def main(argv: list[str] | None = None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m pinot_tpu.devtools.lint",
@@ -96,6 +167,14 @@ def main(argv: list[str] | None = None) -> int:
         "--baseline",
         metavar="FILE",
         help="tolerate the findings recorded in FILE; only NEW findings fail",
+    )
+    ap.add_argument(
+        "--diff",
+        metavar="REF",
+        help=(
+            "analyze the whole tree but report only findings on lines changed"
+            " versus git REF (untracked files report in full)"
+        ),
     )
     ap.add_argument(
         "--update-baseline",
@@ -134,6 +213,16 @@ def main(argv: list[str] | None = None) -> int:
             print(f"pinotlint: error: bad baseline {args.baseline}: {e}", file=sys.stderr)
             return 2
         findings = apply_baseline(findings, budget)
+    if args.diff:
+        anchor = os.path.abspath(args.paths[0])
+        if not os.path.isdir(anchor):
+            anchor = os.path.dirname(anchor) or "."
+        try:
+            changed = changed_lines(args.diff, anchor)
+        except ValueError as e:
+            print(f"pinotlint: error: --diff {args.diff}: {e}", file=sys.stderr)
+            return 2
+        findings = apply_diff_filter(findings, changed)
     if args.json:
         print(
             json.dumps(

@@ -16,18 +16,26 @@
 - **transitive closures** over the call graph: `trans_acquires(fn)` (locks a
   call may take, directly or through callees) and `block_witness(fn)` (a
   representative blocking operation reachable from the function), both
-  computed by fixpoint so call cycles terminate.
+  computed by fixpoint so call cycles terminate;
+- lazy entry points into the **dataflow layer** (`devtools/lint/dataflow.py`):
+  `taint(spec)` builds a k-limited taint analysis for a checker-supplied
+  source predicate, `escapes()` the exception-escape summaries — both cached
+  on the index so every checker shares one fixpoint.
 
 Resolution is lexical and deliberately conservative: a call resolves through
 (1) enclosing-scope nested defs, (2) same-module top-level functions,
 (3) `self.method` through the MRO, (4) `self.attr.method` /
-`localvar.method` through inferred attribute/local types, (5) import
-aliases (`from pkg.mod import fn`, `import pkg.mod as m`). Anything else —
-dynamic dispatch, callables in containers, `getattr` — stays unresolved and
-simply contributes no edges, so the checkers built on top under-approximate
-rather than hallucinate. Explicit `.acquire()`/`.release()` pairs are NOT
-modeled (the codebase convention is `with lock:`); a checker relying on this
-index sees only context-manager acquisitions.
+`localvar.method` through inferred attribute/local types — including
+parameter annotations (`def __init__(self, broker: Broker)`), `alias = self`
+bindings, and closure variables looked up through the enclosing-function
+chain, with dotted receiver chains (`svc.controller.add_table`) resolved one
+attribute hop at a time — (5) import aliases (`from pkg.mod import fn`,
+`import pkg.mod as m`). Anything else — dynamic dispatch, callables in
+containers, `getattr` — stays unresolved and simply contributes no edges, so
+the checkers built on top under-approximate rather than hallucinate.
+Explicit `.acquire()`/`.release()` pairs are NOT modeled (the codebase
+convention is `with lock:`); a checker relying on this index sees only
+context-manager acquisitions.
 
 Lock identity unifies inheritance: `with self._lock:` inside
 `FCFSScheduler` resolves to `QueryScheduler._lock` (the class whose
@@ -63,6 +71,25 @@ def _is_lockish_name(name: str) -> bool:
     return "lock" in low or "mutex" in low
 
 
+def _annotation_name(ann: ast.AST | None) -> str:
+    """Dotted class name from a parameter annotation: plain names, string
+    annotations ('Controller'), `X | None` unions, and `Optional[X]`."""
+    if ann is None:
+        return ""
+    if isinstance(ann, ast.Constant) and isinstance(ann.value, str):
+        return ann.value
+    if isinstance(ann, ast.BinOp) and isinstance(ann.op, ast.BitOr):
+        for side in (ann.left, ann.right):
+            name = _annotation_name(side)
+            if name and name != "None":
+                return name
+        return ""
+    if isinstance(ann, ast.Subscript) and dotted_name(ann.value).endswith("Optional"):
+        return _annotation_name(ann.slice)
+    name = dotted_name(ann)
+    return "" if name == "None" else name
+
+
 @dataclass
 class ClassInfo:
     qname: str
@@ -75,6 +102,10 @@ class ClassInfo:
     attr_types: dict[str, str] = field(default_factory=dict)
     #: self.<attr> assigned threading.Lock/RLock/Semaphore in a method body
     lock_attrs: set[str] = field(default_factory=set)
+    #: self.<attr> assigned asyncio.Lock/Condition/... — NOT thread locks;
+    #: tracked separately so `async with self._alock:` is never misread as a
+    #: threading acquisition (and so event-loop-safety can tell them apart)
+    async_lock_attrs: set[str] = field(default_factory=set)
     #: condition attr -> the lock ATTR NAME it wraps (None = own internal lock)
     cond_binding: dict[str, str | None] = field(default_factory=dict)
 
@@ -103,6 +134,10 @@ class BlockOp:
     #: for `<cond>.wait()`: the id of the lock the Condition releases while
     #: waiting (holding exactly that lock is legal); None otherwise
     releases: str | None = None
+    #: True for ops that only matter on an event loop (subprocess, flock,
+    #: socket connect/sendall, pooled wire calls): event-loop-safety counts
+    #: them, blocking-under-lock keeps its original narrower set
+    loop_only: bool = False
 
 
 @dataclass
@@ -116,8 +151,16 @@ class FuncInfo:
     acquires: list[Acquire] = field(default_factory=list)
     calls: list[CallSite] = field(default_factory=list)
     blocking: list[BlockOp] = field(default_factory=list)
-    #: local var -> class qname for `x = KnownClass(...)` bindings
+    #: local var -> class qname for `x = KnownClass(...)` and alias bindings
     local_types: dict[str, str] = field(default_factory=dict)
+    #: param name -> raw annotation dotted name (`broker: Broker`)
+    param_types: dict[str, str] = field(default_factory=dict)
+    #: (line, locks held) for every `await` expression in the body
+    awaits: list[tuple[int, frozenset]] = field(default_factory=list)
+
+    @property
+    def is_async(self) -> bool:
+        return isinstance(self.node, ast.AsyncFunctionDef)
 
     @property
     def short(self) -> str:
@@ -139,6 +182,9 @@ class ProgramIndex:
         self._mro_cache: dict[str, list[ClassInfo]] = {}
         self._trans_acq: dict[str, frozenset] | None = None
         self._block_wit: dict[str, tuple] | None = None
+        self._loop_block_wit: dict[str, tuple] | None = None
+        self._taints: dict[str, object] = {}  # TaintSpec.name -> TaintAnalysis
+        self._escapes: object | None = None  # EscapeAnalysis
 
     # -- construction --------------------------------------------------------
 
@@ -202,10 +248,25 @@ class ProgramIndex:
         if self_name is None:
             return
         for n in ast.walk(fi.node):
-            if not (isinstance(n, ast.Assign) and isinstance(n.value, ast.Call)):
+            if not isinstance(n, ast.Assign):
+                continue
+            if isinstance(n.value, ast.Name):
+                # `self.x = param` keeps the param's annotated type
+                t = fi.param_types.get(n.value.id)
+                if t is not None:
+                    for tgt in n.targets:
+                        if (
+                            isinstance(tgt, ast.Attribute)
+                            and isinstance(tgt.value, ast.Name)
+                            and tgt.value.id == self_name
+                        ):
+                            ci.attr_types.setdefault(tgt.attr, t)
+                continue
+            if not isinstance(n.value, ast.Call):
                 continue
             ctor = dotted_name(n.value.func)
             leaf = ctor.rsplit(".", 1)[-1]
+            is_asyncio = ctor.startswith("asyncio.")
             for tgt in n.targets:
                 if not (
                     isinstance(tgt, ast.Attribute)
@@ -213,7 +274,9 @@ class ProgramIndex:
                     and tgt.value.id == self_name
                 ):
                     continue
-                if leaf in _LOCK_CTORS:
+                if is_asyncio and leaf in (_LOCK_CTORS | _COND_CTORS):
+                    ci.async_lock_attrs.add(tgt.attr)
+                elif leaf in _LOCK_CTORS:
                     ci.lock_attrs.add(tgt.attr)
                 elif leaf in _COND_CTORS:
                     bound = None
@@ -238,6 +301,10 @@ class ProgramIndex:
         ):
             self_name = node.args.args[0].arg
         fi = FuncInfo(qname=qname, module=mod, node=node, cls=cls, self_name=self_name, parent=parent)
+        for a in [*node.args.posonlyargs, *node.args.args, *node.args.kwonlyargs]:
+            t = _annotation_name(a.annotation)
+            if t:
+                fi.param_types[a.arg] = t
         self.functions[qname] = fi
         if cls is None and parent is None:
             self.module_funcs[mname][node.name] = fi
@@ -354,6 +421,8 @@ class ProgramIndex:
         if sn is not None and d.startswith(sn + ".") and d.count(".") == 1:
             attr = d.split(".", 1)[1]
             ci = fi.cls or (fi.parent.cls if fi.parent else None)
+            if ci is not None and self._attr_is_async_lock(ci, attr):
+                return None  # asyncio primitive: not a thread lock
             if ci is not None and self._attr_is_lock(ci, attr):
                 return self.lock_id_for_attr(ci, attr)
             if _is_lockish_name(attr):
@@ -376,6 +445,8 @@ class ProgramIndex:
         head, _, attr = d.rpartition(".")
         owner = self._type_of_expr(fi, head)
         if owner is not None and "." not in attr:
+            if self._attr_is_async_lock(owner, attr):
+                return None
             if self._attr_is_lock(owner, attr) or _is_lockish_name(attr):
                 return self.lock_id_for_attr(owner, attr)
             return None
@@ -386,6 +457,9 @@ class ProgramIndex:
 
     def _attr_is_lock(self, ci: ClassInfo, attr: str) -> bool:
         return any(attr in c.lock_attrs or attr in c.cond_binding for c in self.mro(ci))
+
+    def _attr_is_async_lock(self, ci: ClassInfo, attr: str) -> bool:
+        return any(attr in c.async_lock_attrs for c in self.mro(ci))
 
     def cond_released_lock(self, fi: FuncInfo, recv_dotted: str) -> str | None:
         """For `<recv>.wait()`: the lock id a Condition receiver releases
@@ -402,22 +476,38 @@ class ProgramIndex:
     # -- type inference helpers ---------------------------------------------
 
     def _type_of_expr(self, fi: FuncInfo, dotted: str) -> ClassInfo | None:
-        """ClassInfo of `dotted` when it is `self.attr` with an inferred
-        attribute type, or a local var bound from a known constructor."""
-        sn = fi.self_name
-        ci = fi.cls or (fi.parent.cls if fi.parent else None)
-        mname = module_name(fi.module.path)
-        if sn is not None and ci is not None and dotted.startswith(sn + "."):
-            attr = dotted[len(sn) + 1 :]
-            for c in self.mro(ci):
-                t = c.attr_types.get(attr)
-                if t is not None:
-                    return self.resolve_class(t, module_name(c.module.path))
+        """ClassInfo of a dotted receiver chain. The HEAD resolves through
+        `self`, locals, annotated params, then the enclosing-closure chain
+        (so `svc` inside a handler method finds `svc = self` in the service
+        `__init__` that defines it); each further hop resolves through the
+        owning class's inferred attribute types."""
+        if not dotted:
             return None
-        if "." not in dotted:
-            t = fi.local_types.get(dotted)
+        head, *rest = dotted.split(".")
+        ci = self._type_of_head(fi, head)
+        for attr in rest:
+            if ci is None:
+                return None
+            ci = self._attr_type(ci, attr)
+        return ci
+
+    def _type_of_head(self, fi: FuncInfo, name: str) -> ClassInfo | None:
+        scope: FuncInfo | None = fi
+        while scope is not None:
+            if scope.self_name is not None and name == scope.self_name:
+                return scope.cls
+            smod = module_name(scope.module.path)
+            t = scope.local_types.get(name) or scope.param_types.get(name)
             if t is not None:
-                return self.resolve_class(t, mname)
+                return self.resolve_class(t, smod)
+            scope = scope.parent
+        return None
+
+    def _attr_type(self, ci: ClassInfo, attr: str) -> ClassInfo | None:
+        for c in self.mro(ci):
+            t = c.attr_types.get(attr)
+            if t is not None:
+                return self.resolve_class(t, module_name(c.module.path))
         return None
 
     # -- call resolution -----------------------------------------------------
@@ -499,15 +589,27 @@ class ProgramIndex:
         `qname`, or None. `chain` is the call path (function shorts) from the
         function to the operation — evidence for the finding message."""
         if self._block_wit is None:
-            self._block_wit = self._fixpoint_blocking()
+            self._block_wit = self._fixpoint_blocking(loop=False)
         return self._block_wit.get(qname)
 
-    def _fixpoint_blocking(self) -> dict[str, tuple]:
+    def loop_block_witness(self, qname: str):
+        """Like `block_witness` but for the event-loop-safety checker: also
+        counts loop-only ops (subprocess, flock, socket connect/sendall,
+        pooled wire calls) and never traverses INTO an `async def` callee —
+        an async function's own blocking ops are reported at that function,
+        not re-attributed to every async caller."""
+        if self._loop_block_wit is None:
+            self._loop_block_wit = self._fixpoint_blocking(loop=True)
+        return self._loop_block_wit.get(qname)
+
+    def _fixpoint_blocking(self, loop: bool) -> dict[str, tuple]:
         wit: dict[str, tuple] = {}
         for q, f in self.functions.items():
-            if f.blocking:
-                op = f.blocking[0]
+            for op in f.blocking:
+                if op.loop_only and not loop:
+                    continue
                 wit[q] = (f.module.path, op.line, op.desc, (f.short,))
+                break
         changed = True
         while changed:
             changed = False
@@ -515,13 +617,38 @@ class ProgramIndex:
                 if q in wit:
                     continue
                 for c in f.calls:
-                    if c.callee is not None and c.callee in wit:
-                        path, line, desc, chain = wit[c.callee]
-                        if len(chain) < 6:  # keep messages readable
-                            wit[q] = (path, line, desc, (f.short, *chain))
-                            changed = True
-                            break
+                    if c.callee is None or c.callee not in wit:
+                        continue
+                    if loop and self.functions[c.callee].is_async:
+                        continue
+                    path, line, desc, chain = wit[c.callee]
+                    if len(chain) < 6:  # keep messages readable
+                        wit[q] = (path, line, desc, (f.short, *chain))
+                        changed = True
+                        break
         return wit
+
+    # -- dataflow layer (lazy; see devtools/lint/dataflow.py) ----------------
+
+    def taint(self, spec):
+        """The (cached) taint analysis for `spec` — a
+        `dataflow.TaintSpec` naming the source predicate. Built to fixpoint
+        on first use; every checker asking for the same spec name shares it."""
+        cached = self._taints.get(spec.name)
+        if cached is None:
+            from pinot_tpu.devtools.lint.dataflow import TaintAnalysis
+
+            cached = self._taints[spec.name] = TaintAnalysis(self, spec)
+        return cached
+
+    def escapes(self):
+        """The (cached) exception-escape analysis: per-function summaries of
+        which project exception classes a call may let propagate."""
+        if self._escapes is None:
+            from pinot_tpu.devtools.lint.dataflow import EscapeAnalysis
+
+            self._escapes = EscapeAnalysis(self)
+        return self._escapes
 
 
 class _Summarizer(ast.NodeVisitor):
@@ -570,18 +697,31 @@ class _Summarizer(ast.NodeVisitor):
     visit_AsyncWith = visit_With
 
     def visit_Assign(self, node: ast.Assign):
+        ci = None
         if isinstance(node.value, ast.Call):
             ctor = dotted_name(node.value.func)
             mname = module_name(self.fi.module.path)
             ci = self.idx.resolve_class(ctor, mname) if ctor else None
-            if ci is not None:
-                for tgt in node.targets:
-                    if isinstance(tgt, ast.Name):
-                        self.fi.local_types[tgt.id] = ci.qname
+        elif isinstance(node.value, (ast.Name, ast.Attribute)):
+            # aliases: `svc = self`, `c = svc.controller` — the target keeps
+            # the resolved type so later `c.method()` calls find their edge
+            d = dotted_name(node.value)
+            ci = self.idx._type_of_expr(self.fi, d) if d else None
+        if ci is not None:
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name):
+                    self.fi.local_types[tgt.id] = ci.qname
+        self.generic_visit(node)
+
+    def visit_Await(self, node: ast.Await):
+        self.fi.awaits.append((node.lineno, frozenset(self.held)))
         self.generic_visit(node)
 
     def visit_Call(self, node: ast.Call):
-        from pinot_tpu.devtools.lint.concurrency import classify_blocking
+        from pinot_tpu.devtools.lint.concurrency import (
+            classify_blocking,
+            classify_loop_blocking,
+        )
 
         dotted = dotted_name(node.func)
         callee = self.idx.resolve_call(self.fi, node)
@@ -589,6 +729,10 @@ class _Summarizer(ast.NodeVisitor):
             CallSite(node=node, line=node.lineno, dotted=dotted, callee=callee, held=frozenset(self.held))
         )
         blocked = classify_blocking(node, dotted)
+        loop_only = False
+        if blocked is None:
+            blocked = classify_loop_blocking(node, dotted)
+            loop_only = blocked is not None
         if blocked is not None:
             releases = None
             if isinstance(node.func, ast.Attribute) and node.func.attr == "wait":
@@ -596,6 +740,12 @@ class _Summarizer(ast.NodeVisitor):
                 if recv:
                     releases = self.idx.cond_released_lock(self.fi, recv)
             self.fi.blocking.append(
-                BlockOp(line=node.lineno, desc=blocked, held=frozenset(self.held), releases=releases)
+                BlockOp(
+                    line=node.lineno,
+                    desc=blocked,
+                    held=frozenset(self.held),
+                    releases=releases,
+                    loop_only=loop_only,
+                )
             )
         self.generic_visit(node)

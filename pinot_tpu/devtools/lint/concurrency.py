@@ -103,6 +103,45 @@ def classify_blocking(call: ast.Call, dotted: str) -> str | None:
     return None
 
 
+#: dotted suffixes that only matter on an asyncio event loop: they park the
+#: ONE thread everything runs on, but are ordinary (often intended) blocking
+#: calls in threaded code, so blocking-under-lock ignores them
+_LOOP_BLOCKING_DOTTED = {
+    "subprocess.run": "subprocess.run()",
+    "subprocess.call": "subprocess.call()",
+    "subprocess.check_call": "subprocess.check_call()",
+    "subprocess.check_output": "subprocess.check_output()",
+    "subprocess.Popen": "subprocess.Popen()",
+    "fcntl.flock": "fcntl.flock()",
+    "fcntl.lockf": "fcntl.lockf()",
+    "os.fsync": "os.fsync()",
+    "pool.request": "wire pool .request()",
+    "pool.checkout": "wire pool .checkout()",
+}
+
+#: attribute calls in the loop-only set (socket/HTTP client surface)
+_LOOP_BLOCKING_ATTRS = {
+    "connect": "socket .connect()",
+    "sendall": "socket .sendall()",
+    "getresponse": "HTTPConnection.getresponse()",
+}
+
+
+def classify_loop_blocking(call: ast.Call, dotted: str) -> str | None:
+    """Label for ops blocking ONLY from the event-loop-safety perspective
+    (`classify_blocking` already returned None). Same lexical heuristics."""
+    if dotted:
+        leaf2 = ".".join(dotted.split(".")[-2:])
+        if dotted in _LOOP_BLOCKING_DOTTED:
+            return _LOOP_BLOCKING_DOTTED[dotted]
+        if leaf2 in _LOOP_BLOCKING_DOTTED:
+            return _LOOP_BLOCKING_DOTTED[leaf2]
+    fn = call.func
+    if isinstance(fn, ast.Attribute) and fn.attr in _LOOP_BLOCKING_ATTRS:
+        return _LOOP_BLOCKING_ATTRS[fn.attr]
+    return None
+
+
 class LockOrderChecker(Checker):
     name = "lock-order"
 
@@ -241,6 +280,8 @@ class BlockingUnderLockChecker(Checker):
         seen: set[tuple] = set()
         for fn in idx.functions.values():
             for op in fn.blocking:
+                if op.loop_only:
+                    continue  # event-loop-safety's set, not this checker's
                 held = set(op.held)
                 if op.releases is not None:
                     held.discard(op.releases)  # Condition.wait releases its lock

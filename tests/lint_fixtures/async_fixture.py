@@ -1,0 +1,66 @@
+"""Golden fixture for the event-loop-safety pack: the four shapes that sink
+an asyncio event loop, plus the sanctioned executor hand-offs and asyncio
+primitives that must stay quiet."""
+
+import asyncio
+import subprocess
+import threading
+import time
+
+
+def sync_slow():
+    time.sleep(0.5)
+
+
+async def direct_block():
+    time.sleep(0.1)  # line 16: VIOLATION blocking call directly in a coroutine
+
+
+async def indirect_block():
+    sync_slow()  # line 20: VIOLATION reaches time.sleep via a sync callee
+
+
+async def loop_only_block():
+    subprocess.run(["true"])  # line 24: VIOLATION loop-only blocking set
+
+
+async def executor_ok(loop):
+    # clean: the worker is passed as an uncalled reference — no call edge,
+    # exactly mirroring the runtime (the blocking work happens off-loop)
+    await loop.run_in_executor(None, sync_slow)
+
+
+async def to_thread_ok():
+    await asyncio.to_thread(sync_slow)  # clean: sanctioned hand-off
+
+
+class Service:
+    def __init__(self):
+        self._tlock = threading.Lock()
+        self._alock = asyncio.Lock()
+        self.value = 0
+
+    async def await_under_lock(self):
+        with self._tlock:  # line 44: VIOLATION threading lock in async def
+            await asyncio.sleep(0)  # line 45: VIOLATION await with the lock held
+
+    async def async_lock_ok(self):
+        async with self._alock:  # clean: asyncio primitive on the loop
+            await asyncio.sleep(0)
+
+
+async def background_refresh():
+    await asyncio.sleep(0)
+
+
+def kick_off():
+    background_refresh()  # line 57: VIOLATION coroutine created, never awaited
+
+
+def scheduled_ok():
+    # clean: the coroutine object is handed to the scheduler, not dropped
+    return asyncio.ensure_future(background_refresh())
+
+
+async def suppressed_block():
+    time.sleep(0.1)  # pinotlint: disable=event-loop-safety — fixture demo: startup-only coroutine that runs before the loop starts

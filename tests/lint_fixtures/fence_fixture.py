@@ -1,0 +1,63 @@
+"""Golden fixture for fence-discipline: every lead-path PropertyStore
+mutation must carry a `fence=` that dataflows from the lease epoch. The
+class names (Controller, LeaderElection, PropertyStore) match the entry
+and sink shapes the checker recognizes in the real cluster package."""
+
+
+class LeaderElection:
+    def __init__(self):
+        self.epoch = 0
+
+
+class PropertyStore:
+    def set(self, path, value, fence=None):
+        pass
+
+    def delete(self, path, fence=None):
+        pass
+
+
+LEASE_PATH = "/cluster/lease"
+
+
+class Controller:
+    def __init__(self):
+        self.store = PropertyStore()
+        self._election = LeaderElection()
+
+    def lease_fence(self):
+        return self._election.epoch
+
+    def unfenced_write(self, meta):
+        self.store.set("/tables/t", meta)  # line 32: VIOLATION omits fence=
+
+    def junk_fence(self, meta):
+        self.store.set("/tables/t", meta, fence=41)  # line 35: VIOLATION fence does not flow
+
+    def fenced_write(self, meta):
+        # clean: fence flows through the lease_fence() return summary
+        self.store.set("/tables/t", meta, fence=self.lease_fence())
+
+    def lease_write(self):
+        # clean: writes to the lease path itself are unfenced by design
+        self.store.set(LEASE_PATH, {"holder": "me"})
+
+    def _apply(self, path, meta, fence=None):
+        # fence is a bare parameter: the obligation moves to lead callers
+        self.store.set(path, meta, fence=fence)
+
+    def good_caller(self, meta):
+        # clean: the caller supplies an epoch-tainted fence
+        self._apply("/tables/a", meta, fence=self._election.epoch)
+
+    def bad_caller(self, meta):
+        self._apply("/tables/b", meta)  # line 54: VIOLATION fence left at default
+
+    def suppressed_write(self, meta):
+        self.store.set("/gc", meta)  # pinotlint: disable=fence-discipline — fixture demo: reasoned designed exception stays quiet
+
+
+def offline_tool(store, meta):
+    # quiet: a plain top-level helper is not a lead-path entry and nothing
+    # on the lead path calls it
+    store.set("/tables/x", meta)

@@ -1,0 +1,25 @@
+"""Cross-module half of the fence-discipline fixture pair: Controller
+methods route their writes through fence_mod_b.apply_meta. Each file alone
+lints clean (the sink lives in the other module / the helper is not an
+entry); linted together, the fence obligation hops the module boundary and
+the defaulted call reports. Lint together with fence_mod_b.py."""
+
+from fence_mod_b import apply_meta
+
+
+class LeaderElection:
+    def __init__(self):
+        self.epoch = 0
+
+
+class Controller:
+    def __init__(self):
+        self.store = None
+        self._election = LeaderElection()
+
+    def good(self, meta):
+        # clean: the epoch taint crosses the module boundary into apply_meta
+        apply_meta(self.store, "/tables/a", meta, fence=self._election.epoch)
+
+    def bad(self, meta):
+        apply_meta(self.store, "/tables/b", meta)  # line 25: VIOLATION default fence

@@ -1,0 +1,8 @@
+"""Cross-module half of the fence-discipline fixture pair: the helper that
+performs the actual store write. It is not a lead-path entry on its own, so
+this file alone lints clean; linted together with fence_mod_a.py its fence
+parameter becomes an obligation on every lead-path caller."""
+
+
+def apply_meta(store, path, meta, fence=None):
+    store.set(path, meta, fence=fence)

@@ -1,0 +1,73 @@
+"""Golden fixture for typed-error-boundary: a project exception that can
+escape into an HTTP handler's generic backstop must carry a registered
+QueryErrorCode. The fixture carries its own registry class — the checker
+discovers it structurally, so these tests never depend on the real
+common/errors.py module."""
+
+
+class QueryErrorCode:
+    BAD_INPUT = 100
+    UPLOAD_FAILED = 200
+
+
+class TypedError(Exception):
+    error_code = QueryErrorCode.BAD_INPUT
+
+
+class NakedError(Exception):
+    """No error_code: reaching a handler's generic backstop is a violation."""
+
+
+class CaughtError(Exception):
+    """Unregistered, but the handler catches it SPECIFICALLY — absolved."""
+
+
+class SuppressedError(Exception):
+    """Unregistered; its raise site carries a reasoned suppression."""
+
+
+def _inner():
+    raise NakedError("boom")  # line 30: VIOLATION escapes through two helpers
+
+
+def _middle():
+    _inner()
+
+
+def _typed_path():
+    # clean: TypedError is registered via its error_code class attribute
+    raise TypedError("bad")
+
+
+def _caught_path():
+    # clean: the do_POST boundary catches CaughtError specifically
+    raise CaughtError("handled")
+
+
+def _builtin_path():
+    # clean: builtins are legitimately mapped to the default code
+    raise ValueError("builtin")
+
+
+def _suppressed_path():
+    raise SuppressedError("known")  # pinotlint: disable=typed-error-boundary — fixture demo: legacy error intentionally untyped
+
+
+class Handler:
+    def do_GET(self):
+        try:
+            _middle()
+            _typed_path()
+            _builtin_path()
+            _suppressed_path()
+        except Exception as e:  # generic backstop does NOT absolve
+            return str(e)
+
+    def do_POST(self):
+        try:
+            _caught_path()
+        except CaughtError as e:
+            return str(e)
+
+    def do_DELETE(self):
+        raise NakedError("direct")  # line 73: VIOLATION raised directly in the handler

@@ -235,6 +235,120 @@ def test_v2_suppressions(name, checks, suppressed_line):
 
 
 # ---------------------------------------------------------------------------
+# v3 dataflow checkers: fence-discipline, typed-error-boundary,
+# event-loop-safety
+# ---------------------------------------------------------------------------
+
+
+def test_fence_fixture_findings():
+    fs = findings_for("fence_fixture.py", checks=["fence-discipline"])
+    assert lines_of(fs, "fence-discipline") == [32, 35, 54]
+    by_line = {f.line: f.message for f in fs}
+    assert "omits fence=" in by_line[32]
+    assert "unfenced_write" in by_line[32]  # entry witness in the message
+    assert "does not flow from the lease epoch" in by_line[35]
+    # the interprocedural hop: _apply's fence parameter obligates the caller
+    assert "fence parameter 'fence' at its default" in by_line[54]
+    # fenced_write, the lease-path write, good_caller, and the non-lead
+    # offline_tool must all stay quiet
+    assert not any(f.line in (39, 43, 51, 64) for f in fs)
+
+
+def test_fence_cross_module_obligation():
+    # the fence obligation exists only when both halves are in the file set:
+    # the sink lives in mod_b, the lead-path entry + the defaulted call in mod_a
+    fs = lint_paths(
+        [fixture("fence_mod_a.py"), fixture("fence_mod_b.py")],
+        checks=["fence-discipline"],
+    )
+    assert [(os.path.basename(f.path), f.line) for f in fs] == [("fence_mod_a.py", 25)]
+    assert "apply_meta()'s fence parameter 'fence'" in fs[0].message
+    # each file alone shows nothing: mod_b's helper is not an entry, and
+    # mod_a's call into the missing module resolves to no edge
+    assert lint_paths([fixture("fence_mod_a.py")], checks=["fence-discipline"]) == []
+    assert lint_paths([fixture("fence_mod_b.py")], checks=["fence-discipline"]) == []
+
+
+def test_typed_error_fixture_findings():
+    fs = findings_for("typed_error_fixture.py", checks=["typed-error-boundary"])
+    assert lines_of(fs, "typed-error-boundary") == [30, 73]
+    by_line = {f.line: f.message for f in fs}
+    # the finding lands at the ORIGIN raise, two helpers below the handler
+    assert "NakedError" in by_line[30] and "do_GET" in by_line[30]
+    assert "via _middle -> _inner" in by_line[30]
+    assert "do_DELETE" in by_line[73]
+    # registered (TypedError), specifically-caught (CaughtError), and
+    # builtin (ValueError) raises must all stay quiet
+    for clean in ("TypedError", "CaughtError", "ValueError"):
+        assert not any(f"raise {clean}" in f.message for f in fs)
+
+
+def test_typed_error_silent_without_registry():
+    # no `class QueryErrorCode` in the file set -> the checker stays silent
+    # (golden fixtures carry their own registry; this one does not)
+    fs = findings_for("async_fixture.py", checks=["typed-error-boundary"])
+    assert fs == []
+
+
+def test_async_fixture_findings():
+    fs = findings_for("async_fixture.py", checks=["event-loop-safety"])
+    assert lines_of(fs, "event-loop-safety") == [16, 20, 24, 44, 45, 57]
+    by_line = {f.line: f.message for f in fs}
+    assert "time.sleep()" in by_line[16] and "direct_block" in by_line[16]
+    # interprocedural: the finding sits at the call, citing the chain
+    assert "via sync_slow" in by_line[20]
+    assert "subprocess.run()" in by_line[24]  # loop-only blocking set
+    assert "threading lock" in by_line[44]
+    assert "await while holding" in by_line[45]
+    assert "never awaited" in by_line[57] and "background_refresh" in by_line[57]
+
+
+def test_async_sanctioned_shapes_stay_quiet():
+    fs = findings_for("async_fixture.py", checks=["event-loop-safety"])
+    # executor hand-offs, asyncio.Lock, and scheduler hand-off are clean
+    for clean in ("executor_ok", "to_thread_ok", "async_lock_ok", "scheduled_ok"):
+        assert not any(clean in f.message for f in fs)
+
+
+@pytest.mark.parametrize(
+    "name, checks, suppressed_line",
+    [
+        ("fence_fixture.py", ["fence-discipline"], 57),
+        ("typed_error_fixture.py", ["typed-error-boundary"], 53),
+        ("async_fixture.py", ["event-loop-safety"], 66),
+    ],
+)
+def test_v3_suppressions(name, checks, suppressed_line):
+    fs = findings_for(name, checks=checks)
+    assert suppressed_line not in {f.line for f in fs}
+
+
+def test_v3_checkers_registered():
+    for name in ("fence-discipline", "typed-error-boundary", "event-loop-safety"):
+        assert name in ALL_CHECKERS
+
+
+def test_fence_mutation_is_caught(tmp_path):
+    # the proof the checker guards the real invariant: copy the package,
+    # strip ONE fence= from a real lead-path store call, and the checker
+    # must catch exactly that site (the unmutated copy stays clean)
+    import shutil
+
+    tree = tmp_path / "pinot_tpu"
+    shutil.copytree(PACKAGE, tree)
+    assert lint_paths([str(tree)], checks=["fence-discipline"]) == []
+    target = tree / "cluster" / "rebalance.py"
+    src = target.read_text()
+    mutated = src.replace(", fence=controller.lease_fence()", "")
+    assert mutated != src  # the mutation actually landed
+    target.write_text(mutated)
+    fs = lint_paths([str(tree)], checks=["fence-discipline"])
+    assert len(fs) == 1, "\n".join(str(f) for f in fs)
+    assert fs[0].path.endswith("rebalance.py")
+    assert "omits fence=" in fs[0].message
+
+
+# ---------------------------------------------------------------------------
 # suppressions
 # ---------------------------------------------------------------------------
 
@@ -415,6 +529,65 @@ def test_checked_in_baseline_is_empty():
     with open(os.path.join(REPO, "pinot_tpu", "devtools", "lint", "baseline.json")) as f:
         doc = json.load(f)
     assert doc == {"version": 1, "findings": []}
+
+
+# ---------------------------------------------------------------------------
+# --diff: whole-program analysis, changed-lines-only reporting
+# ---------------------------------------------------------------------------
+
+
+def _git(cwd, *args: str):
+    return subprocess.run(
+        ["git", "-C", str(cwd), *args], capture_output=True, text=True
+    )
+
+
+def _diff_repo(tmp_path):
+    repo = tmp_path / "repo"
+    repo.mkdir()
+    _git(repo, "init", "-q")
+    _git(repo, "config", "user.email", "lint@test")
+    _git(repo, "config", "user.name", "lint test")
+    return repo
+
+
+def test_cli_diff_reports_only_changed_lines(tmp_path):
+    repo = _diff_repo(tmp_path)
+    target = repo / "errcode_fixture.py"
+    with open(fixture("errcode_fixture.py")) as f:
+        original = f.read()
+    target.write_text(original)
+    _git(repo, "add", "."), _git(repo, "commit", "-qm", "seed")
+    # unmodified tree: every finding is on an unchanged line -> clean
+    proc = _cli("--check", "error-code-registry", "--diff", "HEAD", str(target))
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    # append ONE new violation: only it reports, the four old ones stay out
+    mutated = original + "\n\ndef added():\n    return {'errorCode': 250}\n"
+    target.write_text(mutated)
+    proc = _cli("--check", "error-code-registry", "--diff", "HEAD", str(target))
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    lines = [l for l in proc.stdout.splitlines() if "[error-code-registry]" in l]
+    assert len(lines) == 1  # exactly the new line; the four old ones stay out
+    assert f":{len(mutated.splitlines())}:" in lines[0]  # the appended return line
+
+
+def test_cli_diff_untracked_file_reports_full(tmp_path):
+    repo = _diff_repo(tmp_path)
+    (repo / "seed.py").write_text("x = 1\n")
+    _git(repo, "add", "."), _git(repo, "commit", "-qm", "seed")
+    target = repo / "errcode_fixture.py"
+    with open(fixture("errcode_fixture.py")) as f:
+        target.write_text(f.read())
+    proc = _cli("--check", "error-code-registry", "--diff", "HEAD", str(target))
+    assert proc.returncode == 1
+    assert len([l for l in proc.stdout.splitlines() if "[error-code-registry]" in l]) == 4
+
+
+def test_cli_diff_bad_ref_is_usage_error():
+    proc = _cli("--check", "error-code-registry", "--diff", "no-such-ref",
+                fixture("errcode_fixture.py"))
+    assert proc.returncode == 2
+    assert "no-such-ref" in proc.stderr
 
 
 # ---------------------------------------------------------------------------
