@@ -163,6 +163,10 @@ class Broker:
             enabled=self.obs_config.kernel_obs_enabled,
             hbm_peak_gbps=self.obs_config.hbm_peak_gbps,
         )
+        # scan-path attribution shares the same deployment entry point
+        from pinot_tpu.query import scan_stats
+
+        scan_stats.configure(self.obs_config.scan_obs_enabled)
         if self.obs_config.profiler_enabled:
             from pinot_tpu.common.profiler import maybe_start_profiler
 
@@ -774,6 +778,11 @@ class Broker:
             if st is not None:
                 entry["deviceMs"] = st.get("deviceMs", 0.0)
                 entry["peakHbmBytes"] = st.get("peakHbmBytes", 0)
+        if getattr(result, "scan_profile", None):
+            # scan-path attribution: which index class served each predicate,
+            # entries examined, and any full-scan fallbacks — "was the slow
+            # query slow because it scanned?"
+            entry["scanProfile"] = result.scan_profile
         if result.trace_id:
             # exemplar: join the slow-query log entry to /debug/traces/{id}
             entry["traceId"] = result.trace_id
@@ -1047,15 +1056,24 @@ class Broker:
             # (StreamingReduceService parity)
             return self._execute_streaming(ctx, legs, all_meta, t0, partial=partial)
 
+        from pinot_tpu.query import scan_stats
+
         partials, scanned, queried, pruned = [], 0, 0, 0
+        scan = scan_stats.new_scan_summary()
         for leg_table, leg_sql in legs:
             if deadline is not None:
                 deadline.check(f"scatter {leg_table}")
-            p, s, q, pr = self._scatter_leg(ctx, leg_table, leg_sql, partial=partial)
+            p, s, q, pr, leg_scan = self._scatter_leg(ctx, leg_table, leg_sql, partial=partial)
             partials.extend(p)
             scanned += s
             queried += q
             pruned += pr
+            scan_stats.merge_scan_summaries(scan, leg_scan)
+        if pruned:
+            # broker-side routing prunes (min-max metadata / partition) are
+            # value-based; server-side reasons arrive via the scan summary
+            scan["prunedByReason"]["value"] = scan["prunedByReason"].get("value", 0) + pruned
+        by_reason = scan["prunedByReason"]
 
         with phase_timer(ServerQueryPhase.BROKER_REDUCE, role="broker"):
             rows = QueryEngine.reduce(ctx, partials)
@@ -1065,7 +1083,13 @@ class Broker:
             num_docs_scanned=int(scanned),
             total_docs=sum(m.get("numDocs", 0) for m in all_meta.values()),
             num_segments_queried=queried,
-            num_segments_pruned=pruned,
+            num_segments_pruned=sum(by_reason.values()),
+            num_segments_pruned_by_value=by_reason.get("value", 0),
+            num_segments_pruned_by_bloom=by_reason.get("bloom", 0),
+            num_segments_pruned_by_geo=by_reason.get("geo", 0),
+            num_entries_scanned_in_filter=scan["entriesInFilter"],
+            num_entries_scanned_post_filter=scan["entriesPostFilter"],
+            scan_profile=scan,
             time_used_ms=(time.perf_counter() - t0) * 1e3,
         )
 
@@ -1077,9 +1101,11 @@ class Broker:
         gathered. Connection failures fail over to a surviving replica once,
         like the non-streaming scatter; under allowPartialResults a failed
         failover degrades to the rows gathered so far instead of raising."""
+        from pinot_tpu.query import scan_stats
+
         need = ctx.offset + ctx.limit
         rows: list[list] = []
-        state = {"scanned": 0, "frames": 0}
+        state = {"scanned": 0, "frames": 0, "scan": scan_stats.new_scan_summary()}
         queried = 0
         pruned = 0
         for leg_table, leg_sql in legs:
@@ -1133,13 +1159,26 @@ class Broker:
             if len(rows) >= need:
                 break
         rows = rows[ctx.offset : need]
+        scan = state["scan"]
+        if pruned:
+            # broker-side routing prunes are value-based (min-max/partition
+            # metadata); streamed servers skip pruned segments silently, so
+            # only the broker's own count contributes here
+            scan["prunedByReason"]["value"] = scan["prunedByReason"].get("value", 0) + pruned
+        by_reason = scan["prunedByReason"]
         return build_result(
             ctx,
             rows,
             num_docs_scanned=int(state["scanned"]),
             total_docs=sum(m.get("numDocs", 0) for m in all_meta.values()),
             num_segments_queried=queried,
-            num_segments_pruned=pruned,
+            num_segments_pruned=sum(by_reason.values()),
+            num_segments_pruned_by_value=by_reason.get("value", 0),
+            num_segments_pruned_by_bloom=by_reason.get("bloom", 0),
+            num_segments_pruned_by_geo=by_reason.get("geo", 0),
+            num_entries_scanned_in_filter=scan["entriesInFilter"],
+            num_entries_scanned_post_filter=scan["entriesPostFilter"],
+            scan_profile=scan,
             num_stream_frames=state["frames"],
             time_used_ms=(time.perf_counter() - t0) * 1e3,
         )
@@ -1211,9 +1250,16 @@ class Broker:
                 msg = out_q.get()
             kind = msg[0]
             if kind == "frame":
-                frame, matched, _seg_docs = msg[1]
+                item = msg[1]
+                frame, matched = item[0], item[1]
                 state["frames"] += 1
                 state["scanned"] += int(matched)
+                # a segment's scan record rides only its first frame (4th
+                # element), so chunked segments never double-count
+                if len(item) > 3 and item[3] and "scan" in state:
+                    from pinot_tpu.query import scan_stats
+
+                    scan_stats.fold_segment_stats(state["scan"], item[3])
                 if error is None and hasattr(frame, "values") and len(frame):
                     rows.extend(frame.values.tolist())
                 if len(rows) >= need:
@@ -1283,7 +1329,8 @@ class Broker:
         """One route + scatter pass: prune on stats/partitions, select
         replicas (excluding failure-detected servers), fan out, retry
         connection failures on other replicas once. Returns
-        (partials, scanned, num_segments_queried, num_segments_pruned).
+        (partials, scanned, num_segments_queried, num_segments_pruned,
+        scan_summary).
         When `partial` allows it, a failed failover records the loss and the
         reduce proceeds over the partials that did arrive."""
         from pinot_tpu.cluster.routing import AdaptiveServerSelector
@@ -1372,7 +1419,10 @@ class Broker:
                     partial.record(f"retry failed for server {f[1]}: {f[3]}")
             results.extend(retry_results)
 
+        from pinot_tpu.query import scan_stats
+
         partials, scanned = [], 0
+        scan = scan_stats.new_scan_summary()
         for out in results:
             partials.extend(out[0])
             scanned += out[1]
@@ -1380,7 +1430,11 @@ class Broker:
             # in-process handles share our trace and return the bare triple
             if len(out) > 3 and out[3] and trace is not None:
                 trace.add_remote(out[3])
-        return partials, scanned, n_candidates, pruned
+            # 5th element: the server's scan-path summary. The hedged path
+            # returns only the winning leg's tuple, so stats never double-count.
+            if len(out) > 4:
+                scan_stats.merge_scan_summaries(scan, out[4])
+        return partials, scanned, n_candidates, pruned, scan
 
     def _execute_multistage(self, stmt, sql: str, deadline=None, qid=None) -> ResultTable:
         """Dispatch the v2 engine over one replica of each segment.

@@ -822,6 +822,22 @@ class ServerHTTPService:
                     except ValueError:
                         top = 10
                     _send_json(self, KERNELS.roofline(top=top))
+                elif self.path.partition("?")[0] == "/debug/segments":
+                    # per-segment heat map (common/segment_heat.py): query
+                    # count, docs scanned, bytes touched, decaying heat —
+                    # ranked hot→cold; ?cold=true inverts for eviction
+                    # candidates, ?top=N bounds the list
+                    from pinot_tpu.common.segment_heat import HEAT
+
+                    from urllib.parse import parse_qs
+
+                    qs = parse_qs(self.path.partition("?")[2])
+                    try:
+                        top = int(qs.get("top", ["0"])[0]) or None
+                    except ValueError:
+                        top = None
+                    cold = qs.get("cold", ["false"])[0].lower() in ("1", "true", "yes")
+                    _send_json(self, HEAT.snapshot(top=top, cold=cold))
                 elif self.path == "/debug/frontend":
                     # request-lifecycle & transport plane (server role)
                     _send_json(
@@ -1009,8 +1025,10 @@ class RemoteServerClient:
     def execute_partials_stream(
         self, table: str, sql: str, segment_names: list[str], hints: dict | None = None, max_rows: int | None = None
     ):
-        """Generator over streamed (frame, matched, seg_docs) tuples. Closing
-        the generator closes the HTTP response, telling the server to stop."""
+        """Generator over streamed (frame, matched, seg_docs, seg_scan)
+        tuples — seg_scan is the segment's scan record on its first frame,
+        None on later chunks. Closing the generator closes the HTTP
+        response, telling the server to stop."""
         import struct as _struct
 
         hints = dict(hints or {})

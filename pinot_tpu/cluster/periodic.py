@@ -391,11 +391,16 @@ class ClusterMetricsAggregator(ControllerPeriodicTask):
                 except Exception:  # noqa: BLE001  # pinotlint: disable=deadline-swallow — exemplars are best-effort garnish on the scrape
                     slow = []
             roofline = []
+            segments = []
             if ep["role"] == "server":
                 try:
                     roofline = (json.loads(self.fetch(f"{base}/debug/roofline")) or {}).get("kernels") or []
                 except Exception:  # noqa: BLE001  # pinotlint: disable=deadline-swallow — optional surface; a node without /debug/roofline still contributes metrics
                     roofline = []
+                try:
+                    segments = (json.loads(self.fetch(f"{base}/debug/segments")) or {}).get("segments") or []
+                except Exception:  # noqa: BLE001  # pinotlint: disable=deadline-swallow — optional surface; a node without /debug/segments still contributes metrics
+                    segments = []
             frontend = None
             try:
                 # request-lifecycle/transport plane (latest-snapshot
@@ -405,10 +410,12 @@ class ClusterMetricsAggregator(ControllerPeriodicTask):
             except Exception:  # noqa: BLE001  # pinotlint: disable=deadline-swallow — optional surface; a node without /debug/frontend still contributes metrics
                 frontend = None
             return {"ok": True, "snapshot": snap, "workload": workload, "slow": slow,
-                    "roofline": roofline, "frontend": frontend, "error": None}
+                    "roofline": roofline, "segments": segments, "frontend": frontend,
+                    "error": None}
         except Exception as e:  # noqa: BLE001  # pinotlint: disable=deadline-swallow — the federated scrape must never raise: a down/malformed node marks its series stale and the sweep continues
             return {"ok": False, "snapshot": None, "workload": [], "slow": [],
-                    "roofline": [], "frontend": None, "error": f"{type(e).__name__}: {e}"}
+                    "roofline": [], "segments": [], "frontend": None,
+                    "error": f"{type(e).__name__}: {e}"}
 
     # -- fold -----------------------------------------------------------------
 
@@ -425,6 +432,9 @@ class ClusterMetricsAggregator(ControllerPeriodicTask):
             # the endpoint reports process-lifetime totals, so the newest
             # snapshot IS the accumulation (no delta fold)
             "roofline": [],
+            # latest per-segment heat rows from /debug/segments (same
+            # latest-snapshot semantics: the registry decays in place)
+            "segments": [],
             # latest /debug/frontend document (same latest-snapshot
             # semantics: connection gauges are live state, not counters)
             "frontend": None,
@@ -509,6 +519,7 @@ class ClusterMetricsAggregator(ControllerPeriodicTask):
                 else:
                     acc[f] += max(0, v - prev.get(f, 0))
         st["roofline"] = res.get("roofline") or st["roofline"]
+        st["segments"] = res.get("segments") or st["segments"]
         st["frontend"] = res.get("frontend") or st["frontend"]
 
         st["rawCounters"], st["rawBuckets"] = counters, buckets
@@ -897,6 +908,25 @@ class ClusterMetricsAggregator(ControllerPeriodicTask):
                     agg["deviceMs"] += float(r.get("deviceMs") or 0.0)
                     agg["bytesMoved"] += int(r.get("bytesMoved") or 0)
                     agg["flops"] += int(r.get("flops") or 0)
+            # merge per-server segment-heat rows by (table, segment): load
+            # counters sum across replicas (total cluster demand for that
+            # segment); bytesTouched is a per-copy size estimate, fold with
+            # max; recency takes the freshest replica
+            seg_heat: dict[tuple[str, str], dict] = {}
+            for s in self._nodes.values():
+                for r in s.get("segments") or []:
+                    key = (r.get("table") or "", r.get("segment") or "")
+                    agg = seg_heat.setdefault(
+                        key,
+                        {"queries": 0, "docsScanned": 0, "bytesTouched": 0,
+                         "deviceMs": 0.0, "heat": 0.0, "lastAccessMs": 0.0},
+                    )
+                    agg["queries"] += int(r.get("queries") or 0)
+                    agg["docsScanned"] += int(r.get("docsScanned") or 0)
+                    agg["bytesTouched"] = max(agg["bytesTouched"], int(r.get("bytesTouched") or 0))
+                    agg["deviceMs"] += float(r.get("deviceMs") or 0.0)
+                    agg["heat"] += float(r.get("heat") or 0.0)
+                    agg["lastAccessMs"] = max(agg["lastAccessMs"], float(r.get("lastAccessMs") or 0.0))
         from pinot_tpu.common.kernel_obs import KERNELS
 
         peak_gbps = KERNELS.hbm_peak_gbps
@@ -926,6 +956,23 @@ class ClusterMetricsAggregator(ControllerPeriodicTask):
             (r for r in roofline_rows if r["rooflineGap"] is not None),
             key=lambda r: -r["lostMs"],
         )[:10]
+        heat_rows = [
+            dict(agg, table=t, segment=seg, heat=round(agg["heat"], 6))
+            for (t, seg), agg in seg_heat.items()
+        ]
+        heat_rows.sort(key=lambda r: (r["heat"], r["lastAccessMs"]), reverse=True)
+        heats = [r["heat"] for r in heat_rows]
+        mean_heat = (sum(heats) / len(heats)) if heats else 0.0
+        segments_doc = {
+            "count": len(heat_rows),
+            "topHot": heat_rows[:10],
+            # coldest first: the eviction candidate order a cold tier would
+            # drain in (ROADMAP tiered-storage signal)
+            "topCold": list(reversed(heat_rows[-10:])),
+            # hottest-vs-mean ratio: >> 1 means a few segments carry the
+            # scan load (replication/placement skew worth rebalancing)
+            "heatSkew": round(heats[0] / mean_heat, 3) if heats and mean_heat > 0 else None,
+        }
         frontend_doc = {}
         for role, agg in sorted(fe_roles.items()):
             phases = {}
@@ -979,6 +1026,7 @@ class ClusterMetricsAggregator(ControllerPeriodicTask):
                     "kernels": roofline_rows,
                     "offenders": roofline_offenders,
                 },
+                "segments": segments_doc,
             },
             "rebalance": _rebalance_progress(),
             "controllerHa": self.controller.ha_status()

@@ -551,7 +551,7 @@ class Server:
         self._register_query(broker_qid, deadline)
         try:
             emitted = 0
-            for seg, partial, matched in eng.partials_iter(ctx, segs):
+            for seg, partial, matched, seg_scan in eng.partials_iter(ctx, segs):
                 try:
                     FAULTS.maybe_fail("stream.consume")
                 except InjectedFault:
@@ -564,15 +564,23 @@ class Server:
                     n = len(partial)
                     while start < n:
                         chunk = partial.iloc[start : start + self.STREAM_FRAME_ROWS]
-                        yield chunk, (matched if start == 0 else 0), (seg.n_docs if start == 0 else 0)
+                        # scan stats ride only the segment's FIRST frame (like
+                        # matched/seg_docs) so the broker fold never
+                        # double-counts a chunked segment
+                        yield (
+                            chunk,
+                            (matched if start == 0 else 0),
+                            (seg.n_docs if start == 0 else 0),
+                            (seg_scan if start == 0 else None),
+                        )
                         emitted += len(chunk)
                         start += self.STREAM_FRAME_ROWS
                         if max_rows is not None and emitted >= max_rows:
                             return
                     if n == 0:
-                        yield partial, matched, seg.n_docs
+                        yield partial, matched, seg.n_docs, seg_scan
                 else:
-                    yield partial, matched, seg.n_docs
+                    yield partial, matched, seg.n_docs, seg_scan
                 if max_rows is not None and emitted >= max_rows:
                     return
         finally:
@@ -604,7 +612,8 @@ class Server:
         self, table: str, sql: str, segment_names: list[str], hints: dict | None = None, workload: str = "PRIMARY"
     ):
         """Run the per-segment half for the requested segments; returns
-        (partials, matched_docs, total_docs). The broker passes hints (e.g.
+        (partials, matched_docs, total_docs, trace_subtree | None,
+        scan_summary). The broker passes hints (e.g.
         global percentile bounds) so partials merge across servers. With a
         scheduler configured, execution queues behind its policy; the caller
         blocks on the future (QueryScheduler.submit parity)."""
@@ -725,7 +734,7 @@ class Server:
                     return eng.partials(ctx, segs)
 
         try:
-            partials, matched = run_traced(local_tr, body) if local_tr is not None else body()
+            partials, matched, scan = run_traced(local_tr, body) if local_tr is not None else body()
         finally:
             self._unregister_query(broker_qid)
             if broker_qid and broker_qid != qid:
@@ -739,5 +748,7 @@ class Server:
         total = sum(s.n_docs for s in segs)
         if local_tr is not None:
             local_tr.root.duration_ms = local_tr.now_ms()
-            return partials, matched, total, local_tr.subtree()
-        return partials, matched, total
+            return partials, matched, total, local_tr.subtree(), scan
+        # uniform 5-tuple: element 3 (trace subtree) is None on the
+        # in-process path, element 4 carries the scan-path summary
+        return partials, matched, total, None, scan

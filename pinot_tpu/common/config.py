@@ -65,6 +65,10 @@ class ObservabilityConfig:
     #: heartbeat interval of the scheduling-lag probe (runtime.schedLagMs);
     #: <= 0 disables the probe thread
     sched_lag_interval_ms: float = 50.0
+    #: per-predicate scan-path attribution + segment heat accounting
+    #: (query/scan_stats.py, common/segment_heat.py). On by default — the
+    #: per-segment cost is a handful of dict writes after execution.
+    scan_obs_enabled: bool = True
 
     def to_dict(self) -> dict:
         return {
@@ -80,6 +84,7 @@ class ObservabilityConfig:
             "hbmPeakGBps": self.hbm_peak_gbps,
             "frontendObsEnabled": self.frontend_obs_enabled,
             "schedLagIntervalMs": self.sched_lag_interval_ms,
+            "scanObsEnabled": self.scan_obs_enabled,
         }
 
     @staticmethod
@@ -97,6 +102,7 @@ class ObservabilityConfig:
             d.get("hbmPeakGBps", 819.0),
             d.get("frontendObsEnabled", True),
             d.get("schedLagIntervalMs", 50.0),
+            d.get("scanObsEnabled", True),
         )
 
 

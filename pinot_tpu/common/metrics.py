@@ -522,6 +522,17 @@ class ServerMeter(Enum):
     MAILBOX_STRAGGLER_DROPS = "server.mailboxStragglerDrops"
 
 
+class ScanMeter(Enum):
+    #: scan-path plane (one series per table label; PREDICATES also carries
+    #: an index= label naming the access path that served the predicate)
+    PREDICATES = "server.scan.predicates"
+    ENTRIES_IN_FILTER = "server.scan.entriesInFilter"
+    ENTRIES_POST_FILTER = "server.scan.entriesPostFilter"
+    #: predicate full-scanned a column whose segment declares a usable index
+    #: (the offender signal: follow /debug/segments -> /debug/traces/{id})
+    FULL_SCAN_FALLBACK = "server.scan.fullScanFallback"
+
+
 class ServerHistogram(Enum):
     #: event-to-queryable latency: stream-producer stamp -> row visible in
     #: the consuming segment (freshness SLO input, one series per table)

@@ -1,0 +1,39 @@
+"""Index-probe attribution hook (dependency-free base layer).
+
+``segment/indexes.py`` cannot import ``query/scan_stats.py`` (the query
+package pulls the engine, which pulls the segment package — a cycle), so the
+contextvar collector the index filter entry points report into lives here.
+``query/scan_stats.py`` re-exports these names; everything above the segment
+layer should import them from there.
+
+Cost model: when nobody is collecting (the common case — scan observability
+folds probes only inside a query's resolve loop), ``record_index_probe`` is
+one contextvar read plus a None check, so index hot paths stay unburdened.
+"""
+
+from __future__ import annotations
+
+import contextvars
+from contextlib import contextmanager
+
+_PROBES: contextvars.ContextVar = contextvars.ContextVar(
+    "pinot_scan_probes", default=None
+)
+
+
+def record_index_probe(kind: str, entries: int) -> None:
+    """Called from index filter entry points: `entries` internal index
+    entries were examined to answer one probe.  No-op (one contextvar read)
+    unless a collector is installed."""
+    sink = _PROBES.get()
+    if sink is not None:
+        sink[kind] = sink.get(kind, 0) + int(entries)
+
+
+@contextmanager
+def collect_probes(sink: dict):
+    token = _PROBES.set(sink)
+    try:
+        yield sink
+    finally:
+        _PROBES.reset(token)

@@ -148,7 +148,7 @@ def read_table_via_servers(
             raise KeyError(f"segment owner {sid!r} not in controller instance registry")
         frames = []
         stream = handle.execute_partials_stream(table, sql, segs)
-        for frame, _matched, _docs in stream:
+        for frame, _matched, _docs, *_rest in stream:
             # in-process handles yield DataFrames; HTTP handles yield
             # decoded DataTables (columns + rows)
             if isinstance(frame, pd.DataFrame):

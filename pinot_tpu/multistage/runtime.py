@@ -1127,7 +1127,7 @@ def _try_leaf_device_partial(node: L.Aggregate, ctx: RunCtx) -> pd.DataFrame | N
     qctx.deadline = ctx.mailbox.deadline
     eng = QueryEngine(mine)
     try:
-        partials, _matched = eng.partials(qctx, mine)
+        partials, _matched, _scan = eng.partials(qctx, mine)
     except (QueryTimeoutError, QueryCancelledError, InjectedFault):
         raise  # deadline/cancel/chaos must fail the stage, not fall back
     except Exception:

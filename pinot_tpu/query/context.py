@@ -657,6 +657,21 @@ class QueryContext:
         _collect_filter_identifiers(self.having, out)
         return out
 
+    @property
+    def post_filter_columns(self) -> set[str]:
+        """Columns read AFTER the filter phase (projection, grouping,
+        ordering, having) — the multiplier behind Pinot's
+        numEntriesScannedPostFilter (docsMatched x projected columns)."""
+        out: set[str] = set()
+        for item in self.select_items:
+            _collect_identifiers(item.expr, out)
+        for g in self.group_by:
+            _collect_identifiers(g, out)
+        for o in self.order_by:
+            _collect_identifiers(o.expr, out)
+        _collect_filter_identifiers(self.having, out)
+        return out
+
     def output_name(self, item: SelectItem) -> str:
         return item.alias or canonical(item.expr)
 

@@ -122,52 +122,88 @@ class QueryEngine:
         return ctx
 
     def partials(self, ctx: QueryContext, segments: list[ImmutableSegment] | None = None):
-        """Server-side half: per-segment partials + matched doc count.
+        """Server-side half: (per-segment partials, matched doc count,
+        scan-path summary).
         (ServerQueryExecutorV1Impl role; the broker reduce consumes these.)"""
-        pend, pruned = self._dispatch_all(ctx, segments)
-        return self._resolve_partials(ctx, pend, pruned)
+        from pinot_tpu.query import scan_stats
 
-    def _dispatch_all(self, ctx: QueryContext, segments=None):
+        probes = self._new_probe_sink()
+        pend, pruned = self._dispatch_all(ctx, segments, probe_sink=probes)
+        out, scanned, summary = self._resolve_partials(ctx, pend, pruned)
+        scan_stats.merge_probe_sink(summary, probes)
+        return out, scanned, summary
+
+    def _new_probe_sink(self):
+        """A dict for index-probe entries recorded during dispatch-time
+        pruning (bloom membership, geo grid rejects), or None when scan
+        observability is off."""
+        from pinot_tpu.query import scan_stats
+
+        if scan_stats.enabled() and getattr(self, "scan_obs_enabled", True):
+            return {}
+        return None
+
+    def _dispatch_all(self, ctx: QueryContext, segments=None, probe_sink=None):
         """Prune + enqueue every segment's device program (non-blocking for
         the fused path; host fallbacks run inline). The ONE dispatch loop
-        shared by partials()/submit()/execute()."""
+        shared by partials()/submit()/execute(). Pruning-time index probes
+        (bloom/geo) collect into `probe_sink` when given."""
+        import contextlib
+
         from pinot_tpu.common.accounting import default_accountant
         from pinot_tpu.common.faults import FAULTS, InjectedFault
         from pinot_tpu.common.trace import trace_event
-        from pinot_tpu.query import pruner
+        from pinot_tpu.query import pruner, scan_stats
 
         pend: list = []
         pruned = 0
-        for seg in self.segments if segments is None else segments:
-            default_accountant.checkpoint()
-            if ctx.deadline is not None:
-                ctx.deadline.check(f"segment {seg.name}")
-            try:
-                FAULTS.maybe_fail("segment.execute")
-            except InjectedFault:
-                trace_event("fault.injected", point="segment.execute", segment=seg.name)
-                raise
-            if not pruner.can_match(seg, ctx):
-                # bloom/min-max pruned: contribute a canonical empty partial
-                pend.append((seg, ("pruned", pruner.empty_partial(ctx))))
-                pruned += 1
-            else:
-                pend.append((seg, self._dispatch_segment(seg, ctx)))
+        cm = (
+            scan_stats.collect_probes(probe_sink)
+            if probe_sink is not None
+            else contextlib.nullcontext()
+        )
+        with cm:
+            for seg in self.segments if segments is None else segments:
+                default_accountant.checkpoint()
+                if ctx.deadline is not None:
+                    ctx.deadline.check(f"segment {seg.name}")
+                try:
+                    FAULTS.maybe_fail("segment.execute")
+                except InjectedFault:
+                    trace_event("fault.injected", point="segment.execute", segment=seg.name)
+                    raise
+                reason = pruner.prune_reason(seg, ctx)
+                if reason is not None:
+                    # bloom/min-max/geo pruned: contribute a canonical empty
+                    # partial; the reject reason rides along for the per-reason
+                    # pruning funnel (numSegmentsPrunedByValue/ByBloom/ByGeo)
+                    pend.append((seg, ("pruned", pruner.empty_partial(ctx), reason)))
+                    pruned += 1
+                else:
+                    pend.append((seg, self._dispatch_segment(seg, ctx)))
         return pend, pruned
 
     def _resolve_partials(self, ctx: QueryContext, pend: list, pruned: int):
         """Sync + convert every pending dispatch; per-segment accounting
         checkpoint (the QueryKilledError enforcement point), tracing scope,
-        byte sampling, and segment meters — the ONE resolve loop."""
+        byte sampling, segment meters, and the scan-path/heat fold — the ONE
+        resolve loop.  Returns (partials, matched_docs, scan_summary)."""
         from pinot_tpu.common.accounting import default_accountant
-        from pinot_tpu.common.metrics import ServerMeter, server_metrics
-        from pinot_tpu.common.trace import InvocationScope
+        from pinot_tpu.common.metrics import ScanMeter, ServerMeter, server_metrics
+        from pinot_tpu.common.segment_heat import HEAT
+        from pinot_tpu.common.trace import InvocationScope, trace_event
+        from pinot_tpu.query import scan_stats
 
+        obs = scan_stats.enabled() and getattr(self, "scan_obs_enabled", True)
+        summary = scan_stats.new_scan_summary()
+        n_post = len(ctx.post_filter_columns) if obs else 0
         out = []
         scanned = 0
         for seg, disp in pend:
             if disp[0] == "pruned":
                 out.append(disp[1])  # no scan, no sample
+                if obs and len(disp) > 2:
+                    scan_stats.fold_prune(summary, disp[2])
                 continue
             default_accountant.checkpoint()
             if ctx.deadline is not None:
@@ -176,31 +212,78 @@ class QueryEngine:
             # sampleThreadCPUTime parity): thread_time_ns deltas exclude time
             # this thread spent descheduled or blocked
             t_cpu = time.thread_time_ns()
+            t_wall = time.perf_counter()
             with InvocationScope(f"segment:{seg.name}") as scope:
-                partial, matched = self._finish_segment(seg, ctx, disp)
+                if obs:
+                    with scan_stats.collect_probes(summary["indexProbeEntries"]):
+                        partial, matched = self._finish_segment(seg, ctx, disp)
+                else:
+                    partial, matched = self._finish_segment(seg, ctx, disp)
                 scope.set_attr("numDocsMatched", int(matched))
             default_accountant.sample(
                 segments=1,
                 allocated_bytes=seg.size_bytes,
                 cpu_ns=time.thread_time_ns() - t_cpu,
             )
+            if obs:
+                mode = "device" if disp[0] == "dev" else disp[3]
+                seg_stats = scan_stats.segment_scan_stats(ctx, seg, mode, int(matched), n_post)
+                scan_stats.fold_segment_stats(summary, seg_stats)
+                HEAT.record(
+                    ctx.table,
+                    seg.name,
+                    docs_scanned=int(matched),
+                    bytes_touched=seg.size_bytes,
+                    device_ms=(time.perf_counter() - t_wall) * 1e3,
+                )
+                if seg_stats["fullScanFallbacks"]:
+                    # offender hop for the roofline runbook: which predicate
+                    # full-scanned despite a declared usable index
+                    trace_event(
+                        "scan.fullScan",
+                        segment=seg.name,
+                        columns=",".join(
+                            sorted({f["column"] for f in seg_stats["fullScanFallbacks"]})
+                        ),
+                    )
             out.append(partial)
             scanned += int(matched)
         m = server_metrics()
         m.meter(ServerMeter.NUM_SEGMENTS_QUERIED).mark(len(pend) - pruned)
         if pruned:
             m.meter(ServerMeter.NUM_SEGMENTS_PRUNED).mark(pruned)
-        return out, scanned
+        if obs:
+            tbl = ctx.table
+            if summary["entriesInFilter"]:
+                m.meter(ScanMeter.ENTRIES_IN_FILTER, table=tbl).mark(summary["entriesInFilter"])
+            if summary["entriesPostFilter"]:
+                m.meter(ScanMeter.ENTRIES_POST_FILTER, table=tbl).mark(
+                    summary["entriesPostFilter"]
+                )
+            by_path: dict[str, int] = {}
+            for key, cnt in summary["predicates"].items():
+                path = key.rsplit(":", 1)[1]
+                by_path[path] = by_path.get(path, 0) + cnt
+            for path, cnt in by_path.items():
+                m.meter(ScanMeter.PREDICATES, table=tbl, index=path).mark(cnt)
+            n_fallback = sum(summary["fullScanFallbacks"].values())
+            if n_fallback:
+                m.meter(ScanMeter.FULL_SCAN_FALLBACK, table=tbl).mark(n_fallback)
+        return out, scanned, summary
 
     def partials_iter(self, ctx: QueryContext, segments: list[ImmutableSegment] | None = None):
         """Per-segment streaming variant of partials(): yields
-        (partial, matched) as each segment finishes, so callers can frame
-        results out incrementally and stop early (GrpcQueryServer.submit
-        streaming parity, core/transport/grpc/GrpcQueryServer.java:65,165)."""
+        (seg, partial, matched, scan_stats_or_None) as each segment finishes,
+        so callers can frame results out incrementally and stop early
+        (GrpcQueryServer.submit streaming parity,
+        core/transport/grpc/GrpcQueryServer.java:65,165)."""
         from pinot_tpu.common.faults import FAULTS, InjectedFault
+        from pinot_tpu.common.segment_heat import HEAT
         from pinot_tpu.common.trace import trace_event
-        from pinot_tpu.query import pruner
+        from pinot_tpu.query import pruner, scan_stats
 
+        obs = scan_stats.enabled() and getattr(self, "scan_obs_enabled", True)
+        n_post = len(ctx.post_filter_columns) if obs else 0
         for seg in self.segments if segments is None else segments:
             if ctx.deadline is not None:
                 ctx.deadline.check(f"segment {seg.name}")
@@ -211,8 +294,21 @@ class QueryEngine:
                 raise
             if not pruner.can_match(seg, ctx):
                 continue
-            partial, matched = self._execute_segment(seg, ctx)
-            yield seg, partial, int(matched)
+            disp = self._dispatch_segment(seg, ctx)
+            t_wall = time.perf_counter()
+            partial, matched = self._finish_segment(seg, ctx, disp)
+            seg_stats = None
+            if obs:
+                mode = "device" if disp[0] == "dev" else disp[3]
+                seg_stats = scan_stats.segment_scan_stats(ctx, seg, mode, int(matched), n_post)
+                HEAT.record(
+                    ctx.table,
+                    seg.name,
+                    docs_scanned=int(matched),
+                    bytes_touched=seg.size_bytes,
+                    device_ms=(time.perf_counter() - t_wall) * 1e3,
+                )
+            yield seg, partial, int(matched), seg_stats
 
     @staticmethod
     def reduce(ctx: QueryContext, partials: list) -> list[list]:
@@ -248,14 +344,33 @@ class QueryEngine:
 
             if any(startree_exec.matches(ctx, t) for t in st):
                 rows.append(["STARTREE_SWAP(pre-aggregated table scan)", 1, 0])
+                rows.extend(self._filter_attribution_rows(ctx, seg, "startree", rows))
                 return ResultTable(columns=["Operator", "Operator_Id", "Parent_Id"], rows=rows)
         try:
             plan = plan_segment(seg, ctx)
             rows.append(["DEVICE_FUSED_PROGRAM(segment=" + seg.name + ")", 1, 0])
             rows.extend(_describe_spec(plan.spec, next_id=2, parent=1))
+            rows.extend(self._filter_attribution_rows(ctx, seg, "device", rows))
         except DeviceFallback as e:
             rows.append([f"HOST_EXECUTOR(reason={e})", 1, 0])
+            rows.extend(self._filter_attribution_rows(ctx, seg, "host", rows))
         return ResultTable(columns=["Operator", "Operator_Id", "Parent_Id"], rows=rows)
+
+    @staticmethod
+    def _filter_attribution_rows(ctx: QueryContext, seg, mode: str, rows: list[list]) -> list[list]:
+        """Scan-path attribution lines for EXPLAIN: one FILTER_<PATH>(col)
+        row per filter predicate, parented at the execution node (id 1) —
+        which index class (or FULL_SCAN) serves each predicate under the
+        mode the first segment would execute in."""
+        from pinot_tpu.query import scan_stats
+
+        out = []
+        nid = max(r[1] for r in rows) + 1
+        for leaf in scan_stats.filter_leaves(ctx.filter):
+            col, path, _entries = scan_stats.classify_leaf(leaf, seg, mode)
+            out.append([f"FILTER_{path}({col})", nid, 1])
+            nid += 1
+        return out
 
     def _explain_analyze(self, ctx: QueryContext) -> ResultTable:
         """EXPLAIN ANALYZE: run the query under a private trace and annotate
@@ -268,14 +383,26 @@ class QueryEngine:
         t0 = time.perf_counter()
         with start_trace("explain-analyze") as tr:
             pend, pruned = self._dispatch_all(ctx)
-            partials, scanned = self._resolve_partials(ctx, pend, pruned)
+            partials, scanned, scan = self._resolve_partials(ctx, pend, pruned)
             out_rows = self.reduce(ctx, partials)
         wall_ms = (time.perf_counter() - t0) * 1e3
         rows = [list(r) for r in base.rows]
         rows[0][0] += (
             f" (rows={len(out_rows)}, docsScanned={int(scanned)},"
-            f" segmentsPruned={pruned}, timeMs={wall_ms:.2f})"
+            f" segmentsPruned={pruned},"
+            f" entriesInFilter={scan['entriesInFilter']},"
+            f" entriesPostFilter={scan['entriesPostFilter']}, timeMs={wall_ms:.2f})"
         )
+        # filter-plan attribution rows gain the measured entry counts
+        from pinot_tpu.query import scan_stats
+
+        for r in rows:
+            label = r[0]
+            if label.startswith("FILTER_") and label.endswith(")") and "(" in label:
+                path, _, col = label[len("FILTER_") : -1].partition("(")
+                if path in scan_stats.ALL_PATHS:
+                    entries = scan.get("predicateEntries", {}).get(f"{col}:{path}", 0)
+                    r[0] = f"{label} (entries={entries})"
         # per-segment spans become children of the execution root (the
         # DEVICE_FUSED_PROGRAM / HOST_EXECUTOR / STARTREE_SWAP row)
         exec_parent = rows[1][1] if len(rows) > 1 else rows[0][1]
@@ -317,17 +444,29 @@ class QueryEngine:
             return lambda: self.explain(ctx)
         if getattr(ctx.statement, "explain_analyze", False):
             return lambda: self._explain_analyze(ctx)
-        pend, pruned = self._dispatch_all(ctx)
+        probes = self._new_probe_sink()
+        pend, pruned = self._dispatch_all(ctx, probe_sink=probes)
 
         def resolve() -> ResultTable:
-            partials, scanned = self._resolve_partials(ctx, pend, pruned)
+            from pinot_tpu.query import scan_stats
+
+            partials, scanned, scan = self._resolve_partials(ctx, pend, pruned)
+            scan_stats.merge_probe_sink(scan, probes)
             rows = self.reduce(ctx, partials)
+            by_reason = scan["prunedByReason"]
             return reduce_mod.build_result(
                 ctx,
                 rows,
                 num_docs_scanned=int(scanned),
                 total_docs=sum(s.n_docs for s in self.segments),
                 num_segments_queried=len(self.segments),
+                num_segments_pruned=pruned,
+                num_segments_pruned_by_value=by_reason.get("value", 0),
+                num_segments_pruned_by_bloom=by_reason.get("bloom", 0),
+                num_segments_pruned_by_geo=by_reason.get("geo", 0),
+                num_entries_scanned_in_filter=scan["entriesInFilter"],
+                num_entries_scanned_post_filter=scan["entriesPostFilter"],
+                scan_profile=scan,
                 time_used_ms=(time.perf_counter() - t0) * 1e3,
             )
 
@@ -389,14 +528,15 @@ class QueryEngine:
 
             res = startree_exec.try_execute(self, seg, ctx)
             if res is not None:
-                return ("ready",) + res
+                # trailing element = execution mode, for scan-path attribution
+                return ("ready",) + res + ("startree",)
         vmask = valid(seg.n_docs) if valid is not None else None
         try:
             # plan_segment threads valid_docs into the kernel as a docmask
             # operand, so upsert tables run the fused device path too
             plan = plan_segment(seg, ctx, valid_mask=vmask)
         except DeviceFallback:
-            return ("ready",) + self._host_segment(seg, ctx, extra_mask=vmask)
+            return ("ready",) + self._host_segment(seg, ctx, extra_mask=vmask) + ("host",)
         return ("dev", plan, dispatch_plan_packed(plan, self._device_seg(seg)), vmask)
 
     def _finish_segment(self, seg: ImmutableSegment, ctx: QueryContext, disp):

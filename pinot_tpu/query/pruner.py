@@ -67,20 +67,33 @@ def _geo_rejects(seg: ImmutableSegment, f: ast.FilterExpr | None) -> bool:
     return False
 
 
-def filter_can_match(seg: ImmutableSegment, f: "ast.FilterExpr | None") -> bool:
-    """Segment-level pruning for a bare filter tree (min-max stats, bloom,
-    geo bbox) — shared by query execution and connector pushdown scans."""
+def filter_prune_reason(seg: ImmutableSegment, f: "ast.FilterExpr | None") -> str | None:
+    """Why this segment is pruned for a bare filter tree, or None when it
+    must execute.  Reasons mirror the reject sites: "value" (empty segment /
+    min-max interval miss), "bloom" (bloom filter proves no EQ/IN match),
+    "geo" (grid bbox farther than the probe radius).  These feed the
+    per-reason pruning funnel (numSegmentsPrunedByValue/ByBloom/ByGeo)."""
     from pinot_tpu.cluster.routing import segment_can_match
 
     if seg.n_docs == 0:
-        return False
+        return "value"
     if not segment_can_match(f, _stats_map(seg)):
-        return False
+        return "value"
     if _bloom_rejects(seg, f):
-        return False
+        return "bloom"
     if _geo_rejects(seg, f):
-        return False
-    return True
+        return "geo"
+    return None
+
+
+def filter_can_match(seg: ImmutableSegment, f: "ast.FilterExpr | None") -> bool:
+    """Segment-level pruning for a bare filter tree (min-max stats, bloom,
+    geo bbox) — shared by query execution and connector pushdown scans."""
+    return filter_prune_reason(seg, f) is None
+
+
+def prune_reason(seg: ImmutableSegment, ctx: QueryContext) -> str | None:
+    return filter_prune_reason(seg, ctx.filter)
 
 
 def can_match(seg: ImmutableSegment, ctx: QueryContext) -> bool:

@@ -22,6 +22,20 @@ class ResultTable:
     total_docs: int = 0
     num_segments_queried: int = 0
     num_segments_pruned: int = 0
+    # pruning funnel: numSegmentsPrunedByServer broken down by reject site;
+    # the lumped field above stays their sum (invariant asserted in tests)
+    num_segments_pruned_by_value: int = 0
+    num_segments_pruned_by_bloom: int = 0
+    num_segments_pruned_by_geo: int = 0
+    # scan-path plane (Pinot numEntriesScannedInFilter/PostFilter parity):
+    # filter-phase entries examined (index-served predicates contribute 0,
+    # FULL_SCAN contributes n_docs) and post-filter projection entries
+    # (docsMatched x projected columns)
+    num_entries_scanned_in_filter: int = 0
+    num_entries_scanned_post_filter: int = 0
+    # per-query scan attribution summary (query/scan_stats.py wire form);
+    # the slow-query log persists it as the `scanProfile` entry
+    scan_profile: dict | None = None
     # streamed selection path: how many wire frames carried the rows
     num_stream_frames: int = 0
     time_used_ms: float = 0.0
@@ -61,9 +75,16 @@ class ResultTable:
             "totalDocs": self.total_docs,
             "numSegmentsQueried": self.num_segments_queried,
             "numSegmentsPrunedByServer": self.num_segments_pruned,
+            "numSegmentsPrunedByValue": self.num_segments_pruned_by_value,
+            "numSegmentsPrunedByBloom": self.num_segments_pruned_by_bloom,
+            "numSegmentsPrunedByGeo": self.num_segments_pruned_by_geo,
+            "numEntriesScannedInFilter": self.num_entries_scanned_in_filter,
+            "numEntriesScannedPostFilter": self.num_entries_scanned_post_filter,
             "timeUsedMs": self.time_used_ms,
             "cacheHit": self.cache_hit,
         }
+        if self.scan_profile is not None:
+            d["scanProfile"] = self.scan_profile
         if self.trace is not None:
             d["traceInfo"] = self.trace
         if self.trace_id:

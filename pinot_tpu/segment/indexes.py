@@ -24,6 +24,7 @@ from dataclasses import dataclass
 
 import numpy as np
 
+from pinot_tpu.common.scan_probe import record_index_probe
 from pinot_tpu.query.sketches import murmur_mix32
 
 
@@ -58,6 +59,7 @@ class BloomFilter:
     def might_contain(self, value) -> bool:
         from pinot_tpu.query.sketches import hash_any
 
+        record_index_probe("bloom", self.n_hashes)
         m = np.uint64(len(self.bits) * 64)
         h1 = hash_any(np.asarray([value]))[0].astype(np.uint64)
         h2 = murmur_mix32(np.asarray([h1 ^ np.uint64(0x9E3779B9)], dtype=np.uint32))[0].astype(np.uint64)
@@ -89,12 +91,16 @@ class InvertedIndex:
         return InvertedIndex(offsets, order.astype(np.int32))
 
     def postings(self, dict_id: int) -> np.ndarray:
-        return np.sort(self.doc_ids[self.offsets[dict_id] : self.offsets[dict_id + 1]])
+        out = np.sort(self.doc_ids[self.offsets[dict_id] : self.offsets[dict_id + 1]])
+        record_index_probe("inverted", len(out))
+        return out
 
     def postings_for_many(self, ids: np.ndarray) -> np.ndarray:
         if len(ids) == 0:
             return np.empty(0, dtype=np.int32)
-        return np.sort(np.concatenate([self.doc_ids[self.offsets[i] : self.offsets[i + 1]] for i in ids]))
+        out = np.sort(np.concatenate([self.doc_ids[self.offsets[i] : self.offsets[i + 1]] for i in ids]))
+        record_index_probe("inverted", len(out))
+        return out
 
 
 # ---------------------------------------------------------------------------
@@ -118,6 +124,7 @@ class RangeIndex:
     def docs_in_range(self, lo, hi, lo_incl: bool = True, hi_incl: bool = True) -> np.ndarray:
         a = np.searchsorted(self.sorted_values, lo, side="left" if lo_incl else "right")
         b = np.searchsorted(self.sorted_values, hi, side="right" if hi_incl else "left")
+        record_index_probe("range", max(0, int(b) - int(a)))
         return np.sort(self.sorted_doc_ids[a:b])
 
 
@@ -245,6 +252,7 @@ class TextIndex:
         out = or_groups[0]
         for g in or_groups[1:]:
             out = out | g
+        record_index_probe("text", int(out.sum()))
         return out
 
 
@@ -401,6 +409,7 @@ class JsonIndex:
         out = parse_or()
         if pos != len(tokens):
             raise ValueError(f"JSON_MATCH: trailing tokens in {filter_str!r}")
+        record_index_probe("json", int(out.sum()))
         return out
 
 
@@ -463,8 +472,11 @@ class GeoGridIndex:
         idx = np.searchsorted(self.cells, wanted)
         hits = [i for w, i in zip(wanted, idx) if i < len(self.cells) and self.cells[i] == w]
         if not hits:
+            record_index_probe("geo", 0)
             return np.empty(0, dtype=np.int32)
-        return np.concatenate([self.doc_ids[self.offsets[i] : self.offsets[i + 1]] for i in hits])
+        out = np.concatenate([self.doc_ids[self.offsets[i] : self.offsets[i + 1]] for i in hits])
+        record_index_probe("geo", len(out))
+        return out
 
 
 def bbox_min_distance_m(bbox: tuple, qlat: float, qlng: float) -> float:
@@ -536,6 +548,7 @@ class VectorIndex:
         if qn > 0:
             q = q / qn
         scores = self.vectors @ q
+        record_index_probe("vector", len(scores))
         k = min(k, len(scores))
         if k == 0:
             return np.empty(0, dtype=np.int32)
@@ -669,6 +682,7 @@ class HnswIndex:
         for layer in range(len(self.graphs) - 1, 0, -1):
             ep = self._search_layer(q, ep, layer, 1)[0]
         cands = self._search_layer(q, ep, 0, max(self.EF_SEARCH, k))
+        record_index_probe("vector", len(cands))
         cands = np.asarray(cands[: max(k * 4, k)], dtype=np.int64)
         sims = self.vectors[cands] @ q
         order = np.argsort(-sims)[:k]
@@ -738,6 +752,7 @@ class FstIndex:
         key = ("F:" if full else "S:") + pattern
         hit = self._cache.get(key)
         if hit is not None:
+            record_index_probe("fst", 0)  # memoized: no dictionary walk
             return hit
         import re as _re
 
@@ -755,6 +770,7 @@ class FstIndex:
             lut = np.fromiter(
                 (bool(match(str(v))) for v in self.values), dtype=bool, count=len(self.values)
             )
+        record_index_probe("fst", len(self.values))
         self._cache[key] = lut
         return lut
 

@@ -128,6 +128,34 @@ class ImmutableSegment:
                 total += vals.nbytes
         return total
 
+    def declared_indexes(self) -> dict[str, list[str]]:
+        """Per-column declared index classes (scan-path attribution &
+        debug surfaces): which structures exist for each column, regardless
+        of whether a given query/mode actually uses them.  Geo entries keep
+        their composite "lat,lng" key."""
+        out: dict[str, list[str]] = {}
+
+        def add(col: str, cls: str) -> None:
+            out.setdefault(col, []).append(cls)
+
+        for col, ci in self.columns.items():
+            if ci.is_dict_encoded and not ci.is_mv and getattr(ci.stats, "is_sorted", False):
+                add(col, "SORTED_INDEX")
+        for extras_key, cls in (
+            ("inverted", "INVERTED_INDEX"),
+            ("range", "RANGE_INDEX"),
+            ("bloom", "BLOOM_FILTER"),
+            ("fst", "FST_INDEX"),
+            ("null", "NULL_INDEX"),
+            ("text", "TEXT_INDEX"),
+            ("json", "JSON_INDEX"),
+            ("vector", "VECTOR_INDEX"),
+            ("geo", "GEO_INDEX"),
+        ):
+            for col in self.extras.get(extras_key) or {}:
+                add(col, cls)
+        return out
+
     def to_device_cached(self) -> "DeviceSegment":
         """Memoized default staging (fast32=False). Callers outside a
         QueryEngine (e.g. the multistage leaf Scan) share one staged copy per

@@ -120,6 +120,30 @@ def test_explain_analyze_single_stage(setup):
     assert all(r[2] in ids or r[2] == -1 for r in res.rows)
 
 
+def test_explain_filter_attribution(setup):
+    """Each filter predicate gets a FILTER_<PATH>(col) row under the
+    segment operator; the plain fixture has no aux indexes, so both
+    predicates report FULL_SCAN."""
+    eng, _ = setup
+    res = eng.execute("EXPLAIN PLAN FOR SELECT COUNT(*) FROM t WHERE d = 'a' AND v > 10")
+    ops = [r[0] for r in res.rows]
+    assert "FILTER_FULL_SCAN(d)" in ops
+    assert "FILTER_FULL_SCAN(v)" in ops
+    ids = {r[1] for r in res.rows}
+    assert all(r[2] in ids or r[2] == -1 for r in res.rows)
+
+
+def test_explain_analyze_scan_annotations(setup):
+    """EXPLAIN ANALYZE: the root carries measured entry counts and each
+    FILTER_ row its per-predicate entries-examined figure."""
+    eng, seg = setup
+    res = eng.execute("EXPLAIN ANALYZE SELECT d, SUM(v) FROM t WHERE v > 10 GROUP BY d")
+    root = res.rows[0][0]
+    assert "entriesInFilter=" in root and "entriesPostFilter=" in root
+    flt = next(r[0] for r in res.rows if r[0].startswith("FILTER_FULL_SCAN(v)"))
+    assert f"(entries={seg.n_docs})" in flt
+
+
 def test_explain_analyze_multistage(setup):
     """EXPLAIN ANALYZE on the v2 engine: one row per physical operator with
     the merged runtime stats inline, stages stitched into one tree."""

@@ -1,0 +1,402 @@
+"""Scan-path & segment-heat observability: per-predicate access-path
+attribution verified against brute-force recounts, the pruning-funnel
+breakdown, the segment-heat registry (fold/decay/bound), the
+``/debug/segments`` surface, the cluster-level merge, and the full-scan
+fallback offender signal."""
+
+import json
+import urllib.request
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from pinot_tpu.common import DataType, IndexingConfig, Schema, TableConfig
+from pinot_tpu.common.config import ObservabilityConfig
+from pinot_tpu.common.segment_heat import HEAT, SegmentHeatRegistry
+from pinot_tpu.query import QueryEngine, scan_stats
+from pinot_tpu.query.context import QueryContext
+from pinot_tpu.segment import SegmentBuilder
+
+
+@pytest.fixture(scope="module")
+def indexed():
+    """3 segments x 2000 docs: inverted+bloom on city, range on temp,
+    'pop' deliberately index-free (the FULL_SCAN control column)."""
+    rng = np.random.default_rng(31)
+    schema = Schema.build(
+        "t",
+        dimensions=[("city", DataType.STRING)],
+        metrics=[("temp", DataType.DOUBLE), ("pop", DataType.LONG)],
+    )
+    cfg = TableConfig(
+        "t",
+        indexing=IndexingConfig(
+            bloom_filter_columns=["city"],
+            inverted_index_columns=["city"],
+            range_index_columns=["temp"],
+        ),
+    )
+    b = SegmentBuilder(schema, cfg)
+    segs, frames = [], []
+    pools = [["paris", "lyon"], ["oslo", "bergen"], ["tokyo", "kyoto"]]
+    for i, pool in enumerate(pools):
+        n = 2000
+        data = {
+            "city": np.asarray(pool, dtype=object)[rng.integers(0, 2, n)],
+            "temp": np.round(rng.normal(10 + 10 * i, 5, n), 2),
+            "pop": rng.integers(0, 1000, n).astype(np.int64),
+        }
+        segs.append(b.build(data, f"s{i}"))
+        frames.append(
+            pd.DataFrame({k: (v.astype(str) if v.dtype == object else v) for k, v in data.items()})
+        )
+    return QueryEngine(segs), pd.concat(frames, ignore_index=True), segs
+
+
+# ---------------------------------------------------------------------------
+# attribution vs brute-force recount (inverted / range / sorted / full scan)
+# ---------------------------------------------------------------------------
+
+
+def test_inverted_index_attribution_and_bloom_funnel(indexed):
+    eng, t, segs = indexed
+    res = eng.execute("SELECT COUNT(*) FROM t WHERE city = 'paris'")
+    assert res.rows == [[int((t["city"] == "paris").sum())]]
+    prof = res.scan_profile
+    # served by the inverted index: zero filter-phase entries examined
+    assert prof["predicates"] == {"city:INVERTED_INDEX": 1}
+    assert res.num_entries_scanned_in_filter == 0
+    # COUNT(*) projects nothing
+    assert res.num_entries_scanned_post_filter == 0
+    # pruning funnel: 'paris' exists only in s0. s1 (bergen..oslo) rejects
+    # on dictionary min-max ('paris' > 'oslo': value), s2 (kyoto..tokyo)
+    # straddles 'paris' so only its bloom filter rejects.
+    assert res.num_segments_pruned_by_value == 1
+    assert res.num_segments_pruned_by_bloom == 1
+    assert res.num_segments_pruned == (
+        res.num_segments_pruned_by_value
+        + res.num_segments_pruned_by_bloom
+        + res.num_segments_pruned_by_geo
+    )
+    # the index structure itself reported probe work (bloom membership +
+    # posting-list reads ride the contextvar hook)
+    assert prof["indexProbeEntries"].get("bloom", 0) > 0
+
+
+def test_range_index_attribution_and_value_funnel(indexed):
+    eng, t, segs = indexed
+    res = eng.execute("SELECT COUNT(*) FROM t WHERE temp < -2")
+    assert res.rows == [[int((t["temp"] < -2).sum())]]
+    prof = res.scan_profile
+    assert set(prof["predicates"]) == {"temp:RANGE_INDEX"}
+    assert res.num_entries_scanned_in_filter == 0
+    # s1 (mean 20) and s2 (mean 30) have min > -2: min-max value pruning
+    assert res.num_segments_pruned_by_value == 2
+    assert res.num_segments_pruned == 2
+
+
+def test_full_scan_recount_matches_brute_force(indexed):
+    eng, t, segs = indexed
+    res = eng.execute("SELECT city FROM t WHERE pop > 500 AND city = 'oslo' LIMIT 100000")
+    matched = int(((t["pop"] > 500) & (t["city"] == "oslo")).sum())
+    assert len(res.rows) == matched
+    prof = res.scan_profile
+    # pop has no index: every executed segment's docs are examined.
+    # Brute-force recount: bloom keeps only s1 for 'oslo'.
+    executed = [s for s in segs if "oslo" in set(s.columns["city"].materialize())]
+    assert prof["predicateEntries"]["pop:FULL_SCAN"] == sum(s.n_docs for s in executed)
+    assert prof["predicateEntries"]["city:INVERTED_INDEX"] == 0
+    assert res.num_entries_scanned_in_filter == sum(s.n_docs for s in executed)
+    # post-filter: matched docs x projected columns (city only)
+    assert res.num_entries_scanned_post_filter == matched * 1
+
+
+def test_sorted_index_attribution():
+    schema = Schema.build("ts", dimensions=[("k", DataType.STRING)], metrics=[("v", DataType.LONG)])
+    n = 600
+    # dict-encoded, single-value, sorted => SORTED_INDEX for eq and range
+    data = {
+        "k": np.sort(np.asarray([f"k{i % 7}" for i in range(n)], dtype=object)),
+        "v": np.arange(n, dtype=np.int64),
+    }
+    seg = SegmentBuilder(schema).build(data, "sorted0")
+    assert seg.columns["k"].stats.is_sorted
+    eng = QueryEngine([seg])
+    res = eng.execute("SELECT COUNT(*) FROM ts WHERE k = 'k3'")
+    assert res.scan_profile["predicates"] == {"k:SORTED_INDEX": 1}
+    assert res.num_entries_scanned_in_filter == 0
+    res2 = eng.execute("SELECT COUNT(*) FROM ts WHERE k > 'k3'")
+    assert res2.scan_profile["predicates"] == {"k:SORTED_INDEX": 1}
+
+
+def test_attribution_coverage_at_least_90pct(indexed):
+    """Acceptance floor: >=90% of filter predicates across a query battery
+    resolve to a named access path (FULL_SCAN counts as named)."""
+    eng, _t, _segs = indexed
+    battery = [
+        "SELECT COUNT(*) FROM t WHERE city = 'paris'",
+        "SELECT COUNT(*) FROM t WHERE city IN ('oslo', 'kyoto')",
+        "SELECT COUNT(*) FROM t WHERE temp BETWEEN 5 AND 25",
+        "SELECT COUNT(*) FROM t WHERE pop > 100",
+        "SELECT city, COUNT(*) FROM t WHERE temp < 20 AND pop <= 900 GROUP BY city",
+        "SELECT MAX(temp) FROM t WHERE city != 'lyon'",
+    ]
+    total = named = 0
+    for sql in battery:
+        prof = eng.execute(sql).scan_profile
+        for key, cnt in prof["predicates"].items():
+            total += cnt
+            if key.rsplit(":", 1)[1] in scan_stats.ALL_PATHS:
+                named += cnt
+    assert total > 0
+    assert named / total >= 0.9
+
+
+# ---------------------------------------------------------------------------
+# full-scan fallback offender signal
+# ---------------------------------------------------------------------------
+
+
+def test_full_scan_fallback_detected_on_host_mode(indexed):
+    """MODE() forces the host executor; city's inverted index goes unused,
+    which must surface as a full-scan fallback (the offender signal)."""
+    eng, _t, _segs = indexed
+    res = eng.execute("SELECT MODE(pop) FROM t WHERE city = 'paris'")
+    prof = res.scan_profile
+    assert prof["fullScanFallbacks"].get("city", 0) >= 1
+    assert prof["predicates"] == {"city:FULL_SCAN": 1}
+    assert res.num_entries_scanned_in_filter > 0
+
+
+def test_fallback_classification_unit(indexed):
+    _eng, _t, segs = indexed
+    ctx = QueryContext.from_sql("SELECT COUNT(*) FROM t WHERE city = 'paris'")
+    stats = scan_stats.segment_scan_stats(ctx, segs[0], "host", matched=5, n_post_cols=0)
+    assert stats["fullScanFallbacks"] == [{"column": "city", "missedIndex": "INVERTED_INDEX"}]
+    # device mode uses the structure: no fallback
+    stats_dev = scan_stats.segment_scan_stats(ctx, segs[0], "device", matched=5, n_post_cols=0)
+    assert stats_dev["fullScanFallbacks"] == []
+    assert stats_dev["predicates"][0]["path"] == "INVERTED_INDEX"
+    # star-tree answers every leaf from the tree
+    stats_st = scan_stats.segment_scan_stats(ctx, segs[0], "startree", matched=5, n_post_cols=0)
+    assert stats_st["predicates"][0]["path"] == "STARTREE_INDEX"
+
+
+# ---------------------------------------------------------------------------
+# EXPLAIN / EXPLAIN ANALYZE filter-plan lines
+# ---------------------------------------------------------------------------
+
+
+def test_explain_filter_attribution_lines(indexed):
+    eng, _t, _segs = indexed
+    res = eng.execute(
+        "EXPLAIN PLAN FOR SELECT COUNT(*) FROM t WHERE city = 'paris' AND temp < 50 AND pop > 10"
+    )
+    ops = [r[0] for r in res.rows]
+    assert "FILTER_INVERTED_INDEX(city)" in ops
+    assert "FILTER_RANGE_INDEX(temp)" in ops
+    assert "FILTER_FULL_SCAN(pop)" in ops
+
+
+def test_explain_analyze_carries_entry_counts(indexed):
+    eng, _t, segs = indexed
+    res = eng.execute("EXPLAIN ANALYZE SELECT COUNT(*) FROM t WHERE temp < 50 AND pop > 10")
+    ops = [r[0] for r in res.rows]
+    root = next(o for o in ops if o.startswith("BROKER_REDUCE"))
+    assert "entriesInFilter=" in root and "entriesPostFilter=" in root
+    full = next(o for o in ops if o.startswith("FILTER_FULL_SCAN(pop)"))
+    # measured: pop examined every doc of every executed segment
+    assert f"(entries={sum(s.n_docs for s in segs)})" in full
+    rng_line = next(o for o in ops if o.startswith("FILTER_RANGE_INDEX(temp)"))
+    assert "(entries=0)" in rng_line
+
+
+# ---------------------------------------------------------------------------
+# segment-heat registry: fold, decay, bound
+# ---------------------------------------------------------------------------
+
+
+def test_heat_fold_and_halflife_decay():
+    clock = [0.0]
+    reg = SegmentHeatRegistry(max_entries=8, halflife_s=10.0, now_fn=lambda: clock[0])
+    reg.record("t", "a", docs_scanned=100, bytes_touched=4096, device_ms=1.5)
+    snap = reg.snapshot()
+    row = snap["segments"][0]
+    assert row["heat"] == pytest.approx(1.0)
+    assert row["docsScanned"] == 100 and row["bytesTouched"] == 4096
+    # one half-life later the score halves; counters don't
+    clock[0] = 10.0
+    row = reg.snapshot()["segments"][0]
+    assert row["heat"] == pytest.approx(0.5, rel=1e-6)
+    assert row["queries"] == 1 and row["docsScanned"] == 100
+    assert row["idleS"] == pytest.approx(10.0)
+    # a fresh fold decays-then-adds
+    reg.record("t", "a")
+    assert reg.snapshot()["segments"][0]["heat"] == pytest.approx(1.5, rel=1e-6)
+
+
+def test_heat_ranking_and_cold_inversion():
+    clock = [0.0]
+    reg = SegmentHeatRegistry(now_fn=lambda: clock[0])
+    for _ in range(3):
+        reg.record("t", "hot")
+    reg.record("t", "warm")
+    clock[0] = 1.0
+    reg.record("t", "cold_but_recent")  # heat 1, newest access
+    hot_first = [r["segment"] for r in reg.snapshot()["segments"]]
+    assert hot_first[0] == "hot"
+    cold = reg.snapshot(cold=True)
+    assert cold["order"] == "cold"
+    assert [r["segment"] for r in cold["segments"]] == list(reversed(hot_first))
+    # top bounds the rows but count reports the full population
+    top = reg.snapshot(top=1)
+    assert len(top["segments"]) == 1 and top["count"] == 3
+
+
+def test_heat_bound_evicts_coldest():
+    clock = [0.0]
+    reg = SegmentHeatRegistry(max_entries=3, halflife_s=10.0, now_fn=lambda: clock[0])
+    reg.record("t", "old_once")  # heat 1 @ t=0
+    clock[0] = 10.0
+    reg.record("t", "b")
+    reg.record("t", "b")  # heat 2
+    reg.record("t", "c")  # heat 1 @ t=10; old_once decayed to 0.5
+    reg.record("t", "d")  # over bound: evicts the coldest (old_once)
+    names = {r["segment"] for r in reg.snapshot()["segments"]}
+    assert names == {"b", "c", "d"}
+
+
+# ---------------------------------------------------------------------------
+# /debug/segments HTTP surface
+# ---------------------------------------------------------------------------
+
+
+def test_debug_segments_http_endpoint():
+    from pinot_tpu.cluster.http import ServerHTTPService
+    from pinot_tpu.cluster.server import Server
+
+    HEAT.reset()
+    schema = Schema.build("h", dimensions=[("d", DataType.STRING)], metrics=[("v", DataType.LONG)])
+    rng = np.random.default_rng(5)
+    srv = Server("s1")
+    b = SegmentBuilder(schema)
+    for i in range(2):
+        data = {"d": rng.choice(["x", "y"], 300), "v": rng.integers(0, 50, 300)}
+        srv.add_segment_object("h", b.build(data, f"h{i}"))
+    # h0 is queried twice, h1 once: h0 must rank hotter
+    srv.execute_partials("h", "SELECT COUNT(*) FROM h WHERE v > 5", ["h0", "h1"])
+    srv.execute_partials("h", "SELECT COUNT(*) FROM h WHERE v > 40", ["h0"])
+    svc = ServerHTTPService(srv, port=0)
+    try:
+        base = f"http://127.0.0.1:{svc.port}"
+        doc = json.loads(urllib.request.urlopen(f"{base}/debug/segments").read())
+        assert doc["order"] == "hot" and doc["count"] == 2
+        assert [r["segment"] for r in doc["segments"]] == ["h0", "h1"]
+        assert doc["segments"][0]["queries"] == 2
+        assert doc["segments"][0]["docsScanned"] > 0
+        assert doc["segments"][0]["bytesTouched"] > 0
+        cold = json.loads(urllib.request.urlopen(f"{base}/debug/segments?cold=true&top=1").read())
+        assert cold["order"] == "cold"
+        assert [r["segment"] for r in cold["segments"]] == ["h1"]
+        assert cold["count"] == 2
+    finally:
+        svc.stop()
+        HEAT.reset()
+
+
+# ---------------------------------------------------------------------------
+# cluster merge (aggregator) + node-down retention
+# ---------------------------------------------------------------------------
+
+
+def _heat_row(table, segment, queries, heat, last_ms=1_000_000):
+    return {
+        "table": table, "segment": segment, "queries": queries,
+        "docsScanned": queries * 10, "bytesTouched": 1024,
+        "deviceMs": 0.5 * queries, "heat": heat, "lastAccessMs": last_ms, "idleS": 0.0,
+    }
+
+
+def test_cluster_merge_heat_skew_and_node_down(tmp_path):
+    from pinot_tpu.cluster.controller import Controller, PropertyStore
+    from pinot_tpu.cluster.periodic import ClusterMetricsAggregator
+
+    controller = Controller(PropertyStore(), tmp_path / "deep")
+    controller.register_server("server-0", None, host="server-0", port=80)
+    controller.register_server("server-1", None, host="server-1", port=80)
+
+    responses = {
+        # seg "shared" is replicated on both servers: cluster demand sums
+        "server-0": [_heat_row("t", "shared", 6, 6.0), _heat_row("t", "only0", 2, 2.0)],
+        "server-1": [_heat_row("t", "shared", 4, 4.0), _heat_row("t", "cold1", 1, 0.5)],
+    }
+
+    def fetch(url):
+        host = url.split("//")[1].split(":")[0]
+        r = responses[host]
+        if isinstance(r, Exception):
+            raise r
+        if "/metrics" in url:
+            return json.dumps({})
+        if "/debug/workload" in url:
+            return json.dumps({"rollups": []})
+        if "/debug/roofline" in url:
+            return json.dumps({"kernels": []})
+        if "/debug/segments" in url:
+            return json.dumps({"segments": r})
+        if "/debug/frontend" in url:
+            return json.dumps({})
+        raise AssertionError(f"unexpected scrape url {url}")
+
+    clock = [1000.0]
+    agg = ClusterMetricsAggregator(controller, fetch=fetch, now_fn=lambda: clock[0])
+    agg.run_once()
+    doc = agg.debug_cluster()["cluster"]["segments"]
+    assert doc["count"] == 3
+    by_seg = {r["segment"]: r for r in doc["topHot"]}
+    # replica rows merged by (table, segment): queries/heat sum across servers
+    assert by_seg["shared"]["queries"] == 10
+    assert by_seg["shared"]["heat"] == pytest.approx(10.0)
+    assert doc["topHot"][0]["segment"] == "shared"
+    assert doc["topCold"][0]["segment"] == "cold1"  # coldest first
+    # skew: hottest (10.0) vs mean ((10 + 2 + 0.5) / 3)
+    assert doc["heatSkew"] == pytest.approx(10.0 / (12.5 / 3), abs=1e-3)
+
+    # a dead node keeps its latest snapshot (latest-snapshot semantics):
+    # the merged view must not lose server-1's rows
+    responses["server-1"] = OSError("connection refused")
+    clock[0] += 10.0
+    agg.run_once()
+    doc2 = agg.debug_cluster()["cluster"]["segments"]
+    assert doc2["count"] == 3
+    assert {r["segment"] for r in doc2["topHot"]} == {"shared", "only0", "cold1"}
+
+
+# ---------------------------------------------------------------------------
+# config knob + disabled guard
+# ---------------------------------------------------------------------------
+
+
+def test_observability_config_scan_obs_roundtrip():
+    cfg = ObservabilityConfig(scan_obs_enabled=False)
+    d = cfg.to_dict()
+    assert d["scanObsEnabled"] is False
+    back = ObservabilityConfig.from_dict(d)
+    assert back.scan_obs_enabled is False
+    assert ObservabilityConfig.from_dict({}).scan_obs_enabled is True
+
+
+def test_scan_obs_disabled_guard(indexed):
+    eng, _t, _segs = indexed
+    scan_stats.configure(False)
+    try:
+        res = eng.execute("SELECT COUNT(*) FROM t WHERE pop > 500")
+        assert res.scan_profile["predicates"] == {}
+        assert res.num_entries_scanned_in_filter == 0
+        assert res.num_entries_scanned_post_filter == 0
+    finally:
+        scan_stats.configure(True)
+    res2 = eng.execute("SELECT COUNT(*) FROM t WHERE pop > 500")
+    # per segment execution: all 3 segments evaluate the predicate
+    assert res2.scan_profile["predicates"] == {"pop:FULL_SCAN": 3}
